@@ -1,0 +1,22 @@
+"""jepsen_tpu: a TPU-native distributed-systems-testing framework.
+
+Capability-equivalent to Jepsen (reference: /root/reference/jepsen): a test is
+a plain dict; a control node drives N db nodes over SSH; a purely-functional
+generator schedules concurrent client ops; a nemesis injects faults; the
+recorded history is verified by checkers. Unlike the reference (Clojure +
+JVM-hosted knossos/elle searches), the compute-bound checkers here run as
+batched JAX/XLA kernels on TPU, with CPU implementations kept as the
+differential-testing oracle.
+
+Layer map (mirrors SURVEY.md §1):
+  L0 control/        remote execution (Remote protocol: ssh/docker/k8s/dummy)
+  L1 db.py, os_setup/, net.py   environment automation
+  L2 core.py         orchestrator (run, analyze)
+  L3 nemesis/        fault injection
+  L4 generator/      pure scheduling DSL + threaded interpreter
+  L5 client.py       DB client protocol
+  L6 checker/, models/, ops/    analysis (TPU hot path)
+  L7 store.py, web.py, cli.py   persistence / reporting / CLI
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,259 @@
+"""CPU linearizability oracles.
+
+Two independent algorithms, mirroring the reference's knossos surface
+(jepsen/src/jepsen/checker.clj:185-216 dispatches :linear -> just-in-time
+linearization, :wgl -> Wing & Gong + Lowe bitset/memoization):
+
+* :func:`wgl` — Wing-Gong-Lowe DFS over an entry list with (bitset, model)
+  memoization, operating on op dicts + object Models. The ground-truth
+  oracle.
+* :func:`check_stream` — breadth-first just-in-time linearization over the
+  int-encoded :class:`~jepsen_tpu.checker.linear_encode.EventStream`. Shares
+  its encoding with the TPU kernel (jepsen_tpu.ops.jitlin), so it's the
+  bit-exact CPU twin used for differential testing of the device path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from jepsen_tpu.checker.linear_encode import EV_INVOKE, EV_NOOP, EV_RETURN, EventStream
+from jepsen_tpu.models import CAS_F_CAS, CAS_F_READ, CAS_F_WRITE, Model, is_inconsistent
+
+
+def cas_register_step_py(state: int, f: int, a: int, b: int) -> tuple[int, bool]:
+    """Pure-python twin of models.cas_register_spec().step_ids."""
+    if f == CAS_F_READ:
+        return state, (a == 0 or a == state)
+    if f == CAS_F_WRITE:
+        return a, True
+    if f == CAS_F_CAS:
+        if state == a:
+            return b, True
+        return state, False
+    return state, False
+
+
+# ---------------------------------------------------------------------------
+# Just-in-time linearization over an EventStream (the TPU kernel's CPU twin)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LinearResult:
+    valid: Any                 # True | False | "unknown"
+    failed_event: int = -1     # event index where the frontier died
+    failed_op_index: int = -1  # history index of that event's op
+    configs_max: int = 0       # peak frontier size (for K sizing on TPU)
+    algorithm: str = ""
+
+
+def check_stream(
+    stream: EventStream,
+    step: Callable[[int, int, int, int], tuple[int, bool]] = cas_register_step_py,
+    init_state: int = 0,
+) -> LinearResult:
+    """Breadth-first JIT linearization: configs are (linearized-pending
+    bitmask, state) pairs; closure is computed lazily before each return
+    event (Lowe's 'just-in-time linearization')."""
+    configs: set[tuple[int, int]] = {(0, init_state)}
+    cur: dict[int, tuple[int, int, int]] = {}
+    pending_mask = 0
+    configs_max = 1
+    for e in range(len(stream)):
+        kind = stream.kind[e]
+        if kind == EV_NOOP:
+            continue
+        s = int(stream.slot[e])
+        bit = 1 << s
+        if kind == EV_INVOKE:
+            cur[s] = (int(stream.f[e]), int(stream.a[e]), int(stream.b[e]))
+            pending_mask |= bit
+            continue
+        # EV_RETURN: closure, then require this op linearized
+        all_seen = set(configs)
+        frontier = configs
+        while frontier:
+            new = set()
+            for mask, state in frontier:
+                avail = pending_mask & ~mask
+                m = avail
+                while m:
+                    low = m & (-m)
+                    m ^= low
+                    sl = low.bit_length() - 1
+                    f, a, b2 = cur[sl]
+                    st2, ok = step(state, f, a, b2)
+                    if ok:
+                        c2 = (mask | low, st2)
+                        if c2 not in all_seen:
+                            all_seen.add(c2)
+                            new.add(c2)
+            frontier = new
+        configs_max = max(configs_max, len(all_seen))
+        configs = {(mask & ~bit, state) for (mask, state) in all_seen if mask & bit}
+        pending_mask &= ~bit
+        if not configs:
+            return LinearResult(
+                valid=False, failed_event=e,
+                failed_op_index=int(stream.op_index[e]),
+                configs_max=configs_max, algorithm="jitlin-cpu",
+            )
+    return LinearResult(valid=True, configs_max=configs_max, algorithm="jitlin-cpu")
+
+
+# ---------------------------------------------------------------------------
+# Wing-Gong-Lowe DFS over op dicts + object models (ground-truth oracle)
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("kind", "op_id", "op", "match", "prev", "next")
+
+    def __init__(self, kind, op_id, op):
+        self.kind = kind      # 0 invoke, 1 return
+        self.op_id = op_id
+        self.op = op
+        self.match = None
+        self.prev = None
+        self.next = None
+
+
+def _unlink(n: _Node):
+    n.prev.next = n.next
+    n.next.prev = n.prev
+
+
+def _relink(n: _Node):
+    n.prev.next = n
+    n.next.prev = n
+
+
+def _preprocess(history: list[dict]):
+    """Completes invocation values from returns, drops fail pairs and
+    crashed reads. Returns [(inv_op, completed?)] per live op in invocation
+    order plus their return positions (None = crashed)."""
+    open_inv: dict = {}
+    drop = set()
+    completed_value: dict[int, Any] = {}
+    returns: dict[int, int] = {}
+    for i, op in enumerate(history):
+        p, typ = op.get("process"), op.get("type")
+        if not isinstance(p, int) or p < 0:
+            drop.add(i)
+            continue
+        if typ == "invoke":
+            open_inv[p] = i
+        elif typ == "fail":
+            j = open_inv.pop(p, None)
+            if j is not None:
+                drop.add(j)
+            drop.add(i)
+        elif typ == "ok":
+            j = open_inv.pop(p, None)
+            if j is not None:
+                returns[j] = i
+                if op.get("value") is not None:
+                    completed_value[j] = op.get("value")
+        elif typ == "info":
+            j = open_inv.pop(p, None)
+            drop.add(i)
+            if j is not None and history[j].get("f") == "read":
+                drop.add(j)
+    for p, j in open_inv.items():
+        if history[j].get("f") == "read":
+            drop.add(j)
+    live = []
+    for i, op in enumerate(history):
+        if i in drop or op.get("type") != "invoke":
+            continue
+        o = dict(op)
+        if i in completed_value:
+            o["value"] = completed_value[i]
+        live.append((i, o, returns.get(i)))
+    return live
+
+
+def wgl(history: list[dict], model: Model, max_steps: int = 50_000_000) -> LinearResult:
+    """Wing & Gong DFS with Lowe's (linearized-bitset, state) memoization
+    (knossos.wgl equivalent). Crashed mutations may linearize at any later
+    point or never."""
+    live = _preprocess(history)
+    n = len(live)
+    if n == 0:
+        return LinearResult(valid=True, algorithm="wgl-cpu")
+
+    head = _Node(-1, -1, None)
+    tail = _Node(-2, -1, None)
+    head.next = tail
+    tail.prev = head
+
+    def insert_before(node, ref):
+        node.prev = ref.prev
+        node.next = ref
+        ref.prev.next = node
+        ref.prev = node
+
+    # interleave invoke/return nodes in history order; crashed returns at end
+    events: list[tuple[int, _Node]] = []
+    ok_ops = set()
+    for op_id, (hist_i, op, ret_i) in enumerate(live):
+        inv = _Node(0, op_id, op)
+        events.append((hist_i, inv))
+        if ret_i is not None:
+            ret = _Node(1, op_id, op)
+            inv.match = ret
+            ret.match = inv
+            events.append((ret_i, ret))
+            ok_ops.add(op_id)
+    events.sort(key=lambda t: t[0])
+    for _, node in events:
+        insert_before(node, tail)
+
+    ok_remaining = len(ok_ops)
+    linearized_mask = 0
+    seen: set[tuple[int, Model]] = set()
+    stack: list[tuple[_Node, Model]] = []
+    entry = head.next
+    steps = 0
+    max_lin = 0
+    while True:
+        steps += 1
+        if steps > max_steps:
+            return LinearResult(valid="unknown", algorithm="wgl-cpu",
+                                configs_max=len(seen))
+        if ok_remaining == 0:
+            return LinearResult(valid=True, algorithm="wgl-cpu",
+                                configs_max=len(seen))
+        if entry.kind == 0:  # invoke: candidate for linearization
+            m2 = entry.op and model.step(entry.op)
+            if not is_inconsistent(m2):
+                new_mask = linearized_mask | (1 << entry.op_id)
+                key = (new_mask, m2)
+                if key not in seen:
+                    seen.add(key)
+                    stack.append((entry, model))
+                    _unlink(entry)
+                    if entry.match is not None:
+                        _unlink(entry.match)
+                        ok_remaining -= 1
+                    model = m2
+                    linearized_mask = new_mask
+                    max_lin = max(max_lin, bin(new_mask).count("1"))
+                    entry = head.next
+                    continue
+            entry = entry.next
+        else:
+            # return entry of an unlinearized op (kind 1) or tail (kind -2):
+            # no way forward; backtrack
+            if not stack:
+                # report how far we got: first un-linearizable return
+                fail_op = entry.op_id if entry.kind == 1 else -1
+                hist_i = live[fail_op][0] if fail_op >= 0 else -1
+                return LinearResult(valid=False, failed_op_index=hist_i,
+                                    algorithm="wgl-cpu", configs_max=len(seen))
+            inv, model = stack.pop()
+            linearized_mask &= ~(1 << inv.op_id)
+            if inv.match is not None:
+                _relink(inv.match)
+                ok_remaining += 1
+            _relink(inv)
+            entry = inv.next

@@ -1,0 +1,183 @@
+"""History -> event-stream encoding for linearizability checking.
+
+Shared front-end for both the CPU oracle (linear_cpu) and the TPU kernel
+(jepsen_tpu.ops.jitlin). Capability-equivalent to the preprocessing knossos
+performs before its linear/wgl searches (invoked from the reference at
+jepsen/src/jepsen/checker.clj:185-216):
+
+* ``fail`` ops never happened: the invoke/fail pair is dropped.
+* ``info`` (crashed) ops may or may not have happened. Crashed *reads* have
+  no effect and are dropped; crashed mutations stay open forever (their
+  return is at infinity).
+* Each live op is assigned a small *slot* (reused after return), so a
+  configuration's "linearized pending ops" is a machine-word bitmask.
+
+Values are interned to dense int32 ids (id 0 = None) so the model transition
+is pure integer arithmetic on device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from jepsen_tpu.history import Intern
+from jepsen_tpu.models import CAS_F_CAS, CAS_F_READ, CAS_F_WRITE
+
+# event kinds
+EV_INVOKE, EV_RETURN, EV_NOOP = 0, 1, 2
+
+
+@dataclass
+class EventStream:
+    """Columnar event stream for one key's history."""
+
+    kind: np.ndarray   # int8: EV_INVOKE / EV_RETURN / EV_NOOP
+    slot: np.ndarray   # int32: pending-slot id
+    f: np.ndarray      # int32: model f code
+    a: np.ndarray      # int32: first interned arg
+    b: np.ndarray      # int32: second interned arg
+    op_index: np.ndarray  # int32: source history index (diagnostics)
+    n_slots: int
+    n_ops: int
+    intern: Intern = field(default_factory=Intern)
+
+    def __len__(self):
+        return len(self.kind)
+
+
+def encode_register_ops(history: list[dict], intern: Intern | None = None) -> EventStream:
+    """Encodes a single-register r/w/cas history (the reference tutorial's
+    etcd workload; BASELINE configs 1-3).
+
+    Op encodings (f, a, b):
+      read v  -> (CAS_F_READ, id(v), 0); a read of None (id 0) matches any state
+      write v -> (CAS_F_WRITE, id(v), 0)
+      cas [u,v] -> (CAS_F_CAS, id(u), id(v))
+    """
+    intern = intern or Intern()
+    kinds, slots, fs, as_, bs, idxs = [], [], [], [], [], []
+    open_by_process: dict = {}   # process -> (slot, op)
+    free_slots: list[int] = []
+    next_slot = 0
+    n_ops = 0
+
+    def encode_args(op):
+        f, v = op.get("f"), op.get("value")
+        if f == "read":
+            return CAS_F_READ, intern.id(v), 0
+        if f == "write":
+            return CAS_F_WRITE, intern.id(v), 0
+        if f == "cas":
+            u, w = v
+            return CAS_F_CAS, intern.id(u), intern.id(w)
+        raise ValueError(f"unknown register op {f!r}")
+
+    # First pass: pair invokes with completions; find fail pairs and crashed
+    # reads to drop; *complete* invocation values from their returns
+    # (knossos history/complete semantics — a read's definitive value
+    # arrives with its :ok, but the search consumes it at the invoke event).
+    drop = set()
+    open_inv: dict = {}
+    completed_value: dict[int, object] = {}  # invoke idx -> definitive value
+    for i, op in enumerate(history):
+        p, typ = op.get("process"), op.get("type")
+        if not isinstance(p, int) or p < 0:
+            drop.add(i)
+            continue
+        if typ == "invoke":
+            open_inv[p] = i
+        elif typ == "fail":
+            j = open_inv.pop(p, None)
+            if j is not None:
+                drop.add(j)
+            drop.add(i)
+        elif typ == "ok":
+            j = open_inv.pop(p, None)
+            if j is not None and op.get("value") is not None:
+                completed_value[j] = op.get("value")
+        elif typ == "info":
+            j = open_inv.pop(p, None)
+            drop.add(i)  # info completion itself is not an event
+            if j is not None and history[j].get("f") == "read":
+                drop.add(j)  # crashed reads have no effect
+    # ops still open at the end of history (no completion at all) crash too
+    for p, j in open_inv.items():
+        if history[j].get("f") == "read":
+            drop.add(j)
+
+    for i, op in enumerate(history):
+        if i in drop:
+            continue
+        p, typ = op.get("process"), op.get("type")
+        if typ == "invoke":
+            if free_slots:
+                s = free_slots.pop()
+            else:
+                s = next_slot
+                next_slot += 1
+            open_by_process[p] = (s, i)
+            inv = dict(op)
+            if i in completed_value:
+                inv["value"] = completed_value[i]
+            fcode, a, b = encode_args(inv)
+            kinds.append(EV_INVOKE)
+            slots.append(s)
+            fs.append(fcode)
+            as_.append(a)
+            bs.append(b)
+            idxs.append(i)
+            n_ops += 1
+        elif typ == "ok":
+            got = open_by_process.pop(p, None)
+            if got is None:
+                continue
+            s, j = got
+            kinds.append(EV_RETURN)
+            slots.append(s)
+            fs.append(0)
+            as_.append(0)
+            bs.append(0)
+            idxs.append(i)
+            free_slots.append(s)
+        # info: no return event — the crashed op's slot stays occupied
+        # forever, so it may be linearized at any later point or never.
+
+    return EventStream(
+        kind=np.array(kinds, dtype=np.int8),
+        slot=np.array(slots, dtype=np.int32),
+        f=np.array(fs, dtype=np.int32),
+        a=np.array(as_, dtype=np.int32),
+        b=np.array(bs, dtype=np.int32),
+        op_index=np.array(idxs, dtype=np.int32),
+        n_slots=max(next_slot, 1),
+        n_ops=n_ops,
+        intern=intern,
+    )
+
+
+def pad_streams(streams: list[EventStream], length: int | None = None) -> dict:
+    """Stacks several per-key event streams into one padded batch for vmap
+    (the jepsen.independent -> vmap mapping, SURVEY.md §2.6). Padding events
+    are EV_NOOP."""
+    if not streams:
+        raise ValueError("no streams")
+    E = length or max(len(s) for s in streams)
+    S = max(s.n_slots for s in streams)
+    B = len(streams)
+
+    def pad(arr, fill, dtype):
+        out = np.full((B, E), fill, dtype=dtype)
+        for i, s in enumerate(streams):
+            a = getattr(s, arr)
+            out[i, : len(a)] = a
+        return out
+
+    return {
+        "kind": pad("kind", EV_NOOP, np.int8),
+        "slot": pad("slot", 0, np.int32),
+        "f": pad("f", 0, np.int32),
+        "a": pad("a", 0, np.int32),
+        "b": pad("b", 0, np.int32),
+        "n_slots": S,
+    }

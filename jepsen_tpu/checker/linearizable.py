@@ -1,0 +1,112 @@
+"""Linearizability checker with an accelerator switch.
+
+Reference surface: jepsen.checker/linearizable (checker.clj:185-216), which
+dispatches on :algorithm to knossos's linear/wgl/competition searches. Here
+the dispatch axes are:
+
+* ``algorithm``: "wgl" (object-model DFS oracle), "jitlin" (int-encoded
+  breadth-first search — the TPU kernel's CPU twin), or "auto".
+* ``accelerator``: "cpu", "tpu" (any JAX device), or "auto" — the
+  :accelerator option called for by BASELINE.json's north star. "auto" uses
+  the device kernel for histories big enough to amortize compilation and
+  falls back to CPU when the device frontier overflows (mirroring the
+  reference's competition mode, checker.clj:199-203).
+
+Failure output is truncated (the reference truncates :final-paths/:configs
+to 10 because "Writing these can take *hours*", checker.clj:213-216).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.checker.linear_cpu import (
+    LinearResult, cas_register_step_py, check_stream, wgl,
+)
+from jepsen_tpu.checker.linear_encode import encode_register_ops
+from jepsen_tpu.models import CASRegister, Model
+
+# Histories below this many events run on CPU under accelerator="auto":
+# kernel launch + compile isn't worth it.
+AUTO_TPU_THRESHOLD = 512
+
+
+class LinearizableChecker(Checker):
+    def __init__(
+        self,
+        model: Model | None = None,
+        algorithm: str = "auto",
+        accelerator: str = "auto",
+        capacity: int = 256,
+    ):
+        self.model = model if model is not None else CASRegister()
+        self.algorithm = algorithm
+        self.accelerator = accelerator
+        self.capacity = capacity
+        self._kernel = None
+
+    def _tpu_kernel(self):
+        if self._kernel is None:
+            from jepsen_tpu.ops.jitlin import JitLinKernel
+            self._kernel = JitLinKernel()
+        return self._kernel
+
+    def check(self, test, history, opts):
+        algorithm = opts.get("algorithm", self.algorithm)
+        accelerator = opts.get("accelerator", self.accelerator)
+
+        if algorithm == "wgl":
+            return self._finish(wgl(history, self.model), history)
+
+        # jitlin path: encode once, run on device or host
+        if not isinstance(self.model, CASRegister):
+            # only the register family has an int encoding so far
+            return self._finish(wgl(history, self.model), history)
+        stream = encode_register_ops(history)
+        if accelerator == "cpu" or (
+            accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
+        ):
+            if algorithm == "auto" and len(stream) > 4096:
+                res = check_stream(stream)
+            elif algorithm in ("jitlin", "auto"):
+                res = check_stream(stream)
+            else:
+                res = wgl(history, self.model)
+            return self._finish(res, history)
+
+        # device path
+        from jepsen_tpu.ops.jitlin import verdict
+        alive, died, overflow, peak = self._tpu_kernel().check(
+            stream, capacity=self.capacity
+        )
+        valid = verdict(alive, overflow)
+        if valid == "unknown":
+            # frontier overflowed K and died: retry with the exact CPU twin
+            res = check_stream(stream)
+            res.algorithm = "jitlin-cpu(fallback)"
+            return self._finish(res, history)
+        res = LinearResult(
+            valid=valid,
+            failed_event=died,
+            failed_op_index=int(stream.op_index[died]) if died >= 0 else -1,
+            configs_max=peak,
+            algorithm="jitlin-tpu",
+        )
+        return self._finish(res, history)
+
+    def _finish(self, res: LinearResult, history) -> dict:
+        out: dict[str, Any] = {
+            "valid?": res.valid,
+            "algorithm": res.algorithm,
+            "configs-max": res.configs_max,
+        }
+        if res.valid is False and res.failed_op_index >= 0:
+            i = res.failed_op_index
+            lo = max(0, i - 5)
+            out["failed-op"] = history[i] if i < len(history) else None
+            out["context"] = history[lo : i + 1][-10:]
+        return out
+
+
+def linearizable(model=None, **kw) -> Checker:
+    return LinearizableChecker(model=model, **kw)

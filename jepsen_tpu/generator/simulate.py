@@ -1,0 +1,147 @@
+"""Deterministic generator simulation — the unit-test backbone.
+
+Equivalent capability to jepsen.generator.test (shipped in the reference's
+src/ as a public testing kit, jepsen/src/jepsen/generator/test.clj): a
+simulated scheduler with model workers of fixed latency, so generator
+behavior is asserted as exact op/time/process sequences without threads or a
+cluster (SURVEY.md §4 tier 1).
+"""
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu.generator import (
+    NEMESIS, PENDING, Context, as_gen, context, next_process,
+)
+from jepsen_tpu.utils import ms_to_nanos
+
+DEFAULT_TEST = {"concurrency": 2}
+
+
+def default_context(test: dict | None = None, seed: int = 0) -> Context:
+    """Two client workers plus nemesis, deterministic rng
+    (generator/test.clj:8-24, with-fixed-rand seeding :32-40)."""
+    return context(test or DEFAULT_TEST, rng=random.Random(seed))
+
+
+def simulate(
+    test: dict,
+    gen,
+    complete_fn: Callable[[Context, dict], dict | None],
+    ctx: Context | None = None,
+    limit: int = 100_000,
+) -> list[dict]:
+    """Simulates gen against model workers.
+
+    complete_fn(ctx, invoke_op) -> completion op (type ok/fail/info, with
+    :time set to when the worker would finish) or None for ops that never
+    complete. Pseudo-ops (:sleep/:log) occupy their thread for their
+    duration but do not enter the returned history.
+
+    Returns the full history: invokes and completions interleaved in time
+    order, with generator updates and crashed-process renumbering applied
+    exactly as the threaded interpreter would.
+    """
+    ctx = ctx or default_context(test)
+    g = as_gen(gen)
+    history: list[dict] = []
+    pending: list[dict] = []  # completion ops waiting for their time
+
+    def soonest_pending():
+        if not pending:
+            return None
+        return min(pending, key=lambda o: o["time"])
+
+    steps = 0
+    while steps < limit:
+        steps += 1
+        comp = soonest_pending()
+        res = g.op(test, ctx) if g is not None else None
+        if res is None:
+            if comp is None:
+                break
+            g2, ctx, done = _apply_completion(test, g, ctx, comp, history)
+            pending.remove(comp)
+            g = g2
+            continue
+        op, g_next = res
+        if op is PENDING:
+            if comp is None:
+                # Nothing will ever free a thread or advance time: deadlock.
+                break
+            g2, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+            pending.remove(comp)
+            g = g2
+            continue
+        if comp is not None and comp["time"] <= op["time"]:
+            g2, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+            pending.remove(comp)
+            continue
+        # dispatch the op
+        g = g_next
+        ctx = ctx.with_time(max(ctx.time, op["time"]))
+        thread = NEMESIS if op["process"] == NEMESIS else ctx.thread_of(op["process"])
+        ctx = ctx.busy_thread(thread)
+        if op["type"] in ("sleep", "log"):
+            dt = op["value"] if op["type"] == "sleep" else 0
+            completion = dict(op)
+            completion["time"] = op["time"] + ms_to_nanos(dt * 1000 if dt else 0)
+            completion["type"] = "__free__"
+            pending.append(completion)
+            if g is not None:
+                g = g.update(test, ctx, op)
+            continue
+        history.append(op)
+        if g is not None:
+            g = g.update(test, ctx, op)
+        completion = complete_fn(ctx, op)
+        if completion is not None:
+            pending.append(completion)
+    return history
+
+
+def _apply_completion(test, g, ctx, comp, history):
+    ctx = ctx.with_time(max(ctx.time, comp["time"]))
+    thread = NEMESIS if comp["process"] == NEMESIS else ctx.thread_of(comp["process"])
+    ctx = ctx.free_thread(thread)
+    if comp["type"] == "__free__":
+        return g, ctx, False
+    if comp["type"] == "info" and comp["process"] != NEMESIS:
+        # crashed: worker gets a fresh process id (generator.clj:519-527)
+        ctx = ctx.with_next_process(thread)
+    history.append(comp)
+    if g is not None:
+        g = g.update(test, ctx, comp)
+    return g, ctx, False
+
+
+def _completer(typ: str, latency_nanos: int):
+    def complete(ctx: Context, op: dict):
+        comp = dict(op)
+        comp["type"] = typ
+        comp["time"] = op["time"] + latency_nanos
+        return comp
+    return complete
+
+
+def quick(test: dict, gen, ctx: Context | None = None) -> list[dict]:
+    """Zero-latency :ok completions — the fastest way to see what a
+    generator emits (generator/test.clj quick)."""
+    return simulate(test, gen, _completer("ok", 0), ctx)
+
+
+def perfect(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0) -> list[dict]:
+    """Fixed-latency :ok completions (generator/test.clj perfect)."""
+    return simulate(test, gen, _completer("ok", ms_to_nanos(latency_ms)), ctx)
+
+
+def perfect_info(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0) -> list[dict]:
+    """Fixed-latency :info (crashed) completions — exercises process
+    renumbering (generator/test.clj perfect-info)."""
+    return simulate(test, gen, _completer("info", ms_to_nanos(latency_ms)), ctx)
+
+
+def invocations(history: list[dict]) -> list[dict]:
+    return [op for op in history if op.get("type") == "invoke"]

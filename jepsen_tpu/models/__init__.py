@@ -1,0 +1,238 @@
+"""Consistency models for linearizability checking.
+
+Equivalent capability to knossos.model (external dep of the reference,
+surface used at jepsen/src/jepsen/checker.clj:19-25,185-216 and
+jepsen/src/jepsen/tests/causal.clj:12-31): a Model is an immutable state
+machine; ``step(model, op)`` returns the next model or an ``Inconsistent``.
+
+Two forms exist side by side:
+
+* Object models (this module): the CPU oracle path. Hashable, immutable.
+* :class:`IntSpec` (int-encoded transition functions): the device path. A
+  model whose state and op arguments intern to int32 ids, with a pure
+  ``step_ids`` function traceable under jit/vmap — the form the TPU
+  just-in-time-linearization kernel (jepsen_tpu.ops.jitlin) consumes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class Inconsistent:
+    msg: str
+
+    def is_inconsistent(self) -> bool:
+        return True
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(m) -> bool:
+    return isinstance(m, Inconsistent)
+
+
+class Model:
+    """Immutable state machine. Subclasses must be hashable and implement
+    step(op) -> Model | Inconsistent."""
+
+    def step(self, op: dict) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class NoOp(Model):
+    """Accepts every op."""
+
+    def step(self, op):
+        return self
+
+
+@dataclass(frozen=True)
+class Register(Model):
+    """A read/write register (knossos.model/register)."""
+
+    value: Any = None
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "write":
+            return Register(v)
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"can't read {v!r} from register {self.value!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    """A register supporting read/write/cas (knossos.model/cas-register) —
+    the model of the reference tutorial's etcd test and BASELINE config 1-2.
+    cas value is a pair [old, new]."""
+
+    value: Any = None
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "write":
+            return CASRegister(v)
+        if f == "cas":
+            old, new = v
+            if old == self.value:
+                return CASRegister(new)
+            return inconsistent(f"can't CAS {self.value!r} from {old!r} to {new!r}")
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"can't read {v!r} from register {self.value!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    """A single mutex (knossos.model/mutex): acquire/release."""
+
+    locked: bool = False
+
+    def step(self, op):
+        f = op.get("f")
+        if f == "acquire":
+            if self.locked:
+                return inconsistent("already held")
+            return Mutex(True)
+        if f == "release":
+            if not self.locked:
+                return inconsistent("not held")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    """A FIFO queue: enqueue/dequeue (knossos.model/fifo-queue)."""
+
+    items: tuple = ()
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "enqueue":
+            return FIFOQueue(self.items + (v,))
+        if f == "dequeue":
+            if not self.items:
+                return inconsistent("dequeue from empty queue")
+            if self.items[0] != v:
+                return inconsistent(f"dequeue {v!r} but head is {self.items[0]!r}")
+            return FIFOQueue(self.items[1:])
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """A queue where dequeue may return any enqueued element
+    (knossos.model/unordered-queue); used by checker.queue
+    (checker.clj:218-238)."""
+
+    items: frozenset = frozenset()
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "enqueue":
+            # multiset via (value, seq) tags is overkill here; jepsen's
+            # unordered-queue uses a multiset — emulate with counted tuples.
+            items = dict(self.items)
+            items[v] = items.get(v, 0) + 1
+            return UnorderedQueue(frozenset(items.items()))
+        if f == "dequeue":
+            items = dict(self.items)
+            if items.get(v, 0) <= 0:
+                return inconsistent(f"dequeue {v!r} not present")
+            items[v] -= 1
+            if items[v] == 0:
+                del items[v]
+            return UnorderedQueue(frozenset(items.items()))
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class SetModel(Model):
+    """A grow-only set: add/read."""
+
+    items: frozenset = frozenset()
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "add":
+            return SetModel(self.items | {v})
+        if f == "read":
+            if v is None or frozenset(v) == self.items:
+                return self
+            return inconsistent("set read mismatch")
+        return inconsistent(f"unknown op f={f!r}")
+
+
+# ---------------------------------------------------------------------------
+# Int-encoded model specs: the device-side form.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class IntSpec:
+    """A model whose state is a single int32 and whose ops are (f_code, a, b)
+    int triples, with a jit-traceable transition.
+
+    step_ids(state, f_code, a, b) -> (new_state, ok_bool) where arrays are
+    jnp int32/bool and the function must be shape-polymorphic under vmap.
+    ``init_state`` is the interned id of the initial model state.
+
+    For the CAS register: state = value id; write v: -> v, always ok;
+    read v: ok iff v == state (v==0, i.e. None, reads anything);
+    cas (a,b): ok iff state == a, -> b.
+    """
+
+    name: str
+    init_state: int
+    num_f: int
+    step_ids: Callable  # (state, f, a, b) -> (state', ok)
+
+
+CAS_F_READ, CAS_F_WRITE, CAS_F_CAS = 0, 1, 2
+
+
+def cas_register_spec(init_state: int = 0) -> IntSpec:
+    """Device-encodable CAS register. Ops encode as (f, a, b):
+    read v -> (0, v_id, 0); write v -> (1, v_id, 0); cas [u,v] -> (2, u_id, v_id).
+    A read of value-id 0 (None) matches any state — used for indeterminate
+    reads."""
+
+    def step_ids(state, f, a, b):
+        import jax.numpy as jnp
+        is_read = f == CAS_F_READ
+        is_write = f == CAS_F_WRITE
+        is_cas = f == CAS_F_CAS
+        ok = (
+            (is_read & ((a == 0) | (a == state)))
+            | is_write
+            | (is_cas & (state == a))
+        )
+        new_state = jnp.where(is_write, a, jnp.where(is_cas & ok, b, state))
+        return new_state, ok
+
+    return IntSpec("cas-register", init_state, 3, step_ids)
+
+
+def register_spec(init_state: int = 0) -> IntSpec:
+    """Read/write register (no cas) — same encoding minus cas."""
+    spec = cas_register_spec(init_state)
+    return IntSpec("register", init_state, 2, spec.step_ids)
+
+
+@dataclass(frozen=True)
+class Memo:
+    """Wrapper marking a model as memoizable by (hash) — knossos.model/memo
+    analog. Object models here are frozen dataclasses, hence hashable, so
+    memoization is structural; this exists for API parity."""
+
+    model: Model

@@ -1,0 +1,11 @@
+"""Device kernels (JAX/XLA) for the compute-bound checker cores.
+
+These replace the reference's JVM-hosted hot loops (knossos linear/wgl
+search, elle graph algorithms — SURVEY.md §2.5 "JVM-hosted hot kernels")
+with batched fixed-shape tensor programs:
+
+* jitlin — just-in-time linearization as a lax.scan over history events,
+  frontier-of-configurations as (bitmask, state) arrays, sort-based dedup.
+* scc — strongly-connected components / cycle detection via iterative label
+  propagation over edge lists (the Elle dependency-graph core).
+"""

@@ -1,0 +1,208 @@
+"""TPU just-in-time-linearization kernel.
+
+Replaces the reference's CPU-bound knossos linear/wgl searches (invoked at
+jepsen/src/jepsen/checker.clj:199-203) with a fixed-shape XLA program:
+
+* A *configuration* is (mask, state): ``mask`` = bitset over pending-op
+  slots that have already been linearized; ``state`` = interned model state.
+* The frontier of live configurations is a capacity-K array pair.
+* Events stream through a ``lax.scan``: invokes update the per-slot op
+  table; before consuming each return, the closure of the frontier under
+  "linearize any pending, unlinearized op" is computed by masked batched
+  expansion ([K, S] candidate grid through the model's int transition) and
+  sort-based dedup (two lexicographic ``lax.sort`` passes), then configs
+  that failed to linearize the returning op are killed.
+
+The frontier is monotone within a closure, so convergence is detected by
+count; overflow beyond K makes a False verdict "unknown" (a surviving
+subset is still a sound witness for True). The whole kernel vmaps over a
+batch of per-key histories — the jepsen.independent -> vmap mapping
+(SURVEY.md §2.6, BASELINE config 3).
+
+Shapes are static in (E, S, K): pad E via linear_encode.pad_streams and
+bucket history lengths so XLA caches compilations.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+SENTINEL_MASK = np.uint32(0xFFFFFFFF)
+SENTINEL_STATE = np.int32(0x7FFFFFFF)
+
+EV_INVOKE, EV_RETURN, EV_NOOP = 0, 1, 2
+
+
+def _build_step(num_slots: int, capacity: int, step_ids, init_state: int,
+                max_closure_iters: int | None = None):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S, K = num_slots, capacity
+    closure_iters = max_closure_iters or S
+    slot_bits = (jnp.uint32(1) << jnp.arange(S, dtype=jnp.uint32))
+
+    def count_valid(mask):
+        return jnp.sum((mask != SENTINEL_MASK).astype(jnp.int32))
+
+    def dedup_compact(all_mask, all_state):
+        """Sort, drop duplicates, move valid entries to the front, keep K."""
+        m, st = lax.sort((all_mask, all_state), num_keys=2, is_stable=False)
+        dup = jnp.concatenate([
+            jnp.zeros((1,), dtype=bool),
+            (m[1:] == m[:-1]) & (st[1:] == st[:-1]),
+        ])
+        m = jnp.where(dup, SENTINEL_MASK, m)
+        st = jnp.where(dup, SENTINEL_STATE, st)
+        m, st = lax.sort((m, st), num_keys=2, is_stable=False)
+        overflow = m[K] != SENTINEL_MASK if m.shape[0] > K else jnp.bool_(False)
+        return m[:K], st[:K], overflow
+
+    def closure(mask, state, pend_mask, cur_f, cur_a, cur_b):
+        """Expands the frontier to its closure under linearizing any pending,
+        unlinearized op. Early-exits when the config count stops growing."""
+
+        def body(carry):
+            mask, state, _, count, overflow, it = carry
+            valid = mask != SENTINEL_MASK
+            can = (
+                valid[:, None]
+                & ((pend_mask & slot_bits) != 0)[None, :]
+                & ((mask[:, None] & slot_bits[None, :]) == 0)
+            )
+            st2, ok = step_ids(state[:, None], cur_f[None, :], cur_a[None, :], cur_b[None, :])
+            good = can & ok
+            new_mask = jnp.where(good, mask[:, None] | slot_bits[None, :], SENTINEL_MASK)
+            new_state = jnp.where(good, st2, SENTINEL_STATE)
+            all_mask = jnp.concatenate([mask, new_mask.reshape(-1)])
+            all_state = jnp.concatenate([state, new_state.reshape(-1)])
+            m, st, ovf = dedup_compact(all_mask, all_state)
+            c2 = count_valid(m)
+            return m, st, c2 > count, c2, overflow | ovf, it + 1
+
+        def cond(carry):
+            _, _, changed, _, _, it = carry
+            return changed & (it < closure_iters)
+
+        init = (mask, state, jnp.bool_(True), count_valid(mask), jnp.bool_(False),
+                jnp.int32(0))
+        mask, state, _, count, overflow, _ = lax.while_loop(cond, body, init)
+        return mask, state, count, overflow
+
+    def step_event(carry, ev):
+        (mask, state, cur_f, cur_a, cur_b, pend_mask, alive, died_at,
+         overflow, peak, eidx) = carry
+        kind, slot, f, a, b = ev
+        slot_bit = jnp.uint32(1) << slot.astype(jnp.uint32)
+
+        def on_invoke(_):
+            return (mask, state, cur_f.at[slot].set(f), cur_a.at[slot].set(a),
+                    cur_b.at[slot].set(b), pend_mask | slot_bit, alive,
+                    died_at, overflow, peak, eidx + 1)
+
+        def on_return(_):
+            m, st, count, ovf = closure(mask, state, pend_mask, cur_f, cur_a, cur_b)
+            # keep configs that linearized the returning op; clear its bit
+            # (sentinel entries have all bits set — exclude them explicitly)
+            has = (m != SENTINEL_MASK) & ((m & slot_bit) != 0)
+            m2 = jnp.where(has, m & ~slot_bit, SENTINEL_MASK)
+            st2 = jnp.where(has, st, SENTINEL_STATE)
+            m2, st2, _ = dedup_compact(
+                jnp.concatenate([m2, jnp.full((S,), SENTINEL_MASK, jnp.uint32)]),
+                jnp.concatenate([st2, jnp.full((S,), SENTINEL_STATE, jnp.int32)]),
+            )
+            now_alive = count_valid(m2) > 0
+            new_died = jnp.where(alive & ~now_alive, eidx, died_at)
+            return (m2, st2, cur_f, cur_a, cur_b, pend_mask & ~slot_bit,
+                    alive & now_alive, new_died, overflow | ovf,
+                    jnp.maximum(peak, count), eidx + 1)
+
+        def on_noop(_):
+            return (mask, state, cur_f, cur_a, cur_b, pend_mask, alive,
+                    died_at, overflow, peak, eidx + 1)
+
+        new_carry = lax.switch(kind, [on_invoke, on_return, on_noop], None)
+        return new_carry, None
+
+    def run(kind, slot, f, a, b):
+        mask0 = jnp.full((K,), SENTINEL_MASK, dtype=jnp.uint32)
+        mask0 = mask0.at[0].set(jnp.uint32(0))
+        state0 = jnp.full((K,), SENTINEL_STATE, dtype=jnp.int32)
+        state0 = state0.at[0].set(jnp.int32(init_state))
+        carry = (
+            mask0, state0,
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S,), jnp.int32),
+            jnp.uint32(0), jnp.bool_(True), jnp.int32(-1), jnp.bool_(False),
+            jnp.int32(1), jnp.int32(0),
+        )
+        events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
+                  f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
+        carry, _ = lax.scan(step_event, carry, events)
+        (_, _, _, _, _, _, alive, died_at, overflow, peak, _) = carry
+        return alive, died_at, overflow, peak
+
+    return run
+
+
+class JitLinKernel:
+    """Compiled-kernel cache keyed by (S, K, E-bucket, batched?)."""
+
+    def __init__(self, step_ids=None, init_state: int = 0):
+        if step_ids is None:
+            from jepsen_tpu.models import cas_register_spec
+            step_ids = cas_register_spec().step_ids
+        self.step_ids = step_ids
+        self.init_state = init_state
+        self._cache: dict = {}
+
+    def _get(self, S: int, K: int, batched: bool):
+        import jax
+        key = (S, K, batched)
+        fn = self._cache.get(key)
+        if fn is None:
+            run = _build_step(S, K, self.step_ids, self.init_state)
+            fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
+            self._cache[key] = fn
+        return fn
+
+    def check(self, stream, capacity: int = 256):
+        """Single history. Returns (valid, died_event, overflow, peak)."""
+        from jepsen_tpu.checker.linear_encode import pad_streams
+        batch = pad_streams([stream], length=_bucket(len(stream)))
+        S = max(1, batch["n_slots"])
+        fn = self._get(S, capacity, True)
+        alive, died, ovf, peak = fn(batch["kind"], batch["slot"], batch["f"],
+                                    batch["a"], batch["b"])
+        return (bool(alive[0]), int(died[0]), bool(ovf[0]), int(peak[0]))
+
+    def check_batch(self, streams, capacity: int = 256):
+        """vmapped per-key batch. Returns list of (valid, died, ovf, peak)."""
+        from jepsen_tpu.checker.linear_encode import pad_streams
+        batch = pad_streams(streams, length=_bucket(max(len(s) for s in streams)))
+        S = max(1, batch["n_slots"])
+        fn = self._get(S, capacity, True)
+        alive, died, ovf, peak = fn(batch["kind"], batch["slot"], batch["f"],
+                                    batch["a"], batch["b"])
+        return [
+            (bool(alive[i]), int(died[i]), bool(ovf[i]), int(peak[i]))
+            for i in range(len(streams))
+        ]
+
+
+def _bucket(n: int) -> int:
+    """Round event counts up to a power of two >= 64 so jit caches hit."""
+    b = 64
+    while b < n:
+        b *= 2
+    return b
+
+
+def verdict(alive: bool, overflow: bool):
+    """Soundness rules: a surviving (possibly truncated) frontier proves
+    linearizability; an empty frontier after overflow proves nothing."""
+    if alive:
+        return True
+    return "unknown" if overflow else False

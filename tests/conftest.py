@@ -1,0 +1,32 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX is imported.
+
+Mirrors the reference's tier-1/tier-2 test strategy (SURVEY.md §4): pure unit
+tests plus fake-cluster integration, no real TPU required. Multi-chip sharding
+is exercised on a virtual 8-device CPU mesh, the same mechanism the driver's
+``dryrun_multichip`` uses.
+"""
+import os
+import sys
+
+# Must run before any backend init anywhere in the test session. Force —
+# the image's profile exports JAX_PLATFORMS=axon (a tunneled TPU), and unit
+# tests must not depend on (or block on) that tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize registers an out-of-process TPU PJRT plugin
+# ("axon") in every interpreter and sets jax_platforms="axon,cpu" via
+# jax.config — which overrides the env var. Initializing that backend dials
+# a relay and can block indefinitely if the tunnel is down. Tests are
+# CPU-only by design, so force the platform list back to cpu before any
+# backend init (conftest imports before any test touches jax).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,197 @@
+"""Built-in checker tests on literal histories (mirrors
+jepsen/test/jepsen/checker_test.clj's strategy)."""
+from jepsen_tpu import checker as c
+from jepsen_tpu.models import UnorderedQueue
+
+
+def op(typ, process, f, value=None, **kw):
+    return {"type": typ, "process": process, "f": f, "value": value, **kw}
+
+
+def test_stats():
+    h = [
+        op("invoke", 0, "read"), op("ok", 0, "read", 5),
+        op("invoke", 1, "write", 3), op("fail", 1, "write", 3),
+        op("invoke", 0, "read"), op("info", 0, "read"),
+    ]
+    r = c.stats().check({}, h, {})
+    assert r["count"] == 3
+    assert r["ok-count"] == 1
+    assert r["by-f"]["read"]["ok-count"] == 1
+    assert r["by-f"]["write"]["valid?"] is False
+    assert r["valid?"] is False
+
+
+def test_stats_valid_when_every_f_has_ok():
+    h = [op("invoke", 0, "read"), op("ok", 0, "read", 1)]
+    assert c.stats().check({}, h, {})["valid?"] is True
+
+
+def test_set_checker_happy():
+    h = [
+        op("invoke", 0, "add", 1), op("ok", 0, "add", 1),
+        op("invoke", 1, "add", 2), op("ok", 1, "add", 2),
+        op("invoke", 0, "read"), op("ok", 0, "read", [1, 2]),
+    ]
+    r = c.set_checker().check({}, h, {})
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2
+
+
+def test_set_checker_lost_and_unexpected():
+    h = [
+        op("invoke", 0, "add", 1), op("ok", 0, "add", 1),
+        op("invoke", 1, "add", 2), op("info", 1, "add", 2),   # indeterminate
+        op("invoke", 0, "read"), op("ok", 0, "read", [2, 99]),
+    ]
+    r = c.set_checker().check({}, h, {})
+    assert r["valid?"] is False
+    assert r["lost"] == [1]
+    assert r["unexpected"] == [99]
+    assert r["recovered"] == [2]
+
+
+def test_set_checker_never_read():
+    r = c.set_checker().check({}, [op("invoke", 0, "add", 1), op("ok", 0, "add", 1)], {})
+    assert r["valid?"] == "unknown"
+
+
+def test_set_full_stable():
+    h = [
+        op("invoke", 0, "add", 1, time=0), op("ok", 0, "add", 1, time=10),
+        op("invoke", 1, "read", None, time=20), op("ok", 1, "read", [1], time=30),
+        op("invoke", 1, "read", None, time=40), op("ok", 1, "read", [1], time=50),
+    ]
+    r = c.set_full().check({}, h, {})
+    assert r["valid?"] is True
+    assert r["stable-count"] == 1
+    assert r["lost-count"] == 0
+
+
+def test_set_full_lost():
+    h = [
+        op("invoke", 0, "add", 1, time=0), op("ok", 0, "add", 1, time=10),
+        op("invoke", 1, "read", None, time=20), op("ok", 1, "read", [1], time=30),
+        op("invoke", 1, "read", None, time=40), op("ok", 1, "read", [], time=50),
+    ]
+    r = c.set_full().check({}, h, {})
+    assert r["valid?"] is False
+    assert r["lost"] == [1]
+
+
+def test_set_full_never_read():
+    h = [
+        op("invoke", 0, "add", 1, time=0), op("info", 0, "add", 1, time=10),
+        op("invoke", 1, "read", None, time=20), op("ok", 1, "read", [], time=30),
+    ]
+    r = c.set_full().check({}, h, {})
+    assert r["valid?"] is True
+    assert r["never-read-count"] == 1
+
+
+def test_counter_in_bounds():
+    h = [
+        op("invoke", 0, "add", 5), op("ok", 0, "add", 5),
+        op("invoke", 1, "read"), op("ok", 1, "read", 5),
+        op("invoke", 0, "add", 3), op("info", 0, "add", 3),  # maybe applied
+        op("invoke", 1, "read"), op("ok", 1, "read", 8),
+        op("invoke", 1, "read"), op("ok", 1, "read", 5),
+    ]
+    r = c.counter().check({}, h, {})
+    assert r["valid?"] is True
+    assert r["reads-checked"] == 3
+
+
+def test_counter_out_of_bounds():
+    h = [
+        op("invoke", 0, "add", 5), op("ok", 0, "add", 5),
+        op("invoke", 1, "read"), op("ok", 1, "read", 17),
+    ]
+    r = c.counter().check({}, h, {})
+    assert r["valid?"] is False
+    assert r["errors"][0]["expected"] == [5, 5]
+
+
+def test_counter_failed_add_rolled_back():
+    h = [
+        op("invoke", 0, "add", 5), op("fail", 0, "add", 5),
+        op("invoke", 1, "read"), op("ok", 1, "read", 0),
+    ]
+    assert c.counter().check({}, h, {})["valid?"] is True
+
+
+def test_total_queue():
+    h = [
+        op("invoke", 0, "enqueue", "a"), op("ok", 0, "enqueue", "a"),
+        op("invoke", 1, "enqueue", "b"), op("info", 1, "enqueue", "b"),
+        op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", "b"),
+    ]
+    r = c.total_queue().check({}, h, {})
+    assert r["valid?"] is False           # 'a' was acknowledged, never seen
+    assert r["lost"] == ["a"]
+    assert r["recovered-count"] == 1      # 'b' wasn't acked but came out
+
+
+def test_total_queue_unexpected():
+    h = [op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", "x")]
+    r = c.total_queue().check({}, h, {})
+    assert r["valid?"] is False
+    assert r["unexpected"] == ["x"]
+
+
+def test_queue_model_checker():
+    h = [
+        op("invoke", 0, "enqueue", "a"), op("ok", 0, "enqueue", "a"),
+        op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", "a"),
+    ]
+    assert c.queue(UnorderedQueue()).check({}, h, {})["valid?"] is True
+    bad = [op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", "ghost")]
+    assert c.queue(UnorderedQueue()).check({}, bad, {})["valid?"] is False
+
+
+def test_unique_ids():
+    h = [
+        op("invoke", 0, "generate"), op("ok", 0, "generate", 1),
+        op("invoke", 0, "generate"), op("ok", 0, "generate", 2),
+    ]
+    assert c.unique_ids().check({}, h, {})["valid?"] is True
+    h += [op("invoke", 0, "generate"), op("ok", 0, "generate", 2)]
+    r = c.unique_ids().check({}, h, {})
+    assert r["valid?"] is False
+    assert r["duplicated"] == {2: 2}
+
+
+def test_unhandled_exceptions():
+    h = [
+        op("info", 0, "read", None, error=["timeout"]),
+        op("info", 1, "read", None, error=["timeout"]),
+        op("fail", 0, "write", 1, error=["conflict"]),
+    ]
+    r = c.unhandled_exceptions().check({}, h, {})
+    assert r["valid?"] is True
+    assert r["exceptions"][0]["count"] == 2
+
+
+def test_compose_merges_validity():
+    comp = c.compose({"s": c.stats(), "n": c.noop()})
+    h = [op("invoke", 0, "read"), op("fail", 0, "read")]
+    r = comp.check({}, h, {})
+    assert r["valid?"] is False
+    assert r["n"]["valid?"] is True
+    assert r["s"]["valid?"] is False
+
+
+def test_check_safe_degrades_to_unknown():
+    class Boom(c.Checker):
+        def check(self, test, history, opts):
+            raise RuntimeError("boom")
+
+    r = c.check_safe(Boom(), {}, [], {})
+    assert r["valid?"] == "unknown"
+
+
+def test_merge_valid_priorities():
+    assert c.merge_valid([True, "unknown", False]) is False
+    assert c.merge_valid([True, "unknown"]) == "unknown"
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([]) is True

@@ -1,0 +1,232 @@
+"""Generator semantics tests — exact-output assertions against the
+deterministic simulator, mirroring the reference's strategy
+(jepsen/test/jepsen/generator_test.clj)."""
+import jepsen_tpu.generator as gen
+from jepsen_tpu.generator import NEMESIS, PENDING
+from jepsen_tpu.generator.simulate import (
+    default_context, invocations, perfect, perfect_info, quick,
+)
+from jepsen_tpu.utils import secs_to_nanos
+
+TEST = {"concurrency": 2}
+
+
+def ops_of(history, keys=("f", "value", "type")):
+    return [tuple(op.get(k) for k in keys) for op in history]
+
+
+def test_dict_emits_exactly_one_op():
+    h = quick(TEST, {"f": "read"})
+    assert ops_of(h) == [("read", None, "invoke"), ("read", None, "ok")]
+
+
+def test_list_emits_in_order():
+    h = quick(TEST, [{"f": "a"}, {"f": "b"}, {"f": "c"}])
+    fs = [op["f"] for op in invocations(h)]
+    assert fs == ["a", "b", "c"]
+
+
+def test_fn_generator_repeats_until_none():
+    # fns must be (speculation-tolerant) functions of test/ctx: combinators
+    # may probe them and discard results (generator.clj:575-599)
+    def g(test, ctx):
+        return {"f": "w", "value": "x"}
+
+    h = quick(TEST, gen.limit(3, g))
+    assert [op["value"] for op in invocations(h)] == ["x", "x", "x"]
+
+
+def test_fn_generator_exhausts_on_none():
+    def g(test, ctx):
+        if ctx.time >= secs_to_nanos(2.0):
+            return None
+        return {"f": "w"}
+
+    # fn is consulted at ctx.time (before delay re-stamps op time), so ops
+    # scheduled for t=0,1,2s emit; the t>=2s consult returns None.
+    h = quick(TEST, gen.delay(1.0, g))
+    assert len(invocations(h)) == 3
+
+
+def test_limit_and_once():
+    h = quick(TEST, gen.limit(2, gen.repeat({"f": "read"})))
+    assert len(invocations(h)) == 2
+    h = quick(TEST, gen.once(gen.repeat({"f": "read"})))
+    assert len(invocations(h)) == 1
+
+
+def test_repeat_infinite_with_limit():
+    h = quick(TEST, gen.limit(5, gen.repeat({"f": "read"})))
+    assert len(invocations(h)) == 5
+    assert all(op["f"] == "read" for op in invocations(h))
+
+
+def test_repeat_n():
+    h = quick(TEST, gen.repeat(3, {"f": "read"}))
+    assert len(invocations(h)) == 3
+
+
+def test_cycle():
+    h = quick(TEST, gen.cycle([{"f": "a"}, {"f": "b"}], times=2))
+    assert [op["f"] for op in invocations(h)] == ["a", "b", "a", "b"]
+
+
+def test_map_transforms_ops():
+    h = quick(TEST, gen.gen_map(lambda op: {**op, "f": "X"}, [{"f": "a"}, {"f": "b"}]))
+    assert [op["f"] for op in invocations(h)] == ["X", "X"]
+
+
+def test_filter():
+    g = gen.gen_filter(lambda op: op["value"] % 2 == 0,
+                       [{"f": "w", "value": v} for v in range(6)])
+    h = quick(TEST, g)
+    assert [op["value"] for op in invocations(h)] == [0, 2, 4]
+
+
+def test_mix_draws_from_all():
+    g = gen.mix([gen.repeat({"f": "a"}), gen.repeat({"f": "b"})])
+    h = quick(TEST, gen.limit(100, g))
+    fs = {op["f"] for op in invocations(h)}
+    assert fs == {"a", "b"}
+
+
+def test_clients_excludes_nemesis():
+    h = quick(TEST, gen.clients(gen.limit(10, gen.repeat({"f": "read"}))))
+    assert all(op["process"] != NEMESIS for op in h)
+
+
+def test_nemesis_gen_only_nemesis():
+    h = quick(TEST, gen.nemesis_gen(gen.limit(3, gen.repeat({"f": "start"}))))
+    assert all(op["process"] == NEMESIS for op in h)
+
+
+def test_each_thread_runs_once_per_thread():
+    h = quick(TEST, gen.each_thread({"f": "hi"}))
+    procs = sorted((op["process"] for op in invocations(h)), key=str)
+    # 2 client threads + nemesis
+    assert len(procs) == 3
+    assert NEMESIS in procs or "nemesis" in procs
+
+
+def test_reserve_partitions_threads():
+    g = gen.reserve(1, gen.limit(5, gen.repeat({"f": "a"})),
+                    gen.limit(5, gen.repeat({"f": "b"})))
+    h = perfect(TEST, gen.clients(g))
+    for op in invocations(h):
+        if op["f"] == "a":
+            assert op["process"] == 0
+        else:
+            assert op["process"] == 1
+
+
+def test_stagger_spaces_ops_out():
+    g = gen.stagger(1.0, gen.limit(10, gen.repeat({"f": "read"})))
+    h = quick(TEST, g)
+    times = [op["time"] for op in invocations(h)]
+    assert times == sorted(times)
+    # mean gap should be roughly 1s (uniform [0, 2s)); loose bound
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert 0 < sum(gaps) / len(gaps) < secs_to_nanos(2)
+
+
+def test_delay_enforces_interval():
+    g = gen.delay(1.0, gen.limit(4, gen.repeat({"f": "read"})))
+    h = quick(TEST, g)
+    times = [op["time"] for op in invocations(h)]
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert all(g >= secs_to_nanos(1.0) for g in gaps)
+
+
+def test_time_limit_cuts_off():
+    g = gen.time_limit(5.0, gen.delay(1.0, gen.repeat({"f": "read"})))
+    h = quick(TEST, g)
+    n = len(invocations(h))
+    assert 4 <= n <= 6
+
+
+def test_phases_synchronize():
+    g = gen.phases(gen.limit(4, gen.repeat({"f": "a"})),
+                   gen.limit(2, gen.repeat({"f": "b"})))
+    h = perfect(TEST, g)
+    inv = invocations(h)
+    # all a-invocations precede all b-invocations
+    last_a = max(i for i, op in enumerate(inv) if op["f"] == "a")
+    first_b = min(i for i, op in enumerate(inv) if op["f"] == "b")
+    assert last_a < first_b
+    # and the first b starts only after every a completed
+    a_completions = [op["time"] for op in h if op["f"] == "a" and op["type"] == "ok"]
+    b_invokes = [op["time"] for op in h if op["f"] == "b" and op["type"] == "invoke"]
+    assert max(a_completions) <= min(b_invokes)
+
+
+def test_then_orders():
+    g = gen.then(gen.once(gen.repeat({"f": "b"})), gen.once(gen.repeat({"f": "a"})))
+    h = perfect(TEST, g)
+    assert [op["f"] for op in invocations(h)] == ["a", "b"]
+
+
+def test_until_ok_stops_after_first_ok():
+    g = gen.until_ok(gen.repeat({"f": "read"}))
+    h = perfect(TEST, g)
+    # stops quickly: at most a handful of invokes (those already in flight)
+    assert 1 <= len(invocations(h)) <= 3
+
+
+def test_flip_flop_alternates():
+    g = gen.limit(6, gen.flip_flop(gen.repeat({"f": "start"}), gen.repeat({"f": "stop"})))
+    h = quick(TEST, g)
+    assert [op["f"] for op in invocations(h)] == ["start", "stop"] * 3
+
+
+def test_process_limit():
+    # perfect_info crashes every op, so each op consumes a fresh process
+    g = gen.process_limit(4, gen.clients(gen.repeat({"f": "read"})))
+    h = perfect_info(TEST, g)
+    procs = {op["process"] for op in invocations(h)}
+    assert len(procs) <= 4
+
+
+def test_crashed_process_renumbering():
+    h = perfect_info(TEST, gen.clients(gen.limit(6, gen.repeat({"f": "read"}))))
+    procs = [op["process"] for op in invocations(h)]
+    # processes never repeat after a crash; fresh ids = old + concurrency
+    assert len(set(procs)) == len(procs)
+    assert all(p % 2 in (0, 1) for p in procs)
+
+
+def test_validate_accepts_good_gen():
+    h = quick(TEST, gen.validate(gen.limit(3, gen.repeat({"f": "read"}))))
+    assert len(invocations(h)) == 3
+
+
+def test_any_picks_soonest():
+    g = gen.any_gen(gen.repeat({"f": "slow", "time": secs_to_nanos(10)}),
+                    gen.limit(3, gen.repeat({"f": "fast"})))
+    h = quick(TEST, gen.limit(3, g))
+    assert [op["f"] for op in invocations(h)] == ["fast", "fast", "fast"]
+
+
+def test_context_free_threads():
+    ctx = default_context()
+    assert ctx.free_threads == frozenset([0, 1, NEMESIS])
+    ctx2 = ctx.busy_thread(0)
+    assert ctx2.free_threads == frozenset([1, NEMESIS])
+    assert ctx.free_threads == frozenset([0, 1, NEMESIS])  # immutable
+
+
+def test_next_process():
+    ctx = default_context()
+    assert gen.next_process(ctx, 0) == 2
+    assert gen.next_process(ctx, NEMESIS) == NEMESIS
+
+
+def test_generator_throughput():
+    """The pure scheduler must stay cheap (reference: >20k ops/sec,
+    generator.clj:67-70). We assert a sane floor for the Python build."""
+    import time
+    g = gen.limit(20_000, gen.repeat({"f": "read"}))
+    t0 = time.monotonic()
+    h = quick({"concurrency": 10}, g)
+    dt = time.monotonic() - t0
+    assert len(invocations(h)) == 20_000
+    assert dt < 20.0, f"generator too slow: {20_000/dt:.0f} ops/sec"

@@ -1,0 +1,195 @@
+"""Linearizability checker tests: unit cases + differential testing of the
+WGL oracle, the int-encoded CPU search, and the JAX kernel (on the virtual
+CPU mesh). Mirrors the reference's knossos-as-oracle strategy
+(SURVEY.md §4, BASELINE north_star)."""
+import random
+
+import pytest
+
+from jepsen_tpu.checker.linear_cpu import check_stream, wgl
+from jepsen_tpu.checker.linear_encode import encode_register_ops
+from jepsen_tpu.checker.linearizable import LinearizableChecker
+from jepsen_tpu.models import CASRegister
+
+
+def op(typ, process, f, value=None):
+    return {"type": typ, "process": process, "f": f, "value": value}
+
+
+GOOD_SEQ = [
+    op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+    op("invoke", 1, "read"), op("ok", 1, "read", 1),
+    op("invoke", 0, "cas", [1, 2]), op("ok", 0, "cas", [1, 2]),
+    op("invoke", 1, "read"), op("ok", 1, "read", 2),
+]
+
+BAD_READ = [
+    op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+    op("invoke", 1, "read"), op("ok", 1, "read", 99),
+]
+
+# write(1) and read run concurrently: read may see None or 1
+CONCURRENT_OK = [
+    op("invoke", 0, "write", 1),
+    op("invoke", 1, "read"),
+    op("ok", 1, "read", 1),
+    op("ok", 0, "write", 1),
+]
+
+# crashed write may have taken effect
+CRASHED_WRITE_SEEN = [
+    op("invoke", 0, "write", 7), op("info", 0, "write", 7),
+    op("invoke", 1, "read"), op("ok", 1, "read", 7),
+]
+
+# failed write must NOT be visible
+FAILED_WRITE_SEEN = [
+    op("invoke", 0, "write", 7), op("fail", 0, "write", 7),
+    op("invoke", 1, "read"), op("ok", 1, "read", 7),
+]
+
+# read completed before the write was invoked: must not see it
+REAL_TIME_VIOLATION = [
+    op("invoke", 1, "read"), op("ok", 1, "read", 7),
+    op("invoke", 0, "write", 7), op("ok", 0, "write", 7),
+]
+
+
+CASES = [
+    (GOOD_SEQ, True),
+    (BAD_READ, False),
+    (CONCURRENT_OK, True),
+    (CRASHED_WRITE_SEEN, True),
+    (FAILED_WRITE_SEEN, False),
+    (REAL_TIME_VIOLATION, False),
+    ([], True),
+]
+
+
+@pytest.mark.parametrize("history,expected", CASES)
+def test_wgl_cases(history, expected):
+    assert wgl(history, CASRegister()).valid is expected
+
+
+@pytest.mark.parametrize("history,expected", CASES)
+def test_jitlin_cpu_cases(history, expected):
+    assert check_stream(encode_register_ops(history)).valid is expected
+
+
+@pytest.mark.parametrize("history,expected", CASES)
+def test_jitlin_device_cases(history, expected):
+    from jepsen_tpu.ops.jitlin import JitLinKernel, verdict
+    if not history:
+        return
+    stream = encode_register_ops(history)
+    alive, died, ovf, peak = JitLinKernel().check(stream, capacity=64)
+    assert verdict(alive, ovf) is expected
+
+
+def test_checker_interface():
+    chk = LinearizableChecker(accelerator="cpu")
+    r = chk.check({}, GOOD_SEQ, {})
+    assert r["valid?"] is True
+    r = chk.check({}, BAD_READ, {})
+    assert r["valid?"] is False
+    assert r["failed-op"] is not None
+
+
+def gen_history(rng: random.Random, n_procs=4, n_ops=40, values=4, corrupt=False):
+    """Generates a register history by simulating a real register with
+    random overlap; optionally corrupts one read to force non-linearizable
+    (usually)."""
+    reg = None
+    history = []
+    pending = {}  # process -> op
+    procs = list(range(n_procs))
+    ops_left = n_ops
+    while ops_left > 0 or pending:
+        p = rng.choice(procs)
+        if p in pending:
+            # complete p's op: apply it now (linearization point at completion)
+            o = pending.pop(p)
+            f, v = o["f"], o["value"]
+            outcome = rng.random()
+            if f == "read":
+                o2 = op("ok", p, "read", reg)
+            elif outcome < 0.1:
+                o2 = op("info", p, f, v)   # indeterminate: maybe applied
+                if rng.random() < 0.5:
+                    reg = v if f == "write" else (v[1] if reg == v[0] else reg)
+            elif outcome < 0.2 and f == "cas":
+                o2 = op("fail", p, f, v)   # definitely not applied
+            else:
+                if f == "write":
+                    reg = v
+                    o2 = op("ok", p, f, v)
+                else:  # cas
+                    if reg == v[0]:
+                        reg = v[1]
+                        o2 = op("ok", p, f, v)
+                    else:
+                        o2 = op("fail", p, f, v)
+            history.append(o2)
+        elif ops_left > 0:
+            ops_left -= 1
+            r = rng.random()
+            if r < 0.4:
+                o = op("invoke", p, "read")
+            elif r < 0.7:
+                o = op("invoke", p, "write", rng.randrange(values))
+            else:
+                o = op("invoke", p, "cas", [rng.randrange(values), rng.randrange(values)])
+            pending[p] = o
+            history.append(o)
+    if corrupt:
+        reads = [i for i, o in enumerate(history)
+                 if o["type"] == "ok" and o["f"] == "read"]
+        if reads:
+            i = rng.choice(reads)
+            history[i] = dict(history[i], value=(history[i]["value"] or 0) + 100)
+    return history
+
+
+def test_differential_random_histories():
+    """wgl == jitlin-cpu == jax kernel across random valid/corrupted
+    histories."""
+    from jepsen_tpu.ops.jitlin import JitLinKernel, verdict
+    kernel = JitLinKernel()
+    rng = random.Random(7)
+    n_disagreements = []
+    for trial in range(60):
+        corrupt = trial % 3 == 0
+        h = gen_history(rng, n_procs=4, n_ops=30, corrupt=corrupt)
+        r_wgl = wgl(h, CASRegister()).valid
+        stream = encode_register_ops(h)
+        r_jit = check_stream(stream).valid
+        alive, _, ovf, _ = kernel.check(stream, capacity=128)
+        r_dev = verdict(alive, ovf)
+        assert r_wgl == r_jit, f"trial {trial}: wgl={r_wgl} jit={r_jit}\n{h}"
+        assert r_jit == r_dev, f"trial {trial}: jit={r_jit} dev={r_dev}\n{h}"
+        if not corrupt:
+            assert r_wgl is True, f"trial {trial}: valid history judged {r_wgl}\n{h}"
+        n_disagreements.append((r_wgl, corrupt))
+    # corrupted histories should usually be invalid (sanity that the test
+    # exercises both verdicts)
+    assert any(v is False for v, _ in n_disagreements)
+    assert any(v is True for v, _ in n_disagreements)
+
+
+def test_wgl_handles_uncompleted_ops():
+    h = [
+        op("invoke", 0, "write", 1),   # never completes
+        op("invoke", 1, "read"), op("ok", 1, "read", 1),
+    ]
+    assert wgl(h, CASRegister()).valid is True
+    assert check_stream(encode_register_ops(h)).valid is True
+
+
+def test_nemesis_ops_ignored():
+    h = [
+        {"type": "info", "process": "nemesis", "f": "start", "value": None},
+        op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+        {"type": "info", "process": "nemesis", "f": "stop", "value": None},
+    ]
+    assert wgl(h, CASRegister()).valid is True
+    assert check_stream(encode_register_ops(h)).valid is True
