@@ -1,0 +1,219 @@
+"""CLI runner (reference: jepsen/src/jepsen/cli.clj).
+
+Subcommands: ``test`` (run + exit by validity), ``analyze`` (re-check a
+stored history with fresh checker code — analysis is re-entrant,
+cli.clj:399-427), ``serve`` (web UI), ``test-all`` (sweeps). Exit codes
+mirror cli.clj:129-139: 0 pass / 1 invalid / 2 unknown / 254 bad args /
+255 crash. Node and "--concurrency 3n" parsing per cli.clj:150-202.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Callable
+
+logger = logging.getLogger("jepsen.cli")
+
+EXIT_OK = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_BAD_ARGS = 254
+EXIT_CRASH = 255
+
+
+from jepsen_tpu.utils import parse_concurrency  # noqa: E402  (re-export)
+
+
+def parse_nodes(opts) -> list[str]:
+    """Merges --node, --nodes, --nodes-file (cli.clj:167-202)."""
+    nodes: list[str] = []
+    if getattr(opts, "nodes", None):
+        nodes.extend(x for x in opts.nodes.split(",") if x)
+    if getattr(opts, "node", None):
+        nodes.extend(opts.node)
+    if getattr(opts, "nodes_file", None):
+        with open(opts.nodes_file) as f:
+            nodes.extend(line.strip() for line in f if line.strip())
+    return nodes or ["n1", "n2", "n3", "n4", "n5"]
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """Shared test option spec (cli.clj:64-111)."""
+    p.add_argument("--nodes", help="comma-separated node list")
+    p.add_argument("--node", action="append", help="a node to test (repeatable)")
+    p.add_argument("--nodes-file", help="file with one node per line")
+    p.add_argument("--username", default="root")
+    p.add_argument("--password")
+    p.add_argument("--port", type=int)
+    p.add_argument("--ssh-private-key", dest="ssh_private_key")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="use the dummy remote (no cluster needed)")
+    p.add_argument("--concurrency", default="1n",
+                   help="number of workers; '3n' = 3 per node")
+    p.add_argument("--time-limit", type=float, default=60.0)
+    p.add_argument("--test-count", type=int, default=1)
+    p.add_argument("--leave-db-running", action="store_true")
+    p.add_argument("--accelerator", default="auto",
+                   choices=["auto", "cpu", "tpu"],
+                   help="checker backend (the TPU switch)")
+    p.add_argument("--store-dir", default="store")
+
+
+def test_opts_to_test(opts, base_test: dict) -> dict:
+    nodes = parse_nodes(opts)
+    test = dict(base_test)
+    test["nodes"] = nodes
+    test["concurrency"] = parse_concurrency(opts.concurrency, len(nodes))
+    test["time_limit"] = opts.time_limit
+    test["leave_db_running"] = bool(opts.leave_db_running)
+    test["store_dir"] = opts.store_dir
+    test["accelerator"] = opts.accelerator
+    ssh = dict(test.get("ssh") or {})
+    ssh.update({
+        "username": opts.username,
+        "password": opts.password,
+        "port": opts.port,
+        "private_key_path": opts.ssh_private_key,
+        "dummy": bool(opts.no_ssh) or ssh.get("dummy", False),
+    })
+    test["ssh"] = ssh
+    return test
+
+
+def validity_exit_code(test: dict) -> int:
+    valid = (test.get("results") or {}).get("valid?")
+    if valid is True:
+        return EXIT_OK
+    if valid == "unknown":
+        return EXIT_UNKNOWN
+    return EXIT_INVALID
+
+
+def single_test_cmd(
+    test_fn: Callable[[argparse.Namespace], dict],
+    opt_fn: Callable[[argparse.ArgumentParser], None] | None = None,
+    name: str = "jepsen-tpu",
+) -> Callable[[list[str] | None], int]:
+    """Builds a main() with test/analyze/serve subcommands around a
+    test-map constructor (cli.clj:352-427 single-test-cmd)."""
+
+    def main(argv: list[str] | None = None) -> int:
+        parser = argparse.ArgumentParser(prog=name)
+        sub = parser.add_subparsers(dest="command", required=True)
+
+        p_test = sub.add_parser("test", help="run a test")
+        add_test_opts(p_test)
+        if opt_fn:
+            opt_fn(p_test)
+
+        p_an = sub.add_parser("analyze", help="re-check a stored history")
+        p_an.add_argument("--test-name")
+        p_an.add_argument("--timestamp", help="defaults to latest run")
+        add_test_opts(p_an)  # analyze takes the same opts (cli.clj:399-427)
+        if opt_fn:
+            opt_fn(p_an)
+
+        p_serve = sub.add_parser("serve", help="serve the web UI")
+        p_serve.add_argument("--host", default="0.0.0.0")
+        p_serve.add_argument("-p", "--port", type=int, default=8080)
+        p_serve.add_argument("--store-dir", default="store")
+
+        try:
+            opts = parser.parse_args(argv)
+        except SystemExit as e:
+            return EXIT_BAD_ARGS if e.code not in (0, None) else 0
+
+        try:
+            if opts.command == "test":
+                from jepsen_tpu import core
+                code = EXIT_OK
+                for i in range(opts.test_count):
+                    try:
+                        test = test_fn(opts)
+                    except (ValueError, KeyError) as e:
+                        print(f"bad arguments: {e}", file=sys.stderr)
+                        return EXIT_BAD_ARGS
+                    result = core.run(test)
+                    code = validity_exit_code(result)
+                    if code != EXIT_OK:
+                        break
+                return code
+            if opts.command == "analyze":
+                return analyze_cmd(opts, test_fn)
+            if opts.command == "serve":
+                from jepsen_tpu.web import serve
+                serve(opts.store_dir, opts.host, opts.port)
+                return EXIT_OK
+            return EXIT_BAD_ARGS
+        except KeyboardInterrupt:
+            return EXIT_CRASH
+        except Exception:  # noqa: BLE001
+            logger.exception("test crashed")
+            return EXIT_CRASH
+
+    return main
+
+
+def analyze_cmd(opts, test_fn) -> int:
+    """Re-runs checkers over a stored history (cli.clj:399-427)."""
+    from jepsen_tpu import core, store
+    if opts.test_name:
+        name = opts.test_name
+        if opts.timestamp:
+            ts = opts.timestamp
+        else:
+            runs = store.tests(name, opts.store_dir).get(name) or {}
+            if not runs:
+                print(f"no stored runs for test {name!r}", file=sys.stderr)
+                return EXIT_BAD_ARGS
+            ts = sorted(runs)[-1]
+    else:
+        found = store.latest(opts.store_dir)
+        if found is None:
+            print("no stored tests found", file=sys.stderr)
+            return EXIT_BAD_ARGS
+        name, ts, _ = found
+    stored = store.load_test(name, ts, opts.store_dir)
+    # fresh checker from the suite's constructor
+    fresh = test_fn(opts)
+    stored["checker"] = fresh.get("checker")
+    stored["store_dir"] = opts.store_dir
+    test = core.analyze(stored)
+    core.log_results(test)
+    print(f"valid?: {(test.get('results') or {}).get('valid?')}")
+    return validity_exit_code(test)
+
+
+def test_all_cmd(tests_fn: Callable[[argparse.Namespace], list], name="jepsen-tpu"):
+    """Sweep runner (cli.clj:429-515): runs every workload, summarizes."""
+
+    def main(argv: list[str] | None = None) -> int:
+        parser = argparse.ArgumentParser(prog=f"{name} test-all")
+        add_test_opts(parser)
+        opts = parser.parse_args(argv)
+        from jepsen_tpu import core
+        worst = EXIT_OK
+        for test in tests_fn(opts):
+            result = core.run(test)
+            code = validity_exit_code(result)
+            worst = max(worst, code if code != EXIT_OK else worst)
+            logger.info("%s: %s", test.get("name"),
+                        (result.get("results") or {}).get("valid?"))
+        return worst
+
+    return main
+
+
+def noop_main(argv: list[str] | None = None) -> int:
+    """`python -m jepsen_tpu.cli` — runs the noop test (smoke check)."""
+    from jepsen_tpu.fakes import noop_test
+
+    def build(opts):
+        return test_opts_to_test(opts, noop_test())
+
+    return single_test_cmd(build)(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(noop_main())

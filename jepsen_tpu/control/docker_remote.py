@@ -1,0 +1,45 @@
+"""Remote via `docker exec` / `docker cp` (reference:
+jepsen/src/jepsen/control/docker.clj:30-75)."""
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass
+
+from jepsen_tpu.control.core import Remote, RemoteError, Result, wrap_cd, wrap_sudo
+
+
+@dataclass
+class DockerRemote(Remote):
+    container: str | None = None
+
+    def connect(self, conn_spec: dict) -> "DockerRemote":
+        return DockerRemote(container=conn_spec.get("host"))
+
+    def execute(self, ctx: dict, cmd: str) -> Result:
+        full = wrap_sudo(ctx, wrap_cd(ctx, cmd))
+        p = subprocess.run(
+            ["docker", "exec", self.container, "sh", "-c", full],
+            capture_output=True, text=True, timeout=ctx.get("timeout", 120),
+        )
+        return Result(cmd=cmd, exit_status=p.returncode, out=p.stdout,
+                      err=p.stderr, host=self.container)
+
+    def upload(self, ctx, local_paths, remote_path) -> None:
+        paths = [local_paths] if isinstance(local_paths, str) else list(local_paths)
+        for p in paths:
+            r = subprocess.run(["docker", "cp", str(p),
+                                f"{self.container}:{remote_path}"],
+                               capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RemoteError(f"docker cp failed: {r.stderr[:300]}",
+                                  host=self.container, err=r.stderr)
+
+    def download(self, ctx, remote_paths, local_path) -> None:
+        paths = [remote_paths] if isinstance(remote_paths, str) else list(remote_paths)
+        for p in paths:
+            r = subprocess.run(["docker", "cp", f"{self.container}:{p}",
+                                str(local_path)],
+                               capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RemoteError(f"docker cp failed: {r.stderr[:300]}",
+                                  host=self.container, err=r.stderr)

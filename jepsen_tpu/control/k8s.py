@@ -1,0 +1,50 @@
+"""Remote via `kubectl exec` / `kubectl cp` (reference:
+jepsen/src/jepsen/control/k8s.clj:14-73)."""
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass
+
+from jepsen_tpu.control.core import Remote, RemoteError, Result, wrap_cd, wrap_sudo
+
+
+@dataclass
+class K8sRemote(Remote):
+    pod: str | None = None
+    namespace: str = "default"
+
+    def connect(self, conn_spec: dict) -> "K8sRemote":
+        return K8sRemote(pod=conn_spec.get("host"),
+                         namespace=conn_spec.get("namespace", "default"))
+
+    def execute(self, ctx: dict, cmd: str) -> Result:
+        full = wrap_sudo(ctx, wrap_cd(ctx, cmd))
+        p = subprocess.run(
+            ["kubectl", "exec", "-n", self.namespace, self.pod, "--",
+             "sh", "-c", full],
+            capture_output=True, text=True, timeout=ctx.get("timeout", 120),
+        )
+        return Result(cmd=cmd, exit_status=p.returncode, out=p.stdout,
+                      err=p.stderr, host=self.pod)
+
+    def upload(self, ctx, local_paths, remote_path) -> None:
+        paths = [local_paths] if isinstance(local_paths, str) else list(local_paths)
+        for p in paths:
+            r = subprocess.run(
+                ["kubectl", "cp", "-n", self.namespace, str(p),
+                 f"{self.pod}:{remote_path}"],
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RemoteError(f"kubectl cp failed: {r.stderr[:300]}",
+                                  host=self.pod, err=r.stderr)
+
+    def download(self, ctx, remote_paths, local_path) -> None:
+        paths = [remote_paths] if isinstance(remote_paths, str) else list(remote_paths)
+        for p in paths:
+            r = subprocess.run(
+                ["kubectl", "cp", "-n", self.namespace,
+                 f"{self.pod}:{p}", str(local_path)],
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RemoteError(f"kubectl cp failed: {r.stderr[:300]}",
+                                  host=self.pod, err=r.stderr)

@@ -1,0 +1,65 @@
+"""Retrying remote wrapper (reference: jepsen/src/jepsen/control/retry.clj).
+
+Wraps any Remote, retrying flaky operations: 5 tries with ~50-150 ms
+randomized backoff (retry.clj:15-21 — backoff-time 100 ms ± jitter)."""
+from __future__ import annotations
+
+import random
+import time
+
+from jepsen_tpu.control.core import Remote, RemoteError, Result
+
+TRIES = 5
+BACKOFF_BASE_S = 0.05
+BACKOFF_JITTER_S = 0.1
+
+
+class RetryRemote(Remote):
+    def __init__(self, remote: Remote):
+        self.remote = remote
+
+    def connect(self, conn_spec: dict) -> "RetryRemote":
+        err = None
+        for _ in range(TRIES):
+            try:
+                return RetryRemote(self.remote.connect(conn_spec))
+            except Exception as e:  # noqa: BLE001
+                err = e
+                time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+        raise err
+
+    # ssh itself exits 255 on transport failure; our SSHRemote reports
+    # timeouts as -1. Both are indistinguishable from a remote command
+    # exiting 255, so (like the reference, which retries any flaky SSH op)
+    # we retry them — remote commands exiting 255 are vanishingly rare.
+    TRANSPORT_EXITS = (-1, 255)
+
+    def _retrying(self, fn):
+        err = None
+        for _ in range(TRIES):
+            try:
+                return fn()
+            except RemoteError as e:
+                raise e  # command failed legitimately; don't retry
+            except Exception as e:  # noqa: BLE001  transport flake
+                err = e
+                time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+        raise err
+
+    def execute(self, ctx, cmd) -> Result:
+        res = None
+        for attempt in range(TRIES):
+            res = self._retrying(lambda: self.remote.execute(ctx, cmd))
+            if res.exit_status not in self.TRANSPORT_EXITS:
+                return res
+            time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+        return res
+
+    def upload(self, ctx, local_paths, remote_path):
+        return self._retrying(lambda: self.remote.upload(ctx, local_paths, remote_path))
+
+    def download(self, ctx, remote_paths, local_path):
+        return self._retrying(lambda: self.remote.download(ctx, remote_paths, local_path))
+
+    def disconnect(self):
+        self.remote.disconnect()

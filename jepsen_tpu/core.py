@@ -1,0 +1,236 @@
+"""Orchestrator: the full test lifecycle (reference: jepsen/src/jepsen/core.clj).
+
+``run(test)``: prepare -> logging -> node sessions -> OS setup -> DB cycle ->
+client+nemesis setup -> generator interpreter -> log snarfing -> teardown ->
+save-1 -> analyze -> save-2 -> results (core.clj:326-397). A *test is a map*
+(core.clj:326-352): plain dict keys name/nodes/concurrency/ssh/os/db/client/
+nemesis/generator/checker/... merged over fakes.noop_test defaults.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Any
+
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control, db as db_mod, history as history_mod, store
+from jepsen_tpu.checker import check_safe
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.utils import real_pmap, with_relative_time, with_thread_name
+
+logger = logging.getLogger("jepsen.core")
+
+
+def synchronize(test: dict, timeout_s: float = 60.0) -> None:
+    """A barrier across all db nodes' setup threads (core.clj:44-57).
+    DB implementations call this between setup phases. A broken barrier
+    (another node failed or timed out) surfaces as SetupFailed so
+    db.cycle retries the whole cycle."""
+    barrier = test.get("barrier")
+    if barrier is not None:
+        try:
+            barrier.wait(timeout=timeout_s)
+        except threading.BrokenBarrierError as e:
+            raise db_mod.SetupFailed("setup barrier broken") from e
+
+
+def prepare_test(test: dict) -> dict:
+    """Fills start-time, concurrency, and the setup barrier
+    (core.clj:310-324)."""
+    test = dict(test)
+    test.setdefault("start_time", store.start_time())
+    n_nodes = len(test.get("nodes") or [])
+    from jepsen_tpu.utils import parse_concurrency
+    test["concurrency"] = parse_concurrency(test.get("concurrency", 1), n_nodes)
+    if n_nodes:
+        test.setdefault("barrier", threading.Barrier(n_nodes))
+    if test.get("net") is None and not (test.get("ssh") or {}).get("dummy"):
+        from jepsen_tpu.net import IPTables
+        test["net"] = IPTables()
+    elif test.get("net") is None:
+        from jepsen_tpu.net import NoopNet
+        test["net"] = NoopNet()
+    return test
+
+
+def log_test_start(test: dict) -> None:
+    """Records run provenance (core.clj:253-272)."""
+    import subprocess
+    import sys
+    try:
+        sha = subprocess.run(["git", "rev-parse", "HEAD"], capture_output=True,
+                             text=True, timeout=5).stdout.strip()
+    except Exception:  # noqa: BLE001
+        sha = "unknown"
+    logger.info("Test %s starting; argv=%r git=%s", test.get("name"),
+                sys.argv, sha)
+
+
+@contextlib.contextmanager
+def with_os(test: dict):
+    """OS setup on all nodes; teardown after (core.clj:93-100)."""
+    os_ = test.get("os")
+    if os_ is not None:
+        control.on_nodes(test, lambda n: os_.setup(test, n))
+    try:
+        yield
+    finally:
+        if os_ is not None and not test.get("leave_db_running"):
+            try:
+                control.on_nodes(test, lambda n: os_.teardown(test, n))
+            except Exception:  # noqa: BLE001
+                logger.exception("OS teardown failed")
+
+
+@contextlib.contextmanager
+def with_db(test: dict):
+    """DB cycle (teardown->setup, retried), teardown after unless
+    leave_db_running (core.clj:172-181, db.clj:121-158)."""
+    db = test.get("db")
+    if db is not None:
+        db_mod.cycle(test, db)
+    try:
+        yield
+    finally:
+        if db is not None and not test.get("leave_db_running"):
+            try:
+                db_mod.teardown_all(test, db)
+            except Exception:  # noqa: BLE001
+                logger.exception("DB teardown failed")
+
+
+def snarf_logs(test: dict) -> None:
+    """Downloads db log files from each node into the store dir
+    (core.clj:102-136)."""
+    db = test.get("db")
+    if not isinstance(db, db_mod.LogFiles):
+        return
+
+    def snarf(node):
+        files = db.log_files(test, node)
+        if not files:
+            return
+        dest = store.path_mk(test, node, "x").parent
+        dest.mkdir(parents=True, exist_ok=True)
+        for f in files:
+            try:
+                control.on(node, test, lambda: control.download(f, str(dest)))
+            except Exception:  # noqa: BLE001
+                logger.warning("couldn't download %s from %s", f, node)
+
+    try:
+        real_pmap(snarf, list(test.get("nodes") or []))
+    except Exception:  # noqa: BLE001
+        logger.exception("log snarfing failed")
+
+
+@contextlib.contextmanager
+def with_client_and_nemesis(test: dict):
+    """Nemesis setup (concurrently) + one client open+setup per node;
+    teardown both after (core.clj:183-212). Rebinds test['client'] /
+    test['nemesis'] to the set-up instances."""
+    proto_client = test.get("client")
+    proto_nemesis = test.get("nemesis")
+    setup_clients: list = []
+    clients_lock = threading.Lock()
+
+    nemesis_box: list = [None]
+    nemesis_err: list = []
+
+    def setup_nemesis():
+        try:
+            if proto_nemesis is not None:
+                nemesis_box[0] = proto_nemesis.setup(test)
+        except Exception as e:  # noqa: BLE001
+            nemesis_err.append(e)
+
+    nt = threading.Thread(target=setup_nemesis, daemon=True)
+    nt.start()
+    try:
+        if proto_client is not None:
+            def open_and_setup(node):
+                c = proto_client.open(test, node)
+                # record immediately so a failure on another node still
+                # tears this one down
+                with clients_lock:
+                    setup_clients.append(c)
+                c.setup(test)
+            real_pmap(open_and_setup, list(test.get("nodes") or []))
+        nt.join()
+        if nemesis_err:
+            raise nemesis_err[0]
+        if nemesis_box[0] is not None:
+            test["nemesis"] = nemesis_box[0]
+        yield
+    finally:
+        # never tear down a nemesis that's still setting up
+        nt.join()
+        for c in setup_clients:
+            try:
+                c.teardown(test)
+                c.close(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("client teardown failed")
+        try:
+            if nemesis_box[0] is not None:
+                nemesis_box[0].teardown(test)
+        except Exception:  # noqa: BLE001
+            logger.exception("nemesis teardown failed")
+        test["nemesis"] = proto_nemesis
+
+
+def run_case(test: dict) -> list[dict]:
+    """Client+nemesis setup then the interpreter (core.clj:214-219)."""
+    with with_client_and_nemesis(test):
+        return interpreter.run(test)
+
+
+def analyze(test: dict) -> dict:
+    """Indexes the history, runs the checker, persists results
+    (core.clj:221-236)."""
+    logger.info("Analyzing...")
+    history = history_mod.index(test.get("history") or [])
+    test["history"] = history
+    checker = test.get("checker")
+    if checker is not None:
+        test["results"] = check_safe(checker, test, history, {})
+    else:
+        test["results"] = {"valid?": True}
+    store.save_2(test)
+    logger.info("Analysis complete")
+    return test
+
+
+def log_results(test: dict) -> None:
+    """(core.clj:238-251)"""
+    results = test.get("results") or {}
+    valid = results.get("valid?")
+    if valid is True:
+        logger.info("Everything looks good! ヽ('ー`)ノ")
+    elif valid == "unknown":
+        logger.info("Errors occurred during analysis, but no anomalies found. ಠ~ಠ")
+    else:
+        logger.info("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+
+
+def run(test: dict) -> dict:
+    """The whole enchilada (core.clj:326-397)."""
+    test = prepare_test(test)
+    store.start_logging(test)
+    try:
+        with with_thread_name(f"jepsen-{test.get('name')}"):
+            log_test_start(test)
+            with control.with_test_nodes(test):
+                with with_os(test):
+                    with with_db(test):
+                        with with_relative_time():
+                            history = run_case(test)
+                        test["history"] = history
+                        snarf_logs(test)
+                        store.save_1(test)
+            test = analyze(test)
+            log_results(test)
+            return test
+    finally:
+        store.stop_logging()

@@ -1,0 +1,129 @@
+"""In-memory test doubles: a CAS register over a locked cell, with a meta-log
+of lifecycle calls.
+
+Reference: jepsen/src/jepsen/tests.clj:27-67 (atom-db / atom-client), the
+backbone of cluster-free integration tests of the full run lifecycle
+(core_test.clj basic-cas-test et al., SURVEY.md §4 tier 2).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.client import Client
+
+
+class AtomDB(db_mod.NoopDB):
+    """An in-memory 'cluster': one locked cell shared by all clients.
+    Records setup/teardown calls per node for lifecycle assertions."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value: Any = None
+        self.log: list[tuple] = []
+        self._log_lock = threading.Lock()
+
+    def _note(self, *event):
+        with self._log_lock:
+            self.log.append(event)
+
+    def setup(self, test, node):
+        self._note("db-setup", node)
+
+    def teardown(self, test, node):
+        with self.lock:
+            self.value = None
+        self._note("db-teardown", node)
+
+    # register primitives used by AtomClient
+    def read(self):
+        with self.lock:
+            return self.value
+
+    def write(self, v):
+        with self.lock:
+            self.value = v
+
+    def cas(self, old, new) -> bool:
+        with self.lock:
+            if self.value == old:
+                self.value = new
+                return True
+            return False
+
+
+class AtomClient(Client):
+    """CAS-register client over an AtomDB (tests.clj atom-client)."""
+
+    def __init__(self, db: AtomDB, node: str | None = None):
+        self.db = db
+        self.node = node
+
+    def open(self, test, node):
+        c = AtomClient(self.db, node)
+        self.db._note("client-open", node)
+        return c
+
+    def setup(self, test):
+        self.db._note("client-setup", self.node)
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "read":
+            return {**op, "type": "ok", "value": self.db.read()}
+        if f == "write":
+            self.db.write(v)
+            return {**op, "type": "ok"}
+        if f == "cas":
+            old, new = v
+            ok = self.db.cas(old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+    def teardown(self, test):
+        self.db._note("client-teardown", self.node)
+
+    def close(self, test):
+        self.db._note("client-close", self.node)
+
+
+class CrashingClient(Client):
+    """Always raises — exercises the interpreter's indeterminate-op path
+    (core_test.clj worker-recovery-test)."""
+
+    def __init__(self):
+        self.invocations = 0
+        self._lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self._lock:
+            self.invocations += 1
+        raise RuntimeError("client crashed (as designed)")
+
+    def close(self, test):
+        pass
+
+
+def noop_test(**overrides) -> dict:
+    """Default test map (reference: jepsen/src/jepsen/tests.clj:12-25 noop-test).
+    A test is plain data; suites merge over these defaults."""
+    from jepsen_tpu import checker
+    test = {
+        "name": "noop",
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "ssh": {"dummy": True},
+        "os": None,
+        "db": db_mod.NoopDB(),
+        "client": None,
+        "nemesis": None,
+        "generator": None,
+        "checker": checker.unbridled_optimism(),
+        "time_limit": 60,
+    }
+    test.update(overrides)
+    return test

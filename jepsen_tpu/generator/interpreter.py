@@ -1,0 +1,271 @@
+"""Threaded interpreter: bridges the pure generator to real client threads.
+
+Reference: jepsen/src/jepsen/generator/interpreter.clj. One thread per
+client worker plus one for the nemesis; each worker has a size-1 in-queue,
+all share a completion queue; a single scheduler thread alternates between
+polling completions and asking the generator for ops (interpreter.clj:
+181-310). Crashed ops (:info) renumber the worker's process and force a
+client reopen unless the client is reusable (:33-67, :142-157). Pseudo-ops
+(:sleep/:log) are handled in-worker and excluded from history (:172-179).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from typing import Any
+
+from jepsen_tpu import client as client_mod
+from jepsen_tpu.generator import (
+    NEMESIS, PENDING, Context, as_gen, context, friendly_exceptions, validate,
+)
+from jepsen_tpu.utils import (
+    relative_time_nanos, relative_time_origin, with_relative_time,
+)
+
+logger = logging.getLogger("jepsen.interpreter")
+
+# Max time between generator re-polls when pending, µs (interpreter.clj:166-170)
+MAX_PENDING_INTERVAL_S = 0.001
+
+
+class _Exit:
+    pass
+
+
+_EXIT = _Exit()
+
+
+class Worker:
+    """One sequential execution context (interpreter.clj:19-31)."""
+
+    def open(self, test: dict, worker_id) -> "Worker":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        raise NotImplementedError
+
+    def close(self, test: dict) -> None:
+        pass
+
+
+class ClientWorker(Worker):
+    """Wraps a Client; reopens it when its process crashes
+    (interpreter.clj:33-67)."""
+
+    def __init__(self, node: str, client: client_mod.Client | None = None,
+                 process=None):
+        self.node = node
+        self.client = client
+        self.process = process
+
+    def open(self, test, worker_id):
+        return self
+
+    def _ensure_client(self, test, process):
+        if self.client is not None and (
+            self.process == process or getattr(self.client, "reusable", False)
+        ):
+            self.process = process
+            return self.client
+        if self.client is not None:
+            try:
+                self.client.close(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("error closing client for reopen")
+            self.client = None
+        self.client = test["client"].open(test, self.node)
+        self.process = process
+        return self.client
+
+    def invoke(self, test, op):
+        try:
+            c = self._ensure_client(test, op.get("process"))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("client open failed")
+            return {**op, "type": "fail", "error": ["no-client", repr(e)]}
+        try:
+            return c.invoke(test, op)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("client op crashed")
+            # indeterminate: the op may or may not have happened
+            # (interpreter.clj:142-157)
+            return {**op, "type": "info", "error": ["indeterminate", repr(e)]}
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    """Applies ops via the test's nemesis (interpreter.clj:69-76)."""
+
+    def invoke(self, test, op):
+        try:
+            nemesis = test.get("nemesis")
+            if nemesis is None:
+                return {**op, "type": "info"}
+            completion = nemesis.invoke(test, op)
+            if completion is None:
+                completion = {**op}
+            completion.setdefault("type", "info")
+            return completion
+        except Exception as e:  # noqa: BLE001
+            logger.exception("nemesis op crashed")
+            return {**op, "type": "info", "error": ["indeterminate", repr(e)]}
+
+
+def goes_in_history(op: dict) -> bool:
+    """:sleep and :log pseudo-ops are invisible (interpreter.clj:172-179)."""
+    return op.get("type") not in ("sleep", "log")
+
+
+def _spawn_worker(test: dict, worker_id, completions: queue.Queue):
+    """Worker thread + its in-queue (interpreter.clj:99-164)."""
+    in_q: queue.Queue = queue.Queue(maxsize=1)
+    if worker_id == NEMESIS:
+        worker: Worker = NemesisWorker()
+    else:
+        nodes = test.get("nodes") or [None]
+        worker = ClientWorker(nodes[worker_id % len(nodes)])
+
+    def run():
+        threading.current_thread().name = f"jepsen-worker-{worker_id}"
+        while True:
+            op = in_q.get()
+            if op is _EXIT:
+                completions.put((worker_id, _EXIT))
+                return
+            typ = op.get("type")
+            if typ == "sleep":
+                _time.sleep(op.get("value") or 0)
+                completion = {**op}
+            elif typ == "log":
+                logger.info("%s", op.get("value"))
+                completion = {**op}
+            else:
+                completion = worker.invoke(test, op)
+            completions.put((worker_id, completion))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return {"id": worker_id, "in": in_q, "thread": t, "worker": worker}
+
+
+def run(test: dict) -> list[dict]:
+    """Runs the test's generator to completion, returning the history
+    (interpreter.clj:181-310). Must be called inside
+    utils.with_relative_time (core.run does this); establishes one if not.
+    """
+    if relative_time_origin() is None:
+        with with_relative_time():
+            return run(test)
+
+    gen = friendly_exceptions(validate(as_gen(test.get("generator"))))
+    ctx = context(test)
+    completions: queue.Queue = queue.Queue()
+    workers = {w["id"]: w for w in (
+        _spawn_worker(test, wid, completions) for wid in ctx.workers
+    )}
+    history: list[dict] = []
+
+    def thread_of(process):
+        return NEMESIS if process == NEMESIS else ctx.thread_of(process)
+
+    def process_completion(completion) -> Any:
+        """Re-stamps time, frees the thread, updates the generator, and
+        renumbers crashed processes (interpreter.clj:216-241). Returns the
+        freed thread id."""
+        nonlocal ctx, gen
+        now = relative_time_nanos()
+        completion = {**completion, "time": now}
+        ctx = ctx.with_time(now)
+        thread = thread_of(completion.get("process"))
+        if goes_in_history(completion):
+            history.append(completion)
+            if gen is not None:
+                gen = gen.update(test, ctx, completion)
+            if (completion.get("type") == "info"
+                    and completion.get("process") != NEMESIS):
+                ctx = ctx.with_next_process(thread)
+        ctx = ctx.free_thread(thread)
+        return thread
+
+    try:
+        # main scheduling loop (interpreter.clj:206-292)
+        while True:
+            # 1. drain any ready completion
+            try:
+                _, completion = completions.get_nowait()
+                process_completion(completion)
+                continue
+            except queue.Empty:
+                pass
+            # 2. ask the generator
+            now = relative_time_nanos()
+            ctx = ctx.with_time(now)
+            res = gen.op(test, ctx) if gen is not None else None
+            if res is None:
+                break  # exhausted -> drain
+            op, gen2 = res
+            if op is PENDING:
+                gen = gen2
+                # nothing soon: block briefly on completions
+                # (max-pending-interval, interpreter.clj:166-170,264)
+                try:
+                    _, completion = completions.get(timeout=MAX_PENDING_INTERVAL_S)
+                    process_completion(completion)
+                except queue.Empty:
+                    pass
+                continue
+            if op["time"] > now:
+                # future-dated: wait for its time, but a completion may
+                # change the schedule — reconsult the (old) generator
+                # (interpreter.clj:268-275)
+                try:
+                    _, completion = completions.get(timeout=(op["time"] - now) / 1e9)
+                    process_completion(completion)
+                    continue
+                except queue.Empty:
+                    pass
+            # dispatch
+            gen = gen2
+            now = relative_time_nanos()
+            op = {**op, "time": now}
+            thread = thread_of(op.get("process"))
+            workers[thread]["in"].put(op)
+            ctx = ctx.busy_thread(thread).with_time(now)
+            if goes_in_history(op):
+                history.append(op)
+                if gen is not None:
+                    gen = gen.update(test, ctx, op)
+
+        # drain: free workers exit now; busy workers exit after completing
+        # (interpreter.clj:250-261)
+        pending_exits = set(workers)
+        for t in ctx.free_threads:
+            workers[t]["in"].put(_EXIT)
+        while pending_exits:
+            wid, completion = completions.get()
+            if completion is _EXIT:
+                pending_exits.discard(wid)
+                continue
+            thread = process_completion(completion)
+            workers[thread]["in"].put(_EXIT)
+    finally:
+        # abnormal shutdown: make sure worker threads die and clients close
+        # (interpreter.clj:294-309)
+        for w in workers.values():
+            try:
+                w["in"].put_nowait(_EXIT)
+            except queue.Full:
+                pass
+        for w in workers.values():
+            try:
+                if isinstance(w["worker"], ClientWorker):
+                    w["worker"].close(test)
+            except Exception:  # noqa: BLE001
+                pass
+    return history

@@ -1,0 +1,175 @@
+"""Network manipulation (reference: jepsen/src/jepsen/net.clj +
+net/proto.clj + control/net.clj).
+
+The Net protocol cuts/heals/degrades links between db nodes — it breaks the
+*system under test's* network, not the control plane. The iptables
+implementation mirrors net.clj:58-111 (tc netem for slow/flaky, batch
+PartitionAll application); ipfilter is available for BSD-ish targets
+(net.clj:113-145).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from jepsen_tpu import control
+from jepsen_tpu.utils import real_pmap
+
+logger = logging.getLogger("jepsen.net")
+
+
+class Net:
+    """net/proto.clj:5-12"""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        """Cuts the link src -> dest."""
+        raise NotImplementedError
+
+    def heal(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def slow(self, test: dict, mean_ms: float = 50, variance_ms: float = 10) -> None:
+        raise NotImplementedError
+
+    def flaky(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def fast(self, test: dict) -> None:
+        raise NotImplementedError
+
+    # PartitionAll extension (net.clj:101-111): apply a whole grudge at once
+    def drop_all(self, test: dict, grudge: dict) -> None:
+        """grudge: {node: iterable-of-nodes-to-snub}. Default: per-link."""
+        for node, snubbed in grudge.items():
+            for other in snubbed:
+                self.drop(test, other, node)
+
+
+def resolve_ip(test: dict, node: str) -> str:
+    """Resolves a node name to an IP on the control node or via getent on
+    the node itself (control/net.clj:19-40). Cached on the test map."""
+    cache = test.setdefault("_ip_cache", {})
+    if node in cache:
+        return cache[node]
+    import socket
+    try:
+        ip = socket.gethostbyname(node)
+    except OSError:
+        ip = node
+    cache[node] = ip
+    return ip
+
+
+class IPTables(Net):
+    """Default partitioner: `iptables -A INPUT -s <ips> -j DROP -w`
+    (net.clj:58-111)."""
+
+    def drop(self, test, src, dest):
+        ip = resolve_ip(test, src)
+        control.on(dest, test, lambda: _iptables_drop([ip]))
+
+    def drop_all(self, test, grudge):
+        def apply_node(node):
+            snubbed = grudge.get(node) or []
+            if not snubbed:
+                return
+            ips = [resolve_ip(test, s) for s in snubbed]
+            control.on(node, test, lambda: _iptables_drop(ips))
+        real_pmap(apply_node, [n for n, s in grudge.items() if s])
+
+    def heal(self, test):
+        def heal_node(node):
+            control.on(node, test, lambda: _iptables_heal())
+        real_pmap(heal_node, list(test.get("nodes") or []))
+
+    def slow(self, test, mean_ms=50, variance_ms=10):
+        def slow_node(node):
+            control.on(node, test, lambda: _tc_netem(
+                f"delay {mean_ms}ms {variance_ms}ms distribution normal"))
+        real_pmap(slow_node, list(test.get("nodes") or []))
+
+    def flaky(self, test):
+        def flaky_node(node):
+            control.on(node, test, lambda: _tc_netem(
+                "loss 20% 75% corrupt 1%"))
+        real_pmap(flaky_node, list(test.get("nodes") or []))
+
+    def fast(self, test):
+        def fast_node(node):
+            control.on(node, test, lambda: _tc_del())
+        real_pmap(fast_node, list(test.get("nodes") or []))
+
+
+def _iptables_drop(ips: Iterable[str]) -> None:
+    with control.su():
+        control.exec_("iptables", "-A", "INPUT", "-s", ",".join(ips),
+                      "-j", "DROP", "-w")
+
+
+def _iptables_heal() -> None:
+    with control.su():
+        control.exec_("iptables", "-F", "-w")
+        control.exec_("iptables", "-X", "-w")
+
+
+def _tc_netem(spec: str) -> None:
+    from jepsen_tpu.control.core import lit
+    with control.su():
+        control.exec_("tc", "qdisc", "replace", "dev", "eth0", "root",
+                      "netem", lit(spec))
+
+
+def _tc_del() -> None:
+    with control.su():
+        r = control.exec_star("tc", "qdisc", "del", "dev", "eth0", "root")
+        # no qdisc installed is fine
+        _ = r
+
+
+class IPFilter(Net):
+    """ipfilter-based variant for SmartOS/BSD targets (net.clj:113-145)."""
+
+    def drop(self, test, src, dest):
+        ip = resolve_ip(test, src)
+        control.on(dest, test, lambda: control.exec_(
+            "sh", "-c", f"echo 'block in quick from {ip}/32' | ipf -f -"))
+
+    def heal(self, test):
+        def heal_node(node):
+            control.on(node, test, lambda: control.exec_("ipf", "-Fa"))
+        real_pmap(heal_node, list(test.get("nodes") or []))
+
+    def slow(self, test, mean_ms=50, variance_ms=10):
+        raise NotImplementedError("ipfilter has no netem equivalent")
+
+    def flaky(self, test):
+        raise NotImplementedError("ipfilter has no netem equivalent")
+
+    def fast(self, test):
+        pass
+
+
+class NoopNet(Net):
+    """For dummy-remote runs: records grudges on the test map."""
+
+    def drop(self, test, src, dest):
+        test.setdefault("_net_log", []).append(("drop", src, dest))
+
+    def drop_all(self, test, grudge):
+        test.setdefault("_net_log", []).append(("drop-all", grudge))
+
+    def heal(self, test):
+        test.setdefault("_net_log", []).append(("heal",))
+
+    def slow(self, test, mean_ms=50, variance_ms=10):
+        test.setdefault("_net_log", []).append(("slow",))
+
+    def flaky(self, test):
+        test.setdefault("_net_log", []).append(("flaky",))
+
+    def fast(self, test):
+        test.setdefault("_net_log", []).append(("fast",))
+
+
+iptables = IPTables
+ipfilter = IPFilter

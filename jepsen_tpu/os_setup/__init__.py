@@ -1,0 +1,130 @@
+"""Operating-system automation (reference: jepsen/src/jepsen/os.clj +
+os/debian.clj, os/centos.clj, os/ubuntu.clj, os/smartos.clj).
+
+An OS prepares a node for DB installation: hostnames, base packages,
+package-manager plumbing (os.clj:4-8).
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import control
+
+logger = logging.getLogger("jepsen.os")
+
+
+class OS:
+    def setup(self, test: dict, node: str) -> None:
+        pass
+
+    def teardown(self, test: dict, node: str) -> None:
+        pass
+
+
+class Noop(OS):
+    """jepsen.os/noop"""
+
+
+def setup_hostfile(test: dict) -> None:
+    """Writes /etc/hosts mapping every node name to its IP
+    (os/debian.clj setup-hostfile!)."""
+    from jepsen_tpu.net import resolve_ip
+    lines = ["127.0.0.1 localhost"]
+    for n in test.get("nodes") or []:
+        lines.append(f"{resolve_ip(test, n)} {n}")
+    content = "\n".join(lines) + "\n"
+    with control.su():
+        control.exec_("tee", "/etc/hosts", stdin=content)
+
+
+class Debian(OS):
+    """apt-based setup (os/debian.clj)."""
+
+    def __init__(self, extra_packages: list[str] | None = None):
+        self.extra_packages = extra_packages or []
+
+    base_packages = [
+        "curl", "faketime", "iptables", "iputils-ping", "logrotate",
+        "man-db", "net-tools", "ntpdate", "psmisc", "rsyslog", "sudo",
+        "tar", "unzip", "wget",
+    ]
+
+    def setup(self, test, node):
+        def go():
+            setup_hostfile(test)
+            with control.su():
+                maybe_update()
+                install(self.base_packages + self.extra_packages)
+        control.on(node, test, go)
+
+    def teardown(self, test, node):
+        pass
+
+
+class Ubuntu(Debian):
+    """os/ubuntu.clj — identical surface, different base packages."""
+
+    base_packages = [p for p in Debian.base_packages if p != "faketime"]
+
+
+class CentOS(OS):
+    """yum-based setup (os/centos.clj)."""
+
+    def setup(self, test, node):
+        def go():
+            setup_hostfile(test)
+            with control.su():
+                control.exec_("yum", "-y", "install", "sudo", "curl", "wget",
+                              "unzip", "tar", "iptables", "psmisc")
+        control.on(node, test, go)
+
+
+class SmartOS(OS):
+    """pkgin-based setup (os/smartos.clj)."""
+
+    def setup(self, test, node):
+        def go():
+            with control.su():
+                control.exec_("pkgin", "-y", "update")
+                control.exec_("pkgin", "-y", "install", "curl", "gnu-coreutils")
+        control.on(node, test, go)
+
+
+# --- apt helpers (os/debian.clj:39+) --------------------------------------
+
+def maybe_update(max_age_s: int = 86400) -> None:
+    """apt-get update unless the cache is fresh (os/debian.clj:39-44)."""
+    r = control.exec_star(
+        "sh", "-c",
+        f"test -z \"$(find /var/cache/apt -maxdepth 0 -mmin -{max_age_s // 60})\" "
+        f"&& apt-get update || true")
+    _ = r
+
+
+def installed(packages) -> set:
+    """Subset of packages already installed (os/debian.clj:45+)."""
+    if isinstance(packages, str):
+        packages = [packages]
+    out = control.exec_star("dpkg-query", "-W", "-f", "${Package}\\n", *packages)
+    return set(out.out.split()) & set(packages)
+
+
+def install(packages) -> None:
+    if isinstance(packages, str):
+        packages = [packages]
+    missing = [p for p in packages if p not in installed(packages)]
+    if missing:
+        control.exec_("env", "DEBIAN_FRONTEND=noninteractive", "apt-get",
+                      "install", "-y", *missing)
+
+
+def installed_version(package: str) -> str | None:
+    r = control.exec_star("dpkg-query", "-W", "-f", "${Version}", package)
+    return r.out.strip() if r.exit_status == 0 and r.out.strip() else None
+
+
+debian = Debian
+centos = CentOS
+ubuntu = Ubuntu
+smartos = SmartOS
+noop = Noop

@@ -1,0 +1,240 @@
+"""Persistence: store/<name>/<timestamp>/ with logs, history, results.
+
+Reference: jepsen/src/jepsen/store.clj. Layout mirrors :118-147 (path/path!),
+save-1!/save-2! split (:388-413 — history persists *before* analysis so
+checking is re-entrant), current/latest symlinks (:316-342), and logging
+init (:431-451). Formats are JSON-lines for history and JSON for results
+(the reference's fressian/edn become jsonl + an .npz columnar sidecar —
+the EDN->numpy hop of BASELINE.json's north star is thereby free).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import shutil
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger("jepsen")
+
+BASE_DIR = "store"
+
+# Dropped before serialization (store.clj:160-168)
+NONSERIALIZABLE_KEYS = {
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "remote", "barrier",
+}
+
+
+def base_dir(test: dict) -> Path:
+    return Path(test.get("store_dir", BASE_DIR))
+
+
+def test_dir(test: dict) -> Path:
+    return base_dir(test) / str(test.get("name", "noop")) / str(test["start_time"])
+
+
+def path(test: dict, *components) -> Path:
+    return test_dir(test).joinpath(*[str(c) for c in components])
+
+
+def path_mk(test: dict, *components) -> Path:
+    """path + mkdir -p of the parent (store.clj path!)."""
+    p = path(test, *components)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _serializable(x: Any):
+    if isinstance(x, dict):
+        return {str(k): _serializable(v) for k, v in x.items()
+                if not (isinstance(k, str) and k.startswith("_"))}
+    if isinstance(x, (list, tuple)):
+        return [_serializable(v) for v in x]
+    if isinstance(x, (set, frozenset)):
+        return sorted((_serializable(v) for v in x), key=repr)
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    if isinstance(x, Path):
+        return str(x)
+    import numpy as np
+    if isinstance(x, np.generic):
+        return x.item()
+    return repr(x)
+
+
+def serializable_test(test: dict) -> dict:
+    return _serializable({
+        k: v for k, v in test.items()
+        if k not in NONSERIALIZABLE_KEYS and not str(k).startswith("_")
+        and k not in ("history", "results")
+    })
+
+
+def write_history(test: dict) -> None:
+    """history.jsonl: one op per line (store.clj:354-371). Also writes
+    history.txt in the reference's human format."""
+    from jepsen_tpu.utils import op2str
+    history = test.get("history") or []
+    with open(path_mk(test, "history.jsonl"), "w") as f:
+        for op in history:
+            f.write(json.dumps(_serializable(op)) + "\n")
+    with open(path_mk(test, "history.txt"), "w") as f:
+        for op in history:
+            f.write(op2str(op) + "\n")
+
+
+def write_columnar(test: dict) -> None:
+    """history.npz: the struct-of-arrays sidecar, checker-ready (the
+    EDN->numpy serialization of BASELINE's north star, built at save time)."""
+    import numpy as np
+    from jepsen_tpu.history import ColumnarHistory
+    history = test.get("history") or []
+    if not history:
+        return
+    col = ColumnarHistory.from_ops(history)
+    np.savez_compressed(
+        path_mk(test, "history.npz"),
+        types=col.types, processes=col.processes, fs=col.fs,
+        times=col.times, indices=col.indices,
+        completion_of=col.completion_of, invocation_of=col.invocation_of,
+    )
+
+
+def write_results(test: dict) -> None:
+    with open(path_mk(test, "results.json"), "w") as f:
+        json.dump(_serializable(test.get("results")), f, indent=2)
+
+
+def write_test(test: dict) -> None:
+    with open(path_mk(test, "test.json"), "w") as f:
+        json.dump(serializable_test(test), f, indent=2, default=repr)
+
+
+def save_1(test: dict) -> dict:
+    """Post-run save: history + test map, before analysis
+    (store.clj:388-399, core.clj:395)."""
+    write_history(test)
+    write_columnar(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test: dict) -> dict:
+    """Post-analysis save: results + rewrite test (store.clj:401-413)."""
+    write_results(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def update_symlinks(test: dict) -> None:
+    """store/<name>/latest and store/current (store.clj:316-342)."""
+    d = test_dir(test)
+    for link in [base_dir(test) / str(test.get("name", "noop")) / "latest",
+                 base_dir(test) / "current"]:
+        try:
+            link.parent.mkdir(parents=True, exist_ok=True)
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.symlink_to(d.resolve())
+        except OSError:
+            logger.debug("couldn't update symlink %s", link)
+
+
+def load_results(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> dict:
+    with open(Path(store_dir) / test_name / timestamp / "results.json") as f:
+        return json.load(f)
+
+
+def load_history(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> list[dict]:
+    out = []
+    with open(Path(store_dir) / test_name / timestamp / "history.jsonl") as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def load_test(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> dict:
+    d = Path(store_dir) / test_name / timestamp
+    with open(d / "test.json") as f:
+        test = json.load(f)
+    try:
+        test["history"] = load_history(test_name, timestamp, store_dir)
+    except FileNotFoundError:
+        pass
+    try:
+        test["results"] = load_results(test_name, timestamp, store_dir)
+    except FileNotFoundError:
+        pass
+    return test
+
+
+def tests(test_name: str | None = None, store_dir: str = BASE_DIR) -> dict:
+    """{name: {timestamp: path}} (store.clj:284-303)."""
+    base = Path(store_dir)
+    out: dict = {}
+    if not base.exists():
+        return out
+    names = [test_name] if test_name else [p.name for p in base.iterdir()
+                                           if p.is_dir() and p.name != "current"]
+    for name in names:
+        d = base / name
+        if not d.is_dir():
+            continue
+        out[name] = {p.name: p for p in sorted(d.iterdir())
+                     if p.is_dir() and p.name != "latest" and not p.is_symlink()}
+    return out
+
+
+def latest(store_dir: str = BASE_DIR):
+    """Most recent test dir across all names (store.clj:305-314)."""
+    best = None
+    for name, runs in tests(store_dir=store_dir).items():
+        for ts, p in runs.items():
+            if best is None or ts > best[1]:
+                best = (name, ts, p)
+    return best
+
+
+def delete(test_name: str | None = None, store_dir: str = BASE_DIR) -> None:
+    """Deletes stored runs (store.clj:461-478)."""
+    base = Path(store_dir)
+    target = base / test_name if test_name else base
+    if target.exists():
+        shutil.rmtree(target)
+
+
+def start_time() -> str:
+    return datetime.datetime.now().strftime("%Y%m%dT%H%M%S.%f")[:-3]
+
+
+_log_handler: dict = {}
+
+
+def start_logging(test: dict) -> None:
+    """Per-test jepsen.log file appender + console (store.clj:431-451)."""
+    stop_logging()
+    root = logging.getLogger("jepsen")
+    root.setLevel(logging.INFO)
+    if not any(isinstance(h, logging.StreamHandler) for h in root.handlers):
+        sh = logging.StreamHandler()
+        sh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: %(message)s"))
+        root.addHandler(sh)
+    fh = logging.FileHandler(path_mk(test, "jepsen.log"))
+    fh.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: %(message)s"))
+    root.addHandler(fh)
+    _log_handler["fh"] = fh
+
+
+def stop_logging() -> None:
+    fh = _log_handler.pop("fh", None)
+    if fh is not None:
+        logging.getLogger("jepsen").removeHandler(fh)
+        fh.close()

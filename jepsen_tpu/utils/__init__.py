@@ -39,6 +39,17 @@ def minority_third(n: int) -> int:
     return max(1, (n - 1) // 3)
 
 
+def parse_concurrency(s, n_nodes: int) -> int:
+    """'30' -> 30; '3n' -> 3 * n_nodes; 'n' -> n_nodes (cli.clj:150-165).
+    Single source of truth for the CLI and core.prepare_test."""
+    if isinstance(s, int):
+        return s
+    s = str(s).strip()
+    if s.endswith("n"):
+        return int(s[:-1] or 1) * n_nodes
+    return int(s)
+
+
 def secs_to_nanos(s: float) -> int:
     return int(s * NANOS_PER_SECOND)
 

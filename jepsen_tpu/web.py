@@ -1,0 +1,140 @@
+"""Web UI over the store (reference: jepsen/src/jepsen/web.clj).
+
+A small stdlib http.server app: a test table colored by validity
+(web.clj:104-122), per-run file browser, and zip download of a run
+(web.clj:262-300).
+"""
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from jepsen_tpu import store
+
+logger = logging.getLogger("jepsen.web")
+
+STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { padding: 0.3em 0.8em; border: 1px solid #ddd; text-align: left; }
+.valid-true { background: #c8f7c5; }
+.valid-false { background: #f7c5c5; }
+.valid-unknown { background: #f7eec5; }
+a { text-decoration: none; }
+"""
+
+
+def _validity(run_dir: Path):
+    try:
+        with open(run_dir / "results.json") as f:
+            return json.load(f).get("valid?")
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class Handler(BaseHTTPRequestHandler):
+    store_dir = "store"
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug(fmt, *args)
+
+    def _send(self, body: bytes, ctype="text/html; charset=utf-8", code=200,
+              extra_headers=None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _page(self, title: str, body: str) -> bytes:
+        return (f"<!doctype html><html><head><title>{html.escape(title)}</title>"
+                f"<style>{STYLE}</style></head><body><h1>{html.escape(title)}"
+                f"</h1>{body}</body></html>").encode()
+
+    def do_GET(self):  # noqa: N802
+        path = urllib.parse.unquote(self.path)
+        base = Path(self.store_dir).resolve()
+        try:
+            if path == "/" or path == "":
+                return self._home(base)
+            if path.startswith("/zip/"):
+                return self._zip(base, path[len("/zip/"):])
+            return self._files(base, path.lstrip("/"))
+        except BrokenPipeError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("web error")
+            self._send(self._page("error", "<p>internal error</p>"), code=500)
+
+    def _home(self, base: Path):
+        """Test table, most recent first (web.clj:104-122)."""
+        rows = []
+        for name, runs in sorted(store.tests(store_dir=str(base)).items()):
+            for ts, run_dir in sorted(runs.items(), reverse=True):
+                valid = _validity(run_dir)
+                cls = {True: "valid-true", False: "valid-false"}.get(
+                    valid, "valid-unknown")
+                rows.append(
+                    f"<tr class='{cls}'>"
+                    f"<td><a href='/{name}/{ts}/'>{html.escape(name)}</a></td>"
+                    f"<td><a href='/{name}/{ts}/'>{html.escape(ts)}</a></td>"
+                    f"<td>{valid}</td>"
+                    f"<td><a href='/zip/{name}/{ts}'>zip</a></td></tr>")
+        body = ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
+                "<th>download</th></tr>" + "".join(rows) + "</table>")
+        self._send(self._page("Jepsen-TPU", body))
+
+    def _files(self, base: Path, rel: str):
+        target = (base / rel).resolve()
+        if not (target == base or target.is_relative_to(base)):
+            return self._send(b"forbidden", code=403)
+        if target.is_dir():
+            items = "".join(
+                f"<li><a href='/{rel.rstrip('/')}/{p.name}{'/' if p.is_dir() else ''}'>"
+                f"{html.escape(p.name)}</a></li>"
+                for p in sorted(target.iterdir()))
+            return self._send(self._page(rel, f"<ul>{items}</ul>"))
+        if target.exists():
+            ctype = ("application/json" if target.suffix == ".json"
+                     else "image/png" if target.suffix == ".png"
+                     else "image/svg+xml" if target.suffix == ".svg"
+                     else "text/plain; charset=utf-8")
+            return self._send(target.read_bytes(), ctype=ctype)
+        return self._send(self._page("404", "<p>not found</p>"), code=404)
+
+    def _zip(self, base: Path, rel: str):
+        """Streams a zip of one run (web.clj:262-300)."""
+        target = (base / rel).resolve()
+        if not (target.is_relative_to(base) and target != base and target.is_dir()):
+            return self._send(b"not found", code=404)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for p in target.rglob("*"):
+                if p.is_file():
+                    z.write(p, p.relative_to(base))
+        self._send(buf.getvalue(), ctype="application/zip",
+                   extra_headers={"Content-Disposition":
+                                  f"attachment; filename={rel.replace('/', '-')}.zip"})
+
+
+def serve(store_dir: str = "store", host: str = "0.0.0.0", port: int = 8080):
+    """web.clj:361-366"""
+    handler = type("BoundHandler", (Handler,), {"store_dir": store_dir})
+    server = ThreadingHTTPServer((host, port), handler)
+    logger.info("Jepsen-TPU web UI at http://%s:%d", host, port)
+    server.serve_forever()
+
+
+def make_server(store_dir: str = "store", host: str = "127.0.0.1", port: int = 0):
+    """Non-blocking variant for tests; returns the server (call
+    serve_forever in a thread; .server_address has the bound port)."""
+    handler = type("BoundHandler", (Handler,), {"store_dir": store_dir})
+    return ThreadingHTTPServer((host, port), handler)

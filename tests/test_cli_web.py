@@ -1,0 +1,58 @@
+"""CLI and web UI tests (reference: cli.clj exit-code contract
+:129-139, web.clj table/zip)."""
+import json
+import tempfile
+import threading
+import urllib.request
+
+from jepsen_tpu import cli, store
+
+
+def test_noop_cli_run_and_exit_code():
+    with tempfile.TemporaryDirectory() as tmp:
+        code = cli.noop_main(["test", "--no-ssh", "--store-dir", tmp,
+                              "--concurrency", "2"])
+        assert code == cli.EXIT_OK
+        # a store dir was created with test.json
+        found = store.latest(tmp)
+        assert found is not None
+        name, ts, p = found
+        assert (p / "test.json").exists()
+
+
+def test_cli_analyze_stored_history():
+    with tempfile.TemporaryDirectory() as tmp:
+        assert cli.noop_main(["test", "--no-ssh", "--store-dir", tmp]) == 0
+        code = cli.noop_main(["analyze", "--store-dir", tmp])
+        assert code == cli.EXIT_OK
+
+
+def test_parse_concurrency():
+    assert cli.parse_concurrency("30", 5) == 30
+    assert cli.parse_concurrency("3n", 5) == 15
+    assert cli.parse_concurrency("n", 5) == 5
+
+
+def test_web_ui_serves_table_and_files():
+    from jepsen_tpu.web import make_server
+    with tempfile.TemporaryDirectory() as tmp:
+        assert cli.noop_main(["test", "--no-ssh", "--store-dir", tmp]) == 0
+        srv = make_server(tmp, "127.0.0.1", 0)
+        port = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            home = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+            assert "noop" in home
+            assert "valid-true" in home
+            name, ts, _ = store.latest(tmp)
+            res = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/{name}/{ts}/results.json",
+                timeout=10).read().decode()
+            assert json.loads(res)["valid?"] is True
+            z = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/zip/{name}/{ts}", timeout=10).read()
+            assert z[:2] == b"PK"
+        finally:
+            srv.shutdown()
