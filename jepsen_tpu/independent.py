@@ -1,0 +1,257 @@
+"""Key-lifting: turn single-key workloads into many-key workloads.
+
+Reference: jepsen/src/jepsen/independent.clj. Values become [k, v] tuples
+(:21-29); generators run per-key either sequentially (:31-47) or with
+groups of n threads working concurrently through a key rotation
+(ConcurrentGenerator, :101-209); the checker splits the history per key and
+checks each sub-history independently (:264-315).
+
+This is the cleanest TPU win (SURVEY.md §2.6): per-key sub-histories are
+embarrassingly parallel, so the lifted linearizability checker batches all
+keys into one padded event tensor and runs the jitlin kernel under vmap —
+sharded across devices by jepsen_tpu.parallel when a mesh is available
+(BASELINE config 3).
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Iterable
+
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu.checker import Checker, check_safe, merge_valid
+from jepsen_tpu.generator import Generator, PENDING, as_gen
+from jepsen_tpu.utils import bounded_pmap
+
+logger = logging.getLogger("jepsen.independent")
+
+
+def tuple_value(k, v) -> list:
+    """An independent [key, value] pair (independent.clj:21-29). Plain
+    lists so histories stay JSON-serializable."""
+    return [k, v]
+
+
+def is_tuple_value(v) -> bool:
+    return isinstance(v, (list, tuple)) and len(v) == 2
+
+
+def tuple_gen(k, gen) -> Generator:
+    """Lifts a generator's values into [k, v] tuples."""
+    def lift(op):
+        op = dict(op)
+        op["value"] = tuple_value(k, op.get("value"))
+        return op
+    return gen_mod.Map(lift, gen)
+
+
+def sequential_generator(keys: Iterable, gen_fn: Callable[[Any], Any]) -> Generator:
+    """One key at a time: exhaust gen_fn(k) for each k in order
+    (independent.clj:31-47)."""
+    return gen_mod.Seq([tuple_gen(k, gen_fn(k)) for k in keys])
+
+
+@dataclass(frozen=True)
+class ConcurrentGenerator(Generator):
+    """Groups of n threads each work through their own sequence of keys
+    concurrently (independent.clj:101-209). When a group's generator for
+    its current key is exhausted, the group rotates to the next unclaimed
+    key; the whole generator is exhausted when no keys remain and every
+    group's generator is spent.
+    """
+
+    n: int                       # threads per group
+    keys: tuple                  # remaining unclaimed keys
+    gen_fn: Callable = field(compare=False)
+    groups: tuple = ()           # ((threads-frozenset, key, gen) ...)
+
+    def _init_groups(self, ctx):
+        """Carve client threads into groups of n."""
+        client_threads = sorted(t for t in ctx.workers if t != gen_mod.NEMESIS)
+        groups = []
+        keys = list(self.keys)
+        for i in range(0, len(client_threads) - self.n + 1, self.n):
+            threads = frozenset(client_threads[i:i + self.n])
+            if keys:
+                k = keys.pop(0)
+                groups.append((threads, k, tuple_gen(k, self.gen_fn(k))))
+            else:
+                groups.append((threads, None, None))
+        return replace(self, keys=tuple(keys), groups=tuple(groups))
+
+    def op(self, test, ctx):
+        if not self.groups:
+            inited = self._init_groups(ctx)
+            if not inited.groups:
+                return None
+            return inited.op(test, ctx)
+        candidates = []
+        state = self
+        for i, (threads, k, g) in enumerate(state.groups):
+            # rotate exhausted groups to fresh keys
+            while True:
+                gg = as_gen(g)
+                res = gg.op(test, ctx.restrict(threads)) if gg is not None else None
+                if res is not None:
+                    break
+                if state.keys:
+                    k = state.keys[0]
+                    g = tuple_gen(k, state.gen_fn(k))
+                    groups = list(state.groups)
+                    groups[i] = (threads, k, g)
+                    state = replace(state, keys=state.keys[1:],
+                                    groups=tuple(groups))
+                else:
+                    g = None
+                    groups = list(state.groups)
+                    groups[i] = (threads, None, None)
+                    state = replace(state, groups=tuple(groups))
+                    break
+            if g is None:
+                continue
+            op, g2 = res
+            candidates.append((op, g2, i))
+        if not candidates:
+            return None
+        best = gen_mod.soonest_op_map(candidates)
+        op, g2, i = best
+        if op is PENDING:
+            return (PENDING, state)
+        groups = list(state.groups)
+        threads, k, _ = groups[i]
+        groups[i] = (threads, k, g2)
+        return (op, replace(state, groups=tuple(groups)))
+
+    def update(self, test, ctx, event):
+        if not self.groups:
+            return self
+        p = event.get("process")
+        t = gen_mod.NEMESIS if p == gen_mod.NEMESIS else ctx.thread_of(p)
+        for i, (threads, k, g) in enumerate(self.groups):
+            if t in threads and g is not None:
+                gg = as_gen(g)
+                if gg is None:
+                    return self
+                groups = list(self.groups)
+                groups[i] = (threads, k,
+                             gg.update(test, ctx.restrict(threads), event))
+                return replace(self, groups=tuple(groups))
+        return self
+
+
+def concurrent_generator(n: int, keys: Iterable, gen_fn: Callable) -> Generator:
+    """(independent.clj:211-236). n threads per key-group; len(client
+    threads) should be a multiple of n."""
+    return ConcurrentGenerator(n=n, keys=tuple(keys), gen_fn=gen_fn)
+
+
+def history_keys(history: list[dict]) -> list:
+    """All keys in a lifted history (independent.clj:238-248)."""
+    seen = {}
+    for op in history:
+        v = op.get("value")
+        if is_tuple_value(v):
+            seen.setdefault(_freeze_key(v[0]), v[0])
+    return list(seen.values())
+
+
+def _freeze_key(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+def subhistory(k, history: list[dict]) -> list[dict]:
+    """The sub-history for key k, with inner values unwrapped
+    (independent.clj:250-262)."""
+    fk = _freeze_key(k)
+    out = []
+    for op in history:
+        v = op.get("value")
+        if is_tuple_value(v) and _freeze_key(v[0]) == fk:
+            out.append({**op, "value": v[1]})
+    return out
+
+
+class IndependentChecker(Checker):
+    """Lifts a checker over keys (independent.clj:264-315): splits the
+    history, checks each key, merges validity and reports failures by key.
+
+    Fast path: when the inner checker is a register LinearizableChecker and
+    a device is wanted, all keys are encoded and batched through one
+    vmapped jitlin kernel call (optionally sharded over a mesh); keys whose
+    device verdict is unsound (frontier overflow + death) fall back to the
+    exact CPU search.
+    """
+
+    def __init__(self, checker: Checker):
+        self.checker = checker
+
+    def name(self):
+        return f"independent({self.checker.name()})"
+
+    def check(self, test, history, opts):
+        keys = history_keys(history)
+        if not keys:
+            return {"valid?": True, "results": {}, "count": 0}
+        subs = {_freeze_key(k): subhistory(k, history) for k in keys}
+
+        batched = self._try_batched(test, keys, subs, opts)
+        if batched is not None:
+            results = batched
+        else:
+            pairs = list(subs.items())
+            rs = bounded_pmap(
+                lambda kv: check_safe(self.checker, test, kv[1], opts), pairs)
+            results = {k: r for (k, _), r in zip(pairs, rs)}
+
+        valid = merge_valid(r.get("valid?") for r in results.values())
+        failures = sorted((str(k) for k, r in results.items()
+                           if r.get("valid?") is not True), key=str)
+        return {
+            "valid?": valid,
+            "count": len(results),
+            "failures": failures,
+            "results": {str(k): r for k, r in results.items()},
+        }
+
+    def _try_batched(self, test, keys, subs, opts):
+        from jepsen_tpu.checker.linearizable import LinearizableChecker
+        from jepsen_tpu.models import CASRegister
+        chk = self.checker
+        if not isinstance(chk, LinearizableChecker):
+            return None
+        if not isinstance(chk.model, CASRegister):
+            return None
+        accelerator = opts.get("accelerator", chk.accelerator)
+        if accelerator == "cpu":
+            return None
+        # honor an explicit request for the exact WGL search: the batched
+        # kernel is jitlin-only
+        if opts.get("algorithm", chk.algorithm) == "wgl":
+            return None
+        try:
+            from jepsen_tpu.checker.linear_cpu import check_stream
+            from jepsen_tpu.checker.linear_encode import encode_register_ops
+            from jepsen_tpu.ops.jitlin import verdict
+            from jepsen_tpu.parallel import batch_check
+            fkeys = list(subs.keys())
+            streams = [encode_register_ops(subs[fk]) for fk in fkeys]
+            outcomes = batch_check(streams, capacity=chk.capacity)
+            results = {}
+            for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
+                v = verdict(alive, ovf)
+                if v == "unknown":
+                    res = check_stream(stream)
+                    results[fk] = {"valid?": res.valid,
+                                   "algorithm": "jitlin-cpu(fallback)"}
+                else:
+                    results[fk] = {"valid?": v, "algorithm": "jitlin-tpu",
+                                   "configs-max": peak}
+            return results
+        except Exception:  # noqa: BLE001
+            logger.exception("batched independent check failed; "
+                             "falling back to per-key")
+            return None
+
+
+def checker(inner: Checker) -> Checker:
+    return IndependentChecker(inner)

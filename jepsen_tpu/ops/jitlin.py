@@ -169,27 +169,16 @@ class JitLinKernel:
         return fn
 
     def check(self, stream, capacity: int = 256):
-        """Single history. Returns (valid, died_event, overflow, peak)."""
-        from jepsen_tpu.checker.linear_encode import pad_streams
-        batch = pad_streams([stream], length=_bucket(len(stream)))
-        S = max(1, batch["n_slots"])
-        fn = self._get(S, capacity, True)
-        alive, died, ovf, peak = fn(batch["kind"], batch["slot"], batch["f"],
-                                    batch["a"], batch["b"])
-        return (bool(alive[0]), int(died[0]), bool(ovf[0]), int(peak[0]))
+        """Single history. Returns (alive, died_event, overflow, peak).
+        Delegates to parallel.batch_check (the one batching/sharding
+        implementation)."""
+        return self.check_batch([stream], capacity=capacity)[0]
 
-    def check_batch(self, streams, capacity: int = 256):
-        """vmapped per-key batch. Returns list of (valid, died, ovf, peak)."""
-        from jepsen_tpu.checker.linear_encode import pad_streams
-        batch = pad_streams(streams, length=_bucket(max(len(s) for s in streams)))
-        S = max(1, batch["n_slots"])
-        fn = self._get(S, capacity, True)
-        alive, died, ovf, peak = fn(batch["kind"], batch["slot"], batch["f"],
-                                    batch["a"], batch["b"])
-        return [
-            (bool(alive[i]), int(died[i]), bool(ovf[i]), int(peak[i]))
-            for i in range(len(streams))
-        ]
+    def check_batch(self, streams, capacity: int = 256, mesh=None):
+        """vmapped per-key batch, sharded over a mesh when available.
+        Returns [(alive, died, ovf, peak)] per stream."""
+        from jepsen_tpu.parallel import batch_check
+        return batch_check(streams, capacity=capacity, mesh=mesh, kernel=self)
 
 
 def _bucket(n: int) -> int:

@@ -1,0 +1,105 @@
+"""Device-mesh parallelism for the checker data plane.
+
+The reference's 'distributed communication backend' is SSH fan-out
+(SURVEY.md §5.8); ours is XLA collectives over a `jax.sharding.Mesh`. The
+checker workloads are batch-parallel over keys (independent registers) and
+graph-parallel over txn partitions, so the sharding story is:
+
+* ``keys`` axis: per-key event tensors sharded over all devices; the
+  jitlin kernel runs under vmap with inputs/outputs NamedSharding'd on the
+  leading axis, so each device checks its shard of keys with zero
+  cross-device traffic until the final verdict gather (ICI all-gather of
+  B bools).
+* SCC label propagation shards edges over devices and psums the label
+  updates (see ops/scc.py) — collectives ride ICI on a pod.
+
+Multi-host: the same code runs under ``jax.distributed`` initialization;
+the mesh then spans hosts and XLA routes collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+import numpy as np
+
+logger = logging.getLogger("jepsen.parallel")
+
+
+def devices():
+    import jax
+    return jax.devices()
+
+
+def get_mesh(n_devices: int | None = None, axis: str = "keys"):
+    """A 1-D mesh over available devices (jax.sharding.Mesh)."""
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_leading(mesh, *arrays):
+    """Places arrays with their leading axis sharded over the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    return [jax.device_put(a, sharding) for a in arrays]
+
+
+def pad_to_multiple(batch: dict, multiple: int) -> tuple[dict, int]:
+    """Pads the leading (batch) axis of every array in the event batch to a
+    multiple of `multiple` with EV_NOOP events. Returns (batch, real_B)."""
+    from jepsen_tpu.ops.jitlin import EV_NOOP
+    B = batch["kind"].shape[0]
+    rem = (-B) % multiple
+    if rem == 0:
+        return batch, B
+    out = {}
+    for k, v in batch.items():
+        if not isinstance(v, np.ndarray):
+            out[k] = v
+            continue
+        pad_shape = (rem,) + v.shape[1:]
+        fill = EV_NOOP if k == "kind" else 0
+        out[k] = np.concatenate([v, np.full(pad_shape, fill, v.dtype)])
+    return out, B
+
+
+def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
+                step_ids=None, init_state: int = 0, kernel=None):
+    """Checks a batch of per-key event streams with the vmapped jitlin
+    kernel, sharded across a device mesh when one is available. The single
+    batching implementation — JitLinKernel.check/check_batch delegate here.
+
+    Returns [(alive, died_event, overflow, peak)] per stream (real keys
+    only; padding keys are dropped).
+    """
+    import jax
+    from jepsen_tpu.checker.linear_encode import pad_streams
+    from jepsen_tpu.ops.jitlin import JitLinKernel, _bucket
+
+    if kernel is None:
+        kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
+    batch = pad_streams(list(streams), length=_bucket(max(len(s) for s in streams)))
+    S = max(1, batch["n_slots"])
+
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = get_mesh()
+    if mesh is not None:
+        n_dev = mesh.devices.size
+        batch, real_b = pad_to_multiple(batch, n_dev)
+        arrays = [batch["kind"], batch["slot"], batch["f"], batch["a"], batch["b"]]
+        arrays = shard_leading(mesh, *arrays)
+    else:
+        real_b = batch["kind"].shape[0]
+        arrays = [batch["kind"], batch["slot"], batch["f"], batch["a"], batch["b"]]
+
+    fn = kernel._get(S, capacity, batched=True)
+    alive, died, ovf, peak = fn(*arrays)
+    alive, died, ovf, peak = map(np.asarray, (alive, died, ovf, peak))
+    return [(bool(alive[i]), int(died[i]), bool(ovf[i]), int(peak[i]))
+            for i in range(real_b)]

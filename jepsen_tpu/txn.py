@@ -1,0 +1,82 @@
+"""Transaction micro-op utilities (reference: txn/src/jepsen/txn.clj +
+txn/micro_op.clj).
+
+A transactional op's value is a list of micro-ops ``[f, k, v]``, e.g.
+``[["r", "x", [1, 2]], ["append", "x", 3]]``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+READ_FS = ("r", "read")
+WRITE_FS = ("w", "write", "append")
+
+
+def mop(f, k, v=None) -> list:
+    return [f, k, v]
+
+
+def is_read(m) -> bool:
+    return m[0] in READ_FS
+
+
+def is_write(m) -> bool:
+    return m[0] in WRITE_FS
+
+
+def op_mops(op: dict) -> list:
+    """[(op, mop)] pairs for an op (txn.clj:19-22)."""
+    return [(op, m) for m in (op.get("value") or [])]
+
+
+def reduce_mops(f: Callable, init, history: Iterable[dict]):
+    """Reduces (acc, op, mop) over every micro-op in a history
+    (txn.clj:5-17)."""
+    acc = init
+    for op in history:
+        for m in op.get("value") or []:
+            acc = f(acc, op, m)
+    return acc
+
+
+def ext_reads(txn: list) -> dict:
+    """External reads: keys read before any write in this txn
+    (txn.clj:24-39). {k: value-read}"""
+    out: dict = {}
+    written: set = set()
+    for f, k, v in txn:
+        kk = _hk(k)
+        if f in READ_FS:
+            if kk not in written and kk not in out:
+                out[kk] = v
+        else:
+            written.add(kk)
+    return out
+
+
+def ext_writes(txn: list) -> dict:
+    """External writes: the final write to each key (txn.clj:41-53).
+    {k: value-written} (for append, the appended element)."""
+    out: dict = {}
+    for f, k, v in txn:
+        if f in WRITE_FS:
+            out[_hk(k)] = v
+    return out
+
+
+def int_write_mops(txn: list) -> list:
+    """Writes overwritten within their own txn (txn.clj:55-73). For
+    append-only workloads this is empty (appends accumulate)."""
+    out = []
+    last_write: dict = {}
+    for i, (f, k, v) in enumerate(txn):
+        if f in ("w", "write"):
+            kk = _hk(k)
+            if kk in last_write:
+                out.append(txn[last_write[kk]])
+            last_write[kk] = i
+    return out
+
+
+def _hk(k):
+    return tuple(k) if isinstance(k, list) else k

@@ -1,0 +1,273 @@
+"""Elle-equivalent txn checker tests: classic Adya anomaly constructions +
+serializable histories + device/CPU trim agreement."""
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.elle import Graph, RW, WR, WW, check_cycles, list_append, rw_register
+from jepsen_tpu.ops.scc import has_cycle, tarjan_scc, trim_to_cycles
+
+
+def ok(process, txn):
+    return {"type": "ok", "process": process, "f": "txn", "value": txn}
+
+
+def fail(process, txn):
+    return {"type": "fail", "process": process, "f": "txn", "value": txn}
+
+
+# ---------------------------------------------------------------------------
+# graph machinery
+# ---------------------------------------------------------------------------
+
+def test_trim_finds_cycle():
+    # 0->1->2->0 plus a tail 3->0
+    src = np.array([0, 1, 2, 3], dtype=np.int32)
+    dst = np.array([1, 2, 0, 0], dtype=np.int32)
+    mask = trim_to_cycles(4, src, dst)
+    assert mask.tolist() == [True, True, True, False]
+
+
+def test_trim_acyclic_empty():
+    src = np.array([0, 1, 2], dtype=np.int32)
+    dst = np.array([1, 2, 3], dtype=np.int32)
+    assert not trim_to_cycles(4, src, dst).any()
+    assert not has_cycle(4, src, dst)
+
+
+def test_tarjan():
+    edges = [(0, 1), (1, 2), (2, 0), (3, 4)]
+    sccs = tarjan_scc(5, edges)
+    assert sorted(sccs[0]) == [0, 1, 2]
+    assert len(sccs) == 1
+
+
+def test_check_cycles_classification():
+    g = Graph(2)
+    g.add(0, 1, WW)
+    g.add(1, 0, WW)
+    r = check_cycles(g)
+    assert "G0" in r
+
+    g = Graph(2)
+    g.add(0, 1, WR)
+    g.add(1, 0, WW)
+    r = check_cycles(g)
+    assert "G1c" in r
+
+    g = Graph(2)
+    g.add(0, 1, WR)
+    g.add(1, 0, RW)
+    r = check_cycles(g)
+    assert "G-single" in r
+    assert "G2" not in r
+
+    g = Graph(2)
+    g.add(0, 1, RW)
+    g.add(1, 0, RW)
+    r = check_cycles(g)
+    assert "G2" in r
+
+
+# ---------------------------------------------------------------------------
+# list-append anomalies
+# ---------------------------------------------------------------------------
+
+def test_append_serializable_ok():
+    h = [
+        ok(0, [["append", "x", 1]]),
+        ok(1, [["r", "x", [1]], ["append", "x", 2]]),
+        ok(0, [["r", "x", [1, 2]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is True
+    assert r["anomaly-types"] == []
+
+
+def test_append_g0():
+    h = [
+        ok(0, [["append", "x", 1], ["append", "y", 1]]),
+        ok(1, [["append", "x", 2], ["append", "y", 2]]),
+        ok(2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G0" in r["anomaly-types"]
+
+
+def test_append_g1c():
+    h = [
+        ok(0, [["append", "x", 1], ["r", "y", [1]]]),
+        ok(1, [["append", "y", 1], ["r", "x", [1]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
+
+
+def test_append_g_single():
+    h = [
+        ok(0, [["append", "x", 1], ["append", "y", 1]]),
+        ok(1, [["r", "x", [1]], ["r", "y", []]]),
+        ok(2, [["r", "y", [1]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G-single" in r["anomaly-types"]
+
+
+def test_append_g2_write_skew():
+    h = [
+        ok(0, [["r", "x", []], ["append", "y", 1]]),
+        ok(1, [["r", "y", []], ["append", "x", 1]]),
+        ok(2, [["r", "x", [1]], ["r", "y", [1]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G2" in r["anomaly-types"]
+
+
+def test_append_g1a_aborted_read():
+    h = [
+        fail(0, [["append", "x", 9]]),
+        ok(1, [["r", "x", [9]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G1a" in r["anomaly-types"]
+
+
+def test_append_g1b_intermediate_read():
+    h = [
+        ok(0, [["append", "x", 1], ["append", "x", 2]]),
+        ok(1, [["r", "x", [1]]]),
+        ok(2, [["r", "x", [1, 2]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G1b" in r["anomaly-types"]
+
+
+def test_append_internal():
+    h = [ok(0, [["append", "x", 1], ["r", "x", []]])]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "internal" in r["anomaly-types"]
+
+
+def test_append_incompatible_order():
+    h = [
+        ok(0, [["append", "x", 1]]),
+        ok(1, [["append", "x", 2]]),
+        ok(2, [["r", "x", [1, 2]]]),
+        ok(3, [["r", "x", [2]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "incompatible-order" in r["anomaly-types"]
+
+
+def serializable_append_history(rng, n_txns=300, n_keys=5, n_procs=5):
+    """Executes random append txns sequentially against real lists: the
+    resulting history is serializable by construction."""
+    state = {k: [] for k in range(n_keys)}
+    h = []
+    counter = {k: 0 for k in range(n_keys)}
+    for i in range(n_txns):
+        txn = []
+        for _ in range(rng.randint(1, 4)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.5:
+                txn.append(["r", k, list(state[k])])
+            else:
+                counter[k] += 1
+                state[k].append(counter[k])
+                txn.append(["append", k, counter[k]])
+        h.append(ok(i % n_procs, txn))
+    # final reads pin down version orders
+    for k in range(n_keys):
+        h.append(ok(0, [["r", k, list(state[k])]]))
+    return h
+
+
+def test_append_random_serializable():
+    rng = random.Random(42)
+    h = serializable_append_history(rng)
+    r = list_append.check(h)
+    assert r["valid?"] is True, r["anomaly-types"]
+    assert r["txn-count"] == len(h)
+
+
+def test_append_cpu_and_device_agree():
+    rng = random.Random(1)
+    good = serializable_append_history(rng, n_txns=100)
+    bad = [
+        ok(0, [["append", "x", 1], ["append", "y", 1]]),
+        ok(1, [["append", "x", 2], ["append", "y", 2]]),
+        ok(2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]),
+    ]
+    for h in (good, bad):
+        r_dev = list_append.check(h, accelerator="auto")
+        r_cpu = list_append.check(h, accelerator="cpu")
+        assert r_dev["valid?"] == r_cpu["valid?"]
+        assert r_dev["anomaly-types"] == r_cpu["anomaly-types"]
+
+
+# ---------------------------------------------------------------------------
+# rw-register
+# ---------------------------------------------------------------------------
+
+def test_wr_register_serializable():
+    h = [
+        ok(0, [["w", "x", 1]]),
+        ok(1, [["r", "x", 1], ["w", "x", 2]]),
+        ok(0, [["r", "x", 2]]),
+    ]
+    r = rw_register.check(h)
+    assert r["valid?"] is True
+
+
+def test_wr_register_g1a():
+    h = [
+        fail(0, [["w", "x", 9]]),
+        ok(1, [["r", "x", 9]]),
+    ]
+    r = rw_register.check(h)
+    assert r["valid?"] is False
+    assert "G1a" in r["anomaly-types"]
+
+
+def test_wr_register_internal():
+    h = [ok(0, [["w", "x", 1], ["r", "x", 5]])]
+    r = rw_register.check(h)
+    assert r["valid?"] is False
+    assert "internal" in r["anomaly-types"]
+
+
+def test_wr_register_wr_cycle():
+    # T0 reads T1's write, T1 reads T0's write: wr cycle (G1c)
+    h = [
+        ok(0, [["w", "x", 1], ["r", "y", 1]]),
+        ok(1, [["w", "y", 1], ["r", "x", 1]]),
+    ]
+    r = rw_register.check(h)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+
+def test_append_gen_produces_txns():
+    from jepsen_tpu.generator.simulate import default_context, invocations, quick
+    import jepsen_tpu.generator as gen
+    g = gen.limit(20, list_append.gen(key_count=3))
+    h = quick({"concurrency": 2}, g)
+    inv = invocations(h)
+    assert len(inv) == 20
+    for op in inv:
+        assert op["f"] == "txn"
+        for m in op["value"]:
+            assert m[0] in ("r", "append")
