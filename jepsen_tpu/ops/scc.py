@@ -18,6 +18,8 @@ subgraph (ww-only, ww+wr) answers G0/G1c directly.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 
@@ -62,6 +64,71 @@ def trim_to_cycles(n_nodes: int, src: np.ndarray, dst: np.ndarray,
 
 def has_cycle(n_nodes: int, src, dst) -> bool:
     return bool(trim_to_cycles(n_nodes, np.asarray(src), np.asarray(dst)).any())
+
+
+def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
+                           mesh, max_iters: int = 10_000):
+    """Edge-sharded device trim: the same 2-core peeling as
+    :func:`trim_to_cycles`, but with the edge list sharded over the mesh's
+    first axis under ``shard_map``. Each device computes partial in/out
+    degrees for its edge shard with ``segment_sum``; partials are reduced
+    with ``psum`` (ICI all-reduce on a pod), so the node-activity vector is
+    replicated while edge traffic stays device-local. This is the 50k-txn
+    Elle-graph scaling path (BASELINE config 5, SURVEY.md §5.8)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if len(src) == 0 or n_nodes == 0:
+        return np.zeros(n_nodes, dtype=bool)
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    E = len(src)
+    pad = (-E) % n_dev
+    # Padding edges carry weight 0 so they contribute no degree.
+    src_p = np.concatenate([np.asarray(src, np.int32), np.zeros(pad, np.int32)])
+    dst_p = np.concatenate([np.asarray(dst, np.int32), np.zeros(pad, np.int32)])
+    w_p = np.concatenate([np.ones(E, np.int32), np.zeros(pad, np.int32)])
+
+    esh = NamedSharding(mesh, P(axis))
+
+    def degrees(active, s, d, w):
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(axis), P(axis), P(axis)), out_specs=P())
+        def go(active, s, d, w):
+            ew = w * (active[s] & active[d]).astype(jnp.int32)
+            indeg = jax.ops.segment_sum(ew, d, num_segments=n_nodes)
+            outdeg = jax.ops.segment_sum(ew, s, num_segments=n_nodes)
+            return lax.psum(jnp.stack([indeg, outdeg]), axis)
+
+        return go(active, s, d, w)
+
+    sj = jax.device_put(src_p, esh)
+    dj = jax.device_put(dst_p, esh)
+    wj = jax.device_put(w_p, esh)
+
+    @jax.jit
+    def run(s, d, w):
+        def body(carry):
+            active, _, it = carry
+            deg = degrees(active, s, d, w)
+            new_active = active & (deg[0] > 0) & (deg[1] > 0)
+            changed = jnp.any(new_active != active)
+            return new_active, changed, it + 1
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < max_iters)
+
+        active0 = jnp.ones((n_nodes,), dtype=bool)
+        active, _, _ = lax.while_loop(
+            cond, body, (active0, jnp.bool_(True), jnp.int32(0)))
+        return active
+
+    return np.asarray(run(sj, dj, wj))
 
 
 def tarjan_scc(n_nodes: int, edges: list[tuple[int, int]]) -> list[list[int]]:
