@@ -22,8 +22,7 @@ from jepsen_tpu.elle import RW, WR, WW, Graph
 logger = logging.getLogger("jepsen.elle.append")
 
 
-def _hk(k):
-    return tuple(k) if isinstance(k, list) else k
+from jepsen_tpu.txn import _hk
 
 
 def check(history: list[dict], accelerator: str = "auto",
@@ -95,17 +94,30 @@ def check(history: list[dict], accelerator: str = "auto",
                     # no known writer: future/phantom value
                     anomalies_extra["unobserved-writer"].append(
                         {"key": k, "value": v})
-            # G1b: the read's final element is an intermediate append of its
-            # writer txn (the txn appended more to k afterwards)
-            if r:
-                w = writer_of.get((k, r[-1]))
+            # G1b (intermediate read): txns append atomically, so a read
+            # must observe either ALL of a committed txn's appends to k or
+            # none of them, in append order. A proper subset (in any
+            # position — even when later txns' elements follow it) means
+            # the read saw an intermediate state.
+            observed: dict[int, list] = defaultdict(list)
+            for v in r:
+                w = writer_of.get((k, v))
                 if w is not None:
-                    wi, _, nth = w
-                    txn_appends = appends_per_txn_key[(wi, k)]
-                    if wi != i and nth != len(txn_appends) - 1:
-                        anomalies_extra["G1b"].append(
-                            {"key": k, "read": r,
-                             "writer": txns[wi].get("value")})
+                    observed[w[0]].append(v)
+            for wi, obs in observed.items():
+                if wi == i or txns[wi].get("type") != "ok":
+                    continue  # own reads / indeterminate writers: not G1b
+                txn_appends = appends_per_txn_key[(wi, k)]
+                if obs == txn_appends:
+                    continue
+                if obs == txn_appends[: len(obs)]:
+                    anomalies_extra["G1b"].append(
+                        {"key": k, "read": r,
+                         "writer": txns[wi].get("value")})
+                else:
+                    anomalies_extra["incompatible-order"].append(
+                        {"key": k, "read": r,
+                         "writer-appends": txn_appends})
 
     # internal: a txn's own read must reflect its earlier appends
     for i, op in enumerate(txns):

@@ -23,13 +23,11 @@ from collections import defaultdict
 
 from jepsen_tpu import elle
 from jepsen_tpu.elle import RW, WR, WW, Graph
+from jepsen_tpu.txn import _hk, int_write_mops
 
 
-def _hk(k):
-    return tuple(k) if isinstance(k, list) else k
-
-
-def check(history: list[dict], accelerator: str = "auto") -> dict:
+def check(history: list[dict], accelerator: str = "auto",
+          consistency_models=("strict-serializable",)) -> dict:
     oks = [op for op in history
            if op.get("type") == "ok" and isinstance(op.get("process"), int)]
     fails = [op for op in history if op.get("type") == "fail"]
@@ -42,6 +40,7 @@ def check(history: list[dict], accelerator: str = "auto") -> dict:
 
     writer_of: dict[tuple, int] = {}
     failed_writes: dict[tuple, dict] = {}
+    intermediate_writes: dict[tuple, int] = {}
     for op in fails:
         for m in op.get("value") or []:
             if m[0] == "w":
@@ -54,6 +53,9 @@ def check(history: list[dict], accelerator: str = "auto") -> dict:
                     anomalies_extra["duplicate-writes"].append(
                         {"key": m[1], "value": m[2]})
                 writer_of[key] = i
+        if op.get("type") == "ok":
+            for f, k, v in int_write_mops(op.get("value") or []):
+                intermediate_writes[(_hk(k), v)] = i
 
     graph = Graph(n)
     # One pass per txn builds: wr edges (reads of known writes), trace ww
@@ -78,6 +80,13 @@ def check(history: list[dict], accelerator: str = "auto") -> dict:
                         anomalies_extra["G1a"].append(
                             {"key": m[1], "value": v,
                              "read-txn": op.get("value")})
+                    iw = intermediate_writes.get((k, v))
+                    if iw is not None and iw != i:
+                        # G1b: v was overwritten within its own txn — only
+                        # an intermediate state could have exposed it
+                        anomalies_extra["G1b"].append(
+                            {"key": m[1], "value": v,
+                             "writer": txns[iw].get("value")})
                     w = writer_of.get((k, v))
                     if w is not None and w != i:
                         graph.add(w, i, WR)
@@ -104,7 +113,8 @@ def check(history: list[dict], accelerator: str = "auto") -> dict:
                         graph.add(i, w, RW)
 
     cyc = elle.check_cycles(graph, accelerator=accelerator)
-    result = elle.result_map(cyc, txns, anomalies_extra)
+    result = elle.result_map(cyc, txns, anomalies_extra,
+                             consistency_models=consistency_models)
     result["txn-count"] = n
     result["edge-count"] = len(graph.edges)
     return result

@@ -235,7 +235,8 @@ class IndependentChecker(Checker):
             from jepsen_tpu.parallel import batch_check
             fkeys = list(subs.keys())
             streams = [encode_register_ops(subs[fk]) for fk in fkeys]
-            outcomes = batch_check(streams, capacity=chk.capacity)
+            outcomes = batch_check(streams, capacity=chk.capacity,
+                                   kernel=chk._tpu_kernel())
             results = {}
             for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
                 v = verdict(alive, ovf)

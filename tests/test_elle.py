@@ -271,3 +271,80 @@ def test_append_gen_produces_txns():
         assert op["f"] == "txn"
         for m in op["value"]:
             assert m[0] in ("r", "append")
+
+
+def test_append_g1b_partial_observation_mid_read():
+    # T3 observes T1's append of 1 without its 2, with T2's 3 after it —
+    # an intermediate state even though the read's last element is final.
+    h = [
+        ok(0, [["append", "x", 1], ["append", "x", 2]]),
+        ok(1, [["append", "x", 3]]),
+        ok(2, [["r", "x", [1, 3]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "G1b" in r["anomaly-types"]
+
+
+def test_append_txn_elements_out_of_order():
+    # read observes a txn's own appends in the wrong order
+    h = [
+        ok(0, [["append", "x", 1], ["append", "x", 2]]),
+        ok(1, [["r", "x", [2, 1]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is False
+    assert "incompatible-order" in r["anomaly-types"]
+
+
+def test_append_full_observation_not_g1b():
+    h = [
+        ok(0, [["append", "x", 1], ["append", "x", 2]]),
+        ok(1, [["append", "x", 3]]),
+        ok(2, [["r", "x", [1, 2, 3]]]),
+    ]
+    r = list_append.check(h)
+    assert r["valid?"] is True
+
+
+def test_wr_register_g1b_intermediate_read():
+    # T0 writes x=1 then overwrites with x=2; T1 reads the intermediate 1
+    h = [
+        ok(0, [["w", "x", 1], ["w", "x", 2]]),
+        ok(1, [["r", "x", 1]]),
+    ]
+    r = rw_register.check(h)
+    assert r["valid?"] is False
+    assert "G1b" in r["anomaly-types"]
+
+
+def test_wr_register_consistency_models_forwarded():
+    # A single-rw-edge cycle (G-single): T2 reads x before T1's overwrite
+    # (rw T2->T1) but also observes T1's write to y (wr T1->T2). Blocked
+    # under strict-serializable, allowed under read-committed.
+    h = [
+        ok(0, [["w", "x", 1]]),
+        ok(1, [["r", "x", 1], ["w", "x", 2], ["w", "y", 2]]),
+        ok(2, [["r", "y", 2], ["r", "x", 1]]),
+    ]
+    strict = rw_register.check(h)
+    assert strict["valid?"] is False
+    assert "G-single" in strict["anomaly-types"]
+    rc = rw_register.check(h, consistency_models=("read-committed",))
+    assert rc["valid?"] is True
+
+
+def test_txn_utils():
+    from jepsen_tpu.txn import (ext_reads, ext_writes, int_write_mops,
+                                is_read, is_write, reduce_mops)
+    txn = [["r", "x", 1], ["w", "x", 2], ["w", "x", 3], ["r", "y", None],
+           ["w", "y", 9]]
+    assert ext_reads(txn) == {"x": 1, "y": None}
+    assert ext_writes(txn) == {"x": 3, "y": 9}
+    assert int_write_mops(txn) == [["w", "x", 2]]
+    assert is_read(["r", "x", None]) and is_write(["append", "x", 1])
+    n = reduce_mops(lambda acc, op, m: acc + 1, 0,
+                    [ok(0, txn), ok(1, [["r", "z", None]])])
+    assert n == 6
+    # appends never overwrite within a txn
+    assert int_write_mops([["append", "x", 1], ["append", "x", 2]]) == []
