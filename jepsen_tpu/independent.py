@@ -45,10 +45,72 @@ def tuple_gen(k, gen) -> Generator:
     return gen_mod.Map(lift, gen)
 
 
-def sequential_generator(keys: Iterable, gen_fn: Callable[[Any], Any]) -> Generator:
+@dataclass(frozen=True)
+class SequentialGenerator(Generator):
     """One key at a time: exhaust gen_fn(k) for each k in order
-    (independent.clj:31-47)."""
-    return gen_mod.Seq([tuple_gen(k, gen_fn(k)) for k in keys])
+    (independent.clj:31-47). ``keys`` may be infinite."""
+
+    keys: "KeyStream" = field(compare=False)
+    gen_fn: Callable = field(compare=False)
+    idx: int = 0
+    current: Any = None
+    started: bool = False
+
+    def _advance(self):
+        k, ok = self.keys.get(self.idx)
+        if not ok:
+            return None
+        return replace(self, idx=self.idx + 1,
+                       current=tuple_gen(k, self.gen_fn(k)), started=True)
+
+    def op(self, test, ctx):
+        state = self if self.started else self._advance()
+        while state is not None:
+            g = as_gen(state.current)
+            res = g.op(test, ctx) if g is not None else None
+            if res is None:
+                state = state._advance()
+                continue
+            op, g2 = res
+            return (op, replace(state, current=g2))
+        return None
+
+    def update(self, test, ctx, event):
+        if not self.started:
+            return self
+        g = as_gen(self.current)
+        if g is None:
+            return self
+        return replace(self, current=g.update(test, ctx, event))
+
+
+def sequential_generator(keys: Iterable, gen_fn: Callable[[Any], Any]) -> Generator:
+    """(independent.clj:31-47)."""
+    return SequentialGenerator(keys=KeyStream(keys), gen_fn=gen_fn)
+
+
+class KeyStream:
+    """Memoizing immutable view over a possibly-infinite key sequence, so
+    ``concurrent_generator`` accepts ``itertools.count()`` the way the
+    reference accepts infinite lazy seqs (independent.clj:211-236).
+    Functional generator copies share one stream; the memo only grows, so
+    ``get(i)`` is referentially transparent."""
+
+    def __init__(self, iterable):
+        self._it = iter(iterable)
+        self._memo: list = []
+        self._done = False
+
+    def get(self, i: int):
+        """(key, True) for index i, or (None, False) past the end."""
+        while not self._done and len(self._memo) <= i:
+            try:
+                self._memo.append(next(self._it))
+            except StopIteration:
+                self._done = True
+        if i < len(self._memo):
+            return self._memo[i], True
+        return None, False
 
 
 @dataclass(frozen=True)
@@ -61,23 +123,32 @@ class ConcurrentGenerator(Generator):
     """
 
     n: int                       # threads per group
-    keys: tuple                  # remaining unclaimed keys
+    keys: KeyStream = field(compare=False)  # shared lazy key source
     gen_fn: Callable = field(compare=False)
     groups: tuple = ()           # ((threads-frozenset, key, gen) ...)
+    next_idx: int = 0            # next unclaimed index into the stream
+
+    def _claim(self, state, idx):
+        """Next key from the stream, or (None, state) when exhausted."""
+        k, ok = state.keys.get(idx)
+        if not ok:
+            return None, False
+        return k, True
 
     def _init_groups(self, ctx):
         """Carve client threads into groups of n."""
         client_threads = sorted(t for t in ctx.workers if t != gen_mod.NEMESIS)
         groups = []
-        keys = list(self.keys)
+        idx = self.next_idx
         for i in range(0, len(client_threads) - self.n + 1, self.n):
             threads = frozenset(client_threads[i:i + self.n])
-            if keys:
-                k = keys.pop(0)
+            k, ok = self.keys.get(idx)
+            if ok:
+                idx += 1
                 groups.append((threads, k, tuple_gen(k, self.gen_fn(k))))
             else:
                 groups.append((threads, None, None))
-        return replace(self, keys=tuple(keys), groups=tuple(groups))
+        return replace(self, next_idx=idx, groups=tuple(groups))
 
     def op(self, test, ctx):
         if not self.groups:
@@ -94,12 +165,13 @@ class ConcurrentGenerator(Generator):
                 res = gg.op(test, ctx.restrict(threads)) if gg is not None else None
                 if res is not None:
                     break
-                if state.keys:
-                    k = state.keys[0]
+                k2, ok = state.keys.get(state.next_idx)
+                if ok:
+                    k = k2
                     g = tuple_gen(k, state.gen_fn(k))
                     groups = list(state.groups)
                     groups[i] = (threads, k, g)
-                    state = replace(state, keys=state.keys[1:],
+                    state = replace(state, next_idx=state.next_idx + 1,
                                     groups=tuple(groups))
                 else:
                     g = None
@@ -141,8 +213,9 @@ class ConcurrentGenerator(Generator):
 
 def concurrent_generator(n: int, keys: Iterable, gen_fn: Callable) -> Generator:
     """(independent.clj:211-236). n threads per key-group; len(client
-    threads) should be a multiple of n."""
-    return ConcurrentGenerator(n=n, keys=tuple(keys), gen_fn=gen_fn)
+    threads) should be a multiple of n. ``keys`` may be infinite
+    (e.g. itertools.count())."""
+    return ConcurrentGenerator(n=n, keys=KeyStream(keys), gen_fn=gen_fn)
 
 
 def history_keys(history: list[dict]) -> list:

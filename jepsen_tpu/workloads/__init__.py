@@ -1,0 +1,28 @@
+"""Reusable workload kits: generator + checker (+ final-generator) bundles.
+
+Capability-equivalent to the reference's jepsen.tests.* namespaces
+(jepsen/src/jepsen/tests/, SURVEY.md §2.2). A workload is a plain dict:
+
+    {"generator": ..., "checker": ..., "final_generator": ...?, ...}
+
+merged into a test map by suites; the "test = data" property is preserved
+(SURVEY.md §5.6).
+"""
+from __future__ import annotations
+
+from jepsen_tpu.workloads import (  # noqa: F401
+    adya,
+    append,
+    bank,
+    causal,
+    causal_reverse,
+    long_fork,
+    register,
+    set_workload,
+    wr,
+)
+
+__all__ = [
+    "adya", "append", "bank", "causal", "causal_reverse", "long_fork",
+    "register", "set_workload", "wr",
+]

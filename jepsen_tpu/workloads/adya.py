@@ -1,0 +1,67 @@
+"""Adya G2 (write-skew / anti-dependency cycle) workload (reference:
+jepsen/src/jepsen/tests/adya.clj).
+
+Each txn targets a key pair; it reads both cells of the pair and, iff both
+are empty, inserts its unique id into ONE of them. Under serializability
+at most one insert per pair can succeed (the second txn must observe the
+first's insert); two successful inserts into the same pair demonstrate a
+predicate anti-dependency cycle — G2 (adya.clj:12-87).
+"""
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def generator(n_pairs_hint: int = 0):
+    """Emits {"f": "insert", "value": [pair-id, unique-id, which-cell]};
+    two txns race per pair. Clients must read both cells and only insert
+    when both are empty, reporting :fail otherwise."""
+    pair_counter = itertools.count()
+    uid = itertools.count(1)
+    state: dict = {"open": {}}  # pair -> remaining cell
+
+    def one(test, ctx):
+        open_pairs = state["open"]
+        if open_pairs and ctx.rng.random() < 0.5:
+            pair, cell = open_pairs.popitem()
+        else:
+            pair = next(pair_counter)
+            cell = "a" if ctx.rng.random() < 0.5 else "b"
+            open_pairs[pair] = "b" if cell == "a" else "a"
+        return {"f": "insert", "value": [pair, next(uid), cell]}
+
+    return gen.Fn(one)
+
+
+class G2Checker(Checker):
+    """Two ok inserts into one pair = G2 (adya.clj:61-87)."""
+
+    def name(self):
+        return "adya-g2"
+
+    def check(self, test, history, opts):
+        by_pair: dict = defaultdict(list)
+        for op in history:
+            if op.get("type") == "ok" and op.get("f") == "insert":
+                pair, _uid, _cell = op.get("value")
+                by_pair[pair].append(op)
+        skews = [{"pair": p, "inserts": ops}
+                 for p, ops in by_pair.items() if len(ops) > 1]
+        return {
+            "valid?": not skews,
+            "pair-count": len(by_pair),
+            "g2-count": len(skews),
+            "anomalies": skews[:10],
+        }
+
+
+def checker() -> Checker:
+    return G2Checker()
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {"generator": generator(), "checker": checker()}

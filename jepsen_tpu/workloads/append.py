@@ -1,0 +1,43 @@
+"""List-append transactional workload: thin wrapper over the Elle-style
+checker (reference: jepsen/src/jepsen/tests/cycle/append.clj — a thin
+wrapper over elle.list-append/check + gen, append.clj:11-27).
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.elle import list_append
+
+
+class AppendChecker(Checker):
+    def __init__(self, accelerator: str = "auto",
+                 consistency_models=("strict-serializable",)):
+        self.accelerator = accelerator
+        self.consistency_models = consistency_models
+
+    def name(self):
+        return "elle-list-append"
+
+    def check(self, test, history, opts):
+        return list_append.check(
+            history,
+            accelerator=opts.get("accelerator", self.accelerator),
+            consistency_models=opts.get("consistency_models",
+                                        self.consistency_models))
+
+
+def checker(**kw) -> Checker:
+    return AppendChecker(**kw)
+
+
+def generator(**kw):
+    return gen.Fn(list_append.gen(**kw))
+
+
+def workload(test: dict | None = None, accelerator: str = "auto",
+             consistency_models=("strict-serializable",), **gen_kw) -> dict:
+    return {
+        "generator": generator(**gen_kw),
+        "checker": checker(accelerator=accelerator,
+                           consistency_models=consistency_models),
+    }

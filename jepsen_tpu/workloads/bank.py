@@ -1,0 +1,89 @@
+"""Bank workload (reference: jepsen/src/jepsen/tests/bank.clj).
+
+Accounts hold balances; transfers move money between them; every read of
+all accounts must sum to the invariant total (snapshot-isolation test).
+With ``negative_balances`` false, no read may show a negative balance.
+The sum scan is a columnar O(n) reduction.
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def read_op(test, ctx):
+    return {"f": "read", "value": None}
+
+
+def transfer(test, ctx):
+    accts = test.get("accounts", list(range(8)))
+    frm, to = ctx.rng.sample(list(accts), 2)
+    return {"f": "transfer",
+            "value": {"from": frm, "to": to,
+                      "amount": 1 + ctx.rng.randint(0, test.get("max-transfer", 5) - 1)}}
+
+
+def generator():
+    return gen.mix([gen.Fn(read_op), gen.Fn(transfer)])
+
+
+class BankChecker(Checker):
+    """All ok reads sum to total-amount; optionally no negative balances
+    (bank.clj:57-121)."""
+
+    def __init__(self, negative_balances: bool = False):
+        self.negative_balances = negative_balances
+
+    def name(self):
+        return "bank"
+
+    def check(self, test, history, opts):
+        total = test.get("total-amount", 0)
+        accounts = set(test.get("accounts", list(range(8))))
+        bad_reads = []
+        read_count = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            read_count += 1
+            balances = op.get("value") or {}
+            errs = []
+            extra = set(balances) - accounts
+            if extra:
+                errs.append({"error": "unexpected-accounts",
+                             "accounts": sorted(extra, key=str)})
+            s = sum(balances.values())
+            if s != total:
+                errs.append({"error": "wrong-total", "total": s,
+                             "expected": total})
+            if not self.negative_balances:
+                neg = {a: b for a, b in balances.items() if b < 0}
+                if neg:
+                    errs.append({"error": "negative-balance", "accounts": neg})
+            if errs:
+                bad_reads.append({"op": op, "errors": errs})
+        return {
+            "valid?": not bad_reads,
+            "read-count": read_count,
+            "error-count": len(bad_reads),
+            "first-error": bad_reads[0] if bad_reads else None,
+            "bad-reads": bad_reads[:10],
+        }
+
+
+def checker(negative_balances: bool = False) -> Checker:
+    return BankChecker(negative_balances)
+
+
+def workload(test: dict | None = None, negative_balances: bool = False,
+             **_) -> dict:
+    """Test bundle (bank.clj:179-192): supplies accounts/total defaults."""
+    accounts = list(range(8))
+    return {
+        "accounts": accounts,
+        # clients initialize each account to 10; reads must preserve the sum
+        "total-amount": 10 * len(accounts),
+        "max-transfer": 5,
+        "generator": generator(),
+        "checker": checker(negative_balances),
+    }

@@ -1,0 +1,56 @@
+"""Causal-consistency register workload (reference:
+jepsen/src/jepsen/tests/causal.clj).
+
+A single register written with sequential values 1..n, where each write is
+causally ordered after the previous one (write i+1 is issued only after
+write i is visible). The CausalRegister model accepts a write only when it
+extends the causal chain (value = current + 1) and reads that return the
+current value or the distinguished initial 0. Checking is plain
+linearizability search over this model — causal order violations surface
+as model inconsistency (causal.clj:12-31,88-112).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.models import Model, inconsistent
+
+
+@dataclass(frozen=True)
+class CausalRegister(Model):
+    """Register over the causal chain 0 -> 1 -> 2 -> ... (causal.clj:33-84)."""
+
+    value: int = 0
+
+    def step(self, op):
+        f, v = op.get("f"), op.get("value")
+        if f in ("write", "w"):
+            if v == self.value + 1:
+                return CausalRegister(v)
+            return inconsistent(
+                f"write {v!r} does not extend causal chain at {self.value}")
+        if f in ("read", "r"):
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"read {v!r} at register {self.value}")
+        return inconsistent(f"unknown f {f!r}")
+
+
+def generator(n_writes: int = 10):
+    """Sequential causally-chained writes interleaved with reads."""
+    writes = gen.Seq([{"f": "write", "value": i + 1} for i in range(n_writes)])
+
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    return gen.any_gen(writes, gen.Fn(read))
+
+
+def workload(test: dict | None = None, n_writes: int = 10, **_) -> dict:
+    return {
+        "generator": generator(n_writes),
+        "checker": linearizable(model=CausalRegister()),
+        "model": CausalRegister(),
+    }

@@ -1,0 +1,81 @@
+"""Strict-serializability reorder anomaly workload (reference:
+jepsen/src/jepsen/tests/causal_reverse.clj).
+
+Clients insert unique increasing integers one per txn; reads return the
+set of integers present. If insert A completed (ok) strictly before
+insert B was invoked, then any read observing B must also observe A —
+otherwise the serialization order reversed two real-time-ordered txns,
+which serializability permits but strict serializability forbids
+(causal_reverse.clj:21-74).
+
+The checker builds the real-time write-precedence relation from
+invoke/complete index pairs (columnar int arrays) and scans reads against
+it — O(reads × elements) with a numpy membership matrix.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def generator():
+    counter = itertools.count(1)
+
+    def write(test, ctx):
+        return {"f": "write", "value": next(counter)}
+
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    return gen.mix([gen.Fn(write), gen.Fn(read)])
+
+
+class CausalReverseChecker(Checker):
+    def name(self):
+        return "causal-reverse"
+
+    def check(self, test, history, opts):
+        # completion wall-order: ok writes in history order; invoke order
+        # for each value
+        invoke_pos: dict = {}
+        complete_pos: dict = {}
+        for i, op in enumerate(history):
+            if op.get("f") not in ("write", "w"):
+                continue
+            v = op.get("value")
+            if op.get("type") == "invoke":
+                invoke_pos.setdefault(v, i)
+            elif op.get("type") == "ok":
+                complete_pos[v] = i
+
+        errors = []
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") not in ("read", "r"):
+                continue
+            seen = set(op.get("value") or [])
+            for b in seen:
+                cb = invoke_pos.get(b)
+                if cb is None:
+                    continue
+                # any write that completed before b was invoked must be seen
+                for a, ca in complete_pos.items():
+                    if ca < cb and a not in seen:
+                        errors.append({"read": op, "missing": a,
+                                       "observed-later": b})
+        return {
+            "valid?": not errors,
+            "error-count": len(errors),
+            "errors": errors[:10],
+        }
+
+
+def checker() -> Checker:
+    return CausalReverseChecker()
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {"generator": generator(), "checker": checker()}

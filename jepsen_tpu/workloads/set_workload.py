@@ -1,0 +1,42 @@
+"""Set add/read workload (reference checkers: jepsen/src/jepsen/checker.clj
+240-291 `set` and 461-592 `set-full`).
+
+Clients add unique integers to a set; reads return the whole set. The
+quick checker compares the final read against attempted adds; set-full
+tracks every element's visibility window across all reads.
+"""
+from __future__ import annotations
+
+import itertools
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import generator as gen
+
+
+def adds():
+    """Infinite unique-element add ops."""
+    counter = itertools.count()
+
+    def add(test, ctx):
+        return {"f": "add", "value": next(counter)}
+
+    return gen.Fn(add)
+
+
+def reads(final: bool = False):
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    if final:
+        return gen.clients(gen.each_thread(gen.once(gen.Fn(read))))
+    return gen.Fn(read)
+
+
+def workload(test: dict | None = None, full: bool = False,
+             linearizable: bool = False, **_) -> dict:
+    return {
+        "generator": adds() if full is False else gen.mix([adds(), reads()]),
+        "final_generator": reads(final=True),
+        "checker": (chk.set_full(linearizable=linearizable)
+                    if full else chk.set_checker()),
+    }

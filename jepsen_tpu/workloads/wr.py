@@ -1,0 +1,43 @@
+"""Read-write-register transactional workload: thin wrapper over the
+Elle-style rw-register checker (reference:
+jepsen/src/jepsen/tests/cycle/wr.clj).
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.elle import rw_register
+
+
+class WrChecker(Checker):
+    def __init__(self, accelerator: str = "auto",
+                 consistency_models=("strict-serializable",)):
+        self.accelerator = accelerator
+        self.consistency_models = consistency_models
+
+    def name(self):
+        return "elle-rw-register"
+
+    def check(self, test, history, opts):
+        return rw_register.check(
+            history,
+            accelerator=opts.get("accelerator", self.accelerator),
+            consistency_models=opts.get("consistency_models",
+                                        self.consistency_models))
+
+
+def checker(**kw) -> Checker:
+    return WrChecker(**kw)
+
+
+def generator(**kw):
+    return gen.Fn(rw_register.gen(**kw))
+
+
+def workload(test: dict | None = None, accelerator: str = "auto",
+             consistency_models=("strict-serializable",), **gen_kw) -> dict:
+    return {
+        "generator": generator(**gen_kw),
+        "checker": checker(accelerator=accelerator,
+                           consistency_models=consistency_models),
+    }

@@ -1,0 +1,225 @@
+"""Workload-kit tests: literal-history checker cases (reference tier-1
+style, SURVEY.md §4) plus simulated-generator smoke runs."""
+import pytest
+
+from jepsen_tpu.generator.simulate import perfect, quick, invocations
+from jepsen_tpu.workloads import (adya, append, bank, causal, causal_reverse,
+                                  long_fork, register, set_workload, wr)
+
+
+def op(typ, process, f, value=None):
+    return {"type": typ, "process": process, "f": f, "value": value}
+
+
+# ---------------------------------------------------------------------------
+# bank
+# ---------------------------------------------------------------------------
+
+def bank_test():
+    w = bank.workload()
+    return {**w, "accounts": [0, 1], "total-amount": 20}
+
+
+def test_bank_valid():
+    t = bank_test()
+    h = [
+        op("invoke", 0, "read"), op("ok", 0, "read", {0: 10, 1: 10}),
+        op("invoke", 1, "transfer", {"from": 0, "to": 1, "amount": 5}),
+        op("ok", 1, "transfer", {"from": 0, "to": 1, "amount": 5}),
+        op("invoke", 0, "read"), op("ok", 0, "read", {0: 5, 1: 15}),
+    ]
+    assert bank.checker().check(t, h, {})["valid?"] is True
+
+
+def test_bank_wrong_total():
+    t = bank_test()
+    h = [op("invoke", 0, "read"), op("ok", 0, "read", {0: 10, 1: 11})]
+    r = bank.checker().check(t, h, {})
+    assert r["valid?"] is False
+    assert r["first-error"]["errors"][0]["error"] == "wrong-total"
+
+
+def test_bank_negative_balance():
+    t = bank_test()
+    h = [op("invoke", 0, "read"), op("ok", 0, "read", {0: -5, 1: 25})]
+    assert bank.checker().check(t, h, {})["valid?"] is False
+    assert bank.checker(negative_balances=True).check(t, h, {})["valid?"] is True
+
+
+def test_bank_generator_shapes():
+    t = bank_test()
+    h = quick(t, __import__("jepsen_tpu.generator", fromlist=["g"]).limit(
+        50, bank.generator()))
+    assert len(invocations(h)) == 50
+    for iv in invocations(h):
+        assert iv["f"] in ("read", "transfer")
+        if iv["f"] == "transfer":
+            v = iv["value"]
+            assert v["from"] in t["accounts"] and v["to"] in t["accounts"]
+            assert v["from"] != v["to"] and v["amount"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# long fork
+# ---------------------------------------------------------------------------
+
+def test_long_fork_detects_fork():
+    # keys 0,1 in group 0 (group_size 2); two incomparable reads
+    c = long_fork.checker(group_size=2)
+    h = [
+        op("ok", 0, "txn", [["w", 0, 1]]),
+        op("ok", 1, "txn", [["w", 1, 1]]),
+        op("ok", 2, "txn", [["r", 0, 1], ["r", 1, None]]),
+        op("ok", 3, "txn", [["r", 0, None], ["r", 1, 1]]),
+    ]
+    r = c.check({}, h, {})
+    assert r["valid?"] is False and r["fork-count"] == 1
+
+
+def test_long_fork_comparable_ok():
+    c = long_fork.checker(group_size=2)
+    h = [
+        op("ok", 0, "txn", [["w", 0, 1]]),
+        op("ok", 2, "txn", [["r", 0, 1], ["r", 1, None]]),
+        op("ok", 3, "txn", [["r", 0, 1], ["r", 1, 1]]),
+        op("ok", 1, "txn", [["w", 1, 1]]),
+    ]
+    assert c.check({}, h, {})["valid?"] is True
+
+
+def test_long_fork_generator_simulates():
+    h = quick({"concurrency": 4},
+              __import__("jepsen_tpu.generator", fromlist=["g"]).limit(
+                  60, long_fork.generator(group_size=2)))
+    ivs = invocations(h)
+    assert len(ivs) == 60
+    writes = [m for iv in ivs for m in iv["value"] if m[0] == "w"]
+    # each key written at most once
+    keys = [m[1] for m in writes]
+    assert len(keys) == len(set(keys))
+
+
+# ---------------------------------------------------------------------------
+# causal / causal-reverse
+# ---------------------------------------------------------------------------
+
+def test_causal_model():
+    m = causal.CausalRegister()
+    m2 = m.step({"f": "write", "value": 1})
+    assert m2.value == 1
+    assert m2.step({"f": "write", "value": 3}).is_inconsistent()
+    assert m2.step({"f": "read", "value": 1}) is m2
+
+
+def test_causal_workload_checks():
+    w = causal.workload(n_writes=3)
+    h = [
+        op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+        op("invoke", 1, "read"), op("ok", 1, "read", 1),
+        op("invoke", 0, "write", 2), op("ok", 0, "write", 2),
+    ]
+    assert w["checker"].check({}, h, {"accelerator": "cpu"})["valid?"] is True
+
+
+def test_causal_reverse_detects_reorder():
+    c = causal_reverse.checker()
+    h = [
+        op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+        op("invoke", 0, "write", 2), op("ok", 0, "write", 2),
+        # read sees 2 but not 1, though write 1 completed before write 2 began
+        op("invoke", 1, "read"), op("ok", 1, "read", [2]),
+    ]
+    r = c.check({}, h, {})
+    assert r["valid?"] is False
+    assert r["errors"][0]["missing"] == 1
+
+
+def test_causal_reverse_concurrent_ok():
+    c = causal_reverse.checker()
+    h = [
+        op("invoke", 0, "write", 1),
+        op("invoke", 2, "write", 2), op("ok", 2, "write", 2),
+        op("ok", 0, "write", 1),
+        # 1 and 2 were concurrent: seeing only 2 is fine
+        op("invoke", 1, "read"), op("ok", 1, "read", [2]),
+    ]
+    assert c.check({}, h, {})["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# adya g2
+# ---------------------------------------------------------------------------
+
+def test_adya_write_skew():
+    c = adya.checker()
+    h = [
+        op("ok", 0, "insert", [7, 1, "a"]),
+        op("ok", 1, "insert", [7, 2, "b"]),
+        op("ok", 2, "insert", [8, 3, "a"]),
+    ]
+    r = c.check({}, h, {})
+    assert r["valid?"] is False and r["g2-count"] == 1
+
+
+def test_adya_generator_pairs():
+    h = quick({"concurrency": 4},
+              __import__("jepsen_tpu.generator", fromlist=["g"]).limit(
+                  40, adya.generator()))
+    ivs = invocations(h)
+    uids = [iv["value"][1] for iv in ivs]
+    assert len(set(uids)) == len(uids)
+    by_pair = {}
+    for iv in ivs:
+        pair, _uid, cell = iv["value"]
+        by_pair.setdefault(pair, []).append(cell)
+    for cells in by_pair.values():
+        assert len(cells) <= 2 and len(set(cells)) == len(cells)
+
+
+# ---------------------------------------------------------------------------
+# register / set / elle wrappers: end-to-end smoke via simulation
+# ---------------------------------------------------------------------------
+
+def test_register_workload_end_to_end():
+    import jepsen_tpu.generator as g
+    w = register.workload({"concurrency": 4}, per_key_limit=8)
+    t = {"concurrency": 4}
+    h = perfect(t, g.limit(200, w["generator"]))
+    # simulate returns uniform ok completions mirroring the invoke — i.e.
+    # every read sees its own placeholder; build a trivially valid register
+    # history instead: reads return None (unknown) are not valid ops, so
+    # just verify the generator emits well-formed tuple values
+    for iv in invocations(h):
+        k, v = iv["value"]
+        assert iv["f"] in ("read", "write", "cas")
+
+
+def test_set_workload_checker():
+    w = set_workload.workload()
+    h = [
+        op("invoke", 0, "add", 0), op("ok", 0, "add", 0),
+        op("invoke", 1, "add", 1), op("ok", 1, "add", 1),
+        op("invoke", 0, "read"), op("ok", 0, "read", [0, 1]),
+    ]
+    assert w["checker"].check({}, h, {})["valid?"] is True
+    h_lost = h[:-1] + [op("ok", 0, "read", [1])]
+    assert w["checker"].check({}, h_lost, {})["valid?"] is False
+
+
+def test_append_wr_workloads():
+    aw = append.workload()
+    h = [op("ok", 0, "txn", [["append", "x", 1], ["r", "x", [1]]])]
+    assert aw["checker"].check({}, h, {"accelerator": "cpu"})["valid?"] is True
+    ww = wr.workload()
+    h2 = [op("ok", 0, "txn", [["w", "x", 1], ["r", "x", 1]])]
+    assert ww["checker"].check({}, h2, {"accelerator": "cpu"})["valid?"] is True
+
+
+def test_append_generator_via_workload():
+    import jepsen_tpu.generator as g
+    w = append.workload()
+    h = quick({"concurrency": 2}, g.limit(30, w["generator"]))
+    assert len(invocations(h)) == 30
+    for iv in invocations(h):
+        for m in iv["value"]:
+            assert m[0] in ("r", "append")
