@@ -513,3 +513,28 @@ def log_file_pattern(pattern: str, filename: str) -> Checker:
 
 def unbridled_optimism() -> Checker:
     return UnbridledOptimism()
+
+
+def latency_graph() -> Checker:
+    from jepsen_tpu.checker.perf import LatencyGraph
+    return LatencyGraph()
+
+
+def rate_graph() -> Checker:
+    from jepsen_tpu.checker.perf import RateGraph
+    return RateGraph()
+
+
+def perf() -> Checker:
+    from jepsen_tpu.checker.perf import perf as _perf
+    return _perf()
+
+
+def clock_plot() -> Checker:
+    from jepsen_tpu.checker.clock import ClockPlot
+    return ClockPlot()
+
+
+def timeline_html() -> Checker:
+    from jepsen_tpu.checker.timeline import Timeline
+    return Timeline()

@@ -1,0 +1,206 @@
+"""Performance plots: latency and throughput graphs over the history, with
+shaded nemesis-activity regions (reference: jepsen/src/jepsen/checker/perf.clj
+— gnuplot there; matplotlib Agg here, no subprocess).
+
+All computation is columnar: the history is reduced once to numpy arrays
+(time, latency, f-id, type-id) and every graph is a vectorized
+aggregation — the same struct-of-arrays discipline the checker core uses
+(SURVEY.md §7 design stance).
+"""
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Any
+
+import numpy as np
+
+from jepsen_tpu import store
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.utils import history_to_latencies, nemesis_intervals
+
+logger = logging.getLogger("jepsen.checker.perf")
+
+DEFAULT_QUANTILES = (0.0, 0.5, 0.95, 0.99, 1.0)
+NS = 1e9
+
+TYPE_COLORS = {"ok": "#81BFFC", "info": "#FFA400", "fail": "#FF1E90"}
+NEMESIS_SHADE = "#dddddd"
+
+
+def invokes_with_latency(history: list[dict]) -> list[dict]:
+    h = history_to_latencies(history)
+    return [op for op in h
+            if op.get("type") == "invoke" and op.get("process") != "nemesis"
+            and "latency" in op]
+
+
+def bucket_points(times_s: np.ndarray, dt: float) -> np.ndarray:
+    """Bucket index for each time; bucket centers at (i + .5) * dt
+    (perf.clj:21-49)."""
+    return np.floor(times_s / dt).astype(np.int64)
+
+
+def latencies_to_quantiles(times_s, lats_ms, dt: float,
+                           qs=DEFAULT_QUANTILES) -> dict[float, list[tuple]]:
+    """{q: [(bucket-center-time, latency-ms)...]} (perf.clj:63-85)."""
+    if len(times_s) == 0:
+        return {q: [] for q in qs}
+    buckets = bucket_points(np.asarray(times_s), dt)
+    out: dict[float, list[tuple]] = {q: [] for q in qs}
+    for b in np.unique(buckets):
+        sel = np.sort(np.asarray(lats_ms)[buckets == b])
+        center = (b + 0.5) * dt
+        n = len(sel)
+        for q in qs:
+            idx = min(n - 1, int(np.ceil(q * n)) - 1) if q > 0 else 0
+            out[q].append((center, float(sel[max(0, idx)])))
+    return out
+
+
+def rate(history: list[dict], dt: float) -> dict[tuple, list[tuple]]:
+    """{(f, type): [(bucket-center, ops/sec)...]} (perf.clj:127-141)."""
+    groups: dict[tuple, list[float]] = defaultdict(list)
+    for op in history:
+        if op.get("process") == "nemesis":
+            continue
+        if op.get("type") not in ("ok", "fail", "info"):
+            continue
+        groups[(op.get("f"), op.get("type"))].append(op.get("time", 0) / NS)
+    out = {}
+    for k, ts in groups.items():
+        arr = np.asarray(ts)
+        buckets = bucket_points(arr, dt)
+        out[k] = [((b + 0.5) * dt, float((buckets == b).sum()) / dt)
+                  for b in np.unique(buckets)]
+    return out
+
+
+def nemesis_activity(history: list[dict]) -> list[tuple[float, float]]:
+    """[(start-s, stop-s)] shaded regions (perf.clj:184-270)."""
+    end = max((op.get("time", 0) for op in history), default=0) / NS
+    out = []
+    for start, stop in nemesis_intervals(history):
+        t0 = start.get("time", 0) / NS
+        t1 = stop.get("time", 0) / NS if stop is not None else end
+        out.append((t0, t1))
+    return out
+
+
+def _shade_nemesis(ax, history):
+    for t0, t1 in nemesis_activity(history):
+        ax.axvspan(t0, t1, color=NEMESIS_SHADE, zorder=0)
+
+
+def _figure():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots(figsize=(9, 5), dpi=100)
+    return plt, fig, ax
+
+
+def point_graph(test: dict, history: list[dict], output) -> None:
+    """Raw latency scatter, colored by completion type (perf.clj:484-513)."""
+    plt, fig, ax = _figure()
+    _shade_nemesis(ax, history)
+    by_type: dict[str, list[tuple]] = defaultdict(list)
+    for op in invokes_with_latency(history):
+        comp = op.get("completion") or {}
+        by_type[comp.get("type", "info")].append(
+            (op.get("time", 0) / NS, op["latency"] / 1e6))
+    for typ, pts in sorted(by_type.items()):
+        arr = np.asarray(pts)
+        ax.plot(arr[:, 0], arr[:, 1], ".", ms=3,
+                color=TYPE_COLORS.get(typ, "#888888"), label=typ)
+    ax.set_yscale("log")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title(f"{test.get('name', 'test')} latency (raw)")
+    if by_type:
+        ax.legend(loc="upper right", fontsize=8)
+    fig.savefig(output, bbox_inches="tight")
+    plt.close(fig)
+
+
+def quantiles_graph(test: dict, history: list[dict], output,
+                    dt: float = 10.0, qs=DEFAULT_QUANTILES) -> None:
+    """Latency quantiles over time (perf.clj:513-559)."""
+    plt, fig, ax = _figure()
+    _shade_nemesis(ax, history)
+    ops = invokes_with_latency(history)
+    times = np.asarray([o.get("time", 0) / NS for o in ops])
+    lats = np.asarray([o["latency"] / 1e6 for o in ops])
+    for q, pts in sorted(latencies_to_quantiles(times, lats, dt, qs).items()):
+        if pts:
+            arr = np.asarray(pts)
+            ax.plot(arr[:, 0], arr[:, 1], "-o", ms=3, label=f"q={q}")
+    ax.set_yscale("log")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title(f"{test.get('name', 'test')} latency quantiles")
+    ax.legend(loc="upper right", fontsize=8)
+    fig.savefig(output, bbox_inches="tight")
+    plt.close(fig)
+
+
+def rate_graph(test: dict, history: list[dict], output,
+               dt: float = 10.0) -> None:
+    """Throughput per (f, completion-type) (perf.clj:559-599)."""
+    plt, fig, ax = _figure()
+    _shade_nemesis(ax, history)
+    for (f, typ), pts in sorted(rate(history, dt).items(), key=str):
+        arr = np.asarray(pts)
+        ax.plot(arr[:, 0], arr[:, 1], "-",
+                color=TYPE_COLORS.get(typ, "#888888"), alpha=0.9,
+                label=f"{f} {typ}")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("throughput (ops/s)")
+    ax.set_title(f"{test.get('name', 'test')} rate")
+    ax.legend(loc="upper right", fontsize=8)
+    fig.savefig(output, bbox_inches="tight")
+    plt.close(fig)
+
+
+class LatencyGraph(Checker):
+    """(checker.clj:797-811)"""
+
+    def name(self):
+        return "latency-graph"
+
+    def check(self, test, history, opts):
+        d = opts.get("subdirectory")
+        point_graph(test, history,
+                    store.path_mk(test, *filter(None, [d, "latency-raw.png"])))
+        quantiles_graph(test, history,
+                        store.path_mk(test, *filter(None,
+                                                    [d, "latency-quantiles.png"])))
+        return {"valid?": True}
+
+
+class RateGraph(Checker):
+    """(checker.clj:813-824)"""
+
+    def name(self):
+        return "rate-graph"
+
+    def check(self, test, history, opts):
+        d = opts.get("subdirectory")
+        rate_graph(test, history,
+                   store.path_mk(test, *filter(None, [d, "rate.png"])))
+        return {"valid?": True}
+
+
+def latency_graph() -> Checker:
+    return LatencyGraph()
+
+
+def rate_graph_checker() -> Checker:
+    return RateGraph()
+
+
+def perf() -> Checker:
+    """latency + rate composed (checker.clj:826-829)."""
+    from jepsen_tpu.checker import compose
+    return compose({"latency-graph": latency_graph(),
+                    "rate-graph": rate_graph_checker()})

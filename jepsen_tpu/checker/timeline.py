@@ -1,0 +1,99 @@
+"""HTML timeline: a per-process gantt of operations (reference:
+jepsen/src/jepsen/checker/timeline.clj — hiccup there; direct HTML string
+assembly here, no dependency).
+
+Each op is a positioned block in its process's column; height spans
+invoke→completion, color encodes the completion type. Capped at
+``OP_LIMIT`` ops like the reference (timeline.clj:12-14).
+"""
+from __future__ import annotations
+
+import html as html_mod
+from typing import Any
+
+from jepsen_tpu import store
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.utils import history_to_latencies, nanos_to_ms
+
+OP_LIMIT = 10_000
+
+COLORS = {"ok": "#6DB6FE", "info": "#FFAA26", "fail": "#FEB5DA"}
+NS = 1e9
+HSCALE = 1e-6 / 10.0  # nanos -> px (1 ms = 0.1 px)
+MIN_HEIGHT = 14
+COL_WIDTH = 100
+GUTTER = 4
+
+STYLE = """
+body { font-family: sans-serif; font-size: 11px; }
+.ops { position: absolute; }
+.op { position: absolute; padding: 2px; border-radius: 2px;
+      overflow: hidden; box-sizing: border-box; }
+.op:hover { overflow: visible; z-index: 10; min-width: 250px; }
+.proc-header { position: absolute; top: 0; font-weight: bold; }
+"""
+
+
+def pairs(history: list[dict]) -> list[tuple[dict, dict | None]]:
+    """(invoke, completion|None) pairs, client ops only
+    (timeline.clj:37-57)."""
+    out = []
+    for op in history_to_latencies(history):
+        if op.get("type") != "invoke" or op.get("process") == "nemesis":
+            continue
+        out.append((op, op.get("completion")))
+    return out
+
+
+def render(test: dict, history: list[dict]) -> str:
+    ps = pairs(history)[:OP_LIMIT]
+    processes = sorted({iv.get("process") for iv, _ in ps},
+                       key=lambda p: (str(type(p)), p))
+    col = {p: i for i, p in enumerate(processes)}
+    blocks = []
+    for p in processes:
+        x = col[p] * (COL_WIDTH + GUTTER)
+        blocks.append(f'<div class="proc-header" style="left:{x}px">'
+                      f'process {html_mod.escape(str(p))}</div>')
+    max_y = 0.0
+    for iv, comp in ps:
+        t0 = iv.get("time", 0)
+        t1 = comp.get("time", t0) if comp else t0 + MIN_HEIGHT / HSCALE
+        y = 20 + t0 * HSCALE
+        h = max(MIN_HEIGHT, (t1 - t0) * HSCALE)
+        max_y = max(max_y, y + h)
+        x = col[iv.get("process")] * (COL_WIDTH + GUTTER)
+        typ = comp.get("type", "info") if comp else "info"
+        color = COLORS.get(typ, "#dddddd")
+        label = f"{iv.get('f')} {iv.get('value')!r}"
+        if comp is not None and comp.get("value") != iv.get("value"):
+            label += f" → {comp.get('value')!r}"
+        title = (f"process {iv.get('process')} {typ} "
+                 f"t={nanos_to_ms(t0):.1f}ms "
+                 f"lat={nanos_to_ms(iv.get('latency', 0)):.1f}ms")
+        blocks.append(
+            f'<div class="op" title="{html_mod.escape(title)}" '
+            f'style="left:{x}px;top:{y:.1f}px;width:{COL_WIDTH}px;'
+            f'height:{h:.1f}px;background:{color}">'
+            f'{html_mod.escape(label)}</div>')
+    return (
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+        f"<title>{html_mod.escape(str(test.get('name', 'test')))} timeline"
+        f"</title><style>{STYLE}</style></head><body>"
+        f"<div class='ops' style='height:{max_y + 40:.0f}px'>"
+        + "".join(blocks) + "</div></body></html>")
+
+
+class Timeline(Checker):
+    def name(self):
+        return "timeline"
+
+    def check(self, test, history, opts):
+        d = opts.get("subdirectory")
+        out = store.path_mk(test, *filter(None, [d, "timeline.html"]))
+        out.write_text(render(test, history))
+        return {"valid?": True}
+
+
+def html() -> Checker:
+    return Timeline()

@@ -195,3 +195,65 @@ def test_merge_valid_priorities():
     assert c.merge_valid([True, "unknown"]) == "unknown"
     assert c.merge_valid([True, True]) is True
     assert c.merge_valid([]) is True
+
+
+# ---------------------------------------------------------------------------
+# perf / timeline / clock renderers
+# ---------------------------------------------------------------------------
+
+def _plot_history():
+    ns = 1_000_000_000
+    h = []
+    t = 0
+    for i in range(40):
+        t += ns // 4
+        p = i % 3
+        h.append({"type": "invoke", "process": p, "f": "read", "value": None,
+                  "time": t})
+        h.append({"type": ["ok", "fail", "info"][i % 3], "process": p,
+                  "f": "read", "value": i, "time": t + ns // 10})
+    h.insert(10, {"type": "info", "process": "nemesis", "f": "start",
+                  "value": None, "time": 2 * ns})
+    h.insert(30, {"type": "info", "process": "nemesis", "f": "stop",
+                  "value": {"clock-offsets": {"n1": 50, "n2": -20}},
+                  "time": 6 * ns})
+    return h
+
+
+def test_perf_timeline_clock_render(tmp_path):
+    from jepsen_tpu import checker as chk
+    test = {"name": "plotty", "start_time": "20260729T000000",
+            "store_dir": str(tmp_path)}
+    h = _plot_history()
+    r = chk.perf().check(test, h, {})
+    assert r["valid?"] is True
+    r2 = chk.timeline_html().check(test, h, {})
+    assert r2["valid?"] is True
+    r3 = chk.clock_plot().check(test, h, {})
+    assert r3["valid?"] is True
+    base = tmp_path / "plotty" / "20260729T000000"
+    for f in ("latency-raw.png", "latency-quantiles.png", "rate.png",
+              "timeline.html", "clock-skew.png"):
+        assert (base / f).stat().st_size > 0, f
+    html = (base / "timeline.html").read_text()
+    assert "process 0" in html and "read" in html
+
+
+def test_latencies_to_quantiles():
+    import numpy as np
+    from jepsen_tpu.checker.perf import latencies_to_quantiles
+    times = np.asarray([0.0, 1.0, 2.0, 11.0, 12.0])
+    lats = np.asarray([1.0, 2.0, 3.0, 10.0, 20.0])
+    q = latencies_to_quantiles(times, lats, dt=10.0, qs=(0.5, 1.0))
+    assert q[1.0][0] == (5.0, 3.0)
+    assert q[1.0][1] == (15.0, 20.0)
+    assert q[0.5][0][1] == 2.0
+
+
+def test_nemesis_activity_regions():
+    from jepsen_tpu.checker.perf import nemesis_activity
+    h = _plot_history()
+    regions = nemesis_activity(h)
+    assert len(regions) == 1
+    t0, t1 = regions[0]
+    assert t0 == 2.0 and t1 == 6.0
