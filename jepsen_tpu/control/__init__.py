@@ -142,7 +142,7 @@ def upload_resource(package_relative: str, remote_path: str) -> None:
     """Uploads a file shipped inside jepsen_tpu/resources/
     (control.clj upload-resource!)."""
     import importlib.resources as ir
-    ref = ir.files("jepsen_tpu.resources").joinpath(package_relative)
+    ref = ir.files("jepsen_tpu").joinpath("resources", package_relative)
     with ir.as_file(ref) as p:
         upload(str(p), remote_path)
 

@@ -1,0 +1,190 @@
+"""Node-side helpers: daemons, process kills, downloads, archives.
+
+Reference: jepsen/src/jepsen/control/util.clj — daemon management
+(:310-360), grepkill (:286-308), cached wget / archive install (:167-275),
+plus small fs utilities. All run through the ambient control session.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Iterable
+
+from jepsen_tpu import control
+from jepsen_tpu.control import RemoteError
+
+logger = logging.getLogger("jepsen.control.util")
+
+WGET_CACHE_DIR = "/tmp/jepsen/wget-cache"
+
+
+def file_exists(path: str) -> bool:
+    try:
+        control.exec_("test", "-e", path)
+        return True
+    except RemoteError:
+        return False
+
+
+def ls(dir: str = ".") -> list[str]:
+    out = control.exec_("ls", "-1", dir)
+    return [l for l in out.splitlines() if l]
+
+
+def ls_full(dir: str) -> list[str]:
+    d = dir.rstrip("/")
+    return [f"{d}/{f}" for f in ls(d)]
+
+
+def write_file(content: str, path: str) -> None:
+    """Writes a string to a remote file via stdin (util.clj write-file!)."""
+    control.exec_("tee", path, stdin=content)
+
+
+def mkdir(path: str) -> None:
+    control.exec_("mkdir", "-p", path)
+
+
+def rm_rf(path: str) -> None:
+    control.exec_("rm", "-rf", path)
+
+
+# ---------------------------------------------------------------------------
+# processes
+# ---------------------------------------------------------------------------
+
+def signal(process: str, sig: str = "TERM") -> None:
+    """killall -s SIG process; ignores 'no process found'."""
+    try:
+        control.exec_("killall", "-s", sig, "--", process)
+    except RemoteError as e:
+        if "no process" not in (e.err or "").lower():
+            raise
+
+
+def grepkill(pattern: str, sig: str = "KILL") -> None:
+    """Kills processes whose command line matches pattern
+    (util.clj:286-308). pkill -f, tolerant of no matches."""
+    try:
+        control.exec_("pkill", f"-{sig}", "-f", "--", pattern)
+    except RemoteError as e:
+        if e.exit_status != 1:  # 1 = no processes matched
+            raise
+
+
+# ---------------------------------------------------------------------------
+# daemons (util.clj:310-360)
+# ---------------------------------------------------------------------------
+
+def start_daemon(opts: dict, bin: str, *args) -> bool:
+    """Starts bin as a daemon via start-stop-daemon (falling back to
+    setsid+nohup), recording a pidfile. opts: {"logfile", "pidfile",
+    "chdir", "background"=True, "make-pidfile"=True, "env"={}}.
+    Returns False if already running."""
+    pidfile = opts.get("pidfile")
+    logfile = opts.get("logfile", "/dev/null")
+    chdir = opts.get("chdir", "/")
+    if pidfile and file_exists(pidfile):
+        try:
+            pid = control.exec_("cat", pidfile).strip()
+            if pid:
+                control.exec_("kill", "-0", pid)
+                logger.debug("daemon %s already running (pid %s)", bin, pid)
+                return False
+        except RemoteError:
+            pass  # stale pidfile
+    envmap = opts.get("env") or {}
+    env_prefix = " ".join(f"{k}={control.escape(str(v))}"
+                          for k, v in envmap.items())
+    cmd = " ".join([control.escape(bin), *[control.escape(str(a)) for a in args]])
+    daemon_cmd = (
+        f"cd {control.escape(chdir)} && "
+        f"{env_prefix + ' ' if env_prefix else ''}"
+        f"setsid nohup {cmd} >> {control.escape(logfile)} 2>&1 < /dev/null & "
+        + (f"echo $! > {control.escape(pidfile)}" if pidfile else "true"))
+    control.exec_(control.lit(daemon_cmd))
+    return True
+
+
+def stop_daemon(bin_or_pidfile: str, pidfile: str | None = None) -> None:
+    """Stops a daemon by pidfile (kill -9 pid, remove pidfile) or by
+    name via grepkill (util.clj stop-daemon!)."""
+    pf = pidfile if pidfile is not None else (
+        bin_or_pidfile if bin_or_pidfile.startswith("/") else None)
+    if pf is not None:
+        if file_exists(pf):
+            try:
+                pid = control.exec_("cat", pf).strip()
+                if pid:
+                    try:
+                        control.exec_("kill", "-9", pid)
+                    except RemoteError:
+                        pass
+            finally:
+                control.exec_("rm", "-f", pf)
+        if pidfile is None:
+            return
+    if pf is None or (pidfile is not None and bin_or_pidfile != pf):
+        grepkill(bin_or_pidfile)
+
+
+def daemon_running(pidfile: str) -> bool:
+    try:
+        pid = control.exec_("cat", pidfile).strip()
+        control.exec_("kill", "-0", pid)
+        return True
+    except RemoteError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# downloads & archives (util.clj:167-275)
+# ---------------------------------------------------------------------------
+
+def cached_wget(url: str, force: bool = False) -> str:
+    """Downloads url into a node-local cache dir; returns the cached path."""
+    name = url.rstrip("/").rsplit("/", 1)[-1] or "download"
+    mkdir(WGET_CACHE_DIR)
+    path = f"{WGET_CACHE_DIR}/{name}"
+    if force or not file_exists(path):
+        control.exec_("wget", "-O", f"{path}.tmp", url)
+        control.exec_("mv", f"{path}.tmp", path)
+    return path
+
+
+def install_archive(url: str, dest: str, force: bool = False,
+                    user: str | None = None) -> str:
+    """Downloads (cached) and unpacks a tar/zip archive into dest,
+    stripping a single top-level directory (util.clj install-archive!)."""
+    archive = cached_wget(url, force=force)
+    rm_rf(dest)
+    mkdir(dest)
+    if archive.endswith(".zip"):
+        tmp = f"{dest}.unzip-tmp"
+        rm_rf(tmp)
+        mkdir(tmp)
+        control.exec_("unzip", "-q", archive, "-d", tmp)
+        entries = ls_full(tmp)
+        src = entries[0] if len(entries) == 1 else tmp
+        control.exec_(control.lit(
+            f"mv {control.escape(src)}/* {control.escape(dest)}/"))
+        rm_rf(tmp)
+    else:
+        control.exec_("tar", "-xf", archive, "-C", dest,
+                      "--strip-components=1")
+    if user:
+        control.exec_("chown", "-R", user, dest)
+    return dest
+
+
+def await_tcp_port(port: int, host: str = "localhost",
+                   timeout_s: float = 60.0, dt: float = 1.0) -> None:
+    """Blocks until the port accepts connections (util.clj await-tcp-port)."""
+    from jepsen_tpu.utils import await_fn
+
+    def check():
+        control.exec_("bash", "-c",
+                      f"exec 3<>/dev/tcp/{host}/{port} && exec 3>&-")
+        return True
+
+    await_fn(check, retry_interval=dt, timeout_s=timeout_s,
+             log_message=f"waiting for {host}:{port}")

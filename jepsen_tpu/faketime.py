@@ -1,0 +1,81 @@
+"""libfaketime wrappers: divergent per-node clock *rates*.
+
+Reference: jepsen/src/jepsen/faketime.clj — wraps DB binaries in scripts
+that LD_PRELOAD libfaketime with a per-node rate factor, so node clocks
+drift apart continuously (rather than jumping, like the bump/strobe
+nemesis). The reference builds a patched libfaketime from source
+(faketime.clj:8-22); in sealed environments we use the distro's
+libfaketime when present and raise otherwise (install is gated, not
+assumed).
+"""
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import control
+from jepsen_tpu.control import RemoteError
+from jepsen_tpu.control.util import file_exists, write_file
+
+LIB_PATHS = (
+    "/usr/lib/x86_64-linux-gnu/faketime/libfaketime.so.1",
+    "/usr/lib/faketime/libfaketime.so.1",
+    "/usr/lib64/faketime/libfaketime.so.1",
+    "/usr/local/lib/faketime/libfaketime.so.1",
+)
+
+
+def find_lib() -> str | None:
+    for p in LIB_PATHS:
+        if file_exists(p):
+            return p
+    return None
+
+
+def install() -> str:
+    """Ensures libfaketime is present (distro package), returning the
+    library path (faketime.clj:8-22 capability)."""
+    lib = find_lib()
+    if lib:
+        return lib
+    try:
+        from jepsen_tpu.os_setup import install as pkg_install
+        pkg_install(["faketime", "libfaketime"])
+    except RemoteError:
+        pass
+    lib = find_lib()
+    if lib is None:
+        raise RemoteError("libfaketime unavailable on this node "
+                          "(install the faketime package)")
+    return lib
+
+
+def script(lib: str, rate: float) -> str:
+    """A wrapper-script body applying a clock-rate factor
+    (faketime.clj:24-34)."""
+    return (
+        "#!/bin/bash\n"
+        f"FAKETIME=\"+0 x{rate:.4f}\" "
+        f"LD_PRELOAD={lib} "
+        "exec \"$(dirname \"$0\")/$(basename \"$0\").real\" \"$@\"\n")
+
+
+def wrap(binary: str, rate: float) -> None:
+    """Moves binary to binary.real and installs a faketime wrapper in its
+    place (faketime.clj wrap!/:36-55). Idempotent."""
+    lib = install()
+    if not file_exists(f"{binary}.real"):
+        control.exec_("mv", binary, f"{binary}.real")
+    write_file(script(lib, rate), binary)
+    control.exec_("chmod", "+x", binary)
+
+
+def unwrap(binary: str) -> None:
+    """Restores the original binary (faketime.clj unwrap!)."""
+    if file_exists(f"{binary}.real"):
+        control.exec_("mv", f"{binary}.real", binary)
+
+
+def rand_factor(rng: random.Random | None = None) -> float:
+    """A clock-rate factor near 1 (faketime.clj:57-65)."""
+    rng = rng or random
+    return 1.0 + rng.uniform(-0.02, 0.02)

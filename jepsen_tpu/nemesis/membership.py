@@ -1,0 +1,165 @@
+"""Cluster-membership nemesis: grow/shrink the SUT's member set mid-test.
+
+Reference: jepsen/src/jepsen/nemesis/membership.clj + membership/state.clj.
+A user-supplied State object models the cluster's membership view; per-node
+view threads poll every ``NODE_VIEW_INTERVAL`` seconds and merge into a
+resolved view; ops are generated from the current view, applied via the
+State, and completed once the State considers them resolved (fixed-point
+resolve loop, membership.clj:95-107,159-210).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from typing import Any
+
+from jepsen_tpu.nemesis import Nemesis
+
+logger = logging.getLogger("jepsen.nemesis.membership")
+
+NODE_VIEW_INTERVAL = 5.0  # seconds (membership.clj:59-61)
+
+
+class State:
+    """Membership model protocol (membership/state.clj). Implementations
+    are free-form records over {"view": ..., "pending": [...]}-style
+    state; all methods return a new State (pure) except invoke/teardown.
+    """
+
+    def node_view(self, test: dict, node: str):
+        """This node's current view of the cluster (polled, may raise)."""
+        raise NotImplementedError
+
+    def merge_views(self, test: dict, views: dict):
+        """Collapses {node: view} into one authoritative view; returns
+        new State."""
+        raise NotImplementedError
+
+    def fs(self) -> set:
+        """Op :f values this membership State can perform."""
+        return set()
+
+    def op(self, test: dict):
+        """Next membership op to try: an op dict or "pending"."""
+        return "pending"
+
+    def invoke(self, test: dict, op: dict):
+        """Actually performs the op against the cluster. Returns the
+        completion value."""
+        raise NotImplementedError
+
+    def resolve(self, test: dict):
+        """A chance to update internal state; returns new State."""
+        return self
+
+    def resolve_op(self, test: dict, pending_pair):
+        """(op, completion-value) -> None if still pending, else new
+        State with the op resolved."""
+        return None
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+class MembershipNemesis(Nemesis):
+    """(membership.clj:159-210)"""
+
+    def __init__(self, state: State, poll_interval: float = NODE_VIEW_INTERVAL):
+        self.state = state
+        self.poll_interval = poll_interval
+        self._lock = threading.Lock()
+        self._views: dict = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._pending: list = []
+
+    def fs(self):
+        return self.state.fs()
+
+    # -- node view polling (membership.clj:143-157) ---------------------
+    def _poll_node(self, test, node):
+        while not self._stop.is_set():
+            try:
+                view = self.state.node_view(test, node)
+                with self._lock:
+                    self._views[node] = view
+            except Exception as e:  # noqa: BLE001
+                logger.debug("node view %s failed: %r", node, e)
+            self._stop.wait(self.poll_interval)
+
+    def setup(self, test):
+        for node in test.get("nodes") or []:
+            t = threading.Thread(target=self._poll_node, args=(test, node),
+                                 daemon=True,
+                                 name=f"membership-view-{node}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    # -- resolution fixed point (membership.clj:95-107) ------------------
+    def _resolve(self, test):
+        with self._lock:
+            views = dict(self._views)
+        state = self.state
+        try:
+            state = state.merge_views(test, views) or state
+        except Exception as e:  # noqa: BLE001
+            logger.debug("merge_views failed: %r", e)
+        changed = True
+        while changed:
+            changed = False
+            state = state.resolve(test) or state
+            still = []
+            for pair in self._pending:
+                nxt = state.resolve_op(test, pair)
+                if nxt is None:
+                    still.append(pair)
+                else:
+                    state = nxt
+                    changed = True
+            self._pending = still
+        self.state = state
+
+    def invoke(self, test, op):
+        self._resolve(test)
+        try:
+            value = self.state.invoke(test, op)
+        except Exception as e:  # noqa: BLE001
+            return {**op, "type": "info", "value": ["error", repr(e)]}
+        self._pending.append((op, value))
+        self._resolve(test)
+        return {**op, "type": "info", "value": value}
+
+    def teardown(self, test):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self.state.teardown(test)
+
+
+def membership_gen(nemesis: MembershipNemesis):
+    """Generator polling the State for its next op (membership.clj:212-222)."""
+
+    def next_op(test, ctx):
+        nemesis._resolve(test)
+        op = nemesis.state.op(test)
+        if op == "pending" or op is None:
+            return None
+        return op
+
+    return next_op
+
+
+def package(state: State, interval: float = 10.0,
+            poll_interval: float = NODE_VIEW_INTERVAL) -> dict:
+    """A combined-style package (membership.clj:224-250)."""
+    from jepsen_tpu import generator as gen
+    n = MembershipNemesis(state, poll_interval=poll_interval)
+    return {
+        "nemesis": n,
+        "generator": gen.stagger(interval, gen.Fn(membership_gen(n))),
+        "final_generator": None,
+        "perf": {"name": "membership", "fs": state.fs(),
+                 "start": set(), "stop": set()},
+    }

@@ -1,0 +1,184 @@
+"""Clock nemesis: skew, bump, and strobe the wall clocks of DB nodes.
+
+Reference: jepsen/src/jepsen/nemesis/time.clj — uploads C sources and
+compiles them with gcc ON EACH NODE at setup (:20-39,52-61), then drives
+them per op; NTP is stopped so it can't fight back; offsets are measured
+and embedded in completion values for the clock-plot checker.
+
+The C sources are ours (jepsen_tpu/resources/bump-time.c, strobe-time.c —
+fresh implementations of the same capability).
+"""
+from __future__ import annotations
+
+import logging
+import random
+from typing import Iterable
+
+from jepsen_tpu import control
+from jepsen_tpu.control import RemoteError
+from jepsen_tpu.control.util import file_exists, mkdir
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.utils import real_pmap
+
+logger = logging.getLogger("jepsen.nemesis.time")
+
+BIN_DIR = "/opt/jepsen"
+SOURCES = ("bump-time", "strobe-time")
+
+
+def compile_resource(name: str, force: bool = False) -> None:
+    """Uploads resources/<name>.c and compiles it with the node's gcc
+    (time.clj compile! :20-39)."""
+    binpath = f"{BIN_DIR}/{name}"
+    if not force and file_exists(binpath):
+        return
+    mkdir(BIN_DIR)
+    control.upload_resource(f"{name}.c", f"{BIN_DIR}/{name}.c")
+    control.exec_("gcc", "-O2", "-o", binpath, f"{BIN_DIR}/{name}.c")
+
+
+def install() -> None:
+    """Installs both clock binaries on the current node (time.clj:52-61)."""
+    for name in SOURCES:
+        compile_resource(name)
+
+
+def stop_ntp() -> None:
+    """Keeps NTP from correcting our skew (time.clj clock-nemesis setup)."""
+    for svc in ("ntp", "ntpd", "chrony", "chronyd",
+                "systemd-timesyncd"):
+        try:
+            control.exec_("systemctl", "stop", svc)
+        except RemoteError:
+            pass
+
+
+def reset_time() -> None:
+    """Resyncs this node's clock (ntpdate or systemd restart,
+    time.clj:80-84)."""
+    for cmd in (("ntpdate", "-p", "1", "-b", "pool.ntp.org"),
+                ("chronyc", "-a", "makestep"),
+                ("systemctl", "restart", "systemd-timesyncd")):
+        try:
+            control.exec_(*cmd)
+            return
+        except RemoteError:
+            continue
+    logger.warning("no working clock-resync mechanism on %s",
+                   control.current_host())
+
+
+def bump_time(delta_ms: int) -> None:
+    """(time.clj:86-90)"""
+    control.exec_(f"{BIN_DIR}/bump-time", str(int(delta_ms)))
+
+
+def strobe_time(delta_ms: int, period_ms: int, duration_s: int) -> None:
+    """(time.clj:92-96)"""
+    control.exec_(f"{BIN_DIR}/strobe-time", str(int(delta_ms)),
+                  str(int(period_ms)), str(int(duration_s)))
+
+
+def current_offset_ms(reference_ms: float) -> float:
+    """Node wall-clock minus control-node reference, in ms."""
+    node_ms = float(control.exec_("date", "+%s%3N").strip())
+    return node_ms - reference_ms
+
+
+def clock_offsets(test: dict, nodes: Iterable[str] | None = None) -> dict:
+    """{node: offset-ms} measured against the control node's clock."""
+    import time as _time
+    nodes = list(nodes or test.get("nodes") or [])
+
+    def one(node):
+        ref = _time.time() * 1000.0
+        try:
+            return node, control.on(node, test, lambda: current_offset_ms(ref))
+        except Exception as e:  # noqa: BLE001 — unmeasurable node (e.g. dummy)
+            logger.debug("clock offset unavailable on %s: %r", node, e)
+            return node, None
+
+    return {n: off for n, off in real_pmap(one, nodes) if off is not None}
+
+
+class ClockNemesis(Nemesis):
+    """Ops (time.clj:98-146):
+      {f: "reset",  value: [nodes...]}
+      {f: "bump",   value: {node: delta-ms}}
+      {f: "strobe", value: {node: {"delta": ms, "period": ms, "duration": s}}}
+      {f: "check-offsets"}
+    Completions embed {"clock-offsets": {...}} for the clock-plot checker.
+    """
+
+    def fs(self):
+        return {"reset", "bump", "strobe", "check-offsets"}
+
+    def setup(self, test):
+        def prep(node):
+            control.on(node, test, lambda: (install(), stop_ntp()))
+        real_pmap(prep, list(test.get("nodes") or []))
+        return self
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "reset":
+            nodes = v or list(test.get("nodes") or [])
+            real_pmap(lambda n: control.on(n, test, reset_time), nodes)
+        elif f == "bump":
+            real_pmap(lambda kv: control.on(
+                kv[0], test, lambda: bump_time(kv[1])), list((v or {}).items()))
+        elif f == "strobe":
+            real_pmap(lambda kv: control.on(
+                kv[0], test, lambda: strobe_time(
+                    kv[1]["delta"], kv[1]["period"], kv[1]["duration"])),
+                list((v or {}).items()))
+        elif f == "check-offsets":
+            pass
+        else:
+            return {**op, "type": "info", "value": ["unknown-f", f]}
+        offsets = clock_offsets(test)
+        return {**op, "type": "info",
+                "value": {"f": f, "arg": v, "clock-offsets": offsets}}
+
+
+def clock_nemesis() -> Nemesis:
+    return ClockNemesis()
+
+
+# ---------------------------------------------------------------------------
+# generators (time.clj:148-205)
+# ---------------------------------------------------------------------------
+
+def reset_gen(test, ctx):
+    nodes = list(test.get("nodes") or [])
+    return {"f": "reset",
+            "value": ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes)))
+            if nodes else []}
+
+
+def bump_gen(test, ctx):
+    """±2^2..2^18 ms exponential deltas on a random node subset
+    (time.clj bump-gen)."""
+    nodes = list(test.get("nodes") or [])
+    subset = ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes))) if nodes else []
+    return {"f": "bump",
+            "value": {n: ctx.rng.choice([-1, 1]) * (2 ** ctx.rng.randint(2, 18))
+                      for n in subset}}
+
+
+def strobe_gen(test, ctx):
+    """Strobe a node subset: delta up to 2^8 ms, period up to ~1s, a few
+    seconds long (time.clj strobe-gen)."""
+    nodes = list(test.get("nodes") or [])
+    subset = ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes))) if nodes else []
+    return {"f": "strobe",
+            "value": {n: {"delta": 2 ** ctx.rng.randint(2, 8),
+                          "period": 2 ** ctx.rng.randint(0, 10),
+                          "duration": ctx.rng.randint(1, 16)}
+                      for n in subset}}
+
+
+def clock_gen():
+    """Mixed reset/bump/strobe stream (time.clj clock-gen)."""
+    from jepsen_tpu import generator as gen
+    return gen.mix([gen.Fn(reset_gen), gen.Fn(bump_gen), gen.Fn(strobe_gen)])

@@ -1,0 +1,49 @@
+/* bump-time: jump the system wall clock by a signed number of
+ * milliseconds, once. Compiled with gcc on each DB node at clock-nemesis
+ * setup (capability-equivalent to the reference's
+ * jepsen/resources/bump-time.c, deployed by nemesis/time.clj:20-39).
+ *
+ * usage: bump-time DELTA_MS
+ * exit:  0 on success; 1 on usage error; 2 if settimeofday fails
+ *        (typically: not root).
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s DELTA_MS\n", argv[0]);
+    return 1;
+  }
+  char *end = NULL;
+  long long delta_ms = strtoll(argv[1], &end, 10);
+  if (end == argv[1] || *end != '\0') {
+    fprintf(stderr, "bad delta: %s\n", argv[1]);
+    return 1;
+  }
+
+  struct timeval tv;
+  if (gettimeofday(&tv, NULL) != 0) {
+    perror("gettimeofday");
+    return 2;
+  }
+
+  long long usec = (long long)tv.tv_usec + delta_ms * 1000LL;
+  long long sec_carry = usec / 1000000LL;
+  usec %= 1000000LL;
+  if (usec < 0) {
+    usec += 1000000LL;
+    sec_carry -= 1;
+  }
+  tv.tv_sec += (time_t)sec_carry;
+  tv.tv_usec = (suseconds_t)usec;
+
+  if (settimeofday(&tv, NULL) != 0) {
+    perror("settimeofday");
+    return 2;
+  }
+  return 0;
+}

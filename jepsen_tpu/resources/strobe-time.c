@@ -1,0 +1,75 @@
+/* strobe-time: oscillate the system wall clock by +/- DELTA_MS every
+ * PERIOD_MS for DURATION_S seconds. Compiled with gcc on each DB node at
+ * clock-nemesis setup (capability-equivalent to the reference's
+ * jepsen/resources/strobe-time.c, deployed by nemesis/time.clj:49).
+ *
+ * usage: strobe-time DELTA_MS PERIOD_MS DURATION_S
+ * exit:  0 on success; 1 on usage error; 2 if settimeofday fails.
+ *
+ * The sleep between flips uses the MONOTONIC clock so the oscillation
+ * rate is unaffected by the wall-clock jumps it is itself causing.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <sys/time.h>
+
+static int bump(long long delta_ms) {
+  struct timeval tv;
+  if (gettimeofday(&tv, NULL) != 0) {
+    perror("gettimeofday");
+    return -1;
+  }
+  long long usec = (long long)tv.tv_usec + delta_ms * 1000LL;
+  long long carry = usec / 1000000LL;
+  usec %= 1000000LL;
+  if (usec < 0) {
+    usec += 1000000LL;
+    carry -= 1;
+  }
+  tv.tv_sec += (time_t)carry;
+  tv.tv_usec = (suseconds_t)usec;
+  if (settimeofday(&tv, NULL) != 0) {
+    perror("settimeofday");
+    return -1;
+  }
+  return 0;
+}
+
+static void sleep_ms_monotonic(long long ms) {
+  struct timespec ts;
+  ts.tv_sec = ms / 1000LL;
+  ts.tv_nsec = (ms % 1000LL) * 1000000LL;
+  while (nanosleep(&ts, &ts) != 0 && errno == EINTR) {
+  }
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s DELTA_MS PERIOD_MS DURATION_S\n", argv[0]);
+    return 1;
+  }
+  long long delta_ms = atoll(argv[1]);
+  long long period_ms = atoll(argv[2]);
+  long long duration_s = atoll(argv[3]);
+  if (period_ms <= 0 || duration_s < 0) {
+    fprintf(stderr, "period must be > 0, duration >= 0\n");
+    return 1;
+  }
+
+  struct timespec start, now;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  int sign = 1;
+  for (;;) {
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    if (now.tv_sec - start.tv_sec >= duration_s) break;
+    if (bump(sign * delta_ms) != 0) return 2;
+    sign = -sign;
+    sleep_ms_monotonic(period_ms);
+  }
+  /* leave the clock roughly where we found it: an even number of flips
+   * cancels out; if we stopped after an odd flip, undo it. */
+  if (sign == -1 && bump(-delta_ms) != 0) return 2;
+  return 0;
+}

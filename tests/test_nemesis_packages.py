@@ -1,0 +1,265 @@
+"""Nemesis-package tests over the dummy remote (reference tier-2 style):
+combined kill/pause/partition/clock packages, clock nemesis command shapes,
+daemon helpers, membership nemesis with an in-memory State, faketime
+script generation, and host-side compilation of the C clock utilities."""
+import random
+import subprocess
+import sys
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control.dummy import DummyRemote
+from jepsen_tpu.db import NoopDB, Pause, Process
+from jepsen_tpu.generator.simulate import default_context
+from jepsen_tpu.nemesis import combined, membership
+from jepsen_tpu.nemesis import time as ntime
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def dummy_test(**over):
+    t = {"nodes": list(NODES), "ssh": {"dummy": True}, "concurrency": 2}
+    t.update(over)
+    return t
+
+
+@pytest.fixture()
+def dummy():
+    t = dummy_test()
+    remote = control.default_remote(t)  # the shared-log dummy transport
+    yield t, remote
+    control.disconnect_all(t)
+
+
+class KillableDB(NoopDB, Process, Pause):
+    def __init__(self):
+        self.events = []
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+
+
+# ---------------------------------------------------------------------------
+# node specs
+# ---------------------------------------------------------------------------
+
+def test_db_nodes_specs():
+    t = dummy_test()
+    db = KillableDB()
+    rng = random.Random(0)
+    assert len(combined.db_nodes(t, db, "one", rng)) == 1
+    assert len(combined.db_nodes(t, db, "minority", rng)) == 2
+    assert len(combined.db_nodes(t, db, "majority", rng)) == 3
+    assert len(combined.db_nodes(t, db, "minority-third", rng)) == 1
+    assert combined.db_nodes(t, db, "all", rng) == NODES
+    assert set(combined.db_nodes(t, db, None, rng)) <= set(NODES)
+    assert combined.db_nodes(t, db, ["n2"], rng) == ["n2"]
+
+
+def test_db_package_kill_pause(dummy):
+    t, remote = dummy
+    db = KillableDB()
+    pkg = combined.db_package({"db": db, "faults": {"kill", "pause"},
+                               "interval": 1.0})
+    assert pkg["perf"]["fs"] == {"start", "kill", "pause", "resume"}
+    n = pkg["nemesis"]
+    out = n.invoke(t, {"type": "info", "f": "kill", "value": "all"})
+    assert out["type"] == "info"
+    assert {e for e, _ in db.events} == {"kill"}
+    assert len(db.events) == 5
+    db.events.clear()
+    n.invoke(t, {"type": "info", "f": "start", "value": None})
+    assert {node for _, node in db.events} == set(NODES)
+
+
+def test_partition_package_applies_grudge(dummy):
+    t, remote = dummy
+
+    class RecordingNet:
+        def __init__(self):
+            self.calls = []
+
+        def drop_all(self, test, grudge):
+            self.calls.append(("drop_all", grudge))
+
+        def heal(self, test):
+            self.calls.append(("heal",))
+
+    net = RecordingNet()
+    t["net"] = net
+    pkg = combined.partition_package({"db": None, "faults": {"partition"}})
+    n = pkg["nemesis"].setup(t)
+    out = n.invoke(t, {"type": "info", "f": "start-partition",
+                       "value": "majority"})
+    assert out["value"][0] == "isolated"
+    grudge = out["value"][1]
+    assert set(grudge) == set(NODES)
+    n.invoke(t, {"type": "info", "f": "stop-partition", "value": None})
+    kinds = [c[0] for c in net.calls]
+    assert kinds == ["heal", "drop_all", "heal"]
+
+
+def test_nemesis_package_composes(dummy):
+    t, _ = dummy
+    db = KillableDB()
+    pkg = combined.nemesis_package({
+        "db": db, "faults": {"kill", "partition"}, "interval": 0.5})
+    assert pkg["nemesis"] is not None
+    assert pkg["generator"] is not None
+    assert pkg["final_generator"] is not None
+    fs = pkg["nemesis"].fs()
+    assert {"kill", "start", "start-partition", "stop-partition"} <= fs
+
+
+def test_clock_nemesis_dummy_commands(dummy):
+    t, remote = dummy
+    n = ntime.clock_nemesis()
+    n.setup(t)
+    joined = " ".join(str(x) for x in remote.log)
+    # dummy remote reports the binaries already present, so setup checks
+    # but does not recompile; a forced compile uploads + runs gcc
+    assert "test -e /opt/jepsen/bump-time" in joined
+    control.on("n1", t, lambda: ntime.compile_resource("bump-time", force=True))
+    joined = " ".join(str(x) for x in remote.log)
+    assert "gcc" in joined and "upload" in joined
+    out = n.invoke(t, {"type": "info", "f": "bump",
+                       "value": {"n1": 4000, "n2": -4000}})
+    joined = " ".join(str(x) for x in remote.log)
+    assert "bump-time" in joined
+    assert out["value"]["f"] == "bump"
+    assert "clock-offsets" in out["value"]
+
+
+def test_clock_gens():
+    ctx = default_context({"concurrency": 2, "nodes": NODES}, seed=3)
+    t = {"nodes": NODES}
+    op = ntime.bump_gen(t, ctx)
+    assert op["f"] == "bump"
+    for node, delta in op["value"].items():
+        assert node in NODES and abs(delta) >= 4
+    op2 = ntime.strobe_gen(t, ctx)
+    for node, spec in op2["value"].items():
+        assert {"delta", "period", "duration"} <= set(spec)
+
+
+def test_c_sources_compile(tmp_path):
+    import shutil
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    for src in ("bump-time", "strobe-time"):
+        out = tmp_path / src
+        r = subprocess.run(["gcc", "-O2", "-o", str(out),
+                            f"jepsen_tpu/resources/{src}.c"],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        usage = subprocess.run([str(out)], capture_output=True, text=True)
+        assert usage.returncode == 1
+        assert "usage" in usage.stderr
+
+
+# ---------------------------------------------------------------------------
+# control.util daemon helpers
+# ---------------------------------------------------------------------------
+
+def test_daemon_helpers_dummy(dummy):
+    t, remote = dummy
+    from jepsen_tpu.control import util as cutil
+
+    def run():
+        cutil.start_daemon({"pidfile": "/run/x.pid", "logfile": "/var/log/x",
+                            "chdir": "/opt"}, "/opt/bin/x", "--flag", 1)
+        cutil.grepkill("myproc")
+        cutil.stop_daemon("/opt/bin/x", "/run/x.pid")
+
+    control.on("n1", t, run)
+    joined = " ".join(str(x) for x in remote.log)
+    assert "setsid nohup" in joined
+    assert "pkill" in joined
+    assert "/run/x.pid" in joined
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+class FakeState(membership.State):
+    """In-memory membership over a set of nodes."""
+
+    def __init__(self, nodes):
+        self.members = set(nodes)
+        self.views = {}
+        self.done = []
+
+    def node_view(self, test, node):
+        return sorted(self.members)
+
+    def merge_views(self, test, views):
+        self.views = views
+        return self
+
+    def fs(self):
+        return {"grow", "shrink"}
+
+    def op(self, test):
+        if len(self.members) > 3:
+            gone = sorted(self.members)[-1]
+            return {"type": "info", "f": "shrink", "value": gone}
+        return "pending"
+
+    def invoke(self, test, op):
+        if op["f"] == "shrink":
+            self.members.discard(op["value"])
+            return ["removed", op["value"]]
+        return ["noop"]
+
+    def resolve_op(self, test, pair):
+        op, value = pair
+        self.done.append(op["f"])
+        return self
+
+    def teardown(self, test):
+        self.done.append("teardown")
+
+
+def test_membership_nemesis(dummy):
+    t, _ = dummy
+    state = FakeState(NODES)
+    pkg = membership.package(state, interval=0.1, poll_interval=0.05)
+    n = pkg["nemesis"].setup(t)
+    import time as _t
+    _t.sleep(0.15)  # let view threads poll
+    gen_fn = membership.membership_gen(n)
+    op = gen_fn(t, default_context({"concurrency": 2}))
+    assert op["f"] == "shrink"
+    out = n.invoke(t, op)
+    assert out["value"][0] == "removed"
+    assert state.views  # views were polled and merged
+    n.teardown(t)
+    assert "teardown" in state.done
+    assert "shrink" in state.done
+
+
+# ---------------------------------------------------------------------------
+# faketime
+# ---------------------------------------------------------------------------
+
+def test_faketime_script():
+    from jepsen_tpu import faketime
+    s = faketime.script("/usr/lib/faketime/libfaketime.so.1", 1.0123)
+    assert "LD_PRELOAD=/usr/lib/faketime/libfaketime.so.1" in s
+    assert "x1.0123" in s
+    assert s.startswith("#!/bin/bash")
+    r = faketime.rand_factor(random.Random(1))
+    assert 0.9 < r < 1.1
