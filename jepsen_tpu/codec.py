@@ -1,0 +1,20 @@
+"""Value <-> bytes codec for histories and wire payloads (reference:
+jepsen/src/jepsen/codec.clj — EDN there, canonical JSON here)."""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def encode(value: Any) -> bytes:
+    """(codec.clj:9-18)"""
+    if value is None:
+        return b""
+    return json.dumps(value, sort_keys=True, separators=(",", ":")).encode()
+
+
+def decode(data: bytes | None) -> Any:
+    """(codec.clj:20-28)"""
+    if data is None or len(data) == 0:
+        return None
+    return json.loads(data.decode())

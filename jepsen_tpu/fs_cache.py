@@ -1,0 +1,134 @@
+"""Control-node persistent cache for expensive artifacts (DB builds,
+downloads), keyed by logical paths.
+
+Reference: jepsen/src/jepsen/fs_cache.clj — strings/EDN/files/remote
+files cached under a base dir; atomic rename writes; per-path locks.
+Values here are strings/JSON/files; deploy pushes a cached file to the
+current remote node.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+from pathlib import Path
+from typing import Any
+
+DEFAULT_DIR = os.path.expanduser("~/.jepsen-tpu/cache")
+
+_locks: dict = {}
+_locks_guard = threading.Lock()
+
+
+def cache_dir() -> Path:
+    return Path(os.environ.get("JEPSEN_CACHE_DIR", DEFAULT_DIR))
+
+
+def _encode_component(c: Any) -> str:
+    s = str(c)
+    return "".join(ch if ch.isalnum() or ch in "-._" else "_" for ch in s)
+
+
+def cache_path(path_key) -> Path:
+    """Logical key (sequence or scalar) -> filesystem path
+    (fs_cache.clj encode)."""
+    if not isinstance(path_key, (list, tuple)):
+        path_key = [path_key]
+    return cache_dir().joinpath(*[_encode_component(c) for c in path_key])
+
+
+def lock(path_key) -> threading.Lock:
+    """A per-key lock (fs_cache.clj locking)."""
+    key = str(cache_path(path_key))
+    with _locks_guard:
+        return _locks.setdefault(key, threading.Lock())
+
+
+def exists(path_key) -> bool:
+    return cache_path(path_key).exists()
+
+
+def _atomic_write(dest: Path, write_fn) -> None:
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(dest.parent), prefix=".cache-tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_string(path_key, s: str) -> None:
+    _atomic_write(cache_path(path_key), lambda f: f.write(s.encode()))
+
+
+def load_string(path_key) -> str | None:
+    p = cache_path(path_key)
+    return p.read_text() if p.exists() else None
+
+
+def save_data(path_key, value: Any) -> None:
+    """JSON value (the reference caches EDN; fs_cache.clj save-edn!)."""
+    _atomic_write(cache_path(path_key),
+                  lambda f: f.write(json.dumps(value).encode()))
+
+
+def load_data(path_key) -> Any:
+    p = cache_path(path_key)
+    return json.loads(p.read_text()) if p.exists() else None
+
+
+def save_file(path_key, local_path) -> Path:
+    """Copies a local file into the cache (atomic)."""
+    dest = cache_path(path_key)
+    with open(local_path, "rb") as src:
+        _atomic_write(dest, lambda f: shutil.copyfileobj(src, f))
+    return dest
+
+
+def file_path(path_key) -> Path | None:
+    p = cache_path(path_key)
+    return p if p.exists() else None
+
+
+def save_remote_file(path_key, remote_path: str) -> Path:
+    """Downloads a file from the current control session's node into the
+    cache (fs_cache.clj save-remote-file!)."""
+    from jepsen_tpu import control
+    dest = cache_path(path_key)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    with tempfile.TemporaryDirectory(dir=str(dest.parent)) as td:
+        local = Path(td) / "download"
+        control.download(remote_path, str(local))
+        if local.exists():
+            os.replace(local, dest)
+    return dest
+
+
+def deploy_remote_file(path_key, remote_path: str) -> bool:
+    """Uploads a cached file to the current session's node; False when the
+    key is absent (fs_cache.clj deploy-remote-file!)."""
+    from jepsen_tpu import control
+    p = file_path(path_key)
+    if p is None:
+        return False
+    control.upload(str(p), remote_path)
+    return True
+
+
+def clear(path_key=None) -> None:
+    if path_key is None:
+        shutil.rmtree(cache_dir(), ignore_errors=True)
+    else:
+        p = cache_path(path_key)
+        if p.is_dir():
+            shutil.rmtree(p, ignore_errors=True)
+        elif p.exists():
+            p.unlink()

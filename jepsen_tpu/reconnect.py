@@ -1,0 +1,125 @@
+"""Auto-reconnecting client-connection wrapper.
+
+Reference: jepsen/src/jepsen/reconnect.clj — wraps a connection in a
+read-write-locked box; any exception inside ``with_conn`` closes and
+reopens the connection (under the write lock) before the exception
+propagates, so the next op gets a fresh conn.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+logger = logging.getLogger("jepsen.reconnect")
+
+
+class _RWLock:
+    """Writer-preferring read-write lock (the reference uses a
+    ReentrantReadWriteLock, reconnect.clj:93-146)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+class Wrapper:
+    """(reconnect.clj:16-32). open() -> conn; close(conn); log (name)."""
+
+    def __init__(self, open: Callable[[], Any],
+                 close: Callable[[Any], None] = lambda c: None,
+                 name: str = "conn"):
+        self._open = open
+        self._close = close
+        self.name = name
+        self._lock = _RWLock()
+        self._conn: Any = None
+        self._opened = False
+
+    def open(self) -> "Wrapper":
+        self._lock.acquire_write()
+        try:
+            if not self._opened:
+                self._conn = self._open()
+                self._opened = True
+        finally:
+            self._lock.release_write()
+        return self
+
+    def conn(self) -> Any:
+        return self._conn
+
+    def reopen(self) -> None:
+        """Closes (best-effort) and reopens (reconnect.clj reopen!)."""
+        self._lock.acquire_write()
+        try:
+            if self._opened:
+                try:
+                    self._close(self._conn)
+                except Exception:  # noqa: BLE001
+                    logger.debug("error closing %s", self.name, exc_info=True)
+            self._conn = self._open()
+            self._opened = True
+        finally:
+            self._lock.release_write()
+
+    def close(self) -> None:
+        self._lock.acquire_write()
+        try:
+            if self._opened:
+                try:
+                    self._close(self._conn)
+                finally:
+                    self._conn = None
+                    self._opened = False
+        finally:
+            self._lock.release_write()
+
+    def with_conn(self, fn: Callable[[Any], Any]) -> Any:
+        """Runs fn(conn) under the read lock; on ANY exception, reopens
+        the conn before rethrowing (reconnect.clj:93-146)."""
+        self._lock.acquire_read()
+        try:
+            return fn(self._conn)
+        except Exception:
+            self._lock.release_read()
+            try:
+                self.reopen()
+            except Exception:  # noqa: BLE001
+                logger.warning("reopen of %s failed", self.name, exc_info=True)
+            self._lock.acquire_read()  # re-acquire so finally releases once
+            raise
+        finally:
+            self._lock.release_read()
+
+
+def wrapper(open: Callable[[], Any], close: Callable[[Any], None] = lambda c: None,
+            name: str = "conn") -> Wrapper:
+    return Wrapper(open, close, name)

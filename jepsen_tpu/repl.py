@@ -1,0 +1,16 @@
+"""Interactive conveniences (reference: jepsen/src/jepsen/repl.clj)."""
+from __future__ import annotations
+
+from jepsen_tpu import store
+
+
+def latest_test(store_dir: str = store.BASE_DIR):
+    """Loads the most recently-run test's results (repl.clj:6)."""
+    latest = store.latest(store_dir)
+    if latest is None:
+        return None
+    name, ts, _path = latest
+    return {
+        "test": store.load_test(name, ts, store_dir),
+        "results": store.load_results(name, ts, store_dir),
+    }

@@ -1,0 +1,18 @@
+"""Report helpers (reference: jepsen/src/jepsen/report.clj — a
+stdout-capturing macro writing a store file)."""
+from __future__ import annotations
+
+import contextlib
+import io
+
+from jepsen_tpu import store
+
+
+@contextlib.contextmanager
+def to(test: dict, filename: str):
+    """Captures stdout within the block and writes it to the test's store
+    dir (report.clj:7)."""
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        yield buf
+    store.path_mk(test, filename).write_text(buf.getvalue())

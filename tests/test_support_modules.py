@@ -1,0 +1,111 @@
+"""Tests for reconnect, fs_cache, codec, report, repl."""
+import threading
+
+import pytest
+
+from jepsen_tpu import codec, fs_cache, reconnect, report, repl, store
+
+
+# ---------------------------------------------------------------------------
+# reconnect
+# ---------------------------------------------------------------------------
+
+def test_reconnect_reopens_on_error():
+    opens = []
+    closes = []
+
+    def open_conn():
+        opens.append(1)
+        return {"id": len(opens), "healthy": len(opens) > 1}
+
+    w = reconnect.wrapper(open_conn, lambda c: closes.append(c["id"]),
+                          name="db")
+    w.open()
+    assert w.conn()["id"] == 1
+
+    def use(conn):
+        if not conn["healthy"]:
+            raise RuntimeError("conn dead")
+        return "ok"
+
+    with pytest.raises(RuntimeError):
+        w.with_conn(use)
+    # broken conn was closed and a fresh one opened
+    assert closes == [1]
+    assert w.conn()["id"] == 2
+    assert w.with_conn(use) == "ok"
+    w.close()
+    assert closes == [1, 2]
+
+
+def test_reconnect_concurrent_reads():
+    w = reconnect.wrapper(lambda: {"v": 0}, name="x")
+    w.open()
+    results = []
+
+    def reader():
+        results.append(w.with_conn(lambda c: c["v"]))
+
+    ts = [threading.Thread(target=reader) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert results == [0] * 8
+
+
+# ---------------------------------------------------------------------------
+# fs_cache
+# ---------------------------------------------------------------------------
+
+def test_fs_cache_roundtrips(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_CACHE_DIR", str(tmp_path / "cache"))
+    key = ["builds", "etcd", "v3.5"]
+    assert not fs_cache.exists(key)
+    fs_cache.save_string(key, "hello")
+    assert fs_cache.exists(key)
+    assert fs_cache.load_string(key) == "hello"
+    fs_cache.save_data(["meta"], {"a": [1, 2]})
+    assert fs_cache.load_data(["meta"]) == {"a": [1, 2]}
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"\x00\x01")
+    p = fs_cache.save_file(["files", "artifact"], src)
+    assert p.read_bytes() == b"\x00\x01"
+    with fs_cache.lock(key):
+        pass
+    fs_cache.clear(key)
+    assert not fs_cache.exists(key)
+    fs_cache.clear()
+    assert fs_cache.load_data(["meta"]) is None
+
+
+def test_fs_cache_encodes_weird_keys(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_CACHE_DIR", str(tmp_path))
+    p = fs_cache.cache_path(["a/b", "c:d e"])
+    assert str(tmp_path) in str(p)
+    assert "/b" not in str(p.relative_to(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# codec / report / repl
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip():
+    for v in (None, 0, "x", [1, {"k": [True, None]}], {"a": 1}):
+        assert codec.decode(codec.encode(v)) == v
+    assert codec.encode(None) == b""
+    assert codec.decode(b"") is None
+
+
+def test_report_and_repl(tmp_path):
+    t = {"name": "rpt", "start_time": "20260729T010101",
+         "store_dir": str(tmp_path)}
+    with report.to(t, "analysis.txt"):
+        print("all good")
+    assert "all good" in (tmp_path / "rpt" / "20260729T010101" /
+                          "analysis.txt").read_text()
+    t["results"] = {"valid?": True}
+    t["history"] = []
+    store.save_1(t)
+    store.save_2(t)
+    out = repl.latest_test(str(tmp_path))
+    assert out is not None
+    assert out["results"]["valid?"] is True
