@@ -66,10 +66,16 @@ class LinearizableChecker(Checker):
         if accelerator == "cpu" or (
             accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
         ):
-            if algorithm == "auto" and len(stream) > 4096:
-                res = check_stream(stream)
-            elif algorithm in ("jitlin", "auto"):
-                res = check_stream(stream)
+            res = None
+            if algorithm in ("jitlin", "auto"):
+                # native C++ search first (same algorithm, ~100x the
+                # Python loop); falls back when unbuilt or >63 slots
+                from jepsen_tpu.native import check_stream_native
+                res = check_stream_native(stream)
+                if res is not None and res.valid == "unknown":
+                    res = None  # capacity blown: retry in Python (bignum)
+                if res is None:
+                    res = check_stream(stream)
             else:
                 res = wgl(history, self.model)
             return self._finish(res, history)

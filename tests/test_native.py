@@ -1,0 +1,104 @@
+"""Native C++ WGL library: build, correctness on the unit cases, and
+differential agreement with the Python search on random histories."""
+import random
+import shutil
+
+import pytest
+
+from jepsen_tpu.checker.linear_cpu import check_stream
+from jepsen_tpu.checker.linear_encode import encode_register_ops
+from jepsen_tpu import native
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++ toolchain")
+
+
+def op(typ, process, f, value=None):
+    return {"type": typ, "process": process, "f": f, "value": value}
+
+
+def test_native_builds():
+    assert native.available()
+
+
+CASES = [
+    ([op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+      op("invoke", 1, "read"), op("ok", 1, "read", 1)], True),
+    ([op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+      op("invoke", 1, "read"), op("ok", 1, "read", 99)], False),
+    ([op("invoke", 0, "write", 1), op("invoke", 1, "read"),
+      op("ok", 1, "read", 1), op("ok", 0, "write", 1)], True),
+    ([op("invoke", 0, "write", 7), op("info", 0, "write", 7),
+      op("invoke", 1, "read"), op("ok", 1, "read", 7)], True),
+    ([op("invoke", 0, "write", 7), op("fail", 0, "write", 7),
+      op("invoke", 1, "read"), op("ok", 1, "read", 7)], False),
+    ([op("invoke", 1, "read"), op("ok", 1, "read", 7),
+      op("invoke", 0, "write", 7), op("ok", 0, "write", 7)], False),
+    ([op("invoke", 0, "cas", [None, 3]), op("ok", 0, "cas", [None, 3]),
+      op("invoke", 1, "read"), op("ok", 1, "read", 3)], True),
+]
+
+
+@pytest.mark.parametrize("history,expected", CASES)
+def test_native_unit_cases(history, expected):
+    res = native.check_stream_native(encode_register_ops(history))
+    assert res is not None
+    assert res.valid is expected
+    if expected is False:
+        assert res.failed_op_index >= 0
+
+
+def random_history(rng, n_ops=60, n_procs=4, valid=True):
+    reg = None
+    history = []
+    pending = {}
+    done = 0
+    while done < n_ops or pending:
+        free = [p for p in range(n_procs) if p not in pending]
+        if done < n_ops and free and (not pending or rng.random() < 0.6):
+            p = rng.choice(free)
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                v = None
+            elif f == "write":
+                v = rng.randrange(5)
+            else:
+                v = [reg if reg is not None and rng.random() < 0.6
+                     else rng.randrange(5), rng.randrange(5)]
+            o = {"type": "invoke", "process": p, "f": f, "value": v}
+            history.append(o)
+            pending[p] = o
+            done += 1
+        else:
+            p = rng.choice(list(pending))
+            inv = pending.pop(p)
+            f, v = inv["f"], inv["value"]
+            if f == "read":
+                out = reg
+                if not valid and rng.random() < 0.15:
+                    out = 99
+                history.append(op("ok", p, f, out))
+            elif f == "write":
+                reg = v
+                history.append(op("ok", p, f, v))
+            else:
+                old, new = v
+                if reg == old:
+                    reg = new
+                    history.append(op("ok", p, f, v))
+                else:
+                    history.append(op("fail", p, f, v))
+    return history
+
+
+def test_native_matches_python_on_random_histories():
+    rng = random.Random(5)
+    for trial in range(40):
+        h = random_history(rng, n_ops=50, valid=(trial % 2 == 0))
+        stream = encode_register_ops(h)
+        py = check_stream(stream)
+        nat = native.check_stream_native(stream)
+        assert nat is not None
+        assert nat.valid == py.valid, f"trial {trial}"
+        if py.valid is False:
+            assert nat.failed_event == py.failed_event
