@@ -14,13 +14,13 @@ from jepsen_tpu import db as db_mod
 from jepsen_tpu.client import Client
 
 
-class AtomDB(db_mod.NoopDB):
-    """An in-memory 'cluster': one locked cell shared by all clients.
-    Records setup/teardown calls per node for lifecycle assertions."""
+class MetaLogDB(db_mod.NoopDB):
+    """Base for in-memory 'clusters': a data lock plus a meta-log of
+    lifecycle calls for assertions. Subclasses override ``_wipe`` to clear
+    their data under the lock on teardown."""
 
     def __init__(self):
         self.lock = threading.Lock()
-        self.value: Any = None
         self.log: list[tuple] = []
         self._log_lock = threading.Lock()
 
@@ -28,13 +28,28 @@ class AtomDB(db_mod.NoopDB):
         with self._log_lock:
             self.log.append(event)
 
+    def _wipe(self):
+        pass
+
     def setup(self, test, node):
         self._note("db-setup", node)
 
     def teardown(self, test, node):
         with self.lock:
-            self.value = None
+            self._wipe()
         self._note("db-teardown", node)
+
+
+class AtomDB(MetaLogDB):
+    """An in-memory 'cluster': one locked cell shared by all clients
+    (tests.clj:27-44 atom-db)."""
+
+    def __init__(self):
+        super().__init__()
+        self.value: Any = None
+
+    def _wipe(self):
+        self.value = None
 
     # register primitives used by AtomClient
     def read(self):
@@ -53,20 +68,31 @@ class AtomDB(db_mod.NoopDB):
             return False
 
 
-class AtomClient(Client):
-    """CAS-register client over an AtomDB (tests.clj atom-client)."""
+class MetaLogClient(Client):
+    """Base for clients over a MetaLogDB: records open/setup/teardown/close
+    in the db's meta-log (tests.clj atom-client lifecycle shape)."""
 
-    def __init__(self, db: AtomDB, node: str | None = None):
+    def __init__(self, db: MetaLogDB, node: str | None = None):
         self.db = db
         self.node = node
 
     def open(self, test, node):
-        c = AtomClient(self.db, node)
+        c = type(self)(self.db, node)
         self.db._note("client-open", node)
         return c
 
     def setup(self, test):
         self.db._note("client-setup", self.node)
+
+    def teardown(self, test):
+        self.db._note("client-teardown", self.node)
+
+    def close(self, test):
+        self.db._note("client-close", self.node)
+
+
+class AtomClient(MetaLogClient):
+    """CAS-register client over an AtomDB (tests.clj atom-client)."""
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
@@ -81,11 +107,69 @@ class AtomClient(Client):
             return {**op, "type": "ok" if ok else "fail"}
         return {**op, "type": "fail", "error": ["unknown-f", f]}
 
-    def teardown(self, test):
-        self.db._note("client-teardown", self.node)
 
-    def close(self, test):
-        self.db._note("client-close", self.node)
+class KVStore(MetaLogDB):
+    """In-memory many-key 'cluster': a dict of CAS registers plus a grow-only
+    set — enough surface for the register (independent-lifted) and set
+    workloads that suites run in --fake mode."""
+
+    def __init__(self):
+        super().__init__()
+        self.registers: dict = {}
+        self.elements: set = set()
+
+    def _wipe(self):
+        self.registers.clear()
+        self.elements.clear()
+
+    def read(self, k):
+        with self.lock:
+            return self.registers.get(k)
+
+    def write(self, k, v):
+        with self.lock:
+            self.registers[k] = v
+
+    def cas(self, k, old, new) -> bool:
+        with self.lock:
+            if self.registers.get(k) == old:
+                self.registers[k] = new
+                return True
+            return False
+
+    def add(self, elem):
+        with self.lock:
+            self.elements.add(elem)
+
+    def set_read(self) -> list:
+        with self.lock:
+            return sorted(self.elements)
+
+
+class KVClient(MetaLogClient):
+    """Client over a KVStore, speaking both the independent-lifted register
+    protocol ([k, v] tuple values, independent.clj:21-29) and the set
+    workload's add/read ops."""
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "add":
+            self.db.add(v)
+            return {**op, "type": "ok"}
+        if f == "read" and v is None:  # whole-set read
+            return {**op, "type": "ok", "value": self.db.set_read()}
+        if f == "read":
+            k, _ = v
+            return {**op, "type": "ok", "value": [k, self.db.read(k)]}
+        if f == "write":
+            k, val = v
+            self.db.write(k, val)
+            return {**op, "type": "ok"}
+        if f == "cas":
+            k, (old, new) = v
+            ok = self.db.cas(k, old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
 
 
 class CrashingClient(Client):

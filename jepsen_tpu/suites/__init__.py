@@ -1,0 +1,79 @@
+"""Per-database test suites (reference layer L8, SURVEY.md §1).
+
+Each suite module exposes a test-map constructor plus a CLI ``main``,
+composing a DB, a client, a nemesis package, and one of the reusable
+workload kits — the shape of e.g.
+jepsen/zookeeper/src/jepsen/zookeeper.clj:105-137 and
+yugabyte/src/yugabyte/core.clj:74-106 (workloads-as-data sweeps).
+
+``compose_test`` is the shared assembly step: client ops ride the
+workload's generator while the nemesis package's generator injects faults
+concurrently, the whole thing time-limited, followed by a healing final
+phase (nemesis final-generator, then the workload's final-generator for
+final reads).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import generator as gen
+
+
+def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
+                 extra_checkers: dict | None = None) -> dict:
+    """Merges a workload kit and a nemesis package into a runnable test map.
+
+    Mirrors the standard suite assembly (zookeeper.clj:105-137): main phase
+    = clients(workload gen) ∥ nemesis(package gen) under the test's
+    time-limit; final phase = package final-generator (heal faults) then
+    workload final-generator (e.g. final reads), on clients only.
+    """
+    test = dict(base)
+    time_limit = float(test.get("time_limit", 60))
+
+    main_gens = [gen.clients(workload["generator"])]
+    if nemesis_pkg and nemesis_pkg.get("generator") is not None:
+        main_gens.append(gen.nemesis_gen(nemesis_pkg["generator"]))
+    phase_list = [gen.time_limit(time_limit, gen.any_gen(*main_gens))]
+
+    if nemesis_pkg and nemesis_pkg.get("final_generator") is not None:
+        phase_list.append(gen.nemesis_gen(nemesis_pkg["final_generator"]))
+    if workload.get("final_generator") is not None:
+        phase_list.append(gen.clients(workload["final_generator"]))
+    test["generator"] = (phase_list[0] if len(phase_list) == 1
+                         else gen.phases(*phase_list))
+
+    checkers = {
+        "stats": chk.stats(),
+        "exceptions": chk.unhandled_exceptions(),
+        "workload": workload["checker"],
+    }
+    if not test.get("no_perf"):
+        # direct submodule import: the package-level `perf` factory name is
+        # shadowed by the jepsen_tpu.checker.perf submodule once imported
+        from jepsen_tpu.checker.perf import perf as perf_checker
+        checkers["perf"] = perf_checker()
+    checkers.update(extra_checkers or {})
+    test["checker"] = chk.compose(checkers)
+
+    if nemesis_pkg and nemesis_pkg.get("nemesis") is not None:
+        test["nemesis"] = nemesis_pkg["nemesis"]
+    return test
+
+
+def workload_registry() -> dict[str, Callable]:
+    """name -> workload-constructor map for sweep runners
+    (yugabyte/core.clj:74-118 pattern)."""
+    from jepsen_tpu.workloads import (adya, append, bank, causal_reverse,
+                                      long_fork, register, set_workload, wr)
+    return {
+        "register": register.workload,
+        "set": set_workload.workload,
+        "bank": bank.workload,
+        "append": append.workload,
+        "wr": wr.workload,
+        "long-fork": long_fork.workload,
+        "causal-reverse": causal_reverse.workload,
+        "adya": adya.workload,
+    }

@@ -1,0 +1,297 @@
+"""etcd test suite — the tutorial workload (reference: doc/tutorial/
+01-scaffolding.md..08, jepsen/src/jepsen/tests/linearizable_register.clj;
+BASELINE config 1: etcd single-register r/w/cas history).
+
+DB automation installs an etcd release tarball on each node (cached on the
+control node, control/util.clj install-archive! pattern), starts it as a
+daemon with a static initial cluster, and wipes data on teardown. The
+client speaks etcd's v2 keys HTTP API with stdlib urllib (the reference
+tutorial's Verschlimmbesserung client is exactly this API), mapping
+network timeouts on writes/cas to indeterminate ``info`` ops.
+
+``--fake`` swaps in the in-memory atom client/DB over the dummy remote
+(tests.clj:27-67 pattern), so the full suite lifecycle runs with no
+cluster — the tier-2 test strategy of SURVEY.md §4.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import compose_test, workload_registry
+
+logger = logging.getLogger("jepsen.etcd")
+
+DEFAULT_VERSION = "3.5.15"
+DIR = "/opt/etcd"
+DATA_DIR = f"{DIR}/data"
+LOG_FILE = f"{DIR}/etcd.log"
+PIDFILE = f"{DIR}/etcd.pid"
+CLIENT_PORT = 2379
+PEER_PORT = 2380
+
+
+def archive_url(version: str) -> str:
+    return (f"https://github.com/etcd-io/etcd/releases/download/"
+            f"v{version}/etcd-v{version}-linux-amd64.tar.gz")
+
+
+def node_url(node: str, port: int) -> str:
+    return f"http://{node}:{port}"
+
+
+def initial_cluster(test: dict) -> str:
+    """node=peer-url pairs (tutorial 02-db.md's initial-cluster string)."""
+    return ",".join(f"{n}={node_url(n, PEER_PORT)}"
+                    for n in test.get("nodes") or [])
+
+
+class EtcdDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
+             db_mod.LogFiles):
+    """etcd lifecycle automation (tutorial 02-db.md; db.clj protocols)."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing etcd %s", node, self.version)
+        cu.install_archive(archive_url(self.version), DIR)
+        self.start(test, node)
+        cu.await_tcp_port(CLIENT_PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)
+        cu.rm_rf(LOG_FILE)
+
+    # db_mod.Process
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/etcd",
+            "--name", node,
+            "--data-dir", DATA_DIR,
+            "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+            "--advertise-client-urls", node_url(node, CLIENT_PORT),
+            "--listen-peer-urls", f"http://0.0.0.0:{PEER_PORT}",
+            "--initial-advertise-peer-urls", node_url(node, PEER_PORT),
+            "--initial-cluster", initial_cluster(test),
+            "--initial-cluster-state", "new",
+            "--enable-v2",
+        )
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/etcd", PIDFILE)
+        cu.grepkill("etcd")
+
+    # db_mod.Pause
+    def pause(self, test, node):
+        cu.grepkill("etcd", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("etcd", sig="CONT")
+
+    # db_mod.Primary — etcd elects its own leader; treat node 1 as the
+    # bootstrap primary for setup purposes (db.clj:141-146 semantics).
+    def primaries(self, test):
+        nodes = test.get("nodes") or []
+        return nodes[:1]
+
+    def setup_primary(self, test, node):
+        pass
+
+    # db_mod.LogFiles
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class EtcdClient(Client):
+    """r/w/cas registers + set adds over etcd's v2 keys API.
+
+    Register ops arrive independent-lifted with ``[k, v]`` tuple values
+    (independent.clj:21-29) — the key names the etcd key, exactly as the
+    reference tutorial's client destructures ``(:value op)``
+    (doc/tutorial/07-parameters.md). Set ops (``add``, whole-set
+    ``read``) map to a key directory. Linearizable reads use
+    ``quorum=true``. Timeouts and connection errors on mutating ops
+    complete as ``info`` (the op may or may not have applied —
+    interpreter.clj:142-157 semantics); reads may safely ``fail``.
+    """
+
+    def __init__(self, prefix: str = "jepsen", timeout_s: float = 5.0,
+                 node: str | None = None):
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return EtcdClient(self.prefix, self.timeout_s, node)
+
+    def _url(self, path: str, **params) -> str:
+        q = f"?{urllib.parse.urlencode(params)}" if params else ""
+        return (f"{node_url(self.node, CLIENT_PORT)}/v2/keys/"
+                f"{urllib.parse.quote(path)}{q}")
+
+    def _request(self, url: str, data: dict | None = None,
+                 method: str = "GET") -> dict:
+        body = urllib.parse.urlencode(data).encode() if data else None
+        req = urllib.request.Request(url, data=body, method=method)
+        if body:
+            req.add_header("Content-Type", "application/x-www-form-urlencoded")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode())
+
+    def _read_register(self, k):
+        try:
+            doc = self._request(self._url(f"{self.prefix}/{k}", quorum="true"))
+            return int(doc["node"]["value"])
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # key not yet written
+                return None
+            raise
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._request(self._url(f"{self.prefix}-set/{v}"),
+                              {"value": str(v)}, method="PUT")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:  # whole-set read
+                try:
+                    doc = self._request(self._url(f"{self.prefix}-set",
+                                                  recursive="true",
+                                                  quorum="true"))
+                    nodes = (doc.get("node") or {}).get("nodes") or []
+                    elems = sorted(int(n["key"].rsplit("/", 1)[-1])
+                                   for n in nodes)
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        raise
+                    elems = []
+                return {**op, "type": "ok", "value": elems}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self._read_register(k)]}
+            if f == "write":
+                k, val = v
+                self._request(self._url(f"{self.prefix}/{k}"),
+                              {"value": str(val)}, method="PUT")
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                try:
+                    self._request(self._url(f"{self.prefix}/{k}",
+                                            prevValue=str(old)),
+                                  {"value": str(new)}, method="PUT")
+                    return {**op, "type": "ok"}
+                except urllib.error.HTTPError as e:
+                    # 412 = compare failed, 404 = key not yet written —
+                    # both definite no-ops (the tutorial client maps
+                    # key-not-found cas to :fail too)
+                    if e.code in (412, 404):
+                        return {**op, "type": "fail"}
+                    raise
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except (TimeoutError, urllib.error.URLError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def etcd_test(opts_dict: dict | None = None) -> dict:
+    """Test-map constructor (the zookeeper.clj:105-137 shape)."""
+    o = dict(opts_dict or {})
+    fake = bool(o.get("fake"))
+    workload_name = o.get("workload", "register")
+    if workload_name not in SUPPORTED_WORKLOADS:
+        raise ValueError(f"etcd suite supports workloads "
+                         f"{SUPPORTED_WORKLOADS}, not {workload_name!r}")
+    ssh = dict(o.get("ssh") or {})
+    if fake:  # fake mode always rides the dummy remote
+        ssh["dummy"] = True
+    base = {
+        "name": f"etcd-{workload_name}",
+        "nodes": o.get("nodes") or ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": o.get("concurrency", 5),
+        "time_limit": o.get("time_limit", 60),
+        "ssh": ssh,
+        "accelerator": o.get("accelerator", "auto"),
+        "store_dir": o.get("store_dir", "store"),
+        "no_perf": o.get("no_perf", False),
+    }
+    if fake:
+        from jepsen_tpu.fakes import KVClient, KVStore
+        from jepsen_tpu.net import NoopNet
+        kv = KVStore()
+        base.update(db=kv, client=KVClient(kv), os=None, net=NoopNet())
+    else:
+        base.update(db=EtcdDB(o.get("version", DEFAULT_VERSION)),
+                    client=EtcdClient(), os=Debian())
+
+    workload = workload_registry()[workload_name](
+        base, accelerator=base["accelerator"])
+
+    nemesis_pkg = None
+    faults = o.get("faults")
+    if faults is None:
+        faults = set() if fake else {"partition"}
+    if faults:
+        nemesis_pkg = combined.nemesis_package({
+            "db": base["db"], "faults": set(faults),
+            "interval": o.get("nemesis_interval", 10.0)})
+    return compose_test(base, workload, nemesis_pkg)
+
+
+def _opt_fn(p) -> None:
+    p.add_argument("--workload", default="register",
+                   choices=list(SUPPORTED_WORKLOADS))
+    p.add_argument("--version", default=DEFAULT_VERSION)
+    p.add_argument("--fake", action="store_true",
+                   help="in-memory client/DB over the dummy remote")
+    p.add_argument("--fault", action="append", dest="faults",
+                   choices=["partition", "kill", "pause", "clock"],
+                   help="fault classes to inject (repeatable)")
+    p.add_argument("--nemesis-interval", type=float, default=10.0)
+    p.add_argument("--no-perf", action="store_true",
+                   help="skip perf plot rendering")
+
+
+def _test_fn(opts) -> dict:
+    base = cli.test_opts_to_test(opts, {})
+    return etcd_test({
+        "nodes": base["nodes"],
+        "concurrency": base["concurrency"],
+        "time_limit": base["time_limit"],
+        "ssh": base["ssh"],
+        "accelerator": base["accelerator"],
+        "store_dir": base["store_dir"],
+        "workload": opts.workload,
+        "version": opts.version,
+        "fake": opts.fake or (base["ssh"] or {}).get("dummy", False),
+        "faults": set(opts.faults) if opts.faults else None,
+        "nemesis_interval": opts.nemesis_interval,
+        "no_perf": opts.no_perf,
+    })
+
+
+main = cli.single_test_cmd(_test_fn, _opt_fn, name="jepsen-etcd")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

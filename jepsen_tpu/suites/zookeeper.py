@@ -1,0 +1,261 @@
+"""ZooKeeper test suite (reference: zookeeper/src/jepsen/zookeeper.clj).
+
+DB automation installs the distro zookeeper package, writes ``zoo.cfg``
+with the full server ensemble plus a per-node ``myid``, and restarts the
+service (zookeeper.clj:43-61). The client does single-znode r/w/cas via
+`kazoo` when available (the reference uses an avout distributed atom —
+same znode-version-CAS semantics); without kazoo installed the suite
+still composes and runs in ``--fake`` mode over the in-memory doubles.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import compose_test, workload_registry
+
+logger = logging.getLogger("jepsen.zookeeper")
+
+CONF = "/etc/zookeeper/conf/zoo.cfg"
+MYID = "/etc/zookeeper/conf/myid"
+LOG = "/var/log/zookeeper/zookeeper.log"
+DATA_DIR = "/var/lib/zookeeper"
+CLIENT_PORT = 2181
+
+
+def zoo_cfg(test: dict) -> str:
+    """The ensemble config (zookeeper.clj:33-41 zoo-cfg)."""
+    lines = [
+        "tickTime=2000",
+        "initLimit=10",
+        "syncLimit=5",
+        f"dataDir={DATA_DIR}",
+        f"clientPort={CLIENT_PORT}",
+    ]
+    for i, node in enumerate(test.get("nodes") or [], start=1):
+        lines.append(f"server.{i}={node}:2888:3888")
+    return "\n".join(lines) + "\n"
+
+
+def node_id(test: dict, node: str) -> int:
+    """1-based id of a node in the ensemble (zookeeper.clj:28-31)."""
+    return (test.get("nodes") or []).index(node) + 1
+
+
+class ZookeeperDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Distro-package zookeeper lifecycle (zookeeper.clj:43-61)."""
+
+    def setup(self, test, node):
+        logger.info("%s: installing zookeeper", node)
+        from jepsen_tpu import os_setup
+        os_setup.install(["zookeeper", "zookeeper-bin", "zookeeperd"])
+        cu.write_file(str(node_id(test, node)), MYID)
+        cu.write_file(zoo_cfg(test), CONF)
+        control.exec_("service", "zookeeper", "restart")
+        cu.await_tcp_port(CLIENT_PORT, host=node)
+
+    def teardown(self, test, node):
+        # cycle() tears down before the first setup (db.clj:121-158), so
+        # tolerate a node where the service was never installed
+        control.exec_(control.lit(
+            "service zookeeper stop >/dev/null 2>&1 || true"))
+        cu.rm_rf(f"{DATA_DIR}/version-2")
+        cu.rm_rf(LOG)
+
+    # db_mod.Process
+    def start(self, test, node):
+        control.exec_("service", "zookeeper", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("zookeeper")
+
+    # db_mod.Pause
+    def pause(self, test, node):
+        cu.grepkill("zookeeper", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("zookeeper", sig="CONT")
+
+    # db_mod.LogFiles
+    def log_files(self, test, node):
+        return [LOG]
+
+
+class ZookeeperClient(Client):
+    """Per-key znode r/w/cas via kazoo, using znode versions for CAS
+    (the semantics the reference gets from an avout atom). Register ops
+    arrive independent-lifted with ``[k, v]`` tuple values
+    (independent.clj:21-29); each key is a child znode. Set adds create
+    child znodes under a set parent; whole-set reads list children."""
+
+    def __init__(self, path: str = "/jepsen", timeout_s: float = 5.0,
+                 node: str | None = None):
+        self.path = path
+        self.timeout_s = timeout_s
+        self.node = node
+        self.zk = None
+
+    def open(self, test, node):
+        try:
+            from kazoo.client import KazooClient
+        except ImportError as e:
+            raise RuntimeError(
+                "kazoo is not installed; run this suite with --fake or "
+                "install kazoo for a real cluster") from e
+        c = ZookeeperClient(self.path, self.timeout_s, node)
+        c.zk = KazooClient(hosts=f"{node}:{CLIENT_PORT}",
+                           timeout=self.timeout_s)
+        c.zk.start(timeout=self.timeout_s)
+        return c
+
+    def setup(self, test):
+        self.zk.ensure_path(self.path)
+        self.zk.ensure_path(f"{self.path}-set")
+
+    def _read(self, k):
+        from kazoo.exceptions import NoNodeError
+        try:
+            data, stat = self.zk.get(f"{self.path}/{k}")
+            return (int(data) if data else None), stat.version
+        except NoNodeError:
+            return None, None
+
+    def invoke(self, test, op):
+        from kazoo.exceptions import (BadVersionError, KazooException,
+                                      NodeExistsError)
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                try:
+                    self.zk.create(f"{self.path}-set/{v}", b"1",
+                                   makepath=True)
+                except NodeExistsError:
+                    pass
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:  # whole-set read
+                elems = sorted(int(c) for c in
+                               self.zk.get_children(f"{self.path}-set"))
+                return {**op, "type": "ok", "value": elems}
+            if f == "read":
+                k, _ = v
+                value, _version = self._read(k)
+                return {**op, "type": "ok", "value": [k, value]}
+            if f == "write":
+                k, val = v
+                znode = f"{self.path}/{k}"
+                if self.zk.exists(znode) is None:
+                    try:
+                        self.zk.create(znode, str(val).encode(), makepath=True)
+                        return {**op, "type": "ok"}
+                    except NodeExistsError:
+                        pass
+                self.zk.set(znode, str(val).encode())
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                current, version = self._read(k)
+                if version is None or current != old:
+                    return {**op, "type": "fail"}
+                try:
+                    self.zk.set(f"{self.path}/{k}", str(new).encode(),
+                                version=version)
+                    return {**op, "type": "ok"}
+                except BadVersionError:
+                    return {**op, "type": "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except KazooException as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["zk", type(e).__name__]}
+
+    def close(self, test):
+        if self.zk is not None:
+            try:
+                self.zk.stop()
+                self.zk.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def zookeeper_test(opts_dict: dict | None = None) -> dict:
+    """Test-map constructor (zookeeper.clj:105-137 zk-test)."""
+    o = dict(opts_dict or {})
+    fake = bool(o.get("fake"))
+    workload_name = o.get("workload", "register")
+    if workload_name not in SUPPORTED_WORKLOADS:
+        raise ValueError(f"zookeeper suite supports workloads "
+                         f"{SUPPORTED_WORKLOADS}, not {workload_name!r}")
+    ssh = dict(o.get("ssh") or {})
+    if fake:  # fake mode always rides the dummy remote
+        ssh["dummy"] = True
+    base = {
+        "name": f"zookeeper-{workload_name}",
+        "nodes": o.get("nodes") or ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": o.get("concurrency", 5),
+        "time_limit": o.get("time_limit", 60),
+        "ssh": ssh,
+        "accelerator": o.get("accelerator", "auto"),
+        "store_dir": o.get("store_dir", "store"),
+        "no_perf": o.get("no_perf", False),
+    }
+    if fake:
+        from jepsen_tpu.fakes import KVClient, KVStore
+        from jepsen_tpu.net import NoopNet
+        kv = KVStore()
+        base.update(db=kv, client=KVClient(kv), os=None, net=NoopNet())
+    else:
+        base.update(db=ZookeeperDB(), client=ZookeeperClient(), os=Debian())
+
+    workload = workload_registry()[workload_name](
+        base, accelerator=base["accelerator"])
+
+    nemesis_pkg = None
+    faults = o.get("faults")
+    if faults is None:
+        faults = set() if fake else {"partition"}
+    if faults:
+        nemesis_pkg = combined.nemesis_package({
+            "db": base["db"], "faults": set(faults),
+            "interval": o.get("nemesis_interval", 10.0)})
+    return compose_test(base, workload, nemesis_pkg)
+
+
+def _opt_fn(p) -> None:
+    p.add_argument("--workload", default="register",
+                   choices=list(SUPPORTED_WORKLOADS))
+    p.add_argument("--fake", action="store_true")
+    p.add_argument("--fault", action="append", dest="faults",
+                   choices=["partition", "kill", "pause", "clock"])
+    p.add_argument("--nemesis-interval", type=float, default=10.0)
+    p.add_argument("--no-perf", action="store_true")
+
+
+def _test_fn(opts) -> dict:
+    base = cli.test_opts_to_test(opts, {})
+    return zookeeper_test({
+        "nodes": base["nodes"],
+        "concurrency": base["concurrency"],
+        "time_limit": base["time_limit"],
+        "ssh": base["ssh"],
+        "accelerator": base["accelerator"],
+        "store_dir": base["store_dir"],
+        "workload": opts.workload,
+        "fake": opts.fake or (base["ssh"] or {}).get("dummy", False),
+        "faults": set(opts.faults) if opts.faults else None,
+        "nemesis_interval": opts.nemesis_interval,
+        "no_perf": opts.no_perf,
+    })
+
+
+main = cli.single_test_cmd(_test_fn, _opt_fn, name="jepsen-zookeeper")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -27,8 +27,10 @@ def reads(final: bool = False):
     def read(test, ctx):
         return {"f": "read", "value": None}
 
+    # final reads: one per thread; the composing suite applies the
+    # clients-only restriction (suites.compose_test owns that wrap)
     if final:
-        return gen.clients(gen.each_thread(gen.once(gen.Fn(read))))
+        return gen.each_thread(gen.once(gen.Fn(read)))
     return gen.Fn(read)
 
 

@@ -1,0 +1,130 @@
+"""L8 suite tests: test-map construction, command shapes over the dummy
+remote, and full fake-mode lifecycle runs (reference: per-suite test stubs
+plus core_test.clj tier-2 strategy, SURVEY.md §4)."""
+import tempfile
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.suites import compose_test, etcd, workload_registry, zookeeper
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+def test_workload_registry_complete():
+    reg = workload_registry()
+    assert {"register", "set", "bank", "append", "wr", "long-fork",
+            "causal-reverse", "adya"} <= set(reg)
+    for name, ctor in reg.items():
+        w = ctor({"concurrency": 4, "nodes": NODES})
+        assert "generator" in w and "checker" in w, name
+
+
+def test_etcd_test_map_shape():
+    t = etcd.etcd_test({"fake": True, "time_limit": 5})
+    assert t["name"] == "etcd-register"
+    assert t["generator"] is not None
+    assert t["checker"] is not None
+    assert t.get("nemesis") is None  # fake mode: no faults by default
+    assert t["ssh"]["dummy"]
+
+    t2 = etcd.etcd_test({"fake": True, "faults": {"partition"}})
+    assert t2["nemesis"] is not None
+    fs = t2["nemesis"].fs()
+    assert "start-partition" in fs and "stop-partition" in fs
+
+
+def test_zookeeper_test_map_shape():
+    t = zookeeper.zookeeper_test({"fake": True, "workload": "set"})
+    assert t["name"] == "zookeeper-set"
+    assert t["generator"] is not None and t["checker"] is not None
+
+
+# ---------------------------------------------------------------------------
+# DB automation command shapes (dummy remote)
+# ---------------------------------------------------------------------------
+
+def test_etcd_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = etcd.EtcdDB()
+    try:
+        control.on("n1", t, lambda: db.start(t, "n1"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--initial-cluster" in joined
+        assert "n1=http://n1:2380" in joined
+        assert "--enable-v2" in joined
+        control.on("n1", t, lambda: db.kill(t, "n1"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "kill" in joined.lower()
+    finally:
+        control.disconnect_all(t)
+
+
+def test_zookeeper_cfg_and_myid():
+    t = {"nodes": NODES}
+    cfg = zookeeper.zoo_cfg(t)
+    assert "server.1=n1:2888:3888" in cfg
+    assert "server.5=n5:2888:3888" in cfg
+    assert "clientPort=2181" in cfg
+    assert zookeeper.node_id(t, "n3") == 3
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycle
+# ---------------------------------------------------------------------------
+
+def run_fake(suite_test_fn, **opts):
+    with tempfile.TemporaryDirectory() as tmp:
+        t = suite_test_fn({"fake": True, "time_limit": 1.0,
+                           "store_dir": tmp, "no_perf": True,
+                           "accelerator": "cpu", **opts})
+        from jepsen_tpu import core
+        return core.run(t)
+
+
+def test_etcd_fake_register_run():
+    result = run_fake(etcd.etcd_test)
+    assert result["results"]["valid?"] is True, result["results"]
+    assert result["results"]["workload"]["valid?"] is True
+    assert len(result["history"]) > 0
+
+
+def test_etcd_fake_set_run():
+    result = run_fake(etcd.etcd_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_zookeeper_fake_register_run():
+    result = run_fake(zookeeper.zookeeper_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_etcd_cli_fake_run():
+    with tempfile.TemporaryDirectory() as tmp:
+        code = etcd.main(["test", "--fake", "--no-ssh", "--time-limit", "1",
+                          "--no-perf", "--accelerator", "cpu",
+                          "--store-dir", tmp])
+        assert code == 0
+
+
+def test_etcd_cli_bad_args():
+    assert etcd.main(["test", "--workload", "nonsense"]) == 254
+
+
+def test_fake_forces_dummy_remote():
+    """--fake without --no-ssh must still ride the dummy remote."""
+    t = etcd.etcd_test({"fake": True,
+                        "ssh": {"dummy": False, "username": "root"}})
+    assert t["ssh"]["dummy"] is True
+    t2 = zookeeper.zookeeper_test({"fake": True,
+                                   "ssh": {"dummy": False}})
+    assert t2["ssh"]["dummy"] is True
