@@ -1,0 +1,134 @@
+"""Filesystem fault injection via CharybdeFS (reference:
+charybdefs/src/jepsen/charybdefs.clj).
+
+Builds scylladb/charybdefs — a FUSE passthrough filesystem that injects
+per-syscall EIO/latency faults — from source on each db node (thrift from
+source first, charybdefs.clj:7-38; then git clone + thrift codegen +
+cmake + make, :40-60), mounts it at ``/faulty`` backed by ``/real``
+(:61-65), and exposes the cookbook fault recipes (:67-85). A DB whose
+data directory lives under /faulty gets filesystem faults injected by
+the ``nemesis()`` below.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import control, nemesis as nem, os_setup
+from jepsen_tpu.control import util as cu
+
+logger = logging.getLogger("jepsen.charybdefs")
+
+THRIFT_DIR = "/opt/thrift"
+# old releases live on archive.apache.org only (the dist mirrors rotate
+# them out)
+THRIFT_URL = ("https://archive.apache.org/dist/thrift/0.10.0/"
+              "thrift-0.10.0.tar.gz")
+DIR = "/opt/charybdefs"
+BIN = f"{DIR}/charybdefs"
+REPO = "https://github.com/scylladb/charybdefs.git"
+MOUNT = "/faulty"
+BACKING = "/real"
+
+THRIFT_DEPS = ["automake", "bison", "flex", "g++", "git",
+               "libboost-all-dev", "libevent-dev", "libssl-dev", "libtool",
+               "make", "pkg-config", "python3-setuptools", "libglib2.0-dev"]
+BUILD_DEPS = ["build-essential", "cmake", "libfuse-dev", "fuse"]
+
+
+def install_thrift() -> None:
+    """Thrift compiler + C++/python libs from source (charybdefs needs
+    matching versions; distros only package the compiler —
+    charybdefs.clj:7-38)."""
+    if cu.file_exists("/usr/bin/thrift"):
+        return
+    with control.su():
+        os_setup.install(THRIFT_DEPS)
+        logger.info("Building thrift (this takes several minutes)")
+        cu.install_archive(THRIFT_URL, THRIFT_DIR)
+        with control.cd(THRIFT_DIR):
+            control.exec_("./configure", "--prefix=/usr")
+            control.exec_("make", "-j4")
+            control.exec_("make", "install")
+        with control.cd(f"{THRIFT_DIR}/lib/py"):
+            control.exec_("python3", "setup.py", "install")
+
+
+def install() -> None:
+    """Ensures charybdefs is built and the faulty fs mounted at /faulty
+    (charybdefs.clj:40-65)."""
+    install_thrift()
+    if not cu.file_exists(BIN):
+        with control.su():
+            os_setup.install(BUILD_DEPS)
+            # a half-finished prior build leaves DIR non-empty, which
+            # would fail the clone forever — start clean for idempotence
+            cu.rm_rf(DIR)
+            control.exec_("mkdir", "-p", DIR)
+            control.exec_("chmod", "777", DIR)
+        control.exec_("git", "clone", "--depth", "1", REPO, DIR)
+        with control.cd(DIR):
+            control.exec_("thrift", "-r", "--gen", "cpp", "server.thrift")
+            control.exec_("cmake", "CMakeLists.txt")
+            control.exec_("make")
+    with control.su():
+        control.exec_("modprobe", "fuse")
+        control.exec_(control.lit(f"umount {MOUNT} || /bin/true"))
+        control.exec_("mkdir", "-p", BACKING, MOUNT)
+        control.exec_(BIN, MOUNT,
+                      f"-oallow_other,modules=subdir,subdir={BACKING}")
+        control.exec_("chmod", "777", BACKING, MOUNT)
+
+
+def _cookbook(flag: str) -> None:
+    with control.cd(f"{DIR}/cookbook"):
+        control.exec_("./recipes", flag)
+
+
+def break_all() -> None:
+    """All filesystem operations fail with EIO (charybdefs.clj:72-75)."""
+    _cookbook("--io-error")
+
+
+def break_one_percent() -> None:
+    """1% of disk operations fail (charybdefs.clj:77-80)."""
+    _cookbook("--probability")
+
+
+def clear() -> None:
+    """Clears a previous fault injection (charybdefs.clj:82-85)."""
+    _cookbook("--clear")
+
+
+class FSFaultNemesis(nem.Nemesis):
+    """Injects filesystem faults on target nodes. Op fs: ``break-fs``
+    (value: node list or None for all; mode 'all' or 'one-percent' via
+    value dict) and ``heal-fs``."""
+
+    def fs(self):
+        return {"break-fs", "heal-fs"}
+
+    def setup(self, test):
+        control.on_nodes(test, lambda n: install())
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        v = op.get("value") or {}
+        nodes = v.get("nodes") or list(test.get("nodes") or [])
+        mode = v.get("mode", "all")
+        if f == "break-fs":
+            fault = break_all if mode == "all" else break_one_percent
+            control.on_nodes(test, lambda n: fault(), nodes=nodes)
+            return {**op, "type": "info",
+                    "value": {"f": "break-fs", "mode": mode, "nodes": nodes}}
+        if f == "heal-fs":
+            control.on_nodes(test, lambda n: clear(), nodes=nodes)
+            return {**op, "type": "info",
+                    "value": {"f": "heal-fs", "nodes": nodes}}
+        return {**op, "type": "info", "error": ["unknown-f", f]}
+
+    def teardown(self, test):
+        try:
+            control.on_nodes(test, lambda n: clear())
+        except Exception:  # noqa: BLE001
+            logger.exception("charybdefs clear failed during teardown")

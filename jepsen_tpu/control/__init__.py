@@ -59,7 +59,8 @@ def default_remote(test: dict) -> Remote:
         return test.setdefault("_dummy_remote", DummyRemote())
     if test.get("remote") is not None:
         return test["remote"]
-    return RetryRemote(SSHRemote())
+    from jepsen_tpu.control.scp import SCPRemote
+    return RetryRemote(SCPRemote(SSHRemote()))
 
 
 @contextlib.contextmanager

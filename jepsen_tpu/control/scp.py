@@ -1,0 +1,126 @@
+"""Sudo-aware file-transfer decorator (reference:
+jepsen/src/jepsen/control/scp.clj).
+
+The base SSH transport already shells out to ``scp`` for raw speed (the
+reference adopted scp because JVM SSH libraries are "orders of magnitude
+slower", scp.clj:1-10); what this wrapper adds is scp.clj's *sudo dance*
+(:29-56, :94-139): when the control session is running under sudo as a
+user other than the login user, uploads land in a world-writable tmp file
+and are chown+mv'd into place as root, and downloads of unreadable files
+are hardlinked/copied to a readable tmp file first.
+"""
+from __future__ import annotations
+
+import os.path
+import random
+
+from jepsen_tpu.control.core import (Remote, RemoteError, Result, join_cmd,
+                                     throw_on_nonzero_exit, wrap_sudo)
+
+TMP_DIR = "/tmp/jepsen/scp"
+
+
+def _coll(x) -> list:
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class SCPRemote(Remote):
+    """Wraps any Remote, adding sudo-aware upload/download semantics."""
+
+    def __init__(self, remote: Remote, conn_spec: dict | None = None):
+        self.remote = remote
+        self.conn_spec = conn_spec or {}
+
+    def connect(self, conn_spec: dict) -> "SCPRemote":
+        return SCPRemote(self.remote.connect(conn_spec), conn_spec)
+
+    def disconnect(self) -> None:
+        self.remote.disconnect()
+
+    def execute(self, ctx: dict, cmd: str) -> Result:
+        return self.remote.execute(ctx, cmd)
+
+    # -- internals ----------------------------------------------------------
+
+    def _exec(self, ctx: dict, args: list) -> Result:
+        """Basic exec for our own purposes (scp.clj:17-27)."""
+        cmd = wrap_sudo(ctx, join_cmd(args))
+        return throw_on_nonzero_exit(self.remote.execute({}, cmd))
+
+    def _ensure_tmp_dir(self) -> None:
+        self._exec({"sudo": "root"}, ["mkdir", "-p", TMP_DIR])
+        self._exec({"sudo": "root"}, ["chmod", "a+rwx", TMP_DIR])
+
+    def _tmp_file(self) -> str:
+        return f"{TMP_DIR}/{random.randint(0, 2**31 - 1)}"
+
+    def _needs_dance(self, ctx: dict) -> bool:
+        """True when the transfer must impersonate another user
+        (scp.clj:94-97: sudo set, and not the login user)."""
+        sudo = ctx.get("sudo")
+        if not sudo:
+            return False
+        owner = "root" if sudo is True else str(sudo)
+        return owner != self.conn_spec.get("username")
+
+    # -- transfers ----------------------------------------------------------
+
+    def upload(self, ctx: dict, local_paths, remote_path) -> None:
+        if not self._needs_dance(ctx):
+            return self.remote.upload(ctx, local_paths, remote_path)
+        sudo = ctx.get("sudo")
+        owner = "root" if sudo is True else str(sudo)
+        srcs = _coll(local_paths)
+        # with several sources (or an explicit directory destination) the
+        # destination is a directory: keep each source's basename, like
+        # the plain-scp passthrough would
+        into_dir = len(srcs) > 1 or str(remote_path).endswith("/")
+        self._ensure_tmp_dir()
+        for src in srcs:
+            dest = (f"{str(remote_path).rstrip('/')}/{os.path.basename(str(src))}"
+                    if into_dir else remote_path)
+            tmp = self._tmp_file()
+            try:
+                self.remote.upload({}, src, tmp)
+                self._exec({"sudo": "root"}, ["chown", owner, tmp])
+                self._exec({"sudo": "root"}, ["mv", tmp, dest])
+            finally:
+                try:
+                    self._exec({"sudo": "root"}, ["rm", "-f", tmp])
+                except RemoteError:
+                    pass
+
+    def download(self, ctx: dict, remote_paths, local_path) -> None:
+        if not self._needs_dance(ctx):
+            return self.remote.download(ctx, remote_paths, local_path)
+        srcs = _coll(remote_paths)
+        into_dir = (len(srcs) > 1 or str(local_path).endswith("/")
+                    or os.path.isdir(str(local_path)))
+        for src in srcs:
+            # readable as the login user? then download directly
+            try:
+                self._exec({}, ["head", "-c", "1", src])
+                self.remote.download({}, src, local_path)
+                continue
+            except RemoteError:
+                pass
+            self._ensure_tmp_dir()
+            tmp = self._tmp_file()
+            try:
+                # hardlink if possible; fall back to a full copy
+                try:
+                    self._exec({"sudo": "root"}, ["ln", "-L", src, tmp])
+                except RemoteError:
+                    self._exec({"sudo": "root"}, ["cp", src, tmp])
+                self._exec({"sudo": "root"}, ["chmod", "a+r", tmp])
+                # the tmp file's random name must not leak into a
+                # directory destination — restore the source basename
+                dest = (f"{str(local_path).rstrip('/')}/"
+                        f"{os.path.basename(str(src))}"
+                        if into_dir else local_path)
+                self.remote.download({}, tmp, dest)
+            finally:
+                try:
+                    self._exec({"sudo": "root"}, ["rm", "-f", tmp])
+                except RemoteError:
+                    pass

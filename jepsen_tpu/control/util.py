@@ -63,9 +63,17 @@ def signal(process: str, sig: str = "TERM") -> None:
 
 def grepkill(pattern: str, sig: str = "KILL") -> None:
     """Kills processes whose command line matches pattern
-    (util.clj:286-308). pkill -f, tolerant of no matches."""
+    (util.clj:286-308). pkill -f, tolerant of no matches.
+
+    The first literal character is bracketed ([e]tcd) so the pattern
+    doesn't match the wrapper shells executing this very command — a
+    bare `pkill -f etcd` SIGSTOPs/KILLs its own sh/sudo ancestors, whose
+    command lines contain the pattern."""
+    i = next((j for j, ch in enumerate(pattern) if ch.isalnum()), None)
+    safe = (f"{pattern[:i]}[{pattern[i]}]{pattern[i + 1:]}"
+            if i is not None else pattern)
     try:
-        control.exec_("pkill", f"-{sig}", "-f", "--", pattern)
+        control.exec_("pkill", f"-{sig}", "-f", "--", safe)
     except RemoteError as e:
         if e.exit_status != 1:  # 1 = no processes matched
             raise
@@ -188,3 +196,20 @@ def await_tcp_port(port: int, host: str = "localhost",
 
     await_fn(check, retry_interval=dt, timeout_s=timeout_s,
              log_message=f"waiting for {host}:{port}")
+
+
+def control_ip(peer: str | None = None) -> str:
+    """The control node's IP as routable from the db nodes (reference:
+    control/net.clj:19-40 control-ip) — used e.g. by the tcpdump DB's
+    clients-only filter. A UDP connect (no packets sent) picks the local
+    address the kernel would route toward ``peer``."""
+    import socket
+    target = peer or "10.255.255.255"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((target, 9))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()

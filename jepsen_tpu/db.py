@@ -10,8 +10,6 @@ from __future__ import annotations
 import logging
 from typing import Iterable
 
-from jepsen_tpu.utils import real_pmap
-
 logger = logging.getLogger("jepsen.db")
 
 CYCLE_TRIES = 3  # db.clj:117-119
@@ -70,10 +68,88 @@ class NoopDB(DB, LogFiles):
     """A database that does nothing (jepsen.db/noop)."""
 
 
+class TcpdumpDB(DB, LogFiles):
+    """Runs a tcpdump capture on each node from setup to teardown, yielding
+    the pcap + daemon log as log files (reference: db.clj:49-115 tcpdump).
+
+    Options: ``ports`` (capture only these ports), ``clients_only`` (only
+    traffic to/from the control node — filters out inter-DB-node chatter),
+    ``filter`` (extra pcap filter string, ANDed in).
+    """
+
+    DIR = "/tmp/jepsen/tcpdump"
+
+    def __init__(self, ports: Iterable[int] = (), clients_only: bool = False,
+                 filter: str | None = None):
+        self.ports = list(ports)
+        self.clients_only = clients_only
+        self.filter = filter
+        self.log_file = f"{self.DIR}/log"
+        self.cap_file = f"{self.DIR}/tcpdump"
+        self.pid_file = f"{self.DIR}/pid"
+
+    def _filter_str(self, node: str) -> str:
+        from jepsen_tpu.control.util import control_ip
+        parts = []
+        if self.ports:
+            # any-of the ports; parenthesized so the 'or' doesn't swallow
+            # the ANDed host/custom clauses below
+            parts.append("(" + " or ".join(f"port {p}"
+                                           for p in self.ports) + ")")
+        if self.clients_only:
+            parts.append(f"host {control_ip(node)}")
+        if self.filter:
+            parts.append(self.filter)
+        return " and ".join(parts)
+
+    def setup(self, test, node):
+        from jepsen_tpu import control
+        from jepsen_tpu.control import util as cu
+        with control.su():
+            control.exec_("mkdir", "-p", self.DIR)
+            # -U: unbuffered — tcpdump doesn't reliably flush on signals,
+            # so don't buffer at all (db.clj:88-93)
+            cu.start_daemon(
+                {"logfile": self.log_file, "pidfile": self.pid_file,
+                 "chdir": self.DIR},
+                "tcpdump", "-w", self.cap_file, "-s", "65535",
+                "-B", "16384", "-U", self._filter_str(node))
+
+    def teardown(self, test, node):
+        import time as _time
+        from jepsen_tpu import control
+        from jepsen_tpu.control import RemoteError, util as cu
+        with control.su():
+            # SIGINT for a clean flush, then wait for exit (db.clj:96-109)
+            try:
+                pid = control.exec_("cat", self.pid_file).strip()
+            except RemoteError:
+                pid = None
+            if pid:
+                try:
+                    control.exec_("kill", "-s", "INT", pid)
+                except RemoteError:
+                    pass
+                deadline = _time.monotonic() + 10
+                while _time.monotonic() < deadline:
+                    try:
+                        control.exec_("ps", "-p", pid)
+                        _time.sleep(0.05)
+                    except RemoteError:
+                        break
+            cu.stop_daemon("tcpdump", self.pid_file)
+            control.exec_("rm", "-rf", self.DIR)
+
+    def log_files(self, test, node):
+        return [self.log_file, self.cap_file]
+
+
 def cycle(test: dict, db: DB) -> None:
-    """teardown! then setup! across all nodes in parallel, retried up to
-    CYCLE_TRIES times on SetupFailed (db.clj:121-158). Suites synchronize
-    between phases via core.synchronize."""
+    """teardown! then setup! across all nodes in parallel (with control
+    sessions bound, as the reference's on-nodes does), retried up to
+    CYCLE_TRIES times on SetupFailed (db.clj:121-158, core.clj with-db).
+    Suites synchronize between phases via core.synchronize."""
+    from jepsen_tpu import control
     nodes: Iterable[str] = test.get("nodes") or []
     for attempt in range(1, CYCLE_TRIES + 1):
         # a failed attempt may leave the setup barrier broken (Python
@@ -83,10 +159,12 @@ def cycle(test: dict, db: DB) -> None:
         if barrier is not None:
             barrier.reset()
         try:
-            real_pmap(lambda n: db.teardown(test, n), list(nodes))
-            real_pmap(lambda n: db.setup(test, n), list(nodes))
+            control.on_nodes(test, lambda n: db.teardown(test, n))
+            control.on_nodes(test, lambda n: db.setup(test, n))
             if isinstance(db, Primary) and nodes:
-                db.setup_primary(test, list(nodes)[0])
+                first = list(nodes)[0]
+                control.on(first, test,
+                           lambda: db.setup_primary(test, first))
             return
         except SetupFailed as e:
             if attempt == CYCLE_TRIES:
@@ -96,4 +174,5 @@ def cycle(test: dict, db: DB) -> None:
 
 
 def teardown_all(test: dict, db: DB) -> None:
-    real_pmap(lambda n: db.teardown(test, n), list(test.get("nodes") or []))
+    from jepsen_tpu import control
+    control.on_nodes(test, lambda n: db.teardown(test, n))

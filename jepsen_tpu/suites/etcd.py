@@ -202,6 +202,15 @@ class EtcdClient(Client):
                         return {**op, "type": "fail"}
                     raise
             return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            # 5xx is expected during faults (raft internal error / leader
+            # election) — indeterminate for mutations, safe fail for reads.
+            # Anything else HTTP-level (unhandled 4xx) is a real bug (wrong
+            # API, misconfiguration) — surface it rather than logging noise.
+            if e.code >= 500:
+                kind = "fail" if f == "read" else "info"
+                return {**op, "type": kind, "error": ["http", e.code]}
+            raise
         except (TimeoutError, urllib.error.URLError, ConnectionError, OSError) as e:
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}

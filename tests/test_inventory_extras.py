@@ -1,0 +1,207 @@
+"""Tests for the tcpdump capture DB, the sudo-aware SCP transfer
+decorator, and the charybdefs filesystem-fault wrapper (reference:
+db.clj:49-115, control/scp.clj, charybdefs/src/jepsen/charybdefs.clj) —
+all command-shape tests over stub/dummy remotes (SURVEY.md §4 tier 2)."""
+import pytest
+
+from jepsen_tpu import charybdefs, control
+from jepsen_tpu.control.core import Remote, RemoteError, Result
+from jepsen_tpu.control.scp import SCPRemote
+from jepsen_tpu.db import TcpdumpDB
+
+NODES = ["n1", "n2", "n3"]
+
+
+def dummy_test(**over):
+    t = {"nodes": list(NODES), "ssh": {"dummy": True}, "concurrency": 2}
+    t.update(over)
+    return t
+
+
+@pytest.fixture()
+def dummy():
+    t = dummy_test()
+    remote = control.default_remote(t)
+    yield t, remote
+    control.disconnect_all(t)
+
+
+# ---------------------------------------------------------------------------
+# tcpdump DB
+# ---------------------------------------------------------------------------
+
+def test_tcpdump_setup_teardown_commands(dummy):
+    t, remote = dummy
+    db = TcpdumpDB(ports=[2379, 2380], filter="host 10.0.0.9")
+    control.on("n1", t, lambda: db.setup(t, "n1"))
+    joined = " ".join(str(x) for x in remote.log)
+    assert "tcpdump" in joined
+    assert "(port 2379 or port 2380)" in joined
+    assert "host 10.0.0.9" in joined
+    assert "-U" in joined  # unbuffered capture (db.clj:88-93)
+    control.on("n1", t, lambda: db.teardown(t, "n1"))
+    joined = " ".join(str(x) for x in remote.log)
+    assert "rm -rf /tmp/jepsen/tcpdump" in joined
+    assert db.log_files(t, "n1") == ["/tmp/jepsen/tcpdump/log",
+                                     "/tmp/jepsen/tcpdump/tcpdump"]
+
+
+def test_tcpdump_clients_only_filter():
+    db = TcpdumpDB(ports=[5432], clients_only=True)
+    f = db._filter_str("n1")
+    assert f.startswith("(port 5432) and host ")
+
+
+# ---------------------------------------------------------------------------
+# SCP decorator
+# ---------------------------------------------------------------------------
+
+class StubRemote(Remote):
+    """Logs transfers; lets tests script per-command failures."""
+
+    def __init__(self, fail_cmds=()):
+        self.calls = []
+        self.fail_cmds = tuple(fail_cmds)
+
+    def connect(self, conn_spec):
+        return self
+
+    def execute(self, ctx, cmd):
+        self.calls.append(("exec", cmd))
+        for frag in self.fail_cmds:
+            if frag in cmd:
+                return Result(cmd=cmd, exit_status=1, out="", err="nope",
+                              host="n1")
+        return Result(cmd=cmd, exit_status=0, out="", err="", host="n1")
+
+    def upload(self, ctx, local_paths, remote_path):
+        self.calls.append(("upload", local_paths, remote_path))
+
+    def download(self, ctx, remote_paths, local_path):
+        self.calls.append(("download", remote_paths, local_path))
+
+
+def test_scp_no_sudo_passthrough():
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "admin"})
+    scp.upload({}, "/local/a", "/remote/a")
+    assert stub.calls == [("upload", "/local/a", "/remote/a")]
+    scp.download({}, "/remote/b", "/local/b")
+    assert stub.calls[-1] == ("download", "/remote/b", "/local/b")
+
+
+def test_scp_sudo_upload_dance():
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "admin"})
+    scp.upload({"sudo": True}, "/local/a", "/etc/secret")
+    kinds = [c[0] for c in stub.calls]
+    # tmp dir prepared, upload to tmp, chown+mv as root, tmp cleaned
+    assert "upload" in kinds
+    up = next(c for c in stub.calls if c[0] == "upload")
+    assert up[2].startswith("/tmp/jepsen/scp/")
+    joined = " ".join(c[1] for c in stub.calls if c[0] == "exec")
+    assert "chown root" in joined
+    assert "mv /tmp/jepsen/scp/" in joined and "/etc/secret" in joined
+
+
+def test_scp_sudo_download_unreadable_copies_via_tmp():
+    # head fails -> must copy via tmp as root
+    stub = StubRemote(fail_cmds=("head",))
+    scp = SCPRemote(stub, {"username": "admin"})
+    scp.download({"sudo": True}, "/var/log/secret.log", "/local/")
+    joined = " ".join(c[1] for c in stub.calls if c[0] == "exec")
+    assert "ln -L /var/log/secret.log" in joined
+    dl = next(c for c in stub.calls if c[0] == "download")
+    assert dl[1].startswith("/tmp/jepsen/scp/")
+
+
+def test_scp_sudo_download_readable_direct():
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "admin"})
+    scp.download({"sudo": True}, "/var/log/ok.log", "/local/")
+    dl = next(c for c in stub.calls if c[0] == "download")
+    assert dl[1] == "/var/log/ok.log"  # direct, no tmp dance
+
+
+def test_scp_same_user_sudo_is_direct():
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "root"})
+    scp.upload({"sudo": "root"}, "/a", "/b")
+    assert stub.calls == [("upload", "/a", "/b")]
+
+
+def test_scp_sudo_true_as_root_login_is_direct():
+    """sudo=True with a root login user needs no impersonation dance."""
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "root"})
+    scp.upload({"sudo": True}, "/a", "/b")
+    assert stub.calls == [("upload", "/a", "/b")]
+
+
+def test_scp_sudo_upload_multi_file_keeps_basenames():
+    stub = StubRemote()
+    scp = SCPRemote(stub, {"username": "admin"})
+    scp.upload({"sudo": True}, ["/l/a.conf", "/l/b.conf"], "/etc/app/")
+    joined = " ".join(c[1] for c in stub.calls
+                      if c[0] == "exec" and "mv " in c[1])
+    assert "/etc/app/a.conf" in joined
+    assert "/etc/app/b.conf" in joined
+
+
+def test_etcd_client_5xx_is_indeterminate():
+    import io
+    import urllib.error
+    from jepsen_tpu.suites.etcd import EtcdClient
+    c = EtcdClient(node="n1")
+
+    def boom(url, data=None, method="GET"):
+        raise urllib.error.HTTPError(url, 500, "election", {}, io.BytesIO(b""))
+
+    c._request = boom
+    out = c.invoke({}, {"f": "write", "value": [1, 2]})
+    assert out["type"] == "info"  # mutation during election: indeterminate
+    out = c.invoke({}, {"f": "read", "value": [1, None]})
+    assert out["type"] == "fail"  # reads fail safely
+
+
+def test_grepkill_brackets_pattern(dummy):
+    """pkill -f must not match the wrapper shells running the command
+    itself — the first alnum char gets bracketed."""
+    from jepsen_tpu.control import util as cu
+    t, remote = dummy
+    control.on("n1", t, lambda: cu.grepkill("etcd", sig="STOP"))
+    joined = " ".join(str(x) for x in remote.log)
+    assert "[e]tcd" in joined
+
+
+# ---------------------------------------------------------------------------
+# charybdefs
+# ---------------------------------------------------------------------------
+
+def test_charybdefs_install_commands(dummy):
+    t, remote = dummy
+    control.on("n1", t, lambda: charybdefs.install())
+    joined = " ".join(str(x) for x in remote.log)
+    # dummy remote reports thrift/charybdefs binaries already present, so
+    # only the mount phase runs
+    assert "modprobe fuse" in joined
+    assert "umount /faulty" in joined
+    assert "subdir=/real" in joined
+
+
+def test_charybdefs_nemesis_ops(dummy):
+    t, remote = dummy
+    n = charybdefs.FSFaultNemesis()
+    assert n.fs() == {"break-fs", "heal-fs"}
+    n.setup(t)
+    out = n.invoke(t, {"type": "info", "f": "break-fs",
+                       "value": {"nodes": ["n2"], "mode": "all"}})
+    assert out["type"] == "info"
+    assert out["value"]["nodes"] == ["n2"]
+    joined = " ".join(str(x) for x in remote.log)
+    assert "--io-error" in joined
+    out = n.invoke(t, {"type": "info", "f": "heal-fs"})
+    assert out["value"]["f"] == "heal-fs"
+    joined = " ".join(str(x) for x in remote.log)
+    assert "--clear" in joined
+    n.teardown(t)
