@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Control-node init: trust the db nodes' host keys, then idle so
+# bin/console can exec in.
+set -u
+mkdir -p ~/.ssh
+for i in $(seq 1 "${JEPSEN_NODE_COUNT:-5}"); do
+  n="n$i"
+  for _ in $(seq 1 30); do
+    if ssh-keyscan -T 2 "$n" >> ~/.ssh/known_hosts 2>/dev/null; then
+      break
+    fi
+    sleep 1
+  done
+done
+echo "control node ready; db nodes: $(seq -s' ' -f 'n%g' 1 "${JEPSEN_NODE_COUNT:-5}")"
+exec sleep infinity

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# DB-node init: set the root password (shared via secret/node.env),
+# allow root SSH logins, start sshd in the foreground.
+set -u
+echo "root:${ROOT_PASS:-jepsenpw}" | chpasswd
+sed -i 's/^#\?PermitRootLogin.*/PermitRootLogin yes/' /etc/ssh/sshd_config
+exec /usr/sbin/sshd -D

@@ -516,17 +516,17 @@ def unbridled_optimism() -> Checker:
 
 
 def latency_graph() -> Checker:
-    from jepsen_tpu.checker.perf import LatencyGraph
+    from jepsen_tpu.checker.perf_plots import LatencyGraph
     return LatencyGraph()
 
 
 def rate_graph() -> Checker:
-    from jepsen_tpu.checker.perf import RateGraph
+    from jepsen_tpu.checker.perf_plots import RateGraph
     return RateGraph()
 
 
 def perf() -> Checker:
-    from jepsen_tpu.checker.perf import perf as _perf
+    from jepsen_tpu.checker.perf_plots import perf as _perf
     return _perf()
 
 

@@ -19,7 +19,7 @@ from jepsen_tpu import store
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.utils import history_to_latencies, nemesis_intervals
 
-logger = logging.getLogger("jepsen.checker.perf")
+logger = logging.getLogger("jepsen.checker.perf_plots")
 
 DEFAULT_QUANTILES = (0.0, 0.5, 0.95, 0.99, 1.0)
 NS = 1e9

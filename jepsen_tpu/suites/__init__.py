@@ -50,10 +50,7 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
         "workload": workload["checker"],
     }
     if not test.get("no_perf"):
-        # direct submodule import: the package-level `perf` factory name is
-        # shadowed by the jepsen_tpu.checker.perf submodule once imported
-        from jepsen_tpu.checker.perf import perf as perf_checker
-        checkers["perf"] = perf_checker()
+        checkers["perf"] = chk.perf()
     checkers.update(extra_checkers or {})
     test["checker"] = chk.compose(checkers)
 

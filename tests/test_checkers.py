@@ -241,7 +241,7 @@ def test_perf_timeline_clock_render(tmp_path):
 
 def test_latencies_to_quantiles():
     import numpy as np
-    from jepsen_tpu.checker.perf import latencies_to_quantiles
+    from jepsen_tpu.checker.perf_plots import latencies_to_quantiles
     times = np.asarray([0.0, 1.0, 2.0, 11.0, 12.0])
     lats = np.asarray([1.0, 2.0, 3.0, 10.0, 20.0])
     q = latencies_to_quantiles(times, lats, dt=10.0, qs=(0.5, 1.0))
@@ -251,7 +251,7 @@ def test_latencies_to_quantiles():
 
 
 def test_nemesis_activity_regions():
-    from jepsen_tpu.checker.perf import nemesis_activity
+    from jepsen_tpu.checker.perf_plots import nemesis_activity
     h = _plot_history()
     regions = nemesis_activity(h)
     assert len(regions) == 1

@@ -62,7 +62,12 @@ def test_store_persistence_round_trip():
         name, ts = result["name"], result["start_time"]
         loaded = store.load_test(name, ts, tmp)
         assert len(loaded["history"]) == len(result["history"])
-        assert loaded["results"]["valid?"] is True
+        # persistence, not validity, is under test: with few ops the stats
+        # checker may legitimately flag an all-fail :cas (no successful
+        # compare-and-set in 50 tries) — what matters is that the stored
+        # verdict round-trips exactly
+        assert loaded["results"]["valid?"] == result["results"]["valid?"]
+        assert loaded["results"]["linear"]["valid?"] is True
         # columnar sidecar exists
         assert (store.test_dir(result) / "history.npz").exists()
         # latest symlink resolves
