@@ -25,8 +25,7 @@ def main() -> None:
     sys.path.insert(0, __file__.rsplit("/", 1)[0])
     from __graft_entry__ import _register_history
     from jepsen_tpu.checker.linear_encode import encode_register_ops, pad_streams
-    from jepsen_tpu.models import cas_register_spec
-    from jepsen_tpu.ops.jitlin import _bucket, _build_step, verdict
+    from jepsen_tpu.ops.jitlin import JitLinKernel, _bucket, verdict
 
     import jax
 
@@ -34,10 +33,10 @@ def main() -> None:
     stream = encode_register_ops(history)
     batch = pad_streams([stream], length=_bucket(len(stream)))
     S = max(1, batch["n_slots"])
-    spec = cas_register_spec()
-    run = jax.jit(_build_step(num_slots=S, capacity=CAPACITY,
-                              step_ids=spec.step_ids,
-                              init_state=spec.init_state))
+    # production kernel selection: the exact dense-table scan when the
+    # 2^S x V configuration space is small, else the capacity-K frontier
+    run = JitLinKernel()._get(S, CAPACITY, batched=False,
+                              num_states=len(stream.intern))
     args = tuple(jax.numpy.asarray(batch[k][0])
                  for k in ("kind", "slot", "f", "a", "b"))
 

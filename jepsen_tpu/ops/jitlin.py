@@ -147,8 +147,122 @@ def _build_step(num_slots: int, capacity: int, step_ids, init_state: int,
     return run
 
 
+def _build_dense_step(num_slots: int, num_states: int, step_ids,
+                      init_state: int):
+    """Exact dense-table variant of the scan.
+
+    When per-key concurrency S and the interned state count V are small —
+    the jepsen.independent regime, where per-key histories are kept short
+    and values few — the *entire* configuration space is only
+    ``2^S masks x V states``. The frontier then lives in a dense boolean
+    table T[2^S, V] instead of a capacity-K list: closure under
+    "linearize any pending op" becomes S batched boolean matmuls
+    ``T[r ^ bit_t] @ M_t`` (per-slot [V, V] transition matrices, bf16 on
+    the MXU with f32 accumulation) OR-reduced into T, iterated to a
+    fixpoint. No sorts, no dedup, and — because the table covers the
+    whole space — no capacity overflow: the verdict is always exact.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    S, V = num_slots, num_states
+    M = 1 << S
+    # row index tables: r ^ bit_t (the donor/receiver row permutation per
+    # slot) and whether bit_t is set in r
+    xor_idx = jnp.asarray(np.arange(M)[None, :] ^ (1 << np.arange(S))[:, None])
+    has_bit = jnp.asarray(
+        ((np.arange(M)[None, :] >> np.arange(S)[:, None]) & 1).astype(bool))
+    v_range = jnp.arange(V, dtype=jnp.int32)
+
+    def slot_matrix(f, a, b):
+        """One slot's [V, V] transition matrix, plus an out-of-range flag:
+        a step_ids whose states aren't dense intern ids would otherwise be
+        silently misencoded — flag it so the verdict degrades to unknown
+        instead of a confidently wrong exact answer."""
+        st2, ok = step_ids(v_range, f, a, b)
+        oob = (ok & ((st2 < 0) | (st2 >= V))).any()
+        mt = ok[:, None] & (st2[:, None] == v_range[None, :])
+        return mt.astype(jnp.bfloat16), oob  # [V, V]
+
+    def closure(table, pend_mask, mt):
+        pend = ((pend_mask >> jnp.arange(S, dtype=jnp.uint32)) & 1).astype(bool)
+        gate = pend[:, None] & has_bit  # [S, M]: rows that may receive via t
+
+        def body(carry):
+            t, _, it = carry
+            donors = t[xor_idx]  # [S, M, V]
+            contrib = jnp.einsum(
+                "smv,svw->smw", donors.astype(jnp.bfloat16), mt,
+                preferred_element_type=jnp.float32) > 0
+            t2 = t | (contrib & gate[:, :, None]).any(axis=0)
+            return t2, (t2 != t).any(), it + 1
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < S)
+
+        table, _, _ = lax.while_loop(
+            cond, body, (table, jnp.bool_(True), jnp.int32(0)))
+        return table
+
+    def step_event(carry, ev):
+        table, mt, pend_mask, alive, died_at, peak, inexact, eidx = carry
+        kind, slot, f, a, b = ev
+        slot_bit = jnp.uint32(1) << slot.astype(jnp.uint32)
+
+        def on_invoke(_):
+            # only this slot's [V, V] transition block changes — the rest
+            # of mt rides the carry untouched
+            m_slot, oob = slot_matrix(f, a, b)
+            return (table, mt.at[slot].set(m_slot), pend_mask | slot_bit,
+                    alive, died_at, peak, inexact | oob, eidx + 1)
+
+        def on_return(_):
+            tc = closure(table, pend_mask, mt)
+            # keep configs that linearized the returning op, clearing its
+            # bit: T'[r] = (s not in r) & Tc[r | bit_s]
+            hasb = has_bit[slot]          # [M]
+            t2 = jnp.where(~hasb[:, None], tc[xor_idx[slot]], False)
+            now_alive = t2.any()
+            new_died = jnp.where(alive & ~now_alive, eidx, died_at)
+            count = jnp.sum(tc.astype(jnp.int32))
+            return (t2, mt, pend_mask & ~slot_bit, alive & now_alive,
+                    new_died, jnp.maximum(peak, count), inexact, eidx + 1)
+
+        def on_noop(_):
+            return (table, mt, pend_mask, alive, died_at, peak, inexact,
+                    eidx + 1)
+
+        return lax.switch(kind, [on_invoke, on_return, on_noop], None), None
+
+    def run(kind, slot, f, a, b):
+        table0 = jnp.zeros((M, V), dtype=bool).at[0, init_state].set(True)
+        carry = (
+            table0,
+            jnp.zeros((S, V, V), jnp.bfloat16),
+            jnp.uint32(0), jnp.bool_(True), jnp.int32(-1), jnp.int32(1),
+            jnp.bool_(False), jnp.int32(0),
+        )
+        events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
+                  f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
+        carry, _ = lax.scan(step_event, carry, events)
+        (_, _, _, alive, died_at, peak, inexact, _) = carry
+        # the table covers the whole config space, so the only inexactness
+        # is a state id escaping the intern range — surfaced on the
+        # overflow channel so verdict() degrades to unknown, not wrong
+        return alive, died_at, inexact, peak
+
+    return run
+
+
+# dense-table applicability bounds: 2^S * V booleans must stay small
+DENSE_MAX_SLOTS = 12
+DENSE_MAX_STATES = 512
+
+
 class JitLinKernel:
-    """Compiled-kernel cache keyed by (S, K, E-bucket, batched?)."""
+    """Compiled-kernel cache keyed by backend + (S, K|V, batched?)."""
 
     def __init__(self, step_ids=None, init_state: int = 0):
         if step_ids is None:
@@ -158,9 +272,21 @@ class JitLinKernel:
         self.init_state = init_state
         self._cache: dict = {}
 
-    def _get(self, S: int, K: int, batched: bool):
+    def _get(self, S: int, K: int, batched: bool, num_states: int | None = None):
+        """Picks the dense exact kernel when the configuration space is
+        small enough, else the capacity-K sort-based frontier."""
         import jax
-        key = (S, K, batched)
+        if (num_states is not None and S <= DENSE_MAX_SLOTS
+                and num_states <= DENSE_MAX_STATES):
+            vb = _bucket(num_states, floor=16)
+            key = ("dense", S, vb, batched)
+            fn = self._cache.get(key)
+            if fn is None:
+                run = _build_dense_step(S, vb, self.step_ids, self.init_state)
+                fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
+                self._cache[key] = fn
+            return fn
+        key = ("sparse", S, K, batched)
         fn = self._cache.get(key)
         if fn is None:
             run = _build_step(S, K, self.step_ids, self.init_state)
@@ -181,9 +307,10 @@ class JitLinKernel:
         return batch_check(streams, capacity=capacity, mesh=mesh, kernel=self)
 
 
-def _bucket(n: int) -> int:
-    """Round event counts up to a power of two >= 64 so jit caches hit."""
-    b = 64
+def _bucket(n: int, floor: int = 64) -> int:
+    """Round counts up to a power of two >= floor so jit caches hit
+    (floor 64 for event lengths, 16 for state counts)."""
+    b = floor
     while b < n:
         b *= 2
     return b

@@ -84,8 +84,17 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
 
     if kernel is None:
         kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
-    batch = pad_streams(list(streams), length=_bucket(max(len(s) for s in streams)))
+    streams = list(streams)
+    batch = pad_streams(streams, length=_bucket(max(len(s) for s in streams)))
     S = max(1, batch["n_slots"])
+    # interned-state count selects the exact dense-table kernel when the
+    # configuration space 2^S x V is small (jitlin._build_dense_step);
+    # every stream must carry an intern table, else a stream with
+    # un-interned ids would be misencoded by the dense table
+    if all(getattr(s, "intern", None) is not None for s in streams):
+        n_states = max(len(s.intern) for s in streams)
+    else:
+        n_states = None
 
     if mesh is None and len(jax.devices()) > 1:
         mesh = get_mesh()
@@ -98,7 +107,7 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
         real_b = batch["kind"].shape[0]
         arrays = [batch["kind"], batch["slot"], batch["f"], batch["a"], batch["b"]]
 
-    fn = kernel._get(S, capacity, batched=True)
+    fn = kernel._get(S, capacity, batched=True, num_states=n_states)
     alive, died, ovf, peak = fn(*arrays)
     alive, died, ovf, peak = map(np.asarray, (alive, died, ovf, peak))
     return [(bool(alive[i]), int(died[i]), bool(ovf[i]), int(peak[i]))

@@ -193,3 +193,31 @@ def test_nemesis_ops_ignored():
     ]
     assert wgl(h, CASRegister()).valid is True
     assert check_stream(encode_register_ops(h)).valid is True
+
+
+def test_dense_and_sparse_kernels_agree():
+    """The exact dense-table kernel (small 2^S x V config spaces) and the
+    capacity-K sort-based frontier must return identical verdicts; the
+    batch path auto-selects dense, so pin each explicitly here."""
+    import jax
+    from jepsen_tpu.ops.jitlin import (JitLinKernel, _bucket, verdict)
+    from jepsen_tpu.checker.linear_encode import pad_streams
+
+    kernel = JitLinKernel()
+    rng = random.Random(13)
+    for trial in range(20):
+        h = gen_history(rng, n_procs=3, n_ops=24, corrupt=trial % 3 == 0)
+        if not h:
+            continue
+        stream = encode_register_ops(h)
+        batch = pad_streams([stream], length=_bucket(len(stream)))
+        S = max(1, batch["n_slots"])
+        args = tuple(batch[k][0] for k in ("kind", "slot", "f", "a", "b"))
+        dense = kernel._get(S, 128, batched=False,
+                            num_states=len(stream.intern))
+        sparse = kernel._get(S, 128, batched=False, num_states=None)
+        da, _, dovf, _ = map(jax.device_get, dense(*args))
+        sa, _, sovf, _ = map(jax.device_get, sparse(*args))
+        assert not bool(dovf)  # dense is exact, never overflows
+        assert verdict(bool(da), bool(dovf)) == verdict(bool(sa), bool(sovf)), \
+            f"trial {trial}: dense={bool(da)} sparse={bool(sa)}\n{h}"
