@@ -117,10 +117,12 @@ class KVStore(MetaLogDB):
         super().__init__()
         self.registers: dict = {}
         self.elements: set = set()
+        self.lists: dict = {}
 
     def _wipe(self):
         self.registers.clear()
         self.elements.clear()
+        self.lists.clear()
 
     def read(self, k):
         with self.lock:
@@ -145,6 +147,21 @@ class KVStore(MetaLogDB):
         with self.lock:
             return sorted(self.elements)
 
+    def txn(self, micro_ops) -> list:
+        """Atomically applies a list-append txn ([f, k, v] micro-ops),
+        filling reads with the current list state."""
+        with self.lock:
+            out = []
+            for f, k, v in micro_ops:
+                if f == "r":
+                    out.append(["r", k, list(self.lists.get(k, []))])
+                elif f == "append":
+                    self.lists.setdefault(k, []).append(v)
+                    out.append(["append", k, v])
+                else:
+                    raise ValueError(f"unknown micro-op {f!r}")
+            return out
+
 
 class KVClient(MetaLogClient):
     """Client over a KVStore, speaking both the independent-lifted register
@@ -153,6 +170,8 @@ class KVClient(MetaLogClient):
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
+        if f == "txn":
+            return {**op, "type": "ok", "value": self.db.txn(v)}
         if f == "add":
             self.db.add(v)
             return {**op, "type": "ok"}

@@ -256,9 +256,22 @@ def _build_dense_step(num_slots: int, num_states: int, step_ids,
     return run
 
 
-# dense-table applicability bounds: 2^S * V booleans must stay small
+# dense-table applicability bounds. Besides the per-axis caps, the closure
+# materializes an [S, 2^S, V] f32 intermediate per batch element, so gate
+# on the product too: S * 2^S * V elements (4 bytes each) must stay under
+# a few MB or a vmapped batch of keys would blow device memory where the
+# sparse kernel needs kilobytes.
 DENSE_MAX_SLOTS = 12
 DENSE_MAX_STATES = 512
+DENSE_MAX_ELEMS = 1 << 21  # 2M elements ≈ 8 MB f32 per batch element
+
+
+def _dense_ok(S: int, num_states: int | None) -> bool:
+    if num_states is None:
+        return False
+    vb = _bucket(num_states, floor=16)
+    return (S <= DENSE_MAX_SLOTS and num_states <= DENSE_MAX_STATES
+            and S * (1 << S) * vb <= DENSE_MAX_ELEMS)
 
 
 class JitLinKernel:
@@ -276,8 +289,7 @@ class JitLinKernel:
         """Picks the dense exact kernel when the configuration space is
         small enough, else the capacity-K sort-based frontier."""
         import jax
-        if (num_states is not None and S <= DENSE_MAX_SLOTS
-                and num_states <= DENSE_MAX_STATES):
+        if _dense_ok(S, num_states):
             vb = _bucket(num_states, floor=16)
             key = ("dense", S, vb, batched)
             fn = self._cache.get(key)

@@ -59,6 +59,117 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
     return test
 
 
+def build_suite_test(o: dict | None, *, db_name: str,
+                     supported_workloads: tuple, make_real: Callable) -> dict:
+    """The standard suite test-map constructor shared by every DB suite.
+
+    ``make_real(o) -> {"db": ..., "client": ..., "os": ...}`` supplies the
+    real-cluster pieces; ``--fake`` swaps in the in-memory KV doubles over
+    the dummy remote (tests.clj:27-67 pattern). Fault classes come from
+    ``o["faults"]`` (default: partition on real clusters, none in fake
+    mode) and are assembled by the combined nemesis packages.
+    """
+    from jepsen_tpu.nemesis import combined
+
+    o = dict(o or {})
+    fake = bool(o.get("fake"))
+    workload_name = o.get("workload", "register")
+    if workload_name not in supported_workloads:
+        raise ValueError(f"{db_name} suite supports workloads "
+                         f"{supported_workloads}, not {workload_name!r}")
+    ssh = dict(o.get("ssh") or {})
+    if fake:  # fake mode always rides the dummy remote
+        ssh["dummy"] = True
+    base = {
+        "name": f"{db_name}-{workload_name}",
+        "nodes": o.get("nodes") or ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": o.get("concurrency", 5),
+        "time_limit": o.get("time_limit", 60),
+        "ssh": ssh,
+        "accelerator": o.get("accelerator", "auto"),
+        "store_dir": o.get("store_dir", "store"),
+        "no_perf": o.get("no_perf", False),
+    }
+    if fake:
+        from jepsen_tpu.fakes import KVClient, KVStore
+        from jepsen_tpu.net import NoopNet
+        kv = KVStore()
+        base.update(db=kv, client=KVClient(kv), os=None, net=NoopNet())
+    else:
+        base.update(make_real(o))
+
+    workload = workload_registry()[workload_name](
+        base, accelerator=base["accelerator"])
+
+    nemesis_pkg = None
+    faults = o.get("faults")
+    if faults is None:
+        faults = set() if fake else {"partition"}
+    if faults:
+        nemesis_pkg = combined.nemesis_package({
+            "db": base["db"], "faults": set(faults),
+            "interval": o.get("nemesis_interval", 10.0)})
+    return compose_test(base, workload, nemesis_pkg)
+
+
+def standard_opt_fn(supported_workloads: tuple,
+                    extra: Callable | None = None) -> Callable:
+    """The shared CLI option set for suites (plus per-suite extras)."""
+    def opt_fn(p):
+        p.add_argument("--workload", default=supported_workloads[0],
+                       choices=list(supported_workloads))
+        p.add_argument("--fake", action="store_true",
+                       help="in-memory client/DB over the dummy remote")
+        p.add_argument("--fault", action="append", dest="faults",
+                       choices=["partition", "kill", "pause", "clock"])
+        p.add_argument("--nemesis-interval", type=float, default=10.0)
+        p.add_argument("--no-perf", action="store_true")
+        if extra:
+            extra(p)
+    return opt_fn
+
+
+def standard_test_fn(suite_test: Callable,
+                     extra_keys: tuple = ()) -> Callable:
+    """Adapts argparse opts into the suite constructor's option dict."""
+    from jepsen_tpu import cli
+
+    def test_fn(opts):
+        base = cli.test_opts_to_test(opts, {})
+        o = {
+            "nodes": base["nodes"],
+            "concurrency": base["concurrency"],
+            "time_limit": base["time_limit"],
+            "ssh": base["ssh"],
+            "accelerator": base["accelerator"],
+            "store_dir": base["store_dir"],
+            "workload": opts.workload,
+            "fake": opts.fake or (base["ssh"] or {}).get("dummy", False),
+            "faults": set(opts.faults) if opts.faults else None,
+            "nemesis_interval": opts.nemesis_interval,
+            "no_perf": opts.no_perf,
+        }
+        for k in extra_keys:
+            o[k] = getattr(opts, k)
+        return suite_test(o)
+    return test_fn
+
+
+def suite_registry() -> dict[str, Callable]:
+    """name -> test-map-constructor for every bundled DB suite (the
+    reference's L8 layer; each also has a CLI ``main``)."""
+    from jepsen_tpu.suites import (consul, etcd, mongodb, postgres, redis,
+                                   zookeeper)
+    return {
+        "etcd": etcd.etcd_test,
+        "zookeeper": zookeeper.zookeeper_test,
+        "consul": consul.consul_test,
+        "redis": redis.redis_test,
+        "postgres": postgres.postgres_test,
+        "mongodb": mongodb.mongodb_test,
+    }
+
+
 def workload_registry() -> dict[str, Callable]:
     """name -> workload-constructor map for sweep runners
     (yugabyte/core.clj:74-118 pattern)."""

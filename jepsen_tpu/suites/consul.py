@@ -1,0 +1,198 @@
+"""Consul test suite (reference: consul/ suite in jaydenwen123/jepsen —
+consul/src/jepsen/system/consul.clj: a 5-node consul server cluster
+tested through its HTTP KV API).
+
+The client speaks Consul's KV HTTP API with stdlib urllib: reads use
+``?consistent`` (linearizable through the raft leader), writes are plain
+PUTs, and compare-and-set uses the ``?cas=<ModifyIndex>`` protocol —
+read the key's ModifyIndex, then PUT conditional on it. Set adds map to
+a key directory listed with ``?keys``.
+
+DB automation installs the consul binary zip on each node and runs
+``consul agent -server -bootstrap-expect N`` with retry-join at the
+first node, the same bring-up the reference automates.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+
+logger = logging.getLogger("jepsen.consul")
+
+DEFAULT_VERSION = "1.18.2"
+DIR = "/opt/consul"
+DATA_DIR = f"{DIR}/data"
+LOG_FILE = f"{DIR}/consul.log"
+PIDFILE = f"{DIR}/consul.pid"
+HTTP_PORT = 8500
+
+
+def archive_url(version: str) -> str:
+    return (f"https://releases.hashicorp.com/consul/{version}/"
+            f"consul_{version}_linux_amd64.zip")
+
+
+class ConsulDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """consul agent -server lifecycle (reference consul.clj start-consul!)."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing consul %s", node, self.version)
+        cu.install_archive(archive_url(self.version), DIR)
+        self.start(test, node)
+        cu.await_tcp_port(HTTP_PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)
+        cu.rm_rf(LOG_FILE)
+
+    def start(self, test, node):
+        nodes = test.get("nodes") or []
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/consul", "agent", "-server",
+            "-node", node,
+            "-data-dir", DATA_DIR,
+            "-bind", "0.0.0.0",
+            "-client", "0.0.0.0",
+            "-bootstrap-expect", str(len(nodes)),
+            "-retry-join", nodes[0] if nodes else node,
+        )
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/consul", PIDFILE)
+        cu.grepkill("consul")
+
+    def pause(self, test, node):
+        cu.grepkill("consul", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("consul", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class ConsulClient(Client):
+    """KV r/w/cas over Consul's HTTP API. Register ops arrive
+    independent-lifted ([k, v] tuples); CAS uses the ModifyIndex
+    ``?cas=`` protocol, so a lost race is a definite ``fail``."""
+
+    def __init__(self, prefix: str = "jepsen", timeout_s: float = 5.0,
+                 node: str | None = None):
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return ConsulClient(self.prefix, self.timeout_s, node)
+
+    def _url(self, path: str, **params) -> str:
+        q = f"?{urllib.parse.urlencode(params)}" if params else ""
+        return f"http://{self.node}:{HTTP_PORT}/v1/kv/{urllib.parse.quote(path)}{q}"
+
+    def _request(self, url: str, body: bytes | None = None,
+                 method: str = "GET"):
+        req = urllib.request.Request(url, data=body, method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode())
+
+    def _read_entry(self, k):
+        """(value, modify_index) or (None, 0) when absent."""
+        try:
+            doc = self._request(self._url(f"{self.prefix}/{k}",
+                                          consistent="true"))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None, 0
+            raise
+        entry = doc[0]
+        raw = base64.b64decode(entry["Value"] or b"").decode()
+        return (int(raw) if raw else None), int(entry["ModifyIndex"])
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._request(self._url(f"{self.prefix}-set/{v}"),
+                              str(v).encode(), method="PUT")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:  # whole-set read
+                try:
+                    keys = self._request(self._url(f"{self.prefix}-set/",
+                                                   keys="true",
+                                                   consistent="true"))
+                    elems = sorted(int(k.rsplit("/", 1)[-1]) for k in keys)
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        raise
+                    elems = []
+                return {**op, "type": "ok", "value": elems}
+            if f == "read":
+                k, _ = v
+                value, _idx = self._read_entry(k)
+                return {**op, "type": "ok", "value": [k, value]}
+            if f == "write":
+                k, val = v
+                self._request(self._url(f"{self.prefix}/{k}"),
+                              str(val).encode(), method="PUT")
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                current, idx = self._read_entry(k)
+                if current != old:
+                    return {**op, "type": "fail"}
+                applied = self._request(self._url(f"{self.prefix}/{k}",
+                                                  cas=str(idx)),
+                                        str(new).encode(), method="PUT")
+                return {**op, "type": "ok" if applied else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            # consul answers 500 "No cluster leader" during faults
+            if e.code >= 500:
+                kind = "fail" if f == "read" else "info"
+                return {**op, "type": kind, "error": ["http", e.code]}
+            raise
+        except (TimeoutError, urllib.error.URLError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def consul_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="consul", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": ConsulDB(o.get("version", DEFAULT_VERSION)),
+                             "client": ConsulClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(consul_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-consul")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

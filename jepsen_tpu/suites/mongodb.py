@@ -1,0 +1,182 @@
+"""MongoDB test suite (reference: the mongodb-*/ suites in
+jaydenwen123/jepsen — replica-set mongod clusters probed with
+majority-write/majority-read registers).
+
+DB automation installs mongod on each node, starts it with a shared
+replica-set name, and initiates the replica set from node 1 with every
+node as a member (the reference's mongodb/core.clj bring-up). The
+client needs pymongo (not bundled): registers are per-key documents
+updated with majority write concern and read with linearizable read
+concern; cas is a conditional find_one_and_update, so a lost race is a
+definite ``fail``. Without pymongo the suite runs with ``--fake``
+in-memory doubles.
+"""
+from __future__ import annotations
+
+import json
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+
+logger = logging.getLogger("jepsen.mongodb")
+
+PORT = 27017
+RS_NAME = "jepsen"
+DIR = "/opt/mongo"
+DATA_DIR = f"{DIR}/data"
+LOG_FILE = f"{DIR}/mongod.log"
+PIDFILE = f"{DIR}/mongod.pid"
+
+
+class MongoDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
+              db_mod.LogFiles):
+    """Replica-set mongod lifecycle (reference mongodb/core.clj)."""
+
+    def setup(self, test, node):
+        logger.info("%s: installing mongod", node)
+        from jepsen_tpu import os_setup
+        os_setup.install(["mongodb-org-server", "mongodb-mongosh"])
+        cu.mkdir(DATA_DIR)
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+        # replica-set initiation barriers on every member being up
+        from jepsen_tpu import core
+        core.synchronize(test)
+        if node == (test.get("nodes") or [node])[0]:
+            members = [{"_id": i, "host": f"{n}:{PORT}"}
+                       for i, n in enumerate(test.get("nodes") or [])]
+            conf = json.dumps({"_id": RS_NAME, "members": members})
+            control.exec_(control.lit(
+                f"mongosh --quiet --eval 'rs.initiate({conf})' "
+                f"|| true"))
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)
+        cu.rm_rf(LOG_FILE)
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            "mongod",
+            "--replSet", RS_NAME,
+            "--dbpath", DATA_DIR,
+            "--port", str(PORT),
+            "--bind_ip_all",
+        )
+
+    def kill(self, test, node):
+        cu.stop_daemon("mongod", PIDFILE)
+        cu.grepkill("mongod")
+
+    def pause(self, test, node):
+        cu.grepkill("mongod", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("mongod", sig="CONT")
+
+    def primaries(self, test):
+        return (test.get("nodes") or [])[:1]
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class MongoClient(Client):
+    """Majority-write / linearizable-read register + set client.
+    Requires pymongo; the suite's --fake mode runs without it."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+        self.client = None
+
+    def open(self, test, node):
+        try:
+            import pymongo
+        except ImportError as e:
+            raise RuntimeError(
+                "pymongo is not installed; run this suite with --fake or "
+                "install pymongo for a real cluster") from e
+        c = MongoClient(self.timeout_s, node)
+        ms = int(self.timeout_s * 1000)
+        c.client = pymongo.MongoClient(
+            host=node, port=PORT, replicaSet=RS_NAME,
+            serverSelectionTimeoutMS=ms, socketTimeoutMS=ms,
+            connectTimeoutMS=ms)
+        return c
+
+    def _coll(self, name="registers"):
+        import pymongo
+        from pymongo.read_concern import ReadConcern
+        from pymongo.write_concern import WriteConcern
+        return self.client.jepsen.get_collection(
+            name,
+            read_concern=ReadConcern("linearizable"),
+            write_concern=WriteConcern("majority"),
+            read_preference=pymongo.ReadPreference.PRIMARY)
+
+    def invoke(self, test, op):
+        import pymongo.errors
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._coll("sets").update_one(
+                    {"_id": v}, {"$set": {"_id": v}}, upsert=True)
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                elems = sorted(d["_id"] for d in self._coll("sets").find())
+                return {**op, "type": "ok", "value": elems}
+            if f == "read":
+                k, _ = v
+                doc = self._coll().find_one({"_id": k})
+                return {**op, "type": "ok",
+                        "value": [k, doc["v"] if doc else None]}
+            if f == "write":
+                k, val = v
+                self._coll().update_one({"_id": k}, {"$set": {"v": val}},
+                                        upsert=True)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                doc = self._coll().find_one_and_update(
+                    {"_id": k, "v": old}, {"$set": {"v": new}})
+                return {**op, "type": "ok" if doc is not None else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except pymongo.errors.PyMongoError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["mongo", type(e).__name__]}
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close()
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def mongodb_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="mongodb",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": MongoDB(), "client": MongoClient(),
+                             "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(mongodb_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-mongodb")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""PostgreSQL test suite (reference: postgres-rds/ and the stolon/
+percona/galera SQL suites in jaydenwen123/jepsen — transactional SQL
+stores probed for serializability anomalies).
+
+The flagship workload is Elle-style **list-append**: each op is one SQL
+transaction of reads (``SELECT elems``) and appends
+(``INSERT ... ON CONFLICT ... SET elems = elems || v``) at the chosen
+isolation level; the cycle checker then hunts G0/G1/G-single/G2
+anomalies in the dependency graph. Register/set workloads map to a
+keyed table with UPDATE-guarded compare-and-set.
+
+The client needs psycopg2 (not bundled); without it the suite still
+composes and runs with ``--fake`` in-memory doubles — including the
+append workload, which the fake store applies atomically, so the Elle
+checker path is exercised end-to-end without a cluster. DB automation
+installs the distro postgresql, opens it to the test network, and
+creates the jepsen database.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+
+logger = logging.getLogger("jepsen.postgres")
+
+PORT = 5432
+DB_NAME = "jepsen"
+DB_USER = "jepsen"
+DB_PASS = "jepsenpw"
+CONF_DIR = "/etc/postgresql"
+LOG = "/var/log/postgresql/postgresql.log"
+
+
+class PostgresDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Single-node-per-host distro postgres (postgres-rds tests managed
+    instances; here each node runs its own server and clients bind to
+    their node, the stolon-without-replication shape)."""
+
+    def setup(self, test, node):
+        logger.info("%s: installing postgresql", node)
+        from jepsen_tpu import os_setup
+        os_setup.install(["postgresql", "postgresql-client"])
+        # listen beyond localhost + trust the test network (test rig only)
+        control.exec_(control.lit(
+            "echo \"listen_addresses = '*'\" >> "
+            "$(ls -d /etc/postgresql/*/main)/conf.d/jepsen.conf 2>/dev/null "
+            "|| echo \"listen_addresses = '*'\" >> "
+            "$(ls -d /etc/postgresql/*/main)/postgresql.conf"))
+        control.exec_(control.lit(
+            "echo 'host all all 0.0.0.0/0 md5' >> "
+            "$(ls -d /etc/postgresql/*/main)/pg_hba.conf"))
+        control.exec_("service", "postgresql", "restart")
+        cu.await_tcp_port(PORT, host=node)
+        control.exec_(control.lit(
+            f"su postgres -c \"psql -c \\\"CREATE USER {DB_USER} WITH "
+            f"PASSWORD '{DB_PASS}'\\\"\" || true"))
+        control.exec_(control.lit(
+            f"su postgres -c \"createdb -O {DB_USER} {DB_NAME}\" || true"))
+
+    def teardown(self, test, node):
+        control.exec_(control.lit(
+            "service postgresql stop >/dev/null 2>&1 || true"))
+        control.exec_(control.lit(
+            f"su postgres -c \"dropdb --if-exists {DB_NAME}\" "
+            ">/dev/null 2>&1 || true"))
+
+    def start(self, test, node):
+        control.exec_("service", "postgresql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("postgres")
+
+    def pause(self, test, node):
+        cu.grepkill("postgres", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("postgres", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG]
+
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS registers (k int PRIMARY KEY, v int);
+CREATE TABLE IF NOT EXISTS sets (elem int PRIMARY KEY);
+CREATE TABLE IF NOT EXISTS lists (k int PRIMARY KEY, elems int[] NOT NULL DEFAULT '{}');
+"""
+
+
+class PostgresClient(Client):
+    """SQL client for register/set/append workloads. Requires psycopg2;
+    the suite's --fake mode runs without it."""
+
+    def __init__(self, isolation: str = "serializable",
+                 timeout_s: float = 5.0, node: str | None = None):
+        self.isolation = isolation
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn = None
+
+    def open(self, test, node):
+        try:
+            import psycopg2
+        except ImportError as e:
+            raise RuntimeError(
+                "psycopg2 is not installed; run this suite with --fake or "
+                "install psycopg2 for a real cluster") from e
+        # every node runs an independent unreplicated server, so all
+        # clients share the first node's instance — otherwise reads on n2
+        # could never see writes on n1 and checkers would flag a healthy
+        # deployment (the postgres-rds single-endpoint shape)
+        primary = (test.get("nodes") or [node])[0]
+        c = PostgresClient(self.isolation, self.timeout_s, node)
+        c.conn = psycopg2.connect(
+            host=primary, port=PORT, dbname=DB_NAME, user=DB_USER,
+            password=DB_PASS, connect_timeout=int(self.timeout_s))
+        c.conn.autocommit = True
+        return c
+
+    def setup(self, test):
+        with self.conn.cursor() as cur:
+            cur.execute(SCHEMA)
+
+    def _txn_body(self, cur, micro_ops):
+        out = []
+        for f, k, v in micro_ops:
+            if f == "r":
+                cur.execute("SELECT elems FROM lists WHERE k = %s", (k,))
+                row = cur.fetchone()
+                out.append(["r", k, list(row[0]) if row else []])
+            elif f == "append":
+                cur.execute(
+                    "INSERT INTO lists (k, elems) VALUES (%s, ARRAY[%s]) "
+                    "ON CONFLICT (k) DO UPDATE "
+                    "SET elems = lists.elems || %s", (k, v, v))
+                out.append(["append", k, v])
+        return out
+
+    def invoke(self, test, op):
+        import psycopg2
+        f, v = op.get("f"), op.get("value")
+        try:
+            with self.conn.cursor() as cur:
+                if f == "txn":
+                    self.conn.autocommit = False
+                    try:
+                        level = self.isolation.upper().replace("-", " ")
+                        cur.execute(f"SET TRANSACTION ISOLATION LEVEL {level}")
+                        out = self._txn_body(cur, v)
+                        self.conn.commit()
+                        return {**op, "type": "ok", "value": out}
+                    except psycopg2.errors.SerializationFailure:
+                        self.conn.rollback()
+                        return {**op, "type": "fail",
+                                "error": ["serialization-failure"]}
+                    except psycopg2.Error:
+                        # any other failure leaves the txn aborted: roll it
+                        # back before restoring autocommit (set_session
+                        # inside an aborted txn raises, masking the cause)
+                        try:
+                            self.conn.rollback()
+                        except psycopg2.Error:
+                            pass
+                        raise
+                    finally:
+                        try:
+                            self.conn.autocommit = True
+                        except psycopg2.Error:
+                            pass
+                if f == "add":
+                    cur.execute("INSERT INTO sets (elem) VALUES (%s) "
+                                "ON CONFLICT DO NOTHING", (v,))
+                    return {**op, "type": "ok"}
+                if f == "read" and v is None:
+                    cur.execute("SELECT elem FROM sets ORDER BY elem")
+                    return {**op, "type": "ok",
+                            "value": [r[0] for r in cur.fetchall()]}
+                if f == "read":
+                    k, _ = v
+                    cur.execute("SELECT v FROM registers WHERE k = %s", (k,))
+                    row = cur.fetchone()
+                    return {**op, "type": "ok",
+                            "value": [k, row[0] if row else None]}
+                if f == "write":
+                    k, val = v
+                    cur.execute(
+                        "INSERT INTO registers (k, v) VALUES (%s, %s) "
+                        "ON CONFLICT (k) DO UPDATE SET v = %s", (k, val, val))
+                    return {**op, "type": "ok"}
+                if f == "cas":
+                    k, (old, new) = v
+                    cur.execute("UPDATE registers SET v = %s "
+                                "WHERE k = %s AND v = %s", (new, k, old))
+                    return {**op, "type": "ok" if cur.rowcount == 1 else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except psycopg2.OperationalError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+SUPPORTED_WORKLOADS = ("append", "register", "set")
+
+
+def postgres_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="postgres",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": PostgresDB(),
+            "client": PostgresClient(o.get("isolation", "serializable")),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(postgres_test, extra_keys=("isolation",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--isolation", default="serializable",
+                        choices=["read-committed", "repeatable-read",
+                                 "serializable"])),
+    name="jepsen-postgres")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

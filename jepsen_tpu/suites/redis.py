@@ -1,0 +1,229 @@
+"""Redis test suite (reference: the redis/raftis/disque family of suites
+in jaydenwen123/jepsen — a primary/replica redis cluster whose classic
+failure mode is lost updates across partitions).
+
+The client speaks RESP (the redis wire protocol) over a plain socket —
+no driver dependency — with a tiny protocol core: arrays of bulk
+strings out, the five reply types in. Registers are per-key strings;
+compare-and-set runs server-side as an atomic Lua EVAL (GET == old →
+SET new), so a lost race is a definite ``fail``. Set adds are SADD into
+one redis set, whole-set reads SMEMBERS.
+
+DB automation installs a redis release tarball (built from source the
+first time, cached thereafter), starts node 1 as the primary and the
+rest as replicas (``--replicaof n1``), and directs all writes at the
+primary — the topology whose partition behavior the original Jepsen
+redis analyses demonstrated.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+
+logger = logging.getLogger("jepsen.redis")
+
+DEFAULT_VERSION = "7.2.5"
+DIR = "/opt/redis"
+LOG_FILE = f"{DIR}/redis.log"
+PIDFILE = f"{DIR}/redis.pid"
+PORT = 6379
+
+CAS_LUA = ("if redis.call('GET', KEYS[1]) == ARGV[1] then "
+           "redis.call('SET', KEYS[1], ARGV[2]) return 1 "
+           "else return 0 end")
+
+
+def archive_url(version: str) -> str:
+    return f"https://download.redis.io/releases/redis-{version}.tar.gz"
+
+
+class RedisDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
+              db_mod.LogFiles):
+    """Primary/replica redis lifecycle; node 1 is the primary."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from jepsen_tpu import control
+        # install_archive wipes its destination, which would delete the
+        # compiled binary — skip the whole unpack+build when it exists so
+        # the from-source build really does happen only once per node
+        if not cu.file_exists(f"{DIR}/src/redis-server"):
+            logger.info("%s: installing redis %s", node, self.version)
+            cu.install_archive(archive_url(self.version), DIR)
+            with control.cd(DIR):
+                control.exec_("make", "-j4")
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/dump.rdb")
+        cu.rm_rf(LOG_FILE)
+
+    def start(self, test, node):
+        primary = (test.get("nodes") or [node])[0]
+        args = ["--port", str(PORT), "--bind", "0.0.0.0",
+                "--protected-mode", "no", "--appendonly", "no",
+                "--save", ""]
+        if node != primary:
+            args += ["--replicaof", primary, str(PORT)]
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/src/redis-server", *args)
+
+    def kill(self, test, node):
+        cu.stop_daemon("redis-server", PIDFILE)
+        cu.grepkill("redis-server")
+
+    def pause(self, test, node):
+        cu.grepkill("redis-server", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("redis-server", sig="CONT")
+
+    def primaries(self, test):
+        return (test.get("nodes") or [])[:1]
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class RespError(Exception):
+    """A redis -ERR reply."""
+
+
+class RespConnection:
+    """A minimal RESP client: commands as arrays of bulk strings, replies
+    parsed by type byte (+ - : $ *)."""
+
+    def __init__(self, host: str, port: int = PORT, timeout_s: float = 5.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.buf = self.sock.makefile("rb")
+
+    def command(self, *args):
+        out = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            data = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(data), data))
+        self.sock.sendall(b"".join(out))
+        return self._reply()
+
+    def _reply(self):
+        line = self.buf.readline()
+        if not line:
+            raise ConnectionError("connection closed")
+        kind, rest = line[:1], line[1:].strip()
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self.buf.read(n + 2)[:-2]
+            return data.decode()
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._reply() for _ in range(n)]
+        raise RespError(f"unknown reply type {kind!r}")
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RedisClient(Client):
+    """r/w/cas registers + set ops over RESP, always against the primary
+    (node 1) — replicas are read-only and redis offers no quorum reads."""
+
+    def __init__(self, prefix: str = "jepsen", timeout_s: float = 5.0,
+                 node: str | None = None):
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: RespConnection | None = None
+
+    def open(self, test, node):
+        primary = (test.get("nodes") or [node])[0]
+        c = RedisClient(self.prefix, self.timeout_s, node)
+        c.conn = RespConnection(primary, timeout_s=self.timeout_s)
+        return c
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self.conn.command("SADD", f"{self.prefix}-set", v)
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:  # whole-set read
+                members = self.conn.command("SMEMBERS", f"{self.prefix}-set")
+                return {**op, "type": "ok",
+                        "value": sorted(int(m) for m in (members or []))}
+            if f == "read":
+                k, _ = v
+                raw = self.conn.command("GET", f"{self.prefix}:{k}")
+                return {**op, "type": "ok",
+                        "value": [k, int(raw) if raw is not None else None]}
+            if f == "write":
+                k, val = v
+                self.conn.command("SET", f"{self.prefix}:{k}", val)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                applied = self.conn.command(
+                    "EVAL", CAS_LUA, 1, f"{self.prefix}:{k}", old, new)
+                return {**op, "type": "ok" if applied == 1 else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except RespError as e:
+            # a definite server-side rejection (e.g. READONLY after a
+            # failover demotes our primary) — the op did not apply
+            return {**op, "type": "fail", "error": ["resp", str(e)]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def redis_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="redis", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": RedisDB(o.get("version", DEFAULT_VERSION)),
+                             "client": RedisClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(redis_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-redis")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

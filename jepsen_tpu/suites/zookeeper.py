@@ -14,9 +14,9 @@ import logging
 from jepsen_tpu import cli, control, db as db_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
-from jepsen_tpu.nemesis import combined
 from jepsen_tpu.os_setup import Debian
-from jepsen_tpu.suites import compose_test, workload_registry
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
 
 logger = logging.getLogger("jepsen.zookeeper")
 
@@ -185,75 +185,17 @@ SUPPORTED_WORKLOADS = ("register", "set")
 
 def zookeeper_test(opts_dict: dict | None = None) -> dict:
     """Test-map constructor (zookeeper.clj:105-137 zk-test)."""
-    o = dict(opts_dict or {})
-    fake = bool(o.get("fake"))
-    workload_name = o.get("workload", "register")
-    if workload_name not in SUPPORTED_WORKLOADS:
-        raise ValueError(f"zookeeper suite supports workloads "
-                         f"{SUPPORTED_WORKLOADS}, not {workload_name!r}")
-    ssh = dict(o.get("ssh") or {})
-    if fake:  # fake mode always rides the dummy remote
-        ssh["dummy"] = True
-    base = {
-        "name": f"zookeeper-{workload_name}",
-        "nodes": o.get("nodes") or ["n1", "n2", "n3", "n4", "n5"],
-        "concurrency": o.get("concurrency", 5),
-        "time_limit": o.get("time_limit", 60),
-        "ssh": ssh,
-        "accelerator": o.get("accelerator", "auto"),
-        "store_dir": o.get("store_dir", "store"),
-        "no_perf": o.get("no_perf", False),
-    }
-    if fake:
-        from jepsen_tpu.fakes import KVClient, KVStore
-        from jepsen_tpu.net import NoopNet
-        kv = KVStore()
-        base.update(db=kv, client=KVClient(kv), os=None, net=NoopNet())
-    else:
-        base.update(db=ZookeeperDB(), client=ZookeeperClient(), os=Debian())
-
-    workload = workload_registry()[workload_name](
-        base, accelerator=base["accelerator"])
-
-    nemesis_pkg = None
-    faults = o.get("faults")
-    if faults is None:
-        faults = set() if fake else {"partition"}
-    if faults:
-        nemesis_pkg = combined.nemesis_package({
-            "db": base["db"], "faults": set(faults),
-            "interval": o.get("nemesis_interval", 10.0)})
-    return compose_test(base, workload, nemesis_pkg)
+    return build_suite_test(
+        opts_dict, db_name="zookeeper",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": ZookeeperDB(),
+                             "client": ZookeeperClient(), "os": Debian()})
 
 
-def _opt_fn(p) -> None:
-    p.add_argument("--workload", default="register",
-                   choices=list(SUPPORTED_WORKLOADS))
-    p.add_argument("--fake", action="store_true")
-    p.add_argument("--fault", action="append", dest="faults",
-                   choices=["partition", "kill", "pause", "clock"])
-    p.add_argument("--nemesis-interval", type=float, default=10.0)
-    p.add_argument("--no-perf", action="store_true")
-
-
-def _test_fn(opts) -> dict:
-    base = cli.test_opts_to_test(opts, {})
-    return zookeeper_test({
-        "nodes": base["nodes"],
-        "concurrency": base["concurrency"],
-        "time_limit": base["time_limit"],
-        "ssh": base["ssh"],
-        "accelerator": base["accelerator"],
-        "store_dir": base["store_dir"],
-        "workload": opts.workload,
-        "fake": opts.fake or (base["ssh"] or {}).get("dummy", False),
-        "faults": set(opts.faults) if opts.faults else None,
-        "nemesis_interval": opts.nemesis_interval,
-        "no_perf": opts.no_perf,
-    })
-
-
-main = cli.single_test_cmd(_test_fn, _opt_fn, name="jepsen-zookeeper")
+main = cli.single_test_cmd(
+    standard_test_fn(zookeeper_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-zookeeper")
 
 
 if __name__ == "__main__":

@@ -6,7 +6,9 @@ import tempfile
 import pytest
 
 from jepsen_tpu import control
-from jepsen_tpu.suites import compose_test, etcd, workload_registry, zookeeper
+from jepsen_tpu.suites import (compose_test, consul, etcd, mongodb, postgres,
+                               redis, suite_registry, workload_registry,
+                               zookeeper)
 
 NODES = ["n1", "n2", "n3", "n4", "n5"]
 
@@ -118,6 +120,110 @@ def test_etcd_cli_fake_run():
 
 def test_etcd_cli_bad_args():
     assert etcd.main(["test", "--workload", "nonsense"]) == 254
+
+
+# ---------------------------------------------------------------------------
+# the wider suite registry
+# ---------------------------------------------------------------------------
+
+def test_suite_registry_constructs_fake_tests():
+    for name, ctor in suite_registry().items():
+        t = ctor({"fake": True, "time_limit": 1})
+        assert t["generator"] is not None, name
+        assert t["checker"] is not None, name
+        assert t["ssh"]["dummy"], name
+
+
+def test_consul_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = consul.ConsulDB()
+    try:
+        control.on("n1", t, lambda: db.start(t, "n1"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "-bootstrap-expect 5" in joined
+        assert "-retry-join n1" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+def test_redis_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = redis.RedisDB()
+    try:
+        control.on("n2", t, lambda: db.start(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--replicaof n1 6379" in joined   # n2 follows the primary
+        control.on("n1", t, lambda: db.start(t, "n1"))
+        primary_cmds = [x for x in remote.log if "redis-server" in str(x)]
+        assert any("--replicaof" not in str(c) for c in primary_cmds)
+        assert db.primaries(t) == ["n1"]
+    finally:
+        control.disconnect_all(t)
+
+
+def test_resp_protocol_roundtrip():
+    """The from-scratch RESP client against a scripted socket server."""
+    import socket
+    import threading
+
+    # canned replies: simple string, integer, bulk, nil bulk, array, error
+    replies = [b"+OK\r\n", b":1\r\n", b"$3\r\n42x\r\n", b"$-1\r\n",
+               b"*2\r\n$1\r\n1\r\n$1\r\n2\r\n", b"-ERR boom\r\n"]
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = []
+
+    def serve():
+        conn, _ = srv.accept()
+        f = conn.makefile("rb")
+        for r in replies:
+            # each command: array header + 2 lines per bulk arg
+            header = f.readline()
+            n = int(header[1:].strip())
+            args = []
+            for _ in range(n):
+                f.readline()
+                args.append(f.readline().strip().decode())
+            received.append(args)
+            conn.sendall(r)
+        conn.close()
+
+    thr = threading.Thread(target=serve, daemon=True)
+    thr.start()
+    c = redis.RespConnection("127.0.0.1", port=port)
+    assert c.command("SET", "k", 1) == "OK"
+    assert c.command("EVAL", redis.CAS_LUA, 1, "k", 0, 1) == 1
+    assert c.command("GET", "k") == "42x"
+    assert c.command("GET", "missing") is None
+    assert c.command("SMEMBERS", "s") == ["1", "2"]
+    with pytest.raises(redis.RespError):
+        c.command("BAD")
+    c.close()
+    thr.join(timeout=5)
+    assert received[0] == ["SET", "k", "1"]
+
+
+def test_postgres_fake_append_run():
+    """The Elle list-append workload end-to-end over the fake txn store."""
+    result = run_fake(postgres.postgres_test, workload="append")
+    assert result["results"]["valid?"] is True, result["results"]
+    txns = [op for op in result["history"]
+            if op.get("f") == "txn" and op["type"] == "ok"]
+    assert txns, "no committed txns"
+
+
+def test_redis_fake_set_run():
+    result = run_fake(redis.redis_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_mongodb_fake_register_run():
+    result = run_fake(mongodb.mongodb_test, workload="register")
+    assert result["results"]["valid?"] is True, result["results"]
 
 
 def test_fake_forces_dummy_remote():
