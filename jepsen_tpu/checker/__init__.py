@@ -220,12 +220,117 @@ class SetFullChecker(Checker):
     read and never seen again), or :never-read. Stale reads (absent after
     known, but present again later) violate linearizability when the
     linearizable option is set. Also reports visibility latency quantiles.
+
+    With accelerator 'auto'/'tpu', the history becomes one dense
+    reads x elements membership matrix and every element's verdict is
+    computed at once on device (jepsen_tpu.ops.setscan, BASELINE
+    config 4); 'cpu' keeps the pure-Python per-element walk as the
+    differential oracle.
     """
 
-    def __init__(self, linearizable: bool = False):
+    def __init__(self, linearizable: bool = False, accelerator: str = "cpu"):
         self.linearizable = linearizable
+        self.accelerator = accelerator
 
     def check(self, test, history, opts):
+        accelerator = opts.get("accelerator", self.accelerator)
+        if accelerator in ("auto", "tpu"):
+            try:
+                return self._check_device(test, history, opts)
+            except Exception:  # noqa: BLE001  device path is an optimization
+                if accelerator == "tpu":
+                    raise
+                logger.exception("set-full device path failed; "
+                                 "falling back to CPU")
+        return self._check_cpu(test, history, opts)
+
+    def _check_device(self, test, history, opts):
+        import numpy as np
+        from jepsen_tpu.history import Intern
+        from jepsen_tpu.ops import setscan
+
+        intern = Intern()
+        invoke_t: list[float] = []
+        ok_t: list[float] = []
+        has_ok: list[bool] = []
+        has_invoke: list[bool] = []
+
+        def el_slot(v):
+            i = intern.id(v) - 1  # id 0 is the None sentinel
+            while len(invoke_t) <= i:
+                invoke_t.append(0.0)
+                ok_t.append(0.0)
+                has_ok.append(False)
+                has_invoke.append(False)
+            return i
+
+        reads: list[tuple[float, set]] = []
+        pending_read_invokes: dict = {}
+        for i, op in enumerate(history):
+            f, typ, v, p = (op.get("f"), op.get("type"), op.get("value"),
+                            op.get("process"))
+            t = float(op.get("time", i))
+            if f == "add":
+                j = el_slot(v)
+                if typ == "invoke" and not has_invoke[j]:
+                    invoke_t[j] = t
+                    has_invoke[j] = True
+                elif typ == "ok":
+                    ok_t[j] = t
+                    has_ok[j] = True
+                    if not has_invoke[j]:  # ok with no invoke (CPU parity)
+                        invoke_t[j] = t
+                        has_invoke[j] = True
+            elif f == "read":
+                if typ == "invoke":
+                    pending_read_invokes[p] = t
+                elif typ == "ok":
+                    t0 = pending_read_invokes.pop(p, t)
+                    reads.append((t0, set(v)))
+        if not reads:
+            return {"valid?": "unknown", "error": "Set was never read"}
+        E = len(invoke_t)
+        reads.sort(key=lambda rv: rv[0])
+        member = np.zeros((len(reads), max(E, 1)), dtype=bool)
+        for r, (_, vs) in enumerate(reads):
+            for v in vs:
+                j = intern.id(v) - 1
+                if 0 <= j < E:
+                    member[r, j] = True
+        code, stale, latency = setscan.classify_elements(
+            member[:, :max(E, 1)],
+            np.array([t for t, _ in reads], dtype=np.float32),
+            np.array(invoke_t, dtype=np.float32),
+            np.array(ok_t, dtype=np.float32),
+            np.array(has_ok, dtype=bool))
+
+        els = [intern.value(j + 1) for j in range(E)]
+        lost = [els[j] for j in range(E) if code[j] == setscan.LOST]
+        never_read = [els[j] for j in range(E)
+                      if code[j] == setscan.NEVER_READ]
+        stale_els = [els[j] for j in range(E) if stale[j]]
+        stable_lat = sorted(float(latency[j]) for j in range(E)
+                            if code[j] == setscan.STABLE)
+        latencies = ({q: quantile(stable_lat, q)
+                      for q in (0.0, 0.5, 0.99, 1.0)} if stable_lat else {})
+        valid = not lost
+        if self.linearizable and stale_els:
+            valid = False
+        return {
+            "valid?": valid,
+            "attempt-count": E,
+            "stable-count": sum(1 for j in range(E)
+                                if code[j] == setscan.STABLE),
+            "lost-count": len(lost),
+            "lost": sorted(lost, key=repr)[:100],
+            "never-read-count": len(never_read),
+            "never-read": sorted(never_read, key=repr)[:100],
+            "stale-count": len(stale_els),
+            "stale": sorted(stale_els, key=repr)[:100],
+            "stable-latencies": latencies,
+        }
+
+    def _check_cpu(self, test, history, opts):
         adds: dict[Any, dict] = {}   # element -> {invoke_time, ok_time}
         reads: list[tuple[int, int, set]] = []  # (invoke_time, index, value-set)
         pending_read_invokes: dict[Any, int] = {}
@@ -487,8 +592,8 @@ def set_checker() -> Checker:
     return SetChecker()
 
 
-def set_full(linearizable: bool = False) -> Checker:
-    return SetFullChecker(linearizable=linearizable)
+def set_full(linearizable: bool = False, accelerator: str = "cpu") -> Checker:
+    return SetFullChecker(linearizable=linearizable, accelerator=accelerator)
 
 
 def queue(model) -> Checker:

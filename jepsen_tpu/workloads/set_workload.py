@@ -35,10 +35,12 @@ def reads(final: bool = False):
 
 
 def workload(test: dict | None = None, full: bool = False,
-             linearizable: bool = False, **_) -> dict:
+             linearizable: bool = False, accelerator: str = "cpu",
+             **_) -> dict:
     return {
         "generator": adds() if full is False else gen.mix([adds(), reads()]),
         "final_generator": reads(final=True),
-        "checker": (chk.set_full(linearizable=linearizable)
+        "checker": (chk.set_full(linearizable=linearizable,
+                                 accelerator=accelerator)
                     if full else chk.set_checker()),
     }

@@ -1,0 +1,90 @@
+"""Differential tests for the set-full membership-matrix device kernel
+(jepsen_tpu.ops.setscan, BASELINE config 4) against the pure-Python
+per-element walk — the CPU-as-oracle strategy (SURVEY.md §4)."""
+import random
+
+from jepsen_tpu import checker as chk
+
+
+def gen_set_history(rng: random.Random, n_adds=60, n_reads=8,
+                    lose=0, stale=0, crash=0):
+    """A set history with optional injected loss (acked adds that never
+    appear) and staleness (elements that vanish from one mid read)."""
+    t = [0]
+
+    def tick():
+        t[0] += 1
+        return t[0]
+
+    history = []
+    acked, lost_els, stale_els, crashed = [], [], [], []
+    for v in range(n_adds):
+        history.append({"type": "invoke", "process": v % 5, "f": "add",
+                        "value": v, "time": tick()})
+        r = rng.random()
+        if crash and len(crashed) < crash and r < 0.15:
+            history.append({"type": "info", "process": v % 5, "f": "add",
+                            "value": v, "time": tick()})
+            crashed.append(v)
+        else:
+            history.append({"type": "ok", "process": v % 5, "f": "add",
+                            "value": v, "time": tick()})
+            if lose and len(lost_els) < lose and r > 0.8:
+                lost_els.append(v)
+            else:
+                acked.append(v)
+                if stale and len(stale_els) < stale and 0.4 < r < 0.6:
+                    stale_els.append(v)
+
+    visible = set(acked) | set(x for x in crashed if rng.random() < 0.5)
+    for i in range(n_reads):
+        t0 = tick()
+        vs = set(visible)
+        if 0 < i < n_reads - 1:
+            # a mid-run read that misses the stale elements
+            vs -= set(stale_els)
+        history.append({"type": "invoke", "process": 7, "f": "read",
+                        "value": None, "time": t0})
+        history.append({"type": "ok", "process": 7, "f": "read",
+                        "value": sorted(vs), "time": tick()})
+    return history, lost_els, stale_els
+
+
+def normalize(r):
+    return {k: r[k] for k in ("valid?", "attempt-count", "stable-count",
+                              "lost-count", "lost", "never-read-count",
+                              "never-read", "stale-count", "stale")}
+
+
+def test_device_matches_cpu_random():
+    rng = random.Random(5)
+    for trial in range(12):
+        h, lost, stale = gen_set_history(
+            rng, n_adds=50, n_reads=6,
+            lose=trial % 3, stale=trial % 2, crash=trial % 4)
+        for linearizable in (False, True):
+            cpu = chk.SetFullChecker(linearizable=linearizable,
+                                     accelerator="cpu").check({}, h, {})
+            dev = chk.SetFullChecker(linearizable=linearizable,
+                                     accelerator="auto").check({}, h, {})
+            assert normalize(cpu) == normalize(dev), (
+                f"trial {trial} linearizable={linearizable}:\n"
+                f"cpu={normalize(cpu)}\ndev={normalize(dev)}")
+            if lost:
+                assert cpu["valid?"] is False
+
+
+def test_device_latency_quantiles_close():
+    rng = random.Random(11)
+    h, _, _ = gen_set_history(rng, n_adds=40, n_reads=5)
+    cpu = chk.SetFullChecker(accelerator="cpu").check({}, h, {})
+    dev = chk.SetFullChecker(accelerator="auto").check({}, h, {})
+    for q, v in cpu["stable-latencies"].items():
+        assert abs(dev["stable-latencies"][q] - v) < 1e-3
+
+
+def test_device_no_reads_unknown():
+    h = [{"type": "invoke", "process": 0, "f": "add", "value": 1, "time": 1},
+         {"type": "ok", "process": 0, "f": "add", "value": 1, "time": 2}]
+    r = chk.SetFullChecker(accelerator="auto").check({}, h, {})
+    assert r["valid?"] == "unknown"
