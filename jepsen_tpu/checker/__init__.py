@@ -444,11 +444,35 @@ def _model_size(model):
     return len(items)
 
 
+def expand_queue_drain_ops(history: list[dict]) -> list[dict]:
+    """Expands ok ``drain`` ops (value = list of drained elements) into
+    synthetic dequeue invoke/ok pairs (checker.clj:594-626)."""
+    out: list[dict] = []
+    for op in history:
+        if op.get("f") != "drain":
+            out.append(op)
+            continue
+        typ = op.get("type")
+        if typ in ("invoke", "fail"):
+            continue
+        if typ == "ok":
+            for element in op.get("value") or []:
+                out.append({**op, "type": "invoke", "f": "dequeue",
+                            "value": None})
+                out.append({**op, "type": "ok", "f": "dequeue",
+                            "value": element})
+        else:
+            raise ValueError(f"crashed drain operation unsupported: {op!r}")
+    return out
+
+
 class TotalQueueChecker(Checker):
     """Multiset queue algebra: what goes in must come out
-    (checker.clj:628-687)."""
+    (checker.clj:628-687). Ok ``drain`` ops are expanded into dequeues
+    first, per the reference's total-queue."""
 
     def check(self, test, history, opts):
+        history = expand_queue_drain_ops(history)
         attempts: MultiSet = MultiSet()
         enqueues: MultiSet = MultiSet()
         dequeues: MultiSet = MultiSet()
@@ -461,25 +485,31 @@ class TotalQueueChecker(Checker):
                     enqueues[v] += 1
             elif f == "dequeue" and typ == "ok":
                 dequeues[v] += 1
-        # dequeues of values we never tried to enqueue
-        unexpected = dequeues - attempts
-        # dequeues in excess of attempts (per-value)
+        ok = dequeues & attempts
+        # dequeues of values we *never* tried to enqueue — records from
+        # nowhere (full multiplicity, not just the excess)
+        unexpected = MultiSet({v: n for v, n in dequeues.items()
+                               if v not in attempts})
+        # dequeues in excess of attempts, for values attempted at least
+        # once: redelivery, not invalidity
         duplicated = dequeues - attempts - unexpected
         # acknowledged enqueues that never came out
         lost = enqueues - dequeues
-        # unacknowledged enqueues that did come out
-        recovered = (attempts - enqueues) & dequeues
+        # dequeues whose enqueue was attempted but never acknowledged
+        recovered = ok - enqueues
         return {
             "valid?": not lost and not unexpected,
             "attempt-count": sum(attempts.values()),
             "acknowledged-count": sum(enqueues.values()),
-            "ok-count": sum((dequeues & attempts).values()),
+            "ok-count": sum(ok.values()),
             "unexpected-count": sum(unexpected.values()),
             "duplicated-count": sum(duplicated.values()),
             "lost-count": sum(lost.values()),
             "recovered-count": sum(recovered.values()),
             "lost": sorted(lost.elements(), key=repr)[:100],
             "unexpected": sorted(unexpected.elements(), key=repr)[:100],
+            "duplicated": sorted(duplicated.elements(), key=repr)[:100],
+            "recovered": sorted(recovered.elements(), key=repr)[:100],
         }
 
 

@@ -173,8 +173,10 @@ def suite_registry() -> dict[str, Callable]:
 def workload_registry() -> dict[str, Callable]:
     """name -> workload-constructor map for sweep runners
     (yugabyte/core.clj:74-118 pattern)."""
-    from jepsen_tpu.workloads import (adya, append, bank, causal_reverse,
-                                      long_fork, register, set_workload, wr)
+    from jepsen_tpu.workloads import (adya, append, bank, causal,
+                                      causal_reverse, long_fork,
+                                      queue_workload, register, set_workload,
+                                      wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -182,6 +184,8 @@ def workload_registry() -> dict[str, Callable]:
         "append": append.workload,
         "wr": wr.workload,
         "long-fork": long_fork.workload,
+        "causal": causal.workload,
         "causal-reverse": causal_reverse.workload,
         "adya": adya.workload,
+        "queue": queue_workload.workload,
     }

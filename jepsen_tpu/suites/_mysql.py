@@ -1,0 +1,243 @@
+"""Minimal MySQL client-protocol implementation over stdlib sockets.
+
+The reference's MySQL-family suites (percona/src/jepsen/percona.clj,
+galera/src/jepsen/galera.clj, mysql-cluster/src/jepsen/mysql_cluster.clj,
+tidb/src/tidb/sql.clj) all ride the JVM's jdbc/mysql driver; this module
+is the TPU-framework equivalent wire client so those suites need no
+third-party Python driver.
+
+Implements the subset every suite needs: protocol-41 handshake with
+``mysql_native_password`` auth (including auth-switch), ``COM_QUERY``
+with text-protocol resultsets, OK/ERR/EOF packets, and ``COM_QUIT``.
+Row values come back as Python strings (or None for SQL NULL) — callers
+cast. No prepared statements, no compression, no TLS: test rigs connect
+over the cluster's private network exactly like the reference's
+conn-specs (percona.clj:102-109).
+"""
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+CLIENT_LONG_PASSWORD = 0x0001
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x0008_0000
+CLIENT_CONNECT_WITH_DB = 0x0008
+
+UTF8_CHARSET = 33
+MAX_PACKET = 16 * 1024 * 1024
+
+
+class MySQLError(Exception):
+    """Server ERR packet: ``.code`` (errno), ``.sqlstate``, ``.msg``."""
+
+    def __init__(self, code: int, sqlstate: str, msg: str):
+        super().__init__(f"({code}) [{sqlstate}] {msg}")
+        self.code = code
+        self.sqlstate = sqlstate
+        self.msg = msg
+
+
+def native_password_scramble(password: str, nonce: bytes) -> bytes:
+    """SHA1(pw) XOR SHA1(nonce + SHA1(SHA1(pw))) — mysql_native_password."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(nonce + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _read_lenenc_int(buf: bytes, pos: int) -> tuple[int | None, int]:
+    first = buf[pos]
+    if first < 0xFB:
+        return first, pos + 1
+    if first == 0xFB:  # NULL in resultset rows
+        return None, pos + 1
+    if first == 0xFC:
+        return struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+    if first == 0xFD:
+        return int.from_bytes(buf[pos + 1:pos + 4], "little"), pos + 4
+    return struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+
+
+def _lenenc_bytes(data: bytes) -> bytes:
+    n = len(data)
+    if n < 0xFB:
+        return bytes([n]) + data
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n) + data
+    if n < 1 << 24:
+        return b"\xfd" + n.to_bytes(3, "little") + data
+    return b"\xfe" + struct.pack("<Q", n) + data
+
+
+class MySQLConnection:
+    """One authenticated connection; ``query`` returns rows or an OK tuple."""
+
+    def __init__(self, host: str, port: int = 3306, user: str = "root",
+                 password: str = "", database: str | None = None,
+                 timeout_s: float = 10.0):
+        self.host, self.port = host, port
+        self._seq = 0
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        try:
+            self._handshake(user, password, database)
+        except BaseException:
+            self.sock.close()
+            raise
+
+    # -- packet framing: 3-byte LE length + 1-byte sequence id ------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(n)
+            if not chunk:
+                raise ConnectionError("mysql server closed connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_packet(self) -> bytes:
+        header = self._recv_exact(4)
+        length = int.from_bytes(header[:3], "little")
+        self._seq = (header[3] + 1) & 0xFF
+        if length == 0xFFFFFF:
+            # multi-packet continuation (payload >= 2^24-1 bytes): none of
+            # the suites' statements come close; fail loudly over mis-framing
+            raise ConnectionError(
+                "multi-packet mysql responses unsupported (payload >= 16MB)")
+        return self._recv_exact(length)
+
+    def _send_packet(self, payload: bytes) -> None:
+        self.sock.sendall(len(payload).to_bytes(3, "little")
+                          + bytes([self._seq]) + payload)
+        self._seq = (self._seq + 1) & 0xFF
+
+    # -- handshake --------------------------------------------------------
+
+    def _handshake(self, user: str, password: str,
+                   database: str | None) -> None:
+        greeting = self._read_packet()
+        if greeting and greeting[0] == 0xFF:
+            self._raise_err(greeting)
+        if not greeting or greeting[0] != 0x0A:
+            raise ConnectionError(
+                f"unsupported mysql protocol version {greeting[:1]!r}")
+        pos = 1
+        end = greeting.index(b"\x00", pos)
+        self.server_version = greeting[pos:end].decode("latin1")
+        pos = end + 1
+        pos += 4  # thread id
+        nonce = greeting[pos:pos + 8]
+        pos += 8 + 1  # auth-plugin-data-part-1 + filler
+        caps = struct.unpack_from("<H", greeting, pos)[0]
+        pos += 2
+        plugin = "mysql_native_password"
+        if len(greeting) > pos:
+            pos += 1 + 2  # charset + status flags
+            caps |= struct.unpack_from("<H", greeting, pos)[0] << 16
+            pos += 2
+            auth_len = greeting[pos]
+            pos += 1 + 10  # auth data len + reserved
+            if caps & CLIENT_SECURE_CONNECTION:
+                extra = max(13, auth_len - 8)
+                nonce += greeting[pos:pos + extra].rstrip(b"\x00")
+                pos += extra
+            if caps & CLIENT_PLUGIN_AUTH:
+                end = greeting.find(b"\x00", pos)
+                if end == -1:
+                    end = len(greeting)
+                plugin = greeting[pos:end].decode("latin1")
+
+        client_caps = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41
+                       | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
+                       | CLIENT_PLUGIN_AUTH)
+        if database:
+            client_caps |= CLIENT_CONNECT_WITH_DB
+        auth = (native_password_scramble(password, nonce[:20])
+                if plugin == "mysql_native_password" else b"")
+        payload = (struct.pack("<IIB23x", client_caps, MAX_PACKET,
+                               UTF8_CHARSET)
+                   + user.encode() + b"\x00"
+                   + _lenenc_bytes(auth)
+                   + ((database.encode() + b"\x00") if database else b"")
+                   + b"mysql_native_password\x00")
+        self._send_packet(payload)
+
+        resp = self._read_packet()
+        if resp and resp[0] == 0xFE:  # AuthSwitchRequest
+            end = resp.index(b"\x00", 1)
+            new_plugin = resp[1:end].decode("latin1")
+            if new_plugin != "mysql_native_password":
+                raise ConnectionError(
+                    f"unsupported auth plugin {new_plugin!r}")
+            new_nonce = resp[end + 1:].rstrip(b"\x00")
+            self._send_packet(native_password_scramble(password, new_nonce))
+            resp = self._read_packet()
+        if resp and resp[0] == 0xFF:
+            self._raise_err(resp)
+        if not resp or resp[0] != 0x00:
+            raise ConnectionError(f"unexpected auth response {resp[:1]!r}")
+
+    # -- queries ----------------------------------------------------------
+
+    def _raise_err(self, packet: bytes) -> None:
+        code = struct.unpack_from("<H", packet, 1)[0]
+        sqlstate, msg_at = "", 3
+        if len(packet) > 3 and packet[3:4] == b"#":
+            sqlstate, msg_at = packet[4:9].decode("latin1"), 9
+        raise MySQLError(code, sqlstate, packet[msg_at:].decode("utf8",
+                                                                "replace"))
+
+    def query(self, sql: str):
+        """Runs one statement. Resultset → list of row tuples (str|None
+        cells); otherwise → (affected_rows, last_insert_id)."""
+        self._seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first and first[0] == 0xFF:
+            self._raise_err(first)
+        if first and first[0] == 0x00:
+            pos = 1
+            affected, pos = _read_lenenc_int(first, pos)
+            last_id, _pos = _read_lenenc_int(first, pos)
+            return affected, last_id
+        ncols, _ = _read_lenenc_int(first, 0)
+        for _ in range(ncols):  # column definitions: skipped
+            self._read_packet()
+        self._expect_eof()
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt and pkt[0] == 0xFF:
+                self._raise_err(pkt)
+            if pkt and pkt[0] == 0xFE and len(pkt) < 9:
+                return rows
+            row, pos = [], 0
+            for _ in range(ncols):
+                n, pos = _read_lenenc_int(pkt, pos)
+                if n is None:  # 0xFB: SQL NULL
+                    row.append(None)
+                else:
+                    row.append(pkt[pos:pos + n].decode("utf8", "replace"))
+                    pos += n
+            rows.append(tuple(row))
+
+    def _expect_eof(self) -> None:
+        pkt = self._read_packet()
+        if not (pkt and pkt[0] == 0xFE and len(pkt) < 9):
+            raise ConnectionError(f"expected EOF packet, got {pkt[:1]!r}")
+
+    def close(self) -> None:
+        try:
+            self._seq = 0
+            self._send_packet(b"\x01")  # COM_QUIT
+        except OSError:
+            pass
+        finally:
+            self.sock.close()

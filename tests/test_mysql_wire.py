@@ -1,0 +1,162 @@
+"""The stdlib MySQL wire client against a scripted in-process server.
+
+Covers the protocol surface the MySQL-family suites depend on
+(handshake + mysql_native_password, OK/ERR/resultset parsing,
+auth-switch), the way the reference unit-tests its transports against
+local endpoints (control_test.clj pattern, SURVEY.md §4)."""
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites._mysql import (MySQLConnection, MySQLError,
+                                      native_password_scramble)
+
+NONCE = b"abcdefgh" + b"ijklmnopqrst"  # 8 + 12 bytes
+PASSWORD = "jepsenpw"
+
+
+def _packet(seq: int, payload: bytes) -> bytes:
+    return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+
+def _greeting() -> bytes:
+    return (b"\x0a" + b"8.0.0-fake\x00"
+            + struct.pack("<I", 42)          # thread id
+            + NONCE[:8] + b"\x00"            # auth data part 1 + filler
+            + struct.pack("<H", 0xFFFF)      # caps low (incl SECURE_CONN)
+            + b"\x21"                        # charset
+            + struct.pack("<H", 0x0002)      # status
+            + struct.pack("<H", 0x000F)      # caps high (incl PLUGIN_AUTH)
+            + bytes([len(NONCE) + 1])        # auth data len
+            + b"\x00" * 10
+            + NONCE[8:] + b"\x00"            # part 2, null-terminated
+            + b"mysql_native_password\x00")
+
+
+def _eof() -> bytes:
+    return b"\xfe\x00\x00\x02\x00"
+
+
+def _lenenc_str(s: str) -> bytes:
+    raw = s.encode()
+    assert len(raw) < 0xFB
+    return bytes([len(raw)]) + raw
+
+
+class FakeServer:
+    """Accepts one connection, validates auth, answers scripted queries."""
+
+    def __init__(self, auth_switch: bool = False):
+        self.auth_switch = auth_switch
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.errors: list[str] = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _recv_packet(self, conn) -> bytes:
+        header = b""
+        while len(header) < 4:
+            chunk = conn.recv(4 - len(header))
+            if not chunk:
+                return b""
+            header += chunk
+        n = int.from_bytes(header[:3], "little")
+        payload = b""
+        while len(payload) < n:
+            payload += conn.recv(n - len(payload))
+        return payload
+
+    def _serve(self):
+        conn, _ = self.sock.accept()
+        try:
+            conn.sendall(_packet(0, _greeting()))
+            resp = self._recv_packet(conn)
+            caps, _maxp, _cs = struct.unpack_from("<IIB", resp, 0)
+            pos = 32
+            end = resp.index(b"\x00", pos)
+            user = resp[pos:end].decode()
+            pos = end + 1
+            alen = resp[pos]
+            auth = resp[pos + 1:pos + 1 + alen]
+            if user != "jepsen":
+                self.errors.append(f"bad user {user!r}")
+            if self.auth_switch:
+                new_nonce = b"ZYXWVUTSRQPONMLKJIHG"
+                conn.sendall(_packet(2, b"\xfemysql_native_password\x00"
+                                     + new_nonce + b"\x00"))
+                auth = self._recv_packet(conn)
+                expect = native_password_scramble(PASSWORD, new_nonce)
+            else:
+                expect = native_password_scramble(PASSWORD, NONCE[:20])
+            if auth != expect:
+                self.errors.append("bad scramble")
+            conn.sendall(_packet(4 if self.auth_switch else 2,
+                                 b"\x00\x00\x00\x02\x00\x00\x00"))
+            while True:
+                q = self._recv_packet(conn)
+                if not q or q[0] == 0x01:  # COM_QUIT / close
+                    return
+                sql = q[1:].decode()
+                if sql.startswith("SELECT"):
+                    conn.sendall(_packet(1, b"\x02"))          # 2 columns
+                    coldef = _lenenc_str("def") * 7 + b"\x0c" + b"\x00" * 10
+                    conn.sendall(_packet(2, coldef))
+                    conn.sendall(_packet(3, coldef))
+                    conn.sendall(_packet(4, _eof()))
+                    conn.sendall(_packet(5, _lenenc_str("5")
+                                         + _lenenc_str("hello")))
+                    conn.sendall(_packet(6, b"\xfb" + _lenenc_str("x")))
+                    conn.sendall(_packet(7, _eof()))
+                elif sql.startswith("BOOM"):
+                    conn.sendall(_packet(1, b"\xff" + struct.pack("<H", 1062)
+                                         + b"#23000duplicate key"))
+                else:
+                    conn.sendall(_packet(
+                        1, b"\x00\x03\x07\x02\x00\x00\x00"))  # 3 rows, id 7
+        finally:
+            conn.close()
+            self.sock.close()
+
+
+def test_scramble_matches_reference_algorithm():
+    h1 = hashlib.sha1(b"pw").digest()
+    h2 = hashlib.sha1(h1).digest()
+    expect = bytes(a ^ b for a, b in zip(
+        h1, hashlib.sha1(b"n" * 20 + h2).digest()))
+    assert native_password_scramble("pw", b"n" * 20) == expect
+    assert native_password_scramble("", b"n" * 20) == b""
+
+
+def test_query_roundtrip():
+    srv = FakeServer()
+    conn = MySQLConnection("127.0.0.1", srv.port, user="jepsen",
+                           password=PASSWORD, timeout_s=5)
+    assert conn.server_version == "8.0.0-fake"
+    rows = conn.query("SELECT v FROM t")
+    assert rows == [("5", "hello"), (None, "x")]
+    affected, last_id = conn.query("INSERT INTO t VALUES (1)")
+    assert (affected, last_id) == (3, 7)
+    with pytest.raises(MySQLError) as err:
+        conn.query("BOOM")
+    assert err.value.code == 1062 and err.value.sqlstate == "23000"
+    conn.close()
+    srv.thread.join(timeout=5)
+    assert srv.errors == []
+
+
+def test_auth_switch():
+    srv = FakeServer(auth_switch=True)
+    conn = MySQLConnection("127.0.0.1", srv.port, user="jepsen",
+                           password=PASSWORD, timeout_s=5)
+    assert conn.query("UPDATE t SET x=1")[0] == 3
+    conn.close()
+    srv.thread.join(timeout=5)
+    assert srv.errors == []

@@ -223,3 +223,59 @@ def test_append_generator_via_workload():
     for iv in invocations(h):
         for m in iv["value"]:
             assert m[0] in ("r", "append")
+
+
+# ---------------------------------------------------------------------------
+# queue (enqueue/dequeue/drain -> total-queue)
+# ---------------------------------------------------------------------------
+
+def test_queue_workload_drain_expansion_and_verdicts():
+    from jepsen_tpu.workloads import queue_workload
+    w = queue_workload.workload()
+    ok = [
+        op("invoke", 0, "enqueue", 1), op("ok", 0, "enqueue", 1),
+        op("invoke", 1, "enqueue", 2), op("ok", 1, "enqueue", 2),
+        op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", 1),
+        op("invoke", 1, "drain"), op("ok", 1, "drain", [2]),
+    ]
+    res = w["checker"].check({}, ok, {})
+    assert res["valid?"] is True and res["lost-count"] == 0
+    lost = ok[:-2] + [op("invoke", 1, "drain"), op("ok", 1, "drain", [])]
+    res = w["checker"].check({}, lost, {})
+    assert res["valid?"] is False and res["lost"] == [2]
+    # unacked enqueue that surfaces later is recovered, not unexpected
+    rec = [
+        op("invoke", 0, "enqueue", 9), op("info", 0, "enqueue", 9),
+        op("invoke", 1, "drain"), op("ok", 1, "drain", [9]),
+    ]
+    res = w["checker"].check({}, rec, {})
+    assert res["valid?"] is True and res["recovered-count"] == 1
+
+
+def test_queue_workload_generator_simulates():
+    from jepsen_tpu.workloads import queue_workload
+    import jepsen_tpu.generator as g
+    w = queue_workload.workload()
+    h = quick({"concurrency": 2}, g.limit(20, w["generator"]))
+    fs = {iv["f"] for iv in invocations(h)}
+    assert fs <= {"enqueue", "dequeue"} and "enqueue" in fs
+
+
+def test_queue_duplicate_delivery_is_not_unexpected():
+    # redelivery of an attempted value: duplicated, still valid
+    # (checker.clj:663-666 — duplicates alone don't invalidate)
+    from jepsen_tpu.workloads import queue_workload
+    w = queue_workload.workload()
+    h = [
+        op("invoke", 0, "enqueue", 1), op("ok", 0, "enqueue", 1),
+        op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", 1),
+        op("invoke", 0, "dequeue"), op("ok", 0, "dequeue", 1),
+    ]
+    res = w["checker"].check({}, h, {})
+    assert res["valid?"] is True
+    assert res["duplicated-count"] == 1 and res["duplicated"] == [1]
+    assert res["unexpected-count"] == 0
+    # a value from nowhere is unexpected with full multiplicity
+    h2 = h + [op("invoke", 1, "dequeue"), op("ok", 1, "dequeue", 99)]
+    res2 = w["checker"].check({}, h2, {})
+    assert res2["valid?"] is False and res2["unexpected"] == [99]
