@@ -445,8 +445,14 @@ def _model_size(model):
 
 
 def expand_queue_drain_ops(history: list[dict]) -> list[dict]:
-    """Expands ok ``drain`` ops (value = list of drained elements) into
-    synthetic dequeue invoke/ok pairs (checker.clj:594-626)."""
+    """Expands ``drain`` ops (value = list of drained elements) into
+    synthetic dequeue invoke/ok pairs (checker.clj:594-626).
+
+    Beyond the reference: a crashed (``info``) drain that carries a
+    partial element list is expanded too — those elements were
+    definitely consumed before the crash, and dropping them would
+    produce false ``lost`` verdicts. A crashed drain with no element
+    list is unsupported, as in the reference."""
     out: list[dict] = []
     for op in history:
         if op.get("f") != "drain":
@@ -455,7 +461,8 @@ def expand_queue_drain_ops(history: list[dict]) -> list[dict]:
         typ = op.get("type")
         if typ in ("invoke", "fail"):
             continue
-        if typ == "ok":
+        if typ == "ok" or (typ == "info"
+                           and isinstance(op.get("value"), list)):
             for element in op.get("value") or []:
                 out.append({**op, "type": "invoke", "f": "dequeue",
                             "value": None})

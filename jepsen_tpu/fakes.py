@@ -162,6 +162,23 @@ class KVStore(MetaLogDB):
                     raise ValueError(f"unknown micro-op {f!r}")
             return out
 
+    def enqueue(self, v):
+        with self.lock:
+            self.lists.setdefault("__queue__", []).append(v)
+
+    def dequeue(self):
+        """The head element, or None when empty."""
+        with self.lock:
+            q = self.lists.get("__queue__")
+            return q.pop(0) if q else None
+
+    def drain(self) -> list:
+        with self.lock:
+            q = self.lists.get("__queue__", [])
+            out = list(q)
+            q.clear()
+            return out
+
 
 class KVClient(MetaLogClient):
     """Client over a KVStore, speaking both the independent-lifted register
@@ -188,6 +205,16 @@ class KVClient(MetaLogClient):
             k, (old, new) = v
             ok = self.db.cas(k, old, new)
             return {**op, "type": "ok" if ok else "fail"}
+        if f == "enqueue":
+            self.db.enqueue(v)
+            return {**op, "type": "ok"}
+        if f == "dequeue":
+            out = self.db.dequeue()
+            if out is None:
+                return {**op, "type": "fail"}
+            return {**op, "type": "ok", "value": out}
+        if f == "drain":
+            return {**op, "type": "ok", "value": self.db.drain()}
         return {**op, "type": "fail", "error": ["unknown-f", f]}
 
 

@@ -60,20 +60,28 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
 
 
 def build_suite_test(o: dict | None, *, db_name: str,
-                     supported_workloads: tuple, make_real: Callable) -> dict:
+                     supported_workloads: tuple, make_real: Callable,
+                     make_workload: Callable | None = None,
+                     fake_client: Callable | None = None,
+                     defaults: dict | None = None) -> dict:
     """The standard suite test-map constructor shared by every DB suite.
 
     ``make_real(o) -> {"db": ..., "client": ..., "os": ...}`` supplies the
     real-cluster pieces; ``--fake`` swaps in the in-memory KV doubles over
-    the dummy remote (tests.clj:27-67 pattern). Fault classes come from
+    the dummy remote (tests.clj:27-67 pattern) — or ``fake_client()``
+    when the suite needs its own double. ``make_workload(name, base)``
+    overrides the shared workload registry for suites with bespoke
+    workloads (e.g. chronos jobs). ``defaults`` overrides the standard
+    concurrency/time_limit/nemesis_interval. Fault classes come from
     ``o["faults"]`` (default: partition on real clusters, none in fake
     mode) and are assembled by the combined nemesis packages.
     """
     from jepsen_tpu.nemesis import combined
 
     o = dict(o or {})
+    d = defaults or {}
     fake = bool(o.get("fake"))
-    workload_name = o.get("workload", "register")
+    workload_name = o.get("workload") or supported_workloads[0]
     if workload_name not in supported_workloads:
         raise ValueError(f"{db_name} suite supports workloads "
                          f"{supported_workloads}, not {workload_name!r}")
@@ -83,8 +91,8 @@ def build_suite_test(o: dict | None, *, db_name: str,
     base = {
         "name": f"{db_name}-{workload_name}",
         "nodes": o.get("nodes") or ["n1", "n2", "n3", "n4", "n5"],
-        "concurrency": o.get("concurrency", 5),
-        "time_limit": o.get("time_limit", 60),
+        "concurrency": o.get("concurrency", d.get("concurrency", 5)),
+        "time_limit": o.get("time_limit", d.get("time_limit", 60)),
         "ssh": ssh,
         "accelerator": o.get("accelerator", "auto"),
         "store_dir": o.get("store_dir", "store"),
@@ -94,12 +102,16 @@ def build_suite_test(o: dict | None, *, db_name: str,
         from jepsen_tpu.fakes import KVClient, KVStore
         from jepsen_tpu.net import NoopNet
         kv = KVStore()
-        base.update(db=kv, client=KVClient(kv), os=None, net=NoopNet())
+        client = fake_client() if fake_client else KVClient(kv)
+        base.update(db=kv, client=client, os=None, net=NoopNet())
     else:
         base.update(make_real(o))
 
-    workload = workload_registry()[workload_name](
-        base, accelerator=base["accelerator"])
+    if make_workload is not None:
+        workload = make_workload(workload_name, base)
+    else:
+        workload = workload_registry()[workload_name](
+            base, accelerator=base["accelerator"])
 
     nemesis_pkg = None
     faults = o.get("faults")
@@ -108,12 +120,14 @@ def build_suite_test(o: dict | None, *, db_name: str,
     if faults:
         nemesis_pkg = combined.nemesis_package({
             "db": base["db"], "faults": set(faults),
-            "interval": o.get("nemesis_interval", 10.0)})
+            "interval": o.get("nemesis_interval",
+                              d.get("nemesis_interval", 10.0))})
     return compose_test(base, workload, nemesis_pkg)
 
 
 def standard_opt_fn(supported_workloads: tuple,
-                    extra: Callable | None = None) -> Callable:
+                    extra: Callable | None = None,
+                    nemesis_interval: float = 10.0) -> Callable:
     """The shared CLI option set for suites (plus per-suite extras)."""
     def opt_fn(p):
         p.add_argument("--workload", default=supported_workloads[0],
@@ -122,7 +136,8 @@ def standard_opt_fn(supported_workloads: tuple,
                        help="in-memory client/DB over the dummy remote")
         p.add_argument("--fault", action="append", dest="faults",
                        choices=["partition", "kill", "pause", "clock"])
-        p.add_argument("--nemesis-interval", type=float, default=10.0)
+        p.add_argument("--nemesis-interval", type=float,
+                       default=nemesis_interval)
         p.add_argument("--no-perf", action="store_true")
         if extra:
             extra(p)
@@ -158,8 +173,9 @@ def standard_test_fn(suite_test: Callable,
 def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""
-    from jepsen_tpu.suites import (consul, etcd, mongodb, postgres, redis,
-                                   zookeeper)
+    from jepsen_tpu.suites import (chronos, consul, crate, dgraph,
+                                   elasticsearch, etcd, hazelcast, ignite,
+                                   mongodb, postgres, redis, zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -167,6 +183,12 @@ def suite_registry() -> dict[str, Callable]:
         "redis": redis.redis_test,
         "postgres": postgres.postgres_test,
         "mongodb": mongodb.mongodb_test,
+        "elasticsearch": elasticsearch.elasticsearch_test,
+        "crate": crate.crate_test,
+        "dgraph": dgraph.dgraph_test,
+        "ignite": ignite.ignite_test,
+        "hazelcast": hazelcast.hazelcast_test,
+        "chronos": chronos.chronos_test,
     }
 
 

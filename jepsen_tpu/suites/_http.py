@@ -1,0 +1,54 @@
+"""Tiny stdlib HTTP/JSON helper shared by the HTTP-API DB suites
+(elasticsearch, crate, dgraph, ignite, hazelcast, chronos — the suites
+whose reference counterparts ride JVM HTTP clients, e.g.
+crate/src/jepsen/crate/core.clj, chronos/src/jepsen/chronos.clj:28-31).
+
+Network-level failures surface as the stdlib exceptions
+(``urllib.error.URLError``, ``TimeoutError``, ``ConnectionError``) so
+each client's invoke can map them onto ``fail``/``info`` completions."""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+NET_ERRORS = (TimeoutError, urllib.error.URLError, ConnectionError, OSError)
+
+
+def http_json(url: str, body=None, *, method: str | None = None,
+              timeout_s: float = 5.0, headers: dict | None = None,
+              raw_body: bytes | None = None):
+    """One request; JSON (or raw text on non-JSON) response body.
+
+    ``body`` is JSON-encoded when given; ``raw_body`` sends bytes as-is.
+    4xx/5xx raise ``urllib.error.HTTPError`` (response body preserved on
+    ``.read()`` — callers that need error JSON use ``http_error_json``)."""
+    data = raw_body
+    hdrs = dict(headers or {})
+    if body is not None:
+        data = json.dumps(body).encode()
+        hdrs.setdefault("Content-Type", "application/json")
+    req = urllib.request.Request(
+        url, data=data, headers=hdrs,
+        method=method or ("POST" if data is not None else "GET"))
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        text = resp.read().decode()
+    if not text:
+        return None
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        return text
+
+
+def http_error_json(err: urllib.error.HTTPError):
+    """The JSON body of an HTTPError, or None."""
+    try:
+        return json.loads(err.read().decode())
+    except Exception:
+        return None
+
+
+def quote(s) -> str:
+    return urllib.parse.quote(str(s), safe="")

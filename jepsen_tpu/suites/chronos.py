@@ -1,0 +1,311 @@
+"""Chronos test suite (reference: chronos/ in jaydenwen123/jepsen —
+chronos/src/jepsen/chronos.clj schedules repeating jobs on a
+Mesos+Chronos cluster whose runs append timestamps to per-job files;
+chronos/src/jepsen/chronos/checker.clj verifies every *target*
+invocation window got a run).
+
+Jobs are added over Chronos's HTTP API (``POST /scheduler/iso8601``
+with an ``R<count>/<start>/PT<interval>S`` repeating schedule,
+chronos.clj:102-141); each run's command appends an epoch timestamp to
+``/tmp/chronos-test/<job>`` on whichever node executes it. The final
+read gathers those files from every node via the control layer
+(read-runs, chronos.clj:161-170).
+
+The checker re-derives each acknowledged job's target windows —
+``start + i*interval`` for ``i < count``, due before
+``read-time - epsilon - duration`` — and requires a distinct run in
+every ``[target, target + epsilon + forgiveness]`` window
+(checker.clj:26-47). Matching runs to targets is earliest-deadline
+greedy over sorted windows, which is an exact maximum matching for
+interval bigraphs — replacing the reference's loco constraint solver.
+
+DB automation installs the mesosphere packages (mesos master/agent +
+chronos, backed by zookeeper) the way mesosphere.clj does.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import cli, control, db as db_mod, generator as gen
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites._http import NET_ERRORS, http_json
+
+logger = logging.getLogger("jepsen.chronos")
+
+PORT = 4400
+RUN_DIR = "/tmp/chronos-test"
+EPSILON_FORGIVENESS = 5  # seconds; checker.clj:26-28
+
+
+# ---------------------------------------------------------------------------
+# DB
+# ---------------------------------------------------------------------------
+
+class ChronosDB(db_mod.DB, db_mod.LogFiles):
+    """mesos master+agent and chronos on every node, zk-coordinated
+    (mesosphere.clj:33-84)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import os_setup
+        logger.info("%s: installing mesos+chronos", node)
+        nodes = test.get("nodes") or []
+        zk = ",".join(f"{n}:2181" for n in nodes)
+        os_setup.install(["zookeeper", "mesos", "chronos"])
+        control.exec_("tee", "/etc/mesos/zk",
+                      stdin=f"zk://{zk}/mesos\n")
+        quorum = len(nodes) // 2 + 1
+        control.exec_("tee", "/etc/mesos-master/quorum",
+                      stdin=f"{quorum}\n")
+        control.exec_("mkdir", "-p", RUN_DIR)
+        for svc in ("zookeeper", "mesos-master", "mesos-slave", "chronos"):
+            control.exec_("service", svc, "restart")
+        cu.await_tcp_port(PORT, host=node)
+
+    def teardown(self, test, node):
+        for svc in ("chronos", "mesos-slave", "mesos-master"):
+            try:
+                control.exec_("service", svc, "stop")
+            except Exception:
+                pass
+        cu.rm_rf(RUN_DIR)
+
+    def log_files(self, test, node):
+        return ["/var/log/chronos/chronos.log", "/var/log/mesos/mesos.log"]
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+def interval_str(job: dict) -> str:
+    """R<count>/<ISO start>/PT<interval>S (chronos.clj:102-107)."""
+    import datetime
+    start = datetime.datetime.fromtimestamp(
+        job["start"], tz=datetime.timezone.utc)
+    return (f"R{job['count']}/{start.strftime('%Y-%m-%dT%H:%M:%SZ')}"
+            f"/PT{job['interval']}S")
+
+
+def job_json(job: dict) -> dict:
+    return {
+        "name": str(job["name"]),
+        "command": (f"mkdir -p {RUN_DIR}; "
+                    f"date +%s >> {RUN_DIR}/{job['name']}; "
+                    f"sleep {job['duration']}"),
+        "schedule": interval_str(job),
+        "epsilon": f"PT{job['epsilon']}S",
+        "owner": "jepsen",
+        "async": False,
+    }
+
+
+class ChronosClient(Client):
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return ChronosClient(self.timeout_s, node)
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        try:
+            if f == "add-job":
+                http_json(
+                    f"http://{self.node}:{PORT}/scheduler/iso8601",
+                    job_json(op["value"]), timeout_s=self.timeout_s)
+                return {**op, "type": "ok"}
+            if f == "read":
+                import time
+                runs = read_runs(test)
+                return {**op, "type": "ok",
+                        "value": {"read-time": time.time(), "runs": runs}}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            return {**op, "type": "info", "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            return {**op, "type": "info", "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+def read_runs(test) -> dict:
+    """{job-name: sorted run epochs} across all nodes (chronos.clj:161-170)."""
+    runs: dict = {}
+
+    def gather(node):
+        try:
+            out = control.exec_star(
+                "sh", "-c",
+                f"for f in {RUN_DIR}/*; do "
+                "[ -f \"$f\" ] && echo \"== $f\" && cat \"$f\"; done")
+            return out.out
+        except Exception:
+            return ""
+
+    for node, text in control.on_nodes(test, gather).items():
+        current = None
+        for line in (text or "").splitlines():
+            if line.startswith("== "):
+                current = line[3:].rsplit("/", 1)[-1]
+                runs.setdefault(current, [])
+            elif current and line.strip().isdigit():
+                runs[current].append(int(line.strip()))
+    return {name: sorted(ts) for name, ts in runs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Checker (chronos/src/jepsen/chronos/checker.clj)
+# ---------------------------------------------------------------------------
+
+def job_targets(read_time: float, job: dict) -> list[tuple[float, float]]:
+    """[start, stop] windows that *must* have begun by read time
+    (checker.clj job->targets:30-47)."""
+    finish = read_time - job["epsilon"] - job["duration"]
+    out = []
+    for i in range(job["count"]):
+        t = job["start"] + i * job["interval"]
+        if t >= finish:
+            break
+        out.append((t, t + job["epsilon"] + EPSILON_FORGIVENESS))
+    return out
+
+
+def match_targets(targets: list[tuple[float, float]],
+                  runs: list[float]) -> tuple[list, list]:
+    """(matched, unmatched-targets): earliest-deadline-first greedy,
+    each run satisfies at most one target."""
+    free = sorted(runs)
+    matched, unmatched = [], []
+    for lo, hi in sorted(targets, key=lambda w: w[1]):
+        pick = next((r for r in free if lo <= r <= hi), None)
+        if pick is None:
+            unmatched.append([lo, hi])
+        else:
+            free.remove(pick)
+            matched.append([lo, hi, pick])
+    return matched, unmatched
+
+
+class ChronosChecker(chk.Checker):
+    def check(self, test, history, opts):
+        jobs = [op["value"] for op in history
+                if op.get("f") == "add-job" and op.get("type") == "ok"]
+        read = next((op["value"] for op in reversed(history)
+                     if op.get("f") == "read" and op.get("type") == "ok"),
+                    None)
+        if read is None:
+            return {"valid?": "unknown", "error": "no successful final read"}
+        job_results = {}
+        ok = True
+        for job in jobs:
+            targets = job_targets(read["read-time"], job)
+            runs = read["runs"].get(str(job["name"]), [])
+            matched, unmatched = match_targets(targets, runs)
+            extra = len(runs) - len(matched)
+            job_results[str(job["name"])] = {
+                "target-count": len(targets), "run-count": len(runs),
+                "matched-count": len(matched), "unmatched": unmatched,
+                "extra-run-count": extra,
+            }
+            if unmatched:
+                ok = False
+        return {"valid?": ok, "job-count": len(jobs), "jobs": job_results}
+
+
+# ---------------------------------------------------------------------------
+# Workload + test
+# ---------------------------------------------------------------------------
+
+def add_jobs():
+    """Fresh jobs with randomized duration/epsilon/interval
+    (chronos.clj add-job:194-217)."""
+    import random
+    state = {"n": 0}
+
+    def nxt(test, ctx):
+        import time
+        state["n"] += 1
+        duration = random.randint(0, 9)
+        epsilon = 10 + random.randint(0, 19)
+        interval = (1 + duration + epsilon + EPSILON_FORGIVENESS
+                    + random.randint(0, 59))
+        return {"f": "add-job", "value": {
+            "name": state["n"],
+            "start": time.time() + 2 + random.randint(0, 9),
+            "count": 1 + random.randint(0, 99),
+            "duration": duration, "epsilon": epsilon,
+            "interval": interval}}
+
+    return gen.Fn(nxt)
+
+
+def chronos_workload(base, **_):
+    return {
+        "generator": gen.stagger(30.0, add_jobs()),
+        "final_generator": gen.once(gen.Fn(
+            lambda test, ctx: {"f": "read"})),
+        "checker": ChronosChecker(),
+    }
+
+
+SUPPORTED_WORKLOADS = ("jobs",)
+
+
+def chronos_test(o: dict | None = None) -> dict:
+    from jepsen_tpu.suites import build_suite_test
+    return build_suite_test(
+        o, db_name="chronos", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": ChronosDB(), "client": ChronosClient(),
+                             "os": Debian()},
+        make_workload=lambda name, base: chronos_workload(base),
+        fake_client=FakeChronosClient,
+        defaults={"concurrency": 2, "time_limit": 300,
+                  "nemesis_interval": 60.0})
+
+
+class FakeChronosClient(Client):
+    """In-memory double: jobs 'run' exactly on schedule — every target
+    window gets a punctual run at fake read time."""
+
+    def __init__(self):
+        self.jobs: list[dict] = []
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        import time
+        f = op.get("f")
+        if f == "add-job":
+            self.jobs.append(op["value"])
+            return {**op, "type": "ok"}
+        if f == "read":
+            now = time.time()
+            runs = {str(j["name"]):
+                    [t for t, _ in job_targets(now, j)]
+                    for j in self.jobs}
+            return {**op, "type": "ok",
+                    "value": {"read-time": now, "runs": runs}}
+        return {**op, "type": "fail"}
+
+    def close(self, test):
+        pass
+
+
+from jepsen_tpu.suites import standard_opt_fn, standard_test_fn  # noqa: E402
+
+main = cli.single_test_cmd(
+    standard_test_fn(chronos_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS, nemesis_interval=60.0),
+    name="jepsen-chronos")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""CrateDB test suite (reference: crate/ in jaydenwen123/jepsen —
+crate/src/jepsen/crate/core.clj plus the dirty_read / lost_updates /
+version_divergence workloads probing Crate's eventually-durable SQL
+over Elasticsearch).
+
+The client speaks Crate's HTTP ``_sql`` endpoint (POST {stmt, args})
+with stdlib urllib. Register CAS is an optimistic
+``UPDATE ... WHERE id=? AND val=?`` judged by rowcount — the
+lost-updates shape; set adds INSERT one row per element and final reads
+``REFRESH TABLE`` first (Crate reads are refresh-bounded, the
+version_divergence lesson). DB automation installs the tarball, writes
+unicast discovery over the node list, and runs bin/crate.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_json
+
+logger = logging.getLogger("jepsen.crate")
+
+DEFAULT_VERSION = "5.7.2"
+DIR = "/opt/crate"
+LOG_FILE = f"{DIR}/logs/jepsen.log"
+PIDFILE = f"{DIR}/crate.pid"
+PORT = 4200
+
+
+def archive_url(version: str) -> str:
+    return (f"https://cdn.crate.io/downloads/releases/cratedb/x64_linux/"
+            f"crate-{version}.tar.gz")
+
+
+class CrateDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing crate %s", node, self.version)
+        cu.install_archive(archive_url(self.version), DIR)
+        nodes = test.get("nodes") or []
+        conf = "\n".join([
+            "cluster.name: jepsen",
+            f"node.name: {node}",
+            "network.host: 0.0.0.0",
+            f"discovery.seed_hosts: [{', '.join(nodes)}]",
+            f"cluster.initial_master_nodes: [{', '.join(nodes)}]",
+            f"gateway.expected_data_nodes: {len(nodes)}",
+            f"gateway.recover_after_data_nodes: {max(1, len(nodes) // 2 + 1)}",
+        ]) + "\n"
+        from jepsen_tpu import control
+        control.exec_("tee", f"{DIR}/config/crate.yml", stdin=conf)
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/data")
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/bin/crate")
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/bin/crate", PIDFILE)
+        cu.grepkill("io.crate.bootstrap.CrateDB")
+
+    def pause(self, test, node):
+        cu.grepkill("io.crate.bootstrap.CrateDB", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("io.crate.bootstrap.CrateDB", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class CrateClient(Client):
+    """SQL over the HTTP ``_sql`` endpoint."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return CrateClient(self.timeout_s, node)
+
+    def _sql(self, stmt: str, args: list | None = None):
+        return http_json(f"http://{self.node}:{PORT}/_sql",
+                         {"stmt": stmt, "args": args or []},
+                         timeout_s=self.timeout_s)
+
+    def setup(self, test):
+        self._sql("CREATE TABLE IF NOT EXISTS registers "
+                  "(id INT PRIMARY KEY, val INT) "
+                  "CLUSTERED INTO 5 SHARDS WITH (number_of_replicas = 2)")
+        self._sql("CREATE TABLE IF NOT EXISTS sets "
+                  "(id INT PRIMARY KEY) "
+                  "CLUSTERED INTO 5 SHARDS WITH (number_of_replicas = 2)")
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._sql("INSERT INTO sets (id) VALUES (?)", [v])
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                self._sql("REFRESH TABLE sets")
+                res = self._sql("SELECT id FROM sets ORDER BY id")
+                return {**op, "type": "ok",
+                        "value": [row[0] for row in res["rows"]]}
+            if f == "read":
+                k, _ = v
+                self._sql("REFRESH TABLE registers")
+                res = self._sql("SELECT val FROM registers WHERE id = ?", [k])
+                val = res["rows"][0][0] if res["rows"] else None
+                return {**op, "type": "ok", "value": [k, val]}
+            if f == "write":
+                k, val = v
+                res = self._sql("UPDATE registers SET val = ? WHERE id = ?",
+                                [val, k])
+                if res.get("rowcount", 0) == 0:
+                    self._sql("INSERT INTO registers (id, val) VALUES (?, ?)",
+                              [k, val])
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                self._sql("REFRESH TABLE registers")
+                res = self._sql(
+                    "UPDATE registers SET val = ? WHERE id = ? AND val = ?",
+                    [new, k, old])
+                ok = res.get("rowcount", 0) == 1
+                return {**op, "type": "ok" if ok else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # duplicate key / version conflict
+                return {**op, "type": "fail", "error": ["conflict", e.code]}
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+SUPPORTED_WORKLOADS = ("register", "set")
+
+
+def crate_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="crate", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": CrateDB(o.get("version", DEFAULT_VERSION)),
+                             "client": CrateClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(crate_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-crate")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""Dgraph test suite (reference: dgraph/ in jaydenwen123/jepsen — the
+largest reference suite: dgraph/src/jepsen/dgraph/{set,bank,delete,
+long_fork,upsert,sequential}.clj over a zero+alpha cluster,
+dgraph/src/jepsen/dgraph/support.clj for DB automation).
+
+The client rides Dgraph's HTTP API. Set adds are single JSON mutations
+with ``commitNow``; register writes and CAS are **upsert blocks** — a
+DQL query binding the key's uid/value plus a conditional mutation
+(``@if``), executed atomically server-side, the HTTP equivalent of the
+reference upsert.clj's transactional upserts. Reads query by indexed
+key predicate.
+
+DB automation installs the dgraph binary, runs ``dgraph zero`` on the
+first node (``--replicas N`` for one raft group) and ``dgraph alpha``
+on every node pointing at it — support.clj's zero/alpha bring-up.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_json
+
+logger = logging.getLogger("jepsen.dgraph")
+
+DEFAULT_VERSION = "23.1.1"
+DIR = "/opt/dgraph"
+ZERO_LOG = f"{DIR}/zero.log"
+ALPHA_LOG = f"{DIR}/alpha.log"
+ZERO_PID = f"{DIR}/zero.pid"
+ALPHA_PID = f"{DIR}/alpha.pid"
+ALPHA_HTTP_PORT = 8080
+ZERO_GRPC_PORT = 5080
+
+SCHEMA = "key: int @index(int) .\nval: int .\nel: int @index(int) .\n"
+
+
+def binary_url(version: str) -> str:
+    return (f"https://github.com/dgraph-io/dgraph/releases/download/"
+            f"v{version}/dgraph-linux-amd64.tar.gz")
+
+
+class DgraphDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing dgraph %s", node, self.version)
+        cu.install_archive(binary_url(self.version), DIR)
+        nodes = test.get("nodes") or []
+        zero_node = nodes[0] if nodes else node
+        if node == zero_node:
+            cu.start_daemon(
+                {"logfile": ZERO_LOG, "pidfile": ZERO_PID, "chdir": DIR},
+                f"{DIR}/dgraph", "zero", "--my", f"{node}:{ZERO_GRPC_PORT}",
+                "--replicas", str(len(nodes) or 1))
+            cu.await_tcp_port(ZERO_GRPC_PORT, host=zero_node)
+        self.start(test, node)
+        cu.await_tcp_port(ALPHA_HTTP_PORT, host=node)
+        if node == zero_node:
+            http_json(f"http://{node}:{ALPHA_HTTP_PORT}/alter",
+                      raw_body=SCHEMA.encode(), timeout_s=30)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        for d in ("p", "w", "zw"):
+            cu.rm_rf(f"{DIR}/{d}")
+
+    def start(self, test, node):
+        nodes = test.get("nodes") or []
+        zero_node = nodes[0] if nodes else node
+        return cu.start_daemon(
+            {"logfile": ALPHA_LOG, "pidfile": ALPHA_PID, "chdir": DIR},
+            f"{DIR}/dgraph", "alpha", "--my", f"{node}:7080",
+            "--zero", f"{zero_node}:{ZERO_GRPC_PORT}",
+            "--security", "whitelist=0.0.0.0/0")
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/dgraph", ALPHA_PID)
+        cu.stop_daemon(f"{DIR}/dgraph", ZERO_PID)
+        cu.grepkill("dgraph")
+
+    def pause(self, test, node):
+        cu.grepkill("dgraph", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("dgraph", sig="CONT")
+
+    def log_files(self, test, node):
+        return [ZERO_LOG, ALPHA_LOG]
+
+
+class DgraphClient(Client):
+    """Register/set ops via HTTP upsert blocks and DQL queries."""
+
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return DgraphClient(self.timeout_s, node)
+
+    def _mutate(self, body: dict):
+        doc = http_json(
+            f"http://{self.node}:{ALPHA_HTTP_PORT}/mutate?commitNow=true",
+            body, timeout_s=self.timeout_s)
+        errs = doc.get("errors")
+        if errs:
+            raise DgraphError(str(errs))
+        return doc
+
+    def _query(self, q: str):
+        doc = http_json(f"http://{self.node}:{ALPHA_HTTP_PORT}/query",
+                        raw_body=q.encode(),
+                        headers={"Content-Type": "application/dql"},
+                        timeout_s=self.timeout_s)
+        errs = doc.get("errors")
+        if errs:
+            raise DgraphError(str(errs))
+        return doc.get("data") or {}
+
+    def _read_register(self, k):
+        data = self._query(
+            "{ q(func: eq(key, %d)) { val } }" % k)
+        rows = data.get("q") or []
+        return rows[0].get("val") if rows else None
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._mutate({"set": [{"el": v}]})
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                data = self._query("{ q(func: has(el)) { el } }")
+                elems = sorted(row["el"] for row in (data.get("q") or []))
+                return {**op, "type": "ok", "value": elems}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self._read_register(k)]}
+            if f == "write":
+                k, val = v
+                # upsert: bind the key's uid, write through it (or create)
+                self._mutate({
+                    "query": "{ q(func: eq(key, %d)) { u as uid } }" % k,
+                    "set": [{"uid": "uid(u)", "key": k, "val": val}]})
+                return {**op, "type": "ok"}
+            if f == "cas":
+                # a real dgraph txn: snapshot read at start_ts, write, then
+                # commit with conflict keys — aborts on concurrent writers
+                # (the reference client's txn shape, upsert.clj pattern)
+                k, (old, new) = v
+                q = http_json(
+                    f"http://{self.node}:{ALPHA_HTTP_PORT}/query",
+                    raw_body=(b"{ q(func: eq(key, %d)) { uid val } }"
+                              % k),
+                    headers={"Content-Type": "application/dql"},
+                    timeout_s=self.timeout_s)
+                rows = (q.get("data") or {}).get("q") or []
+                start_ts = (q.get("extensions") or {}).get(
+                    "txn", {}).get("start_ts")
+                if not rows or rows[0].get("val") != old or not start_ts:
+                    return {**op, "type": "fail"}
+                mut = http_json(
+                    f"http://{self.node}:{ALPHA_HTTP_PORT}/mutate"
+                    f"?startTs={start_ts}",
+                    {"set": [{"uid": rows[0]["uid"], "val": new}]},
+                    timeout_s=self.timeout_s)
+                if mut.get("errors"):
+                    return {**op, "type": "fail",
+                            "error": ["txn", str(mut["errors"])]}
+                txn = (mut.get("extensions") or {}).get("txn", {})
+                try:
+                    commit = http_json(
+                        f"http://{self.node}:{ALPHA_HTTP_PORT}/commit"
+                        f"?startTs={start_ts}",
+                        {"keys": txn.get("keys") or [],
+                         "preds": txn.get("preds") or []},
+                        timeout_s=self.timeout_s)
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:  # aborted: lost the conflict race
+                        return {**op, "type": "fail"}
+                    raise
+                if commit.get("errors"):
+                    return {**op, "type": "fail",
+                            "error": ["txn", str(commit["errors"])]}
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except DgraphError as e:
+            # txn conflicts abort server-side: definite failure
+            if "conflict" in str(e).lower() or "aborted" in str(e).lower():
+                return {**op, "type": "fail", "error": ["txn", str(e)]}
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["dgraph", str(e)]}
+        except urllib.error.HTTPError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+class DgraphError(Exception):
+    pass
+
+
+SUPPORTED_WORKLOADS = ("set", "register")
+
+
+def dgraph_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="dgraph", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": DgraphDB(o.get("version", DEFAULT_VERSION)),
+            "client": DgraphClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(dgraph_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-dgraph")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

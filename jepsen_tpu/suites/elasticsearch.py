@@ -1,0 +1,203 @@
+"""Elasticsearch test suite (reference: elasticsearch/ in
+jaydenwen123/jepsen — elasticsearch/src/jepsen/elasticsearch/sets.clj
+indexes docs and checks the final search against attempted adds;
+dirty_read.clj hunts reads of uncommitted/lost writes).
+
+The client rides the REST API with stdlib urllib. Set adds index one
+doc per element followed by the reference's explicit ``_refresh``
+before final reads; register CAS uses optimistic concurrency control
+(``if_seq_no``/``if_primary_term`` conditional updates), the REST-era
+equivalent of the versioned updates the reference's dirty-read client
+does through the Java transport.
+
+DB automation installs the archive, sets ``discovery`` to the node
+list, and runs the bundled launcher — the ``install!``/``configure!``/
+``start!`` cycle of elasticsearch/src/jepsen/elasticsearch/core.clj.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_json, quote
+
+logger = logging.getLogger("jepsen.elasticsearch")
+
+DEFAULT_VERSION = "7.17.21"
+DIR = "/opt/elasticsearch"
+LOG_FILE = f"{DIR}/logs/jepsen.log"
+PIDFILE = f"{DIR}/es.pid"
+PORT = 9200
+INDEX = "jepsen"
+
+
+def archive_url(version: str) -> str:
+    return ("https://artifacts.elastic.co/downloads/elasticsearch/"
+            f"elasticsearch-{version}-linux-x86_64.tar.gz")
+
+
+class ElasticsearchDB(db_mod.DB, db_mod.Process, db_mod.Pause,
+                      db_mod.LogFiles):
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing elasticsearch %s", node, self.version)
+        cu.install_archive(archive_url(self.version), DIR)
+        nodes = test.get("nodes") or []
+        conf = "\n".join([
+            "cluster.name: jepsen",
+            f"node.name: {node}",
+            "network.host: 0.0.0.0",
+            f"discovery.seed_hosts: [{', '.join(nodes)}]",
+            f"cluster.initial_master_nodes: [{', '.join(nodes)}]",
+            "xpack.security.enabled: false",
+        ]) + "\n"
+        from jepsen_tpu import control
+        control.exec_("tee", f"{DIR}/config/elasticsearch.yml", stdin=conf)
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/data")
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/bin/elasticsearch")
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/bin/elasticsearch", PIDFILE)
+        cu.grepkill("org.elasticsearch.bootstrap.Elasticsearch")
+
+    def pause(self, test, node):
+        cu.grepkill("org.elasticsearch.bootstrap.Elasticsearch", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("org.elasticsearch.bootstrap.Elasticsearch", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class ElasticsearchClient(Client):
+    """Register r/w/cas via seq_no-conditional updates; set via one doc
+    per element plus refresh-then-search final reads."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return ElasticsearchClient(self.timeout_s, node)
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.node}:{PORT}/{path}"
+
+    def _get_doc(self, k):
+        """(value, seq_no, primary_term) or (None, None, None)."""
+        try:
+            doc = http_json(self._url(f"{INDEX}/_doc/{quote(k)}"),
+                            timeout_s=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None, None, None
+            raise
+        return (doc["_source"]["v"], doc["_seq_no"], doc["_primary_term"])
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                http_json(self._url(f"{INDEX}-set/_doc/{quote(v)}"
+                                    "?wait_for_active_shards=all"),
+                          {"v": v}, method="PUT", timeout_s=self.timeout_s)
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                # final read: explicit refresh first (sets.clj pattern),
+                # then page the full set via sorted search_after — a
+                # size-capped single search silently truncates >10k
+                # elements into false "lost" verdicts
+                http_json(self._url(f"{INDEX}-set/_refresh"), method="POST",
+                          timeout_s=self.timeout_s)
+                elems: list = []
+                after = None
+                while True:
+                    body = {"size": 10000, "query": {"match_all": {}},
+                            "sort": [{"v": "asc"}]}
+                    if after is not None:
+                        body["search_after"] = after
+                    res = http_json(self._url(f"{INDEX}-set/_search"),
+                                    body, timeout_s=self.timeout_s)
+                    hits = res["hits"]["hits"]
+                    elems.extend(h["_source"]["v"] for h in hits)
+                    if len(hits) < 10000:
+                        return {**op, "type": "ok", "value": elems}
+                    after = hits[-1]["sort"]
+            if f == "read":
+                k, _ = v
+                value, _s, _t = self._get_doc(k)
+                return {**op, "type": "ok", "value": [k, value]}
+            if f == "write":
+                k, val = v
+                http_json(self._url(f"{INDEX}/_doc/{quote(k)}"), {"v": val},
+                          method="PUT", timeout_s=self.timeout_s)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                current, seq_no, term = self._get_doc(k)
+                if current != old or seq_no is None:
+                    return {**op, "type": "fail"}
+                try:
+                    http_json(
+                        self._url(f"{INDEX}/_doc/{quote(k)}"
+                                  f"?if_seq_no={seq_no}"
+                                  f"&if_primary_term={term}"),
+                        {"v": new}, method="PUT", timeout_s=self.timeout_s)
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:  # version conflict: lost the race
+                        return {**op, "type": "fail"}
+                    raise
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+SUPPORTED_WORKLOADS = ("set", "register")
+
+
+def elasticsearch_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="elasticsearch",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": ElasticsearchDB(o.get("version", DEFAULT_VERSION)),
+            "client": ElasticsearchClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(elasticsearch_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-elasticsearch")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

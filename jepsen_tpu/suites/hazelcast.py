@@ -1,0 +1,202 @@
+"""Hazelcast test suite (reference: hazelcast/src/jepsen/hazelcast.clj
+— a 5-node Hazelcast member cluster probed through queue, atomic-long
+unique-id, CAS, and lock clients; the queue client offers/polls and
+drains at the end, checked with total-queue :266-317).
+
+This suite carries the queue workload over Hazelcast's REST map/queue
+API (``/hazelcast/rest/queues/<q>``): enqueue = POST offer, dequeue =
+poll with a bounded timeout, drain = poll-until-empty — the REST-era
+equivalent of the reference's queue-client (hazelcast.clj:270-296).
+The CP-subsystem clients (atomic long, cas register, fenced lock) are
+only reachable through the Java client protocol and are out of REST
+scope; run CAS workloads against the suites with server-side CAS
+(etcd, zookeeper, ignite, consul).
+
+DB automation unpacks the Hazelcast distribution, writes a tcp-ip
+member list plus REST-endpoint-groups config, and runs bin/hz-start —
+the install!/configure!/start! cycle of hazelcast.clj:57-116.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_json, quote
+
+logger = logging.getLogger("jepsen.hazelcast")
+
+DEFAULT_VERSION = "5.3.7"
+DIR = "/opt/hazelcast"
+LOG_FILE = f"{DIR}/jepsen.log"
+PIDFILE = f"{DIR}/hz.pid"
+PORT = 5701
+QUEUE = "jepsen.queue"
+POLL_TIMEOUT_S = 1
+
+CONFIG_YAML = """hazelcast:
+  cluster-name: jepsen
+  network:
+    port:
+      port: %(port)d
+    rest-api:
+      enabled: true
+      endpoint-groups:
+        DATA:
+          enabled: true
+    join:
+      multicast:
+        enabled: false
+      tcp-ip:
+        enabled: true
+        member-list: [%(members)s]
+  queue:
+    %(queue)s:
+      backup-count: 2
+"""
+
+
+def archive_url(version: str) -> str:
+    return ("https://repository.hazelcast.com/download/hazelcast/"
+            f"hazelcast-{version}.tar.gz")
+
+
+class HazelcastDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing hazelcast %s", node, self.version)
+        from jepsen_tpu import control
+        cu.install_archive(archive_url(self.version), DIR)
+        members = ", ".join(test.get("nodes") or [])
+        control.exec_("tee", f"{DIR}/config/hazelcast.yaml",
+                      stdin=CONFIG_YAML % {"port": PORT, "members": members,
+                                           "queue": QUEUE})
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/logs")
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR,
+             "env": {"HAZELCAST_CONFIG": f"{DIR}/config/hazelcast.yaml"}},
+            f"{DIR}/bin/hz-start")
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/bin/hz-start", PIDFILE)
+        cu.grepkill("com.hazelcast.core.server.HazelcastMemberStarter")
+
+    def pause(self, test, node):
+        cu.grepkill("com.hazelcast.core.server.HazelcastMemberStarter",
+                    sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("com.hazelcast.core.server.HazelcastMemberStarter",
+                    sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class HazelcastClient(Client):
+    """Queue ops over the REST data endpoint group."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return HazelcastClient(self.timeout_s, node)
+
+    def _base_url(self) -> str:
+        return (f"http://{self.node}:{PORT}/hazelcast/rest/queues/"
+                f"{quote(QUEUE)}")
+
+    def _offer(self, v) -> None:
+        """POST with the value as the request body."""
+        http_json(self._base_url(), method="POST",
+                  raw_body=str(v).encode(),
+                  headers={"Content-Type": "text/plain"},
+                  timeout_s=self.timeout_s)
+
+    def _poll(self):
+        """DELETE /queues/<q>/<timeout-s>; the item (str) or None when
+        empty (204 / empty body)."""
+        raw = http_json(f"{self._base_url()}/{POLL_TIMEOUT_S}",
+                        method="DELETE",
+                        timeout_s=self.timeout_s + POLL_TIMEOUT_S)
+        if raw is None or raw == "":
+            return None
+        return raw
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        drained: list = []
+        try:
+            if f == "enqueue":
+                self._offer(v)
+                return {**op, "type": "ok"}
+            if f == "dequeue":
+                raw = self._poll()
+                if raw is None:
+                    return {**op, "type": "fail"}
+                return {**op, "type": "ok", "value": int(raw)}
+            if f == "drain":
+                while True:
+                    raw = self._poll()
+                    if raw is None:
+                        return {**op, "type": "ok", "value": drained}
+                    drained.append(int(raw))
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except urllib.error.HTTPError as e:
+            if f == "drain":
+                # elements already polled were consumed: keep them in the
+                # indeterminate completion so total-queue doesn't count
+                # them lost
+                return {**op, "type": "info", "value": drained,
+                        "error": ["http", e.code]}
+            kind = "fail" if f == "dequeue" else "info"
+            return {**op, "type": kind, "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            if f == "drain":
+                return {**op, "type": "info", "value": drained,
+                        "error": ["net", str(e)]}
+            kind = "fail" if f == "dequeue" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+SUPPORTED_WORKLOADS = ("queue",)
+
+
+def hazelcast_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="hazelcast",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": HazelcastDB(o.get("version", DEFAULT_VERSION)),
+            "client": HazelcastClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(hazelcast_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-hazelcast")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

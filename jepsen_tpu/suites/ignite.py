@@ -1,0 +1,195 @@
+"""Apache Ignite test suite (reference: ignite/ in jaydenwen123/jepsen
+— ignite/src/jepsen/ignite/register.clj checks a linearizable cache
+register through Ignite's atomic cache ops; bank.clj runs transfer
+transactions over the Java client).
+
+The client rides Ignite's REST API (the ignite-rest-http module):
+``?cmd=get/put/cas`` against an atomic REPLICATED cache, where ``cas``
+is Ignite's native compare-and-put (``val2`` = expected) — so the
+register workload's CAS is a single server-side atomic op, no
+read-modify-write window. The bank workload needs the Java client's
+transactions and stays out of REST scope (run it against the SQL
+suites instead). DB automation unpacks the binary release, enables the
+REST module, writes static TcpDiscovery IP-finder config over the node
+list, and runs ignite.sh.
+"""
+from __future__ import annotations
+
+import logging
+import urllib.error
+import urllib.parse
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_json
+
+logger = logging.getLogger("jepsen.ignite")
+
+DEFAULT_VERSION = "2.16.0"
+DIR = "/opt/ignite"
+LOG_FILE = f"{DIR}/jepsen.log"
+PIDFILE = f"{DIR}/ignite.pid"
+REST_PORT = 8080
+CACHE = "jepsen"
+
+CONFIG_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<beans xmlns="http://www.springframework.org/schema/beans"
+       xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance"
+       xsi:schemaLocation="http://www.springframework.org/schema/beans
+       http://www.springframework.org/schema/beans/spring-beans.xsd">
+  <bean id="ignite.cfg"
+        class="org.apache.ignite.configuration.IgniteConfiguration">
+    <property name="cacheConfiguration">
+      <bean class="org.apache.ignite.configuration.CacheConfiguration">
+        <property name="name" value="%(cache)s"/>
+        <property name="cacheMode" value="REPLICATED"/>
+        <property name="atomicityMode" value="ATOMIC"/>
+        <property name="writeSynchronizationMode" value="FULL_SYNC"/>
+      </bean>
+    </property>
+    <property name="discoverySpi">
+      <bean class="org.apache.ignite.spi.discovery.tcp.TcpDiscoverySpi">
+        <property name="ipFinder">
+          <bean class="org.apache.ignite.spi.discovery.tcp.ipfinder.vm.TcpDiscoveryVmIpFinder">
+            <property name="addresses">
+              <list>%(addresses)s</list>
+            </property>
+          </bean>
+        </property>
+      </bean>
+    </property>
+  </bean>
+</beans>
+"""
+
+
+def archive_url(version: str) -> str:
+    return ("https://archive.apache.org/dist/ignite/"
+            f"{version}/apache-ignite-{version}-bin.zip")
+
+
+class IgniteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing ignite %s", node, self.version)
+        from jepsen_tpu import control
+        cu.install_archive(archive_url(self.version), DIR)
+        # REST API ships disabled: enable the optional module
+        control.exec_(control.lit(
+            f"cp -rn {DIR}/libs/optional/ignite-rest-http "
+            f"{DIR}/libs/ 2>/dev/null || true"))
+        addresses = "".join(f"<value>{n}:47500..47509</value>"
+                            for n in (test.get("nodes") or []))
+        control.exec_("tee", f"{DIR}/config/jepsen.xml",
+                      stdin=CONFIG_XML % {"cache": CACHE,
+                                          "addresses": addresses})
+        self.start(test, node)
+        cu.await_tcp_port(REST_PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/work")
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/bin/ignite.sh", f"{DIR}/config/jepsen.xml")
+
+    def kill(self, test, node):
+        cu.stop_daemon(f"{DIR}/bin/ignite.sh", PIDFILE)
+        cu.grepkill("org.apache.ignite.startup.cmdline.CommandLineStartup")
+
+    def pause(self, test, node):
+        cu.grepkill("org.apache.ignite.startup.cmdline.CommandLineStartup",
+                    sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("org.apache.ignite.startup.cmdline.CommandLineStartup",
+                    sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class IgniteClient(Client):
+    """Register ops via REST ``cmd=get/put/cas`` on the replicated cache."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return IgniteClient(self.timeout_s, node)
+
+    def _cmd(self, **params):
+        qs = urllib.parse.urlencode({"cacheName": CACHE, **params})
+        doc = http_json(f"http://{self.node}:{REST_PORT}/ignite?{qs}",
+                        timeout_s=self.timeout_s)
+        if doc.get("successStatus") != 0:
+            raise IgniteError(doc.get("error") or str(doc))
+        return doc.get("response")
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read":
+                k, _ = v
+                raw = self._cmd(cmd="get", key=f"r{k}")
+                return {**op, "type": "ok",
+                        "value": [k, int(raw) if raw is not None else None]}
+            if f == "write":
+                k, val = v
+                self._cmd(cmd="put", key=f"r{k}", val=str(val))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                ok = self._cmd(cmd="cas", key=f"r{k}", val=str(new),
+                               val2=str(old))
+                return {**op, "type": "ok" if ok else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except IgniteError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["ignite", str(e)]}
+        except urllib.error.HTTPError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["http", e.code]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        pass
+
+
+class IgniteError(Exception):
+    pass
+
+
+SUPPORTED_WORKLOADS = ("register",)
+
+
+def ignite_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="ignite", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": IgniteDB(o.get("version", DEFAULT_VERSION)),
+            "client": IgniteClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(ignite_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-ignite")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,425 @@
+"""HTTP-API suites (elasticsearch, crate, dgraph, ignite, hazelcast,
+chronos): client wire behavior against scripted in-process HTTP
+servers, DB-automation command shapes over the dummy remote, and full
+fake-mode lifecycle runs (reference tier-1/2 strategy, SURVEY.md §4)."""
+from __future__ import annotations
+
+import json
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from jepsen_tpu import control
+from jepsen_tpu.suites import (chronos, crate, dgraph, elasticsearch,
+                               hazelcast, ignite)
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class ScriptedHTTP:
+    """Serves responses from a handler fn(method, path, body) ->
+    (status, payload); records every request."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.requests: list[tuple[str, str, bytes]] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _go(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                outer.requests.append((self.command, self.path, body))
+                status, payload = outer.fn(self.command, self.path, body)
+                raw = (json.dumps(payload).encode()
+                       if not isinstance(payload, (bytes, str))
+                       else (payload.encode() if isinstance(payload, str)
+                             else payload))
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _go
+
+            def log_message(self, *a):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def hostport(port):
+    return f"127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------------------
+# ignite: REST cas protocol
+# ---------------------------------------------------------------------------
+
+def test_ignite_client_cas_protocol():
+    def fn(method, path, body):
+        if "cmd=get" in path:
+            return 200, {"successStatus": 0, "response": "7"}
+        if "cmd=cas" in path:
+            ok = "val2=7" in path
+            return 200, {"successStatus": 0, "response": ok}
+        if "cmd=put" in path:
+            return 200, {"successStatus": 0, "response": True}
+        return 200, {"successStatus": 1, "error": "bad cmd"}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        c = ignite.IgniteClient(node="127.0.0.1")
+        # patch port by pointing REST_PORT-based URL at the fake server
+        c._cmd_orig = c._cmd
+        import urllib.parse
+
+        def _cmd(**params):
+            qs = urllib.parse.urlencode({"cacheName": ignite.CACHE, **params})
+            from jepsen_tpu.suites._http import http_json
+            doc = http_json(f"http://127.0.0.1:{srv.port}/ignite?{qs}")
+            if doc.get("successStatus") != 0:
+                raise ignite.IgniteError(doc.get("error") or str(doc))
+            return doc.get("response")
+        c._cmd = _cmd
+
+        op = {"type": "invoke", "process": 0, "f": "read", "value": [3, None]}
+        assert c.invoke({}, op)["value"] == [3, 7]
+        cas = {"type": "invoke", "process": 0, "f": "cas", "value": [3, [7, 9]]}
+        assert c.invoke({}, cas)["type"] == "ok"
+        cas_bad = {"type": "invoke", "process": 0, "f": "cas",
+                   "value": [3, [6, 9]]}
+        assert c.invoke({}, cas_bad)["type"] == "fail"
+        w = {"type": "invoke", "process": 0, "f": "write", "value": [3, 5]}
+        assert c.invoke({}, w)["type"] == "ok"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# crate: _sql endpoint
+# ---------------------------------------------------------------------------
+
+def test_crate_client_sql_protocol():
+    state = {"val": 4}
+
+    def fn(method, path, body):
+        doc = json.loads(body) if body else {}
+        stmt = doc.get("stmt", "")
+        if stmt.startswith("UPDATE registers SET val"):
+            new, k, old = doc["args"]
+            if state["val"] == old:
+                state["val"] = new
+                return 200, {"rowcount": 1, "rows": []}
+            return 200, {"rowcount": 0, "rows": []}
+        if stmt.startswith("SELECT val"):
+            return 200, {"rows": [[state["val"]]]}
+        if stmt.startswith("SELECT id"):
+            return 200, {"rows": [[1], [2]]}
+        return 200, {"rowcount": 1, "rows": []}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        c = crate.CrateClient(node="127.0.0.1")
+        real_sql = c._sql
+
+        def _sql(stmt, args=None):
+            from jepsen_tpu.suites._http import http_json
+            return http_json(f"http://127.0.0.1:{srv.port}/_sql",
+                             {"stmt": stmt, "args": args or []})
+        c._sql = _sql
+
+        r = c.invoke({}, {"type": "invoke", "f": "read", "value": [9, None]})
+        assert r["type"] == "ok" and r["value"] == [9, 4]
+        good = c.invoke({}, {"type": "invoke", "f": "cas", "value": [9, [4, 5]]})
+        assert good["type"] == "ok" and state["val"] == 5
+        bad = c.invoke({}, {"type": "invoke", "f": "cas", "value": [9, [4, 6]]})
+        assert bad["type"] == "fail" and state["val"] == 5
+        s = c.invoke({}, {"type": "invoke", "f": "read", "value": None})
+        assert s["value"] == [1, 2]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# dgraph: txn cas protocol (query@ts -> mutate@ts -> commit)
+# ---------------------------------------------------------------------------
+
+def test_dgraph_client_txn_cas():
+    committed = {"n": 0}
+
+    def fn(method, path, body):
+        if path.startswith("/query"):
+            return 200, {"data": {"q": [{"uid": "0x1", "val": 3}]},
+                         "extensions": {"txn": {"start_ts": 42}}}
+        if path.startswith("/mutate"):
+            assert "startTs=42" in path
+            return 200, {"data": {},
+                         "extensions": {"txn": {"start_ts": 42,
+                                                "keys": ["k1"],
+                                                "preds": ["1-val"]}}}
+        if path.startswith("/commit"):
+            committed["n"] += 1
+            return 200, {"data": {"code": "Success"}}
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.dgraph as dg
+        c = dg.DgraphClient(node="127.0.0.1")
+        old_port = dg.ALPHA_HTTP_PORT
+        dg.ALPHA_HTTP_PORT = srv.port
+        try:
+            ok = c.invoke({}, {"type": "invoke", "f": "cas",
+                               "value": [7, [3, 8]]})
+            assert ok["type"] == "ok" and committed["n"] == 1
+            stale = c.invoke({}, {"type": "invoke", "f": "cas",
+                                  "value": [7, [5, 8]]})
+            assert stale["type"] == "fail" and committed["n"] == 1
+        finally:
+            dg.ALPHA_HTTP_PORT = old_port
+    finally:
+        srv.stop()
+
+
+def test_dgraph_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = dgraph.DgraphDB()
+    try:
+        control.on("n2", t, lambda: db.start(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "alpha" in joined and "--zero n1:5080" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+# ---------------------------------------------------------------------------
+# hazelcast: queue REST mapping
+# ---------------------------------------------------------------------------
+
+def test_hazelcast_client_queue_protocol():
+    # offer = POST with the value as request body; poll = DELETE with a
+    # timeout path segment (the Hazelcast REST queue API shape)
+    q: list[str] = ["10", "11"]
+
+    def fn(method, path, body):
+        if method == "POST":
+            q.append(body.decode())
+            return 200, ""
+        assert method == "DELETE" and path.endswith("/1")
+        return 200, (q.pop(0) if q else "")
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.hazelcast as hz
+        c = hz.HazelcastClient(node="127.0.0.1")
+        old_port = hz.PORT
+        hz.PORT = srv.port
+        try:
+            e = c.invoke({}, {"type": "invoke", "f": "enqueue", "value": 12})
+            assert e["type"] == "ok"
+            d = c.invoke({}, {"type": "invoke", "f": "dequeue"})
+            assert d["type"] == "ok" and d["value"] == 10
+            dr = c.invoke({}, {"type": "invoke", "f": "drain"})
+            assert dr["type"] == "ok" and dr["value"] == [11, 12]
+            empty = c.invoke({}, {"type": "invoke", "f": "dequeue"})
+            assert empty["type"] == "fail"
+        finally:
+            hz.PORT = old_port
+    finally:
+        srv.stop()
+
+
+def test_hazelcast_drain_crash_keeps_partial_elements():
+    """A network error mid-drain must not lose already-polled elements."""
+    from jepsen_tpu import checker as chk
+    polls = {"n": 0}
+
+    def fn(method, path, body):
+        if method == "POST":
+            return 200, ""
+        polls["n"] += 1
+        if polls["n"] >= 3:
+            raise BrokenPipeError("boom")  # kills the connection
+        return 200, str(polls["n"])
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.hazelcast as hz
+        c = hz.HazelcastClient(node="127.0.0.1", timeout_s=2)
+        old_port = hz.PORT
+        hz.PORT = srv.port
+        try:
+            dr = c.invoke({}, {"type": "invoke", "f": "drain"})
+            assert dr["type"] == "info"
+            assert dr["value"] == [1, 2]
+        finally:
+            hz.PORT = old_port
+        # the expansion turns the partial info drain into real dequeues
+        h = [{"type": "invoke", "process": 0, "f": "enqueue", "value": 1},
+             {"type": "ok", "process": 0, "f": "enqueue", "value": 1},
+             {"type": "invoke", "process": 0, "f": "enqueue", "value": 2},
+             {"type": "ok", "process": 0, "f": "enqueue", "value": 2},
+             {"type": "invoke", "process": 1, "f": "drain"},
+             {**dr, "process": 1}]
+        res = chk.total_queue().check({}, h, {})
+        assert res["valid?"] is True and res["lost-count"] == 0
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# elasticsearch: seq_no CAS mapping
+# ---------------------------------------------------------------------------
+
+def test_elasticsearch_client_cas_protocol():
+    doc = {"v": 1, "seq": 5, "term": 1}
+
+    def fn(method, path, body):
+        if method == "GET" and "/_doc/" in path:
+            return 200, {"_source": {"v": doc["v"]}, "_seq_no": doc["seq"],
+                         "_primary_term": doc["term"]}
+        if method == "PUT" and "if_seq_no=" in path:
+            want = int(path.split("if_seq_no=")[1].split("&")[0])
+            if want != doc["seq"]:
+                return 409, {"error": "version_conflict"}
+            doc["v"] = json.loads(body)["v"]
+            doc["seq"] += 1
+            return 200, {"result": "updated"}
+        if method == "PUT":
+            doc["v"] = json.loads(body)["v"]
+            doc["seq"] += 1
+            return 200, {"result": "updated"}
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.elasticsearch as es
+        c = es.ElasticsearchClient(node="127.0.0.1")
+        old_port = es.PORT
+        es.PORT = srv.port
+        try:
+            ok = c.invoke({}, {"type": "invoke", "f": "cas",
+                               "value": [0, [1, 2]]})
+            assert ok["type"] == "ok" and doc["v"] == 2
+            stale = c.invoke({}, {"type": "invoke", "f": "cas",
+                                  "value": [0, [1, 3]]})
+            assert stale["type"] == "fail"
+            # race: doc moves between read and conditional put -> 409 -> fail
+            doc["v"] = 3
+            real_get = c._get_doc
+
+            def racy_get(k):
+                v, s, t = real_get(k)
+                doc["seq"] += 1  # someone else writes in the window
+                return v, s, t
+            c._get_doc = racy_get
+            raced = c.invoke({}, {"type": "invoke", "f": "cas",
+                                  "value": [0, [3, 4]]})
+            assert raced["type"] == "fail"
+        finally:
+            es.PORT = old_port
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chronos: targets + matching + full fake run
+# ---------------------------------------------------------------------------
+
+def test_chronos_targets_and_matching():
+    job = {"name": 1, "start": 100, "interval": 60, "count": 3,
+           "epsilon": 10, "duration": 5}
+    # read at 400: all 3 targets due (last begins 220, finish cutoff 385)
+    targets = chronos.job_targets(400, job)
+    assert [t[0] for t in targets] == [100, 160, 220]
+    assert targets[0][1] == 100 + 10 + chronos.EPSILON_FORGIVENESS
+    # read at 170: only the first two targets are due
+    assert [t[0] for t in chronos.job_targets(230, job)] == [100, 160]
+
+    matched, unmatched = chronos.match_targets(targets, [101, 162, 221])
+    assert not unmatched and len(matched) == 3
+    # one run can't satisfy two targets
+    matched, unmatched = chronos.match_targets(targets, [101])
+    assert len(matched) == 1 and len(unmatched) == 2
+    # greedy must leave the early run for the early window
+    two = chronos.job_targets(230, job)
+    matched, unmatched = chronos.match_targets(two, [114, 115])
+    assert len(unmatched) == 1  # 115 fits window-1 only; 160s window empty
+
+
+def test_chronos_checker_verdicts():
+    ck = chronos.ChronosChecker()
+    job = {"name": 1, "start": 100, "interval": 60, "count": 2,
+           "epsilon": 10, "duration": 0}
+    h = [
+        {"type": "invoke", "process": 0, "f": "add-job", "value": job},
+        {"type": "ok", "process": 0, "f": "add-job", "value": job},
+        {"type": "invoke", "process": 1, "f": "read"},
+        {"type": "ok", "process": 1, "f": "read",
+         "value": {"read-time": 400, "runs": {"1": [100, 161]}}},
+    ]
+    assert ck.check({}, h, {})["valid?"] is True
+    h[-1]["value"]["runs"]["1"] = [100]
+    res = ck.check({}, h, {})
+    assert res["valid?"] is False
+    assert res["jobs"]["1"]["unmatched"] == [[160, 175]]
+
+
+def test_chronos_fake_run():
+    with tempfile.TemporaryDirectory() as tmp:
+        t = chronos.chronos_test({"fake": True, "time_limit": 1.0,
+                                  "store_dir": tmp, "no_perf": True,
+                                  "accelerator": "cpu"})
+        from jepsen_tpu import core
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycle for the other new suites
+# ---------------------------------------------------------------------------
+
+def run_fake(suite_test_fn, **opts):
+    with tempfile.TemporaryDirectory() as tmp:
+        t = suite_test_fn({"fake": True, "time_limit": 1.0,
+                           "store_dir": tmp, "no_perf": True,
+                           "accelerator": "cpu", **opts})
+        from jepsen_tpu import core
+        return core.run(t)
+
+
+def test_hazelcast_fake_queue_run():
+    result = run_fake(hazelcast.hazelcast_test, workload="queue")
+    r = result["results"]
+    assert r["valid?"] is True, r
+    assert r["workload"]["attempt-count"] > 0
+
+
+def test_elasticsearch_fake_set_run():
+    result = run_fake(elasticsearch.elasticsearch_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_crate_fake_register_run():
+    result = run_fake(crate.crate_test, workload="register")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_ignite_fake_register_run():
+    result = run_fake(ignite.ignite_test, workload="register")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_dgraph_fake_set_run():
+    result = run_fake(dgraph.dgraph_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
