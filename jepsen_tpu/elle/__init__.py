@@ -107,23 +107,28 @@ def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
         return [(s, d, t) for s, d, t in graph.edges
                 if (types is None or t in types) and s in keep and d in keep]
 
+    # The trim residue is a *superset* of the cycle nodes (and may be
+    # loose when the peel hits its iteration cap on long-diameter graphs),
+    # so only the exact host search's findings count as anomalies.
+
     # G0: ww-only cycles
     ww_edges = residue({WW})
-    if ww_edges:
-        anomalies["G0"] = _exemplars(graph.n, ww_edges)
+    g0 = _exemplars(graph.n, ww_edges) if ww_edges else []
+    if g0:
+        anomalies["G0"] = g0
 
     # G1c: ww+wr cycles involving at least one wr edge
     g1_edges = residue({WW, WR})
     if g1_edges:
-        if not ww_edges:
-            anomalies["G1c"] = _exemplars(graph.n, g1_edges)
+        if not g0:
+            g1c = _exemplars(graph.n, g1_edges)
         else:
             # an SCC may contain both a pure-ww cycle (already reported as
             # G0) and a mixed cycle; search specifically for a cycle
             # through each wr edge so G1c isn't shadowed
-            mixed = _cycles_through_type(graph.n, g1_edges, WR)
-            if mixed:
-                anomalies["G1c"] = mixed
+            g1c = _cycles_through_type(graph.n, g1_edges, WR)
+        if g1c:
+            anomalies["G1c"] = g1c
 
     # full graph: G-single / G2
     full_edges = residue(None)
@@ -149,17 +154,19 @@ def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
     return anomalies
 
 
-def _trim_cpu(n, src, dst):
-    """Pure-numpy twin of the device trim kernel (oracle)."""
+def _trim_cpu(n, src, dst, max_iters: int = 512):
+    """Pure-numpy twin of the device trim kernel (oracle). Same iteration
+    cap: the residue is a superset of the cycle nodes either way."""
     active = np.ones(n, dtype=bool)
-    while True:
+    for _ in range(max_iters):
         ea = active[src] & active[dst]
         indeg = np.bincount(dst[ea], minlength=n) > 0
         outdeg = np.bincount(src[ea], minlength=n) > 0
         new = active & indeg & outdeg
         if (new == active).all():
-            return active
+            break
         active = new
+    return active
 
 
 def _exemplars(n, edges, limit: int = 10):
@@ -207,7 +214,8 @@ def result_map(anomalies: dict, txns, extra_anomalies: dict | None = None,
     proscribed by the requested consistency models."""
     merged: dict[str, Any] = {}
     for k, cycles in anomalies.items():
-        merged[k] = [render_cycle(c, txns) for c in cycles[:10]]
+        if cycles:
+            merged[k] = [render_cycle(c, txns) for c in cycles[:10]]
     for k, v in (extra_anomalies or {}).items():
         if v:
             merged[k] = v[:10] if isinstance(v, list) else v

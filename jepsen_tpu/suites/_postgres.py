@@ -1,0 +1,241 @@
+"""Minimal PostgreSQL v3 wire-protocol client over stdlib sockets.
+
+The reference's postgres-family suites (postgres-rds/src/jepsen/
+postgres_rds.clj, stolon/src/jepsen/stolon.clj, cockroachdb/src/jepsen/
+cockroach.clj, yugabyte/src/yugabyte/ysql.clj) all ride the JVM jdbc/
+postgresql driver; this module is the TPU-framework equivalent wire
+client so those suites need no third-party Python driver.
+
+Implements the subset every suite needs: the startup handshake with
+trust / cleartext / md5 / SCRAM-SHA-256 auth, the simple-query protocol
+with text-format resultsets, error surfacing with SQLSTATE, and clean
+termination. Row cells come back as Python strings (or None for SQL
+NULL) — callers cast; ``parse_int_array`` handles ``int[]`` columns.
+No extended protocol, no COPY, no TLS: test rigs connect over the
+cluster's private network exactly like the reference's conn-specs.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+
+PROTOCOL_V3 = 196608  # 3 << 16
+
+
+class PgError(Exception):
+    """Server ErrorResponse: ``.sqlstate``, ``.severity``, ``.msg``."""
+
+    def __init__(self, fields: dict):
+        self.severity = fields.get("S", "ERROR")
+        self.sqlstate = fields.get("C", "")
+        self.msg = fields.get("M", "")
+        super().__init__(f"[{self.sqlstate}] {self.msg}")
+
+
+# SQLSTATEs every retry loop cares about (class 40 = txn rollback)
+SERIALIZATION_FAILURE = "40001"
+DEADLOCK_DETECTED = "40P01"
+
+
+def parse_int_array(text: str | None) -> list[int]:
+    """``'{1,2,3}'`` → ``[1, 2, 3]`` (text-format int[] columns)."""
+    if not text or text == "{}":
+        return []
+    return [int(x) for x in text.strip("{}").split(",")]
+
+
+def _scram_client(password: str, server_first: str, client_first_bare: str,
+                  ) -> tuple[str, bytes]:
+    """Computes the SCRAM-SHA-256 client-final message and ServerKey
+    (RFC 5802/7677) from the server-first challenge."""
+    parts = dict(kv.split("=", 1) for kv in server_first.split(","))
+    nonce, salt_b64, iters = parts["r"], parts["s"], int(parts["i"])
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                 base64.b64decode(salt_b64), iters)
+    client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored_key = hashlib.sha256(client_key).digest()
+    without_proof = f"c=biws,r={nonce}"
+    auth_message = ",".join([client_first_bare, server_first,
+                             without_proof]).encode()
+    signature = hmac.new(stored_key, auth_message, hashlib.sha256).digest()
+    proof = bytes(a ^ b for a, b in zip(client_key, signature))
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    expect_sig = hmac.new(server_key, auth_message, hashlib.sha256).digest()
+    final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+    return final, expect_sig
+
+
+class PGConnection:
+    """One authenticated connection; ``query`` returns (rows, tag)."""
+
+    def __init__(self, host: str, port: int = 5432, user: str = "postgres",
+                 password: str = "", database: str = "postgres",
+                 timeout_s: float = 10.0):
+        self.host, self.port = host, port
+        self.parameters: dict[str, str] = {}
+        self.txn_status = b"I"
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        try:
+            self._startup(user, password, database)
+        except BaseException:
+            self.sock.close()
+            raise
+
+    # -- framing: backend messages are type byte + int32 length -----------
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(n)
+            if not chunk:
+                raise ConnectionError("postgres server closed connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_message(self) -> tuple[bytes, bytes]:
+        header = self._recv_exact(5)
+        mtype = header[:1]
+        length = struct.unpack("!I", header[1:])[0]
+        return mtype, self._recv_exact(length - 4)
+
+    def _send(self, mtype: bytes, payload: bytes) -> None:
+        self.sock.sendall(mtype + struct.pack("!I", len(payload) + 4)
+                          + payload)
+
+    @staticmethod
+    def _error_fields(payload: bytes) -> dict:
+        fields = {}
+        pos = 0
+        while pos < len(payload) and payload[pos] != 0:
+            code = chr(payload[pos])
+            end = payload.index(b"\x00", pos + 1)
+            fields[code] = payload[pos + 1:end].decode("utf8", "replace")
+            pos = end + 1
+        return fields
+
+    # -- startup / auth ---------------------------------------------------
+
+    def _startup(self, user: str, password: str, database: str) -> None:
+        kv = (f"user\x00{user}\x00database\x00{database}\x00"
+              "application_name\x00jepsen-tpu\x00\x00").encode()
+        payload = struct.pack("!I", PROTOCOL_V3) + kv
+        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+
+        scram_expect_sig = None
+        while True:
+            mtype, body = self._read_message()
+            if mtype == b"E":
+                raise PgError(self._error_fields(body))
+            if mtype == b"R":
+                code = struct.unpack_from("!I", body)[0]
+                if code == 0:  # AuthenticationOk
+                    continue
+                if code == 3:  # CleartextPassword
+                    self._send(b"p", password.encode() + b"\x00")
+                elif code == 5:  # MD5Password
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        password.encode() + user.encode()).hexdigest()
+                    digest = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send(b"p", b"md5" + digest.encode() + b"\x00")
+                elif code == 10:  # SASL: mechanism list
+                    mechs = body[4:].split(b"\x00")
+                    if b"SCRAM-SHA-256" not in mechs:
+                        raise ConnectionError(
+                            f"no supported SASL mechanism in {mechs!r}")
+                    self._scram_bare = (
+                        "n=,r=" + base64.b64encode(os.urandom(18)).decode())
+                    first = ("n,," + self._scram_bare).encode()
+                    self._send(b"p", b"SCRAM-SHA-256\x00"
+                               + struct.pack("!I", len(first)) + first)
+                elif code == 11:  # SASLContinue
+                    server_first = body[4:].decode()
+                    final, scram_expect_sig = _scram_client(
+                        password, server_first, self._scram_bare)
+                    self._send(b"p", final.encode())
+                elif code == 12:  # SASLFinal
+                    fields = dict(kv.split("=", 1) for kv in
+                                  body[4:].decode().split(","))
+                    if scram_expect_sig is not None and base64.b64decode(
+                            fields.get("v", "")) != scram_expect_sig:
+                        raise ConnectionError(
+                            "SCRAM server signature mismatch")
+                else:
+                    raise ConnectionError(
+                        f"unsupported postgres auth method {code}")
+            elif mtype == b"S":  # ParameterStatus
+                k, v = body.split(b"\x00")[:2]
+                self.parameters[k.decode()] = v.decode()
+            elif mtype == b"K":  # BackendKeyData
+                pass
+            elif mtype == b"Z":  # ReadyForQuery
+                self.txn_status = body[:1]
+                return
+            elif mtype == b"N":  # NoticeResponse
+                pass
+            else:
+                raise ConnectionError(
+                    f"unexpected startup message {mtype!r}")
+
+    # -- simple query protocol --------------------------------------------
+
+    def query(self, sql: str):
+        """Runs one statement (simple-query protocol). Resultset → (rows,
+        command tag) with rows as tuples of str|None; statements without
+        a resultset → ([], tag). Raises PgError on server error (the
+        connection stays usable — the protocol resyncs on ReadyForQuery).
+        """
+        self._send(b"Q", sql.encode() + b"\x00")
+        rows: list[tuple] = []
+        tag = ""
+        error: dict | None = None
+        while True:
+            mtype, body = self._read_message()
+            if mtype == b"T":  # RowDescription: column metadata, skipped
+                pass
+            elif mtype == b"D":
+                ncols = struct.unpack_from("!H", body)[0]
+                pos, row = 2, []
+                for _ in range(ncols):
+                    n = struct.unpack_from("!i", body, pos)[0]
+                    pos += 4
+                    if n == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[pos:pos + n].decode("utf8",
+                                                            "replace"))
+                        pos += n
+                rows.append(tuple(row))
+            elif mtype == b"C":
+                tag = body.rstrip(b"\x00").decode()
+            elif mtype == b"E":
+                error = self._error_fields(body)
+            elif mtype in (b"N", b"S", b"I"):  # notice/param/empty-query
+                pass
+            elif mtype == b"Z":
+                self.txn_status = body[:1]
+                if error is not None:
+                    raise PgError(error)
+                return rows, tag
+
+    def rowcount(self, tag: str) -> int:
+        """Affected-row count from a command tag (``'UPDATE 1'`` → 1)."""
+        parts = tag.rsplit(" ", 1)
+        try:
+            return int(parts[-1])
+        except (ValueError, IndexError):
+            return 0
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")  # Terminate
+        except OSError:
+            pass
+        finally:
+            self.sock.close()

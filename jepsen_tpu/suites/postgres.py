@@ -9,12 +9,13 @@ isolation level; the cycle checker then hunts G0/G1/G-single/G2
 anomalies in the dependency graph. Register/set workloads map to a
 keyed table with UPDATE-guarded compare-and-set.
 
-The client needs psycopg2 (not bundled); without it the suite still
-composes and runs with ``--fake`` in-memory doubles — including the
-append workload, which the fake store applies atomically, so the Elle
-checker path is exercised end-to-end without a cluster. DB automation
-installs the distro postgresql, opens it to the test network, and
-creates the jepsen database.
+The client rides the bundled wire-protocol implementation
+(``suites/_postgres.py``) — no third-party driver. ``--fake`` swaps in
+the in-memory doubles — including the append workload, which the fake
+store applies atomically, so the Elle checker path is exercised
+end-to-end without a cluster. DB automation installs the distro
+postgresql, opens it to the test network, and creates the jepsen
+database.
 """
 from __future__ import annotations
 
@@ -26,6 +27,9 @@ from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
                                standard_test_fn)
+from jepsen_tpu.suites._postgres import (PGConnection, PgError,
+                                         SERIALIZATION_FAILURE,
+                                         DEADLOCK_DETECTED, parse_int_array)
 
 logger = logging.getLogger("jepsen.postgres")
 
@@ -94,112 +98,117 @@ CREATE TABLE IF NOT EXISTS lists (k int PRIMARY KEY, elems int[] NOT NULL DEFAUL
 
 
 class PostgresClient(Client):
-    """SQL client for register/set/append workloads. Requires psycopg2;
-    the suite's --fake mode runs without it."""
+    """SQL client for register/set/append workloads over the bundled
+    wire-protocol connection (suites/_postgres.py)."""
+
+    PORT = PORT
+    DB_NAME, DB_USER, DB_PASS = DB_NAME, DB_USER, DB_PASS
 
     def __init__(self, isolation: str = "serializable",
                  timeout_s: float = 5.0, node: str | None = None):
         self.isolation = isolation
         self.timeout_s = timeout_s
         self.node = node
-        self.conn = None
+        self.conn: PGConnection | None = None
+        self._broken = False
 
-    def open(self, test, node):
-        try:
-            import psycopg2
-        except ImportError as e:
-            raise RuntimeError(
-                "psycopg2 is not installed; run this suite with --fake or "
-                "install psycopg2 for a real cluster") from e
+    def endpoint(self, test, node) -> tuple[str, int]:
         # every node runs an independent unreplicated server, so all
         # clients share the first node's instance — otherwise reads on n2
         # could never see writes on n1 and checkers would flag a healthy
         # deployment (the postgres-rds single-endpoint shape)
-        primary = (test.get("nodes") or [node])[0]
-        c = PostgresClient(self.isolation, self.timeout_s, node)
-        c.conn = psycopg2.connect(
-            host=primary, port=PORT, dbname=DB_NAME, user=DB_USER,
-            password=DB_PASS, connect_timeout=int(self.timeout_s))
-        c.conn.autocommit = True
+        return (test.get("nodes") or [node])[0], self.PORT
+
+    def open(self, test, node):
+        c = type(self)(self.isolation, self.timeout_s, node)
+        host, port = c.endpoint(test, node)
+        c.conn = PGConnection(
+            host=host, port=port, database=self.DB_NAME, user=self.DB_USER,
+            password=self.DB_PASS, timeout_s=self.timeout_s)
         return c
 
     def setup(self, test):
-        with self.conn.cursor() as cur:
-            cur.execute(SCHEMA)
+        self.conn.query(SCHEMA)
 
-    def _txn_body(self, cur, micro_ops):
+    def _txn_body(self, micro_ops):
         out = []
         for f, k, v in micro_ops:
             if f == "r":
-                cur.execute("SELECT elems FROM lists WHERE k = %s", (k,))
-                row = cur.fetchone()
-                out.append(["r", k, list(row[0]) if row else []])
+                rows, _ = self.conn.query(
+                    f"SELECT elems FROM lists WHERE k = {int(k)}")
+                out.append(["r", k,
+                            parse_int_array(rows[0][0]) if rows else []])
             elif f == "append":
-                cur.execute(
-                    "INSERT INTO lists (k, elems) VALUES (%s, ARRAY[%s]) "
-                    "ON CONFLICT (k) DO UPDATE "
-                    "SET elems = lists.elems || %s", (k, v, v))
+                self.conn.query(
+                    f"INSERT INTO lists (k, elems) VALUES ({int(k)}, "
+                    f"ARRAY[{int(v)}]) ON CONFLICT (k) DO UPDATE "
+                    f"SET elems = lists.elems || {int(v)}")
                 out.append(["append", k, v])
         return out
 
     def invoke(self, test, op):
-        import psycopg2
         f, v = op.get("f"), op.get("value")
+        if self._broken:
+            # a timed-out/failed socket is desynced (leftover response
+            # bytes would be parsed as the next query's result); the
+            # interpreter only reopens clients on "info" completions, so
+            # reconnect here before touching the wire again
+            self.close(test)
+            host, port = self.endpoint(test, self.node)
+            self.conn = PGConnection(
+                host=host, port=port, database=self.DB_NAME,
+                user=self.DB_USER, password=self.DB_PASS,
+                timeout_s=self.timeout_s)
+            self._broken = False
         try:
-            with self.conn.cursor() as cur:
-                if f == "txn":
-                    self.conn.autocommit = False
+            if f == "txn":
+                level = self.isolation.upper().replace("-", " ")
+                self.conn.query(f"BEGIN ISOLATION LEVEL {level}")
+                try:
+                    out = self._txn_body(v)
+                    self.conn.query("COMMIT")
+                    return {**op, "type": "ok", "value": out}
+                except PgError as e:
                     try:
-                        level = self.isolation.upper().replace("-", " ")
-                        cur.execute(f"SET TRANSACTION ISOLATION LEVEL {level}")
-                        out = self._txn_body(cur, v)
-                        self.conn.commit()
-                        return {**op, "type": "ok", "value": out}
-                    except psycopg2.errors.SerializationFailure:
-                        self.conn.rollback()
+                        self.conn.query("ROLLBACK")
+                    except (PgError, OSError):
+                        pass
+                    if e.sqlstate in (SERIALIZATION_FAILURE,
+                                      DEADLOCK_DETECTED):
                         return {**op, "type": "fail",
-                                "error": ["serialization-failure"]}
-                    except psycopg2.Error:
-                        # any other failure leaves the txn aborted: roll it
-                        # back before restoring autocommit (set_session
-                        # inside an aborted txn raises, masking the cause)
-                        try:
-                            self.conn.rollback()
-                        except psycopg2.Error:
-                            pass
-                        raise
-                    finally:
-                        try:
-                            self.conn.autocommit = True
-                        except psycopg2.Error:
-                            pass
-                if f == "add":
-                    cur.execute("INSERT INTO sets (elem) VALUES (%s) "
-                                "ON CONFLICT DO NOTHING", (v,))
-                    return {**op, "type": "ok"}
-                if f == "read" and v is None:
-                    cur.execute("SELECT elem FROM sets ORDER BY elem")
-                    return {**op, "type": "ok",
-                            "value": [r[0] for r in cur.fetchall()]}
-                if f == "read":
-                    k, _ = v
-                    cur.execute("SELECT v FROM registers WHERE k = %s", (k,))
-                    row = cur.fetchone()
-                    return {**op, "type": "ok",
-                            "value": [k, row[0] if row else None]}
-                if f == "write":
-                    k, val = v
-                    cur.execute(
-                        "INSERT INTO registers (k, v) VALUES (%s, %s) "
-                        "ON CONFLICT (k) DO UPDATE SET v = %s", (k, val, val))
-                    return {**op, "type": "ok"}
-                if f == "cas":
-                    k, (old, new) = v
-                    cur.execute("UPDATE registers SET v = %s "
-                                "WHERE k = %s AND v = %s", (new, k, old))
-                    return {**op, "type": "ok" if cur.rowcount == 1 else "fail"}
+                                "error": ["serialization-failure", e.msg]}
+                    raise
+            if f == "add":
+                self.conn.query(f"INSERT INTO sets (elem) VALUES ({int(v)}) "
+                                "ON CONFLICT DO NOTHING")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                rows, _ = self.conn.query("SELECT elem FROM sets ORDER BY elem")
+                return {**op, "type": "ok",
+                        "value": [int(r[0]) for r in rows]}
+            if f == "read":
+                k, _ = v
+                rows, _ = self.conn.query(
+                    f"SELECT v FROM registers WHERE k = {int(k)}")
+                val = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                return {**op, "type": "ok", "value": [k, val]}
+            if f == "write":
+                k, val = v
+                self.conn.query(
+                    f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
+                    f"{int(val)}) ON CONFLICT (k) DO UPDATE SET v = {int(val)}")
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                _, tag = self.conn.query(
+                    f"UPDATE registers SET v = {int(new)} "
+                    f"WHERE k = {int(k)} AND v = {int(old)}")
+                ok = self.conn.rowcount(tag) == 1
+                return {**op, "type": "ok" if ok else "fail"}
             return {**op, "type": "fail", "error": ["unknown-f", f]}
-        except psycopg2.OperationalError as e:
+        except OSError as e:
+            self._broken = True
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
 

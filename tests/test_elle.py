@@ -348,3 +348,31 @@ def test_txn_utils():
     assert n == 6
     # appends never overwrite within a txn
     assert int_write_mops([["append", "x", 1], ["append", "x", 2]]) == []
+
+
+def test_long_chain_no_false_cycle():
+    """A serial history longer than the trim's iteration cap must not be
+    reported cyclic: the capped peel leaves an acyclic residue and the
+    exact pass must overrule it (regression: 35k-txn fake-mode append
+    runs were flagged G1c with zero witness cycles)."""
+    n = 2000
+    g = Graph(n)
+    for i in range(n - 1):
+        g.add(i, i + 1, WW if i % 2 else WR)
+    # trim with a cap far below the chain length: residue stays non-empty
+    src, dst = g.arrays(None)
+    mask = trim_to_cycles(n, src, dst, max_iters=16)
+    assert mask.any()
+    anoms = check_cycles(g)
+    assert anoms == {}
+
+    # same chain plus one real 3-cycle deep inside: found and classified
+    g.add(500, 400, WR)  # 400..500 chain back-edge => ww+wr cycle
+    anoms = check_cycles(g)
+    assert "G1c" in anoms and anoms["G1c"]
+
+
+def test_result_map_drops_empty_anomaly_lists():
+    from jepsen_tpu.elle import result_map
+    r = result_map({"G1c": []}, [], {})
+    assert r["valid?"] is True and r["anomaly-types"] == []

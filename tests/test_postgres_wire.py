@@ -1,0 +1,243 @@
+"""The stdlib PostgreSQL wire client against a scripted in-process server.
+
+Covers the protocol surface the postgres-family suites depend on
+(startup + trust/md5/SCRAM-SHA-256 auth, simple-query resultsets,
+SQLSTATE error surfacing, int[] parsing), the way the reference
+unit-tests its transports against local endpoints (control_test.clj
+pattern, SURVEY.md §4)."""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites._postgres import (PGConnection, PgError,
+                                         parse_int_array)
+
+PASSWORD = "jepsenpw"
+USER = "jepsen"
+SALT = b"0123456789abcdef"
+ITERS = 4096
+
+
+def _msg(mtype: bytes, payload: bytes) -> bytes:
+    return mtype + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _ready() -> bytes:
+    return _msg(b"Z", b"I")
+
+
+def _row_description(names) -> bytes:
+    body = struct.pack("!H", len(names))
+    for n in names:
+        body += n.encode() + b"\x00" + struct.pack("!IHIHIH", 0, 0, 23, 4,
+                                                   0, 0)
+    return _msg(b"T", body)
+
+
+def _data_row(cells) -> bytes:
+    body = struct.pack("!H", len(cells))
+    for c in cells:
+        if c is None:
+            body += struct.pack("!i", -1)
+        else:
+            raw = str(c).encode()
+            body += struct.pack("!i", len(raw)) + raw
+    return _msg(b"D", body)
+
+
+def _error(sqlstate: str, message: str) -> bytes:
+    body = (b"SERROR\x00" + b"C" + sqlstate.encode() + b"\x00"
+            + b"M" + message.encode() + b"\x00\x00")
+    return _msg(b"E", body)
+
+
+class FakeServer:
+    """Accepts one connection, runs the chosen auth flow, answers
+    scripted queries."""
+
+    def __init__(self, auth: str = "trust"):
+        self.auth = auth
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.errors: list[str] = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _recv_startup(self, conn) -> bytes:
+        n = struct.unpack("!I", self._exact(conn, 4))[0]
+        return self._exact(conn, n - 4)
+
+    def _recv_msg(self, conn) -> tuple[bytes, bytes]:
+        head = self._exact(conn, 5)
+        n = struct.unpack("!I", head[1:])[0]
+        return head[:1], self._exact(conn, n - 4)
+
+    @staticmethod
+    def _exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def _do_auth(self, conn) -> None:
+        if self.auth == "trust":
+            conn.sendall(_msg(b"R", struct.pack("!I", 0)))
+        elif self.auth == "md5":
+            salt = b"ab12"
+            conn.sendall(_msg(b"R", struct.pack("!I", 5) + salt))
+            mtype, body = self._recv_msg(conn)
+            inner = hashlib.md5(PASSWORD.encode() + USER.encode()).hexdigest()
+            expect = b"md5" + hashlib.md5(
+                inner.encode() + salt).hexdigest().encode() + b"\x00"
+            if mtype != b"p" or body != expect:
+                self.errors.append(f"bad md5 response {body!r}")
+            conn.sendall(_msg(b"R", struct.pack("!I", 0)))
+        elif self.auth == "scram":
+            conn.sendall(_msg(b"R", struct.pack("!I", 10)
+                              + b"SCRAM-SHA-256\x00\x00"))
+            mtype, body = self._recv_msg(conn)
+            mech, rest = body.split(b"\x00", 1)
+            if mech != b"SCRAM-SHA-256":
+                self.errors.append(f"bad mechanism {mech!r}")
+            n = struct.unpack("!I", rest[:4])[0]
+            client_first = rest[4:4 + n].decode()
+            bare = client_first[3:]  # strip "n,,"
+            client_nonce = dict(kv.split("=", 1) for kv in
+                                bare.split(","))["r"]
+            server_nonce = client_nonce + "SRVNONCE"
+            server_first = (f"r={server_nonce},"
+                            f"s={base64.b64encode(SALT).decode()},i={ITERS}")
+            conn.sendall(_msg(b"R", struct.pack("!I", 11)
+                              + server_first.encode()))
+            _, final = self._recv_msg(conn)
+            final = final.decode()
+            without_proof, proof_b64 = final.rsplit(",p=", 1)
+            salted = hashlib.pbkdf2_hmac("sha256", PASSWORD.encode(), SALT,
+                                         ITERS)
+            ckey = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+            skey = hashlib.sha256(ckey).digest()
+            auth_msg = ",".join([bare, server_first, without_proof]).encode()
+            sig = hmac.new(skey, auth_msg, hashlib.sha256).digest()
+            expect = bytes(a ^ b for a, b in zip(ckey, sig))
+            if base64.b64decode(proof_b64) != expect:
+                self.errors.append("bad scram proof")
+            server_key = hmac.new(salted, b"Server Key",
+                                  hashlib.sha256).digest()
+            server_sig = hmac.new(server_key, auth_msg,
+                                  hashlib.sha256).digest()
+            conn.sendall(_msg(b"R", struct.pack("!I", 12) + b"v="
+                              + base64.b64encode(server_sig)))
+            conn.sendall(_msg(b"R", struct.pack("!I", 0)))
+
+    def _serve(self):
+        conn, _ = self.sock.accept()
+        try:
+            startup = self._recv_startup(conn)
+            proto = struct.unpack("!I", startup[:4])[0]
+            if proto != 196608:
+                self.errors.append(f"bad protocol {proto}")
+            kv = startup[4:].rstrip(b"\x00").split(b"\x00")
+            params = dict(zip(kv[::2], kv[1::2]))
+            if params.get(b"user") != USER.encode():
+                self.errors.append(f"bad user {params.get(b'user')!r}")
+            self._do_auth(conn)
+            conn.sendall(_msg(b"S", b"server_version\x0015.fake\x00"))
+            conn.sendall(_msg(b"K", struct.pack("!II", 1, 2)))
+            conn.sendall(_ready())
+            while True:
+                mtype, body = self._recv_msg(conn)
+                if mtype == b"X":
+                    return
+                sql = body.rstrip(b"\x00").decode()
+                if sql.startswith("SELECT"):
+                    conn.sendall(_row_description(["k", "elems"]))
+                    conn.sendall(_data_row([5, "{1,2,3}"]))
+                    conn.sendall(_data_row([None, "{}"]))
+                    conn.sendall(_msg(b"C", b"SELECT 2\x00"))
+                elif sql.startswith("BOOM"):
+                    conn.sendall(_error("40001", "serialization failure"))
+                else:
+                    conn.sendall(_msg(b"C", b"UPDATE 1\x00"))
+                conn.sendall(_ready())
+        except ConnectionError:
+            pass
+        finally:
+            conn.close()
+            self.sock.close()
+
+
+@pytest.mark.parametrize("auth", ["trust", "md5", "scram"])
+def test_auth_and_query_roundtrip(auth):
+    srv = FakeServer(auth=auth)
+    conn = PGConnection("127.0.0.1", srv.port, user=USER, password=PASSWORD,
+                        timeout_s=5)
+    assert conn.parameters["server_version"] == "15.fake"
+    rows, tag = conn.query("SELECT k, elems FROM lists")
+    assert rows == [("5", "{1,2,3}"), (None, "{}")]
+    assert tag == "SELECT 2"
+    rows, tag = conn.query("UPDATE registers SET v = 1")
+    assert rows == [] and conn.rowcount(tag) == 1
+    conn.close()
+    srv.thread.join(timeout=5)
+    assert srv.errors == []
+
+
+def test_error_surfacing_keeps_connection_usable():
+    srv = FakeServer()
+    conn = PGConnection("127.0.0.1", srv.port, user=USER, timeout_s=5)
+    with pytest.raises(PgError) as err:
+        conn.query("BOOM")
+    assert err.value.sqlstate == "40001"
+    # connection resynced on ReadyForQuery: further queries work
+    assert conn.query("UPDATE t SET x=1")[1] == "UPDATE 1"
+    conn.close()
+    srv.thread.join(timeout=5)
+    assert srv.errors == []
+
+
+def test_parse_int_array():
+    assert parse_int_array("{1,2,3}") == [1, 2, 3]
+    assert parse_int_array("{}") == []
+    assert parse_int_array(None) == []
+    assert parse_int_array("{-4}") == [-4]
+
+
+def test_client_reconnects_after_net_error():
+    """After an OSError the client marks the socket desynced and the next
+    invoke reconnects instead of reusing it (the interpreter only reopens
+    clients on "info" completions, so read "fail"s would otherwise keep a
+    poisoned connection)."""
+    from jepsen_tpu.suites.postgres import PostgresClient
+
+    srv1, srv2 = FakeServer(), FakeServer()
+    ports = iter([srv1.port, srv2.port])
+
+    class TClient(PostgresClient):
+        DB_NAME, DB_USER, DB_PASS = "postgres", USER, PASSWORD
+
+        def endpoint(self, test, node):
+            return "127.0.0.1", next(ports)
+
+    c = TClient(timeout_s=5).open({"nodes": ["n1"]}, "n1")
+    assert c.conn.query("UPDATE t SET x=1")[1] == "UPDATE 1"
+    # sever the socket under the client: next op fails with OSError
+    c.conn.sock.close()
+    done = c.invoke({}, {"f": "read", "value": [1, None]})
+    assert done["type"] == "fail" and done["error"][0] == "net"
+    assert c._broken
+    # next invoke transparently reconnects (to srv2) and succeeds
+    done = c.invoke({}, {"f": "write", "value": [1, 5]})
+    assert done["type"] == "ok" and not c._broken
+    c.close({})
