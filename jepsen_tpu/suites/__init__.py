@@ -173,9 +173,10 @@ def standard_test_fn(suite_test: Callable,
 def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""
-    from jepsen_tpu.suites import (chronos, consul, crate, dgraph,
+    from jepsen_tpu.suites import (chronos, consul, crate, dgraph, disque,
                                    elasticsearch, etcd, hazelcast, ignite,
-                                   mongodb, postgres, redis, zookeeper)
+                                   mongodb, postgres, raftis, redis,
+                                   zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -189,6 +190,8 @@ def suite_registry() -> dict[str, Callable]:
         "ignite": ignite.ignite_test,
         "hazelcast": hazelcast.hazelcast_test,
         "chronos": chronos.chronos_test,
+        "raftis": raftis.raftis_test,
+        "disque": disque.disque_test,
     }
 
 

@@ -1,0 +1,73 @@
+"""Minimal RESP (REdis Serialization Protocol) wire client, shared by the
+redis-protocol family of suites: redis, raftis (floyd's redis-compatible
+raft server, reference raftis/src/jepsen/raftis.clj), and disque (whose
+job commands ride the same framing, reference
+disque/src/jepsen/disque.clj).
+
+Commands go out as arrays of bulk strings; the five reply types come
+back by leading type byte (``+ - : $ *``). No driver dependency — the
+point (as with the MySQL/Postgres wire clients in ``_mysql.py`` /
+``_postgres.py``) is that suites own their wire protocol end to end, so
+fault-injection tests see real socket behavior, not a driver's retry
+policy.
+"""
+from __future__ import annotations
+
+import socket
+
+
+class RespError(Exception):
+    """A server ``-ERR ...`` reply."""
+
+
+class RespConnection:
+    """A minimal RESP client: commands as arrays of bulk strings, replies
+    parsed by type byte (+ - : $ *)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 5.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.buf = self.sock.makefile("rb")
+
+    def command(self, *args):
+        out = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            data = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(data), data))
+        self.sock.sendall(b"".join(out))
+        return self._reply()
+
+    def _reply(self):
+        line = self.buf.readline()
+        if not line:
+            raise ConnectionError("connection closed")
+        if not line.endswith(b"\r\n"):
+            # EOF mid-line (server killed mid-reply): a truncated reply
+            # must never surface as a successful value
+            raise ConnectionError("truncated reply line")
+        kind, rest = line[:1], line[1:].strip()
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self.buf.read(n + 2)
+            if len(data) != n + 2:
+                raise ConnectionError("truncated bulk reply")
+            return data[:-2].decode()
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._reply() for _ in range(n)]
+        raise RespError(f"unknown reply type {kind!r}")
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,228 @@
+"""Disque test suite (reference: disque/src/jepsen/disque.clj — antirez's
+distributed job queue, tested as a total queue under node restarts and
+partitions).
+
+Disque speaks the redis wire protocol with its own command set
+(disque.clj:141-153): ``ADDJOB queue body ms-timeout REPLICATE n RETRY
+s`` to enqueue, ``GETJOB TIMEOUT ms COUNT 1 FROM queue`` to claim, and
+``ACKJOB id`` to acknowledge. A dequeue that times out with no job is a
+definite ``fail`` (disque.clj:194-208); a ``NOREPL`` reply (job not
+replicated to enough nodes before the partition) is indeterminate
+(disque.clj:244-247). Cluster formation is ``CLUSTER MEET`` of every
+node to the primary (disque.clj:95-105).
+
+The workload is the shared queue kit (enqueue unique ints / dequeue /
+final drain), checked with total-queue multiset algebra — exactly the
+reference's ``model/unordered-queue`` + ``checker/total-queue`` pairing
+(disque.clj:305-310).
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._resp import RespConnection, RespError
+
+logger = logging.getLogger("jepsen.disque")
+
+DEFAULT_VERSION = "f00dd0704128707f7a5effccd5837d796f2c01e3"
+DIR = "/opt/disque"
+DATA_DIR = "/var/lib/disque"
+PIDFILE = "/var/run/disque.pid"
+BINARY = f"{DIR}/src/disque-server"
+LOG_FILE = f"{DATA_DIR}/log"
+PORT = 7711
+QUEUE = "jepsen"
+
+
+class DisqueDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Build from source at a pinned commit, run via daemon helpers, join
+    every node to node 1 with CLUSTER MEET (disque.clj:40-136)."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from jepsen_tpu import control
+        if not cu.file_exists(BINARY):
+            logger.info("%s: building disque @ %s", node, self.version)
+            control.exec_("mkdir", "-p", "/opt")
+            with control.cd("/opt"):
+                if not cu.file_exists(DIR):
+                    control.exec_("git", "clone",
+                                  "https://github.com/antirez/disque.git")
+            with control.cd(DIR):
+                control.exec_("git", "reset", "--hard", self.version)
+                control.exec_("make")
+        control.exec_("mkdir", "-p", DATA_DIR)
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node)
+        # CLUSTER MEET barriers on every node being up (disque.clj:99
+        # jepsen/synchronize) — builds from source have minutes of variance
+        from jepsen_tpu import core
+        core.synchronize(test, timeout_s=600.0)  # sized for make variance
+        self.join(test, node)
+
+    def join(self, test, node):
+        """CLUSTER MEET everyone to the primary (disque.clj:95-105)."""
+        from jepsen_tpu import control
+        from jepsen_tpu.net import resolve_ip
+        nodes = test.get("nodes") or [node]
+        primary = nodes[0]
+        if node != primary:
+            control.exec_(f"{DIR}/src/disque", "-p", str(PORT),
+                          "cluster", "meet",
+                          resolve_ip(test, primary), str(PORT))
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)  # recreated by setup's mkdir -p
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
+            BINARY, "--port", str(PORT), "--bind", "0.0.0.0",
+            "--appendonly", "yes", "--dir", DATA_DIR)
+
+    def kill(self, test, node):
+        cu.stop_daemon("disque-server", PIDFILE)
+        cu.grepkill("disque-server")
+
+    def pause(self, test, node):
+        cu.grepkill("disque-server", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("disque-server", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class _AckLost(Exception):
+    """GETJOB delivered a job but the ACKJOB reply was lost; carries the
+    job body so the completion can report what may have been dequeued."""
+
+    def __init__(self, body: int):
+        super().__init__(body)
+        self.body = body
+
+
+class DisqueClient(Client):
+    """enqueue/dequeue/drain over ADDJOB/GETJOB/ACKJOB
+    (disque.clj:194-249). REPLICATE 3 / RETRY 1 job params match the
+    reference client (disque.clj:254-261)."""
+
+    def __init__(self, timeout_ms: int = 100, replicate: int = 3,
+                 node: str | None = None):
+        self.timeout_ms = timeout_ms
+        self.replicate = replicate
+        self.node = node
+        self.conn: RespConnection | None = None
+
+    def open(self, test, node):
+        c = DisqueClient(self.timeout_ms, self.replicate, node)
+        c.conn = RespConnection(node, PORT, timeout_s=10.0)
+        return c
+
+    def _dequeue_one(self):
+        """One GETJOB+ACKJOB round; returns the job body or None.
+
+        A network error *after* GETJOB delivered a job is re-raised as
+        ``_AckLost(body)``: the ACK may or may not have applied, so the
+        caller must report an indeterminate ``info`` carrying the value —
+        a definite ``fail`` would make total-queue call the job lost.
+        """
+        jobs = self.conn.command("GETJOB", "TIMEOUT", self.timeout_ms,
+                                 "COUNT", 1, "FROM", QUEUE)
+        if not jobs:
+            return None
+        _queue, job_id, body = jobs[0][:3]
+        try:
+            self.conn.command("ACKJOB", job_id)
+        except (TimeoutError, ConnectionError, OSError) as e:
+            raise _AckLost(int(body)) from e
+        return int(body)
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "enqueue":
+                self.conn.command("ADDJOB", QUEUE, str(v), self.timeout_ms,
+                                  "REPLICATE", self.replicate, "RETRY", 1)
+                return {**op, "type": "ok"}
+            if f == "dequeue":
+                body = self._dequeue_one()
+                if body is None:
+                    return {**op, "type": "fail"}  # nothing to dequeue
+                return {**op, "type": "ok", "value": body}
+            if f == "drain":
+                drained: list = []
+                try:
+                    while True:
+                        body = self._dequeue_one()
+                        if body is None:
+                            return {**op, "type": "ok", "value": drained}
+                        drained.append(body)
+                except _AckLost as e:
+                    drained.append(e.body)
+                    return {**op, "type": "info", "value": drained,
+                            "error": ["ack-lost"]}
+                except (RespError, TimeoutError, ConnectionError,
+                        OSError) as e:
+                    # partial drain: these elements were definitely
+                    # consumed (expand_queue_drain_ops handles info+list);
+                    # dropping them would yield false 'lost' verdicts
+                    return {**op, "type": "info", "value": drained,
+                            "error": ["net", str(e)]}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except _AckLost as e:
+            # GETJOB delivered the body, so the dequeue itself happened;
+            # a lost ACK only risks redelivery (duplicated, not lost)
+            return {**op, "type": "ok", "value": e.body,
+                    "error": ["ack-lost"]}
+        except RespError as e:
+            msg = str(e)
+            if msg.startswith("NOREPL"):
+                # job not replicated widely enough — indeterminate
+                # (disque.clj:244-247)
+                return {**op, "type": "info",
+                        "error": ["not-fully-replicated"]}
+            return {**op, "type": "fail", "error": ["resp", msg]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            # dequeue: the error preceded any delivery (post-delivery
+            # errors surface as _AckLost above), so nothing was consumed
+            kind = "fail" if f == "dequeue" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("queue",)
+
+
+def disque_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="disque", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": DisqueDB(o.get("version",
+                                                  DEFAULT_VERSION)),
+                             "client": DisqueClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(disque_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-disque")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

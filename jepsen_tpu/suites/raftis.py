@@ -1,0 +1,163 @@
+"""Raftis test suite (reference: raftis/src/jepsen/raftis.clj — PikaLabs
+floyd's raft-replicated redis-compatible server).
+
+The reference workload is a linearizable r/w register over the redis
+protocol under random-halves partitions (raftis.clj:111-134); its
+error discipline is the interesting part: reads that fail are definite
+``fail``, writes are indeterminate ``info`` *unless* the server said
+"no leader" or the socket closed before the request could have been
+accepted (raftis.clj:37-58). We keep exactly that mapping.
+
+DB automation mirrors raftis.clj:79-109: install a release tarball,
+start the daemon with the full ``host:8901`` cluster string, serve
+clients on 6379.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._resp import RespConnection, RespError
+
+logger = logging.getLogger("jepsen.raftis")
+
+DEFAULT_VERSION = "v2.0.4"
+DIR = "/opt/raftis"
+LOG_FILE = f"{DIR}/data/LOG"
+DAEMON_LOG = f"{DIR}/raftis.log"
+PIDFILE = f"{DIR}/raftis.pid"
+BINARY = "raftis"
+RAFT_PORT = 8901
+CLIENT_PORT = 6379
+
+
+def archive_url(version: str) -> str:
+    return (f"https://github.com/PikaLabs/floyd/releases/download/"
+            f"{version}/raftis-{version}.tar.gz")
+
+
+def initial_cluster(test: dict) -> str:
+    """``n1:8901,n2:8901,...`` (raftis.clj:70-77)."""
+    return ",".join(f"{n}:{RAFT_PORT}" for n in (test.get("nodes") or []))
+
+
+class RaftisDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Raftis lifecycle (raftis.clj:79-109): archive install + daemon with
+    cluster-string/node/raft-port/data-dir/client-port argv."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        logger.info("%s: installing raftis %s", node, self.version)
+        if not cu.file_exists(f"{DIR}/{BINARY}"):
+            cu.install_archive(archive_url(self.version), DIR)
+        self.start(test, node)
+        cu.await_tcp_port(CLIENT_PORT, host=node)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DIR)
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": DAEMON_LOG, "pidfile": PIDFILE, "chdir": DIR},
+            f"{DIR}/{BINARY}", initial_cluster(test), node, str(RAFT_PORT),
+            "data", str(CLIENT_PORT))
+
+    def kill(self, test, node):
+        cu.stop_daemon(BINARY, PIDFILE)
+        cu.grepkill(BINARY)
+
+    def pause(self, test, node):
+        cu.grepkill(BINARY, sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(BINARY, sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE, DAEMON_LOG]
+
+
+class RaftisClient(Client):
+    """r/w/cas registers against the local node — raftis is multi-primary
+    through raft, so every node accepts commands (raftis.clj:28-62).
+
+    CAS is a server-side Lua EVAL like the redis suite's; floyd's redis
+    front end accepts EVAL, and a rejection is a definite ``fail``.
+    """
+
+    def __init__(self, prefix: str = "jepsen", timeout_s: float = 5.0,
+                 node: str | None = None):
+        self.prefix = prefix
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: RespConnection | None = None
+
+    def open(self, test, node):
+        c = RaftisClient(self.prefix, self.timeout_s, node)
+        c.conn = RespConnection(node, CLIENT_PORT, timeout_s=self.timeout_s)
+        return c
+
+    def invoke(self, test, op):
+        from jepsen_tpu.suites.redis import CAS_LUA
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read":
+                k, _ = v
+                raw = self.conn.command("GET", f"{self.prefix}:{k}")
+                return {**op, "type": "ok",
+                        "value": [k, int(raw) if raw is not None else None]}
+            if f == "write":
+                k, val = v
+                self.conn.command("SET", f"{self.prefix}:{k}", val)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                applied = self.conn.command(
+                    "EVAL", CAS_LUA, 1, f"{self.prefix}:{k}", old, new)
+                return {**op, "type": "ok" if applied == 1 else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except RespError as e:
+            # "no leader" means the write was definitely not accepted
+            # (raftis.clj:46-49); any server error on a read is a fail
+            msg = str(e)
+            definite = f == "read" or "no leader" in msg
+            return {**op, "type": "fail" if definite else "info",
+                    "error": ["resp", msg]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("register",)
+
+
+def raftis_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="raftis", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": RaftisDB(o.get("version",
+                                                  DEFAULT_VERSION)),
+                             "client": RaftisClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(raftis_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-raftis")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

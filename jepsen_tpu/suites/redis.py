@@ -18,7 +18,6 @@ redis analyses demonstrated.
 from __future__ import annotations
 
 import logging
-import socket
 
 from jepsen_tpu import cli, db as db_mod
 from jepsen_tpu.client import Client
@@ -100,55 +99,8 @@ class RedisDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
         return [LOG_FILE]
 
 
-class RespError(Exception):
-    """A redis -ERR reply."""
-
-
-class RespConnection:
-    """A minimal RESP client: commands as arrays of bulk strings, replies
-    parsed by type byte (+ - : $ *)."""
-
-    def __init__(self, host: str, port: int = PORT, timeout_s: float = 5.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout_s)
-        self.buf = self.sock.makefile("rb")
-
-    def command(self, *args):
-        out = [f"*{len(args)}\r\n".encode()]
-        for a in args:
-            data = a if isinstance(a, bytes) else str(a).encode()
-            out.append(b"$%d\r\n%s\r\n" % (len(data), data))
-        self.sock.sendall(b"".join(out))
-        return self._reply()
-
-    def _reply(self):
-        line = self.buf.readline()
-        if not line:
-            raise ConnectionError("connection closed")
-        kind, rest = line[:1], line[1:].strip()
-        if kind == b"+":
-            return rest.decode()
-        if kind == b"-":
-            raise RespError(rest.decode())
-        if kind == b":":
-            return int(rest)
-        if kind == b"$":
-            n = int(rest)
-            if n < 0:
-                return None
-            data = self.buf.read(n + 2)[:-2]
-            return data.decode()
-        if kind == b"*":
-            n = int(rest)
-            if n < 0:
-                return None
-            return [self._reply() for _ in range(n)]
-        raise RespError(f"unknown reply type {kind!r}")
-
-    def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+# RESP protocol core shared with the raftis/disque suites
+from jepsen_tpu.suites._resp import RespConnection, RespError  # noqa: E402,F401
 
 
 class RedisClient(Client):
@@ -165,7 +117,7 @@ class RedisClient(Client):
     def open(self, test, node):
         primary = (test.get("nodes") or [node])[0]
         c = RedisClient(self.prefix, self.timeout_s, node)
-        c.conn = RespConnection(primary, timeout_s=self.timeout_s)
+        c.conn = RespConnection(primary, PORT, timeout_s=self.timeout_s)
         return c
 
     def invoke(self, test, op):

@@ -234,3 +234,107 @@ def test_fake_forces_dummy_remote():
     t2 = zookeeper.zookeeper_test({"fake": True,
                                    "ssh": {"dummy": False}})
     assert t2["ssh"]["dummy"] is True
+
+
+# ---------------------------------------------------------------------------
+# raftis & disque (RESP family)
+# ---------------------------------------------------------------------------
+
+def test_raftis_db_commands():
+    from jepsen_tpu.suites import raftis
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = raftis.RaftisDB()
+    try:
+        control.on("n2", t, lambda: db.start(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        # daemon argv: full cluster string, own node name, raft + client ports
+        assert "n1:8901,n2:8901,n3:8901,n4:8901,n5:8901" in joined
+        assert " n2 " in joined and "8901" in joined and "6379" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+def test_raftis_fake_register_run():
+    from jepsen_tpu.suites import raftis
+    result = run_fake(raftis.raftis_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_disque_db_join_commands():
+    from jepsen_tpu.suites import disque
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = disque.DisqueDB()
+    try:
+        control.on("n3", t, lambda: db.join(t, "n3"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "cluster meet" in joined   # CLUSTER MEET to the primary
+        before = len(remote.log)
+        control.on("n1", t, lambda: db.join(t, "n1"))  # primary: no meet
+        assert len(remote.log) == before
+    finally:
+        control.disconnect_all(t)
+
+
+def test_disque_fake_queue_run():
+    from jepsen_tpu.suites import disque
+    result = run_fake(disque.disque_test)
+    assert result["results"]["valid?"] is True, result["results"]
+    # final drain phase must have produced drain ops
+    assert any(op.get("f") == "drain" for op in result["history"])
+
+
+def test_disque_ack_lost_is_indeterminate_not_lost():
+    """A dead connection between GETJOB and ACKJOB must not produce a
+    definite bare 'fail' (which total-queue would count as job loss)."""
+    from jepsen_tpu.suites import disque
+
+    class FakeConn:
+        def __init__(self):
+            self.calls = 0
+
+        def command(self, *args):
+            if args[0] == "GETJOB":
+                return [["jepsen", "D-id", "42"]]
+            raise ConnectionError("dropped before ACKJOB reply")
+
+    c = disque.DisqueClient()
+    c.conn = FakeConn()
+    out = c.invoke({}, {"f": "dequeue", "value": None, "type": "invoke"})
+    assert out["type"] == "ok" and out["value"] == 42  # delivery happened
+
+    c.conn = FakeConn()
+    out = c.invoke({}, {"f": "drain", "value": None, "type": "invoke"})
+    assert out["type"] == "info" and out["value"] == [42]
+
+
+def test_resp_truncated_replies_raise():
+    """A server killed mid-reply must surface as ConnectionError, never as
+    a plausible-but-corrupt successful value."""
+    import socket
+    import threading
+
+    from jepsen_tpu.suites._resp import RespConnection
+
+    for payload in (b"$3\r\n12", b":1", b"+O"):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def serve(s=srv, p=payload):
+            conn, _ = s.accept()
+            conn.recv(4096)
+            conn.sendall(p)
+            conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        c = RespConnection("127.0.0.1", port)
+        try:
+            import pytest
+            with pytest.raises((ConnectionError, OSError)):
+                c.command("GET", "k")
+        finally:
+            c.close()
+            srv.close()
