@@ -118,11 +118,15 @@ class KVStore(MetaLogDB):
         self.registers: dict = {}
         self.elements: set = set()
         self.lists: dict = {}
+        self.accounts: dict = {}   # bank workload balances
+        self.rows: dict = {}       # dirty-reads workload rows
 
     def _wipe(self):
         self.registers.clear()
         self.elements.clear()
         self.lists.clear()
+        self.accounts.clear()
+        self.rows.clear()
 
     def read(self, k):
         with self.lock:
@@ -147,17 +151,24 @@ class KVStore(MetaLogDB):
         with self.lock:
             return sorted(self.elements)
 
-    def txn(self, micro_ops) -> list:
-        """Atomically applies a list-append txn ([f, k, v] micro-ops),
-        filling reads with the current list state."""
+    def txn(self, micro_ops, style: str = "append") -> list:
+        """Atomically applies a txn of [f, k, v] micro-ops. ``style``
+        picks what a read returns: "append" (the per-key list, Elle
+        list-append) or "wr" (the register value, Elle rw-register /
+        long-fork)."""
         with self.lock:
             out = []
             for f, k, v in micro_ops:
-                if f == "r":
+                if f == "r" and style == "wr":
+                    out.append(["r", k, self.registers.get(k)])
+                elif f == "r":
                     out.append(["r", k, list(self.lists.get(k, []))])
                 elif f == "append":
                     self.lists.setdefault(k, []).append(v)
                     out.append(["append", k, v])
+                elif f == "w":
+                    self.registers[k] = v
+                    out.append(["w", k, v])
                 else:
                     raise ValueError(f"unknown micro-op {f!r}")
             return out
@@ -179,16 +190,87 @@ class KVStore(MetaLogDB):
             q.clear()
             return out
 
+    # bank (workloads/bank.py): atomic transfers over an accounts dict
+    def bank_init(self, accounts, balance: int):
+        with self.lock:
+            for a in accounts:
+                self.accounts.setdefault(a, balance)
+
+    def bank_read(self) -> dict:
+        with self.lock:
+            return dict(self.accounts)
+
+    def transfer(self, frm, to, amount: int) -> bool:
+        """Atomically moves amount; refuses to overdraw (the reference
+        bank clients fail transfers that would go negative)."""
+        with self.lock:
+            if self.accounts.get(frm, 0) < amount:
+                return False
+            self.accounts[frm] -= amount
+            self.accounts[to] = self.accounts.get(to, 0) + amount
+            return True
+
+    # dirty-reads (workloads/dirty_reads.py): n rows set atomically
+    def rows_init(self, n: int):
+        with self.lock:
+            for i in range(n):
+                self.rows.setdefault(i, -1)
+
+    def write_all_rows(self, x):
+        with self.lock:
+            for i in self.rows:
+                self.rows[i] = x
+
+    def read_all_rows(self) -> list:
+        with self.lock:
+            return [v for _, v in sorted(self.rows.items())]
+
 
 class KVClient(MetaLogClient):
     """Client over a KVStore, speaking both the independent-lifted register
     protocol ([k, v] tuple values, independent.clj:21-29) and the set
-    workload's add/read ops."""
+    workload's add/read ops.
+
+    ``whole_read`` disambiguates what a bare ``{"f": "read", "value":
+    None}`` means — "set" (whole-set read, the default), "bank" (all
+    balances as a dict), or "dirty" (all dirty-reads rows) — since those
+    three workloads share the same op shape."""
+
+    def __init__(self, db: MetaLogDB, node: str | None = None,
+                 whole_read: str = "set", txn_style: str = "append"):
+        super().__init__(db, node)
+        self.whole_read = whole_read
+        self.txn_style = txn_style
+
+    def open(self, test, node):
+        c = type(self)(self.db, node, self.whole_read, self.txn_style)
+        self.db._note("client-open", node)
+        return c
+
+    def setup(self, test):
+        super().setup(test)
+        if self.whole_read == "bank":
+            self.db.bank_init(test.get("accounts", range(8)), 10)
+        elif self.whole_read == "dirty":
+            self.db.rows_init(int(test.get("dirty-rows", 4)))
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
+        if f == "transfer":
+            t = v or {}
+            ok = self.db.transfer(t.get("from"), t.get("to"),
+                                  int(t.get("amount", 0)))
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "read" and v is None and self.whole_read == "bank":
+            return {**op, "type": "ok", "value": self.db.bank_read()}
+        if f == "read" and v is None and self.whole_read == "dirty":
+            return {**op, "type": "ok", "value": self.db.read_all_rows()}
+        if f == "write" and self.whole_read == "dirty":
+            self.db.write_all_rows(v)
+            return {**op, "type": "ok"}
         if f == "txn":
-            return {**op, "type": "ok", "value": self.db.txn(v)}
+            return {**op, "type": "ok",
+                    "value": self.db.txn(v, style=self.txn_style)}
         if f == "add":
             self.db.add(v)
             return {**op, "type": "ok"}

@@ -123,6 +123,26 @@ def installed_version(package: str) -> str | None:
     return r.out.strip() if r.exit_status == 0 and r.out.strip() else None
 
 
+def add_repo(name: str, line: str, keyserver: str | None = None,
+             key_id: str | None = None) -> None:
+    """Adds an apt source list plus (optionally) its signing key
+    (os/debian.clj add-repo!, used by galera.clj:37-41 and
+    percona.clj:37-42)."""
+    control.exec_("sh", "-c",
+                  f"echo {control.escape(line)} > "
+                  f"/etc/apt/sources.list.d/{name}.list")
+    if keyserver and key_id:
+        control.exec_("apt-key", "adv", "--keyserver", keyserver,
+                      "--recv-keys", key_id)
+    control.exec_("apt-get", "update")
+
+
+def debconf_set(selection: str) -> None:
+    """Pre-seeds a debconf answer (the reference's
+    ``echo ... | debconf-set-selections`` pattern, galera.clj:44-46)."""
+    control.exec_("debconf-set-selections", stdin=selection + "\n")
+
+
 debian = Debian
 centos = CentOS
 ubuntu = Ubuntu

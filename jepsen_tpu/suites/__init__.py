@@ -30,6 +30,13 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
     workload final-generator (e.g. final reads), on clients only.
     """
     test = dict(base)
+    # workload config keys (e.g. bank's accounts/total-amount, dirty-reads'
+    # row count) ride the test map so checkers and op generators see them;
+    # base keys win so CLI options still override workload defaults
+    for k, v in workload.items():
+        if k not in ("generator", "checker", "final_generator") \
+                and k not in test:
+            test[k] = v
     time_limit = float(test.get("time_limit", 60))
 
     main_gens = [gen.clients(workload["generator"])]
@@ -102,7 +109,11 @@ def build_suite_test(o: dict | None, *, db_name: str,
         from jepsen_tpu.fakes import KVClient, KVStore
         from jepsen_tpu.net import NoopNet
         kv = KVStore()
-        client = fake_client() if fake_client else KVClient(kv)
+        whole_read = {"bank": "bank", "dirty-reads": "dirty"}.get(
+            workload_name, "set")
+        txn_style = "wr" if workload_name in ("wr", "long-fork") else "append"
+        client = fake_client() if fake_client \
+            else KVClient(kv, whole_read=whole_read, txn_style=txn_style)
         base.update(db=kv, client=client, os=None, net=NoopNet())
     else:
         base.update(make_real(o))
@@ -174,9 +185,9 @@ def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""
     from jepsen_tpu.suites import (chronos, consul, crate, dgraph, disque,
-                                   elasticsearch, etcd, hazelcast, ignite,
-                                   mongodb, postgres, raftis, redis,
-                                   zookeeper)
+                                   elasticsearch, etcd, galera, hazelcast,
+                                   ignite, mongodb, mysql_cluster, percona,
+                                   postgres, raftis, redis, tidb, zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -192,6 +203,10 @@ def suite_registry() -> dict[str, Callable]:
         "chronos": chronos.chronos_test,
         "raftis": raftis.raftis_test,
         "disque": disque.disque_test,
+        "galera": galera.galera_test,
+        "percona": percona.percona_test,
+        "mysql-cluster": mysql_cluster.mysql_cluster_test,
+        "tidb": tidb.tidb_test,
     }
 
 
@@ -199,7 +214,7 @@ def workload_registry() -> dict[str, Callable]:
     """name -> workload-constructor map for sweep runners
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
-                                      causal_reverse, long_fork,
+                                      causal_reverse, dirty_reads, long_fork,
                                       queue_workload, register, set_workload,
                                       wr)
     return {
@@ -213,4 +228,5 @@ def workload_registry() -> dict[str, Callable]:
         "causal-reverse": causal_reverse.workload,
         "adya": adya.workload,
         "queue": queue_workload.workload,
+        "dirty-reads": dirty_reads.workload,
     }

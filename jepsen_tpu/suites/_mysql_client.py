@@ -1,0 +1,319 @@
+"""Shared SQL client for the MySQL-protocol suite family — galera,
+percona, mysql-cluster, and tidb (reference: the jdbc client layers in
+galera/src/jepsen/galera.clj:86-187, percona/src/jepsen/percona.clj,
+tidb/src/tidb/{sql,txn}.clj).
+
+One client class speaks every bundled SQL workload over the
+from-scratch wire protocol in ``_mysql.py``:
+
+- register r/w/cas: keyed rows with UPDATE-guarded compare-and-set
+- set add/read: grow-only table of unique ints (galera.clj:214-236)
+- bank read/transfer: serializable two-row transfer with negative-
+  balance refusal (galera.clj:260-308)
+- dirty-reads read/write: write sets *all* rows of a small table in one
+  txn, read scans them (galera/dirty_reads.clj:29-67)
+- txn (Elle list-append / rw-register micro-ops): per-key rows appended
+  via ``ON DUPLICATE KEY UPDATE CONCAT`` exactly like tidb's mop!
+  (tidb/src/tidb/txn.clj:19-48)
+
+Error discipline (galera.clj:133-176): deadlock/lock-wait rollbacks and
+galera's "WSREP has not yet prepared node" are definite ``fail``s (the
+txn did not commit); network errors fail reads and are indeterminate
+``info`` for writes. A connection that errored mid-conversation is
+rebuilt before its next use, since leftover response bytes would desync
+the wire protocol.
+"""
+from __future__ import annotations
+
+from jepsen_tpu.client import Client
+from jepsen_tpu.suites._mysql import MySQLConnection, MySQLError
+
+# MySQL errnos that mean "transaction rolled back, definitely not applied"
+ER_LOCK_DEADLOCK = 1213
+ER_LOCK_WAIT_TIMEOUT = 1205
+ROLLBACK_ERRNOS = (ER_LOCK_DEADLOCK, ER_LOCK_WAIT_TIMEOUT)
+
+
+def parse_int_list(text: str | None) -> list[int]:
+    """``"1,2,3"`` → ``[1, 2, 3]`` (the CONCAT-encoded list rows)."""
+    if not text:
+        return []
+    return [int(x) for x in text.split(",") if x != ""]
+
+
+def create_db_and_user(db_name: str, user: str, password: str,
+                       root_pass: str | None = None,
+                       port: int | None = None) -> None:
+    """Creates the jepsen database and a ``'%'``-visible user via the
+    node-local mysql shell (galera.clj:95-100) — shared by every
+    MySQL-family suite's DB automation."""
+    from jepsen_tpu import control
+    argv = ["mysql", "-u", "root"]
+    if root_pass:
+        argv.append(f"--password={root_pass}")
+    if port:
+        argv += ["-h", "127.0.0.1", "-P", str(port)]
+    for sql in (f"CREATE DATABASE IF NOT EXISTS {db_name};",
+                f"CREATE USER IF NOT EXISTS '{user}'@'%' "
+                f"IDENTIFIED BY '{password}';",
+                f"GRANT ALL PRIVILEGES ON {db_name}.* TO '{user}'@'%';"):
+        control.exec_(*argv, "-e", sql)
+
+
+class MySQLSuiteClient(Client):
+    """Workload client over one MySQLConnection. ``engine`` appends an
+    ENGINE clause to CREATE TABLE (mysql-cluster needs NDBCLUSTER);
+    ``endpoint_mode`` is "node" (connect to your own node — the
+    multi-primary galera/percona/tidb shape) or "first" (all clients
+    share node 1)."""
+
+    def __init__(self, *, port: int = 3306, database: str = "jepsen",
+                 user: str = "jepsen", password: str = "jepsen",
+                 isolation: str = "serializable", engine: str | None = None,
+                 endpoint_mode: str = "node", txn_style: str = "append",
+                 timeout_s: float = 10.0, node: str | None = None):
+        self.port = port
+        self.database = database
+        self.user = user
+        self.password = password
+        self.isolation = isolation
+        self.engine = engine
+        self.endpoint_mode = endpoint_mode
+        # "append": txn r micro-ops read the lists table (Elle
+        # list-append); "wr": they read registers (Elle rw-register)
+        self.txn_style = txn_style
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: MySQLConnection | None = None
+        self._broken = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def endpoint(self, test, node) -> str:
+        if self.endpoint_mode == "first":
+            return (test.get("nodes") or [node])[0]
+        return node
+
+    def _connect(self, test):
+        self.conn = MySQLConnection(
+            self.endpoint(test, self.node), port=self.port, user=self.user,
+            password=self.password, database=self.database,
+            timeout_s=self.timeout_s)
+        # session isolation is sticky — set once per connection, not per txn
+        level = self.isolation.upper().replace("-", " ")
+        self.conn.query(
+            f"SET SESSION TRANSACTION ISOLATION LEVEL {level}")
+
+    def open(self, test, node):
+        c = type(self)(port=self.port, database=self.database,
+                       user=self.user, password=self.password,
+                       isolation=self.isolation, engine=self.engine,
+                       endpoint_mode=self.endpoint_mode,
+                       txn_style=self.txn_style,
+                       timeout_s=self.timeout_s, node=node)
+        c._connect(test)
+        return c
+
+    def setup(self, test):
+        suffix = f" ENGINE={self.engine}" if self.engine else ""
+        for ddl in (
+                "CREATE TABLE IF NOT EXISTS registers "
+                f"(k INT NOT NULL PRIMARY KEY, v BIGINT){suffix}",
+                "CREATE TABLE IF NOT EXISTS sets "
+                f"(elem BIGINT NOT NULL PRIMARY KEY){suffix}",
+                "CREATE TABLE IF NOT EXISTS accounts "
+                f"(id INT NOT NULL PRIMARY KEY, balance BIGINT NOT NULL)"
+                f"{suffix}",
+                "CREATE TABLE IF NOT EXISTS dirty "
+                f"(id INT NOT NULL PRIMARY KEY, x BIGINT NOT NULL){suffix}",
+                "CREATE TABLE IF NOT EXISTS lists "
+                f"(k INT NOT NULL PRIMARY KEY, elems TEXT){suffix}"):
+            self.conn.query(ddl)
+        # bank initial balances (galera.clj:262-273) and dirty rows
+        # (dirty_reads.clj:31-43); both idempotent across clients
+        for a in test.get("accounts", []):
+            self.conn.query(
+                f"INSERT IGNORE INTO accounts (id, balance) "
+                f"VALUES ({int(a)}, 10)")
+        for i in range(int(test.get("dirty-rows", 0) or 0)):
+            self.conn.query(
+                f"INSERT IGNORE INTO dirty (id, x) VALUES ({int(i)}, -1)")
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- transactions -----------------------------------------------------
+
+    def _begin(self):
+        self.conn.query("BEGIN")
+
+    def _rollback(self):
+        try:
+            self.conn.query("ROLLBACK")
+        except (MySQLError, OSError):
+            self._broken = True
+
+    def _select_int(self, sql: str):
+        rows = self.conn.query(sql)
+        if not rows or rows[0][0] is None:
+            return None
+        return int(rows[0][0])
+
+    # -- op dispatch ------------------------------------------------------
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if self._broken:
+            self.close(test)
+            self._connect(test)
+            self._broken = False
+        try:
+            if f == "read" and v is None:
+                return self._whole_read(test, op)
+            if f == "read":
+                k, _ = v
+                val = self._select_int(
+                    f"SELECT v FROM registers WHERE k = {int(k)}")
+                return {**op, "type": "ok", "value": [k, val]}
+            if f == "write" and isinstance(v, (list, tuple)):
+                k, val = v
+                self.conn.query(
+                    f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
+                    f"{int(val)}) ON DUPLICATE KEY UPDATE v = {int(val)}")
+                return {**op, "type": "ok"}
+            if f == "write":
+                return self._dirty_write(test, op)
+            if f == "cas":
+                k, (old, new) = v
+                affected, _ = self.conn.query(
+                    f"UPDATE registers SET v = {int(new)} "
+                    f"WHERE k = {int(k)} AND v = {int(old)}")
+                return {**op, "type": "ok" if affected == 1 else "fail"}
+            if f == "add":
+                self.conn.query(
+                    f"INSERT IGNORE INTO sets (elem) VALUES ({int(v)})")
+                return {**op, "type": "ok"}
+            if f == "transfer":
+                return self._transfer(op)
+            if f == "txn":
+                return self._txn(op)
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except MySQLError as e:
+            return self._sql_error(op, e)
+        except (TimeoutError, ConnectionError, OSError) as e:
+            self._broken = True
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def _sql_error(self, op, e: MySQLError):
+        if e.code in ROLLBACK_ERRNOS:
+            return {**op, "type": "fail", "error": ["rollback", e.msg]}
+        if "WSREP has not yet prepared node" in e.msg:
+            # galera node not in the primary component (galera.clj:167-176)
+            return {**op, "type": "fail", "error": ["wsrep", e.msg]}
+        # unknown server error after a possible partial conversation:
+        # reads are safe to fail; writes are indeterminate
+        kind = "fail" if op.get("f") == "read" else "info"
+        return {**op, "type": kind, "error": ["sql", e.code, e.msg]}
+
+    # -- workload bodies --------------------------------------------------
+
+    def _whole_read(self, test, op):
+        """A bare read: bank balances when the test carries accounts,
+        dirty rows when it carries dirty-rows, else the whole set."""
+        if test.get("accounts"):
+            rows = self.conn.query(
+                "SELECT id, balance FROM accounts ORDER BY id")
+            return {**op, "type": "ok",
+                    "value": {int(r[0]): int(r[1]) for r in rows}}
+        if test.get("dirty-rows"):
+            rows = self.conn.query("SELECT x FROM dirty ORDER BY id")
+            return {**op, "type": "ok",
+                    "value": [int(r[0]) for r in rows]}
+        rows = self.conn.query("SELECT elem FROM sets ORDER BY elem")
+        return {**op, "type": "ok", "value": [int(r[0]) for r in rows]}
+
+    def _transfer(self, op):
+        """Two-row serializable transfer (galera.clj:277-306): read both
+        balances, refuse overdrafts, write both."""
+        t = op.get("value") or {}
+        frm, to = int(t.get("from")), int(t.get("to"))
+        amount = int(t.get("amount", 0))
+        self._begin()
+        try:
+            b1 = self._select_int(
+                f"SELECT balance FROM accounts WHERE id = {frm}")
+            b2 = self._select_int(
+                f"SELECT balance FROM accounts WHERE id = {to}")
+            if b1 is None or b2 is None:
+                self._rollback()
+                return {**op, "type": "fail", "error": ["no-such-account"]}
+            if b1 - amount < 0:
+                self._rollback()
+                return {**op, "type": "fail",
+                        "error": ["negative", frm, b1 - amount]}
+            self.conn.query(f"UPDATE accounts SET balance = {b1 - amount} "
+                            f"WHERE id = {frm}")
+            self.conn.query(f"UPDATE accounts SET balance = {b2 + amount} "
+                            f"WHERE id = {to}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _dirty_write(self, test, op):
+        """Set every dirty row to op value in one txn
+        (dirty_reads.clj:59-65): select each row, then update each."""
+        x = int(op.get("value"))
+        n = int(test.get("dirty-rows", 4) or 4)
+        self._begin()
+        try:
+            for i in range(n):
+                self.conn.query(f"SELECT x FROM dirty WHERE id = {i}")
+            for i in range(n):
+                self.conn.query(f"UPDATE dirty SET x = {x} WHERE id = {i}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _txn(self, op):
+        """Elle micro-op transaction (tidb/src/tidb/txn.clj:19-48):
+        r → SELECT, append → CONCAT upsert, w → plain upsert."""
+        self._begin()
+        out = []
+        try:
+            for f, k, v in op.get("value") or []:
+                if f == "r" and self.txn_style == "wr":
+                    val = self._select_int(
+                        f"SELECT v FROM registers WHERE k = {int(k)}")
+                    out.append(["r", k, val])
+                elif f == "r":
+                    rows = self.conn.query(
+                        f"SELECT elems FROM lists WHERE k = {int(k)}")
+                    out.append(["r", k,
+                                parse_int_list(rows[0][0]) if rows else []])
+                elif f == "append":
+                    self.conn.query(
+                        f"INSERT INTO lists (k, elems) VALUES ({int(k)}, "
+                        f"'{int(v)}') ON DUPLICATE KEY UPDATE "
+                        f"elems = CONCAT(elems, ',', '{int(v)}')")
+                    out.append(["append", k, v])
+                elif f == "w":
+                    self.conn.query(
+                        f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
+                        f"{int(v)}) ON DUPLICATE KEY UPDATE v = {int(v)}")
+                    out.append(["w", k, v])
+                else:
+                    raise ValueError(f"unknown micro-op {f!r}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok", "value": out}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)

@@ -1,0 +1,147 @@
+"""MariaDB Galera Cluster test suite (reference:
+galera/src/jepsen/galera.clj + galera/dirty_reads.clj — a multi-primary
+synchronous-replication MySQL whose classic anomalies are dirty reads of
+aborted transactions and broken snapshot sums).
+
+Workloads: ``set`` (auto-increment insert table, galera.clj:214-258),
+``bank`` (serializable transfers whose reads must preserve the total,
+galera.clj:260-383), and ``dirty-reads`` (writers racing to set every
+row while readers scan, dirty_reads.clj). All ride the shared
+MySQL-wire suite client (``_mysql_client.py``), connecting each client
+to its own node — galera is multi-primary (galera.clj:86-93).
+
+DB automation mirrors galera.clj:34-131: install the mariadb server
+package, write a wsrep config with ``gcomm://`` cluster address,
+bootstrap the first node as a new cluster, start the rest after a
+barrier, then create the jepsen database and user.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._mysql_client import (MySQLSuiteClient,
+                                             create_db_and_user)
+
+logger = logging.getLogger("jepsen.galera")
+
+PORT = 3306
+DB_NAME = "jepsen"
+DB_USER = "jepsen"
+DB_PASS = "jepsen"
+DATA_DIR = "/var/lib/mysql"
+CONF_FILE = "/etc/mysql/conf.d/jepsen.cnf"
+LOG_FILES = ["/var/log/syslog", "/var/log/mysql.log", "/var/log/mysql.err"]
+
+
+def cluster_address(test: dict) -> str:
+    """``gcomm://n1,n2,...`` (galera.clj:59-62)."""
+    return "gcomm://" + ",".join(test.get("nodes") or [])
+
+
+GALERA_PROVIDER = "/usr/lib/galera/libgalera_smm.so"
+
+
+def wsrep_config(test: dict, provider: str = GALERA_PROVIDER) -> str:
+    """The jepsen.cnf wsrep settings (galera.clj resources/jepsen.cnf).
+    ``provider`` varies by distribution: mariadb's galera-4 package owns
+    /usr/lib/galera/, percona-xtradb-cluster bundles galera-3 under
+    /usr/lib/galera3/."""
+    return "\n".join([
+        "[mysqld]",
+        "bind-address = 0.0.0.0",
+        "binlog_format = ROW",
+        "default_storage_engine = InnoDB",
+        "innodb_autoinc_lock_mode = 2",
+        "wsrep_on = ON",
+        f"wsrep_provider = {provider}",
+        f"wsrep_cluster_address = {cluster_address(test)}",
+        "wsrep_cluster_name = jepsen",
+        "wsrep_sst_method = rsync",
+        "",
+    ])
+
+
+class GaleraDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Galera lifecycle (galera.clj:102-131): package install, wsrep
+    config, --wsrep-new-cluster bootstrap on node 1, barrier, join."""
+
+    def __init__(self, package: str = "mariadb-server"):
+        self.package = package
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing %s", node, self.package)
+        os_setup.install([self.package, "galera-4", "rsync"])
+        control.exec_(control.lit(
+            "service mysql stop >/dev/null 2>&1 || true"))
+        cu.mkdir("/etc/mysql/conf.d")
+        cu.write_file(wsrep_config(test), CONF_FILE)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            # first node bootstraps a new cluster (galera.clj:110-111)
+            control.exec_(control.lit(
+                "galera_new_cluster || service mysql start "
+                "--wsrep-new-cluster"))
+        core.synchronize(test, timeout_s=300.0)
+        if node != primary:
+            control.exec_("service", "mysql", "start")
+        core.synchronize(test, timeout_s=300.0)
+        cu.await_tcp_port(PORT, host=node)
+        create_db_and_user(DB_NAME, DB_USER, DB_PASS)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        control.exec_(control.lit(
+            f"mysql -u root -e 'DROP DATABASE IF EXISTS {DB_NAME}' "
+            ">/dev/null 2>&1 || true"))
+
+    def start(self, test, node):
+        control.exec_("service", "mysql", "start")
+
+    def kill(self, test, node):
+        control.exec_(control.lit(
+            "service mysql stop >/dev/null 2>&1 || true"))
+        cu.grepkill("mysqld")
+
+    def pause(self, test, node):
+        cu.grepkill("mysqld", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("mysqld", sig="CONT")
+
+    def log_files(self, test, node):
+        return LOG_FILES
+
+
+SUPPORTED_WORKLOADS = ("set", "bank", "dirty-reads")
+
+
+def galera_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="galera", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": GaleraDB(),
+            "client": MySQLSuiteClient(
+                port=PORT, database=DB_NAME, user=DB_USER, password=DB_PASS,
+                isolation=o.get("isolation", "serializable")),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(galera_test, extra_keys=("isolation",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--isolation", default="serializable",
+                        choices=["read-committed", "repeatable-read",
+                                 "serializable"])),
+    name="jepsen-galera")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""MySQL Cluster (NDB) test suite (reference:
+mysql-cluster/src/jepsen/mysql_cluster.clj — the three-daemon NDB
+topology: management servers, storage nodes, and SQL front ends).
+
+The reference suite builds the topology but ships only a noop test map
+(mysql_cluster.clj:220-227 ``simple-test``); here the shared MySQL-wire
+client additionally runs register/set/bank against the SQL nodes with
+``ENGINE=NDBCLUSTER`` tables, which is the natural workload surface for
+the same deployment.
+
+Topology per mysql_cluster.clj:54-118: every node gets a management
+daemon (node ids 1..n), the first four get storage daemons (ids 11..),
+and every node gets a mysqld (ids 21..) whose ndb connect string lists
+all nodes. Startup order is mgmd → barrier → ndbd → barrier → mysqld
+(mysql_cluster.clj:188-203).
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._mysql_client import (MySQLSuiteClient,
+                                             create_db_and_user)
+
+logger = logging.getLogger("jepsen.mysql_cluster")
+
+PORT = 3306
+DB_NAME = "jepsen"
+DB_USER = "jepsen"
+DB_PASS = "jepsen"
+MGMD_DIR = "/var/lib/mysql/cluster"
+NDBD_DIR = "/var/lib/mysql/data"
+MYSQLD_DIR = "/var/lib/mysql/mysql"
+CONFIG_INI = "/etc/my.config.ini"
+MY_CNF = "/etc/my.cnf"
+# node-id blocks per role (mysql_cluster.clj:54-75)
+MGMD_ID_OFFSET = 1
+NDBD_ID_OFFSET = 11
+MYSQLD_ID_OFFSET = 21
+NDBD_COUNT = 4
+
+
+def node_index(test: dict, node: str) -> int:
+    return (test.get("nodes") or [node]).index(node)
+
+
+def ndbd_nodes(test: dict) -> list[str]:
+    """First four nodes carry storage daemons (mysql_cluster.clj:99-103)."""
+    return sorted((test.get("nodes") or [])[:NDBD_COUNT])
+
+
+def config_ini(test: dict) -> str:
+    """The cluster-wide config.ini role snippets
+    (mysql_cluster.clj:77-118)."""
+    nodes = test.get("nodes") or []
+    parts = ["[ndbd default]", "NoOfReplicas=2", ""]
+    for n in nodes:
+        parts += [f"[ndb_mgmd]",
+                  f"NodeId={MGMD_ID_OFFSET + node_index(test, n)}",
+                  f"hostname={n}", f"datadir={MGMD_DIR}", ""]
+    for n in ndbd_nodes(test):
+        parts += [f"[ndbd]",
+                  f"NodeId={NDBD_ID_OFFSET + node_index(test, n)}",
+                  f"hostname={n}", f"datadir={NDBD_DIR}", ""]
+    for n in nodes:
+        parts += [f"[mysqld]",
+                  f"NodeId={MYSQLD_ID_OFFSET + node_index(test, n)}",
+                  f"hostname={n}", ""]
+    return "\n".join(parts)
+
+
+def ndb_connect_string(test: dict) -> str:
+    return ",".join(test.get("nodes") or [])
+
+
+def my_cnf(test: dict, node: str) -> str:
+    """The per-node mysqld config (mysql_cluster.clj:120-132)."""
+    return "\n".join([
+        "[mysqld]",
+        "ndbcluster",
+        f"ndb-nodeid={MYSQLD_ID_OFFSET + node_index(test, node)}",
+        f"ndb-connectstring={ndb_connect_string(test)}",
+        f"datadir={MYSQLD_DIR}",
+        "bind-address=0.0.0.0",
+        "user=mysql",
+        "",
+        "[mysql_cluster]",
+        f"ndb-connectstring={ndb_connect_string(test)}",
+        "",
+    ])
+
+
+class MySQLClusterDB(db_mod.DB, db_mod.Process, db_mod.LogFiles):
+    """NDB lifecycle (mysql_cluster.clj:140-218): mgmd everywhere,
+    ndbd on the first four nodes, mysqld everywhere, phase barriers
+    between the three role startups."""
+
+    def __init__(self, package: str = "mysql-cluster-community-server"):
+        self.package = package
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing mysql cluster", node)
+        os_setup.install(["libaio1", "libncurses5"])
+        os_setup.install([self.package])
+        for d in (MGMD_DIR, NDBD_DIR, MYSQLD_DIR):
+            cu.mkdir(d)
+        cu.write_file(config_ini(test), CONFIG_INI)
+        cu.write_file(my_cnf(test, node), MY_CNF)
+        self.start_mgmd(test, node)
+        core.synchronize(test, timeout_s=300.0)
+        self.start_ndbd(test, node)
+        core.synchronize(test, timeout_s=300.0)
+        self.start_mysqld(test, node)
+        cu.await_tcp_port(PORT, host=node, timeout_s=120.0)
+        create_db_and_user(DB_NAME, DB_USER, DB_PASS)
+
+    def start_mgmd(self, test, node):
+        """Management daemon (mysql_cluster.clj:140-147)."""
+        control.exec_("ndb_mgmd",
+                      f"--ndb-nodeid={MGMD_ID_OFFSET + node_index(test, node)}",
+                      "-f", CONFIG_INI,
+                      "--configdir=" + MGMD_DIR)
+
+    def start_ndbd(self, test, node):
+        """Storage daemon on the first four nodes only
+        (mysql_cluster.clj:149-157)."""
+        if node in ndbd_nodes(test):
+            control.exec_(
+                "ndbd",
+                f"--ndb-nodeid={NDBD_ID_OFFSET + node_index(test, node)}")
+
+    def start_mysqld(self, test, node):
+        """SQL daemon (mysql_cluster.clj:159-167). An empty datadir is
+        initialized first — the package postinst only initializes the
+        default location, not our my.cnf's."""
+        if not cu.file_exists(f"{MYSQLD_DIR}/mysql"):
+            control.exec_(control.lit(
+                f"mysqld --defaults-file={MY_CNF} --initialize-insecure "
+                f">/dev/null 2>&1 || true"))
+        return cu.start_daemon(
+            {"logfile": f"{MYSQLD_DIR}/mysqld.log",
+             "pidfile": f"{MYSQLD_DIR}/mysqld.pid",
+             "chdir": MYSQLD_DIR},
+            "mysqld", f"--defaults-file={MY_CNF}")
+
+    def teardown(self, test, node):
+        for proc in ("mysqld", "ndbd", "ndb_mgmd"):
+            cu.grepkill(proc)
+        for d in (MGMD_DIR, NDBD_DIR, MYSQLD_DIR):
+            cu.rm_rf(d)
+
+    def start(self, test, node):
+        self.start_mysqld(test, node)
+
+    def kill(self, test, node):
+        cu.grepkill("mysqld")
+
+    def log_files(self, test, node):
+        return [f"{MYSQLD_DIR}/mysqld.log"]
+
+
+SUPPORTED_WORKLOADS = ("register", "set", "bank")
+
+
+def mysql_cluster_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="mysql-cluster",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": MySQLClusterDB(),
+            "client": MySQLSuiteClient(
+                port=PORT, database=DB_NAME, user=DB_USER, password=DB_PASS,
+                isolation=o.get("isolation", "repeatable-read"),
+                engine="NDBCLUSTER"),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(mysql_cluster_test, extra_keys=("isolation",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--isolation", default="repeatable-read",
+                        choices=["read-committed", "repeatable-read",
+                                 "serializable"])),
+    name="jepsen-mysql-cluster")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Percona XtraDB Cluster test suite (reference:
+percona/src/jepsen/percona.clj + percona/dirty_reads.clj — galera-based
+synchronous replication on Percona Server; the reference probes the
+same bank-sum and dirty-read anomalies as the galera suite).
+
+Workloads ride the shared MySQL-wire client: ``bank``
+(percona.clj:243-301 serializable transfers), ``dirty-reads``
+(percona/dirty_reads.clj), and ``set``. DB automation mirrors
+percona.clj:34-151: add the percona apt repo, pre-seed debconf root
+passwords, install the cluster package, write the wsrep config, start
+node 1 with ``bootstrap-pxc``, barrier, start the rest.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._mysql_client import (MySQLSuiteClient,
+                                             create_db_and_user)
+from jepsen_tpu.suites.galera import wsrep_config
+
+logger = logging.getLogger("jepsen.percona")
+
+PORT = 3306
+DB_NAME = "jepsen"
+DB_USER = "jepsen"
+DB_PASS = "jepsen"
+ROOT_PASS = "jepsen"
+PACKAGE = "percona-xtradb-cluster-57"
+CONF_FILE = "/etc/mysql/conf.d/jepsen.cnf"
+LOG_FILES = ["/var/log/syslog", "/var/log/mysql.log", "/var/log/mysql.err",
+             "/var/log/mysqld.log"]
+
+
+class PerconaDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Percona XtraDB Cluster lifecycle (percona.clj:34-151)."""
+
+    def __init__(self, package: str = PACKAGE):
+        self.package = package
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing %s", node, self.package)
+        os_setup.add_repo(
+            "percona", "deb http://repo.percona.com/apt bullseye main",
+            keyserver="keyserver.ubuntu.com", key_id="9334A25F8507EFA5")
+        # pre-seed root passwords so the install is non-interactive
+        # (percona.clj:52-56)
+        for sel in (f"{self.package} mysql-server/root_password "
+                    f"password {ROOT_PASS}",
+                    f"{self.package} mysql-server/root_password_again "
+                    f"password {ROOT_PASS}"):
+            os_setup.debconf_set(sel)
+        os_setup.install([self.package, "rsync"])
+        control.exec_(control.lit(
+            "service mysql stop >/dev/null 2>&1 || true"))
+        cu.mkdir("/etc/mysql/conf.d")
+        # PXC bundles galera-3 under /usr/lib/galera3/
+        cu.write_file(
+            wsrep_config(test,
+                         provider="/usr/lib/galera3/libgalera_smm.so"),
+            CONF_FILE)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            # bootstrap-pxc forms the new cluster (percona.clj:127)
+            control.exec_("service", "mysql", "start", "bootstrap-pxc")
+        core.synchronize(test, timeout_s=300.0)
+        if node != primary:
+            control.exec_("service", "mysql", "start")
+        core.synchronize(test, timeout_s=300.0)
+        cu.await_tcp_port(PORT, host=node)
+        create_db_and_user(DB_NAME, DB_USER, DB_PASS, root_pass=ROOT_PASS)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        control.exec_(control.lit(
+            f"mysql -u root --password={ROOT_PASS} "
+            f"-e 'DROP DATABASE IF EXISTS {DB_NAME}' "
+            ">/dev/null 2>&1 || true"))
+
+    def start(self, test, node):
+        control.exec_("service", "mysql", "start")
+
+    def kill(self, test, node):
+        control.exec_(control.lit(
+            "service mysql stop >/dev/null 2>&1 || true"))
+        cu.grepkill("mysqld")
+
+    def pause(self, test, node):
+        cu.grepkill("mysqld", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("mysqld", sig="CONT")
+
+    def log_files(self, test, node):
+        return LOG_FILES
+
+
+SUPPORTED_WORKLOADS = ("bank", "dirty-reads", "set")
+
+
+def percona_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="percona",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": PerconaDB(),
+            "client": MySQLSuiteClient(
+                port=PORT, database=DB_NAME, user=DB_USER, password=DB_PASS,
+                isolation=o.get("isolation", "serializable")),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(percona_test, extra_keys=("isolation",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--isolation", default="serializable",
+                        choices=["read-committed", "repeatable-read",
+                                 "serializable"])),
+    name="jepsen-percona")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

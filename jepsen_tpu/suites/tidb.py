@@ -1,0 +1,200 @@
+"""TiDB test suite (reference: tidb/src/tidb/ — PD placement drivers,
+TiKV storage, and MySQL-protocol tidb-servers; the reference's richest
+SQL suite, with register/set/bank/txn workloads, tidb/src/tidb/core.clj
+workloads-as-data).
+
+Workloads ride the shared MySQL-wire client on port 4000:
+``register``/``set``/``bank`` (tidb/src/tidb/{register,sets,bank}.clj)
+plus the Elle ``append`` and ``wr`` transactional workloads whose
+micro-op SQL mirrors tidb/src/tidb/txn.clj:19-48 (CONCAT-upsert
+appends).
+
+DB automation mirrors tidb/src/tidb/db.clj: one release tarball, then
+per node pd-server (client 2379 / peer 2380, full --initial-cluster),
+tikv-server (20160, --pd endpoints), and tidb-server (--store tikv,
+port 4000), with barriers between the three tiers and health waits.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._mysql_client import MySQLSuiteClient
+
+logger = logging.getLogger("jepsen.tidb")
+
+DEFAULT_VERSION = "v7.1.5"
+DIR = "/opt/tidb"
+BIN = f"{DIR}/bin"
+PD_CLIENT_PORT = 2379
+PD_PEER_PORT = 2380
+KV_PORT = 20160
+SQL_PORT = 4000
+DB_NAME = "jepsen"
+# the root user ships passwordless (tidb/src/tidb/sql.clj conn specs)
+DB_USER = "root"
+DB_PASS = ""
+
+PD_LOG = f"{DIR}/pd.log"
+KV_LOG = f"{DIR}/kv.log"
+DB_LOG = f"{DIR}/db.log"
+
+
+def tarball_url(version: str) -> str:
+    return (f"https://download.pingcap.org/tidb-community-server-"
+            f"{version}-linux-amd64.tar.gz")
+
+
+def pd_name(test: dict, node: str) -> str:
+    """pd1..pdn (tidb/db.clj:48-55 tidb-map)."""
+    return f"pd{(test.get('nodes') or [node]).index(node) + 1}"
+
+
+def initial_cluster(test: dict) -> str:
+    """``pd1=http://n1:2380,...`` (tidb/db.clj:72-78)."""
+    return ",".join(f"{pd_name(test, n)}=http://{n}:{PD_PEER_PORT}"
+                    for n in (test.get("nodes") or []))
+
+
+def pd_endpoints(test: dict) -> str:
+    """``n1:2379,n2:2379,...`` (tidb/db.clj:80-87)."""
+    return ",".join(f"{n}:{PD_CLIENT_PORT}"
+                    for n in (test.get("nodes") or []))
+
+
+class TiDBDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Three-tier lifecycle with per-tier barriers
+    (tidb/db.clj:165-215,287-310)."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from jepsen_tpu import core
+        if not cu.file_exists(f"{BIN}/pd-server"):
+            logger.info("%s: installing tidb %s", node, self.version)
+            cu.install_archive(tarball_url(self.version), DIR)
+            cu.mkdir(BIN)
+            # the community tarball nests binaries one directory down
+            control.exec_(control.lit(
+                f"find {DIR} -name pd-server -o -name tikv-server "
+                f"-o -name tidb-server | xargs -I{{}} cp {{}} {BIN}/"))
+        self.start_pd(test, node)
+        cu.await_tcp_port(PD_CLIENT_PORT, host=node, timeout_s=120.0)
+        core.synchronize(test, timeout_s=600.0)
+        self.start_kv(test, node)
+        cu.await_tcp_port(KV_PORT, host=node, timeout_s=120.0)
+        core.synchronize(test, timeout_s=600.0)
+        self.start_db(test, node)
+        cu.await_tcp_port(SQL_PORT, host=node, timeout_s=180.0)
+        control.exec_(control.lit(
+            f"mysql -h 127.0.0.1 -P {SQL_PORT} -u root -e "
+            f"'CREATE DATABASE IF NOT EXISTS {DB_NAME}' "
+            f"2>/dev/null || true"))
+
+    def start_pd(self, test, node):
+        """pd-server argv (tidb/db.clj:165-183)."""
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/pd.stdout", "pidfile": f"{DIR}/pd.pid",
+             "chdir": DIR},
+            f"{BIN}/pd-server",
+            "--name", pd_name(test, node),
+            "--data-dir", f"{DIR}/data/pd",
+            "--client-urls", f"http://0.0.0.0:{PD_CLIENT_PORT}",
+            "--peer-urls", f"http://0.0.0.0:{PD_PEER_PORT}",
+            "--advertise-client-urls", f"http://{node}:{PD_CLIENT_PORT}",
+            "--advertise-peer-urls", f"http://{node}:{PD_PEER_PORT}",
+            "--initial-cluster", initial_cluster(test),
+            "--log-file", PD_LOG)
+
+    def start_kv(self, test, node):
+        """tikv-server argv (tidb/db.clj:185-200)."""
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/kv.stdout", "pidfile": f"{DIR}/kv.pid",
+             "chdir": DIR},
+            f"{BIN}/tikv-server",
+            "--pd", pd_endpoints(test),
+            "--addr", f"0.0.0.0:{KV_PORT}",
+            "--advertise-addr", f"{node}:{KV_PORT}",
+            "--data-dir", f"{DIR}/data/kv",
+            "--log-file", KV_LOG)
+
+    def start_db(self, test, node):
+        """tidb-server argv (tidb/db.clj:202-215)."""
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/db.stdout", "pidfile": f"{DIR}/db.pid",
+             "chdir": DIR},
+            f"{BIN}/tidb-server",
+            "-P", str(SQL_PORT),
+            "--store", "tikv",
+            "--path", pd_endpoints(test),
+            "--log-file", DB_LOG)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/data")
+        for f in (PD_LOG, KV_LOG, DB_LOG):
+            cu.rm_rf(f)
+
+    def start(self, test, node):
+        self.start_pd(test, node)
+        self.start_kv(test, node)
+        self.start_db(test, node)
+
+    def kill(self, test, node):
+        for proc in ("tidb-server", "tikv-server", "pd-server"):
+            cu.grepkill(proc)
+
+    def pause(self, test, node):
+        for proc in ("tidb-server", "tikv-server", "pd-server"):
+            cu.grepkill(proc, sig="STOP")
+
+    def resume(self, test, node):
+        for proc in ("tidb-server", "tikv-server", "pd-server"):
+            cu.grepkill(proc, sig="CONT")
+
+    def log_files(self, test, node):
+        return [PD_LOG, KV_LOG, DB_LOG]
+
+
+SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "wr",
+                       "long-fork")
+
+
+def tidb_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    return build_suite_test(
+        o, db_name="tidb", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": TiDBDB(o.get("version", DEFAULT_VERSION)),
+            "client": MySQLSuiteClient(
+                port=SQL_PORT, database=DB_NAME, user=DB_USER,
+                password=DB_PASS,
+                isolation=o.get("isolation", "repeatable-read"),
+                txn_style="wr" if workload in ("wr", "long-fork")
+                else "append"),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(tidb_test, extra_keys=("isolation", "version")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: (
+                        p.add_argument("--isolation",
+                                       default="repeatable-read",
+                                       choices=["read-committed",
+                                                "repeatable-read",
+                                                "serializable"]),
+                        p.add_argument("--version",
+                                       default=DEFAULT_VERSION))),
+    name="jepsen-tidb")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Dirty-reads workload (reference: galera/src/jepsen/galera/dirty_reads.clj
+and percona/src/jepsen/percona/dirty_reads.clj).
+
+Writers compete to set *every* row of an n-row table to one unique
+value inside a single transaction; readers concurrently read all rows.
+A reader observing the value of a **failed** (aborted) write transaction
+is a dirty read — the anomaly this workload exists to catch
+(dirty_reads.clj:73-96). Reads whose rows are not all equal are reported
+as ``inconsistent-reads`` (fractured snapshots) but, as in the
+reference, only dirty reads invalidate the run.
+
+Op shapes: ``{"f": "write", "value": x}`` (set all rows to x) and
+``{"f": "read", "value": None → [x0 ... xn-1]}``.
+"""
+from __future__ import annotations
+
+import itertools
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+DEFAULT_ROWS = 4
+
+
+def reads():
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    return gen.Fn(read)
+
+
+def writes():
+    """Unique, monotonically-increasing write values (dirty_reads.clj:100-105)
+    so a failed write's value can be attributed unambiguously."""
+    counter = itertools.count()
+
+    def write(test, ctx):
+        return {"f": "write", "value": next(counter)}
+
+    return gen.Fn(write)
+
+
+class DirtyReadsChecker(Checker):
+    """Failed writes' values must never appear in an ok read
+    (dirty_reads.clj:73-96)."""
+
+    def name(self):
+        return "dirty-reads"
+
+    def check(self, test, history, opts):
+        failed_writes = {op.get("value") for op in history
+                         if op.get("type") == "fail"
+                         and op.get("f") == "write"}
+        ok_reads = [op.get("value") or [] for op in history
+                    if op.get("type") == "ok" and op.get("f") == "read"]
+        inconsistent = [r for r in ok_reads if len(set(r)) > 1]
+        dirty = [r for r in ok_reads
+                 if any(x in failed_writes for x in r)]
+        return {
+            "valid?": not dirty,
+            "read-count": len(ok_reads),
+            "failed-write-count": len(failed_writes),
+            "inconsistent-reads": inconsistent[:10],
+            "inconsistent-count": len(inconsistent),
+            "dirty-reads": dirty[:10],
+            "dirty-count": len(dirty),
+        }
+
+
+def checker() -> Checker:
+    return DirtyReadsChecker()
+
+
+def workload(test: dict | None = None, rows: int = DEFAULT_ROWS,
+             **_) -> dict:
+    return {
+        "dirty-rows": rows,
+        "generator": gen.mix([reads(), writes()]),
+        "checker": checker(),
+    }

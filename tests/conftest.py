@@ -30,3 +30,17 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def run_fake(suite_test_fn, **opts):
+    """Shared fake-mode lifecycle harness for suite tests: builds the
+    suite's test map in --fake mode (in-memory doubles over the dummy
+    remote) and runs the full core.run lifecycle into a throwaway store."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        t = suite_test_fn({"fake": True, "time_limit": 1.0,
+                           "store_dir": tmp, "no_perf": True,
+                           "accelerator": "cpu", **opts})
+        from jepsen_tpu import core
+        return core.run(t)

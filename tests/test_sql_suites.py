@@ -1,0 +1,221 @@
+"""MySQL-protocol suite family tests: galera, percona, mysql-cluster,
+tidb — test-map shapes, DB automation command shapes over the dummy
+remote, fake-mode lifecycle runs for the new bank/dirty-reads fake
+paths, and the shared SQL client's workload bodies against a stub
+connection."""
+from jepsen_tpu import control
+from jepsen_tpu.suites import galera, mysql_cluster, percona, tidb
+from jepsen_tpu.suites._mysql_client import MySQLSuiteClient, parse_int_list
+from jepsen_tpu.workloads import dirty_reads
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+from conftest import run_fake  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# config generation
+# ---------------------------------------------------------------------------
+
+def test_galera_wsrep_config():
+    cfg = galera.wsrep_config({"nodes": NODES})
+    assert "wsrep_cluster_address = gcomm://n1,n2,n3,n4,n5" in cfg
+    assert "wsrep_on = ON" in cfg
+    assert "binlog_format = ROW" in cfg
+
+
+def test_mysql_cluster_config_ini_roles():
+    t = {"nodes": NODES}
+    ini = mysql_cluster.config_ini(t)
+    # mgmd on every node (ids 1..5), ndbd on first four (ids 11..14),
+    # mysqld everywhere (ids 21..25) — mysql_cluster.clj:54-118
+    assert "NodeId=1" in ini and "NodeId=5" in ini
+    assert "NodeId=11" in ini and "NodeId=14" in ini
+    assert "NodeId=15" not in ini.split("[mysqld]")[0]
+    assert "NodeId=21" in ini and "NodeId=25" in ini
+    cnf = mysql_cluster.my_cnf(t, "n3")
+    assert "ndbcluster" in cnf
+    assert "ndb-connectstring=n1,n2,n3,n4,n5" in cnf
+    assert "ndb-nodeid=23" in cnf
+
+
+def test_tidb_cluster_strings():
+    t = {"nodes": NODES}
+    assert tidb.initial_cluster(t).startswith("pd1=http://n1:2380,")
+    assert tidb.pd_endpoints(t) == ("n1:2379,n2:2379,n3:2379,"
+                                    "n4:2379,n5:2379")
+
+
+def test_tidb_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = tidb.TiDBDB()
+    try:
+        control.on("n2", t, lambda: db.start_pd(t, "n2"))
+        control.on("n2", t, lambda: db.start_kv(t, "n2"))
+        control.on("n2", t, lambda: db.start_db(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--name pd2" in joined
+        assert "--initial-cluster" in joined
+        assert "--store tikv" in joined
+        assert "--advertise-addr n2:20160" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycle: bank, dirty-reads, append
+# ---------------------------------------------------------------------------
+
+def test_galera_fake_bank_run():
+    result = run_fake(galera.galera_test, workload="bank")
+    assert result["results"]["valid?"] is True, result["results"]
+    # bank reads must be balance dicts summing to the invariant total
+    reads = [op for op in result["history"]
+             if op.get("f") == "read" and op.get("type") == "ok"]
+    assert reads and all(sum(op["value"].values()) == 80 for op in reads)
+
+
+def test_galera_fake_dirty_reads_run():
+    result = run_fake(galera.galera_test, workload="dirty-reads")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_percona_fake_bank_run():
+    result = run_fake(percona.percona_test, workload="bank")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_tidb_fake_append_run():
+    result = run_fake(tidb.tidb_test, workload="append")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_tidb_fake_long_fork_run():
+    result = run_fake(tidb.tidb_test, workload="long-fork")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_mysql_cluster_fake_register_run():
+    result = run_fake(mysql_cluster.mysql_cluster_test, workload="register")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# dirty-reads checker semantics
+# ---------------------------------------------------------------------------
+
+def test_dirty_reads_checker_flags_failed_write_values():
+    chk = dirty_reads.checker()
+    history = [
+        {"type": "invoke", "f": "write", "value": 7, "process": 0},
+        {"type": "fail", "f": "write", "value": 7, "process": 0},
+        {"type": "invoke", "f": "read", "value": None, "process": 1},
+        {"type": "ok", "f": "read", "value": [7, 7, 7, 7], "process": 1},
+    ]
+    out = chk.check({}, history, {})
+    assert out["valid?"] is False
+    assert out["dirty-count"] == 1
+
+
+def test_dirty_reads_checker_reports_inconsistent_reads():
+    chk = dirty_reads.checker()
+    history = [
+        {"type": "invoke", "f": "write", "value": 3, "process": 0},
+        {"type": "ok", "f": "write", "value": 3, "process": 0},
+        {"type": "ok", "f": "read", "value": [3, 3, -1, -1], "process": 1},
+    ]
+    out = chk.check({}, history, {})
+    assert out["valid?"] is True            # only dirty reads invalidate
+    assert out["inconsistent-count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the shared SQL client against a stub connection
+# ---------------------------------------------------------------------------
+
+class StubConn:
+    """Collects queries; returns canned rows per matching prefix."""
+
+    def __init__(self, replies=()):
+        self.queries: list[str] = []
+        self.replies = dict(replies)
+
+    def query(self, sql):
+        self.queries.append(sql)
+        for prefix, rows in self.replies.items():
+            if sql.startswith(prefix):
+                return rows
+        return (0, 0)
+
+    def close(self):
+        pass
+
+
+def test_sql_client_transfer_refuses_overdraft():
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT balance": [("3",)]})
+    out = c.invoke({"accounts": [0, 1]},
+                   {"f": "transfer", "type": "invoke",
+                    "value": {"from": 0, "to": 1, "amount": 5}})
+    assert out["type"] == "fail" and out["error"][0] == "negative"
+    assert any(q == "ROLLBACK" for q in c.conn.queries)
+    assert not any(q.startswith("UPDATE") for q in c.conn.queries)
+
+
+def test_sql_client_transfer_commits():
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT balance": [("10",)]})
+    out = c.invoke({}, {"f": "transfer", "type": "invoke",
+                        "value": {"from": 0, "to": 1, "amount": 5}})
+    assert out["type"] == "ok"
+    updates = [q for q in c.conn.queries if q.startswith("UPDATE")]
+    assert len(updates) == 2 and c.conn.queries[-1] == "COMMIT"
+
+
+def test_sql_client_txn_append_and_read():
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT elems": [("1,2,3",)]})
+    out = c.invoke({}, {"f": "txn", "type": "invoke",
+                        "value": [["r", 5, None], ["append", 5, 4]]})
+    assert out["type"] == "ok"
+    assert out["value"][0] == ["r", 5, [1, 2, 3]]
+    assert out["value"][1] == ["append", 5, 4]
+    assert any("CONCAT" in q for q in c.conn.queries)
+    assert c.conn.queries[-1] == "COMMIT"
+
+
+def test_sql_client_wr_txn_reads_registers():
+    c = MySQLSuiteClient(txn_style="wr")
+    c.conn = StubConn({"SELECT v FROM registers": [("9",)]})
+    out = c.invoke({}, {"f": "txn", "type": "invoke",
+                        "value": [["r", 1, None], ["w", 1, 2]]})
+    assert out["type"] == "ok"
+    assert out["value"][0] == ["r", 1, 9]
+    assert out["value"][1] == ["w", 1, 2]
+
+
+def test_sql_client_whole_read_dispatch():
+    # bank-style test map → balances dict
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT id, balance": [("0", "10"), ("1", "13")]})
+    out = c.invoke({"accounts": [0, 1]},
+                   {"f": "read", "type": "invoke", "value": None})
+    assert out["value"] == {0: 10, 1: 13}
+    # dirty-reads test map → row list
+    c.conn = StubConn({"SELECT x FROM dirty": [("5",), ("5",)]})
+    out = c.invoke({"dirty-rows": 2},
+                   {"f": "read", "type": "invoke", "value": None})
+    assert out["value"] == [5, 5]
+    # plain → whole set
+    c.conn = StubConn({"SELECT elem": [("1",), ("2",)]})
+    out = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+    assert out["value"] == [1, 2]
+
+
+def test_parse_int_list():
+    assert parse_int_list(None) == []
+    assert parse_int_list("") == []
+    assert parse_int_list("1") == [1]
+    assert parse_int_list("1,2,3") == [1, 2, 3]

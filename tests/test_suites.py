@@ -80,13 +80,7 @@ def test_zookeeper_cfg_and_myid():
 # fake-mode lifecycle
 # ---------------------------------------------------------------------------
 
-def run_fake(suite_test_fn, **opts):
-    with tempfile.TemporaryDirectory() as tmp:
-        t = suite_test_fn({"fake": True, "time_limit": 1.0,
-                           "store_dir": tmp, "no_perf": True,
-                           "accelerator": "cpu", **opts})
-        from jepsen_tpu import core
-        return core.run(t)
+from conftest import run_fake  # noqa: E402
 
 
 def test_etcd_fake_register_run():
