@@ -120,6 +120,9 @@ class KVStore(MetaLogDB):
         self.lists: dict = {}
         self.accounts: dict = {}   # bank workload balances
         self.rows: dict = {}       # dirty-reads workload rows
+        self.mono: list = []       # monotonic workload (val, ts) rows
+        self.seq: set = set()      # sequential workload subkeys
+        self.adya: dict = {}       # adya G2 pair -> (cell, uid)
 
     def _wipe(self):
         self.registers.clear()
@@ -127,6 +130,9 @@ class KVStore(MetaLogDB):
         self.lists.clear()
         self.accounts.clear()
         self.rows.clear()
+        self.mono.clear()
+        self.seq.clear()
+        self.adya.clear()
 
     def read(self, k):
         with self.lock:
@@ -210,6 +216,35 @@ class KVStore(MetaLogDB):
             self.accounts[to] = self.accounts.get(to, 0) + amount
             return True
 
+    # monotonic (workloads/monotonic.py): read-max-insert-max+1 rows
+    def mono_inc(self) -> int:
+        with self.lock:
+            val = (self.mono[-1][0] + 1) if self.mono else 0
+            self.mono.append((val, len(self.mono)))
+            return val
+
+    def mono_read(self) -> list:
+        with self.lock:
+            return [[v, ts] for v, ts in self.mono]
+
+    # adya G2 (workloads/adya.py): insert-if-pair-empty, atomically
+    def adya_insert(self, pair, uid, cell) -> bool:
+        with self.lock:
+            if pair in self.adya:
+                return False
+            self.adya[pair] = (cell, uid)
+            return True
+
+    # sequential (workloads/sequential.py): ordered subkey inserts
+    def seq_write(self, sks) -> None:
+        with self.lock:
+            for sk in sks:
+                self.seq.add(sk)
+
+    def seq_read(self, sks) -> list:
+        with self.lock:
+            return [sk if sk in self.seq else None for sk in sks]
+
     # dirty-reads (workloads/dirty_reads.py): n rows set atomically
     def rows_init(self, n: int):
         with self.lock:
@@ -268,6 +303,23 @@ class KVClient(MetaLogClient):
         if f == "write" and self.whole_read == "dirty":
             self.db.write_all_rows(v)
             return {**op, "type": "ok"}
+        if f == "insert":
+            pair, uid, cell = v
+            ok = self.db.adya_insert(pair, uid, cell)
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "inc":
+            return {**op, "type": "ok", "value": self.db.mono_inc()}
+        if f == "read-all":
+            return {**op, "type": "ok", "value": self.db.mono_read()}
+        if test.get("key-count") and f in ("read", "write") \
+                and not isinstance(v, (list, tuple)):
+            from jepsen_tpu.workloads.sequential import subkeys
+            sks = subkeys(int(test.get("key-count", 5)), v)
+            if f == "write":
+                self.db.seq_write(sks)
+                return {**op, "type": "ok"}
+            return {**op, "type": "ok",
+                    "value": [v, self.db.seq_read(list(reversed(sks)))]}
         if f == "txn":
             return {**op, "type": "ok",
                     "value": self.db.txn(v, style=self.txn_style)}

@@ -184,10 +184,11 @@ def standard_test_fn(suite_test: Callable,
 def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""
-    from jepsen_tpu.suites import (chronos, consul, crate, dgraph, disque,
-                                   elasticsearch, etcd, galera, hazelcast,
-                                   ignite, mongodb, mysql_cluster, percona,
-                                   postgres, raftis, redis, tidb, zookeeper)
+    from jepsen_tpu.suites import (chronos, cockroachdb, consul, crate,
+                                   dgraph, disque, elasticsearch, etcd,
+                                   galera, hazelcast, ignite, mongodb,
+                                   mysql_cluster, percona, postgres, raftis,
+                                   redis, stolon, tidb, yugabyte, zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -207,6 +208,9 @@ def suite_registry() -> dict[str, Callable]:
         "percona": percona.percona_test,
         "mysql-cluster": mysql_cluster.mysql_cluster_test,
         "tidb": tidb.tidb_test,
+        "cockroachdb": cockroachdb.cockroachdb_test,
+        "stolon": stolon.stolon_test,
+        "yugabyte": yugabyte.yugabyte_test,
     }
 
 
@@ -215,8 +219,8 @@ def workload_registry() -> dict[str, Callable]:
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
                                       causal_reverse, dirty_reads, long_fork,
-                                      queue_workload, register, set_workload,
-                                      wr)
+                                      monotonic, queue_workload, register,
+                                      sequential, set_workload, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -229,4 +233,6 @@ def workload_registry() -> dict[str, Callable]:
         "adya": adya.workload,
         "queue": queue_workload.workload,
         "dirty-reads": dirty_reads.workload,
+        "monotonic": monotonic.workload,
+        "sequential": sequential.workload,
     }

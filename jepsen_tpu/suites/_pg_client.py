@@ -1,0 +1,369 @@
+"""Shared SQL client for the Postgres-protocol suite family — postgres,
+cockroachdb, stolon, and yugabyte YSQL (reference: the jdbc client
+layers in cockroachdb/src/jepsen/cockroach/client.clj,
+stolon/src/jepsen/stolon/client.clj, postgres-rds/).
+
+One client class speaks every bundled SQL workload over the
+from-scratch wire protocol in ``_postgres.py``:
+
+- register r/w/cas, set add/read, Elle list-append txns — the surface
+  the postgres suite established (suites/postgres.py)
+- bank read/transfer (cockroach/bank.clj shape: serializable two-row
+  transfers with overdraft refusal)
+- dirty-reads read/write (galera/dirty_reads.clj shape)
+- monotonic inc/read-all (cockroach/monotonic.clj:32-66: read max,
+  insert max+1 with the DB's own timestamp expression — cockroach's
+  ``cluster_logical_timestamp()``, plain postgres's wall clock)
+- sequential write/read (cockroach/sequential.clj:33-95: subkeys
+  inserted in order across per-hash tables, read reversed)
+
+Error discipline: SQLSTATE class-40 rollbacks (serialization failure /
+deadlock) are definite ``fail``; network errors fail reads and are
+indeterminate for writes; an errored connection is rebuilt before its
+next use (leftover bytes would desync the wire protocol).
+"""
+from __future__ import annotations
+
+import zlib
+
+from jepsen_tpu.client import Client
+from jepsen_tpu.suites._postgres import (DEADLOCK_DETECTED, PGConnection,
+                                         PgError, SERIALIZATION_FAILURE,
+                                         parse_int_array)
+
+SEQ_TABLE_COUNT = 5
+# postgres wall-clock default; cockroach overrides with its HLC
+DEFAULT_TS_EXPR = "extract(epoch from clock_timestamp())"
+
+
+def seq_table(k: str, table_count: int = SEQ_TABLE_COUNT) -> str:
+    """Stable subkey→table assignment (sequential.clj:41-44; crc32, not
+    Python's salted hash, so every client agrees)."""
+    return f"seq_{zlib.crc32(str(k).encode()) % table_count}"
+
+
+class PGSuiteClient(Client):
+    """Workload client over one PGConnection. ``ts_expr`` is the SQL
+    expression for the monotonic workload's commit-order timestamp;
+    ``endpoint_mode`` is "node" (connect to your own node) or "first"
+    (all clients share node 1)."""
+
+    def __init__(self, *, port: int = 5432, database: str = "jepsen",
+                 user: str = "jepsen", password: str = "jepsenpw",
+                 isolation: str = "serializable",
+                 endpoint_mode: str = "node", txn_style: str = "append",
+                 ts_expr: str = DEFAULT_TS_EXPR,
+                 timeout_s: float = 10.0, node: str | None = None):
+        self.port = port
+        self.database = database
+        self.user = user
+        self.password = password
+        self.isolation = isolation
+        self.endpoint_mode = endpoint_mode
+        # "append": txn r micro-ops read the lists table (Elle
+        # list-append); "wr": they read registers (Elle rw-register)
+        self.txn_style = txn_style
+        self.ts_expr = ts_expr
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: PGConnection | None = None
+        self._broken = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def endpoint(self, test, node) -> tuple[str, int]:
+        if self.endpoint_mode == "first":
+            return (test.get("nodes") or [node])[0], self.port
+        return node, self.port
+
+    def _connect(self, test):
+        host, port = self.endpoint(test, self.node)
+        self.conn = PGConnection(
+            host=host, port=port, database=self.database, user=self.user,
+            password=self.password, timeout_s=self.timeout_s)
+
+    def open(self, test, node):
+        c = type(self)(port=self.port, database=self.database,
+                       user=self.user, password=self.password,
+                       isolation=self.isolation,
+                       endpoint_mode=self.endpoint_mode,
+                       txn_style=self.txn_style, ts_expr=self.ts_expr,
+                       timeout_s=self.timeout_s, node=node)
+        c._connect(test)
+        return c
+
+    def setup(self, test):
+        ddl = [
+            "CREATE TABLE IF NOT EXISTS registers "
+            "(k INT PRIMARY KEY, v BIGINT)",
+            "CREATE TABLE IF NOT EXISTS sets (elem BIGINT PRIMARY KEY)",
+            "CREATE TABLE IF NOT EXISTS lists "
+            "(k INT PRIMARY KEY, elems INT[] NOT NULL DEFAULT '{}')",
+            "CREATE TABLE IF NOT EXISTS accounts "
+            "(id INT PRIMARY KEY, balance BIGINT NOT NULL)",
+            "CREATE TABLE IF NOT EXISTS dirty "
+            "(id INT PRIMARY KEY, x BIGINT NOT NULL)",
+            "CREATE TABLE IF NOT EXISTS mono "
+            "(val BIGINT, sts TEXT, node TEXT, process INT)",
+            "CREATE TABLE IF NOT EXISTS adya "
+            "(pair INT, cell TEXT, uid BIGINT, PRIMARY KEY (pair, cell))",
+        ]
+        ddl += [f"CREATE TABLE IF NOT EXISTS seq_{i} "
+                f"(k TEXT PRIMARY KEY)" for i in range(SEQ_TABLE_COUNT)]
+        for stmt in ddl:
+            self.conn.query(stmt)
+        for a in test.get("accounts", []):
+            self.conn.query(
+                f"INSERT INTO accounts (id, balance) VALUES ({int(a)}, 10) "
+                f"ON CONFLICT DO NOTHING")
+        for i in range(int(test.get("dirty-rows", 0) or 0)):
+            self.conn.query(
+                f"INSERT INTO dirty (id, x) VALUES ({int(i)}, -1) "
+                f"ON CONFLICT DO NOTHING")
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- transactions -----------------------------------------------------
+
+    def _begin(self):
+        level = self.isolation.upper().replace("-", " ")
+        self.conn.query(f"BEGIN ISOLATION LEVEL {level}")
+
+    def _rollback(self):
+        try:
+            self.conn.query("ROLLBACK")
+        except (PgError, OSError):
+            self._broken = True
+
+    def _select_int(self, sql: str):
+        rows, _ = self.conn.query(sql)
+        if not rows or rows[0][0] is None:
+            return None
+        return int(rows[0][0])
+
+    def _sql_error(self, op, e: PgError):
+        if e.sqlstate in (SERIALIZATION_FAILURE, DEADLOCK_DETECTED):
+            return {**op, "type": "fail",
+                    "error": ["serialization-failure", e.msg]}
+        kind = "fail" if op.get("f") in ("read", "read-all") else "info"
+        return {**op, "type": kind, "error": ["sql", e.sqlstate, e.msg]}
+
+    # -- op dispatch ------------------------------------------------------
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if self._broken:
+            self.close(test)
+            self._connect(test)
+            self._broken = False
+        try:
+            if f == "txn":
+                return self._txn(op)
+            if f == "add":
+                self.conn.query(
+                    f"INSERT INTO sets (elem) VALUES ({int(v)}) "
+                    f"ON CONFLICT DO NOTHING")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                return self._whole_read(test, op)
+            if f == "read" and isinstance(v, (list, tuple)):
+                k, _ = v
+                val = self._select_int(
+                    f"SELECT v FROM registers WHERE k = {int(k)}")
+                return {**op, "type": "ok", "value": [k, val]}
+            if f == "read":
+                return self._seq_read(test, op)
+            if f == "write" and isinstance(v, (list, tuple)):
+                k, val = v
+                self.conn.query(
+                    f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
+                    f"{int(val)}) ON CONFLICT (k) DO UPDATE "
+                    f"SET v = {int(val)}")
+                return {**op, "type": "ok"}
+            if f == "write" and test.get("key-count"):
+                return self._seq_write(test, op)
+            if f == "write":
+                return self._dirty_write(test, op)
+            if f == "cas":
+                k, (old, new) = v
+                _, tag = self.conn.query(
+                    f"UPDATE registers SET v = {int(new)} "
+                    f"WHERE k = {int(k)} AND v = {int(old)}")
+                ok = self.conn.rowcount(tag) == 1
+                return {**op, "type": "ok" if ok else "fail"}
+            if f == "transfer":
+                return self._transfer(op)
+            if f == "insert":
+                return self._adya_insert(op)
+            if f == "inc":
+                return self._mono_inc(test, op)
+            if f == "read-all":
+                # ts stays a string: cockroach HLCs overflow float
+                # precision; the checker compares them as Decimals
+                rows, _ = self.conn.query(
+                    "SELECT val, sts FROM mono ORDER BY sts::numeric")
+                return {**op, "type": "ok",
+                        "value": [[int(r[0]), r[1]] for r in rows]}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except PgError as e:
+            return self._sql_error(op, e)
+        except (TimeoutError, ConnectionError, OSError) as e:
+            self._broken = True
+            kind = "fail" if f in ("read", "read-all") else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    # -- workload bodies --------------------------------------------------
+
+    def _whole_read(self, test, op):
+        if test.get("accounts"):
+            rows, _ = self.conn.query(
+                "SELECT id, balance FROM accounts ORDER BY id")
+            return {**op, "type": "ok",
+                    "value": {int(r[0]): int(r[1]) for r in rows}}
+        if test.get("dirty-rows"):
+            rows, _ = self.conn.query("SELECT x FROM dirty ORDER BY id")
+            return {**op, "type": "ok",
+                    "value": [int(r[0]) for r in rows]}
+        rows, _ = self.conn.query("SELECT elem FROM sets ORDER BY elem")
+        return {**op, "type": "ok", "value": [int(r[0]) for r in rows]}
+
+    def _txn(self, op):
+        self._begin()
+        out = []
+        try:
+            for f, k, v in op.get("value") or []:
+                if f == "r" and self.txn_style == "wr":
+                    val = self._select_int(
+                        f"SELECT v FROM registers WHERE k = {int(k)}")
+                    out.append(["r", k, val])
+                elif f == "r":
+                    rows, _ = self.conn.query(
+                        f"SELECT elems FROM lists WHERE k = {int(k)}")
+                    out.append(["r", k,
+                                parse_int_array(rows[0][0]) if rows else []])
+                elif f == "append":
+                    self.conn.query(
+                        f"INSERT INTO lists (k, elems) VALUES ({int(k)}, "
+                        f"ARRAY[{int(v)}]) ON CONFLICT (k) DO UPDATE "
+                        f"SET elems = lists.elems || {int(v)}")
+                    out.append(["append", k, v])
+                elif f == "w":
+                    self.conn.query(
+                        f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
+                        f"{int(v)}) ON CONFLICT (k) DO UPDATE "
+                        f"SET v = {int(v)}")
+                    out.append(["w", k, v])
+                else:
+                    raise ValueError(f"unknown micro-op {f!r}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok", "value": out}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _transfer(self, op):
+        t = op.get("value") or {}
+        frm, to = int(t.get("from")), int(t.get("to"))
+        amount = int(t.get("amount", 0))
+        self._begin()
+        try:
+            b1 = self._select_int(
+                f"SELECT balance FROM accounts WHERE id = {frm}")
+            b2 = self._select_int(
+                f"SELECT balance FROM accounts WHERE id = {to}")
+            if b1 is None or b2 is None:
+                self._rollback()
+                return {**op, "type": "fail", "error": ["no-such-account"]}
+            if b1 - amount < 0:
+                self._rollback()
+                return {**op, "type": "fail",
+                        "error": ["negative", frm, b1 - amount]}
+            self.conn.query(f"UPDATE accounts SET balance = {b1 - amount} "
+                            f"WHERE id = {frm}")
+            self.conn.query(f"UPDATE accounts SET balance = {b2 + amount} "
+                            f"WHERE id = {to}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _dirty_write(self, test, op):
+        x = int(op.get("value"))
+        n = int(test.get("dirty-rows", 4) or 4)
+        self._begin()
+        try:
+            for i in range(n):
+                self.conn.query(f"SELECT x FROM dirty WHERE id = {i}")
+            for i in range(n):
+                self.conn.query(f"UPDATE dirty SET x = {x} WHERE id = {i}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _adya_insert(self, op):
+        """Adya G2 txn (tests/adya.clj:12-59 via workloads/adya.py):
+        predicate-read both cells of the pair; insert our uid only if
+        both are empty. Serializability must abort one of two racing
+        inserts — two ok inserts per pair demonstrate G2."""
+        pair, uid, cell = op.get("value")
+        self._begin()
+        try:
+            rows, _ = self.conn.query(
+                f"SELECT uid FROM adya WHERE pair = {int(pair)}")
+            if rows:
+                self._rollback()
+                return {**op, "type": "fail", "error": ["pair-occupied"]}
+            self.conn.query(
+                f"INSERT INTO adya (pair, cell, uid) VALUES "
+                f"({int(pair)}, '{cell}', {int(uid)})")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _mono_inc(self, test, op):
+        """Read max, insert max+1 with the DB's timestamp expression in
+        one serializable txn (monotonic.clj:32-66)."""
+        self._begin()
+        try:
+            m = self._select_int("SELECT MAX(val) FROM mono")
+            val = (m if m is not None else -1) + 1
+            self.conn.query(
+                f"INSERT INTO mono (val, sts, node, process) VALUES "
+                f"({val}, ({self.ts_expr})::text, "
+                f"'{self.node}', {int(op.get('process') or 0)})")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok", "value": val}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _seq_write(self, test, op):
+        """Insert each subkey in client order, one txn each
+        (sequential.clj:76-82)."""
+        from jepsen_tpu.workloads.sequential import subkeys
+        for sk in subkeys(int(test.get("key-count", 5)), op.get("value")):
+            self.conn.query(
+                f"INSERT INTO {seq_table(sk)} (k) VALUES ('{sk}') "
+                f"ON CONFLICT DO NOTHING")
+        return {**op, "type": "ok"}
+
+    def _seq_read(self, test, op):
+        """Read subkeys reversed (sequential.clj:84-95)."""
+        from jepsen_tpu.workloads.sequential import subkeys
+        ks = subkeys(int(test.get("key-count", 5)), op.get("value"))
+        out = []
+        for sk in reversed(ks):
+            rows, _ = self.conn.query(
+                f"SELECT k FROM {seq_table(sk)} WHERE k = '{sk}'")
+            out.append(rows[0][0] if rows else None)
+        return {**op, "type": "ok", "value": [op.get("value"), out]}

@@ -1,0 +1,150 @@
+"""CockroachDB test suite (reference: cockroachdb/src/jepsen/cockroach/
+— the richest SQL suite in the reference: register, bank, sets,
+monotonic (HLC-timestamp ordering), sequential, and G2 anti-dependency
+workloads against a geo-replicated serializable SQL store).
+
+Workloads ride the shared Postgres-wire client (``_pg_client.py``) on
+port 26257 with ``root``/insecure auth (cockroach/auto.clj:29-54); the
+monotonic workload's timestamp expression is cockroach's own
+``cluster_logical_timestamp()`` HLC (cockroach/monotonic.clj:32-66),
+which the checker compares as exact Decimals. ``adya`` maps the
+reference's g2 predicate-anti-dependency test (cockroach/adya-ish
+comments.clj/g2) onto the shared adya workload kit.
+
+DB automation per cockroach/auto.clj: one release tarball, then
+``cockroach start --insecure --store=... --join=n1,n2,...`` on every
+node, a ``cockroach init`` through node 1, and the jepsen database.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._pg_client import PGSuiteClient
+
+logger = logging.getLogger("jepsen.cockroachdb")
+
+DEFAULT_VERSION = "v23.1.14"
+DIR = "/opt/cockroach"
+BINARY = f"{DIR}/cockroach"
+STORE = f"{DIR}/cockroach-data"
+LOG_DIR = f"{DIR}/logs"
+PIDFILE = f"{DIR}/cockroach.pid"
+SQL_PORT = 26257
+HTTP_PORT = 8080
+DB_NAME = "jepsen"
+
+
+def tarball_url(version: str) -> str:
+    return (f"https://binaries.cockroachdb.com/cockroach-"
+            f"{version}.linux-amd64.tgz")
+
+
+def join_spec(test: dict) -> str:
+    return ",".join(f"{n}:{SQL_PORT}" for n in (test.get("nodes") or []))
+
+
+class CockroachDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
+                  db_mod.LogFiles):
+    """Cockroach lifecycle (cockroach/auto.clj): tarball install, start
+    with --join on every node, one-shot ``init`` via node 1."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from jepsen_tpu import core
+        if not cu.file_exists(BINARY):
+            logger.info("%s: installing cockroach %s", node, self.version)
+            cu.install_archive(tarball_url(self.version), DIR)
+            control.exec_(control.lit(
+                f"find {DIR} -name cockroach -type f "
+                f"| head -1 | xargs -I{{}} cp {{}} {BINARY} "
+                f"&& chmod +x {BINARY}"))
+        cu.mkdir(LOG_DIR)
+        self.start(test, node)
+        core.synchronize(test, timeout_s=600.0)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            control.exec_(control.lit(
+                f"{BINARY} init --insecure --host={node}:{SQL_PORT} "
+                f"2>/dev/null || true"))  # idempotent re-init says done
+            cu.await_tcp_port(SQL_PORT, host=node, timeout_s=120.0)
+            control.exec_(BINARY, "sql", "--insecure",
+                          f"--host={node}:{SQL_PORT}", "-e",
+                          f"CREATE DATABASE IF NOT EXISTS {DB_NAME}")
+        core.synchronize(test, timeout_s=600.0)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(STORE)
+        cu.rm_rf(LOG_DIR)
+
+    def start(self, test, node):
+        return cu.start_daemon(
+            {"logfile": f"{LOG_DIR}/cockroach.stdout", "pidfile": PIDFILE,
+             "chdir": DIR},
+            BINARY, "start", "--insecure",
+            f"--store={STORE}",
+            f"--listen-addr=0.0.0.0:{SQL_PORT}",
+            f"--advertise-addr={node}:{SQL_PORT}",
+            f"--http-addr=0.0.0.0:{HTTP_PORT}",
+            f"--join={join_spec(test)}",
+            f"--log-dir={LOG_DIR}")
+
+    def kill(self, test, node):
+        cu.stop_daemon("cockroach", PIDFILE)
+        cu.grepkill("cockroach")
+
+    def pause(self, test, node):
+        cu.grepkill("cockroach", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("cockroach", sig="CONT")
+
+    def primaries(self, test):
+        # cockroach is multi-primary; every node serves SQL
+        return list(test.get("nodes") or [])
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node):
+        return [f"{LOG_DIR}/cockroach.stdout"]
+
+
+SUPPORTED_WORKLOADS = ("register", "bank", "set", "append", "monotonic",
+                       "sequential", "adya", "long-fork", "wr")
+
+
+def cockroachdb_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    return build_suite_test(
+        o, db_name="cockroachdb", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": CockroachDB(o.get("version", DEFAULT_VERSION)),
+            "client": PGSuiteClient(
+                port=SQL_PORT, database=DB_NAME, user="root", password="",
+                isolation="serializable",
+                ts_expr="cluster_logical_timestamp()",
+                txn_style="wr" if workload in ("wr", "long-fork")
+                else "append"),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(cockroachdb_test, extra_keys=("version",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: p.add_argument(
+                        "--version", default=DEFAULT_VERSION)),
+    name="jepsen-cockroachdb")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

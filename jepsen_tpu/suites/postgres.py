@@ -22,14 +22,11 @@ from __future__ import annotations
 import logging
 
 from jepsen_tpu import cli, control, db as db_mod
-from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
                                standard_test_fn)
-from jepsen_tpu.suites._postgres import (PGConnection, PgError,
-                                         SERIALIZATION_FAILURE,
-                                         DEADLOCK_DETECTED, parse_int_array)
+from jepsen_tpu.suites._pg_client import PGSuiteClient
 
 logger = logging.getLogger("jepsen.postgres")
 
@@ -90,137 +87,34 @@ class PostgresDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
         return [LOG]
 
 
-SCHEMA = """
-CREATE TABLE IF NOT EXISTS registers (k int PRIMARY KEY, v int);
-CREATE TABLE IF NOT EXISTS sets (elem int PRIMARY KEY);
-CREATE TABLE IF NOT EXISTS lists (k int PRIMARY KEY, elems int[] NOT NULL DEFAULT '{}');
-"""
+class PostgresClient(PGSuiteClient):
+    """The postgres-rds single-endpoint shape of the shared PG suite
+    client (``_pg_client.py``): every node runs an independent
+    unreplicated server, so all clients share the first node's instance
+    — otherwise reads on n2 could never see writes on n1 and checkers
+    would flag a healthy deployment.
 
-
-class PostgresClient(Client):
-    """SQL client for register/set/append workloads over the bundled
-    wire-protocol connection (suites/_postgres.py)."""
+    Class attributes stay overridable (the wire tests subclass with
+    their own endpoint/credentials)."""
 
     PORT = PORT
     DB_NAME, DB_USER, DB_PASS = DB_NAME, DB_USER, DB_PASS
 
     def __init__(self, isolation: str = "serializable",
                  timeout_s: float = 5.0, node: str | None = None):
-        self.isolation = isolation
-        self.timeout_s = timeout_s
-        self.node = node
-        self.conn: PGConnection | None = None
-        self._broken = False
-
-    def endpoint(self, test, node) -> tuple[str, int]:
-        # every node runs an independent unreplicated server, so all
-        # clients share the first node's instance — otherwise reads on n2
-        # could never see writes on n1 and checkers would flag a healthy
-        # deployment (the postgres-rds single-endpoint shape)
-        return (test.get("nodes") or [node])[0], self.PORT
+        super().__init__(
+            port=self.PORT, database=self.DB_NAME, user=self.DB_USER,
+            password=self.DB_PASS, isolation=isolation,
+            endpoint_mode="first", timeout_s=timeout_s, node=node)
 
     def open(self, test, node):
         c = type(self)(self.isolation, self.timeout_s, node)
-        host, port = c.endpoint(test, node)
-        c.conn = PGConnection(
-            host=host, port=port, database=self.DB_NAME, user=self.DB_USER,
-            password=self.DB_PASS, timeout_s=self.timeout_s)
+        c._connect(test)
         return c
 
-    def setup(self, test):
-        self.conn.query(SCHEMA)
 
-    def _txn_body(self, micro_ops):
-        out = []
-        for f, k, v in micro_ops:
-            if f == "r":
-                rows, _ = self.conn.query(
-                    f"SELECT elems FROM lists WHERE k = {int(k)}")
-                out.append(["r", k,
-                            parse_int_array(rows[0][0]) if rows else []])
-            elif f == "append":
-                self.conn.query(
-                    f"INSERT INTO lists (k, elems) VALUES ({int(k)}, "
-                    f"ARRAY[{int(v)}]) ON CONFLICT (k) DO UPDATE "
-                    f"SET elems = lists.elems || {int(v)}")
-                out.append(["append", k, v])
-        return out
-
-    def invoke(self, test, op):
-        f, v = op.get("f"), op.get("value")
-        if self._broken:
-            # a timed-out/failed socket is desynced (leftover response
-            # bytes would be parsed as the next query's result); the
-            # interpreter only reopens clients on "info" completions, so
-            # reconnect here before touching the wire again
-            self.close(test)
-            host, port = self.endpoint(test, self.node)
-            self.conn = PGConnection(
-                host=host, port=port, database=self.DB_NAME,
-                user=self.DB_USER, password=self.DB_PASS,
-                timeout_s=self.timeout_s)
-            self._broken = False
-        try:
-            if f == "txn":
-                level = self.isolation.upper().replace("-", " ")
-                self.conn.query(f"BEGIN ISOLATION LEVEL {level}")
-                try:
-                    out = self._txn_body(v)
-                    self.conn.query("COMMIT")
-                    return {**op, "type": "ok", "value": out}
-                except PgError as e:
-                    try:
-                        self.conn.query("ROLLBACK")
-                    except (PgError, OSError):
-                        pass
-                    if e.sqlstate in (SERIALIZATION_FAILURE,
-                                      DEADLOCK_DETECTED):
-                        return {**op, "type": "fail",
-                                "error": ["serialization-failure", e.msg]}
-                    raise
-            if f == "add":
-                self.conn.query(f"INSERT INTO sets (elem) VALUES ({int(v)}) "
-                                "ON CONFLICT DO NOTHING")
-                return {**op, "type": "ok"}
-            if f == "read" and v is None:
-                rows, _ = self.conn.query("SELECT elem FROM sets ORDER BY elem")
-                return {**op, "type": "ok",
-                        "value": [int(r[0]) for r in rows]}
-            if f == "read":
-                k, _ = v
-                rows, _ = self.conn.query(
-                    f"SELECT v FROM registers WHERE k = {int(k)}")
-                val = int(rows[0][0]) if rows and rows[0][0] is not None \
-                    else None
-                return {**op, "type": "ok", "value": [k, val]}
-            if f == "write":
-                k, val = v
-                self.conn.query(
-                    f"INSERT INTO registers (k, v) VALUES ({int(k)}, "
-                    f"{int(val)}) ON CONFLICT (k) DO UPDATE SET v = {int(val)}")
-                return {**op, "type": "ok"}
-            if f == "cas":
-                k, (old, new) = v
-                _, tag = self.conn.query(
-                    f"UPDATE registers SET v = {int(new)} "
-                    f"WHERE k = {int(k)} AND v = {int(old)}")
-                ok = self.conn.rowcount(tag) == 1
-                return {**op, "type": "ok" if ok else "fail"}
-            return {**op, "type": "fail", "error": ["unknown-f", f]}
-        except OSError as e:
-            self._broken = True
-            kind = "fail" if f == "read" else "info"
-            return {**op, "type": kind, "error": ["net", str(e)]}
-
-    def close(self, test):
-        if self.conn is not None:
-            try:
-                self.conn.close()
-            except Exception:  # noqa: BLE001
-                pass
-
-
-SUPPORTED_WORKLOADS = ("append", "register", "set")
+SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "dirty-reads",
+                       "monotonic", "sequential")
 
 
 def postgres_test(opts_dict: dict | None = None) -> dict:

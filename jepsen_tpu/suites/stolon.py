@@ -1,0 +1,211 @@
+"""Stolon test suite (reference: stolon/src/jepsen/stolon/ — a
+PostgreSQL HA manager: keepers wrap postgres instances, sentinels
+elect a primary through an etcdv3 store, and proxies route clients to
+the elected primary; the classic anomalies are lost updates across
+failovers).
+
+Workloads ride the shared Postgres-wire client against the local
+node's stolon proxy (the reference clients also bind to their node,
+stolon/client.clj). DB automation per stolon/db.clj: an etcd store
+(reusing the etcd suite's automation), the stolon release tarball,
+then keeper (``--uid pgN --pg-port 5433``), sentinel (with an
+initial-cluster-spec json), and proxy daemons per node.
+"""
+from __future__ import annotations
+
+import json
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._pg_client import PGSuiteClient
+from jepsen_tpu.suites.etcd import EtcdDB
+
+logger = logging.getLogger("jepsen.stolon")
+
+DEFAULT_VERSION = "0.17.0"
+DIR = "/opt/stolon"
+DATA_DIR = f"{DIR}/data"
+CLUSTER_NAME = "jepsen"
+PG_PORT = 5433       # keepers' postgres (stolon/db.clj:160)
+PROXY_PORT = 25432   # stolon-proxy default listen port
+ETCD_CLIENT_PORT = 2379
+DB_NAME = "jepsen"
+DB_USER = "postgres"
+DB_PASS = "pw"
+
+
+def tarball_url(version: str) -> str:
+    return (f"https://github.com/sorintlab/stolon/releases/download/"
+            f"v{version}/stolon-v{version}-linux-amd64.tar.gz")
+
+
+def store_endpoints(test: dict) -> str:
+    """The etcd store address list (stolon/db.clj:72-76)."""
+    return ",".join(f"http://{n}:{ETCD_CLIENT_PORT}"
+                    for n in (test.get("nodes") or []))
+
+
+def pg_id(test: dict, node: str) -> str:
+    """pg1..pgn (stolon/db.clj:129-133)."""
+    return f"pg{(test.get('nodes') or [node]).index(node) + 1}"
+
+
+def initial_cluster_spec(test: dict) -> dict:
+    """Synchronous-replication cluster spec (stolon/db.clj:92-108)."""
+    n = len(test.get("nodes") or [])
+    return {
+        "initMode": "new",
+        "sleepInterval": "1s",
+        "requestTimeout": "2s",
+        "failInterval": "5s",
+        "synchronousReplication": True,
+        "proxyCheckInterval": "1s",
+        "proxyTimeout": "3s",
+        "maxStandbysPerSender": max(n - 1, 1),
+        "minSynchronousStandbys": 1,
+        "maxSynchronousStandbys": 1,
+        "pgHBA": ["host all all 0.0.0.0/0 md5"],
+    }
+
+
+class StolonDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Stolon lifecycle: etcd store first, then sentinel (carrying the
+    initial cluster spec), keeper, and proxy (stolon/db.clj:110-196)."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+        self.etcd = EtcdDB()
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        self.etcd.setup(test, node)
+        os_setup.install(["postgresql"])
+        control.exec_(control.lit(
+            "service postgresql stop >/dev/null 2>&1 || true"))
+        if not cu.file_exists(f"{DIR}/bin/stolon-keeper"):
+            logger.info("%s: installing stolon %s", node, self.version)
+            cu.install_archive(tarball_url(self.version), DIR)
+            control.exec_(control.lit(
+                f"d=$(find {DIR} -name stolon-keeper | head -1); "
+                f"test -n \"$d\" && mkdir -p {DIR}/bin && "
+                f"cp $(dirname $d)/stolon-* {DIR}/bin/ || true"))
+        cu.mkdir(DATA_DIR)
+        self.start_sentinel(test, node)
+        self.start_keeper(test, node)
+        core.synchronize(test, timeout_s=600.0)
+        self.start_proxy(test, node)
+        cu.await_tcp_port(PROXY_PORT, host=node, timeout_s=300.0)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            # the keepers init a bare postgres; create the jepsen
+            # database through the proxy once it routes to the primary
+            control.exec_(control.lit(
+                f"PGPASSWORD={DB_PASS} psql -h {node} -p {PROXY_PORT} "
+                f"-U {DB_USER} -d postgres -c 'CREATE DATABASE {DB_NAME}' "
+                f"2>/dev/null || true"))
+        core.synchronize(test, timeout_s=600.0)
+
+    def start_sentinel(self, test, node):
+        spec = f"{DIR}/init-spec.json"
+        cu.write_file(json.dumps(initial_cluster_spec(test)), spec)
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/sentinel.log",
+             "pidfile": f"{DIR}/sentinel.pid", "chdir": DIR},
+            f"{DIR}/bin/stolon-sentinel",
+            "--cluster-name", CLUSTER_NAME,
+            "--store-backend", "etcdv3",
+            "--store-endpoints", store_endpoints(test),
+            "--initial-cluster-spec", spec)
+
+    def start_keeper(self, test, node):
+        uid = pg_id(test, node)
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/keeper.log",
+             "pidfile": f"{DIR}/keeper.pid", "chdir": DIR},
+            f"{DIR}/bin/stolon-keeper",
+            "--cluster-name", CLUSTER_NAME,
+            "--store-backend", "etcdv3",
+            "--store-endpoints", store_endpoints(test),
+            "--uid", uid,
+            "--data-dir", f"{DATA_DIR}/{uid}",
+            "--pg-su-password", DB_PASS,
+            "--pg-repl-username", "repluser",
+            "--pg-repl-password", DB_PASS,
+            "--pg-listen-address", node,
+            "--pg-port", str(PG_PORT),
+            "--pg-bin-path", "/usr/lib/postgresql/*/bin")
+
+    def start_proxy(self, test, node):
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/proxy.log",
+             "pidfile": f"{DIR}/proxy.pid", "chdir": DIR},
+            f"{DIR}/bin/stolon-proxy",
+            "--cluster-name", CLUSTER_NAME,
+            "--store-backend", "etcdv3",
+            "--store-endpoints", store_endpoints(test),
+            "--listen-address", "0.0.0.0",
+            "--port", str(PROXY_PORT))
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)
+        self.etcd.teardown(test, node)
+
+    def start(self, test, node):
+        self.start_sentinel(test, node)
+        self.start_keeper(test, node)
+        self.start_proxy(test, node)
+
+    def kill(self, test, node):
+        for name in ("stolon-proxy", "stolon-sentinel", "stolon-keeper",
+                     "postgres"):
+            cu.grepkill(name)
+
+    def pause(self, test, node):
+        for name in ("stolon-keeper", "postgres"):
+            cu.grepkill(name, sig="STOP")
+
+    def resume(self, test, node):
+        for name in ("stolon-keeper", "postgres"):
+            cu.grepkill(name, sig="CONT")
+
+    def log_files(self, test, node):
+        return [f"{DIR}/sentinel.log", f"{DIR}/keeper.log",
+                f"{DIR}/proxy.log"]
+
+
+SUPPORTED_WORKLOADS = ("append", "register", "set", "bank")
+
+
+def stolon_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="stolon", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": StolonDB(o.get("version", DEFAULT_VERSION)),
+            "client": PGSuiteClient(
+                port=PROXY_PORT, database=DB_NAME, user=DB_USER,
+                password=DB_PASS,
+                isolation=o.get("isolation", "serializable")),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(stolon_test, extra_keys=("isolation", "version")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: (
+                        p.add_argument("--isolation", default="serializable",
+                                       choices=["read-committed",
+                                                "repeatable-read",
+                                                "serializable"]),
+                        p.add_argument("--version",
+                                       default=DEFAULT_VERSION))),
+    name="jepsen-stolon")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

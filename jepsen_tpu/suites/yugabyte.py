@@ -1,0 +1,214 @@
+"""YugabyteDB test suite (reference: yugabyte/src/yugabyte/ — the
+reference's workloads-as-data flagship: a registry of YCQL and YSQL
+workloads with a ``workload-options-expected-to-pass`` sweep for
+test-all, yugabyte/core.clj:74-123).
+
+Here the YSQL side rides the shared Postgres-wire client on port 5433
+(YSQL speaks the postgres protocol): set, bank (negative balances
+allowed, matching ``workload-allow-neg``), long-fork, append, register
+(the single-key-acid shape), and wr. The YCQL side requires a CQL wire
+client, which this framework does not bundle — YCQL workload names are
+listed in ``YCQL_WORKLOADS`` for parity but constructing one raises
+with a pointer here, exactly like the reference gates unsupported
+combinations out of ``workload-options-expected-to-pass``.
+
+DB automation per yugabyte/auto.clj: a release tarball, yb-master on
+the first (up to) three nodes with the full master address list,
+yb-tserver everywhere.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn, workload_registry)
+from jepsen_tpu.suites._pg_client import PGSuiteClient
+
+logger = logging.getLogger("jepsen.yugabyte")
+
+DEFAULT_VERSION = "2.18.5.0"
+DIR = "/opt/yugabyte"
+MASTER_RPC_PORT = 7100
+TSERVER_RPC_PORT = 9100
+YSQL_PORT = 5433
+DB_NAME = "jepsen"
+DB_USER = "yugabyte"
+DB_PASS = "yugabyte"
+MASTER_COUNT = 3
+
+# reference registry shape (yugabyte/core.clj:74-104)
+YSQL_WORKLOADS = ("append", "set", "bank", "long-fork", "register", "wr")
+YCQL_WORKLOADS = ("counter", "set", "set-index", "bank", "long-fork",
+                  "single-key-acid", "multi-key-acid")
+
+
+def tarball_url(version: str) -> str:
+    return (f"https://downloads.yugabyte.com/releases/{version}/"
+            f"yugabyte-{version}-b0-linux-x86_64.tar.gz")
+
+
+def master_nodes(test: dict) -> list[str]:
+    """The first three nodes carry masters (yugabyte/auto.clj:57-67)."""
+    return (test.get("nodes") or [])[:MASTER_COUNT]
+
+
+def master_addresses(test: dict) -> str:
+    """``n1:7100,n2:7100,n3:7100`` (yugabyte/auto.clj:74-79)."""
+    return ",".join(f"{n}:{MASTER_RPC_PORT}" for n in master_nodes(test))
+
+
+def workloads_expected_to_pass() -> dict:
+    """name → workload constructor, the test-all sweep surface
+    (yugabyte/core.clj:110-123 workload-options-expected-to-pass)."""
+    reg = workload_registry()
+    return {name: reg[name] for name in YSQL_WORKLOADS}
+
+
+def ycql_workload(name: str):
+    """YCQL parity stub: the reference's YCQL clients need a CQL wire
+    protocol this framework does not bundle (yugabyte/core.clj:74-85)."""
+    raise NotImplementedError(
+        f"YCQL workload {name!r} needs a CQL wire client; use the ysql "
+        f"variant (suites/yugabyte.py YSQL_WORKLOADS) instead")
+
+
+class YugabyteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
+                 db_mod.LogFiles):
+    """Master/tserver lifecycle (yugabyte/auto.clj): masters on the
+    first three nodes (barrier), tservers everywhere."""
+
+    def __init__(self, version: str = DEFAULT_VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        from jepsen_tpu import core
+        if not cu.file_exists(f"{DIR}/bin/yb-master"):
+            logger.info("%s: installing yugabyte %s", node, self.version)
+            cu.install_archive(tarball_url(self.version), DIR)
+            control.exec_(control.lit(
+                f"{DIR}/bin/post_install.sh >/dev/null 2>&1 || true"))
+        self.start_master(test, node)
+        core.synchronize(test, timeout_s=600.0)
+        self.start_tserver(test, node)
+        cu.await_tcp_port(YSQL_PORT, host=node, timeout_s=300.0)
+        core.synchronize(test, timeout_s=600.0)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            control.exec_(control.lit(
+                f"{DIR}/bin/ysqlsh -h {node} -p {YSQL_PORT} -U {DB_USER} "
+                f"-c 'CREATE DATABASE {DB_NAME}' 2>/dev/null || true"))
+        core.synchronize(test, timeout_s=600.0)
+
+    def start_master(self, test, node):
+        """yb-master with the full master list (yugabyte/auto.clj:84-90)."""
+        if node not in master_nodes(test):
+            return False
+        cu.mkdir(f"{DIR}/master")
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/master/stdout",
+             "pidfile": f"{DIR}/master.pid", "chdir": DIR},
+            f"{DIR}/bin/yb-master",
+            "--master_addresses", master_addresses(test),
+            "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
+            "--fs_data_dirs", f"{DIR}/master",
+            "--replication_factor", str(len(master_nodes(test))))
+
+    def start_tserver(self, test, node):
+        cu.mkdir(f"{DIR}/tserver")
+        return cu.start_daemon(
+            {"logfile": f"{DIR}/tserver/stdout",
+             "pidfile": f"{DIR}/tserver.pid", "chdir": DIR},
+            f"{DIR}/bin/yb-tserver",
+            "--tserver_master_addrs", master_addresses(test),
+            "--rpc_bind_addresses", f"{node}:{TSERVER_RPC_PORT}",
+            "--fs_data_dirs", f"{DIR}/tserver",
+            "--enable_ysql",
+            "--pgsql_proxy_bind_address", f"0.0.0.0:{YSQL_PORT}")
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(f"{DIR}/master")
+        cu.rm_rf(f"{DIR}/tserver")
+
+    def start(self, test, node):
+        self.start_master(test, node)
+        self.start_tserver(test, node)
+
+    def kill(self, test, node):
+        for name in ("yb-tserver", "yb-master"):
+            cu.grepkill(name)
+
+    def pause(self, test, node):
+        for name in ("yb-tserver", "yb-master"):
+            cu.grepkill(name, sig="STOP")
+
+    def resume(self, test, node):
+        for name in ("yb-tserver", "yb-master"):
+            cu.grepkill(name, sig="CONT")
+
+    def primaries(self, test):
+        return master_nodes(test)
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node):
+        return [f"{DIR}/master/stdout", f"{DIR}/tserver/stdout"]
+
+
+SUPPORTED_WORKLOADS = YSQL_WORKLOADS
+
+
+def yugabyte_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    return build_suite_test(
+        o, db_name="yugabyte", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": YugabyteDB(o.get("version", DEFAULT_VERSION)),
+            "client": PGSuiteClient(
+                port=YSQL_PORT, database=DB_NAME, user=DB_USER,
+                password=DB_PASS,
+                isolation=o.get("isolation", "serializable"),
+                txn_style="wr" if workload in ("wr", "long-fork")
+                else "append"),
+            "os": Debian()})
+
+
+def all_tests(opts) -> list:
+    """The test-all sweep over workloads expected to pass
+    (yugabyte/core.clj:110-123, cli.clj:429-515)."""
+    from jepsen_tpu.cli import test_opts_to_test
+    base = test_opts_to_test(opts, {})
+    tests = []
+    for name in workloads_expected_to_pass():
+        o = {"workload": name, "nodes": base["nodes"],
+             "concurrency": base["concurrency"],
+             "time_limit": base["time_limit"], "ssh": base["ssh"],
+             "store_dir": base["store_dir"],
+             "fake": (base["ssh"] or {}).get("dummy", False)}
+        tests.append(yugabyte_test(o))
+    return tests
+
+
+main_all = cli.test_all_cmd(all_tests, name="jepsen-yugabyte")
+
+main = cli.single_test_cmd(
+    standard_test_fn(yugabyte_test, extra_keys=("isolation", "version")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: (
+                        p.add_argument("--isolation", default="serializable",
+                                       choices=["read-committed",
+                                                "repeatable-read",
+                                                "serializable"]),
+                        p.add_argument("--version",
+                                       default=DEFAULT_VERSION))),
+    name="jepsen-yugabyte")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Monotonic-inserts workload (reference:
+cockroachdb/src/jepsen/cockroach/monotonic.clj — each transaction reads
+the current maximum value and inserts max+1 together with the DB's own
+transaction timestamp; a serializable system must yield values whose
+order agrees with timestamp order).
+
+Op shapes:
+- ``{"f": "inc", "value": None}`` — one read-max-insert-max+1 txn; the
+  ok completion's value is the inserted integer.
+- ``{"f": "read-all", "value": None → [[val, ts], ...]}`` — final read
+  of every row with its commit timestamp (``ts`` compares as a string
+  or number, whatever the DB provides).
+
+The checker (monotonic.clj:147-210): order the final read's rows by
+timestamp; the values must be strictly increasing (off-order values =
+serializability violation), with no duplicates.
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def incs():
+    def inc(test, ctx):
+        return {"f": "inc", "value": None}
+
+    return gen.Fn(inc)
+
+
+def final_read():
+    def read(test, ctx):
+        return {"f": "read-all", "value": None}
+
+    return gen.once(gen.Fn(read))
+
+
+def non_monotonic(pairs: list) -> list:
+    """Adjacent [val, ts] pairs (sorted by ts) whose values do not
+    strictly increase (monotonic.clj:147-154)."""
+    bad = []
+    for a, b in zip(pairs, pairs[1:]):
+        if not a[0] < b[0]:
+            bad.append([a, b])
+    return bad
+
+
+class MonotonicChecker(Checker):
+    def name(self):
+        return "monotonic"
+
+    def check(self, test, history, opts):
+        final = None
+        for op in history:
+            if op.get("type") == "ok" and op.get("f") == "read-all":
+                final = op
+        if final is None:
+            return {"valid?": "unknown", "error": "no final read"}
+        from decimal import Decimal, InvalidOperation
+
+        def ts_key(r):
+            # timestamps arrive as strings (HLC decimals overflow float
+            # precision) or numbers; Decimal compares both exactly
+            try:
+                return Decimal(str(r[1]))
+            except InvalidOperation:
+                return Decimal(0)
+
+        rows = [list(r) for r in (final.get("value") or [])]
+        rows.sort(key=ts_key)
+        off_order = non_monotonic(rows)
+        vals = [r[0] for r in rows]
+        from collections import Counter
+        dups = sorted(v for v, n in Counter(vals).items() if n > 1)
+        # every acknowledged insert must be present in the final read
+        acked = {op.get("value") for op in history
+                 if op.get("type") == "ok" and op.get("f") == "inc"}
+        lost = sorted(acked - set(vals))
+        return {
+            "valid?": not off_order and not dups and not lost,
+            "row-count": len(rows),
+            "off-order-pairs": off_order[:10],
+            "off-order-count": len(off_order),
+            "duplicates": dups[:10],
+            "lost": lost[:10],
+            "lost-count": len(lost),
+        }
+
+
+def checker() -> Checker:
+    return MonotonicChecker()
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "generator": incs(),
+        "final_generator": final_read(),
+        "checker": checker(),
+    }

@@ -1,0 +1,102 @@
+"""Sequential-consistency workload (reference:
+cockroachdb/src/jepsen/cockroach/sequential.clj — a writer inserts a
+key's subkeys in client order across distinct transactions; readers
+read them in *reverse* order, so observing a later subkey obliges every
+earlier subkey to be visible: a nil after a non-nil in the reversed
+read is a sequential-consistency violation).
+
+Op shapes:
+- ``{"f": "write", "value": k}`` — insert subkeys ``k_0 .. k_{m-1}``
+  in order, one transaction each.
+- ``{"f": "read", "value": k → [k, [newest .. oldest]]}`` — read the
+  subkeys reversed; each element is the subkey string or None.
+"""
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+DEFAULT_KEY_COUNT = 5
+
+
+def subkeys(key_count: int, k) -> list[str]:
+    """``k_0 .. k_{m-1}`` (sequential.clj:50-52)."""
+    return [f"{k}_{i}" for i in range(key_count)]
+
+
+def generator(writer_count: int = 2, buffer_factor: int = 2):
+    """n reserved writer threads emitting sequential keys; everyone else
+    reads a recently-written key (sequential.clj:105-133)."""
+    lock = threading.Lock()
+    last_written: list = []
+    counter = [0]
+
+    def write(test, ctx):
+        with lock:
+            k = counter[0]
+            counter[0] += 1
+            last_written.append(k)
+            if len(last_written) > buffer_factor * writer_count:
+                last_written.pop(0)
+        return {"f": "write", "value": k}
+
+    def read(test, ctx):
+        with lock:
+            # before any write lands, read key 0 — its subkeys don't
+            # exist yet, so the read is an (all-nil) no-op for the checker
+            k = ctx.rng.choice(last_written) if last_written else 0
+        return {"f": "read", "value": k}
+
+    return gen.reserve(writer_count, gen.Fn(write), gen.Fn(read))
+
+
+def trailing_nil(coll) -> bool:
+    """A nil after a non-nil element (sequential.clj:135-138) — the
+    reversed read saw a later subkey but missed an earlier one."""
+    seen_non_nil = False
+    for x in coll:
+        if x is not None:
+            seen_non_nil = True
+        elif seen_non_nil:
+            return True
+    return False
+
+
+class SequentialChecker(Checker):
+    def name(self):
+        return "sequential"
+
+    def check(self, test, history, opts):
+        bad_reads = []
+        read_count = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            read_count += 1
+            v = op.get("value")
+            if not isinstance(v, (list, tuple)) or len(v) != 2:
+                continue
+            _k, elements = v
+            if trailing_nil(elements or []):
+                bad_reads.append(op)
+        return {
+            "valid?": not bad_reads,
+            "read-count": read_count,
+            "bad-read-count": len(bad_reads),
+            "bad-reads": bad_reads[:10],
+        }
+
+
+def checker() -> Checker:
+    return SequentialChecker()
+
+
+def workload(test: dict | None = None,
+             key_count: int = DEFAULT_KEY_COUNT, **_) -> dict:
+    return {
+        "key-count": key_count,
+        "generator": generator(),
+        "checker": checker(),
+    }

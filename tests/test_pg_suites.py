@@ -1,0 +1,258 @@
+"""Postgres-protocol suite family tests: cockroachdb, stolon, yugabyte
+plus the widened postgres suite — test-map shapes, DB-automation
+command shapes over the dummy remote, fake-mode runs for the new
+monotonic/sequential workloads, and the shared PG client's workload
+bodies against a stub connection."""
+from jepsen_tpu import control
+from jepsen_tpu.suites import cockroachdb, postgres, stolon, yugabyte
+from jepsen_tpu.suites._pg_client import PGSuiteClient, seq_table
+from jepsen_tpu.workloads import monotonic, sequential
+
+from conftest import run_fake  # noqa: E402
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# ---------------------------------------------------------------------------
+# cluster strings / command shapes
+# ---------------------------------------------------------------------------
+
+def test_cockroach_join_spec():
+    assert cockroachdb.join_spec({"nodes": NODES}).startswith("n1:26257,")
+
+
+def test_cockroach_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = cockroachdb.CockroachDB()
+    try:
+        control.on("n2", t, lambda: db.start(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--insecure" in joined
+        assert "--join=n1:26257,n2:26257,n3:26257,n4:26257,n5:26257" in joined
+        assert "--advertise-addr=n2:26257" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+def test_stolon_topology():
+    t = {"nodes": NODES}
+    assert stolon.pg_id(t, "n3") == "pg3"
+    assert stolon.store_endpoints(t).startswith("http://n1:2379,")
+    spec = stolon.initial_cluster_spec(t)
+    assert spec["synchronousReplication"] is True
+    assert spec["maxStandbysPerSender"] == 4
+
+
+def test_stolon_daemon_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = stolon.StolonDB()
+    try:
+        control.on("n2", t, lambda: db.start_keeper(t, "n2"))
+        control.on("n2", t, lambda: db.start_proxy(t, "n2"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--uid pg2" in joined
+        assert "--store-backend etcdv3" in joined
+        assert "--pg-port 5433" in joined
+        assert "stolon-proxy" in joined
+    finally:
+        control.disconnect_all(t)
+
+
+def test_yugabyte_masters():
+    t = {"nodes": NODES}
+    assert yugabyte.master_nodes(t) == ["n1", "n2", "n3"]
+    assert yugabyte.master_addresses(t) == "n1:7100,n2:7100,n3:7100"
+    assert set(yugabyte.workloads_expected_to_pass()) == \
+        set(yugabyte.YSQL_WORKLOADS)
+
+
+def test_yugabyte_ycql_gated():
+    import pytest
+    with pytest.raises(NotImplementedError):
+        yugabyte.ycql_workload("counter")
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycle: monotonic & sequential
+# ---------------------------------------------------------------------------
+
+def test_cockroach_fake_monotonic_run():
+    result = run_fake(cockroachdb.cockroachdb_test, workload="monotonic")
+    assert result["results"]["valid?"] is True, result["results"]
+    finals = [op for op in result["history"]
+              if op.get("f") == "read-all" and op.get("type") == "ok"]
+    assert finals and finals[-1]["value"], "final read must return rows"
+
+
+def test_cockroach_fake_sequential_run():
+    result = run_fake(cockroachdb.cockroachdb_test, workload="sequential")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_stolon_fake_append_run():
+    result = run_fake(stolon.stolon_test, workload="append")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_yugabyte_fake_bank_run():
+    result = run_fake(yugabyte.yugabyte_test, workload="bank")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_postgres_fake_monotonic_run():
+    result = run_fake(postgres.postgres_test, workload="monotonic")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# monotonic checker semantics
+# ---------------------------------------------------------------------------
+
+def _final_read(rows):
+    return [{"type": "ok", "f": "read-all", "value": rows}]
+
+
+def test_monotonic_checker_accepts_increasing():
+    out = monotonic.checker().check(
+        {}, _final_read([[0, "1.0"], [1, "2.0"], [2, "10.0"]]), {})
+    assert out["valid?"] is True
+
+
+def test_monotonic_checker_flags_off_order():
+    # value 2 committed at an earlier timestamp than value 1
+    out = monotonic.checker().check(
+        {}, _final_read([[0, "1.0"], [2, "2.0"], [1, "3.0"]]), {})
+    assert out["valid?"] is False
+    assert out["off-order-count"] >= 1
+
+
+def test_monotonic_checker_numeric_ts_comparison():
+    # "10.0" must sort after "2.0" (Decimal, not lexicographic)
+    out = monotonic.checker().check(
+        {}, _final_read([[0, "2.0"], [1, "10.0"]]), {})
+    assert out["valid?"] is True
+
+
+def test_monotonic_checker_flags_lost_inserts():
+    history = [{"type": "ok", "f": "inc", "value": 5}] + \
+        _final_read([[0, "1.0"]])
+    out = monotonic.checker().check({}, history, {})
+    assert out["valid?"] is False
+    assert out["lost"] == [5]
+
+
+# ---------------------------------------------------------------------------
+# sequential checker semantics
+# ---------------------------------------------------------------------------
+
+def test_sequential_trailing_nil():
+    assert sequential.trailing_nil(["5_4", None]) is True
+    assert not sequential.trailing_nil([None, "5_3"])
+    assert not sequential.trailing_nil([None, None])
+    assert not sequential.trailing_nil(["5_4", "5_3"])
+
+
+def test_sequential_checker():
+    chk = sequential.checker()
+    good = {"type": "ok", "f": "read",
+            "value": [5, [None, None, "5_2", "5_1", "5_0"]]}
+    bad = {"type": "ok", "f": "read",
+           "value": [5, ["5_4", None, "5_2", "5_1", "5_0"]]}
+    assert chk.check({}, [good], {})["valid?"] is True
+    out = chk.check({}, [good, bad], {})
+    assert out["valid?"] is False and out["bad-read-count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the shared PG client against a stub connection
+# ---------------------------------------------------------------------------
+
+class StubConn:
+    """Collects queries; PGConnection.query returns (rows, tag)."""
+
+    def __init__(self, replies=()):
+        self.queries: list[str] = []
+        self.replies = dict(replies)
+
+    def query(self, sql):
+        self.queries.append(sql)
+        for prefix, rows in self.replies.items():
+            if sql.startswith(prefix):
+                return rows, "SELECT"
+        return [], "OK 0"
+
+    def rowcount(self, tag):
+        return 0
+
+    def close(self):
+        pass
+
+
+def test_pg_client_mono_inc_uses_ts_expr():
+    c = PGSuiteClient(ts_expr="cluster_logical_timestamp()")
+    c.conn = StubConn({"SELECT MAX": [["4"]]})
+    out = c.invoke({}, {"f": "inc", "type": "invoke", "value": None,
+                        "process": 3})
+    assert out["type"] == "ok" and out["value"] == 5
+    insert = [q for q in c.conn.queries if q.startswith("INSERT INTO mono")]
+    assert insert and "cluster_logical_timestamp()" in insert[0]
+    assert c.conn.queries[-1] == "COMMIT"
+
+
+def test_pg_client_read_all_keeps_ts_strings():
+    c = PGSuiteClient()
+    big = "1712000000000000000000000000.0000000001"
+    c.conn = StubConn({"SELECT val, sts": [["0", "1.5"], ["1", big]]})
+    out = c.invoke({}, {"f": "read-all", "type": "invoke", "value": None})
+    assert out["value"] == [[0, "1.5"], [1, big]]  # precision preserved
+
+
+def test_pg_client_sequential_ops():
+    c = PGSuiteClient()
+    c.conn = StubConn()
+    out = c.invoke({"key-count": 3},
+                   {"f": "write", "type": "invoke", "value": 7})
+    assert out["type"] == "ok"
+    inserts = [q for q in c.conn.queries if q.startswith("INSERT INTO seq_")]
+    assert len(inserts) == 3
+    assert "'7_0'" in inserts[0] and "'7_2'" in inserts[2]  # client order
+
+    c.conn = StubConn()
+    out = c.invoke({"key-count": 3},
+                   {"f": "read", "type": "invoke", "value": 7})
+    assert out["type"] == "ok"
+    k, elements = out["value"]
+    assert k == 7 and len(elements) == 3
+    selects = [q for q in c.conn.queries if q.startswith("SELECT k FROM")]
+    assert "'7_2'" in selects[0] and "'7_0'" in selects[2]  # reversed
+
+
+def test_seq_table_stable():
+    assert seq_table("5_0") == seq_table("5_0")
+    assert seq_table("5_0").startswith("seq_")
+
+
+def test_cockroach_fake_adya_run():
+    result = run_fake(cockroachdb.cockroachdb_test, workload="adya")
+    assert result["results"]["valid?"] is True, result["results"]
+    # the G2 test is only meaningful if inserts actually executed
+    assert any(op.get("f") == "insert" and op.get("type") == "ok"
+               for op in result["history"])
+
+
+def test_pg_client_adya_insert():
+    c = PGSuiteClient()
+    c.conn = StubConn()  # empty pair → insert proceeds
+    out = c.invoke({}, {"f": "insert", "type": "invoke",
+                        "value": [3, 17, "a"]})
+    assert out["type"] == "ok"
+    assert any(q.startswith("INSERT INTO adya") for q in c.conn.queries)
+    assert c.conn.queries[-1] == "COMMIT"
+
+    c.conn = StubConn({"SELECT uid FROM adya": [["9"]]})  # occupied
+    out = c.invoke({}, {"f": "insert", "type": "invoke",
+                        "value": [3, 18, "b"]})
+    assert out["type"] == "fail"
+    assert any(q == "ROLLBACK" for q in c.conn.queries)
