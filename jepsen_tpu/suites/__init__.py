@@ -186,9 +186,10 @@ def suite_registry() -> dict[str, Callable]:
     reference's L8 layer; each also has a CLI ``main``)."""
     from jepsen_tpu.suites import (chronos, cockroachdb, consul, crate,
                                    dgraph, disque, elasticsearch, etcd,
-                                   galera, hazelcast, ignite, mongodb,
-                                   mysql_cluster, percona, postgres, raftis,
-                                   redis, stolon, tidb, yugabyte, zookeeper)
+                                   faunadb, galera, hazelcast, ignite,
+                                   logcabin, mongodb, mysql_cluster, percona,
+                                   postgres, raftis, redis, robustirc,
+                                   stolon, tidb, yugabyte, zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -211,6 +212,9 @@ def suite_registry() -> dict[str, Callable]:
         "cockroachdb": cockroachdb.cockroachdb_test,
         "stolon": stolon.stolon_test,
         "yugabyte": yugabyte.yugabyte_test,
+        "faunadb": faunadb.faunadb_test,
+        "robustirc": robustirc.robustirc_test,
+        "logcabin": logcabin.logcabin_test,
     }
 
 

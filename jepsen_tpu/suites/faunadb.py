@@ -1,0 +1,277 @@
+"""FaunaDB test suite (reference: faunadb/src/jepsen/faunadb/ — a
+Calvin-style distributed transactional database; the reference probes
+registers, bank transfers, set membership (pages), and monotonicity
+through the JVM driver).
+
+Every FaunaDB query is a single transaction POSTed as a JSON-encoded
+FQL expression to port 8443 with HTTP Basic auth (the cluster secret as
+username) — so each workload op here is one ``http_json`` call carrying
+a composed expression tree: register CAS is ``If(Equals(Select(...),
+old), Update(...), false)`` evaluated atomically server-side
+(faunadb/register.clj's cas shape), bank transfers are a ``Do`` of two
+guarded updates, set adds create one instance per element.
+
+DB automation per faunadb/auto.clj: install the ``faunadb`` apt
+package, write /etc/faunadb.yml with this node's addresses, start the
+service, ``faunadb-admin init`` on the primary and ``join`` elsewhere.
+"""
+from __future__ import annotations
+
+import base64
+import logging
+import urllib.error
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS, http_error_json, http_json
+
+logger = logging.getLogger("jepsen.faunadb")
+
+PORT = 8443
+SECRET = "secret"
+YML = "/etc/faunadb.yml"
+LOG_FILE = "/var/log/faunadb/core.log"
+
+
+def config_yml(test: dict, node: str) -> str:
+    """/etc/faunadb.yml (faunadb/auto.clj:160-196 shape)."""
+    return "\n".join([
+        f"auth_root_key: {SECRET}",
+        "cluster_name: jepsen",
+        f"network_broadcast_address: {node}",
+        "network_datacenter_name: replica-1",
+        f"network_host_id: {node}",
+        "network_listen_address: 0.0.0.0",
+        "storage_data_path: /var/lib/faunadb",
+        "log_path: /var/log/faunadb",
+        "",
+    ])
+
+
+# -- FQL JSON expression builders (the v2 JSON wire forms the JVM driver
+# -- emits; each helper returns a plain dict ready to POST) -----------------
+
+def ref_(cls: str, instance_id) -> dict:
+    return {"ref": {"@ref": f"classes/{cls}/{instance_id}"}}
+
+
+def get_(cls: str, instance_id) -> dict:
+    return {"get": ref_(cls, instance_id)["ref"]}
+
+
+def select_data(field: str, from_expr, default=None) -> dict:
+    return {"select": ["data", field], "from": from_expr,
+            "default": default}
+
+
+def exists_(cls: str, instance_id) -> dict:
+    return {"exists": ref_(cls, instance_id)["ref"]}
+
+
+def create_(cls: str, instance_id, data: dict) -> dict:
+    return {"create": ref_(cls, instance_id)["ref"],
+            "params": {"object": {"data": {"object": data}}}}
+
+
+def update_(cls: str, instance_id, data: dict) -> dict:
+    return {"update": ref_(cls, instance_id)["ref"],
+            "params": {"object": {"data": {"object": data}}}}
+
+
+def if_(cond, then, else_) -> dict:
+    return {"if": cond, "then": then, "else": else_}
+
+
+def do_(*exprs) -> dict:
+    return {"do": list(exprs)}
+
+
+def upsert(cls: str, instance_id, data: dict) -> dict:
+    return if_(exists_(cls, instance_id),
+               update_(cls, instance_id, data),
+               create_(cls, instance_id, data))
+
+
+class FaunaDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """FaunaDB lifecycle (faunadb/auto.clj): package install, yml
+    config, init on the primary, join everywhere else."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing faunadb", node)
+        os_setup.install(["faunadb"])
+        cu.write_file(config_yml(test, node), YML)
+        control.exec_("service", "faunadb", "start")
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            control.exec_(control.lit(
+                "faunadb-admin init -r replica-1 2>/dev/null || true"))
+        core.synchronize(test, timeout_s=600.0)
+        if node != primary:
+            control.exec_(control.lit(
+                f"faunadb-admin join -r replica-1 {primary} "
+                f"2>/dev/null || true"))
+        core.synchronize(test, timeout_s=600.0)
+        cu.await_tcp_port(PORT, host=node, timeout_s=300.0)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf("/var/lib/faunadb/*")
+
+    def start(self, test, node):
+        control.exec_("service", "faunadb", "start")
+
+    def kill(self, test, node):
+        control.exec_(control.lit(
+            "service faunadb stop >/dev/null 2>&1 || true"))
+        cu.grepkill("faunadb")
+
+    def pause(self, test, node):
+        cu.grepkill("faunadb", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("faunadb", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class FaunaClient(Client):
+    """register/set/bank over single-query FQL transactions."""
+
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+
+    def open(self, test, node):
+        return FaunaClient(self.timeout_s, node)
+
+    def _query(self, expr):
+        auth = base64.b64encode(f"{SECRET}:".encode()).decode()
+        out = http_json(f"http://{self.node}:{PORT}/", expr,
+                        timeout_s=self.timeout_s,
+                        headers={"Authorization": f"Basic {auth}"})
+        if isinstance(out, dict) and "errors" in out:
+            raise FaunaError(out["errors"])
+        return out.get("resource") if isinstance(out, dict) else out
+
+    def setup(self, test):
+        for cls in ("registers", "accounts"):
+            try:
+                self._query({"create_class": {"object": {"name": cls}}})
+            except FaunaError:
+                pass  # already exists
+        for a in test.get("accounts", []):
+            try:
+                self._query(create_("accounts", a, {"balance": 10}))
+            except FaunaError:
+                pass
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read" and v is None and test.get("accounts"):
+                # ONE query = one transaction: an object of selects reads
+                # every balance in the same snapshot (per-account queries
+                # would interleave with transfers → false wrong-total)
+                expr = {"object": {
+                    str(a): select_data("balance", get_("accounts", a),
+                                        default=0)
+                    for a in test.get("accounts")}}
+                balances = self._query(expr) or {}
+                return {**op, "type": "ok",
+                        "value": {int(a): int(b or 0)
+                                  for a, b in balances.items()}}
+            if f == "transfer":
+                return self._transfer(op)
+            if f == "read":
+                k, _ = v
+                out = self._query(select_data("v", get_("registers", k)))
+                return {**op, "type": "ok",
+                        "value": [k, int(out) if out is not None else None]}
+            if f == "write":
+                k, val = v
+                self._query(upsert("registers", k, {"v": int(val)}))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                out = self._query(if_(
+                    {"equals": [select_data("v", get_("registers", k)),
+                                int(old)]},
+                    do_(update_("registers", k, {"v": int(new)}), True),
+                    False))
+                return {**op, "type": "ok" if out is True else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except FaunaError as e:
+            # instance not found on a register read → empty register
+            # (bank reads carry value None — not unpackable)
+            if f == "read" and isinstance(v, (list, tuple)) \
+                    and e.not_found():
+                k, _ = v
+                return {**op, "type": "ok", "value": [k, None]}
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["fauna", str(e)]}
+        except urllib.error.HTTPError as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind,
+                    "error": ["http", e.code, http_error_json(e)]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def _transfer(self, op):
+        """One transactional Do: guard both balances, move the amount
+        (faunadb/bank.clj's shape — the whole expression is one txn)."""
+        t = op.get("value") or {}
+        frm, to, amount = t.get("from"), t.get("to"), int(t.get("amount", 0))
+        b_from = select_data("balance", get_("accounts", frm), default=0)
+        b_to = select_data("balance", get_("accounts", to), default=0)
+        out = self._query(if_(
+            {"lt": [{"subtract": [b_from, amount]}, 0]},
+            False,
+            do_(update_("accounts", frm,
+                        {"balance": {"subtract": [b_from, amount]}}),
+                update_("accounts", to,
+                        {"balance": {"add": [b_to, amount]}}),
+                True)))
+        if out is True:
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": ["negative"]}
+
+
+class FaunaError(Exception):
+    """A FaunaDB ``errors`` response body."""
+
+    def __init__(self, errors):
+        super().__init__(str(errors))
+        self.errors = errors
+
+    def not_found(self) -> bool:
+        return any(e.get("code") == "instance not found"
+                   for e in self.errors if isinstance(e, dict))
+
+
+SUPPORTED_WORKLOADS = ("register", "bank")
+
+
+def faunadb_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="faunadb",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": FaunaDB(), "client": FaunaClient(),
+                             "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(faunadb_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-faunadb")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

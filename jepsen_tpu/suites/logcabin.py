@@ -1,0 +1,216 @@
+"""LogCabin test suite (reference: logcabin/src/jepsen/logcabin.clj —
+Diego Ongaro's original Raft implementation, tested as a linearizable
+CAS register through its on-node ``TreeOps`` client binary).
+
+Unlike the wire-protocol suites, the client here is *exec-based*: ops
+run the TreeOps example binary on the db node over the control layer
+(logcabin.clj:163-208), exactly as the reference does — read is
+``TreeOps read /jepsen``, write pipes the value into ``TreeOps write``,
+and CAS uses TreeOps's ``-p path:expected`` predicate flag, whose
+distinctive "has value ... not ... as required" error marks a definite
+CAS failure (logcabin.clj:152-155,189-208).
+
+DB automation per logcabin.clj:24-148: scons-build from source, write
+per-node serverId/listenAddresses config, ``--bootstrap`` the first
+node's log, start daemons, then ``Reconfigure set`` the full membership
+from the primary.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+
+logger = logging.getLogger("jepsen.logcabin")
+
+PORT = 5254
+CONFIG = "/root/logcabin.conf"
+LOG_FILE = "/root/logcabin.log"
+PID_FILE = "/root/logcabin.pid"
+STORE_DIR = "/root/storage"
+LOGCABIN_BIN = "/root/LogCabin"
+RECONFIGURE_BIN = "/root/Reconfigure"
+TREEOPS_BIN = "/root/TreeOps"
+OP_TIMEOUT = 3
+PATH = "/jepsen"
+
+# TreeOps's CAS-mismatch and timeout errors (logcabin.clj:152-158)
+CAS_MSG = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Path '.*' has value "
+    r"'.*', not '.*' as required")
+TIMEOUT_MSG = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Client-specified "
+    r"timeout elapsed")
+
+
+def server_id(node: str) -> str:
+    """n3 → 3 (logcabin.clj:48-50)."""
+    return node.replace("n", "")
+
+
+def server_addrs(test: dict) -> str:
+    return ",".join(f"{n}:{PORT}" for n in (test.get("nodes") or []))
+
+
+class LogCabinDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Build, bootstrap node 1, start, reconfigure full membership
+    (logcabin.clj:24-148)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        os_setup.install(["git", "protobuf-compiler", "libprotobuf-dev",
+                          "libcrypto++-dev", "g++", "scons"])
+        if not cu.file_exists(TREEOPS_BIN):
+            logger.info("%s: building logcabin", node)
+            with control.cd("/"):
+                if not cu.file_exists("/logcabin"):
+                    control.exec_("git", "clone", "--depth", "1",
+                                  "https://github.com/logcabin/logcabin.git")
+            with control.cd("/logcabin"):
+                control.exec_("git", "submodule", "update", "--init")
+                control.exec_("scons")
+            for f in ("LogCabin", "Examples/Reconfigure", "Examples/TreeOps"):
+                control.exec_("cp", "-f", f"/logcabin/build/{f}", "/root")
+        cu.write_file(f"serverId = {server_id(node)}\n"
+                      f"listenAddresses = {node}:{PORT}\n"
+                      f"storagePath = {STORE_DIR}\n", CONFIG)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            # bootstrap writes an initial single-server log
+            control.exec_(LOGCABIN_BIN, "-c", CONFIG, "-l", LOG_FILE,
+                          "--bootstrap")
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host=node, timeout_s=600.0)
+        core.synchronize(test, timeout_s=900.0)  # source build variance
+        if node == primary:
+            self.reconfigure(test, node)
+
+    def reconfigure(self, test, node):
+        """Grow the cluster to full membership (logcabin.clj:102-112)."""
+        control.exec_(RECONFIGURE_BIN, "-c", server_addrs(test), "set",
+                      *[f"{n}:{PORT}" for n in (test.get("nodes") or [])])
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(STORE_DIR)
+        cu.rm_rf(LOG_FILE)
+
+    def start(self, test, node):
+        with control.cd("/root"):
+            control.exec_(LOGCABIN_BIN, "-c", CONFIG, "-d", "-l", LOG_FILE,
+                          "-p", PID_FILE)
+
+    def kill(self, test, node):
+        cu.grepkill("LogCabin")
+        cu.rm_rf(PID_FILE)
+
+    def pause(self, test, node):
+        cu.grepkill("LogCabin", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("LogCabin", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class LogCabinClient(Client):
+    """CAS register via the on-node TreeOps binary
+    (logcabin.clj:163-246). Register values are stored as plain ints at
+    one tree path per key: /jepsen-<k>."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+        self.test: dict | None = None
+
+    def open(self, test, node):
+        c = LogCabinClient(node)
+        c.test = test
+        return c
+
+    def _exec(self, *args, stdin: str | None = None):
+        return control.on(
+            self.node, self.test,
+            lambda: control.exec_star(
+                TREEOPS_BIN, "-c", server_addrs(self.test), "-q",
+                "-t", str(OP_TIMEOUT), *args, stdin=stdin))
+
+    def _path(self, k) -> str:
+        return f"{PATH}-{k}"
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read":
+                k, _ = v
+                r = self._exec("read", self._path(k))
+                if r.exit_status != 0:
+                    return self._error(op, r)
+                raw = (r.out or "").strip()
+                return {**op, "type": "ok",
+                        "value": [k, int(raw) if raw else None]}
+            if f == "write":
+                k, val = v
+                r = self._exec("write", self._path(k), stdin=str(val))
+                if r.exit_status != 0:
+                    return self._error(op, r)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                r = self._exec("-p", f"{self._path(k)}:{old}",
+                               "write", self._path(k), stdin=str(new))
+                if r.exit_status != 0:
+                    msg = (r.err or r.out or "").strip()
+                    if CAS_MSG.match(msg):
+                        return {**op, "type": "fail"}  # precondition miss
+                    return self._error(op, r)
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except Exception as e:  # control-layer/SSH failure
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["exec", str(e)]}
+
+    def _error(self, op, r):
+        """TreeOps nonzero exit → typed completion. A read that never
+        found the path is an empty register; timeouts are definite
+        fails per the reference (logcabin.clj:238-241)."""
+        msg = (r.err or r.out or "").strip()
+        if op.get("f") == "read" and "does not exist" in msg:
+            k, _ = op.get("value")
+            return {**op, "type": "ok", "value": [k, None]}
+        # deviation from logcabin.clj:238-241 (which fails ALL timed-out
+        # ops): a timed-out write/cas may still have applied, so claiming
+        # a definite fail could manufacture linearizability violations —
+        # only reads are safe to fail on timeout
+        kind = "fail" if op.get("f") == "read" else "info"
+        if TIMEOUT_MSG.match(msg):
+            return {**op, "type": kind, "error": ["timed-out"]}
+        return {**op, "type": kind, "error": ["treeops", msg[:200]]}
+
+
+SUPPORTED_WORKLOADS = ("register",)
+
+
+def logcabin_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="logcabin",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": LogCabinDB(),
+                             "client": LogCabinClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(logcabin_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-logcabin")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

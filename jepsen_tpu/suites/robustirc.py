@@ -1,0 +1,262 @@
+"""RobustIRC test suite (reference: robustirc/src/jepsen/robustirc.clj
+— a raft-replicated IRC network whose HTTP "robustsession" API lets a
+set test ride IRC TOPIC messages: adds set the channel topic to an
+integer, the final read replays the message log and collects every
+TOPIC value, checked with set semantics).
+
+The client speaks the robustsession JSON API over HTTPS with the
+server's self-signed cert (robustirc.clj:104-136): POST /robustirc/v1/
+session to open, POST .../{sid}/message with an X-Session-Auth header
+to send an IRC line, GET .../{sid}/messages?lastseen=0.0 to stream the
+log back.
+
+DB automation per robustirc.clj:24-103: build the Go binary, upload a
+shared self-signed cert, start the primary with ``-singlenode``, then
+join the rest with ``-join``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import ssl
+import threading
+import urllib.request
+import uuid
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._http import NET_ERRORS
+
+logger = logging.getLogger("jepsen.robustirc")
+
+PORT = 13001
+NETWORK_PASSWORD = "secret"
+CERT = "/tmp/cert.pem"
+KEY = "/tmp/key.pem"
+BINARY = "/root/gocode/bin/robustirc"
+DATA_DIR = "/var/lib/robustirc"
+CHANNEL = "#jepsen"
+
+
+def base_args(node: str) -> list[str]:
+    return [f"-listen={node}:{PORT}",
+            f"-network_password={NETWORK_PASSWORD}",
+            "-network_name=jepsen",
+            f"-tls_cert_path={CERT}",
+            f"-tls_ca_file={CERT}",
+            f"-tls_key_path={KEY}"]
+
+
+def shared_cert(test: dict) -> tuple[str, str]:
+    """Generates (once per test, on the control node) a self-signed cert
+    whose SAN covers every node, for upload to the whole cluster."""
+    import subprocess
+    import tempfile
+    lock = test.setdefault("_robustirc_cert_lock", threading.Lock())
+    with lock:
+        paths = test.get("_robustirc_cert")
+        if paths:
+            return paths
+        d = tempfile.mkdtemp(prefix="jepsen-robustirc-")
+        cert, key = f"{d}/cert.pem", f"{d}/key.pem"
+        san = ",".join(f"DNS:{n}" for n in (test.get("nodes") or []))
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "7",
+             "-subj", "/CN=jepsen", "-addext", f"subjectAltName={san}"],
+            check=True, capture_output=True)
+        test["_robustirc_cert"] = (cert, key)
+        return cert, key
+
+
+class RobustIRCDB(db_mod.DB, db_mod.Process, db_mod.LogFiles):
+    """Go build + singlenode bootstrap + joins (robustirc.clj:24-103)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        os_setup.install(["golang-go", "git", "openssl"])
+        if not cu.file_exists(BINARY):
+            logger.info("%s: building robustirc", node)
+            control.exec_(control.lit(
+                "env GOPATH=/root/gocode GOBIN=/root/gocode/bin "
+                "go install github.com/robustirc/robustirc@latest"))
+        # ONE shared cert for the whole cluster, generated once on the
+        # control node and uploaded everywhere (robustirc.clj:39-41) —
+        # per-node certs would fail inter-node TLS verification since
+        # each server's cert must validate against -tls_ca_file
+        local_cert, local_key = shared_cert(test)
+        control.upload([local_cert], CERT)
+        control.upload([local_key], KEY)
+        cu.rm_rf(DATA_DIR)
+        cu.mkdir(DATA_DIR)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            cu.start_daemon(
+                {"logfile": f"{DATA_DIR}/robustirc.log",
+                 "pidfile": f"{DATA_DIR}/robustirc.pid", "chdir": DATA_DIR},
+                BINARY, *base_args(node), "-singlenode")
+            cu.await_tcp_port(PORT, host=node, timeout_s=120.0)
+        core.synchronize(test, timeout_s=600.0)
+        if node != primary:
+            cu.start_daemon(
+                {"logfile": f"{DATA_DIR}/robustirc.log",
+                 "pidfile": f"{DATA_DIR}/robustirc.pid", "chdir": DATA_DIR},
+                BINARY, *base_args(node), f"-join={primary}:{PORT}")
+            cu.await_tcp_port(PORT, host=node, timeout_s=120.0)
+        core.synchronize(test, timeout_s=600.0)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf(DATA_DIR)
+
+    def start(self, test, node):
+        primary = (test.get("nodes") or [node])[0]
+        extra = "-singlenode" if node == primary else f"-join={primary}:{PORT}"
+        return cu.start_daemon(
+            {"logfile": f"{DATA_DIR}/robustirc.log",
+             "pidfile": f"{DATA_DIR}/robustirc.pid", "chdir": DATA_DIR},
+            BINARY, *base_args(node), extra)
+
+    def kill(self, test, node):
+        cu.grepkill("robustirc")
+
+    def log_files(self, test, node):
+        return [f"{DATA_DIR}/robustirc.log"]
+
+
+class RobustIRCClient(Client):
+    """The robustsession set client (robustirc.clj:104-182): adds post
+    ``TOPIC #jepsen :<n>``, the whole-set read replays the message log
+    and extracts every TOPIC integer."""
+
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+        self.session_id: str | None = None
+        self.session_auth: str | None = None
+        self._ctx = ssl._create_unverified_context()  # self-signed cert
+        self._msg_counter = 0
+
+    def _url(self, path: str) -> str:
+        return f"https://{self.node}:{PORT}/robustirc/v1/{path}"
+
+    def _request(self, path: str, body=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        hdrs = dict(headers or {})
+        if data is not None:
+            hdrs["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self._url(path), data=data, headers=hdrs,
+            method="POST" if data is not None else "GET")
+        with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                    context=self._ctx) as resp:
+            return json.loads(resp.read().decode() or "null")
+
+    def open(self, test, node):
+        c = RobustIRCClient(self.timeout_s, node)
+        sess = c._request("session", body={})
+        c.session_id = sess["Sessionid"]
+        c.session_auth = sess["Sessionauth"]
+        c._post(f"NICK j{node}")
+        c._post("USER j j j j")
+        c._post(f"JOIN {CHANNEL}")
+        return c
+
+    def _post(self, irc_line: str):
+        """POST one IRC message with a collision-resistant id
+        (robustirc.clj:108-121)."""
+        self._msg_counter += 1
+        digest = hashlib.md5(
+            f"{irc_line}-{self._msg_counter}".encode()).hexdigest()
+        msg_id = int(digest[:15], 16)
+        return self._request(
+            f"{self.session_id}/message",
+            body={"Data": irc_line, "ClientMessageId": msg_id},
+            headers={"X-Session-Auth": self.session_auth})
+
+    def _read_topics(self) -> list[int]:
+        """Stream the message log; collect TOPIC integers
+        (robustirc.clj:123-148).
+
+        The GetMessages stream never closes — it waits for future
+        events — so termination needs a marker: we post a uniquely-
+        tagged PRIVMSG first and stream exactly until it comes back,
+        which yields a consistent prefix of the log."""
+        marker = f"jepsen-read-marker-{uuid.uuid4().hex}"
+        self._post(f"PRIVMSG {CHANNEL} :{marker}")
+        req = urllib.request.Request(
+            self._url(f"{self.session_id}/messages?lastseen=0.0"),
+            headers={"X-Session-Auth": self.session_auth})
+        out: list[int] = []
+        with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                    context=self._ctx) as resp:
+            decoder = json.JSONDecoder()
+            buf = ""
+            while True:
+                chunk = resp.read(65536).decode(errors="replace")
+                if not chunk:
+                    break  # server closed early; partial → caller fails op
+                buf += chunk
+                while buf:
+                    buf = buf.lstrip()
+                    try:
+                        msg, idx = decoder.raw_decode(buf)
+                    except json.JSONDecodeError:
+                        break
+                    buf = buf[idx:]
+                    data = (msg or {}).get("Data", "")
+                    if marker in data:
+                        return out
+                    parts = data.split(" ")
+                    if len(parts) > 1 and parts[1] == "TOPIC":
+                        try:
+                            out.append(int(data.split(":")[-1]))
+                        except ValueError:
+                            pass
+        raise ConnectionError("message stream closed before marker")
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "add":
+                self._post(f"TOPIC {CHANNEL} :{int(v)}")
+                return {**op, "type": "ok"}
+            if f == "read":
+                try:
+                    topics = self._read_topics()
+                except NET_ERRORS:
+                    # a streaming read cut short mid-log would report a
+                    # partial set and yield false 'lost' verdicts
+                    return {**op, "type": "fail", "error": ["stream-cut"]}
+                return {**op, "type": "ok", "value": sorted(set(topics))}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except NET_ERRORS as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+
+SUPPORTED_WORKLOADS = ("set",)
+
+
+def robustirc_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="robustirc",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": RobustIRCDB(),
+                             "client": RobustIRCClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(robustirc_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-robustirc")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""FaunaDB / RobustIRC / LogCabin suite tests: FQL expression
+composition, robustsession message parsing, TreeOps exec command
+shapes and error mapping, plus fake-mode lifecycle runs."""
+from jepsen_tpu import control
+from jepsen_tpu.suites import faunadb, logcabin, robustirc
+
+from conftest import run_fake  # noqa: E402
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# ---------------------------------------------------------------------------
+# faunadb: FQL JSON expression builders + client bodies
+# ---------------------------------------------------------------------------
+
+def test_fauna_fql_builders():
+    r = faunadb.ref_("registers", 3)
+    assert r == {"ref": {"@ref": "classes/registers/3"}}
+    up = faunadb.upsert("registers", 3, {"v": 7})
+    assert up["if"] == {"exists": {"@ref": "classes/registers/3"}}
+    assert up["then"]["update"] == {"@ref": "classes/registers/3"}
+    assert up["else"]["create"] == {"@ref": "classes/registers/3"}
+
+
+def test_fauna_client_cas_expression():
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            return True
+
+    c = TClient(node="n1")
+    out = c.invoke({}, {"f": "cas", "type": "invoke",
+                        "value": [1, (4, 5)]})
+    assert out["type"] == "ok"
+    expr = sent[0]
+    # If(Equals(Select(..), 4), Do(Update(.., v=5), true), false)
+    assert expr["if"]["equals"][1] == 4
+    assert expr["then"]["do"][0]["update"] == {"@ref": "classes/registers/1"}
+    assert expr["else"] is False
+
+
+def test_fauna_client_not_found_read_is_nil():
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            raise faunadb.FaunaError([{"code": "instance not found"}])
+
+    out = TClient(node="n1").invoke(
+        {}, {"f": "read", "type": "invoke", "value": [2, None]})
+    assert out["type"] == "ok" and out["value"] == [2, None]
+
+
+def test_fauna_fake_register_run():
+    result = run_fake(faunadb.faunadb_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_fauna_fake_bank_run():
+    result = run_fake(faunadb.faunadb_test, workload="bank")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# robustirc
+# ---------------------------------------------------------------------------
+
+def test_robustirc_daemon_args():
+    args = robustirc.base_args("n2")
+    joined = " ".join(args)
+    assert "-listen=n2:13001" in joined
+    assert "-network_password=secret" in joined
+
+
+def test_robustirc_db_commands():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    db = robustirc.RobustIRCDB()
+    try:
+        control.on("n1", t, lambda: db.start(t, "n1"))
+        control.on("n3", t, lambda: db.start(t, "n3"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "-singlenode" in joined          # primary bootstraps
+        assert "-join=n1:13001" in joined        # others join it
+    finally:
+        control.disconnect_all(t)
+
+
+def test_robustirc_fake_set_run():
+    result = run_fake(robustirc.robustirc_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# logcabin
+# ---------------------------------------------------------------------------
+
+def test_logcabin_config():
+    assert logcabin.server_id("n3") == "3"
+    assert logcabin.server_addrs({"nodes": NODES}).startswith("n1:5254,")
+
+
+def test_logcabin_client_exec_shapes():
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    try:
+        c = logcabin.LogCabinClient().open(t, "n2")
+        out = c.invoke(t, {"f": "write", "type": "invoke",
+                           "value": [1, 5]})
+        assert out["type"] == "ok"
+        joined = " ".join(str(x) for x in remote.log)
+        assert "/root/TreeOps" in joined
+        assert "write /jepsen-1" in joined
+        out = c.invoke(t, {"f": "cas", "type": "invoke",
+                           "value": [1, (5, 6)]})
+        joined = " ".join(str(x) for x in remote.log)
+        assert "-p /jepsen-1:5" in joined        # TreeOps CAS predicate
+    finally:
+        control.disconnect_all(t)
+
+
+def test_logcabin_error_mapping():
+    c = logcabin.LogCabinClient("n1")
+
+    class R:
+        exit_status = 1
+        out = ""
+        err = ("Exiting due to LogCabin::Client::Exception: Path "
+               "'/jepsen-1' has value '3', not '4' as required")
+
+    # a CAS precondition miss is a definite fail
+    c._exec = lambda *a, **kw: R()
+    out = c.invoke({}, {"f": "cas", "type": "invoke", "value": [1, (4, 5)]})
+    assert out["type"] == "fail"
+
+    class RTimeout(R):
+        err = ("Exiting due to LogCabin::Client::Exception: "
+               "Client-specified timeout elapsed")
+
+    # a timed-out write is indeterminate (deviation from the reference,
+    # which unsoundly fails all timed-out ops)
+    c._exec = lambda *a, **kw: RTimeout()
+    out = c.invoke({}, {"f": "write", "type": "invoke", "value": [1, 2]})
+    assert out["type"] == "info"
+    out = c.invoke({}, {"f": "read", "type": "invoke", "value": [1, None]})
+    assert out["type"] == "fail"
+
+
+def test_logcabin_fake_register_run():
+    result = run_fake(logcabin.logcabin_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_suite_registry_is_complete():
+    """Every reference L8 suite dir has a counterpart in the registry
+    (SURVEY.md §1 L8; mongodb-* / postgres-rds map to mongodb/postgres,
+    aerospike/rabbitmq/rethinkdb arrive with their own wire clients)."""
+    from jepsen_tpu.suites import suite_registry
+    reg = set(suite_registry())
+    assert {"etcd", "zookeeper", "consul", "redis", "postgres", "mongodb",
+            "elasticsearch", "crate", "dgraph", "ignite", "hazelcast",
+            "chronos", "raftis", "disque", "galera", "percona",
+            "mysql-cluster", "tidb", "cockroachdb", "stolon", "yugabyte",
+            "faunadb", "robustirc", "logcabin"} <= reg
+
+
+def test_fauna_bank_read_is_one_transaction():
+    """All balances must come back from ONE query (one FaunaDB txn) —
+    per-account queries would interleave with transfers and produce
+    false wrong-total violations."""
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            return {"0": 10, "1": 13}
+
+    out = TClient(node="n1").invoke(
+        {"accounts": [0, 1]}, {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "ok" and out["value"] == {0: 10, 1: 13}
+    assert len(sent) == 1 and "object" in sent[0]
+
+
+def test_fauna_not_found_on_bank_read_is_typed_completion():
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            raise faunadb.FaunaError([{"code": "instance not found"}])
+
+    out = TClient(node="n1").invoke(
+        {"accounts": [0, 1]}, {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "fail"  # not a raised TypeError
