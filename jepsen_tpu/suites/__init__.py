@@ -184,12 +184,13 @@ def standard_test_fn(suite_test: Callable,
 def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""
-    from jepsen_tpu.suites import (chronos, cockroachdb, consul, crate,
-                                   dgraph, disque, elasticsearch, etcd,
-                                   faunadb, galera, hazelcast, ignite,
+    from jepsen_tpu.suites import (aerospike, chronos, cockroachdb, consul,
+                                   crate, dgraph, disque, elasticsearch,
+                                   etcd, faunadb, galera, hazelcast, ignite,
                                    logcabin, mongodb, mysql_cluster, percona,
-                                   postgres, raftis, redis, robustirc,
-                                   stolon, tidb, yugabyte, zookeeper)
+                                   postgres, rabbitmq, raftis, redis,
+                                   rethinkdb, robustirc, stolon, tidb,
+                                   yugabyte, zookeeper)
     return {
         "etcd": etcd.etcd_test,
         "zookeeper": zookeeper.zookeeper_test,
@@ -215,6 +216,9 @@ def suite_registry() -> dict[str, Callable]:
         "faunadb": faunadb.faunadb_test,
         "robustirc": robustirc.robustirc_test,
         "logcabin": logcabin.logcabin_test,
+        "rabbitmq": rabbitmq.rabbitmq_test,
+        "rethinkdb": rethinkdb.rethinkdb_test,
+        "aerospike": aerospike.aerospike_test,
     }
 
 

@@ -1,0 +1,249 @@
+"""Minimal Aerospike wire client for the aerospike suite (reference:
+aerospike/src/aerospike/ rides the official Java client; this is the
+from-scratch equivalent for the CAS-register workload).
+
+Two sub-protocols share an 8-byte ``version(1) type(1) length(6)``
+envelope:
+
+- **info** (type 1): newline-terminated request names, tab-separated
+  replies — used for cluster administration.
+- **message** (type 3): a 22-byte header (info bits, result code,
+  generation, ttl, field/op counts) followed by fields (namespace,
+  set, key digest) and bin operations — used for reads and writes.
+
+Single-record transactions address records by a RIPEMD-160 digest of
+``set + key-type + key`` which the *client* computes; OpenSSL 3 ships
+ripemd160 only in the legacy provider, so a pure-Python implementation
+(verified against the published test vectors) is included.
+
+Compare-and-set uses Aerospike's generation policy: read returns the
+record's generation counter, and a write carrying that generation with
+the GENERATION info bit set is rejected with GENERATION_ERROR if the
+record changed in between — the same optimistic-CAS scheme the
+reference's cas_register client uses.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+
+# -- RIPEMD-160 (pure python; test vectors in tests/test_wire_suites.py) ----
+
+def _rol(x, n):
+    x &= 0xFFFFFFFF
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+_R1 = [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+       7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+       3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+       1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+       4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13]
+_R2 = [5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+       6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+       15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+       8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+       12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11]
+_S1 = [11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+       7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+       11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+       11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+       9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6]
+_S2 = [8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+       9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+       9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+       15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+       8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11]
+_K1 = [0x00000000, 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xA953FD4E]
+_K2 = [0x50A28BE6, 0x5C4DD124, 0x6D703EF3, 0x7A6D76E9, 0x00000000]
+
+
+def _f(j, x, y, z):
+    if j < 16:
+        return x ^ y ^ z
+    if j < 32:
+        return (x & y) | (~x & z)
+    if j < 48:
+        return (x | ~y) ^ z
+    if j < 64:
+        return (x & z) | (y & ~z)
+    return x ^ (y | ~z)
+
+
+def ripemd160(data: bytes) -> bytes:
+    h = [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0]
+    # MD4-style padding: 0x80, zeros, 64-bit little-endian bit length
+    ml = len(data) * 8
+    data = data + b"\x80"
+    data += b"\x00" * ((56 - len(data) % 64) % 64)
+    data += struct.pack("<Q", ml)
+    for off in range(0, len(data), 64):
+        x = struct.unpack("<16I", data[off:off + 64])
+        a1, b1, c1, d1, e1 = h
+        a2, b2, c2, d2, e2 = h
+        for j in range(80):
+            t = _rol((a1 + _f(j, b1, c1, d1) + x[_R1[j]] + _K1[j // 16]),
+                     _S1[j]) + e1
+            a1, e1, d1, c1, b1 = e1, d1, _rol(c1, 10), b1, t & 0xFFFFFFFF
+            t = _rol((a2 + _f(79 - j, b2, c2, d2) + x[_R2[j]]
+                      + _K2[j // 16]), _S2[j]) + e2
+            a2, e2, d2, c2, b2 = e2, d2, _rol(c2, 10), b2, t & 0xFFFFFFFF
+        t = (h[1] + c1 + d2) & 0xFFFFFFFF
+        h[1] = (h[2] + d1 + e2) & 0xFFFFFFFF
+        h[2] = (h[3] + e1 + a2) & 0xFFFFFFFF
+        h[3] = (h[4] + a1 + b2) & 0xFFFFFFFF
+        h[4] = (h[0] + b1 + c2) & 0xFFFFFFFF
+        h[0] = t
+    return struct.pack("<5I", *h)
+
+
+# -- wire constants ---------------------------------------------------------
+
+PROTO_VERSION = 2
+TYPE_INFO = 1
+TYPE_MESSAGE = 3
+
+# message header info bits
+INFO1_READ = 0x01
+INFO1_GET_ALL = 0x02
+INFO2_WRITE = 0x01
+INFO2_GENERATION = 0x04     # write only if generation matches
+
+# field types
+FIELD_NAMESPACE = 0
+FIELD_SET = 1
+FIELD_DIGEST = 4
+
+# bin operations / particle types
+OP_READ = 1
+OP_WRITE = 2
+PARTICLE_INTEGER = 1
+
+# result codes (aerospike server)
+RC_OK = 0
+RC_KEY_NOT_FOUND = 2
+RC_GENERATION_ERROR = 3
+
+KEY_TYPE_INTEGER = 1
+
+
+class AerospikeError(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"result code {code}")
+        self.code = code
+
+
+def key_digest(set_name: str, key: int) -> bytes:
+    """RIPEMD-160 of set + key-type byte + big-endian key bytes — the
+    digest every Aerospike client computes for integer keys."""
+    return ripemd160(set_name.encode() + bytes([KEY_TYPE_INTEGER])
+                     + struct.pack(">q", key))
+
+
+def _field(ftype: int, data: bytes) -> bytes:
+    return struct.pack(">IB", len(data) + 1, ftype) + data
+
+
+def _op(op_type: int, bin_name: str, data: bytes = b"",
+        particle: int = 0) -> bytes:
+    name = bin_name.encode()
+    return (struct.pack(">IBBBB", 4 + len(name) + len(data),
+                        op_type, particle, 0, len(name)) + name + data)
+
+
+class AerospikeConnection:
+    """One socket to one node; single-record transactions + info."""
+
+    def __init__(self, host: str, port: int = 3000,
+                 namespace: str = "test", set_name: str = "jepsen",
+                 timeout_s: float = 5.0):
+        self.namespace = namespace
+        self.set_name = set_name
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+
+    def _recv_exact(self, n: int) -> bytes:
+        from jepsen_tpu.suites._wire import recv_exact
+        return recv_exact(self.sock, n)
+
+    def _send(self, mtype: int, payload: bytes) -> bytes:
+        size = len(payload)
+        header = struct.pack(">Q", (PROTO_VERSION << 56) | (mtype << 48)
+                             | size)
+        self.sock.sendall(header + payload)
+        reply_header = struct.unpack(">Q", self._recv_exact(8))[0]
+        reply_size = reply_header & 0xFFFFFFFFFFFF
+        return self._recv_exact(reply_size)
+
+    # -- info protocol ----------------------------------------------------
+
+    def info(self, *names: str) -> dict[str, str]:
+        """The info sub-protocol (cluster admin; aerospike
+        support.clj's asinfo usage)."""
+        payload = ("\n".join(names) + "\n").encode()
+        reply = self._send(TYPE_INFO, payload).decode()
+        out = {}
+        for line in reply.split("\n"):
+            if "\t" in line:
+                k, v = line.split("\t", 1)
+                out[k] = v
+        return out
+
+    # -- single-record transactions --------------------------------------
+
+    def _message(self, info1: int, info2: int, generation: int,
+                 ops: list[bytes], key: int) -> tuple[int, int, bytes]:
+        fields = [_field(FIELD_NAMESPACE, self.namespace.encode()),
+                  _field(FIELD_SET, self.set_name.encode()),
+                  _field(FIELD_DIGEST, key_digest(self.set_name, key))]
+        body = (struct.pack(">BBBBBBIIIHH", 22, info1, info2, 0, 0, 0,
+                            generation, 0, 1000, len(fields), len(ops))
+                + b"".join(fields) + b"".join(ops))
+        reply = self._send(TYPE_MESSAGE, body)
+        result_code = reply[5]
+        r_generation = struct.unpack(">I", reply[6:10])[0]
+        n_fields, n_ops = struct.unpack(">HH", reply[18:22])
+        pos = 22
+        for _ in range(n_fields):
+            fsize = struct.unpack(">I", reply[pos:pos + 4])[0]
+            pos += 4 + fsize
+        bin_data = b""
+        for _ in range(n_ops):
+            osize = struct.unpack(">I", reply[pos:pos + 4])[0]
+            name_len = reply[pos + 7]
+            bin_data = reply[pos + 8 + name_len:pos + 4 + osize]
+            pos += 4 + osize
+        return result_code, r_generation, bin_data
+
+    def get(self, key: int, bin_name: str = "value"):
+        """Reads one named bin; returns (value, generation) or (None, 0)
+        when the record is absent."""
+        rc, gen, data = self._message(INFO1_READ, 0, 0,
+                                      [_op(OP_READ, bin_name)], key)
+        if rc == RC_KEY_NOT_FOUND:
+            return None, 0
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+        value = struct.unpack(">q", data)[0] if len(data) == 8 else None
+        return value, gen
+
+    def put(self, key: int, value: int, bin_name: str = "value",
+            generation: int | None = None) -> bool:
+        """Writes; with ``generation`` set, succeeds only if the record
+        still carries that generation (False on GENERATION_ERROR)."""
+        info2 = INFO2_WRITE
+        gen = 0
+        if generation is not None:
+            info2 |= INFO2_GENERATION
+            gen = generation
+        ops = [_op(OP_WRITE, bin_name, struct.pack(">q", value),
+                   PARTICLE_INTEGER)]
+        rc, _, _ = self._message(0, info2, gen, ops, key)
+        if rc == RC_GENERATION_ERROR:
+            return False
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+        return True
+
+    def close(self) -> None:
+        from jepsen_tpu.suites._wire import close_quietly
+        close_quietly(self.sock)

@@ -1,0 +1,256 @@
+"""Minimal AMQP 0-9-1 wire client for the rabbitmq suite (reference:
+rabbitmq/src/jepsen/rabbitmq.clj rides the langohr JVM driver; this
+module is the from-scratch equivalent, like ``_mysql.py`` /
+``_postgres.py`` / ``_resp.py`` for their families).
+
+Implements exactly the subset the queue workload needs: connection
+negotiation (Start/Tune/Open with PLAIN auth), channel open, publisher
+confirms (``confirm.select`` + waiting for ``basic.ack``), durable
+``queue.declare``, ``basic.publish`` with persistent delivery-mode,
+``basic.get`` + client ``basic.ack``, and ``queue.purge``. Heartbeats
+are negotiated off. Server-initiated ``channel.close`` /
+``connection.close`` raise :class:`AmqpError` after the protocol-
+mandated close-ok handshake.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+
+PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
+FRAME_METHOD, FRAME_HEADER, FRAME_BODY, FRAME_HEARTBEAT = 1, 2, 3, 8
+FRAME_END = 0xCE
+
+# (class, method) ids used below
+CONN_START = (10, 10)
+CONN_START_OK = (10, 11)
+CONN_TUNE = (10, 30)
+CONN_TUNE_OK = (10, 31)
+CONN_OPEN = (10, 40)
+CONN_OPEN_OK = (10, 41)
+CONN_CLOSE = (10, 50)
+CONN_CLOSE_OK = (10, 51)
+CHAN_OPEN = (20, 10)
+CHAN_OPEN_OK = (20, 11)
+CHAN_CLOSE = (20, 40)
+CHAN_CLOSE_OK = (20, 41)
+QUEUE_DECLARE = (50, 10)
+QUEUE_DECLARE_OK = (50, 11)
+QUEUE_PURGE = (50, 30)
+QUEUE_PURGE_OK = (50, 31)
+BASIC_PUBLISH = (60, 40)
+BASIC_RETURN = (60, 50)
+BASIC_GET = (60, 70)
+BASIC_GET_OK = (60, 71)
+BASIC_GET_EMPTY = (60, 72)
+BASIC_ACK = (60, 80)
+BASIC_NACK = (60, 120)
+CONFIRM_SELECT = (85, 10)
+CONFIRM_SELECT_OK = (85, 11)
+
+
+class AmqpError(Exception):
+    """A server channel/connection close: ``.code`` and ``.text``."""
+
+    def __init__(self, code: int, text: str):
+        super().__init__(f"{code} {text}")
+        self.code = code
+        self.text = text
+
+
+def shortstr(s: str) -> bytes:
+    data = s.encode()
+    return struct.pack(">B", len(data)) + data
+
+
+def longstr(data: bytes) -> bytes:
+    return struct.pack(">I", len(data)) + data
+
+
+def parse_shortstr(buf: bytes, pos: int) -> tuple[str, int]:
+    n = buf[pos]
+    return buf[pos + 1:pos + 1 + n].decode(), pos + 1 + n
+
+
+class AmqpConnection:
+    """One connection + one channel (channel 1), the shape every op in
+    the rabbitmq suite uses (rabbitmq.clj's with-ch per invoke)."""
+
+    def __init__(self, host: str, port: int = 5672, user: str = "guest",
+                 password: str = "guest", vhost: str = "/",
+                 timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._publish_seq = 0  # confirm-mode sequence number
+        try:
+            self._handshake(user, password, vhost)
+            self._open_channel()
+        except BaseException:
+            self.sock.close()
+            raise
+
+    # -- framing ----------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        from jepsen_tpu.suites._wire import recv_exact
+        return recv_exact(self.sock, n)
+
+    def _read_frame(self) -> tuple[int, int, bytes]:
+        ftype, channel, size = struct.unpack(">BHI", self._recv_exact(7))
+        payload = self._recv_exact(size)
+        end = self._recv_exact(1)
+        if end[0] != FRAME_END:
+            raise ConnectionError(f"bad frame end {end!r}")
+        return ftype, channel, payload
+
+    def _send_frame(self, ftype: int, channel: int, payload: bytes) -> None:
+        self.sock.sendall(struct.pack(">BHI", ftype, channel, len(payload))
+                          + payload + bytes([FRAME_END]))
+
+    def _send_method(self, channel: int, cm: tuple[int, int],
+                     args: bytes = b"") -> None:
+        self._send_frame(FRAME_METHOD, channel,
+                         struct.pack(">HH", *cm) + args)
+
+    def _read_method(self) -> tuple[tuple[int, int], bytes, int]:
+        """Next method frame (skipping heartbeats); raises on close."""
+        while True:
+            ftype, channel, payload = self._read_frame()
+            if ftype == FRAME_HEARTBEAT:
+                continue
+            if ftype != FRAME_METHOD:
+                raise ConnectionError(f"unexpected frame type {ftype}")
+            cm = struct.unpack(">HH", payload[:4])
+            args = payload[4:]
+            if cm == CHAN_CLOSE:
+                code = struct.unpack(">H", args[:2])[0]
+                text, _ = parse_shortstr(args, 2)
+                self._send_method(channel, CHAN_CLOSE_OK)
+                raise AmqpError(code, text)
+            if cm == CONN_CLOSE:
+                code = struct.unpack(">H", args[:2])[0]
+                text, _ = parse_shortstr(args, 2)
+                self._send_method(0, CONN_CLOSE_OK)
+                raise AmqpError(code, text)
+            return cm, args, channel
+
+    def _expect(self, cm: tuple[int, int]) -> bytes:
+        got, args, _channel = self._read_method()
+        if got != cm:
+            raise ConnectionError(f"expected {cm}, got {got}")
+        return args
+
+    # -- connection negotiation ------------------------------------------
+
+    def _handshake(self, user: str, password: str, vhost: str) -> None:
+        self.sock.sendall(PROTOCOL_HEADER)
+        self._expect(CONN_START)
+        plain = b"\x00" + user.encode() + b"\x00" + password.encode()
+        self._send_method(0, CONN_START_OK,
+                          longstr(b"")              # client-properties {}
+                          + shortstr("PLAIN")
+                          + longstr(plain)
+                          + shortstr("en_US"))
+        args = self._expect(CONN_TUNE)
+        channel_max, frame_max, _hb = struct.unpack(">HIH", args[:8])
+        # echo the server's limits; heartbeat 0 = disabled
+        self._send_method(0, CONN_TUNE_OK,
+                          struct.pack(">HIH", channel_max, frame_max, 0))
+        self._send_method(0, CONN_OPEN,
+                          shortstr(vhost) + shortstr("") + b"\x00")
+        self._expect(CONN_OPEN_OK)
+
+    def _open_channel(self) -> None:
+        self._send_method(1, CHAN_OPEN, shortstr(""))
+        self._expect(CHAN_OPEN_OK)
+
+    # -- queue ops --------------------------------------------------------
+
+    def confirm_select(self) -> None:
+        """Publisher-confirm mode (rabbitmq.clj lco/select)."""
+        self._send_method(1, CONFIRM_SELECT, b"\x00")  # nowait=false
+        self._expect(CONFIRM_SELECT_OK)
+
+    def queue_declare(self, queue: str, durable: bool = True) -> None:
+        bits = 0x02 if durable else 0x00  # passive|durable|excl|auto-del|nowait
+        self._send_method(1, QUEUE_DECLARE,
+                          struct.pack(">H", 0) + shortstr(queue)
+                          + bytes([bits]) + longstr(b""))
+        self._expect(QUEUE_DECLARE_OK)
+
+    def queue_purge(self, queue: str) -> int:
+        self._send_method(1, QUEUE_PURGE,
+                          struct.pack(">H", 0) + shortstr(queue) + b"\x00")
+        args = self._expect(QUEUE_PURGE_OK)
+        return struct.unpack(">I", args[:4])[0]
+
+    def publish(self, queue: str, body: bytes, mandatory: bool = True,
+                persistent: bool = True) -> bool:
+        """basic.publish to the default exchange + wait for the broker's
+        confirm (rabbitmq.clj:155-165). Returns True on basic.ack, False
+        on basic.nack or a mandatory-unroutable basic.return."""
+        self._publish_seq += 1
+        bits = 0x01 if mandatory else 0x00
+        self._send_method(1, BASIC_PUBLISH,
+                          struct.pack(">H", 0) + shortstr("")
+                          + shortstr(queue) + bytes([bits]))
+        # content header: class, weight, body size, flags, delivery-mode
+        flags = 0x1000 if persistent else 0  # delivery-mode property bit
+        header = struct.pack(">HHQH", 60, 0, len(body), flags)
+        if persistent:
+            header += bytes([2])
+        self._send_frame(FRAME_HEADER, 1, header)
+        self._send_frame(FRAME_BODY, 1, body)
+        returned = False
+        while True:
+            cm, args, _ = self._read_method()
+            if cm == BASIC_RETURN:
+                # unroutable; a content header follows, then as many
+                # body frames as its body-size requires (possibly none)
+                ftype, _, hdr = self._read_frame()
+                if ftype != FRAME_HEADER:
+                    raise ConnectionError("expected returned-msg header")
+                body_size = struct.unpack(">Q", hdr[4:12])[0]
+                got = 0
+                while got < body_size:
+                    ftype, _, chunk = self._read_frame()
+                    if ftype != FRAME_BODY:
+                        raise ConnectionError("expected returned-msg body")
+                    got += len(chunk)
+                returned = True
+                continue
+            if cm == BASIC_ACK:
+                return not returned
+            if cm == BASIC_NACK:
+                return False
+            raise ConnectionError(f"unexpected method {cm} awaiting confirm")
+
+    def get(self, queue: str, no_ack: bool = False):
+        """basic.get; returns (delivery_tag, body) or None when empty."""
+        self._send_method(1, BASIC_GET,
+                          struct.pack(">H", 0) + shortstr(queue)
+                          + (b"\x01" if no_ack else b"\x00"))
+        cm, args, _ = self._read_method()
+        if cm == BASIC_GET_EMPTY:
+            return None
+        if cm != BASIC_GET_OK:
+            raise ConnectionError(f"expected get-ok, got {cm}")
+        delivery_tag = struct.unpack(">Q", args[:8])[0]
+        ftype, _, payload = self._read_frame()
+        if ftype != FRAME_HEADER:
+            raise ConnectionError("expected content header")
+        body_size = struct.unpack(">Q", payload[4:12])[0]
+        body = b""
+        while len(body) < body_size:
+            ftype, _, chunk = self._read_frame()
+            if ftype != FRAME_BODY:
+                raise ConnectionError("expected content body")
+            body += chunk
+        return delivery_tag, body
+
+    def ack(self, delivery_tag: int) -> None:
+        self._send_method(1, BASIC_ACK,
+                          struct.pack(">Q", delivery_tag) + b"\x00")
+
+    def close(self) -> None:
+        from jepsen_tpu.suites._wire import close_quietly
+        close_quietly(self.sock)

@@ -93,14 +93,8 @@ class MySQLConnection:
     # -- packet framing: 3-byte LE length + 1-byte sequence id ------------
 
     def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        while n:
-            chunk = self.sock.recv(n)
-            if not chunk:
-                raise ConnectionError("mysql server closed connection")
-            chunks.append(chunk)
-            n -= len(chunk)
-        return b"".join(chunks)
+        from jepsen_tpu.suites._wire import recv_exact
+        return recv_exact(self.sock, n)
 
     def _read_packet(self) -> bytes:
         header = self._recv_exact(4)

@@ -88,14 +88,8 @@ class PGConnection:
     # -- framing: backend messages are type byte + int32 length -----------
 
     def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        while n:
-            chunk = self.sock.recv(n)
-            if not chunk:
-                raise ConnectionError("postgres server closed connection")
-            chunks.append(chunk)
-            n -= len(chunk)
-        return b"".join(chunks)
+        from jepsen_tpu.suites._wire import recv_exact
+        return recv_exact(self.sock, n)
 
     def _read_message(self) -> tuple[bytes, bytes]:
         header = self._recv_exact(5)

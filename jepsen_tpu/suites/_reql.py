@@ -1,0 +1,180 @@
+"""Minimal ReQL wire driver for the rethinkdb suite (reference:
+rethinkdb/src/jepsen/rethinkdb/ rides the clj-rethinkdb JVM driver;
+this is the from-scratch equivalent).
+
+Protocol (V0_4 + JSON): the client sends a 4-byte little-endian magic
+``0x400c2d20``, a length-prefixed auth key, and the JSON-protocol magic
+``0x7e6970c7``; the server answers with a NUL-terminated ``SUCCESS``.
+Queries are ``token(8B LE) + length(4B LE) + JSON`` where the JSON is
+``[START, term, optargs]``; responses echo the token and carry
+``{"t": response-type, "r": [...]}``.
+
+Terms are JSON arrays ``[term-code, args, optargs?]``; the builders
+below cover the document-CAS workload: db/table/get/insert/update plus
+the func/branch/eq/error combinators the CAS lambda needs
+(document_cas.clj:95-105).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+V0_4 = 0x400c2d20
+PROTOCOL_JSON = 0x7e6970c7
+
+START = 1
+
+SUCCESS_ATOM = 1
+SUCCESS_SEQUENCE = 2
+SUCCESS_PARTIAL = 3
+CLIENT_ERROR = 16
+COMPILE_ERROR = 17
+RUNTIME_ERROR = 18
+
+# term codes (ql2.proto)
+MAKE_ARRAY = 2
+VAR = 10
+ERROR = 12
+DB = 14
+TABLE = 15
+GET = 16
+EQ = 17
+GET_FIELD = 31
+UPDATE = 53
+INSERT = 56
+DB_CREATE = 57
+TABLE_CREATE = 60
+BRANCH = 65
+FUNC = 69
+DEFAULT = 92
+
+
+class ReqlError(Exception):
+    """A ReQL client/compile/runtime error response."""
+
+    def __init__(self, rtype: int, messages):
+        super().__init__(f"{rtype}: {messages}")
+        self.rtype = rtype
+        self.messages = messages
+
+
+# -- term builders ----------------------------------------------------------
+
+def db(name: str):
+    return [DB, [name]]
+
+
+def table(db_term, name: str, read_mode: str | None = None):
+    opt = {"read_mode": read_mode} if read_mode else {}
+    return [TABLE, [db_term, name], opt] if opt else [TABLE, [db_term, name]]
+
+
+def get(table_term, key):
+    return [GET, [table_term, key]]
+
+
+def get_field(row, field: str):
+    return [GET_FIELD, [row, field]]
+
+
+def eq(a, b):
+    return [EQ, [a, b]]
+
+
+def branch(cond, then, else_):
+    return [BRANCH, [cond, then, else_]]
+
+
+def error(msg: str):
+    return [ERROR, [msg]]
+
+
+def func(body):
+    """A one-argument ReQL lambda; the argument is var 1."""
+    return [FUNC, [[MAKE_ARRAY, [1]], body]]
+
+
+def var(n: int):
+    return [VAR, [n]]
+
+
+def default(term, dflt):
+    return [DEFAULT, [term, dflt]]
+
+
+def insert(table_term, doc: dict, conflict: str = "update"):
+    return [INSERT, [table_term, {k: v for k, v in doc.items()}],
+            {"conflict": conflict}]
+
+
+def update(selection, func_term):
+    return [UPDATE, [selection, func_term]]
+
+
+def db_create(name: str):
+    return [DB_CREATE, [name]]
+
+
+def table_create(db_term, name: str, replicas: int | None = None):
+    opt = {"replicas": replicas} if replicas else {}
+    return ([TABLE_CREATE, [db_term, name], opt] if opt
+            else [TABLE_CREATE, [db_term, name]])
+
+
+class ReqlConnection:
+    """One V0_4/JSON connection; ``run`` sends a START query and returns
+    the decoded result."""
+
+    def __init__(self, host: str, port: int = 28015, auth_key: str = "",
+                 timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._token = 0
+        try:
+            self._handshake(auth_key)
+        except BaseException:
+            self.sock.close()
+            raise
+
+    def _recv_exact(self, n: int) -> bytes:
+        from jepsen_tpu.suites._wire import recv_exact
+        return recv_exact(self.sock, n)
+
+    def _handshake(self, auth_key: str) -> None:
+        key = auth_key.encode()
+        self.sock.sendall(struct.pack("<I", V0_4)
+                          + struct.pack("<I", len(key)) + key
+                          + struct.pack("<I", PROTOCOL_JSON))
+        buf = b""
+        while not buf.endswith(b"\x00"):
+            chunk = self.sock.recv(64)
+            if not chunk:
+                raise ConnectionError("connection closed during handshake")
+            buf += chunk
+        msg = buf[:-1].decode()
+        if msg != "SUCCESS":
+            raise ConnectionError(f"handshake rejected: {msg}")
+
+    def run(self, term):
+        """Runs one START query; returns the atom (or sequence list)."""
+        self._token += 1
+        token = self._token
+        payload = json.dumps([START, term, {}]).encode()
+        self.sock.sendall(struct.pack("<Q", token)
+                          + struct.pack("<I", len(payload)) + payload)
+        rtoken, size = struct.unpack("<QI", self._recv_exact(12))
+        if rtoken != token:
+            raise ConnectionError(
+                f"response token {rtoken} != query token {token}")
+        resp = json.loads(self._recv_exact(size).decode())
+        rtype = resp.get("t")
+        if rtype in (CLIENT_ERROR, COMPILE_ERROR, RUNTIME_ERROR):
+            raise ReqlError(rtype, resp.get("r"))
+        r = resp.get("r", [])
+        if rtype == SUCCESS_ATOM:
+            return r[0] if r else None
+        return r  # sequence (partials unsupported: workloads read atoms)
+
+    def close(self) -> None:
+        from jepsen_tpu.suites._wire import close_quietly
+        close_quietly(self.sock)

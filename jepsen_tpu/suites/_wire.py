@@ -1,0 +1,28 @@
+"""Shared socket plumbing for the wire-protocol suite clients
+(``_mysql.py``, ``_postgres.py``, ``_resp.py``, ``_amqp.py``,
+``_reql.py``, ``_aerospike.py``): exact reads that refuse to return
+short data, and quiet closes."""
+from __future__ import annotations
+
+import socket
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Reads exactly n bytes or raises ConnectionError — a short read
+    must never surface as a (truncated) protocol unit."""
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        out += chunk
+    return out
+
+
+def close_quietly(sock: socket.socket | None) -> None:
+    if sock is None:
+        return
+    try:
+        sock.close()
+    except OSError:
+        pass

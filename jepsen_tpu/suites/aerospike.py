@@ -1,0 +1,206 @@
+"""Aerospike test suite (reference: aerospike/src/aerospike/ — the
+strong-consistency-mode KV store whose CAS register, counter, and set
+tests exposed lost updates under partitions).
+
+The client rides the bundled binary wire protocol (``_aerospike.py``):
+reads return (value, generation) from a single-record transaction, and
+CAS is a generation-conditioned write — read the register's generation,
+verify the value, then write with the GENERATION policy bit so the
+server rejects the write (GENERATION_ERROR) if anything committed in
+between, exactly the optimistic scheme of the reference's cas-register
+client (aerospike/cas_register.clj).
+
+DB automation per aerospike/support.clj: install the server package,
+write a mesh-heartbeat config listing every node with a
+strong-consistency namespace, start, then ``roster-set`` + ``recluster``
+via asinfo from the primary.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._aerospike import (AerospikeConnection,
+                                          AerospikeError)
+
+logger = logging.getLogger("jepsen.aerospike")
+
+PORT = 3000
+HEARTBEAT_PORT = 3002
+FABRIC_PORT = 3001
+NAMESPACE = "jepsen"
+SET_NAME = "registers"
+CONF = "/etc/aerospike/aerospike.conf"
+LOG_FILE = "/var/log/aerospike/aerospike.log"
+
+
+def config(test: dict, node: str) -> str:
+    """Mesh-heartbeat config with a strong-consistency namespace
+    (aerospike/support.clj's aerospike.conf resource)."""
+    mesh_seeds = "\n".join(
+        f"                mesh-seed-address-port {n} {HEARTBEAT_PORT}"
+        for n in (test.get("nodes") or []))
+    return f"""
+service {{
+        proto-fd-max 15000
+        node-id-interface eth0
+}}
+logging {{
+        file {LOG_FILE} {{
+                context any info
+        }}
+}}
+network {{
+        service {{
+                address any
+                port {PORT}
+        }}
+        heartbeat {{
+                mode mesh
+                address any
+                port {HEARTBEAT_PORT}
+{mesh_seeds}
+                interval 150
+                timeout 10
+        }}
+        fabric {{
+                port {FABRIC_PORT}
+        }}
+}}
+namespace {NAMESPACE} {{
+        replication-factor 3
+        strong-consistency true
+        storage-engine memory {{
+                data-size 1G
+        }}
+}}
+"""
+
+
+class AerospikeDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Package install, SC-namespace config, roster-set + recluster
+    (aerospike/support.clj:213-280)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing aerospike", node)
+        os_setup.install(["aerospike-server-community", "aerospike-tools"])
+        cu.write_file(config(test, node), CONF)
+        control.exec_("service", "aerospike", "restart")
+        cu.await_tcp_port(PORT, host=node, timeout_s=300.0)
+        core.synchronize(test, timeout_s=600.0)
+        primary = (test.get("nodes") or [node])[0]
+        if node == primary:
+            # strong-consistency roster: observe → set → recluster
+            # (support.clj:135-211), through our own info protocol
+            conn = AerospikeConnection(node, PORT, namespace=NAMESPACE,
+                                       timeout_s=30.0)
+            try:
+                cmd = f"roster:namespace={NAMESPACE}"
+                reply = conn.info(cmd).get(cmd, "")
+                observed = ""
+                for part in reply.split(":"):
+                    if part.startswith("observed_nodes="):
+                        observed = part.split("=", 1)[1]
+                conn.info(f"roster-set:namespace={NAMESPACE};"
+                          f"nodes={observed}")
+                conn.info("recluster:")
+            finally:
+                conn.close()
+        core.synchronize(test, timeout_s=600.0)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf("/opt/aerospike/data")
+
+    def start(self, test, node):
+        control.exec_("service", "aerospike", "start")
+
+    def kill(self, test, node):
+        control.exec_(control.lit(
+            "service aerospike stop >/dev/null 2>&1 || true"))
+        cu.grepkill("asd")
+
+    def pause(self, test, node):
+        cu.grepkill("asd", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("asd", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class AerospikeClient(Client):
+    """Generation-CAS register client (aerospike/cas_register.clj)."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: AerospikeConnection | None = None
+
+    def open(self, test, node):
+        c = AerospikeClient(self.timeout_s, node)
+        c.conn = AerospikeConnection(node, PORT, namespace=NAMESPACE,
+                                     set_name=SET_NAME,
+                                     timeout_s=self.timeout_s)
+        return c
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read":
+                k, _ = v
+                value, _gen = self.conn.get(int(k))
+                return {**op, "type": "ok", "value": [k, value]}
+            if f == "write":
+                k, val = v
+                self.conn.put(int(k), int(val))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                value, gen = self.conn.get(int(k))
+                if value != old:
+                    return {**op, "type": "fail", "error": ["value-mismatch"]}
+                applied = self.conn.put(int(k), int(new), generation=gen)
+                return {**op, "type": "ok" if applied else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except AerospikeError as e:
+            # server-side rejection with a result code: the op did not
+            # apply (unavailable partitions in SC mode return codes too)
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["aerospike", e.code]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("register",)
+
+
+def aerospike_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="aerospike",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": AerospikeDB(),
+                             "client": AerospikeClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(aerospike_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-aerospike")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

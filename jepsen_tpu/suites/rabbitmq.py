@@ -1,0 +1,187 @@
+"""RabbitMQ test suite (reference: rabbitmq/src/jepsen/rabbitmq.clj —
+a mirrored durable queue under partitions, the analysis that first
+demonstrated RabbitMQ losing acknowledged messages).
+
+The client rides the bundled AMQP 0-9-1 wire implementation
+(``_amqp.py``): enqueues publish persistent messages in publisher-
+confirm mode and only report ``ok`` once the broker acks the confirm
+(rabbitmq.clj:155-165); dequeues are ``basic.get`` + explicit ack,
+with an empty queue a definite ``fail``; drain loops dequeue until
+empty (rabbitmq.clj:105-117,167-172). Checked with total-queue
+multiset algebra.
+
+DB automation per rabbitmq.clj:24-101: install the server, share one
+erlang cookie, stop_app/join_cluster/start_app every node onto node 1,
+then mirror ``jepsen.``-prefixed queues across 3 nodes with ha-mode
+"exactly" + automatic sync.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites._amqp import AmqpConnection, AmqpError
+
+logger = logging.getLogger("jepsen.rabbitmq")
+
+PORT = 5672
+QUEUE = "jepsen.queue"
+COOKIE = "jepsen-rabbitmq"
+MIRROR_POLICY = ('{"ha-mode": "exactly", "ha-params": 3, '
+                 '"ha-sync-mode": "automatic"}')
+
+
+class RabbitMQDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Cookie-shared cluster join + mirroring policy
+    (rabbitmq.clj:24-101)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing rabbitmq", node)
+        os_setup.install(["rabbitmq-server"])
+        # one cookie for the whole cluster (rabbitmq.clj:42-50)
+        control.exec_(control.lit(
+            "service rabbitmq-server stop >/dev/null 2>&1 || true"))
+        control.exec_("sh", "-c",
+                      f"echo {COOKIE} > /var/lib/rabbitmq/.erlang.cookie")
+        control.exec_("chown", "rabbitmq:rabbitmq",
+                      "/var/lib/rabbitmq/.erlang.cookie")
+        control.exec_("chmod", "600", "/var/lib/rabbitmq/.erlang.cookie")
+        control.exec_("service", "rabbitmq-server", "start")
+        primary = (test.get("nodes") or [node])[0]
+        if node != primary:
+            control.exec_("rabbitmqctl", "stop_app")
+        core.synchronize(test, timeout_s=600.0)
+        if node != primary:
+            control.exec_("rabbitmqctl", "join_cluster", f"rabbit@{primary}")
+            control.exec_("rabbitmqctl", "start_app")
+        core.synchronize(test, timeout_s=600.0)
+        # mirror jepsen.* queues across 3 nodes (rabbitmq.clj:82-88)
+        control.exec_("rabbitmqctl", "set_policy", "ha-maj", "jepsen.",
+                      MIRROR_POLICY)
+        cu.await_tcp_port(PORT, host=node, timeout_s=120.0)
+
+    def teardown(self, test, node):
+        # the reference nukes the beam VM and mnesia (rabbitmq.clj:91-101)
+        cu.grepkill("beam.smp")
+        cu.grepkill("epmd")
+        cu.rm_rf("/var/lib/rabbitmq/mnesia/")
+        control.exec_(control.lit(
+            "service rabbitmq-server stop >/dev/null 2>&1 || true"))
+
+    def start(self, test, node):
+        control.exec_("service", "rabbitmq-server", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("beam.smp")
+
+    def pause(self, test, node):
+        cu.grepkill("beam.smp", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("beam.smp", sig="CONT")
+
+    def log_files(self, test, node):
+        return ["/var/log/rabbitmq/rabbit.log"]
+
+
+class RabbitMQClient(Client):
+    """Queue ops over AMQP with publisher confirms."""
+
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None):
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: AmqpConnection | None = None
+
+    def open(self, test, node):
+        c = RabbitMQClient(self.timeout_s, node)
+        c.conn = AmqpConnection(node, PORT, timeout_s=self.timeout_s)
+        # confirm mode is per-channel and sticky — select once here
+        # (also covers interpreter reopens, which skip setup())
+        c.conn.confirm_select()
+        return c
+
+    def setup(self, test):
+        self.conn.queue_declare(QUEUE, durable=True)
+
+    def _dequeue_one(self):
+        got = self.conn.get(QUEUE)
+        if got is None:
+            return None
+        tag, body = got
+        value = int(body.decode())
+        # even if the ack is lost the message is redelivered — dequeue
+        # delivery already happened (the reference's auto-ack rationale,
+        # rabbitmq.clj:105-110)
+        try:
+            self.conn.ack(tag)
+        except (AmqpError, OSError):
+            pass
+        return value
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "enqueue":
+                confirmed = self.conn.publish(QUEUE, str(v).encode(),
+                                              mandatory=True,
+                                              persistent=True)
+                return {**op, "type": "ok" if confirmed else "fail"}
+            if f == "dequeue":
+                value = self._dequeue_one()
+                if value is None:
+                    return {**op, "type": "fail", "error": ["empty"]}
+                return {**op, "type": "ok", "value": value}
+            if f == "drain":
+                drained: list = []
+                try:
+                    while True:
+                        value = self._dequeue_one()
+                        if value is None:
+                            return {**op, "type": "ok", "value": drained}
+                        drained.append(value)
+                except (AmqpError, TimeoutError, ConnectionError,
+                        OSError) as e:
+                    # partial drains carry what was definitely consumed
+                    return {**op, "type": "info", "value": drained,
+                            "error": ["net", str(e)]}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except AmqpError as e:
+            kind = "fail" if f == "dequeue" else "info"
+            return {**op, "type": kind, "error": ["amqp", e.code, e.text]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            # an enqueue without a confirm is indeterminate; a dequeue
+            # that died pre-delivery is redelivered later → fail is safe
+            kind = "fail" if f == "dequeue" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("queue",)
+
+
+def rabbitmq_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="rabbitmq",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {"db": RabbitMQDB(),
+                             "client": RabbitMQClient(), "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(rabbitmq_test),
+    standard_opt_fn(SUPPORTED_WORKLOADS),
+    name="jepsen-rabbitmq")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""RethinkDB test suite (reference: rethinkdb/src/jepsen/rethinkdb.clj
++ rethinkdb/document_cas.clj — a document store whose per-document
+atomic update enables a linearizable CAS register, tested across
+write-ack/read-mode combinations).
+
+The client rides the bundled ReQL wire driver (``_reql.py``). Register
+ops follow document_cas.clj:71-105: read is ``get(k)["val"].default
+(nil)`` at the configured read mode ("majority" for linearizable
+reads); write is an insert with ``conflict: update``; CAS runs the
+atomic update lambda ``branch(eq(row["val"], old), {"val": new},
+error("abort"))`` and succeeds iff exactly one row reports
+``replaced`` with zero errors.
+
+DB automation per rethinkdb.clj:52-95: apt repo install, a config file
+with ``join=`` lines for every peer, service start.
+"""
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu.client import Client
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.os_setup import Debian
+from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
+                               standard_test_fn)
+from jepsen_tpu.suites import _reql as r
+from jepsen_tpu.suites._reql import ReqlConnection, ReqlError
+
+logger = logging.getLogger("jepsen.rethinkdb")
+
+DRIVER_PORT = 28015
+CLUSTER_PORT = 29015
+DB_NAME = "jepsen"
+TABLE = "cas"
+CAS_ABORT_SENTINEL = "jepsen-cas-precondition-abort"
+CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
+LOG_FILE = "/var/log/rethinkdb"
+
+
+def config(test: dict, node: str) -> str:
+    """Config with join= lines for every peer (rethinkdb.clj:67-87)."""
+    lines = ["bind=all",
+             f"server-name={node}",
+             f"directory=/var/lib/rethinkdb/jepsen"]
+    lines += [f"join={n}:{CLUSTER_PORT}" for n in (test.get("nodes") or [])
+              if n != node]
+    return "\n".join(lines) + "\n"
+
+
+class RethinkDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """Apt install + join-configured service (rethinkdb.clj:52-95)."""
+
+    def setup(self, test, node):
+        from jepsen_tpu import core, os_setup
+        logger.info("%s: installing rethinkdb", node)
+        os_setup.install(["rethinkdb"])
+        cu.mkdir("/etc/rethinkdb/instances.d")
+        cu.write_file(config(test, node), CONF)
+        control.exec_("service", "rethinkdb", "restart")
+        cu.await_tcp_port(DRIVER_PORT, host=node, timeout_s=300.0)
+        core.synchronize(test, timeout_s=600.0)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.rm_rf("/var/lib/rethinkdb/jepsen")
+
+    def start(self, test, node):
+        control.exec_("service", "rethinkdb", "start")
+
+    def kill(self, test, node):
+        control.exec_(control.lit(
+            "service rethinkdb stop >/dev/null 2>&1 || true"))
+        cu.grepkill("rethinkdb")
+
+    def pause(self, test, node):
+        cu.grepkill("rethinkdb", sig="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("rethinkdb", sig="CONT")
+
+    def log_files(self, test, node):
+        return [LOG_FILE]
+
+
+class RethinkDBClient(Client):
+    """Document-CAS register client (document_cas.clj:40-105)."""
+
+    def __init__(self, write_acks: str = "majority",
+                 read_mode: str = "majority", timeout_s: float = 10.0,
+                 node: str | None = None):
+        self.write_acks = write_acks
+        self.read_mode = read_mode
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: ReqlConnection | None = None
+
+    def open(self, test, node):
+        c = RethinkDBClient(self.write_acks, self.read_mode,
+                            self.timeout_s, node)
+        c.conn = ReqlConnection(node, DRIVER_PORT, timeout_s=self.timeout_s)
+        return c
+
+    def setup(self, test):
+        try:
+            self.conn.run(r.db_create(DB_NAME))
+        except ReqlError:
+            pass  # already exists
+        try:
+            self.conn.run(r.table_create(
+                r.db(DB_NAME), TABLE,
+                replicas=len(test.get("nodes") or []) or None))
+        except ReqlError:
+            pass
+        # table-level write acks (document_cas.clj set-write-acks!)
+        try:
+            self.conn.run([r.UPDATE, [
+                [r.TABLE, [[r.DB, ["rethinkdb"]], "table_config"]],
+                {"write_acks": self.write_acks}]])
+        except ReqlError:
+            pass
+
+    def _row(self, k):
+        return r.get(r.table(r.db(DB_NAME), TABLE,
+                             read_mode=self.read_mode), int(k))
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        try:
+            if f == "read":
+                k, _ = v
+                out = self.conn.run(
+                    r.default(r.get_field(self._row(k), "val"), None))
+                return {**op, "type": "ok",
+                        "value": [k, int(out) if out is not None else None]}
+            if f == "write":
+                k, val = v
+                self.conn.run(r.insert(
+                    r.table(r.db(DB_NAME), TABLE),
+                    {"id": int(k), "val": int(val)}, conflict="update"))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                res = self.conn.run(r.update(
+                    self._row(k),
+                    r.func(r.branch(
+                        r.eq(r.get_field(r.var(1), "val"), int(old)),
+                        {"val": int(new)},
+                        r.error(CAS_ABORT_SENTINEL)))))
+                ok = (isinstance(res, dict) and res.get("errors") == 0
+                      and res.get("replaced") == 1)
+                return {**op, "type": "ok" if ok else "fail"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except ReqlError as e:
+            # reads are safe to fail; the CAS lambda's own unique abort
+            # sentinel is a definite precondition miss; any other runtime
+            # error on a write/cas (e.g. "lost contact with primary
+            # replica") is indeterminate (document_cas.clj with-errors
+            # #{:read}) — a generic substring match would misclassify
+            # server messages that merely mention "abort"
+            if f == "read" or any(CAS_ABORT_SENTINEL in str(m)
+                                  for m in (e.messages or [])):
+                return {**op, "type": "fail", "error": ["reql", str(e)]}
+            return {**op, "type": "info", "error": ["reql", str(e)]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("register",)
+
+
+def rethinkdb_test(opts_dict: dict | None = None) -> dict:
+    return build_suite_test(
+        opts_dict, db_name="rethinkdb",
+        supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=lambda o: {
+            "db": RethinkDB(),
+            "client": RethinkDBClient(o.get("write_acks", "majority"),
+                                      o.get("read_mode", "majority")),
+            "os": Debian()})
+
+
+main = cli.single_test_cmd(
+    standard_test_fn(rethinkdb_test, extra_keys=("write_acks", "read_mode")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: (
+                        p.add_argument("--write-acks", dest="write_acks",
+                                       default="majority",
+                                       choices=["single", "majority"]),
+                        p.add_argument("--read-mode", dest="read_mode",
+                                       default="majority",
+                                       choices=["single", "majority",
+                                                "outdated"]))),
+    name="jepsen-rethinkdb")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,424 @@
+"""Wire-protocol tests for the rabbitmq (AMQP 0-9-1), rethinkdb (ReQL),
+and aerospike suites: each client is exercised against a scripted
+stub server speaking the real framing, plus digest/codec unit tests
+and fake-mode lifecycle runs."""
+import json
+import socket
+import struct
+import threading
+
+from jepsen_tpu.suites import aerospike, rabbitmq, rethinkdb
+from jepsen_tpu.suites import _amqp, _reql
+from jepsen_tpu.suites._aerospike import key_digest, ripemd160
+
+from conftest import run_fake  # noqa: E402
+
+
+def serve_once(handler, want_thread=False):
+    """Starts a one-connection stub server; returns its port (and the
+    server thread when want_thread, so tests can join before asserting
+    on state the handler writes after the client's last await)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def go():
+        conn, _ = srv.accept()
+        try:
+            handler(conn)
+        finally:
+            conn.close()
+            srv.close()
+
+    thread = threading.Thread(target=go, daemon=True)
+    thread.start()
+    return (port, thread) if want_thread else port
+
+
+# ---------------------------------------------------------------------------
+# AMQP 0-9-1
+# ---------------------------------------------------------------------------
+
+def amqp_frame(ftype, channel, payload):
+    return (struct.pack(">BHI", ftype, channel, len(payload)) + payload
+            + b"\xce")
+
+
+def amqp_method(channel, cm, args=b""):
+    return amqp_frame(1, channel, struct.pack(">HH", *cm) + args)
+
+
+def read_amqp_frame(f):
+    ftype, channel, size = struct.unpack(">BHI", f.read(7))
+    payload = f.read(size)
+    assert f.read(1) == b"\xce"
+    return ftype, channel, payload
+
+
+def test_amqp_connect_publish_confirm_get():
+    """Full AMQP conversation: negotiate, declare, publish-with-confirm,
+    get + ack, against a scripted broker."""
+    received = {}
+
+    def broker(conn):
+        f = conn.makefile("rb")
+        assert f.read(8) == b"AMQP\x00\x00\x09\x01"
+        conn.sendall(amqp_method(0, _amqp.CONN_START,
+                                 bytes([0, 9]) + b"\x00\x00\x00\x00"
+                                 + _amqp.longstr(b"PLAIN")
+                                 + _amqp.longstr(b"en_US")))
+        _, _, payload = read_amqp_frame(f)          # start-ok
+        assert payload[:4] == struct.pack(">HH", *_amqp.CONN_START_OK)
+        received["auth"] = payload
+        conn.sendall(amqp_method(0, _amqp.CONN_TUNE,
+                                 struct.pack(">HIH", 2047, 131072, 60)))
+        read_amqp_frame(f)                          # tune-ok
+        read_amqp_frame(f)                          # connection.open
+        conn.sendall(amqp_method(0, _amqp.CONN_OPEN_OK, _amqp.shortstr("")))
+        read_amqp_frame(f)                          # channel.open
+        conn.sendall(amqp_method(1, _amqp.CHAN_OPEN_OK,
+                                 _amqp.longstr(b"")))
+        # queue.declare
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.QUEUE_DECLARE_OK,
+                                 _amqp.shortstr("jepsen.queue")
+                                 + struct.pack(">II", 0, 0)))
+        # confirm.select
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.CONFIRM_SELECT_OK))
+        # basic.publish + header + body → confirm with basic.ack
+        read_amqp_frame(f)                          # publish method
+        _, _, header = read_amqp_frame(f)           # content header
+        body_size = struct.unpack(">Q", header[4:12])[0]
+        _, _, body = read_amqp_frame(f)             # body
+        received["body"] = body
+        assert len(body) == body_size
+        conn.sendall(amqp_method(1, _amqp.BASIC_ACK,
+                                 struct.pack(">QB", 1, 0)))
+        # basic.get → get-ok + header + body; then client basic.ack
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.BASIC_GET_OK,
+                                 struct.pack(">Q", 7) + b"\x00"
+                                 + _amqp.shortstr("")
+                                 + _amqp.shortstr("jepsen.queue")
+                                 + struct.pack(">I", 0)))
+        conn.sendall(amqp_frame(2, 1, struct.pack(">HHQH", 60, 0, 2, 0)))
+        conn.sendall(amqp_frame(3, 1, b"42"))
+        _, _, ack = read_amqp_frame(f)
+        received["ack_tag"] = struct.unpack(
+            ">Q", ack[4:12])[0]
+
+    port, thread = serve_once(broker, want_thread=True)
+    c = _amqp.AmqpConnection("127.0.0.1", port)
+    assert b"PLAIN" in received["auth"]
+    assert b"\x00guest\x00guest" in received["auth"]
+    c.queue_declare("jepsen.queue")
+    c.confirm_select()
+    assert c.publish("jepsen.queue", b"42") is True
+    got = c.get("jepsen.queue")
+    assert got is not None
+    tag, body = got
+    assert tag == 7 and body == b"42"
+    c.ack(tag)
+    thread.join(timeout=10)  # ack is fire-and-forget; let the broker read it
+    c.close()
+    assert received["body"] == b"42"
+    assert received["ack_tag"] == 7
+
+
+def test_amqp_channel_close_raises():
+    def broker(conn):
+        f = conn.makefile("rb")
+        f.read(8)
+        conn.sendall(amqp_method(0, _amqp.CONN_START,
+                                 bytes([0, 9]) + b"\x00\x00\x00\x00"
+                                 + _amqp.longstr(b"PLAIN")
+                                 + _amqp.longstr(b"en_US")))
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(0, _amqp.CONN_TUNE,
+                                 struct.pack(">HIH", 0, 131072, 0)))
+        read_amqp_frame(f)
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(0, _amqp.CONN_OPEN_OK, _amqp.shortstr("")))
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.CHAN_OPEN_OK, _amqp.longstr(b"")))
+        # respond to queue.declare with channel.close 404
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.CHAN_CLOSE,
+                                 struct.pack(">H", 404)
+                                 + _amqp.shortstr("NOT_FOUND")
+                                 + struct.pack(">HH", 50, 10)))
+        read_amqp_frame(f)  # client's close-ok
+
+    port = serve_once(broker)
+    c = _amqp.AmqpConnection("127.0.0.1", port)
+    import pytest
+    with pytest.raises(_amqp.AmqpError) as ei:
+        c.queue_declare("nope")
+    assert ei.value.code == 404
+    c.close()
+
+
+def test_rabbitmq_fake_queue_run():
+    result = run_fake(rabbitmq.rabbitmq_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# ReQL
+# ---------------------------------------------------------------------------
+
+def test_reql_handshake_and_query():
+    received = {}
+
+    def server(conn):
+        f = conn.makefile("rb")
+        magic = struct.unpack("<I", f.read(4))[0]
+        assert magic == _reql.V0_4
+        key_len = struct.unpack("<I", f.read(4))[0]
+        f.read(key_len)
+        proto = struct.unpack("<I", f.read(4))[0]
+        assert proto == _reql.PROTOCOL_JSON
+        conn.sendall(b"SUCCESS\x00")
+        token, size = struct.unpack("<QI", f.read(12))
+        received["query"] = json.loads(f.read(size).decode())
+        resp = json.dumps({"t": _reql.SUCCESS_ATOM, "r": [4]}).encode()
+        conn.sendall(struct.pack("<QI", token, len(resp)) + resp)
+
+    port = serve_once(server)
+    c = _reql.ReqlConnection("127.0.0.1", port)
+    term = _reql.default(
+        _reql.get_field(
+            _reql.get(_reql.table(_reql.db("jepsen"), "cas",
+                                  read_mode="majority"), 5), "val"), None)
+    out = c.run(term)
+    assert out == 4
+    c.close()
+    qtype, qterm, _opts = received["query"]
+    assert qtype == _reql.START
+    # DEFAULT(GET_FIELD(GET(TABLE(DB(jepsen), cas, read_mode), 5), val))
+    assert qterm[0] == _reql.DEFAULT
+    assert qterm[1][0][0] == _reql.GET_FIELD
+    table_term = qterm[1][0][1][0][1][0]
+    assert table_term[0] == _reql.TABLE
+    assert table_term[2] == {"read_mode": "majority"}
+
+
+def test_reql_runtime_error_raises():
+    def server(conn):
+        f = conn.makefile("rb")
+        f.read(4)
+        key_len = struct.unpack("<I", f.read(4))[0]
+        f.read(key_len)
+        f.read(4)
+        conn.sendall(b"SUCCESS\x00")
+        token, size = struct.unpack("<QI", f.read(12))
+        f.read(size)
+        resp = json.dumps({"t": _reql.RUNTIME_ERROR,
+                           "r": ["abort"]}).encode()
+        conn.sendall(struct.pack("<QI", token, len(resp)) + resp)
+
+    port = serve_once(server)
+    c = _reql.ReqlConnection("127.0.0.1", port)
+    import pytest
+    with pytest.raises(_reql.ReqlError):
+        c.run(_reql.db("x"))
+    c.close()
+
+
+def test_rethinkdb_cas_term_shape():
+    """The CAS update lambda must be branch(eq(row.val, old), {...},
+    error) wrapped in func (document_cas.clj:95-105)."""
+    sent = []
+
+    class FakeConn:
+        def run(self, term):
+            sent.append(term)
+            return {"errors": 0, "replaced": 1}
+
+    c = rethinkdb.RethinkDBClient(node="n1")
+    c.conn = FakeConn()
+    out = c.invoke({}, {"f": "cas", "type": "invoke", "value": [1, (4, 5)]})
+    assert out["type"] == "ok"
+    update_term = sent[0]
+    assert update_term[0] == _reql.UPDATE
+    func_term = update_term[1][1]
+    assert func_term[0] == _reql.FUNC
+    branch_term = func_term[1][1]
+    assert branch_term[0] == _reql.BRANCH
+    assert branch_term[1][0][0] == _reql.EQ          # eq(row.val, 4)
+    assert branch_term[1][1] == {"val": 5}
+    assert branch_term[1][2][0] == _reql.ERROR
+
+
+def test_rethinkdb_cas_not_replaced_is_fail():
+    class FakeConn:
+        def run(self, term):
+            return {"errors": 1, "replaced": 0,
+                    "first_error": "abort"}
+
+    c = rethinkdb.RethinkDBClient(node="n1")
+    c.conn = FakeConn()
+    out = c.invoke({}, {"f": "cas", "type": "invoke", "value": [1, (4, 5)]})
+    assert out["type"] == "fail"
+
+
+def test_rethinkdb_fake_register_run():
+    result = run_fake(rethinkdb.rethinkdb_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# Aerospike
+# ---------------------------------------------------------------------------
+
+def test_ripemd160_vectors():
+    """Published RIPEMD-160 test vectors (Dobbertin et al.)."""
+    assert ripemd160(b"").hex() == \
+        "9c1185a5c5e9fc54612808977ee8f548b2258d31"
+    assert ripemd160(b"abc").hex() == \
+        "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+    assert ripemd160(b"message digest").hex() == \
+        "5d0689ef49d2fae572b881b123a85ffa21595f36"
+    assert ripemd160(b"a" * 1000000).hex() == \
+        "52783243c1697bdbe16d37f97f68f08325dc1528"
+
+
+def test_aerospike_key_digest_deterministic():
+    d1 = key_digest("registers", 5)
+    assert len(d1) == 20
+    assert d1 == key_digest("registers", 5)
+    assert d1 != key_digest("registers", 6)
+    assert d1 != key_digest("other", 5)
+
+
+def test_aerospike_message_roundtrip():
+    """get/put against a scripted server speaking the message framing."""
+    received = []
+
+    def server(conn):
+        for reply_payload in (
+                # put reply: header-only message, rc=0
+                struct.pack(">BBBBBBIIIHH", 22, 0, 0, 0, 0, 0, 3, 0, 0,
+                            0, 0),
+                # get reply: rc=0, generation=3, one op with int value 9
+                struct.pack(">BBBBBBIIIHH", 22, 0, 0, 0, 0, 0, 3, 0, 0,
+                            0, 1)
+                + struct.pack(">IBBBB", 4 + 5 + 8, 1, 1, 0, 5) + b"value"
+                + struct.pack(">q", 9)):
+            header = conn.recv(8)
+            size = struct.unpack(">Q", header)[0] & 0xFFFFFFFFFFFF
+            buf = b""
+            while len(buf) < size:
+                buf += conn.recv(size - len(buf))
+            received.append(buf)
+            out = struct.pack(">Q", (2 << 56) | (3 << 48)
+                              | len(reply_payload)) + reply_payload
+            conn.sendall(out)
+
+    port = serve_once(server)
+    c = aerospike.AerospikeConnection(
+        "127.0.0.1", port, namespace="jepsen", set_name="registers")
+    assert c.put(5, 7) is True
+    value, gen = c.get(5)
+    assert value == 9 and gen == 3
+    c.close()
+    # the put message carried namespace/set/digest fields + one write op
+    put_msg = received[0]
+    assert b"jepsen" in put_msg and b"registers" in put_msg
+    assert key_digest("registers", 5) in put_msg
+    assert b"value" in put_msg
+
+
+def test_aerospike_gen_cas_fail():
+    """A GENERATION_ERROR result maps to an unapplied CAS."""
+    def server(conn):
+        while True:
+            header = conn.recv(8)
+            if not header:
+                return
+            size = struct.unpack(">Q", header)[0] & 0xFFFFFFFFFFFF
+            buf = b""
+            while len(buf) < size:
+                buf += conn.recv(size - len(buf))
+            payload = struct.pack(">BBBBBBIIIHH", 22, 0, 0, 0, 0,
+                                  3,  # rc=3: GENERATION_ERROR
+                                  0, 0, 0, 0, 0)
+            conn.sendall(struct.pack(">Q", (2 << 56) | (3 << 48)
+                                     | len(payload)) + payload)
+
+    port = serve_once(server)
+    c = aerospike.AerospikeConnection("127.0.0.1", port)
+    assert c.put(1, 2, generation=5) is False    # generation mismatch
+    c.close()
+
+
+def test_aerospike_fake_register_run():
+    result = run_fake(aerospike.aerospike_test)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_registry_covers_all_reference_suites():
+    from jepsen_tpu.suites import suite_registry
+    assert {"rabbitmq", "rethinkdb", "aerospike"} <= set(suite_registry())
+
+
+def test_aerospike_info_protocol():
+    def server(conn):
+        header = conn.recv(8)
+        size = struct.unpack(">Q", header)[0] & 0xFFFFFFFFFFFF
+        req = b""
+        while len(req) < size:
+            req += conn.recv(size - len(req))
+        assert req == b"roster:namespace=jepsen\n"
+        reply = (b"roster:namespace=jepsen\t"
+                 b"roster=null:observed_nodes=BB9,BB8\n")
+        conn.sendall(struct.pack(">Q", (2 << 56) | (1 << 48) | len(reply))
+                     + reply)
+
+    port = serve_once(server)
+    c = aerospike.AerospikeConnection("127.0.0.1", port)
+    out = c.info("roster:namespace=jepsen")
+    assert out["roster:namespace=jepsen"].endswith("observed_nodes=BB9,BB8")
+    c.close()
+
+
+def test_amqp_empty_body_basic_return_keeps_sync():
+    """A mandatory-unroutable publish with an EMPTY body sends a return
+    + header with body-size 0 and NO body frame; the confirm loop must
+    not consume the following basic.ack as if it were the body."""
+    def broker(conn):
+        f = conn.makefile("rb")
+        f.read(8)
+        conn.sendall(amqp_method(0, _amqp.CONN_START,
+                                 bytes([0, 9]) + b"\x00\x00\x00\x00"
+                                 + _amqp.longstr(b"PLAIN")
+                                 + _amqp.longstr(b"en_US")))
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(0, _amqp.CONN_TUNE,
+                                 struct.pack(">HIH", 0, 131072, 0)))
+        read_amqp_frame(f)
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(0, _amqp.CONN_OPEN_OK, _amqp.shortstr("")))
+        read_amqp_frame(f)
+        conn.sendall(amqp_method(1, _amqp.CHAN_OPEN_OK, _amqp.longstr(b"")))
+        read_amqp_frame(f)                         # publish
+        read_amqp_frame(f)                         # header
+        # empty body → no body frame from client either; now return it:
+        conn.sendall(amqp_method(1, _amqp.BASIC_RETURN,
+                                 struct.pack(">H", 312)
+                                 + _amqp.shortstr("NO_ROUTE")
+                                 + _amqp.shortstr("")
+                                 + _amqp.shortstr("jepsen.queue")))
+        conn.sendall(amqp_frame(2, 1, struct.pack(">HHQH", 60, 0, 0, 0)))
+        # no body frame — straight to the confirm ack
+        conn.sendall(amqp_method(1, _amqp.BASIC_ACK,
+                                 struct.pack(">QB", 1, 0)))
+
+    port = serve_once(broker)
+    c = _amqp.AmqpConnection("127.0.0.1", port)
+    # returned (unroutable) → publish reports False, and the connection
+    # stays frame-aligned (no hang, no misparse)
+    assert c.publish("jepsen.queue", b"") is False
+    c.close()
