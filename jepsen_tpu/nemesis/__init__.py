@@ -403,6 +403,51 @@ def hammer_time(targeter=None, process: str = "") -> Nemesis:
     return NodeStartStopper(targeter, start, stop, "start-pause", "stop-pause")
 
 
+def set_time(test, node, unix_seconds: float) -> None:
+    """Sets the wall clock on a node via ``date`` (nemesis.clj:430-433
+    set-time!) — the coarse sibling of the compiled bump-time utility
+    (nemesis/time.py)."""
+    from jepsen_tpu import control
+    control.on(node, test,
+               lambda: control.exec_("date", "-s", f"@{int(unix_seconds)}"))
+
+
+class ClockScrambler(Nemesis):
+    """Randomizes node clocks within ±limit seconds of now
+    (nemesis.clj:435-450); teardown restores approximately-correct
+    time. The C-utility ClockNemesis (nemesis/time.py) is the precise
+    replacement; this is the reference's original coarse scrambler."""
+
+    def __init__(self, limit_s: int):
+        self.limit_s = limit_s
+
+    def fs(self):
+        return {"scramble-clock"}
+
+    def invoke(self, test, op):
+        import time as _time
+        from jepsen_tpu.utils import real_pmap
+        nodes = op.get("value") or list(test.get("nodes") or [])
+
+        def scramble(node):
+            offset = random.randint(-self.limit_s, self.limit_s)
+            set_time(test, node, _time.time() + offset)
+            return offset
+        offsets = real_pmap(scramble, nodes)
+        return {**op, "type": "info",
+                "value": dict(zip(nodes, offsets))}
+
+    def teardown(self, test):
+        import time as _time
+        from jepsen_tpu.utils import real_pmap
+        real_pmap(lambda n: set_time(test, n, _time.time()),
+                  list(test.get("nodes") or []))
+
+
+def clock_scrambler(limit_s: int) -> Nemesis:
+    return ClockScrambler(limit_s)
+
+
 class TruncateFile(Nemesis):
     """Truncates a file on targeted nodes by a random number of bytes
     (nemesis.clj:513-539)."""

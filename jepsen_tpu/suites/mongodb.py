@@ -35,12 +35,24 @@ PIDFILE = f"{DIR}/mongod.pid"
 
 class MongoDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
               db_mod.LogFiles):
-    """Replica-set mongod lifecycle (reference mongodb/core.clj)."""
+    """Replica-set mongod lifecycle (reference mongodb/core.clj).
+
+    ``storage_engine`` covers the reference's per-engine suite variants:
+    the mongodb-rocks/ suite is this deployment with the rocksdb engine,
+    mongodb-smartos/ pairs the default engine with the SmartOS OS layer
+    (os_setup.SmartOS)."""
+
+    def __init__(self, storage_engine: str | None = None):
+        self.storage_engine = storage_engine
 
     def setup(self, test, node):
         logger.info("%s: installing mongod", node)
         from jepsen_tpu import os_setup
-        os_setup.install(["mongodb-org-server", "mongodb-mongosh"])
+        if isinstance(test.get("os"), os_setup.SmartOS):
+            # the mongodb-smartos variant: pkgin, not apt
+            control.exec_("pkgin", "-y", "install", "mongodb")
+        else:
+            os_setup.install(["mongodb-org-server", "mongodb-mongosh"])
         cu.mkdir(DATA_DIR)
         self.start(test, node)
         cu.await_tcp_port(PORT, host=node)
@@ -61,14 +73,15 @@ class MongoDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
         cu.rm_rf(LOG_FILE)
 
     def start(self, test, node):
+        args = ["--replSet", RS_NAME,
+                "--dbpath", DATA_DIR,
+                "--port", str(PORT),
+                "--bind_ip_all"]
+        if self.storage_engine:
+            args += ["--storageEngine", self.storage_engine]
         return cu.start_daemon(
             {"logfile": LOG_FILE, "pidfile": PIDFILE, "chdir": DIR},
-            "mongod",
-            "--replSet", RS_NAME,
-            "--dbpath", DATA_DIR,
-            "--port", str(PORT),
-            "--bind_ip_all",
-        )
+            "mongod", *args)
 
     def kill(self, test, node):
         cu.stop_daemon("mongod", PIDFILE)
@@ -164,16 +177,29 @@ SUPPORTED_WORKLOADS = ("register", "set")
 
 
 def mongodb_test(opts_dict: dict | None = None) -> dict:
+    def make_real(o):
+        from jepsen_tpu import os_setup
+        os_cls = (os_setup.SmartOS if o.get("os") == "smartos" else Debian)
+        return {"db": MongoDB(o.get("storage_engine")),
+                "client": MongoClient(), "os": os_cls()}
+
     return build_suite_test(
         opts_dict, db_name="mongodb",
-        supported_workloads=SUPPORTED_WORKLOADS,
-        make_real=lambda o: {"db": MongoDB(), "client": MongoClient(),
-                             "os": Debian()})
+        supported_workloads=SUPPORTED_WORKLOADS, make_real=make_real)
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(mongodb_test),
-    standard_opt_fn(SUPPORTED_WORKLOADS),
+    standard_test_fn(mongodb_test, extra_keys=("storage_engine", "os")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra=lambda p: (
+                        p.add_argument("--storage-engine",
+                                       dest="storage_engine", default=None,
+                                       help="e.g. wiredTiger or rocksdb "
+                                            "(the mongodb-rocks variant)"),
+                        p.add_argument("--os", default="debian",
+                                       choices=["debian", "smartos"],
+                                       help="smartos = the mongodb-smartos "
+                                            "variant"))),
     name="jepsen-mongodb")
 
 

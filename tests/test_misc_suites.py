@@ -189,3 +189,36 @@ def test_fauna_not_found_on_bank_read_is_typed_completion():
     out = TClient(node="n1").invoke(
         {"accounts": [0, 1]}, {"f": "read", "type": "invoke", "value": None})
     assert out["type"] == "fail"  # not a raised TypeError
+
+
+def test_clock_scrambler_commands():
+    from jepsen_tpu import nemesis as nem
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    try:
+        scrambler = nem.clock_scrambler(60).setup(t)
+        out = scrambler.invoke(t, {"f": "scramble-clock", "type": "info",
+                                   "value": ["n1", "n2"]})
+        assert out["type"] == "info"
+        assert set(out["value"]) == {"n1", "n2"}
+        assert all(-60 <= off <= 60 for off in out["value"].values())
+        joined = " ".join(str(x) for x in remote.log)
+        assert "date -s" in joined
+        scrambler.teardown(t)
+    finally:
+        control.disconnect_all(t)
+
+
+def test_mongodb_variants():
+    from jepsen_tpu.suites import mongodb
+    t = {"nodes": NODES, "ssh": {"dummy": True}}
+    remote = control.default_remote(t)
+    try:
+        db = mongodb.MongoDB("rocksdb")
+        control.on("n1", t, lambda: db.start(t, "n1"))
+        joined = " ".join(str(x) for x in remote.log)
+        assert "--storageEngine rocksdb" in joined
+    finally:
+        control.disconnect_all(t)
+    tm = mongodb.mongodb_test({"fake": True})
+    assert tm["generator"] is not None  # variants don't break fake mode
