@@ -80,8 +80,17 @@ class LinearizableChecker(Checker):
                 res = wgl(history, self.model)
             return self._finish(res, history)
 
-        # device path
-        from jepsen_tpu.ops.jitlin import verdict
+        # device path. For long histories over small value domains, the
+        # block-composed transfer-matrix kernel settles the verdict with
+        # far less sequential depth (MXU boolean matmuls over chunks);
+        # the event scan remains the diagnostics path (died-at, peak).
+        from jepsen_tpu.ops.jitlin import matrix_check, verdict
+        m = matrix_check(stream)
+        if m is not None and m[0]:
+            return self._finish(LinearResult(
+                valid=True, failed_event=-1, failed_op_index=-1,
+                configs_max=0, algorithm="jitlin-tpu-matrix"),
+                history)
         alive, died, overflow, peak = self._tpu_kernel().check(
             stream, capacity=self.capacity
         )

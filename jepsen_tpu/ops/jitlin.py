@@ -256,6 +256,229 @@ def _build_dense_step(num_slots: int, num_states: int, step_ids,
     return run
 
 
+def _returns_prepass(kind, slot, f, a, b):
+    """Host pre-pass for the matrix kernel: the per-slot op table and
+    pending mask evolve deterministically from the event stream alone
+    (invokes/returns), independent of the frontier — so each return's
+    (pending set, op table, returning slot) is computable up front.
+    Returns numpy arrays over the R return events."""
+    kind = np.asarray(kind)
+    slot = np.asarray(slot)
+    fabs = np.stack([np.asarray(f), np.asarray(a), np.asarray(b)], axis=1)
+    S = int(slot.max(initial=0)) + 1
+    cur = np.zeros((S, 3), np.int64)
+    pend = np.zeros((S,), bool)
+    r_slot, r_pend, r_ops = [], [], []
+    for i in range(kind.shape[0]):
+        k = int(kind[i])
+        if k == EV_INVOKE:
+            s = int(slot[i])
+            cur[s] = fabs[i]
+            pend[s] = True
+        elif k == EV_RETURN:
+            s = int(slot[i])
+            r_slot.append(s)
+            r_pend.append(pend.copy())
+            r_ops.append(cur.copy())
+            pend[s] = False
+    if not r_slot:
+        return (np.zeros((0,), np.int32), np.zeros((0, S), bool),
+                np.zeros((0, S, 3), np.int64), S)
+    return (np.asarray(r_slot, np.int32), np.stack(r_pend),
+            np.stack(r_ops), S)
+
+
+def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
+                         g_steps: int, n_chunks: int):
+    """Block-composed transfer-matrix variant of the dense scan.
+
+    For each return event, closure-then-kill is a *linear* boolean
+    operator on the flattened [2^S * V] table: closure is (I+L)^S where
+    L = sum_t pend_t * (R_t ⊗ M_t) (R_t the static mask-receiver map for
+    slot t, M_t the op's [V, V] transition), computable with
+    ceil(log2 S) boolean matrix squarings; kill is a row gather+mask.
+    Composing the per-return matrices A_i is associative, so chunks of
+    the history multiply *in parallel* (one lax.scan whose every step
+    advances all chunks by one return — [G, MV, MV] batched matmuls on
+    the MXU) and the G chunk products combine at the end. Sequential
+    depth falls from one step per event to one per chunk-row, which is
+    what makes a single long history fast on TPU; the event-by-event
+    dense scan remains the exact-diagnostics path (died-at event, peak).
+
+    Boolean products ride bf16 inputs with f32 accumulation (counts
+    <= MV = 2^S * V <= 2^12 are exact in f32) and a >0 threshold.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    M = 1 << S
+    MV = M * V
+    G, T = n_chunks, g_steps
+
+    # static tables ------------------------------------------------------
+    r = np.arange(M)
+    receiver = np.zeros((S, M, M), np.float32)  # R_t[r|bit_t, r] for t∉r
+    for t in range(S):
+        src = r[((r >> t) & 1) == 0]
+        receiver[t, src | (1 << t), src] = 1.0
+    rows = np.arange(MV)
+    rr, ww = rows // V, rows % V
+    kill_idx = np.zeros((S, MV), np.int32)
+    kill_mask = np.zeros((S, MV), np.float32)
+    for s in range(S):
+        ok = ((rr >> s) & 1) == 0
+        kill_idx[s] = np.where(ok, (rr | (1 << s)) * V + ww, 0)
+        kill_mask[s] = ok.astype(np.float32)
+    n_sq = 0
+    while (1 << n_sq) < S:
+        n_sq += 1
+    receiver_j = jnp.asarray(receiver, jnp.bfloat16)
+    kill_idx_j = jnp.asarray(kill_idx)
+    kill_mask_j = jnp.asarray(kill_mask, jnp.bfloat16)
+    eye = jnp.eye(MV, dtype=jnp.bfloat16)
+    v_range = jnp.arange(V, dtype=jnp.int32)
+
+    def bmm(x, y):
+        out = jnp.einsum("gij,gjk->gik", x, y,
+                         preferred_element_type=jnp.float32)
+        return (out > 0).astype(jnp.bfloat16)
+
+    def slot_matrices(ops):
+        """[G, S, 3] op table -> [G, S, V, V] transition matrices + oob."""
+        def one(fab):
+            st2, ok = step_ids(v_range, fab[0], fab[1], fab[2])
+            oob = (ok & ((st2 < 0) | (st2 >= V))).any()
+            return (ok[:, None] & (st2[:, None] == v_range[None, :])), oob
+        mt, oob = jax.vmap(jax.vmap(one))(ops)
+        return mt.astype(jnp.bfloat16), oob
+
+    def step(carry, inp):
+        P, inexact = carry
+        pend_g, ops_g, s_g, val_g = inp
+        mt, oob = slot_matrices(ops_g)           # [G, S, V, V]
+        gated = pend_g.astype(jnp.bfloat16)
+        # row = (receiver mask a, NEW state w); col = (source mask b,
+        # OLD state v): L[(a,w),(b,v)] = Σ_t pend_t R_t[a,b] M_t[v,w]
+        L = jnp.einsum("gt,tab,gtvw->gawbv", gated, receiver_j, mt,
+                       preferred_element_type=jnp.float32)
+        B = ((L.reshape(G, MV, MV) + eye[None]) > 0).astype(jnp.bfloat16)
+        for _ in range(n_sq):
+            B = bmm(B, B)                        # (I+L)^(2^k) → closure
+        A = jax.vmap(lambda b, idx, msk: b[idx] * msk[:, None])(
+            B, kill_idx_j[s_g], kill_mask_j[s_g])
+        A = jnp.where(val_g[:, None, None], A, eye[None])
+        return (bmm(A, P),
+                inexact | (oob & pend_g & val_g[:, None]).any()), None
+
+    @jax.jit
+    def run(pend, ops, slots, valid):
+        P0 = jnp.broadcast_to(eye, (G, MV, MV))
+        (P, inexact), _ = lax.scan(step, (P0, jnp.bool_(False)),
+                                   (pend, ops, slots, valid))
+
+        def comb(c, tot):
+            return (jnp.einsum("ij,jk->ik", P[c], tot,
+                               preferred_element_type=jnp.float32)
+                    > 0).astype(jnp.bfloat16)
+        total = lax.fori_loop(0, G, comb, eye)
+        alive = (total[:, init_state] > 0).any()
+        return alive, inexact
+
+    return run
+
+
+# matrix-path applicability: cost is quadratic in MV = 2^S * V (each
+# return becomes an [MV, MV] operator), so the value domain must be small
+# — the realistic register regime (a handful of distinct values), not
+# arbitrary histories. Below MIN_RETURNS the event scan's sequential
+# depth is short enough that composing matrices can't pay for itself.
+MATRIX_MAX_SLOTS = 8
+MATRIX_MAX_STATES = 16
+MATRIX_MIN_RETURNS = 2000
+# per-step [G, MV, MV] f32 intermediates: cap G * MV^2 (~1 GB at f32)
+MATRIX_MAX_ELEMS = 1 << 28
+
+
+def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
+    return (num_states is not None and S <= MATRIX_MAX_SLOTS
+            and num_states <= MATRIX_MAX_STATES
+            and n_returns >= MATRIX_MIN_RETURNS)
+
+
+def matrix_check(stream, step_ids=None, init_state: int = 0,
+                 num_states: int | None = None, force: bool = False):
+    """Fast exact-aliveness check of ONE history via block-composed
+    transfer matrices. Returns (alive, died, overflow, peak) with
+    died=-1/peak=0 placeholders — callers that need the failing event or
+    frontier stats re-run the event scan (only relevant when not alive).
+    Returns None when the matrix regime doesn't apply (``force=True``
+    skips the size gate, for differential tests)."""
+    import jax
+
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    num_states = num_states if num_states is not None else len(stream.intern)
+    kind, slot = np.asarray(stream.kind), np.asarray(stream.slot)
+    # gate BEFORE the O(E) python prepass: everything the gate needs is
+    # computable from cheap array reductions
+    S = int(slot.max(initial=0)) + 1
+    R = int((kind == EV_RETURN).sum())
+    if not force and not matrix_ok(S, num_states, R):
+        return None
+    V = _bucket(num_states, floor=8)
+    if R == 0:
+        return True, -1, False, 0
+    r_slot, r_pend, r_ops, S = _returns_prepass(
+        kind, slot, np.asarray(stream.f), np.asarray(stream.a),
+        np.asarray(stream.b))
+    # chunk layout: G parallel chunks of T returns (padded with identity).
+    # R is bucketed so (T, G) — and therefore the compiled program — is
+    # shared across nearby history lengths; G is capped so the step's
+    # [G, MV, MV] f32 intermediates stay within the element budget.
+    MV = (1 << S) * V
+    rb = _bucket(R, floor=64)
+    G = int(np.clip(rb // 120, 8, 256))
+    G = max(1, min(G, MATRIX_MAX_ELEMS // (MV * MV)))
+    T = -(-rb // G)
+    pad = G * T - R
+    r_slot = np.concatenate([r_slot, np.zeros((pad,), np.int32)])
+    r_pend = np.concatenate([r_pend, np.zeros((pad, S), bool)])
+    r_ops = np.concatenate([r_ops, np.zeros((pad, S, 3), np.int64)])
+    valid = np.concatenate([np.ones((R,), bool), np.zeros((pad,), bool)])
+    # [R] → chunk-major [G, T] → time-major [T, G] for the scan
+    as_tg = lambda x: np.swapaxes(  # noqa: E731
+        x.reshape((G, T) + x.shape[1:]), 0, 1)
+    run = _matrix_cache(S, V, step_ids, init_state, T, G)
+    alive, inexact = run(as_tg(r_pend), as_tg(r_ops), as_tg(r_slot),
+                         as_tg(valid))
+    jax.block_until_ready(alive)
+    return bool(alive), -1, bool(inexact), 0
+
+
+_MATRIX_CACHE: dict = {}
+_DEFAULT_STEP_IDS = None
+
+
+def _default_step_ids():
+    """One shared default spec — a fresh object per call would defeat
+    the id()-keyed compile cache."""
+    global _DEFAULT_STEP_IDS
+    if _DEFAULT_STEP_IDS is None:
+        from jepsen_tpu.models import cas_register_spec
+        _DEFAULT_STEP_IDS = cas_register_spec().step_ids
+    return _DEFAULT_STEP_IDS
+
+
+def _matrix_cache(S, V, step_ids, init_state, T, G):
+    key = (S, V, id(step_ids), init_state, T, G)
+    fn = _MATRIX_CACHE.get(key)
+    if fn is None:
+        fn = _build_matrix_kernel(S, V, step_ids, init_state, T, G)
+        _MATRIX_CACHE[key] = fn
+    return fn
+
+
 # dense-table applicability bounds. Besides the per-axis caps, the closure
 # materializes an [S, 2^S, V] f32 intermediate per batch element, so gate
 # on the product too: S * 2^S * V elements (4 bytes each) must stay under

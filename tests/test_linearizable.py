@@ -221,3 +221,81 @@ def test_dense_and_sparse_kernels_agree():
         assert not bool(dovf)  # dense is exact, never overflows
         assert verdict(bool(da), bool(dovf)) == verdict(bool(sa), bool(sovf)), \
             f"trial {trial}: dense={bool(da)} sparse={bool(sa)}\n{h}"
+
+
+# ---------------------------------------------------------------------------
+# block-composed transfer-matrix kernel (ops/jitlin.matrix_check)
+# ---------------------------------------------------------------------------
+
+def _scan_alive(history):
+    """The event-scan kernel's aliveness for differential comparison."""
+    import jax
+    from jepsen_tpu.checker.linear_encode import (encode_register_ops,
+                                                  pad_streams)
+    from jepsen_tpu.ops.jitlin import JitLinKernel, _bucket
+    stream = encode_register_ops(history)
+    batch = pad_streams([stream], length=_bucket(len(stream)))
+    run = JitLinKernel()._get(max(1, batch["n_slots"]), 256, batched=False,
+                              num_states=len(stream.intern))
+    args = tuple(jax.numpy.asarray(batch[k][0])
+                 for k in ("kind", "slot", "f", "a", "b"))
+    alive, _, _, _ = run(*args)
+    return bool(alive)
+
+
+def test_matrix_kernel_differential_valid():
+    from __graft_entry__ import _register_history  # conftest adds the root
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import matrix_check
+    for n, seed in ((60, 0), (60, 1), (300, 2), (300, 3)):
+        h = _register_history(n, n_procs=4, seed=seed)
+        m = matrix_check(encode_register_ops(h), force=True)
+        assert m is not None
+        assert m[0] == _scan_alive(h) is True, (n, seed)
+
+
+def test_matrix_kernel_differential_invalid():
+    import random
+    from __graft_entry__ import _register_history  # conftest adds the root
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import matrix_check
+    for seed in range(4):
+        h = _register_history(200, n_procs=4, seed=100 + seed)
+        rng = random.Random(seed)
+        reads = [op for op in h
+                 if op.get("f") == "read" and op.get("type") == "ok"]
+        for op in rng.sample(reads, min(2, len(reads))):
+            op["value"] = 999  # a value never written
+        m = matrix_check(encode_register_ops(h), force=True)
+        assert m is not None
+        assert m[0] == _scan_alive(h) is False, seed
+
+
+def test_matrix_kernel_gating():
+    """The matrix path must decline outside its regime: large value
+    domains (quadratic blowup) and short histories."""
+    from jepsen_tpu.ops.jitlin import matrix_ok
+    assert matrix_ok(5, 8, 5000)
+    assert not matrix_ok(5, 101, 5000)   # 10k-op bench history: 101 values
+    assert not matrix_ok(5, 8, 100)      # short history: scan is cheaper
+    assert not matrix_ok(12, 8, 5000)    # too many slots
+
+
+def test_matrix_kernel_shape_bucketing():
+    """Nearby return counts must map to the same (T, G) chunk shape so
+    the compiled program is reused, and G stays within the element cap."""
+    from jepsen_tpu.ops.jitlin import (MATRIX_MAX_ELEMS, _bucket)
+    import numpy as np
+    shapes = set()
+    for R in (2000, 2040, 2500, 3000):
+        MV = 32 * 8
+        rb = _bucket(R, floor=64)
+        G = int(np.clip(rb // 120, 8, 256))
+        G = max(1, min(G, MATRIX_MAX_ELEMS // (MV * MV)))
+        T = -(-rb // G)
+        shapes.add((T, G))
+    assert len(shapes) <= 2  # 2048 and 4096 buckets
+    # the memory cap engages for big MV
+    MV = 4096
+    G = max(1, min(256, MATRIX_MAX_ELEMS // (MV * MV)))
+    assert G * MV * MV <= MATRIX_MAX_ELEMS
