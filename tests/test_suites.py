@@ -332,3 +332,21 @@ def test_resp_truncated_replies_raise():
         finally:
             c.close()
             srv.close()
+
+
+def test_fake_run_with_partition_nemesis_end_to_end():
+    """Full lifecycle with the nemesis ACTIVE in fake mode: partition
+    ops ride the nemesis thread concurrently with client ops, the final
+    phase heals, and the history records the fault schedule."""
+    from jepsen_tpu.suites import etcd
+    result = run_fake(etcd.etcd_test, faults={"partition"},
+                      nemesis_interval=0.2, time_limit=2.0)
+    assert result["results"]["valid?"] is True, result["results"]
+    nem_ops = [op for op in result["history"]
+               if op.get("process") == "nemesis"]
+    assert any(op.get("f") == "start-partition" for op in nem_ops)
+    # the final phase heals: the LAST nemesis action must be a heal
+    # (main-phase ops alternate, so any() alone wouldn't prove the
+    # final-generator phase ran)
+    completions = [op for op in nem_ops if op.get("type") != "invoke"]
+    assert completions and completions[-1].get("f") == "stop-partition"
