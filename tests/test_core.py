@@ -157,3 +157,35 @@ def test_time_limit_wall_clock():
         assert result["results"]["valid?"] is True
         n = result["results"]["count"]
         assert 5 <= n <= 40  # ~20 ops in 1s at 50ms stagger
+
+
+def test_high_concurrency_soak():
+    """50 workers x ~4 s of mixed register traffic with a fast nemesis:
+    shakes out interpreter races; asserts the structural invariants the
+    reference's interpreter tests check (every invoke completed by the
+    same process, types legal, crashed processes renumbered)."""
+    from conftest import run_fake
+    from jepsen_tpu.suites import etcd
+
+    result = run_fake(etcd.etcd_test, time_limit=4.0, concurrency=50,
+                      faults={"partition"}, nemesis_interval=0.1)
+    history = result["history"]
+    assert len(history) > 200
+    # pair invokes with their completions per process
+    open_ops: dict = {}
+    for op in history:
+        p = op.get("process")
+        if p == "nemesis":
+            continue
+        if op.get("type") == "invoke":
+            assert p not in open_ops, f"process {p} double-invoked"
+            open_ops[p] = op
+        elif op.get("type") in ("ok", "fail", "info"):
+            inv = open_ops.pop(p, None)
+            assert inv is not None, f"completion without invoke: {op}"
+            assert inv.get("f") == op.get("f")
+        else:
+            raise AssertionError(f"illegal type: {op}")
+    # anything left open must have crashed (type info would have closed it)
+    assert not open_ops, f"unclosed invokes: {list(open_ops)[:5]}"
+    assert result["results"]["valid?"] is True, result["results"]
