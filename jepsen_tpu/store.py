@@ -100,7 +100,29 @@ def write_columnar(test: dict) -> None:
         types=col.types, processes=col.processes, fs=col.fs,
         times=col.times, indices=col.indices,
         completion_of=col.completion_of, invocation_of=col.invocation_of,
+        f_table=np.asarray(col.f_table, dtype=object),
     )
+
+
+def load_columnar(test_name: str, timestamp: str, store_dir: str = BASE_DIR):
+    """Reloads the .npz sidecar as a ColumnarHistory (sans Python values
+    — those live in history.jsonl). This is the restart format for
+    checker jobs (SURVEY.md §5.4: analysis is re-entrant; the columnar
+    sidecar skips the jsonl parse + re-encoding on re-check)."""
+    import numpy as np
+    from jepsen_tpu.history import ColumnarHistory
+    p = path({"name": test_name, "start_time": timestamp,
+              "store_dir": store_dir}, "history.npz")
+    with np.load(p, allow_pickle=True) as z:
+        # archives from before the f_table key degrade to int codes only
+        f_table = ([None if x is None else str(x) for x in z["f_table"]]
+                   if "f_table" in z else [])
+        return ColumnarHistory(
+            types=z["types"], processes=z["processes"], fs=z["fs"],
+            times=z["times"], indices=z["indices"],
+            completion_of=z["completion_of"],
+            invocation_of=z["invocation_of"],
+            f_table=f_table)
 
 
 def write_results(test: dict) -> None:

@@ -56,3 +56,34 @@ def test_web_ui_serves_table_and_files():
             assert z[:2] == b"PK"
         finally:
             srv.shutdown()
+
+
+def test_columnar_sidecar_round_trip(tmp_path):
+    """history.npz reloads as a ColumnarHistory with the f table intact
+    (the re-entrant-analysis restart format, SURVEY.md §5.4)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.history import ColumnarHistory
+
+    history = [
+        {"type": "invoke", "f": "write", "value": 1, "process": 0,
+         "time": 1000, "index": 0},
+        {"type": "ok", "f": "write", "value": 1, "process": 0,
+         "time": 2000, "index": 1},
+        {"type": "invoke", "f": "read", "value": None, "process": 1,
+         "time": 1500, "index": 2},
+        {"type": "ok", "f": "read", "value": 1, "process": 1,
+         "time": 2500, "index": 3},
+    ]
+    test = {"name": "colstore", "start_time": "20260101T000000",
+            "store_dir": str(tmp_path), "history": history}
+    store.write_columnar(test)
+    col = store.load_columnar("colstore", "20260101T000000",
+                              store_dir=str(tmp_path))
+    ref = ColumnarHistory.from_ops(history)
+    import numpy as np
+    assert np.array_equal(col.types, ref.types)
+    assert np.array_equal(col.completion_of, ref.completion_of)
+    assert col.f_table == ref.f_table
+    # f codes decode back to op names through the table
+    assert col.f_table[int(col.fs[0])] == "write"
+    assert col.f_table[int(col.fs[2])] == "read"
