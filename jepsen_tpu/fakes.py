@@ -123,6 +123,7 @@ class KVStore(MetaLogDB):
         self.mono: list = []       # monotonic workload (val, ts) rows
         self.seq: set = set()      # sequential workload subkeys
         self.adya: dict = {}       # adya G2 pair -> (cell, uid)
+        self.holder = None         # mutex workload: current lock holder
 
     def _wipe(self):
         self.registers.clear()
@@ -133,6 +134,7 @@ class KVStore(MetaLogDB):
         self.mono.clear()
         self.seq.clear()
         self.adya.clear()
+        self.holder = None
 
     def read(self, k):
         with self.lock:
@@ -227,6 +229,21 @@ class KVStore(MetaLogDB):
         with self.lock:
             return [[v, ts] for v, ts in self.mono]
 
+    # mutex (workloads/mutex.py): one lock, owner-checked release
+    def acquire(self, p) -> bool:
+        with self.lock:
+            if self.holder is None:
+                self.holder = p
+                return True
+            return False
+
+    def release(self, p) -> bool:
+        with self.lock:
+            if self.holder == p:
+                self.holder = None
+                return True
+            return False
+
     # adya G2 (workloads/adya.py): insert-if-pair-empty, atomically
     def adya_insert(self, pair, uid, cell) -> bool:
         with self.lock:
@@ -306,6 +323,12 @@ class KVClient(MetaLogClient):
         if f == "insert":
             pair, uid, cell = v
             ok = self.db.adya_insert(pair, uid, cell)
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "acquire":
+            ok = self.db.acquire(op.get("process"))
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "release":
+            ok = self.db.release(op.get("process"))
             return {**op, "type": "ok" if ok else "fail"}
         if f == "inc":
             return {**op, "type": "ok", "value": self.db.mono_inc()}

@@ -227,8 +227,8 @@ def workload_registry() -> dict[str, Callable]:
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
                                       causal_reverse, dirty_reads, long_fork,
-                                      monotonic, queue_workload, register,
-                                      sequential, set_workload, wr)
+                                      monotonic, mutex, queue_workload,
+                                      register, sequential, set_workload, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -243,4 +243,5 @@ def workload_registry() -> dict[str, Callable]:
         "dirty-reads": dirty_reads.workload,
         "monotonic": monotonic.workload,
         "sequential": sequential.workload,
+        "mutex": mutex.workload,
     }

@@ -44,6 +44,7 @@ BASIC_GET = (60, 70)
 BASIC_GET_OK = (60, 71)
 BASIC_GET_EMPTY = (60, 72)
 BASIC_ACK = (60, 80)
+BASIC_REJECT = (60, 90)
 BASIC_NACK = (60, 120)
 CONFIRM_SELECT = (85, 10)
 CONFIRM_SELECT_OK = (85, 11)
@@ -250,6 +251,13 @@ class AmqpConnection:
     def ack(self, delivery_tag: int) -> None:
         self._send_method(1, BASIC_ACK,
                           struct.pack(">Q", delivery_tag) + b"\x00")
+
+    def reject(self, delivery_tag: int, requeue: bool = True) -> None:
+        """basic.reject — with requeue, returns an unacked message to
+        the queue (the semaphore workload's release)."""
+        self._send_method(1, BASIC_REJECT,
+                          struct.pack(">Q", delivery_tag)
+                          + (b"\x01" if requeue else b"\x00"))
 
     def close(self) -> None:
         from jepsen_tpu.suites._wire import close_quietly

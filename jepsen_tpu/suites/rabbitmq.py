@@ -165,15 +165,93 @@ class RabbitMQClient(Client):
             self.conn.close()
 
 
-SUPPORTED_WORKLOADS = ("queue",)
+SEM_QUEUE = "jepsen.semaphore"
+
+
+class SemaphoreClient(Client):
+    """The one-message-queue mutex (rabbitmq.clj:178-255): acquire =
+    basic.get without ack (we hold the unacked delivery), release =
+    basic.reject with requeue. A release whose channel already died is
+    still an ``ok`` — the broker requeues unacked messages itself."""
+
+    def __init__(self, timeout_s: float = 10.0, node: str | None = None,
+                 shared: dict | None = None):
+        import threading
+        self.timeout_s = timeout_s
+        self.node = node
+        self.shared = shared if shared is not None else {
+            "seeded": False, "lock": threading.Lock()}
+        self.conn: AmqpConnection | None = None
+        self.tag: int | None = None
+
+    def open(self, test, node):
+        c = SemaphoreClient(self.timeout_s, node, self.shared)
+        c.conn = AmqpConnection(node, PORT, timeout_s=self.timeout_s)
+        return c
+
+    def setup(self, test):
+        self.conn.queue_declare(SEM_QUEUE, durable=True)
+        # exactly ONE token message, seeded once across all clients
+        # (rabbitmq.clj:232-243's compare-and-set); client setups run in
+        # parallel threads, so the check-then-seed must hold a lock —
+        # double-seeding would put two tokens in the queue and fabricate
+        # mutual-exclusion violations
+        with self.shared["lock"]:
+            if self.shared.get("seeded"):
+                return
+            self.conn.confirm_select()
+            self.conn.queue_purge(SEM_QUEUE)
+            if not self.conn.publish(SEM_QUEUE, b"", mandatory=False):
+                raise RuntimeError("couldn't enqueue semaphore token")
+            self.shared["seeded"] = True
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        try:
+            if f == "acquire":
+                if self.tag is not None:
+                    return {**op, "type": "fail",
+                            "error": ["already-held"]}
+                got = self.conn.get(SEM_QUEUE, no_ack=False)
+                if got is None:
+                    return {**op, "type": "fail"}  # lock busy
+                self.tag, _body = got
+                return {**op, "type": "ok"}
+            if f == "release":
+                if self.tag is None:
+                    return {**op, "type": "fail", "error": ["not-held"]}
+                tag, self.tag = self.tag, None
+                try:
+                    self.conn.reject(tag, requeue=True)
+                except (AmqpError, TimeoutError, ConnectionError, OSError):
+                    pass  # dead channel requeues the token server-side
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except AmqpError as e:
+            kind = "fail" if f == "acquire" else "info"
+            return {**op, "type": kind, "error": ["amqp", e.code, e.text]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            # an indeterminate acquire may still hold the delivery on the
+            # broker until the channel dies, when it requeues
+            return {**op, "type": "info", "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("queue", "mutex")
 
 
 def rabbitmq_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    client = SemaphoreClient() if workload == "mutex" else RabbitMQClient()
     return build_suite_test(
-        opts_dict, db_name="rabbitmq",
+        o, db_name="rabbitmq",
         supported_workloads=SUPPORTED_WORKLOADS,
         make_real=lambda o: {"db": RabbitMQDB(),
-                             "client": RabbitMQClient(), "os": Debian()})
+                             "client": client, "os": Debian()})
 
 
 main = cli.single_test_cmd(

@@ -422,3 +422,47 @@ def test_amqp_empty_body_basic_return_keeps_sync():
     # stays frame-aligned (no hang, no misparse)
     assert c.publish("jepsen.queue", b"") is False
     c.close()
+
+
+# ---------------------------------------------------------------------------
+# mutex workload (rabbitmq semaphore)
+# ---------------------------------------------------------------------------
+
+def test_rabbitmq_fake_mutex_run():
+    """The semaphore workload checks linearizable mutual exclusion
+    against the knossos mutex model."""
+    result = run_fake(rabbitmq.rabbitmq_test, workload="mutex",
+                      concurrency=4)
+    assert result["results"]["valid?"] is True, result["results"]
+    oks = [op for op in result["history"]
+           if op.get("type") == "ok" and op.get("f") in ("acquire",
+                                                         "release")]
+    assert oks, "some acquires must have succeeded"
+
+
+def test_semaphore_client_state_machine():
+    """Client-side held-tag discipline (rabbitmq.clj:196-231): double
+    acquire fails locally, release without hold fails locally, release
+    rejects the held delivery with requeue."""
+    calls = []
+
+    class FakeConn:
+        def get(self, queue, no_ack=False):
+            calls.append(("get", no_ack))
+            return (9, b"")
+
+        def reject(self, tag, requeue=True):
+            calls.append(("reject", tag, requeue))
+
+    c = rabbitmq.SemaphoreClient()
+    c.conn = FakeConn()
+    out = c.invoke({}, {"f": "release", "type": "invoke"})
+    assert out["type"] == "fail" and out["error"] == ["not-held"]
+    out = c.invoke({}, {"f": "acquire", "type": "invoke"})
+    assert out["type"] == "ok" and c.tag == 9
+    assert calls[-1] == ("get", False)       # unacked hold, not auto-ack
+    out = c.invoke({}, {"f": "acquire", "type": "invoke"})
+    assert out["type"] == "fail" and out["error"] == ["already-held"]
+    out = c.invoke({}, {"f": "release", "type": "invoke"})
+    assert out["type"] == "ok" and c.tag is None
+    assert calls[-1] == ("reject", 9, True)  # requeue the token
