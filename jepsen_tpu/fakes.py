@@ -124,6 +124,7 @@ class KVStore(MetaLogDB):
         self.seq: set = set()      # sequential workload subkeys
         self.adya: dict = {}       # adya G2 pair -> (cell, uid)
         self.holder = None         # mutex workload: current lock holder
+        self.counter = 0           # counter workload
 
     def _wipe(self):
         self.registers.clear()
@@ -135,6 +136,7 @@ class KVStore(MetaLogDB):
         self.seq.clear()
         self.adya.clear()
         self.holder = None
+        self.counter = 0
 
     def read(self, k):
         with self.lock:
@@ -229,6 +231,15 @@ class KVStore(MetaLogDB):
         with self.lock:
             return [[v, ts] for v, ts in self.mono]
 
+    # counter (workloads/counter.py)
+    def counter_add(self, delta: int) -> None:
+        with self.lock:
+            self.counter += delta
+
+    def counter_read(self) -> int:
+        with self.lock:
+            return self.counter
+
     # mutex (workloads/mutex.py): one lock, owner-checked release
     def acquire(self, p) -> bool:
         with self.lock:
@@ -308,6 +319,11 @@ class KVClient(MetaLogClient):
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
+        if test.get("counter") and f == "add":
+            self.db.counter_add(int(v))
+            return {**op, "type": "ok"}
+        if test.get("counter") and f == "read" and v is None:
+            return {**op, "type": "ok", "value": self.db.counter_read()}
         if f == "transfer":
             t = v or {}
             ok = self.db.transfer(t.get("from"), t.get("to"),

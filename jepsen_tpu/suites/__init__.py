@@ -226,9 +226,10 @@ def workload_registry() -> dict[str, Callable]:
     """name -> workload-constructor map for sweep runners
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
-                                      causal_reverse, dirty_reads, long_fork,
-                                      monotonic, mutex, queue_workload,
-                                      register, sequential, set_workload, wr)
+                                      causal_reverse, counter, dirty_reads,
+                                      long_fork, monotonic, mutex,
+                                      queue_workload, register, sequential,
+                                      set_workload, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -244,4 +245,5 @@ def workload_registry() -> dict[str, Callable]:
         "monotonic": monotonic.workload,
         "sequential": sequential.workload,
         "mutex": mutex.workload,
+        "counter": counter.workload,
     }

@@ -117,6 +117,7 @@ FIELD_DIGEST = 4
 # bin operations / particle types
 OP_READ = 1
 OP_WRITE = 2
+OP_INCR = 5
 PARTICLE_INTEGER = 1
 
 # result codes (aerospike server)
@@ -243,6 +244,15 @@ class AerospikeConnection:
         if rc != RC_OK:
             raise AerospikeError(rc)
         return True
+
+    def incr(self, key: int, delta: int, bin_name: str = "value") -> None:
+        """Server-side atomic integer add (the counter workload's
+        operate-add, aerospike/counter.clj)."""
+        ops = [_op(OP_INCR, bin_name, struct.pack(">q", delta),
+                   PARTICLE_INTEGER)]
+        rc, _, _ = self._message(0, INFO2_WRITE, 0, ops, key)
+        if rc != RC_OK:
+            raise AerospikeError(rc)
 
     def close(self) -> None:
         from jepsen_tpu.suites._wire import close_quietly

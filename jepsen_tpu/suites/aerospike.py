@@ -154,6 +154,12 @@ class AerospikeClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("counter") and f == "add":
+                self.conn.incr(0, int(v))
+                return {**op, "type": "ok"}
+            if test.get("counter") and f == "read" and v is None:
+                value, _gen = self.conn.get(0)
+                return {**op, "type": "ok", "value": int(value or 0)}
             if f == "read":
                 k, _ = v
                 value, _gen = self.conn.get(int(k))
@@ -184,7 +190,7 @@ class AerospikeClient(Client):
             self.conn.close()
 
 
-SUPPORTED_WORKLOADS = ("register",)
+SUPPORTED_WORKLOADS = ("register", "counter")
 
 
 def aerospike_test(opts_dict: dict | None = None) -> dict:

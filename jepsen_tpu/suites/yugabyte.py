@@ -40,7 +40,8 @@ DB_PASS = "yugabyte"
 MASTER_COUNT = 3
 
 # reference registry shape (yugabyte/core.clj:74-104)
-YSQL_WORKLOADS = ("append", "set", "bank", "long-fork", "register", "wr")
+YSQL_WORKLOADS = ("append", "set", "bank", "long-fork", "register", "wr",
+                  "counter")
 YCQL_WORKLOADS = ("counter", "set", "set-index", "bank", "long-fork",
                   "single-key-acid", "multi-key-acid")
 

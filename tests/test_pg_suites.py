@@ -256,3 +256,27 @@ def test_pg_client_adya_insert():
                         "value": [3, 18, "b"]})
     assert out["type"] == "fail"
     assert any(q == "ROLLBACK" for q in c.conn.queries)
+
+
+def test_pg_client_counter_add_checks_rowcount():
+    class CounterStub(StubConn):
+        def __init__(self, rc):
+            super().__init__()
+            self.rc = rc
+
+        def query(self, sql):
+            self.queries.append(sql)
+            return [], "UPDATE"
+
+        def rowcount(self, tag):
+            return self.rc
+
+    c = PGSuiteClient()
+    c.conn = CounterStub(1)
+    out = c.invoke({"counter": True},
+                   {"f": "add", "type": "invoke", "value": 3})
+    assert out["type"] == "ok"
+    c.conn = CounterStub(0)  # row missing → the add did not apply
+    out = c.invoke({"counter": True},
+                   {"f": "add", "type": "invoke", "value": 3})
+    assert out["type"] == "fail"

@@ -466,3 +466,27 @@ def test_semaphore_client_state_machine():
     out = c.invoke({}, {"f": "release", "type": "invoke"})
     assert out["type"] == "ok" and c.tag is None
     assert calls[-1] == ("reject", 9, True)  # requeue the token
+
+
+def test_aerospike_fake_counter_run():
+    result = run_fake(aerospike.aerospike_test, workload="counter")
+    assert result["results"]["valid?"] is True, result["results"]
+    reads = [op for op in result["history"]
+             if op.get("f") == "read" and op.get("type") == "ok"]
+    assert reads and isinstance(reads[-1]["value"], int)
+
+
+def test_counter_checker_bounds():
+    from jepsen_tpu import checker as chk
+    history = [
+        {"type": "invoke", "f": "add", "value": 2, "process": 0},
+        {"type": "ok", "f": "add", "value": 2, "process": 0},
+        {"type": "invoke", "f": "read", "value": None, "process": 1},
+        {"type": "ok", "f": "read", "value": 2, "process": 1},
+        # read outside [acknowledged, attempted] window
+        {"type": "invoke", "f": "read", "value": None, "process": 1},
+        {"type": "ok", "f": "read", "value": 7, "process": 1},
+    ]
+    out = chk.counter().check({}, history, {})
+    assert out["valid?"] is False
+    assert out["reads-checked"] == 2
