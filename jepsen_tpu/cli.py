@@ -194,12 +194,15 @@ def test_all_cmd(tests_fn: Callable[[argparse.Namespace], list], name="jepsen-tp
         opts = parser.parse_args(argv)
         from jepsen_tpu import core
         worst = EXIT_OK
-        for test in tests_fn(opts):
-            result = core.run(test)
-            code = validity_exit_code(result)
-            worst = max(worst, code if code != EXIT_OK else worst)
-            logger.info("%s: %s", test.get("name"),
-                        (result.get("results") or {}).get("valid?"))
+        # each round rebuilds the test maps — core.run mutates them
+        # (cli.clj:429-515 runs every combination test-count times)
+        for _ in range(getattr(opts, "test_count", 1) or 1):
+            for test in tests_fn(opts):
+                result = core.run(test)
+                code = validity_exit_code(result)
+                worst = max(worst, code if code != EXIT_OK else worst)
+                logger.info("%s: %s", test.get("name"),
+                            (result.get("results") or {}).get("valid?"))
         return worst
 
     return main

@@ -104,6 +104,7 @@ def build_suite_test(o: dict | None, *, db_name: str,
         "accelerator": o.get("accelerator", "auto"),
         "store_dir": o.get("store_dir", "store"),
         "no_perf": o.get("no_perf", False),
+        "leave_db_running": o.get("leave_db_running", False),
     }
     if fake:
         from jepsen_tpu.fakes import KVClient, KVStore

@@ -184,15 +184,12 @@ def all_tests(opts) -> list:
     (yugabyte/core.clj:110-123, cli.clj:429-515)."""
     from jepsen_tpu.cli import test_opts_to_test
     base = test_opts_to_test(opts, {})
-    tests = []
-    for name in workloads_expected_to_pass():
-        o = {"workload": name, "nodes": base["nodes"],
-             "concurrency": base["concurrency"],
-             "time_limit": base["time_limit"], "ssh": base["ssh"],
-             "store_dir": base["store_dir"],
-             "fake": (base["ssh"] or {}).get("dummy", False)}
-        tests.append(yugabyte_test(o))
-    return tests
+    # carry the WHOLE option map — cherry-picking keys silently drops
+    # any option later added to test_opts_to_test
+    return [yugabyte_test(dict(base, workload=name,
+                               fake=(base.get("ssh") or {}).get("dummy",
+                                                                False)))
+            for name in workloads_expected_to_pass()]
 
 
 main_all = cli.test_all_cmd(all_tests, name="jepsen-yugabyte")

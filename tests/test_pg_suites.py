@@ -280,3 +280,15 @@ def test_pg_client_counter_add_checks_rowcount():
     out = c.invoke({"counter": True},
                    {"f": "add", "type": "invoke", "value": 3})
     assert out["type"] == "fail"
+
+
+def test_yugabyte_test_all_sweep_fake():
+    """The test-all runner sweeps every workload expected to pass
+    (yugabyte/core.clj:110-123 + cli.clj:429-515) in fake mode."""
+    import tempfile
+
+    from jepsen_tpu.suites.yugabyte import main_all
+    with tempfile.TemporaryDirectory() as tmp:
+        code = main_all(["--no-ssh", "--time-limit", "1",
+                         "--accelerator", "cpu", "--store-dir", tmp])
+    assert code == 0
