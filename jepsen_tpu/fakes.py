@@ -14,10 +14,14 @@ from jepsen_tpu import db as db_mod
 from jepsen_tpu.client import Client
 
 
-class MetaLogDB(db_mod.NoopDB):
+class MetaLogDB(db_mod.NoopDB, db_mod.Process, db_mod.Pause):
     """Base for in-memory 'clusters': a data lock plus a meta-log of
     lifecycle calls for assertions. Subclasses override ``_wipe`` to clear
-    their data under the lock on teardown."""
+    their data under the lock on teardown.
+
+    Implements Process/Pause as meta-logged no-ops so fake-mode tests can
+    schedule kill/pause nemesis packages end to end (an in-memory store
+    has no process to kill, but the fault plumbing all runs)."""
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -38,6 +42,18 @@ class MetaLogDB(db_mod.NoopDB):
         with self.lock:
             self._wipe()
         self._note("db-teardown", node)
+
+    def start(self, test, node):
+        self._note("db-start", node)
+
+    def kill(self, test, node):
+        self._note("db-kill", node)
+
+    def pause(self, test, node):
+        self._note("db-pause", node)
+
+    def resume(self, test, node):
+        self._note("db-resume", node)
 
 
 class AtomDB(MetaLogDB):

@@ -350,3 +350,18 @@ def test_fake_run_with_partition_nemesis_end_to_end():
     # final-generator phase ran)
     completions = [op for op in nem_ops if op.get("type") != "invoke"]
     assert completions and completions[-1].get("f") == "stop-partition"
+
+
+def test_fake_run_with_kill_and_pause_nemesis():
+    """Kill/pause fault packages now compose in fake mode (the in-memory
+    DB implements Process/Pause as meta-logged no-ops), so the whole
+    DBNemesis scheduling path runs end to end."""
+    from jepsen_tpu.suites import etcd
+    result = run_fake(etcd.etcd_test,
+                      faults={"kill", "pause", "partition"},
+                      nemesis_interval=0.2, time_limit=2.5)
+    assert result["results"]["valid?"] is True, result["results"]
+    nem_fs = {op.get("f") for op in result["history"]
+              if op.get("process") == "nemesis"}
+    # at least two distinct fault families scheduled
+    assert len(nem_fs & {"kill", "pause", "start-partition"}) >= 2, nem_fs
