@@ -376,3 +376,83 @@ def test_result_map_drops_empty_anomaly_lists():
     from jepsen_tpu.elle import result_map
     r = result_map({"G1c": []}, [], {})
     assert r["valid?"] is True and r["anomaly-types"] == []
+
+
+# ---------------------------------------------------------------------------
+# soundness differential vs a brute-force serializability oracle
+# ---------------------------------------------------------------------------
+
+def _brute_force_serializable(txns) -> bool:
+    """Tries every ordering of the committed txns; serializable iff some
+    order replays with every read seeing the exact current list state."""
+    from itertools import permutations
+
+    for perm in permutations(txns):
+        lists: dict = {}
+        ok = True
+        for txn in perm:
+            for f, k, v in txn:
+                if f == "r":
+                    if list(lists.get(k, [])) != list(v or []):
+                        ok = False
+                        break
+                else:
+                    lists.setdefault(k, []).append(v)
+            if not ok:
+                break
+        if ok:
+            return True
+    return False
+
+
+def test_append_checker_soundness_vs_brute_force():
+    """Whenever the cycle checker CONVICTS a history (valid? False), a
+    brute-force search over all serializations must agree no valid
+    order exists — the checker must never accuse a serializable
+    history. Histories are tiny (<= 6 txns) so permutations are cheap;
+    reads are randomly corrupted to produce both verdicts."""
+    import random
+
+    from jepsen_tpu.elle import list_append
+
+    rng = random.Random(99)
+    convictions = acquittals = 0
+    for trial in range(120):
+        # build a sequentially-applied (serializable) history over 2 keys
+        lists: dict = {}
+        history = []
+        txns = []
+        for i in range(rng.randrange(3, 7)):
+            ops = []
+            k = rng.randrange(2)
+            if rng.random() < 0.6:
+                ops.append(["r", k, list(lists.get(k, []))])
+            lists.setdefault(k, []).append(i)
+            ops.append(["append", k, i])
+            txns.append(ops)
+            history.append({"type": "invoke", "f": "txn", "process": i % 3,
+                            "value": [[f, kk, None if f == "r" else vv]
+                                      for f, kk, vv in ops], "index": 2 * i})
+            history.append({"type": "ok", "f": "txn", "process": i % 3,
+                            "value": ops, "index": 2 * i + 1})
+        if rng.random() < 0.6:
+            # corrupt one read to a random (often impossible) state
+            reads = [(ti, oi) for ti, t in enumerate(txns)
+                     for oi, (f, _, _) in enumerate(t) if f == "r"]
+            if reads:
+                ti, oi = reads[rng.randrange(len(reads))]
+                k = txns[ti][oi][1]
+                # the ok op's value aliases txns[ti], so this mutates
+                # the history entry too
+                txns[ti][oi] = ["r", k, [rng.randrange(10)]]
+        out = list_append.check(history, accelerator="cpu",
+                                consistency_models=("serializable",))
+        if out.get("valid?") is False:
+            convictions += 1
+            assert not _brute_force_serializable(txns), (
+                f"trial {trial}: checker convicted a serializable history "
+                f"{txns}\nanomalies: {out.get('anomaly-types')}")
+        else:
+            acquittals += 1
+    # the fuzz must have exercised both verdicts to mean anything
+    assert convictions >= 10 and acquittals >= 10, (convictions, acquittals)

@@ -363,5 +363,7 @@ def test_fake_run_with_kill_and_pause_nemesis():
     assert result["results"]["valid?"] is True, result["results"]
     nem_fs = {op.get("f") for op in result["history"]
               if op.get("process") == "nemesis"}
-    # at least two distinct fault families scheduled
-    assert len(nem_fs & {"kill", "pause", "start-partition"}) >= 2, nem_fs
+    # BOTH newly-enabled families must schedule — a >=2-of-3 threshold
+    # would let a dropped Process/Pause mixin regress undetected
+    assert "kill" in nem_fs, nem_fs
+    assert "pause" in nem_fs, nem_fs
