@@ -57,10 +57,18 @@ def check(history: list[dict], accelerator: str = "auto",
             for f, k, v in int_write_mops(op.get("value") or []):
                 intermediate_writes[(_hk(k), v)] = i
 
+    # writers per key, for the single-write init-read inference below
+    key_writers: dict = defaultdict(set)
+    for (k, _v), w in writer_of.items():
+        key_writers[k].add(w)
+
     graph = Graph(n)
     # One pass per txn builds: wr edges (reads of known writes), trace ww
     # edges and value-level succession (txn read v then wrote v' for the
     # same key => writer(v) precedes this txn), G1a, and internal checks.
+    # The initial state None is a first-class version: a None-read then
+    # write traces the succession init -> first value.
+    _MISSING = object()
     succ: dict[tuple, set[int]] = defaultdict(set)
     for i, op in enumerate(txns):
         if op.get("type") != "ok":
@@ -90,27 +98,43 @@ def check(history: list[dict], accelerator: str = "auto",
                     w = writer_of.get((k, v))
                     if w is not None and w != i:
                         graph.add(w, i, WR)
-                    last_read[k] = v
+                last_read[k] = v
             elif m[0] == "w":
-                prev = last_read.get(k)
-                if prev is not None:
-                    succ[(k, prev)].add(i)
-                    w = writer_of.get((k, prev))
-                    if w is not None and w != i:
-                        graph.add(w, i, WW)
+                prev = last_read.get(k, _MISSING)
+                if prev is not _MISSING:
+                    # prev None traces init -> m[2], but only when None
+                    # is really the init state (never a written value)
+                    if prev is not None or (k, None) not in writer_of:
+                        succ[(k, prev)].add(i)
+                    if prev is not None:
+                        w = writer_of.get((k, prev))
+                        if w is not None and w != i:
+                            graph.add(w, i, WW)
                 last_read[k] = m[2]
                 written[k] = m[2]
 
     # rw anti-dependencies: i read version v of k; known successor writers
-    # (from the succession map) anti-depend on i.
+    # (from the succession map) anti-depend on i. A read of the initial
+    # state (None) additionally anti-depends on the key's writer when the
+    # key has exactly ONE writing txn — init's immediate successor is then
+    # unambiguous (elle's nil-version inference).
     for i, op in enumerate(txns):
         if op.get("type") != "ok":
             continue
         for m in op.get("value") or []:
-            if m[0] == "r" and m[2] is not None:
-                for w in succ.get((_hk(m[1]), m[2]), ()):
-                    if w != i:
-                        graph.add(i, w, RW)
+            if m[0] != "r":
+                continue
+            k, v = _hk(m[1]), m[2]
+            for w in succ.get((k, v), ()):
+                if w != i:
+                    graph.add(i, w, RW)
+            if (v is None and (k, None) not in writer_of
+                    and len(key_writers.get(k, ())) == 1):
+                # a None read is the INITIAL state only if no txn ever
+                # wrote a literal None to this key
+                (w,) = key_writers[k]
+                if w != i:
+                    graph.add(i, w, RW)
 
     cyc = elle.check_cycles(graph, accelerator=accelerator)
     result = elle.result_map(cyc, txns, anomalies_extra,

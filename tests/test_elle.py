@@ -456,3 +456,100 @@ def test_append_checker_soundness_vs_brute_force():
             acquittals += 1
     # the fuzz must have exercised both verdicts to mean anything
     assert convictions >= 10 and acquittals >= 10, (convictions, acquittals)
+
+
+def test_wr_checker_soundness_vs_brute_force():
+    """rw-register twin of the append soundness fuzz: a conviction must
+    mean NO serialization replays with every read seeing the latest
+    write (writes are unique ints, so version attribution is exact)."""
+    import random
+    from itertools import permutations
+
+    from jepsen_tpu.elle import rw_register
+
+    def brute_force_serializable(txns) -> bool:
+        for perm in permutations(txns):
+            regs: dict = {}
+            ok = True
+            for txn in perm:
+                for f, k, v in txn:
+                    if f == "r":
+                        if regs.get(k) != v:
+                            ok = False
+                            break
+                    else:
+                        regs[k] = v
+                if not ok:
+                    break
+            if ok:
+                return True
+        return False
+
+    rng = random.Random(41)
+    convictions = acquittals = 0
+    for trial in range(150):
+        regs: dict = {}
+        versions: dict = {0: [None], 1: [None]}  # per-key version order
+        history = []
+        txns = []
+        for i in range(rng.randrange(3, 7)):
+            # mix same-key read-then-write txns (they trace version
+            # successions, powering rw-edge inference) with cross-key ones
+            ops = []
+            k = rng.randrange(2)
+            wk = k if rng.random() < 0.5 else 1 - k
+            if rng.random() < 0.8:
+                ops.append(["r", k, regs.get(k)])
+            regs[wk] = i  # unique write values
+            versions[wk].append(i)
+            ops.append(["w", wk, i])
+            txns.append(ops)
+            history.append({"type": "invoke", "f": "txn", "process": i % 3,
+                            "value": [[f, kk, None if f == "r" else vv]
+                                      for f, kk, vv in ops], "index": 2 * i})
+            history.append({"type": "ok", "f": "txn", "process": i % 3,
+                            "value": ops, "index": 2 * i + 1})
+        if rng.random() < 0.7:
+            # corrupt one read to a STALE version of its key (a value the
+            # key really held earlier, or the initial None) — phantom
+            # values would be unattributable and prove nothing
+            reads = [(ti, oi) for ti, t in enumerate(txns)
+                     for oi, (f, _, _) in enumerate(t) if f == "r"]
+            if reads:
+                ti, oi = reads[rng.randrange(len(reads))]
+                k = txns[ti][oi][1]
+                cur = txns[ti][oi][2]
+                older = [v for v in versions[k] if v != cur]
+                if older:
+                    # the ok op's value aliases txns[ti]
+                    txns[ti][oi] = ["r", k, rng.choice(older)]
+        out = rw_register.check(history, accelerator="cpu",
+                                consistency_models=("serializable",))
+        if out.get("valid?") is False:
+            convictions += 1
+            assert not brute_force_serializable(txns), (
+                f"trial {trial}: convicted a serializable history {txns}\n"
+                f"anomalies: {out.get('anomaly-types')}")
+        else:
+            acquittals += 1
+    assert convictions >= 10 and acquittals >= 10, (convictions, acquittals)
+
+
+def test_wr_written_none_is_not_the_initial_state():
+    """A txn can WRITE a literal None; reading it must not be conflated
+    with reading the initial state (which would fabricate rw edges and
+    convict a serializable history)."""
+    from jepsen_tpu.elle import rw_register
+
+    txns = [[["w", 0, None], ["w", 1, 1]],
+            [["r", 1, 1], ["r", 0, None]]]
+    h = []
+    for i, ops in enumerate(txns):
+        h.append({"type": "invoke", "f": "txn", "process": i,
+                  "value": [[f, k, None if f == "r" else v]
+                            for f, k, v in ops], "index": 2 * i})
+        h.append({"type": "ok", "f": "txn", "process": i, "value": ops,
+                  "index": 2 * i + 1})
+    out = rw_register.check(h, accelerator="cpu",
+                            consistency_models=("serializable",))
+    assert out["valid?"] is True, out  # T1;T2 replays fine
