@@ -155,6 +155,10 @@ def check(history: list[dict], accelerator: str = "auto",
                 if w is not None and w[0] != i:
                     graph.add(i, w[0], RW)
 
+    # realtime (invoke/complete interval order) + per-process succession
+    # edges: close the strict-serializable / sequential anomaly surface
+    elle.add_timing_edges(graph, history, txns)
+
     cyc = elle.check_cycles(graph, accelerator=accelerator)
     # drop informational-only extras from validity
     extras = {k: v for k, v in anomalies_extra.items()

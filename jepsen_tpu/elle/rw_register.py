@@ -136,6 +136,10 @@ def check(history: list[dict], accelerator: str = "auto",
                 if w != i:
                     graph.add(i, w, RW)
 
+    # realtime (invoke/complete interval order) + per-process succession
+    # edges: close the strict-serializable / sequential anomaly surface
+    elle.add_timing_edges(graph, history, txns)
+
     cyc = elle.check_cycles(graph, accelerator=accelerator)
     result = elle.result_map(cyc, txns, anomalies_extra,
                              consistency_models=consistency_models)

@@ -553,3 +553,223 @@ def test_wr_written_none_is_not_the_initial_state():
     out = rw_register.check(h, accelerator="cpu",
                             consistency_models=("serializable",))
     assert out["valid?"] is True, out  # T1;T2 replays fine
+
+
+# ---------------------------------------------------------------------------
+# realtime / process precedence (strict-serializable surface)
+# ---------------------------------------------------------------------------
+
+def inv(process, txn):
+    return {"type": "invoke", "process": process, "f": "txn",
+            "value": [[f, k, None if f in ("r",) else v] for f, k, v in txn]}
+
+
+def test_append_realtime_cycle_stale_read():
+    # T1 appends 1 and completes; T2, invoked strictly after, still reads
+    # the empty list. Serializable (order T2 < T1) but not strictly so.
+    h = [
+        inv(0, [["append", "x", 1]]),
+        ok(0, [["append", "x", 1]]),
+        inv(1, [["r", "x", []]]),
+        ok(1, [["r", "x", []]]),
+        inv(2, [["r", "x", [1]]]),
+        ok(2, [["r", "x", [1]]]),
+    ]
+    strict = list_append.check(h, accelerator="cpu")
+    assert strict["valid?"] is False
+    assert "realtime-cycle" in strict["anomaly-types"]
+    serial = list_append.check(h, accelerator="cpu",
+                               consistency_models=("serializable",))
+    assert serial["valid?"] is True
+
+
+def test_append_process_cycle_completion_only_history():
+    # Same stale read by ONE process, with no invocation events at all:
+    # the per-process succession still orders T1 < T2.
+    h = [
+        ok(0, [["append", "x", 1]]),
+        ok(0, [["r", "x", []]]),
+        ok(1, [["r", "x", [1]]]),
+    ]
+    strict = list_append.check(h, accelerator="cpu")
+    assert strict["valid?"] is False
+    assert "process-cycle" in strict["anomaly-types"]
+    seq = list_append.check(h, accelerator="cpu",
+                            consistency_models=("sequential",))
+    assert seq["valid?"] is False
+    serial = list_append.check(h, accelerator="cpu",
+                               consistency_models=("serializable",))
+    assert serial["valid?"] is True
+
+
+def test_wr_register_realtime_cycle_stale_read():
+    # rw-register twin: T1 writes x=1 and completes, then T2 reads the
+    # initial state. The init-successor inference yields rw T2 -> T1;
+    # realtime yields T1 -> T2.
+    h = [
+        inv(0, [["w", "x", 1]]),
+        ok(0, [["w", "x", 1]]),
+        inv(1, [["r", "x", None]]),
+        ok(1, [["r", "x", None]]),
+    ]
+    strict = rw_register.check(h, accelerator="cpu")
+    assert strict["valid?"] is False
+    assert "realtime-cycle" in strict["anomaly-types"]
+    serial = rw_register.check(h, accelerator="cpu",
+                               consistency_models=("serializable",))
+    assert serial["valid?"] is True
+
+
+def test_concurrent_txns_no_false_realtime_cycle():
+    # Overlapping intervals: T1 and T2 both in flight; T2 reads [] while
+    # T1's append lands after. Strictly serializable -> no anomaly.
+    h = [
+        inv(0, [["append", "x", 1]]),
+        inv(1, [["r", "x", []]]),
+        ok(0, [["append", "x", 1]]),
+        ok(1, [["r", "x", []]]),
+        inv(2, [["r", "x", [1]]]),
+        ok(2, [["r", "x", [1]]]),
+    ]
+    strict = list_append.check(h, accelerator="cpu")
+    assert strict["valid?"] is True, strict
+
+
+def test_realtime_soundness_fuzz_linearized_store():
+    """Histories generated by applying each txn atomically at a random
+    point inside its [invoke, complete] interval are strictly
+    serializable by construction; the checker must never convict one."""
+    rng = random.Random(4242)
+    for trial in range(60):
+        n_txns = rng.randrange(6, 14)
+        concurrency = rng.randrange(2, 5)
+        # build txn intents
+        intents = []
+        ctr = 0
+        for _ in range(n_txns):
+            txn = []
+            for _ in range(rng.randrange(1, 4)):
+                k = rng.randrange(2)
+                if rng.random() < 0.5:
+                    txn.append(["r", k, None])
+                else:
+                    ctr += 1
+                    txn.append(["append", k, ctr])
+            intents.append(txn)
+        # schedule: each txn has invoke < apply < complete events; at most
+        # `concurrency` txns in flight; apply executes against the store
+        lists: dict = {}
+        history = []
+        in_flight: list = []  # (txn_idx, applied?)
+        next_txn = 0
+        done = 0
+        state: dict = {}
+        while done < n_txns:
+            choices = []
+            if next_txn < n_txns and len(in_flight) < concurrency:
+                choices.append("invoke")
+            for idx, (ti, applied) in enumerate(in_flight):
+                choices.append(("apply", idx) if not applied
+                               else ("complete", idx))
+            ev = choices[rng.randrange(len(choices))]
+            if ev == "invoke":
+                p = next_txn  # fresh process per txn keeps pairing simple
+                history.append({"type": "invoke", "process": p, "f": "txn",
+                                "value": [[f, k, None if f == "r" else v]
+                                          for f, k, v in intents[next_txn]]})
+                in_flight.append((next_txn, False))
+                next_txn += 1
+            elif ev[0] == "apply":
+                ti, _ = in_flight[ev[1]]
+                executed = []
+                for f, k, v in intents[ti]:
+                    if f == "r":
+                        executed.append(["r", k, list(lists.get(k, []))])
+                    else:
+                        lists.setdefault(k, []).append(v)
+                        executed.append(["append", k, v])
+                state[ti] = executed
+                in_flight[ev[1]] = (ti, True)
+            else:
+                ti, _ = in_flight.pop(ev[1])
+                history.append({"type": "ok", "process": ti, "f": "txn",
+                                "value": state[ti]})
+                done += 1
+        out = list_append.check(history, accelerator="cpu")
+        assert out["valid?"] is True, (
+            f"trial {trial}: convicted a linearized history: "
+            f"{out['anomaly-types']}\n{history}")
+
+
+def test_strict_soundness_fuzz_sequential_histories():
+    """For a fully sequential history (each txn completes before the next
+    invokes) the ONLY realtime-respecting serialization is history order;
+    a strict-serializable conviction must mean that order fails replay."""
+    rng = random.Random(777)
+
+    def replays_in_order(txns):
+        lists: dict = {}
+        for txn in txns:
+            for f, k, v in txn:
+                if f == "r":
+                    if list(lists.get(k, [])) != list(v or []):
+                        return False
+                else:
+                    lists.setdefault(k, []).append(v)
+        return True
+
+    convictions = acquittals = 0
+    for trial in range(120):
+        lists = {}
+        history = []
+        txns = []
+        for i in range(rng.randrange(3, 7)):
+            ops = []
+            k = rng.randrange(2)
+            if rng.random() < 0.6:
+                ops.append(["r", k, list(lists.get(k, []))])
+            lists.setdefault(k, []).append(i)
+            ops.append(["append", k, i])
+            txns.append(ops)
+            history.append(inv(i % 3, ops))
+            history.append(ok(i % 3, ops))
+        if rng.random() < 0.7:
+            reads = [(ti, oi) for ti, t in enumerate(txns)
+                     for oi, (f, _, _) in enumerate(t) if f == "r"]
+            if reads:
+                ti, oi = reads[rng.randrange(len(reads))]
+                k = txns[ti][oi][1]
+                corrupt = rng.choice([[], [rng.randrange(8)]])
+                txns[ti][oi] = ["r", k, corrupt]
+        out = list_append.check(history, accelerator="cpu")
+        if out["valid?"] is False:
+            convictions += 1
+            assert not replays_in_order(txns), (
+                f"trial {trial}: strict conviction of a history that "
+                f"replays in realtime order {txns}\n{out['anomaly-types']}")
+        else:
+            acquittals += 1
+    assert convictions >= 10 and acquittals >= 10, (convictions, acquittals)
+
+
+def test_mixed_process_and_realtime_cycle_detected():
+    """A strict-serializability violation whose cycle needs BOTH a
+    process edge (between completion-only txns) and a realtime edge:
+    A ->process B ->wr C ->realtime D ->rw A. Neither order alone closes
+    the cycle, so the realtime search must walk process edges too."""
+    h = [
+        ok(0, [["append", "x", 1]]),            # A (no invoke events)
+        ok(0, [["append", "y", 1]]),            # B: process A -> B
+        inv(1, [["r", "y", [1]]]),
+        ok(1, [["r", "y", [1]]]),               # C: wr B -> C
+        inv(2, [["r", "x", []]]),               # invoked after C completed
+        ok(2, [["r", "x", []]]),                # D: realtime C -> D, rw D -> A
+        inv(3, [["r", "x", [1]]]),
+        ok(3, [["r", "x", [1]]]),               # E: establishes x order [1]
+    ]
+    strict = list_append.check(h, accelerator="cpu")
+    assert strict["valid?"] is False
+    assert "realtime-cycle" in strict["anomaly-types"], strict["anomaly-types"]
+    serial = list_append.check(h, accelerator="cpu",
+                               consistency_models=("serializable",))
+    assert serial["valid?"] is True, serial
