@@ -264,7 +264,7 @@ class SetFullChecker(Checker):
                 has_invoke.append(False)
             return i
 
-        reads: list[tuple[float, set]] = []
+        reads: list[tuple[float, Any]] = []  # (invoke time, raw payload)
         pending_read_invokes: dict = {}
         for i, op in enumerate(history):
             f, typ, v, p = (op.get("f"), op.get("type"), op.get("value"),
@@ -286,14 +286,41 @@ class SetFullChecker(Checker):
                     pending_read_invokes[p] = t
                 elif typ == "ok":
                     t0 = pending_read_invokes.pop(p, t)
-                    reads.append((t0, set(v)))
+                    reads.append((t0, v))
         if not reads:
             return {"valid?": "unknown", "error": "Set was never read"}
         E = len(invoke_t)
         reads.sort(key=lambda rv: rv[0])
         member = np.zeros((len(reads), max(E, 1)), dtype=bool)
+        # Columnar fast path for the common set workload (integer
+        # elements): map each read payload to element columns with one
+        # sorted-array searchsorted instead of a per-element dict walk —
+        # the membership matrix build is the device path's host-side cost
+        # and must not dominate the kernel it feeds. Elements a read
+        # mentions that were never added are ignored on both paths.
+        uv_sorted = uv_order = None
+        vals = intern.table[1:E + 1]
+        if E and all(type(x) is int for x in vals):
+            uv = np.asarray(vals, np.int64)
+            uv_order = np.argsort(uv)
+            uv_sorted = uv[uv_order]
         for r, (_, vs) in enumerate(reads):
-            for v in vs:
+            if uv_sorted is not None:
+                try:
+                    arr = np.asarray(list(vs))
+                except (TypeError, ValueError, OverflowError):
+                    arr = None
+                # signed-int dtype only: asarray would silently coerce
+                # floats ('2.5' -> 2) or parse digit strings, making a
+                # read "contain" elements it never mentioned
+                if arr is not None and arr.ndim == 1 \
+                        and arr.dtype.kind == "i":
+                    arr = arr.astype(np.int64)
+                    pos = np.clip(np.searchsorted(uv_sorted, arr), 0, E - 1)
+                    hit = uv_sorted[pos] == arr
+                    member[r, uv_order[pos[hit]]] = True
+                    continue
+            for v in set(vs):
                 j = intern.id(v) - 1
                 if 0 <= j < E:
                     member[r, j] = True

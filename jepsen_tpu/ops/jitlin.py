@@ -261,35 +261,50 @@ def _returns_prepass(kind, slot, f, a, b):
     pending mask evolve deterministically from the event stream alone
     (invokes/returns), independent of the frontier — so each return's
     (pending set, op table, returning slot) is computable up front.
+
+    Fully vectorized (O(S) passes of O(E) numpy work, no per-event Python)
+    so the prepass doesn't dominate the kernel it feeds: per slot t, the
+    pending bit at event i is ``#invokes(t) <= i  >  #returns(t) <= i``
+    (cumulative counts), and the current op is the last invoke of t at or
+    before i, found by searchsorted into t's invoke positions.
+
     Returns numpy arrays over the R return events."""
     kind = np.asarray(kind)
     slot = np.asarray(slot)
-    fabs = np.stack([np.asarray(f), np.asarray(a), np.asarray(b)], axis=1)
+    fabs = np.stack([np.asarray(f, np.int64), np.asarray(a, np.int64),
+                     np.asarray(b, np.int64)], axis=1)
     S = int(slot.max(initial=0)) + 1
-    cur = np.zeros((S, 3), np.int64)
-    pend = np.zeros((S,), bool)
-    r_slot, r_pend, r_ops = [], [], []
-    for i in range(kind.shape[0]):
-        k = int(kind[i])
-        if k == EV_INVOKE:
-            s = int(slot[i])
-            cur[s] = fabs[i]
-            pend[s] = True
-        elif k == EV_RETURN:
-            s = int(slot[i])
-            r_slot.append(s)
-            r_pend.append(pend.copy())
-            r_ops.append(cur.copy())
-            pend[s] = False
-    if not r_slot:
+    ret_idx = np.nonzero(kind == EV_RETURN)[0]
+    R = ret_idx.shape[0]
+    if R == 0:
         return (np.zeros((0,), np.int32), np.zeros((0, S), bool),
                 np.zeros((0, S, 3), np.int64), S)
-    return (np.asarray(r_slot, np.int32), np.stack(r_pend),
-            np.stack(r_ops), S)
+    r_slot = slot[ret_idx].astype(np.int32)
+    r_pend = np.zeros((R, S), bool)
+    r_ops = np.zeros((R, S, 3), np.int64)
+    is_inv = kind == EV_INVOKE
+    is_ret = kind == EV_RETURN
+    for t in range(S):
+        on_t = slot == t
+        inv_pos = np.nonzero(is_inv & on_t)[0]
+        # pending at return event i: invokes-so-far > returns-so-far,
+        # where "so-far" includes event i itself (a return of slot t at i
+        # still sees t pending — it is the op being linearized-and-killed)
+        n_inv = np.cumsum(is_inv & on_t)
+        n_ret_before = np.cumsum(is_ret & on_t) - (is_ret & on_t)
+        r_pend[:, t] = (n_inv > n_ret_before)[ret_idx]
+        if inv_pos.size == 0:
+            continue  # slot never invoked: never pending, op stays 0
+        # current op of slot t at event i: last invoke of t at or before i
+        j = np.searchsorted(inv_pos, ret_idx, side="right") - 1
+        has = j >= 0
+        src = inv_pos[np.where(has, j, 0)]
+        r_ops[:, t, :] = np.where(has[:, None], fabs[src], 0)
+    return r_slot, r_pend, r_ops, S
 
 
 def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
-                         g_steps: int, n_chunks: int):
+                         g_steps: int, n_chunks: int, n_keys: int = 1):
     """Block-composed transfer-matrix variant of the dense scan.
 
     For each return event, closure-then-kill is a *linear* boolean
@@ -305,6 +320,21 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
     what makes a single long history fast on TPU; the event-by-event
     dense scan remains the exact-diagnostics path (died-at event, peak).
 
+    With ``n_keys`` = B > 1, the same chunk axis also carries a batch of
+    independent per-key histories (the jepsen.independent regime): chunk
+    g = b * n_chunks + c holds key b's c-th slice of returns, every scan
+    step advances all B x C chunks with one [G, MV, MV] MXU matmul, and
+    the final combine chains each key's C chunk products separately.
+    This replaces the latency-bound vmapped event scan with dense batched
+    matmul work — sequential depth per key falls from E events to
+    T = g_steps.
+
+    Host→device traffic is kept minimal for tunneled/remote accelerators:
+    the host interns the batch's distinct (f, a, b) ops into a table of
+    ``n_uops`` entries, each op's [V, V] transition matrix is built ONCE
+    on device, and the per-return op tables arrive as small int32 id
+    grids gathered against that table each step.
+
     Boolean products ride bf16 inputs with f32 accumulation (counts
     <= MV = 2^S * V <= 2^12 are exact in f32) and a >0 threshold.
     """
@@ -314,7 +344,8 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
 
     M = 1 << S
     MV = M * V
-    G, T = n_chunks, g_steps
+    B, C, T = n_keys, n_chunks, g_steps
+    G = B * C
 
     # static tables ------------------------------------------------------
     r = np.arange(M)
@@ -340,50 +371,69 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
     v_range = jnp.arange(V, dtype=jnp.int32)
 
     def bmm(x, y):
+        # bf16 accumulation is sound for the >0 test: every addend is
+        # non-negative, so rounding can never produce a spurious zero (a
+        # positive sum stays positive) nor a spurious positive — and the
+        # bf16 output halves the HBM traffic of these [G, MV, MV]
+        # intermediates, which is what bounds the step
         out = jnp.einsum("gij,gjk->gik", x, y,
-                         preferred_element_type=jnp.float32)
+                         preferred_element_type=jnp.bfloat16)
         return (out > 0).astype(jnp.bfloat16)
 
-    def slot_matrices(ops):
-        """[G, S, 3] op table -> [G, S, V, V] transition matrices + oob."""
+    def uop_tables(uops):
+        """[U, 3] distinct-op table -> [U, V, V] transition matrices
+        (computed once per run, gathered per step) + [U] oob flags."""
         def one(fab):
             st2, ok = step_ids(v_range, fab[0], fab[1], fab[2])
             oob = (ok & ((st2 < 0) | (st2 >= V))).any()
             return (ok[:, None] & (st2[:, None] == v_range[None, :])), oob
-        mt, oob = jax.vmap(jax.vmap(one))(ops)
+        mt, oob = jax.vmap(one)(uops)
         return mt.astype(jnp.bfloat16), oob
 
-    def step(carry, inp):
-        P, inexact = carry
-        pend_g, ops_g, s_g, val_g = inp
-        mt, oob = slot_matrices(ops_g)           # [G, S, V, V]
-        gated = pend_g.astype(jnp.bfloat16)
-        # row = (receiver mask a, NEW state w); col = (source mask b,
-        # OLD state v): L[(a,w),(b,v)] = Σ_t pend_t R_t[a,b] M_t[v,w]
-        L = jnp.einsum("gt,tab,gtvw->gawbv", gated, receiver_j, mt,
-                       preferred_element_type=jnp.float32)
-        B = ((L.reshape(G, MV, MV) + eye[None]) > 0).astype(jnp.bfloat16)
-        for _ in range(n_sq):
-            B = bmm(B, B)                        # (I+L)^(2^k) → closure
-        A = jax.vmap(lambda b, idx, msk: b[idx] * msk[:, None])(
-            B, kill_idx_j[s_g], kill_mask_j[s_g])
-        A = jnp.where(val_g[:, None, None], A, eye[None])
-        return (bmm(A, P),
-                inexact | (oob & pend_g & val_g[:, None]).any()), None
+    def make_step(mt_tab, oob_tab):
+        def step(carry, inp):
+            P, inexact = carry
+            pend_g, ids_g, s_g, val_g = inp
+            mt = mt_tab[ids_g]                   # [G, S, V, V] gather
+            oob = oob_tab[ids_g]                 # [G, S]
+            gated = pend_g.astype(jnp.bfloat16)
+            # row = (receiver mask a, NEW state w); col = (source mask b,
+            # OLD state v): L[(a,w),(b,v)] = Σ_t pend_t R_t[a,b] M_t[v,w]
+            # (bf16 accumulation: ≤ S non-negative addends, see bmm)
+            L = jnp.einsum("gt,tab,gtvw->gawbv", gated, receiver_j, mt,
+                           preferred_element_type=jnp.bfloat16)
+            Bm = ((L.reshape(G, MV, MV) + eye[None]) > 0).astype(jnp.bfloat16)
+            for _ in range(n_sq):
+                Bm = bmm(Bm, Bm)                 # (I+L)^(2^k) → closure
+            A = jax.vmap(lambda m, idx, msk: m[idx] * msk[:, None])(
+                Bm, kill_idx_j[s_g], kill_mask_j[s_g])
+            A = jnp.where(val_g[:, None, None], A, eye[None])
+            return (bmm(A, P),
+                    inexact | (oob & pend_g & val_g[:, None]).any(axis=1)), None
+        return step
 
     @jax.jit
-    def run(pend, ops, slots, valid):
+    def run(pend, op_ids, uops, slots, valid):
+        """pend [T,G,S]; op_ids [T,G,S] (indices into uops [U,3]);
+        slots [T,G]; valid [T,G], with chunk g = key * C + chunk.
+        Returns (alive[B], inexact[B])."""
+        mt_tab, oob_tab = uop_tables(uops)
         P0 = jnp.broadcast_to(eye, (G, MV, MV))
-        (P, inexact), _ = lax.scan(step, (P0, jnp.bool_(False)),
-                                   (pend, ops, slots, valid))
+        (P, inexact), _ = lax.scan(make_step(mt_tab, oob_tab),
+                                   (P0, jnp.zeros((G,), bool)),
+                                   (pend, op_ids, slots, valid))
+        # chain each key's C chunk products in time order: chunks are
+        # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0]
+        Pk = P.reshape(B, C, MV, MV)
 
         def comb(c, tot):
-            return (jnp.einsum("ij,jk->ik", P[c], tot,
-                               preferred_element_type=jnp.float32)
+            return (jnp.einsum("bij,bjk->bik", Pk[:, c], tot,
+                               preferred_element_type=jnp.bfloat16)
                     > 0).astype(jnp.bfloat16)
-        total = lax.fori_loop(0, G, comb, eye)
-        alive = (total[:, init_state] > 0).any()
-        return alive, inexact
+        total = lax.fori_loop(0, C, comb,
+                              jnp.broadcast_to(eye, (B, MV, MV)))
+        alive = (total[:, :, init_state] > 0).any(axis=1)
+        return alive, inexact.reshape(B, C).any(axis=1)
 
     return run
 
@@ -414,46 +464,104 @@ def matrix_check(stream, step_ids=None, init_state: int = 0,
     frontier stats re-run the event scan (only relevant when not alive).
     Returns None when the matrix regime doesn't apply (``force=True``
     skips the size gate, for differential tests)."""
-    import jax
-
     if step_ids is None:
         step_ids = _default_step_ids()
     num_states = num_states if num_states is not None else len(stream.intern)
     kind, slot = np.asarray(stream.kind), np.asarray(stream.slot)
-    # gate BEFORE the O(E) python prepass: everything the gate needs is
+    # gate BEFORE the O(E) prepass: everything the gate needs is
     # computable from cheap array reductions
     S = int(slot.max(initial=0)) + 1
     R = int((kind == EV_RETURN).sum())
     if not force and not matrix_ok(S, num_states, R):
         return None
+    return matrix_check_batch([stream], step_ids=step_ids,
+                              init_state=init_state,
+                              num_states=num_states)[0]
+
+
+def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
+                       num_states: int | None = None, mesh=None):
+    """Batched transfer-matrix check over independent per-key histories
+    (the jepsen.independent regime, BASELINE config 3). All keys' chunk
+    products advance together in one [B*C, MV, MV] MXU matmul per scan
+    step, then each key's chunks chain separately — so B keys cost the
+    same sequential depth as one. With a mesh, the chunk axis G = B*C is
+    sharded over the mesh's first axis (each device multiplies its own
+    chunk block; the per-key combine re-shards on keys), so the batch
+    scales over ICI like the rest of the checker data plane. Returns
+    [(alive, -1, inexact, 0)] per stream; callers needing failure
+    diagnostics re-run the event scan on the not-alive keys. Callers gate
+    the regime (matrix_ok on max S / max V / total returns) before paying
+    the prepass."""
+    import jax
+
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    if num_states is None:
+        num_states = max(len(s.intern) for s in streams)
     V = _bucket(num_states, floor=8)
-    if R == 0:
-        return True, -1, False, 0
-    r_slot, r_pend, r_ops, S = _returns_prepass(
-        kind, slot, np.asarray(stream.f), np.asarray(stream.a),
-        np.asarray(stream.b))
-    # chunk layout: G parallel chunks of T returns (padded with identity).
-    # R is bucketed so (T, G) — and therefore the compiled program — is
-    # shared across nearby history lengths; G is capped so the step's
-    # [G, MV, MV] f32 intermediates stay within the element budget.
+    B = len(streams)
+    preps = [_returns_prepass(np.asarray(s.kind), np.asarray(s.slot),
+                              np.asarray(s.f), np.asarray(s.a),
+                              np.asarray(s.b))
+             for s in streams]
+    S = max(p[3] for p in preps)
+    R_max = max((p[0].shape[0] for p in preps), default=0)
+    if R_max == 0:
+        return [(True, -1, False, 0)] * B
+    # chunk layout: per key, C chunks of T returns (padded with identity);
+    # chunk g = b*C + c. R is bucketed so (T, C, B) — and therefore the
+    # compiled program — is shared across nearby history lengths; C is
+    # capped so the step's [B*C, MV, MV] f32 intermediates stay within
+    # the element budget.
     MV = (1 << S) * V
-    rb = _bucket(R, floor=64)
-    G = int(np.clip(rb // 120, 8, 256))
-    G = max(1, min(G, MATRIX_MAX_ELEMS // (MV * MV)))
-    T = -(-rb // G)
-    pad = G * T - R
-    r_slot = np.concatenate([r_slot, np.zeros((pad,), np.int32)])
-    r_pend = np.concatenate([r_pend, np.zeros((pad, S), bool)])
-    r_ops = np.concatenate([r_ops, np.zeros((pad, S, 3), np.int64)])
-    valid = np.concatenate([np.ones((R,), bool), np.zeros((pad,), bool)])
-    # [R] → chunk-major [G, T] → time-major [T, G] for the scan
-    as_tg = lambda x: np.swapaxes(  # noqa: E731
-        x.reshape((G, T) + x.shape[1:]), 0, 1)
-    run = _matrix_cache(S, V, step_ids, init_state, T, G)
-    alive, inexact = run(as_tg(r_pend), as_tg(r_ops), as_tg(r_slot),
-                         as_tg(valid))
+    rb = _bucket(R_max, floor=64)
+    C = int(np.clip(rb // 120, 8 if B == 1 else 1, 256))
+    C = max(1, min(C, MATRIX_MAX_ELEMS // (B * MV * MV)))
+    T = -(-rb // C)
+
+    def key_arrays(p):
+        r_slot, r_pend, r_ops, s_k = p
+        R = r_slot.shape[0]
+        pad = C * T - R
+        slot_p = np.concatenate([r_slot, np.zeros((pad,), np.int32)])
+        pend_p = np.zeros((C * T, S), bool)
+        pend_p[:R, :s_k] = r_pend
+        ops_p = np.zeros((C * T, S, 3), np.int64)
+        ops_p[:R, :s_k] = r_ops
+        val_p = np.concatenate([np.ones((R,), bool), np.zeros((pad,), bool)])
+        return slot_p, pend_p, ops_p, val_p
+
+    slots, pends, opss, vals = zip(*[key_arrays(p) for p in preps])
+    # Intern the batch's distinct (f, a, b) ops: the kernel receives small
+    # int32 id grids plus one [U, 3] table instead of a [T, G, S, 3] int64
+    # op tensor — an ~8x transfer cut that matters on tunneled devices,
+    # and the per-op transition matrices get built once instead of per
+    # scan step.
+    all_ops = np.concatenate([o.reshape(-1, 3) for o in opss])
+    uops, inv = np.unique(all_ops, axis=0, return_inverse=True)
+    ids = inv.astype(np.int32).reshape(B, C * T, S)
+    ub = _bucket(len(uops), floor=16)
+    uops = np.concatenate(
+        [uops, np.zeros((ub - len(uops), 3), uops.dtype)]).astype(np.int32)
+
+    def as_tg(x):
+        # [B, C*T, ...] → [B, C, T, ...] → [T, B, C, ...] → [T, B*C, ...]
+        x = np.asarray(x).reshape((B, C, T) + x.shape[2:])
+        x = np.moveaxis(x, 2, 0)
+        return x.reshape((T, B * C) + x.shape[3:])
+
+    grids = [as_tg(np.stack(pends)), as_tg(ids),
+             as_tg(np.stack(slots)), as_tg(np.stack(vals))]
+    if mesh is not None and (B * C) % mesh.devices.size == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, mesh.axis_names[0]))
+        grids = [jax.device_put(a, sh) for a in grids]
+    run = _matrix_cache(S, V, step_ids, init_state, T, C, B)
+    alive, inexact = run(grids[0], grids[1], uops, grids[2], grids[3])
     jax.block_until_ready(alive)
-    return bool(alive), -1, bool(inexact), 0
+    alive, inexact = np.asarray(alive), np.asarray(inexact)
+    return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
 
 
 _MATRIX_CACHE: dict = {}
@@ -470,11 +578,13 @@ def _default_step_ids():
     return _DEFAULT_STEP_IDS
 
 
-def _matrix_cache(S, V, step_ids, init_state, T, G):
-    key = (S, V, id(step_ids), init_state, T, G)
+def _matrix_cache(S, V, step_ids, init_state, T, C, B=1):
+    # the uop-table length is a runtime array shape — jax.jit retraces on
+    # it, so it doesn't belong in this key
+    key = (S, V, id(step_ids), init_state, T, C, B)
     fn = _MATRIX_CACHE.get(key)
     if fn is None:
-        fn = _build_matrix_kernel(S, V, step_ids, init_state, T, G)
+        fn = _build_matrix_kernel(S, V, step_ids, init_state, T, C, n_keys=B)
         _MATRIX_CACHE[key] = fn
     return fn
 
@@ -501,10 +611,9 @@ class JitLinKernel:
     """Compiled-kernel cache keyed by backend + (S, K|V, batched?)."""
 
     def __init__(self, step_ids=None, init_state: int = 0):
-        if step_ids is None:
-            from jepsen_tpu.models import cas_register_spec
-            step_ids = cas_register_spec().step_ids
-        self.step_ids = step_ids
+        # the shared default spec keeps id(step_ids)-keyed compile caches
+        # (matrix kernels) warm across kernel instances
+        self.step_ids = step_ids if step_ids is not None else _default_step_ids()
         self.init_state = init_state
         self._cache: dict = {}
 

@@ -25,29 +25,28 @@ from functools import partial
 import numpy as np
 
 
-def trim_to_cycles(n_nodes: int, src: np.ndarray, dst: np.ndarray,
-                   max_iters: int = 512):
-    """Device trim: returns a bool[n_nodes] mask of nodes surviving 2-core
-    peeling (empty => acyclic; every cycle is inside the residue). Peeling
-    removes one fringe layer per iteration, so a near-serial history (a
-    ~n-long dependency chain) would need ~n iterations to fully converge;
-    the cap keeps device time bounded and leaves a conservative residue
-    that the exact host pass classifies."""
+_TRIM_CACHE: dict = {}
+
+
+def _trim_kernel(n_nodes: int, n_edges: int, max_iters: int):
+    """Compiled trim kernel for bucketed (n_nodes, n_edges) shapes. Edge
+    arrays are runtime arguments (with a validity mask for padding), NOT
+    trace-time constants — so one compilation serves every graph in the
+    same shape bucket instead of re-jitting per call."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    if len(src) == 0 or n_nodes == 0:
-        return np.zeros(n_nodes, dtype=bool)
-
-    src_j = jnp.asarray(src, dtype=jnp.int32)
-    dst_j = jnp.asarray(dst, dtype=jnp.int32)
+    key = (n_nodes, n_edges, max_iters)
+    fn = _TRIM_CACHE.get(key)
+    if fn is not None:
+        return fn
 
     @jax.jit
-    def run():
+    def run(src_j, dst_j, valid):
         def body(carry):
             active, _, it = carry
-            edge_active = active[src_j] & active[dst_j]
+            edge_active = valid & active[src_j] & active[dst_j]
             indeg = jax.ops.segment_sum(edge_active.astype(jnp.int32), dst_j,
                                         num_segments=n_nodes)
             outdeg = jax.ops.segment_sum(edge_active.astype(jnp.int32), src_j,
@@ -65,7 +64,37 @@ def trim_to_cycles(n_nodes: int, src: np.ndarray, dst: np.ndarray,
                                                    jnp.int32(0)))
         return active
 
-    return np.asarray(run())
+    _TRIM_CACHE[key] = run
+    return run
+
+
+def trim_to_cycles(n_nodes: int, src: np.ndarray, dst: np.ndarray,
+                   max_iters: int = 512):
+    """Device trim: returns a bool[n_nodes] mask of nodes surviving 2-core
+    peeling (empty => acyclic; every cycle is inside the residue). Peeling
+    removes one fringe layer per iteration, so a near-serial history (a
+    ~n-long dependency chain) would need ~n iterations to fully converge;
+    the cap keeps device time bounded and leaves a conservative residue
+    that the exact host pass classifies.
+
+    Node and edge counts are bucketed to powers of two (padding nodes have
+    no edges and peel away in the first iteration; padding edges carry a
+    False validity bit), so nearby graph sizes share one compilation."""
+    from jepsen_tpu.ops.jitlin import _bucket
+
+    if len(src) == 0 or n_nodes == 0:
+        return np.zeros(n_nodes, dtype=bool)
+
+    nb = _bucket(n_nodes, floor=64)
+    eb = _bucket(len(src), floor=64)
+    pad = eb - len(src)
+    src_p = np.concatenate([np.asarray(src, np.int32),
+                            np.zeros(pad, np.int32)])
+    dst_p = np.concatenate([np.asarray(dst, np.int32),
+                            np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(len(src), bool), np.zeros(pad, bool)])
+    run = _trim_kernel(nb, eb, max_iters)
+    return np.asarray(run(src_p, dst_p, valid))[:n_nodes]
 
 
 def has_cycle(n_nodes: int, src, dst) -> bool:

@@ -69,24 +69,47 @@ def pad_to_multiple(batch: dict, multiple: int) -> tuple[dict, int]:
     return out, B
 
 
+_DEFAULT_KERNEL = None
+
+
+def _default_kernel():
+    """One shared default JitLinKernel — its compile cache must survive
+    across batch_check calls (a fresh instance per call would re-jit the
+    vmapped kernel every time)."""
+    global _DEFAULT_KERNEL
+    if _DEFAULT_KERNEL is None:
+        from jepsen_tpu.ops.jitlin import JitLinKernel
+        _DEFAULT_KERNEL = JitLinKernel()
+    return _DEFAULT_KERNEL
+
+
 def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
                 step_ids=None, init_state: int = 0, kernel=None):
-    """Checks a batch of per-key event streams with the vmapped jitlin
-    kernel, sharded across a device mesh when one is available. The single
-    batching implementation — JitLinKernel.check/check_batch delegate here.
+    """Checks a batch of per-key event streams, sharded across a device
+    mesh when one is available. The single batching implementation —
+    JitLinKernel.check/check_batch delegate here.
+
+    Single-device dispatch prefers the key-batched transfer-matrix kernel
+    (jitlin.matrix_check_batch) when the whole batch fits its regime —
+    all keys advance together in MXU matmuls instead of a latency-bound
+    vmapped event scan — falling back to the scan for keys the matrix
+    pass leaves undecided (not-alive or inexact) and for meshes.
 
     Returns [(alive, died_event, overflow, peak)] per stream (real keys
     only; padding keys are dropped).
     """
     import jax
-    from jepsen_tpu.checker.linear_encode import pad_streams
-    from jepsen_tpu.ops.jitlin import JitLinKernel, _bucket
+    from jepsen_tpu.ops.jitlin import (
+        EV_RETURN, MATRIX_MAX_ELEMS, MATRIX_MAX_SLOTS, MATRIX_MAX_STATES,
+        MATRIX_MIN_RETURNS, _bucket, matrix_check_batch)
 
     if kernel is None:
-        kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
+        if step_ids is None and init_state == 0:
+            kernel = _default_kernel()
+        else:
+            from jepsen_tpu.ops.jitlin import JitLinKernel
+            kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
     streams = list(streams)
-    batch = pad_streams(streams, length=_bucket(max(len(s) for s in streams)))
-    S = max(1, batch["n_slots"])
     # interned-state count selects the exact dense-table kernel when the
     # configuration space 2^S x V is small (jitlin._build_dense_step);
     # every stream must carry an intern table, else a stream with
@@ -98,6 +121,39 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
 
     if mesh is None and len(jax.devices()) > 1:
         mesh = get_mesh()
+
+    S_all = max(max(1, s.n_slots) for s in streams)
+    if n_states is not None and S_all <= MATRIX_MAX_SLOTS \
+            and n_states <= MATRIX_MAX_STATES:
+        mv = (1 << S_all) * _bucket(n_states, floor=8)
+        total_returns = sum(int((np.asarray(s.kind) == EV_RETURN).sum())
+                            for s in streams)
+        if total_returns >= MATRIX_MIN_RETURNS \
+                and len(streams) * mv * mv <= MATRIX_MAX_ELEMS:
+            results = matrix_check_batch(
+                streams, step_ids=kernel.step_ids,
+                init_state=kernel.init_state, num_states=n_states,
+                mesh=mesh)
+            undecided = [i for i, r in enumerate(results)
+                         if not r[0] or r[2]]
+            if undecided:
+                redo = _scan_batch([streams[i] for i in undecided],
+                                   capacity, mesh, kernel, n_states)
+                results = list(results)
+                for i, r in zip(undecided, redo):
+                    results[i] = r
+            return results
+
+    return _scan_batch(streams, capacity, mesh, kernel, n_states)
+
+
+def _scan_batch(streams, capacity, mesh, kernel, n_states):
+    """The vmapped event-scan path (dense or sparse frontier kernel)."""
+    from jepsen_tpu.checker.linear_encode import pad_streams
+    from jepsen_tpu.ops.jitlin import _bucket
+
+    batch = pad_streams(streams, length=_bucket(max(len(s) for s in streams)))
+    S = max(1, batch["n_slots"])
     if mesh is not None:
         n_dev = mesh.devices.size
         batch, real_b = pad_to_multiple(batch, n_dev)

@@ -88,3 +88,26 @@ def test_device_no_reads_unknown():
          {"type": "ok", "process": 0, "f": "add", "value": 1, "time": 2}]
     r = chk.SetFullChecker(accelerator="auto").check({}, h, {})
     assert r["valid?"] == "unknown"
+
+
+def test_device_member_build_rejects_coercible_payloads():
+    """The columnar member-matrix fast path must not coerce float/string
+    read elements into ints (np.asarray would turn 2.5 into 2, making a
+    lost element look present). Device and CPU paths must agree."""
+    from jepsen_tpu.checker import SetFullChecker
+
+    history = []
+    for v in range(4):
+        history.append({"type": "invoke", "process": 0, "f": "add",
+                        "value": v, "time": 2 * v})
+        history.append({"type": "ok", "process": 0, "f": "add",
+                        "value": v, "time": 2 * v + 1})
+    # element 2 vanishes from the final read, which instead carries 2.5
+    history.append({"type": "invoke", "process": 1, "f": "read",
+                    "value": None, "time": 100})
+    history.append({"type": "ok", "process": 1, "f": "read",
+                    "value": [0, 1, 2.5, 3], "time": 101})
+    dev = SetFullChecker(accelerator="tpu").check({}, history, {})
+    cpu = SetFullChecker(accelerator="cpu").check({}, history, {})
+    assert dev["valid?"] is False and cpu["valid?"] is False
+    assert dev["lost"] == cpu["lost"] == [2]
