@@ -68,15 +68,46 @@ class Ubuntu(Debian):
 
 
 class CentOS(OS):
-    """yum-based setup (os/centos.clj)."""
+    """yum/rpm-based setup (os/centos.clj).
+
+    Beyond the package list, the reference's CentOS does three RH-specific
+    things this mirrors: it patches the 127.0.0.1 line of /etc/hosts to
+    include the node's own hostname (centos.clj:12-25 — RH images often
+    miss it and Java networking breaks), it installs the C toolchain
+    (gcc/gcc-c++) that the clock nemesis needs for its on-node builds
+    (nemesis/time.py compiles bump-time.c with the node's gcc; ref
+    nemesis/time.clj:52-61), and it bootstraps ``start-stop-daemon`` —
+    absent on RH — from the dpkg source tarball (centos.clj:110-121)
+    because the shared daemon helpers depend on it.
+    """
+
+    base_packages = [
+        "wget", "gcc", "gcc-c++", "curl", "vim-common", "unzip", "rsyslog",
+        "iptables", "ncurses-devel", "iproute", "logrotate", "sudo", "tar",
+        "psmisc",
+    ]
+
+    def __init__(self, extra_packages: list[str] | None = None):
+        self.extra_packages = extra_packages or []
 
     def setup(self, test, node):
         def go():
             setup_hostfile(test)
+            patch_loopback_hostname()
             with control.su():
-                control.exec_("yum", "-y", "install", "sudo", "curl", "wget",
-                              "unzip", "tar", "iptables", "psmisc")
+                yum_maybe_update()
+                yum_install(self.base_packages + self.extra_packages)
+                install_start_stop_daemon()
+            net = test.get("net")
+            if net is not None:
+                try:
+                    net.heal(test)  # meh'd like the reference (u/meh)
+                except Exception:  # noqa: BLE001
+                    logger.exception("net heal during OS setup failed")
         control.on(node, test, go)
+
+    def teardown(self, test, node):
+        pass
 
 
 class SmartOS(OS):
@@ -141,6 +172,120 @@ def debconf_set(selection: str) -> None:
     """Pre-seeds a debconf answer (the reference's
     ``echo ... | debconf-set-selections`` pattern, galera.clj:44-46)."""
     control.exec_("debconf-set-selections", stdin=selection + "\n")
+
+
+# --- yum/rpm helpers (os/centos.clj:28-121) -------------------------------
+
+def patch_loopback_hostname() -> None:
+    """Appends the node's hostname to the 127.0.0.1 line of /etc/hosts if
+    missing (centos.clj setup-hostfile!)."""
+    name = control.exec_("hostname")
+    hosts = control.exec_("cat", "/etc/hosts")
+    changed = False
+    lines = []
+    for line in hosts.splitlines():
+        if line.startswith("127.0.0.1") and name not in line.split():
+            line = f"{line} {name}"
+            changed = True
+        lines.append(line)
+    if changed:
+        with control.su():
+            control.exec_("tee", "/etc/hosts", stdin="\n".join(lines) + "\n")
+
+
+def yum_maybe_update(max_age_s: int = 86400) -> None:
+    """yum update unless one ran in the last day, judged by the yum log's
+    mtime — missing log counts as stale (centos.clj:27-44)."""
+    control.exec_(
+        "sh", "-c",
+        f"test $(( $(date +%s) - "
+        f"$(stat -c %Y /var/log/yum.log 2>/dev/null || echo 0) )) "
+        f"-lt {max_age_s} || yum -y update")
+
+
+def yum_installed(packages) -> set:
+    """Subset of packages already installed, via rpm -q (the query side of
+    centos.clj installed — rpm answers directly instead of grepping
+    ``yum list installed``)."""
+    if isinstance(packages, str):
+        packages = [packages]
+    r = control.exec_star("rpm", "-q", "--qf", "%{NAME}\\n", *packages)
+    # rpm prints "package X is not installed" for misses ON STDOUT — only
+    # single-token lines are real package names
+    names = {line.strip() for line in r.out.splitlines()
+             if line.strip() and " " not in line.strip()}
+    return names & set(packages)
+
+
+def yum_installed_version(package: str) -> str | None:
+    """Installed version of a package, or None (centos.clj:74-86)."""
+    r = control.exec_star("rpm", "-q", "--qf", "%{VERSION}", package)
+    return r.out.strip() if r.exit_status == 0 and r.out.strip() else None
+
+
+def yum_install(packages) -> None:
+    """Ensures packages are installed; a dict pins versions
+    (centos.clj:88-107)."""
+    if isinstance(packages, dict):
+        for pkg, version in packages.items():
+            if yum_installed_version(pkg) != version:
+                control.exec_("yum", "-y", "install", f"{pkg}-{version}")
+        return
+    if isinstance(packages, str):
+        packages = [packages]
+    present = yum_installed(packages)
+    missing = [p for p in packages if p not in present]
+    if missing:
+        control.exec_("yum", "-y", "install", *missing)
+
+
+def yum_uninstall(packages) -> None:
+    """Removes the installed subset of packages (centos.clj:59-66)."""
+    if isinstance(packages, str):
+        packages = [packages]
+    installed = yum_installed(packages)
+    present = [p for p in packages if p in installed]
+    if present:
+        control.exec_("yum", "-y", "remove", *present)
+
+
+_SSD_DPKG_VERSION = "1.17.27"
+
+
+def install_start_stop_daemon() -> None:
+    """Builds start-stop-daemon from the dpkg source tarball when absent —
+    RH systems don't ship it, and the shared daemon helpers
+    (control/util.py) drive services through it (centos.clj:110-127)."""
+    if control.exec_star("test", "-x",
+                         "/usr/bin/start-stop-daemon").exit_status == 0:
+        return
+    v = _SSD_DPKG_VERSION
+    control.exec_("wget", "-nv",
+                  f"http://ftp.de.debian.org/debian/pool/main/d/dpkg/dpkg_{v}.tar.xz")
+    control.exec_("tar", "-xf", f"dpkg_{v}.tar.xz")
+    control.exec_("sh", "-c",
+                  f"cd dpkg-{v} && ./configure && make -C utils")
+    control.exec_("cp", f"dpkg-{v}/utils/start-stop-daemon",
+                  "/usr/bin/start-stop-daemon")
+    control.exec_("rm", "-rf", f"dpkg_{v}.tar.xz", f"dpkg-{v}")
+
+
+OS_REGISTRY = {
+    "debian": Debian,
+    "ubuntu": Ubuntu,
+    "centos": CentOS,
+    "smartos": SmartOS,
+    "noop": Noop,
+}
+
+
+def os_by_name(name: str) -> type[OS]:
+    """Maps a CLI ``--os`` choice to its OS class."""
+    try:
+        return OS_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown os {name!r}; choose from {sorted(OS_REGISTRY)}") from None
 
 
 debian = Debian

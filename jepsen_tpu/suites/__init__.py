@@ -118,6 +118,9 @@ def build_suite_test(o: dict | None, *, db_name: str,
         base.update(db=kv, client=client, os=None, net=NoopNet())
     else:
         base.update(make_real(o))
+        if o.get("os"):  # --os overrides the suite's default OS
+            from jepsen_tpu.os_setup import os_by_name
+            base["os"] = os_by_name(o["os"])()
 
     if make_workload is not None:
         workload = make_workload(workload_name, base)
@@ -151,6 +154,9 @@ def standard_opt_fn(supported_workloads: tuple,
         p.add_argument("--nemesis-interval", type=float,
                        default=nemesis_interval)
         p.add_argument("--no-perf", action="store_true")
+        from jepsen_tpu.os_setup import OS_REGISTRY
+        p.add_argument("--os", choices=sorted(OS_REGISTRY),
+                       help="override the suite's node OS automation")
         if extra:
             extra(p)
     return opt_fn
@@ -175,6 +181,7 @@ def standard_test_fn(suite_test: Callable,
             "faults": set(opts.faults) if opts.faults else None,
             "nemesis_interval": opts.nemesis_interval,
             "no_perf": opts.no_perf,
+            "os": getattr(opts, "os", None),
         }
         for k in extra_keys:
             o[k] = getattr(opts, k)

@@ -1,0 +1,181 @@
+"""OS automation unit tests over a scripted remote.
+
+Covers the Debian apt path and the CentOS yum/rpm path (reference:
+jepsen/src/jepsen/os/debian.clj, os/centos.clj) the way the wire-protocol
+suites are covered: every shell command is captured and asserted, with
+canned outputs for the query commands.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from jepsen_tpu import control
+from jepsen_tpu.control.core import Remote, Result
+from jepsen_tpu.os_setup import (
+    CentOS, Debian, OS_REGISTRY, install_start_stop_daemon, os_by_name,
+    patch_loopback_hostname, yum_install, yum_installed,
+    yum_installed_version, yum_maybe_update, yum_uninstall,
+)
+
+
+@dataclass
+class ScriptedRemote(Remote):
+    """Records every command; answers from a substring-keyed script."""
+
+    script: dict = field(default_factory=dict)  # substring -> (rc, out)
+    log: list = field(default_factory=list)
+    host: str | None = None
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def connect(self, conn_spec):
+        return ScriptedRemote(script=self.script, log=self.log,
+                              host=conn_spec.get("host"), _lock=self._lock)
+
+    def execute(self, ctx, cmd):
+        with self._lock:
+            self.log.append((cmd, ctx.get("stdin")))
+        for key, (rc, out) in self.script.items():
+            if key in cmd:
+                return Result(cmd=cmd, exit_status=rc, out=out, err="",
+                              host=self.host)
+        return Result(cmd=cmd, exit_status=0, out="", err="", host=self.host)
+
+    def upload(self, ctx, local_paths, remote_path):
+        pass
+
+    def download(self, ctx, remote_paths, local_path):
+        pass
+
+
+def _run_on(remote, test, fn):
+    test = dict(test)
+    test.setdefault("ssh", {})
+    test["remote"] = remote
+    return control.on("n1", test, fn)
+
+
+def _test_with(remote, nodes=("n1", "n2")):
+    return {"ssh": {}, "remote": remote, "nodes": list(nodes)}
+
+
+def test_debian_setup_installs_base_packages():
+    remote = ScriptedRemote(script={"dpkg-query": (0, "sudo\ncurl\n")})
+    Debian(extra_packages=["tcpdump"]).setup(_test_with(remote), "n1")
+    cmds = [c for c, _ in remote.log]
+    assert any("tee /etc/hosts" in c for c in cmds)
+    install = next(c for c in cmds if "apt-get install" in c)
+    assert "tcpdump" in install and "iptables" in install
+    assert "curl" not in install.split()  # already installed per dpkg-query
+
+
+def test_debian_hostfile_maps_all_nodes():
+    remote = ScriptedRemote()
+    Debian().setup(_test_with(remote, nodes=("n1", "n2", "n3")), "n1")
+    stdin = next(s for c, s in remote.log if "tee /etc/hosts" in c)
+    for n in ("n1", "n2", "n3"):
+        assert f" {n}" in stdin
+
+
+def test_centos_setup_full_path():
+    remote = ScriptedRemote(script={
+        "hostname": (0, "n1"),
+        "cat /etc/hosts": (0, "127.0.0.1 localhost\n10.0.0.2 n2"),
+        "rpm -q": (1, "curl\nwget\npackage gcc is not installed\n"),
+        "test -x /usr/bin/start-stop-daemon": (1, ""),
+    })
+    CentOS().setup(_test_with(remote), "n1")
+    cmds = [c for c, _ in remote.log]
+    # loopback patch appended the hostname to the 127.0.0.1 line
+    loop_stdin = [s for c, s in remote.log
+                  if "tee /etc/hosts" in c and s and "127.0.0.1" in s]
+    assert any("127.0.0.1 localhost n1" in s for s in loop_stdin)
+    # yum update gated on the yum log's age
+    assert any("/var/log/yum.log" in c and "yum -y update" in c
+               for c in cmds)
+    # build tools for the clock nemesis's on-node compiles are installed,
+    # already-present packages are not
+    install = next(c for c in cmds if "yum -y install" in c)
+    assert "gcc" in install.split() and "gcc-c++" in install
+    assert "curl" not in install.split()
+    # start-stop-daemon was absent, so it gets built from the dpkg tarball
+    assert any("start-stop-daemon" in c and "cp" in c for c in cmds)
+    assert any("./configure" in c for c in cmds)
+
+
+def test_centos_skips_ssd_build_when_present():
+    remote = ScriptedRemote(script={
+        "hostname": (0, "n1"),
+        "cat /etc/hosts": (0, "127.0.0.1 localhost n1"),
+        "rpm -q": (1, ""),
+        "test -x /usr/bin/start-stop-daemon": (0, ""),
+    })
+    CentOS().setup(_test_with(remote), "n1")
+    cmds = [c for c, _ in remote.log]
+    assert not any("dpkg" in c for c in cmds)
+    # loopback line already had the hostname: no hosts rewrite beyond the
+    # cluster hostfile
+    loop = [c for c, s in remote.log
+            if "tee /etc/hosts" in c and s and "localhost n1 n1" in (s or "")]
+    assert not loop
+
+
+def test_yum_helpers():
+    # rpm reports misses ON STDOUT ("package b is not installed") — the
+    # installed-set parse must not count those lines as package names
+    remote = ScriptedRemote(script={
+        "VERSION": (0, "2.17"),
+        "rpm -q": (1, "a\npackage b is not installed\nc\n"),
+    })
+
+    def go():
+        assert yum_installed(["a", "b", "c"]) == {"a", "c"}
+        yum_install(["a", "b", "c"])
+        yum_uninstall(["a", "b"])
+        yum_maybe_update()
+        assert yum_installed_version("glibc") == "2.17"
+        yum_install({"glibc": "2.17"})   # matching version: no install
+        yum_install({"glibc": "2.18"})   # mismatch: pinned install
+    _run_on(remote, {"ssh": {}}, go)
+    cmds = [c for c, _ in remote.log]
+    assert any(c.startswith("yum -y install b") for c in cmds)
+    assert any("yum -y remove a" in c for c in cmds)
+    assert any("glibc-2.18" in c for c in cmds)
+    assert not any("glibc-2.17" in c for c in cmds)
+
+
+def test_install_start_stop_daemon_builds_when_missing():
+    remote = ScriptedRemote(script={"test -x": (1, "")})
+    _run_on(remote, {"ssh": {}}, install_start_stop_daemon)
+    cmds = [c for c, _ in remote.log]
+    assert any("wget" in c and "dpkg" in c for c in cmds)
+    assert any("make -C utils" in c for c in cmds)
+
+
+def test_patch_loopback_noop_when_hostname_present():
+    remote = ScriptedRemote(script={
+        "hostname": (0, "n7"),
+        "cat /etc/hosts": (0, "127.0.0.1 localhost n7"),
+    })
+    _run_on(remote, {"ssh": {}}, patch_loopback_hostname)
+    assert not any("tee" in c for c, _ in remote.log)
+
+
+def test_os_registry_and_suite_option():
+    assert os_by_name("centos") is CentOS
+    assert set(OS_REGISTRY) == {"debian", "ubuntu", "centos", "smartos",
+                                "noop"}
+    try:
+        os_by_name("bsd")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_suite_os_override():
+    """--os centos must override a suite's default Debian automation."""
+    from jepsen_tpu.suites import etcd
+
+    test = etcd.etcd_test({"os": "centos", "nodes": ["n1"],
+                           "faults": set()})
+    assert isinstance(test["os"], CentOS)
