@@ -45,6 +45,12 @@ class LinearResult:
     failed_op_index: int = -1  # history index of that event's op
     configs_max: int = 0       # peak frontier size (for K sizing on TPU)
     algorithm: str = ""
+    # on failure: the surviving configurations just before the fatal
+    # return killed them (knossos's :configs surface, checker.clj:205-212),
+    # truncated to 10 like the reference ("Writing these can take hours").
+    # Each is {"state": model-state value-or-id, "linearized": [history
+    # op-index...], "pending": [history op-index...]}.
+    final_configs: list | None = None
 
 
 def check_stream(
@@ -57,6 +63,7 @@ def check_stream(
     event (Lowe's 'just-in-time linearization')."""
     configs: set[tuple[int, int]] = {(0, init_state)}
     cur: dict[int, tuple[int, int, int]] = {}
+    cur_idx: dict[int, int] = {}   # slot -> history index of its open op
     pending_mask = 0
     configs_max = 1
     for e in range(len(stream)):
@@ -67,6 +74,7 @@ def check_stream(
         bit = 1 << s
         if kind == EV_INVOKE:
             cur[s] = (int(stream.f[e]), int(stream.a[e]), int(stream.b[e]))
+            cur_idx[s] = int(stream.op_index[e])
             pending_mask |= bit
             continue
         # EV_RETURN: closure, then require this op linearized
@@ -93,10 +101,24 @@ def check_stream(
         configs = {(mask & ~bit, state) for (mask, state) in all_seen if mask & bit}
         pending_mask &= ~bit
         if not configs:
+            def op_indices(mask):
+                return [cur_idx[t] for t in cur_idx if mask & (1 << t)]
+
+            def state_val(st):
+                try:
+                    return stream.intern.value(st)
+                except (IndexError, AttributeError):
+                    return st
+
+            finals = [{"state": state_val(state),
+                       "linearized": sorted(op_indices(mask)),
+                       "pending": sorted(op_indices(pending_mask & ~mask))}
+                      for mask, state in sorted(all_seen)[:10]]
             return LinearResult(
                 valid=False, failed_event=e,
                 failed_op_index=int(stream.op_index[e]),
                 configs_max=configs_max, algorithm="jitlin-cpu",
+                final_configs=finals,
             )
     return LinearResult(valid=True, configs_max=configs_max, algorithm="jitlin-cpu")
 

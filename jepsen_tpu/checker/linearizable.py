@@ -17,6 +17,7 @@ to 10 because "Writing these can take *hours*", checker.clj:213-216).
 """
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 from jepsen_tpu.checker import Checker
@@ -26,9 +27,15 @@ from jepsen_tpu.checker.linear_cpu import (
 from jepsen_tpu.checker.linear_encode import encode_register_ops
 from jepsen_tpu.models import CASRegister, Model
 
+logger = logging.getLogger("jepsen.checker.linearizable")
+
 # Histories below this many events run on CPU under accelerator="auto":
 # kernel launch + compile isn't worth it.
 AUTO_TPU_THRESHOLD = 512
+
+# Failure reports re-run the exact CPU search to recover the dying
+# frontier; skip that recovery for histories longer than this.
+MAX_REPORT_EVENTS = 200_000
 
 
 class LinearizableChecker(Checker):
@@ -56,12 +63,12 @@ class LinearizableChecker(Checker):
         accelerator = opts.get("accelerator", self.accelerator)
 
         if algorithm == "wgl":
-            return self._finish(wgl(history, self.model), history)
+            return self._finish(wgl(history, self.model), history, test)
 
         # jitlin path: encode once, run on device or host
         if not isinstance(self.model, CASRegister):
             # only the register family has an int encoding so far
-            return self._finish(wgl(history, self.model), history)
+            return self._finish(wgl(history, self.model), history, test)
         stream = encode_register_ops(history)
         if accelerator == "cpu" or (
             accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
@@ -78,7 +85,7 @@ class LinearizableChecker(Checker):
                     res = check_stream(stream)
             else:
                 res = wgl(history, self.model)
-            return self._finish(res, history)
+            return self._finish(res, history, test, stream)
 
         # device path. For long histories over small value domains, the
         # block-composed transfer-matrix kernel settles the verdict with
@@ -90,7 +97,7 @@ class LinearizableChecker(Checker):
             return self._finish(LinearResult(
                 valid=True, failed_event=-1, failed_op_index=-1,
                 configs_max=0, algorithm="jitlin-tpu-matrix"),
-                history)
+                history, test)
         alive, died, overflow, peak = self._tpu_kernel().check(
             stream, capacity=self.capacity
         )
@@ -99,7 +106,7 @@ class LinearizableChecker(Checker):
             # frontier overflowed K and died: retry with the exact CPU twin
             res = check_stream(stream)
             res.algorithm = "jitlin-cpu(fallback)"
-            return self._finish(res, history)
+            return self._finish(res, history, test, stream)
         res = LinearResult(
             valid=valid,
             failed_event=died,
@@ -107,9 +114,10 @@ class LinearizableChecker(Checker):
             configs_max=peak,
             algorithm="jitlin-tpu",
         )
-        return self._finish(res, history)
+        return self._finish(res, history, test, stream)
 
-    def _finish(self, res: LinearResult, history) -> dict:
+    def _finish(self, res: LinearResult, history, test=None,
+                stream=None) -> dict:
         out: dict[str, Any] = {
             "valid?": res.valid,
             "algorithm": res.algorithm,
@@ -120,7 +128,36 @@ class LinearizableChecker(Checker):
             lo = max(0, i - 5)
             out["failed-op"] = history[i] if i < len(history) else None
             out["context"] = history[lo : i + 1][-10:]
+            # device verdicts carry no frontier detail: one exact CPU pass
+            # recovers the dying configurations for the report (the
+            # knossos :configs surface). Gated by length — the history was
+            # routed to the device because host search may be slow, and a
+            # report must never cost more than the verdict.
+            if res.final_configs is None and stream is not None \
+                    and len(stream) <= MAX_REPORT_EVENTS:
+                try:
+                    res2 = check_stream(stream)
+                    if res2.valid is False:
+                        res.final_configs = res2.final_configs
+                except Exception:  # noqa: BLE001 report detail is optional
+                    logger.exception("final-configs recovery failed")
+            if res.final_configs is not None:
+                out["final-configs"] = res.final_configs
+            out["plot"] = self._render(res, history, test)
         return out
+
+    def _render(self, res, history, test) -> str | None:
+        """linear.png into the test's store dir (checker.clj:205-212)."""
+        if test is None:
+            return None
+        try:
+            from jepsen_tpu import store
+            from jepsen_tpu.checker.linear_report import render_failure
+            path = str(store.path_mk(test, "linear.png"))
+            return render_failure(history, res, path)
+        except Exception:  # noqa: BLE001  rendering must not mask verdicts
+            logger.exception("linear.png rendering failed")
+            return None
 
 
 def linearizable(model=None, **kw) -> Checker:

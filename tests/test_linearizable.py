@@ -299,3 +299,175 @@ def test_matrix_kernel_shape_bucketing():
     MV = 4096
     G = max(1, min(256, MATRIX_MAX_ELEMS // (MV * MV)))
     assert G * MV * MV <= MATRIX_MAX_ELEMS
+
+
+def test_returns_prepass_vectorized_differential():
+    """The vectorized matrix-kernel prepass must agree event-for-event
+    with the straightforward per-event walk it replaced."""
+    import numpy as np
+    from jepsen_tpu.ops.jitlin import EV_INVOKE, EV_RETURN, _returns_prepass
+
+    def walk(kind, slot, f, a, b):
+        fabs = np.stack([f, a, b], axis=1)
+        S = int(slot.max(initial=0)) + 1
+        cur = np.zeros((S, 3), np.int64)
+        pend = np.zeros((S,), bool)
+        r_slot, r_pend, r_ops = [], [], []
+        for i in range(kind.shape[0]):
+            k, s = int(kind[i]), int(slot[i])
+            if k == EV_INVOKE:
+                cur[s] = fabs[i]
+                pend[s] = True
+            elif k == EV_RETURN:
+                r_slot.append(s)
+                r_pend.append(pend.copy())
+                r_ops.append(cur.copy())
+                pend[s] = False
+        if not r_slot:
+            return (np.zeros((0,), np.int32), np.zeros((0, S), bool),
+                    np.zeros((0, S, 3), np.int64), S)
+        return (np.asarray(r_slot, np.int32), np.stack(r_pend),
+                np.stack(r_ops), S)
+
+    rng = np.random.default_rng(7)
+    for trial in range(100):
+        E, S = int(rng.integers(1, 80)), int(rng.integers(1, 6))
+        kind, slot, pend = [], [], set()
+        for _ in range(E):
+            r = rng.random()
+            if (r < 0.25 and pend) or (r < 0.85 and len(pend) == S):
+                s = int(rng.choice(sorted(pend)))
+                pend.discard(s)
+                kind.append(EV_RETURN)
+            elif r < 0.85:
+                s = int(rng.choice([x for x in range(S) if x not in pend]))
+                pend.add(s)
+                kind.append(EV_INVOKE)
+            else:
+                s = 0
+                kind.append(2)  # noop
+            slot.append(s)
+        kind, slot = np.array(kind), np.array(slot)
+        f = rng.integers(0, 3, E)
+        a = rng.integers(0, 9, E)
+        b = rng.integers(0, 9, E)
+        got = _returns_prepass(kind, slot, f, a, b)
+        want = walk(kind, slot, f, a, b)
+        assert got[3] == want[3], trial
+        for g, w in zip(got[:3], want[:3]):
+            assert np.array_equal(g, w), trial
+
+
+def test_matrix_check_batch_differential_and_dispatch(monkeypatch):
+    """batch_check must route in-regime batches through the key-batched
+    transfer-matrix kernel and still agree per-key with the CPU oracle —
+    including invalid keys, which fall back to the event scan for
+    diagnostics."""
+    import jepsen_tpu.ops.jitlin as jitlin
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.checker.linear_cpu import check_stream
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.parallel import batch_check
+
+    histories = []
+    for k in range(8):
+        h = _register_history(500, n_procs=4, seed=500 + k, n_values=5)
+        if k % 3 == 2:  # corrupt: read a value never written
+            reads = [op for op in h
+                     if op.get("f") == "read" and op.get("type") == "ok"]
+            reads[len(reads) // 2]["value"] = 999
+        histories.append(h)
+    streams = [encode_register_ops(h) for h in histories]
+
+    calls = []
+    real = jitlin.matrix_check_batch
+
+    def spy(*a, **kw):
+        calls.append(len(a[0]))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(jitlin, "matrix_check_batch", spy)
+    results = batch_check(streams, capacity=256)
+    assert calls == [8], "in-regime batch must take the matrix path"
+    for i, (s, r) in enumerate(zip(streams, results)):
+        want = check_stream(s).valid
+        assert (r[0] and not r[2]) == (want is True), (i, r, want)
+
+
+def test_linearizable_checker_selects_matrix_path():
+    """The device dispatch must pick the transfer-matrix kernel for long
+    small-value-domain histories (its home regime)."""
+    import jax
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    if not jax.devices():
+        return
+    h = _register_history(3000, n_procs=4, seed=11, n_values=5)
+    res = LinearizableChecker(accelerator="tpu").check({}, h, {})
+    assert res["valid?"] is True
+    assert res["algorithm"] == "jitlin-tpu-matrix", res["algorithm"]
+
+
+# ---------------------------------------------------------------------------
+# failure rendering (reference: linear.svg, checker.clj:205-212)
+# ---------------------------------------------------------------------------
+
+def _failing_history():
+    return [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 1, "f": "write", "value": 2},
+        {"type": "ok", "process": 1, "f": "write", "value": 2},
+        {"type": "invoke", "process": 0, "f": "read", "value": None},
+        {"type": "ok", "process": 0, "f": "read", "value": 1},  # stale!
+    ]
+
+
+def test_check_stream_captures_final_configs():
+    from jepsen_tpu.checker.linear_cpu import check_stream
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+
+    res = check_stream(encode_register_ops(_failing_history()))
+    assert res.valid is False
+    assert res.final_configs, "dying frontier must be captured"
+    for c in res.final_configs:
+        assert set(c) == {"state", "linearized", "pending"}
+    # just before the fatal read returns, the register held 2
+    assert any(c["state"] == 2 for c in res.final_configs)
+
+
+def test_linear_png_written_on_failure(tmp_path):
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    test = {"name": "lin-fail", "start_time": "20260730T000000",
+            "store_dir": str(tmp_path)}
+    out = LinearizableChecker(accelerator="cpu").check(
+        test, _failing_history(), {})
+    assert out["valid?"] is False
+    assert out["final-configs"]
+    plot = out.get("plot")
+    assert plot and plot.endswith("linear.png")
+    import os
+    assert os.path.getsize(plot) > 0
+
+
+def test_linear_png_device_path_recovers_configs(tmp_path):
+    """A device verdict has no frontier detail; the report path re-runs
+    the CPU twin to recover the dying configurations."""
+    import jax
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    if not jax.devices():
+        return
+    h = _register_history(800, n_procs=4, seed=77, n_values=5)
+    reads = [op for op in h
+             if op.get("f") == "read" and op.get("type") == "ok"]
+    reads[-1]["value"] = 999  # a value never written
+    test = {"name": "lin-fail-tpu", "start_time": "20260730T000001",
+            "store_dir": str(tmp_path)}
+    out = LinearizableChecker(accelerator="tpu").check(test, h, {})
+    assert out["valid?"] is False
+    assert out["final-configs"]
+    assert out.get("plot", "").endswith("linear.png")
