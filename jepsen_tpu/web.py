@@ -30,12 +30,29 @@ a { text-decoration: none; }
 """
 
 
+_VALIDITY_CACHE: dict[str, tuple[int, object]] = {}
+
+
 def _validity(run_dir: Path):
+    """Cached results validity (the reference memoizes result loading —
+    web.clj:48-69 fast-tests — because re-parsing every run per request
+    doesn't scale). Keyed on the results file's mtime, so re-analysis
+    invalidates naturally."""
+    f = run_dir / "results.json"
     try:
-        with open(run_dir / "results.json") as f:
-            return json.load(f).get("valid?")
-    except Exception:  # noqa: BLE001
+        mtime = f.stat().st_mtime_ns
+    except OSError:
         return None
+    hit = _VALIDITY_CACHE.get(str(f))
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    try:
+        with open(f) as fh:
+            valid = json.load(fh).get("valid?")
+    except Exception:  # noqa: BLE001
+        valid = None
+    _VALIDITY_CACHE[str(f)] = (mtime, valid)
+    return valid
 
 
 class Handler(BaseHTTPRequestHandler):

@@ -7,8 +7,12 @@ The sum scan is a columnar O(n) reduction.
 """
 from __future__ import annotations
 
+import logging
+
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker import Checker
+
+logger = logging.getLogger("jepsen.workloads.bank")
 
 
 def read_op(test, ctx):
@@ -71,13 +75,71 @@ class BankChecker(Checker):
         }
 
 
+class BankPlotter(Checker):
+    """Balance-over-time plot (bank.clj:143-177 plotter): the total of
+    all accounts seen by each ok read, one series per node (process mod
+    node-count), with nemesis activity shaded. A healthy run draws one
+    flat line at total-amount; anomalies show up as excursions."""
+
+    def name(self):
+        return "plot"
+
+    def check(self, test, history, opts):
+        try:
+            points_by_node: dict[str, list[tuple[float, float]]] = {}
+            nodes = test.get("nodes") or []
+            for op in history:
+                if op.get("type") != "ok" or op.get("f") != "read":
+                    continue
+                balances = op.get("value")
+                if not isinstance(balances, dict):
+                    continue
+                p = op.get("process")
+                node = (str(nodes[p % len(nodes)])
+                        if nodes and isinstance(p, int) else str(p))
+                total = sum(v for v in balances.values() if v is not None)
+                points_by_node.setdefault(node, []).append(
+                    (op.get("time", 0) / 1e9, total))
+            if not points_by_node:
+                return {"valid?": True}
+            from jepsen_tpu import store
+            from jepsen_tpu.checker.perf_plots import _figure, _shade_nemesis
+            plt, fig, ax = _figure()
+            _shade_nemesis(ax, history)
+            for node, pts in sorted(points_by_node.items()):
+                xs = [x for x, _ in pts]
+                ys = [y for _, y in pts]
+                ax.plot(xs, ys, "x", ms=4, label=node)
+            ax.set_xlabel("time (s)")
+            ax.set_ylabel("Total of all accounts")
+            ax.set_title(f"{test.get('name', 'test')} bank")
+            ax.legend(loc="upper right", fontsize=8)
+            d = opts.get("subdirectory")
+            path = store.path_mk(test, *filter(None, [d, "bank.png"]))
+            fig.savefig(path, bbox_inches="tight")
+            plt.close(fig)
+            return {"valid?": True, "plot": str(path)}
+        except Exception:  # noqa: BLE001  plotting must not fail the test
+            logger.exception("bank plot failed")
+            return {"valid?": True}
+
+
 def checker(negative_balances: bool = False) -> Checker:
     return BankChecker(negative_balances)
 
 
+def plotter() -> Checker:
+    return BankPlotter()
+
+
 def workload(test: dict | None = None, negative_balances: bool = False,
              **_) -> dict:
-    """Test bundle (bank.clj:179-192): supplies accounts/total defaults."""
+    """Test bundle (bank.clj:179-192): supplies accounts/total defaults;
+    the checker composes the snapshot-isolation check with the
+    balance-over-time plot exactly like the reference's
+    ``{:SI (checker) :plot (plotter)}``."""
+    from jepsen_tpu import checker as chk
+
     accounts = list(range(8))
     return {
         "accounts": accounts,
@@ -85,5 +147,6 @@ def workload(test: dict | None = None, negative_balances: bool = False,
         "total-amount": 10 * len(accounts),
         "max-transfer": 5,
         "generator": generator(),
-        "checker": checker(negative_balances),
+        "checker": chk.compose({"SI": checker(negative_balances),
+                                "plot": plotter()}),
     }

@@ -64,6 +64,10 @@ class LongForkChecker(Checker):
     def check(self, test, history, opts):
         reads_by_group: dict[int, list[tuple[dict, tuple]]] = defaultdict(list)
         early_read_errors = []
+        # early/late accounting (long_fork.clj:290-321): a read observing
+        # nothing yet (all nil) or everything (all written) can't witness
+        # a fork — report how much of the read budget was wasted on them
+        reads_count = early_reads = late_reads = 0
         for op in history:
             if op.get("type") != "ok" or op.get("f") != "txn":
                 continue
@@ -71,6 +75,11 @@ class LongForkChecker(Checker):
             rs = [m for m in mops if m[0] == "r"]
             if not rs or len(rs) != len(mops):
                 continue  # write txn
+            reads_count += 1
+            if all(m[2] is None for m in rs):
+                early_reads += 1
+            elif all(m[2] is not None for m in rs):
+                late_reads += 1
             keys = sorted(_hk(m[1]) for m in rs)
             g = group_of(keys[0], self.group_size)
             if keys != group_keys(g, self.group_size):
@@ -97,6 +106,9 @@ class LongForkChecker(Checker):
             "forks": forks[:10],
             "fork-count": len(forks),
             "read-errors": early_read_errors[:10],
+            "reads-count": reads_count,
+            "early-read-count": early_reads,
+            "late-read-count": late_reads,
         }
 
 

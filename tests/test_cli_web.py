@@ -87,3 +87,19 @@ def test_columnar_sidecar_round_trip(tmp_path):
     # f codes decode back to op names through the table
     assert col.f_table[int(col.fs[0])] == "write"
     assert col.f_table[int(col.fs[2])] == "read"
+
+
+def test_web_validity_cache_invalidates_on_mtime(tmp_path):
+    import os
+    from jepsen_tpu.web import _validity, _VALIDITY_CACHE
+
+    run = tmp_path / "t" / "ts"
+    run.mkdir(parents=True)
+    f = run / "results.json"
+    f.write_text('{"valid?": true}')
+    assert _validity(run) is True
+    assert _validity(run) is True  # served from cache
+    assert str(f) in _VALIDITY_CACHE
+    f.write_text('{"valid?": false}')
+    os.utime(f, ns=(1, 1))  # force a distinct mtime
+    assert _validity(run) is False  # mtime change invalidated the entry

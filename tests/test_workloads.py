@@ -279,3 +279,39 @@ def test_queue_duplicate_delivery_is_not_unexpected():
     h2 = h + [op("invoke", 1, "dequeue"), op("ok", 1, "dequeue", 99)]
     res2 = w["checker"].check({}, h2, {})
     assert res2["valid?"] is False and res2["unexpected"] == [99]
+
+
+def test_bank_plotter_writes_png(tmp_path):
+    t = {**bank_test(), "name": "bank-plot", "start_time": "t0",
+         "store_dir": str(tmp_path), "nodes": ["n1", "n2"]}
+    h = [
+        op("invoke", 0, "read"), op("ok", 0, "read", {0: 10, 1: 10}),
+        op("invoke", 1, "read"), op("ok", 1, "read", {0: 10, 1: 10}),
+    ]
+    for i, o in enumerate(h):
+        o["time"] = i * 10**9
+    r = bank.plotter().check(t, h, {})
+    assert r["valid?"] is True
+    import os
+    assert r["plot"].endswith("bank.png") and os.path.getsize(r["plot"]) > 0
+    # the workload's composed checker runs SI + plot together
+    rc = t["checker"].check(t, h, {})
+    assert rc["valid?"] is True and "plot" in rc
+
+
+def test_long_fork_read_accounting():
+    from jepsen_tpu.workloads import long_fork
+    chk = long_fork.checker(group_size=2)
+    h = [
+        # early: nothing written yet
+        op("ok", 0, "txn", [["r", 0, None], ["r", 1, None]]),
+        # partial: witnesses the intermediate state
+        op("ok", 1, "txn", [["r", 0, 1], ["r", 1, None]]),
+        # late: everything written
+        op("ok", 0, "txn", [["r", 0, 1], ["r", 1, 1]]),
+    ]
+    r = chk.check({}, h, {})
+    assert r["valid?"] is True
+    assert r["reads-count"] == 3
+    assert r["early-read-count"] == 1
+    assert r["late-read-count"] == 1
