@@ -234,15 +234,22 @@ class SetFullChecker(Checker):
 
     def check(self, test, history, opts):
         accelerator = opts.get("accelerator", self.accelerator)
+        fallback = False
         if accelerator in ("auto", "tpu"):
             try:
                 return self._check_device(test, history, opts)
             except Exception:  # noqa: BLE001  device path is an optimization
                 if accelerator == "tpu":
                     raise
-                logger.exception("set-full device path failed; "
-                                 "falling back to CPU")
-        return self._check_cpu(test, history, opts)
+                # visible, counted fallback: a silent one would hide a
+                # perf regression behind identical-looking results
+                logger.warning("set-full device path failed; falling back "
+                               "to CPU", exc_info=True)
+                fallback = True
+        out = self._check_cpu(test, history, opts)
+        if fallback:
+            out["device-fallback"] = True
+        return out
 
     def _check_device(self, test, history, opts):
         import numpy as np

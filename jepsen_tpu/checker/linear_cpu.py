@@ -110,9 +110,12 @@ def check_stream(
                 except (IndexError, AttributeError):
                     return st
 
+            # the fatal op WAS pending when these configs died — its bit
+            # was cleared from pending_mask just above, so restore it
+            fatal_pending = pending_mask | bit
             finals = [{"state": state_val(state),
                        "linearized": sorted(op_indices(mask)),
-                       "pending": sorted(op_indices(pending_mask & ~mask))}
+                       "pending": sorted(op_indices(fatal_pending & ~mask))}
                       for mask, state in sorted(all_seen)[:10]]
             return LinearResult(
                 valid=False, failed_event=e,

@@ -93,7 +93,9 @@ class LinearizableChecker(Checker):
         # the event scan remains the diagnostics path (died-at, peak).
         from jepsen_tpu.ops.jitlin import matrix_check, verdict
         m = matrix_check(stream)
-        if m is not None and m[0]:
+        # accept only an exact matrix True: m[2] (inexact/oob) means a
+        # state id escaped the intern range, so the verdict proves nothing
+        if m is not None and m[0] and not m[2]:
             return self._finish(LinearResult(
                 valid=True, failed_event=-1, failed_op_index=-1,
                 configs_max=0, algorithm="jitlin-tpu-matrix"),

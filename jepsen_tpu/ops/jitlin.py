@@ -385,6 +385,11 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
         (computed once per run, gathered per step) + [U] oob flags."""
         def one(fab):
             st2, ok = step_ids(v_range, fab[0], fab[1], fab[2])
+            # INVARIANT: transitions leaving [0, V) are DROPPED (the
+            # equality below can't match), under-approximating
+            # reachability — so alive=True with oob set proves nothing
+            # and callers must treat it as unknown, never as valid. The
+            # oob flag is how that escape is surfaced.
             oob = (ok & ((st2 < 0) | (st2 >= V))).any()
             return (ok[:, None] & (st2[:, None] == v_range[None, :])), oob
         mt, oob = jax.vmap(one)(uops)

@@ -53,6 +53,7 @@ class PGSuiteClient(Client):
                  isolation: str = "serializable",
                  endpoint_mode: str = "node", txn_style: str = "append",
                  ts_expr: str = DEFAULT_TS_EXPR,
+                 logical_ts: bool = False,
                  timeout_s: float = 10.0, node: str | None = None):
         self.port = port
         self.database = database
@@ -64,6 +65,11 @@ class PGSuiteClient(Client):
         # list-append); "wr": they read registers (Elle rw-register)
         self.txn_style = txn_style
         self.ts_expr = ts_expr
+        # wall-clock ts_exprs (the clock_timestamp() default) make the
+        # monotonic workload meaningless under a clock nemesis — the
+        # checker downgrades to "unknown" in that combination. Suites with
+        # a logical/HLC expression (cockroach) set logical_ts=True.
+        self.logical_ts = logical_ts
         self.timeout_s = timeout_s
         self.node = node
         self.conn: PGConnection | None = None
@@ -88,6 +94,7 @@ class PGSuiteClient(Client):
                        isolation=self.isolation,
                        endpoint_mode=self.endpoint_mode,
                        txn_style=self.txn_style, ts_expr=self.ts_expr,
+                       logical_ts=self.logical_ts,
                        timeout_s=self.timeout_s, node=node)
         c._connect(test)
         return c

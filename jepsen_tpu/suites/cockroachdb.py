@@ -131,7 +131,7 @@ def cockroachdb_test(opts_dict: dict | None = None) -> dict:
             "client": PGSuiteClient(
                 port=SQL_PORT, database=DB_NAME, user="root", password="",
                 isolation="serializable",
-                ts_expr="cluster_logical_timestamp()",
+                ts_expr="cluster_logical_timestamp()", logical_ts=True,
                 txn_style="wr" if workload in ("wr", "long-fork")
                 else "append"),
             "os": Debian()})

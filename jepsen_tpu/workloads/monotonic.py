@@ -35,17 +35,46 @@ def final_read():
     return gen.once(gen.Fn(read))
 
 
-def non_monotonic(pairs: list) -> list:
-    """Adjacent [val, ts] pairs (sorted by ts) whose values do not
-    strictly increase (monotonic.clj:147-154)."""
-    bad = []
-    for a, b in zip(pairs, pairs[1:]):
-        if not a[0] < b[0]:
-            bad.append([a, b])
-    return bad
+def non_monotonic(rows: list) -> tuple[list, list]:
+    """Classifies adjacent rows of a ts-sorted [(ts, [val, ts]), ...]
+    sequence (monotonic.clj:147-154): returns (off_order, ambiguous)
+    pair lists. Equal-timestamp neighbours have no knowable order, so
+    they are ambiguous regardless of value order — judged before the
+    value comparison so the count doesn't depend on the DB's row-return
+    order for ties."""
+    off_order, ambiguous = [], []
+    for (ta, a), (tb, b) in zip(rows, rows[1:]):
+        if ta == tb:
+            ambiguous.append([a, b])
+        elif not a[0] < b[0]:
+            off_order.append([a, b])
+    return off_order, ambiguous
+
+
+CLOCK_NEMESIS_FS = {"reset", "bump", "strobe"}
+
+
+def _clock_nemesis_active(history) -> bool:
+    return any(not isinstance(op.get("process"), int)
+               and op.get("f") in CLOCK_NEMESIS_FS for op in history)
 
 
 class MonotonicChecker(Checker):
+    """Timestamp-order monotonicity (monotonic.clj:147-210), with three
+    honesty refinements over a naive sort-and-compare:
+
+    * rows whose timestamp doesn't parse are reported separately and
+      force ``valid? "unknown"`` — a data/parsing problem must not
+      masquerade as a serializability violation;
+    * adjacent rows with EQUAL timestamps have no knowable order, so
+      they're counted as ``ambiguous-pairs`` rather than off-order;
+    * when the history contains clock-nemesis activity and the client's
+      timestamps are wall-clock (``client.logical_ts`` is False — the
+      postgres-family default ``clock_timestamp()``; cockroach's HLC sets
+      True), off-order pairs are expected even on a healthy serializable
+      DB, so the verdict degrades to ``"unknown"`` instead of convicting.
+    """
+
     def name(self):
         return "monotonic"
 
@@ -58,33 +87,55 @@ class MonotonicChecker(Checker):
             return {"valid?": "unknown", "error": "no final read"}
         from decimal import Decimal, InvalidOperation
 
-        def ts_key(r):
-            # timestamps arrive as strings (HLC decimals overflow float
-            # precision) or numbers; Decimal compares both exactly
+        rows, unparseable = [], []
+        for r in final.get("value") or []:
             try:
-                return Decimal(str(r[1]))
-            except InvalidOperation:
-                return Decimal(0)
-
-        rows = [list(r) for r in (final.get("value") or [])]
-        rows.sort(key=ts_key)
-        off_order = non_monotonic(rows)
-        vals = [r[0] for r in rows]
+                row = list(r)
+                rows.append((Decimal(str(row[1])), row))
+            except (InvalidOperation, TypeError, ValueError, IndexError):
+                # any malformed row (short, scalar, unparseable ts) lands
+                # here — including ones list() itself can't take
+                try:
+                    unparseable.append(list(r))
+                except TypeError:
+                    unparseable.append([r, None])
+        rows.sort(key=lambda p: p[0])
+        off_order, ambiguous = non_monotonic(rows)
+        vals = [r[0] for _, r in rows] + [r[0] for r in unparseable
+                                         if r and r[0] is not None]
         from collections import Counter
         dups = sorted(v for v, n in Counter(vals).items() if n > 1)
         # every acknowledged insert must be present in the final read
         acked = {op.get("value") for op in history
                  if op.get("type") == "ok" and op.get("f") == "inc"}
         lost = sorted(acked - set(vals))
-        return {
-            "valid?": not off_order and not dups and not lost,
-            "row-count": len(rows),
+        valid = not off_order and not dups and not lost
+        note = None
+        if unparseable:
+            valid = "unknown" if valid is True else valid
+            note = "unparseable timestamps: no ordering verdict"
+        if off_order and not dups and not lost and _clock_nemesis_active(
+                history) and getattr(test.get("client"), "logical_ts",
+                                     None) is False:
+            valid = "unknown"
+            note = ("wall-clock timestamps under a clock nemesis: "
+                    "off-order pairs are not evidence against the DB")
+        out = {
+            "valid?": valid,
+            "row-count": len(rows) + len(unparseable),
             "off-order-pairs": off_order[:10],
             "off-order-count": len(off_order),
+            "ambiguous-pairs": ambiguous[:10],
+            "ambiguous-count": len(ambiguous),
+            "unparseable-ts": unparseable[:10],
+            "unparseable-count": len(unparseable),
             "duplicates": dups[:10],
             "lost": lost[:10],
             "lost-count": len(lost),
         }
+        if note:
+            out["note"] = note
+        return out
 
 
 def checker() -> Checker:

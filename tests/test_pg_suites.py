@@ -143,6 +143,40 @@ def test_monotonic_checker_flags_lost_inserts():
     assert out["lost"] == [5]
 
 
+def test_monotonic_unparseable_ts_is_unknown():
+    # a parsing problem must not masquerade as a serializability verdict
+    out = monotonic.checker().check(
+        {}, _final_read([[0, "1.0"], [1, "garbage"], [2, "2.0"]]), {})
+    assert out["valid?"] == "unknown"
+    assert out["unparseable-count"] == 1
+    assert out["unparseable-ts"] == [[1, "garbage"]]
+
+
+def test_monotonic_equal_ts_is_ambiguous_not_off_order():
+    out = monotonic.checker().check(
+        {}, _final_read([[0, "1.0"], [2, "2.0"], [1, "2.0"]]), {})
+    assert out["valid?"] is True
+    assert out["off-order-count"] == 0
+    assert out["ambiguous-count"] == 1
+
+
+def test_monotonic_wallclock_plus_clock_nemesis_is_unknown():
+    class _C:
+        logical_ts = False
+
+    nemesis_op = {"type": "info", "process": "nemesis", "f": "bump",
+                  "value": {"n1": 1000}}
+    h = [nemesis_op] + _final_read([[0, "1.0"], [2, "2.0"], [1, "3.0"]])
+    out = monotonic.checker().check({"client": _C()}, h, {})
+    assert out["valid?"] == "unknown"
+    assert out["off-order-count"] >= 1  # still reported, just not convicted
+    # a logical/HLC timestamp keeps full conviction power
+    class _L:
+        logical_ts = True
+    out = monotonic.checker().check({"client": _L()}, h, {})
+    assert out["valid?"] is False
+
+
 # ---------------------------------------------------------------------------
 # sequential checker semantics
 # ---------------------------------------------------------------------------

@@ -111,3 +111,22 @@ def test_device_member_build_rejects_coercible_payloads():
     cpu = SetFullChecker(accelerator="cpu").check({}, history, {})
     assert dev["valid?"] is False and cpu["valid?"] is False
     assert dev["lost"] == cpu["lost"] == [2]
+
+
+def test_set_full_device_fallback_is_counted(monkeypatch):
+    """An auto-mode device failure must fall back loudly: CPU result plus
+    a device-fallback marker (a silent fallback hides perf regressions)."""
+    from jepsen_tpu.checker import SetFullChecker
+
+    chk = SetFullChecker(accelerator="auto")
+    monkeypatch.setattr(SetFullChecker, "_check_device",
+                        lambda self, *a: (_ for _ in ()).throw(RuntimeError))
+    history = [
+        {"type": "invoke", "process": 0, "f": "add", "value": 1, "time": 0},
+        {"type": "ok", "process": 0, "f": "add", "value": 1, "time": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None, "time": 2},
+        {"type": "ok", "process": 1, "f": "read", "value": [1], "time": 3},
+    ]
+    out = chk.check({}, history, {})
+    assert out["valid?"] is True
+    assert out["device-fallback"] is True
