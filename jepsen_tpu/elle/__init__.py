@@ -31,6 +31,7 @@ REALTIME, PROCESS = "realtime", "process"
 # elle's anomaly/model mapping)
 ANOMALY_SEVERITY = {
     "G0": "read-uncommitted",
+    "cyclic-versions": "read-uncommitted",
     "G1a": "read-committed",
     "G1b": "read-committed",
     "G1c": "read-committed",
@@ -50,7 +51,7 @@ SERIALIZABLE_BLOCKERS = {"G0", "G1a", "G1b", "G1c", "G-single", "G2",
 # anomalies proscribed by each consistency model (Adya's hierarchy, the
 # shape of elle's consistency-model option)
 _RU = {"G0", "duplicate-elements", "incompatible-order", "duplicate-appends",
-       "duplicate-writes"}
+       "duplicate-writes", "cyclic-versions"}
 _RC = _RU | {"G1a", "G1b", "G1c", "internal"}
 MODEL_ANOMALIES = {
     "read-uncommitted": _RU,

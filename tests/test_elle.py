@@ -773,3 +773,79 @@ def test_mixed_process_and_realtime_cycle_detected():
     serial = list_append.check(h, accelerator="cpu",
                                consistency_models=("serializable",))
     assert serial["valid?"] is True, serial
+
+
+# ---------------------------------------------------------------------------
+# richer rw-register version-order inference (round-2 strengthening)
+# ---------------------------------------------------------------------------
+
+def _rw_history(txns, procs=3):
+    h = []
+    for i, ops in enumerate(txns):
+        h.append({"type": "invoke", "f": "txn", "process": i % procs,
+                  "value": [[f, k, None if f == "r" else v]
+                            for f, k, v in ops], "index": 2 * i})
+        h.append({"type": "ok", "f": "txn", "process": i % procs,
+                  "value": ops, "index": 2 * i + 1})
+    return h
+
+
+def test_wr_init_read_orders_before_all_writers():
+    """G-single the old single-writer-only init inference missed: key 1
+    has TWO writers, yet a None read of key 1 still proves the reader
+    precedes both."""
+    txns = [
+        [["w", 0, 10], ["w", 1, 100]],            # W1: writes both keys
+        [["w", 1, 101]],                          # W2: second writer of 1
+        [["r", 0, 10], ["r", 1, None]],           # T: saw W1's key-0 write
+    ]
+    out = rw_register.check(_rw_history(txns), accelerator="cpu",
+                            consistency_models=("serializable",))
+    # wr edge W1->T (read 10); rw edge T->W1 (init read of key 1): cycle
+    assert out["valid?"] is False
+    assert "G-single" in out["anomaly-types"]
+
+
+def test_wr_init_read_two_writers_acquits_consistent():
+    """Same shape but consistent: T read key 0's initial state too, so T
+    precedes everything — acyclic, serializable."""
+    txns = [
+        [["w", 0, 10], ["w", 1, 100]],
+        [["w", 1, 101]],
+        [["r", 0, None], ["r", 1, None]],
+    ]
+    out = rw_register.check(_rw_history(txns), accelerator="cpu",
+                            consistency_models=("serializable",))
+    assert out["valid?"] is True
+
+
+def test_wr_cyclic_versions_detected():
+    """Two txns whose traces order each other's writes both ways: the
+    version graph 1->2->1 can't come from any register execution."""
+    txns = [
+        [["r", 0, 1], ["w", 0, 2]],   # traces 1 -> 2
+        [["r", 0, 2], ["w", 0, 1]],   # traces 2 -> 1
+    ]
+    out = rw_register.check(_rw_history(txns), accelerator="cpu",
+                            consistency_models=("read-uncommitted",))
+    assert out["valid?"] is False
+    assert "cyclic-versions" in out["anomaly-types"]
+    (anom,) = out["anomalies"]["cyclic-versions"]
+    assert anom["key"] == 0 and set(anom["versions"]) == {1, 2}
+
+
+def test_wr_version_chain_composes_g_single():
+    """Write-follows-read chains compose: T read v1; v1's successor chain
+    v1->v2->v3 gives T rw-> writer(v2) ww-> writer(v3); if writer(v3)'s
+    write was read by a txn T depends on, the cycle closes."""
+    txns = [
+        [["w", 0, 1]],                 # A
+        [["r", 0, 1], ["w", 0, 2]],    # B traces 1->2
+        [["r", 0, 2], ["w", 0, 3], ["w", 1, 30]],  # C traces 2->3, writes k1
+        [["r", 1, 30], ["r", 0, 1]],   # T: depends on C (wr), but read STALE 1
+    ]
+    out = rw_register.check(_rw_history(txns), accelerator="cpu",
+                            consistency_models=("serializable",))
+    # T rw-> B (succ of 1) ww-> C wr-> T
+    assert out["valid?"] is False
+    assert "G-single" in out["anomaly-types"] or "G2" in out["anomaly-types"]
