@@ -1,0 +1,193 @@
+"""Real-daemon integration smoke (SURVEY.md §4 tier 3).
+
+Starts an actual single-node DB as a local process and runs the full
+suite lifecycle against it over the dummy remote: every remote command
+(install, start-stop-daemon, teardown) no-ops, but the CLIENT speaks the
+real wire protocol to the real daemon on 127.0.0.1, the interpreter
+schedules real concurrent ops, and the checker judges the real history.
+This is the layer the scripted wire-protocol tests can't cover: a
+daemon's actual command semantics, framing quirks, and timing.
+
+Gated behind ``-m realdb``: each test skips unless the daemon binary is
+on PATH (or named by JEPSEN_<DB>_BIN). In the build image no daemons
+exist, so these skip; on a workstation with redis/etcd installed they
+run the real thing.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _await_port(port: int, proc, timeout_s: float = 20.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"daemon never listened on {port}")
+
+
+def _find(binary: str, env_var: str) -> str | None:
+    return os.environ.get(env_var) or shutil.which(binary)
+
+
+def _run_suite(suite_test, tmp_path, **opts):
+    from jepsen_tpu import core
+
+    test = suite_test({
+        "nodes": ["127.0.0.1"],
+        "concurrency": 3,
+        "time_limit": opts.pop("time_limit", 6),
+        "ssh": {"dummy": True},
+        "faults": set(),
+        "store_dir": str(tmp_path),
+        "no_perf": True,
+        **opts,
+    })
+    return core.run(test)
+
+
+MINI_RESP_SERVER = r"""
+import socketserver, sys, threading
+
+SETS = {}
+LOCK = threading.Lock()
+
+class H(socketserver.StreamRequestHandler):
+    def read_cmd(self):
+        line = self.rfile.readline()
+        if not line or not line.startswith(b"*"):
+            return None
+        n = int(line[1:])
+        out = []
+        for _ in range(n):
+            ln = self.rfile.readline()      # $<len>
+            size = int(ln[1:])
+            out.append(self.rfile.read(size))
+            self.rfile.read(2)              # trailing CRLF
+        return out
+
+    def handle(self):
+        while True:
+            cmd = self.read_cmd()
+            if cmd is None:
+                return
+            op = cmd[0].upper()
+            with LOCK:
+                if op == b"SADD":
+                    SETS.setdefault(cmd[1], set()).add(cmd[2])
+                    self.wfile.write(b":1\r\n")
+                elif op == b"SMEMBERS":
+                    ms = sorted(SETS.get(cmd[1], set()))
+                    self.wfile.write(b"*%d\r\n" % len(ms))
+                    for m in ms:
+                        self.wfile.write(b"$%d\r\n%s\r\n" % (len(m), m))
+                else:
+                    self.wfile.write(b"-ERR unknown\r\n")
+
+class S(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+S(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
+"""
+
+
+def test_realdb_harness_mechanics(tmp_path, monkeypatch):
+    """Proves the realdb harness end-to-end without a redis binary: a
+    SUBPROCESS mini-RESP daemon stands in for redis-server, and the full
+    suite lifecycle (dummy remote, real TCP wire protocol, interpreter,
+    checker, store) runs against it. Not marked realdb — this must pass
+    everywhere, so the gated tests' plumbing can't rot unnoticed."""
+    import sys
+
+    from jepsen_tpu.suites import redis as redis_suite
+
+    port = _free_port()
+    proc = subprocess.Popen([sys.executable, "-c", MINI_RESP_SERVER,
+                             str(port)])
+    try:
+        _await_port(port, proc)
+        monkeypatch.setattr(redis_suite, "PORT", port)
+        result = _run_suite(redis_suite.redis_test, tmp_path,
+                            workload="set", time_limit=4)
+        ops = [o for o in result["history"] if o.get("type") == "ok"
+               and isinstance(o.get("process"), int)]
+        assert len(ops) > 10, "daemon must have served real ops"
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.realdb
+def test_redis_real_daemon_set(tmp_path, monkeypatch):
+    binary = _find("redis-server", "JEPSEN_REDIS_BIN")
+    if not binary:
+        pytest.skip("no redis-server binary available")
+    from jepsen_tpu.suites import redis as redis_suite
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [binary, "--port", str(port), "--bind", "127.0.0.1",
+         "--save", "", "--appendonly", "no"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc)
+        monkeypatch.setattr(redis_suite, "PORT", port)
+        result = _run_suite(redis_suite.redis_test, tmp_path,
+                            workload="set")
+        ops = [o for o in result["history"] if o.get("type") == "ok"
+               and isinstance(o.get("process"), int)]
+        assert len(ops) > 10, "real daemon must have served real ops"
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.realdb
+def test_etcd_real_daemon_register(tmp_path, monkeypatch):
+    binary = _find("etcd", "JEPSEN_ETCD_BIN")
+    if not binary:
+        pytest.skip("no etcd binary available")
+    from jepsen_tpu.suites import etcd as etcd_suite
+
+    port = _free_port()
+    peer = _free_port()
+    proc = subprocess.Popen(
+        [binary, "--name", "n0", "--data-dir", str(tmp_path / "etcd"),
+         "--listen-client-urls", f"http://127.0.0.1:{port}",
+         "--advertise-client-urls", f"http://127.0.0.1:{port}",
+         "--listen-peer-urls", f"http://127.0.0.1:{peer}",
+         "--initial-advertise-peer-urls", f"http://127.0.0.1:{peer}",
+         "--initial-cluster", f"n0=http://127.0.0.1:{peer}",
+         "--enable-v2=true"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc)
+        monkeypatch.setattr(etcd_suite, "CLIENT_PORT", port)
+        result = _run_suite(etcd_suite.etcd_test, tmp_path,
+                            workload="register")
+        ops = [o for o in result["history"] if o.get("type") == "ok"
+               and isinstance(o.get("process"), int)]
+        assert len(ops) > 10, "real daemon must have served real ops"
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
