@@ -51,7 +51,9 @@ def non_monotonic(rows: list) -> tuple[list, list]:
     return off_order, ambiguous
 
 
-CLOCK_NEMESIS_FS = {"reset", "bump", "strobe"}
+# every clock-fault op shape: the combined clock package's ClockNemesis
+# (reset/bump/strobe) and the legacy coarse ClockScrambler
+CLOCK_NEMESIS_FS = {"reset", "bump", "strobe", "scramble-clock"}
 
 
 def _clock_nemesis_active(history) -> bool:
@@ -103,12 +105,22 @@ class MonotonicChecker(Checker):
         off_order, ambiguous = non_monotonic(rows)
         vals = [r[0] for _, r in rows] + [r[0] for r in unparseable
                                          if r and r[0] is not None]
+
+        def key(v):  # unhashable values must not crash the verdict
+            try:
+                hash(v)
+                return v
+            except TypeError:
+                return ("__unhashable__", repr(v))
+
         from collections import Counter
-        dups = sorted(v for v, n in Counter(vals).items() if n > 1)
+        counts = Counter(key(v) for v in vals)
+        dups = sorted((v for v in {key(v): v for v in vals}.values()
+                       if counts[key(v)] > 1), key=repr)
         # every acknowledged insert must be present in the final read
-        acked = {op.get("value") for op in history
+        acked = {key(op.get("value")) for op in history
                  if op.get("type") == "ok" and op.get("f") == "inc"}
-        lost = sorted(acked - set(vals))
+        lost = sorted(acked - {key(v) for v in vals}, key=repr)
         valid = not off_order and not dups and not lost
         note = None
         if unparseable:

@@ -326,3 +326,20 @@ def test_yugabyte_test_all_sweep_fake():
         code = main_all(["--no-ssh", "--time-limit", "1",
                          "--accelerator", "cpu", "--store-dir", tmp])
     assert code == 0
+
+
+def test_monotonic_unhashable_values_do_not_crash():
+    h = _final_read([[[1, 2], "garbage"], [0, "1.0"], [1, "2.0"]])
+    out = monotonic.checker().check({}, h, {})
+    assert out["valid?"] == "unknown"   # unparseable row present
+    assert out["unparseable-count"] == 1
+
+
+def test_monotonic_scrambler_counts_as_clock_nemesis():
+    class _C:
+        logical_ts = False
+
+    nem = {"type": "info", "process": "nemesis", "f": "scramble-clock"}
+    h = [nem] + _final_read([[0, "1.0"], [2, "2.0"], [1, "3.0"]])
+    out = monotonic.checker().check({"client": _C()}, h, {})
+    assert out["valid?"] == "unknown"
