@@ -16,6 +16,8 @@ import random
 from collections import defaultdict
 from typing import Any
 
+import numpy as np
+
 from jepsen_tpu import elle
 from jepsen_tpu.elle import RW, WR, WW, Graph
 
@@ -23,6 +25,179 @@ logger = logging.getLogger("jepsen.elle.append")
 
 
 from jepsen_tpu.txn import _hk
+
+
+def _scan_reads_fast(k, reads, longest, txns, writer_of, failed_writes,
+                     appends_per_txn_key, multi_writers, anomalies_extra,
+                     wr_pairs, fail_vals):
+    """Columnar per-key read scan (prefix consistency, duplicates, G1a,
+    unobserved writers, G1b) for integer value domains — the common
+    workload shape, where per-element Python dict walks would dominate
+    the whole Elle check at history scale. Returns False when the domain
+    isn't integer-typed (caller falls back to the Python twin).
+
+    Anomaly semantics are identical to _scan_reads_py; a differential
+    test pins the two together."""
+    from itertools import chain
+
+    def int_col(values):
+        """Exact signed-int column or None — np.asarray(x, int64) would
+        silently TRUNCATE floats (2.7 -> 2), which must fall back to the
+        Python twin instead of fabricating membership hits."""
+        if not len(values):
+            return np.zeros(0, np.int64)  # asarray([]) defaults to float64
+        try:
+            a = np.asarray(values)
+        except (TypeError, ValueError):
+            return None
+        if a.ndim != 1 or a.dtype.kind != "i":
+            return None
+        return a.astype(np.int64)
+
+    spine = int_col(longest)
+    wvals = int_col([v for v, _ in wr_pairs])
+    fvals = int_col(sorted(fail_vals))
+    if spine is None or wvals is None or fvals is None:
+        return False
+    payloads = [r for _, r in reads]
+    lens = np.fromiter((len(r) for r in payloads), np.int64,
+                       count=len(payloads))
+    total = int(lens.sum())
+    try:
+        concat = np.fromiter(chain.from_iterable(payloads), np.int64,
+                             count=total)
+        # fromiter truncates floats too: verify the int view is exact
+        concat_f = np.fromiter(chain.from_iterable(payloads), np.float64,
+                               count=total)
+    except (TypeError, ValueError, OverflowError):
+        return False
+    if not np.array_equal(concat.astype(np.float64), concat_f):
+        return False
+    order = np.argsort(wvals) if wvals.size else np.zeros(0, np.int64)
+    wvals_sorted = wvals[order]
+    wtxn_sorted = (np.asarray([wi for _, wi in wr_pairs], np.int64)[order]
+                   if wr_pairs else np.zeros(0, np.int64))
+    multi_arr = np.asarray(sorted(multi_writers), dtype=np.int64)
+
+    def member(sorted_arr, vals):
+        if sorted_arr.size == 0:
+            return np.zeros(vals.shape, bool), np.zeros(vals.shape, np.int64)
+        pos = np.clip(np.searchsorted(sorted_arr, vals), 0,
+                      sorted_arr.size - 1)
+        return sorted_arr[pos] == vals, pos
+
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    # row id per element; bincount-based segment reductions sidestep
+    # reduceat's empty-segment pitfalls (a trailing empty read must not
+    # steal elements from its neighbour)
+    row_of_elem = np.repeat(np.arange(len(payloads)), lens)
+
+    def read_of(elem_idx):  # global element position -> read row
+        return int(np.searchsorted(ends, elem_idx, side="right"))
+
+    def any_per_row(elem_mask):
+        return np.bincount(row_of_elem, weights=elem_mask,
+                           minlength=len(payloads)) > 0
+
+    # prefix consistency, all reads at once: element p of read j must
+    # equal spine[p - starts[j]]
+    if total:
+        within = np.arange(total) - starts[row_of_elem]
+        seg_ok = ~any_per_row(concat != spine[within])
+    else:
+        seg_ok = np.ones(len(payloads), bool)
+    spine_dup_free = np.unique(spine).size == spine.size
+
+    # G1a / unobserved writers, element-level
+    failed_hit, _ = member(fvals, concat)
+    writer_hit, pos = member(wvals_sorted, concat)
+    for idx in np.nonzero(failed_hit)[0].tolist():
+        anomalies_extra["G1a"].append(
+            {"key": k, "value": int(concat[idx]),
+             "read-txn": txns[reads[read_of(idx)][0]].get("value")})
+    for idx in np.nonzero(~writer_hit & ~failed_hit)[0].tolist():
+        anomalies_extra["unobserved-writer"].append(
+            {"key": k, "value": int(concat[idx])})
+
+    # G1b candidates: reads touching a multi-append writer's values need
+    # the per-writer grouping check (everything else can't be partial)
+    g1b_rows = np.zeros(len(payloads), bool)
+    if multi_arr.size and total:
+        elem_w = np.where(writer_hit, wtxn_sorted[pos], -1)
+        touched, _ = member(multi_arr, elem_w)
+        g1b_rows = any_per_row(touched)
+
+    # per-read scrutiny only where something is off: a clean prefix of a
+    # duplicate-free spine can contain neither incompatibilities nor
+    # duplicates, so the common case never re-enters Python
+    for j in np.nonzero(~seg_ok)[0].tolist():
+        i, r = reads[j]
+        anomalies_extra["incompatible-order"].append(
+            {"key": k, "read": r, "longest": longest})
+    if spine_dup_free:
+        scrutiny = ~seg_ok
+    else:
+        scrutiny = np.ones(len(payloads), bool)
+    for j in np.nonzero(scrutiny)[0].tolist():
+        i, r = reads[j]
+        if len(set(r)) != len(r):
+            anomalies_extra["duplicate-elements"].append(
+                {"key": k, "read": r})
+            g1b_rows[j] = True  # a doubled single-append value also
+            #                     fails the subsequence test
+    for j in np.nonzero(g1b_rows)[0].tolist():
+        i, r = reads[j]
+        _g1b_one_read(k, i, r, txns, writer_of, appends_per_txn_key,
+                      anomalies_extra)
+    return True
+
+
+def _g1b_one_read(k, i, r, txns, writer_of, appends_per_txn_key,
+                  anomalies_extra):
+    """The per-writer observed-subsequence check for one read (G1b /
+    incompatible-order): a committed txn's appends to k must be observed
+    all-or-nothing, in order (append.clj intermediate-read semantics)."""
+    observed: dict[int, list] = defaultdict(list)
+    for v in r:
+        w = writer_of.get((k, v))
+        if w is not None:
+            observed[w[0]].append(v)
+    for wi, obs in observed.items():
+        if wi == i or txns[wi].get("type") != "ok":
+            continue  # own reads / indeterminate writers: not G1b
+        txn_appends = appends_per_txn_key[(wi, k)]
+        if obs == txn_appends:
+            continue
+        if obs == txn_appends[: len(obs)]:
+            anomalies_extra["G1b"].append(
+                {"key": k, "read": r, "writer": txns[wi].get("value")})
+        else:
+            anomalies_extra["incompatible-order"].append(
+                {"key": k, "read": r, "writer-appends": txn_appends})
+
+
+def _scan_reads_py(k, reads, longest, txns, writer_of, failed_writes,
+                   appends_per_txn_key, anomalies_extra):
+    """Pure-Python per-key read scan: the oracle twin of
+    _scan_reads_fast, and the fallback for non-integer domains."""
+    for i, r in reads:
+        if r != longest[: len(r)]:
+            anomalies_extra["incompatible-order"].append(
+                {"key": k, "read": r, "longest": longest})
+        if len(set(r)) != len(r):
+            anomalies_extra["duplicate-elements"].append(
+                {"key": k, "read": r})
+        for v in r:
+            if (k, v) in failed_writes:
+                anomalies_extra["G1a"].append(
+                    {"key": k, "value": v, "read-txn": txns[i].get("value")})
+            elif (k, v) not in writer_of:
+                # no known writer: future/phantom value
+                anomalies_extra["unobserved-writer"].append(
+                    {"key": k, "value": v})
+        _g1b_one_read(k, i, r, txns, writer_of, appends_per_txn_key,
+                      anomalies_extra)
 
 
 def check(history: list[dict], accelerator: str = "auto",
@@ -71,53 +246,37 @@ def check(history: list[dict], accelerator: str = "auto",
             if m[0] == "r" and m[2] is not None:
                 reads_by_key[_hk(m[1])].append((i, list(m[2])))
 
+    # multi-append writers are the only possible G1b sources: a
+    # single-append writer is always either fully observed or absent
+    multi_by_key: dict[Any, set] = defaultdict(set)
+    for (wi, kk), ap in appends_per_txn_key.items():
+        if len(ap) > 1:
+            multi_by_key[kk].add(wi)
+
+    # per-key writer/failed-value columns, built once (not per key-scan)
+    wv_by_key: dict[Any, list] = defaultdict(list)
+    for (kk, v), wi in writer_of.items():
+        wv_by_key[kk].append((v, wi[0]))
+    fails_by_key: dict[Any, list] = defaultdict(list)
+    for (kk, v) in failed_writes:
+        fails_by_key[kk].append(v)
+
     version_order: dict[Any, list] = {}
+    scan_counts = {"columnar": 0, "python": 0}
     for k, reads in reads_by_key.items():
         longest = max(reads, key=lambda t: len(t[1]))[1]
-        for i, r in reads:
-            if r != longest[: len(r)]:
-                anomalies_extra["incompatible-order"].append(
-                    {"key": k, "read": r, "longest": longest})
-            if len(set(r)) != len(r):
-                anomalies_extra["duplicate-elements"].append(
-                    {"key": k, "read": r})
         version_order[k] = longest
-
-    # ---- non-cyclic anomalies ------------------------------------------
-    for k, reads in reads_by_key.items():
-        for i, r in reads:
-            for v in r:
-                if (k, v) in failed_writes:
-                    anomalies_extra["G1a"].append(
-                        {"key": k, "value": v, "read-txn": txns[i].get("value")})
-                elif (k, v) not in writer_of:
-                    # no known writer: future/phantom value
-                    anomalies_extra["unobserved-writer"].append(
-                        {"key": k, "value": v})
-            # G1b (intermediate read): txns append atomically, so a read
-            # must observe either ALL of a committed txn's appends to k or
-            # none of them, in append order. A proper subset (in any
-            # position — even when later txns' elements follow it) means
-            # the read saw an intermediate state.
-            observed: dict[int, list] = defaultdict(list)
-            for v in r:
-                w = writer_of.get((k, v))
-                if w is not None:
-                    observed[w[0]].append(v)
-            for wi, obs in observed.items():
-                if wi == i or txns[wi].get("type") != "ok":
-                    continue  # own reads / indeterminate writers: not G1b
-                txn_appends = appends_per_txn_key[(wi, k)]
-                if obs == txn_appends:
-                    continue
-                if obs == txn_appends[: len(obs)]:
-                    anomalies_extra["G1b"].append(
-                        {"key": k, "read": r,
-                         "writer": txns[wi].get("value")})
-                else:
-                    anomalies_extra["incompatible-order"].append(
-                        {"key": k, "read": r,
-                         "writer-appends": txn_appends})
+        if _scan_reads_fast(k, reads, longest, txns, writer_of,
+                            failed_writes, appends_per_txn_key,
+                            multi_by_key.get(k, set()), anomalies_extra,
+                            wv_by_key.get(k, []),
+                            fails_by_key.get(k, [])):
+            scan_counts["columnar"] += 1
+        else:  # counted: a silently-falling-back fast path would make a
+            #    multi-x perf regression invisible in identical results
+            scan_counts["python"] += 1
+            _scan_reads_py(k, reads, longest, txns, writer_of, failed_writes,
+                           appends_per_txn_key, anomalies_extra)
 
     # internal: a txn's own read must reflect its earlier appends
     for i, op in enumerate(txns):
@@ -167,6 +326,7 @@ def check(history: list[dict], accelerator: str = "auto",
                              consistency_models=consistency_models)
     result["txn-count"] = n
     result["edge-count"] = len(graph.edges)
+    result["read-scan-keys"] = scan_counts
     return result
 
 

@@ -849,3 +849,120 @@ def test_wr_version_chain_composes_g_single():
     # T rw-> B (succ of 1) ww-> C wr-> T
     assert out["valid?"] is False
     assert "G-single" in out["anomaly-types"] or "G2" in out["anomaly-types"]
+
+
+def test_list_append_fast_scan_matches_python_twin(monkeypatch):
+    """The columnar per-key read scan and the pure-Python twin must emit
+    identical anomalies across random histories seeded with every
+    anomaly class it classifies (G1a, G1b, duplicates, incompatible
+    orders, unobserved writers)."""
+    import json
+    import random as rnd
+
+    def run(history, force_py):
+        if force_py:
+            with_mp = monkeypatch.context()
+            with with_mp as m:
+                m.setattr(list_append, "_scan_reads_fast",
+                          lambda *a, **kw: False)
+                return list_append.check(history, accelerator="cpu")
+        return list_append.check(history, accelerator="cpu")
+
+    rng = rnd.Random(97)
+    for trial in range(40):
+        n_keys = rng.randint(1, 3)
+        vals = {k: [] for k in range(n_keys)}
+        txns = []
+        for i in range(rng.randint(3, 8)):
+            ops = []
+            k = rng.randrange(n_keys)
+            n_app = rng.choice([1, 1, 1, 2])  # sometimes multi-append
+            for _ in range(n_app):
+                v = len(vals[k]) + 1000 * k
+                vals[k].append(v)
+                ops.append(["append", k, v])
+            if rng.random() < 0.8:
+                rk = rng.randrange(n_keys)
+                ops.append(["r", rk, list(vals[rk])])
+            txns.append(ops)
+        history = []
+        for i, ops in enumerate(txns):
+            history.append({"type": "invoke", "process": i % 3, "f": "txn",
+                            "value": [[f, k, None if f == "r" else v]
+                                      for f, k, v in ops]})
+            history.append({"type": "ok", "process": i % 3, "f": "txn",
+                            "value": ops})
+        # corruptions: drop a mid element (G1b/incompatible), duplicate an
+        # element, insert a phantom, read a failed write
+        c = rng.random()
+        reads = [(ti, oi) for ti, t in enumerate(txns)
+                 for oi, m in enumerate(t) if m[0] == "r" and len(m[2]) >= 2]
+        if c < 0.5 and reads:
+            ti, oi = reads[rng.randrange(len(reads))]
+            r = list(txns[ti][oi][2])
+            kind = rng.random()
+            if kind < 0.3:
+                del r[rng.randrange(len(r) - 1)]       # lose a mid element
+            elif kind < 0.6:
+                r.append(r[rng.randrange(len(r))])     # duplicate
+            elif kind < 0.8:
+                r.append(999_999)                      # phantom value
+            else:
+                r[0], r[1] = r[1], r[0]                # reorder
+            txns[ti][oi][2] = r
+        if c >= 0.5 and c < 0.6:
+            history.append({"type": "fail", "process": 9, "f": "txn",
+                            "value": [["append", 0, 777]]})
+            if reads:
+                ti, oi = reads[rng.randrange(len(reads))]
+                txns[ti][oi][2] = list(txns[ti][oi][2]) + [777]
+
+        fast = run(history, force_py=False)
+        slow = run(history, force_py=True)
+        assert fast["valid?"] == slow["valid?"], trial
+        assert fast["anomaly-types"] == slow["anomaly-types"], (
+            trial, fast["anomaly-types"], slow["anomaly-types"])
+        for typ in fast["anomalies"]:
+            f_recs = fast["anomalies"][typ]
+            s_recs = slow["anomalies"][typ]
+            if typ in ("G1c", "realtime-cycle", "process-cycle"):
+                continue  # cycle exemplars may legitimately differ
+            norm = lambda rs: sorted(  # noqa: E731
+                json.dumps(x, sort_keys=True, default=repr) for x in rs)
+            assert norm(f_recs) == norm(s_recs), (trial, typ)
+
+
+def test_list_append_fast_scan_trailing_empty_read():
+    """Regression: a trailing empty read must not steal the final element
+    of its neighbour's segment (reduceat-clipping bug)."""
+    txns = [
+        [["append", 0, 1], ["append", 0, 2], ["append", 0, 3]],
+        [["r", 0, [1, 2, 3]]],
+        [["r", 0, [1, 9]]],   # stale/invented tail: incompatible-order
+        [["r", 0, []]],
+    ]
+    history = []
+    for i, ops in enumerate(txns):
+        history.append({"type": "invoke", "process": i % 3, "f": "txn",
+                        "value": ops})
+        history.append({"type": "ok", "process": i % 3, "f": "txn",
+                        "value": ops})
+    out = list_append.check(history, accelerator="cpu",
+                            consistency_models=("read-committed",))
+    assert "incompatible-order" in out["anomaly-types"]
+
+
+def test_list_append_fast_scan_rejects_float_domain():
+    """Regression: float values must fall back to the Python twin, not
+    truncate (2.7 -> 2 fabricated a G1a against a failed write)."""
+    history = [
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["append", 0, 2.1]]},
+        {"type": "fail", "process": 1, "f": "txn",
+         "value": [["append", 0, 2.7]]},
+        {"type": "ok", "process": 2, "f": "txn",
+         "value": [["r", 0, [2.1]]]},
+    ]
+    out = list_append.check(history, accelerator="cpu",
+                            consistency_models=("serializable",))
+    assert out["valid?"] is True, out["anomaly-types"]
