@@ -320,8 +320,26 @@ def cfg_matrix_kernel():
     dt_scan = time.perf_counter() - t0
     assert bool(alive) and not bool(ovf)
     assert bool(m[0]) == bool(alive), "matrix and scan verdicts must agree"
+
+    # failing-history double run: a not-alive matrix verdict falls back to
+    # the event scan for diagnostics — measure that total so the cost of
+    # the two-pass failure path is on record (VERDICT r1 weak #7)
+    from dataclasses import replace
+    t = (E // (2 * N_PROCS)) // 2
+    a_bad = stream.a.copy()
+    e_corrupt = t * 2 * N_PROCS + 1     # block t, proc 1's read invoke
+    a_bad[e_corrupt] = (t + 1) % 4 + 1  # neither w_{t-1} nor w_t
+    bad = replace(stream, a=a_bad)
+    t0 = time.perf_counter()
+    mb = matrix_check(bad)
+    assert mb is not None and not mb[0]
+    batch_bad = pad_streams([bad], length=_bucket(E))
+    alive_b, _, _, _ = _force(*run(*_device_args(batch_bad)))
+    dt_fail = time.perf_counter() - t0
+    assert not bool(alive_b)
     emit("matrix_kernel_128k_events_per_sec", E / dt_matrix, "events/s",
-         dt_scan / dt_matrix, scan_events_per_sec=round(E / dt_scan, 2))
+         dt_scan / dt_matrix, scan_events_per_sec=round(E / dt_scan, 2),
+         failing_double_run_seconds=round(dt_fail, 3))
 
 
 def cfg_scale(device_rate: float):
@@ -360,12 +378,23 @@ def cfg_scale(device_rate: float):
         E //= 2
         stream = _prefix(stream, E)
         dt = run_once(stream)
-    if dt < 300.0:
-        emit("max_history_len_checked_300s", E, "events", E / N_OPS,
-             measured_seconds=round(dt, 1),
+    # the headline rate underestimates long-run throughput (fixed
+    # overheads amortize), so grow while a doubling is predicted to fit
+    # the budget with margin; always keep the best verified result
+    best = (E, dt) if dt < 300.0 else None
+    while dt < 100.0 and 2 * E <= 16_000_000:
+        E *= 2
+        stream = _block_stream(E // (2 * N_PROCS), n_values=n_values)
+        E = len(stream)
+        dt = run_once(stream)
+        if dt < 300.0:
+            best = (E, dt)
+    if best is not None:
+        emit("max_history_len_checked_300s", best[0], "events",
+             best[0] / N_OPS, measured_seconds=round(best[1], 1),
              note="largest length run; rate extrapolates higher")
     else:
-        print(f"[bench] scale run still over budget at E={E}: {dt:.0f}s",
+        print(f"[bench] scale run over budget at E={E}: {dt:.0f}s",
               file=sys.stderr)
 
 

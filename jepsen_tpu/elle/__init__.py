@@ -167,6 +167,12 @@ def add_timing_edges(graph: Graph, history: list, txns: list,
     graph.time_order = order if sequential_ok else None
 
 
+# below this many edges, "auto" trims on host (see residue() in
+# check_cycles); measured crossover on one chip with tunnel-attached
+# dispatch — the device trim amortizes only on big graphs
+TRIM_DEVICE_MIN_EDGES = 500_000
+
+
 def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
     """Finds and classifies cycles. Device trim narrows the graph; exact
     host Tarjan + typed cycle search classify the residue (the structure of
@@ -199,7 +205,13 @@ def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
         src, dst = graph.arrays(types)
         if len(src) == 0:
             return []
-        if accelerator == "cpu":
+        # "auto" takes the device trim only at scale: below this edge
+        # count the vectorized host peel wins on measured shapes (the
+        # trim is O(diameter) sequential sweeps either way, and the
+        # device pays per-iteration dispatch for tiny arrays)
+        if accelerator == "cpu" or (
+                accelerator == "auto"
+                and len(src) < TRIM_DEVICE_MIN_EDGES):
             mask = _trim_cpu(graph.n, src, dst)
         else:
             mask = scc_mod.trim_to_cycles(graph.n, src, dst)

@@ -544,7 +544,17 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     # and the per-op transition matrices get built once instead of per
     # scan step.
     all_ops = np.concatenate([o.reshape(-1, 3) for o in opss])
-    uops, inv = np.unique(all_ops, axis=0, return_inverse=True)
+    # interning via packed scalar keys when fields fit 21 bits (the
+    # in-regime case: f codes and interned value ids are tiny) — a 1-D
+    # unique sorts ~10x faster than np.unique(axis=0)'s row view
+    if all_ops.size and 0 <= all_ops.min() and all_ops.max() < (1 << 21):
+        packed = ((all_ops[:, 0] << 42) | (all_ops[:, 1] << 21)
+                  | all_ops[:, 2])
+        keys, inv = np.unique(packed, return_inverse=True)
+        uops = np.stack([keys >> 42, (keys >> 21) & 0x1FFFFF,
+                         keys & 0x1FFFFF], axis=1)
+    else:
+        uops, inv = np.unique(all_ops, axis=0, return_inverse=True)
     ids = inv.astype(np.int32).reshape(B, C * T, S)
     ub = _bucket(len(uops), floor=16)
     uops = np.concatenate(
