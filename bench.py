@@ -124,6 +124,17 @@ def _force(*xs):
     return [np.asarray(x) for x in xs]
 
 
+def _best_of(fn, n: int = 2):
+    """(result, best dt) over n runs — the shared host is noisy, so all
+    quick configs take the minimum for BOTH sides of any comparison."""
+    dt = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = min(dt, time.perf_counter() - t0)
+    return out, dt
+
+
 def cfg_cpu_ref_200() -> float:
     """BASELINE config 1: the CPU oracle (knossos :linear analog)."""
     from __graft_entry__ import _register_history
@@ -133,9 +144,7 @@ def cfg_cpu_ref_200() -> float:
     history = _register_history(200, n_procs=N_PROCS, seed=1)
     stream = encode_register_ops(history)
     check_stream(stream)  # warm interpreter caches
-    t0 = time.perf_counter()
-    res = check_stream(stream)
-    dt = time.perf_counter() - t0
+    res, dt = _best_of(lambda: check_stream(stream))
     assert res.valid is True
     rate = 200 / dt
     # this IS the CPU reference anchor the device configs compare against
@@ -151,10 +160,8 @@ def cfg_interpreter_sched():
 
     n = 50_000
     test = {"concurrency": 5}
-    g = gen.limit(n, gen.Fn(lambda: {"f": "write", "value": 1}))
-    t0 = time.perf_counter()
-    history = quick(test, g)
-    dt = time.perf_counter() - t0
+    history, dt = _best_of(lambda: quick(
+        test, gen.limit(n, gen.Fn(lambda: {"f": "write", "value": 1}))))
     n_inv = sum(1 for op in history if op["type"] == "invoke")
     assert n_inv == n, n_inv
     rate = n / dt
@@ -176,14 +183,13 @@ def cfg_multikey():
         _register_history(1000, n_procs=N_PROCS, seed=1000 + k, n_values=5))
         for k in range(64)]
     batch_check(streams, capacity=CAPACITY)  # warm-up compile
-    t0 = time.perf_counter()
-    results = batch_check(streams, capacity=CAPACITY)
-    dt = time.perf_counter() - t0
+    results, dt = _best_of(lambda: batch_check(streams, capacity=CAPACITY))
     assert all(r[0] and not r[2] for r in results)
-    t0 = time.perf_counter()
-    for s in streams:
-        assert check_stream(s).valid is True
-    dt_cpu = time.perf_counter() - t0
+
+    def cpu_all():
+        for s in streams:
+            assert check_stream(s).valid is True
+    _, dt_cpu = _best_of(cpu_all)
     rate = 64_000 / dt
     emit("multikey_64x1k_ops_per_sec", rate, "ops/s", dt_cpu / dt,
          cpu_sequential_ops_per_sec=round(64_000 / dt_cpu, 2))
@@ -213,12 +219,8 @@ def cfg_set_full():
     dev = SetFullChecker(accelerator="tpu")
     cpu = SetFullChecker(accelerator="cpu")
     dev.check(test, history, opts)  # warm-up compile
-    t0 = time.perf_counter()
-    r_dev = dev.check(test, history, opts)
-    dt_dev = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    r_cpu = cpu.check(test, history, opts)
-    dt_cpu = time.perf_counter() - t0
+    r_dev, dt_dev = _best_of(lambda: dev.check(test, history, opts))
+    r_cpu, dt_cpu = _best_of(lambda: cpu.check(test, history, opts))
     assert r_dev["valid?"] and r_cpu["valid?"]
     assert r_dev["stable-count"] == r_cpu["stable-count"]
     emit("set_full_elements_per_sec", n_els / dt_dev, "elements/s",
@@ -320,26 +322,33 @@ def cfg_matrix_kernel():
     dt_scan = time.perf_counter() - t0
     assert bool(alive) and not bool(ovf)
     assert bool(m[0]) == bool(alive), "matrix and scan verdicts must agree"
+    extra = {"scan_events_per_sec": round(E / dt_scan, 2)}
 
     # failing-history double run: a not-alive matrix verdict falls back to
     # the event scan for diagnostics — measure that total so the cost of
-    # the two-pass failure path is on record (VERDICT r1 weak #7)
-    from dataclasses import replace
-    t = (E // (2 * N_PROCS)) // 2
-    a_bad = stream.a.copy()
-    e_corrupt = t * 2 * N_PROCS + 1     # block t, proc 1's read invoke
-    a_bad[e_corrupt] = (t + 1) % 4 + 1  # neither w_{t-1} nor w_t
-    bad = replace(stream, a=a_bad)
-    t0 = time.perf_counter()
-    mb = matrix_check(bad)
-    assert mb is not None and not mb[0]
-    batch_bad = pad_streams([bad], length=_bucket(E))
-    alive_b, _, _, _ = _force(*run(*_device_args(batch_bad)))
-    dt_fail = time.perf_counter() - t0
-    assert not bool(alive_b)
+    # the two-pass failure path is on record (VERDICT r1 weak #7). Run
+    # guarded AFTER the primary measurement exists, so a failure here
+    # can't discard it.
+    try:
+        from dataclasses import replace
+        t = (E // (2 * N_PROCS)) // 2
+        a_bad = stream.a.copy()
+        e_corrupt = t * 2 * N_PROCS + 1     # block t, proc 1's read invoke
+        a_bad[e_corrupt] = (t + 1) % 4 + 1  # neither w_{t-1} nor w_t
+        bad = replace(stream, a=a_bad)
+        t0 = time.perf_counter()
+        mb = matrix_check(bad)
+        assert mb is not None and not mb[0]
+        batch_bad = pad_streams([bad], length=_bucket(E))
+        alive_b, _, _, _ = _force(*run(*_device_args(batch_bad)))
+        dt_fail = time.perf_counter() - t0
+        assert not bool(alive_b)
+        extra["failing_double_run_seconds"] = round(dt_fail, 3)
+    except Exception:
+        print("[bench] failing-path add-on failed:", file=sys.stderr)
+        traceback.print_exc()
     emit("matrix_kernel_128k_events_per_sec", E / dt_matrix, "events/s",
-         dt_scan / dt_matrix, scan_events_per_sec=round(E / dt_scan, 2),
-         failing_double_run_seconds=round(dt_fail, 3))
+         dt_scan / dt_matrix, **extra)
 
 
 def cfg_scale(device_rate: float):
@@ -355,7 +364,11 @@ def cfg_scale(device_rate: float):
     target_s = float(os.environ.get("BENCH_SCALE_TARGET_S", "240"))
     if target_s <= 0:
         return
-    e_target = min(device_rate * target_s, 16_000_000)
+    # hard cap: 8M+-event scans have crashed the tunneled TPU worker
+    # process ("TPU worker process crashed or restarted"); 4.19M is the
+    # largest size proven stable on this backend
+    E_CAP = 4_200_000
+    e_target = min(device_rate * target_s, E_CAP)
     E = _bucket(int(e_target)) // 2 or 64          # largest bucket <= target
     n_values = 100
     stream = _block_stream(E // (2 * N_PROCS), n_values=n_values)
@@ -380,15 +393,21 @@ def cfg_scale(device_rate: float):
         dt = run_once(stream)
     # the headline rate underestimates long-run throughput (fixed
     # overheads amortize), so grow while a doubling is predicted to fit
-    # the budget with margin; always keep the best verified result
+    # the budget with margin; always keep the best verified result, even
+    # if a larger attempt dies
     best = (E, dt) if dt < 300.0 else None
-    while dt < 100.0 and 2 * E <= 16_000_000:
-        E *= 2
-        stream = _block_stream(E // (2 * N_PROCS), n_values=n_values)
-        E = len(stream)
-        dt = run_once(stream)
-        if dt < 300.0:
-            best = (E, dt)
+    try:
+        while dt < 100.0 and 2 * E <= E_CAP:
+            E *= 2
+            stream = _block_stream(E // (2 * N_PROCS), n_values=n_values)
+            E = len(stream)
+            dt = run_once(stream)
+            if dt < 300.0:
+                best = (E, dt)
+    except Exception:
+        print(f"[bench] scale doubling failed at E={E}; keeping best",
+              file=sys.stderr)
+        traceback.print_exc()
     if best is not None:
         emit("max_history_len_checked_300s", best[0], "events",
              best[0] / N_OPS, measured_seconds=round(best[1], 1),

@@ -85,11 +85,23 @@ def classify_elements(member: np.ndarray, t_read: np.ndarray,
     key = (Rb, Eb)
     fn = _JIT_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_build_classify(Rb, Eb))
+        base = _build_classify(Rb, Eb)
+
+        def unpack_and_classify(packed, *rest):
+            # bit-unpack on device: the [R, E] membership matrix ships
+            # as uint8 bits (8x less host->device traffic — the matrix
+            # is the whole transfer cost on tunnel-attached devices)
+            bits = (packed[:, :, None]
+                    >> jnp.arange(8, dtype=jnp.uint8)) & 1
+            m = bits.reshape(Rb, -1)[:, :Eb].astype(bool)
+            return base(m, *rest)
+
+        fn = jax.jit(unpack_and_classify)
         _JIT_CACHE[key] = fn
 
     mem = np.zeros((Rb, Eb), dtype=bool)
     mem[:R, :E] = member
+    mem = np.packbits(mem, axis=1, bitorder="little")
     tr = np.full((Rb,), _POS, dtype=np.float32)
     tr[:R] = t_read
     rv = np.zeros((Rb,), dtype=bool)
