@@ -64,13 +64,18 @@ def _scan_reads_fast(k, reads, longest, txns, writer_of, failed_writes,
                        count=len(payloads))
     total = int(lens.sum())
     try:
-        concat = np.fromiter(chain.from_iterable(payloads), np.int64,
-                             count=total)
-        # fromiter truncates floats too: verify the int view is exact
+        # one C pass builds float64; the int view must round-trip exactly
+        # (fromiter into int64 would silently truncate 2.7 -> 2). Ints at
+        # or beyond 2^53 also fall back: float64 can't represent them
+        # exactly, so the round-trip check below couldn't notice a value
+        # that float conversion itself already corrupted.
         concat_f = np.fromiter(chain.from_iterable(payloads), np.float64,
                                count=total)
     except (TypeError, ValueError, OverflowError):
         return False
+    if total and np.abs(concat_f).max() >= float(1 << 53):
+        return False
+    concat = concat_f.astype(np.int64)
     if not np.array_equal(concat.astype(np.float64), concat_f):
         return False
     order = np.argsort(wvals) if wvals.size else np.zeros(0, np.int64)

@@ -261,6 +261,17 @@ class IndependentChecker(Checker):
     def name(self):
         return f"independent({self.checker.name()})"
 
+    @staticmethod
+    def _key_opts(opts, k):
+        """Per-key opts: sub-checkers write under independent/<k> like the
+        reference (independent.clj:287-292), so concurrent keys' artifacts
+        (timeline.html, plots) can't overwrite each other."""
+        d = opts.get("subdirectory")
+        return {**opts,
+                "subdirectory": "/".join(
+                    filter(None, [d, "independent", str(k)])),
+                "history-key": k}
+
     def check(self, test, history, opts):
         keys = history_keys(history)
         if not keys:
@@ -273,7 +284,8 @@ class IndependentChecker(Checker):
         else:
             pairs = list(subs.items())
             rs = bounded_pmap(
-                lambda kv: check_safe(self.checker, test, kv[1], opts), pairs)
+                lambda kv: check_safe(self.checker, test, kv[1],
+                                      self._key_opts(opts, kv[0])), pairs)
             results = {k: r for (k, _), r in zip(pairs, rs)}
 
         valid = merge_valid(r.get("valid?") for r in results.values())
@@ -287,9 +299,24 @@ class IndependentChecker(Checker):
         }
 
     def _try_batched(self, test, keys, subs, opts):
+        from jepsen_tpu.checker import Compose
         from jepsen_tpu.checker.linearizable import LinearizableChecker
         from jepsen_tpu.models import CASRegister
+
+        # see through a Compose holding exactly one LinearizableChecker
+        # (the register workload's linear+timeline composition): the
+        # linear sub-checker takes the one batched kernel call, the rest
+        # run per key, and per-key results merge like Compose would
         chk = self.checker
+        lin_name, others = None, {}
+        if isinstance(chk, Compose):
+            lins = [(nm, c) for nm, c in chk.checkers.items()
+                    if isinstance(c, LinearizableChecker)]
+            if len(lins) != 1:
+                return None
+            lin_name, chk = lins[0]
+            others = {nm: c for nm, c in self.checker.checkers.items()
+                      if nm != lin_name}
         if not isinstance(chk, LinearizableChecker):
             return None
         if not isinstance(chk.model, CASRegister):
@@ -302,6 +329,7 @@ class IndependentChecker(Checker):
         if opts.get("algorithm", chk.algorithm) == "wgl":
             return None
         try:
+            from jepsen_tpu.checker import merge_valid
             from jepsen_tpu.checker.linear_cpu import check_stream
             from jepsen_tpu.checker.linear_encode import encode_register_ops
             from jepsen_tpu.ops.jitlin import verdict
@@ -320,7 +348,22 @@ class IndependentChecker(Checker):
                 else:
                     results[fk] = {"valid?": v, "algorithm": "jitlin-tpu",
                                    "configs-max": peak}
-            return results
+            if lin_name is None:
+                return results
+            pairs = list(subs.items())
+            other_rs = bounded_pmap(
+                lambda kv: {nm: check_safe(c, test, kv[1],
+                                           self._key_opts(opts, kv[0]))
+                            for nm, c in others.items()}, pairs)
+            merged = {}
+            for (fk, _), extra in zip(pairs, other_rs):
+                sub = {lin_name: results[fk], **extra}
+                merged[fk] = {
+                    "valid?": merge_valid(r.get("valid?")
+                                          for r in sub.values()),
+                    **sub,
+                }
+            return merged
         except Exception:  # noqa: BLE001
             logger.exception("batched independent check failed; "
                              "falling back to per-key")

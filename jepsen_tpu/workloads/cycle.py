@@ -1,0 +1,42 @@
+"""Generic cycle-detection checker from a custom dependency analyzer
+(reference: jepsen/src/jepsen/tests/cycle.clj:9-16, the thin adapter over
+elle.core/check).
+
+``checker(analyze_fn)`` wraps a function that derives a typed dependency
+graph from a history — the extension point for bespoke consistency
+models whose dependencies aren't list-append or rw-register shaped. The
+analyzer returns ``(graph, txns)``: a :class:`jepsen_tpu.elle.Graph`
+over transaction indices plus the transaction ops those indices name
+(used to render cycle exemplars). Cycles are classified by edge type
+exactly like the txn checkers (G0/G1c/G-single/G2, realtime/process
+stages when the analyzer adds timing edges).
+"""
+from __future__ import annotations
+
+from jepsen_tpu import elle
+from jepsen_tpu.checker import Checker
+
+
+class CycleChecker(Checker):
+    def __init__(self, analyze_fn,
+                 consistency_models=("strict-serializable",)):
+        self.analyze_fn = analyze_fn
+        self.consistency_models = consistency_models
+
+    def name(self):
+        return "cycle"
+
+    def check(self, test, history, opts):
+        graph, txns = self.analyze_fn(history)
+        anomalies = elle.check_cycles(
+            graph, accelerator=opts.get(
+                "accelerator", test.get("accelerator", "auto")))
+        result = elle.result_map(
+            anomalies, txns,
+            consistency_models=self.consistency_models)
+        result["edge-count"] = len(graph.edges)
+        return result
+
+
+def checker(analyze_fn, consistency_models=("strict-serializable",)) -> Checker:
+    return CycleChecker(analyze_fn, consistency_models=consistency_models)

@@ -48,6 +48,12 @@ def workload(test: dict | None = None, per_key_limit: int = 20,
     return {
         "generator": independent.concurrent_generator(
             group, itertools.count(), key_gen),
-        "checker": independent.checker(
-            linearizable(model=CASRegister(), accelerator=accelerator)),
+        # per-key linear + timeline composition, exactly the reference's
+        # (independent/checker (checker/compose {:linear ... :timeline
+        # (timeline/html)})) (linearizable_register.clj:30-41)
+        "checker": independent.checker(chk.compose({
+            "linear": linearizable(model=CASRegister(),
+                                   accelerator=accelerator),
+            "timeline": chk.timeline_html(),
+        })),
     }

@@ -966,3 +966,19 @@ def test_list_append_fast_scan_rejects_float_domain():
     out = list_append.check(history, accelerator="cpu",
                             consistency_models=("serializable",))
     assert out["valid?"] is True, out["anomaly-types"]
+
+
+def test_list_append_fast_scan_big_int_fallback():
+    """Values at/above 2^53 can't be float-verified: the fast path must
+    fall back to the Python twin rather than silently rounding them."""
+    big = (1 << 53) + 1
+    history = [
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["append", 0, big]]},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["r", 0, [big]]]},
+    ]
+    out = list_append.check(history, accelerator="cpu",
+                            consistency_models=("serializable",))
+    assert out["valid?"] is True, out["anomaly-types"]
+    assert out["read-scan-keys"]["python"] == 1

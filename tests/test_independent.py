@@ -125,3 +125,41 @@ def test_batch_check_sharded_over_mesh():
         expected = check_stream(streams[i]).valid
         from jepsen_tpu.ops.jitlin import verdict
         assert verdict(alive, ovf) == expected, i
+
+
+def test_batched_path_sees_through_compose(tmp_path):
+    """The register workload composes linear+timeline per key; the
+    batched kernel path must still engage for the linear sub-checker,
+    and each key's timeline must land in its own independent/<k> dir."""
+    import os
+
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import CASRegister
+
+    inner = chk.compose({"linear": linearizable(model=CASRegister()),
+                         "timeline": chk.timeline_html()})
+    c = ind.checker(inner)
+    h = []
+    for k in ("a", "b"):
+        h += [
+            {"type": "invoke", "process": 0, "f": "write", "value": [k, 1],
+             "time": 1},
+            {"type": "ok", "process": 0, "f": "write", "value": [k, 1],
+             "time": 2},
+            {"type": "invoke", "process": 1, "f": "read", "value": [k, None],
+             "time": 3},
+            {"type": "ok", "process": 1, "f": "read", "value": [k, 1],
+             "time": 4},
+        ]
+    test = {"name": "ind-compose", "start_time": "t0",
+            "store_dir": str(tmp_path)}
+    out = c.check(test, h, {})
+    assert out["valid?"] is True
+    for k in ("a", "b"):
+        sub = out["results"][k]
+        assert sub["linear"]["algorithm"].startswith("jitlin"), sub
+        assert sub["timeline"]["valid?"] is True
+        assert os.path.exists(
+            tmp_path / "ind-compose" / "t0" / "independent" / k
+            / "timeline.html")

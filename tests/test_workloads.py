@@ -315,3 +315,58 @@ def test_long_fork_read_accounting():
     assert r["reads-count"] == 3
     assert r["early-read-count"] == 1
     assert r["late-read-count"] == 1
+
+
+def test_generic_cycle_checker_custom_analyzer():
+    """tests/cycle.clj parity: a checker built from a custom analyzer fn
+    classifies cycles in whatever dependency graph the analyzer derives."""
+    from jepsen_tpu.elle import Graph, WW, WR
+    from jepsen_tpu.workloads import cycle
+
+    def analyzer(history):
+        # toy analyzer: "observed" field names the txn each op depends on
+        oks = [o for o in history if o["type"] == "ok"]
+        g = Graph(len(oks))
+        for i, o in enumerate(oks):
+            dep = o.get("observed")
+            if dep is not None:
+                g.add(dep, i, WR)
+            if i > 0 and o.get("overwrites") is not None:
+                g.add(i, o["overwrites"], WW)
+        return g, oks
+
+    acyclic = [
+        {"type": "ok", "process": 0, "value": 1},
+        {"type": "ok", "process": 1, "value": 2, "observed": 0},
+    ]
+    out = cycle.checker(analyzer).check({}, acyclic, {})
+    assert out["valid?"] is True
+
+    cyclic = [
+        {"type": "ok", "process": 0, "value": 1},
+        {"type": "ok", "process": 1, "value": 2, "observed": 0,
+         "overwrites": 0},
+    ]
+    out = cycle.checker(analyzer).check({}, cyclic, {})
+    assert out["valid?"] is False
+    assert out["anomaly-types"], out
+
+
+def test_register_workload_composes_timeline(tmp_path):
+    from jepsen_tpu.workloads import register
+
+    w = register.workload({"concurrency": 2})
+    t = {"name": "reg", "start_time": "t0", "store_dir": str(tmp_path),
+         "concurrency": 2}
+    h = [
+        {"type": "invoke", "process": 0, "f": "write", "value": [1, 3],
+         "time": 0},
+        {"type": "ok", "process": 0, "f": "write", "value": [1, 3],
+         "time": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": [1, None],
+         "time": 2},
+        {"type": "ok", "process": 1, "f": "read", "value": [1, 3],
+         "time": 3},
+    ]
+    out = w["checker"].check(t, h, {})
+    assert out["valid?"] is True
