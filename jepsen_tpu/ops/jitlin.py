@@ -516,13 +516,29 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         return [(True, -1, False, 0)] * B
     # chunk layout: per key, C chunks of T returns (padded with identity);
     # chunk g = b*C + c. R is bucketed so (T, C, B) — and therefore the
-    # compiled program — is shared across nearby history lengths; C is
-    # capped so the step's [B*C, MV, MV] f32 intermediates stay within
+    # compiled program — is shared across nearby history lengths. The
+    # total chunk count targets G = B*C ≈ 256: measured on-device, the
+    # per-step cost grows superlinearly with G (the [G, MV, MV]
+    # intermediates become HBM-bound) while G ≥ ~128 already saturates
+    # the matmul units, so more parallel chunks past that point only
+    # slows each of the fewer steps down. C is additionally capped by
     # the element budget.
     MV = (1 << S) * V
     rb = _bucket(R_max, floor=64)
-    C = int(np.clip(rb // 120, 8 if B == 1 else 1, 256))
+    C = int(np.clip(256 // B, 1, 256))
     C = max(1, min(C, MATRIX_MAX_ELEMS // (B * MV * MV)))
+    if mesh is not None:
+        # G = B*C must divide over the mesh or the sharding guard below
+        # would silently fall back to one device: bump C to the next
+        # value making B*C a device-count multiple (always exists within
+        # nd steps) — kept only if it fits the element budget, else the
+        # original C stands and the batch runs unsharded as before
+        nd = int(mesh.devices.size)
+        c2 = C
+        while (B * c2) % nd:
+            c2 += 1
+        if B * c2 * MV * MV <= MATRIX_MAX_ELEMS:
+            C = c2
     T = -(-rb // C)
 
     def key_arrays(p):

@@ -471,3 +471,28 @@ def test_linear_png_device_path_recovers_configs(tmp_path):
     assert out["valid?"] is False
     assert out["final-configs"]
     assert out.get("plot", "").endswith("linear.png")
+
+
+def test_matrix_batch_mesh_divisible_chunks():
+    """Odd key counts on a mesh must still shard: the chunk heuristic
+    rounds G = B*C to a device-count multiple."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.checker.linear_cpu import check_stream
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import matrix_check_batch
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return
+    mesh = Mesh(np.array(devs), ("keys",))
+    # B=3: 256//3 = 85, 3*85 = 255 not divisible by common device counts
+    streams = [encode_register_ops(
+        _register_history(800, n_procs=4, seed=900 + k, n_values=5))
+        for k in range(3)]
+    results = matrix_check_batch(streams, mesh=mesh)
+    for s, r in zip(streams, results):
+        want = check_stream(s).valid
+        assert (r[0] and not r[2]) == (want is True)
