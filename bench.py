@@ -268,7 +268,11 @@ def cfg_elle_50k():
 
     n_txns = 50_000
     history = _elle_history(n_txns)
-    list_append.check(history[-2000:], accelerator="tpu")  # warm caches
+    # warm caches on a tail WITH the same anomaly count so the φ-cluster
+    # screen kernel compiles at the anomalous run's exact bucket shapes
+    # (the valid tail alone never reaches it: no back edges, no clusters)
+    warm = _elle_history(2_000, crossed_pairs=50)
+    list_append.check(warm, accelerator="tpu")
     t0 = time.perf_counter()
     r_cpu = list_append.check(history, accelerator="cpu")
     dt_cpu = time.perf_counter() - t0

@@ -75,7 +75,13 @@ def blocked_anomalies(consistency_models) -> set:
 
 @dataclass
 class Graph:
-    """Typed edge-list dependency graph over txn indices."""
+    """Typed edge-list dependency graph over txn indices.
+
+    Two storage forms: ``edges`` (list of (src, dst, type) tuples — the
+    incremental builder API) or ``cols`` (columnar int64 arrays
+    (type-codes, src, dst) — what the vectorized builder in
+    elle.columnar produces). ``edge_list()`` materializes tuples from
+    columns on demand so every consumer works with either form."""
 
     n: int
     edges: list = field(default_factory=list)  # (src, dst, type)
@@ -83,18 +89,39 @@ class Graph:
     # add_timing_edges; None when unavailable or per-process
     # sequentiality was violated
     time_order: np.ndarray | None = None
+    cols: tuple | None = None  # (codes, src, dst) int64 arrays
 
     def add(self, src: int, dst: int, typ: str):
         if src != dst or typ == RW:
             self.edges.append((src, dst, typ))
 
+    def edge_list(self) -> list:
+        if self.cols is not None and not self.edges:
+            codes, src, dst = self.cols
+            self.edges = [(int(s), int(d), _CODE_TYPE[int(c)])
+                          for c, s, d in zip(codes.tolist(), src.tolist(),
+                                             dst.tolist())]
+        return self.edges
+
     def arrays(self, types: set | None = None):
+        if self.cols is not None and not self.edges:
+            codes, src, dst = self.cols
+            if types is None:
+                keep = np.ones(len(codes), bool)
+            else:
+                tcodes = [_TYPE_CODE[t] for t in types]
+                keep = np.isin(codes, tcodes)
+            return src[keep].astype(np.int32), dst[keep].astype(np.int32)
         es = [(s, d) for s, d, t in self.edges
               if types is None or t in types]
         if not es:
             return np.zeros(0, np.int32), np.zeros(0, np.int32)
         a = np.asarray(es, dtype=np.int32)
         return a[:, 0], a[:, 1]
+
+    def edge_count(self) -> int:
+        return len(self.cols[0]) if (self.cols is not None
+                                     and not self.edges) else len(self.edges)
 
 
 def add_timing_edges(graph: Graph, history: list, txns: list,
@@ -168,18 +195,298 @@ def add_timing_edges(graph: Graph, history: list, txns: list,
 
 
 # below this many edges, "auto" trims on host (see residue() in
-# check_cycles); measured crossover on one chip with tunnel-attached
-# dispatch — the device trim amortizes only on big graphs
+# _check_cycles_global); measured crossover on one chip with
+# tunnel-attached dispatch — the device trim amortizes only on big graphs
 TRIM_DEVICE_MIN_EDGES = 500_000
+
+# φ-interval clusters larger than this fall back to the trim + global
+# Tarjan pipeline for that cluster: a [V, V] dense closure beyond it
+# stops paying for itself on one chip (and 1024² bf16 is still <3 MB)
+MATRIX_CLUSTER_MAX = 1024
+
+# under "auto" with no explicit device request, clusters are settled by
+# host Tarjan directly unless the batched matrix work is at least this
+# many elements (B·V²) — tunnel dispatch costs ~10 ms either way
+SCREEN_DEVICE_MIN_ELEMS = 1 << 16
 
 
 def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
-    """Finds and classifies cycles. Device trim narrows the graph; exact
-    host Tarjan + typed cycle search classify the residue (the structure of
-    elle.core/check with typed searches)."""
+    """Finds and classifies cycles (the structure of elle.core/check with
+    typed searches, jepsen/src/jepsen/tests/cycle.clj).
+
+    Production path (``auto``/``tpu``) is φ-interval localization:
+    add_timing_edges records each node's event position φ, and all timing
+    edges strictly increase φ by construction, so **every cycle must
+    traverse a φ-decreasing dependency edge** ("back edge"). Forward
+    paths visit φ-monotone node intervals, so every cycle — and therefore
+    every SCC — lies entirely inside the merged φ-interval hull of its
+    back edges (proof in _phi_clusters). Back-edge detection and interval
+    merging are O(E) vectorized; each cluster is then settled EXACTLY by
+    the batched [B, V, V] matrix-closure screen on device
+    (ops.scc.batch_cluster_screen — one dispatch for all clusters) and
+    flagged clusters get the exact typed classification on their few
+    nodes. No trim, no full-graph Tarjan, and the two timing stages ride
+    the same clusters.
+
+    ``cpu`` keeps the trim + global-Tarjan pipeline unchanged — it is the
+    auditable oracle twin the differential tests pin the fast path to.
+    Histories without a usable φ (no invocations recorded, or per-process
+    sequentiality violated) fall back to that pipeline too."""
+    if accelerator == "cpu":
+        return _check_cycles_global(graph, accelerator)
+
+    codes, src, dst, order = _edge_columns(graph)
+    if order is None:
+        return _check_cycles_global(graph, accelerator)
+
+    dep_mask = codes <= 2
+    o_s, o_d = order[src], order[dst]
+    if ((o_s < 0) | (o_d < 0)).any():
+        # a node never matched to a history event: φ is unusable
+        return _check_cycles_global(graph, accelerator)
+    back = dep_mask & (o_d <= o_s)
+    if not back.any():
+        return {}  # all dependency edges increase φ: acyclic in every stage
+
+    clusters = _phi_clusters(order[src[back]], order[dst[back]])
+    return _check_cycles_clusters(codes, src, dst, order, clusters,
+                                  accelerator)
+
+
+_TYPE_CODE = {WW: 0, WR: 1, RW: 2, REALTIME: 3, PROCESS: 4}
+_CODE_TYPE = {v: k for k, v in _TYPE_CODE.items()}
+
+
+def _edge_columns(graph: Graph):
+    """Columnar (type-code, src, dst, φ) view of the graph, built once
+    (free when the columnar builder already produced ``cols``)."""
+    if graph.time_order is None:
+        return None, None, None, None
+    if graph.cols is not None and not graph.edges:
+        codes, src, dst = graph.cols
+        return codes, src, dst, graph.time_order
+    if not graph.edges:
+        return None, None, None, None
+    arr = np.asarray([(_TYPE_CODE[t], s, d) for s, d, t in graph.edges],
+                     np.int64)
+    return arr[:, 0], arr[:, 1], arr[:, 2], graph.time_order
+
+
+def _phi_clusters(back_src_phi: np.ndarray, back_dst_phi: np.ndarray):
+    """Merges back-edge φ-intervals into disjoint clusters [(lo, hi), ...].
+
+    Soundness: a cycle alternates back edges with (possibly empty)
+    forward paths. A forward path from a to b climbs φ monotonically, so
+    its nodes lie in [φ(a), φ(b)]; hence every node of the cycle lies in
+    the union of its back edges' intervals [φ(dst), φ(src)]. Consecutive
+    intervals around the cycle overlap (the forward path from one back
+    edge's dst ends at the next one's src, so φ(dst_i) <= φ(src_{i+1})
+    and disjointness would contradict it), so the whole cycle sits inside
+    ONE merged cluster. Clusters are therefore an exact localization: all
+    cycles (and all nontrivial SCCs) of every stage's edge set live
+    inside them, and none spans two."""
+    lo = np.minimum(back_dst_phi, back_src_phi)
+    hi = np.maximum(back_dst_phi, back_src_phi)
+    order = np.argsort(lo, kind="stable")
+    lo, hi = lo[order], hi[order]
+    out = []
+    cur_lo, cur_hi = int(lo[0]), int(hi[0])
+    for l, h in zip(lo[1:].tolist(), hi[1:].tolist()):
+        if l <= cur_hi:
+            cur_hi = max(cur_hi, h)
+        else:
+            out.append((cur_lo, cur_hi))
+            cur_lo, cur_hi = l, h
+    out.append((cur_lo, cur_hi))
+    return out
+
+
+def _check_cycles_clusters(codes, src, dst, order, clusters,
+                           accelerator: str) -> dict:
+    """Classifies anomalies cluster by cluster. Every edge (any type) with
+    both endpoint φs inside a cluster's interval joins that cluster's
+    subgraph; the device screen proves most clusters acyclic in a few
+    batched dispatches and the exact typed searches run only on the rest.
+
+    Clusters are remapped to dense local ids ONCE, then grouped into
+    size buckets for the screen — so a thousand 4-node clusters never
+    pay a single big cluster's [V, V] matrix footprint."""
+    from jepsen_tpu.ops import scc as scc_mod
+    from jepsen_tpu.ops.jitlin import _bucket
+
+    los = np.asarray([c[0] for c in clusters], np.int64)
+    his = np.asarray([c[1] for c in clusters], np.int64)
+    o_s, o_d = order[src], order[dst]
+    # cluster id per edge (-1 = none): both endpoints inside one interval
+    cid_s = np.searchsorted(los, o_s, side="right") - 1
+    in_s = (cid_s >= 0) & (o_s <= his[np.clip(cid_s, 0, len(his) - 1)])
+    cid_d = np.searchsorted(los, o_d, side="right") - 1
+    in_d = (cid_d >= 0) & (o_d <= his[np.clip(cid_d, 0, len(his) - 1)])
+    member = in_s & in_d & (cid_s == cid_d)
+    e_cid = np.where(member, cid_s, -1)
+
+    # pack per-cluster edge lists (global node ids), remap once apiece
+    sel = np.nonzero(member)[0]
+    sel = sel[np.argsort(e_cid[sel], kind="stable")]
+    bounds = np.searchsorted(e_cid[sel], np.arange(len(clusters) + 1))
+    remapped: list = []  # (n_local, local_edges, to_global) per cluster
+    for c in range(len(clusters)):
+        idx = sel[bounds[c]:bounds[c + 1]]
+        edges = [(int(src[i]), int(dst[i]), _CODE_TYPE[int(codes[i])])
+                 for i in idx.tolist()]
+        remapped.append(_remap_full(edges) if edges else None)
+
+    # group screenable clusters into size buckets so each screen call's
+    # [B, V, V] footprint matches its clusters
+    big: list = []
+    buckets: dict[int, list] = {}
+    for c, rm in enumerate(remapped):
+        if rm is None:
+            continue
+        if rm[0] > MATRIX_CLUSTER_MAX:
+            big.append(c)
+            continue
+        buckets.setdefault(_bucket(rm[0], floor=8), []).append(c)
+
+    live: list = []
+    for vb, members in sorted(buckets.items()):
+        use_device = accelerator == "tpu" or (
+            accelerator == "auto"
+            and len(members) * vb * vb >= SCREEN_DEVICE_MIN_ELEMS)
+        if use_device:
+            packed_cid: list = []
+            packed_src: list = []
+            packed_dst: list = []
+            for b, c in enumerate(members):
+                for s, d, _ in remapped[c][1]:
+                    packed_cid.append(b)
+                    packed_src.append(s)
+                    packed_dst.append(d)
+            flags = scc_mod.batch_cluster_screen(
+                np.asarray(packed_cid, np.int32),
+                np.asarray(packed_src, np.int32),
+                np.asarray(packed_dst, np.int32), len(members), vb)
+            live += [c for c, f in zip(members, flags.tolist()) if f]
+        else:
+            # host screen: no nontrivial SCC means no cycles
+            live += [c for c in members
+                     if scc_mod.tarjan_scc(
+                         remapped[c][0],
+                         [(s, d) for s, d, _ in remapped[c][1]])]
+    live += big  # oversized clusters go straight to the exact pass
+
+    anomalies: dict[str, list] = {}
+    for c in sorted(live):
+        n_local, local_edges, to_global = remapped[c]
+        _classify_stages(n_local, local_edges, to_global, anomalies)
+    return anomalies
+
+
+def _remap_full(edges):
+    nodes = sorted({v for s, d, _ in edges for v in (s, d)})
+    local = {g: i for i, g in enumerate(nodes)}
+    return (len(nodes),
+            [(local[s], local[d], t) for s, d, t in edges],
+            nodes)
+
+
+def _run_stages(n: int, dep_edges: list, all_edges: list, emit) -> None:
+    """The typed anomaly stages, shared verbatim by the global pipeline
+    and the per-cluster classifier (one copy so the two cannot
+    desynchronize — the differential tests pin them together).
+
+    * G0: ww-only cycles.
+    * G1c: ww+wr cycles through at least one wr edge. When G0 exists the
+      same SCC may hold both a pure-ww and a mixed cycle, so the search
+      goes through each wr edge specifically to avoid shadowing.
+    * G-single / G2: per-SCC fewest-rw cycle over the dependency edges
+      (n_rw == 0 cycles were already reported as G0/G1c).
+    * realtime / process: cycles forced through a timing edge. A strict
+      serialization must respect realtime AND process order, so the
+      realtime search walks paths through process edges too; the process
+      search stays dep+process only — exactly the sequential-consistency
+      question.
+
+    ``dep_edges`` may be a trimmed superset (global path) or a cluster's
+    dependency subset; ``all_edges`` additionally carries the timing
+    edges for the timing stages."""
+    from jepsen_tpu.ops import scc as scc_mod
+
+    # G0: ww-only cycles
+    ww_edges = [e for e in dep_edges if e[2] == WW]
+    g0 = _exemplars(n, ww_edges) if ww_edges else []
+    emit("G0", g0)
+
+    # G1c: ww+wr cycles through at least one wr edge
+    g1_edges = [e for e in dep_edges if e[2] in (WW, WR)]
+    if g1_edges:
+        if not g0:
+            emit("G1c", _exemplars(n, g1_edges))
+        else:
+            emit("G1c", _cycles_through_type(n, g1_edges, WR))
+
+    # dependency stage: G-single / G2 via per-SCC fewest-rw cycles
+    if dep_edges:
+        sccs = scc_mod.tarjan_scc(n, [(s, d) for s, d, _ in dep_edges])
+        singles, g2s = [], []
+        for scc in sccs:
+            cycle = scc_mod.find_cycle_in_scc(scc, dep_edges,
+                                              prefer_fewest=RW)
+            if cycle is None:
+                continue
+            n_rw = sum(1 for _, _, t in cycle if t == RW)
+            if n_rw == 1:
+                singles.append(cycle)
+            elif n_rw >= 2:
+                g2s.append(cycle)
+        emit("G-single", singles)
+        emit("G2", g2s)
+
+    # timing stages: cycles through a realtime/process edge
+    for typ, path_types, name in (
+            (REALTIME, (WW, WR, RW, REALTIME, PROCESS), "realtime-cycle"),
+            (PROCESS, (WW, WR, RW, PROCESS), "process-cycle")):
+        if not any(t == typ for _, _, t in all_edges):
+            continue
+        timed = [e for e in all_edges if e[2] in path_types]
+        sccs = scc_mod.tarjan_scc(n, [(s, d) for s, d, _ in timed])
+        if not sccs:
+            continue
+        keep = {v for scc in sccs for v in scc}
+        scc_edges = [(s, d, t) for s, d, t in timed
+                     if s in keep and d in keep]
+        if any(t == typ for _, _, t in scc_edges):
+            emit(name, _cycles_through_type(n, scc_edges, typ))
+
+
+def _classify_stages(n: int, edges: list, to_global: list,
+                     anomalies: dict, limit: int = 10) -> None:
+    """Runs the typed anomaly stages on one cluster subgraph and merges
+    renders (in GLOBAL node ids) into ``anomalies``. Restricting each
+    search to the cluster loses nothing: closed walks, like cycles, sit
+    φ-inside one cluster (_phi_clusters), so every path the global BFS
+    could use is cluster-internal."""
+    def emit(name, cycles):
+        if cycles:
+            room = limit - len(anomalies.get(name, []))
+            if room > 0:
+                anomalies.setdefault(name, []).extend(
+                    [[(to_global[s], to_global[d], t) for s, d, t in cyc]
+                     for cyc in cycles[:room]])
+
+    dep_edges = [e for e in edges if e[2] in (WW, WR, RW)]
+    _run_stages(n, dep_edges, edges, emit)
+
+
+def _check_cycles_global(graph: Graph, accelerator: str = "auto") -> dict:
+    """Trim + global Tarjan pipeline: the oracle twin of the φ-cluster
+    path, and the fallback when no usable φ exists. Device trim narrows
+    the graph; exact host Tarjan + typed cycle search classify the
+    residue."""
     from jepsen_tpu.ops import scc as scc_mod
 
     anomalies: dict[str, list] = {}
+    graph.edge_list()  # materialize tuples if the builder was columnar
 
     # Potential-function screen shared by every stage: add_timing_edges
     # records each node's event position φ, and all timing edges strictly
@@ -229,77 +536,18 @@ def check_cycles(graph: Graph, accelerator: str = "auto") -> dict:
     # subset (ww-only, ww+wr) is a cycle of the full dependency graph, so
     # its nodes are inside the full residue — the typed stages search the
     # residue-restricted subsets exactly instead of paying a trim each.
+    #
+    # The timing stages get the UNtrimmed edge set: the peel trim is
+    # wrong for them (timing edges chain nearly the whole history, so
+    # peeling needs O(diameter) ~ O(n) sweeps; linear-time Tarjan inside
+    # _run_stages goes straight to the nontrivial SCCs instead).
     full_edges = residue({WW, WR, RW})
 
-    # G0: ww-only cycles
-    ww_edges = [e for e in full_edges if e[2] == WW]
-    g0 = _exemplars(graph.n, ww_edges) if ww_edges else []
-    if g0:
-        anomalies["G0"] = g0
+    def emit(name, cycles):
+        if cycles:
+            anomalies[name] = cycles
 
-    # G1c: ww+wr cycles involving at least one wr edge
-    g1_edges = [e for e in full_edges if e[2] in (WW, WR)]
-    if g1_edges:
-        if not g0:
-            g1c = _exemplars(graph.n, g1_edges)
-        else:
-            # an SCC may contain both a pure-ww cycle (already reported as
-            # G0) and a mixed cycle; search specifically for a cycle
-            # through each wr edge so G1c isn't shadowed
-            g1c = _cycles_through_type(graph.n, g1_edges, WR)
-        if g1c:
-            anomalies["G1c"] = g1c
-
-    # dependency graph: G-single / G2. Timing edges are excluded here so
-    # the serializable verdict is exactly the dependency-cycle question;
-    # they get their own stages below.
-    if full_edges:
-        sccs = scc_mod.tarjan_scc(graph.n, [(s, d) for s, d, _ in full_edges])
-        singles, g2s = [], []
-        for scc in sccs:
-            cycle = scc_mod.find_cycle_in_scc(scc, full_edges,
-                                              prefer_fewest=RW)
-            if cycle is None:
-                continue
-            n_rw = sum(1 for _, _, t in cycle if t == RW)
-            if n_rw == 0:
-                continue  # already reported as G0/G1c
-            elif n_rw == 1:
-                singles.append(cycle)
-            else:
-                g2s.append(cycle)
-        if singles:
-            anomalies["G-single"] = singles
-        if g2s:
-            anomalies["G2"] = g2s
-
-    # strict-serializable / sequential: cycles forced through a timing
-    # edge. Timing edges alone are acyclic (both follow history event
-    # order), so any such cycle genuinely mixes in dependency edges.
-    # The peel trim is wrong here — timing edges chain nearly the whole
-    # history, so peeling needs O(diameter) ~ O(n) sweeps; linear-time
-    # Tarjan goes straight to the nontrivial SCCs instead.
-    # A strict serialization must respect realtime AND process order, so
-    # the realtime search walks paths through process edges too (a cycle
-    # needing both kinds is still a strict-serializability violation);
-    # the process search stays dep+process only — that is exactly the
-    # sequential-consistency question.
-    for typ, path_types, name in (
-            (REALTIME, (WW, WR, RW, REALTIME, PROCESS), "realtime-cycle"),
-            (PROCESS, (WW, WR, RW, PROCESS), "process-cycle")):
-        if not any(t == typ for _, _, t in graph.edges):
-            continue
-        timed = [(s, d, t) for s, d, t in graph.edges if t in path_types]
-        sccs = scc_mod.tarjan_scc(graph.n, [(s, d) for s, d, _ in timed])
-        if not sccs:
-            continue
-        keep = {v for scc in sccs for v in scc}
-        scc_edges = [(s, d, t) for s, d, t in timed
-                     if s in keep and d in keep]
-        if any(t == typ for _, _, t in scc_edges):
-            cycles = _cycles_through_type(graph.n, scc_edges, typ)
-            if cycles:
-                anomalies[name] = cycles
+    _run_stages(graph.n, full_edges, graph.edges, emit)
     return anomalies
 
 

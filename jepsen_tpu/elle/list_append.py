@@ -207,6 +207,15 @@ def _scan_reads_py(k, reads, longest, txns, writer_of, failed_writes,
 
 def check(history: list[dict], accelerator: str = "auto",
           consistency_models=("strict-serializable",)) -> dict:
+    # Production path: the vectorized columnar builder (elle.columnar)
+    # covers integer-valued histories — the universal workload shape —
+    # and feeds the φ-cluster cycle path. The cpu oracle keeps the
+    # Python builder below; differential tests pin the two together.
+    if accelerator != "cpu":
+        from jepsen_tpu.elle import columnar
+        r = columnar.check_columnar(history, consistency_models, accelerator)
+        if r is not None:
+            return r
     # ok txns participate in the graph; failed txns matter for G1a;
     # info (indeterminate) txns' writes may be observed — treated like ok
     # when they are (elle does the same: info writes that appear are real)

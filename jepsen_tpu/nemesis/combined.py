@@ -280,9 +280,15 @@ def compose_packages(packages: list[dict]) -> dict:
 
 def nemesis_package(opts: dict) -> dict:
     """The top-level entry (combined.clj:328-374). opts keys: db, faults
-    (set of "kill"/"pause"/"partition"/"clock"), interval, extra_packages.
+    (set of "kill"/"pause"/"partition"/"clock" plus any name registered
+    in ``fault_packages``), interval, extra_packages, fault_packages
+    (name → builder(opts), the DB-specific vocabularies — see
+    jepsen_tpu.nemesis.db_specific).
     """
     pkgs = [db_package(opts), partition_package(opts), clock_package(opts)]
+    registry = opts.get("fault_packages") or {}
+    for name in sorted(set(opts.get("faults") or []) & set(registry)):
+        pkgs.append(registry[name](opts))
     pkgs += list(opts.get("extra_packages") or [])
     pkgs = [p for p in pkgs if p]
     if not pkgs:

@@ -1,0 +1,341 @@
+"""DB-specific fault vocabularies: the named nemesis families the deep
+reference suites ship beyond the generic kill/pause/partition/clock
+packages.
+
+* Cockroach's clock-skew family — ``strobe-skews``, ``small-skews``
+  (100 ms), ``subcritical-skews`` (200 ms), ``critical-skews`` (250 ms,
+  the commit-wait boundary), ``big-skews``/``huge-skews`` (0.5 s / 5 s,
+  network-slowed so the cluster survives the jump) — plus the
+  ``restarting`` and ``slowing`` combinators and ``startkill``
+  (reference: cockroachdb/src/jepsen/cockroach/nemesis.clj:110-141,
+  152-267).
+* Yugabyte's role-targeted process nemesis: master-vs-tserver
+  start/stop/kill/pause/resume on random node subsets (reference:
+  yugabyte/src/yugabyte/nemesis.clj:12-44).
+
+Everything is packaged in the combined.clj package shape so suites wire
+them through ``--fault`` exactly like the generic families: a suite
+passes ``fault_packages`` (name → builder) and the combined assembler
+picks them up (see jepsen_tpu.nemesis.combined.nemesis_package).
+"""
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.combined import DEFAULT_INTERVAL
+from jepsen_tpu.utils import real_pmap
+
+
+def _start_stop_gen(interval, start_f="start", stop_f="stop"):
+    return gen.stagger(interval, gen.cycle(gen.Seq([
+        {"type": "info", "f": start_f, "value": None},
+        {"type": "info", "f": stop_f, "value": None},
+    ])))
+
+
+def _on_nodes(test, nodes, fn):
+    """{node: result-or-error-string} via per-node control sessions (the
+    c/on-nodes shape: failures become values, not raised exceptions)."""
+    from jepsen_tpu import control
+
+    def one(node):
+        try:
+            return node, control.on(node, test, lambda: fn(node))
+        except Exception as e:  # noqa: BLE001 — mirrored on-nodes contract
+            return node, f"{type(e).__name__}: {e}"
+
+    return dict(real_pmap(one, list(nodes)))
+
+
+class Restarting(Nemesis):
+    """Wraps a nemesis so that every ``stop`` op additionally restarts
+    the DB on all nodes — skewed clocks crash strict stores, and the
+    family's contract is "on stop, nodes come back"
+    (cockroach/nemesis.clj:175-200 ``restarting``)."""
+
+    def __init__(self, inner: Nemesis, db):
+        self.inner = inner
+        self.db = db
+
+    def fs(self):
+        return self.inner.fs()
+
+    def setup(self, test):
+        self.inner.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        out = self.inner.invoke(test, op)
+        if op.get("f") == "stop":
+            started = _on_nodes(
+                test, test.get("nodes") or [],
+                lambda node: (self.db.start(test, node), "started")[1])
+            out = {**out, "value": [out.get("value"), started]}
+        return out
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+
+class BumpTime(Nemesis):
+    """On ``start``, bumps the clock by dt seconds on a random half of
+    the nodes (coin flip per node, millisecond precision); on ``stop``,
+    resets all clocks (cockroach/nemesis.clj:232-252 ``bump-time``)."""
+
+    def __init__(self, dt_s: float, rng: random.Random | None = None):
+        self.dt_s = dt_s
+        self.rng = rng or random.Random()
+
+    def fs(self):
+        return {"start", "stop"}
+
+    def setup(self, test):
+        from jepsen_tpu.nemesis import time as nt
+        _on_nodes(test, test.get("nodes") or [],
+                  lambda node: (nt.install(), nt.reset_time()))
+        return self
+
+    def invoke(self, test, op):
+        from jepsen_tpu.nemesis import time as nt
+        if op.get("f") == "start":
+            ms = int(self.dt_s * 1000)
+            picks = {n: (self.rng.random() < 0.5)
+                     for n in (test.get("nodes") or [])}
+            res = _on_nodes(
+                test, picks,
+                lambda node: (nt.bump_time(ms), self.dt_s)[1]
+                if picks[node] else 0)
+        else:
+            res = _on_nodes(test, test.get("nodes") or [],
+                            lambda node: (nt.reset_time(), "reset")[1])
+        return {**op, "type": "info", "value": res}
+
+    def teardown(self, test):
+        from jepsen_tpu.nemesis import time as nt
+        _on_nodes(test, test.get("nodes") or [],
+                  lambda node: nt.reset_time())
+
+
+class StrobeTime(Nemesis):
+    """On ``start``, strobes the clock between now and delta ms ahead,
+    flipping every period ms for duration seconds, on every node
+    (cockroach/nemesis.clj:202-230 ``strobe-time``/``strobe-skews``)."""
+
+    def __init__(self, delta_ms: int = 200, period_ms: int = 10,
+                 duration_s: int = 10):
+        self.delta_ms = delta_ms
+        self.period_ms = period_ms
+        self.duration_s = duration_s
+
+    def fs(self):
+        return {"start", "stop"}
+
+    def setup(self, test):
+        from jepsen_tpu.nemesis import time as nt
+        _on_nodes(test, test.get("nodes") or [],
+                  lambda node: (nt.install(), nt.reset_time()))
+        return self
+
+    def invoke(self, test, op):
+        from jepsen_tpu.nemesis import time as nt
+        if op.get("f") == "start":
+            res = _on_nodes(
+                test, test.get("nodes") or [],
+                lambda node: (nt.strobe_time(self.delta_ms, self.period_ms,
+                                             self.duration_s), "strobed")[1])
+        else:
+            res = None
+        return {**op, "type": "info", "value": res}
+
+    def teardown(self, test):
+        from jepsen_tpu.nemesis import time as nt
+        _on_nodes(test, test.get("nodes") or [],
+                  lambda node: nt.reset_time())
+
+
+class Slowing(Nemesis):
+    """Wraps a nemesis: before its ``start`` the network slows by dt
+    seconds of added latency; after its ``stop`` speeds are restored
+    (cockroach/nemesis.clj:152-173 ``slowing`` — big/huge skews only
+    survive because the cluster is slowed around them)."""
+
+    def __init__(self, inner: Nemesis, dt_s: float):
+        self.inner = inner
+        self.dt_s = dt_s
+
+    def fs(self):
+        return self.inner.fs()
+
+    def setup(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.fast(test)
+        self.inner.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        net = test.get("net")
+        if op.get("f") == "start" and net is not None:
+            net.slow(test, mean_ms=self.dt_s * 1000)
+        out = self.inner.invoke(test, op)
+        if op.get("f") == "stop" and net is not None:
+            net.fast(test)
+        return out
+
+    def teardown(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.fast(test)
+        self.inner.teardown(test)
+
+
+class StartKill(Nemesis):
+    """``start`` kills the DB on n shuffled nodes; ``stop`` restarts it
+    there (cockroach/nemesis.clj:135-141 ``startkill`` via
+    node-start-stopper)."""
+
+    def __init__(self, db, n: int = 1, rng: random.Random | None = None):
+        self.db = db
+        self.n = n
+        self.rng = rng or random.Random()
+        self.targets: list = []
+
+    def fs(self):
+        return {"start", "stop"}
+
+    def invoke(self, test, op):
+        if op.get("f") == "start":
+            nodes = list(test.get("nodes") or [])
+            self.rng.shuffle(nodes)
+            self.targets = nodes[: self.n]
+            res = _on_nodes(test, self.targets,
+                            lambda node: (self.db.kill(test, node),
+                                          "killed")[1])
+        else:
+            res = _on_nodes(test, self.targets or test.get("nodes") or [],
+                            lambda node: (self.db.start(test, node),
+                                          "started")[1])
+        return {**op, "type": "info", "value": res}
+
+
+def _skew_package(opts: dict, name: str, client: Nemesis,
+                  slow_s: float | None = None) -> dict:
+    db = opts.get("db")
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    nem: Nemesis = Restarting(client, db) if db is not None else client
+    if slow_s is not None:
+        nem = Slowing(nem, slow_s)
+    return {
+        "nemesis": nem,
+        "generator": _start_stop_gen(interval),
+        "final_generator": gen.Seq([{"type": "info", "f": "stop",
+                                     "value": None}]),
+        "perf": {"name": name, "fs": {"start", "stop"},
+                 "start": {"start"}, "stop": {"stop"}},
+    }
+
+
+def cockroach_fault_packages() -> dict:
+    """--fault name → package builder, the cockroach skew/kill family
+    (cockroach/nemesis.clj:110-141, 201-271)."""
+    def skew(name, offset_s, slow_s=None):
+        return lambda opts: _skew_package(
+            opts, name, BumpTime(offset_s), slow_s)
+
+    return {
+        "skew-small": skew("small-skews", 0.100),
+        "skew-subcritical": skew("subcritical-skews", 0.200),
+        "skew-critical": skew("critical-skews", 0.250),
+        "skew-big": skew("big-skews", 0.5, slow_s=0.5),
+        "skew-huge": skew("huge-skews", 5.0, slow_s=5.0),
+        "skew-strobe": lambda opts: _skew_package(
+            opts, "strobe-skews", StrobeTime(200, 10, 10)),
+        "startkill": lambda opts: {
+            "nemesis": StartKill(opts.get("db"), 1),
+            "generator": _start_stop_gen(
+                opts.get("interval", DEFAULT_INTERVAL)),
+            "final_generator": gen.Seq([{"type": "info", "f": "stop",
+                                         "value": None}]),
+            "perf": {"name": "startkill", "fs": {"start", "stop"},
+                     "start": {"start"}, "stop": {"stop"}},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# yugabyte: master / tserver role-targeted process faults
+# ---------------------------------------------------------------------------
+
+class RoleProcess(Nemesis):
+    """start/stop/kill/pause/resume one DB *role* (yugabyte master vs
+    tserver) on random node subsets (yugabyte/nemesis.clj:12-44).
+
+    Destructive verbs target a random nonempty subset of the role's
+    nodes; ``start``/``resume`` go to all of them. The DB supplies
+    ``role_nodes(test, role)`` and per-role methods
+    (``kill_master(test, node)``, ...); absent a per-role method the
+    generic Process/Pause verb runs with the role recorded in the value.
+    """
+
+    VERBS = ("start", "stop", "kill", "pause", "resume")
+
+    def __init__(self, db, roles=("master", "tserver"),
+                 rng: random.Random | None = None):
+        self.db = db
+        self.roles = tuple(roles)
+        self.rng = rng or random.Random()
+
+    def fs(self):
+        return {f"{v}-{r}" for v in self.VERBS for r in self.roles}
+
+    def _role_nodes(self, test, role):
+        fn = getattr(self.db, "role_nodes", None)
+        if fn is not None:
+            return list(fn(test, role))
+        return list(test.get("nodes") or [])
+
+    def invoke(self, test, op):
+        verb, _, role = op.get("f", "").partition("-")
+        nodes = self._role_nodes(test, role)
+        if verb in ("stop", "kill", "pause") and nodes:
+            k = self.rng.randint(1, len(nodes))
+            nodes = self.rng.sample(nodes, k)
+        method = getattr(self.db, f"{verb}_{role}", None)
+
+        def one(node):
+            if method is not None:
+                return method(test, node)
+            return getattr(self.db, verb)(test, node)
+
+        res = _on_nodes(test, nodes, one)
+        return {**op, "type": "info", "value": {"role": role, verb: res}}
+
+
+def role_fault_package(opts: dict, role: str, verb: str) -> dict:
+    """One --fault entry, e.g. kill-master: cycles destroy/heal on the
+    role with the package interval; final op heals the role."""
+    heal = "resume" if verb == "pause" else "start"
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    return {
+        "nemesis": RoleProcess(opts.get("db"), roles=(role,)),
+        "generator": _start_stop_gen(interval, f"{verb}-{role}",
+                                     f"{heal}-{role}"),
+        "final_generator": gen.Seq([{"type": "info", "f": f"{heal}-{role}",
+                                     "value": None}]),
+        "perf": {"name": f"{verb}-{role}",
+                 "fs": {f"{verb}-{role}", f"{heal}-{role}"},
+                 "start": {f"{verb}-{role}"}, "stop": {f"{heal}-{role}"}},
+    }
+
+
+def yugabyte_fault_packages() -> dict:
+    """--fault name → package builder for the master/tserver process
+    faults (yugabyte/nemesis.clj:12-44, core.clj nemeses map)."""
+    out = {}
+    for role in ("master", "tserver"):
+        for verb in ("kill", "stop", "pause"):
+            out[f"{verb}-{role}"] = (
+                lambda opts, r=role, v=verb: role_fault_package(opts, r, v))
+    return out

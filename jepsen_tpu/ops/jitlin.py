@@ -524,6 +524,13 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     # slows each of the fewer steps down. C is additionally capped by
     # the element budget.
     MV = (1 << S) * V
+    if B * MV * MV > MATRIX_MAX_ELEMS:
+        # even C=1 would allocate over-budget [B, MV, MV] intermediates;
+        # callers pre-gate with matrix_ok, so a direct caller this large
+        # must hear "out of regime" rather than OOM the device
+        raise ValueError(
+            f"matrix_check_batch out of regime: B*MV^2 = {B * MV * MV} "
+            f"> {MATRIX_MAX_ELEMS}; split the key batch or use the scan")
     rb = _bucket(R_max, floor=64)
     C = int(np.clip(256 // B, 1, 256))
     C = max(1, min(C, MATRIX_MAX_ELEMS // (B * MV * MV)))

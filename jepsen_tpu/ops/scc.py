@@ -177,6 +177,110 @@ def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
     return np.asarray(run(sj, dj, wj))
 
 
+_SCREEN_CACHE: dict = {}
+
+
+def _screen_kernel(n_clusters: int, n_local: int, n_edges: int):
+    """Compiled batched-closure screen for bucketed (B, V, E) shapes.
+
+    One boolean adjacency matrix per cluster, [B, V, V]; transitive
+    closure by repeated squaring — ``ceil(log2(V))`` batched bf16
+    matmuls on the MXU (R := R ∨ R·R doubles the covered path length
+    each step, so it has fully converged once 2^steps >= V; the result
+    is EXACT, unlike the capped peeling trim). A cluster contains a
+    cycle iff its closure has a nonzero diagonal.
+
+    bf16 operands with float32 accumulation (`preferred_element_type`)
+    keep the MXU path while making the >0 threshold exact: entries are
+    0/1, so any true sum is >= 1 and cannot round to 0."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    key = (n_clusters, n_local, n_edges)
+    fn = _SCREEN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_steps = max(1, int(np.ceil(np.log2(max(2, n_local)))))
+
+    @jax.jit
+    def run(cid, src_l, dst_l, valid):
+        adj = jnp.zeros((n_clusters, n_local, n_local), jnp.bfloat16)
+        adj = adj.at[cid, src_l, dst_l].max(
+            jnp.where(valid, jnp.bfloat16(1), jnp.bfloat16(0)))
+
+        def body(_, r):
+            sq = jax.lax.dot_general(
+                r, r,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return jnp.maximum(r, (sq > 0).astype(jnp.bfloat16))
+
+        closure = lax.fori_loop(0, n_steps, body, adj)
+        diag = jnp.diagonal(closure, axis1=1, axis2=2)
+        return jnp.any(diag > 0, axis=1)
+
+    _SCREEN_CACHE[key] = run
+    return run
+
+
+# ceiling on one screen dispatch's [B, V, V] element count: bf16
+# adjacency ~64 MB and the f32 dot_general intermediate ~128 MB at this
+# size — batches beyond it are chunked along the cluster axis
+SCREEN_MAX_ELEMS = 1 << 25
+
+
+def batch_cluster_screen(cid: np.ndarray, src_l: np.ndarray,
+                         dst_l: np.ndarray, n_clusters: int,
+                         max_local: int) -> np.ndarray:
+    """Exact per-cluster cycle screen on device: returns bool[n_clusters],
+    True iff cluster ``c`` (edges where ``cid == c``, node ids already
+    LOCAL to the cluster) contains a directed cycle.
+
+    This is the device half of the φ-interval Elle path (see
+    jepsen_tpu.elle.check_cycles): the host localizes all possible cycle
+    nodes into small clusters, and this kernel settles every cluster's
+    has-a-cycle question in ONE dispatch — batched [B, V, V] boolean
+    matrix squaring instead of the reference's per-graph host Tarjan
+    (jepsen/src/jepsen/tests/cycle.clj's SCC search). Transfers are edge
+    lists (KBs), not matrices; shapes are bucketed so compilations cache."""
+    from jepsen_tpu.ops.jitlin import _bucket
+
+    if n_clusters == 0:
+        return np.zeros(0, dtype=bool)
+    if len(cid) == 0:
+        return np.zeros(n_clusters, dtype=bool)
+
+    vb = _bucket(max_local, floor=8)
+    # element budget: chunk the cluster axis when B*V^2 would exceed it
+    # (callers bucket clusters by size, so V is tight for every chunk)
+    b_max = max(1, SCREEN_MAX_ELEMS // (vb * vb))
+    if n_clusters > b_max:
+        cid = np.asarray(cid, np.int64)
+        out = np.zeros(n_clusters, dtype=bool)
+        for b0 in range(0, n_clusters, b_max):
+            b1 = min(b0 + b_max, n_clusters)
+            m = (cid >= b0) & (cid < b1)
+            out[b0:b1] = batch_cluster_screen(
+                (cid[m] - b0).astype(np.int32), src_l[m], dst_l[m],
+                b1 - b0, max_local)
+        return out
+
+    bb = _bucket(n_clusters, floor=8)
+    eb = _bucket(len(cid), floor=64)
+    pad = eb - len(cid)
+    cid_p = np.concatenate([np.asarray(cid, np.int32),
+                            np.zeros(pad, np.int32)])
+    src_p = np.concatenate([np.asarray(src_l, np.int32),
+                            np.zeros(pad, np.int32)])
+    dst_p = np.concatenate([np.asarray(dst_l, np.int32),
+                            np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(len(cid), bool), np.zeros(pad, bool)])
+    run = _screen_kernel(bb, vb, eb)
+    return np.asarray(run(cid_p, src_p, dst_p, valid))[:n_clusters]
+
+
 def tarjan_scc(n_nodes: int, edges: list[tuple[int, int]]) -> list[list[int]]:
     """Exact SCCs, iterative Tarjan (host-side; used on the trimmed
     residue). Returns SCCs with >1 node or a self-loop."""

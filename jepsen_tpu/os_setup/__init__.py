@@ -252,16 +252,24 @@ def yum_uninstall(packages) -> None:
 _SSD_DPKG_VERSION = "1.17.27"
 
 
-def install_start_stop_daemon() -> None:
+def install_start_stop_daemon(sha256: str | None = None) -> None:
     """Builds start-stop-daemon from the dpkg source tarball when absent —
     RH systems don't ship it, and the shared daemon helpers
-    (control/util.py) drive services through it (centos.clj:110-127)."""
+    (control/util.py) drive services through it (centos.clj:110-127).
+
+    The tarball is fetched over HTTPS from the official Debian mirror
+    (transport integrity); pass ``sha256`` to additionally pin the
+    artifact — deployments that require supply-chain pinning should
+    supply the digest of the mirror copy they vetted."""
     if control.exec_star("test", "-x",
                          "/usr/bin/start-stop-daemon").exit_status == 0:
         return
     v = _SSD_DPKG_VERSION
     control.exec_("wget", "-nv",
-                  f"http://ftp.de.debian.org/debian/pool/main/d/dpkg/dpkg_{v}.tar.xz")
+                  f"https://deb.debian.org/debian/pool/main/d/dpkg/dpkg_{v}.tar.xz")
+    if sha256:
+        control.exec_("sh", "-c",
+                      f"echo '{sha256}  dpkg_{v}.tar.xz' | sha256sum -c -")
     control.exec_("tar", "-xf", f"dpkg_{v}.tar.xz")
     control.exec_("sh", "-c",
                   f"cd dpkg-{v} && ./configure && make -C utils")

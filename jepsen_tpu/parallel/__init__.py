@@ -89,11 +89,13 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     mesh when one is available. The single batching implementation —
     JitLinKernel.check/check_batch delegate here.
 
-    Single-device dispatch prefers the key-batched transfer-matrix kernel
+    Dispatch prefers the key-batched transfer-matrix kernel
     (jitlin.matrix_check_batch) when the whole batch fits its regime —
     all keys advance together in MXU matmuls instead of a latency-bound
-    vmapped event scan — falling back to the scan for keys the matrix
-    pass leaves undecided (not-alive or inexact) and for meshes.
+    vmapped event scan. With a mesh the matrix path is still taken: its
+    chunk axis is sharded across devices (matrix_check_batch handles the
+    divisibility bump). The scan serves as the fallback for keys the
+    matrix pass leaves undecided (not-alive or inexact).
 
     Returns [(alive, died_event, overflow, peak)] per stream (real keys
     only; padding keys are dropped).

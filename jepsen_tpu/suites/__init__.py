@@ -70,6 +70,8 @@ def build_suite_test(o: dict | None, *, db_name: str,
                      supported_workloads: tuple, make_real: Callable,
                      make_workload: Callable | None = None,
                      fake_client: Callable | None = None,
+                     fake_db: Callable | None = None,
+                     fault_packages: dict | None = None,
                      defaults: dict | None = None) -> dict:
     """The standard suite test-map constructor shared by every DB suite.
 
@@ -109,7 +111,7 @@ def build_suite_test(o: dict | None, *, db_name: str,
     if fake:
         from jepsen_tpu.fakes import KVClient, KVStore
         from jepsen_tpu.net import NoopNet
-        kv = KVStore()
+        kv = fake_db() if fake_db else KVStore()
         whole_read = {"bank": "bank", "dirty-reads": "dirty"}.get(
             workload_name, "set")
         txn_style = "wr" if workload_name in ("wr", "long-fork") else "append"
@@ -135,6 +137,7 @@ def build_suite_test(o: dict | None, *, db_name: str,
     if faults:
         nemesis_pkg = combined.nemesis_package({
             "db": base["db"], "faults": set(faults),
+            "fault_packages": fault_packages,
             "interval": o.get("nemesis_interval",
                               d.get("nemesis_interval", 10.0))})
     return compose_test(base, workload, nemesis_pkg)
@@ -142,15 +145,19 @@ def build_suite_test(o: dict | None, *, db_name: str,
 
 def standard_opt_fn(supported_workloads: tuple,
                     extra: Callable | None = None,
-                    nemesis_interval: float = 10.0) -> Callable:
-    """The shared CLI option set for suites (plus per-suite extras)."""
+                    nemesis_interval: float = 10.0,
+                    extra_faults: tuple = ()) -> Callable:
+    """The shared CLI option set for suites (plus per-suite extras).
+    ``extra_faults`` extends --fault with the suite's DB-specific
+    vocabulary (e.g. cockroach's skew family, yugabyte's kill-master)."""
     def opt_fn(p):
         p.add_argument("--workload", default=supported_workloads[0],
                        choices=list(supported_workloads))
         p.add_argument("--fake", action="store_true",
                        help="in-memory client/DB over the dummy remote")
         p.add_argument("--fault", action="append", dest="faults",
-                       choices=["partition", "kill", "pause", "clock"])
+                       choices=["partition", "kill", "pause", "clock",
+                                *extra_faults])
         p.add_argument("--nemesis-interval", type=float,
                        default=nemesis_interval)
         p.add_argument("--no-perf", action="store_true")

@@ -122,10 +122,12 @@ SUPPORTED_WORKLOADS = ("register", "bank", "set", "append", "monotonic",
 
 
 def cockroachdb_test(opts_dict: dict | None = None) -> dict:
+    from jepsen_tpu.nemesis.db_specific import cockroach_fault_packages
     o = dict(opts_dict or {})
     workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
     return build_suite_test(
         o, db_name="cockroachdb", supported_workloads=SUPPORTED_WORKLOADS,
+        fault_packages=cockroach_fault_packages(),
         make_real=lambda o: {
             "db": CockroachDB(o.get("version", DEFAULT_VERSION)),
             "client": PGSuiteClient(
@@ -137,11 +139,16 @@ def cockroachdb_test(opts_dict: dict | None = None) -> dict:
             "os": Debian()})
 
 
+# the named skew family (cockroach/nemesis.clj:201-271) rides --fault
+COCKROACH_FAULTS = ("skew-small", "skew-subcritical", "skew-critical",
+                    "skew-big", "skew-huge", "skew-strobe", "startkill")
+
 main = cli.single_test_cmd(
     standard_test_fn(cockroachdb_test, extra_keys=("version",)),
     standard_opt_fn(SUPPORTED_WORKLOADS,
                     extra=lambda p: p.add_argument(
-                        "--version", default=DEFAULT_VERSION)),
+                        "--version", default=DEFAULT_VERSION),
+                    extra_faults=COCKROACH_FAULTS),
     name="jepsen-cockroachdb")
 
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import logging
 
-from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu import cli, control, db as db_mod, fakes
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
@@ -150,6 +150,36 @@ class YugabyteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
         for name in ("yb-tserver", "yb-master"):
             cu.grepkill(name, sig="CONT")
 
+    # ---- role-targeted process surface (yugabyte/nemesis.clj:12-44;
+    # the RoleProcess nemesis drives one role at a time) ----------------
+    def role_nodes(self, test, role):
+        return (master_nodes(test) if role == "master"
+                else list(test.get("nodes") or []))
+
+    def kill_master(self, test, node):
+        cu.grepkill("yb-master")
+
+    def kill_tserver(self, test, node):
+        cu.grepkill("yb-tserver")
+
+    def stop_master(self, test, node):
+        cu.grepkill("yb-master", sig="TERM")
+
+    def stop_tserver(self, test, node):
+        cu.grepkill("yb-tserver", sig="TERM")
+
+    def pause_master(self, test, node):
+        cu.grepkill("yb-master", sig="STOP")
+
+    def pause_tserver(self, test, node):
+        cu.grepkill("yb-tserver", sig="STOP")
+
+    def resume_master(self, test, node):
+        cu.grepkill("yb-master", sig="CONT")
+
+    def resume_tserver(self, test, node):
+        cu.grepkill("yb-tserver", sig="CONT")
+
     def primaries(self, test):
         return master_nodes(test)
 
@@ -162,12 +192,62 @@ class YugabyteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
 
 SUPPORTED_WORKLOADS = YSQL_WORKLOADS
 
+# role-targeted process faults (yugabyte/nemesis.clj:12-44) ride --fault
+YUGABYTE_FAULTS = ("kill-master", "kill-tserver", "stop-master",
+                   "stop-tserver", "pause-master", "pause-tserver")
+
+
+class FakeYugabyte(fakes.KVStore):
+    """Fake-mode double with the master/tserver role surface: role verbs
+    meta-log so tests can assert the fault vocabulary reaches the right
+    roles (masters = first three nodes, like the real topology)."""
+
+    def role_nodes(self, test, role):
+        return (master_nodes(test) if role == "master"
+                else list(test.get("nodes") or []))
+
+    def _role_note(self, verb, role, node):
+        self._note(f"db-{verb}-{role}", node)
+
+    def kill_master(self, test, node):
+        self._role_note("kill", "master", node)
+
+    def kill_tserver(self, test, node):
+        self._role_note("kill", "tserver", node)
+
+    def stop_master(self, test, node):
+        self._role_note("stop", "master", node)
+
+    def stop_tserver(self, test, node):
+        self._role_note("stop", "tserver", node)
+
+    def pause_master(self, test, node):
+        self._role_note("pause", "master", node)
+
+    def pause_tserver(self, test, node):
+        self._role_note("pause", "tserver", node)
+
+    def resume_master(self, test, node):
+        self._role_note("resume", "master", node)
+
+    def resume_tserver(self, test, node):
+        self._role_note("resume", "tserver", node)
+
+    def start_master(self, test, node):
+        self._role_note("start", "master", node)
+
+    def start_tserver(self, test, node):
+        self._role_note("start", "tserver", node)
+
 
 def yugabyte_test(opts_dict: dict | None = None) -> dict:
+    from jepsen_tpu.nemesis.db_specific import yugabyte_fault_packages
     o = dict(opts_dict or {})
     workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
     return build_suite_test(
         o, db_name="yugabyte", supported_workloads=SUPPORTED_WORKLOADS,
+        fault_packages=yugabyte_fault_packages(),
+        fake_db=FakeYugabyte,
         make_real=lambda o: {
             "db": YugabyteDB(o.get("version", DEFAULT_VERSION)),
             "client": PGSuiteClient(
@@ -203,7 +283,8 @@ main = cli.single_test_cmd(
                                                 "repeatable-read",
                                                 "serializable"]),
                         p.add_argument("--version",
-                                       default=DEFAULT_VERSION))),
+                                       default=DEFAULT_VERSION)),
+                    extra_faults=YUGABYTE_FAULTS),
     name="jepsen-yugabyte")
 
 

@@ -42,6 +42,13 @@ def _validity(run_dir: Path):
     try:
         mtime = f.stat().st_mtime_ns
     except OSError:
+        # run directory deleted: drop its entry so a long-lived server
+        # over many runs doesn't grow the cache monotonically
+        _VALIDITY_CACHE.pop(str(f), None)
+        if len(_VALIDITY_CACHE) > 4096:
+            for k in [k for k in _VALIDITY_CACHE
+                      if not Path(k).exists()]:
+                _VALIDITY_CACHE.pop(k, None)
         return None
     hit = _VALIDITY_CACHE.get(str(f))
     if hit is not None and hit[0] == mtime:

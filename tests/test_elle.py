@@ -982,3 +982,296 @@ def test_list_append_fast_scan_big_int_fallback():
                             consistency_models=("serializable",))
     assert out["valid?"] is True, out["anomaly-types"]
     assert out["read-scan-keys"]["python"] == 1
+
+
+# ---------------------------------------------------------------------------
+# φ-interval cluster path (production check_cycles) vs the cpu oracle
+# ---------------------------------------------------------------------------
+
+def test_phi_clusters_merge_intervals():
+    from jepsen_tpu.elle import _phi_clusters
+    import numpy as np
+
+    # back edges (src_phi, dst_phi): [2,7], [5,9] overlap; [20,21] apart
+    src_phi = np.asarray([7, 9, 21])
+    dst_phi = np.asarray([2, 5, 20])
+    assert _phi_clusters(src_phi, dst_phi) == [(2, 9), (20, 21)]
+    # self-loop (equal phi) is its own point interval
+    assert _phi_clusters(np.asarray([4]), np.asarray([4])) == [(4, 4)]
+
+
+def test_batch_cluster_screen_exact():
+    from jepsen_tpu.ops.scc import batch_cluster_screen
+    import numpy as np
+
+    # cluster 0: 3-cycle; cluster 1: acyclic chain; cluster 2: self-loop
+    cid = np.asarray([0, 0, 0, 1, 1, 2], np.int32)
+    src = np.asarray([0, 1, 2, 0, 1, 0], np.int32)
+    dst = np.asarray([1, 2, 0, 1, 2, 0], np.int32)
+    flags = batch_cluster_screen(cid, src, dst, 3, 3)
+    assert flags.tolist() == [True, False, True]
+    # empty edge set: nothing flagged
+    z = np.zeros(0, np.int32)
+    assert batch_cluster_screen(z, z, z, 2, 4).tolist() == [False, False]
+
+
+def _interleaved_history(rng, n_txns=60, n_keys=3, corrupt=0):
+    """Concurrent-process append history with real invoke/ok intervals
+    (so φ exists), optionally corrupting reads to inject anomalies."""
+    lists: dict = {}
+    history = []
+    open_ops: dict = {}
+    procs = list(range(4))
+    i = 0
+    while i < n_txns or open_ops:
+        p = rng.choice(procs)
+        if p in open_ops:
+            mops = open_ops.pop(p)
+            applied = []
+            for f, k, v in mops:
+                if f == "append":
+                    lists.setdefault(k, []).append(v)
+                    applied.append(["append", k, v])
+                else:
+                    applied.append(["r", k, list(lists.get(k, []))])
+            history.append({"type": "ok", "process": p, "f": "txn",
+                            "value": applied})
+        elif i < n_txns:
+            mops = []
+            for _ in range(rng.randrange(1, 3)):
+                k = rng.randrange(n_keys)
+                if rng.random() < 0.5:
+                    mops.append(["r", k, None])
+                else:
+                    mops.append(["append", k, 1000 * (i + 1) + len(mops)])
+            history.append({"type": "invoke", "process": p, "f": "txn",
+                            "value": mops})
+            open_ops[p] = mops
+            i += 1
+    for _ in range(corrupt):
+        oks = [op for op in history if op["type"] == "ok"]
+        op = rng.choice(oks)
+        reads = [m for m in op["value"] if m[0] == "r"]
+        if reads:
+            m = rng.choice(reads)
+            m[2] = list(m[2][:-1]) if m[2] else [rng.randrange(5)]
+    return history
+
+
+def test_phi_path_parity_fuzz_vs_cpu_oracle():
+    """The φ-cluster production path must reach the same verdict and
+    anomaly-type set as the trim+Tarjan cpu oracle on fuzzed concurrent
+    histories, clean and corrupted alike."""
+    rng = random.Random(7)
+    saw_invalid = saw_valid = 0
+    for trial in range(40):
+        h = _interleaved_history(rng, corrupt=rng.randrange(3))
+        r_fast = list_append.check(h, accelerator="auto")
+        r_cpu = list_append.check(h, accelerator="cpu")
+        assert r_fast["valid?"] == r_cpu["valid?"], (trial, r_fast, r_cpu)
+        assert r_fast["anomaly-types"] == r_cpu["anomaly-types"], (
+            trial, r_fast["anomaly-types"], r_cpu["anomaly-types"])
+        if r_cpu["valid?"]:
+            saw_valid += 1
+        else:
+            saw_invalid += 1
+    assert saw_valid >= 5 and saw_invalid >= 5, (saw_valid, saw_invalid)
+
+
+def test_phi_path_device_screen_parity():
+    """Force the device (virtual-cpu jax here) batched screen and check it
+    agrees with the oracle on a history with injected wr cycles."""
+    rng = random.Random(11)
+    h = _interleaved_history(rng, corrupt=2)
+    r_dev = list_append.check(h, accelerator="tpu")
+    r_cpu = list_append.check(h, accelerator="cpu")
+    assert r_dev["valid?"] == r_cpu["valid?"]
+    assert r_dev["anomaly-types"] == r_cpu["anomaly-types"]
+
+
+def test_phi_path_timing_cycles_parity():
+    """Realtime/process cycles must survive the cluster decomposition:
+    a stale read closed by realtime order is found by both paths."""
+    h = [
+        {"type": "invoke", "process": 0, "f": "txn",
+         "value": [["append", "x", 1]]},
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["append", "x", 1]]},
+        {"type": "invoke", "process": 1, "f": "txn",
+         "value": [["append", "x", 2], ["r", "x", None]]},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["append", "x", 2], ["r", "x", [1, 2]]]},
+        # realtime-after both, but reads the pre-2 state: stale
+        {"type": "invoke", "process": 2, "f": "txn",
+         "value": [["r", "x", None]]},
+        {"type": "ok", "process": 2, "f": "txn",
+         "value": [["r", "x", [1]]]},
+    ]
+    r_fast = list_append.check(h, accelerator="auto")
+    r_cpu = list_append.check(h, accelerator="cpu")
+    assert r_fast["valid?"] is False and r_cpu["valid?"] is False
+    assert r_fast["anomaly-types"] == r_cpu["anomaly-types"]
+
+
+def test_phi_path_oversized_cluster_falls_back(monkeypatch):
+    """Clusters beyond MATRIX_CLUSTER_MAX must still be classified
+    exactly (straight to the host pass, no matrix)."""
+    import jepsen_tpu.elle as elle_mod
+
+    monkeypatch.setattr(elle_mod, "MATRIX_CLUSTER_MAX", 2)
+    rng = random.Random(13)
+    h = _interleaved_history(rng, corrupt=2)
+    r_fast = list_append.check(h, accelerator="auto")
+    r_cpu = list_append.check(h, accelerator="cpu")
+    assert r_fast["valid?"] == r_cpu["valid?"]
+    assert r_fast["anomaly-types"] == r_cpu["anomaly-types"]
+
+
+# ---------------------------------------------------------------------------
+# columnar builder vs Python-builder oracle
+# ---------------------------------------------------------------------------
+
+def _messy_history(rng, n_txns=50):
+    """History exercising every columnar corner: multi-appends, failed
+    writes, info txns, empty reads, then random corruptions (dropped
+    elements, duplicated elements, failed-value reads, phantom values)."""
+    lists: dict = {}
+    history = []
+    vc = [0]
+
+    def nv():
+        vc[0] += 1
+        return vc[0]
+
+    for i in range(n_txns):
+        p = i % 5
+        k = rng.randrange(3)
+        kind = rng.random()
+        if kind < 0.15:
+            # failed multi-append
+            vals = [nv() for _ in range(rng.randrange(1, 3))]
+            mops = [["append", k, v] for v in vals]
+            history.append({"type": "invoke", "process": p, "f": "txn",
+                            "value": [[f, kk, vv] for f, kk, vv in mops]})
+            history.append({"type": "fail", "process": p, "f": "txn",
+                            "value": mops})
+            continue
+        mops = []
+        for _ in range(rng.randrange(1, 4)):
+            if rng.random() < 0.5:
+                mops.append(["r", k, None])
+            else:
+                v = nv()
+                lists.setdefault(k, []).append(v)
+                mops.append(["append", k, v])
+        applied = [
+            ["r", m[1], list(lists.get(m[1], []))] if m[0] == "r" else m
+            for m in mops]
+        history.append({"type": "invoke", "process": p, "f": "txn",
+                        "value": mops})
+        t = "info" if kind < 0.22 else "ok"
+        history.append({"type": t, "process": p, "f": "txn",
+                        "value": applied if t == "ok" else mops})
+    # corruptions
+    for _ in range(rng.randrange(4)):
+        oks = [op for op in history if op["type"] == "ok"]
+        op = rng.choice(oks)
+        reads = [m for m in op["value"] if m[0] == "r"]
+        if not reads:
+            continue
+        m = rng.choice(reads)
+        roll = rng.random()
+        if roll < 0.3 and m[2]:
+            m[2] = list(m[2][:-1])          # dropped tail element
+        elif roll < 0.5 and m[2]:
+            m[2] = list(m[2]) + [m[2][0]]   # duplicated element
+        elif roll < 0.75:
+            m[2] = list(m[2]) + [vc[0] + rng.randrange(1, 9)]  # phantom
+        else:
+            m[2] = [rng.randrange(1, vc[0] + 1)]  # arbitrary single value
+    return history
+
+
+def test_columnar_builder_parity_fuzz():
+    """The columnar builder must reach the oracle's verdict and
+    anomaly-type set on messy histories (multi-appends, fails, infos,
+    corrupted reads)."""
+    rng = random.Random(23)
+    invalid = 0
+    for trial in range(60):
+        h = _messy_history(rng)
+        r_col = list_append.check(h, accelerator="auto")
+        r_cpu = list_append.check(h, accelerator="cpu")
+        assert r_col.get("builder") == "columnar", "fast path must engage"
+        assert r_col["valid?"] == r_cpu["valid?"], (trial, r_col, r_cpu)
+        assert r_col["anomaly-types"] == r_cpu["anomaly-types"], (
+            trial, r_col["anomaly-types"], r_cpu["anomaly-types"])
+        assert r_col["edge-count"] == r_cpu["edge-count"], trial
+        invalid += 0 if r_cpu["valid?"] else 1
+    assert invalid >= 15, invalid
+
+
+def test_columnar_falls_back_on_non_int_domains():
+    for bad_val in ("s", 2.5, True, (1 << 53) + 1):
+        h = [
+            {"type": "ok", "process": 0, "f": "txn",
+             "value": [["append", 0, bad_val]]},
+            {"type": "ok", "process": 1, "f": "txn",
+             "value": [["r", 0, [bad_val]]]},
+        ]
+        r = list_append.check(h, accelerator="auto")
+        assert "builder" not in r, bad_val  # python builder took over
+
+
+def test_columnar_out_of_range_read_value_no_writer_collision():
+    """Regression: a corrupt read ending in a value >= 2^32 must not
+    alias another key's writer through the 32-bit composite join."""
+    h = [
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["append", 0, 7]]},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["append", 1, 1]]},
+        # key-1 read whose last element is (1<<32)|7 — with kid=1 the
+        # composite equals key-0's append of 7 if unmasked
+        {"type": "ok", "process": 2, "f": "txn",
+         "value": [["r", 1, [(1 << 32) | 7]]]},
+    ]
+    r_col = list_append.check(h, accelerator="auto")
+    r_cpu = list_append.check(h, accelerator="cpu")
+    assert r_col["edge-count"] == r_cpu["edge-count"]
+    assert r_col["anomaly-types"] == r_cpu["anomaly-types"]
+
+
+def test_columnar_spine_tie_break_matches_oracle():
+    """Regression: on equal-length conflicting reads the spine must be
+    the FIRST longest read (the oracle's max(key=len) semantics)."""
+    h = [
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["append", 0, 1]]},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["append", 0, 2]]},
+        {"type": "ok", "process": 2, "f": "txn",
+         "value": [["append", 0, 3]]},
+        {"type": "ok", "process": 3, "f": "txn",
+         "value": [["r", 0, [1, 2]]]},
+        {"type": "ok", "process": 4, "f": "txn",
+         "value": [["r", 0, [1, 3]]]},
+    ]
+    r_col = list_append.check(h, accelerator="auto")
+    r_cpu = list_append.check(h, accelerator="cpu")
+    assert r_col["anomaly-types"] == r_cpu["anomaly-types"]
+    assert r_col["edge-count"] == r_cpu["edge-count"]
+
+
+def test_batch_cluster_screen_chunks_over_budget(monkeypatch):
+    """Batches beyond the element budget split along the cluster axis
+    without changing verdicts."""
+    from jepsen_tpu.ops import scc as scc_mod
+    import numpy as np
+
+    monkeypatch.setattr(scc_mod, "SCREEN_MAX_ELEMS", 8 * 8 * 2)  # 2/chunk
+    cid = np.asarray([0, 0, 1, 2, 2, 2, 4], np.int32)
+    src = np.asarray([0, 1, 0, 0, 1, 2, 0], np.int32)
+    dst = np.asarray([1, 0, 1, 1, 2, 0, 0], np.int32)
+    flags = scc_mod.batch_cluster_screen(cid, src, dst, 5, 3)
+    assert flags.tolist() == [True, False, True, False, True]
