@@ -34,6 +34,28 @@ def cas_register_step_py(state: int, f: int, a: int, b: int) -> tuple[int, bool]
     return state, False
 
 
+def multi_register_step_py(n_keys: int, n_values: int):
+    """Pure-python twin of models.multi_register_spec().step_ids (same
+    base-digit state/txn encodings; see that spec for the layout)."""
+    V, K = n_values, n_keys
+    SB, AB = V + 1, 2 * V + 2
+
+    def step(state: int, f: int, a: int, b: int) -> tuple[int, bool]:
+        acts = a
+        for k in range(K):
+            act = acts % AB
+            acts //= AB
+            digit = (state // (SB ** k)) % SB
+            if 2 <= act < 2 + V:          # read value act-2
+                if digit != act - 1:
+                    return state, False
+            elif act >= 2 + V:            # write value act-(2+V)
+                state += (act - (1 + V) - digit) * (SB ** k)
+        return state, True
+
+    return step
+
+
 # ---------------------------------------------------------------------------
 # Just-in-time linearization over an EventStream (the TPU kernel's CPU twin)
 # ---------------------------------------------------------------------------

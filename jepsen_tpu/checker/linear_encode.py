@@ -46,15 +46,19 @@ class EventStream:
         return len(self.kind)
 
 
-def encode_register_ops(history: list[dict], intern: Intern | None = None) -> EventStream:
+def encode_register_ops(history: list[dict], intern: Intern | None = None,
+                        encode_args=None) -> EventStream:
     """Encodes a single-register r/w/cas history (the reference tutorial's
-    etcd workload; BASELINE configs 1-3).
+    etcd workload; BASELINE configs 1-3) into an EventStream.
 
     Op encodings (f, a, b):
       read v  -> (CAS_F_READ, id(v), 0); a read of None (id 0) matches any state
       write v -> (CAS_F_WRITE, id(v), 0)
       cas [u,v] -> (CAS_F_CAS, id(u), id(v))
-    """
+
+    ``encode_args(op) -> (f, a, b)`` overrides the per-op encoding (the
+    invoke/completion pairing, slot assignment, and crashed-read handling
+    are model-independent — encode_multi_register_ops reuses them)."""
     intern = intern or Intern()
     kinds, slots, fs, as_, bs, idxs = [], [], [], [], [], []
     open_by_process: dict = {}   # process -> (slot, op)
@@ -62,16 +66,17 @@ def encode_register_ops(history: list[dict], intern: Intern | None = None) -> Ev
     next_slot = 0
     n_ops = 0
 
-    def encode_args(op):
-        f, v = op.get("f"), op.get("value")
-        if f == "read":
-            return CAS_F_READ, intern.id(v), 0
-        if f == "write":
-            return CAS_F_WRITE, intern.id(v), 0
-        if f == "cas":
-            u, w = v
-            return CAS_F_CAS, intern.id(u), intern.id(w)
-        raise ValueError(f"unknown register op {f!r}")
+    if encode_args is None:
+        def encode_args(op):
+            f, v = op.get("f"), op.get("value")
+            if f == "read":
+                return CAS_F_READ, intern.id(v), 0
+            if f == "write":
+                return CAS_F_WRITE, intern.id(v), 0
+            if f == "cas":
+                u, w = v
+                return CAS_F_CAS, intern.id(u), intern.id(w)
+            raise ValueError(f"unknown register op {f!r}")
 
     # First pass: pair invokes with completions; find fail pairs and crashed
     # reads to drop; *complete* invocation values from their returns
@@ -154,6 +159,66 @@ def encode_register_ops(history: list[dict], intern: Intern | None = None) -> Ev
         n_ops=n_ops,
         intern=intern,
     )
+
+
+def encode_multi_register_ops(history: list[dict], n_keys: int = 3,
+                              n_values: int = 5) -> EventStream:
+    """Encodes a multi-register txn history (the multi-key-acid workload,
+    yugabyte/multi_key_acid.clj) for models.multi_register_spec: one op
+    f="txn" whose value is [[f, k, v], ...] packs into base-(2V+2)
+    per-key action digits of ``a`` (see the spec for the layout).
+
+    The packed encoding holds one action per key, which covers the
+    workload's generators exactly (they draw random nonempty *subsets*
+    of the key range, so a txn never touches a key twice); a history
+    with repeated keys in one txn raises ValueError and the checker
+    falls back to the object-model search."""
+    V, K = n_values, n_keys
+    AB = 2 * V + 2
+
+    def encode_args(op):
+        if op.get("f") != "txn":
+            raise ValueError(f"multi-register op must be txn, got "
+                             f"{op.get('f')!r}")
+        acts = [0] * K
+        for f, k, v in op.get("value") or ():
+            if not isinstance(k, int) or not (0 <= k < K):
+                raise ValueError(f"key {k!r} outside [0, {K})")
+            if acts[k] != 0:
+                raise ValueError(f"txn touches key {k} twice")
+            if f == "r":
+                if v is None:
+                    acts[k] = 1
+                elif isinstance(v, int) and 0 <= v < V:
+                    acts[k] = 2 + v
+                else:
+                    raise ValueError(f"read value {v!r} outside [0, {V})")
+            elif f == "w":
+                if not (isinstance(v, int) and 0 <= v < V):
+                    raise ValueError(f"write value {v!r} outside [0, {V})")
+                acts[k] = 2 + V + v
+            else:
+                raise ValueError(f"unknown micro-op {f!r}")
+        a = 0
+        for k in reversed(range(K)):
+            a = a * AB + acts[k]
+        return 0, a, 0
+
+    stream = encode_register_ops(history, encode_args=encode_args)
+    # interned-state count for kernel selection: the whole map space
+    stream.intern = _DenseIntern((V + 1) ** K)
+    return stream
+
+
+class _DenseIntern:
+    """Stands in for Intern when states are arithmetic encodings rather
+    than interned values: only the state-count surface is needed."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self):
+        return self._n
 
 
 def pad_streams(streams: list[EventStream], length: int | None = None) -> dict:

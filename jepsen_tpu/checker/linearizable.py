@@ -45,17 +45,51 @@ class LinearizableChecker(Checker):
         algorithm: str = "auto",
         accelerator: str = "auto",
         capacity: int = 256,
+        multi_shape: tuple = (3, 5),
     ):
         self.model = model if model is not None else CASRegister()
         self.algorithm = algorithm
         self.accelerator = accelerator
         self.capacity = capacity
+        # (n_keys, n_values) for the MultiRegister int encoding — the
+        # multi-key-acid workload's shape (multi_key_acid.clj key-range/
+        # rand-val)
+        self.multi_shape = multi_shape
         self._kernel = None
 
-    def _tpu_kernel(self):
+    def _encoding(self, history):
+        """(stream, step_py, spec) when the model has an int encoding for
+        the device/stream paths, else None (object-model wgl search)."""
+        from jepsen_tpu.models import MultiRegister, multi_register_spec
+
+        if isinstance(self.model, CASRegister):
+            from jepsen_tpu.history import Intern
+            from jepsen_tpu.models import cas_register_spec
+            intern = Intern()
+            # a non-None initial register value interns FIRST so its id
+            # is the kernel's init state (single-key-acid starts at 0)
+            init_id = (0 if self.model.value is None
+                       else intern.id(self.model.value))
+            return (encode_register_ops(history, intern=intern),
+                    cas_register_step_py, cas_register_spec(init_id))
+        if isinstance(self.model, MultiRegister):
+            from jepsen_tpu.checker.linear_cpu import multi_register_step_py
+            from jepsen_tpu.checker.linear_encode import (
+                encode_multi_register_ops)
+            k, v = self.multi_shape
+            try:
+                stream = encode_multi_register_ops(history, k, v)
+            except ValueError:
+                return None  # outside the packed encoding: wgl fallback
+            return (stream, multi_register_step_py(k, v),
+                    multi_register_spec(k, v))
+        return None
+
+    def _tpu_kernel(self, spec):
         if self._kernel is None:
             from jepsen_tpu.ops.jitlin import JitLinKernel
-            self._kernel = JitLinKernel()
+            self._kernel = JitLinKernel(step_ids=spec.step_ids,
+                                        init_state=spec.init_state)
         return self._kernel
 
     def check(self, test, history, opts):
@@ -66,49 +100,64 @@ class LinearizableChecker(Checker):
             return self._finish(wgl(history, self.model), history, test)
 
         # jitlin path: encode once, run on device or host
-        if not isinstance(self.model, CASRegister):
-            # only the register family has an int encoding so far
+        enc = self._encoding(history)
+        if enc is None:
             return self._finish(wgl(history, self.model), history, test)
-        stream = encode_register_ops(history)
+        stream, step_py, spec = enc
+        is_cas = isinstance(self.model, CASRegister)
         if accelerator == "cpu" or (
             accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
         ):
             res = None
             if algorithm in ("jitlin", "auto"):
-                # native C++ search first (same algorithm, ~100x the
-                # Python loop); falls back when unbuilt or >63 slots
-                from jepsen_tpu.native import check_stream_native
-                res = check_stream_native(stream)
-                if res is not None and res.valid == "unknown":
-                    res = None  # capacity blown: retry in Python (bignum)
+                if is_cas and spec.init_state == 0:
+                    # native C++ search first (same algorithm, ~100x the
+                    # Python loop); falls back when unbuilt, >63 slots,
+                    # or a non-default initial state (the C search
+                    # hardcodes init id 0)
+                    from jepsen_tpu.native import check_stream_native
+                    res = check_stream_native(stream)
+                    if res is not None and res.valid == "unknown":
+                        res = None  # capacity blown: retry in Python
                 if res is None:
-                    res = check_stream(stream)
+                    res = check_stream(stream, step=step_py,
+                                       init_state=spec.init_state)
             else:
                 res = wgl(history, self.model)
-            return self._finish(res, history, test, stream)
+            return self._finish(res, history, test, stream,
+                                step_py=step_py,
+                                init_state=spec.init_state)
 
         # device path. For long histories over small value domains, the
         # block-composed transfer-matrix kernel settles the verdict with
         # far less sequential depth (MXU boolean matmuls over chunks);
         # the event scan remains the diagnostics path (died-at, peak).
-        from jepsen_tpu.ops.jitlin import matrix_check, verdict
-        m = matrix_check(stream)
-        # accept only an exact matrix True: m[2] (inexact/oob) means a
-        # state id escaped the intern range, so the verdict proves nothing
-        if m is not None and m[0] and not m[2]:
-            return self._finish(LinearResult(
-                valid=True, failed_event=-1, failed_op_index=-1,
-                configs_max=0, algorithm="jitlin-tpu-matrix"),
-                history, test)
-        alive, died, overflow, peak = self._tpu_kernel().check(
+        from jepsen_tpu.ops.jitlin import matrix_check, matrix_ok, verdict
+        import numpy as np
+        n_returns = int((np.asarray(stream.kind) == 1).sum())
+        if matrix_ok(stream.n_slots, len(stream.intern), n_returns):
+            m = matrix_check(stream, step_ids=spec.step_ids,
+                             init_state=spec.init_state,
+                             num_states=len(stream.intern))
+            # accept only an exact matrix True: m[2] (inexact/oob) means a
+            # state id escaped the intern range and proves nothing
+            if m is not None and m[0] and not m[2]:
+                return self._finish(LinearResult(
+                    valid=True, failed_event=-1, failed_op_index=-1,
+                    configs_max=0, algorithm="jitlin-tpu-matrix"),
+                    history, test)
+        alive, died, overflow, peak = self._tpu_kernel(spec).check(
             stream, capacity=self.capacity
         )
         valid = verdict(alive, overflow)
         if valid == "unknown":
             # frontier overflowed K and died: retry with the exact CPU twin
-            res = check_stream(stream)
+            res = check_stream(stream, step=step_py,
+                               init_state=spec.init_state)
             res.algorithm = "jitlin-cpu(fallback)"
-            return self._finish(res, history, test, stream)
+            return self._finish(res, history, test, stream,
+                                step_py=step_py,
+                                init_state=spec.init_state)
         res = LinearResult(
             valid=valid,
             failed_event=died,
@@ -116,10 +165,11 @@ class LinearizableChecker(Checker):
             configs_max=peak,
             algorithm="jitlin-tpu",
         )
-        return self._finish(res, history, test, stream)
+        return self._finish(res, history, test, stream, step_py=step_py,
+                            init_state=spec.init_state)
 
     def _finish(self, res: LinearResult, history, test=None,
-                stream=None) -> dict:
+                stream=None, step_py=None, init_state: int = 0) -> dict:
         out: dict[str, Any] = {
             "valid?": res.valid,
             "algorithm": res.algorithm,
@@ -138,7 +188,9 @@ class LinearizableChecker(Checker):
             if res.final_configs is None and stream is not None \
                     and len(stream) <= MAX_REPORT_EVENTS:
                 try:
-                    res2 = check_stream(stream)
+                    res2 = check_stream(
+                        stream, step=step_py or cas_register_step_py,
+                        init_state=init_state)
                     if res2.valid is False:
                         res.final_configs = res2.final_configs
                 except Exception:  # noqa: BLE001 report detail is optional

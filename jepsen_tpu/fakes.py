@@ -141,6 +141,9 @@ class KVStore(MetaLogDB):
         self.adya: dict = {}       # adya G2 pair -> (cell, uid)
         self.holder = None         # mutex workload: current lock holder
         self.counter = 0           # counter workload
+        self.ddl_rows: list | None = None  # default-value table (None=absent)
+        self.ddl_next = 0
+        self.cmt: dict = {}        # comments workload: key -> set of ids
 
     def _wipe(self):
         self.registers.clear()
@@ -153,6 +156,9 @@ class KVStore(MetaLogDB):
         self.adya.clear()
         self.holder = None
         self.counter = 0
+        self.ddl_rows = None
+        self.ddl_next = 0
+        self.cmt.clear()
 
     def read(self, k):
         with self.lock:
@@ -198,6 +204,55 @@ class KVStore(MetaLogDB):
                 else:
                     raise ValueError(f"unknown micro-op {f!r}")
             return out
+
+    def multi_txn(self, group, micro_ops) -> list:
+        """Atomic multi-register txn for one independent key group
+        (multi-key-acid): registers live at (group, k)."""
+        with self.lock:
+            out = []
+            for f, k, v in micro_ops:
+                if f == "r":
+                    out.append(["r", k, self.registers.get((group, k))])
+                elif f == "w":
+                    self.registers[(group, k)] = v
+                    out.append(["w", k, v])
+                else:
+                    raise ValueError(f"unknown micro-op {f!r}")
+            return out
+
+    # default-value workload: one DDL-churned table with an int column
+    # whose default is 0 (the fake is anomaly-free: inserts always carry
+    # the default, so reads never surface a null)
+    def ddl_create(self) -> None:
+        with self.lock:
+            if self.ddl_rows is None:
+                self.ddl_rows = []
+
+    def ddl_drop(self) -> None:
+        with self.lock:
+            self.ddl_rows = None
+
+    def ddl_insert(self) -> bool:
+        with self.lock:
+            if self.ddl_rows is None:
+                return False
+            self.ddl_rows.append({"id": self.ddl_next, "v": 0})
+            self.ddl_next += 1
+            return True
+
+    def ddl_read(self) -> list | None:
+        with self.lock:
+            return (None if self.ddl_rows is None
+                    else [dict(r) for r in self.ddl_rows])
+
+    # comments workload: per-key visible-id sets
+    def cmt_write(self, k, i) -> None:
+        with self.lock:
+            self.cmt.setdefault(k, set()).add(i)
+
+    def cmt_read(self, k) -> list:
+        with self.lock:
+            return sorted(self.cmt.get(k, ()))
 
     def enqueue(self, v):
         with self.lock:
@@ -340,6 +395,34 @@ class KVClient(MetaLogClient):
             return {**op, "type": "ok"}
         if test.get("counter") and f == "read" and v is None:
             return {**op, "type": "ok", "value": self.db.counter_read()}
+        if test.get("txn-mode") == "multi" and f == "txn":
+            k, mops = v
+            return {**op, "type": "ok",
+                    "value": [k, self.db.multi_txn(k, mops)]}
+        if test.get("ddl-table"):
+            if f == "create-table":
+                self.db.ddl_create()
+                return {**op, "type": "ok"}
+            if f == "drop-table":
+                self.db.ddl_drop()
+                return {**op, "type": "ok"}
+            if f == "insert":
+                ok = self.db.ddl_insert()
+                return {**op, "type": "ok" if ok else "fail"}
+            if f == "read":
+                rows = self.db.ddl_read()
+                if rows is None:
+                    return {**op, "type": "fail", "error": ["no-table"]}
+                return {**op, "type": "ok", "value": rows}
+        if test.get("comments"):
+            if f == "write":
+                k, i = v
+                self.db.cmt_write(k, i)
+                return {**op, "type": "ok"}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self.db.cmt_read(k)]}
         if f == "transfer":
             t = v or {}
             ok = self.db.transfer(t.get("from"), t.get("to"),

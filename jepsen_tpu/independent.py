@@ -331,18 +331,25 @@ class IndependentChecker(Checker):
         try:
             from jepsen_tpu.checker import merge_valid
             from jepsen_tpu.checker.linear_cpu import check_stream
-            from jepsen_tpu.checker.linear_encode import encode_register_ops
             from jepsen_tpu.ops.jitlin import verdict
             from jepsen_tpu.parallel import batch_check
             fkeys = list(subs.keys())
-            streams = [encode_register_ops(subs[fk]) for fk in fkeys]
+            # per-key encode via the checker's own _encoding so the
+            # initial register value interns to the kernel's init state
+            # (CASRegister(0) — single-key-acid — needs init id 1)
+            encs = [chk._encoding(subs[fk]) for fk in fkeys]
+            if any(e is None for e in encs):
+                return None
+            streams = [e[0] for e in encs]
+            step_py, spec = encs[0][1], encs[0][2]
             outcomes = batch_check(streams, capacity=chk.capacity,
-                                   kernel=chk._tpu_kernel())
+                                   kernel=chk._tpu_kernel(spec))
             results = {}
             for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
                 v = verdict(alive, ovf)
                 if v == "unknown":
-                    res = check_stream(stream)
+                    res = check_stream(stream, step=step_py,
+                                       init_state=spec.init_state)
                     results[fk] = {"valid?": res.valid,
                                    "algorithm": "jitlin-cpu(fallback)"}
                 else:

@@ -230,6 +230,71 @@ def register_spec(init_state: int = 0) -> IntSpec:
 
 
 @dataclass(frozen=True)
+class MultiRegister(Model):
+    """A register map supporting transactional reads/writes over keys
+    (yugabyte/src/yugabyte/multi_key_acid.clj:17-37 MultiRegister): one
+    op f="txn" whose value is [[f, k, v], ...] with f "r"/"w"; a read of
+    None is always legal, a read of v must match the key's current value
+    (missing keys read as None)."""
+
+    entries: tuple = ()  # sorted ((k, v), ...)
+
+    def get(self, k):
+        for kk, v in self.entries:
+            if kk == k:
+                return v
+        return None
+
+    def step(self, op):
+        entries = dict(self.entries)
+        for f, k, v in op.get("value") or ():
+            if f == "r":
+                if v is not None and v != entries.get(k):
+                    return inconsistent(
+                        f"{entries.get(k)!r} ≠ {v!r} at key {k!r}")
+            elif f == "w":
+                entries[k] = v
+            else:
+                return inconsistent(f"unknown txn micro-op {f!r}")
+        return MultiRegister(tuple(sorted(entries.items())))
+
+
+def multi_register_spec(n_keys: int = 3, n_values: int = 5) -> IntSpec:
+    """Device-encodable multi-register (the multi-key-acid model).
+
+    State interns the whole key→value map as base-(V+1) digits (digit 0
+    = unset/None, 1..V = values), so K keys × V values is only (V+1)^K
+    states — 216 at the workload's 3×5, squarely in the dense-table
+    kernel's regime. A txn op packs per-key actions as base-(2V+2)
+    digits of ``a``: 0 none, 1 read-None, 2+v read-v, 2+V+v write-v.
+    ``step_ids`` decodes with a static loop over keys (shape-polymorphic
+    jnp arithmetic, no data-dependent control flow)."""
+    V, K = n_values, n_keys
+    SB = V + 1          # state digit base
+    AB = 2 * V + 2      # action digit base
+    if AB ** K >= (1 << 31):
+        raise ValueError(f"txn encoding overflows int32: ({AB})^{K}")
+
+    def step_ids(state, f, a, b):
+        import jax.numpy as jnp
+        ok = jnp.full(jnp.shape(state), True)
+        new_state = state
+        acts = a
+        for k in range(K):
+            act = acts % AB
+            acts = acts // AB
+            digit = (new_state // (SB ** k)) % SB
+            is_rv = (act >= 2) & (act < 2 + V)
+            is_w = act >= 2 + V
+            ok = ok & (~is_rv | (digit == act - 1))  # read v: digit == v+1
+            wdigit = jnp.where(is_w, act - (1 + V), digit)
+            new_state = new_state + (wdigit - digit) * (SB ** k)
+        return new_state, ok
+
+    return IntSpec(f"multi-register-{K}x{V}", 0, 1, step_ids)
+
+
+@dataclass(frozen=True)
 class Memo:
     """Wrapper marking a model as memoizable by (hash) — knossos.model/memo
     analog. Object models here are frozen dataclasses, hence hashable, so

@@ -126,11 +126,7 @@ def _build_step(num_slots: int, capacity: int, step_ids, init_state: int,
         new_carry = lax.switch(kind, [on_invoke, on_return, on_noop], None)
         return new_carry, None
 
-    def run(kind, slot, f, a, b):
-        mask0 = jnp.full((K,), SENTINEL_MASK, dtype=jnp.uint32)
-        mask0 = mask0.at[0].set(jnp.uint32(0))
-        state0 = jnp.full((K,), SENTINEL_STATE, dtype=jnp.int32)
-        state0 = state0.at[0].set(jnp.int32(init_state))
+    def scan_from(mask0, state0, events):
         carry = (
             mask0, state0,
             jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
@@ -138,12 +134,36 @@ def _build_step(num_slots: int, capacity: int, step_ids, init_state: int,
             jnp.uint32(0), jnp.bool_(True), jnp.int32(-1), jnp.bool_(False),
             jnp.int32(1), jnp.int32(0),
         )
+        carry, _ = lax.scan(step_event, carry, events)
+        (mask, state, _, _, _, _, alive, died_at, overflow, peak, _) = carry
+        return mask, state, alive, died_at, overflow, peak
+
+    def run(kind, slot, f, a, b):
+        mask0 = jnp.full((K,), SENTINEL_MASK, dtype=jnp.uint32)
+        mask0 = mask0.at[0].set(jnp.uint32(0))
+        state0 = jnp.full((K,), SENTINEL_STATE, dtype=jnp.int32)
+        state0 = state0.at[0].set(jnp.int32(init_state))
         events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
                   f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
-        carry, _ = lax.scan(step_event, carry, events)
-        (_, _, _, _, _, _, alive, died_at, overflow, peak, _) = carry
+        _, _, alive, died_at, overflow, peak = scan_from(mask0, state0, events)
         return alive, died_at, overflow, peak
 
+    def run_resume(kind, slot, f, a, b, mask0, state0):
+        """Segmented-verification variant: starts from a prior segment's
+        frontier (masks are all-zero at a quiescent cut, so only states
+        carry meaning) and returns the final frontier with the verdict."""
+        events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
+                  f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
+        mask, state, alive, died_at, overflow, peak = scan_from(
+            mask0, state0, events)
+        return alive, died_at, overflow, peak, mask, state
+
+    run.resume = run_resume
+    run.init_frontier = lambda: (
+        np.concatenate([np.zeros(1, np.uint32),
+                        np.full(K - 1, SENTINEL_MASK, np.uint32)]),
+        np.concatenate([np.asarray([init_state], np.int32),
+                        np.full(K - 1, SENTINEL_STATE, np.int32)]))
     return run
 
 
@@ -236,23 +256,45 @@ def _build_dense_step(num_slots: int, num_states: int, step_ids,
 
         return lax.switch(kind, [on_invoke, on_return, on_noop], None), None
 
-    def run(kind, slot, f, a, b):
-        table0 = jnp.zeros((M, V), dtype=bool).at[0, init_state].set(True)
+    def scan_from(table0, events):
         carry = (
             table0,
             jnp.zeros((S, V, V), jnp.bfloat16),
             jnp.uint32(0), jnp.bool_(True), jnp.int32(-1), jnp.int32(1),
             jnp.bool_(False), jnp.int32(0),
         )
+        carry, _ = lax.scan(step_event, carry, events)
+        (table, _, _, alive, died_at, peak, inexact, _) = carry
+        return table, alive, died_at, peak, inexact
+
+    def run(kind, slot, f, a, b):
+        table0 = jnp.zeros((M, V), dtype=bool).at[0, init_state].set(True)
         events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
                   f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
-        carry, _ = lax.scan(step_event, carry, events)
-        (_, _, _, alive, died_at, peak, inexact, _) = carry
+        _, alive, died_at, peak, inexact = scan_from(table0, events)
         # the table covers the whole config space, so the only inexactness
         # is a state id escaping the intern range — surfaced on the
         # overflow channel so verdict() degrades to unknown, not wrong
         return alive, died_at, inexact, peak
 
+    def run_resume(kind, slot, f, a, b, table0):
+        """Segmented-verification variant: starts from a caller-supplied
+        frontier table (a previous segment's output — the stream must be
+        cut at quiescent points, i.e. no ops pending across the cut) and
+        returns the final table alongside the verdict, staying on device
+        between segments."""
+        events = (kind.astype(jnp.int32), slot.astype(jnp.int32),
+                  f.astype(jnp.int32), a.astype(jnp.int32), b.astype(jnp.int32))
+        table, alive, died_at, peak, inexact = scan_from(table0, events)
+        return alive, died_at, inexact, peak, table
+
+    def init_table():
+        t = np.zeros((M, V), bool)
+        t[0, init_state] = True
+        return t
+
+    run.resume = run_resume
+    run.init_table = init_table
     return run
 
 
@@ -453,6 +495,9 @@ MATRIX_MAX_STATES = 16
 MATRIX_MIN_RETURNS = 2000
 # per-step [G, MV, MV] f32 intermediates: cap G * MV^2 (~1 GB at f32)
 MATRIX_MAX_ELEMS = 1 << 28
+# keys per dispatch: G = B*C beyond ~256 goes HBM-bound superlinearly,
+# so bigger key batches pipeline as several ≤256-key dispatches
+MATRIX_SUB_KEYS = 256
 
 
 def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
@@ -514,6 +559,41 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     R_max = max((p[0].shape[0] for p in preps), default=0)
     if R_max == 0:
         return [(True, -1, False, 0)] * B
+
+    # Large key batches split into sub-dispatches of MATRIX_SUB_KEYS:
+    # per-step cost grows superlinearly with G = B*C past the measured
+    # sweet spot (the [G, MV, MV] intermediates go HBM-bound), so four
+    # 256-key dispatches beat one 1024-key dispatch. All sub-batches are
+    # submitted BEFORE any result is read, so host prep and grid
+    # transfers for batch k+1 overlap batch k's device compute — on a
+    # tunneled accelerator that hides most of the transfer wall-clock.
+    # (A mesh shards G across devices, shifting the sweet spot; the mesh
+    # path keeps the single dispatch.)
+    if mesh is None and B > MATRIX_SUB_KEYS:
+        handles = []
+        for lo in range(0, B, MATRIX_SUB_KEYS):
+            sl = preps[lo:lo + MATRIX_SUB_KEYS]
+            handles.append((len(sl), _matrix_dispatch(
+                sl, S, R_max, V, step_ids, init_state, None)))
+        out = []
+        for nb, (alive, inexact) in handles:
+            a, ix = np.asarray(alive), np.asarray(inexact)
+            out += [(bool(a[b]), -1, bool(ix[b]), 0) for b in range(nb)]
+        return out
+
+    alive, inexact = _matrix_dispatch(preps, S, R_max, V, step_ids,
+                                      init_state, mesh)
+    alive, inexact = np.asarray(alive), np.asarray(inexact)
+    return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
+
+
+def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh):
+    """Builds one sub-batch's chunk grids and dispatches the kernel,
+    returning UNSYNCED device arrays (alive[B], inexact[B]) so callers
+    can pipeline several dispatches before reading any back."""
+    import jax
+
+    B = len(preps)
     # chunk layout: per key, C chunks of T returns (padded with identity);
     # chunk g = b*C + c. R is bucketed so (T, C, B) — and therefore the
     # compiled program — is shared across nearby history lengths. The
@@ -562,7 +642,7 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
 
     slots, pends, opss, vals = zip(*[key_arrays(p) for p in preps])
     # Intern the batch's distinct (f, a, b) ops: the kernel receives small
-    # int32 id grids plus one [U, 3] table instead of a [T, G, S, 3] int64
+    # int id grids plus one [U, 3] table instead of a [T, G, S, 3] int64
     # op tensor — an ~8x transfer cut that matters on tunneled devices,
     # and the per-op transition matrices get built once instead of per
     # scan step.
@@ -578,7 +658,10 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
                          keys & 0x1FFFFF], axis=1)
     else:
         uops, inv = np.unique(all_ops, axis=0, return_inverse=True)
-    ids = inv.astype(np.int32).reshape(B, C * T, S)
+    # id/slot grids ride the narrowest exact dtype — the grids are the
+    # bulk of host→device traffic and the tunnel is bandwidth-bound
+    id_dtype = np.int16 if len(uops) < (1 << 15) else np.int32
+    ids = inv.astype(id_dtype).reshape(B, C * T, S)
     ub = _bucket(len(uops), floor=16)
     uops = np.concatenate(
         [uops, np.zeros((ub - len(uops), 3), uops.dtype)]).astype(np.int32)
@@ -590,16 +673,13 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         return x.reshape((T, B * C) + x.shape[3:])
 
     grids = [as_tg(np.stack(pends)), as_tg(ids),
-             as_tg(np.stack(slots)), as_tg(np.stack(vals))]
+             as_tg(np.stack(slots).astype(np.int8)), as_tg(np.stack(vals))]
     if mesh is not None and (B * C) % mesh.devices.size == 0:
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh = NamedSharding(mesh, P(None, mesh.axis_names[0]))
         grids = [jax.device_put(a, sh) for a in grids]
     run = _matrix_cache(S, V, step_ids, init_state, T, C, B)
-    alive, inexact = run(grids[0], grids[1], uops, grids[2], grids[3])
-    jax.block_until_ready(alive)
-    alive, inexact = np.asarray(alive), np.asarray(inexact)
-    return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
+    return run(grids[0], grids[1], uops, grids[2], grids[3])
 
 
 _MATRIX_CACHE: dict = {}
@@ -645,6 +725,105 @@ def _dense_ok(S: int, num_states: int | None) -> bool:
             and S * (1 << S) * vb <= DENSE_MAX_ELEMS)
 
 
+class _ResumeKernel:
+    """A jitted resume-scan plus its initial-frontier constructor (jit
+    wrappers don't take attributes, so the pair rides a tiny holder)."""
+
+    def __init__(self, fn, init_carry):
+        self.fn = fn
+        self.init_carry = init_carry
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+
+def quiescent_cuts(kind, max_segment: int) -> list[int]:
+    """Cut positions for segmented verification: indices where no op is
+    pending (every invoke has returned), at most ``max_segment`` events
+    apart. Vectorized over the event-kind array; returns cumulative end
+    positions including the final one."""
+    kind = np.asarray(kind)
+    delta = np.where(kind == EV_INVOKE, 1,
+                     np.where(kind == EV_RETURN, -1, 0))
+    pending = np.cumsum(delta)
+    quiet = np.nonzero(pending == 0)[0] + 1  # cut AFTER these events
+    cuts: list[int] = []
+    pos = 0
+    n = len(kind)
+    while pos < n:
+        limit = pos + max_segment
+        if limit >= n:
+            cuts.append(n)
+            break
+        j = np.searchsorted(quiet, limit, side="right") - 1
+        if j >= 0 and quiet[j] > pos:
+            nxt = int(quiet[j])
+        else:
+            # no quiescent point inside the window: a raw cut would DROP
+            # pending-op state and could convict a valid history, so
+            # extend to the next quiescent point (or the end) instead —
+            # soundness beats the segment-size preference
+            k = np.searchsorted(quiet, limit, side="right")
+            nxt = int(quiet[k]) if k < len(quiet) else n
+        cuts.append(nxt)
+        pos = nxt
+    return cuts
+
+
+def segmented_check(stream, max_segment: int = 1 << 21, kernel=None,
+                    capacity: int = 256, num_states: int | None = None):
+    """Checks one long history as a chain of bounded segments, carrying
+    the frontier on device between them — arbitrarily long histories in
+    bounded device memory (and bounded single-dispatch size, which the
+    tunneled backend needs: monolithic multi-million-event scans have
+    crashed its worker).
+
+    The stream is cut ONLY at quiescent points (no pending ops across a
+    cut): the resume carry holds the frontier but not pending-op state,
+    so a mid-operation cut would drop obligations and could convict a
+    valid history. When a window has no quiescent point, the segment
+    extends to the next one (or the end) — soundness beats the
+    segment-size preference. Returns (alive, died_event, overflow, peak).
+    """
+    if kernel is None:
+        kernel = JitLinKernel()
+    if num_states is None and getattr(stream, "intern", None) is not None:
+        num_states = len(stream.intern)
+    S = max(1, stream.n_slots)
+    run = kernel._get(S, capacity, batched=False, num_states=num_states,
+                      resume=True)
+    kind = np.asarray(stream.kind)
+    cuts = quiescent_cuts(kind, max_segment)
+    carry = run.init_carry()
+    alive, died, ovf, peak = True, -1, False, 0
+    base = 0
+    from jepsen_tpu.checker.linear_encode import pad_streams
+    for end in cuts:
+        seg = _slice_stream(stream, base, end)
+        batch = pad_streams([seg], length=_bucket(len(seg)))
+        out = run(batch["kind"][0], batch["slot"][0], batch["f"][0],
+                  batch["a"][0], batch["b"][0], *carry)
+        a, d, o, p = out[0], out[1], out[2], out[3]
+        carry = out[4:]
+        a, d, o, p = (bool(np.asarray(a)), int(np.asarray(d)),
+                      bool(np.asarray(o)), int(np.asarray(p)))
+        ovf |= o
+        peak = max(peak, p)
+        if not a:
+            return False, base + d if d >= 0 else -1, ovf, peak
+        base = end
+    return True, -1, ovf, peak
+
+
+def _slice_stream(stream, lo: int, hi: int):
+    """A view-slice of an EventStream's arrays (shared intern/slots)."""
+    import copy
+    seg = copy.copy(stream)
+    for field in ("kind", "slot", "f", "a", "b"):
+        setattr(seg, field, np.asarray(getattr(stream, field))[lo:hi])
+    return seg
+
+
 class JitLinKernel:
     """Compiled-kernel cache keyed by backend + (S, K|V, batched?)."""
 
@@ -655,24 +834,36 @@ class JitLinKernel:
         self.init_state = init_state
         self._cache: dict = {}
 
-    def _get(self, S: int, K: int, batched: bool, num_states: int | None = None):
+    def _get(self, S: int, K: int, batched: bool, num_states: int | None = None,
+             resume: bool = False):
         """Picks the dense exact kernel when the configuration space is
-        small enough, else the capacity-K sort-based frontier."""
+        small enough, else the capacity-K sort-based frontier. With
+        ``resume`` the returned callable takes and returns the frontier
+        carry (dense: +table; sparse: +mask,state) for segmented
+        verification; it also exposes ``.init_carry()``."""
         import jax
         if _dense_ok(S, num_states):
             vb = _bucket(num_states, floor=16)
-            key = ("dense", S, vb, batched)
+            key = ("dense", S, vb, batched, resume)
             fn = self._cache.get(key)
             if fn is None:
                 run = _build_dense_step(S, vb, self.step_ids, self.init_state)
-                fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
+                if resume:
+                    fn = _ResumeKernel(jax.jit(run.resume),
+                                       lambda: (run.init_table(),))
+                else:
+                    fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
                 self._cache[key] = fn
             return fn
-        key = ("sparse", S, K, batched)
+        key = ("sparse", S, K, batched, resume)
         fn = self._cache.get(key)
         if fn is None:
             run = _build_step(S, K, self.step_ids, self.init_state)
-            fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
+            if resume:
+                fn = _ResumeKernel(jax.jit(run.resume),
+                                   lambda: run.init_frontier())
+            else:
+                fn = jax.jit(jax.vmap(run)) if batched else jax.jit(run)
             self._cache[key] = fn
         return fn
 

@@ -122,15 +122,12 @@ def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
     replicated while edge traffic stays device-local. This is the 50k-txn
     Elle-graph scaling path (BASELINE config 5, SURVEY.md §5.8)."""
     import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if len(src) == 0 or n_nodes == 0:
         return np.zeros(n_nodes, dtype=bool)
 
-    axis = mesh.axis_names[0]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     n_dev = mesh.devices.size
     E = len(src)
     pad = (-E) % n_dev
@@ -139,7 +136,26 @@ def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
     dst_p = np.concatenate([np.asarray(dst, np.int32), np.zeros(pad, np.int32)])
     w_p = np.concatenate([np.ones(E, np.int32), np.zeros(pad, np.int32)])
 
-    esh = NamedSharding(mesh, P(axis))
+    esh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    sj = jax.device_put(src_p, esh)
+    dj = jax.device_put(dst_p, esh)
+    wj = jax.device_put(w_p, esh)
+    return np.asarray(run_sharded_trim(mesh, n_nodes, sj, dj, wj, max_iters))
+
+
+def run_sharded_trim(mesh, n_nodes: int, sj, dj, wj, max_iters: int = 512):
+    """The compute half of the sharded trim, over ALREADY-PLACED edge
+    arrays (sharded on the mesh's first axis with weight 0 padding).
+    Split out so the multi-process (DCN) path can place per-process
+    local shards with make_array_from_process_local_data and run the
+    identical kernel (jepsen_tpu.parallel.distributed)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
 
     def degrees(active, s, d, w):
         @partial(shard_map, mesh=mesh,
@@ -151,10 +167,6 @@ def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
             return lax.psum(jnp.stack([indeg, outdeg]), axis)
 
         return go(active, s, d, w)
-
-    sj = jax.device_put(src_p, esh)
-    dj = jax.device_put(dst_p, esh)
-    wj = jax.device_put(w_p, esh)
 
     @jax.jit
     def run(s, d, w):
@@ -174,7 +186,7 @@ def trim_to_cycles_sharded(n_nodes: int, src: np.ndarray, dst: np.ndarray,
             cond, body, (active0, jnp.bool_(True), jnp.int32(0)))
         return active
 
-    return np.asarray(run(sj, dj, wj))
+    return run(sj, dj, wj)
 
 
 _SCREEN_CACHE: dict = {}

@@ -111,13 +111,34 @@ class CentOS(OS):
 
 
 class SmartOS(OS):
-    """pkgin-based setup (os/smartos.clj)."""
+    """pkgin-based setup (os/smartos.clj, the full surface): loopback
+    hostfile patch, age-gated ``pkgin update`` (judged by
+    /var/db/pkgin/sql.log's mtime like the reference's
+    time-since-last-update), installed-set-aware package install, the
+    ipfilter service enabled via ``svcadm``, and a best-effort net heal.
+    Commands run under su — illumos roles would use pfexec, but the
+    reference drives SmartOS through the same c/su wrapper this mirrors.
+    """
+
+    base_packages = ["wget", "curl", "vim", "unzip", "rsyslog", "logrotate"]
+
+    def __init__(self, extra_packages: list[str] | None = None):
+        self.extra_packages = extra_packages or []
 
     def setup(self, test, node):
         def go():
+            setup_hostfile(test)
+            patch_loopback_hostname()
             with control.su():
-                control.exec_("pkgin", "-y", "update")
-                control.exec_("pkgin", "-y", "install", "curl", "gnu-coreutils")
+                pkgin_maybe_update()
+                pkgin_install(self.base_packages + self.extra_packages)
+                control.exec_("svcadm", "enable", "-r", "ipfilter")
+            net = test.get("net")
+            if net is not None:
+                try:
+                    net.heal(test)  # meh'd like the reference (u/meh)
+                except Exception:  # noqa: BLE001
+                    logger.exception("net heal during OS setup failed")
         control.on(node, test, go)
 
 
@@ -201,6 +222,72 @@ def yum_maybe_update(max_age_s: int = 86400) -> None:
         f"test $(( $(date +%s) - "
         f"$(stat -c %Y /var/log/yum.log 2>/dev/null || echo 0) )) "
         f"-lt {max_age_s} || yum -y update")
+
+
+def pkgin_maybe_update(max_age_s: int = 86400) -> None:
+    """pkgin update unless one ran in the last day, judged by pkgin's
+    sql.log mtime — missing log counts as stale (smartos.clj:27-43
+    time-since-last-update / maybe-update!)."""
+    control.exec_(
+        "sh", "-c",
+        f"test $(( $(date +%s) - "
+        f"$(stat -c %Y /var/db/pkgin/sql.log 2>/dev/null || echo 0) )) "
+        f"-lt {max_age_s} || pkgin update")
+
+
+def _pkgin_list() -> list[tuple[str, str]]:
+    """[(name, version)] from ``pkgin -p list`` lines of the form
+    ``name-version;...`` (smartos.clj:45-57 parse)."""
+    import re
+    r = control.exec_star("pkgin", "-p", "list")
+    out = []
+    for line in (r.out or "").splitlines():
+        head = line.split(";", 1)[0].strip()
+        m = re.match(r"(.+)-([^-]+)$", head)
+        if m:
+            out.append((m.group(1), m.group(2)))
+    return out
+
+
+def pkgin_installed(packages) -> set:
+    """Subset of packages already installed (smartos.clj installed)."""
+    names = {n for n, _ in _pkgin_list()}
+    return {p for p in packages if p in names}
+
+
+def pkgin_installed_version(pkg: str) -> str | None:
+    """Installed version of a pkgin package, or None
+    (smartos.clj:70-81)."""
+    for n, v in _pkgin_list():
+        if n == pkg:
+            return v
+    return None
+
+
+def pkgin_install(pkgs) -> None:
+    """Ensures packages are present: a flat collection installs any
+    missing name, a {pkg: version} map pins versions
+    (smartos.clj:83-103)."""
+    if isinstance(pkgs, dict):
+        listed = dict(_pkgin_list())
+        for pkg, version in pkgs.items():
+            if listed.get(pkg) != version:
+                control.exec_("pkgin", "-y", "install", f"{pkg}-{version}")
+        return
+    present = pkgin_installed(pkgs)
+    missing = [p for p in pkgs if p not in present]
+    if missing:
+        control.exec_("pkgin", "-y", "install", *missing)
+
+
+def pkgin_uninstall(pkgs) -> None:
+    """Removes whichever of the packages are installed
+    (smartos.clj:59-64)."""
+    if isinstance(pkgs, str):
+        pkgs = [pkgs]
+    present = sorted(pkgin_installed(pkgs))
+    if present:
+        control.exec_("pkgin", "-y", "remove", *present)
 
 
 def yum_installed(packages) -> set:

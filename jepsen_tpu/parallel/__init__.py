@@ -13,8 +13,13 @@ graph-parallel over txn partitions, so the sharding story is:
 * SCC label propagation shards edges over devices and psums the label
   updates (see ops/scc.py) — collectives ride ICI on a pod.
 
-Multi-host: the same code runs under ``jax.distributed`` initialization;
-the mesh then spans hosts and XLA routes collectives over ICI/DCN.
+Multi-host: ``parallel.distributed`` initializes ``jax.distributed``,
+builds a process-spanning global mesh, places per-process edge shards
+with make_array_from_process_local_data for the sharded trim (psum
+crossing the process boundary), and splits independent key batches by
+process with a verdict allgather. Exercised for real by
+tests/test_distributed.py: two OS processes × 4 virtual CPU devices
+form one 8-device mesh and run both paths end to end.
 """
 from __future__ import annotations
 
@@ -103,7 +108,7 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     import jax
     from jepsen_tpu.ops.jitlin import (
         EV_RETURN, MATRIX_MAX_ELEMS, MATRIX_MAX_SLOTS, MATRIX_MAX_STATES,
-        MATRIX_MIN_RETURNS, _bucket, matrix_check_batch)
+        MATRIX_MIN_RETURNS, MATRIX_SUB_KEYS, _bucket, matrix_check_batch)
 
     if kernel is None:
         if step_ids is None and init_state == 0:
@@ -121,7 +126,13 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     else:
         n_states = None
 
-    if mesh is None and len(jax.devices()) > 1:
+    # mesh=False forces single-device local execution — the multi-process
+    # path (distributed.batch_check_distributed) splits keys BY PROCESS
+    # and must not let auto-detection grab the process-spanning global
+    # mesh (a process can only address its own devices' shards)
+    if mesh is False:
+        mesh = None
+    elif mesh is None and len(jax.devices()) > 1:
         mesh = get_mesh()
 
     S_all = max(max(1, s.n_slots) for s in streams)
@@ -130,8 +141,12 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
         mv = (1 << S_all) * _bucket(n_states, floor=8)
         total_returns = sum(int((np.asarray(s.kind) == EV_RETURN).sum())
                             for s in streams)
+        # single-device batches split into MATRIX_SUB_KEYS dispatches, so
+        # the element budget binds per sub-batch, not the whole key set
+        sub = (len(streams) if mesh is not None
+               else min(len(streams), MATRIX_SUB_KEYS))
         if total_returns >= MATRIX_MIN_RETURNS \
-                and len(streams) * mv * mv <= MATRIX_MAX_ELEMS:
+                and sub * mv * mv <= MATRIX_MAX_ELEMS:
             results = matrix_check_batch(
                 streams, step_ids=kernel.step_ids,
                 init_state=kernel.init_state, num_states=n_states,

@@ -241,10 +241,11 @@ def workload_registry() -> dict[str, Callable]:
     """name -> workload-constructor map for sweep runners
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
-                                      causal_reverse, counter, dirty_reads,
-                                      long_fork, monotonic, mutex,
+                                      causal_reverse, comments, counter,
+                                      default_value, dirty_reads, long_fork,
+                                      monotonic, multi_key_acid, mutex,
                                       queue_workload, register, sequential,
-                                      set_workload, wr)
+                                      set_workload, single_key_acid, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -261,4 +262,8 @@ def workload_registry() -> dict[str, Callable]:
         "sequential": sequential.workload,
         "mutex": mutex.workload,
         "counter": counter.workload,
+        "single-key-acid": single_key_acid.workload,
+        "multi-key-acid": multi_key_acid.workload,
+        "default-value": default_value.workload,
+        "comments": comments.workload,
     }

@@ -118,7 +118,7 @@ class CockroachDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
 
 
 SUPPORTED_WORKLOADS = ("register", "bank", "set", "append", "monotonic",
-                       "sequential", "adya", "long-fork", "wr")
+                       "sequential", "adya", "long-fork", "wr", "comments")
 
 
 def cockroachdb_test(opts_dict: dict | None = None) -> dict:

@@ -41,7 +41,8 @@ MASTER_COUNT = 3
 
 # reference registry shape (yugabyte/core.clj:74-104)
 YSQL_WORKLOADS = ("append", "set", "bank", "long-fork", "register", "wr",
-                  "counter")
+                  "counter", "single-key-acid", "multi-key-acid",
+                  "default-value")
 YCQL_WORKLOADS = ("counter", "set", "set-index", "bank", "long-fork",
                   "single-key-acid", "multi-key-acid")
 

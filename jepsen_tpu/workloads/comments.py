@@ -1,0 +1,91 @@
+"""Comments workload (reference:
+cockroachdb/src/jepsen/cockroach/comments.clj — the sequential-id /
+visibility probe for strict serializability: if T1 < T2 in realtime but
+T2 is visible without T1, later readers see comment threads with holes).
+
+Concurrent blind writes of increasing ids per independent key, spread
+across shard-split tables on a real cluster; reads return every visible
+id for the key. The checker replays the history tracking, for each
+write's invocation, the set of writes already completed — if a read
+sees write w but misses some write that completed before w was even
+invoked, strict serializability is violated.
+
+Op shapes (independent-lifted [k, v] values):
+- ``{"f": "write", "value": [k, id]}`` — blind insert of ``id``
+- ``{"f": "read",  "value": [k, sorted-ids]}``
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import Checker
+
+
+def generator(n_groups: int = 5, per_key_limit: int = 60):
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    def key_gen(k):
+        lock = threading.Lock()
+        counter = [0]
+
+        def write(test, ctx):
+            with lock:
+                n = counter[0]
+                counter[0] += 1
+            return {"f": "write", "value": n}
+
+        return gen.limit(per_key_limit,
+                         gen.stagger(0.01,
+                                     gen.mix([gen.Fn(read), gen.Fn(write)])))
+
+    return independent.concurrent_generator(n_groups, itertools.count(),
+                                            key_gen)
+
+
+class CommentsChecker(Checker):
+    """First-order write-precedence replay (comments.clj:93-141): a read
+    seeing write w must see every write completed before w's invocation."""
+
+    def check(self, test, history, opts):
+        completed: set = set()
+        expected: dict = {}   # write id -> frozenset completed at invoke
+        for op in history:
+            if op.get("f") != "write":
+                continue
+            v = op.get("value")
+            if op.get("type") == "invoke":
+                expected[v] = frozenset(completed)
+            elif op.get("type") == "ok":
+                completed.add(v)
+        errors = []
+        reads = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            reads += 1
+            seen = set(op.get("value") or ())
+            our_expected: set = set()
+            for w in seen:
+                our_expected |= expected.get(w, frozenset())
+            missing = our_expected - seen
+            if missing:
+                errors.append({"op": {k: v for k, v in op.items()
+                                      if k != "value"},
+                               "missing": sorted(missing),
+                               "expected-count": len(our_expected)})
+        return {"valid?": not errors, "errors": errors[:10],
+                "read-count": reads}
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    test = test or {}
+    n = len(test.get("nodes") or []) or 5
+    return {
+        "comments": True,  # fake-mode client dispatch marker
+        "generator": generator(n_groups=n),
+        "checker": independent.checker(CommentsChecker()),
+    }

@@ -163,3 +163,48 @@ def test_batched_path_sees_through_compose(tmp_path):
         assert os.path.exists(
             tmp_path / "ind-compose" / "t0" / "independent" / k
             / "timeline.html")
+
+
+def test_batched_device_path_actually_engages():
+    """Regression: the batched independent fast path must produce
+    jitlin-tpu verdicts, not silently fall back per-key (a signature
+    drift in the checker once made every batch raise and the broad
+    fallback ate it)."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import CASRegister
+
+    history = []
+    for k in range(3):
+        for i, v in enumerate([1, 2, 3]):
+            history.append({"type": "invoke", "process": k, "f": "write",
+                            "value": [k, v]})
+            history.append({"type": "ok", "process": k, "f": "write",
+                            "value": [k, v]})
+    chk = independent.checker(linearizable(model=CASRegister(),
+                                           accelerator="tpu"))
+    out = chk.check({}, history, {})
+    assert out["valid?"] is True
+    per_key = list(out["results"].values())
+    assert len(per_key) == 3, out
+    assert all(r.get("algorithm", "").startswith("jitlin-tpu")
+               for r in per_key), out
+
+
+def test_batched_device_path_nonzero_init_state():
+    """CASRegister(0) (single-key-acid) must thread its initial value
+    through the batched encoding: a first read of 0 is valid."""
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import CASRegister
+
+    history = []
+    for k in range(2):
+        history.append({"type": "invoke", "process": k, "f": "read",
+                        "value": None})
+        history.append({"type": "ok", "process": k, "f": "read",
+                        "value": [k, 0]})
+    chk = independent.checker(linearizable(model=CASRegister(0),
+                                           accelerator="tpu"))
+    out = chk.check({}, history, {})
+    assert out["valid?"] is True, out

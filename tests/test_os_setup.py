@@ -179,3 +179,52 @@ def test_suite_os_override():
     test = etcd.etcd_test({"os": "centos", "nodes": ["n1"],
                            "faults": set()})
     assert isinstance(test["os"], CentOS)
+
+
+def test_smartos_setup_full_path():
+    """SmartOS setup: loopback hostfile patch, age-gated pkgin update,
+    installed-set-aware install, ipfilter via svcadm (smartos.clj)."""
+    from jepsen_tpu.os_setup import SmartOS
+
+    remote = ScriptedRemote(script={
+        "hostname": (0, "n1"),
+        "cat /etc/hosts": (0, "127.0.0.1\tlocalhost\n10.0.0.2 n2"),
+        # curl + wget installed; vim/unzip/rsyslog/logrotate missing
+        "pkgin -p list": (0, "curl-8.4.0;x;y\nwget-1.21.4;x;y\n"),
+    })
+    SmartOS().setup(_test_with(remote), "n1")
+    cmds = [c for c, _ in remote.log]
+    loop_stdin = [s for c, s in remote.log
+                  if "tee /etc/hosts" in c and s and "127.0.0.1" in s]
+    assert any("n1" in s.splitlines()[0] for s in loop_stdin)
+    # update gated on pkgin's sql.log age
+    assert any("/var/db/pkgin/sql.log" in c and "pkgin update" in c
+               for c in cmds)
+    install = next(c for c in cmds if "pkgin -y install" in c)
+    assert "vim" in install.split() and "rsyslog" in install.split()
+    assert "curl" not in install.split()  # already present per pkgin list
+    assert any("svcadm enable -r ipfilter" in c for c in cmds)
+
+
+def test_pkgin_helpers_parse_versions():
+    from jepsen_tpu.os_setup import (pkgin_install, pkgin_installed,
+                                     pkgin_installed_version,
+                                     pkgin_uninstall)
+
+    remote = ScriptedRemote(script={
+        "pkgin -p list": (0, "gnu-coreutils-9.1;x\ncurl-8.4.0;x\n"),
+    })
+
+    def go():
+        assert pkgin_installed(["curl", "vim"]) == {"curl"}
+        assert pkgin_installed_version("gnu-coreutils") == "9.1"
+        assert pkgin_installed_version("vim") is None
+        # version pin: mismatched version reinstalls, matching doesn't
+        pkgin_install({"curl": "8.5.0", "gnu-coreutils": "9.1"})
+        pkgin_uninstall(["curl", "vim"])
+
+    _run_on(remote, {"ssh": {}}, go)
+    cmds = [c for c, _ in remote.log]
+    assert any("pkgin -y install curl-8.5.0" in c for c in cmds)
+    assert not any("install gnu-coreutils" in c for c in cmds)
+    assert any("pkgin -y remove curl" in c for c in cmds)

@@ -191,3 +191,96 @@ def test_etcd_real_daemon_register(tmp_path, monkeypatch):
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# PostgreSQL: the from-scratch v3 wire client against a real server
+# (VERDICT r2 item 6 — SCRAM auth, simple query, serialization-failure
+# retry, and the bank workload lifecycle)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_postgres_wire_client(tmp_path, monkeypatch):
+    initdb = _find("initdb", "JEPSEN_INITDB_BIN")
+    postgres_bin = _find("postgres", "JEPSEN_POSTGRES_BIN")
+    if not (initdb and postgres_bin):
+        pytest.skip("postgres/initdb not installed")
+
+    from jepsen_tpu.suites import postgres as pg_suite
+    from jepsen_tpu.suites._postgres import (PGConnection, PgError,
+                                             SERIALIZATION_FAILURE)
+
+    port = _free_port()
+    data = tmp_path / "pgdata"
+    pw = tmp_path / "pw"
+    pw.write_text("superpw\n")
+    subprocess.run(
+        [initdb, "-D", str(data), "-U", "super", "--auth-host=scram-sha-256",
+         "--auth-local=trust", f"--pwfile={pw}"],
+        check=True, capture_output=True)
+    proc = subprocess.Popen(
+        [postgres_bin, "-D", str(data), "-p", str(port),
+         "-c", "listen_addresses=127.0.0.1",
+         "-c", f"unix_socket_directories={tmp_path}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc)
+
+        # SCRAM-SHA-256 auth + simple query over our own wire code
+        deadline = time.time() + 20
+        conn = None
+        while conn is None:
+            try:
+                conn = PGConnection("127.0.0.1", port=port, user="super",
+                                    password="superpw", database="postgres")
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+        rows, _ = conn.query("select 1 + 1")
+        assert rows[0][0] in ("2", 2)
+
+        conn.query("create role jepsen with login password 'jepsenpw'")
+        conn.query("create database jepsen owner jepsen")
+
+        # serialization-failure retry: two serializable txns racing on
+        # one row; the loser surfaces SQLSTATE 40001 through PgError and
+        # a fresh attempt succeeds
+        a = PGConnection("127.0.0.1", port=port, user="super",
+                         password="superpw", database="postgres")
+        b = PGConnection("127.0.0.1", port=port, user="super",
+                         password="superpw", database="postgres")
+        conn.query("create table sf (k int primary key, v int)")
+        conn.query("insert into sf values (1, 0)")
+        for c in (a, b):
+            c.query("begin isolation level serializable")
+            c.query("select v from sf where k = 1")
+        a.query("update sf set v = 1 where k = 1")
+        a.query("commit")
+        failed = False
+        try:
+            b.query("update sf set v = 2 where k = 1")
+            b.query("commit")
+        except PgError as e:
+            failed = True
+            assert e.sqlstate == SERIALIZATION_FAILURE, e.sqlstate
+            try:
+                b.query("rollback")
+            except Exception:
+                pass
+        assert failed, "concurrent serializable update must conflict"
+        b.query("begin isolation level serializable")
+        b.query("update sf set v = 2 where k = 1")
+        b.query("commit")
+
+        # bank workload end-to-end through the suite lifecycle: the
+        # dummy remote no-ops node automation while the client speaks
+        # the real wire protocol to the real server
+        monkeypatch.setattr(pg_suite, "PORT", port)
+        monkeypatch.setattr(pg_suite.PostgresClient, "PORT", port)
+        result = _run_suite(pg_suite.postgres_test, tmp_path,
+                            workload="bank", time_limit=5)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
