@@ -146,12 +146,18 @@ def build_suite_test(o: dict | None, *, db_name: str,
 def standard_opt_fn(supported_workloads: tuple,
                     extra: Callable | None = None,
                     nemesis_interval: float = 10.0,
-                    extra_faults: tuple = ()) -> Callable:
+                    extra_faults: tuple = (),
+                    workload_default: str | None = "__first__") -> Callable:
     """The shared CLI option set for suites (plus per-suite extras).
     ``extra_faults`` extends --fault with the suite's DB-specific
-    vocabulary (e.g. cockroach's skew family, yugabyte's kill-master)."""
+    vocabulary (e.g. cockroach's skew family, yugabyte's kill-master).
+    ``workload_default=None`` leaves --workload unset when omitted — for
+    suites whose default depends on another option (yugabyte's --api)."""
+    if workload_default == "__first__":
+        workload_default = supported_workloads[0]
+
     def opt_fn(p):
-        p.add_argument("--workload", default=supported_workloads[0],
+        p.add_argument("--workload", default=workload_default,
                        choices=list(supported_workloads))
         p.add_argument("--fake", action="store_true",
                        help="in-memory client/DB over the dummy remote")

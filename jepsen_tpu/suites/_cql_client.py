@@ -1,0 +1,482 @@
+"""From-scratch CQL native-protocol client for the YCQL suite family
+(reference: yugabyte/src/yugabyte/ycql/client.clj and the per-workload
+clients under ycql/ — they ride the cassaforte JVM driver; this is the
+same capability over a stdlib socket speaking protocol v4).
+
+Surface kept to what the YCQL workloads need:
+
+* STARTUP/READY handshake (plus PLAIN SASL when the server demands
+  AUTHENTICATE)
+* QUERY with QUORUM consistency; RESULT parsing for Void, Rows (typed
+  decode of int/bigint/counter/varchar/ascii/boolean/double, uuid as a
+  hex string), Set_keyspace and Schema_change
+* ERROR frames surfaced as :class:`CqlError` with the server's code —
+  the YCQL error discipline mirrors the SQL family's: definite
+  application failures (LWT not applied, invalid query) fail ops;
+  network errors are indeterminate for writes
+
+YCQL transactions span a single statement string
+(``BEGIN TRANSACTION ... END TRANSACTION;`` — the reference builds the
+same strings, ycql/bank.clj:51-60, ycql/multi_key_acid.clj:49-60),
+so the client needs no prepared-statement or batch machinery.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+
+from jepsen_tpu.suites._wire import close_quietly, recv_exact
+
+# protocol v4 opcodes
+OP_ERROR = 0x00
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_AUTHENTICATE = 0x03
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+OP_AUTH_RESPONSE = 0x0F
+OP_AUTH_SUCCESS = 0x10
+
+CONSISTENCY_QUORUM = 0x0004
+
+RESULT_VOID = 0x0001
+RESULT_ROWS = 0x0002
+RESULT_SET_KEYSPACE = 0x0003
+RESULT_SCHEMA_CHANGE = 0x0005
+
+# type option ids (v4 §6)
+T_BIGINT = 0x0002
+T_BOOLEAN = 0x0004
+T_COUNTER = 0x0005
+T_DOUBLE = 0x0007
+T_FLOAT = 0x0008
+T_INT = 0x0009
+T_TIMESTAMP = 0x000B
+T_VARCHAR = 0x000D
+T_ASCII = 0x0001
+T_UUID = 0x000C
+T_TIMEUUID = 0x000F
+T_SMALLINT = 0x0013
+T_TINYINT = 0x0014
+
+# response frame flags (v4 §2.2)
+FLAG_COMPRESSED = 0x01
+FLAG_TRACING = 0x02
+FLAG_CUSTOM_PAYLOAD = 0x04
+FLAG_WARNING = 0x08
+
+
+class CqlError(Exception):
+    """Server ERROR frame: ``code`` is the CQL error code int."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code:#06x}] {message}")
+        self.code = code
+        self.message = message
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!H", len(b)) + b
+
+
+def _long_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!I", len(b)) + b
+
+
+def _string_map(m: dict) -> bytes:
+    out = struct.pack("!H", len(m))
+    for k, v in m.items():
+        out += _string(k) + _string(v)
+    return out
+
+
+def _decode_value(type_id: int, raw: bytes):
+    if raw is None:
+        return None
+    if type_id in (T_INT,):
+        return struct.unpack("!i", raw)[0]
+    if type_id in (T_BIGINT, T_COUNTER, T_TIMESTAMP):
+        return struct.unpack("!q", raw)[0]
+    if type_id == T_SMALLINT:
+        return struct.unpack("!h", raw)[0]
+    if type_id == T_TINYINT:
+        return struct.unpack("!b", raw)[0]
+    if type_id == T_BOOLEAN:
+        return raw != b"\x00"
+    if type_id == T_DOUBLE:
+        return struct.unpack("!d", raw)[0]
+    if type_id == T_FLOAT:
+        return struct.unpack("!f", raw)[0]
+    if type_id in (T_VARCHAR, T_ASCII):
+        return raw.decode()
+    if type_id in (T_UUID, T_TIMEUUID):
+        return raw.hex()
+    return raw  # unknown types surface as bytes
+
+
+class CQLConnection:
+    """One authenticated CQL connection; ``query`` returns a list of
+    row dicts (column name → decoded value), or [] for non-Rows."""
+
+    def __init__(self, host: str, port: int = 9042, user: str = "",
+                 password: str = "", timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._stream = 0
+        try:
+            self._startup(user, password)
+        except BaseException:
+            close_quietly(self.sock)
+            raise
+
+    # -- framing ----------------------------------------------------------
+
+    def _send_frame(self, opcode: int, body: bytes) -> None:
+        header = struct.pack("!BBhBI", 0x04, 0x00, self._stream, opcode,
+                             len(body))
+        self.sock.sendall(header + body)
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        header = recv_exact(self.sock, 9)
+        _ver, flags, _stream, opcode, length = struct.unpack("!BBhBI",
+                                                             header)
+        body = recv_exact(self.sock, length) if length else b""
+        if flags & FLAG_COMPRESSED:
+            # never negotiated in STARTUP; a server that compresses
+            # anyway has desynced the connection
+            raise CqlError(0x000A, "unexpected compressed frame")
+        if flags & FLAG_TRACING:
+            body = body[16:]  # tracing session uuid
+        if flags & FLAG_WARNING:
+            # [string list] of warnings prefixes the body (v4 §2.2)
+            n = struct.unpack("!H", body[:2])[0]
+            off = 2
+            for _ in range(n):
+                slen = struct.unpack("!H", body[off:off + 2])[0]
+                off += 2 + slen
+            body = body[off:]
+        if flags & FLAG_CUSTOM_PAYLOAD:
+            # [bytes map] prefixes the body
+            n = struct.unpack("!H", body[:2])[0]
+            off = 2
+            for _ in range(n):
+                klen = struct.unpack("!H", body[off:off + 2])[0]
+                off += 2 + klen
+                vlen = struct.unpack("!i", body[off:off + 4])[0]
+                off += 4 + max(vlen, 0)
+            body = body[off:]
+        if opcode == OP_ERROR:
+            code = struct.unpack("!I", body[:4])[0]
+            mlen = struct.unpack("!H", body[4:6])[0]
+            raise CqlError(code, body[6:6 + mlen].decode())
+        return opcode, body
+
+    # -- handshake --------------------------------------------------------
+
+    def _startup(self, user: str, password: str) -> None:
+        self._send_frame(OP_STARTUP, _string_map({"CQL_VERSION": "3.0.0"}))
+        opcode, body = self._read_frame()
+        if opcode == OP_AUTHENTICATE:
+            # PLAIN SASL: \0user\0password (the only scheme yugabyte's
+            # password authenticator speaks)
+            token = b"\x00" + user.encode() + b"\x00" + password.encode()
+            self._send_frame(OP_AUTH_RESPONSE,
+                             struct.pack("!I", len(token)) + token)
+            opcode, body = self._read_frame()
+            if opcode != OP_AUTH_SUCCESS:
+                raise CqlError(0x0100, f"auth failed (opcode {opcode})")
+        elif opcode != OP_READY:
+            raise CqlError(0x000A, f"unexpected startup opcode {opcode}")
+
+    # -- queries ----------------------------------------------------------
+
+    def query(self, cql: str) -> list[dict]:
+        body = _long_string(cql) + struct.pack("!HB", CONSISTENCY_QUORUM, 0)
+        self._send_frame(OP_QUERY, body)
+        opcode, payload = self._read_frame()
+        if opcode != OP_RESULT:
+            raise CqlError(0x000A, f"unexpected result opcode {opcode}")
+        kind = struct.unpack("!I", payload[:4])[0]
+        if kind != RESULT_ROWS:
+            return []
+        return self._parse_rows(payload[4:])
+
+    def _parse_rows(self, b: bytes) -> list[dict]:
+        off = 0
+        flags, col_count = struct.unpack("!II", b[off:off + 8])
+        off += 8
+        if flags & 0x0002:  # has_more_pages: paging state blob
+            plen = struct.unpack("!i", b[off:off + 4])[0]
+            off += 4 + max(plen, 0)
+        global_spec = bool(flags & 0x0001)
+        if global_spec:
+            for _ in range(2):  # keyspace + table
+                slen = struct.unpack("!H", b[off:off + 2])[0]
+                off += 2 + slen
+        cols = []
+        for _ in range(col_count):
+            if not global_spec:
+                for _ in range(2):
+                    slen = struct.unpack("!H", b[off:off + 2])[0]
+                    off += 2 + slen
+            nlen = struct.unpack("!H", b[off:off + 2])[0]
+            name = b[off + 2:off + 2 + nlen].decode()
+            off += 2 + nlen
+            type_id = struct.unpack("!H", b[off:off + 2])[0]
+            off += 2
+            # custom/parameterized types carry extra payload; only the
+            # scalar ids above appear in the YCQL workload tables
+            if type_id == 0x0020 or type_id == 0x0022:  # list/set<t>
+                off += 2
+            elif type_id == 0x0021:  # map<k,v>
+                off += 4
+            cols.append((name, type_id))
+        row_count = struct.unpack("!I", b[off:off + 4])[0]
+        off += 4
+        rows = []
+        for _ in range(row_count):
+            row = {}
+            for name, type_id in cols:
+                vlen = struct.unpack("!i", b[off:off + 4])[0]
+                off += 4
+                if vlen < 0:
+                    row[name] = None
+                else:
+                    row[name] = _decode_value(type_id, b[off:off + vlen])
+                    off += vlen
+            rows.append(row)
+        return rows
+
+    def close(self) -> None:
+        close_quietly(self.sock)
+        self.sock = None
+
+
+# ---------------------------------------------------------------------------
+# workload client over one CQLConnection
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.client import Client  # noqa: E402
+
+KEYSPACE = "jepsen"
+SET_GROUPS = 8  # ycql/set.clj group-count for the indexed variant
+
+
+class YCQLSuiteClient(Client):
+    """The YCQL half of yugabyte's api split (yugabyte/core.clj:74-85):
+    one client speaking every YCQL workload over the from-scratch CQL
+    wire protocol — counter/set updates, LWT cas (UPDATE ... IF), and
+    single-statement ``BEGIN TRANSACTION ... END TRANSACTION`` batches
+    for the transactional workloads (ycql/bank.clj:51-60,
+    ycql/multi_key_acid.clj:49-60).
+
+    Error discipline mirrors the SQL family: CqlError on a read fails
+    the op; CqlError or a network error on a write is indeterminate
+    (info) and the connection is rebuilt before its next use."""
+
+    def __init__(self, port: int = 9042, user: str = "", password: str = "",
+                 timeout_s: float = 10.0, node: str | None = None):
+        self.port = port
+        self.user = user
+        self.password = password
+        self.timeout_s = timeout_s
+        self.node = node
+        self.conn: CQLConnection | None = None
+        self._broken = False
+
+    def _connect(self, test):
+        host = self.node or (test.get("nodes") or ["localhost"])[0]
+        self.conn = CQLConnection(host, port=self.port, user=self.user,
+                                  password=self.password,
+                                  timeout_s=self.timeout_s)
+
+    def open(self, test, node):
+        c = type(self)(port=self.port, user=self.user,
+                       password=self.password, timeout_s=self.timeout_s,
+                       node=node)
+        c._connect(test)
+        return c
+
+    def setup(self, test):
+        q = self.conn.query
+        q(f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}")
+        txn_props = " WITH transactions = {'enabled': true}"
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.counters "
+          f"(id INT PRIMARY KEY, v COUNTER)")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.elements "
+          f"(val INT PRIMARY KEY, count COUNTER)")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.elements_idx "
+          f"(key INT PRIMARY KEY, val INT, grp INT){txn_props}")
+        q(f"CREATE INDEX IF NOT EXISTS elements_by_group "
+          f"ON {KEYSPACE}.elements_idx (grp)")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.bank "
+          f"(id INT PRIMARY KEY, balance BIGINT){txn_props}")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.long_fork "
+          f"(key INT PRIMARY KEY, val INT){txn_props}")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.single_key_acid "
+          f"(id INT PRIMARY KEY, val INT)")
+        q(f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.multi_key_acid "
+          f"(id INT, ik INT, val INT, PRIMARY KEY (id, ik)){txn_props}")
+        for a in test.get("accounts", []):
+            q(f"INSERT INTO {KEYSPACE}.bank (id, balance) "
+              f"VALUES ({int(a)}, 10) IF NOT EXISTS")
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.conn = None
+
+    def teardown(self, test):
+        pass
+
+    # -- op dispatch ------------------------------------------------------
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if self._broken:
+            self.close(test)
+            self._connect(test)
+            self._broken = False
+        try:
+            if test.get("counter") and f == "add":
+                self.conn.query(
+                    f"UPDATE {KEYSPACE}.counters SET v = v + {int(v)} "
+                    f"WHERE id = 0")
+                return {**op, "type": "ok"}
+            if test.get("counter") and f == "read" and v is None:
+                rows = self.conn.query(
+                    f"SELECT v FROM {KEYSPACE}.counters WHERE id = 0")
+                val = rows[0]["v"] if rows else 0
+                return {**op, "type": "ok", "value": int(val or 0)}
+            if f == "add" and test.get("set-index"):
+                g = int(v) % SET_GROUPS
+                self.conn.query(
+                    f"INSERT INTO {KEYSPACE}.elements_idx (key, val, grp) "
+                    f"VALUES ({int(v)}, {int(v)}, {g})")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None and test.get("set-index"):
+                out = []
+                for g in range(SET_GROUPS):  # per-group reads ride the index
+                    rows = self.conn.query(
+                        f"SELECT val FROM {KEYSPACE}.elements_idx "
+                        f"WHERE grp = {g}")
+                    out += [r["val"] for r in rows]
+                return {**op, "type": "ok", "value": sorted(out)}
+            if f == "add":
+                self.conn.query(
+                    f"UPDATE {KEYSPACE}.elements SET count = count + 1 "
+                    f"WHERE val = {int(v)}")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None and test.get("accounts"):
+                return self._read_bank(op, test)
+            if f == "read" and v is None:
+                rows = self.conn.query(
+                    f"SELECT val, count FROM {KEYSPACE}.elements")
+                out = []
+                for r in rows:  # ycql/set.clj expands count-weighted rows
+                    out += [r["val"]] * int(r.get("count") or 0)
+                return {**op, "type": "ok", "value": sorted(out)}
+            if f == "transfer":
+                return self._transfer(op)
+            if f == "read" and isinstance(v, (list, tuple)):
+                k, _ = v
+                rows = self.conn.query(
+                    f"SELECT val FROM {KEYSPACE}.single_key_acid "
+                    f"WHERE id = {int(k)}")
+                val = rows[0]["val"] if rows else None
+                return {**op, "type": "ok", "value": [k, val]}
+            if f == "write":
+                k, val = v
+                self.conn.query(
+                    f"INSERT INTO {KEYSPACE}.single_key_acid (id, val) "
+                    f"VALUES ({int(k)}, {int(val)})")
+                return {**op, "type": "ok"}
+            if f == "cas":
+                k, (old, new) = v
+                rows = self.conn.query(
+                    f"UPDATE {KEYSPACE}.single_key_acid SET val = {int(new)} "
+                    f"WHERE id = {int(k)} IF val = {int(old)}")
+                applied = bool(rows and rows[0].get("[applied]"))
+                return {**op, "type": "ok" if applied else "fail"}
+            if f == "txn" and test.get("txn-mode") == "multi":
+                return self._multi_txn(op)
+            if f == "txn":
+                return self._long_fork_txn(op)
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except CqlError as e:
+            self._broken = True
+            typ = "fail" if f == "read" else "info"
+            return {**op, "type": typ, "error": ["cql", e.code, e.message]}
+        except (TimeoutError, ConnectionError, OSError) as e:
+            self._broken = True
+            typ = "fail" if f == "read" else "info"
+            return {**op, "type": typ, "error": [type(e).__name__, str(e)]}
+
+    def _transfer(self, op):
+        """Balance-guarded two-row transfer in one YCQL transaction
+        (ycql/bank.clj:40-60: read the source balance, refuse overdraft,
+        then a BEGIN TRANSACTION of two updates)."""
+        t = op.get("value") or {}
+        frm, to, amount = int(t["from"]), int(t["to"]), int(t["amount"])
+        rows = self.conn.query(
+            f"SELECT balance FROM {KEYSPACE}.bank WHERE id = {frm}")
+        bal = rows[0]["balance"] if rows else None
+        if bal is None or bal < amount:
+            return {**op, "type": "fail", "error": ["insufficient-funds"]}
+        self.conn.query(
+            f"BEGIN TRANSACTION "
+            f"UPDATE {KEYSPACE}.bank SET balance = balance - {amount} "
+            f"WHERE id = {frm}; "
+            f"UPDATE {KEYSPACE}.bank SET balance = balance + {amount} "
+            f"WHERE id = {to}; "
+            f"END TRANSACTION;")
+        return {**op, "type": "ok"}
+
+    def _read_bank(self, op, test):
+        rows = self.conn.query(
+            f"SELECT id, balance FROM {KEYSPACE}.bank")
+        return {**op, "type": "ok",
+                "value": {r["id"]: r["balance"] for r in rows}}
+
+    def _multi_txn(self, op):
+        """Multi-key-acid txn (ycql/multi_key_acid.clj:43-60): writes
+        batch into one BEGIN TRANSACTION; reads select the group's rows."""
+        k, mops = op.get("value")
+        writes = [m for m in mops if m[0] == "w"]
+        if writes:
+            stmts = "".join(
+                f"INSERT INTO {KEYSPACE}.multi_key_acid (id, ik, val) "
+                f"VALUES ({int(k)}, {int(ik)}, {int(val)}); "
+                for _, ik, val in writes)
+            self.conn.query(
+                f"BEGIN TRANSACTION {stmts}END TRANSACTION;")
+            return {**op, "type": "ok", "value": [k, mops]}
+        rows = self.conn.query(
+            f"SELECT ik, val FROM {KEYSPACE}.multi_key_acid "
+            f"WHERE id = {int(k)}")
+        by_ik = {r["ik"]: r["val"] for r in rows}
+        filled = [[f2, ik, by_ik.get(ik)] for f2, ik, _ in mops]
+        return {**op, "type": "ok", "value": [k, filled]}
+
+    def _long_fork_txn(self, op):
+        """Long-fork txns: single-write inserts, whole-group reads
+        (ycql/long_fork.clj shape)."""
+        mops = op.get("value") or []
+        if any(m[0] == "w" for m in mops):
+            stmts = "".join(
+                f"INSERT INTO {KEYSPACE}.long_fork (key, val) "
+                f"VALUES ({int(k)}, {int(val)}); "
+                for f2, k, val in mops if f2 == "w")
+            self.conn.query(f"BEGIN TRANSACTION {stmts}END TRANSACTION;")
+            return {**op, "type": "ok"}
+        keys = [int(k) for f2, k, _ in mops if f2 == "r"]
+        rows = self.conn.query(
+            f"SELECT key, val FROM {KEYSPACE}.long_fork "
+            f"WHERE key IN ({', '.join(map(str, keys))})")
+        by_key = {r["key"]: r["val"] for r in rows}
+        filled = [[f2, k, by_key.get(int(k))] for f2, k, _ in mops]
+        return {**op, "type": "ok", "value": filled}

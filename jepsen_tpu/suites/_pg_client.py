@@ -32,6 +32,7 @@ from jepsen_tpu.suites._postgres import (DEADLOCK_DETECTED, PGConnection,
                                          parse_int_array)
 
 SEQ_TABLE_COUNT = 5
+COMMENT_TABLE_COUNT = 10  # cockroach/comments.clj:30 table-count
 # postgres wall-clock default; cockroach overrides with its HLC
 DEFAULT_TS_EXPR = "extract(epoch from clock_timestamp())"
 
@@ -119,6 +120,11 @@ class PGSuiteClient(Client):
         ]
         ddl += [f"CREATE TABLE IF NOT EXISTS seq_{i} "
                 f"(k TEXT PRIMARY KEY)" for i in range(SEQ_TABLE_COUNT)]
+        # comments workload: blind inserts split across tables so ids
+        # land in different shard ranges (cockroach/comments.clj:30-40)
+        ddl += [f"CREATE TABLE IF NOT EXISTS comment_{i} "
+                f"(id INT PRIMARY KEY, key INT)"
+                for i in range(COMMENT_TABLE_COUNT)]
         for stmt in ddl:
             self.conn.query(stmt)
         for a in test.get("accounts", []):
@@ -185,6 +191,30 @@ class PGSuiteClient(Client):
             if test.get("counter") and f == "read" and v is None:
                 val = self._select_int("SELECT v FROM counters WHERE id = 0")
                 return {**op, "type": "ok", "value": int(val or 0)}
+            if test.get("comments") and f == "write":
+                k, i = v
+                t = int(i) % COMMENT_TABLE_COUNT
+                self.conn.query(
+                    f"INSERT INTO comment_{t} (id, key) "
+                    f"VALUES ({int(i)}, {int(k)})")
+                return {**op, "type": "ok"}
+            if test.get("comments") and f == "read":
+                k, _ = v
+                # one txn over all tables (comments.clj:74-84 reads both
+                # tables in a transaction so visibility is a snapshot)
+                self._begin()
+                try:
+                    ids: list = []
+                    for t in range(COMMENT_TABLE_COUNT):
+                        rows, _tag = self.conn.query(
+                            f"SELECT id FROM comment_{t} "
+                            f"WHERE key = {int(k)}")
+                        ids += [int(r[0]) for r in rows]
+                    self.conn.query("COMMIT")
+                except PgError as e:
+                    self._rollback()
+                    return self._sql_error(op, e)
+                return {**op, "type": "ok", "value": [k, sorted(ids)]}
             if f == "txn":
                 return self._txn(op)
             if f == "add":

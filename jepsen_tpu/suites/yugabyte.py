@@ -5,12 +5,13 @@ test-all, yugabyte/core.clj:74-123).
 
 Here the YSQL side rides the shared Postgres-wire client on port 5433
 (YSQL speaks the postgres protocol): set, bank (negative balances
-allowed, matching ``workload-allow-neg``), long-fork, append, register
-(the single-key-acid shape), and wr. The YCQL side requires a CQL wire
-client, which this framework does not bundle — YCQL workload names are
-listed in ``YCQL_WORKLOADS`` for parity but constructing one raises
-with a pointer here, exactly like the reference gates unsupported
-combinations out of ``workload-options-expected-to-pass``.
+allowed, matching ``workload-allow-neg``), long-fork, append, register,
+wr, counter, single/multi-key-acid and default-value. The YCQL side
+(``--api ycql``) rides the from-scratch CQL native-protocol client
+(suites/_cql_client.py) on port 9042: counter, set, set-index, bank,
+long-fork, single-key-acid and multi-key-acid, with transactional
+workloads issued as single-statement ``BEGIN TRANSACTION`` batches the
+way the reference's ycql clients build them.
 
 DB automation per yugabyte/auto.clj: a release tarball, yb-master on
 the first (up to) three nodes with the full master address list,
@@ -34,6 +35,7 @@ DIR = "/opt/yugabyte"
 MASTER_RPC_PORT = 7100
 TSERVER_RPC_PORT = 9100
 YSQL_PORT = 5433
+YCQL_PORT = 9042  # yb-tserver's CQL proxy (on by default)
 DB_NAME = "jepsen"
 DB_USER = "yugabyte"
 DB_PASS = "yugabyte"
@@ -69,12 +71,21 @@ def workloads_expected_to_pass() -> dict:
     return {name: reg[name] for name in YSQL_WORKLOADS}
 
 
-def ycql_workload(name: str):
-    """YCQL parity stub: the reference's YCQL clients need a CQL wire
-    protocol this framework does not bundle (yugabyte/core.clj:74-85)."""
-    raise NotImplementedError(
-        f"YCQL workload {name!r} needs a CQL wire client; use the ysql "
-        f"variant (suites/yugabyte.py YSQL_WORKLOADS) instead")
+def ycql_workload(name: str, base: dict, accelerator: str = "auto") -> dict:
+    """YCQL workload kit (yugabyte/core.clj:74-85): the shared kits plus
+    the set-index variant (ycql/set.clj CQLSetIndexClient — adds are
+    transactional rows with a group column, reads go through the
+    secondary index per group; the kit is the set kit with a test-map
+    marker the YCQL client dispatches on)."""
+    from jepsen_tpu.suites import workload_registry
+
+    reg = workload_registry()
+    if name == "set-index":
+        w = reg["set"](base, accelerator=accelerator)
+        return {**w, "set-index": True}
+    if name not in YCQL_WORKLOADS:
+        raise ValueError(f"not a YCQL workload: {name!r}")
+    return reg[name](base, accelerator=accelerator)
 
 
 class YugabyteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.Primary,
@@ -242,22 +253,39 @@ class FakeYugabyte(fakes.KVStore):
 
 
 def yugabyte_test(opts_dict: dict | None = None) -> dict:
+    """--api picks the reference's workload/client split
+    (yugabyte/core.clj:74-118): ysql rides the shared Postgres-wire
+    client on 5433, ycql the CQL-wire client on 9042."""
     from jepsen_tpu.nemesis.db_specific import yugabyte_fault_packages
     o = dict(opts_dict or {})
+    api = o.get("api", "ysql")
     workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
-    return build_suite_test(
-        o, db_name="yugabyte", supported_workloads=SUPPORTED_WORKLOADS,
-        fault_packages=yugabyte_fault_packages(),
-        fake_db=FakeYugabyte,
-        make_real=lambda o: {
-            "db": YugabyteDB(o.get("version", DEFAULT_VERSION)),
-            "client": PGSuiteClient(
+
+    def make_real(o):
+        db = YugabyteDB(o.get("version", DEFAULT_VERSION))
+        if api == "ycql":
+            from jepsen_tpu.suites._cql_client import YCQLSuiteClient
+            client = YCQLSuiteClient(port=YCQL_PORT)
+        else:
+            client = PGSuiteClient(
                 port=YSQL_PORT, database=DB_NAME, user=DB_USER,
                 password=DB_PASS,
                 isolation=o.get("isolation", "serializable"),
                 txn_style="wr" if workload in ("wr", "long-fork")
-                else "append"),
-            "os": Debian()})
+                else "append")
+        return {"db": db, "client": client, "os": Debian()}
+
+    kw = {}
+    if api == "ycql":
+        kw["make_workload"] = lambda name, base: ycql_workload(
+            name, base, accelerator=base["accelerator"])
+    return build_suite_test(
+        o, db_name="yugabyte",
+        supported_workloads=(YCQL_WORKLOADS if api == "ycql"
+                             else SUPPORTED_WORKLOADS),
+        fault_packages=yugabyte_fault_packages(),
+        fake_db=FakeYugabyte,
+        make_real=make_real, **kw)
 
 
 def all_tests(opts) -> list:
@@ -276,9 +304,14 @@ def all_tests(opts) -> list:
 main_all = cli.test_all_cmd(all_tests, name="jepsen-yugabyte")
 
 main = cli.single_test_cmd(
-    standard_test_fn(yugabyte_test, extra_keys=("isolation", "version")),
-    standard_opt_fn(SUPPORTED_WORKLOADS,
+    standard_test_fn(yugabyte_test, extra_keys=("isolation", "version",
+                                                "api")),
+    standard_opt_fn(tuple(dict.fromkeys(SUPPORTED_WORKLOADS
+                                        + YCQL_WORKLOADS)),
+                    workload_default=None,  # per-api default (see test fn)
                     extra=lambda p: (
+                        p.add_argument("--api", default="ysql",
+                                       choices=["ysql", "ycql"]),
                         p.add_argument("--isolation", default="serializable",
                                        choices=["read-committed",
                                                 "repeatable-read",

@@ -1,0 +1,223 @@
+"""The stdlib CQL wire client against a scripted in-process server.
+
+Covers the protocol surface the YCQL suite depends on (STARTUP/READY,
+PLAIN SASL auth, QUERY → Rows decode with typed columns and the LWT
+``[applied]`` column, ERROR frames) the way test_postgres_wire.py covers
+the Postgres family."""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites._cql_client import (CQLConnection, CqlError,
+                                           T_BOOLEAN, T_COUNTER, T_INT,
+                                           T_VARCHAR, YCQLSuiteClient)
+
+
+def _frame(opcode: int, body: bytes, stream: int = 0) -> bytes:
+    return struct.pack("!BBhBI", 0x84, 0, stream, opcode, len(body)) + body
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!H", len(b)) + b
+
+
+def _rows(cols, rows) -> bytes:
+    """RESULT/Rows with a global table spec; cols = [(name, type_id)]."""
+    body = struct.pack("!I", 0x0002)           # kind = Rows
+    body += struct.pack("!II", 0x0001, len(cols))  # global spec flag
+    body += _string("ks") + _string("tbl")
+    for name, tid in cols:
+        body += _string(name) + struct.pack("!H", tid)
+    body += struct.pack("!I", len(rows))
+    for row in rows:
+        for cell in row:
+            if cell is None:
+                body += struct.pack("!i", -1)
+            else:
+                body += struct.pack("!i", len(cell)) + cell
+    return _frame(0x08, body)
+
+
+def _void() -> bytes:
+    return _frame(0x08, struct.pack("!I", 0x0001))
+
+
+def _error(code: int, msg: str) -> bytes:
+    return _frame(0x00, struct.pack("!I", code) + _string(msg))
+
+
+class MockCQLServer:
+    """One-connection scripted server: responds READY to STARTUP (or the
+    AUTHENTICATE dance when ``auth``), then pops canned responses per
+    QUERY; records the query strings."""
+
+    def __init__(self, responses, auth: bool = False):
+        self.responses = list(responses)
+        self.auth = auth
+        self.queries: list[str] = []
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _recv_frame(self, conn):
+        header = b""
+        while len(header) < 9:
+            chunk = conn.recv(9 - len(header))
+            if not chunk:
+                return None, None
+            header += chunk
+        _v, _f, _s, opcode, length = struct.unpack("!BBhBI", header)
+        body = b""
+        while len(body) < length:
+            body += conn.recv(length - len(body))
+        return opcode, body
+
+    def _serve(self):
+        conn, _ = self.sock.accept()
+        with conn:
+            opcode, _body = self._recv_frame(conn)
+            assert opcode == 0x01  # STARTUP
+            if self.auth:
+                conn.sendall(_frame(0x03, _string("PasswordAuthenticator")))
+                opcode, body = self._recv_frame(conn)
+                assert opcode == 0x0F  # AUTH_RESPONSE
+                tlen = struct.unpack("!I", body[:4])[0]
+                self.token = body[4:4 + tlen]
+                conn.sendall(_frame(0x10, struct.pack("!i", -1)))
+            else:
+                conn.sendall(_frame(0x02, b""))
+            while self.responses:
+                opcode, body = self._recv_frame(conn)
+                if opcode is None:
+                    return
+                assert opcode == 0x07  # QUERY
+                qlen = struct.unpack("!I", body[:4])[0]
+                self.queries.append(body[4:4 + qlen].decode())
+                conn.sendall(self.responses.pop(0))
+
+
+def test_startup_query_and_typed_rows():
+    srv = MockCQLServer([
+        _rows([("val", T_INT), ("count", T_COUNTER), ("name", T_VARCHAR)],
+              [[struct.pack("!i", 7), struct.pack("!q", 3), b"x"],
+               [struct.pack("!i", 9), None, b"y"]]),
+        _void(),
+    ])
+    c = CQLConnection("127.0.0.1", port=srv.port)
+    rows = c.query("SELECT val, count, name FROM t")
+    assert rows == [{"val": 7, "count": 3, "name": "x"},
+                    {"val": 9, "count": None, "name": "y"}]
+    assert c.query("CREATE TABLE t (x INT PRIMARY KEY)") == []
+    assert srv.queries[0].startswith("SELECT")
+    c.close()
+
+
+def test_plain_sasl_auth():
+    srv = MockCQLServer([_void()], auth=True)
+    c = CQLConnection("127.0.0.1", port=srv.port, user="cassandra",
+                      password="pw")
+    c.query("SELECT 1")
+    assert srv.token == b"\x00cassandra\x00pw"
+    c.close()
+
+
+def test_error_frame_raises_cql_error():
+    srv = MockCQLServer([_error(0x2200, "Invalid query")])
+    c = CQLConnection("127.0.0.1", port=srv.port)
+    with pytest.raises(CqlError) as ei:
+        c.query("SELECT nonsense")
+    assert ei.value.code == 0x2200
+    assert "Invalid query" in ei.value.message
+    c.close()
+
+
+def _client_with(srv) -> YCQLSuiteClient:
+    cl = YCQLSuiteClient(port=srv.port, node="127.0.0.1")
+    cl._connect({"nodes": ["127.0.0.1"]})
+    return cl
+
+
+def test_ycql_client_cas_applied_column():
+    """LWT cas maps the [applied] column to ok/fail
+    (ycql/single_key_acid.clj:33-39)."""
+    srv = MockCQLServer([
+        _rows([("[applied]", T_BOOLEAN)], [[b"\x01"]]),
+        _rows([("[applied]", T_BOOLEAN)], [[b"\x00"]]),
+    ])
+    cl = _client_with(srv)
+    ok = cl.invoke({}, {"f": "cas", "value": [3, [1, 2]]})
+    assert ok["type"] == "ok"
+    fail = cl.invoke({}, {"f": "cas", "value": [3, [4, 2]]})
+    assert fail["type"] == "fail"
+    assert "IF val = 1" in srv.queries[0]
+    cl.close({})
+
+
+def test_ycql_client_multi_key_txn_string():
+    """Write txns compose one BEGIN/END TRANSACTION statement
+    (ycql/multi_key_acid.clj:49-60); reads fill mops from the group's
+    rows."""
+    srv = MockCQLServer([
+        _void(),
+        _rows([("ik", T_INT), ("val", T_INT)],
+              [[struct.pack("!i", 0), struct.pack("!i", 4)]]),
+    ])
+    cl = _client_with(srv)
+    w = cl.invoke({"txn-mode": "multi"},
+                  {"f": "txn", "value": [7, [["w", 0, 4], ["w", 2, 1]]]})
+    assert w["type"] == "ok"
+    q = srv.queries[0]
+    assert q.startswith("BEGIN TRANSACTION") and q.rstrip().endswith(
+        "END TRANSACTION;")
+    assert q.count("INSERT INTO") == 2
+    r = cl.invoke({"txn-mode": "multi"},
+                  {"f": "txn", "value": [7, [["r", 0, None], ["r", 2, None]]]})
+    assert r["type"] == "ok"
+    assert r["value"] == [7, [["r", 0, 4], ["r", 2, None]]]
+    cl.close({})
+
+
+def test_ycql_client_bank_transfer_guard():
+    """Transfers read the source balance first and refuse overdrafts
+    without issuing the transaction (ycql/bank.clj:40-60)."""
+    srv = MockCQLServer([
+        _rows([("balance", T_COUNTER)], [[struct.pack("!q", 3)]]),
+    ])
+    cl = _client_with(srv)
+    out = cl.invoke({}, {"f": "transfer",
+                         "value": {"from": 0, "to": 1, "amount": 5}})
+    assert out["type"] == "fail"
+    assert len(srv.queries) == 1  # no txn was sent
+    cl.close({})
+
+
+def test_ycql_client_error_discipline():
+    """CqlError: reads fail, writes go indeterminate, and the connection
+    is rebuilt before the next op."""
+    srv = MockCQLServer([_error(0x1000, "unavailable")])
+    cl = _client_with(srv)
+    out = cl.invoke({}, {"f": "write", "value": [1, 2]})
+    assert out["type"] == "info"
+    assert cl._broken
+    cl.close({})
+
+
+def test_yugabyte_ycql_fake_mode_lifecycle():
+    """--api ycql composes the YCQL workload list end to end in fake
+    mode (yugabyte/core.clj:74-85)."""
+    from conftest import run_fake
+    from jepsen_tpu.suites.yugabyte import yugabyte_test
+
+    for wl in ("set-index", "multi-key-acid"):
+        t = run_fake(yugabyte_test, api="ycql", workload=wl,
+                     time_limit=0.5)
+        assert t["results"]["valid?"] in (True, "unknown"), (
+            wl, t["results"])

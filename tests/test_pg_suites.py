@@ -68,10 +68,17 @@ def test_yugabyte_masters():
         set(yugabyte.YSQL_WORKLOADS)
 
 
-def test_yugabyte_ycql_gated():
+def test_yugabyte_ycql_workloads_resolve():
+    """The YCQL api split resolves every YCQL workload to a kit
+    (yugabyte/core.clj:74-85); unknown names are rejected."""
     import pytest
-    with pytest.raises(NotImplementedError):
-        yugabyte.ycql_workload("counter")
+    base = {"nodes": ["n1", "n2", "n3"], "concurrency": 3}
+    for name in yugabyte.YCQL_WORKLOADS:
+        w = yugabyte.ycql_workload(name, base)
+        assert "generator" in w and "checker" in w, name
+    assert yugabyte.ycql_workload("set-index", base).get("set-index") is True
+    with pytest.raises(ValueError):
+        yugabyte.ycql_workload("monotonic", base)
 
 
 # ---------------------------------------------------------------------------
@@ -343,3 +350,29 @@ def test_monotonic_scrambler_counts_as_clock_nemesis():
     h = [nem] + _final_read([[0, "1.0"], [2, "2.0"], [1, "3.0"]])
     out = monotonic.checker().check({"client": _C()}, h, {})
     assert out["valid?"] == "unknown"
+
+
+def test_pg_client_comments_dispatch():
+    """comments ops route to the sharded comment_N tables
+    (cockroach/comments.clj:30-84): writes insert by id-table, reads
+    union every table inside one txn."""
+    from jepsen_tpu.suites._pg_client import COMMENT_TABLE_COUNT
+
+    c = PGSuiteClient()
+    c.conn = StubConn()
+    out = c.invoke({"comments": True},
+                   {"f": "write", "type": "invoke", "value": [3, 17]})
+    assert out["type"] == "ok"
+    assert any(q.startswith(f"INSERT INTO comment_{17 % COMMENT_TABLE_COUNT}")
+               for q in c.conn.queries)
+
+    c = PGSuiteClient()
+    c.conn = StubConn({"SELECT id FROM comment_2": [["17"]],
+                       "SELECT id FROM comment_5": [["5"]]})
+    out = c.invoke({"comments": True},
+                   {"f": "read", "type": "invoke", "value": [3, None]})
+    assert out["type"] == "ok"
+    assert out["value"] == [3, [5, 17]]
+    selects = [q for q in c.conn.queries if q.startswith("SELECT id FROM")]
+    assert len(selects) == COMMENT_TABLE_COUNT
+    assert c.conn.queries[-1] == "COMMIT"
