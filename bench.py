@@ -406,20 +406,21 @@ def cfg_scale(device_rate: float):
     """North-star scaling metric: the largest single logical history
     verified on device inside the 300 s budget.
 
-    Runs as a CHAIN of ~2M-event segments with the frontier carried on
-    device between them (the segmented-verification path,
-    jitlin.segmented_check semantics): each segment is generated fresh
-    with a continuing block offset, transferred, and scanned from the
-    previous segment's frontier — one contiguous valid history, verified
-    end to end. Segmentation is what lets the run spend the WHOLE budget:
+    Runs as a CHAIN of ~1M-event segments through the transfer-matrix
+    kernel with the composed operator product carried on device between
+    them (jitlin.matrix_check_resume): each segment is generated fresh
+    with a continuing block offset, its returns compose as [MV, MV] MXU
+    matmuls, and the product chains — one contiguous valid history on the
+    faithful rand-int-5 domain, verified end to end, ~300k events/s per
+    segment. Segmentation is what lets the run spend the WHOLE budget:
     monolithic 8M+-event dispatches crash the tunneled TPU worker
     ("TPU worker process crashed or restarted"), so r2 stopped at a 4.19M
-    stability cap; bounded dispatches sidestep that entirely. A segment
-    failure is caught and named, and the total verified so far (a sound
-    prefix verdict) is still reported."""
-    from jepsen_tpu.ops.jitlin import JitLinKernel, _bucket
-
-    import jax
+    stability cap; bounded dispatches sidestep that entirely. (Large
+    domains out of the matrix regime take the same segment chain through
+    the event-scan kernels' frontier carry — jitlin.segmented_check.) A
+    segment failure is caught and named, and the total verified so far (a
+    sound prefix verdict) is still reported."""
+    from jepsen_tpu.ops.jitlin import matrix_check_resume
 
     target_s = float(os.environ.get("BENCH_SCALE_TARGET_S", "280"))
     if target_s <= 0:
@@ -428,36 +429,41 @@ def cfg_scale(device_rate: float):
     #                                      monolithic-dispatch crash size,
     #                                      fine-grained enough to respect
     #                                      the budget within one segment
-    n_values = 100
+    # faithful small domain (the register workload's rand-int 5 → values
+    # 0..4): each return composes one [MV, MV] operator on the MXU, and
+    # the segment carry is the composed product — the matrix kernel's
+    # home regime
+    n_values = 5
     seg_blocks = SEG_E // (2 * N_PROCS)
-    kernel = JitLinKernel()
-    run = kernel._get(N_PROCS, CAPACITY, batched=False,
-                      num_states=n_values + 1, resume=True)
+    seg_events = seg_blocks * 2 * N_PROCS
 
-    def seg_args(k):
-        """Segment k's event arrays, device_put EAGERLY (async) so the
-        next segment's host generation + transfer overlap the current
-        segment's device compute — grid dtypes are narrowed first (slot/f
-        fit int8, values int16), the tunnel is bandwidth-bound."""
-        s = _block_stream(seg_blocks, n_values=n_values,
-                          start_block=k * seg_blocks)
-        return tuple(jax.device_put(a) for a in (
-            s.kind, s.slot.astype(np.int8), s.f.astype(np.int8),
-            s.a.astype(np.int16), s.b.astype(np.int16)))
+    def seg_stream(k):
+        return _block_stream(seg_blocks, n_values=n_values,
+                             start_block=k * seg_blocks)
 
-    # compile + warm outside the budget on segment 0's exact shape
-    carry = run.init_carry()
-    args0 = seg_args(0)
-    warm = run(*args0, *carry)
-    _force(warm[0])
+    def dispatch(k, tot):
+        return matrix_check_resume(seg_stream(k), tot, n_slots=N_PROCS,
+                                   num_states=n_values + 1)
 
+    # compile + warm outside the budget at both carry shapes (the first
+    # call carries the identity, later calls the previous device total)
+    a0, ix0, warm_tot = dispatch(0, None)
+    a1, ix1, _ = dispatch(1, warm_tot)
+    a1, ix1 = _force(a1, ix1)
+    assert bool(np.asarray(a1).all()) and not bool(np.asarray(ix1).any())
+
+    # one-deep pipeline: dispatch segment k (async), THEN sync segment
+    # k-1 — so segment k's host generation + prepass + grid transfer
+    # overlap segment k-1's device compute. The tot carry chains as a
+    # lazy device array, no sync needed between dispatches.
     total_events = 0
     segments = 0
     failure = None
-    carry = run.init_carry()
+    tot = None
+    pending = None
     seg_times: list = []
     t_start = time.perf_counter()
-    nxt = args0
+    k = 0
     while True:
         elapsed = time.perf_counter() - t_start
         est = max(seg_times[-3:]) if seg_times else 0.0
@@ -465,26 +471,38 @@ def cfg_scale(device_rate: float):
             break
         try:
             t0 = time.perf_counter()
-            out = run(*nxt, *carry)
-            carry = out[4:]
-            # prefetch the NEXT segment while this one computes
-            nxt = seg_args(segments + 1)
-            alive, ovf = _force(out[0], out[2])
+            alive, inexact, tot = dispatch(k, tot)
+            k += 1
+            if pending is not None:
+                pa, pix = _force(*pending)
+                assert bool(np.asarray(pa).all())
+                assert not bool(np.asarray(pix).any())
+                total_events += seg_events
+                segments += 1
+            pending = (alive, inexact)
             seg_times.append(round(time.perf_counter() - t0, 1))
-            assert bool(alive) and not bool(ovf)
-            total_events += seg_blocks * 2 * N_PROCS  # actual, not SEG_E
-            segments += 1
         except Exception as e:  # noqa: BLE001 — name the failure, keep prefix
             failure = f"{type(e).__name__}: {e}"
             print(f"[bench] scale segment {segments} failed: {failure}",
                   file=sys.stderr)
             traceback.print_exc()
+            pending = None
             break
+    if pending is not None:
+        try:
+            pa, pix = _force(*pending)
+            assert bool(np.asarray(pa).all())
+            assert not bool(np.asarray(pix).any())
+            total_events += seg_events
+            segments += 1
+        except Exception as e:  # noqa: BLE001
+            failure = f"{type(e).__name__}: {e}"
     used = time.perf_counter() - t_start
     if total_events:
         extra = {"measured_seconds": round(used, 1), "segments": segments,
-                 "segment_events": seg_blocks * 2 * N_PROCS,
-                 "segment_seconds": seg_times,
+                 "segment_events": seg_events,
+                 "segment_seconds": seg_times, "value_domain": n_values,
+                 "path": "matrix-segmented",
                  "events_per_sec": round(total_events / used, 1)}
         if failure:
             extra["failure"] = failure

@@ -460,28 +460,46 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
         return step
 
     @jax.jit
-    def run(pend, op_ids, uops, slots, valid):
-        """pend [T,G,S]; op_ids [T,G,S] (indices into uops [U,3]);
-        slots [T,G]; valid [T,G], with chunk g = key * C + chunk.
-        Returns (alive[B], inexact[B])."""
+    def scan_total(pend, op_ids, uops, slots, valid, tot0):
         mt_tab, oob_tab = uop_tables(uops)
         P0 = jnp.broadcast_to(eye, (G, MV, MV))
         (P, inexact), _ = lax.scan(make_step(mt_tab, oob_tab),
                                    (P0, jnp.zeros((G,), bool)),
                                    (pend, op_ids, slots, valid))
         # chain each key's C chunk products in time order: chunks are
-        # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0]
+        # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0] @ tot0
         Pk = P.reshape(B, C, MV, MV)
 
         def comb(c, tot):
             return (jnp.einsum("bij,bjk->bik", Pk[:, c], tot,
                                preferred_element_type=jnp.bfloat16)
                     > 0).astype(jnp.bfloat16)
-        total = lax.fori_loop(0, C, comb,
-                              jnp.broadcast_to(eye, (B, MV, MV)))
+        total = lax.fori_loop(0, C, comb, tot0.astype(jnp.bfloat16))
         alive = (total[:, :, init_state] > 0).any(axis=1)
-        return alive, inexact.reshape(B, C).any(axis=1)
+        return alive, inexact.reshape(B, C).any(axis=1), total
 
+    def run(pend, op_ids, uops, slots, valid):
+        """pend [T,G,S]; op_ids [T,G,S] (indices into uops [U,3]);
+        slots [T,G]; valid [T,G], with chunk g = key * C + chunk.
+        Returns (alive[B], inexact[B])."""
+        alive, inexact, _ = scan_total(pend, op_ids, uops, slots, valid,
+                                       jnp.broadcast_to(eye, (B, MV, MV)))
+        return alive, inexact
+
+    def run_resume(pend, op_ids, uops, slots, valid, tot0):
+        """Segmented-verification variant: ``tot0`` [B, MV, MV] is the
+        composed operator product of the previous segments (block
+        composition is associative, so chaining segment products equals
+        one monolithic run provided segments cut at quiescent points —
+        the per-segment prepass assumes no pending ops at entry).
+        Returns (alive, inexact, total) with total staying on device."""
+        return scan_total(pend, op_ids, uops, slots, valid, tot0)
+
+    run.resume = run_resume
+    # bf16 identity: the carry dtype must match scan_total's output or
+    # the second chained segment retraces (and recompiles) mid-run
+    run.init_total = lambda: jnp.broadcast_to(
+        jnp.eye(MV, dtype=jnp.bfloat16), (B, MV, MV))
     return run
 
 
@@ -527,6 +545,54 @@ def matrix_check(stream, step_ids=None, init_state: int = 0,
     return matrix_check_batch([stream], step_ids=step_ids,
                               init_state=init_state,
                               num_states=num_states)[0]
+
+
+def matrix_check_resume(stream, tot0=None, step_ids=None,
+                        init_state: int = 0, num_states: int | None = None,
+                        n_slots: int | None = None):
+    """Segmented transfer-matrix verification of one long history: checks
+    a segment starting from the composed operator product ``tot0`` of the
+    prior segments (None = identity) and returns
+    ``(alive, inexact, total)`` with ``total`` staying on device for the
+    next segment. Block composition is associative, so chaining segment
+    products equals one monolithic run — provided segments cut at
+    quiescent points (the per-segment prepass assumes no pending ops at
+    entry; see quiescent_cuts) and share the slot dimension (pass
+    ``n_slots`` to pin S across segments whose own concurrency differs).
+
+    This is the scale path for long SMALL-DOMAIN histories: each return
+    costs one [MV, MV] composition on the MXU instead of a sequential
+    frontier step, and the carry is a single [MV, MV] product.
+
+    Segments must also share the STATE basis: pass ``num_states`` (and
+    build segment streams against one interning scheme) so every
+    segment's value ids mean the same thing — tot0 is checked against
+    the resulting operator dimension and a mismatch raises rather than
+    composing over a permuted basis."""
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    if num_states is None:
+        num_states = len(stream.intern)
+    V = _bucket(num_states, floor=8)
+    prep = _returns_prepass(np.asarray(stream.kind), np.asarray(stream.slot),
+                            np.asarray(stream.f), np.asarray(stream.a),
+                            np.asarray(stream.b))
+    S = max(n_slots or 1, prep[3])
+    if tot0 is not None and tot0.shape[-1] != (1 << S) * V:
+        raise ValueError(
+            f"carry dimension {tot0.shape[-1]} != (1<<{S})*{V}: segments "
+            f"must share n_slots and num_states")
+    R_max = prep[0].shape[0]
+    if R_max == 0:
+        # no returns in this segment: the chain's aliveness is whatever
+        # the carried product says (a dead chain must not revive)
+        if tot0 is None:
+            return True, False, tot0
+        alive = (np.asarray(tot0)[:, :, init_state] > 0).any(axis=1)
+        return alive, False, tot0
+    out = _matrix_dispatch([prep], S, R_max, V, step_ids, init_state,
+                           None, resume=True, tot0=tot0)
+    return out[0], out[1], out[2]
 
 
 def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
@@ -587,10 +653,12 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
 
 
-def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh):
+def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh,
+                     resume: bool = False, tot0=None):
     """Builds one sub-batch's chunk grids and dispatches the kernel,
-    returning UNSYNCED device arrays (alive[B], inexact[B]) so callers
-    can pipeline several dispatches before reading any back."""
+    returning UNSYNCED device arrays (alive[B], inexact[B]; plus the
+    composed total[B, MV, MV] when ``resume``) so callers can pipeline
+    several dispatches before reading any back."""
     import jax
 
     B = len(preps)
@@ -679,6 +747,11 @@ def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh):
         sh = NamedSharding(mesh, P(None, mesh.axis_names[0]))
         grids = [jax.device_put(a, sh) for a in grids]
     run = _matrix_cache(S, V, step_ids, init_state, T, C, B)
+    if resume:
+        if tot0 is None:
+            tot0 = run.init_total()
+        return run.resume(grids[0], grids[1], uops, grids[2], grids[3],
+                          tot0)
     return run(grids[0], grids[1], uops, grids[2], grids[3])
 
 
