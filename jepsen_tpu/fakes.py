@@ -144,6 +144,7 @@ class KVStore(MetaLogDB):
         self.ddl_rows: list | None = None  # default-value table (None=absent)
         self.ddl_next = 0
         self.cmt: dict = {}        # comments workload: key -> set of ids
+        self.tables: set = set()   # table workload: created table ids
 
     def _wipe(self):
         self.registers.clear()
@@ -159,6 +160,7 @@ class KVStore(MetaLogDB):
         self.ddl_rows = None
         self.ddl_next = 0
         self.cmt.clear()
+        self.tables.clear()
 
     def read(self, k):
         with self.lock:
@@ -244,6 +246,15 @@ class KVStore(MetaLogDB):
         with self.lock:
             return (None if self.ddl_rows is None
                     else [dict(r) for r in self.ddl_rows])
+
+    # table workload: created-table visibility (the fake is anomaly-free)
+    def tbl_create(self, tid) -> None:
+        with self.lock:
+            self.tables.add(tid)
+
+    def tbl_insert(self, tid) -> bool:
+        with self.lock:
+            return tid in self.tables
 
     # comments workload: per-key visible-id sets
     def cmt_write(self, k, i) -> None:
@@ -414,6 +425,15 @@ class KVClient(MetaLogClient):
                 if rows is None:
                     return {**op, "type": "fail", "error": ["no-table"]}
                 return {**op, "type": "ok", "value": rows}
+        if test.get("table-workload"):
+            if f == "create-table":
+                self.db.tbl_create(v)
+                return {**op, "type": "ok"}
+            if f == "insert":
+                tid, _k = v
+                if self.db.tbl_insert(tid):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": ["doesnt-exist", tid]}
         if test.get("comments"):
             if f == "write":
                 k, i = v

@@ -112,8 +112,8 @@ def build_suite_test(o: dict | None, *, db_name: str,
         from jepsen_tpu.fakes import KVClient, KVStore
         from jepsen_tpu.net import NoopNet
         kv = fake_db() if fake_db else KVStore()
-        whole_read = {"bank": "bank", "dirty-reads": "dirty"}.get(
-            workload_name, "set")
+        whole_read = {"bank": "bank", "bank-multitable": "bank",
+                      "dirty-reads": "dirty"}.get(workload_name, "set")
         txn_style = "wr" if workload_name in ("wr", "long-fork") else "append"
         client = fake_client() if fake_client \
             else KVClient(kv, whole_read=whole_read, txn_style=txn_style)
@@ -251,7 +251,8 @@ def workload_registry() -> dict[str, Callable]:
                                       default_value, dirty_reads, long_fork,
                                       monotonic, multi_key_acid, mutex,
                                       queue_workload, register, sequential,
-                                      set_workload, single_key_acid, wr)
+                                      set_workload, single_key_acid,
+                                      table_workload, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -272,4 +273,5 @@ def workload_registry() -> dict[str, Callable]:
         "multi-key-acid": multi_key_acid.workload,
         "default-value": default_value.workload,
         "comments": comments.workload,
+        "table": table_workload.workload,
     }

@@ -129,9 +129,27 @@ class MySQLSuiteClient(Client):
                 "CREATE TABLE IF NOT EXISTS lists "
                 f"(k INT NOT NULL PRIMARY KEY, elems TEXT){suffix}"):
             self.conn.query(ddl)
+        if test.get("set-cas"):
+            # tidb/sets.clj CasSetClient: the whole set is one text row
+            self.conn.query("CREATE TABLE IF NOT EXISTS sets_cas "
+                            f"(id INT NOT NULL PRIMARY KEY, value TEXT)"
+                            f"{suffix}")
+        if test.get("bank-multitable"):
+            # tidb/bank.clj MultiBankClient: one table per account
+            accounts = list(test.get("accounts", []))
+            total = int(test.get("total-amount", 10 * len(accounts) or 80))
+            for i, a in enumerate(accounts):
+                self.conn.query(
+                    f"CREATE TABLE IF NOT EXISTS accounts{int(a)} "
+                    f"(id INT NOT NULL PRIMARY KEY, balance BIGINT NOT "
+                    f"NULL){suffix}")
+                self.conn.query(
+                    f"INSERT IGNORE INTO accounts{int(a)} (id, balance) "
+                    f"VALUES (0, {total if i == 0 else 0})")
         # bank initial balances (galera.clj:262-273) and dirty rows
         # (dirty_reads.clj:31-43); both idempotent across clients
-        for a in test.get("accounts", []):
+        for a in ([] if test.get("bank-multitable")
+                  else test.get("accounts", [])):
             self.conn.query(
                 f"INSERT IGNORE INTO accounts (id, balance) "
                 f"VALUES ({int(a)}, 10)")
@@ -172,6 +190,35 @@ class MySQLSuiteClient(Client):
             self._connect(test)
             self._broken = False
         try:
+            if test.get("table-workload") and f == "create-table":
+                self.conn.query(
+                    f"CREATE TABLE IF NOT EXISTS t{int(v)} "
+                    f"(id INT NOT NULL PRIMARY KEY, val INT)")
+                return {**op, "type": "ok"}
+            if test.get("table-workload") and f == "insert":
+                tid, k = v
+                try:
+                    self.conn.query(
+                        f"INSERT INTO t{int(tid)} (id, val) "
+                        f"VALUES ({int(k)}, 0)")
+                except MySQLError as e:
+                    if "doesn't exist" in e.msg or e.code == 1146:
+                        return {**op, "type": "fail",
+                                "error": ["doesnt-exist", tid]}
+                    if e.code == 1062:  # duplicate key: insert still proves
+                        #                 the table is visible
+                        return {**op, "type": "fail",
+                                "error": ["duplicate-key", tid]}
+                    raise
+                return {**op, "type": "ok"}
+            if test.get("set-cas") and f == "add":
+                return self._cas_set_add(op)
+            if test.get("set-cas") and f == "read" and v is None:
+                return self._cas_set_read(op)
+            if test.get("bank-multitable") and f == "transfer":
+                return self._multitable_transfer(test, op)
+            if test.get("bank-multitable") and f == "read" and v is None:
+                return self._multitable_read(test, op)
             if f == "read" and v is None:
                 return self._whole_read(test, op)
             if f == "read":
@@ -237,18 +284,30 @@ class MySQLSuiteClient(Client):
         rows = self.conn.query("SELECT elem FROM sets ORDER BY elem")
         return {**op, "type": "ok", "value": [int(r[0]) for r in rows]}
 
-    def _transfer(self, op):
+    @staticmethod
+    def _acct_loc(a):
+        """(table, where) for the shared single accounts table."""
+        return "accounts", f"id = {int(a)}"
+
+    @staticmethod
+    def _acct_loc_multi(a):
+        """(table, where) for per-account tables (tidb/bank.clj
+        MultiBankClient)."""
+        return f"accounts{int(a)}", "id = 0"
+
+    def _transfer(self, op, loc=None):
         """Two-row serializable transfer (galera.clj:277-306): read both
-        balances, refuse overdrafts, write both."""
+        balances, refuse overdrafts, write both. ``loc(account) ->
+        (table, where)`` picks the storage layout."""
+        loc = loc or self._acct_loc
         t = op.get("value") or {}
         frm, to = int(t.get("from")), int(t.get("to"))
         amount = int(t.get("amount", 0))
+        (ft, fw), (tt, tw) = loc(frm), loc(to)
         self._begin()
         try:
-            b1 = self._select_int(
-                f"SELECT balance FROM accounts WHERE id = {frm}")
-            b2 = self._select_int(
-                f"SELECT balance FROM accounts WHERE id = {to}")
+            b1 = self._select_int(f"SELECT balance FROM {ft} WHERE {fw}")
+            b2 = self._select_int(f"SELECT balance FROM {tt} WHERE {tw}")
             if b1 is None or b2 is None:
                 self._rollback()
                 return {**op, "type": "fail", "error": ["no-such-account"]}
@@ -256,12 +315,65 @@ class MySQLSuiteClient(Client):
                 self._rollback()
                 return {**op, "type": "fail",
                         "error": ["negative", frm, b1 - amount]}
-            self.conn.query(f"UPDATE accounts SET balance = {b1 - amount} "
-                            f"WHERE id = {frm}")
-            self.conn.query(f"UPDATE accounts SET balance = {b2 + amount} "
-                            f"WHERE id = {to}")
+            self.conn.query(f"UPDATE {ft} SET balance = {b1 - amount} "
+                            f"WHERE {fw}")
+            self.conn.query(f"UPDATE {tt} SET balance = {b2 + amount} "
+                            f"WHERE {tw}")
             self.conn.query("COMMIT")
             return {**op, "type": "ok"}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _cas_set_add(self, op):
+        """Append to the single text-row set under a txn
+        (tidb/sets.clj CasSetClient :add) — the read-modify-write
+        contention probe the plain insert-per-element set can't be."""
+        e = int(op.get("value"))
+        self._begin()
+        try:
+            rows = self.conn.query("SELECT value FROM sets_cas WHERE id = 0")
+            if rows and rows[0][0] not in (None, ""):
+                self.conn.query(
+                    f"UPDATE sets_cas SET value = CONCAT(value, ',{e}') "
+                    f"WHERE id = 0")
+            else:
+                # the empty read may race a concurrent first insert: the
+                # duplicate-key fallback must APPEND, never overwrite, or
+                # an acknowledged element vanishes and the set checker
+                # wrongly convicts the DB
+                self.conn.query(
+                    f"INSERT INTO sets_cas (id, value) VALUES (0, '{e}') "
+                    f"ON DUPLICATE KEY UPDATE value = IF(value IS NULL OR "
+                    f"value = '', '{e}', CONCAT(value, ',{e}'))")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
+        except MySQLError as e2:
+            self._rollback()
+            return self._sql_error(op, e2)
+
+    def _cas_set_read(self, op):
+        rows = self.conn.query("SELECT value FROM sets_cas WHERE id = 0")
+        raw = rows[0][0] if rows else None
+        vals = ([int(x) for x in str(raw).split(",")]
+                if raw not in (None, "") else [])
+        return {**op, "type": "ok", "value": sorted(vals)}
+
+    def _multitable_transfer(self, test, op):
+        """Per-account-table transfer (tidb/bank.clj MultiBankClient):
+        _transfer's discipline with the per-table layout."""
+        return self._transfer(op, loc=self._acct_loc_multi)
+
+    def _multitable_read(self, test, op):
+        self._begin()
+        try:
+            out = {}
+            for a in test.get("accounts", []):
+                t, w = self._acct_loc_multi(a)
+                out[int(a)] = self._select_int(
+                    f"SELECT balance FROM {t} WHERE {w}")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok", "value": out}
         except MySQLError as e:
             self._rollback()
             return self._sql_error(op, e)

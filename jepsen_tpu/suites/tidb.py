@@ -161,8 +161,27 @@ class TiDBDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
         return [PD_LOG, KV_LOG, DB_LOG]
 
 
-SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "wr",
-                       "long-fork")
+SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "wr", "table",
+                       "long-fork", "set-cas", "bank-multitable")
+
+
+def _tidb_workload(name: str, base: dict) -> dict:
+    """The shared kits plus tidb's registry variants
+    (tidb/core.clj:32-45): set-cas re-runs the set workload through the
+    single-text-row CAS client (tidb/sets.clj CasSetClient) and
+    bank-multitable re-runs bank across per-account tables
+    (tidb/bank.clj MultiBankClient) — kit semantics unchanged, a
+    test-map marker routes the client."""
+    from jepsen_tpu.suites import workload_registry
+
+    reg = workload_registry()
+    if name == "set-cas":
+        return {**reg["set"](base, accelerator=base["accelerator"]),
+                "set-cas": True}
+    if name == "bank-multitable":
+        return {**reg["bank"](base, accelerator=base["accelerator"]),
+                "bank-multitable": True}
+    return reg[name](base, accelerator=base["accelerator"])
 
 
 def tidb_test(opts_dict: dict | None = None) -> dict:
@@ -170,6 +189,7 @@ def tidb_test(opts_dict: dict | None = None) -> dict:
     workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
     return build_suite_test(
         o, db_name="tidb", supported_workloads=SUPPORTED_WORKLOADS,
+        make_workload=_tidb_workload,
         make_real=lambda o: {
             "db": TiDBDB(o.get("version", DEFAULT_VERSION)),
             "client": MySQLSuiteClient(

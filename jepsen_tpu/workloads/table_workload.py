@@ -1,0 +1,62 @@
+"""Table-creation visibility workload (reference:
+tidb/src/tidb/table.clj — a DDL race probe: create a table, then insert
+into it; a "table doesn't exist" failure for a table whose creation
+already acknowledged is a schema-visibility violation).
+
+Op shapes:
+- ``{"f": "create-table", "value": table_id}``
+- ``{"f": "insert", "value": [table_id, k]}`` — fails with error
+  ["doesnt-exist", ...] when the server can't see the table
+
+The generator only inserts into tables whose create-table op has
+completed ok (table.clj:62-69 tracks last-created-table the same way),
+so every doesnt-exist failure indicts the DB, not the workload.
+"""
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def generator():
+    lock = threading.Lock()
+    state = {"last_created": None, "next": 0}
+
+    def one(test, ctx):
+        with lock:
+            last = state["last_created"]
+            if last is not None and ctx.rng.random() < 0.8:
+                return {"f": "insert", "value": [last, 0]}
+            state["next"] += 1
+            return {"f": "create-table", "value": state["next"]}
+
+    def on_update(g, test, ctx, event):
+        if event.get("type") == "ok" and event.get("f") == "create-table":
+            with lock:
+                cur = state["last_created"]
+                v = event.get("value")
+                state["last_created"] = v if cur is None else max(cur, v)
+        return g
+
+    return gen.on_update(on_update, gen.Fn(one))
+
+
+class TableChecker(Checker):
+    """Valid iff no insert failed with doesnt-exist (table.clj:70-79)."""
+
+    def check(self, test, history, opts):
+        bad = [op for op in history
+               if op.get("type") == "fail"
+               and (op.get("error") or [None])[0] == "doesnt-exist"]
+        return {"valid?": not bad, "missing-table-count": len(bad),
+                "missing-table": bad[:10]}
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "table-workload": True,  # fake-mode client dispatch marker
+        "generator": generator(),
+        "checker": TableChecker(),
+    }

@@ -219,3 +219,83 @@ def test_parse_int_list():
     assert parse_int_list("") == []
     assert parse_int_list("1") == [1]
     assert parse_int_list("1,2,3") == [1, 2, 3]
+
+
+def test_tidb_set_cas_client_body():
+    """tidb/sets.clj CasSetClient: the set is one text row appended under
+    a txn; reads split it."""
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT value FROM sets_cas": [("3,5",)]})
+    out = c.invoke({"set-cas": True}, {"f": "add", "type": "invoke",
+                                       "value": 9})
+    assert out["type"] == "ok"
+    assert any("CONCAT(value, ',9')" in q for q in c.conn.queries)
+    assert c.conn.queries[-1] == "COMMIT"
+    out = c.invoke({"set-cas": True}, {"f": "read", "type": "invoke",
+                                       "value": None})
+    assert out["type"] == "ok" and out["value"] == [3, 5]
+
+    # empty set: first add inserts
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT value FROM sets_cas": []})
+    c.invoke({"set-cas": True}, {"f": "add", "type": "invoke", "value": 1})
+    assert any(q.startswith("INSERT INTO sets_cas") for q in c.conn.queries)
+
+
+def test_tidb_multitable_bank_client_body():
+    """tidb/bank.clj MultiBankClient: balances live in per-account
+    tables; transfers keep the overdraft discipline."""
+    c = MySQLSuiteClient()
+    c.conn = StubConn({"SELECT balance FROM accounts0": [("3",)],
+                       "SELECT balance FROM accounts1": [("7",)]})
+    out = c.invoke({"bank-multitable": True, "accounts": [0, 1]},
+                   {"f": "transfer", "type": "invoke",
+                    "value": {"from": 0, "to": 1, "amount": 5}})
+    assert out["type"] == "fail" and out["error"][0] == "negative"
+    out = c.invoke({"bank-multitable": True, "accounts": [0, 1]},
+                   {"f": "transfer", "type": "invoke",
+                    "value": {"from": 1, "to": 0, "amount": 5}})
+    assert out["type"] == "ok"
+    assert any("UPDATE accounts1" in q for q in c.conn.queries)
+    assert any("UPDATE accounts0" in q for q in c.conn.queries)
+    out = c.invoke({"bank-multitable": True, "accounts": [0, 1]},
+                   {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "ok" and out["value"] == {0: 3, 1: 7}
+
+
+def test_tidb_fake_set_cas_and_multitable_runs():
+    from jepsen_tpu.suites import tidb
+
+    for wl in ("set-cas", "bank-multitable"):
+        result = run_fake(tidb.tidb_test, workload=wl)
+        assert result["results"]["valid?"] is True, (wl, result["results"])
+
+
+def test_tidb_table_workload_client_body():
+    """Real-client half of the table probe: create-table issues DDL,
+    inserts map 'doesn't exist' to the checker's doesnt-exist error."""
+    from jepsen_tpu.suites._mysql import MySQLError
+
+    c = MySQLSuiteClient()
+    c.conn = StubConn()
+    out = c.invoke({"table-workload": True},
+                   {"f": "create-table", "type": "invoke", "value": 3})
+    assert out["type"] == "ok"
+    assert any(q.startswith("CREATE TABLE IF NOT EXISTS t3") 
+               for q in c.conn.queries)
+    out = c.invoke({"table-workload": True},
+                   {"f": "insert", "type": "invoke", "value": [3, 0]})
+    assert out["type"] == "ok"
+
+    class MissingTableConn(StubConn):
+        def query(self, sql):
+            if sql.startswith("INSERT INTO t"):
+                raise MySQLError(1146, "42S02",
+                                 "Table 'jepsen.t4' doesn't exist")
+            return super().query(sql)
+
+    c = MySQLSuiteClient()
+    c.conn = MissingTableConn()
+    out = c.invoke({"table-workload": True},
+                   {"f": "insert", "type": "invoke", "value": [4, 0]})
+    assert out["type"] == "fail" and out["error"][0] == "doesnt-exist"

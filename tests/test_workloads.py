@@ -544,3 +544,25 @@ def test_comments_checker_finds_visibility_hole():
     # seeing both (or only w0) is fine
     h[-1] = {"type": "ok", "f": "read", "process": 2, "value": [0, 1]}
     assert CommentsChecker().check({}, h, {})["valid?"] is True
+
+
+def test_table_workload_checker_and_fake_lifecycle():
+    """tidb's table-creation visibility probe (tidb/table.clj): inserts
+    into acknowledged tables must never fail with doesnt-exist."""
+    from jepsen_tpu.suites.tidb import tidb_test
+    from jepsen_tpu.workloads.table_workload import TableChecker
+    from conftest import run_fake
+
+    bad = [{"type": "fail", "f": "insert", "process": 0,
+            "value": [1, 0], "error": ["doesnt-exist", 1]}]
+    out = TableChecker().check({}, bad, {})
+    assert out["valid?"] is False and out["missing-table-count"] == 1
+    assert TableChecker().check({}, [], {})["valid?"] is True
+
+    t = run_fake(tidb_test, workload="table", time_limit=0.5)
+    assert t["results"]["valid?"] is True, t["results"]
+    creates = [op for op in t["history"]
+               if op.get("f") == "create-table" and op.get("type") == "ok"]
+    inserts = [op for op in t["history"]
+               if op.get("f") == "insert" and op.get("type") == "ok"]
+    assert creates and inserts
