@@ -355,9 +355,7 @@ class MySQLSuiteClient(Client):
     def _cas_set_read(self, op):
         rows = self.conn.query("SELECT value FROM sets_cas WHERE id = 0")
         raw = rows[0][0] if rows else None
-        vals = ([int(x) for x in str(raw).split(",")]
-                if raw not in (None, "") else [])
-        return {**op, "type": "ok", "value": sorted(vals)}
+        return {**op, "type": "ok", "value": sorted(parse_int_list(raw))}
 
     def _multitable_transfer(self, test, op):
         """Per-account-table transfer (tidb/bank.clj MultiBankClient):

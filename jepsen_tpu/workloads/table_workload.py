@@ -22,13 +22,17 @@ from jepsen_tpu.checker import Checker
 
 def generator():
     lock = threading.Lock()
-    state = {"last_created": None, "next": 0}
+    state = {"last_created": None, "next": 0, "row": 0}
 
     def one(test, ctx):
         with lock:
             last = state["last_created"]
             if last is not None and ctx.rng.random() < 0.8:
-                return {"f": "insert", "value": [last, 0]}
+                # fresh row key per insert, so every insert probes the
+                # table's visibility (a fixed key would duplicate-key
+                # away all but the first probe on a real DB)
+                state["row"] += 1
+                return {"f": "insert", "value": [last, state["row"]]}
             state["next"] += 1
             return {"f": "create-table", "value": state["next"]}
 

@@ -500,7 +500,14 @@ def test_default_value_fake_mode_lifecycle():
     from conftest import run_fake
 
     t = run_fake(yugabyte_test, workload="default-value", time_limit=0.5)
-    assert t["results"]["valid?"] in (True, "unknown"), t["results"]
+    # DDL churn legitimately fails ops while the table is dropped, so a
+    # short run can leave some op class with zero oks and trip the
+    # generic stats checker — the WORKLOAD verdict (no null-column rows)
+    # and the exceptions checker are what this lifecycle test pins
+    assert t["results"]["workload"]["valid?"] is True, t["results"]
+    assert t["results"]["exceptions"]["valid?"] is True, t["results"]
+    oks = [op for op in t["history"] if op.get("type") == "ok"]
+    assert oks, "the DDL-churn run must complete some ops"
 
 
 def test_comments_fake_mode_lifecycle():
