@@ -148,7 +148,7 @@ class FaunaClient(Client):
         self.node = node
 
     def open(self, test, node):
-        return FaunaClient(self.timeout_s, node)
+        return type(self)(self.timeout_s, node)
 
     def _query(self, expr):
         auth = base64.b64encode(f"{SECRET}:".encode()).decode()
@@ -160,11 +160,30 @@ class FaunaClient(Client):
         return out.get("resource") if isinstance(out, dict) else out
 
     def setup(self, test):
-        for cls in ("registers", "accounts"):
+        for cls in ("registers", "accounts", "elements", "adya"):
             try:
                 self._query({"create_class": {"object": {"name": cls}}})
             except FaunaError:
                 pass  # already exists
+        try:
+            # enumeration index for the set workload's whole reads
+            # (faunadb/set.clj builds the same all-elements index)
+            self._query({"create_index": {"object": {
+                "name": "all_elements",
+                "source": {"@ref": "classes/elements"},
+                "values": [{"field": ["data", "elem"]}]}}})
+        except FaunaError:
+            pass
+        try:
+            # pair-term index: the adya probe's PREDICATE read (a phantom
+            # -permitting DB must be caught, so the guard reads the whole
+            # pair through the index, not two concrete refs — g2.clj)
+            self._query({"create_index": {"object": {
+                "name": "adya_by_pair",
+                "source": {"@ref": "classes/adya"},
+                "terms": [{"field": ["data", "pair"]}]}}})
+        except FaunaError:
+            pass
         for a in test.get("accounts", []):
             try:
                 self._query(create_("accounts", a, {"balance": 10}))
@@ -188,6 +207,41 @@ class FaunaClient(Client):
                                   for a, b in balances.items()}}
             if f == "transfer":
                 return self._transfer(op)
+            if f == "add":
+                self._query(upsert("elements", int(v), {"elem": int(v)}))
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                # set whole-read: paginate the all-elements index in one
+                # query/transaction (faunadb/set.clj's read; the pages
+                # workload stresses exactly this surface)
+                out = self._query({
+                    "paginate": {"match": {"index":
+                                           {"@ref": "indexes/all_elements"}}},
+                    "size": 100000})
+                elems = (out.get("data", []) if isinstance(out, dict)
+                         else (out or []))
+                return {**op, "type": "ok",
+                        "value": sorted(int(e) for e in elems)}
+            if f == "insert":
+                # adya G2 probe: PREDICATE-read the pair through the
+                # adya_by_pair index and create our cell only if it is
+                # empty — one FQL If is one strictly-serializable
+                # transaction, and the index match (not item reads of
+                # concrete refs) is what makes a phantom-permitting DB
+                # fail the probe (faunadb/g2.clj shape)
+                pair, uid, cell = v
+                pair_match = {"match": {"index":
+                                        {"@ref": "indexes/adya_by_pair"}},
+                              "terms": int(pair)}
+                out = self._query(if_(
+                    {"is_empty": {"paginate": pair_match}},
+                    do_(create_("adya", f"{int(pair)}-{cell}",
+                                {"uid": int(uid), "pair": int(pair)}),
+                        True),
+                    False))
+                if out is True:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": ["pair-occupied"]}
             if f == "read":
                 k, _ = v
                 out = self._query(select_data("v", get_("registers", k)))
@@ -255,7 +309,7 @@ class FaunaError(Exception):
                    for e in self.errors if isinstance(e, dict))
 
 
-SUPPORTED_WORKLOADS = ("register", "bank")
+SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya")
 
 
 def faunadb_test(opts_dict: dict | None = None) -> dict:

@@ -222,3 +222,50 @@ def test_mongodb_variants():
         control.disconnect_all(t)
     tm = mongodb.mongodb_test({"fake": True})
     assert tm["generator"] is not None  # variants don't break fake mode
+
+
+def test_fauna_client_set_and_adya_expressions():
+    """set adds upsert keyed elements and whole-reads paginate the
+    all-elements index; adya inserts predicate-read both pair cells in
+    one If transaction (faunadb/set.clj, g2.clj shapes)."""
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            if "paginate" in expr:
+                return {"data": [3, 1]}
+            return True
+
+    c = TClient(node="n1")
+    assert c.invoke({}, {"f": "add", "type": "invoke",
+                         "value": 7})["type"] == "ok"
+    assert sent[0]["if"] == {"exists": {"@ref": "classes/elements/7"}}
+    out = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "ok" and out["value"] == [1, 3]
+    assert sent[1]["paginate"]["match"]["index"] == \
+        {"@ref": "indexes/all_elements"}
+
+    out = c.invoke({}, {"f": "insert", "type": "invoke",
+                        "value": [4, 99, "a"]})
+    assert out["type"] == "ok"
+    g2 = sent[2]
+    # the guard is a PREDICATE read: index match over the pair term
+    assert g2["if"]["is_empty"]["paginate"]["match"]["index"] ==         {"@ref": "indexes/adya_by_pair"}
+    assert g2["if"]["is_empty"]["paginate"]["terms"] == 4
+    assert g2["then"]["do"][0]["create"] == {"@ref": "classes/adya/4-a"}
+    assert g2["else"] is False
+
+    class Occupied(faunadb.FaunaClient):
+        def _query(self, expr):
+            return False  # pair not empty: If takes the else branch
+
+    out = Occupied(node="n1").invoke({}, {"f": "insert", "type": "invoke",
+                                          "value": [4, 99, "b"]})
+    assert out["type"] == "fail"
+
+
+def test_fauna_fake_set_and_adya_runs():
+    for wl in ("set", "adya"):
+        result = run_fake(faunadb.faunadb_test, workload=wl)
+        assert result["results"]["valid?"] is True, (wl, result["results"])
