@@ -256,6 +256,17 @@ class KVStore(MetaLogDB):
         with self.lock:
             return tid in self.tables
 
+    # upsert workload: at most one record per key (the fake is
+    # anomaly-free: creates are idempotent under the lock)
+    def upsert_create(self, k) -> None:
+        with self.lock:
+            self.registers.setdefault(("__upsert__", k), f"u{k}")
+
+    def upsert_read(self, k) -> list:
+        with self.lock:
+            u = self.registers.get(("__upsert__", k))
+            return [u] if u is not None else []
+
     # comments workload: per-key visible-id sets
     def cmt_write(self, k, i) -> None:
         with self.lock:
@@ -434,6 +445,15 @@ class KVClient(MetaLogClient):
                 if self.db.tbl_insert(tid):
                     return {**op, "type": "ok"}
                 return {**op, "type": "fail", "error": ["doesnt-exist", tid]}
+        if test.get("upsert-workload"):
+            if f == "upsert":
+                k, _uid = v
+                self.db.upsert_create(k)
+                return {**op, "type": "ok"}
+            if f == "read-uids":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self.db.upsert_read(k)]}
         if test.get("comments"):
             if f == "write":
                 k, i = v

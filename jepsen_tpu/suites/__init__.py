@@ -252,7 +252,7 @@ def workload_registry() -> dict[str, Callable]:
                                       monotonic, multi_key_acid, mutex,
                                       queue_workload, register, sequential,
                                       set_workload, single_key_acid,
-                                      table_workload, wr)
+                                      table_workload, upsert, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -274,4 +274,5 @@ def workload_registry() -> dict[str, Callable]:
         "default-value": default_value.workload,
         "comments": comments.workload,
         "table": table_workload.workload,
+        "upsert": upsert.workload,
     }

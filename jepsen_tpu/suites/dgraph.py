@@ -38,7 +38,15 @@ ALPHA_PID = f"{DIR}/alpha.pid"
 ALPHA_HTTP_PORT = 8080
 ZERO_GRPC_PORT = 5080
 
-SCHEMA = "key: int @index(int) .\nval: int .\nel: int @index(int) .\n"
+# @upsert on the indexed predicates makes dgraph conflict-check the
+# index reads inside upsert blocks — without it two conditional creates
+# of one key can both commit and the client fabricates duplicates the
+# checkers would blame on the DB (the reference schemas carry the same
+# directive)
+SCHEMA = ("key: int @index(int) @upsert .\nval: int .\n"
+          "el: int @index(int) .\n"
+          "acct: int @index(int) @upsert .\nbalance: int .\n"
+          "ukey: int @index(int) @upsert .\nuval: int .\n")
 
 
 def binary_url(version: str) -> str:
@@ -104,7 +112,16 @@ class DgraphClient(Client):
         self.node = node
 
     def open(self, test, node):
-        return DgraphClient(self.timeout_s, node)
+        return type(self)(self.timeout_s, node)
+
+    def setup(self, test):
+        # bank accounts: conditional create per account — idempotent
+        # across clients (dgraph/bank.clj seeds the same way)
+        for a in test.get("accounts", []):
+            self._mutate({
+                "query": "{ q(func: eq(acct, %d)) { u as uid } }" % int(a),
+                "cond": "@if(eq(len(u), 0))",
+                "set": [{"acct": int(a), "balance": 10}]})
 
     def _mutate(self, body: dict):
         doc = http_json(
@@ -131,12 +148,54 @@ class DgraphClient(Client):
         rows = data.get("q") or []
         return rows[0].get("val") if rows else None
 
+    # -- real dgraph transactions: snapshot query at start_ts, mutations
+    # -- at the same ts, then commit with the server's conflict keys —
+    # -- the reference client's txn shape (dgraph/client.clj with-txn)
+    def _txn_query(self, dql: str):
+        doc = http_json(f"http://{self.node}:{ALPHA_HTTP_PORT}/query",
+                        raw_body=dql.encode(),
+                        headers={"Content-Type": "application/dql"},
+                        timeout_s=self.timeout_s)
+        if doc.get("errors"):
+            raise DgraphError(str(doc["errors"]))
+        ts = (doc.get("extensions") or {}).get("txn", {}).get("start_ts")
+        return doc.get("data") or {}, ts
+
+    def _txn_mutate(self, start_ts, body: dict):
+        mut = http_json(
+            f"http://{self.node}:{ALPHA_HTTP_PORT}/mutate"
+            f"?startTs={start_ts}", body, timeout_s=self.timeout_s)
+        if mut.get("errors"):
+            raise DgraphError(str(mut["errors"]))
+        return (mut.get("extensions") or {}).get("txn", {})
+
+    def _txn_commit(self, start_ts, txn: dict):
+        try:
+            commit = http_json(
+                f"http://{self.node}:{ALPHA_HTTP_PORT}/commit"
+                f"?startTs={start_ts}",
+                {"keys": txn.get("keys") or [],
+                 "preds": txn.get("preds") or []},
+                timeout_s=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # aborted: lost the conflict race
+                raise DgraphAborted("commit aborted")
+            raise
+        if commit.get("errors"):
+            raise DgraphError(str(commit["errors"]))
+
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
             if f == "add":
                 self._mutate({"set": [{"el": v}]})
                 return {**op, "type": "ok"}
+            if f == "read" and v is None and test.get("accounts"):
+                data = self._query(
+                    "{ q(func: has(acct)) { acct balance } }")
+                return {**op, "type": "ok",
+                        "value": {int(r["acct"]): int(r.get("balance", 0))
+                                  for r in (data.get("q") or [])}}
             if f == "read" and v is None:
                 data = self._query("{ q(func: has(el)) { el } }")
                 elems = sorted(row["el"] for row in (data.get("q") or []))
@@ -153,46 +212,31 @@ class DgraphClient(Client):
                     "set": [{"uid": "uid(u)", "key": k, "val": val}]})
                 return {**op, "type": "ok"}
             if f == "cas":
-                # a real dgraph txn: snapshot read at start_ts, write, then
-                # commit with conflict keys — aborts on concurrent writers
-                # (the reference client's txn shape, upsert.clj pattern)
                 k, (old, new) = v
-                q = http_json(
-                    f"http://{self.node}:{ALPHA_HTTP_PORT}/query",
-                    raw_body=(b"{ q(func: eq(key, %d)) { uid val } }"
-                              % k),
-                    headers={"Content-Type": "application/dql"},
-                    timeout_s=self.timeout_s)
-                rows = (q.get("data") or {}).get("q") or []
-                start_ts = (q.get("extensions") or {}).get(
-                    "txn", {}).get("start_ts")
-                if not rows or rows[0].get("val") != old or not start_ts:
+                data, ts = self._txn_query(
+                    "{ q(func: eq(key, %d)) { uid val } }" % k)
+                rows = data.get("q") or []
+                if not rows or rows[0].get("val") != old or not ts:
                     return {**op, "type": "fail"}
-                mut = http_json(
-                    f"http://{self.node}:{ALPHA_HTTP_PORT}/mutate"
-                    f"?startTs={start_ts}",
-                    {"set": [{"uid": rows[0]["uid"], "val": new}]},
-                    timeout_s=self.timeout_s)
-                if mut.get("errors"):
-                    return {**op, "type": "fail",
-                            "error": ["txn", str(mut["errors"])]}
-                txn = (mut.get("extensions") or {}).get("txn", {})
-                try:
-                    commit = http_json(
-                        f"http://{self.node}:{ALPHA_HTTP_PORT}/commit"
-                        f"?startTs={start_ts}",
-                        {"keys": txn.get("keys") or [],
-                         "preds": txn.get("preds") or []},
-                        timeout_s=self.timeout_s)
-                except urllib.error.HTTPError as e:
-                    if e.code == 409:  # aborted: lost the conflict race
-                        return {**op, "type": "fail"}
-                    raise
-                if commit.get("errors"):
-                    return {**op, "type": "fail",
-                            "error": ["txn", str(commit["errors"])]}
+                txn = self._txn_mutate(
+                    ts, {"set": [{"uid": rows[0]["uid"], "val": new}]})
+                self._txn_commit(ts, txn)
                 return {**op, "type": "ok"}
+            if f == "transfer":
+                return self._transfer(op)
+            if f == "txn":
+                return self._wr_txn(op)
+            if f == "upsert":
+                return self._upsert(op)
+            if f == "read-uids":
+                k, _ = v
+                data = self._query(
+                    "{ q(func: eq(ukey, %d)) { uid } }" % int(k))
+                uids = [r["uid"] for r in (data.get("q") or [])]
+                return {**op, "type": "ok", "value": [k, uids]}
             return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except DgraphAborted:
+            return {**op, "type": "fail", "error": ["txn", "aborted"]}
         except DgraphError as e:
             # txn conflicts abort server-side: definite failure
             if "conflict" in str(e).lower() or "aborted" in str(e).lower():
@@ -206,6 +250,82 @@ class DgraphClient(Client):
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
 
+    def _transfer(self, op):
+        """Two-account transfer in one dgraph txn (dgraph/bank.clj):
+        snapshot both balances, refuse overdrafts, mutate both at the
+        same start_ts, commit with conflict keys."""
+        t = op.get("value") or {}
+        frm, to = int(t.get("from")), int(t.get("to"))
+        amount = int(t.get("amount", 0))
+        data, ts = self._txn_query(
+            "{ a(func: eq(acct, %d)) { uid balance } "
+            "b(func: eq(acct, %d)) { uid balance } }" % (frm, to))
+        a = (data.get("a") or [None])[0]
+        b = (data.get("b") or [None])[0]
+        if not a or not b or not ts:
+            return {**op, "type": "fail", "error": ["no-such-account"]}
+        if int(a.get("balance", 0)) - amount < 0:
+            return {**op, "type": "fail",
+                    "error": ["negative", frm,
+                              int(a.get("balance", 0)) - amount]}
+        txn = self._txn_mutate(ts, {"set": [
+            {"uid": a["uid"], "balance": int(a.get("balance", 0)) - amount},
+            {"uid": b["uid"], "balance": int(b.get("balance", 0)) + amount},
+        ]})
+        self._txn_commit(ts, txn)
+        return {**op, "type": "ok"}
+
+    def _wr_txn(self, op):
+        """rw-register txn (dgraph/wr.clj, long_fork.clj): every key's
+        row binds in one snapshot query; reads fill from it, writes go
+        through ONE upsert-block mutation at the same start_ts — each
+        write binds its key's uid with a query var, so an existing row
+        updates in place and a fresh key creates exactly once (two
+        concurrent first-writers conflict on the @upsert index read
+        instead of both creating) — then commit."""
+        mops = op.get("value") or []
+        keys = sorted({int(k) for _, k, _ in mops})
+        blocks = " ".join(
+            "k%d(func: eq(key, %d)) { uid val }" % (k, k) for k in keys)
+        data, ts = self._txn_query("{ %s }" % blocks)
+        row = {k: (data.get("k%d" % k) or [None])[0] for k in keys}
+        out = []
+        last_write: dict = {}
+        for fm, k, val in mops:
+            k = int(k)
+            if fm == "r":
+                r = row.get(k)
+                out.append(["r", k, r.get("val") if r else None])
+            else:
+                last_write[k] = int(val)  # register: last write wins
+                row[k] = {"val": int(val)}  # later reads in-txn observe it
+                out.append(["w", k, int(val)])
+        if last_write:
+            if not ts:
+                raise DgraphError("no start_ts for txn")
+            wkeys = sorted(last_write)
+            bind = " ".join(
+                "w%d(func: eq(key, %d)) { u%d as uid }" % (k, k, k)
+                for k in wkeys)
+            txn = self._txn_mutate(ts, {
+                "query": "{ %s }" % bind,
+                "set": [{"uid": "uid(u%d)" % k, "key": k,
+                         "val": last_write[k]} for k in wkeys]})
+            self._txn_commit(ts, txn)
+        return {**op, "type": "ok", "value": out}
+
+    def _upsert(self, op):
+        """Conditional create (dgraph/upsert.clj): one upsert block
+        whose mutation is gated on the key being absent — two racers
+        both seeing absent and both creating is the duplicate-upsert
+        anomaly the checker hunts."""
+        k, uid = op.get("value")
+        self._mutate({
+            "query": "{ q(func: eq(ukey, %d)) { u as uid } }" % int(k),
+            "cond": "@if(eq(len(u), 0))",
+            "set": [{"ukey": int(k), "uval": int(uid)}]})
+        return {**op, "type": "ok"}
+
     def close(self, test):
         pass
 
@@ -214,7 +334,12 @@ class DgraphError(Exception):
     pass
 
 
-SUPPORTED_WORKLOADS = ("set", "register")
+class DgraphAborted(DgraphError):
+    """Server-side txn abort (commit 409): a definite failure."""
+
+
+SUPPORTED_WORKLOADS = ("set", "register", "bank", "wr", "long-fork",
+                       "upsert")
 
 
 def dgraph_test(opts_dict: dict | None = None) -> dict:

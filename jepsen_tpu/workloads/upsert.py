@@ -1,0 +1,63 @@
+"""Upsert-uniqueness workload (reference: dgraph/src/jepsen/dgraph/
+upsert.clj — concurrent conditional creates of the same key must yield
+at most ONE record: two racers both reading "absent" and both creating
+is the classic upsert anomaly).
+
+Op shapes:
+- ``{"f": "upsert", "value": [k, attempt_id]}`` — create key k if absent
+- ``{"f": "read-uids", "value": [k, uids]}`` — every record currently
+  holding key k
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def generator(key_rotation: int = 8, attempts_per_key: int = 6):
+    """Bursts of concurrent upserts on one key, then a read, then rotate
+    to the next key (upsert.clj drives ~n concurrent upserts per key)."""
+    lock = threading.Lock()
+    counter = itertools.count()
+    state = {"key": 0, "left": attempts_per_key}
+
+    def one(test, ctx):
+        with lock:
+            if state["left"] <= 0:
+                state["key"] += 1
+                state["left"] = attempts_per_key
+                return {"f": "read-uids", "value": [state["key"] - 1, None]}
+            state["left"] -= 1
+            return {"f": "upsert",
+                    "value": [state["key"], next(counter)]}
+
+    return gen.Fn(one)
+
+
+class UpsertChecker(Checker):
+    """Valid iff no read ever observes two records for one key
+    (upsert.clj's at-most-one invariant)."""
+
+    def check(self, test, history, opts):
+        dups = []
+        reads = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read-uids":
+                continue
+            reads += 1
+            k, uids = op.get("value")
+            if uids is not None and len(uids) > 1:
+                dups.append({"key": k, "uids": list(uids)})
+        return {"valid?": not dups, "read-count": reads,
+                "duplicate-count": len(dups), "duplicates": dups[:10]}
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "upsert-workload": True,  # fake-mode client dispatch marker
+        "generator": generator(),
+        "checker": UpsertChecker(),
+    }
