@@ -118,7 +118,9 @@ FIELD_DIGEST = 4
 OP_READ = 1
 OP_WRITE = 2
 OP_INCR = 5
+OP_APPEND = 9
 PARTICLE_INTEGER = 1
+PARTICLE_STRING = 3
 
 # result codes (aerospike server)
 RC_OK = 0
@@ -244,6 +246,24 @@ class AerospikeConnection:
         if rc != RC_OK:
             raise AerospikeError(rc)
         return True
+
+    def append(self, key: int, text: str, bin_name: str = "value") -> None:
+        """Server-side atomic string append (the set workload's
+        operate-append, aerospike/set.clj:35 s/append!)."""
+        ops = [_op(OP_APPEND, bin_name, text.encode(), PARTICLE_STRING)]
+        rc, _, _ = self._message(0, INFO2_WRITE, 0, ops, key)
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+
+    def get_string(self, key: int, bin_name: str = "value"):
+        """Reads one named bin as a string ('' when absent)."""
+        rc, _gen, data = self._message(INFO1_READ, 0, 0,
+                                       [_op(OP_READ, bin_name)], key)
+        if rc == RC_KEY_NOT_FOUND:
+            return ""
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+        return data.decode(errors="replace")
 
     def incr(self, key: int, delta: int, bin_name: str = "value") -> None:
         """Server-side atomic integer add (the counter workload's

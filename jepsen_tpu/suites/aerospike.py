@@ -160,6 +160,15 @@ class AerospikeClient(Client):
             if test.get("counter") and f == "read" and v is None:
                 value, _gen = self.conn.get(0)
                 return {**op, "type": "ok", "value": int(value or 0)}
+            if f == "add":
+                # set adds append ' v' to one record's string bin — the
+                # reference's CAS-op set shape (aerospike/set.clj:35)
+                self.conn.append(0, f" {int(v)}")
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                raw = self.conn.get_string(0)
+                return {**op, "type": "ok",
+                        "value": sorted(int(x) for x in raw.split() if x)}
             if f == "read":
                 k, _ = v
                 value, _gen = self.conn.get(int(k))
@@ -190,7 +199,7 @@ class AerospikeClient(Client):
             self.conn.close()
 
 
-SUPPORTED_WORKLOADS = ("register", "counter")
+SUPPORTED_WORKLOADS = ("register", "counter", "set")
 
 
 def aerospike_test(opts_dict: dict | None = None) -> dict:

@@ -490,3 +490,47 @@ def test_counter_checker_bounds():
     out = chk.counter().check({}, history, {})
     assert out["valid?"] is False
     assert out["reads-checked"] == 2
+
+
+def test_aerospike_append_and_string_read():
+    """The set workload's wire ops: atomic string append + string get
+    (aerospike/set.clj CAS-op set shape)."""
+    received = []
+
+    def server(conn):
+        raw = " 3 5".encode()
+        for reply_payload in (
+                # append reply: header-only, rc=0
+                struct.pack(">BBBBBBIIIHH", 22, 0, 0, 0, 0, 0, 1, 0, 0,
+                            0, 0),
+                # string get reply: one op with string particle
+                struct.pack(">BBBBBBIIIHH", 22, 0, 0, 0, 0, 0, 1, 0, 0,
+                            0, 1)
+                + struct.pack(">IBBBB", 4 + 5 + len(raw), 1, 3, 0, 5)
+                + b"value" + raw):
+            header = conn.recv(8)
+            size = struct.unpack(">Q", header)[0] & 0xFFFFFFFFFFFF
+            buf = b""
+            while len(buf) < size:
+                buf += conn.recv(size - len(buf))
+            received.append(buf)
+            out = struct.pack(">Q", (2 << 56) | (3 << 48)
+                              | len(reply_payload)) + reply_payload
+            conn.sendall(out)
+
+    port = serve_once(server)
+    c = aerospike.AerospikeConnection(
+        "127.0.0.1", port, namespace="jepsen", set_name="elements")
+    c.append(0, " 5")
+    assert c.get_string(0) == " 3 5"
+    c.close()
+    # the append op rode the wire with the string particle payload
+    assert b" 5" in received[0]
+
+
+def test_aerospike_fake_set_run():
+    from conftest import run_fake
+    from jepsen_tpu.suites.aerospike import aerospike_test
+
+    result = run_fake(aerospike_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
