@@ -145,6 +145,7 @@ class KVStore(MetaLogDB):
         self.ddl_next = 0
         self.cmt: dict = {}        # comments workload: key -> set of ids
         self.tables: set = set()   # table workload: created table ids
+        self.lu: dict = {}         # lost-updates workload: key -> set
 
     def _wipe(self):
         self.registers.clear()
@@ -161,6 +162,7 @@ class KVStore(MetaLogDB):
         self.ddl_next = 0
         self.cmt.clear()
         self.tables.clear()
+        self.lu.clear()
 
     def read(self, k):
         with self.lock:
@@ -266,6 +268,16 @@ class KVStore(MetaLogDB):
         with self.lock:
             u = self.registers.get(("__upsert__", k))
             return [u] if u is not None else []
+
+    # lost-updates workload: per-key element sets (the fake applies
+    # adds atomically, so no update is ever lost)
+    def lu_add(self, k, el) -> None:
+        with self.lock:
+            self.lu.setdefault(k, set()).add(el)
+
+    def lu_read(self, k) -> list:
+        with self.lock:
+            return sorted(self.lu.get(k, ()))
 
     # comments workload: per-key visible-id sets
     def cmt_write(self, k, i) -> None:
@@ -454,6 +466,15 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.upsert_read(k)]}
+        if test.get("lost-updates"):
+            if f == "add":
+                k, el = v
+                self.db.lu_add(k, el)
+                return {**op, "type": "ok"}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self.db.lu_read(k)]}
         if test.get("comments"):
             if f == "write":
                 k, i = v

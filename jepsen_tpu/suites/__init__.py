@@ -249,10 +249,11 @@ def workload_registry() -> dict[str, Callable]:
     from jepsen_tpu.workloads import (adya, append, bank, causal,
                                       causal_reverse, comments, counter,
                                       default_value, dirty_reads, long_fork,
-                                      monotonic, multi_key_acid, mutex,
-                                      queue_workload, register, sequential,
-                                      set_workload, single_key_acid,
-                                      table_workload, upsert, wr)
+                                      lost_updates, monotonic,
+                                      multi_key_acid, mutex, queue_workload,
+                                      register, sequential, set_workload,
+                                      single_key_acid, table_workload,
+                                      upsert, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -275,4 +276,5 @@ def workload_registry() -> dict[str, Callable]:
         "comments": comments.workload,
         "table": table_workload.workload,
         "upsert": upsert.workload,
+        "lost-updates": lost_updates.workload,
     }

@@ -105,10 +105,23 @@ class CrateClient(Client):
         self._sql("CREATE TABLE IF NOT EXISTS sets "
                   "(id INT PRIMARY KEY) "
                   "CLUSTERED INTO 5 SHARDS WITH (number_of_replicas = 2)")
+        self._sql("CREATE TABLE IF NOT EXISTS lu "
+                  "(id INT PRIMARY KEY, elements ARRAY(INT)) "
+                  "CLUSTERED INTO 5 SHARDS WITH (number_of_replicas = 2)")
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("lost-updates") and f == "add":
+                return self._lu_add(op)
+            if test.get("lost-updates") and f == "read":
+                k, _ = v
+                self._sql("REFRESH TABLE lu")
+                res = self._sql("SELECT elements FROM lu WHERE id = ?",
+                                [int(k)])
+                rows = res.get("rows") or []
+                els = sorted(rows[0][0]) if rows and rows[0][0] else []
+                return {**op, "type": "ok", "value": [k, els]}
             if f == "add":
                 self._sql("INSERT INTO sets (id) VALUES (?)", [v])
                 return {**op, "type": "ok"}
@@ -149,11 +162,51 @@ class CrateClient(Client):
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
 
+    def _lu_add(self, op):
+        """Read-modify-write under crate's optimistic _version guard
+        (lost_updates.clj): append the element to the key's list only if
+        the row hasn't changed since the read; retry conflicts, and fail
+        definitively when retries exhaust — a lost ACKED add is the
+        anomaly, so an unacked add must never linger as ok."""
+        k, el = op.get("value")
+        k, el = int(k), int(el)
+        ambiguous = False
+        for _ in range(5):
+            self._sql("REFRESH TABLE lu")
+            res = self._sql(
+                "SELECT elements, _version FROM lu WHERE id = ?", [k])
+            rows = res.get("rows") or []
+            if not rows:
+                try:
+                    ins = self._sql(
+                        "INSERT INTO lu (id, elements) VALUES (?, ?)",
+                        [k, [el]])
+                    if ins.get("rowcount", 0) == 1:
+                        return {**op, "type": "ok"}
+                except urllib.error.HTTPError as e:
+                    # 409 = raced another first insert (definitely not
+                    # ours); anything else may have applied server-side
+                    if e.code != 409:
+                        ambiguous = True
+                continue
+            elements, version = rows[0]
+            upd = self._sql(
+                "UPDATE lu SET elements = ? WHERE id = ? AND _version = ?",
+                [list(elements or []) + [el], k, int(version)])
+            if upd.get("rowcount", 0) == 1:
+                return {**op, "type": "ok"}
+        if ambiguous:
+            # an insert attempt may have landed: the op is indeterminate,
+            # a definite fail here would turn a surviving element into a
+            # false anomaly under fail-semantics checkers
+            return {**op, "type": "info", "error": ["ambiguous-insert", k, el]}
+        return {**op, "type": "fail", "error": ["version-conflict", k, el]}
+
     def close(self, test):
         pass
 
 
-SUPPORTED_WORKLOADS = ("register", "set")
+SUPPORTED_WORKLOADS = ("register", "set", "lost-updates")
 
 
 def crate_test(opts_dict: dict | None = None) -> dict:
