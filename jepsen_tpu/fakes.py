@@ -269,6 +269,18 @@ class KVStore(MetaLogDB):
             u = self.registers.get(("__upsert__", k))
             return [u] if u is not None else []
 
+    # version-divergence workload: per-key (value, version) rows — the
+    # fake's versions advance atomically, so no version ever diverges
+    def vd_write(self, k, val) -> None:
+        with self.lock:
+            _v, ver = self.registers.get(("__vd__", k), (None, 0))
+            self.registers[("__vd__", k)] = (val, ver + 1)
+
+    def vd_read(self, k) -> list:
+        with self.lock:
+            val, ver = self.registers.get(("__vd__", k), (None, None))
+            return [val, ver]
+
     # lost-updates workload: per-key element sets (the fake applies
     # adds atomically, so no update is ever lost)
     def lu_add(self, k, el) -> None:
@@ -466,6 +478,15 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.upsert_read(k)]}
+        if test.get("version-divergence"):
+            if f == "write":
+                k, val = v
+                self.db.vd_write(k, val)
+                return {**op, "type": "ok"}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self.db.vd_read(k)]}
         if test.get("lost-updates"):
             if f == "add":
                 k, el = v

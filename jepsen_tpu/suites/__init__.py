@@ -253,7 +253,7 @@ def workload_registry() -> dict[str, Callable]:
                                       multi_key_acid, mutex, queue_workload,
                                       register, sequential, set_workload,
                                       single_key_acid, table_workload,
-                                      upsert, wr)
+                                      upsert, version_divergence, wr)
     return {
         "register": register.workload,
         "set": set_workload.workload,
@@ -277,4 +277,5 @@ def workload_registry() -> dict[str, Callable]:
         "table": table_workload.workload,
         "upsert": upsert.workload,
         "lost-updates": lost_updates.workload,
+        "version-divergence": version_divergence.workload,
     }

@@ -112,6 +112,24 @@ class CrateClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("version-divergence") and f == "read":
+                k, _ = v
+                res = self._sql(
+                    "SELECT val, _version FROM registers WHERE id = ?",
+                    [int(k)])
+                rows = res.get("rows") or []
+                pair = ([rows[0][0], rows[0][1]] if rows
+                        else [None, None])
+                return {**op, "type": "ok", "value": [k, pair]}
+            if test.get("version-divergence") and f == "write":
+                k, val = v
+                # blind upsert: the store advances _version per write
+                # (version_divergence.clj's on-duplicate-key insert)
+                self._sql(
+                    "INSERT INTO registers (id, val) VALUES (?, ?) "
+                    "ON CONFLICT (id) DO UPDATE SET val = excluded.val",
+                    [int(k), int(val)])
+                return {**op, "type": "ok"}
             if test.get("lost-updates") and f == "add":
                 return self._lu_add(op)
             if test.get("lost-updates") and f == "read":
@@ -206,7 +224,8 @@ class CrateClient(Client):
         pass
 
 
-SUPPORTED_WORKLOADS = ("register", "set", "lost-updates")
+SUPPORTED_WORKLOADS = ("register", "set", "lost-updates",
+                       "version-divergence")
 
 
 def crate_test(opts_dict: dict | None = None) -> dict:

@@ -1,0 +1,72 @@
+"""Version-divergence workload (reference:
+crate/src/jepsen/crate/version_divergence.clj — writes a stream of
+unique integers to per-key rows while faults run; every observed
+``_version`` of a row must identify exactly ONE value, so two reads
+seeing different values at the same version prove the replicas diverged
+under one version number).
+
+Op shapes (independent-lifted [k, v] values):
+- ``{"f": "write", "value": [k, unique_int]}``
+- ``{"f": "read",  "value": [k, [value, version]]}`` — value+row-version
+  as the store reports them (None when the row doesn't exist yet)
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import Checker
+
+
+def generator(n_groups: int = 5, per_key_limit: int = 60):
+    lock = threading.Lock()
+    counter = itertools.count()
+
+    def write(test, ctx):
+        with lock:
+            return {"f": "write", "value": next(counter)}
+
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    def key_gen(k):
+        return gen.limit(per_key_limit,
+                         gen.mix([gen.Fn(read), gen.Fn(write)]))
+
+    return independent.concurrent_generator(n_groups, itertools.count(),
+                                            key_gen)
+
+
+class VersionDivergenceChecker(Checker):
+    """Groups ok reads by row version: a version carrying two distinct
+    values is divergence (version_divergence.clj:97-108)."""
+
+    def check(self, test, history, opts):
+        by_version: dict = {}
+        reads = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            val = op.get("value")
+            if not val or val[1] is None:
+                continue  # row absent: no version to judge
+            reads += 1
+            v, version = val
+            by_version.setdefault(version, set()).add(v)
+        multis = {ver: sorted(vals) for ver, vals in by_version.items()
+                  if len(vals) > 1}
+        return {"valid?": not multis, "read-count": reads,
+                "divergent-count": len(multis),
+                "multis": dict(itertools.islice(multis.items(), 10))}
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    test = test or {}
+    n = len(test.get("nodes") or []) or 5
+    return {
+        "version-divergence": True,  # client dispatch marker
+        "generator": generator(n_groups=n),
+        "checker": independent.checker(VersionDivergenceChecker()),
+    }

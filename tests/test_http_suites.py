@@ -623,3 +623,57 @@ def test_crate_fake_lost_updates_run():
     assert not any(v.get("valid?") is False for v in per_key.values()), wl
     proven = sum(1 for v in per_key.values() if v.get("valid?") is True)
     assert proven >= 3, wl
+
+
+def test_version_divergence_checker_and_crate_bodies():
+    """A version mapping to two distinct values is divergence
+    (crate/version_divergence.clj:97-108); the crate client reads
+    val+_version pairs and blind-upserts writes."""
+    from jepsen_tpu.workloads.version_divergence import (
+        VersionDivergenceChecker)
+
+    ok = [{"type": "ok", "f": "read", "value": [7, 3]},
+          {"type": "ok", "f": "read", "value": [7, 3]},
+          {"type": "ok", "f": "read", "value": [9, 4]},
+          {"type": "ok", "f": "read", "value": [None, None]}]
+    out = VersionDivergenceChecker().check({}, ok, {})
+    assert out["valid?"] is True and out["read-count"] == 3
+    bad = ok + [{"type": "ok", "f": "read", "value": [8, 3]}]
+    out = VersionDivergenceChecker().check({}, bad, {})
+    assert out["valid?"] is False and out["divergent-count"] == 1
+    assert out["multis"][3] == [7, 8]
+
+    def fn(method, path, body):
+        req = json.loads(body.decode())
+        if req["stmt"].startswith("SELECT val, _version"):
+            return 200, {"rows": [[5, 12]]}
+        if req["stmt"].startswith("INSERT INTO registers"):
+            return 200, {"rowcount": 1}
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.crate as cr
+        old_port = cr.PORT
+        cr.PORT = srv.port
+        try:
+            c = cr.CrateClient(node="127.0.0.1")
+            t = {"version-divergence": True}
+            out = c.invoke(t, {"type": "invoke", "f": "read",
+                               "value": [2, None]})
+            assert out["type"] == "ok" and out["value"] == [2, [5, 12]]
+            out = c.invoke(t, {"type": "invoke", "f": "write",
+                               "value": [2, 44]})
+            assert out["type"] == "ok"
+        finally:
+            cr.PORT = old_port
+    finally:
+        srv.stop()
+
+
+def test_crate_fake_version_divergence_run():
+    from conftest import run_fake
+    from jepsen_tpu.suites.crate import crate_test
+
+    result = run_fake(crate_test, workload="version-divergence")
+    assert result["results"]["valid?"] is True, result["results"]
