@@ -187,6 +187,10 @@ class KVStore(MetaLogDB):
         with self.lock:
             return sorted(self.elements)
 
+    def set_read_raw(self) -> set:
+        with self.lock:
+            return set(self.elements)
+
     def txn(self, micro_ops, style: str = "append") -> list:
         """Atomically applies a txn of [f, k, v] micro-ops. ``style``
         picks what a read returns: "append" (the per-key list, Elle
@@ -478,6 +482,19 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.upsert_read(k)]}
+        if test.get("dirty-read"):
+            if f == "write":
+                self.db.add(("__dr__", v))
+                return {**op, "type": "ok"}
+            if f == "read" and v is not None:
+                present = ("__dr__", v) in self.db.set_read_raw()
+                return {**op, "type": "ok" if present else "fail"}
+            if f == "refresh":
+                return {**op, "type": "ok"}
+            if f == "strong-read":
+                els = [x[1] for x in self.db.set_read_raw()
+                       if isinstance(x, tuple) and x[0] == "__dr__"]
+                return {**op, "type": "ok", "value": sorted(els)}
         if test.get("version-divergence"):
             if f == "write":
                 k, val = v

@@ -248,7 +248,8 @@ def workload_registry() -> dict[str, Callable]:
     (yugabyte/core.clj:74-118 pattern)."""
     from jepsen_tpu.workloads import (adya, append, bank, causal,
                                       causal_reverse, comments, counter,
-                                      default_value, dirty_reads, long_fork,
+                                      default_value, dirty_read,
+                                      dirty_reads, long_fork,
                                       lost_updates, monotonic,
                                       multi_key_acid, mutex, queue_workload,
                                       register, sequential, set_workload,
@@ -278,4 +279,5 @@ def workload_registry() -> dict[str, Callable]:
         "upsert": upsert.workload,
         "lost-updates": lost_updates.workload,
         "version-divergence": version_divergence.workload,
+        "dirty-read": dirty_read.workload,
     }

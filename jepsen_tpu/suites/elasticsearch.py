@@ -115,6 +115,10 @@ class ElasticsearchClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("dirty-read"):
+                out = self._dirty_read_op(op, f, v)
+                if out is not None:
+                    return out
             if f == "add":
                 http_json(self._url(f"{INDEX}-set/_doc/{quote(v)}"
                                     "?wait_for_active_shards=all"),
@@ -122,25 +126,12 @@ class ElasticsearchClient(Client):
                 return {**op, "type": "ok"}
             if f == "read" and v is None:
                 # final read: explicit refresh first (sets.clj pattern),
-                # then page the full set via sorted search_after — a
-                # size-capped single search silently truncates >10k
-                # elements into false "lost" verdicts
+                # then page the full set — a size-capped single search
+                # silently truncates >10k elements into false "lost"
                 http_json(self._url(f"{INDEX}-set/_refresh"), method="POST",
                           timeout_s=self.timeout_s)
-                elems: list = []
-                after = None
-                while True:
-                    body = {"size": 10000, "query": {"match_all": {}},
-                            "sort": [{"v": "asc"}]}
-                    if after is not None:
-                        body["search_after"] = after
-                    res = http_json(self._url(f"{INDEX}-set/_search"),
-                                    body, timeout_s=self.timeout_s)
-                    hits = res["hits"]["hits"]
-                    elems.extend(h["_source"]["v"] for h in hits)
-                    if len(hits) < 10000:
-                        return {**op, "type": "ok", "value": elems}
-                    after = hits[-1]["sort"]
+                return {**op, "type": "ok",
+                        "value": self._paged_search(f"{INDEX}-set")}
             if f == "read":
                 k, _ = v
                 value, _s, _t = self._get_doc(k)
@@ -174,11 +165,59 @@ class ElasticsearchClient(Client):
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
 
+    def _dirty_read_op(self, op, f, v):
+        """The dirty-read probe's op surface (dirty_read.clj:52-104):
+        unique-doc writes, point reads (absent => fail, not an anomaly),
+        an explicit refresh, and paged strong reads."""
+        if f == "write":
+            http_json(self._url(f"{INDEX}-dr/_doc/{int(v)}"),
+                      {"v": int(v)}, method="PUT",
+                      timeout_s=self.timeout_s)
+            return {**op, "type": "ok"}
+        if f == "read" and v is not None:
+            try:
+                doc = http_json(self._url(f"{INDEX}-dr/_doc/{int(v)}"),
+                                timeout_s=self.timeout_s)
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return {**op, "type": "fail", "error": ["not-found"]}
+                raise
+            if not doc.get("found"):
+                return {**op, "type": "fail", "error": ["not-found"]}
+            return {**op, "type": "ok"}
+        if f == "refresh":
+            http_json(self._url(f"{INDEX}-dr/_refresh"), method="POST",
+                      timeout_s=self.timeout_s)
+            return {**op, "type": "ok"}
+        if f == "strong-read":
+            return {**op, "type": "ok",
+                    "value": self._paged_search(f"{INDEX}-dr")}
+        return None
+
+    def _paged_search(self, index: str) -> list:
+        """The whole index via sorted search_after pages (one shared
+        pagination for the set final read and the dirty-read probe's
+        strong reads)."""
+        elems: list = []
+        after = None
+        while True:
+            body = {"size": 10000, "query": {"match_all": {}},
+                    "sort": [{"v": "asc"}]}
+            if after is not None:
+                body["search_after"] = after
+            res = http_json(self._url(f"{index}/_search"),
+                            body, timeout_s=self.timeout_s)
+            hits = res["hits"]["hits"]
+            elems.extend(h["_source"]["v"] for h in hits)
+            if len(hits) < 10000:
+                return elems
+            after = hits[-1]["sort"]
+
     def close(self, test):
         pass
 
 
-SUPPORTED_WORKLOADS = ("set", "register")
+SUPPORTED_WORKLOADS = ("set", "register", "dirty-read")
 
 
 def elasticsearch_test(opts_dict: dict | None = None) -> dict:

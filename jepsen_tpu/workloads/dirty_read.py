@@ -1,0 +1,101 @@
+"""Dirty-read workload (reference:
+elasticsearch/src/jepsen/elasticsearch/dirty_read.clj — hunts reads of
+documents that never became durable: any id observed by a point read
+but absent from every node's final strong read was a dirty read, and
+any acknowledged write absent from the final reads was lost).
+
+Op shapes:
+- ``{"f": "write", "value": id}`` — index a unique document
+- ``{"f": "read", "value": id}`` — point-read that id; found → ok,
+  absent → fail (not an anomaly by itself)
+- ``{"f": "refresh"}`` — force visibility before the final phase
+- ``{"f": "strong-read", "value": [ids...]}`` — one full read per
+  thread in the final phase
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+def generator():
+    lock = threading.Lock()
+    counter = itertools.count()
+    issued = [0]
+
+    def write(test, ctx):
+        with lock:
+            v = next(counter)
+            issued[0] = v + 1
+            return {"f": "write", "value": v}
+
+    def read(test, ctx):
+        with lock:
+            hi = issued[0]
+        if hi == 0:
+            return {"f": "write", "value": 0}
+        return {"f": "read", "value": ctx.rng.randrange(hi)}
+
+    return gen.mix([gen.Fn(write), gen.Fn(read)])
+
+
+def final_generator():
+    # phases BARRIERS between the refresh and the strong reads — Seq
+    # would hand out strong-reads while the refresh is still in flight,
+    # and pre-refresh reads would see a smaller index and fabricate
+    # node disagreement
+    return gen.phases(
+        gen.once(gen.Fn(lambda test, ctx: {"f": "refresh", "value": None})),
+        gen.each_thread(gen.once(gen.Fn(
+            lambda test, ctx: {"f": "strong-read", "value": None}))),
+    )
+
+
+class DirtyReadChecker(Checker):
+    """dirty = point-read ids no strong read ever saw; lost = acked
+    writes no strong read ever saw; nodes agree when every strong read
+    returned the same set (dirty_read.clj:106-150)."""
+
+    def check(self, test, history, opts):
+        writes, reads, strong = set(), set(), []
+        for op in history:
+            if op.get("type") != "ok":
+                continue
+            f = op.get("f")
+            if f == "write":
+                writes.add(op.get("value"))
+            elif f == "read":
+                reads.add(op.get("value"))
+            elif f == "strong-read":
+                strong.append(set(op.get("value") or ()))
+        if not strong:
+            return {"valid?": "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = reads - on_some
+        lost = writes - on_some
+        # node disagreement is REPORTED, not a validity condition: an
+        # indeterminate write landing between two strong reads is benign
+        # visibility skew, while dirty/lost elements are real anomalies
+        return {
+            "valid?": not dirty and not lost,
+            "nodes-agree?": on_all == on_some,
+            "read-count": len(reads),
+            "write-count": len(writes),
+            "strong-read-count": len(strong),
+            "dirty-count": len(dirty), "dirty": sorted(dirty)[:10],
+            "lost-count": len(lost), "lost": sorted(lost)[:10],
+            "not-on-all-count": len(on_some - on_all),
+        }
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "dirty-read": True,  # client dispatch marker
+        "generator": generator(),
+        "final_generator": final_generator(),
+        "checker": DirtyReadChecker(),
+    }

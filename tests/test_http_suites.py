@@ -677,3 +677,92 @@ def test_crate_fake_version_divergence_run():
 
     result = run_fake(crate_test, workload="version-divergence")
     assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_dirty_read_checker_semantics():
+    """dirty = point-read ids absent from every strong read; lost =
+    acked writes absent; node disagreement invalidates
+    (elasticsearch/dirty_read.clj:106-150)."""
+    from jepsen_tpu.workloads.dirty_read import DirtyReadChecker
+
+    def h(reads, writes, strongs):
+        out = []
+        for w in writes:
+            out.append({"type": "ok", "f": "write", "value": w})
+        for r in reads:
+            out.append({"type": "ok", "f": "read", "value": r})
+        for s in strongs:
+            out.append({"type": "ok", "f": "strong-read", "value": s})
+        return out
+
+    ok = DirtyReadChecker().check(
+        {}, h([1, 2], [1, 2, 3], [[1, 2, 3], [1, 2, 3]]), {})
+    assert ok["valid?"] is True
+    dirty = DirtyReadChecker().check(
+        {}, h([9], [1], [[1], [1]]), {})
+    assert dirty["valid?"] is False and dirty["dirty"] == [9]
+    lost = DirtyReadChecker().check(
+        {}, h([], [1, 2], [[1], [1]]), {})
+    assert lost["valid?"] is False and lost["lost"] == [2]
+    # node disagreement is reported but not a validity condition (an
+    # indeterminate write landing between strong reads is benign skew)
+    split = DirtyReadChecker().check(
+        {}, h([], [1, 2], [[1, 2], [1]]), {})
+    assert split["valid?"] is True and split["nodes-agree?"] is False
+    assert split["not-on-all-count"] == 1
+    none = DirtyReadChecker().check({}, h([1], [1], []), {})
+    assert none["valid?"] == "unknown"
+
+
+def test_elasticsearch_dirty_read_client_bodies():
+    docs = {}
+
+    def fn(method, path, body):
+        if "_doc/" in path and method == "PUT":
+            docs[int(path.rsplit("/", 1)[1])] = True
+            return 200, {"result": "created"}
+        if "_doc/" in path and method == "GET":
+            v = int(path.rsplit("/", 1)[1])
+            if v in docs:
+                return 200, {"found": True, "_source": {"v": v}}
+            return 404, {"found": False}
+        if path.endswith("_refresh"):
+            return 200, {}
+        if path.endswith("_search"):
+            hits = [{"_source": {"v": v}, "sort": [v]}
+                    for v in sorted(docs)]
+            return 200, {"hits": {"hits": hits}}
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.elasticsearch as es
+        c = es.ElasticsearchClient(node="127.0.0.1")
+        old = es.PORT
+        es.PORT = srv.port
+        try:
+            t = {"dirty-read": True}
+            assert c.invoke(t, {"type": "invoke", "f": "write",
+                                "value": 3})["type"] == "ok"
+            assert c.invoke(t, {"type": "invoke", "f": "read",
+                                "value": 3})["type"] == "ok"
+            out = c.invoke(t, {"type": "invoke", "f": "read", "value": 9})
+            assert out["type"] == "fail" and out["error"] == ["not-found"]
+            assert c.invoke(t, {"type": "invoke", "f": "refresh",
+                                "value": None})["type"] == "ok"
+            out = c.invoke(t, {"type": "invoke", "f": "strong-read",
+                               "value": None})
+            assert out["type"] == "ok" and out["value"] == [3]
+        finally:
+            es.PORT = old
+    finally:
+        srv.stop()
+
+
+def test_elasticsearch_fake_dirty_read_run():
+    from conftest import run_fake
+    from jepsen_tpu.suites.elasticsearch import elasticsearch_test
+
+    result = run_fake(elasticsearch_test, workload="dirty-read")
+    assert result["results"]["workload"]["valid?"] is True, (
+        result["results"])
