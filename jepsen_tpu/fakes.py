@@ -191,6 +191,10 @@ class KVStore(MetaLogDB):
         with self.lock:
             return set(self.elements)
 
+    def contains(self, elem) -> bool:
+        with self.lock:
+            return elem in self.elements
+
     def txn(self, micro_ops, style: str = "append") -> list:
         """Atomically applies a txn of [f, k, v] micro-ops. ``style``
         picks what a read returns: "append" (the per-key list, Elle
@@ -487,7 +491,7 @@ class KVClient(MetaLogClient):
                 self.db.add(("__dr__", v))
                 return {**op, "type": "ok"}
             if f == "read" and v is not None:
-                present = ("__dr__", v) in self.db.set_read_raw()
+                present = self.db.contains(("__dr__", v))
                 return {**op, "type": "ok" if present else "fail"}
             if f == "refresh":
                 return {**op, "type": "ok"}

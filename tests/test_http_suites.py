@@ -681,8 +681,9 @@ def test_crate_fake_version_divergence_run():
 
 def test_dirty_read_checker_semantics():
     """dirty = point-read ids absent from every strong read; lost =
-    acked writes absent; node disagreement invalidates
-    (elasticsearch/dirty_read.clj:106-150)."""
+    acked writes absent; node disagreement is reported but does not
+    invalidate (elasticsearch/dirty_read.clj:106-150 semantics with
+    benign visibility skew tolerated)."""
     from jepsen_tpu.workloads.dirty_read import DirtyReadChecker
 
     def h(reads, writes, strongs):
