@@ -138,8 +138,37 @@ class HazelcastClient(Client):
             return None
         return raw
 
+    def _map_url(self, k) -> str:
+        return (f"http://{self.node}:{PORT}/hazelcast/rest/maps/"
+                f"jepsen/{quote(str(k))}")
+
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
+        if f in ("read", "write") and isinstance(v, (list, tuple)):
+            # map workload: IMap get/put over the REST map endpoint (the
+            # REST surface has no CAS, so the r/w register subset runs)
+            try:
+                if f == "read":
+                    k, _ = v
+                    raw = http_json(self._map_url(k),
+                                    timeout_s=self.timeout_s)
+                    val = int(raw) if raw not in (None, "") else None
+                    return {**op, "type": "ok", "value": [k, val]}
+                k, val = v
+                http_json(self._map_url(k), method="POST",
+                          raw_body=str(int(val)).encode(),
+                          headers={"Content-Type": "text/plain"},
+                          timeout_s=self.timeout_s)
+                return {**op, "type": "ok"}
+            except urllib.error.HTTPError as e:
+                # HTTPError subclasses URLError: catch it FIRST or HTTP
+                # failures masquerade as network errors (the queue
+                # branch's ordering)
+                kind = "fail" if f == "read" else "info"
+                return {**op, "type": kind, "error": ["http", e.code]}
+            except NET_ERRORS as e:
+                kind = "fail" if f == "read" else "info"
+                return {**op, "type": kind, "error": ["net", str(e)]}
         drained: list = []
         try:
             if f == "enqueue":
@@ -177,13 +206,27 @@ class HazelcastClient(Client):
         pass
 
 
-SUPPORTED_WORKLOADS = ("queue",)
+SUPPORTED_WORKLOADS = ("queue", "map")
+
+
+def _hazelcast_workload(name: str, base: dict) -> dict:
+    """map = the r/w register subset (the REST map API exposes get/put
+    but no CAS; hazelcast.clj's richer map workloads ride the native
+    client protocol — see PARITY's protocol-bounded scope note)."""
+    if name == "map":
+        from jepsen_tpu.workloads import register as register_wl
+        return register_wl.workload(base, accelerator=base["accelerator"],
+                                    ops=("r", "w"))
+    from jepsen_tpu.suites import workload_registry
+
+    return workload_registry()[name](base, accelerator=base["accelerator"])
 
 
 def hazelcast_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="hazelcast",
         supported_workloads=SUPPORTED_WORKLOADS,
+        make_workload=_hazelcast_workload,
         make_real=lambda o: {
             "db": HazelcastDB(o.get("version", DEFAULT_VERSION)),
             "client": HazelcastClient(), "os": Debian()})

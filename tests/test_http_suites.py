@@ -767,3 +767,50 @@ def test_elasticsearch_fake_dirty_read_run():
     result = run_fake(elasticsearch_test, workload="dirty-read")
     assert result["results"]["workload"]["valid?"] is True, (
         result["results"])
+
+
+def test_hazelcast_map_workload_rw_register():
+    """The map workload runs the r/w register subset over the REST map
+    endpoint (no CAS on that surface)."""
+    store = {}
+
+    def fn(method, path, body):
+        k = path.rsplit("/", 1)[1]
+        if method == "POST":
+            store[k] = body.decode()
+            return 200, ""
+        if method == "GET":
+            if k in store:
+                return 200, store[k]
+            return 204, ""
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.hazelcast as hz
+        c = hz.HazelcastClient(node="127.0.0.1")
+        old = hz.PORT
+        hz.PORT = srv.port
+        try:
+            out = c.invoke({}, {"type": "invoke", "f": "read",
+                                "value": [3, None]})
+            assert out["type"] == "ok" and out["value"] == [3, None]
+            assert c.invoke({}, {"type": "invoke", "f": "write",
+                                 "value": [3, 7]})["type"] == "ok"
+            out = c.invoke({}, {"type": "invoke", "f": "read",
+                                "value": [3, None]})
+            assert out["value"] == [3, 7]
+        finally:
+            hz.PORT = old
+    finally:
+        srv.stop()
+
+
+def test_hazelcast_fake_map_run():
+    from conftest import run_fake
+    from jepsen_tpu.suites.hazelcast import hazelcast_test
+
+    result = run_fake(hazelcast_test, workload="map")
+    assert result["results"]["valid?"] is True, result["results"]
+    # the r/w subset must never emit cas
+    assert not any(op.get("f") == "cas" for op in result["history"])
