@@ -1,0 +1,86 @@
+"""Extended differential fuzzes — the long-running confidence harness
+behind the fast CI fuzzes. Run with ``-m deepfuzz``; the default suite
+excludes the marker via pyproject's addopts filter.
+
+Three nets, each pinning a production fast path to its exact oracle on
+hundreds of randomized histories:
+
+* the Elle φ-cluster/columnar path vs the trim+Tarjan cpu pipeline,
+  across three consistency-model configurations,
+* segmented event-scan verification (frontier carry) vs monolithic runs
+  at random cut sizes,
+* the transfer-matrix operator-product chain vs monolithic matrix runs.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.deepfuzz
+
+
+def test_elle_production_vs_oracle_many():
+    from tests.test_elle import _interleaved_history, _messy_history
+    from jepsen_tpu.elle import list_append
+
+    rng = random.Random(20260731)
+    for i in range(300):
+        if i % 2 == 0:
+            h = _interleaved_history(rng, n_txns=rng.randrange(40, 200),
+                                     n_keys=rng.randrange(2, 6),
+                                     corrupt=rng.randrange(5))
+        else:
+            h = _messy_history(rng, n_txns=rng.randrange(30, 120))
+        for models in (("strict-serializable",), ("serializable",),
+                       ("snapshot-isolation",)):
+            a = list_append.check(h, accelerator="auto",
+                                  consistency_models=models)
+            c = list_append.check(h, accelerator="cpu",
+                                  consistency_models=models)
+            assert (a["valid?"], a["anomaly-types"]) == \
+                (c["valid?"], c["anomaly-types"]), (i, models)
+
+
+def test_segmented_paths_vs_monolithic_many():
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import (JitLinKernel, _slice_stream,
+                                       matrix_check, matrix_check_resume,
+                                       quiescent_cuts, segmented_check)
+
+    rng = random.Random(42)
+    k = JitLinKernel()
+    for trial in range(60):
+        n = rng.randrange(100, 700)
+        stream = encode_register_ops(_register_history(
+            n, n_procs=rng.randrange(2, 6), seed=trial, n_values=5))
+        if rng.random() < 0.5:
+            a = np.asarray(stream.a).copy()
+            reads = np.nonzero((np.asarray(stream.kind) == 0)
+                               & (np.asarray(stream.f) == 0))[0]
+            for r in rng.sample(list(reads), min(5, len(reads))):
+                a[r] = rng.randrange(1, 6)
+            stream = replace(stream, a=a)
+
+        whole = k.check(stream)
+        seg = segmented_check(
+            stream, max_segment=rng.choice([32, 64, 128, 256]), kernel=k)
+        assert bool(seg[0]) == bool(whole[0]), trial
+
+        m_whole = matrix_check(stream, force=True)
+        cuts = quiescent_cuts(np.asarray(stream.kind),
+                              rng.choice([64, 128, 256]))
+        tot, alive, base = None, True, 0
+        for end in cuts:
+            a2, ix, tot = matrix_check_resume(
+                _slice_stream(stream, base, end), tot,
+                n_slots=stream.n_slots)
+            assert not bool(np.asarray(ix).any())
+            alive = bool(np.asarray(a2).all())
+            if not alive:
+                break
+            base = end
+        assert alive == bool(m_whole[0]), trial
