@@ -289,6 +289,15 @@ class KVStore(MetaLogDB):
             val, ver = self.registers.get(("__vd__", k), (None, None))
             return [val, ver]
 
+    # pages workload: per-key element groups appended atomically
+    def pages_add(self, k, group) -> None:
+        with self.lock:
+            self.lists.setdefault(("__pages__", k), []).extend(group)
+
+    def pages_read(self, k) -> list:
+        with self.lock:
+            return sorted(self.lists.get(("__pages__", k), []))
+
     # lost-updates workload: per-key element sets (the fake applies
     # adds atomically, so no update is ever lost)
     def lu_add(self, k, el) -> None:
@@ -486,6 +495,15 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.upsert_read(k)]}
+        if test.get("pages"):
+            if f == "add":
+                k, group = v
+                self.db.pages_add(k, group)
+                return {**op, "type": "ok"}
+            if f == "read":
+                k, _ = v
+                return {**op, "type": "ok",
+                        "value": [k, self.db.pages_read(k)]}
         if test.get("dirty-read"):
             if f == "write":
                 self.db.add(("__dr__", v))

@@ -251,7 +251,8 @@ def workload_registry() -> dict[str, Callable]:
                                       default_value, dirty_read,
                                       dirty_reads, long_fork,
                                       lost_updates, monotonic,
-                                      multi_key_acid, mutex, queue_workload,
+                                      multi_key_acid, mutex, pages,
+                                      queue_workload,
                                       register, sequential, set_workload,
                                       single_key_acid, table_workload,
                                       upsert, version_divergence, wr)
@@ -280,4 +281,5 @@ def workload_registry() -> dict[str, Callable]:
         "lost-updates": lost_updates.workload,
         "version-divergence": version_divergence.workload,
         "dirty-read": dirty_read.workload,
+        "pages": pages.workload,
     }

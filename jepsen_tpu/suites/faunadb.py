@@ -175,6 +175,16 @@ class FaunaClient(Client):
         except FaunaError:
             pass
         try:
+            # pages workload: per-key groups read through cursor-paged
+            # index matches (pages.clj's by-key index)
+            self._query({"create_index": {"object": {
+                "name": "pages_by_key",
+                "source": {"@ref": "classes/elements"},
+                "terms": [{"field": ["data", "key"]}],
+                "values": [{"field": ["data", "value"]}]}}})
+        except FaunaError:
+            pass
+        try:
             # pair-term index: the adya probe's PREDICATE read (a phantom
             # -permitting DB must be caught, so the guard reads the whole
             # pair through the index, not two concrete refs — g2.clj)
@@ -207,6 +217,35 @@ class FaunaClient(Client):
                                   for a, b in balances.items()}}
             if f == "transfer":
                 return self._transfer(op)
+            if test.get("pages") and f == "add":
+                k, group = v
+                # ONE query = one transaction: the whole group inserts
+                # atomically (pages.clj:48-56)
+                self._query(do_(*[
+                    {"create": {"@ref": "classes/elements"},
+                     "params": {"object": {"data": {"object": {
+                         "key": int(k), "value": int(el)}}}}}
+                    for el in group]))
+                return {**op, "type": "ok"}
+            if test.get("pages") and f == "read":
+                k, _ = v
+                # page through the key's index match with small cursored
+                # pages — separate queries, which is exactly the
+                # isolation surface under test (pages.clj query-all)
+                match = {"match": {"index": {"@ref": "indexes/pages_by_key"}},
+                         "terms": int(k)}
+                out: list = []
+                after = None
+                while True:
+                    q = {"paginate": match, "size": 4}
+                    if after is not None:
+                        q["after"] = after
+                    res = self._query(q)
+                    res = res if isinstance(res, dict) else {}
+                    out += [int(x) for x in res.get("data", [])]
+                    after = res.get("after")
+                    if after is None:
+                        return {**op, "type": "ok", "value": [k, out]}
             if f == "add":
                 self._query(upsert("elements", int(v), {"elem": int(v)}))
                 return {**op, "type": "ok"}
@@ -261,10 +300,14 @@ class FaunaClient(Client):
                 return {**op, "type": "ok" if out is True else "fail"}
             return {**op, "type": "fail", "error": ["unknown-f", f]}
         except FaunaError as e:
-            # instance not found on a register read → empty register
-            # (bank reads carry value None — not unpackable)
+            # instance not found on a REGISTER read → empty register
+            # (bank reads carry value None — not unpackable). A pages
+            # read must NOT take this recovery: its value shape matches,
+            # but a not-found there means the index is missing, and a
+            # fabricated ok-empty read would mask pagination anomalies
+            # behind a trivially-valid verdict
             if f == "read" and isinstance(v, (list, tuple)) \
-                    and e.not_found():
+                    and not test.get("pages") and e.not_found():
                 k, _ = v
                 return {**op, "type": "ok", "value": [k, None]}
             kind = "fail" if f == "read" else "info"
@@ -309,7 +352,7 @@ class FaunaError(Exception):
                    for e in self.errors if isinstance(e, dict))
 
 
-SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya")
+SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya", "pages")
 
 
 def faunadb_test(opts_dict: dict | None = None) -> dict:

@@ -1,0 +1,115 @@
+"""Pagination-isolation workload (reference:
+faunadb/src/jepsen/faunadb/pages.clj — groups of elements insert
+together in one transaction; concurrent reads page through the
+collection cursor by cursor, and every read must be expressible as a
+union of COMPLETE groups. A page boundary slicing a group in half is
+the pagination-isolation anomaly this hunts).
+
+Op shapes (independent-lifted [k, v] values):
+- ``{"f": "add", "value": [k, [elements...]]}`` — one txn inserts the
+  whole group
+- ``{"f": "read", "value": [k, [elements...]]}`` — the key's elements,
+  gathered across pages
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import Checker
+
+
+def generator(n_groups: int = 5, per_key_limit: int = 40,
+              group_min: int = 2, group_max: int = 4):
+    lock = threading.Lock()
+    counter = itertools.count()
+
+    def add(test, ctx):
+        n = ctx.rng.randint(group_min, group_max)
+        with lock:
+            group = [next(counter) for _ in range(n)]
+        return {"f": "add", "value": group}
+
+    def read(test, ctx):
+        return {"f": "read", "value": None}
+
+    def key_gen(k):
+        return gen.limit(per_key_limit,
+                         gen.mix([gen.Fn(add), gen.Fn(read)]))
+
+    return independent.concurrent_generator(n_groups, itertools.count(),
+                                            key_gen)
+
+
+def read_errors(group_of: dict, read: set) -> list:
+    """Errors for any read not expressible as a union of complete groups
+    (pages.clj:68-91 read-errs): repeatedly pick an element, check its
+    whole group is present, and cross the group off."""
+    errs = []
+    read = set(read)
+    while read:
+        e = next(iter(read))
+        group = group_of.get(e)
+        if group is None:
+            errs.append({"unexpected": e})
+            read.discard(e)
+            continue
+        missing = group - read
+        if missing:
+            errs.append({"expected": sorted(group),
+                         "found": sorted(read & group)})
+        read -= group
+    return errs
+
+
+class PagesChecker(Checker):
+    """Index each element to its add-group (invoked adds minus definite
+    fails — an indeterminate add may appear); every ok read must
+    decompose into complete groups, without duplicates
+    (pages.clj:93-145)."""
+
+    def check(self, test, history, opts):
+        invoked: dict = {}
+        failed: set = set()
+        for op in history:
+            if op.get("f") != "add":
+                continue
+            group = tuple(op.get("value") or ())
+            if op.get("type") == "invoke":
+                invoked[group] = set(group)
+            elif op.get("type") == "fail":
+                failed.add(group)
+        group_of: dict = {}
+        for group, els in invoked.items():
+            if group in failed:
+                continue
+            for e in els:
+                group_of[e] = els
+        errs = []
+        reads = 0
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            reads += 1
+            vals = list(op.get("value") or ())
+            if len(vals) != len(set(vals)):
+                errs.append({"op-errors": ["duplicate-items"],
+                             "read": sorted(vals)[:20]})
+                continue
+            e = read_errors(group_of, set(vals))
+            if e:
+                errs.append({"op-errors": e[:5]})
+        return {"valid?": not errs, "ok-read-count": reads,
+                "error-count": len(errs), "errors": errs[:10]}
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    test = test or {}
+    n = len(test.get("nodes") or []) or 5
+    return {
+        "pages": True,  # client dispatch marker
+        "generator": generator(n_groups=n),
+        "checker": independent.checker(PagesChecker()),
+    }

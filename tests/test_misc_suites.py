@@ -269,3 +269,79 @@ def test_fauna_fake_set_and_adya_runs():
     for wl in ("set", "adya"):
         result = run_fake(faunadb.faunadb_test, workload=wl)
         assert result["results"]["valid?"] is True, (wl, result["results"])
+
+
+def test_pages_checker_group_atomicity():
+    """Reads must decompose into COMPLETE add-groups; a page boundary
+    slicing a group is the anomaly (faunadb/pages.clj:93-145)."""
+    from jepsen_tpu.workloads.pages import PagesChecker
+
+    def h(adds, reads, failed=()):
+        out = []
+        for g in adds:
+            out.append({"type": "invoke", "f": "add", "value": list(g)})
+            out.append({"type": ("fail" if tuple(g) in failed else "ok"),
+                        "f": "add", "value": list(g)})
+        for r in reads:
+            out.append({"type": "ok", "f": "read", "value": list(r)})
+        return out
+
+    ok = PagesChecker().check(
+        {}, h([[1, 2], [3, 4, 5]], [[1, 2], [1, 2, 3, 4, 5], []]), {})
+    assert ok["valid?"] is True and ok["ok-read-count"] == 3
+    torn = PagesChecker().check(
+        {}, h([[1, 2], [3, 4, 5]], [[1, 2, 3]]), {})
+    assert torn["valid?"] is False
+    assert torn["errors"][0]["op-errors"][0]["expected"] == [3, 4, 5]
+    dup = PagesChecker().check({}, h([[1, 2]], [[1, 1, 2]]), {})
+    assert dup["valid?"] is False
+    # a definitely-failed group's elements are unexpected if read
+    ghost = PagesChecker().check(
+        {}, h([[1, 2]], [[1, 2]], failed={(1, 2)}), {})
+    assert ghost["valid?"] is False
+
+
+def test_fauna_pages_client_cursored_reads():
+    """Group adds ride one Do-of-creates transaction; reads page the
+    by-key index match with cursors across separate queries."""
+    sent = []
+    pages = [{"data": [1, 5], "after": ["c1"]},
+             {"data": [9], "after": None}]
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            if "paginate" in expr:
+                return pages[1 if "after" in expr else 0]
+            return True
+
+    c = TClient(node="n1")
+    t = {"pages": True}
+    out = c.invoke(t, {"f": "add", "type": "invoke",
+                       "value": [7, [1, 5]]})
+    assert out["type"] == "ok"
+    do = sent[0]["do"]
+    assert len(do) == 2
+    assert do[0]["params"]["object"]["data"]["object"] == {"key": 7,
+                                                           "value": 1}
+    out = c.invoke(t, {"f": "read", "type": "invoke", "value": [7, None]})
+    assert out["type"] == "ok" and out["value"] == [7, [1, 5, 9]]
+    assert sent[1]["paginate"]["terms"] == 7
+    assert sent[2]["after"] == ["c1"]  # the cursor chained
+
+
+def test_fauna_fake_pages_run():
+    result = run_fake(faunadb.faunadb_test, workload="pages")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_fauna_pages_read_not_found_fails():
+    """A missing pages index must FAIL the read, not fabricate an
+    ok-empty one (a trivially-valid verdict would mask anomalies)."""
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            raise faunadb.FaunaError([{"code": "instance not found"}])
+
+    out = TClient(node="n1").invoke(
+        {"pages": True}, {"f": "read", "type": "invoke", "value": [2, None]})
+    assert out["type"] == "fail", out
