@@ -1,7 +1,10 @@
 """FaunaDB test suite (reference: faunadb/src/jepsen/faunadb/ — a
 Calvin-style distributed transactional database; the reference probes
-registers, bank transfers, set membership (pages), and monotonicity
-through the JVM driver).
+registers, bank transfers, set membership (pages and whole-set reads),
+G2/adya phantoms, timestamp monotonicity (monotonic.clj /
+multimonotonic.clj), within-transaction internal consistency
+(internal.clj), and cluster topology changes (topology.clj +
+nemesis.clj's topo-nemesis) through the JVM driver).
 
 Every FaunaDB query is a single transaction POSTed as a JSON-encoded
 FQL expression to port 8443 with HTTP Basic auth (the cluster secret as
@@ -24,6 +27,7 @@ import urllib.error
 from jepsen_tpu import cli, control, db as db_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import membership as _membership
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
                                standard_test_fn)
@@ -96,6 +100,87 @@ def upsert(cls: str, instance_id, data: dict) -> dict:
                create_(cls, instance_id, data))
 
 
+def let_(bindings: dict, in_expr) -> dict:
+    """Let(bindings, in) — the ordered wire form is an ARRAY of
+    single-binding objects, so later bindings may reference earlier ones
+    via ``var_`` (the q/let form internal.clj's create-tabby-let leans
+    on for its evaluation-order probe)."""
+    return {"let": [{k: v} for k, v in bindings.items()], "in": in_expr}
+
+
+def var_(name: str) -> dict:
+    return {"var": name}
+
+
+def lambda_(param: str, expr) -> dict:
+    return {"lambda": param, "expr": expr}
+
+
+def map_(collection, param: str, expr) -> dict:
+    """Map(lambda, collection) — the wire form carries the lambda under
+    the ``map`` key and the collection alongside."""
+    return {"map": lambda_(param, expr), "collection": collection}
+
+
+def foreach_(collection, param: str, expr) -> dict:
+    return {"foreach": lambda_(param, expr), "collection": collection}
+
+
+def at_(ts, expr) -> dict:
+    """At(ts, expr): evaluate ``expr`` against the snapshot at ``ts``
+    (the temporal-read form monotonic.clj's read-at rides)."""
+    return {"at": ts, "expr": expr}
+
+
+def update_ref_(ref_expr, data: dict) -> dict:
+    """Update through a computed ref expression (vs ``update_``'s
+    literal class/id)."""
+    return {"update": ref_expr,
+            "params": {"object": {"data": {"object": data}}}}
+
+
+TIME_NOW = {"time": "now"}
+
+
+def strip_ts(ts):
+    """Normalizes a transaction timestamp for string comparison: unwraps
+    the ``{"@ts": ...}`` wire form and strips a trailing Z
+    (monotonic.clj:51-59 — '...09Z' and '...09.143Z' don't compare as
+    strings until the Z goes)."""
+    if isinstance(ts, dict) and "@ts" in ts:
+        ts = ts["@ts"]
+    if isinstance(ts, str) and ts.endswith("Z"):
+        return ts[:-1]
+    return ts
+
+
+def jitter_ts(ts, jitter_s: float, rng=None):
+    """A timestamp up to ``jitter_s`` seconds before ``ts`` (the
+    :at-query-jitter past-read monotonic.clj:118-121 uses). Stripped
+    ISO-8601 strings are shifted properly; anything unparseable is
+    returned as-is (an honest current-time read, never a fabrication)."""
+    import datetime
+    import random as _random
+    rng = rng or _random
+    if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+        return ts - rng.random() * jitter_s
+    try:
+        dt = datetime.datetime.fromisoformat(str(ts))
+    except ValueError:
+        return ts
+    dt -= datetime.timedelta(seconds=rng.random() * jitter_s)
+    out = dt.isoformat()
+    return out
+
+
+def _names(page):
+    """Flattens a paginate/map result to a plain list (the ``{"data":
+    [...]}`` page wrapper or a bare list)."""
+    if isinstance(page, dict):
+        page = page.get("data", [])
+    return list(page or [])
+
+
 class FaunaDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
     """FaunaDB lifecycle (faunadb/auto.clj): package install, yml
     config, init on the primary, join everywhere else."""
@@ -165,6 +250,20 @@ class FaunaClient(Client):
                 self._query({"create_class": {"object": {"name": cls}}})
             except FaunaError:
                 pass  # already exists
+        if test.get("fauna_internal"):
+            # cats + the by-type [ref, name] index (internal.clj:60-69)
+            for expr in (
+                    {"create_class": {"object": {"name": "cats"}}},
+                    {"create_index": {"object": {
+                        "name": "cats_by_type",
+                        "source": {"@ref": "classes/cats"},
+                        "terms": [{"field": ["data", "type"]}],
+                        "values": [{"field": ["ref"]},
+                                   {"field": ["data", "name"]}]}}}):
+                try:
+                    self._query(expr)
+                except FaunaError:
+                    pass
         try:
             # enumeration index for the set workload's whole reads
             # (faunadb/set.clj builds the same all-elements index)
@@ -203,6 +302,12 @@ class FaunaClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("fauna_monotonic"):
+                return self._monotonic_invoke(test, op)
+            if test.get("fauna_multimonotonic"):
+                return self._multimonotonic_invoke(test, op)
+            if test.get("fauna_internal"):
+                return self._internal_invoke(test, op)
             if f == "read" and v is None and test.get("accounts"):
                 # ONE query = one transaction: an object of selects reads
                 # every balance in the same snapshot (per-account queries
@@ -305,20 +410,190 @@ class FaunaClient(Client):
             # read must NOT take this recovery: its value shape matches,
             # but a not-found there means the index is missing, and a
             # fabricated ok-empty read would mask pagination anomalies
-            # behind a trivially-valid verdict
+            # behind a trivially-valid verdict. Multimonotonic reads
+            # also carry a list value (of keys) — the recovery would
+            # unpack garbage (or crash), so those are gated out too.
             if f == "read" and isinstance(v, (list, tuple)) \
-                    and not test.get("pages") and e.not_found():
+                    and not test.get("pages") \
+                    and not test.get("fauna_multimonotonic") \
+                    and e.not_found():
                 k, _ = v
                 return {**op, "type": "ok", "value": [k, None]}
-            kind = "fail" if f == "read" else "info"
-            return {**op, "type": kind, "error": ["fauna", str(e)]}
+            kind = "fail" if f in ("read", "read-at") else "info"
+            # surface not-found as its own tagged element so the
+            # monotonic suite's not-found checker can see it
+            err = (["fauna", "not-found", str(e)] if e.not_found()
+                   else ["fauna", str(e)])
+            return {**op, "type": kind, "error": err}
         except urllib.error.HTTPError as e:
-            kind = "fail" if f == "read" else "info"
+            kind = "fail" if f in ("read", "read-at") else "info"
             return {**op, "type": kind,
                     "error": ["http", e.code, http_error_json(e)]}
         except NET_ERRORS as e:
-            kind = "fail" if f == "read" else "info"
+            kind = "fail" if f in ("read", "read-at") else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
+
+    # -- monotonic (monotonic.clj:93-141) -------------------------------
+
+    def _monotonic_invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        r = ("registers", 0)
+        if f == "inc":
+            # one txn: [now, if exists then (remember v; v:=v+1; v) else
+            # (create 1; 0)] — returns the PRE-increment value with the
+            # txn time (monotonic.clj:99-110)
+            out = self._query([
+                TIME_NOW,
+                if_(exists_(*r),
+                    let_({"v": select_data("value", get_(*r))},
+                         do_(update_(*r, {"value": {"add": [var_("v"), 1]}}),
+                             var_("v"))),
+                    do_(create_(*r, {"value": 1}), 0))])
+            ts, val = out
+            return {**op, "type": "ok", "value": [strip_ts(ts), val]}
+        if f == "read":
+            out = self._query([
+                TIME_NOW,
+                if_(exists_(*r), select_data("value", get_(*r)), 0)])
+            ts, val = out
+            return {**op, "type": "ok", "value": [strip_ts(ts), val]}
+        if f == "read-at":
+            ts = (v or [None])[0]
+            if ts is None:
+                now = self._query(TIME_NOW)
+                ts = jitter_ts(strip_ts(now),
+                               test.get("at_query_jitter", 1.0))
+            # a stripped ISO string must go back over the wire as a
+            # timestamp VALUE, not a bare string — re-tag through Time()
+            ts_expr = {"time": f"{ts}Z"} if isinstance(ts, str) else ts
+            out = self._query([
+                ts_expr, at_(ts_expr, if_(exists_(*r),
+                                          select_data("value", get_(*r)), 0))])
+            ts2, val = out
+            return {**op, "type": "ok", "value": [strip_ts(ts2), val]}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+    # -- multimonotonic (multimonotonic.clj:85-105) ----------------------
+
+    def _multimonotonic_invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "write":
+            # blind writes: no read locks, maximum throughput
+            # (multimonotonic.clj:90-95)
+            self._query(do_(*[upsert("registers", int(k), {"value": int(x)})
+                              for k, x in sorted((v or {}).items())]))
+            return {**op, "type": "ok", "value": v}
+        if f == "read":
+            ks = list(v or [])
+            out = self._query([
+                TIME_NOW,
+                [if_(exists_("registers", int(k)), get_("registers", int(k)),
+                     None)
+                 for k in ks]])
+            ts, instances = out
+            regs = {}
+            for k, inst in zip(ks, instances or []):
+                if isinstance(inst, dict):
+                    data = inst.get("data") or {}
+                    regs[k] = {"value": data.get("value"),
+                               "ts": strip_ts(inst.get("ts"))}
+            return {**op, "type": "ok",
+                    "value": {"ts": strip_ts(ts), "registers": regs}}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+    # -- internal (internal.clj:71-133) ----------------------------------
+
+    CATS_INDEX = {"@ref": "indexes/cats_by_type"}
+
+    def _match_cats(self, cat_type: str) -> dict:
+        """First 1024 cat [ref, name] pairs of a type through the index
+        (internal.clj:33-39)."""
+        return {"paginate": {"match": {"index": self.CATS_INDEX},
+                             "terms": cat_type},
+                "size": 1024}
+
+    def _match_names(self, cat_type: str) -> dict:
+        """Just the names of a type — a Map(lambda) over the page's
+        [ref, name] pairs (internal.clj:33-39)."""
+        return map_({"select": ["data"], "from": self._match_cats(cat_type)},
+                    "row", {"select": [1], "from": var_("row")})
+
+    def _internal_invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        if f == "reset":
+            # delete every cat of both types, guarded per-ref because
+            # indices need not be serializable (internal.clj:41-53)
+            self._query(do_(*[
+                foreach_({"select": ["data"], "from": self._match_cats(t)},
+                         "row",
+                         if_({"exists": {"select": [0],
+                                         "from": var_("row")}},
+                             {"delete": {"select": [0],
+                                         "from": var_("row")}},
+                             None))
+                for t in ("tabby", "calico")]))
+            return {**op, "type": "ok"}
+        if f in ("create-tabby-let", "create-tabby-obj",
+                 "create-tabby-arr"):
+            name = f"cat-{int(v)}"
+            create = create_("cats", int(v), {"type": "tabby",
+                                              "name": name})
+            if f == "create-tabby-let":
+                # at(now) observes the txn's own mutations — the let
+                # binds in source order, the result object is permuted
+                # (internal.clj:80-96)
+                expr = let_({"t": TIME_NOW},
+                            let_({"tabbies0": at_(var_("t"),
+                                                  self._match_names("tabby")),
+                                  "tabby": create,
+                                  "tabbies1": at_(var_("t"),
+                                                  self._match_names("tabby"))},
+                                 {"object": {"tabbies-1": var_("tabbies1"),
+                                             "tabby": name,
+                                             "tabbies-0": var_("tabbies0")}}))
+                out = self._query(expr) or {}
+                out = dict(out)
+            elif f == "create-tabby-obj":
+                # object-literal composition, evaluated in key order
+                # (internal.clj:98-113); keys chosen so declaration
+                # order ≠ alphabetical order
+                out = self._query({"object": {
+                    "c": self._match_names("tabby"),
+                    "a": create,
+                    "b": self._match_names("tabby")}}) or {}
+                out = {"tabbies-0": out.get("c"), "tabby": name,
+                       "tabbies-1": out.get("b")}
+            else:
+                # array composition (internal.clj:115-121)
+                out = self._query([self._match_names("tabby"), create,
+                                   self._match_names("tabby")]) or []
+                out = {"tabbies-0": out[0] if len(out) > 0 else [],
+                       "tabby": name,
+                       "tabbies-1": out[2] if len(out) > 2 else []}
+            out["tabbies-0"] = _names(out.get("tabbies-0"))
+            out["tabbies-1"] = _names(out.get("tabbies-1"))
+            out["tabby"] = name
+            return {**op, "type": "ok", "value": out}
+        if f == "change-type":
+            # retype the first tabby, re-read both sets — one txn
+            # (internal.clj:123-132)
+            expr = let_(
+                {"page": {"paginate": {"match": {"index": self.CATS_INDEX},
+                                       "terms": "tabby"},
+                          "size": 1}},
+                [if_({"non_empty": {"select": ["data"], "from": var_("page")}},
+                     do_(update_ref_({"select": ["data", 0, 0],
+                                      "from": var_("page")},
+                                     {"type": "calico"}),
+                         {"select": ["data", 0, 1], "from": var_("page")}),
+                     None),
+                 self._match_names("tabby"),
+                 self._match_names("calico")])
+            out = self._query(expr) or [None, [], []]
+            name, tabbies, calicos = (list(out) + [None, [], []])[:3]
+            return {**op, "type": "ok",
+                    "value": [name, _names(tabbies), _names(calicos)]}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
 
     def _transfer(self, op):
         """One transactional Do: guard both balances, move the amount
@@ -352,20 +627,317 @@ class FaunaError(Exception):
                    for e in self.errors if isinstance(e, dict))
 
 
-SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya", "pages")
+# ---------------------------------------------------------------------------
+# Fake doubles for the monotonic / multimonotonic / internal workloads:
+# a shared versioned store with a logical clock standing in for Fauna's
+# temporal model (SURVEY.md §4 tier-2 cluster-free lifecycle tests)
+# ---------------------------------------------------------------------------
+
+class _FakeFaunaState:
+    """Versioned registers + cats under one lock and logical clock."""
+
+    def __init__(self):
+        import threading
+        self.lock = threading.Lock()
+        self.clock = 0
+        self.history: dict = {}  # key -> [(ts, value), ...] append-only
+        self.cats: dict = {}     # name -> type
+
+    def tick(self) -> int:
+        self.clock += 1
+        return self.clock
+
+
+class _FakeFaunaClient(Client):
+    """Shared base: linearizable by construction, so every fake-mode
+    lifecycle run must come back valid."""
+
+    def __init__(self, state: _FakeFaunaState | None = None):
+        self.state = state or _FakeFaunaState()
+
+    def open(self, test, node):
+        return type(self)(self.state)
+
+    def setup(self, test):
+        pass
+
+
+class FakeMonotonicFauna(_FakeFaunaClient):
+    """Single increment-only register with temporal reads."""
+
+    def invoke(self, test, op):
+        import random
+        s = self.state
+        f = op.get("f")
+        with s.lock:
+            hist = s.history.setdefault(0, [])
+            if f == "inc":
+                ts = s.tick()
+                pre = hist[-1][1] if hist else 0
+                hist.append((ts, pre + 1))
+                return {**op, "type": "ok", "value": [ts, pre]}
+            if f == "read":
+                ts = s.tick()
+                return {**op, "type": "ok",
+                        "value": [ts, hist[-1][1] if hist else 0]}
+            if f == "read-at":
+                ts = (op.get("value") or [None])[0]
+                if ts is None:
+                    ts = max(1, s.clock - random.randint(0, 3))
+                val = 0
+                for t, v in hist:
+                    if t <= ts:
+                        val = v
+                return {**op, "type": "ok", "value": [ts, val]}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+class FakeMultimonotonicFauna(_FakeFaunaClient):
+    """Per-key increment-only registers, snapshot reads."""
+
+    def invoke(self, test, op):
+        s = self.state
+        f, v = op.get("f"), op.get("value")
+        with s.lock:
+            if f == "write":
+                ts = s.tick()
+                for k, x in (v or {}).items():
+                    s.history.setdefault(k, []).append((ts, x))
+                return {**op, "type": "ok", "value": v}
+            if f == "read":
+                ts = s.tick()
+                regs = {}
+                for k in v or []:
+                    hist = s.history.get(k)
+                    if hist:
+                        regs[k] = {"value": hist[-1][1], "ts": hist[-1][0]}
+                return {**op, "type": "ok",
+                        "value": {"ts": ts, "registers": regs}}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+class FakeInternalFauna(_FakeFaunaClient):
+    """Atomic cats-by-type mutations with in-transaction re-reads."""
+
+    def invoke(self, test, op):
+        s = self.state
+        f, v = op.get("f"), op.get("value")
+
+        def names(t):
+            return sorted(n for n, typ in s.cats.items() if typ == t)
+
+        with s.lock:
+            if f == "reset":
+                s.cats = {n: t for n, t in s.cats.items()
+                          if t not in ("tabby", "calico")}
+                return {**op, "type": "ok"}
+            if f in ("create-tabby-let", "create-tabby-obj",
+                     "create-tabby-arr"):
+                name = f"cat-{int(v)}"
+                before = names("tabby")
+                s.cats[name] = "tabby"
+                return {**op, "type": "ok",
+                        "value": {"tabbies-0": before, "tabby": name,
+                                  "tabbies-1": names("tabby")}}
+            if f == "change-type":
+                tabbies = names("tabby")
+                name = tabbies[0] if tabbies else None
+                if name is not None:
+                    s.cats[name] = "calico"
+                return {**op, "type": "ok",
+                        "value": [name, names("tabby"), names("calico")]}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+# ---------------------------------------------------------------------------
+# Topology nemesis: grow/shrink the cluster through the membership
+# machinery (topology.clj + nemesis.clj:74-139's topo-nemesis)
+# ---------------------------------------------------------------------------
+
+class FaunaTopology(_membership.State):
+    """Membership State over faunadb-admin: models the cluster as
+    ``{"replica_count": n, "nodes": [{"node", "state", "replica"}]}``
+    (topology.clj:12-28), generates random add-node / remove-node
+    transitions (topology.clj:103-138), and applies them with the
+    reference's recipe — configure + start + join for adds
+    (nemesis.clj:101-108), kill + wipe + remove-from-peer for removes
+    (nemesis.clj:110-133)."""
+
+    def __init__(self, replicas: int = 3, rng=None):
+        import random as _random
+        self.replicas = replicas
+        self.rng = rng or _random.Random()
+        self.topo: dict | None = None
+
+    # -- topology.clj:12-28 ---------------------------------------------
+    def _ensure_topo(self, test) -> dict:
+        if self.topo is None:
+            nodes = list(test.get("nodes") or [])
+            k = min(self.replicas, max(1, len(nodes)))
+            self.topo = {
+                "replica_count": k,
+                "nodes": [{"node": n, "state": "active",
+                           "replica": f"replica-{i % k}"}
+                          for i, n in enumerate(nodes)]}
+        return self.topo
+
+    def _active(self) -> list[dict]:
+        return [n for n in (self.topo or {}).get("nodes", [])
+                if n["state"] == "active"]
+
+    # -- membership State protocol --------------------------------------
+    def node_view(self, test, node):
+        """``faunadb-admin status`` parsed to this node's member list;
+        None when the output isn't a status table (e.g. dummy remote)."""
+        from jepsen_tpu import control
+        out = control.on(
+            node, test,
+            lambda: control.exec_(control.lit(
+                "faunadb-admin status 2>/dev/null || true")))
+        members = []
+        for line in str(out or "").splitlines():
+            parts = line.split()
+            if len(parts) >= 3 and parts[1].startswith("replica-"):
+                members.append({"node": parts[0], "replica": parts[1],
+                                "state": parts[2].lower()})
+        return members or None
+
+    def merge_views(self, test, views):
+        """Adopt the largest parseable view; absent any (fake mode), the
+        model from applied transitions stands."""
+        best = max((v for v in views.values() if v), key=len, default=None)
+        if best is not None:
+            topo = self._ensure_topo(test)
+            by_name = {m["node"]: m for m in best}
+            for n in topo["nodes"]:
+                seen = by_name.get(n["node"])
+                if seen is not None:
+                    n["state"] = ("active" if seen["state"] in
+                                  ("active", "up", "live") else seen["state"])
+        return self
+
+    def fs(self):
+        return {"add-node", "remove-node"}
+
+    def op(self, test):
+        """A random feasible transition (topology.clj:158-183): add any
+        test node not in the cluster, or remove a node whose replica
+        keeps ≥1 member."""
+        topo = self._ensure_topo(test)
+        active = self._active()
+        candidates = []
+        absent = sorted(set(test.get("nodes") or [])
+                        - {n["node"] for n in topo["nodes"]})
+        if absent and active:
+            node = self.rng.choice(absent)
+            candidates.append({
+                "type": "info", "f": "add-node",
+                "value": {"node": node,
+                          "join": self.rng.choice(active)["node"]}})
+        by_replica: dict = {}
+        for n in active:
+            by_replica.setdefault(n["replica"], []).append(n["node"])
+        removable = sorted(n for ns in by_replica.values() if len(ns) > 1
+                           for n in ns)
+        if removable:
+            candidates.append({"type": "info", "f": "remove-node",
+                               "value": self.rng.choice(removable)})
+        if not candidates:
+            return "pending"
+        return self.rng.choice(candidates)
+
+    def invoke(self, test, op):
+        from jepsen_tpu import control
+        topo = self._ensure_topo(test)
+        f, v = op.get("f"), op.get("value")
+        if f == "add-node":
+            node, join = v["node"], v["join"]
+            replica = f"replica-{self.rng.randrange(topo['replica_count'])}"
+
+            def _add():
+                cu.write_file(config_yml(test, node), YML)
+                control.exec_("service", "faunadb", "start")
+                control.exec_(control.lit(
+                    f"faunadb-admin join -r {replica} {join} "
+                    f"2>/dev/null || true"))
+            control.on(node, test, _add)
+            topo["nodes"].append({"node": node, "state": "active",
+                                  "replica": replica})
+            return ["added", v]
+        if f == "remove-node":
+            # stop-then-remove: the reference found live removal
+            # untrodden ground (nemesis.clj:110-117)
+            control.on(v, test, lambda: (
+                control.exec_(control.lit(
+                    "service faunadb stop >/dev/null 2>&1 || true")),
+                cu.rm_rf("/var/lib/faunadb/*")))
+            peers = [n["node"] for n in self._active() if n["node"] != v]
+            if peers:
+                peer = self.rng.choice(peers)
+                control.on(peer, test, lambda: control.exec_(control.lit(
+                    f"faunadb-admin remove {v} 2>/dev/null || true")))
+            topo["nodes"] = [n for n in topo["nodes"] if n["node"] != v]
+            return ["removed", v]
+        return ["noop", f]
+
+    def resolve(self, test):
+        return self
+
+    def resolve_op(self, test, pending_pair):
+        """Transitions apply synchronously (the reference resets its
+        topology atom right in invoke, nemesis.clj:135-137)."""
+        return self
+
+    def teardown(self, test):
+        pass
+
+
+def topology_fault_package(opts: dict) -> dict:
+    """--fault topology: the membership package over FaunaTopology."""
+    from jepsen_tpu.nemesis import membership
+    return membership.package(FaunaTopology(),
+                              interval=opts.get("interval", 10.0))
+
+
+SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya", "pages",
+                       "monotonic", "multimonotonic", "internal")
+
+FAUNA_WORKLOADS = {"monotonic", "multimonotonic", "internal"}
+
+FAKE_CLIENTS = {"monotonic": FakeMonotonicFauna,
+                "multimonotonic": FakeMultimonotonicFauna,
+                "internal": FakeInternalFauna}
+
+
+def _make_workload(name: str, base: dict):
+    from jepsen_tpu.suites import workload_registry
+    from jepsen_tpu.workloads import (fauna_internal, fauna_monotonic,
+                                      fauna_multimonotonic)
+    fauna = {"monotonic": fauna_monotonic.workload,
+             "multimonotonic": fauna_multimonotonic.workload,
+             "internal": fauna_internal.workload}
+    if name in fauna:
+        return fauna[name](base)
+    return workload_registry()[name](base, accelerator=base["accelerator"])
 
 
 def faunadb_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload_name = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    fake_client = FAKE_CLIENTS.get(workload_name)
     return build_suite_test(
-        opts_dict, db_name="faunadb",
+        o, db_name="faunadb",
         supported_workloads=SUPPORTED_WORKLOADS,
+        make_workload=_make_workload,
+        fake_client=fake_client,
+        fault_packages={"topology": topology_fault_package},
         make_real=lambda o: {"db": FaunaDB(), "client": FaunaClient(),
                              "os": Debian()})
 
 
 main = cli.single_test_cmd(
     standard_test_fn(faunadb_test),
-    standard_opt_fn(SUPPORTED_WORKLOADS),
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("topology",)),
     name="jepsen-faunadb")
 
 

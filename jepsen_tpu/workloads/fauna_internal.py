@@ -1,0 +1,110 @@
+"""FaunaDB-style internal-consistency workload (reference:
+faunadb/src/jepsen/faunadb/internal.clj — probes whether a single
+transaction observes its *own* effects coherently: a query that reads a
+set, inserts into it, and reads it again must see the insert in the
+second read and not the first, whether the three steps are composed via
+let bindings, object literals, or arrays).
+
+Op shapes (internal.clj:71-133):
+- ``{"f": "reset", "value": None}`` — delete every cat of both types.
+- ``{"f": "create-tabby-let" | "create-tabby-obj" | "create-tabby-arr",
+  "value": id}`` → ok value ``{"tabbies-0": [names before],
+  "tabby": name, "tabbies-1": [names after]}`` — one transaction that
+  reads the tabby set, creates cat ``id`` as a tabby, reads again; the
+  three result positions are composed through a let / object literal /
+  array respectively, exercising each composition form's evaluation
+  order.
+- ``{"f": "change-type", "value": None}`` → ok value
+  ``[name|None, tabbies_after, calicos_after]`` — one transaction that
+  retypes the first tabby to calico and re-reads both sets.
+
+The checker (internal.clj:140-206) is purely per-op: a created tabby
+present *before* its create, or missing *after* it, or a retyped cat
+still in the old set / missing from the new one, is an internal
+consistency error.
+"""
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+CREATE_FS = ("create-tabby-let", "create-tabby-obj", "create-tabby-arr")
+
+
+def op_errors(op: dict) -> list[dict]:
+    """Internal-consistency errors evidenced by one ok completion
+    (internal.clj:140-191)."""
+    f, v = op.get("f"), op.get("value")
+    errs = []
+    if f in CREATE_FS and isinstance(v, dict):
+        name = v.get("tabby")
+        if name in (v.get("tabbies-0") or []):
+            errs.append({"type": "present-before-create", "name": name,
+                         "op": op})
+        if name not in (v.get("tabbies-1") or []):
+            errs.append({"type": "missing-after-create", "name": name,
+                         "op": op})
+    elif f == "change-type" and isinstance(v, (list, tuple)) and len(v) == 3:
+        name, tabbies, calicos = v
+        if name is not None:
+            if name in (tabbies or []):
+                errs.append({"type": "present-after-change", "name": name,
+                             "op": op})
+            if name not in (calicos or []):
+                errs.append({"type": "missing-after-change", "name": name,
+                             "op": op})
+    return errs
+
+
+class InternalChecker(Checker):
+    """(internal.clj:193-206)"""
+
+    def name(self):
+        return "internal"
+
+    def check(self, test, history, opts):
+        errors = []
+        for op in history:
+            if op.get("type") == "ok":
+                errors.extend(op_errors(op))
+        return {
+            "valid?": not errors,
+            "error-count": len(errors),
+            "error-types": sorted({e["type"] for e in errors}),
+            "errors": errors[:10],
+        }
+
+
+def generator():
+    """Uniform mix of resets, type changes, and the three create
+    composition forms, ids unique across the run (internal.clj:208-228)."""
+    lock = threading.Lock()
+    counter = [0]
+
+    def create(f):
+        def fn(test, ctx):
+            with lock:
+                i = counter[0]
+                counter[0] += 1
+            return {"f": f, "value": i}
+        return gen.Fn(fn)
+
+    return gen.mix([
+        gen.Fn(lambda test, ctx: {"f": "reset", "value": None}),
+        gen.Fn(lambda test, ctx: {"f": "change-type", "value": None}),
+        *[create(f) for f in CREATE_FS],
+    ])
+
+
+def checker() -> Checker:
+    return InternalChecker()
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "fauna_internal": True,
+        "generator": generator(),
+        "checker": checker(),
+    }

@@ -1,0 +1,258 @@
+"""FaunaDB-style monotonic workload (reference:
+faunadb/src/jepsen/faunadb/monotonic.clj — clients observe a single
+increment-only register through current reads, temporal (``at``) reads,
+and increments; every completion carries the transaction timestamp, so
+the history supports both per-session and global timestamp-order
+monotonicity checks).
+
+Op shapes (monotonic.clj:8-26):
+- ``{"f": "inc", "value": None}`` → ok ``[ts, v]`` — bumped the register
+  at time ``ts``; ``v`` is the pre-increment value.
+- ``{"f": "read", "value": None}`` → ok ``[ts, v]`` — current read.
+- ``{"f": "read-at", "value": [ts|None, None]}`` → ok ``[ts, v]`` — read
+  at the (possibly jittered past) timestamp ``ts``.
+
+Checkers:
+- ``monotonic`` (monotonic.clj:151-192): within each process, the
+  sequence of ok read/inc completions must never go backwards — in
+  value OR in timestamp.
+- ``timestamp-value`` (monotonic.clj:206-219): globally, sorting ok
+  read-at/inc completions by timestamp must yield non-decreasing
+  values (the register is increment-only, so a higher timestamp can
+  never hold a lower value).
+- ``not-found`` (monotonic.clj:334-348): reads guard with explicit
+  existence checks, so a not-found failure is itself an anomaly.
+- ``timestamp-value-plot`` (monotonic.clj:293-332): renders windows of
+  the value-vs-timestamp curve around each non-monotonic spot.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker, compose
+
+
+def ts_key(ts) -> tuple:
+    """Total-order key over heterogeneous timestamps: numerics sort
+    numerically, everything else lexically (stripped ISO-8601 strings
+    compare correctly this way — monotonic.clj:51-59 strips the Z for
+    exactly this reason)."""
+    if isinstance(ts, bool):  # bool is an int subtype; don't let it in
+        return (1, 0.0, str(ts))
+    if isinstance(ts, (int, float)):
+        return (0, float(ts), "")
+    return (1, 0.0, str(ts))
+
+
+def _pair_value(op: dict):
+    v = op.get("value")
+    if isinstance(v, (list, tuple)) and len(v) == 2:
+        return v
+    return None
+
+
+def non_monotonic_pairs_by_process(extractor: Callable, history) -> list:
+    """Pairs of ok ops on the same process where ``extractor`` goes
+    backwards (monotonic.clj:151-172)."""
+    last: dict[Any, dict] = {}
+    errs = []
+    for op in history:
+        if op.get("type") != "ok":
+            continue
+        p = op.get("process")
+        prev = last.get(p)
+        if prev is not None:
+            a, b = extractor(prev), extractor(op)
+            if a is not None and b is not None and not a <= b:
+                errs.append([prev, op])
+        last[p] = op
+    return errs
+
+
+def non_monotonic_pairs(extractor: Callable, ops: list) -> list:
+    """Adjacent pairs where ``extractor`` decreases
+    (monotonic.clj:194-204)."""
+    errs = []
+    for a, b in zip(ops, ops[1:]):
+        va, vb = extractor(a), extractor(b)
+        if va is not None and vb is not None and not va <= vb:
+            errs.append([a, b])
+    return errs
+
+
+def merged_windows(s: int, points: list) -> list:
+    """[lower, upper] windows of ``s`` around each point, overlaps
+    merged (monotonic.clj:221-243)."""
+    if not points:
+        return []
+    points = sorted(points)
+    windows = []
+    lower, upper = points[0] - s, points[0] + s
+    for p in points[1:]:
+        if upper <= p - s:
+            windows.append([lower, upper])
+            lower = p - s
+        upper = p + s
+    windows.append([lower, upper])
+    return windows
+
+
+def _val_of(op):
+    pair = _pair_value(op)
+    return None if pair is None else pair[1]
+
+
+def _ts_of(op):
+    pair = _pair_value(op)
+    return None if pair is None else ts_key(pair[0])
+
+
+class PerProcessMonotonicChecker(Checker):
+    """Per-session monotonicity of both values and timestamps
+    (monotonic.clj:174-192)."""
+
+    def name(self):
+        return "monotonic"
+
+    def check(self, test, history, opts):
+        ops = [op for op in history if op.get("f") in ("read", "inc")]
+        value_errs = non_monotonic_pairs_by_process(_val_of, ops)
+        ts_errs = non_monotonic_pairs_by_process(_ts_of, ops)
+        return {
+            "valid?": not value_errs and not ts_errs,
+            "value-errors": value_errs[:10],
+            "value-error-count": len(value_errs),
+            "ts-errors": ts_errs[:10],
+            "ts-error-count": len(ts_errs),
+        }
+
+
+class TimestampValueChecker(Checker):
+    """Global timestamp→value monotonicity over read-at/inc completions
+    (monotonic.clj:206-219)."""
+
+    def name(self):
+        return "timestamp-value"
+
+    def check(self, test, history, opts):
+        ops = sorted(
+            (op for op in history
+             if op.get("type") == "ok" and op.get("f") in ("read-at", "inc")
+             and _pair_value(op) is not None),
+            key=_ts_of)
+        errs = non_monotonic_pairs(_val_of, ops)
+        return {"valid?": not errs, "errors": errs[:10],
+                "error-count": len(errs)}
+
+
+class NotFoundChecker(Checker):
+    """Existence-guarded reads must never fail not-found
+    (monotonic.clj:334-348)."""
+
+    def name(self):
+        return "not-found"
+
+    def check(self, test, history, opts):
+        def is_nf(op):
+            err = op.get("error")
+            if err == "not-found":
+                return True
+            return isinstance(err, (list, tuple)) and "not-found" in err
+
+        errs = [op for op in history
+                if op.get("type") == "fail" and is_nf(op)]
+        return {
+            "valid?": not errs,
+            "invoke-count": sum(op.get("type") == "invoke"
+                                for op in history),
+            "error-count": len(errs),
+            "first": errs[0] if errs else None,
+            "last": errs[-1] if errs else None,
+        }
+
+
+class TimestampValuePlotter(Checker):
+    """Plots value-vs-timestamp windows around non-monotonic spots
+    (monotonic.clj:293-332). Always valid — a render, not a verdict."""
+
+    WINDOW = 32
+
+    def name(self):
+        return "timestamp-value-plot"
+
+    def check(self, test, history, opts):
+        ops = sorted(
+            (op for op in history
+             if op.get("type") == "ok" and op.get("f") == "read-at"
+             and _pair_value(op) is not None),
+            key=_ts_of)
+        # non-monotonic "spots": positions where a process's view of the
+        # value went backwards (monotonic.clj:308-323)
+        last: dict[Any, dict] = {}
+        spots = []
+        for i, op in enumerate(ops):
+            p = op.get("process")
+            prev = last.get(p)
+            if prev is not None:
+                a, b = _val_of(prev), _val_of(op)
+                if a is not None and b is not None and not a <= b:
+                    spots.append(i)
+            last[p] = op
+        for i, (lo, hi) in enumerate(merged_windows(self.WINDOW, spots)):
+            window = ops[max(lo, 0): hi]  # slice end clamps itself
+            self._plot(test, opts, i, window)
+        return {"valid?": True, "spot-count": len(spots)}
+
+    def _plot(self, test, opts, index, window):
+        if not window:
+            return
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from jepsen_tpu import store
+
+        by_process: dict[Any, list] = {}
+        for pos, op in enumerate(window):
+            by_process.setdefault(op.get("process"), []).append(
+                (pos, _val_of(op)))
+        fig, ax = plt.subplots(figsize=(8, 4))
+        for p, pts in sorted(by_process.items(), key=lambda kv: str(kv[0])):
+            ax.plot([x for x, _ in pts], [y for _, y in pts], "-x",
+                    ms=4, label=str(p))
+        ax.set_xlabel("read (timestamp order)")
+        ax.set_ylabel("register value")
+        ax.set_title(f"{test.get('name', 'test')} sequential {index}")
+        ax.legend(loc="upper left", fontsize=7)
+        d = opts.get("subdirectory")
+        fig.savefig(store.path_mk(test, *filter(None, [
+            d, f"sequential-{index}.png"])), bbox_inches="tight")
+        plt.close(fig)
+
+
+def generator():
+    """Uniform mix of incs, current reads, and temporal reads
+    (monotonic.clj:350-366)."""
+    return gen.mix([
+        gen.Fn(lambda test, ctx: {"f": "inc", "value": None}),
+        gen.Fn(lambda test, ctx: {"f": "read", "value": None}),
+        gen.Fn(lambda test, ctx: {"f": "read-at", "value": [None, None]}),
+    ])
+
+
+def checker() -> Checker:
+    return compose({
+        "monotonic": PerProcessMonotonicChecker(),
+        "timestamp-value": TimestampValueChecker(),
+        "not-found": NotFoundChecker(),
+        "timestamp-value-plot": TimestampValuePlotter(),
+    })
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "fauna_monotonic": True,
+        "generator": generator(),
+        "checker": checker(),
+    }

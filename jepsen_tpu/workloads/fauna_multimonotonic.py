@@ -1,0 +1,210 @@
+"""FaunaDB-style multi-register monotonic workload (reference:
+faunadb/src/jepsen/faunadb/multimonotonic.clj — increment-only
+registers, one writer per register doing blind writes for throughput,
+readers snapshotting random register subsets with the transaction
+timestamp; checkers hunt reads that flow backwards).
+
+Op shapes (multimonotonic.clj:85-105):
+- ``{"f": "write", "value": {k: v}}`` — blind-write register ``k`` to
+  ``v`` (single writer per key; values strictly increase).
+- ``{"f": "read", "value": [k, ...]}`` → ok value
+  ``{"ts": read_ts, "registers": {k: {"value": v, "ts": ts_k}, ...}}``.
+
+Checkers:
+- ``ts-order`` (multimonotonic.clj:255-272): order reads by their
+  transaction timestamp and play a state machine forward, tracking the
+  maximum observed value per register; a read showing a *lower* value
+  than an earlier-timestamped read is an internal consistency
+  violation.
+- ``read-skew`` (multimonotonic.clj:274-312): the reference describes
+  the cycle-detection algorithm in its docstring but ships a stub that
+  always passes; here it is implemented for real. Reads are vertices;
+  for each register, edges connect each read to the reads observing the
+  next-higher value of that register (the transitive reduction of <_k
+  over observed values); a strongly connected component with more than
+  one read is a set of mutually-unorderable snapshots — read skew.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker, compose
+from jepsen_tpu.workloads.fauna_monotonic import ts_key
+
+
+def generator(concurrency: int = 5):
+    """Half the threads write (each blind-writing a key derived from its
+    process id, so a crashed process starts a fresh key), half read
+    random nonempty subsets of the active keys
+    (multimonotonic.clj:314-341)."""
+    lock = threading.Lock()
+    last_vals: dict[Any, int] = {}  # key -> last written value
+    active: list = []               # insertion-ordered distinct keys
+
+    def write(test, ctx):
+        p = ctx.some_free_process()
+        if p is None:  # no free reserved thread: let fill_in_op pend
+            return {"f": "write", "value": None}
+        k = p
+        with lock:
+            v = last_vals.get(k, -1) + 1
+            last_vals[k] = v
+            if k not in active:
+                active.append(k)
+        return {"f": "write", "value": {k: v}, "process": p}
+
+    def read(test, ctx):
+        with lock:
+            ks = list(active)
+        if not ks:
+            ks = [0]
+        n = ctx.rng.randint(1, len(ks))
+        return {"f": "read", "value": sorted(ctx.rng.sample(ks, n))}
+
+    writers = max(1, concurrency // 2)
+    return gen.reserve(writers, gen.Fn(write), gen.Fn(read))
+
+
+# ---------------------------------------------------------------------------
+# ts-order checker
+# ---------------------------------------------------------------------------
+
+def read_state(op: dict) -> dict:
+    """Register key -> observed value for a read completion
+    (multimonotonic.clj:244-248)."""
+    regs = (op.get("value") or {}).get("registers") or {}
+    return {k: r.get("value") for k, r in regs.items()
+            if isinstance(r, dict)}
+
+
+def op_observation(op: dict, k) -> dict:
+    """What ``op`` observed for register ``k``
+    (multimonotonic.clj:163-177)."""
+    value = op.get("value") or {}
+    reg = (value.get("registers") or {}).get(k) or {}
+    return {"read-ts": value.get("ts"), "ts": reg.get("ts"),
+            "value": reg.get("value"), "op-index": op.get("index")}
+
+
+def nonmonotonic_states(ops: list) -> list:
+    """Plays reads forward, tracking the highest observation per key;
+    errors where a read's value undercuts the inferred lower bound
+    (multimonotonic.clj:179-242)."""
+    inferred: dict[Any, dict] = {}  # key -> highest observation
+    errors = []
+    for op in ops:
+        state = read_state(op)
+        bad = {}
+        for k, v in state.items():
+            prev = inferred.get(k)
+            if prev is not None and v is not None \
+                    and prev["value"] is not None and v < prev["value"]:
+                bad[k] = [prev, op_observation(op, k)]
+        if bad:
+            errors.append({
+                "inferred": {k: inferred[k]["value"] for k in state
+                             if k in inferred},
+                "observed": state,
+                "op": op,
+                "errors": bad,
+            })
+        for k, v in state.items():
+            prev = inferred.get(k)
+            if v is not None and (prev is None or prev["value"] is None
+                                  or prev["value"] < v):
+                inferred[k] = op_observation(op, k)
+    return errors
+
+
+class TsOrderChecker(Checker):
+    """(multimonotonic.clj:255-272)"""
+
+    def name(self):
+        return "ts-order"
+
+    def check(self, test, history, opts):
+        reads = sorted(
+            (op for op in history
+             if op.get("type") == "ok" and op.get("f") == "read"
+             and isinstance(op.get("value"), dict)
+             and op["value"].get("ts") is not None),
+            key=lambda op: ts_key(op["value"]["ts"]))
+        errs = nonmonotonic_states(reads)
+        return {"valid?": not errs, "errors": errs[:10],
+                "error-count": len(errs)}
+
+
+# ---------------------------------------------------------------------------
+# read-skew checker (the reference's docstring algorithm, implemented)
+# ---------------------------------------------------------------------------
+
+def skew_edges(reads: list) -> tuple[int, list[tuple[int, int]]]:
+    """(n_nodes, edges) over read snapshots: for each register, group
+    reads by observed value and chain each value class to the next
+    higher one *through a synthetic gate node* — reads(class i) → gate_i
+    → reads(class i+1) — so reachability matches the per-register value
+    order in O(reads) edges instead of a per-class cross product. Gates
+    only point forward, so same-value reads never form a spurious
+    cycle; any SCC holding >1 READ certifies incompatible orders — read
+    skew (multimonotonic.clj:283-299)."""
+    edges = []
+    by_key: dict[Any, dict] = {}
+    for i, op in enumerate(reads):
+        for k, v in read_state(op).items():
+            if v is not None:
+                by_key.setdefault(k, {}).setdefault(v, []).append(i)
+    n = len(reads)
+    for classes in by_key.values():
+        vals = sorted(classes)
+        for lo, hi in zip(vals, vals[1:]):
+            gate = n
+            n += 1
+            edges.extend((a, gate) for a in classes[lo])
+            edges.extend((gate, b) for b in classes[hi])
+    return n, edges
+
+
+class ReadSkewChecker(Checker):
+    """SCC detection over the union of per-register value orders; uses
+    the shared Tarjan (ops/scc.py) the Elle path rides."""
+
+    def name(self):
+        return "read-skew"
+
+    def check(self, test, history, opts):
+        reads = [op for op in history
+                 if op.get("type") == "ok" and op.get("f") == "read"
+                 and isinstance(op.get("value"), dict)]
+        n, edges = skew_edges(reads)
+        if not edges:
+            return {"valid?": True, "read-count": len(reads)}
+        from jepsen_tpu.ops.scc import tarjan_scc
+        sccs = []
+        for c in tarjan_scc(n, edges):
+            members = [i for i in c if i < len(reads)]  # drop gate nodes
+            if len(members) > 1:
+                sccs.append(members)
+        return {
+            "valid?": not sccs,
+            "read-count": len(reads),
+            "skew-component-count": len(sccs),
+            "skewed-reads": [[reads[i] for i in c[:4]] for c in sccs[:3]],
+        }
+
+
+def checker() -> Checker:
+    return compose({
+        "ts-order": TsOrderChecker(),
+        "read-skew": ReadSkewChecker(),
+    })
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    conc = int((test or {}).get("concurrency", 5))
+    return {
+        "fauna_multimonotonic": True,
+        "generator": generator(conc),
+        "checker": checker(),
+    }

@@ -1,0 +1,396 @@
+"""FaunaDB deep-suite probes: the monotonic / multimonotonic / internal
+workloads (checker soundness on known-bad histories, client FQL
+expression shapes, fake-mode lifecycles) and the topology membership
+nemesis (reference: faunadb/src/jepsen/faunadb/{monotonic,
+multimonotonic,internal,topology,nemesis}.clj)."""
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.suites import faunadb
+from jepsen_tpu.workloads import (fauna_internal, fauna_monotonic,
+                                  fauna_multimonotonic)
+
+from conftest import run_fake  # noqa: E402
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def dummy_test(**over):
+    t = {"nodes": list(NODES), "ssh": {"dummy": True}, "concurrency": 2}
+    t.update(over)
+    return t
+
+
+@pytest.fixture()
+def dummy():
+    t = dummy_test()
+    remote = control.default_remote(t)
+    yield t, remote
+    control.disconnect_all(t)
+
+
+# ---------------------------------------------------------------------------
+# monotonic: checkers
+# ---------------------------------------------------------------------------
+
+def _ok(f, value, process=0, index=0):
+    return {"type": "ok", "f": f, "value": value, "process": process,
+            "index": index}
+
+
+def test_monotonic_per_process_catches_value_regression():
+    history = [_ok("read", [1, 5], process=0),
+               _ok("read", [2, 3], process=0)]  # value went backwards
+    out = fauna_monotonic.PerProcessMonotonicChecker().check({}, history, {})
+    assert out["valid?"] is False
+    assert out["value-error-count"] == 1
+    assert out["ts-error-count"] == 0
+
+
+def test_monotonic_per_process_catches_ts_regression():
+    history = [_ok("inc", ["2020-01-01T00:00:09", 1], process=1),
+               _ok("read", ["2020-01-01T00:00:05", 2], process=1)]
+    out = fauna_monotonic.PerProcessMonotonicChecker().check({}, history, {})
+    assert out["valid?"] is False
+    assert out["ts-error-count"] == 1
+
+
+def test_monotonic_per_process_ignores_cross_process_order():
+    history = [_ok("read", [5, 9], process=0),
+               _ok("read", [6, 2], process=1)]  # different session: fine
+    out = fauna_monotonic.PerProcessMonotonicChecker().check({}, history, {})
+    assert out["valid?"] is True
+
+
+def test_timestamp_value_checker_global_order():
+    # read-at completions: higher timestamp must not show a lower value
+    history = [_ok("read-at", [10, 4]),
+               _ok("read-at", [20, 2]),
+               _ok("inc", [30, 5])]
+    out = fauna_monotonic.TimestampValueChecker().check({}, history, {})
+    assert out["valid?"] is False and out["error-count"] == 1
+    good = [_ok("read-at", [10, 1]), _ok("read-at", [20, 1]),
+            _ok("inc", [30, 2])]
+    assert fauna_monotonic.TimestampValueChecker().check(
+        {}, good, {})["valid?"] is True
+
+
+def test_not_found_checker():
+    history = [{"type": "fail", "f": "read", "error": ["not-found"]},
+               {"type": "invoke", "f": "read", "value": None}]
+    out = fauna_monotonic.NotFoundChecker().check({}, history, {})
+    assert out["valid?"] is False and out["error-count"] == 1
+
+
+def test_merged_windows():
+    assert fauna_monotonic.merged_windows(2, [5, 6, 20]) == [[3, 8], [18, 22]]
+    assert fauna_monotonic.merged_windows(2, []) == []
+
+
+def test_timestamp_value_plotter_renders_windows(tmp_path):
+    history = []
+    for i in range(40):
+        # process 0 sees a regression at ts 20
+        v = 3 if i == 20 else i // 2
+        history.append(_ok("read-at", [i, v], process=0, index=i))
+    t = {"name": "plot-test", "store_dir": str(tmp_path),
+         "start_time": "t"}
+    out = fauna_monotonic.TimestampValuePlotter().check(t, history, {})
+    assert out["valid?"] is True and out["spot-count"] >= 1
+    pngs = list(tmp_path.rglob("sequential-*.png"))
+    assert pngs, "expected a rendered window plot"
+
+
+# ---------------------------------------------------------------------------
+# multimonotonic: checkers
+# ---------------------------------------------------------------------------
+
+def _mread(ts, regs, index=0):
+    return {"type": "ok", "f": "read", "index": index,
+            "value": {"ts": ts,
+                      "registers": {k: {"value": v, "ts": ts}
+                                    for k, v in regs.items()}}}
+
+
+def test_ts_order_checker_catches_backwards_read():
+    history = [_mread(1, {"a": 5}, index=0),
+               _mread(2, {"a": 3}, index=1)]  # a regressed at later ts
+    out = fauna_multimonotonic.TsOrderChecker().check({}, history, {})
+    assert out["valid?"] is False
+    err = out["errors"][0]
+    assert err["inferred"] == {"a": 5} and err["observed"] == {"a": 3}
+    assert "a" in err["errors"]
+
+
+def test_ts_order_checker_valid_on_monotonic():
+    history = [_mread(1, {"a": 1, "b": 1}), _mread(2, {"a": 2}),
+               _mread(3, {"a": 2, "b": 4})]
+    assert fauna_multimonotonic.TsOrderChecker().check(
+        {}, history, {})["valid?"] is True
+
+
+def test_read_skew_checker_catches_skew():
+    # r1: x=1,y=2; r2: x=2,y=1 — x orders r1<r2, y orders r2<r1
+    history = [_mread(1, {"x": 1, "y": 2}, index=0),
+               _mread(2, {"x": 2, "y": 1}, index=1)]
+    out = fauna_multimonotonic.ReadSkewChecker().check({}, history, {})
+    assert out["valid?"] is False
+    assert out["skew-component-count"] == 1
+
+
+def test_read_skew_checker_valid_on_compatible_orders():
+    history = [_mread(1, {"x": 1, "y": 1}, index=0),
+               _mread(2, {"x": 2, "y": 1}, index=1),
+               _mread(3, {"x": 2, "y": 2}, index=2)]
+    out = fauna_multimonotonic.ReadSkewChecker().check({}, history, {})
+    assert out["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# internal: checker
+# ---------------------------------------------------------------------------
+
+def test_internal_checker_create_errors():
+    bad = [{"type": "ok", "f": "create-tabby-let",
+            "value": {"tabbies-0": ["cat-1"], "tabby": "cat-1",
+                      "tabbies-1": []}}]
+    out = fauna_internal.InternalChecker().check({}, bad, {})
+    assert out["valid?"] is False
+    assert out["error-types"] == ["missing-after-create",
+                                  "present-before-create"]
+
+
+def test_internal_checker_change_type_errors():
+    bad = [{"type": "ok", "f": "change-type",
+            "value": ["cat-2", ["cat-2"], []]}]
+    out = fauna_internal.InternalChecker().check({}, bad, {})
+    assert out["valid?"] is False
+    assert out["error-types"] == ["missing-after-change",
+                                  "present-after-change"]
+
+
+def test_internal_checker_valid():
+    good = [
+        {"type": "ok", "f": "create-tabby-obj",
+         "value": {"tabbies-0": [], "tabby": "cat-0",
+                   "tabbies-1": ["cat-0"]}},
+        {"type": "ok", "f": "change-type",
+         "value": ["cat-0", [], ["cat-0"]]},
+        {"type": "ok", "f": "change-type", "value": [None, [], []]},
+        {"type": "ok", "f": "reset", "value": None},
+    ]
+    assert fauna_internal.InternalChecker().check(
+        {}, good, {})["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# client FQL expression shapes (scripted _query doubles)
+# ---------------------------------------------------------------------------
+
+def test_monotonic_client_inc_expression():
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            return [{"@ts": "2020-01-01T00:00:01Z"}, 4]
+
+    out = TClient(node="n1").invoke(
+        {"fauna_monotonic": True},
+        {"f": "inc", "type": "invoke", "value": None})
+    assert out["type"] == "ok"
+    assert out["value"] == ["2020-01-01T00:00:01", 4]  # Z stripped
+    expr = sent[0]
+    assert expr[0] == faunadb.TIME_NOW
+    # the exists branch binds v then updates to v+1 and yields v
+    then = expr[1]["then"]
+    assert "let" in then
+    add = then["in"]["do"][0]["update"]
+    assert add == {"@ref": "classes/registers/0"}
+
+
+def test_monotonic_client_read_at_jitters_now():
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            if expr == faunadb.TIME_NOW:
+                return {"@ts": "2020-01-01T00:00:10Z"}
+            return ["2020-01-01T00:00:09.5", 3]
+
+    out = TClient(node="n1").invoke(
+        {"fauna_monotonic": True},
+        {"f": "read-at", "type": "invoke", "value": [None, None]})
+    assert out["type"] == "ok" and out["value"][1] == 3
+    # second query wraps the jittered (≤ now) timestamp in At, re-tagged
+    # as a timestamp VALUE through Time(), not a bare string
+    at = sent[1][1]
+    assert "at" in at and "time" in at["at"]
+    assert at["at"]["time"] <= "2020-01-01T00:00:10Z"
+    assert at["at"]["time"].endswith("Z")
+
+
+def test_multimonotonic_client_read_parses_instances():
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            return [{"@ts": "2020-01-01T00:00:02Z"},
+                    [{"ts": 123, "data": {"value": 7}}, None]]
+
+    out = TClient(node="n1").invoke(
+        {"fauna_multimonotonic": True},
+        {"f": "read", "type": "invoke", "value": [3, 9]})
+    assert out["type"] == "ok"
+    v = out["value"]
+    assert v["ts"] == "2020-01-01T00:00:02"
+    assert v["registers"] == {3: {"value": 7, "ts": 123}}  # 9 was absent
+
+
+def test_internal_client_obj_form_permutes_keys():
+    sent = []
+
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            sent.append(expr)
+            return {"c": {"data": []}, "a": "inst", "b": {"data": ["cat-5"]}}
+
+    out = TClient(node="n1").invoke(
+        {"fauna_internal": True},
+        {"f": "create-tabby-obj", "type": "invoke", "value": 5})
+    assert out["type"] == "ok"
+    assert out["value"] == {"tabbies-0": [], "tabby": "cat-5",
+                            "tabbies-1": ["cat-5"]}
+    obj = sent[0]["object"]
+    # declaration order c (before), a (create), b (after) — deliberately
+    # not alphabetical (internal.clj:98-113)
+    assert list(obj.keys()) == ["c", "a", "b"]
+    assert obj["a"]["create"] == {"@ref": "classes/cats/5"}
+
+
+def test_internal_client_change_type_value_shape():
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            return ["cat-1", {"data": []}, {"data": ["cat-1"]}]
+
+    out = TClient(node="n1").invoke(
+        {"fauna_internal": True},
+        {"f": "change-type", "type": "invoke", "value": None})
+    assert out["type"] == "ok"
+    assert out["value"] == ["cat-1", [], ["cat-1"]]
+
+
+def test_multimonotonic_not_found_read_fails_not_fabricates():
+    """A not-found on a multimonotonic read (key-list value) must NOT
+    take the register-workload's ok-empty recovery — with 2 keys the
+    shapes collide and a fabricated [k, None] would silently pass."""
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            raise faunadb.FaunaError([{"code": "instance not found"}])
+
+    out = TClient(node="n1").invoke(
+        {"fauna_multimonotonic": True},
+        {"f": "read", "type": "invoke", "value": [3, 9]})
+    assert out["type"] == "fail"
+    assert "not-found" in out["error"]
+
+
+def test_not_found_error_is_tagged_for_checker():
+    """The client's not-found failures carry the literal "not-found"
+    element the NotFoundChecker matches on."""
+    class TClient(faunadb.FaunaClient):
+        def _query(self, expr):
+            raise faunadb.FaunaError([{"code": "instance not found"}])
+
+    out = TClient(node="n1").invoke(
+        {"fauna_monotonic": True},
+        {"f": "read-at", "type": "invoke", "value": [5, None]})
+    assert out["type"] == "fail"  # temporal reads are idempotent: fail
+    res = fauna_monotonic.NotFoundChecker().check({}, [out], {})
+    assert res["valid?"] is False and res["error-count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycles
+# ---------------------------------------------------------------------------
+
+def test_fauna_fake_monotonic_run():
+    result = run_fake(faunadb.faunadb_test, workload="monotonic")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_fauna_fake_multimonotonic_run():
+    result = run_fake(faunadb.faunadb_test, workload="multimonotonic")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+def test_fauna_fake_internal_run():
+    result = run_fake(faunadb.faunadb_test, workload="internal")
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# topology membership nemesis
+# ---------------------------------------------------------------------------
+
+def test_topology_initial_model_and_ops(dummy):
+    t, _ = dummy
+    topo = faunadb.FaunaTopology(replicas=3)
+    topo._ensure_topo(t)
+    assert topo.topo["replica_count"] == 3
+    # 5 nodes over 3 replicas: two replicas have 2 members
+    op = topo.op(t)
+    assert op["f"] == "remove-node"  # nothing absent yet
+    assert op["value"] in {"n1", "n2", "n4", "n5"}  # n3 alone in replica-2
+
+
+def test_topology_invoke_remove_then_add(dummy):
+    t, remote = dummy
+    import random
+    topo = faunadb.FaunaTopology(replicas=3, rng=random.Random(7))
+    topo._ensure_topo(t)
+    out = topo.invoke(t, {"f": "remove-node", "value": "n1"})
+    assert out == ["removed", "n1"]
+    assert all(n["node"] != "n1" for n in topo.topo["nodes"])
+    cmds = [c for (kind, _h, c) in remote.log if kind == "exec"]
+    assert any("faunadb-admin remove n1" in c for c in cmds)
+    # n1 now absent → an add op becomes possible
+    ops = {topo.op(t)["f"] for _ in range(30)}
+    assert "add-node" in ops
+    out = topo.invoke(t, {"f": "add-node",
+                          "value": {"node": "n1", "join": "n2"}})
+    assert out[0] == "added"
+    assert any(n["node"] == "n1" for n in topo.topo["nodes"])
+    cmds = [c for (kind, _h, c) in remote.log if kind == "exec"]
+    assert any("faunadb-admin join" in c for c in cmds)
+
+
+def test_topology_node_view_parses_status(dummy):
+    t, _ = dummy
+    topo = faunadb.FaunaTopology()
+
+    class R:
+        pass
+
+    # scripted: feed a status table through a stand-in exec
+    import jepsen_tpu.control as ctl
+    real_on = ctl.on
+    try:
+        ctl.on = lambda node, test, fn: (
+            "n1 replica-0 Active\nn2 replica-1 Active\njunk line")
+        view = topo.node_view(t, "n1")
+    finally:
+        ctl.on = real_on
+    assert view == [
+        {"node": "n1", "replica": "replica-0", "state": "active"},
+        {"node": "n2", "replica": "replica-1", "state": "active"}]
+
+
+def test_fauna_fake_run_with_topology_fault():
+    result = run_fake(faunadb.faunadb_test, workload="register",
+                      faults={"topology"}, nemesis_interval=0.2,
+                      time_limit=1.5)
+    assert result["results"]["valid?"] is True, result["results"]
+    # the membership nemesis actually emitted topology transitions
+    fs = {op.get("f") for op in result["history"]
+          if not isinstance(op.get("process"), int)}
+    assert fs & {"add-node", "remove-node"}, fs
