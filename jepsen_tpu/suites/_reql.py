@@ -40,7 +40,10 @@ DB = 14
 TABLE = 15
 GET = 16
 EQ = 17
+ADD = 24
 GET_FIELD = 31
+MAP = 38
+COERCE_TO = 51
 UPDATE = 53
 INSERT = 56
 DB_CREATE = 57
@@ -48,6 +51,7 @@ TABLE_CREATE = 60
 BRANCH = 65
 FUNC = 69
 DEFAULT = 92
+RECONFIGURE = 176
 
 
 class ReqlError(Exception):
@@ -120,6 +124,27 @@ def table_create(db_term, name: str, replicas: int | None = None):
     opt = {"replicas": replicas} if replicas else {}
     return ([TABLE_CREATE, [db_term, name], opt] if opt
             else [TABLE_CREATE, [db_term, name]])
+
+
+def add(a, b):
+    return [ADD, [a, b]]
+
+
+def map_(seq, func_term):
+    return [MAP, [seq, func_term]]
+
+
+def coerce_to(term, type_name: str):
+    return [COERCE_TO, [term, type_name]]
+
+
+def reconfigure(table_term, replicas: dict, primary_tag: str,
+                shards: int = 1):
+    """table.reconfigure({shards, replicas: {tag: n}, primary_replica_tag})
+    — the topology-change admin term (rethinkdb.clj:180-193)."""
+    return [RECONFIGURE, [table_term],
+            {"shards": shards, "replicas": dict(replicas),
+             "primary_replica_tag": primary_tag}]
 
 
 class ReqlConnection:

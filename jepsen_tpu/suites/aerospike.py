@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 
 from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu import nemesis as nemesis_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
@@ -202,17 +203,142 @@ class AerospikeClient(Client):
 SUPPORTED_WORKLOADS = ("register", "counter", "set")
 
 
+# ---------------------------------------------------------------------------
+# Killer nemesis (aerospike/nemesis.clj:17-128): capped kills, restarts,
+# and the SC-mode revive/recluster recovery vocabulary
+# ---------------------------------------------------------------------------
+
+DEFAULT_MAX_DEAD = 2  # --max-dead-nodes default (aerospike/core.clj:91-94)
+
+
+class KillerNemesis(nemesis_mod.Nemesis):
+    """``kill`` SIGKILLs asd on a random nonempty node subset but never
+    lets more than ``max_dead`` nodes stay down at once (capped-conj,
+    nemesis.clj:11-15,31-36); ``restart`` brings a subset back;
+    ``revive`` + ``recluster`` run the asinfo recovery pair that
+    readmits dead-partition data in strong-consistency mode
+    (support.clj:142-152)."""
+
+    def __init__(self, max_dead: int = DEFAULT_MAX_DEAD, signal: int = 9,
+                 rng=None):
+        import random as _random
+        import threading
+        self.max_dead = max_dead
+        self.signal = signal
+        self.rng = rng or _random.Random()
+        self.dead: set = set()
+        # per-node closures run concurrently (_on_nodes/real_pmap); the
+        # cap check-then-add must be atomic like the reference's
+        # capped-conj swap! (nemesis.clj:11-15) or a slow multi-node
+        # kill op blows past max_dead
+        self._dead_lock = threading.Lock()
+
+    def fs(self):
+        return {"kill", "restart", "revive", "recluster"}
+
+    def invoke(self, test, op):
+        from jepsen_tpu.nemesis.db_specific import _on_nodes
+        f = op.get("f")
+        # subsets come from the generator (nemesis.clj:59-77); a bare op
+        # (e.g. the final heal) targets every node
+        nodes = op.get("value") or list(test.get("nodes") or [])
+
+        def one(node):
+            if f == "kill":
+                with self._dead_lock:
+                    allowed = (node in self.dead
+                               or len(self.dead) < self.max_dead)
+                    if allowed:
+                        self.dead.add(node)
+                if not allowed:
+                    return "still-alive"
+                control.exec_(control.lit(
+                    f"killall -{self.signal} asd "
+                    f">/dev/null 2>&1 || true"))
+                return "killed"
+            if f == "restart":
+                control.exec_("service", "aerospike", "restart")
+                with self._dead_lock:
+                    self.dead.discard(node)
+                return "started"
+            if f == "revive":
+                return control.exec_(control.lit(
+                    f"asinfo -v revive:namespace={NAMESPACE} "
+                    f"2>&1 || echo not-running"))
+            if f == "recluster":
+                return control.exec_(control.lit(
+                    "asinfo -v recluster: 2>&1 || echo not-running"))
+            return "unknown-f"
+
+        return {**op, "type": "info",
+                "value": _on_nodes(test, nodes, one)}
+
+
+def killer_gen():
+    """Randomized kill / restart / revive+recluster patterns; kill and
+    restart ops carry a random nonempty node subset computed at
+    generation time, revive/recluster target every node
+    (nemesis.clj:59-94)."""
+    from jepsen_tpu import generator as gen
+
+    def subset(test, ctx):
+        nodes = list(test.get("nodes") or [])
+        return ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes))) \
+            if nodes else []
+
+    def fn(test, ctx):
+        pattern = ctx.rng.choice([["kill"], ["restart"],
+                                  ["revive", "recluster"]])
+        return gen.Seq([
+            {"type": "info", "f": f,
+             "value": (subset(test, ctx) if f in ("kill", "restart")
+                       else list(test.get("nodes") or []))}
+            for f in pattern])
+
+    return gen.Fn(fn)
+
+
+def killer_package(opts: dict) -> dict:
+    """--fault killer: the full kill/restart/revive/recluster cycle,
+    healed by a final restart + recovery pair."""
+    from jepsen_tpu import generator as gen
+    interval = opts.get("interval", 10.0)
+    return {
+        "nemesis": KillerNemesis(
+            max_dead=opts.get("max_dead_nodes", DEFAULT_MAX_DEAD)),
+        "generator": gen.stagger(interval, killer_gen()),
+        "final_generator": gen.Seq([
+            {"type": "info", "f": "restart", "value": None},
+            {"type": "info", "f": "revive", "value": None},
+            {"type": "info", "f": "recluster", "value": None}]),
+        "perf": {"name": "killer",
+                 "fs": {"kill", "restart", "revive", "recluster"},
+                 "start": {"kill"}, "stop": {"restart"}},
+    }
+
+
 def aerospike_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    max_dead = o.get("max_dead_nodes")
     return build_suite_test(
-        opts_dict, db_name="aerospike",
+        o, db_name="aerospike",
         supported_workloads=SUPPORTED_WORKLOADS,
+        fault_packages={"killer": lambda opts: killer_package(
+            {**opts, "max_dead_nodes": max_dead}
+            if max_dead is not None else opts)},
         make_real=lambda o: {"db": AerospikeDB(),
                              "client": AerospikeClient(), "os": Debian()})
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(aerospike_test),
-    standard_opt_fn(SUPPORTED_WORKLOADS),
+    standard_test_fn(aerospike_test, extra_keys=("max_dead_nodes",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("killer",),
+                    extra=lambda p: p.add_argument(
+                        "--max-dead-nodes", dest="max_dead_nodes",
+                        type=int, default=None,
+                        help="cap on simultaneously-killed nodes "
+                             "(aerospike/core.clj:91-94; default "
+                             f"{DEFAULT_MAX_DEAD})")),
     name="jepsen-aerospike")
 
 

@@ -18,8 +18,10 @@ from __future__ import annotations
 
 import logging
 import urllib.error
+import urllib.parse
 
 from jepsen_tpu import cli, db as db_mod
+from jepsen_tpu import nemesis as nemesis_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
@@ -338,6 +340,102 @@ class DgraphAborted(DgraphError):
     """Server-side txn abort (commit 409): a definite failure."""
 
 
+# ---------------------------------------------------------------------------
+# Tablet-mover nemesis (dgraph/nemesis.clj:51-99): shuffles predicate
+# tablets between groups through zero's admin HTTP API
+# ---------------------------------------------------------------------------
+
+ZERO_HTTP_PORT = 6080
+
+
+def zero_state(node: str, timeout_s: float = 5.0):
+    """Zero's ``/state`` — group/tablet/leader topology, or "timeout"
+    when zero doesn't answer (support.clj:159-170)."""
+    try:
+        return http_json(f"http://{node}:{ZERO_HTTP_PORT}/state",
+                         timeout_s=timeout_s)
+    except (urllib.error.HTTPError, *NET_ERRORS):
+        return "timeout"
+
+
+def zero_leader(state) -> str | None:
+    """The zero leader's node name from a ``/state`` body
+    (support.clj:172-181)."""
+    for z in (state.get("zeros") or {}).values():
+        if z.get("leader"):
+            addr = z.get("addr") or ""
+            return addr.split(":")[0] or None
+    return None
+
+
+class TabletMover(nemesis_mod.Nemesis):
+    """On each op, asks the zero leader to move randomly chosen tablets
+    to randomly chosen other groups (dgraph/nemesis.clj:51-99). The op
+    value maps each predicate to its [from, to] group pair; reserved
+    predicates and not-leader rejections are recorded, not raised."""
+
+    def __init__(self, rng=None):
+        import random as _random
+        self.rng = rng or _random.Random()
+
+    def fs(self):
+        return {"move-tablet"}
+
+    def invoke(self, test, op):
+        nodes = list(test.get("nodes") or [])
+        state = zero_state(self.rng.choice(nodes)) if nodes else "timeout"
+        if state == "timeout" or not isinstance(state, dict):
+            return {**op, "type": "info", "value": "timeout"}
+        groups = sorted((state.get("groups") or {}).keys())
+        leader = zero_leader(state) or (nodes[0] if nodes else None)
+        tablets = []
+        for group_id, group in sorted((state.get("groups") or {}).items()):
+            for pred in sorted((group.get("tablets") or {})):
+                tablets.append((pred, str(group_id)))
+        self.rng.shuffle(tablets)
+        moves = {}
+        for pred, group in tablets:
+            group2 = self.rng.choice(groups) if groups else group
+            if str(group2) == str(group):
+                continue
+            try:
+                http_json(
+                    f"http://{leader}:{ZERO_HTTP_PORT}/moveTablet"
+                    f"?tablet={urllib.parse.quote(pred)}&group={group2}",
+                    timeout_s=20.0)
+                moves[pred] = [group, str(group2)]
+            except urllib.error.HTTPError as e:
+                try:  # zero's refusals are plain text, not JSON
+                    body = e.read().decode(errors="replace")
+                except OSError:
+                    body = ""
+                # reserved predicates / stale leaders: expected refusals
+                # (nemesis.clj:84-95) — recorded distinguishably from
+                # completed moves so history consumers aren't misled
+                if "Unable to move reserved" in body \
+                        or "not leader" in body.lower():
+                    moves[pred] = ["refused", group, str(group2)]
+                else:
+                    raise
+            except NET_ERRORS:
+                moves[pred] = ["error", "net"]
+        return {**op, "type": "info", "value": moves}
+
+
+def tablet_mover_package(opts: dict) -> dict:
+    """--fault move-tablet: periodic tablet shuffles."""
+    from jepsen_tpu import generator as gen
+    interval = opts.get("interval", 10.0)
+    return {
+        "nemesis": TabletMover(),
+        "generator": gen.stagger(interval, gen.repeat(
+            {"type": "info", "f": "move-tablet", "value": None})),
+        "final_generator": None,
+        "perf": {"name": "move-tablet", "fs": {"move-tablet"},
+                 "start": set(), "stop": set()},
+    }
+
+
 SUPPORTED_WORKLOADS = ("set", "register", "bank", "wr", "long-fork",
                        "upsert")
 
@@ -345,6 +443,7 @@ SUPPORTED_WORKLOADS = ("set", "register", "bank", "wr", "long-fork",
 def dgraph_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="dgraph", supported_workloads=SUPPORTED_WORKLOADS,
+        fault_packages={"move-tablet": tablet_mover_package},
         make_real=lambda o: {
             "db": DgraphDB(o.get("version", DEFAULT_VERSION)),
             "client": DgraphClient(), "os": Debian()})
@@ -352,7 +451,7 @@ def dgraph_test(opts_dict: dict | None = None) -> dict:
 
 main = cli.single_test_cmd(
     standard_test_fn(dgraph_test, extra_keys=("version",)),
-    standard_opt_fn(SUPPORTED_WORKLOADS,
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("move-tablet",),
                     extra=lambda p: p.add_argument(
                         "--version", default=DEFAULT_VERSION)),
     name="jepsen-dgraph")

@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 
 from jepsen_tpu import cli, control, db as db_mod
+from jepsen_tpu import nemesis as nemesis_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
@@ -33,6 +34,17 @@ DRIVER_PORT = 28015
 CLUSTER_PORT = 29015
 DB_NAME = "jepsen"
 TABLE = "cas"
+SET_TABLE = "elements"
+COUNTER_TABLE = "counter"
+
+
+def active_table(test: dict) -> str:
+    """The table the running workload lives in (reconfigure targets it
+    too)."""
+    if test.get("counter"):
+        return COUNTER_TABLE
+    name = str(test.get("name") or "")
+    return SET_TABLE if name.endswith("-set") else TABLE
 CAS_ABORT_SENTINEL = "jepsen-cas-precondition-abort"
 CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
 LOG_FILE = "/var/log/rethinkdb"
@@ -42,6 +54,9 @@ def config(test: dict, node: str) -> str:
     """Config with join= lines for every peer (rethinkdb.clj:67-87)."""
     lines = ["bind=all",
              f"server-name={node}",
+             # per-node server tags are what reconfigure! targets
+             # replicas by (rethinkdb.clj:86,184-188)
+             f"server-tag={node}",
              f"directory=/var/lib/rethinkdb/jepsen"]
     lines += [f"join={n}:{CLUSTER_PORT}" for n in (test.get("nodes") or [])
               if n != node]
@@ -108,10 +123,17 @@ class RethinkDBClient(Client):
             pass  # already exists
         try:
             self.conn.run(r.table_create(
-                r.db(DB_NAME), TABLE,
+                r.db(DB_NAME), active_table(test),
                 replicas=len(test.get("nodes") or []) or None))
         except ReqlError:
             pass
+        if test.get("counter"):
+            try:  # single counter row, starts at 0
+                self.conn.run(r.insert(
+                    r.table(r.db(DB_NAME), active_table(test)),
+                    {"id": 0, "val": 0}, conflict="error"))
+            except ReqlError:
+                pass
         # table-level write acks (document_cas.clj set-write-acks!)
         try:
             self.conn.run([r.UPDATE, [
@@ -127,6 +149,40 @@ class RethinkDBClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("counter") and f == "add":
+                # atomic in-document add (the per-document atomicity the
+                # register CAS also rides); a skipped update (missing
+                # counter row) must NOT ack, or the checker's
+                # acknowledged-sum bound convicts a healthy run
+                res = self.conn.run(r.update(
+                    r.get(r.table(r.db(DB_NAME), COUNTER_TABLE), 0),
+                    r.func({"val": r.add(r.get_field(r.var(1), "val"),
+                                         int(v))})))
+                applied = (isinstance(res, dict) and res.get("errors") == 0
+                           and res.get("replaced") == 1)
+                if applied:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": ["not-applied", res]}
+            if test.get("counter") and f == "read" and v is None:
+                out = self.conn.run(r.default(r.get_field(
+                    r.get(r.table(r.db(DB_NAME), COUNTER_TABLE,
+                                  read_mode=self.read_mode), 0),
+                    "val"), 0))
+                return {**op, "type": "ok", "value": int(out or 0)}
+            if f == "add":
+                # set adds: one doc per element, id = the element
+                self.conn.run(r.insert(
+                    r.table(r.db(DB_NAME), SET_TABLE), {"id": int(v)},
+                    conflict="update"))
+                return {**op, "type": "ok"}
+            if f == "read" and v is None:
+                out = self.conn.run(r.coerce_to(
+                    r.map_(r.table(r.db(DB_NAME), SET_TABLE,
+                                   read_mode=self.read_mode),
+                           r.func(r.get_field(r.var(1), "id"))),
+                    "array"))
+                return {**op, "type": "ok",
+                        "value": sorted(int(x) for x in out or [])}
             if f == "read":
                 k, _ = v
                 out = self.conn.run(
@@ -171,13 +227,89 @@ class RethinkDBClient(Client):
             self.conn.close()
 
 
-SUPPORTED_WORKLOADS = ("register",)
+# ---------------------------------------------------------------------------
+# Reconfigure nemesis (rethinkdb.clj:180-232): randomly re-replicate and
+# re-primary the workload's table through the admin reconfigure term
+# ---------------------------------------------------------------------------
+
+class ReconfigureNemesis(nemesis_mod.Nemesis):
+    """Each op picks a random nonempty replica set and primary (by
+    server tag = node name) and reconfigures the active table to it;
+    tag-not-found / servers-unreachable errors retry up to 10 times
+    (rethinkdb.clj:195-232)."""
+
+    RETRYABLE = ("Could not find any servers with server tag",
+                 "currently unreachable")
+
+    def __init__(self, rng=None, timeout_s: float = 5.0):
+        import random as _random
+        self.rng = rng or _random.Random()
+        self.timeout_s = timeout_s
+
+    def fs(self):
+        return {"reconfigure"}
+
+    def _reconfigure_once(self, test):
+        nodes = list(test.get("nodes") or [])
+        size = self.rng.randint(1, len(nodes))
+        replicas = self.rng.sample(nodes, size)
+        primary = self.rng.choice(replicas)
+        conn = self._connect(primary)
+        try:
+            res = conn.run(r.reconfigure(
+                r.table(r.db(DB_NAME), active_table(test)),
+                {n: 1 for n in replicas}, primary))
+            if not (isinstance(res, dict) and res.get("reconfigured") == 1):
+                # surfaces through invoke's ReqlError handling as a
+                # non-retryable ["error", ...] value (an assert would
+                # escape it — and vanish under -O)
+                raise ReqlError(0, [f"unexpected reconfigure result: {res}"])
+            return {"replicas": replicas, "primary": primary}
+        finally:
+            conn.close()
+
+    def _connect(self, primary):
+        return ReqlConnection(primary, DRIVER_PORT, timeout_s=self.timeout_s)
+
+    def invoke(self, test, op):
+        last = None
+        for _ in range(10):
+            try:
+                return {**op, "type": "info",
+                        "value": self._reconfigure_once(test)}
+            except ReqlError as e:
+                last = e
+                if not any(pat in str(e) for pat in self.RETRYABLE):
+                    break
+            except (TimeoutError, ConnectionError, OSError) as e:
+                return {**op, "type": "info", "value": "timeout",
+                        "error": ["net", str(e)]}
+        return {**op, "type": "info", "value": ["error", str(last)]}
+
+
+def reconfigure_package(opts: dict) -> dict:
+    """--fault reconfigure: periodic topology churn on the active
+    table."""
+    from jepsen_tpu import generator as gen
+    interval = opts.get("interval", 10.0)
+    return {
+        "nemesis": ReconfigureNemesis(),
+        "generator": gen.stagger(interval, gen.repeat(
+            {"type": "info", "f": "reconfigure", "value": None})),
+        "final_generator": None,
+        "perf": {"name": "reconfigure", "fs": {"reconfigure"},
+                 "start": set(), "stop": set()},
+    }
+
+
+SUPPORTED_WORKLOADS = ("register", "set", "counter")
 
 
 def rethinkdb_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="rethinkdb",
         supported_workloads=SUPPORTED_WORKLOADS,
+        fault_packages={"reconfigure": reconfigure_package},
         make_real=lambda o: {
             "db": RethinkDB(),
             "client": RethinkDBClient(o.get("write_acks", "majority"),
@@ -187,7 +319,7 @@ def rethinkdb_test(opts_dict: dict | None = None) -> dict:
 
 main = cli.single_test_cmd(
     standard_test_fn(rethinkdb_test, extra_keys=("write_acks", "read_mode")),
-    standard_opt_fn(SUPPORTED_WORKLOADS,
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("reconfigure",),
                     extra=lambda p: (
                         p.add_argument("--write-acks", dest="write_acks",
                                        default="majority",

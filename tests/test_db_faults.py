@@ -1,0 +1,309 @@
+"""Suite-specific fault machinery: dgraph's tablet-mover, aerospike's
+kill/revive/recluster vocabulary, rethinkdb's reconfigure nemesis, plus
+the rethinkdb set/counter workloads those faults exercise (references:
+dgraph/src/jepsen/dgraph/nemesis.clj:51-99,
+aerospike/src/aerospike/nemesis.clj:17-128,
+rethinkdb/src/jepsen/rethinkdb.clj:180-232)."""
+import random
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.suites import _reql as r
+from jepsen_tpu.suites import aerospike, dgraph, rethinkdb
+from jepsen_tpu.suites._reql import ReqlError
+
+from conftest import run_fake  # noqa: E402
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def dummy_test(**over):
+    t = {"nodes": list(NODES), "ssh": {"dummy": True}, "concurrency": 2}
+    t.update(over)
+    return t
+
+
+@pytest.fixture()
+def dummy():
+    t = dummy_test()
+    remote = control.default_remote(t)
+    yield t, remote
+    control.disconnect_all(t)
+
+
+# ---------------------------------------------------------------------------
+# dgraph tablet-mover
+# ---------------------------------------------------------------------------
+
+ZERO_STATE = {
+    "zeros": {"1": {"addr": "n2:5080", "leader": True},
+              "2": {"addr": "n1:5080"}},
+    "groups": {
+        "1": {"tablets": {"key": {"predicate": "key", "groupId": 1}}},
+        "2": {"tablets": {"el": {"predicate": "el", "groupId": 2}}}},
+}
+
+
+def test_zero_leader_parse():
+    assert dgraph.zero_leader(ZERO_STATE) == "n2"
+    assert dgraph.zero_leader({"zeros": {}}) is None
+
+
+def test_tablet_mover_moves_through_leader(monkeypatch):
+    urls = []
+
+    def fake_http(url, body=None, **kw):
+        urls.append(url)
+        if url.endswith("/state"):
+            return ZERO_STATE
+        return ""
+
+    monkeypatch.setattr(dgraph, "http_json", fake_http)
+    mover = dgraph.TabletMover(rng=random.Random(3))
+    out = mover.invoke({"nodes": NODES},
+                       {"type": "info", "f": "move-tablet", "value": None})
+    assert out["type"] == "info"
+    moves = out["value"]
+    assert isinstance(moves, dict) and moves, moves
+    # every move went to the zero LEADER's admin endpoint with both params
+    move_urls = [u for u in urls if "/moveTablet" in u]
+    assert move_urls and all(u.startswith("http://n2:6080/") for u in move_urls)
+    assert all("tablet=" in u and "group=" in u for u in move_urls)
+    # recorded as {predicate: [from, to]} with from != to
+    for pred, (frm, to) in moves.items():
+        assert frm != to
+
+
+def test_tablet_mover_timeout_value(monkeypatch):
+    monkeypatch.setattr(dgraph, "http_json",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("down")))
+    mover = dgraph.TabletMover()
+    out = mover.invoke({"nodes": NODES},
+                       {"type": "info", "f": "move-tablet", "value": None})
+    assert out["value"] == "timeout"
+
+
+def test_dgraph_fake_run_with_move_tablet_fault():
+    result = run_fake(dgraph.dgraph_test, workload="register",
+                      faults={"move-tablet"}, nemesis_interval=0.3)
+    assert result["results"]["valid?"] is True, result["results"]
+    fs = {op.get("f") for op in result["history"]
+          if not isinstance(op.get("process"), int)}
+    assert "move-tablet" in fs
+
+
+# ---------------------------------------------------------------------------
+# aerospike killer
+# ---------------------------------------------------------------------------
+
+def test_killer_kill_respects_max_dead(dummy):
+    t, remote = dummy
+    n = aerospike.KillerNemesis(max_dead=2, rng=random.Random(1))
+    out = n.invoke(t, {"type": "info", "f": "kill",
+                       "value": ["n1", "n2", "n3"]})
+    vals = out["value"]
+    assert sorted(vals) == ["n1", "n2", "n3"]
+    assert sorted(v for v in vals.values()) == [
+        "killed", "killed", "still-alive"]
+    assert len(n.dead) == 2
+    cmds = [c for (k, _h, c) in remote.log if k == "exec"]
+    assert sum("killall -9 asd" in c for c in cmds) == 2
+
+
+def test_killer_kill_cap_holds_under_concurrency(dummy):
+    """The cap check-then-add must be atomic: _on_nodes runs per-node
+    closures on real threads, and with SSH-like latency every thread
+    would otherwise see the dead set empty (nemesis.clj:11-15's atomic
+    capped-conj)."""
+    import time
+
+    t, remote = dummy
+    real_execute = type(remote).execute
+
+    def slow_execute(self, ctx, cmd):
+        time.sleep(0.05)
+        return real_execute(self, ctx, cmd)
+
+    n = aerospike.KillerNemesis(max_dead=2)
+    try:
+        type(remote).execute = slow_execute
+        out = n.invoke(t, {"type": "info", "f": "kill", "value": NODES})
+    finally:
+        type(remote).execute = real_execute
+    assert sorted(out["value"].values()).count("killed") == 2
+    assert len(n.dead) == 2
+
+
+def test_tablet_mover_marks_refusals(monkeypatch):
+    import urllib.error
+
+    def fake_http(url, body=None, **kw):
+        if url.endswith("/state"):
+            return ZERO_STATE
+        raise urllib.error.HTTPError(
+            url, 500, "err", {}, __import__("io").BytesIO(
+                b"Unable to move reserved predicate"))
+
+    monkeypatch.setattr(dgraph, "http_json", fake_http)
+    mover = dgraph.TabletMover(rng=random.Random(3))
+    out = mover.invoke({"nodes": NODES},
+                       {"type": "info", "f": "move-tablet", "value": None})
+    assert out["value"], out
+    for entry in out["value"].values():
+        assert entry[0] == "refused" and len(entry) == 3
+
+
+def test_killer_restart_revive_recluster(dummy):
+    t, remote = dummy
+    n = aerospike.KillerNemesis(max_dead=2)
+    n.dead = {"n1", "n2"}
+    out = n.invoke(t, {"type": "info", "f": "restart",
+                       "value": ["n1", "n2"]})
+    assert all(v == "started" for v in out["value"].values())
+    assert not n.dead
+    n.invoke(t, {"type": "info", "f": "revive", "value": None})
+    n.invoke(t, {"type": "info", "f": "recluster", "value": None})
+    cmds = [c for (k, _h, c) in remote.log if k == "exec"]
+    assert any("asinfo -v revive:namespace=jepsen" in c for c in cmds)
+    assert any("asinfo -v recluster:" in c for c in cmds)
+    # revive/recluster with no explicit subset hit EVERY node
+    revive_hosts = {h for (k, h, c) in remote.log
+                    if k == "exec" and "revive:" in c}
+    assert revive_hosts == set(NODES)
+
+
+def test_killer_gen_patterns():
+    from jepsen_tpu import generator as gen
+    g = gen.time_limit(5.0, gen.nemesis_gen(aerospike.killer_gen()))
+    t = dummy_test()
+    ctx = gen.context(t)
+    seen = set()
+    for _ in range(60):
+        res = g.op(t, ctx)
+        if res is None:
+            break
+        op, g = res
+        if op is gen.PENDING or op.get("f") is None:
+            break
+        seen.add(op.get("f"))
+        if op.get("f") in ("kill", "restart"):
+            assert op.get("value"), "kill/restart must carry a node subset"
+        g = g.update(t, ctx, {**op, "type": "info"})
+    assert {"kill", "restart", "revive", "recluster"} <= seen
+
+
+def test_aerospike_fake_run_with_killer_fault():
+    result = run_fake(aerospike.aerospike_test, workload="register",
+                      faults={"killer"}, nemesis_interval=0.3)
+    assert result["results"]["valid?"] is True, result["results"]
+    fs = {op.get("f") for op in result["history"]
+          if not isinstance(op.get("process"), int)}
+    assert fs & {"kill", "restart", "revive", "recluster"}, fs
+
+
+# ---------------------------------------------------------------------------
+# rethinkdb reconfigure
+# ---------------------------------------------------------------------------
+
+class FakeConn:
+    def __init__(self, script):
+        self.script = script  # list of results or exceptions
+        self.terms = []
+
+    def run(self, term):
+        self.terms.append(term)
+        out = self.script.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def close(self):
+        pass
+
+
+def scripted_reconfigurer(script, rng=None):
+    conn = FakeConn(script)
+
+    class TNemesis(rethinkdb.ReconfigureNemesis):
+        def _connect(self, primary):
+            conn.primary = primary
+            return conn
+
+    return TNemesis(rng=rng or random.Random(5)), conn
+
+
+def test_reconfigure_term_shape():
+    n, conn = scripted_reconfigurer([{"reconfigured": 1}])
+    t = dummy_test(name="rethinkdb-register")
+    out = n.invoke(t, {"type": "info", "f": "reconfigure", "value": None})
+    v = out["value"]
+    assert v["primary"] in v["replicas"]
+    term = conn.terms[0]
+    assert term[0] == r.RECONFIGURE
+    opts = term[2]
+    assert opts["shards"] == 1
+    assert opts["primary_replica_tag"] == v["primary"]
+    assert set(opts["replicas"]) == set(v["replicas"])
+    assert all(x == 1 for x in opts["replicas"].values())
+    # the connection went to the new primary itself
+    assert conn.primary == v["primary"]
+
+
+def test_reconfigure_retries_tag_errors():
+    err = ReqlError(18, ["Could not find any servers with server tag n3"])
+    n, conn = scripted_reconfigurer([err, err, {"reconfigured": 1}])
+    out = n.invoke(dummy_test(), {"type": "info", "f": "reconfigure",
+                                  "value": None})
+    assert isinstance(out["value"], dict)
+    assert len(conn.terms) == 3
+
+
+def test_reconfigure_gives_up_on_other_errors():
+    err = ReqlError(18, ["Table `jepsen.cas` does not exist"])
+    n, conn = scripted_reconfigurer([err])
+    out = n.invoke(dummy_test(), {"type": "info", "f": "reconfigure",
+                                  "value": None})
+    assert out["value"][0] == "error"
+    assert len(conn.terms) == 1
+
+
+# ---------------------------------------------------------------------------
+# rethinkdb set / counter workloads
+# ---------------------------------------------------------------------------
+
+def scripted_client(results):
+    conn = FakeConn(list(results))
+    c = rethinkdb.RethinkDBClient()
+    c.conn = conn
+    return c, conn
+
+
+def test_rethinkdb_set_client_ops():
+    c, conn = scripted_client([{"inserted": 1}, [3, 1, 2]])
+    out = c.invoke({}, {"f": "add", "type": "invoke", "value": 3})
+    assert out["type"] == "ok"
+    ins = conn.terms[0]
+    assert ins[0] == r.INSERT and ins[1][1] == {"id": 3}
+    out = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "ok" and out["value"] == [1, 2, 3]
+    read = conn.terms[1]
+    assert read[0] == r.COERCE_TO and read[1][1] == "array"
+
+
+def test_rethinkdb_counter_client_ops():
+    t = {"counter": True}
+    c, conn = scripted_client([{"replaced": 1, "errors": 0}, 7])
+    out = c.invoke(t, {"f": "add", "type": "invoke", "value": 2})
+    assert out["type"] == "ok"
+    upd = conn.terms[0]
+    assert upd[0] == r.UPDATE
+    out = c.invoke(t, {"f": "read", "type": "invoke", "value": None})
+    assert out["type"] == "ok" and out["value"] == 7
+
+
+def test_rethinkdb_fake_set_and_counter_runs():
+    result = run_fake(rethinkdb.rethinkdb_test, workload="set")
+    assert result["results"]["valid?"] is True, result["results"]
+    result = run_fake(rethinkdb.rethinkdb_test, workload="counter")
+    assert result["results"]["valid?"] is True, result["results"]
