@@ -146,6 +146,10 @@ class KVStore(MetaLogDB):
         self.cmt: dict = {}        # comments workload: key -> set of ids
         self.tables: set = set()   # table workload: created table ids
         self.lu: dict = {}         # lost-updates workload: key -> set
+        self.mono_keys: dict = {}  # monotonic-key pool (tidb inc-workload)
+        self.ledger: dict = {}     # ledger workload: account -> balance
+        self.del_records: dict = {}  # delete workload: key -> uid
+        self.del_next = 0
 
     def _wipe(self):
         self.registers.clear()
@@ -163,6 +167,10 @@ class KVStore(MetaLogDB):
         self.cmt.clear()
         self.tables.clear()
         self.lu.clear()
+        self.mono_keys.clear()
+        self.ledger.clear()
+        self.del_records.clear()
+        self.del_next = 0
 
     def read(self, k):
         with self.lock:
@@ -365,6 +373,59 @@ class KVStore(MetaLogDB):
         with self.lock:
             return [[v, ts] for v, ts in self.mono]
 
+    # delete workload (workloads/delete_workload.py, dgraph/delete.clj):
+    # key -> uid; reads see the whole record
+    def del_upsert(self, k):
+        """uid when created, None when already present."""
+        with self.lock:
+            if k in self.del_records:
+                return None
+            self.del_next += 1
+            self.del_records[k] = f"0x{self.del_next:x}"
+            return self.del_records[k]
+
+    def del_delete(self, k):
+        with self.lock:
+            return self.del_records.pop(k, None)
+
+    def del_read(self, k) -> list:
+        with self.lock:
+            uid = self.del_records.get(k)
+            return [{"uid": uid, "key": k}] if uid is not None else []
+
+    # per-process-monotonic register (workloads/dgraph_sequential.py)
+    def seq_reg_inc(self, k) -> int:
+        with self.lock:
+            v = self.mono_keys.get(("seq", k), 0) + 1
+            self.mono_keys[("seq", k)] = v
+            return v
+
+    def seq_reg_read(self, k) -> int:
+        with self.lock:
+            return self.mono_keys.get(("seq", k), 0)
+
+    # monotonic-key (workloads/monotonic_key.py, tidb's inc-workload):
+    # per-key increment-only pool, -1 = never written
+    def mono_key_inc(self, k) -> int:
+        with self.lock:
+            v = self.mono_keys.get(k, -1) + 1
+            self.mono_keys[k] = v
+            return v
+
+    def mono_key_read(self, ks) -> dict:
+        with self.lock:
+            return {k: self.mono_keys.get(k, -1) for k in ks}
+
+    # ledger (workloads/ledger.py): row-per-transfer balances with a
+    # non-negative guard, atomic here so the fake never double-spends
+    def ledger_transfer(self, account, amount) -> bool:
+        with self.lock:
+            balance = self.ledger.get(account, 0)
+            if amount < 0 and balance + amount < 0:
+                return False
+            self.ledger[account] = balance + amount
+            return True
+
     # counter (workloads/counter.py)
     def counter_add(self, delta: int) -> None:
         with self.lock:
@@ -544,6 +605,41 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.cmt_read(k)]}
+        if test.get("monotonic-key"):
+            if f == "inc":
+                return {**op, "type": "ok",
+                        "value": {v: self.db.mono_key_inc(v)}}
+            if f == "read":
+                return {**op, "type": "ok",
+                        "value": self.db.mono_key_read(
+                            list((v or {}).keys()))}
+        if test.get("delete-workload"):
+            k, _ = v
+            if f == "read":
+                return {**op, "type": "ok",
+                        "value": [k, self.db.del_read(k)]}
+            if f == "upsert":
+                uid = self.db.del_upsert(k)
+                if uid is None:
+                    return {**op, "type": "fail", "error": ["present"]}
+                return {**op, "type": "ok"}
+            if f == "delete":
+                uid = self.db.del_delete(k)
+                if uid is None:
+                    return {**op, "type": "fail", "error": ["not-found"]}
+                return {**op, "type": "ok"}
+        if test.get("dgraph-sequential"):
+            k, _ = v
+            if f == "inc":
+                return {**op, "type": "ok",
+                        "value": [k, self.db.seq_reg_inc(k)]}
+            if f == "read":
+                return {**op, "type": "ok",
+                        "value": [k, self.db.seq_reg_read(k)]}
+        if test.get("ledger") and f == "transfer":
+            account, amount = v[0], v[1]
+            ok = self.db.ledger_transfer(account, int(amount))
+            return {**op, "type": "ok" if ok else "fail"}
         if f == "transfer":
             t = v or {}
             ok = self.db.transfer(t.get("from"), t.get("to"),

@@ -69,6 +69,7 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
 def build_suite_test(o: dict | None, *, db_name: str,
                      supported_workloads: tuple, make_real: Callable,
                      make_workload: Callable | None = None,
+                     extra_workloads: dict | None = None,
                      fake_client: Callable | None = None,
                      fake_db: Callable | None = None,
                      fault_packages: dict | None = None,
@@ -79,8 +80,11 @@ def build_suite_test(o: dict | None, *, db_name: str,
     real-cluster pieces; ``--fake`` swaps in the in-memory KV doubles over
     the dummy remote (tests.clj:27-67 pattern) — or ``fake_client()``
     when the suite needs its own double. ``make_workload(name, base)``
-    overrides the shared workload registry for suites with bespoke
-    workloads (e.g. chronos jobs). ``defaults`` overrides the standard
+    overrides the shared workload registry wholesale for suites with
+    bespoke routing (e.g. chronos jobs); ``extra_workloads`` is the
+    lighter form — a ``{name: workload_fn(base)}`` map consulted before
+    the shared registry, for suites whose own probes shadow or extend
+    the registry names. ``defaults`` overrides the standard
     concurrency/time_limit/nemesis_interval. Fault classes come from
     ``o["faults"]`` (default: partition on real clusters, none in fake
     mode) and are assembled by the combined nemesis packages.
@@ -126,6 +130,8 @@ def build_suite_test(o: dict | None, *, db_name: str,
 
     if make_workload is not None:
         workload = make_workload(workload_name, base)
+    elif extra_workloads and workload_name in extra_workloads:
+        workload = extra_workloads[workload_name](base)
     else:
         workload = workload_registry()[workload_name](
             base, accelerator=base["accelerator"])

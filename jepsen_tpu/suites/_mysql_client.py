@@ -134,6 +134,20 @@ class MySQLSuiteClient(Client):
             self.conn.query("CREATE TABLE IF NOT EXISTS sets_cas "
                             f"(id INT NOT NULL PRIMARY KEY, value TEXT)"
                             f"{suffix}")
+        if test.get("monotonic-key"):
+            # tidb/monotonic.clj:44-49: the increment-only key pool
+            self.conn.query(
+                "CREATE TABLE IF NOT EXISTS cycle "
+                "(pk INT NOT NULL PRIMARY KEY, sk INT NOT NULL, val INT)"
+                f"{suffix}")
+        if test.get("key-count"):
+            # tidb/sequential.clj:32-61: subkeys split across tables so
+            # they land in different shard ranges
+            from jepsen_tpu.suites._pg_client import SEQ_TABLE_COUNT
+            for i in range(SEQ_TABLE_COUNT):
+                self.conn.query(
+                    f"CREATE TABLE IF NOT EXISTS seq_{i} "
+                    f"(k VARCHAR(191) NOT NULL PRIMARY KEY){suffix}")
         if test.get("bank-multitable"):
             # tidb/bank.clj MultiBankClient: one table per account
             accounts = list(test.get("accounts", []))
@@ -219,6 +233,14 @@ class MySQLSuiteClient(Client):
                 return self._multitable_transfer(test, op)
             if test.get("bank-multitable") and f == "read" and v is None:
                 return self._multitable_read(test, op)
+            if test.get("monotonic-key") and f == "inc":
+                return self._mono_key_inc(op)
+            if test.get("monotonic-key") and f == "read":
+                return self._mono_key_read(op)
+            if test.get("key-count") and f == "write":
+                return self._seq_write(test, op)
+            if test.get("key-count") and f == "read":
+                return self._seq_read(test, op)
             if f == "read" and v is None:
                 return self._whole_read(test, op)
             if f == "read":
@@ -324,6 +346,70 @@ class MySQLSuiteClient(Client):
         except MySQLError as e:
             self._rollback()
             return self._sql_error(op, e)
+
+    def _mono_key_inc(self, op):
+        """One r/w txn bumping a key (tidb/monotonic.clj:57-83): read
+        the value, insert 0 when absent, else write v+1; the ok value is
+        what was written."""
+        k = int(op.get("value"))
+        self._begin()
+        try:
+            v = self._select_int(f"SELECT val FROM cycle WHERE pk = {k}")
+            if v is None:
+                self.conn.query(
+                    f"INSERT INTO cycle (pk, sk, val) VALUES ({k}, {k}, 0)")
+                written = 0
+            else:
+                self.conn.query(
+                    f"UPDATE cycle SET val = {v + 1} WHERE pk = {k}")
+                written = v + 1
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok", "value": {k: written}}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _mono_key_read(self, op):
+        """Snapshot the key pool in one txn, shuffled read order, -1 for
+        missing keys (tidb/monotonic.clj:19-33,54-56)."""
+        import random as _random
+        ks = list((op.get("value") or {}).keys())
+        _random.shuffle(ks)
+        self._begin()
+        try:
+            out = {}
+            for k in ks:
+                v = self._select_int(
+                    f"SELECT val FROM cycle WHERE pk = {int(k)}")
+                out[k] = -1 if v is None else v
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok",
+                    "value": dict(sorted(out.items()))}
+        except MySQLError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _seq_write(self, test, op):
+        """Insert a key's subkeys in order, one txn each
+        (tidb/sequential.clj:63-71)."""
+        from jepsen_tpu.suites._pg_client import seq_table
+        from jepsen_tpu.workloads.sequential import subkeys
+        for sk in subkeys(int(test.get("key-count", 5)), op.get("value")):
+            self.conn.query(
+                f"INSERT IGNORE INTO {seq_table(sk)} (k) VALUES ('{sk}')")
+        return {**op, "type": "ok"}
+
+    def _seq_read(self, test, op):
+        """Read subkeys reversed (tidb/sequential.clj:73-85)."""
+        from jepsen_tpu.suites._pg_client import seq_table
+        from jepsen_tpu.workloads.sequential import subkeys
+        ks = subkeys(int(test.get("key-count", 5)), op.get("value"))
+        out = []
+        for sk in reversed(ks):
+            rows = self.conn.query(
+                f"SELECT k FROM {seq_table(sk)} WHERE k = '{sk}'")
+            out.append(rows[0][0] if rows else None)
+        return {**op, "type": "ok", "value": [op.get("value"), out]}
 
     def _cas_set_add(self, op):
         """Append to the single text-row set under a txn

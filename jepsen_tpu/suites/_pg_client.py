@@ -138,6 +138,14 @@ class PGSuiteClient(Client):
         if test.get("counter"):
             self.conn.query("INSERT INTO counters (id, v) VALUES (0, 0) "
                             "ON CONFLICT DO NOTHING")
+        if test.get("ledger"):
+            # one row per transfer, indexed by account (ledger.clj:85-99)
+            self.conn.query(
+                "CREATE TABLE IF NOT EXISTS ledger "
+                "(id INT PRIMARY KEY, account INT NOT NULL, "
+                "amount INT NOT NULL)")
+            self.conn.query(
+                "CREATE INDEX IF NOT EXISTS i_account ON ledger (account)")
 
     def close(self, test):
         if self.conn is not None:
@@ -249,6 +257,8 @@ class PGSuiteClient(Client):
                     f"WHERE k = {int(k)} AND v = {int(old)}")
                 ok = self.conn.rowcount(tag) == 1
                 return {**op, "type": "ok" if ok else "fail"}
+            if test.get("ledger") and f == "transfer":
+                return self._ledger_transfer(test, op)
             if f == "transfer":
                 return self._transfer(op)
             if f == "insert":
@@ -315,6 +325,33 @@ class PGSuiteClient(Client):
                     raise ValueError(f"unknown micro-op {f!r}")
             self.conn.query("COMMIT")
             return {**op, "type": "ok", "value": out}
+        except PgError as e:
+            self._rollback()
+            return self._sql_error(op, e)
+
+    def _ledger_transfer(self, test, op):
+        """Row-per-transfer ledger insert (ledger.clj:56-68,117-132):
+        deposits insert unconditionally; withdrawals first sum the
+        account's OTHER rows and only insert while the total stays
+        non-negative — the guard a write-skewing DB lets two concurrent
+        withdrawals both pass."""
+        account, amount, row_id = (list(op.get("value") or []) + [0, 0, 0])[:3]
+        account, amount, row_id = int(account), int(amount), int(row_id)
+        self._begin()
+        try:
+            if amount <= 0:
+                balance = self._select_int(
+                    f"SELECT COALESCE(SUM(amount), 0) FROM ledger "
+                    f"WHERE account = {account} AND id != {row_id}") or 0
+                if balance + amount < 0:
+                    self._rollback()
+                    return {**op, "type": "fail",
+                            "error": ["insufficient", balance]}
+            self.conn.query(
+                f"INSERT INTO ledger (id, account, amount) "
+                f"VALUES ({row_id}, {account}, {amount})")
+            self.conn.query("COMMIT")
+            return {**op, "type": "ok"}
         except PgError as e:
             self._rollback()
             return self._sql_error(op, e)

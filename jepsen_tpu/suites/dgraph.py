@@ -46,6 +46,7 @@ ZERO_GRPC_PORT = 5080
 # checkers would blame on the DB (the reference schemas carry the same
 # directive)
 SCHEMA = ("key: int @index(int) @upsert .\nval: int .\n"
+          "value: int @index(int) .\n"
           "el: int @index(int) .\n"
           "acct: int @index(int) @upsert .\nbalance: int .\n"
           "ukey: int @index(int) @upsert .\nuval: int .\n")
@@ -189,6 +190,10 @@ class DgraphClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("delete-workload"):
+                return self._delete_invoke(op)
+            if test.get("dgraph-sequential"):
+                return self._seq_register_invoke(op)
             if f == "add":
                 self._mutate({"set": [{"el": v}]})
                 return {**op, "type": "ok"}
@@ -328,6 +333,66 @@ class DgraphClient(Client):
             "set": [{"ukey": int(k), "uval": int(uid)}]})
         return {**op, "type": "ok"}
 
+    # -- delete workload (dgraph/delete.clj:32-58) -----------------------
+
+    def _delete_invoke(self, op):
+        f = op.get("f")
+        k, _ = op.get("value")
+        k = int(k)
+        if f == "read":
+            data = self._query(
+                "{ q(func: eq(key, %d)) { uid key } }" % k)
+            return {**op, "type": "ok",
+                    "value": [k, data.get("q") or []]}
+        if f == "upsert":
+            doc = self._mutate({
+                "query": "{ q(func: eq(key, %d)) { u as uid } }" % k,
+                "cond": "@if(eq(len(u), 0))",
+                "set": [{"key": k}]})
+            created = (doc.get("data") or {}).get("uids") or {}
+            if created:
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["present"]}
+        if f == "delete":
+            data, ts = self._txn_query(
+                "{ q(func: eq(key, %d)) { uid } }" % k)
+            rows = data.get("q") or []
+            if not rows or not ts:
+                return {**op, "type": "fail", "error": ["not-found"]}
+            txn = self._txn_mutate(
+                ts, {"delete": [{"uid": rows[0]["uid"]}]})
+            self._txn_commit(ts, txn)
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+    # -- sequential workload (dgraph/sequential.clj:77-100) --------------
+
+    def _seq_register_invoke(self, op):
+        f = op.get("f")
+        k, _ = op.get("value")
+        k = int(k)
+        if f == "read":
+            data = self._query(
+                "{ q(func: eq(key, %d)) { value } }" % k)
+            rows = data.get("q") or []
+            val = rows[0].get("value", 0) if rows else 0
+            return {**op, "type": "ok", "value": [k, int(val or 0)]}
+        if f == "inc":
+            data, ts = self._txn_query(
+                "{ q(func: eq(key, %d)) { uid value } }" % k)
+            rows = data.get("q") or []
+            if not ts:
+                return {**op, "type": "fail", "error": ["no-start-ts"]}
+            value = int((rows[0].get("value") if rows else 0) or 0) + 1
+            if rows:
+                body = {"set": [{"uid": rows[0]["uid"], "value": value}]}
+            else:
+                body = {"set": [{"key": k, "value": value}]}
+            txn = self._txn_mutate(ts, body)
+            self._txn_commit(ts, txn)
+            return {**op, "type": "ok", "value": [k, value]}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
     def close(self, test):
         pass
 
@@ -437,12 +502,22 @@ def tablet_mover_package(opts: dict) -> dict:
 
 
 SUPPORTED_WORKLOADS = ("set", "register", "bank", "wr", "long-fork",
-                       "upsert")
+                       "upsert", "delete", "sequential")
+
+
+def _extra_workloads() -> dict:
+    """Dgraph's own delete (index freshness, dgraph/delete.clj) and
+    sequential (per-process monotonic register, dgraph/sequential.clj —
+    NOT the cockroach subkey kit) probes."""
+    from jepsen_tpu.workloads import delete_workload, dgraph_sequential
+    return {"delete": delete_workload.workload,
+            "sequential": dgraph_sequential.workload}
 
 
 def dgraph_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="dgraph", supported_workloads=SUPPORTED_WORKLOADS,
+        extra_workloads=_extra_workloads(),
         fault_packages={"move-tablet": tablet_mover_package},
         make_real=lambda o: {
             "db": DgraphDB(o.get("version", DEFAULT_VERSION)),

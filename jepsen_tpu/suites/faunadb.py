@@ -909,16 +909,12 @@ FAKE_CLIENTS = {"monotonic": FakeMonotonicFauna,
                 "internal": FakeInternalFauna}
 
 
-def _make_workload(name: str, base: dict):
-    from jepsen_tpu.suites import workload_registry
+def _extra_workloads() -> dict:
     from jepsen_tpu.workloads import (fauna_internal, fauna_monotonic,
                                       fauna_multimonotonic)
-    fauna = {"monotonic": fauna_monotonic.workload,
-             "multimonotonic": fauna_multimonotonic.workload,
-             "internal": fauna_internal.workload}
-    if name in fauna:
-        return fauna[name](base)
-    return workload_registry()[name](base, accelerator=base["accelerator"])
+    return {"monotonic": fauna_monotonic.workload,
+            "multimonotonic": fauna_multimonotonic.workload,
+            "internal": fauna_internal.workload}
 
 
 def faunadb_test(opts_dict: dict | None = None) -> dict:
@@ -928,7 +924,7 @@ def faunadb_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         o, db_name="faunadb",
         supported_workloads=SUPPORTED_WORKLOADS,
-        make_workload=_make_workload,
+        extra_workloads=_extra_workloads(),
         fake_client=fake_client,
         fault_packages={"topology": topology_fault_package},
         make_real=lambda o: {"db": FaunaDB(), "client": FaunaClient(),

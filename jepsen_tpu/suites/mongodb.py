@@ -137,10 +137,61 @@ class MongoClient(Client):
             write_concern=WriteConcern("majority"),
             read_preference=pymongo.ReadPreference.PRIMARY)
 
+    def setup(self, test):
+        if test.get("transfer"):
+            # seed the account pool (transfer.clj:137-146)
+            for a in test.get("transfer_accounts") or []:
+                self._coll("accts").update_one(
+                    {"_id": a},
+                    {"$setOnInsert": {
+                        "balance": test.get("starting_balance", 10),
+                        "pendingTxns": []}},
+                    upsert=True)
+
+    def _transfer_invoke(self, test, op):
+        """The two-phase-commit transfer dance (transfer.clj:43-133):
+        create a txn document, apply both $inc sides guarded on the txn
+        not being pending on that account, mark applied, clear pending
+        markers, mark done."""
+        f, v = op.get("f"), op.get("value")
+        accts, txns = self._coll("accts"), self._coll("txns")
+        if f == "read":
+            docs = accts.find({}, {"_id": 1, "balance": 1})
+            return {**op, "type": "ok",
+                    "value": {d["_id"]: d["balance"] for d in docs}}
+        if f == "partial-read":
+            docs = accts.find({"pendingTxns": {"$size": 0}},
+                              {"_id": 1, "balance": 1})
+            return {**op, "type": "ok",
+                    "value": {d["_id"]: d["balance"] for d in docs}}
+        if f == "transfer":
+            frm, to, amount = v["from"], v["to"], v["amount"]
+            tid = txns.insert_one(
+                {"state": "pending", "from": frm, "to": to,
+                 "amount": amount}).inserted_id
+            accts.update_one({"_id": frm, "pendingTxns": {"$ne": tid}},
+                             {"$inc": {"balance": -amount},
+                              "$push": {"pendingTxns": tid}})
+            accts.update_one({"_id": to, "pendingTxns": {"$ne": tid}},
+                             {"$inc": {"balance": amount},
+                              "$push": {"pendingTxns": tid}})
+            txns.update_one({"_id": tid, "state": "pending"},
+                            {"$set": {"state": "applied"}})
+            accts.update_one({"_id": frm, "pendingTxns": tid},
+                             {"$pull": {"pendingTxns": tid}})
+            accts.update_one({"_id": to, "pendingTxns": tid},
+                             {"$pull": {"pendingTxns": tid}})
+            txns.update_one({"_id": tid, "state": "applied"},
+                            {"$set": {"state": "done"}})
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
     def invoke(self, test, op):
         import pymongo.errors
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("transfer"):
+                return self._transfer_invoke(test, op)
             if f == "add":
                 self._coll("sets").update_one(
                     {"_id": v}, {"$set": {"_id": v}}, upsert=True)
@@ -173,33 +224,70 @@ class MongoClient(Client):
             self.client.close()
 
 
-SUPPORTED_WORKLOADS = ("register", "set")
+class FakeTransferMongo(Client):
+    """In-memory double for the transfer workload: transfers apply
+    atomically under one lock, so the fake history is linearizable by
+    construction and the Accounts-model check must pass."""
+
+    def __init__(self, state=None):
+        import threading
+        self.state = state if state is not None else {
+            "lock": threading.Lock(), "balances": {}}
+
+    def open(self, test, node):
+        return type(self)(self.state)
+
+    def setup(self, test):
+        with self.state["lock"]:
+            for a in test.get("transfer_accounts") or []:
+                self.state["balances"].setdefault(
+                    a, test.get("starting_balance", 10))
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        with self.state["lock"]:
+            balances = self.state["balances"]
+            if f in ("read", "partial-read"):
+                return {**op, "type": "ok", "value": dict(balances)}
+            if f == "transfer":
+                balances[v["from"]] -= v["amount"]
+                balances[v["to"]] += v["amount"]
+                return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+SUPPORTED_WORKLOADS = ("register", "set", "transfer")
 
 
 def mongodb_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    from jepsen_tpu.workloads import transfer
+
     def make_real(o):
         from jepsen_tpu import os_setup
         os_cls = (os_setup.SmartOS if o.get("os") == "smartos" else Debian)
         return {"db": MongoDB(o.get("storage_engine")),
                 "client": MongoClient(), "os": os_cls()}
 
+    fake_client = (FakeTransferMongo if o.get("workload") == "transfer"
+                   else None)
     return build_suite_test(
-        opts_dict, db_name="mongodb",
-        supported_workloads=SUPPORTED_WORKLOADS, make_real=make_real)
+        o, db_name="mongodb",
+        supported_workloads=SUPPORTED_WORKLOADS, make_real=make_real,
+        extra_workloads={"transfer": transfer.workload},
+        fake_client=fake_client)
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(mongodb_test, extra_keys=("storage_engine", "os")),
+    standard_test_fn(mongodb_test, extra_keys=("storage_engine",)),
     standard_opt_fn(SUPPORTED_WORKLOADS,
-                    extra=lambda p: (
-                        p.add_argument("--storage-engine",
-                                       dest="storage_engine", default=None,
-                                       help="e.g. wiredTiger or rocksdb "
-                                            "(the mongodb-rocks variant)"),
-                        p.add_argument("--os", default="debian",
-                                       choices=["debian", "smartos"],
-                                       help="smartos = the mongodb-smartos "
-                                            "variant"))),
+                    # the shared --os option covers the smartos variant
+                    # (a suite-local --os would collide with it)
+                    extra=lambda p: p.add_argument(
+                        "--storage-engine",
+                        dest="storage_engine", default=None,
+                        help="e.g. wiredTiger or rocksdb "
+                             "(the mongodb-rocks variant)")),
     name="jepsen-mongodb")
 
 

@@ -40,11 +40,12 @@ COUNTER_TABLE = "counter"
 
 def active_table(test: dict) -> str:
     """The table the running workload lives in (reconfigure targets it
-    too)."""
+    too); routed by test-map markers, matching how invoke routes ops."""
     if test.get("counter"):
         return COUNTER_TABLE
-    name = str(test.get("name") or "")
-    return SET_TABLE if name.endswith("-set") else TABLE
+    if test.get("rethinkdb-set"):
+        return SET_TABLE
+    return TABLE
 CAS_ABORT_SENTINEL = "jepsen-cas-precondition-abort"
 CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
 LOG_FILE = "/var/log/rethinkdb"
@@ -305,10 +306,19 @@ def reconfigure_package(opts: dict) -> dict:
 SUPPORTED_WORKLOADS = ("register", "set", "counter")
 
 
+def _set_workload(base: dict) -> dict:
+    """The shared set kit plus the table-routing marker."""
+    from jepsen_tpu.workloads import set_workload
+    return {**set_workload.workload(base,
+                                    accelerator=base["accelerator"]),
+            "rethinkdb-set": True}
+
+
 def rethinkdb_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="rethinkdb",
         supported_workloads=SUPPORTED_WORKLOADS,
+        extra_workloads={"set": _set_workload},
         fault_packages={"reconfigure": reconfigure_package},
         make_real=lambda o: {
             "db": RethinkDB(),

@@ -178,12 +178,14 @@ class StolonDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
                 f"{DIR}/proxy.log"]
 
 
-SUPPORTED_WORKLOADS = ("append", "register", "set", "bank")
+SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "ledger")
 
 
 def stolon_test(opts_dict: dict | None = None) -> dict:
+    from jepsen_tpu.workloads import ledger
     return build_suite_test(
         opts_dict, db_name="stolon", supported_workloads=SUPPORTED_WORKLOADS,
+        extra_workloads={"ledger": ledger.workload},
         make_real=lambda o: {
             "db": StolonDB(o.get("version", DEFAULT_VERSION)),
             "client": PGSuiteClient(

@@ -162,7 +162,8 @@ class TiDBDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
 
 
 SUPPORTED_WORKLOADS = ("append", "register", "set", "bank", "wr", "table",
-                       "long-fork", "set-cas", "bank-multitable")
+                       "long-fork", "set-cas", "bank-multitable",
+                       "monotonic", "sequential")
 
 
 def _tidb_workload(name: str, base: dict) -> dict:
@@ -171,7 +172,11 @@ def _tidb_workload(name: str, base: dict) -> dict:
     single-text-row CAS client (tidb/sets.clj CasSetClient) and
     bank-multitable re-runs bank across per-account tables
     (tidb/bank.clj MultiBankClient) — kit semantics unchanged, a
-    test-map marker routes the client."""
+    test-map marker routes the client. ``monotonic`` is tidb's OWN
+    monotonic probe (tidb/monotonic.clj inc-workload: per-key
+    increments + pool reads under a monotonic-key+realtime cycle
+    check), not the cockroach timestamp workload; ``sequential`` is the
+    shared kit over per-hash tables (tidb/sequential.clj)."""
     from jepsen_tpu.suites import workload_registry
 
     reg = workload_registry()
@@ -181,6 +186,9 @@ def _tidb_workload(name: str, base: dict) -> dict:
     if name == "bank-multitable":
         return {**reg["bank"](base, accelerator=base["accelerator"]),
                 "bank-multitable": True}
+    if name == "monotonic":
+        from jepsen_tpu.workloads import monotonic_key
+        return monotonic_key.workload(base)
     return reg[name](base, accelerator=base["accelerator"])
 
 

@@ -1,0 +1,98 @@
+"""Indexed create/delete visibility workload (reference:
+dgraph/src/jepsen/dgraph/delete.clj:1-104 — upsert an indexed record,
+delete it, and read through the index; a stale index shows ghost
+records or malformed rows).
+
+Per-key op shapes (independent-lifted, delete.clj:18-20):
+- ``{"f": "upsert", "value": [k, None]}`` — create the record for ``k``
+  unless present (ok, or fail ``present``).
+- ``{"f": "delete", "value": [k, None]}`` — delete ``k``'s record if
+  present (ok, or fail ``not-found``).
+- ``{"f": "read", "value": [k, records]}`` — index lookup; each record
+  is a ``{"uid": ..., "key": k}`` dict.
+
+The checker (delete.clj:66-87): every ok read must find either nothing
+or exactly one record carrying exactly a uid and the right key —
+anything else (two records, a record missing fields, a wrong key) is a
+stale- or corrupt-index anomaly.
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import Checker
+
+KEY_CONCURRENCY_FACTOR = 2  # delete.clj:92 (2 * node count)
+OPS_PER_KEY = 1000          # delete.clj:95
+
+
+def per_key_gen(k):
+    """Mix of read/upsert/delete on one key (delete.clj:90-96)."""
+    mix = gen.mix([
+        gen.Fn(lambda test, ctx: {"f": "read", "value": None}),
+        gen.Fn(lambda test, ctx: {"f": "upsert", "value": None}),
+        gen.Fn(lambda test, ctx: {"f": "delete", "value": None}),
+    ])
+    return gen.limit(OPS_PER_KEY, mix)
+
+
+def bad_read(k, op: dict):
+    """Why an ok read's value is anomalous, or None (delete.clj:70-85)."""
+    records = op.get("value")
+    records = records[1] if independent.is_tuple_value(records) else records
+    records = records or []
+    if len(records) == 0:
+        return None
+    if len(records) > 1:
+        return "multiple-records"
+    rec = records[0]
+    if not isinstance(rec, dict) or set(rec.keys()) != {"uid", "key"}:
+        return "malformed-record"
+    if rec.get("key") != k:
+        return "wrong-key"
+    return None
+
+
+class DeleteChecker(Checker):
+    """(delete.clj:66-87); runs under the independent lift, so each
+    check sees one key's subhistory."""
+
+    def name(self):
+        return "deletes"
+
+    def check(self, test, history, opts):
+        k = opts.get("history-key")
+        bad = []
+        for op in history:
+            if op.get("type") == "ok" and op.get("f") == "read":
+                why = bad_read(k, op)
+                if why:
+                    bad.append({"why": why, "op": op})
+        return {"valid?": not bad, "bad-reads": bad[:10],
+                "bad-read-count": len(bad)}
+
+
+def checker() -> Checker:
+    return independent.checker(DeleteChecker())
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    t = test or {}
+    # the reference sizes groups at 2x node count (delete.clj:92); a
+    # group can never exceed the actual client-thread count or the
+    # concurrent generator forms zero groups and emits nothing
+    n = max(1, min(KEY_CONCURRENCY_FACTOR * len(t.get("nodes") or [1]),
+                   int(t.get("concurrency", 5))))
+    return {
+        "delete-workload": True,
+        "generator": independent.concurrent_generator(
+            n, _naturals(), per_key_gen),
+        "checker": checker(),
+    }
+
+
+def _naturals():
+    i = 0
+    while True:
+        yield i
+        i += 1

@@ -1,0 +1,84 @@
+"""Per-process monotonic register workload (reference:
+dgraph/src/jepsen/dgraph/sequential.clj — snapshot isolation permits
+arbitrarily stale reads; restricting transactions to read-only or
+write-your-read-set makes the history serializable, and then each
+process must observe each register's value monotonically. A process
+that sees a register's value go DOWN proves the system is not
+sequentially consistent).
+
+Per-key op shapes (independent-lifted, sequential.clj:232-235; keys are
+drawn from a fixed pool of 8):
+- ``{"f": "inc", "value": [k, None]}`` → ok ``[k, v']`` — one
+  read-increment-write transaction; ``v'`` is the written value.
+- ``{"f": "read", "value": [k, None]}`` → ok ``[k, v]`` (0 when the
+  register doesn't exist yet).
+
+The checker (sequential.clj:107-136): within each process, the ok
+values for a key never decrease.
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker import Checker
+
+KEY_POOL = 8  # sequential.clj:232-235
+
+
+def generator(key_pool: int = KEY_POOL):
+    def inc(test, ctx):
+        return {"f": "inc",
+                "value": independent.tuple_value(
+                    ctx.rng.randrange(key_pool), None)}
+
+    def read(test, ctx):
+        return {"f": "read",
+                "value": independent.tuple_value(
+                    ctx.rng.randrange(key_pool), None)}
+
+    return gen.mix([gen.Fn(inc), gen.Fn(read)])
+
+
+def non_monotonic_pairs(history: list) -> list:
+    """Same-process ok pairs where the observed value decreased
+    (sequential.clj:107-126)."""
+    last: dict = {}
+    errs = []
+    for op in history:
+        if op.get("type") != "ok":
+            continue
+        v = op.get("value")
+        if independent.is_tuple_value(v):
+            v = v[1]
+        if not isinstance(v, int):
+            continue
+        p = op.get("process")
+        prev = last.get(p)
+        if prev is not None and prev[1] > v:
+            errs.append([prev[0], op])
+        last[p] = (op, v)
+    return errs
+
+
+class SequentialChecker(Checker):
+    """(sequential.clj:128-136); runs under the independent lift."""
+
+    def name(self):
+        return "sequential"
+
+    def check(self, test, history, opts):
+        errs = non_monotonic_pairs(history)
+        return {"valid?": not errs, "non-monotonic": errs[:10],
+                "non-monotonic-count": len(errs)}
+
+
+def checker() -> Checker:
+    return independent.checker(SequentialChecker())
+
+
+def workload(test: dict | None = None, **_) -> dict:
+    return {
+        "dgraph-sequential": True,
+        "generator": generator(),
+        "checker": checker(),
+    }

@@ -1,0 +1,128 @@
+"""Ledger workload (reference: stolon/src/jepsen/stolon/ledger.clj —
+a concrete double-spend probe for G2-item anomalies: each transfer is a
+row; withdrawals require the account's row-sum to stay non-negative, so
+two concurrent withdrawals that each read a sufficient balance and both
+commit demonstrate write skew in monetary form).
+
+Op shape (ledger.clj:117-132):
+- ``{"f": "transfer", "value": [account, amount, id]}`` — deposit when
+  ``amount`` > 0 (inserted unconditionally), withdrawal when < 0
+  (inserted only if the other rows' sum + amount ≥ 0, else fail).
+  ``id`` is a generator-assigned unique row key (the reference draws it
+  from a client-side atom; hoisting it into the op value keeps the op
+  deterministic and the client stateless).
+
+The checker takes the charitable interpretation (ledger.clj:139-153):
+deposits count when ok OR indeterminate, withdrawals only when ok; an
+account whose balance under that reading is negative proves a
+double-spend. (The reference's published checker flags any *nonzero*
+balance, which convicts every healthy deposit — the non-negativity
+bound is the sound invariant its docstring describes, so that is what
+is enforced here.)
+
+Generators: ``rand`` — small random transfers per account, 16 ops each
+(ledger.clj:166-172); ``double-spend`` — fund an account with 10, then
+race 2^k withdrawals of 9 (ledger.clj:155-164, the headline attack).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+OPS_PER_ACCOUNT = 16  # rand-gen's per-account limit (ledger.clj:171)
+
+
+def rand_gen():
+    """Per-account bursts of small transfers in [-3, 1]
+    (ledger.clj:166-172)."""
+    lock = threading.Lock()
+    ids = itertools.count()
+    state = {"account": 0, "left": OPS_PER_ACCOUNT}
+
+    def transfer(test, ctx):
+        with lock:
+            if state["left"] == 0:
+                state["account"] += 1
+                state["left"] = OPS_PER_ACCOUNT
+            state["left"] -= 1
+            account = state["account"]
+            row_id = next(ids)
+        return {"f": "transfer",
+                "value": [account, ctx.rng.randint(-3, 1), row_id]}
+
+    return gen.Fn(transfer)
+
+
+def double_spend_gen():
+    """Fund each account with 10, then race up to 2^5 withdrawals of 9
+    (ledger.clj:155-164) — at most one may commit."""
+    lock = threading.Lock()
+    ids = itertools.count()
+    state = {"account": -1, "left": 0}
+
+    def transfer(test, ctx):
+        with lock:
+            if state["left"] == 0:
+                state["account"] += 1
+                state["left"] = 2 ** ctx.rng.randint(0, 4)
+                fund = True
+            else:
+                state["left"] -= 1
+                fund = False
+            account = state["account"]
+            row_id = next(ids)
+        amount = 10 if fund else -9
+        return {"f": "transfer", "value": [account, amount, row_id]}
+
+    return gen.Fn(transfer)
+
+
+def check_account(ops: list):
+    """Charitable balance for one account's ops (ledger.clj:139-153):
+    deposits ok+info, withdrawals ok only; negative proves the probe."""
+    balance = 0
+    for op in ops:
+        amount = op["value"][1]
+        if amount > 0 and op.get("type") in ("ok", "info"):
+            balance += amount
+        elif amount < 0 and op.get("type") == "ok":
+            balance += amount
+    return balance
+
+
+class LedgerChecker(Checker):
+    def name(self):
+        return "ledger"
+
+    def check(self, test, history, opts):
+        by_account: dict = {}
+        for op in history:
+            v = op.get("value")
+            if op.get("f") == "transfer" and op.get("type") in ("ok", "info") \
+                    and isinstance(v, (list, tuple)) and len(v) >= 2:
+                by_account.setdefault(v[0], []).append(op)
+        errs = []
+        for account, ops in sorted(by_account.items(), key=lambda kv: str(kv[0])):
+            balance = check_account(ops)
+            if balance < 0:
+                errs.append({"account": account, "balance": balance})
+        return {"valid?": not errs,
+                "account-count": len(by_account),
+                "errors": errs}
+
+
+def checker() -> Checker:
+    return LedgerChecker()
+
+
+def workload(test: dict | None = None, style: str = "rand", **_) -> dict:
+    style = (test or {}).get("ledger_style", style)
+    return {
+        "ledger": True,
+        "generator": (double_spend_gen() if style == "double-spend"
+                      else rand_gen()),
+        "checker": checker(),
+    }

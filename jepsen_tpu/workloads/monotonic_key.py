@@ -1,0 +1,96 @@
+"""Monotonic-key cycle workload (reference:
+tidb/src/tidb/monotonic.clj:1-110 — a pool of increment-only registers;
+``inc`` bumps one key in a read-write transaction, ``read`` snapshots
+the whole pool. The orders implied by each key's values must be
+mutually consistent AND consistent with realtime: no transaction may
+observe key x advance but key y retreat, and a transaction that
+finished before another began can never depend on it).
+
+Op shapes:
+- ``{"f": "inc", "value": k}`` → ok value ``{k: v'}`` — the written
+  value.
+- ``{"f": "read", "value": {k: None, ...}}`` → ok value ``{k: v}`` with
+  ``-1`` for keys never written (monotonic.clj:19-27).
+
+The checker is the generic cycle kit over the monotonic-key dependency
+graph combined with realtime precedence (the reference's
+``cycle/combine monotonic-key-graph realtime-graph``): for each key,
+observations are ordered by observed value, each value class linked to
+the next; a cycle in the union (including through realtime edges) is an
+anomaly. Value-class links are all-pairs per adjacent class — histories
+here are bounded by the generator, so the quadratic corner stays small.
+"""
+from __future__ import annotations
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+from jepsen_tpu.workloads import cycle as cycle_kit
+
+DEFAULT_KEY_COUNT = 8  # monotonic.clj:103
+
+
+def generator(key_count: int = DEFAULT_KEY_COUNT):
+    """Uniform mix of single-key incs and whole-pool reads
+    (monotonic.clj:90-99)."""
+    def inc(test, ctx):
+        return {"f": "inc", "value": ctx.rng.randrange(key_count)}
+
+    def read(test, ctx):
+        return {"f": "read", "value": {k: None for k in range(key_count)}}
+
+    return gen.mix([gen.Fn(inc), gen.Fn(read)])
+
+
+def observations(op: dict) -> dict:
+    """Key -> observed value for an ok completion; -1 (never written)
+    observations are skipped — they order nothing."""
+    v = op.get("value")
+    if not isinstance(v, dict):
+        return {}
+    return {k: x for k, x in v.items()
+            if isinstance(x, int) and x >= 0}
+
+
+def monotonic_key_graph(history: list):
+    """(Graph, txns): per-key value order as WW edges between adjacent
+    value classes (elle.core's monotonic-key-graph shape)."""
+    from jepsen_tpu.elle import WW, Graph
+
+    txns = [op for op in history
+            if op.get("type") == "ok" and isinstance(op.get("value"), dict)]
+    by_key: dict = {}
+    for i, op in enumerate(txns):
+        for k, val in observations(op).items():
+            by_key.setdefault(k, {}).setdefault(val, []).append(i)
+    g = Graph(len(txns))
+    for classes in by_key.values():
+        vals = sorted(classes)
+        for lo, hi in zip(vals, vals[1:]):
+            for a in classes[lo]:
+                for b in classes[hi]:
+                    g.add(a, b, WW)
+    return g, txns
+
+
+def analyzer(history: list):
+    """monotonic-key graph + realtime precedence (monotonic.clj:105-108
+    ``cycle/combine monotonic-key-graph realtime-graph``)."""
+    from jepsen_tpu import elle
+
+    g, txns = monotonic_key_graph(history)
+    elle.add_timing_edges(g, history, txns, process=False)
+    return g, txns
+
+
+def checker() -> Checker:
+    return cycle_kit.checker(analyzer,
+                             consistency_models=("strict-serializable",))
+
+
+def workload(test: dict | None = None,
+             key_count: int = DEFAULT_KEY_COUNT, **_) -> dict:
+    return {
+        "monotonic-key": True,
+        "generator": generator(key_count),
+        "checker": checker(),
+    }
