@@ -6,7 +6,10 @@ the >20k ops/sec generator-scheduling figure
 (jepsen/src/jepsen/generator.clj:67-70).  Each config below prints one
 compact JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 All lines are buffered and emitted together at the very end, with the
-round-1 headline metric LAST (the driver parses the final line):
+round-1 headline metric LAST (the driver parses the final line) and a
+compact ``bench_summary`` line (every metric's value+ratio) right
+before it, so the driver's 2000-char stdout tail always recovers every
+metric:
 
   1. cpu_ref_200op          — 200-op single-register history, CPU oracle
                               (the knossos :linear analog; the anchor the
@@ -203,9 +206,9 @@ def cfg_multikey():
     Emits the 64-key config (r1/r2 comparability) AND the batch-scaling
     curve at 256/1024 keys — the matrix path splits big batches into
     pipelined ≤256-key sub-dispatches, so the win opens with batch size
-    (VERDICT r2 item 2). The CPU side is measured at 64/128 keys and
-    scaled linearly (strictly per-key sequential work; labeled in the
-    extras)."""
+    (VERDICT r2 item 2). The CPU side is measured DIRECTLY at every
+    batch size (r3 weak #3 closed: no linear extrapolation; big sizes
+    take fewer trials to bound the added wall time)."""
     from __graft_entry__ import _register_history
     from jepsen_tpu.checker.linear_cpu import check_stream
     from jepsen_tpu.checker.linear_encode import encode_register_ops
@@ -219,23 +222,21 @@ def cfg_multikey():
         for s in all_streams[:n]:
             assert check_stream(s).valid is True
 
-    _, cpu_times = _trials(lambda: cpu_n(128), 3)
-    cpu_med, _ = _spread(cpu_times, 1.0)
-    cpu_per_key = cpu_med / 128
-
-    for nk, main in ((64, True), (256, False), (1024, False)):
+    for nk, main, cpu_trials in ((64, True, 3), (256, False, 2),
+                                 (1024, False, 2)):
         streams = all_streams[:nk]
+        _, cpu_times = _trials(lambda: cpu_n(nk), cpu_trials)
+        dt_cpu = min(cpu_times)  # noisy host: best run is the fair anchor
         batch_check(streams, capacity=CAPACITY)  # warm-up compile
         results, times = _trials(
             lambda: batch_check(streams, capacity=CAPACITY), 3)
         assert all(r[0] and not r[2] for r in results)
         med, extras = _spread(times, nk * 1000)
-        dt_cpu = cpu_per_key * nk
         name = ("multikey_64x1k_ops_per_sec" if main
                 else f"multikey_{nk}x1k_ops_per_sec")
         emit(name, nk * 1000 / med, "ops/s", dt_cpu / med,
              cpu_sequential_ops_per_sec=round(nk * 1000 / dt_cpu, 2),
-             cpu_note="measured at 128 keys, scaled linearly", **extras)
+             cpu_trials=cpu_trials, **extras)
 
 
 def cfg_set_full():
@@ -334,14 +335,27 @@ def cfg_elle_50k():
     n_bad = n_txns + 100
     r_cpu, t_cpu = _trials(
         lambda: list_append.check(bad, accelerator="cpu"), 3)
-    r_dev, t_dev = _trials(
-        lambda: list_append.check(bad, accelerator="tpu"), 3)
+    # per-trial phase split (r3 weak #2: the 2x trial spread needs a
+    # cause on record — build is host numpy, cycles is the device screen
+    # + search, so the split names the noisy side)
+    from jepsen_tpu.elle import columnar
+    phases: list[dict] = []
+
+    def dev_check():
+        out = list_append.check(bad, accelerator="tpu")
+        phases.append(dict(columnar.LAST_PHASE_SECONDS))
+        return out
+
+    r_dev, t_dev = _trials(dev_check, 3)
     assert r_dev["valid?"] is False and r_cpu["valid?"] is False
     assert "G1c" in r_dev["anomaly-types"], r_dev.get("anomaly-types")
     med, extras = _spread(t_dev, n_bad)
     cpu_med, _ = _spread(t_cpu, n_bad)
     emit("elle_50k_anomalous_txns_per_sec", n_bad / med, "txns/s",
          cpu_med / med, cpu_txns_per_sec=round(n_bad / cpu_med, 2),
+         trial_seconds=[round(t, 2) for t in t_dev],
+         phase_build_s=[p.get("build") for p in phases],
+         phase_cycles_s=[p.get("cycles") for p in phases],
          **extras)
 
 
@@ -456,29 +470,49 @@ def cfg_scale(device_rate: float):
     # k-1 — so segment k's host generation + prepass + grid transfer
     # overlap segment k-1's device compute. The tot carry chains as a
     # lazy device array, no sync needed between dispatches.
+    # budget discipline (r3 weak #1): a segment COUNTS only if its sync
+    # completed with elapsed <= target_s. A sync that straggles past the
+    # budget (the tunnel-stall signature r3 caught: one 262 s sync after
+    # ~2 s steady state) is reported separately, never counted.
     total_events = 0
     segments = 0
     failure = None
     tot = None
     pending = None
     seg_times: list = []
+    counted_at = 0.0          # elapsed when the last counted sync landed
+    overflow = None           # the uncounted straggler, if any
     t_start = time.perf_counter()
+
+    def sync_counts(p):
+        """Forces p; returns True iff it verified AND landed in budget."""
+        nonlocal total_events, segments, counted_at, overflow
+        pa, pix = _force(*p)
+        assert bool(np.asarray(pa).all())
+        assert not bool(np.asarray(pix).any())
+        elapsed = time.perf_counter() - t_start
+        if elapsed <= target_s:
+            total_events += seg_events
+            segments += 1
+            counted_at = elapsed
+            return True
+        overflow = {"events": seg_events,
+                    "synced_at_seconds": round(elapsed, 1)}
+        return False
+
     k = 0
     while True:
         elapsed = time.perf_counter() - t_start
         est = max(seg_times[-3:]) if seg_times else 0.0
-        if elapsed >= target_s or elapsed + est >= target_s + 20:
+        if elapsed >= target_s or elapsed + est >= target_s:
             break
         try:
             t0 = time.perf_counter()
             alive, inexact, tot = dispatch(k, tot)
             k += 1
-            if pending is not None:
-                pa, pix = _force(*pending)
-                assert bool(np.asarray(pa).all())
-                assert not bool(np.asarray(pix).any())
-                total_events += seg_events
-                segments += 1
+            if pending is not None and not sync_counts(pending):
+                pending = None
+                break  # budget blown mid-sync: stop dispatching
             pending = (alive, inexact)
             seg_times.append(round(time.perf_counter() - t0, 1))
         except Exception as e:  # noqa: BLE001 — name the failure, keep prefix
@@ -490,26 +524,39 @@ def cfg_scale(device_rate: float):
             break
     if pending is not None:
         try:
-            pa, pix = _force(*pending)
-            assert bool(np.asarray(pa).all())
-            assert not bool(np.asarray(pix).any())
-            total_events += seg_events
-            segments += 1
+            sync_counts(pending)
         except Exception as e:  # noqa: BLE001
             failure = f"{type(e).__name__}: {e}"
-    used = time.perf_counter() - t_start
+    wall = time.perf_counter() - t_start
     if total_events:
-        extra = {"measured_seconds": round(used, 1), "segments": segments,
+        ts = sorted(seg_times)
+        med_seg = ts[len(ts) // 2] if ts else 0.0
+        extra = {"measured_seconds": round(counted_at, 1),
+                 "wall_seconds": round(wall, 1), "segments": segments,
                  "segment_events": seg_events,
-                 "segment_seconds": seg_times, "value_domain": n_values,
+                 "segment_seconds_median": med_seg,
+                 "segment_seconds_max": max(ts) if ts else 0.0,
+                 "value_domain": n_values,
                  "path": "matrix-segmented",
-                 "events_per_sec": round(total_events / used, 1)}
+                 "events_per_sec": round(total_events / max(counted_at, 1e-9),
+                                         1)}
+        if ts and max(ts) > 5 * max(med_seg, 0.1):
+            extra["stall"] = (f"tunnel stall: worst segment "
+                              f"{max(ts)}s vs median {med_seg}s")
+        if overflow:
+            extra["uncounted_overflow_segment"] = overflow
         if failure:
             extra["failure"] = failure
+        # full per-segment timings to stderr only (they once pushed the
+        # metric lines out of the driver's 2000-char stdout tail)
+        print(f"[bench] scale segment_seconds={seg_times}", file=sys.stderr)
         emit("max_history_len_checked_300s", total_events, "events",
              total_events / N_OPS, **extra)
     else:
-        print(f"[bench] scale run produced nothing: {failure}",
+        # nothing counted — name WHY (a first-segment tunnel stall is
+        # sync work that verified late, not a silent no-op)
+        print(f"[bench] scale run counted nothing: failure={failure} "
+              f"overflow={overflow} wall={round(wall, 1)}s",
               file=sys.stderr)
 
 
@@ -586,10 +633,19 @@ def main() -> None:
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
 
-    # all lines together at the end (driver tails stdout); headline last
+    # all lines together at the end (driver tails stdout ~2000 chars);
+    # headline last (the driver parses the final line), and a compact
+    # every-metric summary right before it so even a short tail
+    # recovers every value+ratio (r3 weak #5: verbose extras once
+    # pushed 5 of 11 metrics out of the tail)
     headline = "single_register_ops_verified_per_sec_10k"
-    for line in ([r for r in _RESULTS if r["metric"] != headline]
-                 + [r for r in _RESULTS if r["metric"] == headline]):
+    summary = {"metric": "bench_summary",
+               "all": {r["metric"]: [r["value"], r["vs_baseline"]]
+                       for r in _RESULTS}}
+    for line in [r for r in _RESULTS if r["metric"] != headline]:
+        print(json.dumps(line), flush=True)
+    print(json.dumps(summary), flush=True)
+    for line in [r for r in _RESULTS if r["metric"] == headline]:
         print(json.dumps(line), flush=True)
 
 

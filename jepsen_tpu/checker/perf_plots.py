@@ -100,8 +100,13 @@ def _figure():
     return plt, fig, ax
 
 
+POINT_LIMIT = 10_000  # per completion type; matches timeline.py's cap idea
+
+
 def point_graph(test: dict, history: list[dict], output) -> None:
-    """Raw latency scatter, colored by completion type (perf.clj:484-513)."""
+    """Raw latency scatter, colored by completion type (perf.clj:484-513).
+    Downsampled evenly past POINT_LIMIT points per type — a 1M-op run
+    must render in seconds, not choke matplotlib (r2 weak #5)."""
     plt, fig, ax = _figure()
     _shade_nemesis(ax, history)
     by_type: dict[str, list[tuple]] = defaultdict(list)
@@ -109,14 +114,27 @@ def point_graph(test: dict, history: list[dict], output) -> None:
         comp = op.get("completion") or {}
         by_type[comp.get("type", "info")].append(
             (op.get("time", 0) / NS, op["latency"] / 1e6))
+    downsampled = False
     for typ, pts in sorted(by_type.items()):
         arr = np.asarray(pts)
+        if len(arr) > POINT_LIMIT:
+            # stride-sample the bulk but KEEP the slow tail — the
+            # outliers are what the scatter exists to reveal
+            lat = arr[:, 1]
+            tail = lat >= np.quantile(lat, 0.999)
+            idx = np.zeros(len(arr), bool)
+            idx[np.linspace(0, len(arr) - 1,
+                            POINT_LIMIT).astype(np.int64)] = True
+            arr = arr[idx | tail]
+            downsampled = True
         ax.plot(arr[:, 0], arr[:, 1], ".", ms=3,
                 color=TYPE_COLORS.get(typ, "#888888"), label=typ)
     ax.set_yscale("log")
     ax.set_xlabel("time (s)")
     ax.set_ylabel("latency (ms)")
-    ax.set_title(f"{test.get('name', 'test')} latency (raw)")
+    suffix = (f" (raw, downsampled to {POINT_LIMIT}/type)" if downsampled
+              else " (raw)")
+    ax.set_title(f"{test.get('name', 'test')} latency{suffix}")
     if by_type:
         ax.legend(loc="upper right", fontsize=8)
     fig.savefig(output, bbox_inches="tight")

@@ -48,9 +48,17 @@ _MAX_MOPS = 1 << 12
 _MAX_VAL = 1 << 32
 
 
+# phase timings of the most recent check_columnar call (seconds); a
+# diagnosis surface for benchmark trial spread — build is host-side
+# numpy, cycles is the (possibly device) screen + search
+LAST_PHASE_SECONDS: dict = {}
+
+
 def check_columnar(history: list, consistency_models, accelerator: str):
     """Full list-append check on the columnar fast path, or None when the
     history falls outside the integer regime (caller falls back)."""
+    import time as _time
+    t0 = _time.perf_counter()
     try:
         parts = _build(history)
     except (TypeError, ValueError, OverflowError):
@@ -58,8 +66,11 @@ def check_columnar(history: list, consistency_models, accelerator: str):
     if parts is None:
         return None
     graph, txns, extras, n_keys = parts
+    t1 = _time.perf_counter()
 
     cyc = elle.check_cycles(graph, accelerator=accelerator)
+    LAST_PHASE_SECONDS.update(build=round(t1 - t0, 3),
+                              cycles=round(_time.perf_counter() - t1, 3))
     merged_extras = {k: v for k, v in extras.items()
                      if k != "unobserved-writer"}
     result = elle.result_map(cyc, txns, merged_extras,

@@ -57,8 +57,9 @@ def rand_gen():
 
 
 def double_spend_gen():
-    """Fund each account with 10, then race up to 2^5 withdrawals of 9
-    (ledger.clj:155-164) — at most one may commit."""
+    """Fund each account with 10, then race up to 2^4 = 16 withdrawals
+    of 9 (ledger.clj:155-164's ``(Math/pow 2 (rand-int 5))``) — at most
+    one may commit."""
     lock = threading.Lock()
     ids = itertools.count()
     state = {"account": -1, "left": 0}

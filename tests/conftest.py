@@ -11,10 +11,16 @@ import sys
 # Must run before any backend init anywhere in the test session. Force —
 # the image's profile exports JAX_PLATFORMS=axon (a tunneled TPU), and unit
 # tests must not depend on (or block on) that tunnel.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+#
+# Exception: JEPSEN_TPU_TESTS=1 opts a session INTO the real chip for the
+# ``-m tpu`` parity tier (tests/test_tpu_parity.py) — the platform list is
+# left alone so the axon TPU stays the default device.
+TPU_SESSION = bool(os.environ.get("JEPSEN_TPU_TESTS"))
+if not TPU_SESSION:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -22,14 +28,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # ("axon") in every interpreter and sets jax_platforms="axon,cpu" via
 # jax.config — which overrides the env var. Initializing that backend dials
 # a relay and can block indefinitely if the tunnel is down. Tests are
-# CPU-only by design, so force the platform list back to cpu before any
-# backend init (conftest imports before any test touches jax).
-try:
-    import jax
+# CPU-only by design (outside the opted-in tpu tier), so force the
+# platform list back to cpu before any backend init (conftest imports
+# before any test touches jax).
+if not TPU_SESSION:
+    try:
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 
 def run_fake(suite_test_fn, **opts):

@@ -239,6 +239,30 @@ def test_perf_timeline_clock_render(tmp_path):
     assert "process 0" in html and "read" in html
 
 
+def test_point_graph_downsamples_large_histories(tmp_path):
+    """r2 weak #5 / r3 item 8: the raw-latency scatter must cap its
+    point count so a huge run renders in seconds, not choke
+    matplotlib."""
+    import time
+
+    from jepsen_tpu.checker.perf_plots import POINT_LIMIT, point_graph
+
+    ns = 1_000_000_000
+    h = []
+    for i in range(60_000):
+        h.append({"type": "invoke", "process": i % 5, "f": "w",
+                  "value": None, "time": i * ns // 1000})
+        h.append({"type": "ok", "process": i % 5, "f": "w",
+                  "value": i, "time": i * ns // 1000 + ns // 10_000})
+    out = tmp_path / "raw.png"
+    t0 = time.perf_counter()
+    point_graph({"name": "big"}, h, out)
+    dt = time.perf_counter() - t0
+    assert out.stat().st_size > 0
+    assert dt < 30, f"downsampled render took {dt:.1f}s"
+    assert POINT_LIMIT == 10_000
+
+
 def test_latencies_to_quantiles():
     import numpy as np
     from jepsen_tpu.checker.perf_plots import latencies_to_quantiles

@@ -284,3 +284,89 @@ def test_realdb_postgres_wire_client(tmp_path, monkeypatch):
     finally:
         proc.kill()
         proc.wait()
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_mysql_wire_client(tmp_path, monkeypatch):
+    """Scratch mysqld/mariadbd + the from-scratch MySQL wire client:
+    native-password auth, CRUD, the serializable bank workload through
+    the full suite lifecycle (VERDICT r3 item 6 — the PG template at
+    test_realdb_postgres_wire_client, one protocol over)."""
+    mysqld = _find("mariadbd", "JEPSEN_MYSQLD_BIN") \
+        or _find("mysqld", "JEPSEN_MYSQLD_BIN")
+    if not mysqld:
+        pytest.skip("mysqld/mariadbd not installed")
+    install = _find("mariadb-install-db", "JEPSEN_MYSQL_INSTALL_BIN") \
+        or _find("mysql_install_db", "JEPSEN_MYSQL_INSTALL_BIN")
+
+    from jepsen_tpu.suites import galera as galera_suite
+    from jepsen_tpu.suites._mysql import MySQLConnection, MySQLError
+
+    port = _free_port()
+    data = tmp_path / "mysqldata"
+    sock = tmp_path / "mysql.sock"
+    base_args = [mysqld, f"--datadir={data}", f"--socket={sock}",
+                 f"--port={port}", "--bind-address=127.0.0.1",
+                 "--skip-name-resolve",
+                 f"--pid-file={tmp_path}/mysqld.pid",
+                 f"--log-error={tmp_path}/mysqld.err"]
+    if install:  # mariadb: normal auth gives root a password-less login
+        subprocess.run(
+            [install, f"--datadir={data}",
+             "--auth-root-authentication-method=normal"],
+            check=True, capture_output=True)
+    else:        # oracle mysqld: self-initializing, root with empty pw
+        subprocess.run(
+            [mysqld, f"--datadir={data}", "--initialize-insecure",
+             f"--log-error={tmp_path}/init.err"],
+            check=True, capture_output=True)
+        base_args.append(
+            "--default-authentication-plugin=mysql_native_password")
+    proc = subprocess.Popen(base_args, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc)
+
+        # native-password auth (empty root pw) + CRUD over our own wire
+        deadline = time.time() + 30
+        conn = None
+        while conn is None:
+            try:
+                conn = MySQLConnection("127.0.0.1", port=port, user="root",
+                                       password="", database="mysql")
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+        rows = conn.query("SELECT 1 + 1")
+        assert int(rows[0][0]) == 2
+
+        conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+        conn.query("CREATE USER IF NOT EXISTS 'jepsen'@'%' IDENTIFIED "
+                   "WITH mysql_native_password BY 'jepsen'")
+        conn.query("GRANT ALL PRIVILEGES ON jepsen.* TO 'jepsen'@'%'")
+        conn.query("FLUSH PRIVILEGES")
+
+        # authenticated CRUD as the workload user (non-empty password
+        # exercises the scramble path)
+        c2 = MySQLConnection("127.0.0.1", port=port, user="jepsen",
+                             password="jepsen", database="jepsen")
+        c2.query("CREATE TABLE t (k INT PRIMARY KEY, v INT)")
+        c2.query("INSERT INTO t VALUES (1, 10)")
+        c2.query("UPDATE t SET v = 11 WHERE k = 1")
+        rows = c2.query("SELECT v FROM t WHERE k = 1")
+        assert int(rows[0][0]) == 11
+        with pytest.raises(MySQLError):
+            c2.query("INSERT INTO t VALUES (1, 12)")  # duplicate key
+
+        # bank workload end-to-end: dummy remote no-ops the node
+        # automation, the client speaks the real protocol to the daemon
+        monkeypatch.setattr(galera_suite, "PORT", port)
+        result = _run_suite(galera_suite.galera_test, tmp_path / "store",
+                            workload="bank", time_limit=5)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
