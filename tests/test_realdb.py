@@ -47,6 +47,22 @@ def _find(binary: str, env_var: str) -> str | None:
     return os.environ.get(env_var) or shutil.which(binary)
 
 
+def _await_conn(factory, proc, timeout_s: float = 30.0, dt: float = 0.3):
+    """Retries ``factory()`` until it connects; raises early when the
+    daemon has already exited (a dead daemon must not spin the whole
+    timeout and surface as a generic connection error)."""
+    deadline = time.time() + timeout_s
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode}")
+        try:
+            return factory()
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(dt)
+
+
 def _run_suite(suite_test, tmp_path, **opts):
     from jepsen_tpu import core
 
@@ -227,16 +243,10 @@ def test_realdb_postgres_wire_client(tmp_path, monkeypatch):
         _await_port(port, proc)
 
         # SCRAM-SHA-256 auth + simple query over our own wire code
-        deadline = time.time() + 20
-        conn = None
-        while conn is None:
-            try:
-                conn = PGConnection("127.0.0.1", port=port, user="super",
-                                    password="superpw", database="postgres")
-            except Exception:
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.3)
+        conn = _await_conn(
+            lambda: PGConnection("127.0.0.1", port=port, user="super",
+                                 password="superpw", database="postgres"),
+            proc, timeout_s=20)
         rows, _ = conn.query("select 1 + 1")
         assert rows[0][0] in ("2", 2)
 
@@ -330,16 +340,9 @@ def test_realdb_mysql_wire_client(tmp_path, monkeypatch):
         _await_port(port, proc)
 
         # native-password auth (empty root pw) + CRUD over our own wire
-        deadline = time.time() + 30
-        conn = None
-        while conn is None:
-            try:
-                conn = MySQLConnection("127.0.0.1", port=port, user="root",
-                                       password="", database="mysql")
-            except Exception:
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.3)
+        conn = _await_conn(
+            lambda: MySQLConnection("127.0.0.1", port=port, user="root",
+                                    password="", database="mysql"), proc)
         rows = conn.query("SELECT 1 + 1")
         assert int(rows[0][0]) == 2
 
@@ -366,6 +369,240 @@ def test_realdb_mysql_wire_client(tmp_path, monkeypatch):
         monkeypatch.setattr(galera_suite, "PORT", port)
         result = _run_suite(galera_suite.galera_test, tmp_path / "store",
                             workload="bank", time_limit=5)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_rethinkdb_wire_client(tmp_path, monkeypatch):
+    """Scratch single-node rethinkdb + the bundled ReQL driver: V0_4
+    handshake, DDL, CRUD terms, then the register and set workloads
+    through the suite lifecycle."""
+    rethinkdb_bin = _find("rethinkdb", "JEPSEN_RETHINKDB_BIN")
+    if not rethinkdb_bin:
+        pytest.skip("rethinkdb not installed")
+
+    from jepsen_tpu.suites import rethinkdb as r_suite
+    from jepsen_tpu.suites import _reql as r
+    from jepsen_tpu.suites._reql import ReqlConnection
+
+    driver_port = _free_port()
+    cluster_port = _free_port()
+    proc = subprocess.Popen(
+        [rethinkdb_bin, "--directory", str(tmp_path / "rdb"),
+         "--bind", "127.0.0.1", "--driver-port", str(driver_port),
+         "--cluster-port", str(cluster_port), "--no-http-admin",
+         "--no-update-check"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _await_port(driver_port, proc, timeout_s=60)
+        conn = _await_conn(
+            lambda: ReqlConnection("127.0.0.1", driver_port), proc)
+        conn.run(r.db_create("smoke"))
+        conn.run(r.table_create(r.db("smoke"), "t"))
+        conn.run(r.insert(r.table(r.db("smoke"), "t"), {"id": 1, "v": 5}))
+        out = conn.run(r.get_field(r.get(r.table(r.db("smoke"), "t"), 1),
+                                   "v"))
+        assert out == 5
+
+        monkeypatch.setattr(r_suite, "DRIVER_PORT", driver_port)
+        for workload in ("register", "set"):
+            result = _run_suite(r_suite.rethinkdb_test,
+                                tmp_path / f"store-{workload}",
+                                workload=workload, time_limit=5)
+            assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_rabbitmq_wire_client(tmp_path, monkeypatch):
+    """Scratch rabbitmq-server + the bundled AMQP 0-9-1 client:
+    handshake, declare/publish/get/ack, then the queue workload through
+    the suite lifecycle."""
+    server = _find("rabbitmq-server", "JEPSEN_RABBITMQ_BIN")
+    if not server:
+        pytest.skip("rabbitmq-server not installed")
+
+    from jepsen_tpu.suites import rabbitmq as mq_suite
+    from jepsen_tpu.suites._amqp import AmqpConnection
+
+    port = _free_port()
+    env = dict(os.environ,
+               RABBITMQ_NODENAME=f"jepsen{port}@localhost",
+               RABBITMQ_NODE_PORT=str(port),
+               RABBITMQ_NODE_IP_ADDRESS="127.0.0.1",
+               RABBITMQ_DIST_PORT=str(_free_port()),
+               RABBITMQ_MNESIA_BASE=str(tmp_path / "mnesia"),
+               RABBITMQ_LOG_BASE=str(tmp_path / "log"),
+               RABBITMQ_PID_FILE=str(tmp_path / "pid"),
+               RABBITMQ_ENABLED_PLUGINS_FILE=str(tmp_path / "plugins"))
+    proc = subprocess.Popen([server], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc, timeout_s=90)
+        conn = _await_conn(lambda: AmqpConnection("127.0.0.1", port),
+                           proc, timeout_s=60, dt=0.5)
+        conn.confirm_select()
+        conn.queue_declare("smoke")
+        conn.publish("smoke", b"42")
+        tag, body = conn.get("smoke")
+        assert body == b"42"
+        conn.ack(tag)
+
+        monkeypatch.setattr(mq_suite, "PORT", port)
+        result = _run_suite(mq_suite.rabbitmq_test, tmp_path / "store",
+                            workload="queue", time_limit=5)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_cassandra_cql_wire_client(tmp_path):
+    """Scratch single-node Cassandra + the from-scratch CQL v4 client:
+    STARTUP, DDL, typed Rows decode, counters, and LWT — the protocol
+    surface the YCQL suite rides, against a real CQL server (the
+    scripted-server tests' semantics check)."""
+    cassandra_bin = _find("cassandra", "JEPSEN_CASSANDRA_BIN")
+    if not cassandra_bin:
+        pytest.skip("cassandra not installed")
+
+    from jepsen_tpu.suites._cql_client import CQLConnection
+
+    port = _free_port()
+    storage_port = _free_port()
+    conf = tmp_path / "conf"
+    conf.mkdir()
+    # partitioner + commitlog_sync are REQUIRED directives; the
+    # host:port seed form needs Cassandra 4.0+
+    (conf / "cassandra.yaml").write_text(f"""
+cluster_name: jepsen-smoke
+num_tokens: 16
+partitioner: org.apache.cassandra.dht.Murmur3Partitioner
+commitlog_sync: periodic
+commitlog_sync_period_in_ms: 10000
+commitlog_directory: {tmp_path}/commitlog
+data_file_directories: [{tmp_path}/data]
+saved_caches_directory: {tmp_path}/caches
+hints_directory: {tmp_path}/hints
+listen_address: 127.0.0.1
+rpc_address: 127.0.0.1
+native_transport_port: {port}
+storage_port: {storage_port}
+start_native_transport: true
+endpoint_snitch: SimpleSnitch
+seed_provider:
+  - class_name: org.apache.cassandra.locator.SimpleSeedProvider
+    parameters:
+      - seeds: "127.0.0.1:{storage_port}"
+""")
+    env = dict(os.environ, CASSANDRA_CONF=str(conf))
+    proc = subprocess.Popen([cassandra_bin, "-f"], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc, timeout_s=180)
+        conn = _await_conn(lambda: CQLConnection("127.0.0.1", port),
+                           proc, timeout_s=60, dt=0.5)
+        conn.query("CREATE KEYSPACE smoke WITH replication = "
+                   "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        conn.query("CREATE TABLE smoke.t (k INT PRIMARY KEY, v INT)")
+        conn.query("INSERT INTO smoke.t (k, v) VALUES (1, 10)")
+        rows = conn.query("SELECT k, v FROM smoke.t WHERE k = 1")
+        assert rows == [{"k": 1, "v": 10}]
+        # LWT: applied and not-applied both decode
+        rows = conn.query("UPDATE smoke.t SET v = 11 WHERE k = 1 IF v = 10")
+        assert rows and rows[0].get("[applied]") is True
+        rows = conn.query("UPDATE smoke.t SET v = 12 WHERE k = 1 IF v = 99")
+        assert rows and rows[0].get("[applied]") is False
+        # counter column decode
+        conn.query("CREATE TABLE smoke.c (id INT PRIMARY KEY, n COUNTER)")
+        conn.query("UPDATE smoke.c SET n = n + 5 WHERE id = 0")
+        rows = conn.query("SELECT n FROM smoke.c WHERE id = 0")
+        assert rows[0]["n"] == 5
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.realdb
+def test_realdb_aerospike_wire_client(tmp_path, monkeypatch):
+    """Scratch single-node asd + the from-scratch binary protocol
+    client: info, put/get, generation CAS, string append, then the
+    register workload through the suite lifecycle."""
+    asd = _find("asd", "JEPSEN_ASD_BIN")
+    if not asd:
+        pytest.skip("asd (aerospike) not installed")
+
+    from jepsen_tpu.suites import aerospike as as_suite
+    from jepsen_tpu.suites._aerospike import AerospikeConnection
+
+    port = _free_port()
+    conf = tmp_path / "asd.conf"
+    conf.write_text(f"""
+service {{
+    work-directory {tmp_path}
+    pidfile {tmp_path}/asd.pid
+    proto-fd-max 1024
+}}
+logging {{
+    file {tmp_path}/asd.log {{ context any info }}
+}}
+network {{
+    service {{ address 127.0.0.1
+               port {port} }}
+    heartbeat {{ mode mesh
+                 address 127.0.0.1
+                 port {_free_port()}
+                 interval 150
+                 timeout 10 }}
+    fabric {{ port {_free_port()} }}
+    info {{ port {_free_port()} }}
+}}
+namespace jepsen {{
+    replication-factor 1
+    storage-engine memory {{ data-size 128M }}
+}}
+""")
+    proc = subprocess.Popen([asd, "--foreground", "--config-file",
+                             str(conf)],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        _await_port(port, proc, timeout_s=60)
+        conn = AerospikeConnection("127.0.0.1", port, namespace="jepsen",
+                                   set_name="registers")
+        conn.put(1, 10)
+        value, gen = conn.get(1)
+        assert value == 10
+        applied = conn.put(1, 11, generation=gen)
+        assert applied
+        stale = conn.put(1, 12, generation=gen)  # gen moved on: rejected
+        assert not stale
+        conn.append(2, " 7")
+        conn.append(2, " 9")
+        assert conn.get_string(2).split() == ["7", "9"]
+        conn.incr(3, 4)
+        value, _ = conn.get(3)
+        assert value == 4
+
+        monkeypatch.setattr(as_suite, "PORT", port)
+        result = _run_suite(as_suite.aerospike_test, tmp_path / "store",
+                            workload="register", time_limit=5)
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         proc.kill()
