@@ -1,0 +1,176 @@
+"""Soundness fuzz for the round-4 checkers (SURVEY §4's
+differential-oracle strategy): valid-by-construction histories must
+NEVER be convicted, and planted anomalies must always be caught.
+
+Covered: the fauna multimonotonic read-skew SCC checker (vs an O(n²)
+pairwise incomparability oracle), the ts-order state machine, the
+monotonic-key cycle checker (tidb), and the ledger double-spend
+checker."""
+import random
+
+from jepsen_tpu.workloads import fauna_multimonotonic, ledger, monotonic_key
+
+
+def _simulate_multi_reads(rng, n_keys=4, n_ops=60):
+    """Sequential execution of per-key increments with interleaved
+    snapshot reads — every read sees a true moment-in-time state, so
+    both checkers must pass."""
+    state = {k: 0 for k in range(n_keys)}
+    ts = 0
+    reads = []
+    for i in range(n_ops):
+        if rng.random() < 0.5:
+            k = rng.randrange(n_keys)
+            state[k] += 1
+            ts += 1
+        else:
+            ts += 1
+            ks = rng.sample(range(n_keys), rng.randint(1, n_keys))
+            reads.append({
+                "type": "ok", "f": "read", "index": i,
+                "value": {"ts": ts,
+                          "registers": {k: {"value": state[k], "ts": ts}
+                                        for k in ks}}})
+    return reads
+
+
+def _pairwise_skew_oracle(reads):
+    """O(n²) oracle for the 2-cycle case: a pair of reads where one key
+    increases and another decreases (multimonotonic.clj's map-compare
+    incomparability)."""
+    states = [fauna_multimonotonic.read_state(op) for op in reads]
+    for i in range(len(states)):
+        for j in range(i + 1, len(states)):
+            common = set(states[i]) & set(states[j])
+            signs = {(states[i][k] > states[j][k]) - (states[i][k] <
+                                                      states[j][k])
+                     for k in common}
+            if 1 in signs and -1 in signs:
+                return True
+    return False
+
+
+def test_read_skew_fuzz_no_false_convictions():
+    for seed in range(30):
+        rng = random.Random(seed)
+        reads = _simulate_multi_reads(rng)
+        out = fauna_multimonotonic.ReadSkewChecker().check({}, reads, {})
+        assert out["valid?"] is True, (seed, out)
+        assert _pairwise_skew_oracle(reads) is False
+        out = fauna_multimonotonic.TsOrderChecker().check({}, reads, {})
+        assert out["valid?"] is True, (seed, out)
+
+
+def test_read_skew_fuzz_agrees_with_pairwise_oracle_on_mutations():
+    """Mutate a valid history; wherever the pairwise oracle sees a
+    2-cycle, the SCC checker must convict too (SCC also catches longer
+    cycles, so only oracle→checker is implied)."""
+    caught = 0
+    for seed in range(40):
+        rng = random.Random(1000 + seed)
+        reads = _simulate_multi_reads(rng, n_ops=40)
+        if len(reads) < 3:
+            continue
+        # swap two observed values of one key between two reads
+        victims = [op for op in reads
+                   if len(fauna_multimonotonic.read_state(op)) >= 2]
+        if len(victims) < 2:
+            continue
+        a, b = rng.sample(victims, 2)
+        ks = list(set(fauna_multimonotonic.read_state(a))
+                  & set(fauna_multimonotonic.read_state(b)))
+        if len(ks) < 2:
+            continue
+        k1, k2 = rng.sample(ks, 2)
+        ra, rb = a["value"]["registers"], b["value"]["registers"]
+        # force a: k1 low, k2 high; b: k1 high, k2 low
+        ra[k1]["value"], rb[k1]["value"] = 0, 10
+        ra[k2]["value"], rb[k2]["value"] = 10, 0
+        oracle = _pairwise_skew_oracle(reads)
+        out = fauna_multimonotonic.ReadSkewChecker().check({}, reads, {})
+        if oracle:
+            caught += 1
+            assert out["valid?"] is False, seed
+    assert caught >= 10, f"mutation fuzz only produced {caught} skews"
+
+
+def _simulate_mono_key(rng, n_keys=4, n_ops=50):
+    """Sequential per-key increments + whole-pool reads with realtime
+    metadata — valid by construction."""
+    state = {k: -1 for k in range(n_keys)}
+    history = []
+    t = 0
+    for i in range(n_ops):
+        p = i % 3
+        if rng.random() < 0.5:
+            k = rng.randrange(n_keys)
+            state[k] += 1
+            history.append({"type": "invoke", "f": "inc", "value": k,
+                            "process": p, "time": t})
+            history.append({"type": "ok", "f": "inc",
+                            "value": {k: state[k]}, "process": p,
+                            "time": t + 1})
+        else:
+            history.append({"type": "invoke", "f": "read", "value": None,
+                            "process": p, "time": t})
+            history.append({"type": "ok", "f": "read",
+                            "value": dict(state), "process": p,
+                            "time": t + 1})
+        t += 2
+    return history
+
+
+def test_monotonic_key_fuzz_no_false_convictions():
+    for seed in range(25):
+        rng = random.Random(seed)
+        history = _simulate_mono_key(rng)
+        out = monotonic_key.checker().check({"accelerator": "cpu"},
+                                            history, {})
+        assert out["valid?"] is True, (seed, out)
+
+
+def _simulate_ledger(rng, n_accounts=3, n_ops=60):
+    """Guarded sequential ledger — never double-spends."""
+    balances = {a: 0 for a in range(n_accounts)}
+    history = []
+    for i in range(n_ops):
+        a = rng.randrange(n_accounts)
+        amount = rng.randint(-3, 3)
+        if amount >= 0 or balances[a] + amount >= 0:
+            if amount != 0:
+                balances[a] += amount
+                history.append({"type": "ok", "f": "transfer",
+                                "value": [a, amount, i]})
+        else:
+            history.append({"type": "fail", "f": "transfer",
+                            "value": [a, amount, i]})
+        if rng.random() < 0.1:  # indeterminate deposit: counts
+            balances[a] += 2
+            history.append({"type": "info", "f": "transfer",
+                            "value": [a, 2, 1000 + i]})
+    return history
+
+
+def test_ledger_fuzz_no_false_convictions():
+    for seed in range(40):
+        rng = random.Random(seed)
+        history = _simulate_ledger(rng)
+        out = ledger.LedgerChecker().check({}, history, {})
+        assert out["valid?"] is True, (seed, out)
+
+
+def test_ledger_fuzz_catches_planted_double_spends():
+    for seed in range(20):
+        rng = random.Random(seed)
+        history = _simulate_ledger(rng)
+        # plant: one acknowledged withdrawal that overdraws account 0
+        balance = sum(v[1] for op in history
+                      for v in [op["value"]]
+                      if v[0] == 0 and (op["type"] == "ok"
+                                        or (op["type"] == "info"
+                                            and v[1] > 0)))
+        history.append({"type": "ok", "f": "transfer",
+                        "value": [0, -(balance + 1), 9999]})
+        out = ledger.LedgerChecker().check({}, history, {})
+        assert out["valid?"] is False, seed
+        assert any(e["account"] == 0 for e in out["errors"])

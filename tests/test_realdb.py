@@ -30,23 +30,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _await_port(port: int, proc, timeout_s: float = 20.0) -> None:
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            raise RuntimeError(f"daemon exited rc={proc.returncode}")
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=1):
-                return
-        except OSError:
-            time.sleep(0.2)
-    raise TimeoutError(f"daemon never listened on {port}")
-
-
-def _find(binary: str, env_var: str) -> str | None:
-    return os.environ.get(env_var) or shutil.which(binary)
-
-
 def _await_conn(factory, proc, timeout_s: float = 30.0, dt: float = 0.3):
     """Retries ``factory()`` until it connects; raises early when the
     daemon has already exited (a dead daemon must not spin the whole
@@ -61,6 +44,17 @@ def _await_conn(factory, proc, timeout_s: float = 30.0, dt: float = 0.3):
             if time.time() > deadline:
                 raise
             time.sleep(dt)
+
+
+def _await_port(port: int, proc, timeout_s: float = 20.0) -> None:
+    def probe():
+        socket.create_connection(("127.0.0.1", port), timeout=1).close()
+
+    _await_conn(probe, proc, timeout_s=timeout_s, dt=0.2)
+
+
+def _find(binary: str, env_var: str) -> str | None:
+    return os.environ.get(env_var) or shutil.which(binary)
 
 
 def _run_suite(suite_test, tmp_path, **opts):
@@ -584,9 +578,14 @@ namespace jepsen {{
                             stderr=subprocess.DEVNULL)
     try:
         _await_port(port, proc, timeout_s=60)
-        conn = AerospikeConnection("127.0.0.1", port, namespace="jepsen",
-                                   set_name="registers")
-        conn.put(1, 10)
+
+        def first_contact():
+            c = AerospikeConnection("127.0.0.1", port, namespace="jepsen",
+                                    set_name="registers")
+            c.put(1, 10)  # retried too: partitions settle after the port
+            return c
+
+        conn = _await_conn(first_contact, proc)
         value, gen = conn.get(1)
         assert value == 10
         applied = conn.put(1, 11, generation=gen)
