@@ -67,6 +67,25 @@ class Accounts(Model):
         return f"Accounts({self.balances})"
 
 
+def _acct_key(k):
+    """JSON round-trips (store.jsonl → analyze re-check) stringify dict
+    keys; integer account ids come back as digit strings and would
+    falsely convict every stored read against the int-keyed model."""
+    if isinstance(k, str):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+    return k
+
+
+def _norm_op(op: dict) -> dict:
+    v = op.get("value")
+    if op.get("f") in ("read", "partial-read") and isinstance(v, dict):
+        return {**op, "value": {_acct_key(k): x for k, x in v.items()}}
+    return op
+
+
 class TransferChecker(Checker):
     """Linearizability against the Accounts model via the shared WGL
     oracle (transfer.clj's knossos check)."""
@@ -79,7 +98,7 @@ class TransferChecker(Checker):
 
     def check(self, test, history, opts):
         from jepsen_tpu.checker.linear_cpu import wgl
-        client_ops = [op for op in history
+        client_ops = [_norm_op(op) for op in history
                       if isinstance(op.get("process"), int)]
         res = wgl(client_ops, Accounts(self.init))
         out = {"valid?": res.valid if res.valid == "unknown"

@@ -1,0 +1,48 @@
+"""Checkpoint/resume soundness for the round-4 workloads: a stored
+history re-checked through the analyze path (store.jsonl round-trip)
+must reach the SAME verdict as the live run. JSON stringifies dict
+keys, so any checker comparing against int-keyed state is at risk —
+the mongodb transfer checker was falsely convicting stored histories
+until its key normalization landed."""
+import pytest
+
+from jepsen_tpu import core, store
+from jepsen_tpu.suites import dgraph, faunadb, mongodb, stolon, tidb
+
+
+def _run_and_reanalyze(suite_test_fn, tmp_path, **opts):
+    t = suite_test_fn({"fake": True, "time_limit": 1.0,
+                       "store_dir": str(tmp_path), "no_perf": True,
+                       "accelerator": "cpu", **opts})
+    live = core.run(t)
+    name = t["name"]
+    ts = sorted(store.tests(name, str(tmp_path))[name])[-1]
+    hist = store.load_history(name, ts, str(tmp_path))
+    # a fresh test map, the way the analyze CLI rebuilds it
+    t2 = suite_test_fn({"fake": True, "time_limit": 1.0,
+                        "store_dir": str(tmp_path), "no_perf": True,
+                        "accelerator": "cpu", **opts})
+    re = t2["checker"].check(t2, hist, {})
+    return live["results"], re
+
+
+CASES = [
+    (mongodb.mongodb_test, {"workload": "transfer"}),
+    (faunadb.faunadb_test, {"workload": "monotonic"}),
+    (faunadb.faunadb_test, {"workload": "multimonotonic"}),
+    (faunadb.faunadb_test, {"workload": "internal"}),
+    (tidb.tidb_test, {"workload": "monotonic"}),
+    (dgraph.dgraph_test, {"workload": "delete"}),
+    (dgraph.dgraph_test, {"workload": "sequential"}),
+    (stolon.stolon_test, {"workload": "ledger"}),
+]
+
+
+@pytest.mark.parametrize("suite_fn,opts", CASES,
+                         ids=[f"{fn.__name__}-{o['workload']}"
+                              for fn, o in CASES])
+def test_analyze_verdict_matches_live(tmp_path, suite_fn, opts):
+    live, re = _run_and_reanalyze(suite_fn, tmp_path, **opts)
+    assert live["valid?"] is True, live
+    assert re["valid?"] is True, (
+        "stored-history re-check diverged from the live verdict", re)
