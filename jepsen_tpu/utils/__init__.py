@@ -355,3 +355,21 @@ class NamedLocks:
             lock = self._locks.setdefault(name, threading.RLock())
         with lock:
             yield
+
+
+def int_key(k):
+    """Digit-string → int, anything else unchanged. JSON round-trips
+    (store history.jsonl → analyze re-check) stringify dict keys, so
+    checkers comparing read maps against int-keyed config (bank
+    accounts, transfer's Accounts model) normalize through this before
+    judging — a stored history must reach the live verdict."""
+    if isinstance(k, str):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+    return k
+
+
+def int_keyed(d: dict) -> dict:
+    return {int_key(k): v for k, v in d.items()}

@@ -11,6 +11,7 @@ import logging
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker import Checker
+from jepsen_tpu.utils import int_keyed
 
 logger = logging.getLogger("jepsen.workloads.bank")
 
@@ -50,7 +51,10 @@ class BankChecker(Checker):
             if op.get("type") != "ok" or op.get("f") != "read":
                 continue
             read_count += 1
-            balances = op.get("value") or {}
+            # stored histories stringify account keys (store.jsonl →
+            # analyze); normalize or every re-check sees phantom
+            # "unexpected accounts"
+            balances = int_keyed(op.get("value") or {})
             errs = []
             extra = set(balances) - accounts
             if extra:

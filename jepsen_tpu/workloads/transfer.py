@@ -22,6 +22,7 @@ from __future__ import annotations
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.models import Inconsistent, Model, inconsistent
+from jepsen_tpu.utils import int_keyed
 
 DEFAULT_ACCOUNTS = 2
 DEFAULT_BALANCE = 10
@@ -67,22 +68,13 @@ class Accounts(Model):
         return f"Accounts({self.balances})"
 
 
-def _acct_key(k):
+def _norm_op(op: dict) -> dict:
     """JSON round-trips (store.jsonl → analyze re-check) stringify dict
     keys; integer account ids come back as digit strings and would
     falsely convict every stored read against the int-keyed model."""
-    if isinstance(k, str):
-        try:
-            return int(k)
-        except ValueError:
-            return k
-    return k
-
-
-def _norm_op(op: dict) -> dict:
     v = op.get("value")
     if op.get("f") in ("read", "partial-read") and isinstance(v, dict):
-        return {**op, "value": {_acct_key(k): x for k, x in v.items()}}
+        return {**op, "value": int_keyed(v)}
     return op
 
 

@@ -27,6 +27,7 @@ def _run_and_reanalyze(suite_test_fn, tmp_path, **opts):
 
 
 CASES = [
+    (faunadb.faunadb_test, {"workload": "bank"}),
     (mongodb.mongodb_test, {"workload": "transfer"}),
     (faunadb.faunadb_test, {"workload": "monotonic"}),
     (faunadb.faunadb_test, {"workload": "multimonotonic"}),
