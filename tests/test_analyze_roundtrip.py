@@ -7,7 +7,8 @@ until its key normalization landed."""
 import pytest
 
 from jepsen_tpu import core, store
-from jepsen_tpu.suites import dgraph, faunadb, mongodb, stolon, tidb
+from jepsen_tpu.suites import (crate, dgraph, faunadb, galera, mongodb,
+                               rabbitmq, rethinkdb, stolon, tidb, yugabyte)
 
 
 def _run_and_reanalyze(suite_test_fn, tmp_path, **opts):
@@ -26,24 +27,43 @@ def _run_and_reanalyze(suite_test_fn, tmp_path, **opts):
     return live["results"], re
 
 
+# (suite_fn, opts, may_be_unknown) — the last flag marks the one
+# workload whose short fake run can legitimately end "unknown" (a
+# straggler key claimed near the time limit never gets its final read);
+# every other case must deterministically verify True
 CASES = [
-    (faunadb.faunadb_test, {"workload": "bank"}),
-    (mongodb.mongodb_test, {"workload": "transfer"}),
-    (faunadb.faunadb_test, {"workload": "monotonic"}),
-    (faunadb.faunadb_test, {"workload": "multimonotonic"}),
-    (faunadb.faunadb_test, {"workload": "internal"}),
-    (tidb.tidb_test, {"workload": "monotonic"}),
-    (dgraph.dgraph_test, {"workload": "delete"}),
-    (dgraph.dgraph_test, {"workload": "sequential"}),
-    (stolon.stolon_test, {"workload": "ledger"}),
+    (faunadb.faunadb_test, {"workload": "bank"}, False),
+    (mongodb.mongodb_test, {"workload": "transfer"}, False),
+    (faunadb.faunadb_test, {"workload": "monotonic"}, False),
+    (faunadb.faunadb_test, {"workload": "multimonotonic"}, False),
+    (faunadb.faunadb_test, {"workload": "internal"}, False),
+    (tidb.tidb_test, {"workload": "monotonic"}, False),
+    (dgraph.dgraph_test, {"workload": "delete"}, False),
+    (dgraph.dgraph_test, {"workload": "sequential"}, False),
+    (stolon.stolon_test, {"workload": "ledger"}, False),
+    # broad sweep over value shapes (lists, tuples, txn mops, queues):
+    # the whole JSON-round-trip bug class, not just dict keys
+    (galera.galera_test, {"workload": "dirty-reads"}, False),
+    (yugabyte.yugabyte_test, {"workload": "multi-key-acid"}, False),
+    (tidb.tidb_test, {"workload": "set-cas"}, False),
+    (tidb.tidb_test, {"workload": "append"}, False),
+    (crate.crate_test, {"workload": "lost-updates"}, True),
+    (rethinkdb.rethinkdb_test, {"workload": "counter"}, False),
+    (rabbitmq.rabbitmq_test, {"workload": "queue"}, False),
+    (faunadb.faunadb_test, {"workload": "pages"}, False),
 ]
 
 
-@pytest.mark.parametrize("suite_fn,opts", CASES,
+@pytest.mark.parametrize("suite_fn,opts,may_be_unknown", CASES,
                          ids=[f"{fn.__name__}-{o['workload']}"
-                              for fn, o in CASES])
-def test_analyze_verdict_matches_live(tmp_path, suite_fn, opts):
+                              for fn, o, _ in CASES])
+def test_analyze_verdict_matches_live(tmp_path, suite_fn, opts,
+                                      may_be_unknown):
     live, re = _run_and_reanalyze(suite_fn, tmp_path, **opts)
-    assert live["valid?"] is True, live
-    assert re["valid?"] is True, (
-        "stored-history re-check diverged from the live verdict", re)
+    if may_be_unknown:
+        assert live["valid?"] in (True, "unknown"), live
+    else:
+        assert live["valid?"] is True, live
+    assert re["valid?"] == live["valid?"], (
+        "stored-history re-check diverged from the live verdict",
+        live["valid?"], re)
