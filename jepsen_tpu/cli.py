@@ -186,24 +186,35 @@ def analyze_cmd(opts, test_fn) -> int:
 
 
 def test_all_cmd(tests_fn: Callable[[argparse.Namespace], list], name="jepsen-tpu"):
-    """Sweep runner (cli.clj:429-515): runs every workload, summarizes."""
+    """Sweep runner (cli.clj:429-515): runs every workload, summarizes.
+    Honors the module exit-code contract like single_test_cmd: bad
+    arguments → EXIT_BAD_ARGS, a crash mid-sweep → EXIT_CRASH."""
 
     def main(argv: list[str] | None = None) -> int:
         parser = argparse.ArgumentParser(prog=f"{name} test-all")
         add_test_opts(parser)
-        opts = parser.parse_args(argv)
-        from jepsen_tpu import core
-        worst = EXIT_OK
-        # each round rebuilds the test maps — core.run mutates them
-        # (cli.clj:429-515 runs every combination test-count times)
-        for _ in range(getattr(opts, "test_count", 1) or 1):
-            for test in tests_fn(opts):
-                result = core.run(test)
-                code = validity_exit_code(result)
-                worst = max(worst, code if code != EXIT_OK else worst)
-                logger.info("%s: %s", test.get("name"),
-                            (result.get("results") or {}).get("valid?"))
-        return worst
+        try:
+            opts = parser.parse_args(argv)
+        except SystemExit:
+            return EXIT_BAD_ARGS
+        try:
+            from jepsen_tpu import core
+            worst = EXIT_OK
+            # each round rebuilds the test maps — core.run mutates them
+            # (cli.clj:429-515 runs every combination test-count times)
+            for _ in range(getattr(opts, "test_count", 1) or 1):
+                for test in tests_fn(opts):
+                    result = core.run(test)
+                    code = validity_exit_code(result)
+                    worst = max(worst, code if code != EXIT_OK else worst)
+                    logger.info("%s: %s", test.get("name"),
+                                (result.get("results") or {}).get("valid?"))
+            return worst
+        except KeyboardInterrupt:
+            return EXIT_CRASH
+        except Exception:  # noqa: BLE001
+            logger.exception("sweep crashed")
+            return EXIT_CRASH
 
     return main
 

@@ -208,6 +208,24 @@ def standard_test_fn(suite_test: Callable,
     return test_fn
 
 
+def standard_test_all(suite_test_fn: Callable, supported_workloads: tuple,
+                      name: str) -> Callable:
+    """A ``test-all`` sweep main for a suite: every supported workload
+    once per round, from the shared CLI options (cli.clj:429-515; the
+    yugabyte sweep generalized)."""
+    from jepsen_tpu import cli
+
+    def all_tests(opts) -> list:
+        base = cli.test_opts_to_test(opts, {})
+        # carry the WHOLE option map — cherry-picking keys silently
+        # drops any option later added to test_opts_to_test
+        fake = (base.get("ssh") or {}).get("dummy", False)
+        return [suite_test_fn(dict(base, workload=w, fake=fake))
+                for w in supported_workloads]
+
+    return cli.test_all_cmd(all_tests, name=name)
+
+
 def suite_registry() -> dict[str, Callable]:
     """name -> test-map-constructor for every bundled DB suite (the
     reference's L8 layer; each also has a CLI ``main``)."""

@@ -25,7 +25,7 @@ from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._aerospike import (AerospikeConnection,
                                           AerospikeError)
 
@@ -329,6 +329,9 @@ def aerospike_test(opts_dict: dict | None = None) -> dict:
         make_real=lambda o: {"db": AerospikeDB(),
                              "client": AerospikeClient(), "os": Debian()})
 
+
+main_all = standard_test_all(aerospike_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-aerospike")
 
 main = cli.single_test_cmd(
     standard_test_fn(aerospike_test, extra_keys=("max_dead_nodes",)),

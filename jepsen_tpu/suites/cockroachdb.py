@@ -23,7 +23,7 @@ from jepsen_tpu import cli, control, db as db_mod
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._pg_client import PGSuiteClient
 
 logger = logging.getLogger("jepsen.cockroachdb")
@@ -142,6 +142,9 @@ def cockroachdb_test(opts_dict: dict | None = None) -> dict:
 # the named skew family (cockroach/nemesis.clj:201-271) rides --fault
 COCKROACH_FAULTS = ("skew-small", "skew-subcritical", "skew-critical",
                     "skew-big", "skew-huge", "skew-strobe", "startkill")
+
+main_all = standard_test_all(cockroachdb_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-cockroachdb")
 
 main = cli.single_test_cmd(
     standard_test_fn(cockroachdb_test, extra_keys=("version",)),

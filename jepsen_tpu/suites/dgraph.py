@@ -26,7 +26,7 @@ from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._http import NET_ERRORS, http_json
 
 logger = logging.getLogger("jepsen.dgraph")
@@ -523,6 +523,9 @@ def dgraph_test(opts_dict: dict | None = None) -> dict:
             "db": DgraphDB(o.get("version", DEFAULT_VERSION)),
             "client": DgraphClient(), "os": Debian()})
 
+
+main_all = standard_test_all(dgraph_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-dgraph")
 
 main = cli.single_test_cmd(
     standard_test_fn(dgraph_test, extra_keys=("version",)),

@@ -30,7 +30,7 @@ from jepsen_tpu.control import util as cu
 from jepsen_tpu.nemesis import membership as _membership
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._http import NET_ERRORS, http_error_json, http_json
 
 logger = logging.getLogger("jepsen.faunadb")
@@ -930,6 +930,9 @@ def faunadb_test(opts_dict: dict | None = None) -> dict:
         make_real=lambda o: {"db": FaunaDB(), "client": FaunaClient(),
                              "os": Debian()})
 
+
+main_all = standard_test_all(faunadb_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-faunadb")
 
 main = cli.single_test_cmd(
     standard_test_fn(faunadb_test),

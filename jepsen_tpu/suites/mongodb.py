@@ -21,7 +21,7 @@ from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 
 logger = logging.getLogger("jepsen.mongodb")
 
@@ -277,6 +277,9 @@ def mongodb_test(opts_dict: dict | None = None) -> dict:
         extra_workloads={"transfer": transfer.workload},
         fake_client=fake_client)
 
+
+main_all = standard_test_all(mongodb_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-mongodb")
 
 main = cli.single_test_cmd(
     standard_test_fn(mongodb_test, extra_keys=("storage_engine",)),

@@ -24,7 +24,7 @@ from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites import _reql as r
 from jepsen_tpu.suites._reql import ReqlConnection, ReqlError
 
@@ -326,6 +326,9 @@ def rethinkdb_test(opts_dict: dict | None = None) -> dict:
                                       o.get("read_mode", "majority")),
             "os": Debian()})
 
+
+main_all = standard_test_all(rethinkdb_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-rethinkdb")
 
 main = cli.single_test_cmd(
     standard_test_fn(rethinkdb_test, extra_keys=("write_acks", "read_mode")),

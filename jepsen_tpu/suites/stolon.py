@@ -20,7 +20,7 @@ from jepsen_tpu import cli, control, db as db_mod
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._pg_client import PGSuiteClient
 from jepsen_tpu.suites.etcd import EtcdDB
 
@@ -194,6 +194,9 @@ def stolon_test(opts_dict: dict | None = None) -> dict:
                 isolation=o.get("isolation", "serializable")),
             "os": Debian()})
 
+
+main_all = standard_test_all(stolon_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-stolon")
 
 main = cli.single_test_cmd(
     standard_test_fn(stolon_test, extra_keys=("isolation", "version")),

@@ -22,7 +22,7 @@ from jepsen_tpu import cli, control, db as db_mod
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn)
+                               standard_test_all, standard_test_fn)
 from jepsen_tpu.suites._mysql_client import MySQLSuiteClient
 
 logger = logging.getLogger("jepsen.tidb")
@@ -208,6 +208,9 @@ def tidb_test(opts_dict: dict | None = None) -> dict:
                 else "append"),
             "os": Debian()})
 
+
+main_all = standard_test_all(tidb_test, SUPPORTED_WORKLOADS,
+                             name="jepsen-tidb")
 
 main = cli.single_test_cmd(
     standard_test_fn(tidb_test, extra_keys=("isolation", "version")),

@@ -25,7 +25,8 @@ from jepsen_tpu import cli, control, db as db_mod, fakes
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
-                               standard_test_fn, workload_registry)
+                               standard_test_all, standard_test_fn,
+                               workload_registry)
 from jepsen_tpu.suites._pg_client import PGSuiteClient
 
 logger = logging.getLogger("jepsen.yugabyte")
@@ -288,20 +289,11 @@ def yugabyte_test(opts_dict: dict | None = None) -> dict:
         make_real=make_real, **kw)
 
 
-def all_tests(opts) -> list:
-    """The test-all sweep over workloads expected to pass
-    (yugabyte/core.clj:110-123, cli.clj:429-515)."""
-    from jepsen_tpu.cli import test_opts_to_test
-    base = test_opts_to_test(opts, {})
-    # carry the WHOLE option map — cherry-picking keys silently drops
-    # any option later added to test_opts_to_test
-    return [yugabyte_test(dict(base, workload=name,
-                               fake=(base.get("ssh") or {}).get("dummy",
-                                                                False)))
-            for name in workloads_expected_to_pass()]
-
-
-main_all = cli.test_all_cmd(all_tests, name="jepsen-yugabyte")
+# the sweep over workloads expected to pass (yugabyte/core.clj:110-123)
+# rides the shared runner
+main_all = standard_test_all(yugabyte_test,
+                             tuple(workloads_expected_to_pass()),
+                             name="jepsen-yugabyte")
 
 main = cli.single_test_cmd(
     standard_test_fn(yugabyte_test, extra_keys=("isolation", "version",

@@ -205,3 +205,27 @@ def test_charybdefs_nemesis_ops(dummy):
     joined = " ".join(str(x) for x in remote.log)
     assert "--clear" in joined
     n.teardown(t)
+
+
+def test_suite_test_all_sweeps_fake(tmp_path):
+    """The shared test-all runner (suites.standard_test_all) sweeps
+    every supported workload of a suite in fake mode (cli.clj:429-515;
+    yugabyte has its own bespoke sweep, tested in test_pg_suites)."""
+    from jepsen_tpu.suites import mongodb, rethinkdb
+
+    for suite in (rethinkdb, mongodb):
+        code = suite.main_all(["--no-ssh", "--time-limit", "1",
+                               "--accelerator", "cpu",
+                               "--store-dir", str(tmp_path)])
+        assert code == 0, suite.__name__
+
+
+def test_faunadb_test_all_sweep_fake(tmp_path):
+    """FaunaDB's sweep covers all eight workloads incl. the
+    timestamp-monotonicity family."""
+    from jepsen_tpu.suites import faunadb
+
+    code = faunadb.main_all(["--no-ssh", "--time-limit", "1",
+                             "--accelerator", "cpu",
+                             "--store-dir", str(tmp_path)])
+    assert code == 0
