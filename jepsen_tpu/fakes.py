@@ -587,7 +587,7 @@ class KVClient(MetaLogClient):
                 k, _ = v
                 return {**op, "type": "ok",
                         "value": [k, self.db.vd_read(k)]}
-        if test.get("lost-updates"):
+        if test.get("lost-updates") or test.get("pause-workload"):
             if f == "add":
                 k, el = v
                 self.db.lu_add(k, el)

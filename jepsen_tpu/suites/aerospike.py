@@ -155,6 +155,17 @@ class AerospikeClient(Client):
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("pause-workload"):
+                # per-key string-append sets (pause.clj:105-136)
+                k, x = v
+                if f == "add":
+                    self.conn.append(int(k), f" {int(x)}")
+                    return {**op, "type": "ok"}
+                if f == "read":
+                    raw = self.conn.get_string(int(k))
+                    return {**op, "type": "ok",
+                            "value": [k, sorted(int(e)
+                                                for e in raw.split() if e)]}
             if test.get("counter") and f == "add":
                 self.conn.incr(0, int(v))
                 return {**op, "type": "ok"}
@@ -200,7 +211,7 @@ class AerospikeClient(Client):
             self.conn.close()
 
 
-SUPPORTED_WORKLOADS = ("register", "counter", "set")
+SUPPORTED_WORKLOADS = ("register", "counter", "set", "pause")
 
 
 # ---------------------------------------------------------------------------
@@ -317,15 +328,160 @@ def killer_package(opts: dict) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Pause nemesis (aerospike/pause.clj:40-103): freeze a master so its
+# trapped in-flight writes resurface with a far-future local clock
+# ---------------------------------------------------------------------------
+
+class PauseNemesis(nemesis_mod.Nemesis):
+    """``pause`` / ``resume`` on the op's node list, in one of three
+    modes (pause.clj:40-83): ``process`` SIGSTOPs asd; ``net`` injects
+    self-removing egress latency (a nohup mini-daemon restores the
+    qdisc — raising latency would sever our own SSH session otherwise);
+    ``clock`` bumps the node's clock far ahead and snubs it from every
+    peer, so its local commits carry unreplicated future timestamps."""
+
+    def __init__(self, mode: str = "process",
+                 pause_delay_s: float = 30.0):
+        self.mode = mode
+        self.pause_delay_s = pause_delay_s
+
+    def fs(self):
+        return {"pause", "resume"}
+
+    def _pause(self, test, node):
+        from jepsen_tpu.nemesis import time as nt
+        if self.mode == "process":
+            db = test.get("db")
+            if hasattr(db, "pause"):  # one source of asd process control
+                db.pause(test, node)
+            else:
+                cu.grepkill("asd", sig="STOP")
+            return "paused"
+        if self.mode == "net":
+            # qdisc replace tolerates an existing root qdisc; the
+            # mini-daemon outlives the wait window (which only starts
+            # at the first post-pause ack) with 2x slack
+            secs = 2 * int(self.pause_delay_s) + 2
+            control.exec_(control.lit(
+                f"nohup bash -c 'tc qdisc replace dev eth0 root netem "
+                f"delay {int(self.pause_delay_s * 1000)}ms 1ms "
+                f"distribution normal; sleep {secs}; "
+                f"tc qdisc del dev eth0 root' >/dev/null 2>&1 &"))
+            return "net-delayed"
+        if self.mode == "clock":
+            nt.install()
+            nt.bump_time(int(self.pause_delay_s * 1000) * 1000)
+            return "clock-bumped"
+        return "unknown-mode"
+
+    def _snub(self, test, node):
+        """clock mode: partition the bumped node from every peer both
+        ways (pause.clj:58-68)."""
+        net = test.get("net")
+        if net is None:
+            return
+        for other in test.get("nodes") or []:
+            if other != node:
+                net.drop(test, node, other)
+                net.drop(test, other, node)
+
+    def invoke(self, test, op):
+        from jepsen_tpu.nemesis import time as nt
+        from jepsen_tpu.nemesis.db_specific import _on_nodes
+        f = op.get("f")
+        nodes = op.get("value") or list(test.get("nodes") or [])
+        if f == "pause":
+            if self.mode == "clock":
+                # snub FIRST: a bumped clock must never replicate its
+                # far-future timestamps (an improvement over the
+                # reference's bump-then-isolate order, pause.clj:58-68,
+                # whose window is only small because the clock binary
+                # pre-installs at setup)
+                for node in nodes:
+                    self._snub(test, node)
+            res = _on_nodes(test, nodes,
+                            lambda node: self._pause(test, node))
+            return {**op, "type": "info", "value": res}
+        if f == "resume":
+            if self.mode == "process":
+                db = test.get("db")
+
+                def cont(node):
+                    if hasattr(db, "resume"):
+                        db.resume(test, node)
+                    else:
+                        cu.grepkill("asd", sig="CONT")
+                    return "resumed"
+
+                res = _on_nodes(test, nodes, cont)
+            elif self.mode == "net":
+                res = "self-healing"  # the qdisc removes itself
+            else:  # clock (pause.clj:75-83)
+                res = _on_nodes(test, nodes,
+                                lambda node: (nt.reset_time(), "reset")[1])
+                net = test.get("net")
+                if net is not None:
+                    net.heal(test)
+                others = [n for n in (test.get("nodes") or [])
+                          if n not in nodes]
+                _on_nodes(test, others, lambda node: control.exec_(
+                    control.lit("service aerospike restart "
+                                ">/dev/null 2>&1 || true")))
+            return {**op, "type": "info", "value": res}
+        return {**op, "type": "info", "value": ["unknown-f", f]}
+
+
+def pause_package(opts: dict, state, mode: str = "process",
+                  pause_delay_s: float = 30.0) -> dict:
+    """--fault pause-writes: the state-machine-coordinated nemesis half
+    of the pause workload (pause.clj:226-233). Registered under its own
+    name — "pause" would ALSO trigger the generic db pause package,
+    whose uncoordinated ~interval pause/resume cycle owns the same op
+    vocabulary in the compose routing and would both shadow this
+    nemesis and break the wait window."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads.pause_workload import PauseNemesisGen
+    return {
+        "nemesis": PauseNemesis(mode, pause_delay_s),
+        "generator": PauseNemesisGen(state),
+        "final_generator": gen.Seq([
+            {"type": "info", "f": "resume", "value": None}]),
+        "perf": {"name": "pause", "fs": {"pause", "resume"},
+                 "start": {"pause"}, "stop": {"resume"}},
+    }
+
+
 def aerospike_test(opts_dict: dict | None = None) -> dict:
+    from jepsen_tpu.workloads import pause_workload
     o = dict(opts_dict or {})
     max_dead = o.get("max_dead_nodes")
+    pause_state = pause_workload.MachineState()
+    pause_delay = float(o.get("pause_delay", 30.0))
+
+    def pause_wk(base):
+        return {**pause_workload.workload(base, state=pause_state),
+                "pause-healthy-delay": float(o.get("healthy_delay", 5.0)),
+                "pause-delay": pause_delay}
+
+    if "pause-writes" in (o.get("faults") or ()) \
+            and (o.get("workload") or SUPPORTED_WORKLOADS[0]) != "pause":
+        # without the pause workload's client generator nothing ever
+        # flips paused→wait and the nemesis wedges a node SIGSTOPped
+        # for the whole main phase
+        raise ValueError("--fault pause-writes requires --workload pause")
+
     return build_suite_test(
         o, db_name="aerospike",
         supported_workloads=SUPPORTED_WORKLOADS,
-        fault_packages={"killer": lambda opts: killer_package(
-            {**opts, "max_dead_nodes": max_dead}
-            if max_dead is not None else opts)},
+        extra_workloads={"pause": pause_wk},
+        fault_packages={
+            "killer": lambda opts: killer_package(
+                {**opts, "max_dead_nodes": max_dead}
+                if max_dead is not None else opts),
+            "pause-writes": lambda opts: pause_package(
+                opts, pause_state, o.get("pause_mode", "process"),
+                pause_delay)},
         make_real=lambda o: {"db": AerospikeDB(),
                              "client": AerospikeClient(), "os": Debian()})
 
@@ -334,14 +490,26 @@ main_all = standard_test_all(aerospike_test, SUPPORTED_WORKLOADS,
                              name="jepsen-aerospike")
 
 main = cli.single_test_cmd(
-    standard_test_fn(aerospike_test, extra_keys=("max_dead_nodes",)),
-    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("killer",),
-                    extra=lambda p: p.add_argument(
-                        "--max-dead-nodes", dest="max_dead_nodes",
-                        type=int, default=None,
-                        help="cap on simultaneously-killed nodes "
-                             "(aerospike/core.clj:91-94; default "
-                             f"{DEFAULT_MAX_DEAD})")),
+    standard_test_fn(aerospike_test,
+                     extra_keys=("max_dead_nodes", "pause_mode",
+                                 "pause_delay", "healthy_delay")),
+    standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra_faults=("killer", "pause-writes"),
+                    extra=lambda p: (
+                        p.add_argument(
+                            "--max-dead-nodes", dest="max_dead_nodes",
+                            type=int, default=None,
+                            help="cap on simultaneously-killed nodes "
+                                 "(aerospike/core.clj:91-94; default "
+                                 f"{DEFAULT_MAX_DEAD})"),
+                        p.add_argument("--pause-mode", dest="pause_mode",
+                                       default="process",
+                                       choices=["process", "net", "clock"]),
+                        p.add_argument("--pause-delay", dest="pause_delay",
+                                       type=float, default=30.0),
+                        p.add_argument("--healthy-delay",
+                                       dest="healthy_delay",
+                                       type=float, default=5.0))),
     name="jepsen-aerospike")
 
 

@@ -307,3 +307,93 @@ def test_rethinkdb_fake_set_and_counter_runs():
     assert result["results"]["valid?"] is True, result["results"]
     result = run_fake(rethinkdb.rethinkdb_test, workload="counter")
     assert result["results"]["valid?"] is True, result["results"]
+
+
+# ---------------------------------------------------------------------------
+# aerospike pause-to-lose-writes (pause.clj)
+# ---------------------------------------------------------------------------
+
+def test_pause_client_gen_paused_to_wait_on_ok_add():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads.pause_workload import (MachineState,
+                                                     PauseClientGen)
+    t = dummy_test(concurrency=4)
+    ctx = gen.context(t)
+    state = MachineState(rng=random.Random(1))
+    g = PauseClientGen(state)
+    op, g = g.op(t, ctx)
+    assert op is not gen.PENDING and op["f"] == "add"
+    state.phase = "paused"
+    g = g.update(t, ctx, {**op, "type": "ok"})
+    assert state.phase == "wait"
+    # wait phase: clients stop cold
+    assert g.op(t, ctx)[0] is gen.PENDING
+
+
+def test_pause_nemesis_gen_cycle():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads.pause_workload import (MachineState,
+                                                     PauseNemesisGen)
+    t = dummy_test(concurrency=4)
+    t["pause-healthy-delay"] = 0.001
+    t["pause-delay"] = 0.001
+    ctx = gen.context(t)
+    state = MachineState(rng=random.Random(1))
+    g = PauseNemesisGen(state)
+    op, g = g.op(t, ctx)
+    assert op["f"] == "pause" and op["value"] == state.masters
+    # op() is PURE: a discarded poll must not transition the machine
+    assert state.phase == "healthy"
+    op2, g = g.op(t, ctx)
+    assert op2["f"] == "pause"  # re-polled, same phase, same op
+    g = g.update(t, ctx, {**op, "type": "info"})  # dispatched invocation
+    assert state.phase == "paused"
+    assert g.op(t, ctx)[0] is gen.PENDING  # waits for the client flip
+    state.phase = "wait"
+    first_keys = list(state.keys)
+    op, g = g.op(t, ctx)
+    assert op["f"] == "resume"
+    assert state.phase == "wait"  # still pure at emission
+    g = g.update(t, ctx, {**op, "type": "info"})
+    assert state.phase == "healthy"
+    assert state.keys != first_keys  # fresh key block (pause.clj:29-38)
+
+
+def test_pause_nemesis_process_mode(dummy):
+    t, remote = dummy
+    n = aerospike.PauseNemesis(mode="process")
+    n.invoke(t, {"type": "info", "f": "pause", "value": ["n2"]})
+    n.invoke(t, {"type": "info", "f": "resume", "value": ["n2"]})
+    cmds = [c for (k, h, c) in remote.log if k == "exec" and h == "n2"]
+    # grepkill emits pkill -STOP/-CONT with a bracketed pattern
+    assert any("-STOP" in c and "sd'" in c for c in cmds), cmds
+    assert any("-CONT" in c and "sd'" in c for c in cmds), cmds
+
+
+def test_pause_client_bodies():
+    sent = []
+
+    class TConn:
+        def append(self, key, text):
+            sent.append(("append", key, text))
+
+        def get_string(self, key):
+            sent.append(("get", key))
+            return " 3 1"
+
+    c = aerospike.AerospikeClient(node="n1")
+    c.conn = TConn()
+    t = {"pause-workload": True}
+    out = c.invoke(t, {"f": "add", "type": "invoke", "value": [7, 3]})
+    assert out["type"] == "ok" and sent[0] == ("append", 7, " 3")
+    out = c.invoke(t, {"f": "read", "type": "invoke", "value": [7, None]})
+    assert out["type"] == "ok" and out["value"] == [7, [1, 3]]
+
+
+def test_aerospike_fake_pause_run():
+    result = run_fake(aerospike.aerospike_test, workload="pause",
+                      faults={"pause-writes"}, time_limit=2.0,
+                      healthy_delay=0.1, pause_delay=0.1, concurrency=4)
+    assert result["results"]["valid?"] is True, result["results"]
+    fs = {op.get("f") for op in result["history"]}
+    assert {"pause", "resume", "add", "read"} <= fs, fs
