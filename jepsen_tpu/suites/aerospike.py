@@ -14,6 +14,12 @@ DB automation per aerospike/support.clj: install the server package,
 write a mesh-heartbeat config listing every node with a
 strong-consistency namespace, start, then ``roster-set`` + ``recluster``
 via asinfo from the primary.
+
+Faults beyond the generic families: ``--fault killer`` (the capped
+kill/restart/revive/recluster vocabulary, aerospike/nemesis.clj) and
+``--fault pause-writes`` with ``--workload pause`` (the coordinated
+pause-to-lose-writes probe, aerospike/pause.clj — see
+workloads/pause_workload.py).
 """
 from __future__ import annotations
 

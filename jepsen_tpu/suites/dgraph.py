@@ -13,6 +13,12 @@ key predicate.
 DB automation installs the dgraph binary, runs ``dgraph zero`` on the
 first node (``--replicas N`` for one raft group) and ``dgraph alpha``
 on every node pointing at it — support.clj's zero/alpha bring-up.
+
+Dgraph-specific probes: ``delete`` (index freshness, delete.clj) and
+``sequential`` (per-process monotonic register, sequential.clj) beyond
+the shared kits, plus ``--fault move-tablet`` — the tablet-mover
+nemesis shuffling predicates between groups through zero's admin API
+(nemesis.clj:51-99).
 """
 from __future__ import annotations
 

@@ -12,7 +12,12 @@ error("abort"))`` and succeeds iff exactly one row reports
 ``replaced`` with zero errors.
 
 DB automation per rethinkdb.clj:52-95: apt repo install, a config file
-with ``join=`` lines for every peer, service start.
+with ``join=`` and per-node ``server-tag=`` lines, service start.
+
+Beyond the register: ``set`` (doc-per-element) and ``counter`` (atomic
+in-document add) workloads, and ``--fault reconfigure`` — the random
+replica/primary topology churn nemesis (rethinkdb.clj:180-232) over
+the RECONFIGURE admin term.
 """
 from __future__ import annotations
 
