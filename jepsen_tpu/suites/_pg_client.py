@@ -29,7 +29,7 @@ import zlib
 from jepsen_tpu.client import Client
 from jepsen_tpu.suites._postgres import (DEADLOCK_DETECTED, PGConnection,
                                          PgError, SERIALIZATION_FAILURE,
-                                         parse_int_array)
+                                         UNDEFINED_TABLE, parse_int_array)
 
 SEQ_TABLE_COUNT = 5
 COMMENT_TABLE_COUNT = 10  # cockroach/comments.clj:30 table-count
@@ -296,6 +296,8 @@ class PGSuiteClient(Client):
         return {**op, "type": "ok", "value": [int(r[0]) for r in rows]}
 
     def _txn(self, op):
+        if self.txn_style == "append-table":
+            return self._txn_append_table(op)
         self._begin()
         out = []
         try:
@@ -328,6 +330,61 @@ class PGSuiteClient(Client):
         except PgError as e:
             self._rollback()
             return self._sql_error(op, e)
+
+    def _txn_append_table(self, op):
+        """Elle list-append with one table per key: rows are the list
+        elements, ordered by an insert-timestamp column, and tables are
+        created on demand when a txn trips "relation does not exist" —
+        then the whole txn retries
+        (yugabyte/ysql/append_table.clj:28-129; its docstring concedes
+        the timestamp ordering is best-effort, and so is this)."""
+        last_err = None
+        for _ in range(8):
+            self._begin()
+            out = []
+            try:
+                for f, k, v in op.get("value") or []:
+                    table = f"append_{int(k)}"
+                    if f == "r":
+                        rows, _ = self.conn.query(
+                            f"SELECT v FROM {table} ORDER BY k")
+                        out.append(["r", k, [int(r[0]) for r in rows]])
+                    elif f == "append":
+                        self.conn.query(
+                            f"INSERT INTO {table} (v) VALUES ({int(v)})")
+                        out.append(["append", k, v])
+                    else:
+                        raise ValueError(f"unknown micro-op {f!r}")
+                self.conn.query("COMMIT")
+                return {**op, "type": "ok", "value": out}
+            except PgError as e:
+                self._rollback()
+                if e.sqlstate != UNDEFINED_TABLE:
+                    return self._sql_error(op, e)
+                last_err = e
+                table = self._missing_relation(e)
+                if not table:
+                    return self._sql_error(op, e)
+                try:  # YB chokes on IF NOT EXISTS races: swallow dups
+                    # clock_timestamp(), not now(): now() is fixed for
+                    # the whole txn, so two same-key appends in one txn
+                    # would tie on k and read back in arbitrary order —
+                    # a guaranteed false Elle anomaly, not the conceded
+                    # best-effort cross-txn skew
+                    self.conn.query(
+                        f"CREATE TABLE IF NOT EXISTS {table} "
+                        f"(k TIMESTAMP DEFAULT clock_timestamp(), v INT)")
+                except PgError:
+                    pass
+        return self._sql_error(op, last_err)
+
+    @staticmethod
+    def _missing_relation(e: PgError) -> str | None:
+        """The quoted relation name out of a 42P01 message
+        (append_table.clj:92-101 catch-dne)."""
+        import re
+        m = re.search(r'relation "(.+?)" does not exist', e.msg or "")
+        return m.group(1) if m else None
 
     def _ledger_transfer(self, test, op):
         """Row-per-transfer ledger insert (ledger.clj:56-68,117-132):

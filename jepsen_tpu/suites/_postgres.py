@@ -39,6 +39,7 @@ class PgError(Exception):
 # SQLSTATEs every retry loop cares about (class 40 = txn rollback)
 SERIALIZATION_FAILURE = "40001"
 DEADLOCK_DETECTED = "40P01"
+UNDEFINED_TABLE = "42P01"
 
 
 def parse_int_array(text: str | None) -> list[int]:

@@ -39,8 +39,10 @@ def archive_url(version: str) -> str:
 
 
 class CrateDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
-    def __init__(self, version: str = DEFAULT_VERSION):
+    def __init__(self, version: str = DEFAULT_VERSION,
+                 es_api: bool = False):
         self.version = version
+        self.es_api = es_api  # expose the embedded ES HTTP API
 
     def setup(self, test, node):
         logger.info("%s: installing crate %s", node, self.version)
@@ -54,7 +56,11 @@ class CrateDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
             f"cluster.initial_master_nodes: [{', '.join(nodes)}]",
             f"gateway.expected_data_nodes: {len(nodes)}",
             f"gateway.recover_after_data_nodes: {max(1, len(nodes) // 2 + 1)}",
-        ]) + "\n"
+        ] + (
+            # --es-ops routing needs the embedded ES HTTP API (only
+            # crate versions that still carry it honor this setting)
+            ["es.api.enabled: true"] if self.es_api else []
+        )) + "\n"
         from jepsen_tpu import control
         control.exec_("tee", f"{DIR}/config/crate.yml", stdin=conf)
         self.start(test, node)
@@ -84,14 +90,22 @@ class CrateDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
 
 
 class CrateClient(Client):
-    """SQL over the HTTP ``_sql`` endpoint."""
+    """SQL over the HTTP ``_sql`` endpoint.
 
-    def __init__(self, timeout_s: float = 5.0, node: str | None = None):
+    ``es_ops`` routes a subset of the dirty-read probe's op ``f``s
+    through Crate's embedded Elasticsearch HTTP API instead of SQL
+    (dirty_read.clj:97-141 es-client — requires a crate version that
+    still exposes the ES API; setup adds ``es.api.enabled`` when the
+    routing is requested)."""
+
+    def __init__(self, timeout_s: float = 5.0, node: str | None = None,
+                 es_ops: frozenset = frozenset()):
         self.timeout_s = timeout_s
         self.node = node
+        self.es_ops = frozenset(es_ops or ())
 
     def open(self, test, node):
-        return CrateClient(self.timeout_s, node)
+        return CrateClient(self.timeout_s, node, self.es_ops)
 
     def _sql(self, stmt: str, args: list | None = None):
         return http_json(f"http://{self.node}:{PORT}/_sql",
@@ -108,10 +122,17 @@ class CrateClient(Client):
         self._sql("CREATE TABLE IF NOT EXISTS lu "
                   "(id INT PRIMARY KEY, elements ARRAY(INT)) "
                   "CLUSTERED INTO 5 SHARDS WITH (number_of_replicas = 2)")
+        # dirty_read.clj:43-50: replicate everywhere so every node's
+        # strong read scans a local copy
+        self._sql("CREATE TABLE IF NOT EXISTS dirty_read "
+                  "(id INT PRIMARY KEY) "
+                  "WITH (number_of_replicas = '0-all')")
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         try:
+            if test.get("dirty-read"):
+                return self._dirty_read_op(op, f, v)
             if test.get("version-divergence") and f == "read":
                 k, _ = v
                 res = self._sql(
@@ -180,6 +201,54 @@ class CrateClient(Client):
             kind = "fail" if f == "read" else "info"
             return {**op, "type": kind, "error": ["net", str(e)]}
 
+    def _dirty_read_op(self, op, f, v):
+        """The crate dirty-read probe's op surface
+        (dirty_read.clj:54-141): point read by id, unique-int insert,
+        table refresh, and the full strong-read scan — each routable
+        through the ES API instead of SQL via ``es_ops``."""
+        if f in self.es_ops:
+            base = f"http://{self.node}:{PORT}"
+            if f == "write":
+                http_json(f"{base}/dirty_read/default/{int(v)}",
+                          {"id": int(v)}, method="PUT",
+                          timeout_s=self.timeout_s)
+                return {**op, "type": "ok"}
+            if f == "read":
+                try:
+                    doc = http_json(f"{base}/dirty_read/default/{int(v)}",
+                                    timeout_s=self.timeout_s)
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return {**op, "type": "fail"}
+                    raise
+                found = bool((doc or {}).get("found"))
+                return {**op, "type": "ok" if found else "fail"}
+            if f == "strong-read":
+                res = http_json(f"{base}/dirty_read/_search",
+                                {"size": 100000000,
+                                 "_source": ["id"]},
+                                timeout_s=self.timeout_s)
+                hits = ((res or {}).get("hits") or {}).get("hits") or []
+                ids = sorted(int(h["_source"]["id"]) for h in hits)
+                return {**op, "type": "ok", "value": ids}
+            # refresh falls through to SQL either way
+        if f == "write":
+            self._sql("INSERT INTO dirty_read (id) VALUES (?)", [int(v)])
+            return {**op, "type": "ok"}
+        if f == "read":
+            res = self._sql("SELECT id FROM dirty_read WHERE id = ?",
+                            [int(v)])
+            found = bool(res.get("rows"))
+            return {**op, "type": "ok" if found else "fail"}
+        if f == "refresh":
+            self._sql("REFRESH TABLE dirty_read")
+            return {**op, "type": "ok"}
+        if f == "strong-read":
+            res = self._sql("SELECT id FROM dirty_read LIMIT 100000000")
+            return {**op, "type": "ok",
+                    "value": sorted(r[0] for r in res.get("rows") or [])}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
     def _lu_add(self, op):
         """Read-modify-write under crate's optimistic _version guard
         (lost_updates.clj): append the element to the key's list only if
@@ -225,21 +294,45 @@ class CrateClient(Client):
 
 
 SUPPORTED_WORKLOADS = ("register", "set", "lost-updates",
-                       "version-divergence")
+                       "version-divergence", "dirty-read")
+
+
+def _parse_es_ops(raw) -> frozenset:
+    """``--es-ops read,write`` → the op fs routed through the ES API
+    (dirty_read.clj:228-241's :es-ops set)."""
+    if not raw:
+        return frozenset()
+    if isinstance(raw, (set, frozenset, list, tuple)):
+        return frozenset(raw)
+    return frozenset(s.strip() for s in str(raw).split(",") if s.strip())
 
 
 def crate_test(opts_dict: dict | None = None) -> dict:
+    from jepsen_tpu.workloads import crate_dirty_read
+
+    o = dict(opts_dict or {})
+    es_ops = _parse_es_ops(o.get("es_ops"))
     return build_suite_test(
-        opts_dict, db_name="crate", supported_workloads=SUPPORTED_WORKLOADS,
-        make_real=lambda o: {"db": CrateDB(o.get("version", DEFAULT_VERSION)),
-                             "client": CrateClient(), "os": Debian()})
+        o, db_name="crate", supported_workloads=SUPPORTED_WORKLOADS,
+        extra_workloads={
+            "dirty-read": lambda base: crate_dirty_read.workload(
+                base,
+                quiesce_s=float(o.get("dirty_read_quiesce", 10.0)))},
+        make_real=lambda o: {"db": CrateDB(o.get("version", DEFAULT_VERSION),
+                                           es_api=bool(es_ops)),
+                             "client": CrateClient(es_ops=es_ops),
+                             "os": Debian()})
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(crate_test, extra_keys=("version",)),
+    standard_test_fn(crate_test, extra_keys=("version", "es_ops")),
     standard_opt_fn(SUPPORTED_WORKLOADS,
-                    extra=lambda p: p.add_argument(
-                        "--version", default=DEFAULT_VERSION)),
+                    extra=lambda p: (
+                        p.add_argument("--version",
+                                       default=DEFAULT_VERSION),
+                        p.add_argument("--es-ops", default="",
+                                       help="ops routed through the ES "
+                                            "API: e.g. read,write"))),
     name="jepsen-crate")
 
 

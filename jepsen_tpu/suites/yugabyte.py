@@ -43,9 +43,9 @@ DB_PASS = "yugabyte"
 MASTER_COUNT = 3
 
 # reference registry shape (yugabyte/core.clj:74-104)
-YSQL_WORKLOADS = ("append", "set", "bank", "long-fork", "register", "wr",
-                  "counter", "single-key-acid", "multi-key-acid",
-                  "default-value")
+YSQL_WORKLOADS = ("append", "append-table", "set", "bank", "long-fork",
+                  "register", "wr", "counter", "single-key-acid",
+                  "multi-key-acid", "default-value")
 YCQL_WORKLOADS = ("counter", "set", "set-index", "bank", "long-fork",
                   "single-key-acid", "multi-key-acid")
 
@@ -67,9 +67,12 @@ def master_addresses(test: dict) -> str:
 
 def workloads_expected_to_pass() -> dict:
     """name → workload constructor, the test-all sweep surface
-    (yugabyte/core.clj:110-123 workload-options-expected-to-pass)."""
+    (yugabyte/core.clj:110-123 workload-options-expected-to-pass).
+    append-table rides the append kit — the client's txn_style routes
+    its micro-ops to per-key tables (ysql/append_table.clj)."""
     reg = workload_registry()
-    return {name: reg[name] for name in YSQL_WORKLOADS}
+    return {name: (reg["append"] if name == "append-table" else reg[name])
+            for name in YSQL_WORKLOADS}
 
 
 def ycql_workload(name: str, base: dict, accelerator: str = "auto") -> dict:
@@ -273,6 +276,7 @@ def yugabyte_test(opts_dict: dict | None = None) -> dict:
                 password=DB_PASS,
                 isolation=o.get("isolation", "serializable"),
                 txn_style="wr" if workload in ("wr", "long-fork")
+                else workload if workload == "append-table"
                 else "append")
         return {"db": db, "client": client, "os": Debian()}
 
@@ -280,6 +284,14 @@ def yugabyte_test(opts_dict: dict | None = None) -> dict:
     if api == "ycql":
         kw["make_workload"] = lambda name, base: ycql_workload(
             name, base, accelerator=base["accelerator"])
+    else:
+        # append-table is the Elle list-append kit routed to per-key
+        # tables by the client (ysql/append_table.clj); checker-side it
+        # IS the append workload
+        from jepsen_tpu.suites import workload_registry
+        kw["extra_workloads"] = {
+            "append-table": lambda base: workload_registry()["append"](
+                base, accelerator=base.get("accelerator", "auto"))}
     return build_suite_test(
         o, db_name="yugabyte",
         supported_workloads=(YCQL_WORKLOADS if api == "ycql"

@@ -1,0 +1,155 @@
+"""Crate dirty-read workload (reference:
+crate/src/jepsen/crate/dirty_read.clj — hunts reads of rows from
+transactions that never committed).
+
+Distinct from the elasticsearch probe (workloads/dirty_read.py): here
+the generator itself aims every read at the write currently in flight
+on the reader's *own* node (rw-gen, dirty_read.clj:197-226) — probing
+whether an uncommitted insert is visible in the instant before a crash
+— and node disagreement in the final strong reads is a validity
+condition, not just a statistic (dirty_read.clj:178-180).
+
+Op shapes:
+- ``{"f": "write", "value": id}`` — insert a unique integer row
+- ``{"f": "read", "value": id}`` — point-read that id; found → ok,
+  absent → fail
+- ``{"f": "refresh"}`` — per-thread table refresh before the final
+  reads
+- ``{"f": "strong-read", "value": [ids...]}`` — one full scan per
+  thread in the final phase
+
+The first ``writers`` client threads write; the rest read. The write
+counter and the per-node in-flight table are carried *functionally* in
+the generator state, so polls discarded by composing generators
+(any_gen races the nemesis) never burn a value — the reference's
+mutable atoms (dirty_read.clj:202-205) rely on op emission being
+dispatch, which does not hold on this framework's pure protocol.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Checker
+
+
+@dataclass(frozen=True)
+class RWGen(gen.Generator):
+    """While writer threads insert fresh ids (recording each as their
+    node's in-flight write), reader threads point-read the id most
+    recently in flight on their own node (dirty_read.clj:197-226)."""
+
+    writers: int = 1
+    counter: int = 0
+    in_flight: tuple = ()
+
+    def op(self, test, ctx):
+        p = ctx.some_free_process()
+        thread = None if p is None else ctx.thread_of(p)
+        # clients-wrapped in production; PENDING on the nemesis
+        # sentinel for bare-context polls (a client op bound to the
+        # nemesis worker would misdispatch)
+        if p is None or not isinstance(thread, int):
+            return (gen.PENDING, self)
+        nodes = test.get("nodes") or ["n1"]
+        in_flight = self.in_flight or (0,) * len(nodes)
+        # the node a worker talks to is bound by THREAD id (the
+        # interpreter's nodes[thread % n] binding survives process
+        # renumbering after crashes) — keying on process id would drift
+        # off the worker's real node after the first crashed op
+        node_i = thread % len(nodes)
+        if thread < self.writers:
+            v = self.counter
+            nxt = replace(
+                self, counter=v + 1,
+                in_flight=tuple(v if i == node_i else x
+                                for i, x in enumerate(in_flight)))
+            return ({"type": "invoke", "f": "write", "value": v,
+                     "process": p, "time": ctx.time}, nxt)
+        return ({"type": "invoke", "f": "read",
+                 "value": in_flight[node_i],
+                 "process": p, "time": ctx.time},
+                replace(self, in_flight=in_flight))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def generator(writers: int):
+    return gen.stagger(0.1, RWGen(writers=writers))
+
+
+def final_generator(quiesce_s: float = 10.0):
+    """Per-thread refresh, quiescence, then one strong read per thread
+    (dirty_read.clj:259-264). ``phases`` barriers each step so no
+    strong read can start while a refresh is still in flight."""
+    return gen.phases(
+        gen.each_thread(gen.once(gen.Fn(
+            lambda test, ctx: {"f": "refresh", "value": None}))),
+        gen.sleep(quiesce_s),
+        gen.each_thread(gen.once(gen.Fn(
+            lambda test, ctx: {"f": "strong-read", "value": None}))),
+    )
+
+
+class CrateDirtyReadChecker(Checker):
+    """dirty = ok point-reads no strong read corroborates; lost = acked
+    writes absent from every strong read; valid additionally requires
+    every node's strong read to agree (dirty_read.clj:143-193)."""
+
+    def check(self, test, history, opts):
+        writes, reads, strong = set(), set(), []
+        for op in history:
+            if op.get("type") != "ok":
+                continue
+            f = op.get("f")
+            if f == "write":
+                writes.add(op.get("value"))
+            elif f == "read":
+                reads.add(op.get("value"))
+            elif f == "strong-read":
+                strong.append(set(op.get("value") or ()))
+        if not strong:
+            return {"valid?": "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        not_on_all = on_some - on_all
+        unchecked = on_some - reads
+        dirty = reads - on_some
+        lost = writes - on_some
+        some_lost = writes - on_all
+        nodes_agree = on_all == on_some
+        result = {
+            "valid?": bool(nodes_agree and not dirty and not lost),
+            "nodes-agree?": nodes_agree,
+            "read-count": len(reads),
+            "on-all-count": len(on_all),
+            "on-some-count": len(on_some),
+            "unchecked-count": len(unchecked),
+            "not-on-all-count": len(not_on_all),
+            "not-on-all": sorted(not_on_all)[:10],
+            "dirty-count": len(dirty), "dirty": sorted(dirty)[:10],
+            "lost-count": len(lost), "lost": sorted(lost)[:10],
+            "some-lost-count": len(some_lost),
+            "some-lost": sorted(some_lost)[:10],
+        }
+        # the reference asserts one strong read per worker
+        # (dirty_read.clj:176); degrade to unknown instead of crashing
+        if len(strong) != int(test.get("concurrency", len(strong))):
+            result["valid?"] = "unknown"
+            result["error"] = ["strong-read-count", len(strong),
+                               "concurrency", test.get("concurrency")]
+        return result
+
+
+def workload(test: dict | None = None, quiesce_s: float = 10.0,
+             **_) -> dict:
+    test = test or {}
+    concurrency = int(test.get("concurrency", 5))
+    writers = max(1, concurrency // 3)
+    return {
+        "dirty-read": True,  # client dispatch marker
+        "generator": generator(writers),
+        "final_generator": final_generator(quiesce_s),
+        "checker": CrateDirtyReadChecker(),
+    }

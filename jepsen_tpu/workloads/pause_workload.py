@@ -93,7 +93,9 @@ class PauseClientGen(Generator):
                 # appending before it fires would break the wait window
                 return (PENDING, self)
             p = ctx.some_free_process()
-            if p is None:
+            # clients-wrapped in production; guard the nemesis sentinel
+            # for bare-context polls
+            if p is None or not isinstance(p, int):
                 return (PENDING, self)
             k = s.keys[p % len(s.keys)]
             v = s.next_value

@@ -318,7 +318,9 @@ def test_pause_client_gen_paused_to_wait_on_ok_add():
     from jepsen_tpu.workloads.pause_workload import (MachineState,
                                                      PauseClientGen)
     t = dummy_test(concurrency=4)
-    ctx = gen.context(t)
+    # clients-restricted context, as compose_test wraps it in production
+    # (a bare context would let some_free_process pick the nemesis)
+    ctx = gen.context(t).restrict(frozenset(range(4)))
     state = MachineState(rng=random.Random(1))
     g = PauseClientGen(state)
     op, g = g.op(t, ctx)

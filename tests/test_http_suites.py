@@ -814,3 +814,168 @@ def test_hazelcast_fake_map_run():
     assert result["results"]["valid?"] is True, result["results"]
     # the r/w subset must never emit cas
     assert not any(op.get("f") == "cas" for op in result["history"])
+
+
+def test_crate_dirty_read_rw_gen():
+    """rw-gen (crate/dirty_read.clj:197-226): writer threads insert
+    fresh ids recording each as their node's in-flight write; reader
+    threads point-read the id most recently in flight on their OWN
+    node; discarded polls never burn a value (pure state threading)."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads.crate_dirty_read import RWGen
+
+    test = {"nodes": ["n1", "n2", "n3"], "concurrency": 6}
+    ctx = gen.context(test)
+    g = RWGen(writers=2)
+
+    c0 = ctx.restrict(frozenset({0}))       # thread 0 = writer, node 0
+    op, g = g.op(test, c0)
+    assert op["f"] == "write" and op["value"] == 0 and op["process"] == 0
+    op, g = g.op(test, c0)
+    assert op["f"] == "write" and op["value"] == 1
+
+    c3 = ctx.restrict(frozenset({3}))       # thread 3 = reader, node 0
+    op, g2 = g.op(test, c3)
+    assert op["f"] == "read" and op["value"] == 1
+
+    c4 = ctx.restrict(frozenset({4}))       # thread 4 = reader, node 1
+    op, _ = g2.op(test, c4)
+    assert op["f"] == "read" and op["value"] == 0
+
+    # a poll whose op gets discarded must not advance the counter
+    op_a, _ = g.op(test, c0)
+    op_b, _ = g.op(test, c0)
+    assert op_a["value"] == op_b["value"] == 2
+
+
+def test_crate_dirty_read_checker_semantics():
+    """Unlike the elasticsearch probe, node disagreement IS a validity
+    condition here (crate/dirty_read.clj:178-180); dirty and lost
+    elements convict; a strong-read count short of concurrency degrades
+    to unknown instead of the reference's assert."""
+    from jepsen_tpu.workloads.crate_dirty_read import CrateDirtyReadChecker
+
+    def h(reads, writes, strongs):
+        out = []
+        for w in writes:
+            out.append({"type": "ok", "f": "write", "value": w})
+        for r in reads:
+            out.append({"type": "ok", "f": "read", "value": r})
+        for s in strongs:
+            out.append({"type": "ok", "f": "strong-read", "value": s})
+        return out
+
+    t = {"concurrency": 2}
+    ok = CrateDirtyReadChecker().check(
+        t, h([1, 2], [1, 2, 3], [[1, 2, 3], [1, 2, 3]]), {})
+    assert ok["valid?"] is True and ok["unchecked-count"] == 1
+
+    dirty = CrateDirtyReadChecker().check(
+        t, h([9], [1], [[1], [1]]), {})
+    assert dirty["valid?"] is False and dirty["dirty"] == [9]
+
+    lost = CrateDirtyReadChecker().check(
+        t, h([], [1, 2], [[1], [1]]), {})
+    assert lost["valid?"] is False and lost["lost"] == [2]
+
+    # node disagreement alone convicts (the crate probe's distinction)
+    split = CrateDirtyReadChecker().check(
+        t, h([], [1, 2], [[1, 2], [1]]), {})
+    assert split["valid?"] is False and split["nodes-agree?"] is False
+    assert split["some-lost-count"] == 1
+
+    short = CrateDirtyReadChecker().check(
+        {"concurrency": 5}, h([], [1], [[1], [1]]), {})
+    assert short["valid?"] == "unknown"
+
+    none = CrateDirtyReadChecker().check(t, h([1], [1], []), {})
+    assert none["valid?"] == "unknown"
+
+
+def test_crate_dirty_read_client_bodies():
+    """SQL bodies (insert / point read / refresh / LIMIT scan) and the
+    --es-ops routing through the embedded ES API
+    (crate/dirty_read.clj:54-141)."""
+    rows = set()
+
+    def fn(method, path, body):
+        if path.endswith("/_sql"):
+            req = json.loads(body)
+            stmt, args = req["stmt"], req.get("args") or []
+            if stmt.startswith("INSERT INTO dirty_read"):
+                rows.add(int(args[0]))
+                return 200, {"rowcount": 1}
+            if "WHERE id" in stmt:
+                hit = int(args[0]) in rows
+                return 200, {"rows": [[int(args[0])]] if hit else []}
+            if stmt.startswith("REFRESH"):
+                return 200, {"rowcount": 0}
+            if stmt.startswith("SELECT id FROM dirty_read"):
+                return 200, {"rows": [[i] for i in sorted(rows)]}
+            return 200, {"rows": [], "rowcount": 0}
+        if "/dirty_read/default/" in path:
+            v = int(path.rsplit("/", 1)[1])
+            if method == "PUT":
+                rows.add(v)
+                return 200, {"result": "created"}
+            if v in rows:
+                return 200, {"found": True, "_source": {"id": v}}
+            return 404, {"found": False}
+        if path.endswith("/_search"):
+            hits = [{"_source": {"id": v}} for v in sorted(rows)]
+            return 200, {"hits": {"hits": hits}}
+        return 404, {}
+
+    srv = ScriptedHTTP(fn)
+    try:
+        import jepsen_tpu.suites.crate as cr
+        old = cr.PORT
+        cr.PORT = srv.port
+        try:
+            t = {"dirty-read": True}
+            c = cr.CrateClient(node="127.0.0.1")
+            assert c.invoke(t, {"type": "invoke", "f": "write",
+                                "value": 3})["type"] == "ok"
+            assert c.invoke(t, {"type": "invoke", "f": "read",
+                                "value": 3})["type"] == "ok"
+            assert c.invoke(t, {"type": "invoke", "f": "read",
+                                "value": 9})["type"] == "fail"
+            assert c.invoke(t, {"type": "invoke", "f": "refresh",
+                                "value": None})["type"] == "ok"
+            out = c.invoke(t, {"type": "invoke", "f": "strong-read",
+                               "value": None})
+            assert out["type"] == "ok" and out["value"] == [3]
+
+            es = cr.CrateClient(node="127.0.0.1",
+                                es_ops={"read", "write", "strong-read"})
+            assert es.invoke(t, {"type": "invoke", "f": "write",
+                                 "value": 7})["type"] == "ok"
+            assert es.invoke(t, {"type": "invoke", "f": "read",
+                                 "value": 7})["type"] == "ok"
+            assert es.invoke(t, {"type": "invoke", "f": "read",
+                                 "value": 99})["type"] == "fail"
+            out = es.invoke(t, {"type": "invoke", "f": "strong-read",
+                                "value": None})
+            assert out["type"] == "ok" and out["value"] == [3, 7]
+            # refresh rides SQL even under es-ops routing
+            assert es.invoke(t, {"type": "invoke", "f": "refresh",
+                                 "value": None})["type"] == "ok"
+        finally:
+            cr.PORT = old
+    finally:
+        srv.stop()
+
+
+def test_crate_fake_dirty_read_run():
+    from conftest import run_fake
+    from jepsen_tpu.suites.crate import crate_test
+
+    result = run_fake(crate_test, workload="dirty-read",
+                      dirty_read_quiesce=0.2)
+    assert result["results"]["workload"]["valid?"] is True, (
+        result["results"])
+    # the final phase is deterministic; write/read emission is pinned
+    # by test_crate_dirty_read_rw_gen (the 1 s main phase schedules so
+    # few ops that demanding a writer-thread pick would flake)
+    fs = {op.get("f") for op in result["history"]}
+    assert {"refresh", "strong-read"} <= fs

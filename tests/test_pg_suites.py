@@ -376,3 +376,88 @@ def test_pg_client_comments_dispatch():
     selects = [q for q in c.conn.queries if q.startswith("SELECT id FROM")]
     assert len(selects) == COMMENT_TABLE_COUNT
     assert c.conn.queries[-1] == "COMMIT"
+
+
+def test_pg_append_table_txn():
+    """append-table txns route micro-ops to one table per key, create
+    missing tables on demand, and retry the whole txn
+    (yugabyte/ysql/append_table.clj:28-129 with-table)."""
+    import re
+
+    from jepsen_tpu.suites._pg_client import PGSuiteClient
+    from jepsen_tpu.suites._postgres import PgError
+
+    class ScriptedPG:
+        def __init__(self):
+            self.tables = {}
+            self.sql = []
+            self._snap = None
+
+        def query(self, sql):
+            self.sql.append(sql)
+            if sql.startswith("BEGIN"):
+                self._snap = {t: list(v) for t, v in self.tables.items()}
+                return [], b""
+            if sql.startswith("COMMIT"):
+                self._snap = None
+                return [], b""
+            if sql.startswith("ROLLBACK"):
+                if self._snap is not None:  # undo in-txn inserts
+                    self.tables = self._snap
+                    self._snap = None
+                return [], b""
+            m = re.search(r"CREATE TABLE IF NOT EXISTS (\w+)", sql)
+            if m:
+                self.tables.setdefault(m.group(1), [])
+                return [], b""
+            m = re.search(r"SELECT v FROM (\w+) ORDER BY k", sql)
+            if m:
+                t = m.group(1)
+                if t not in self.tables:
+                    raise PgError({"C": "42P01",
+                                   "M": f'relation "{t}" does not exist'})
+                return [[v] for v in self.tables[t]], b""
+            m = re.search(r"INSERT INTO (\w+) \(v\) VALUES \((\d+)\)", sql)
+            if m:
+                t = m.group(1)
+                if t not in self.tables:
+                    raise PgError({"C": "42P01",
+                                   "M": f'relation "{t}" does not exist'})
+                self.tables[t].append(int(m.group(2)))
+                return [], b""
+            return [], b""
+
+    c = PGSuiteClient.__new__(PGSuiteClient)
+    c.isolation = "serializable"
+    c.txn_style = "append-table"
+    c._broken = False
+    c.conn = ScriptedPG()
+    op = {"f": "txn", "type": "invoke",
+          "value": [["append", 1, 10], ["r", 1, None], ["append", 2, 20]]}
+    out = c._txn(op)
+    assert out["type"] == "ok"
+    assert out["value"] == [["append", 1, 10], ["r", 1, [10]],
+                            ["append", 2, 20]]
+    assert set(c.conn.tables) == {"append_1", "append_2"}
+    creates = [s for s in c.conn.sql if s.startswith("CREATE TABLE")]
+    assert len(creates) == 2  # one per missing table, then retried
+
+    # a non-42P01 error still maps through the standard sql-error path
+    class AlwaysFails(ScriptedPG):
+        def query(self, sql):
+            if sql.startswith(("BEGIN", "ROLLBACK")):
+                return [], b""
+            raise PgError({"C": "40001", "M": "restart transaction"})
+
+    c.conn = AlwaysFails()
+    out = c._txn(op)
+    assert out["type"] == "fail" and out["error"][0] == \
+        "serialization-failure"
+
+
+def test_yugabyte_fake_append_table_run():
+    from conftest import run_fake
+
+    result = run_fake(yugabyte.yugabyte_test, workload="append-table")
+    assert result["results"]["workload"]["valid?"] is True, (
+        result["results"])
