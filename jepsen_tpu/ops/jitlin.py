@@ -24,9 +24,12 @@ bucket history lengths so XLA caches compilations.
 """
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import numpy as np
+
+logger = logging.getLogger("jepsen.jitlin")
 
 SENTINEL_MASK = np.uint32(0xFFFFFFFF)
 SENTINEL_STATE = np.int32(0x7FFFFFFF)
@@ -345,6 +348,34 @@ def _returns_prepass(kind, slot, f, a, b):
     return r_slot, r_pend, r_ops, S
 
 
+def receiver_kill_tables(S: int, V: int):
+    """The transfer-matrix operators' static bit tables — ONE source of
+    truth shared by the XLA scan kernel and the pallas kernel
+    (ops/pallas_matrix.py expands these into matrix form):
+
+    - receiver [S, M, M] f32: R_t[r | bit_t, r] = 1 for slots t not in
+      mask r (the mask-receiver map of linearizing pending op t)
+    - kill_idx [S, MV] i32 / kill_mask [S, MV] f32: the
+      closure-then-kill row gather+mask for a return on slot s
+    """
+    M = 1 << S
+    MV = M * V
+    r = np.arange(M)
+    receiver = np.zeros((S, M, M), np.float32)
+    for t in range(S):
+        src = r[((r >> t) & 1) == 0]
+        receiver[t, src | (1 << t), src] = 1.0
+    rows = np.arange(MV)
+    rr, ww = rows // V, rows % V
+    kill_idx = np.zeros((S, MV), np.int32)
+    kill_mask = np.zeros((S, MV), np.float32)
+    for s in range(S):
+        ok = ((rr >> s) & 1) == 0
+        kill_idx[s] = np.where(ok, (rr | (1 << s)) * V + ww, 0)
+        kill_mask[s] = ok.astype(np.float32)
+    return receiver, kill_idx, kill_mask
+
+
 def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
                          g_steps: int, n_chunks: int, n_keys: int = 1):
     """Block-composed transfer-matrix variant of the dense scan.
@@ -389,20 +420,8 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
     B, C, T = n_keys, n_chunks, g_steps
     G = B * C
 
-    # static tables ------------------------------------------------------
-    r = np.arange(M)
-    receiver = np.zeros((S, M, M), np.float32)  # R_t[r|bit_t, r] for t∉r
-    for t in range(S):
-        src = r[((r >> t) & 1) == 0]
-        receiver[t, src | (1 << t), src] = 1.0
-    rows = np.arange(MV)
-    rr, ww = rows // V, rows % V
-    kill_idx = np.zeros((S, MV), np.int32)
-    kill_mask = np.zeros((S, MV), np.float32)
-    for s in range(S):
-        ok = ((rr >> s) & 1) == 0
-        kill_idx[s] = np.where(ok, (rr | (1 << s)) * V + ww, 0)
-        kill_mask[s] = ok.astype(np.float32)
+    # static tables (shared constructor with the pallas kernel) ----------
+    receiver, kill_idx, kill_mask = receiver_kill_tables(S, V)
     n_sq = 0
     while (1 << n_sq) < S:
         n_sq += 1
@@ -459,13 +478,7 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
                     inexact | (oob & pend_g & val_g[:, None]).any(axis=1)), None
         return step
 
-    @jax.jit
-    def scan_total(pend, op_ids, uops, slots, valid, tot0):
-        mt_tab, oob_tab = uop_tables(uops)
-        P0 = jnp.broadcast_to(eye, (G, MV, MV))
-        (P, inexact), _ = lax.scan(make_step(mt_tab, oob_tab),
-                                   (P0, jnp.zeros((G,), bool)),
-                                   (pend, op_ids, slots, valid))
+    def _combine(P, inexact, tot0):
         # chain each key's C chunk products in time order: chunks are
         # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0] @ tot0
         Pk = P.reshape(B, C, MV, MV)
@@ -478,11 +491,51 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
         alive = (total[:, :, init_state] > 0).any(axis=1)
         return alive, inexact.reshape(B, C).any(axis=1), total
 
+    @jax.jit
+    def scan_total(pend, op_ids, uops, slots, valid, tot0):
+        mt_tab, oob_tab = uop_tables(uops)
+        P0 = jnp.broadcast_to(eye, (G, MV, MV))
+        (P, inexact), _ = lax.scan(make_step(mt_tab, oob_tab),
+                                   (P0, jnp.zeros((G,), bool)),
+                                   (pend, op_ids, slots, valid))
+        return _combine(P, inexact, tot0)
+
+    @jax.jit
+    def scan_total_pallas(pend, op_ids, uops, slots, valid, tot0):
+        """Same contract as scan_total, with the T-step chunk product
+        fused into ONE pallas program per chunk (P stays VMEM-resident
+        across all its returns — see ops/pallas_matrix.py). The oob →
+        inexact reduction runs on the small id grids outside the
+        kernel; boolean results are bit-identical to the scan path (f32
+        accumulation of 0/1 addends, thresholded per product)."""
+        from jepsen_tpu.ops import pallas_matrix
+
+        mt_tab, oob_tab = uop_tables(uops)
+        fn = pallas_matrix.chunk_product(S, V, T, uops.shape[0])
+        mtT = jnp.transpose(mt_tab, (0, 2, 1)).astype(jnp.float32)
+        P = fn(pend, op_ids, mtT, slots, valid)
+        inexact = (oob_tab[op_ids] & pend & valid[..., None]).any(axis=(0, 2))
+        return _combine(P, inexact, tot0)
+
+    def _dispatch_total(pend, op_ids, uops, slots, valid, tot0):
+        from jepsen_tpu.ops import pallas_matrix
+
+        if pallas_matrix.enabled(S, V):
+            try:
+                return scan_total_pallas(pend, op_ids, uops, slots, valid,
+                                         tot0)
+            except Exception:  # noqa: BLE001 — lowering/runtime failure
+                logger.warning("pallas matrix path failed at %s; falling "
+                               "back to the XLA scan", (S, V, T),
+                               exc_info=True)
+                pallas_matrix.disable(S, V)
+        return scan_total(pend, op_ids, uops, slots, valid, tot0)
+
     def run(pend, op_ids, uops, slots, valid):
         """pend [T,G,S]; op_ids [T,G,S] (indices into uops [U,3]);
         slots [T,G]; valid [T,G], with chunk g = key * C + chunk.
         Returns (alive[B], inexact[B])."""
-        alive, inexact, _ = scan_total(pend, op_ids, uops, slots, valid,
+        alive, inexact, _ = _dispatch_total(pend, op_ids, uops, slots, valid,
                                        jnp.broadcast_to(eye, (B, MV, MV)))
         return alive, inexact
 
@@ -493,7 +546,7 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
         one monolithic run provided segments cut at quiescent points —
         the per-segment prepass assumes no pending ops at entry).
         Returns (alive, inexact, total) with total staying on device."""
-        return scan_total(pend, op_ids, uops, slots, valid, tot0)
+        return _dispatch_total(pend, op_ids, uops, slots, valid, tot0)
 
     run.resume = run_resume
     # bf16 identity: the carry dtype must match scan_total's output or

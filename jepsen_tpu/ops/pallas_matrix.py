@@ -1,0 +1,306 @@
+"""Pallas TPU kernel for the transfer-matrix chunk product.
+
+The block-composed matrix kernel (ops/jitlin.py _build_matrix_kernel,
+the TPU analog of knossos's wgl search — checker.clj:185-216) advances
+every chunk's composed operator by one return per ``lax.scan`` step.
+Under XLA each step materializes ~6 [G, MV, MV] intermediates in HBM
+(L build, I+L, the closure squarings, the kill product, the compose),
+and on long histories (the scale path's ~2k-step segments) that HBM
+round-trip traffic — not the matmul FLOPs — bounds the step.
+
+This kernel fuses the ENTIRE T-step product per chunk: one pallas
+program per chunk g keeps its running product P in a VMEM scratch
+buffer across all T returns and only writes the final [MV, MV] chunk
+product to HBM. Per-step HBM traffic drops from ~6 full [G, MV, MV]
+arrays to zero.
+
+The L build is re-formulated to be layout-friendly (no [M, V, M, V]
+reshapes, which relayout badly on TPU tiles):
+
+    L = sum_s pend_s * (R_s (kron) Mt_s^T)
+      = sum_s pend_s * Rexp_s * (U1 @ Mt_s^T @ U2)
+
+where ``Rexp_s[(a,w),(b,v)] = R_s[a,b]`` is a STATIC [MV, MV]
+block-expansion of the slot-s receiver map, and ``U1 @ X @ U2`` tiles a
+[V, V] matrix over every (a, b) block — two tiny matmuls plus one VPU
+elementwise multiply, instead of a Kronecker construction. The kill
+gather becomes a matmul with a static per-slot kill matrix
+``Kexp_s[r, kill_idx_s[r]] = kill_mask_s[r]``. Products accumulate in
+f32 (counts <= MV <= 2^12 are exact) and threshold back to 0/1, so the
+boolean-semiring result is bit-identical to the XLA path — the
+differential tests in tests/test_pallas_matrix.py pin that. Two
+data-dependent skips ride ``lax.cond``: closure squarings a step's
+pending-op count can't use, and whole padding steps (valid=0), which
+compose the identity.
+
+``chunk_product`` returns a jitted callable or None when the regime
+doesn't fit (VMEM budget, dtype caps) or pallas lowering fails on this
+backend — callers fall back to the XLA scan path.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger("jepsen.pallas")
+
+# VMEM budget gate: the two static [S, MV, MV] tables plus ~4 [MV, MV]
+# scratch/working buffers must fit comfortably; MV <= 512 and S <= 8
+# keeps the residents under ~8 MB
+PALLAS_MAX_MV = 512
+PALLAS_MAX_SLOTS = 8
+
+
+def available() -> bool:
+    """Pallas path enabled? (env kill-switch for triage)."""
+    return not os.environ.get("JEPSEN_TPU_NO_PALLAS")
+
+
+def _static_tables(S: int, V: int):
+    """Host-side static operator tables for (S, V), expanded from the
+    SAME receiver/kill constructor the XLA scan path uses
+    (jitlin.receiver_kill_tables — one source of truth, so the two
+    kernels' bit-identical-verdict guarantee can't drift):
+
+    - Rexp [S, MV, MV]: receiver map R_s block-expanded (R_s[a,b]
+      broadcast over the V*V cells of each (a,b) block)
+    - Kexp [S, MV, MV]: the closure-then-kill row gather+mask as a
+      matrix (A = Kexp_s @ B  ==  B rows gathered at kill_idx_s, masked)
+    - U1 [MV, V], U2 [V, MV]: the tiling maps (U1 @ X @ U2 repeats a
+      [V, V] X over every block)
+    """
+    from jepsen_tpu.ops.jitlin import receiver_kill_tables
+
+    M = 1 << S
+    MV = M * V
+    rows = np.arange(MV)
+    ww = rows % V
+    receiver, kill_idx, kill_mask = receiver_kill_tables(S, V)
+
+    Rexp = np.stack([receiver[t][rows // V][:, rows // V]
+                     for t in range(S)]).astype(np.float32)
+    Kexp = np.zeros((S, MV, MV), np.float32)
+    for s in range(S):
+        Kexp[s, rows, kill_idx[s]] = kill_mask[s]
+
+    U1 = np.zeros((MV, V), np.float32)
+    U1[rows, ww] = 1.0
+    U2 = np.zeros((V, MV), np.float32)
+    U2[ww, rows] = 1.0
+    return Rexp, Kexp, U1, U2
+
+
+@functools.lru_cache(maxsize=16)
+def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
+    """Compile-cached pallas chunk-product for static shapes.
+
+    Returns fn(pend [T,G,S] f32, ids [T,G,S] i32, mtT [U,V,V] f32,
+    slots [T,G] i32, valid [T,G] f32) -> P [G, MV, MV] bf16 — the
+    per-chunk composed operator product over its T returns.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M = 1 << S
+    MV = M * V
+    n_sq = 0
+    while (1 << n_sq) < S:
+        n_sq += 1
+    Rexp, Kexp, U1, U2 = _static_tables(S, V)
+    # f32 throughout: measured FASTER than bf16 on this kernel (both
+    # all-bf16 and mixed variants lost ~25% — the bf16 (16, 128) tile
+    # shape slows the per-step thresholds/selects more than the MXU
+    # rate buys at MV=256)
+    Rexp_j = jnp.asarray(Rexp)
+    Kexp_j = jnp.asarray(Kexp)
+    U1_j = jnp.asarray(U1)
+    U2_j = jnp.asarray(U2)
+
+    def kernel(pend_ref, ids_ref, mtT_ref, slot_ref, val_ref,
+               rexp_ref, kexp_ref, u1_ref, u2_ref, out_ref):
+        eye = (jax.lax.broadcasted_iota(jnp.int32, (MV, MV), 0)
+               == jax.lax.broadcasted_iota(jnp.int32, (MV, MV), 1)
+               ).astype(jnp.float32)
+
+        def bool_mm(x, y):
+            # f32 0/1 inputs and accumulation: exact (a positive count
+            # can't round to zero), and the measured-fastest dtype here
+            return (jnp.dot(x, y, preferred_element_type=jnp.float32)
+                    > 0).astype(jnp.float32)
+
+        def step(t, P):
+            # padding rows (valid=0) compose the identity: skip outright
+            return lax.cond(val_ref[0, t, 0] > 0, _live_step,
+                            lambda tt, PP: PP, t, P)
+
+        def _live_step(t, P):
+            # L = sum_s pend[t,s] * Rexp_s * tile(Mt_s^T)
+            L = jnp.zeros((MV, MV), jnp.float32)
+            for s in range(S):
+                idx = ids_ref[0, t, s]
+                mtT = mtT_ref[pl.dslice(idx, 1), :, :][0]   # [V, V]
+                tile = jnp.dot(
+                    jnp.dot(u1_ref[...], mtT,
+                            preferred_element_type=jnp.float32),
+                    u2_ref[...], preferred_element_type=jnp.float32)
+                L = L + pend_ref[0, t, s] * rexp_ref[s] * tile
+            Bm = ((L + eye) > 0).astype(jnp.float32)
+            # closure saturates once the exponent reaches the number of
+            # pending ops (each linearization consumes one), so skip
+            # squarings a sparse step can't use
+            npend = jnp.sum(pend_ref[0, t, :])
+            for _i in range(n_sq):
+                Bm = lax.cond(npend > (1 << _i),
+                              lambda B: bool_mm(B, B),
+                              lambda B: B, Bm)   # (I+L)^(2^k) -> closure
+            ks = kexp_ref[pl.dslice(slot_ref[0, t, 0], 1), :, :][0]
+            A = bool_mm(ks, Bm)                  # closure-then-kill
+            return bool_mm(A, P)
+
+        P = lax.fori_loop(0, T, step, eye)
+        out_ref[0] = P.astype(jnp.bfloat16)
+
+    def grid_fn(pend, ids, mtT, slots, valid):
+        # grids arrive [G, T, S] / [G, T, 1]: blocking only on the
+        # leading grid axis keeps every block's trailing dims equal to
+        # the array's — the Mosaic block-shape rule (trailing two dims
+        # divisible by (8, 128) or equal to the array's)
+        G = pend.shape[0]
+        full = lambda shape: pl.BlockSpec(
+            shape, lambda g: (0,) * len(shape), memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=(G,),
+            in_specs=[
+                pl.BlockSpec((1, T, S), lambda g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, T, S), lambda g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                full((U, V, V)),
+                pl.BlockSpec((1, T, 1), lambda g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, T, 1), lambda g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),
+                full((S, MV, MV)),
+                full((S, MV, MV)),
+                full((MV, V)),
+                full((V, MV)),
+            ],
+            out_specs=pl.BlockSpec((1, MV, MV), lambda g: (g, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((G, MV, MV), jnp.bfloat16),
+            interpret=interpret,
+        )(pend, ids, mtT, slots, valid, Rexp_j, Kexp_j, U1_j, U2_j)
+
+    @jax.jit
+    def run(pend, ids, mtT, slots, valid):
+        """Accepts the scan-path layout (pend/ids [T, G, S], slots/valid
+        [T, G]) and relayouts on device to the kernel's [G, T, ...]."""
+        return grid_fn(
+            jnp.transpose(pend.astype(jnp.float32), (1, 0, 2)),
+            jnp.transpose(ids.astype(jnp.int32), (1, 0, 2)),
+            mtT.astype(jnp.float32),
+            jnp.transpose(slots.astype(jnp.int32), (1, 0))[..., None],
+            jnp.transpose(valid.astype(jnp.float32), (1, 0))[..., None])
+
+    return run
+
+
+# tests set True to exercise the kernel on CPU through the production
+# dispatch (pallas interpret mode); never set in production
+FORCE_INTERPRET = False
+
+
+def chunk_product(S: int, V: int, T: int, U: int,
+                  interpret: bool | None = None):
+    """The compiled kernel for these static shapes, or None when out of
+    the pallas regime. Lowering/compile failures are reported by the
+    first actual call — use ``enabled`` for an upfront check."""
+    MV = (1 << S) * V
+    if not available() or S > PALLAS_MAX_SLOTS or MV > PALLAS_MAX_MV:
+        return None
+    return _build(S, V, T, U,
+                  FORCE_INTERPRET if interpret is None else interpret)
+
+
+_PROBED: dict = {}
+
+
+def _oracle_product(S, V, pend, ids, mtT, slots, valid):
+    """Numpy replay of the factored chunk product — the probe's and the
+    tests' independent reference."""
+    MV = (1 << S) * V
+    T, G = slots.shape
+    Rexp, Kexp, U1, U2 = _static_tables(S, V)
+    eye = np.eye(MV, dtype=np.float32)
+    n_sq = 0
+    while (1 << n_sq) < S:
+        n_sq += 1
+    P = np.broadcast_to(eye, (G, MV, MV)).copy()
+    for t in range(T):
+        for g in range(G):
+            L = np.zeros((MV, MV), np.float32)
+            for s in range(S):
+                L += (pend[t, g, s]
+                      * Rexp[s] * (U1 @ mtT[ids[t, g, s]] @ U2))
+            Bm = ((L + eye) > 0).astype(np.float32)
+            for _ in range(n_sq):
+                Bm = ((Bm @ Bm) > 0).astype(np.float32)
+            A = ((Kexp[slots[t, g]] @ Bm) > 0).astype(np.float32)
+            if not valid[t, g]:
+                A = eye
+            P[g] = ((A @ P[g]) > 0).astype(np.float32)
+    return P
+
+
+def enabled(S: int, V: int) -> bool:
+    """Should the matrix kernel take the pallas path for (S, V)?
+    Gates on the env switch and VMEM caps, then memoizes a small RANDOM
+    end-to-end run checked bit-for-bit against the numpy oracle — so a
+    backend that fails to lower (CPU) OR miscompiles the kernel
+    disables itself and the XLA scan path takes over."""
+    MV = (1 << S) * V
+    if not available() or S > PALLAS_MAX_SLOTS or MV > PALLAS_MAX_MV:
+        return False
+    key = (S, V)
+    # a disable() (runtime failure) sticks even under FORCE_INTERPRET —
+    # otherwise a failing interpret-mode kernel would retrace and fail
+    # on every dispatch
+    if key in _PROBED:
+        return _PROBED[key]
+    if FORCE_INTERPRET:
+        return True
+    ok = False
+    try:
+        T, U, G = 3, 16, 2
+        rng = np.random.default_rng(0)
+        pend = (rng.random((T, G, S)) < 0.5).astype(np.float32)
+        ids = rng.integers(0, U, (T, G, S)).astype(np.int32)
+        mtT = (rng.random((U, V, V)) < 0.3).astype(np.float32)
+        slots = rng.integers(0, S, (T, G)).astype(np.int32)
+        valid = (rng.random((T, G)) < 0.8).astype(np.float32)
+        fn = _build(S, V, T, U, False)
+        got = np.asarray(fn(pend, ids, mtT, slots, valid),
+                         dtype=np.float32)
+        ref = _oracle_product(S, V, pend, ids, mtT, slots, valid)
+        ok = np.array_equal(got, ref)
+        if not ok:
+            logger.warning("pallas matrix kernel MISCOMPILES on this "
+                           "backend (probe mismatch at S=%d V=%d); "
+                           "using the XLA scan path", S, V)
+    except Exception as e:  # noqa: BLE001 — any lowering failure
+        logger.warning("pallas matrix kernel unavailable: %s", e)
+    _PROBED[key] = ok
+    return ok
+
+
+def disable(S: int, V: int) -> None:
+    """Permanently (for this process) route (S, V) to the XLA scan path
+    — called by the dispatcher after a runtime failure."""
+    _PROBED[(S, V)] = False

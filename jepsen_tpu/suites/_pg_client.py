@@ -381,9 +381,12 @@ class PGSuiteClient(Client):
     @staticmethod
     def _missing_relation(e: PgError) -> str | None:
         """The quoted relation name out of a 42P01 message
-        (append_table.clj:92-101 catch-dne)."""
+        (append_table.clj:92-101 catch-dne) — only when it has the
+        append-table shape; anything else (schema-qualified, some other
+        relation) must NOT be interpolated into CREATE TABLE DDL."""
         import re
-        m = re.search(r'relation "(.+?)" does not exist', e.msg or "")
+        m = re.search(r'relation "(append_\d+)" does not exist',
+                      e.msg or "")
         return m.group(1) if m else None
 
     def _ledger_transfer(self, test, op):

@@ -24,6 +24,12 @@ the generator state, so polls discarded by composing generators
 (any_gen races the nemesis) never burn a value — the reference's
 mutable atoms (dirty_read.clj:202-205) rely on op emission being
 dispatch, which does not hold on this framework's pure protocol.
+
+Sizing: like the reference (whose in-flight vector also starts all
+zero), a node only gets live in-flight targets once some writer thread
+lands on it — readers on writer-less nodes keep probing id 0. Run with
+concurrency >= 3x the node count (the reference's typical ``-c 3n``)
+so ``writers = concurrency // 3`` covers every node.
 """
 from __future__ import annotations
 

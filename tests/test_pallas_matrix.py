@@ -1,0 +1,117 @@
+"""Pallas transfer-matrix chunk-product kernel (ops/pallas_matrix.py).
+
+CPU tier: the kernel runs in pallas interpret mode, differentially
+pinned against (a) an independent numpy oracle of the factored math and
+(b) the XLA scan path through the PRODUCTION matrix_check dispatch.
+Real-chip verdict parity lives in tests/test_tpu_parity.py (-m tpu).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+def _oracle(S, V, pend, ids, mtT, slots, valid):
+    """The shared numpy replay (also the enabled() probe's reference)."""
+    from jepsen_tpu.ops.pallas_matrix import _oracle_product
+
+    return _oracle_product(S, V, pend, ids, mtT, slots, valid)
+
+
+def test_static_tables_express_kron_and_kill():
+    """Rexp * tile(X) == R (kron) X^T, and Kexp @ B == the row
+    gather+mask the XLA path performs — the two identities the
+    factored kernel rests on."""
+    from jepsen_tpu.ops.pallas_matrix import _static_tables
+
+    S, V = 3, 4
+    M = 1 << S
+    MV = M * V
+    Rexp, Kexp, U1, U2 = _static_tables(S, V)
+    rng = np.random.default_rng(7)
+    X = (rng.random((V, V)) < 0.4).astype(np.float32)
+    rows = np.arange(MV)
+    a, w = rows // V, rows % V
+    for s in range(S):
+        R = np.zeros((M, M), np.float32)
+        src = np.arange(M)[((np.arange(M) >> s) & 1) == 0]
+        R[src | (1 << s), src] = 1.0
+        kron = R[a][:, a] * X.T[w][:, w]  # [(a,w),(b,v)] = R[a,b] X[v,w]
+        got = Rexp[s] * (U1 @ X.T @ U2)
+        assert np.array_equal(kron, got), s
+
+    B = (rng.random((MV, MV)) < 0.3).astype(np.float32)
+    for s in range(S):
+        ok = ((a >> s) & 1) == 0
+        kill_idx = np.where(ok, ((a | (1 << s)) * V + w), 0)
+        ref = B[kill_idx] * ok[:, None]
+        assert np.array_equal((Kexp[s] @ B > 0) * 1.0, (ref > 0) * 1.0), s
+
+
+def test_kernel_matches_numpy_oracle_interpret():
+    from jepsen_tpu.ops.pallas_matrix import _build
+
+    S, V, T, U, G = 3, 8, 5, 16, 4
+    rng = np.random.default_rng(0)
+    pend = (rng.random((T, G, S)) < 0.5).astype(np.float32)
+    ids = rng.integers(0, U, (T, G, S)).astype(np.int32)
+    mtT = (rng.random((U, V, V)) < 0.3).astype(np.float32)
+    slots = rng.integers(0, S, (T, G)).astype(np.int32)
+    valid = (rng.random((T, G)) < 0.8).astype(np.float32)
+
+    ref = _oracle(S, V, pend, ids, mtT, slots, valid)
+    fn = _build(S, V, T, U, interpret=True)
+    got = np.asarray(fn(pend, ids, mtT, slots, valid)).astype(np.float32)
+    assert np.array_equal(ref, got)
+
+
+def test_production_dispatch_verdict_parity(monkeypatch):
+    """matrix_check through the pallas path (interpret mode, forced)
+    agrees with the XLA scan path on valid AND corrupted histories —
+    the same cross-check the chip parity tier runs for real."""
+    from __graft_entry__ import _register_history  # conftest adds the root
+    import jepsen_tpu.ops.pallas_matrix as pm
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import matrix_check
+
+    def verdicts(h):
+        monkeypatch.setattr(pm, "FORCE_INTERPRET", False)
+        scan = matrix_check(encode_register_ops(h), force=True)
+        monkeypatch.setattr(pm, "FORCE_INTERPRET", True)
+        try:
+            pallas = matrix_check(encode_register_ops(h), force=True)
+        finally:
+            monkeypatch.setattr(pm, "FORCE_INTERPRET", False)
+        return scan, pallas
+
+    h = _register_history(120, n_procs=4, seed=5)
+    scan, pallas = verdicts(h)
+    assert scan is not None and pallas is not None
+    assert pallas[0] == scan[0] is True
+
+    import random
+    h = _register_history(120, n_procs=4, seed=6)
+    reads = [op for op in h
+             if op.get("f") == "read" and op.get("type") == "ok"]
+    for op in random.Random(0).sample(reads, min(2, len(reads))):
+        op["value"] = 999
+    scan, pallas = verdicts(h)
+    assert pallas[0] == scan[0] is False
+
+
+def test_gates():
+    import jepsen_tpu.ops.pallas_matrix as pm
+
+    # VMEM caps: decline huge operator dimensions
+    assert pm.chunk_product(9, 8, 4, 16) is None        # S over cap
+    assert pm.chunk_product(8, 16, 4, 16) is None       # MV = 4096 over cap
+    # env kill-switch
+    import os
+    os.environ["JEPSEN_TPU_NO_PALLAS"] = "1"
+    try:
+        assert not pm.available()
+        assert not pm.enabled(3, 8)
+        assert pm.chunk_product(3, 8, 4, 16) is None
+    finally:
+        del os.environ["JEPSEN_TPU_NO_PALLAS"]
+    assert pm.available()

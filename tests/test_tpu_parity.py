@@ -184,3 +184,27 @@ def test_elle_device_parity(tpu_device):
         assert r_dev["valid?"] is want and r_cpu["valid?"] is want
         if not want:
             assert set(r_dev["anomaly-types"]) == set(r_cpu["anomaly-types"])
+
+
+def test_pallas_chunk_product_parity(tpu_device, streams):
+    """The pallas fused chunk product (ops/pallas_matrix.py) against
+    the XLA scan path on the REAL chip, both verdict polarities. Also
+    asserts the self-verifying probe actually admitted the pallas path
+    on this backend (if Mosaic regressed, the probe must say so rather
+    than this test silently exercising the fallback twice)."""
+    import jepsen_tpu.ops.pallas_matrix as pm
+    from jepsen_tpu.ops.jitlin import matrix_check
+
+    good, bad = streams
+    if not pm.enabled(5, 8):
+        pytest.fail("pallas probe rejected the kernel on the real chip "
+                    "(lowering failure or miscompile — see the log)")
+    for stream, expect in ((good, True), (bad, False)):
+        pal = matrix_check(stream, force=True)
+        os.environ["JEPSEN_TPU_NO_PALLAS"] = "1"
+        try:
+            scan = matrix_check(stream, force=True)
+        finally:
+            del os.environ["JEPSEN_TPU_NO_PALLAS"]
+        assert pal is not None and scan is not None
+        assert bool(pal[0]) == bool(scan[0]) == expect
