@@ -517,13 +517,27 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
         inexact = (oob_tab[op_ids] & pend & valid[..., None]).any(axis=(0, 2))
         return _combine(P, inexact, tot0)
 
+    synced_shapes: set = set()
+
     def _dispatch_total(pend, op_ids, uops, slots, valid, tot0):
         from jepsen_tpu.ops import pallas_matrix
 
         if pallas_matrix.enabled(S, V):
+            shape_key = (pend.shape, uops.shape)
             try:
-                return scan_total_pallas(pend, op_ids, uops, slots, valid,
-                                         tot0)
+                out = scan_total_pallas(pend, op_ids, uops, slots, valid,
+                                        tot0)
+                # jitted dispatch is async: a Mosaic RUNTIME fault (vs
+                # the lowering faults the probe catches) would otherwise
+                # surface at the caller's readback, outside this try.
+                # Deterministic per compiled shape, so force one sync on
+                # each shape's first execution and keep later dispatches
+                # pipelined.
+                if shape_key not in synced_shapes:
+                    import jax
+                    jax.block_until_ready(out)
+                    synced_shapes.add(shape_key)
+                return out
             except Exception:  # noqa: BLE001 — lowering/runtime failure
                 logger.warning("pallas matrix path failed at %s; falling "
                                "back to the XLA scan", (S, V, T),

@@ -230,6 +230,7 @@ def chunk_product(S: int, V: int, T: int, U: int,
 
 
 _PROBED: dict = {}
+_DISABLED: set = set()
 
 
 def _oracle_product(S, V, pend, ids, mtT, slots, valid):
@@ -271,11 +272,15 @@ def enabled(S: int, V: int) -> bool:
     key = (S, V)
     # a disable() (runtime failure) sticks even under FORCE_INTERPRET —
     # otherwise a failing interpret-mode kernel would retrace and fail
-    # on every dispatch
-    if key in _PROBED:
-        return _PROBED[key]
+    # on every dispatch. It is tracked apart from probe results: a
+    # CPU probe failure (no pallas backend) must NOT poison forced
+    # interpret-mode runs, which don't need one.
+    if key in _DISABLED:
+        return False
     if FORCE_INTERPRET:
         return True
+    if key in _PROBED:
+        return _PROBED[key]
     ok = False
     try:
         T, U, G = 3, 16, 2
@@ -302,5 +307,6 @@ def enabled(S: int, V: int) -> bool:
 
 def disable(S: int, V: int) -> None:
     """Permanently (for this process) route (S, V) to the XLA scan path
-    — called by the dispatcher after a runtime failure."""
-    _PROBED[(S, V)] = False
+    — called by the dispatcher after a runtime failure. Unlike a probe
+    miss, this also sticks under FORCE_INTERPRET."""
+    _DISABLED.add((S, V))

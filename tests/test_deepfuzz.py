@@ -84,3 +84,37 @@ def test_segmented_paths_vs_monolithic_many():
                 break
             base = end
         assert alive == bool(m_whole[0]), trial
+
+
+def test_pallas_chunk_product_vs_scan_many():
+    """The pallas fused chunk product (interpret mode, forced through
+    the production dispatch) vs the XLA scan across random valid and
+    corrupted histories — the deep net behind the two-case CI test in
+    tests/test_pallas_matrix.py."""
+    from __graft_entry__ import _register_history
+    import jepsen_tpu.ops.pallas_matrix as pm
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.ops.jitlin import matrix_check
+
+    rng = random.Random(9)
+    for trial in range(20):
+        n = rng.randrange(80, 400)
+        stream = encode_register_ops(_register_history(
+            n, n_procs=rng.randrange(2, 6), seed=1000 + trial, n_values=5))
+        if rng.random() < 0.5:
+            a = np.asarray(stream.a).copy()
+            reads = np.nonzero((np.asarray(stream.kind) == 0)
+                               & (np.asarray(stream.f) == 0))[0]
+            for r in rng.sample(list(reads), min(4, len(reads))):
+                a[r] = rng.randrange(1, 6)
+            stream = replace(stream, a=a)
+
+        pm.FORCE_INTERPRET = False
+        scan = matrix_check(stream, force=True)
+        pm.FORCE_INTERPRET = True
+        try:
+            pal = matrix_check(stream, force=True)
+        finally:
+            pm.FORCE_INTERPRET = False
+        assert pal is not None and scan is not None
+        assert bool(pal[0]) == bool(scan[0]), trial

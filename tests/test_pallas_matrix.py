@@ -99,19 +99,16 @@ def test_production_dispatch_verdict_parity(monkeypatch):
     assert pallas[0] == scan[0] is False
 
 
-def test_gates():
+def test_gates(monkeypatch):
     import jepsen_tpu.ops.pallas_matrix as pm
 
     # VMEM caps: decline huge operator dimensions
     assert pm.chunk_product(9, 8, 4, 16) is None        # S over cap
     assert pm.chunk_product(8, 16, 4, 16) is None       # MV = 4096 over cap
-    # env kill-switch
-    import os
-    os.environ["JEPSEN_TPU_NO_PALLAS"] = "1"
-    try:
-        assert not pm.available()
-        assert not pm.enabled(3, 8)
-        assert pm.chunk_product(3, 8, 4, 16) is None
-    finally:
-        del os.environ["JEPSEN_TPU_NO_PALLAS"]
+    # env kill-switch (monkeypatch restores any externally-set value)
+    monkeypatch.setenv("JEPSEN_TPU_NO_PALLAS", "1")
+    assert not pm.available()
+    assert not pm.enabled(3, 8)
+    assert pm.chunk_product(3, 8, 4, 16) is None
+    monkeypatch.delenv("JEPSEN_TPU_NO_PALLAS")
     assert pm.available()
