@@ -124,12 +124,15 @@ def _device_args(batch):
 
 
 def _force(*xs):
-    """Forces completion by reading results back to host. Timings must
-    end with this, NOT jax.block_until_ready: on out-of-process backends
-    (the tunneled TPU) block_until_ready can return before execution
-    finishes, silently turning a compute measurement into a dispatch
-    measurement."""
-    return [np.asarray(x) for x in xs]
+    """Forces completion by reading results back to host in ONE batched
+    transfer. Timings must end with this, NOT jax.block_until_ready: on
+    out-of-process backends (the tunneled TPU) block_until_ready can
+    return before execution finishes, silently turning a compute
+    measurement into a dispatch measurement. One call, not one per
+    array — each host readback is a full tunnel round-trip (~100 ms)."""
+    import jax
+
+    return list(jax.device_get(xs))
 
 
 def _best_of(fn, n: int = 2):

@@ -712,15 +712,16 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
             sl = preps[lo:lo + MATRIX_SUB_KEYS]
             handles.append((len(sl), _matrix_dispatch(
                 sl, S, R_max, V, step_ids, init_state, None)))
+        # ONE batched host transfer for the whole pipeline — per-handle
+        # np.asarray pairs would pay a tunnel round-trip each
+        fetched = jax.device_get([h for _, h in handles])
         out = []
-        for nb, (alive, inexact) in handles:
-            a, ix = np.asarray(alive), np.asarray(inexact)
+        for (nb, _), (a, ix) in zip(handles, fetched):
             out += [(bool(a[b]), -1, bool(ix[b]), 0) for b in range(nb)]
         return out
 
-    alive, inexact = _matrix_dispatch(preps, S, R_max, V, step_ids,
-                                      init_state, mesh)
-    alive, inexact = np.asarray(alive), np.asarray(inexact)
+    alive, inexact = jax.device_get(_matrix_dispatch(
+        preps, S, R_max, V, step_ids, init_state, mesh))
     return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
 
 

@@ -119,5 +119,7 @@ def classify_elements(member: np.ndarray, t_read: np.ndarray,
                               jnp.asarray(rv), jnp.asarray(iv),
                               jnp.asarray(okt), jnp.asarray(hok),
                               jnp.asarray(ev))
-    return (np.asarray(code)[:E], np.asarray(stale)[:E],
-            np.asarray(latency)[:E])
+    # one batched host transfer (three sequential syncs would pay a
+    # tunnel round-trip each)
+    code, stale, latency = jax.device_get((code, stale, latency))
+    return code[:E], stale[:E], latency[:E]

@@ -166,6 +166,8 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
 
 def _scan_batch(streams, capacity, mesh, kernel, n_states):
     """The vmapped event-scan path (dense or sparse frontier kernel)."""
+    import jax
+
     from jepsen_tpu.checker.linear_encode import pad_streams
     from jepsen_tpu.ops.jitlin import _bucket
 
@@ -182,6 +184,9 @@ def _scan_batch(streams, capacity, mesh, kernel, n_states):
 
     fn = kernel._get(S, capacity, batched=True, num_states=n_states)
     alive, died, ovf, peak = fn(*arrays)
-    alive, died, ovf, peak = map(np.asarray, (alive, died, ovf, peak))
+    # ONE batched host transfer: each np.asarray is a full tunnel
+    # round-trip (~100 ms on remote-attached chips), so four sequential
+    # syncs would quadruple the fixed cost of every batch check
+    alive, died, ovf, peak = jax.device_get((alive, died, ovf, peak))
     return [(bool(alive[i]), int(died[i]), bool(ovf[i]), int(peak[i]))
             for i in range(real_b)]
