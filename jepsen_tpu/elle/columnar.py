@@ -44,6 +44,7 @@ from jepsen_tpu.txn import _hk
 # composite-key bit budget: (txn << 32) | (kid << 12) | mi must be exact
 # in int64, and (kid << 32) | value needs value in [0, 2^32)
 _MAX_KIDS = 1 << 20
+_I64 = 1 << 63
 _MAX_MOPS = 1 << 12
 _MAX_VAL = 1 << 32
 
@@ -80,6 +81,70 @@ def check_columnar(history: list, consistency_models, accelerator: str):
     result["read-scan-keys"] = {"columnar": n_keys, "python": 0}
     result["builder"] = "columnar"
     return result
+
+
+def _flatten_mops_fast(txns):
+    """Vectorized pass B for the all-int regime (every mop key a plain
+    int, every append value a plain int): C-speed comprehensions +
+    numpy replace the per-mop Python loop, which dominates the whole
+    check on large histories. Returns the exact pass-B product —
+    including kid ids in FIRST-ENCOUNTER order over appended/read mops,
+    matching the general loop's interning bit-for-bit — or None to fall
+    back to the general loop (exotic keys/values, over-long txns).
+    Differentially pinned to the loop by the columnar-vs-python fuzz in
+    tests/test_elle.py."""
+    vals = [op.get("value") or () for op in txns]
+    counts = np.fromiter((len(v) for v in vals), np.int64, len(vals))
+    total = int(counts.sum())
+    if counts.size and int(counts.max()) > _MAX_MOPS:
+        return None
+    mops = [m for v in vals for m in v]
+    if not mops:
+        return None
+    try:
+        fs, keys, third = zip(*((m[0], m[1], m[2]) for m in mops))
+    except (ValueError, IndexError):
+        return None
+    if any(type(k) is not int or k < -_I64 or k >= _I64 for k in keys):
+        return None  # exotic/huge keys: the general loop interns anything
+
+    flat_txn = np.repeat(np.arange(len(vals), dtype=np.int64), counts)
+    flat_mi = (np.arange(total, dtype=np.int64)
+               - np.repeat(np.cumsum(counts) - counts, counts))
+    is_append = np.fromiter((f == "append" for f in fs), bool, total)
+    is_read = np.fromiter(
+        (f == "r" and t is not None for f, t in zip(fs, third)),
+        bool, total)
+    ai = np.nonzero(is_append)[0]
+    ri = np.nonzero(is_read)[0]
+
+    a_val = [third[j] for j in ai.tolist()]
+    if any(type(v) is not int for v in a_val):
+        return None
+    payloads = [third[j] if type(third[j]) is list else list(third[j])
+                for j in ri.tolist()]
+
+    # interning: ids in first-encounter order over appended/read mops
+    # (ignored mops' keys never intern — same as kid())
+    karr = np.asarray(keys, np.int64)
+    sel = np.sort(np.concatenate([ai, ri]))
+    ksel = karr[sel]
+    uniq, first_idx, inverse = np.unique(ksel, return_index=True,
+                                         return_inverse=True)
+    order = np.argsort(first_idx)
+    rank = np.empty(order.size, np.int64)
+    rank[order] = np.arange(order.size)
+    kid_of_flat = np.full(total, -1, np.int64)
+    kid_of_flat[sel] = rank[inverse]
+    raw_key = uniq[order].tolist()
+    kid_of = {k: i for i, k in enumerate(raw_key)}
+
+    # a_* go straight back into np.asarray downstream: return arrays
+    # (no copy on re-asarray); r_kid stays a python list — the prefix
+    # loop indexes it per row and np scalar boxing would cost more
+    return (flat_txn[ai], kid_of_flat[ai], a_val, flat_mi[ai],
+            flat_txn[ri], kid_of_flat[ri].tolist(), flat_mi[ri],
+            payloads, raw_key, kid_of)
 
 
 def _build(history: list):
@@ -122,10 +187,17 @@ def _build(history: list):
     extras: dict[str, list] = defaultdict(list)
 
     # ---- pass B: flatten micro-ops into columns ------------------------
-    kid_of: dict = {}
-    raw_key: list = []
+    fast = _flatten_mops_fast(txns)
+    if fast is not None:
+        (a_txn, a_kid, a_val, a_mi, r_txn, r_kid, r_mi, payloads,
+         raw_key, kid_of) = fast
+    else:
+        kid_of = {}
+        raw_key = []
 
     def kid(k):
+        # interns into kid_of/raw_key: fresh on the general loop,
+        # continuing the fast map for fail ops on the fast path
         hk = _hk(k)
         i = kid_of.get(hk)
         if i is None:
@@ -133,32 +205,29 @@ def _build(history: list):
             raw_key.append(k)
         return i
 
-    a_txn: list = []
-    a_kid: list = []
-    a_val: list = []
-    a_mi: list = []
-    r_txn: list = []
-    r_kid: list = []
-    r_mi: list = []
-    payloads: list = []
-    for i, op in enumerate(txns):
-        for mi, m in enumerate(op.get("value") or ()):
-            if mi >= _MAX_MOPS:
-                return None
-            f = m[0]
-            if f == "append":
-                v = m[2]
-                if not isinstance(v, int) or isinstance(v, bool):
+    if fast is None:
+        a_txn, a_kid, a_val, a_mi = [], [], [], []
+        r_txn, r_kid, r_mi = [], [], []
+        payloads = []
+        for i, op in enumerate(txns):
+            for mi, m in enumerate(op.get("value") or ()):
+                if mi >= _MAX_MOPS:
                     return None
-                a_txn.append(i)
-                a_kid.append(kid(m[1]))
-                a_val.append(v)
-                a_mi.append(mi)
-            elif f == "r" and m[2] is not None:
-                r_txn.append(i)
-                r_kid.append(kid(m[1]))
-                r_mi.append(mi)
-                payloads.append(m[2] if type(m[2]) is list else list(m[2]))
+                f = m[0]
+                if f == "append":
+                    v = m[2]
+                    if not isinstance(v, int) or isinstance(v, bool):
+                        return None
+                    a_txn.append(i)
+                    a_kid.append(kid(m[1]))
+                    a_val.append(v)
+                    a_mi.append(mi)
+                elif f == "r" and m[2] is not None:
+                    r_txn.append(i)
+                    r_kid.append(kid(m[1]))
+                    r_mi.append(mi)
+                    payloads.append(m[2] if type(m[2]) is list
+                                    else list(m[2]))
 
     f_kid: list = []
     f_val: list = []

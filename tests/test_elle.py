@@ -1277,3 +1277,33 @@ def test_batch_cluster_screen_chunks_over_budget(monkeypatch):
     dst = np.asarray([1, 0, 1, 1, 2, 0, 0], np.int32)
     flags = scc_mod.batch_cluster_screen(cid, src, dst, 5, 3)
     assert flags.tolist() == [True, False, True, False, True]
+
+
+def test_columnar_fast_flatten_fallbacks():
+    """The vectorized pass B declines regimes the general loop handles —
+    huge int keys (beyond int64), non-int keys, bool append values —
+    and _build still produces a columnar result for them."""
+    from jepsen_tpu.elle import columnar
+
+    def h(key, val=1):
+        return [
+            {"type": "invoke", "process": 0,
+             "value": [["append", key, val]]},
+            {"type": "ok", "process": 0, "value": [["append", key, val]]},
+            {"type": "invoke", "process": 0, "value": [["r", key, None]]},
+            {"type": "ok", "process": 0, "value": [["r", key, [val]]]},
+        ]
+
+    for key in (1 << 63, -(1 << 63) - 1, "k"):
+        types = [op.get("type") for op in h(key)]
+        txns = [op for op, t in zip(h(key), types) if t == "ok"]
+        assert columnar._flatten_mops_fast(txns) is None, key
+        parts = columnar._build(h(key))   # general loop still builds
+        assert parts is not None, key
+        graph, txns_out, extras, n_keys = parts
+        assert n_keys == 1 and len(txns_out) == 2
+
+    # bool append value: BOTH paths decline (python builder territory)
+    txns = [op for op in h(0, True) if op["type"] == "ok"]
+    assert columnar._flatten_mops_fast(txns) is None
+    assert columnar._build(h(0, True)) is None
