@@ -187,17 +187,9 @@ def _build(history: list):
     extras: dict[str, list] = defaultdict(list)
 
     # ---- pass B: flatten micro-ops into columns ------------------------
-    fast = _flatten_mops_fast(txns)
-    if fast is not None:
-        (a_txn, a_kid, a_val, a_mi, r_txn, r_kid, r_mi, payloads,
-         raw_key, kid_of) = fast
-    else:
-        kid_of = {}
-        raw_key = []
-
     def kid(k):
-        # interns into kid_of/raw_key: fresh on the general loop,
-        # continuing the fast map for fail ops on the fast path
+        # interns into kid_of/raw_key (bound at call time): fresh on the
+        # general loop, continuing the fast map for fail ops after it
         hk = _hk(k)
         i = kid_of.get(hk)
         if i is None:
@@ -205,7 +197,13 @@ def _build(history: list):
             raw_key.append(k)
         return i
 
-    if fast is None:
+    fast = _flatten_mops_fast(txns)
+    if fast is not None:
+        (a_txn, a_kid, a_val, a_mi, r_txn, r_kid, r_mi, payloads,
+         raw_key, kid_of) = fast
+    else:
+        kid_of = {}
+        raw_key = []
         a_txn, a_kid, a_val, a_mi = [], [], [], []
         r_txn, r_kid, r_mi = [], [], []
         payloads = []

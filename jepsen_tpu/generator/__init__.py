@@ -87,19 +87,31 @@ class Context:
         (generator.clj:480-487; fairness rationale 438-449)."""
         if not self.free_threads:
             return None
-        ts = sorted(self.free_threads, key=_thread_sort_key)
+        # the sorted view is memoized by the free-thread SET value —
+        # contexts churn every step but cycle through few distinct sets,
+        # and the per-poll sort was the scheduler loop's second-hottest
+        # cost (the reference leans on Bifurcan's ordered sets here)
+        ts = _FREE_SORT_CACHE.get(self.free_threads)
+        if ts is None:
+            if len(_FREE_SORT_CACHE) > 4096:
+                _FREE_SORT_CACHE.clear()
+            ts = sorted(self.free_threads, key=_thread_sort_key)
+            _FREE_SORT_CACHE[self.free_threads] = ts
         t = ts[self.rng.randrange(len(ts))]
         return self.workers[t]
 
-    # -- functional updates ----------------------------------------------
+    # -- functional updates (direct construction: dataclasses.replace's
+    # field introspection was the scheduler loop's hottest cost) --------
     def with_time(self, time: int) -> "Context":
-        return replace(self, time=time)
+        return Context(time, self.free_threads, self.workers, self.rng)
 
     def busy_thread(self, thread) -> "Context":
-        return replace(self, free_threads=self.free_threads - {thread})
+        return Context(self.time, self.free_threads - {thread},
+                       self.workers, self.rng)
 
     def free_thread(self, thread) -> "Context":
-        return replace(self, free_threads=self.free_threads | {thread})
+        return Context(self.time, self.free_threads | {thread},
+                       self.workers, self.rng)
 
     def with_next_process(self, thread) -> "Context":
         """Assigns a fresh process id to thread after a crash."""
@@ -115,6 +127,9 @@ class Context:
             free_threads=self.free_threads & threads,
             workers={t: p for t, p in self.workers.items() if t in threads},
         )
+
+
+_FREE_SORT_CACHE: dict = {}
 
 
 def _thread_sort_key(t):
