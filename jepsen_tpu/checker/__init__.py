@@ -144,7 +144,16 @@ def _freeze(x):
 
 class Stats(Checker):
     """ok/fail/info counts overall and by :f; valid iff every :f saw at
-    least one ok (checker.clj:153-183)."""
+    least one ok (checker.clj:153-183). ``ungated_fs`` exempts specific
+    op fs from the validity gate (counts still reported) — for probes
+    where ONE op type is expected to fail en masse while the rest must
+    still work (e.g. the crate dirty-read generator aims reads at
+    in-flight writes; the reference composes only {dirty-read, perf}
+    there, crate/dirty_read.clj:245-247 — but a blanket exemption
+    would also mask e.g. every write failing)."""
+
+    def __init__(self, ungated_fs=()):
+        self.ungated_fs = frozenset(ungated_fs or ())
 
     def check(self, test, history, opts):
         def summarize(ops):
@@ -166,7 +175,9 @@ class Stats(Checker):
         return {
             **summarize(completions),
             "by-f": by_f_stats,
-            "valid?": merge_valid([s["valid?"] for s in by_f_stats.values()] or [True]),
+            "valid?": merge_valid(
+                [s["valid?"] for f, s in by_f_stats.items()
+                 if f not in self.ungated_fs] or [True]),
         }
 
 
@@ -651,8 +662,8 @@ def noop() -> Checker:
     return Noop()
 
 
-def stats() -> Checker:
-    return Stats()
+def stats(ungated_fs=()) -> Checker:
+    return Stats(ungated_fs)
 
 
 def unhandled_exceptions() -> Checker:

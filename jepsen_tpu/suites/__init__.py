@@ -52,7 +52,7 @@ def compose_test(base: dict, workload: dict, nemesis_pkg: dict | None = None,
                          else gen.phases(*phase_list))
 
     checkers = {
-        "stats": chk.stats(),
+        "stats": chk.stats(ungated_fs=workload.get("stats_ungated_fs", ())),
         "exceptions": chk.unhandled_exceptions(),
         "workload": workload["checker"],
     }

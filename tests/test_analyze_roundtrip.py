@@ -51,6 +51,11 @@ CASES = [
     (rethinkdb.rethinkdb_test, {"workload": "counter"}, False),
     (rabbitmq.rabbitmq_test, {"workload": "queue"}, False),
     (faunadb.faunadb_test, {"workload": "pages"}, False),
+    # round-4 additions: the crate visibility probe (strong-read sets)
+    # and the per-key-table Elle variant
+    (crate.crate_test, {"workload": "dirty-read",
+                        "dirty_read_quiesce": 0.2}, False),
+    (yugabyte.yugabyte_test, {"workload": "append-table"}, False),
 ]
 
 
