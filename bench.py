@@ -330,35 +330,42 @@ def cfg_elle_50k():
     # (the valid tail alone never reaches it: no back edges, no clusters)
     warm = _elle_history(2_000, crossed_pairs=50)
     list_append.check(warm, accelerator="tpu")
-    # 5 trials: the elle check is host-numpy-bound and this shared VM's
-    # ambient noise swung 3-trial medians by 40%+ between clean runs
+    # 5 trials: the build is host-bound (C parser + numpy tail) and this
+    # shared VM's ambient noise swung 3-trial medians by 40%+ between
+    # clean runs. Per-trial phase split on BOTH regimes (r4 weak #1: the
+    # clean-path regression was unattributable without it) — build is
+    # the host-side history parse, cycles is the device screen + search.
+    from jepsen_tpu.elle import columnar
+    from jepsen_tpu.native import columnar_c
+
+    def phased(h, phases):
+        def run():
+            out = list_append.check(h, accelerator="tpu")
+            phases.append(dict(columnar.LAST_PHASE_SECONDS))
+            return out
+        return run
+
     r_cpu, t_cpu = _trials(
         lambda: list_append.check(history, accelerator="cpu"), 5)
-    r_dev, t_dev = _trials(
-        lambda: list_append.check(history, accelerator="tpu"), 5)
+    clean_phases: list[dict] = []
+    r_dev, t_dev = _trials(phased(history, clean_phases), 5)
     assert r_dev["valid?"] is True and r_cpu["valid?"] is True
     med, extras = _spread(t_dev, n_txns)
     cpu_med, _ = _spread(t_cpu, n_txns)
     emit("elle_50k_txns_per_sec", n_txns / med, "txns/s",
          cpu_med / med, cpu_txns_per_sec=round(n_txns / cpu_med, 2),
+         trial_seconds=[round(t, 2) for t in t_dev],
+         phase_build_s=[p.get("build") for p in clean_phases],
+         phase_cycles_s=[p.get("cycles") for p in clean_phases],
+         c_parser=columnar_c.available(),
          **extras)
 
     bad = _elle_history(n_txns, crossed_pairs=50)
     n_bad = n_txns + 100
     r_cpu, t_cpu = _trials(
         lambda: list_append.check(bad, accelerator="cpu"), 5)
-    # per-trial phase split (r3 weak #2: the 2x trial spread needs a
-    # cause on record — build is host numpy, cycles is the device screen
-    # + search, so the split names the noisy side)
-    from jepsen_tpu.elle import columnar
     phases: list[dict] = []
-
-    def dev_check():
-        out = list_append.check(bad, accelerator="tpu")
-        phases.append(dict(columnar.LAST_PHASE_SECONDS))
-        return out
-
-    r_dev, t_dev = _trials(dev_check, 5)
+    r_dev, t_dev = _trials(phased(bad, phases), 5)
     assert r_dev["valid?"] is False and r_cpu["valid?"] is False
     assert "G1c" in r_dev["anomaly-types"], r_dev.get("anomaly-types")
     med, extras = _spread(t_dev, n_bad)

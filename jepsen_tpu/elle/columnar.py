@@ -6,18 +6,15 @@ elle library) walks per-txn micro-ops with JVM map operations; at 50k+
 txns the equivalent Python walk dominates the whole check. This module
 derives the same dependency graph with a few C-speed passes instead:
 
-* list comprehensions + one vectorized "previous event of the same
-  process" join pair invocations with completions (the pending-dict
-  semantics of elle.add_timing_edges, closed form),
-* one Python pass flattens micro-ops into append/read columns,
-* prefix verification of every read is a plain list comparison against
-  the key's longest read (its "spine") — CPython compares int lists at
-  C speed, so no elementwise numpy conversion of payloads is needed,
+* history parsing — event pairing, micro-op flattening, key interning,
+  spine selection, prefix verification — runs in a native C extension
+  (`native/columnar_ext.c`, built on demand) when available, else in
+  the vectorized numpy front below,
 * writer maps, element-level scans (aborted reads, unobserved writers,
   intermediate reads), the internal (own-writes) check, ww/wr/rw edge
   derivation and the realtime/process timing edges are array joins over
   the ~n_appends spine/last-element columns: sorts, searchsorted,
-  gathers.
+  gathers (the shared tail, identical for both fronts).
 
 The key economy: a read that verifies as a clean prefix of its key's
 spine contains only spine elements, so element-level scans run over the
@@ -51,8 +48,14 @@ _MAX_VAL = 1 << 32
 
 # phase timings of the most recent check_columnar call (seconds); a
 # diagnosis surface for benchmark trial spread — build is host-side
-# numpy, cycles is the (possibly device) screen + search
+# C/numpy, cycles is the (possibly device) screen + search
 LAST_PHASE_SECONDS: dict = {}
+
+
+def _cmod():
+    """The native C parser module, or None (pure-Python fallback)."""
+    from jepsen_tpu.native import columnar_c
+    return columnar_c.mod()
 
 
 def check_columnar(history: list, consistency_models, accelerator: str):
@@ -83,6 +86,53 @@ def check_columnar(history: list, consistency_models, accelerator: str):
     return result
 
 
+def _build(history: list):
+    """Dependency-graph build: C parser when available, numpy otherwise.
+    Returns (graph, txns, extras, n_keys) or None (regime miss)."""
+    m = _cmod()
+    if m is not None:
+        try:
+            out = m.parse(history)
+        except Exception:  # noqa: BLE001 - never fail the check over C
+            out = None
+        if out is not None:
+            return _build_from_c(out)
+    return _build_py(history)
+
+
+def _build_from_c(out):
+    """Adapts the C parser's 25-tuple into the shared tail's inputs."""
+    (n_ok, nk, node_pos_b, node_inv_b, node_proc_b, txns,
+     a_txn_b, a_kid_b, a_val_b, a_mi_b,
+     r_txn_b, r_kid_b, r_mi_b, r_len_b, r_last_b,
+     payloads, raw_key, f_kid_b, f_val_b,
+     s_concat_b, s_kid_b, soff_b, slen_b, brow_b, scrutiny_l) = out
+    b = lambda x: np.frombuffer(x, np.int64)  # noqa: E731
+    R_txn = b(r_txn_b)
+    R_isok = R_txn < n_ok
+    F_comp = np.sort((b(f_kid_b) << 32) | b(f_val_b)) \
+        if len(f_val_b) else np.asarray([], np.int64)
+    brow = b(brow_b)
+
+    def spine_of(k):
+        r = int(brow[k])
+        return payloads[r] if r >= 0 else None
+
+    return _tail(
+        txns=txns, n=len(txns), n_ok=n_ok, nk=nk, raw_key=raw_key,
+        A_txn=b(a_txn_b), A_kid=b(a_kid_b), A_val=b(a_val_b),
+        A_mi=b(a_mi_b), F_comp=F_comp,
+        R_txn=R_txn, R_kid=b(r_kid_b), R_mi=b(r_mi_b),
+        lens=b(r_len_b), last_arr=b(r_last_b), R_isok=R_isok,
+        payloads=payloads,
+        S_concat=b(s_concat_b), s_kid=b(s_kid_b),
+        soff_of_kid=b(soff_b), slen_of_kid=b(slen_b),
+        spine_of=spine_of,
+        scrutiny=set(scrutiny_l), rows_by_kid=None,
+        node_pos=b(node_pos_b), node_inv=b(node_inv_b),
+        node_proc=b(node_proc_b))
+
+
 def _flatten_mops_fast(txns):
     """Vectorized pass B for the all-int regime (every mop key a plain
     int, every append value a plain int): C-speed comprehensions +
@@ -94,6 +144,11 @@ def _flatten_mops_fast(txns):
     Differentially pinned to the loop by the columnar-vs-python fuzz in
     tests/test_elle.py."""
     vals = [op.get("value") or () for op in txns]
+    # only sized, re-iterable containers take the fast path — a one-shot
+    # or unsized iterable (no len, or consumed by the flatten) must flow
+    # to the general loop, which iterates each value exactly once
+    if any(type(v) not in (list, tuple) for v in vals):
+        return None
     counts = np.fromiter((len(v) for v in vals), np.int64, len(vals))
     total = int(counts.sum())
     if counts.size and int(counts.max()) > _MAX_MOPS:
@@ -102,8 +157,10 @@ def _flatten_mops_fast(txns):
     if not mops:
         return None
     try:
-        fs, keys, third = zip(*((m[0], m[1], m[2]) for m in mops))
-    except (ValueError, IndexError):
+        fs = [m[0] for m in mops]
+        keys = [m[1] for m in mops]
+        third = [m[2] for m in mops]
+    except (ValueError, IndexError, TypeError):
         return None
     if any(type(k) is not int or k < -_I64 or k >= _I64 for k in keys):
         return None  # exotic/huge keys: the general loop interns anything
@@ -147,44 +204,48 @@ def _flatten_mops_fast(txns):
             payloads, raw_key, kid_of)
 
 
-def _build(history: list):
+def _build_py(history: list):
     # ---- pass A: event extraction + invocation pairing -----------------
     # Closed form of the pending-dict walk: a completion's invocation is
     # the previous event of the same process iff that event is an invoke
     # (a newer invoke overwrites, a completion consumes — both exactly
     # the "previous event" rule). Verified equivalent by differential
     # test against the dict semantics.
+    nh = len(history)
     types = [op.get("type") for op in history]
+    procs = [op.get("process") for op in history]
     _EV = {"invoke": 0, "ok": 1, "fail": 1, "info": 1}
     ev = [_EV.get(t, -1) for t in types]
     pid_of: dict = {}
-    pid = [pid_of.setdefault(op.get("process"), len(pid_of))
-           for op in history]
+    pid = [pid_of.setdefault(p, len(pid_of)) for p in procs]
     ev_a = np.asarray(ev, np.int64)
     pid_a = np.asarray(pid, np.int64)
     sel = np.nonzero(ev_a >= 0)[0]
     o = sel[np.argsort(pid_a[sel], kind="stable")]
     link = ((pid_a[o][1:] == pid_a[o][:-1]) & (ev_a[o][:-1] == 0)
             & (ev_a[o][1:] == 1)) if o.size > 1 else np.zeros(0, bool)
-    inv_pos_of = np.full(len(history), -1, np.int64)
+    inv_pos_of = np.full(nh, -1, np.int64)
     if o.size > 1:
         inv_pos_of[o[1:][link]] = o[:-1][link]
 
-    oks = [(op, int(inv_pos_of[i]), i)
-           for i, op in enumerate(history)
-           if types[i] == "ok" and isinstance(op.get("process"), int)]
-    infos = [(op, int(inv_pos_of[i]), i)
-             for i, op in enumerate(history)
-             if types[i] == "info" and isinstance(op.get("process"), int)]
-    fail_ops = [op for i, op in enumerate(history) if types[i] == "fail"]
+    # mask-select ok/info/fail positions at C speed (the per-event
+    # conditional comprehensions dominated the whole build at 50k txns)
+    pint = np.fromiter((isinstance(p, int) for p in procs), bool, nh)
+    ok_m = np.fromiter((t == "ok" for t in types), bool, nh)
+    info_m = np.fromiter((t == "info" for t in types), bool, nh)
+    fail_m = np.fromiter((t == "fail" for t in types), bool, nh)
+    ok_pos = np.nonzero(ok_m & pint)[0]
+    info_pos = np.nonzero(info_m & pint)[0]
+    fail_ops = [history[i] for i in np.nonzero(fail_m)[0].tolist()]
 
-    n_ok = len(oks)
-    txns = [rec[0] for rec in oks] + [rec[0] for rec in infos]
+    n_ok = int(ok_pos.size)
+    node_pos = np.concatenate([ok_pos, info_pos])
+    txns = [history[i] for i in node_pos.tolist()]
     n = len(txns)
     if n == 0 or n >= (1 << 31):
         return None
-
-    extras: dict[str, list] = defaultdict(list)
+    node_inv = inv_pos_of[node_pos]
+    node_proc = np.asarray([procs[i] for i in node_pos.tolist()], np.int64)
 
     # ---- pass B: flatten micro-ops into columns ------------------------
     def kid(k):
@@ -270,30 +331,6 @@ def _build(history: list):
         return None
     last_arr = last_arr.astype(np.int64, copy=False)
 
-    # ---- writer map: first append of (key, value) wins -----------------
-    A_comp = (A_kid << 32) | A_val
-    a_order = np.argsort(A_comp, kind="stable")
-    ac_sorted = A_comp[a_order]
-    first = np.r_[True, ac_sorted[1:] != ac_sorted[:-1]] \
-        if ac_sorted.size else np.zeros(0, bool)
-    for j in a_order[~first].tolist():
-        extras["duplicate-appends"].append(
-            {"key": raw_key[int(A_kid[j])], "value": int(A_val[j])})
-    W_comp = ac_sorted[first]
-    W_txn = A_txn[a_order][first]
-
-    def writer_lookup(comps):
-        if W_comp.size == 0:
-            return np.full(comps.shape, -1, np.int64)
-        pos = np.clip(np.searchsorted(W_comp, comps), 0, W_comp.size - 1)
-        return np.where(W_comp[pos] == comps, W_txn[pos], -1)
-
-    def failed_lookup(comps):
-        if F_comp.size == 0:
-            return np.zeros(comps.shape, bool)
-        pos = np.clip(np.searchsorted(F_comp, comps), 0, F_comp.size - 1)
-        return F_comp[pos] == comps
-
     # ---- spines: longest ok read per key -------------------------------
     okr = np.nonzero(R_isok)[0]
     soff_of_kid = np.full(nk, -1, np.int64)
@@ -333,8 +370,9 @@ def _build(history: list):
     # ---- prefix verification: C-speed list compares --------------------
     rows_by_kid: dict = defaultdict(list)
     scrutiny: set = set()
-    r_kid_l = r_kid  # python list view, avoids 50k np scalar boxing
-    for j in np.nonzero(R_isok)[0].tolist():
+    r_kid_l = r_kid if type(r_kid) is list else \
+        R_kid.tolist()  # python list view, avoids 50k np scalar boxing
+    for j in okr.tolist():
         k = r_kid_l[j]
         rows_by_kid[k].append(j)
         p = payloads[j]
@@ -344,15 +382,72 @@ def _build(history: list):
         if p != sp[: len(p)]:
             scrutiny.add(j)
 
+    return _tail(
+        txns=txns, n=n, n_ok=n_ok, nk=nk, raw_key=raw_key,
+        A_txn=A_txn, A_kid=A_kid, A_val=A_val, A_mi=A_mi, F_comp=F_comp,
+        R_txn=R_txn, R_kid=R_kid, R_mi=R_mi, lens=lens,
+        last_arr=last_arr, R_isok=R_isok, payloads=payloads,
+        S_concat=S_concat, s_kid=s_kid, soff_of_kid=soff_of_kid,
+        slen_of_kid=slen_of_kid, spine_of=spine_list_of_kid.__getitem__,
+        scrutiny=scrutiny, rows_by_kid=rows_by_kid,
+        node_pos=node_pos, node_inv=node_inv, node_proc=node_proc)
+
+
+def _tail(*, txns, n, n_ok, nk, raw_key,
+          A_txn, A_kid, A_val, A_mi, F_comp,
+          R_txn, R_kid, R_mi, lens, last_arr, R_isok, payloads,
+          S_concat, s_kid, soff_of_kid, slen_of_kid, spine_of,
+          scrutiny, rows_by_kid, node_pos, node_inv, node_proc):
+    """Shared analysis tail over the columnar product (either front):
+    writer maps, anomaly scans, edge derivation, timing edges."""
+    extras: dict[str, list] = defaultdict(list)
+    n_reads = len(payloads)
+
+    # lazy rows_by_kid: the C front doesn't build it (only anomaly
+    # attribution needs it, which clean histories never reach)
+    _rbk = [rows_by_kid]
+
+    def get_rows_by_kid():
+        if _rbk[0] is None:
+            d: dict = defaultdict(list)
+            okr = np.nonzero(R_isok)[0]
+            for j, k in zip(okr.tolist(), R_kid[okr].tolist()):
+                d[k].append(j)
+            _rbk[0] = d
+        return _rbk[0]
+
+    # ---- writer map: first append of (key, value) wins -----------------
+    A_comp = (A_kid << 32) | A_val
+    a_order = np.argsort(A_comp, kind="stable")
+    ac_sorted = A_comp[a_order]
+    first = np.r_[True, ac_sorted[1:] != ac_sorted[:-1]] \
+        if ac_sorted.size else np.zeros(0, bool)
+    for j in a_order[~first].tolist():
+        extras["duplicate-appends"].append(
+            {"key": raw_key[int(A_kid[j])], "value": int(A_val[j])})
+    W_comp = ac_sorted[first]
+    W_txn = A_txn[a_order][first]
+
+    def writer_lookup(comps):
+        if W_comp.size == 0:
+            return np.full(comps.shape, -1, np.int64)
+        pos = np.clip(np.searchsorted(W_comp, comps), 0, W_comp.size - 1)
+        return np.where(W_comp[pos] == comps, W_txn[pos], -1)
+
+    def failed_lookup(comps):
+        if F_comp.size == 0:
+            return np.zeros(comps.shape, bool)
+        pos = np.clip(np.searchsorted(F_comp, comps), 0, F_comp.size - 1)
+        return F_comp[pos] == comps
+
     # keys whose spine repeats a value need per-row duplicate scrutiny
-    dup_kids: set = set()
     if S_concat.size:
         comp_spine = (s_kid << 32) | S_concat
         sc = np.sort(comp_spine)
         dup_kids = set((sc[1:][sc[1:] == sc[:-1]] >> 32).tolist())
         if dup_kids:
             for k in dup_kids:
-                scrutiny.update(rows_by_kid.get(int(k), ()))
+                scrutiny.update(get_rows_by_kid().get(int(k), ()))
     else:
         comp_spine = np.zeros(0, np.int64)
 
@@ -423,7 +518,7 @@ def _build(history: list):
         m = lazy_maps()
         r = payloads[j]
         k = int(R_kid[j])
-        sp = spine_list_of_kid[k] or []
+        sp = spine_of(k) or []
         if r != sp[: len(r)]:
             extras["incompatible-order"].append(
                 {"key": raw_key[k], "read": list(r), "longest": list(sp)})
@@ -446,7 +541,7 @@ def _build(history: list):
 
     # clean rows: element-level anomalies can only involve spine elements
     def clean_rows_of(k, q):
-        return [j for j in rows_by_kid.get(k, ())
+        return [j for j in get_rows_by_kid().get(k, ())
                 if j not in scrutiny and lens[j] > q]
 
     for k, q, e in spine_elem_hits(f_hit_spine):
@@ -534,13 +629,6 @@ def _build(history: list):
             add_edges(_TYPE_CODE[RW], R_txn[nz][keep], w[keep])
 
     # ---- timing edges (vectorized add_timing_edges twin) ---------------
-    node_inv = np.asarray([rec[1] for rec in oks] + [rec[1] for rec in infos],
-                          np.int64)
-    node_pos = np.asarray([rec[2] for rec in oks] + [rec[2] for rec in infos],
-                          np.int64)
-    node_proc = np.asarray(
-        [rec[0].get("process") for rec in oks]
-        + [rec[0].get("process") for rec in infos], np.int64)
     order = np.where(node_inv >= 0, node_inv, node_pos)
 
     sequential_ok = True

@@ -111,6 +111,195 @@ class Mutex(Model):
         return inconsistent(f"unknown op f={f!r}")
 
 
+_INVALID_FENCE = 0
+
+
+def _op_fence(op) -> int:
+    """Fence token from an acquire completion (hazelcast.clj get-fence
+    :564-566): ok acquires carry the fence as the op value; anything
+    else (pending/indeterminate acquires, releases) is the invalid
+    fence 0."""
+    v = op.get("value")
+    if isinstance(v, dict):
+        v = v.get("fence")
+    return v if isinstance(v, int) and not isinstance(v, bool) \
+        else _INVALID_FENCE
+
+
+def _op_client(op):
+    """Lock-owner identity. The reference maps invocation uids to client
+    names through a side map (hazelcast.clj:514-516) because its JVM
+    clients multiplex threads; here each logical process IS one client
+    session, so the process id is the owner."""
+    v = op.get("value")
+    if isinstance(v, dict) and v.get("client") is not None:
+        return v.get("client")
+    return op.get("process")
+
+
+@dataclass(frozen=True)
+class OwnerMutex(Model):
+    """Owner-aware non-reentrant mutex (hazelcast.clj OwnerAwareMutex
+    :539-555): acquire only when free, release only by the holder."""
+
+    owner: Any = None
+
+    def step(self, op):
+        f, c = op.get("f"), _op_client(op)
+        if c is None:
+            return inconsistent("no owner!")
+        if f == "acquire":
+            if self.owner is None:
+                return OwnerMutex(c)
+            return inconsistent(f"{c!r} can't acquire: {self.owner!r} holds")
+        if f == "release":
+            if self.owner is None or self.owner != c:
+                return inconsistent(f"{c!r} can't release: not holder")
+            return OwnerMutex(None)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class ReentrantMutex(Model):
+    """Reentrant mutex with a bounded hold count (hazelcast.clj
+    ReentrantMutex :516-533, reentrant-lock-acquire-count=2): the holder
+    may re-acquire up to ``max_holds`` times; releases peel one hold."""
+
+    owner: Any = None
+    holds: int = 0
+    max_holds: int = 2
+
+    def step(self, op):
+        f, c = op.get("f"), _op_client(op)
+        if c is None:
+            return inconsistent("no owner!")
+        if f == "acquire":
+            if self.holds < self.max_holds and \
+                    (self.owner is None or self.owner == c):
+                return ReentrantMutex(c, self.holds + 1, self.max_holds)
+            return inconsistent(f"{c!r} can't acquire {self!r}")
+        if f == "release":
+            if self.owner is None or self.owner != c:
+                return inconsistent(f"{c!r} can't release {self!r}")
+            return ReentrantMutex(None if self.holds == 1 else self.owner,
+                                  self.holds - 1, self.max_holds)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class FencedMutex(Model):
+    """Non-reentrant mutex checking fencing-token monotonicity
+    (hazelcast.clj FencedMutex :569-589): an acquire may carry an
+    unknown fence (0, e.g. a crashed acquire linearized late) or a
+    fence strictly greater than every fence seen so far."""
+
+    owner: Any = None
+    fence: int = _INVALID_FENCE
+
+    def step(self, op):
+        f, c = op.get("f"), _op_client(op)
+        if c is None:
+            return inconsistent("no owner!")
+        if f == "acquire":
+            fence = _op_fence(op)
+            if self.owner is not None:
+                return inconsistent(f"{c!r} can't acquire {self!r}")
+            if fence == _INVALID_FENCE:
+                return FencedMutex(c, self.fence)
+            if fence > self.fence:
+                return FencedMutex(c, fence)
+            return inconsistent(f"fence {fence} not above {self.fence}")
+        if f == "release":
+            if self.owner is None or self.owner != c:
+                return inconsistent(f"{c!r} can't release {self!r}")
+            return FencedMutex(None, self.fence)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class ReentrantFencedMutex(Model):
+    """Reentrant fenced mutex (hazelcast.clj ReentrantFencedMutex
+    :597-625): bounded re-acquire, with fences monotone across lock
+    ownership and constant within one held incarnation (re-acquiring
+    while holding returns the same fence or none)."""
+
+    owner: Any = None
+    holds: int = 0
+    fence: int = _INVALID_FENCE       # fence of the current incarnation
+    highest: int = _INVALID_FENCE     # highest fence ever observed
+    max_holds: int = 2
+
+    def _with(self, **kw):
+        d = dict(owner=self.owner, holds=self.holds, fence=self.fence,
+                 highest=self.highest, max_holds=self.max_holds)
+        d.update(kw)
+        return ReentrantFencedMutex(**d)
+
+    def step(self, op):
+        f, c = op.get("f"), _op_client(op)
+        if c is None:
+            return inconsistent("no owner!")
+        if f == "acquire":
+            fence = _op_fence(op)
+            fresh = fence == _INVALID_FENCE or fence > self.highest
+            if self.owner is None:
+                if fresh:
+                    return self._with(owner=c, holds=1, fence=fence,
+                                      highest=max(fence, self.highest))
+                return inconsistent(f"fence {fence} ≤ {self.highest}")
+            if self.owner != c or self.holds == self.max_holds:
+                return inconsistent(f"{c!r} can't acquire {self!r}")
+            if self.fence == _INVALID_FENCE:
+                # held without a known fence: a re-acquire may reveal it
+                if fresh:
+                    return self._with(holds=self.holds + 1, fence=fence,
+                                      highest=max(fence, self.highest))
+                return inconsistent(f"fence {fence} ≤ {self.highest}")
+            if fence == _INVALID_FENCE or fence == self.fence:
+                return self._with(holds=self.holds + 1)
+            return inconsistent(
+                f"re-acquire fence {fence} ≠ held {self.fence}")
+        if f == "release":
+            if self.owner is None or self.owner != c:
+                return inconsistent(f"{c!r} can't release {self!r}")
+            if self.holds == 1:
+                return self._with(owner=None, holds=0,
+                                  fence=_INVALID_FENCE)
+            return self._with(holds=self.holds - 1)
+        return inconsistent(f"unknown op f={f!r}")
+
+
+@dataclass(frozen=True)
+class AcquiredPermits(Model):
+    """Counting-semaphore permit model (hazelcast.clj
+    AcquiredPermitsModel :631-650, num-permits=2): at most ``permits``
+    acquired across clients; a client releases only what it holds."""
+
+    acquired: tuple = ()   # sorted ((client, count>0), ...)
+    permits: int = 2
+
+    def step(self, op):
+        f, c = op.get("f"), _op_client(op)
+        if c is None:
+            return inconsistent("no owner!")
+        held = dict(self.acquired)
+        if f == "acquire":
+            if sum(held.values()) < self.permits:
+                held[c] = held.get(c, 0) + 1
+                return AcquiredPermits(tuple(sorted(held.items())),
+                                       self.permits)
+            return inconsistent(f"{c!r} can't acquire: no permits free")
+        if f == "release":
+            if held.get(c, 0) > 0:
+                held[c] -= 1
+                if not held[c]:
+                    del held[c]
+                return AcquiredPermits(tuple(sorted(held.items())),
+                                       self.permits)
+            return inconsistent(f"{c!r} releases nothing held")
+        return inconsistent(f"unknown op f={f!r}")
+
+
 @dataclass(frozen=True)
 class FIFOQueue(Model):
     """A FIFO queue: enqueue/dequeue (knossos.model/fifo-queue)."""

@@ -43,7 +43,9 @@ def build(force: bool = False) -> Path:
     if so.exists() and not force:
         return so
     so.parent.mkdir(parents=True, exist_ok=True)
-    tmp = so.with_suffix(".so.tmp")
+    # per-process tmp name: concurrent builders must not interleave g++
+    # output before the atomic publish
+    tmp = so.with_suffix(f".so.tmp{os.getpid()}")
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
            "-o", str(tmp), str(_SRC)]
     try:

@@ -1,0 +1,86 @@
+"""Build + load the C columnar-history parser as an extension module.
+
+Same on-demand g++ pattern as the WGL library (`native/__init__.py`),
+but this one needs the CPython C API (it walks PyObject histories), so
+it is loaded as a real extension module via importlib rather than
+ctypes. Unavailable toolchain degrades silently: callers get ``None``
+and use the pure-Python/numpy path.
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib.machinery
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from pathlib import Path
+
+logger = logging.getLogger("jepsen.native")
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "columnar_ext.c"
+_lock = threading.Lock()
+_mod = None
+_mod_failed = False
+
+
+def _build_dir() -> Path:
+    d = os.environ.get("JEPSEN_NATIVE_BUILD_DIR")
+    return Path(d) if d else _HERE
+
+
+def _so_path() -> Path:
+    src_hash = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    return _build_dir() / f"_columnar_c-{src_hash}.so"
+
+
+def build(force: bool = False) -> Path:
+    so = _so_path()
+    if so.exists() and not force:
+        return so
+    so.parent.mkdir(parents=True, exist_ok=True)
+    # per-process tmp name: concurrent builders (pytest workers, parallel
+    # sessions) must not interleave g++ output before the atomic publish
+    tmp = so.with_suffix(f".so.tmp{os.getpid()}")
+    inc = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           f"-I{inc}", "-o", str(tmp), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError:
+        cmd = [c for c in cmd if c != "-march=native"]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, so)
+    logger.info("built %s", so)
+    return so
+
+
+def mod():
+    """The extension module, or None when unbuildable."""
+    global _mod, _mod_failed
+    if _mod is not None or _mod_failed:
+        return _mod
+    with _lock:
+        if _mod is not None or _mod_failed:
+            return _mod
+        try:
+            so = build()
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_columnar_c", str(so))
+            spec = importlib.util.spec_from_file_location(
+                "_columnar_c", str(so), loader=loader)
+            m = importlib.util.module_from_spec(spec)
+            loader.exec_module(m)
+            _mod = m
+        except Exception:  # noqa: BLE001
+            logger.warning("native columnar parser unavailable; "
+                           "using Python builder", exc_info=True)
+            _mod_failed = True
+    return _mod
+
+
+def available() -> bool:
+    return mod() is not None

@@ -1,0 +1,458 @@
+"""Minimal Hazelcast Open Binary Client Protocol (2.x) client for the
+hazelcast suite's CP-subsystem workloads (reference:
+hazelcast/src/jepsen/hazelcast.clj rides the official Java client; this
+is the from-scratch equivalent for the CP AtomicLong / FencedLock /
+Semaphore clients, the same playbook as the CQL/RESP/AMQP/MySQL/PG wire
+clients in this package).
+
+Protocol shape (Hazelcast 4/5, the ``CP2`` handshake):
+
+- After connect the client sends the 3-byte protocol id ``CP2``; all
+  further traffic is **client messages** — sequences of frames, each
+  ``length(le u32) | flags(le u16) | payload``, where length counts the
+  6-byte header. The first frame of a message starts with message type
+  (le u32) and correlation id (le u64); requests add a partition id
+  (le u32, -1 for CP ops). Response initial frames carry one
+  backup-acks byte after the correlation id.
+- Fixed-size request parameters pack into the initial frame in
+  declaration order; variable-size parameters (strings, custom types)
+  follow as their own frames. Custom types (RaftGroupId here) nest
+  between BEGIN/END data-structure frames with their fixed fields in a
+  leading frame.
+- CP data structures address a **Raft group** (RaftGroupId =
+  {name, seed, id}) obtained from ``CPGroup.createCPGroup``; FencedLock
+  and Semaphore ops additionally carry a CP **session**
+  (``CPSession.createSession``, kept alive by heartbeats), a thread id
+  (``CPSession.generateThreadId``) and a per-invocation UUID for
+  exactly-once retry semantics.
+
+Message type ids follow the public hazelcast-client-protocol 2.x
+protocol definitions (module id in the high byte pair, method in the
+middle): Client=0x00, FencedLock=0x07, AtomicLong=0x09, Semaphore=0x0C,
+CPGroup=0x1E, CPSession=0x1F. They are centralised in :data:`MSG` so a
+deployment against a server revision that renumbers a module is a
+one-line audit. The mock-server wire tests
+(tests/test_hazelcast_wire.py) speak the same table from the server
+side and pin the codec layouts; the realdb-gated test exercises a real
+member when one is installed.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import struct
+import threading
+import time
+
+from jepsen_tpu.suites._wire import close_quietly, recv_exact
+
+PROTOCOL_ID = b"CP2"
+
+# frame flags
+BEGIN_FRAGMENT = 1 << 15
+END_FRAGMENT = 1 << 14
+UNFRAGMENTED = BEGIN_FRAGMENT | END_FRAGMENT
+IS_FINAL = 1 << 13
+BEGIN_DATA = 1 << 12
+END_DATA = 1 << 11
+IS_NULL = 1 << 10
+IS_EVENT = 1 << 9
+
+SIZE_OF_FRAME_HEADER = 6
+REQUEST_HEADER = 16   # type(4) correlation(8) partition(4)
+RESPONSE_HEADER = 13  # type(4) correlation(8) backup-acks(1)
+
+EXCEPTION_MSG_TYPE = 0
+INVALID_FENCE = 0
+
+MSG = {
+    "client.authentication": 0x000100,
+    "cpgroup.createcpgroup": 0x1E0100,
+    "cpsession.createsession": 0x1F0100,
+    "cpsession.closesession": 0x1F0200,
+    "cpsession.heartbeatsession": 0x1F0300,
+    "cpsession.generatethreadid": 0x1F0400,
+    "atomiclong.addandget": 0x090300,
+    "atomiclong.compareandset": 0x090400,
+    "atomiclong.get": 0x090500,
+    "atomiclong.getandset": 0x090700,
+    "fencedlock.lock": 0x070100,
+    "fencedlock.trylock": 0x070200,
+    "fencedlock.unlock": 0x070300,
+    "semaphore.init": 0x0C0100,
+    "semaphore.acquire": 0x0C0200,
+    "semaphore.release": 0x0C0300,
+}
+
+
+class HzError(Exception):
+    """Server-side error response (ErrorCodec). ``code`` is the first
+    error holder's numeric code, ``class_name`` its Java class."""
+
+    def __init__(self, code: int, class_name: str, message: str):
+        super().__init__(f"{class_name}({code}): {message}")
+        self.code = code
+        self.class_name = class_name
+        self.message = message
+
+
+class Frame:
+    __slots__ = ("flags", "payload")
+
+    def __init__(self, payload: bytes, flags: int = 0):
+        self.flags = flags
+        self.payload = payload
+
+    def is_null(self) -> bool:
+        return bool(self.flags & IS_NULL)
+
+    def is_begin(self) -> bool:
+        return bool(self.flags & BEGIN_DATA)
+
+    def is_end(self) -> bool:
+        return bool(self.flags & END_DATA)
+
+
+NULL_FRAME = Frame(b"", IS_NULL)
+BEGIN_FRAME = Frame(b"", BEGIN_DATA)
+END_FRAME = Frame(b"", END_DATA)
+
+
+def encode_message(frames: list[Frame]) -> bytes:
+    """Serializes frames; first gets UNFRAGMENTED, last gets IS_FINAL."""
+    out = bytearray()
+    last = len(frames) - 1
+    for i, f in enumerate(frames):
+        flags = f.flags
+        if i == 0:
+            flags |= UNFRAGMENTED
+        if i == last:
+            flags |= IS_FINAL
+        out += struct.pack("<IH", len(f.payload) + SIZE_OF_FRAME_HEADER,
+                           flags)
+        out += f.payload
+    return bytes(out)
+
+
+def read_message(sock: socket.socket) -> list[Frame]:
+    """Reads frames until one carries IS_FINAL."""
+    frames = []
+    while True:
+        size, flags = struct.unpack("<IH",
+                                    recv_exact(sock, SIZE_OF_FRAME_HEADER))
+        payload = recv_exact(sock, size - SIZE_OF_FRAME_HEADER)
+        frames.append(Frame(payload, flags))
+        if flags & IS_FINAL:
+            return frames
+
+
+# -- codec primitives -------------------------------------------------------
+
+def str_frame(s: str) -> Frame:
+    return Frame(s.encode("utf-8"))
+
+
+def nullable_str_frame(s: str | None) -> Frame:
+    return NULL_FRAME if s is None else str_frame(s)
+
+
+def encode_uuid(u: bytes | None) -> bytes:
+    """17-byte nullable UUID: is-null bool + 16 raw bytes."""
+    if u is None:
+        return b"\x01" + b"\x00" * 16
+    assert len(u) == 16
+    return b"\x00" + u
+
+
+def random_uuid() -> bytes:
+    return os.urandom(16)
+
+
+def raft_group_frames(group: "RaftGroupId") -> list[Frame]:
+    """RaftGroupId custom codec: BEGIN, fixed [seed(8) id(8)], name,
+    END."""
+    return [BEGIN_FRAME,
+            Frame(struct.pack("<qq", group.seed, group.group_id)),
+            str_frame(group.name),
+            END_FRAME]
+
+
+class RaftGroupId:
+    __slots__ = ("name", "seed", "group_id")
+
+    def __init__(self, name: str, seed: int, group_id: int):
+        self.name = name
+        self.seed = seed
+        self.group_id = group_id
+
+    def __repr__(self):
+        return f"RaftGroupId({self.name!r}, {self.seed}, {self.group_id})"
+
+
+def decode_raft_group(frames: list[Frame], i: int) -> tuple[RaftGroupId, int]:
+    """Decodes the custom type starting at frames[i] (a BEGIN frame);
+    returns (group, next index). Skips unknown trailing fields until the
+    matching END frame (forward-compatible decode)."""
+    assert frames[i].is_begin(), "RaftGroupId must start with BEGIN"
+    seed, gid = struct.unpack_from("<qq", frames[i + 1].payload, 0)
+    name = frames[i + 2].payload.decode("utf-8")
+    depth, j = 1, i + 3
+    while depth > 0:
+        if frames[j].is_begin():
+            depth += 1
+        elif frames[j].is_end():
+            depth -= 1
+        j += 1
+    return RaftGroupId(name, seed, gid), j
+
+
+def decode_error(frames: list[Frame]) -> HzError:
+    """ErrorCodec response: a list-of-ErrorHolder data structure; each
+    holder = BEGIN, fixed [errorCode(4)], className str, message
+    nullable str, stack-trace list, END. Only the first holder's
+    essentials are surfaced."""
+    try:
+        # frames[0] initial; frames[1] list BEGIN; frames[2] holder
+        # BEGIN; frames[3] holder initial [errorCode]; then var fields
+        code = struct.unpack_from("<i", frames[3].payload, 0)[0]
+        class_name = frames[4].payload.decode("utf-8", "replace")
+        msg_f = frames[5]
+        message = "" if msg_f.is_null() else \
+            msg_f.payload.decode("utf-8", "replace")
+        return HzError(code, class_name, message)
+    except (IndexError, struct.error):
+        return HzError(-1, "unknown", "undecodable error response")
+
+
+# -- the client -------------------------------------------------------------
+
+class HzClient:
+    """One TCP connection to a member, authenticated, single in-flight
+    invocation (the suite runs one client per logical process, matching
+    the generator's thread model — no multiplexing needed)."""
+
+    def __init__(self, host: str, port: int = 5701,
+                 cluster_name: str = "jepsen",
+                 client_name: str | None = None,
+                 timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.cluster_name = cluster_name
+        self.client_name = client_name or f"jepsen-{os.getpid()}"
+        self.timeout_s = timeout_s
+        self.sock: socket.socket | None = None
+        self._correlation = itertools.count(1)
+        self._lock = threading.Lock()
+        self._groups: dict[str, RaftGroupId] = {}
+        self._sessions: dict[tuple[str, int], tuple[int, float, float]] = {}
+        self._thread_id: int | None = None
+
+    # -- connection/auth ----------------------------------------------------
+
+    def connect(self) -> "HzClient":
+        # a (re)connect is a fresh client to the server: cached groups,
+        # CP sessions and the thread id belong to the old connection
+        self._groups.clear()
+        self._sessions.clear()
+        self._thread_id = None
+        self.sock = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.sendall(PROTOCOL_ID)
+        frames = self._invoke(
+            MSG["client.authentication"],
+            fixed=encode_uuid(random_uuid()) + b"\x01",  # uuid, ser-version
+            var=[str_frame(self.cluster_name),
+                 NULL_FRAME,                    # username
+                 NULL_FRAME,                    # password
+                 str_frame("PYT"),              # client type
+                 str_frame("5.3"),              # client hz version
+                 str_frame(self.client_name),
+                 BEGIN_FRAME, END_FRAME])       # labels: empty list
+        status = frames[0].payload[RESPONSE_HEADER]
+        if status != 0:
+            raise HzError(status, "AuthenticationException",
+                          f"status {status}")
+        return self
+
+    def close(self):
+        close_quietly(self.sock)
+        self.sock = None
+
+    # -- invocation ---------------------------------------------------------
+
+    def _invoke(self, msg_type: int, fixed: bytes = b"",
+                var: list[Frame] | None = None,
+                partition: int = -1) -> list[Frame]:
+        """Sends one request, returns the matching response's frames.
+        Events (unsolicited pushes) are skipped; an error response
+        raises HzError."""
+        if self.sock is None:
+            raise ConnectionError("not connected")
+        corr = next(self._correlation)
+        initial = Frame(struct.pack("<IqI", msg_type, corr,
+                                    partition & 0xFFFFFFFF) + fixed)
+        msg = encode_message([initial] + (var or []))
+        with self._lock:
+            self.sock.sendall(msg)
+            while True:
+                frames = read_message(self.sock)
+                if frames[0].flags & IS_EVENT:
+                    continue
+                rtype, rcorr = struct.unpack_from("<Iq",
+                                                  frames[0].payload, 0)
+                if rcorr != corr:
+                    continue  # stale response from an abandoned retry
+                if rtype == EXCEPTION_MSG_TYPE:
+                    raise decode_error(frames)
+                return frames
+
+    @staticmethod
+    def _fixed(frames: list[Frame], fmt: str):
+        vals = struct.unpack_from(fmt, frames[0].payload, RESPONSE_HEADER)
+        return vals[0] if len(vals) == 1 else vals
+
+    # -- CP plumbing --------------------------------------------------------
+
+    def cp_group(self, proxy_name: str = "default") -> RaftGroupId:
+        """Resolves (and caches) the Raft group for a CP proxy name
+        ("name@group", default group otherwise)."""
+        group_name = proxy_name.split("@", 1)[1] if "@" in proxy_name \
+            else "default"
+        g = self._groups.get(group_name)
+        if g is None:
+            frames = self._invoke(MSG["cpgroup.createcpgroup"],
+                                  var=[str_frame(group_name)])
+            g, _ = decode_raft_group(frames, 1)
+            self._groups[group_name] = g
+        return g
+
+    def thread_id(self, group: RaftGroupId) -> int:
+        if self._thread_id is None:
+            frames = self._invoke(MSG["cpsession.generatethreadid"],
+                                  var=raft_group_frames(group))
+            self._thread_id = self._fixed(frames, "<q")
+        return self._thread_id
+
+    def session_id(self, group: RaftGroupId) -> int:
+        """Current CP session for the group, creating or refreshing as
+        needed (the Java client's background heartbeater, done lazily:
+        a heartbeat rides ahead of any op once half the TTL elapsed)."""
+        key = (group.name, group.group_id)
+        now = time.monotonic()
+        entry = self._sessions.get(key)
+        if entry is not None:
+            sid, ttl_s, last = entry
+            if now - last < ttl_s / 2:
+                return sid
+            try:
+                self._invoke(MSG["cpsession.heartbeatsession"],
+                             fixed=struct.pack("<q", sid),
+                             var=raft_group_frames(group))
+                self._sessions[key] = (sid, ttl_s, now)
+                return sid
+            except HzError:
+                del self._sessions[key]  # expired: fall through, recreate
+        frames = self._invoke(MSG["cpsession.createsession"],
+                              var=raft_group_frames(group)
+                              + [str_frame(self.client_name)])
+        sid, ttl_ms, _hb = self._fixed(frames, "<qqq")
+        self._sessions[key] = (sid, max(ttl_ms / 1000.0, 1.0), now)
+        return sid
+
+    def close_session(self, group: RaftGroupId):
+        key = (group.name, group.group_id)
+        entry = self._sessions.pop(key, None)
+        if entry is not None:
+            self._invoke(MSG["cpsession.closesession"],
+                         fixed=struct.pack("<q", entry[0]),
+                         var=raft_group_frames(group))
+
+    # -- AtomicLong ---------------------------------------------------------
+
+    def atomic_add_and_get(self, name: str, delta: int) -> int:
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["atomiclong.addandget"],
+                              fixed=struct.pack("<q", delta),
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return self._fixed(frames, "<q")
+
+    def atomic_get(self, name: str) -> int:
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["atomiclong.get"],
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return self._fixed(frames, "<q")
+
+    def atomic_compare_and_set(self, name: str, expected: int,
+                               updated: int) -> bool:
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["atomiclong.compareandset"],
+                              fixed=struct.pack("<qq", expected, updated),
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return bool(self._fixed(frames, "<b"))
+
+    def atomic_get_and_set(self, name: str, value: int) -> int:
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["atomiclong.getandset"],
+                              fixed=struct.pack("<q", value),
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return self._fixed(frames, "<q")
+
+    # -- FencedLock ---------------------------------------------------------
+
+    def lock_try_lock(self, name: str, timeout_ms: int = 5000) -> int:
+        """tryLock: the fencing token, or INVALID_FENCE (0) when the
+        wait timed out."""
+        g = self.cp_group(name)
+        sid = self.session_id(g)
+        tid = self.thread_id(g)
+        frames = self._invoke(
+            MSG["fencedlock.trylock"],
+            fixed=struct.pack("<qq", sid, tid) + encode_uuid(random_uuid())
+            + struct.pack("<q", timeout_ms),
+            var=raft_group_frames(g) + [str_frame(name)])
+        return self._fixed(frames, "<q")
+
+    def lock_unlock(self, name: str) -> bool:
+        g = self.cp_group(name)
+        sid = self.session_id(g)
+        tid = self.thread_id(g)
+        frames = self._invoke(
+            MSG["fencedlock.unlock"],
+            fixed=struct.pack("<qq", sid, tid) + encode_uuid(random_uuid()),
+            var=raft_group_frames(g) + [str_frame(name)])
+        return bool(self._fixed(frames, "<b"))
+
+    # -- Semaphore ----------------------------------------------------------
+
+    def semaphore_init(self, name: str, permits: int) -> bool:
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["semaphore.init"],
+                              fixed=struct.pack("<i", permits),
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return bool(self._fixed(frames, "<b"))
+
+    def semaphore_acquire(self, name: str, permits: int = 1,
+                          timeout_ms: int = 5000) -> bool:
+        g = self.cp_group(name)
+        sid = self.session_id(g)
+        tid = self.thread_id(g)
+        frames = self._invoke(
+            MSG["semaphore.acquire"],
+            fixed=struct.pack("<qq", sid, tid) + encode_uuid(random_uuid())
+            + struct.pack("<iq", permits, timeout_ms),
+            var=raft_group_frames(g) + [str_frame(name)])
+        return bool(self._fixed(frames, "<b"))
+
+    def semaphore_release(self, name: str, permits: int = 1) -> bool:
+        g = self.cp_group(name)
+        sid = self.session_id(g)
+        tid = self.thread_id(g)
+        frames = self._invoke(
+            MSG["semaphore.release"],
+            fixed=struct.pack("<qq", sid, tid) + encode_uuid(random_uuid())
+            + struct.pack("<i", permits),
+            var=raft_group_frames(g) + [str_frame(name)])
+        return bool(self._fixed(frames, "<b")) if \
+            len(frames[0].payload) > RESPONSE_HEADER else True

@@ -1,33 +1,46 @@
 """Hazelcast test suite (reference: hazelcast/src/jepsen/hazelcast.clj
-— a 5-node Hazelcast member cluster probed through queue, atomic-long
-unique-id, CAS, and lock clients; the queue client offers/polls and
-drains at the end, checked with total-queue :266-317).
+— a 5-node Hazelcast member cluster probed through queue, map,
+atomic-long unique-id, CAS, semaphore, and four strengths of
+CP-FencedLock clients; the queue client offers/polls and drains at the
+end, checked with total-queue :266-317; the lock clients are checked
+linearizable against owner/reentrancy/fence-aware mutex models
+:516-650).
 
-This suite carries the queue workload over Hazelcast's REST map/queue
-API (``/hazelcast/rest/queues/<q>``): enqueue = POST offer, dequeue =
-poll with a bounded timeout, drain = poll-until-empty — the REST-era
-equivalent of the reference's queue-client (hazelcast.clj:270-296).
-The CP-subsystem clients (atomic long, cas register, fenced lock) are
-only reachable through the Java client protocol and are out of REST
-scope; run CAS workloads against the suites with server-side CAS
-(etcd, zookeeper, ignite, consul).
+Two transports:
+
+- **queue/map** ride Hazelcast's REST data endpoint
+  (``/hazelcast/rest/queues/<q>``): enqueue = POST offer, dequeue =
+  poll with a bounded timeout, drain = poll-until-empty — the REST-era
+  equivalent of the reference's queue-client (hazelcast.clj:270-296).
+- **CP workloads** (lock family, cp-cas, ids, semaphore) ride the
+  from-scratch Open Binary Client Protocol client
+  (:mod:`jepsen_tpu.suites._hazelcast`): authentication, Raft-group
+  resolution, CP sessions with lazy heartbeats, AtomicLong /
+  FencedLock / Semaphore invocations — the same capability surface as
+  the reference's Java-client CP workloads (hazelcast.clj:146-264,
+  345-411).
 
 DB automation unpacks the Hazelcast distribution, writes a tcp-ip
-member list plus REST-endpoint-groups config, and runs bin/hz-start —
-the install!/configure!/start! cycle of hazelcast.clj:57-116.
+member list plus REST-endpoint-groups + CP-subsystem config, and runs
+bin/hz-start — the install!/configure!/start! cycle of
+hazelcast.clj:57-116.
 """
 from __future__ import annotations
 
 import logging
+import socket
 import urllib.error
 
 from jepsen_tpu import cli, db as db_mod
 from jepsen_tpu.client import Client
 from jepsen_tpu.control import util as cu
+from jepsen_tpu.fakes import MetaLogDB
 from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
                                standard_test_fn)
+from jepsen_tpu.suites._hazelcast import INVALID_FENCE, HzClient, HzError
 from jepsen_tpu.suites._http import NET_ERRORS, http_json, quote
+from jepsen_tpu.workloads import cp_lock as cp_wl
 
 logger = logging.getLogger("jepsen.hazelcast")
 
@@ -58,6 +71,10 @@ CONFIG_YAML = """hazelcast:
   queue:
     %(queue)s:
       backup-count: 2
+  cp-subsystem:
+    cp-member-count: %(cp_members)d
+    session-time-to-live-seconds: 30
+    session-heartbeat-interval-seconds: 5
 """
 
 
@@ -74,10 +91,12 @@ class HazelcastDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
         logger.info("%s: installing hazelcast %s", node, self.version)
         from jepsen_tpu import control
         cu.install_archive(archive_url(self.version), DIR)
-        members = ", ".join(test.get("nodes") or [])
+        nodes = test.get("nodes") or []
+        members = ", ".join(nodes)
         control.exec_("tee", f"{DIR}/config/hazelcast.yaml",
                       stdin=CONFIG_YAML % {"port": PORT, "members": members,
-                                           "queue": QUEUE})
+                                           "queue": QUEUE,
+                                           "cp_members": max(3, len(nodes))})
         self.start(test, node)
         cu.await_tcp_port(PORT, host=node)
 
@@ -206,30 +225,291 @@ class HazelcastClient(Client):
         pass
 
 
-SUPPORTED_WORKLOADS = ("queue", "map")
+# -- CP-subsystem clients (wire protocol) -----------------------------------
+
+LOCK_NAME = "jepsen.cpLock"
+SEMAPHORE_NAME = "jepsen.cpSemaphore"
+ATOMIC_NAME = "jepsen.atomic-long"
+CAS_NAME = "jepsen.cas-long"
+
+# workload name -> which CP object family the client drives
+CP_MODES = {
+    "lock": "lock", "cp-lock": "lock", "reentrant-cp-lock": "lock",
+    "fenced-lock": "lock", "reentrant-fenced-lock": "lock",
+    "cp-semaphore": "semaphore",
+    "atomic-long-ids": "ids", "cp-cas-long": "cas",
+}
+
+
+class HzCPClient(Client):
+    """CP-subsystem ops over the binary protocol (the counterpart of
+    hazelcast.clj's fenced-lock-client :339-370, cp-semaphore-client
+    :372-411, cp-atomic-long-id-client :174-188, cp-cas-long-client
+    :190-209). Error mapping follows the reference: lock-owner
+    violations fail, transport errors that may have applied complete
+    info."""
+
+    def __init__(self, mode: str = "lock", node: str | None = None,
+                 conn: HzClient | None = None, timeout_s: float = 10.0):
+        self.mode = mode
+        self.node = node
+        self.conn = conn
+        self.timeout_s = timeout_s
+
+    def open(self, test, node):
+        conn = HzClient(node, PORT, timeout_s=self.timeout_s).connect()
+        if self.mode == "semaphore":
+            try:
+                conn.semaphore_init(SEMAPHORE_NAME, cp_wl.NUM_PERMITS)
+            except HzError:
+                pass  # already initialised by a sibling
+        return HzCPClient(self.mode, node, conn, self.timeout_s)
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        try:
+            if self.conn.sock is None:   # dropped after a net error
+                self.conn.connect()
+            if self.mode == "lock":
+                if f == "acquire":
+                    fence = self.conn.lock_try_lock(LOCK_NAME)
+                    if fence == INVALID_FENCE:
+                        return {**op, "type": "fail"}
+                    return {**op, "type": "ok", "value": fence}
+                if f == "release":
+                    self.conn.lock_unlock(LOCK_NAME)
+                    return {**op, "type": "ok"}
+            elif self.mode == "semaphore":
+                if f == "acquire":
+                    ok = self.conn.semaphore_acquire(SEMAPHORE_NAME)
+                    return {**op, "type": "ok" if ok else "fail"}
+                if f == "release":
+                    self.conn.semaphore_release(SEMAPHORE_NAME)
+                    return {**op, "type": "ok"}
+            elif self.mode == "ids":
+                if f == "generate":
+                    v = self.conn.atomic_add_and_get(ATOMIC_NAME, 1)
+                    return {**op, "type": "ok", "value": v}
+            elif self.mode == "cas":
+                v = op.get("value")
+                if f == "read":
+                    return {**op, "type": "ok",
+                            "value": self.conn.atomic_get(CAS_NAME)}
+                if f == "write":
+                    self.conn.atomic_get_and_set(CAS_NAME, int(v))
+                    return {**op, "type": "ok"}
+                if f == "cas":
+                    old, new = v
+                    ok = self.conn.atomic_compare_and_set(
+                        CAS_NAME, int(old), int(new))
+                    if ok:
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except HzError as e:
+            if "IllegalMonitorState" in e.class_name:
+                return {**op, "type": "fail", "error": "not-lock-owner"}
+            # reads can safely fail; any other errored op may still have
+            # applied server-side (e.g. an indeterminate Raft commit), so
+            # it must complete info or the lock models see phantom frees
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind,
+                    "error": ["hz", e.class_name, e.message]}
+        except (ConnectionError, socket.timeout, OSError) as e:
+            # the stream may hold a half-read response: drop the
+            # connection so the next invoke reconnects cleanly instead
+            # of desynchronizing the frame decoder
+            if self.conn is not None:
+                self.conn.close()
+            kind = "fail" if f == "read" else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                for g in list(self.conn._groups.values()):
+                    self.conn.close_session(g)
+            except (HzError, ConnectionError, socket.timeout, OSError):
+                pass  # best-effort: the server reaps expired sessions
+            self.conn.close()
+
+
+# -- fake-mode CP doubles ---------------------------------------------------
+
+class CPFakeStore(MetaLogDB):
+    """In-memory CP subsystem: a reentrant fenced lock, a counting
+    semaphore, an atomic long, and an id counter — the cluster double
+    the fake-mode lifecycle tests run the CP workloads against."""
+
+    def __init__(self, max_holds: int = cp_wl.MAX_HOLDS,
+                 permits: int = cp_wl.NUM_PERMITS):
+        super().__init__()
+        self.max_holds = max_holds
+        self.permits = permits
+        self._wipe()
+
+    def _wipe(self):
+        self.holder = None
+        self.holds = 0
+        self.fence = 0
+        self.fence_counter = 0
+        self.sem: dict = {}
+        self.along = 0
+        self.ids = 0
+
+    def try_lock(self, p) -> int:
+        """Fence if acquired (same fence on reentrant re-acquire), 0 if
+        busy or at max holds."""
+        with self.lock:
+            if self.holder is None:
+                self.fence_counter += 1
+                self.holder, self.holds = p, 1
+                self.fence = self.fence_counter
+                return self.fence
+            if self.holder == p and self.holds < self.max_holds:
+                self.holds += 1
+                return self.fence
+            return 0
+
+    def unlock(self, p) -> bool:
+        with self.lock:
+            if self.holder != p:
+                return False
+            self.holds -= 1
+            if self.holds == 0:
+                self.holder = None
+            return True
+
+    def sem_acquire(self, p) -> bool:
+        with self.lock:
+            if sum(self.sem.values()) < self.permits:
+                self.sem[p] = self.sem.get(p, 0) + 1
+                return True
+            return False
+
+    def sem_release(self, p) -> bool:
+        with self.lock:
+            if self.sem.get(p, 0) > 0:
+                self.sem[p] -= 1
+                return True
+            return False
+
+    def next_id(self) -> int:
+        with self.lock:
+            self.ids += 1
+            return self.ids
+
+    def along_get(self) -> int:
+        with self.lock:
+            return self.along
+
+    def along_set(self, v: int) -> None:
+        with self.lock:
+            self.along = v
+
+    def along_cas(self, old: int, new: int) -> bool:
+        with self.lock:
+            if self.along == old:
+                self.along = new
+                return True
+            return False
+
+
+class CPFakeClient(Client):
+    """Fake-mode twin of HzCPClient over a CPFakeStore."""
+
+    def __init__(self, store: CPFakeStore, mode: str,
+                 node: str | None = None):
+        self.store = store
+        self.mode = mode
+        self.node = node
+
+    def open(self, test, node):
+        self.store._note("client-open", node)
+        return CPFakeClient(self.store, self.mode, node)
+
+    def invoke(self, test, op):
+        f, p = op.get("f"), op.get("process")
+        if self.mode == "lock":
+            if f == "acquire":
+                fence = self.store.try_lock(p)
+                if fence:
+                    return {**op, "type": "ok", "value": fence}
+                return {**op, "type": "fail"}
+            if f == "release":
+                if self.store.unlock(p):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "not-lock-owner"}
+        elif self.mode == "semaphore":
+            if f == "acquire":
+                return {**op,
+                        "type": "ok" if self.store.sem_acquire(p)
+                        else "fail"}
+            if f == "release":
+                if self.store.sem_release(p):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "not-permit-owner"}
+        elif self.mode == "ids":
+            if f == "generate":
+                return {**op, "type": "ok", "value": self.store.next_id()}
+        elif self.mode == "cas":
+            v = op.get("value")
+            if f == "read":
+                return {**op, "type": "ok", "value": self.store.along_get()}
+            if f == "write":
+                self.store.along_set(int(v))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                if self.store.along_cas(int(old), int(new)):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-failed"}
+        return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+SUPPORTED_WORKLOADS = ("queue", "map", "lock", "cp-lock",
+                       "reentrant-cp-lock", "fenced-lock",
+                       "reentrant-fenced-lock", "cp-semaphore",
+                       "atomic-long-ids", "cp-cas-long")
 
 
 def _hazelcast_workload(name: str, base: dict) -> dict:
     """map = the r/w register subset (the REST map API exposes get/put
-    but no CAS; hazelcast.clj's richer map workloads ride the native
-    client protocol — see PARITY's protocol-bounded scope note)."""
+    but no CAS); the CP workloads ride the workload kits in
+    workloads/cp_lock.py against the binary-protocol client."""
+    acc = base["accelerator"]
     if name == "map":
         from jepsen_tpu.workloads import register as register_wl
-        return register_wl.workload(base, accelerator=base["accelerator"],
-                                    ops=("r", "w"))
+        return register_wl.workload(base, accelerator=acc, ops=("r", "w"))
+    if name in ("lock", "cp-lock", "reentrant-cp-lock", "fenced-lock",
+                "reentrant-fenced-lock"):
+        return cp_wl.lock_workload(base, accelerator=acc, flavor=name)
+    if name == "cp-semaphore":
+        return cp_wl.semaphore_workload(base, accelerator=acc)
+    if name == "atomic-long-ids":
+        return cp_wl.ids_workload(base, accelerator=acc)
+    if name == "cp-cas-long":
+        return cp_wl.cas_long_workload(base, accelerator=acc)
     from jepsen_tpu.suites import workload_registry
 
-    return workload_registry()[name](base, accelerator=base["accelerator"])
+    return workload_registry()[name](base, accelerator=acc)
 
 
 def hazelcast_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+    workload = o.get("workload") or SUPPORTED_WORKLOADS[0]
+    mode = CP_MODES.get(workload)
+    store = CPFakeStore()
     return build_suite_test(
-        opts_dict, db_name="hazelcast",
+        o, db_name="hazelcast",
         supported_workloads=SUPPORTED_WORKLOADS,
         make_workload=_hazelcast_workload,
-        make_real=lambda o: {
-            "db": HazelcastDB(o.get("version", DEFAULT_VERSION)),
-            "client": HazelcastClient(), "os": Debian()})
+        fake_db=(lambda: store) if mode else None,
+        fake_client=(lambda: CPFakeClient(store, mode)) if mode else None,
+        make_real=lambda opts: {
+            "db": HazelcastDB(opts.get("version", DEFAULT_VERSION)),
+            "client": (HzCPClient(mode) if mode else HazelcastClient()),
+            "os": Debian()})
 
 
 main = cli.single_test_cmd(

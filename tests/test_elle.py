@@ -1307,3 +1307,70 @@ def test_columnar_fast_flatten_fallbacks():
     txns = [op for op in h(0, True) if op["type"] == "ok"]
     assert columnar._flatten_mops_fast(txns) is None
     assert columnar._build(h(0, True)) is None
+
+
+def test_c_front_vs_python_front_parity_fuzz(monkeypatch):
+    """The native C parser front (native/columnar_ext.c) and the
+    numpy Python front must produce identical results — verdict,
+    anomaly types, edge counts, extras — on messy histories. When the
+    C extension is unavailable this reduces to a self-check."""
+    from jepsen_tpu.elle import columnar
+    from jepsen_tpu.native import columnar_c
+
+    if not columnar_c.available():
+        pytest.skip("C toolchain unavailable")
+
+    rng = random.Random(71)
+    engaged = 0
+    for trial in range(40):
+        h = _messy_history(rng)
+        r_c = list_append.check(h, accelerator="auto")
+        with monkeypatch.context() as mp:
+            mp.setattr(columnar, "_cmod", lambda: None)
+            r_py = list_append.check(h, accelerator="auto")
+        if r_c.get("builder") != "columnar":
+            assert r_py.get("builder") != "columnar", trial
+            continue
+        engaged += 1
+        assert r_c["valid?"] == r_py["valid?"], (trial, r_c, r_py)
+        assert r_c["anomaly-types"] == r_py["anomaly-types"], trial
+        assert r_c["edge-count"] == r_py["edge-count"], trial
+        assert r_c["txn-count"] == r_py["txn-count"], trial
+        assert r_c["anomalies"] == r_py["anomalies"], trial
+    assert engaged >= 30, engaged
+
+
+def test_c_front_bails_match_python_front(monkeypatch):
+    """Inputs the C parser declines must still produce the same final
+    result through whichever builder takes over."""
+    from jepsen_tpu.native import columnar_c
+
+    if not columnar_c.available():
+        pytest.skip("C toolchain unavailable")
+    cases = [
+        # non-int key (general loop path)
+        [{"type": "ok", "process": 0, "value": [["append", "k", 1]]},
+         {"type": "ok", "process": 1, "value": [["r", "k", [1]]]}],
+        # bool append value (python builder path)
+        [{"type": "ok", "process": 0, "value": [["append", 0, True]]}],
+        # tuple micro-op container and tuple payload
+        [{"type": "ok", "process": 0, "value": (("append", 0, 1),)},
+         {"type": "ok", "process": 1, "value": [("r", 0, (1,))]}],
+        # out-of-range append value
+        [{"type": "ok", "process": 0, "value": [["append", 0, 1 << 33]]}],
+        # huge int key: C path interns objects, numpy front declines
+        [{"type": "ok", "process": 0, "value": [["append", 1 << 70, 1]]},
+         {"type": "ok", "process": 1, "value": [["r", 1 << 70, [1]]]}],
+        # non-string process on an ok op (dropped from txn set)
+        [{"type": "ok", "process": "nemesis", "value": [["append", 0, 1]]},
+         {"type": "ok", "process": 0, "value": [["append", 0, 2]]},
+         {"type": "ok", "process": 1, "value": [["r", 0, [2]]]}],
+    ]
+    from jepsen_tpu.elle import columnar
+    for i, h in enumerate(cases):
+        r_c = list_append.check(h, accelerator="auto")
+        with monkeypatch.context() as mp:
+            mp.setattr(columnar, "_cmod", lambda: None)
+            r_py = list_append.check(h, accelerator="auto")
+        assert r_c["valid?"] == r_py["valid?"], (i, r_c, r_py)
+        assert r_c["anomaly-types"] == r_py["anomaly-types"], i

@@ -1,0 +1,470 @@
+"""Hazelcast CP-subsystem tests: the lock-model family (reference
+models hazelcast.clj:516-650), the Open Binary Client Protocol wire
+client against an in-process mock member, the suite's error mapping,
+and the fake-mode lifecycle for every CP workload."""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.models import (AcquiredPermits, FencedMutex, OwnerMutex,
+                               ReentrantFencedMutex, ReentrantMutex,
+                               is_inconsistent)
+from jepsen_tpu.suites import _hazelcast as hz
+from jepsen_tpu.suites._hazelcast import (BEGIN_FRAME, END_FRAME, Frame,
+                                          HzClient, HzError, MSG, NULL_FRAME,
+                                          RESPONSE_HEADER, REQUEST_HEADER,
+                                          decode_raft_group, encode_message,
+                                          encode_uuid, read_message,
+                                          str_frame)
+
+
+def _op(f, process, value=None, **kw):
+    return {"f": f, "process": process, "value": value, **kw}
+
+
+# ---------------------------------------------------------------------------
+# models
+# ---------------------------------------------------------------------------
+
+def test_owner_mutex_owner_checked():
+    m = OwnerMutex()
+    m = m.step(_op("acquire", 1))
+    assert not is_inconsistent(m)
+    assert is_inconsistent(m.step(_op("acquire", 2)))
+    assert is_inconsistent(m.step(_op("release", 2)))
+    m = m.step(_op("release", 1))
+    assert not is_inconsistent(m) and m.owner is None
+
+
+def test_reentrant_mutex_bounded_holds():
+    m = ReentrantMutex(max_holds=2)
+    m = m.step(_op("acquire", 1))
+    m = m.step(_op("acquire", 1))          # re-acquire: ok
+    assert not is_inconsistent(m)
+    assert is_inconsistent(m.step(_op("acquire", 1)))   # third: over bound
+    assert is_inconsistent(m.step(_op("acquire", 2)))   # other client
+    m = m.step(_op("release", 1))
+    assert m.owner == 1                     # still held once
+    assert is_inconsistent(m.step(_op("release", 2)))
+    m = m.step(_op("release", 1))
+    assert m.owner is None and m.holds == 0
+
+
+def test_fenced_mutex_fence_monotonicity():
+    m = FencedMutex()
+    m = m.step(_op("acquire", 1, 5))
+    assert m.fence == 5
+    m = m.step(_op("release", 1))
+    # next fence must exceed 5; an equal or lower fence is inconsistent
+    assert is_inconsistent(m.step(_op("acquire", 2, 5)))
+    assert is_inconsistent(m.step(_op("acquire", 2, 4)))
+    m2 = m.step(_op("acquire", 2, 6))
+    assert m2.fence == 6
+    # an acquire with no observed fence (crashed acquire) is always legal
+    m3 = m.step(_op("acquire", 2, None))
+    assert m3.owner == 2 and m3.fence == 5
+
+
+def test_reentrant_fenced_mutex_same_fence_on_reacquire():
+    m = ReentrantFencedMutex(max_holds=2)
+    m = m.step(_op("acquire", 1, 7))
+    m2 = m.step(_op("acquire", 1, 7))      # same fence: ok
+    assert not is_inconsistent(m2)
+    assert is_inconsistent(m.step(_op("acquire", 1, 8)))  # new fence held
+    m2 = m2.step(_op("release", 1))
+    m2 = m2.step(_op("release", 1))
+    assert m2.owner is None
+    assert is_inconsistent(m2.step(_op("acquire", 2, 7)))  # ≤ highest
+    assert not is_inconsistent(m2.step(_op("acquire", 2, 8)))
+
+
+def test_reentrant_fenced_mutex_unknown_fence_reveal():
+    m = ReentrantFencedMutex(max_holds=2)
+    m = m.step(_op("acquire", 1, None))    # crashed acquire, fence unknown
+    assert m.fence == 0 and m.owner == 1
+    m2 = m.step(_op("acquire", 1, 9))      # re-acquire reveals the fence
+    assert m2.fence == 9 and m2.highest == 9
+    # a revealed fence must still exceed every previously observed one
+    stale = ReentrantFencedMutex(owner=1, holds=1, fence=0, highest=10)
+    assert is_inconsistent(stale.step(_op("acquire", 1, 5)))
+
+
+def test_acquired_permits_caps_and_ownership():
+    m = AcquiredPermits(permits=2)
+    m = m.step(_op("acquire", 1))
+    m = m.step(_op("acquire", 2))
+    assert is_inconsistent(m.step(_op("acquire", 3)))   # permits exhausted
+    assert is_inconsistent(m.step(_op("release", 3)))   # holds nothing
+    m = m.step(_op("release", 1))
+    m = m.step(_op("acquire", 3))
+    assert not is_inconsistent(m)
+
+
+# ---------------------------------------------------------------------------
+# mock member
+# ---------------------------------------------------------------------------
+
+class MockMember:
+    """In-process Hazelcast member speaking the 2.x client protocol from
+    the server side: auth, Raft-group resolution, CP sessions, an
+    AtomicLong, a reentrant FencedLock, and a counting semaphore."""
+
+    def __init__(self, max_holds=2, permits=2):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.lock = threading.Lock()
+        self.along: dict[str, int] = {}
+        self.sessions = 0
+        self.threads = 0
+        self.fences = 0
+        self.locks: dict = {}   # name -> [holder(sid,tid)|None, holds, fence]
+        self.sem: dict = {}     # name -> {holder: count}
+        self.sem_permits: dict = {}
+        self.max_holds = max_holds
+        self.permits = permits
+        self.auths = 0
+        self.stop = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self.stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            proto = b""
+            while len(proto) < 3:
+                proto += conn.recv(3 - len(proto))
+            assert proto == b"CP2", proto
+            while True:
+                frames = read_message(conn)
+                conn.sendall(self._dispatch(frames))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- response builders --------------------------------------------------
+
+    @staticmethod
+    def _resp(req_type, corr, fixed=b"", var=None):
+        initial = Frame(struct.pack("<IqB", req_type + 1, corr, 0) + fixed)
+        return encode_message([initial] + (var or []))
+
+    @staticmethod
+    def _error(corr, code, class_name, message=""):
+        initial = Frame(struct.pack("<IqB", hz.EXCEPTION_MSG_TYPE, corr, 0))
+        frames = [initial, BEGIN_FRAME, BEGIN_FRAME,
+                  Frame(struct.pack("<i", code)), str_frame(class_name),
+                  str_frame(message) if message else NULL_FRAME,
+                  BEGIN_FRAME, END_FRAME,   # empty stack trace list
+                  END_FRAME, END_FRAME]
+        return encode_message(frames)
+
+    # -- request decode helpers --------------------------------------------
+
+    @staticmethod
+    def _group_and_name(frames):
+        group, j = decode_raft_group(frames, 1)
+        return group, frames[j].payload.decode()
+
+    def _dispatch(self, frames) -> bytes:
+        rtype, corr = struct.unpack_from("<Iq", frames[0].payload, 0)
+        fixed = frames[0].payload[REQUEST_HEADER:]
+        with self.lock:
+            if rtype == MSG["client.authentication"]:
+                self.auths += 1
+                body = (b"\x00" + encode_uuid(b"\x11" * 16) + b"\x01"
+                        + struct.pack("<i", 271) + encode_uuid(b"\x22" * 16)
+                        + b"\x00")
+                return self._resp(rtype, corr, body,
+                                  [NULL_FRAME, str_frame("5.3.7")])
+            if rtype == MSG["cpgroup.createcpgroup"]:
+                name = frames[1].payload.decode()
+                return self._resp(
+                    rtype, corr, b"",
+                    [BEGIN_FRAME, Frame(struct.pack("<qq", 0, 7)),
+                     str_frame(name), END_FRAME])
+            if rtype == MSG["cpsession.createsession"]:
+                self.sessions += 1
+                return self._resp(rtype, corr,
+                                  struct.pack("<qqq", self.sessions,
+                                              30_000, 5_000))
+            if rtype == MSG["cpsession.heartbeatsession"]:
+                sid = struct.unpack_from("<q", fixed, 0)[0]
+                if sid > self.sessions:
+                    return self._error(corr, 17,
+                                       "com.hazelcast.cp.internal.session."
+                                       "SessionExpiredException")
+                return self._resp(rtype, corr)
+            if rtype == MSG["cpsession.generatethreadid"]:
+                self.threads += 1
+                return self._resp(rtype, corr,
+                                  struct.pack("<q", self.threads))
+            if rtype == MSG["atomiclong.addandget"]:
+                delta = struct.unpack_from("<q", fixed, 0)[0]
+                _, name = self._group_and_name(frames)
+                v = self.along.get(name, 0) + delta
+                self.along[name] = v
+                return self._resp(rtype, corr, struct.pack("<q", v))
+            if rtype == MSG["atomiclong.get"]:
+                _, name = self._group_and_name(frames)
+                return self._resp(rtype, corr,
+                                  struct.pack("<q", self.along.get(name, 0)))
+            if rtype == MSG["atomiclong.compareandset"]:
+                old, new = struct.unpack_from("<qq", fixed, 0)
+                _, name = self._group_and_name(frames)
+                ok = self.along.get(name, 0) == old
+                if ok:
+                    self.along[name] = new
+                return self._resp(rtype, corr, struct.pack("<b", ok))
+            if rtype == MSG["atomiclong.getandset"]:
+                new = struct.unpack_from("<q", fixed, 0)[0]
+                _, name = self._group_and_name(frames)
+                v = self.along.get(name, 0)
+                self.along[name] = new
+                return self._resp(rtype, corr, struct.pack("<q", v))
+            if rtype == MSG["fencedlock.trylock"]:
+                sid, tid = struct.unpack_from("<qq", fixed, 0)
+                _, name = self._group_and_name(frames)
+                st = self.locks.setdefault(name, [None, 0, 0])
+                if st[0] is None:
+                    self.fences += 1
+                    st[0], st[1], st[2] = (sid, tid), 1, self.fences
+                    fence = st[2]
+                elif st[0] == (sid, tid) and st[1] < self.max_holds:
+                    st[1] += 1
+                    fence = st[2]
+                else:
+                    fence = 0
+                return self._resp(rtype, corr, struct.pack("<q", fence))
+            if rtype == MSG["fencedlock.unlock"]:
+                sid, tid = struct.unpack_from("<qq", fixed, 0)
+                _, name = self._group_and_name(frames)
+                st = self.locks.setdefault(name, [None, 0, 0])
+                if st[0] != (sid, tid):
+                    return self._error(
+                        corr, 24, "java.lang.IllegalMonitorStateException",
+                        "Current thread is not owner of the lock!")
+                st[1] -= 1
+                if st[1] == 0:
+                    st[0] = None
+                return self._resp(rtype, corr,
+                                  struct.pack("<b", st[1] > 0))
+            if rtype == MSG["semaphore.init"]:
+                permits = struct.unpack_from("<i", fixed, 0)[0]
+                _, name = self._group_and_name(frames)
+                fresh = name not in self.sem_permits
+                if fresh:
+                    self.sem_permits[name] = permits
+                    self.sem[name] = {}
+                return self._resp(rtype, corr, struct.pack("<b", fresh))
+            if rtype == MSG["semaphore.acquire"]:
+                sid, tid = struct.unpack_from("<qq", fixed, 0)
+                _, name = self._group_and_name(frames)
+                held = self.sem.setdefault(name, {})
+                cap = self.sem_permits.get(name, self.permits)
+                ok = sum(held.values()) < cap
+                if ok:
+                    held[(sid, tid)] = held.get((sid, tid), 0) + 1
+                return self._resp(rtype, corr, struct.pack("<b", ok))
+            if rtype == MSG["semaphore.release"]:
+                sid, tid = struct.unpack_from("<qq", fixed, 0)
+                _, name = self._group_and_name(frames)
+                held = self.sem.setdefault(name, {})
+                if held.get((sid, tid), 0) <= 0:
+                    return self._error(
+                        corr, 25, "java.lang.IllegalArgumentException",
+                        "not a permit holder")
+                held[(sid, tid)] -= 1
+                return self._resp(rtype, corr, struct.pack("<b", 1))
+            return self._error(corr, -1, "java.lang."
+                               "UnsupportedOperationException",
+                               hex(rtype))
+
+
+@pytest.fixture()
+def member():
+    m = MockMember()
+    yield m
+    m.close()
+
+
+def _client(member) -> HzClient:
+    return HzClient("127.0.0.1", member.port).connect()
+
+
+# ---------------------------------------------------------------------------
+# wire client vs mock member
+# ---------------------------------------------------------------------------
+
+def test_auth_handshake(member):
+    c = _client(member)
+    assert member.auths == 1
+    c.close()
+
+
+def test_atomic_long_ops(member):
+    c = _client(member)
+    assert c.atomic_add_and_get("jepsen.a", 1) == 1
+    assert c.atomic_add_and_get("jepsen.a", 2) == 3
+    assert c.atomic_get("jepsen.a") == 3
+    assert c.atomic_compare_and_set("jepsen.a", 3, 9) is True
+    assert c.atomic_compare_and_set("jepsen.a", 3, 5) is False
+    assert c.atomic_get_and_set("jepsen.a", 0) == 9
+    assert c.atomic_get("jepsen.a") == 0
+    c.close()
+
+
+def test_fenced_lock_fences_monotonic(member):
+    c1, c2 = _client(member), _client(member)
+    f1 = c1.lock_try_lock("jepsen.L")
+    assert f1 > 0
+    assert c2.lock_try_lock("jepsen.L") == 0       # busy -> invalid fence
+    # reentrant acquire by the holder: same fence
+    assert c1.lock_try_lock("jepsen.L") == f1
+    c1.lock_unlock("jepsen.L")
+    c1.lock_unlock("jepsen.L")
+    f2 = c2.lock_try_lock("jepsen.L")
+    assert f2 > f1                                  # fence grew
+    c2.lock_unlock("jepsen.L")
+    c1.close()
+    c2.close()
+
+
+def test_unlock_by_non_owner_raises(member):
+    c1, c2 = _client(member), _client(member)
+    assert c1.lock_try_lock("jepsen.L") > 0
+    with pytest.raises(HzError) as ei:
+        c2.lock_unlock("jepsen.L")
+    assert "IllegalMonitorState" in ei.value.class_name
+    c1.close()
+    c2.close()
+
+
+def test_semaphore_permits(member):
+    c1, c2, c3 = (_client(member) for _ in range(3))
+    assert c1.semaphore_init("jepsen.S", 2) is True
+    assert c1.semaphore_acquire("jepsen.S") is True
+    assert c2.semaphore_acquire("jepsen.S") is True
+    assert c3.semaphore_acquire("jepsen.S") is False   # permits exhausted
+    with pytest.raises(HzError):
+        c3.semaphore_release("jepsen.S")
+    assert c1.semaphore_release("jepsen.S")
+    assert c3.semaphore_acquire("jepsen.S") is True
+    for c in (c1, c2, c3):
+        c.close()
+
+
+def test_session_and_thread_id_reused(member):
+    c = _client(member)
+    c.lock_try_lock("jepsen.L")
+    c.lock_unlock("jepsen.L")
+    c.lock_try_lock("jepsen.L")
+    # one session + one thread id for the whole connection
+    assert member.sessions == 1
+    assert member.threads == 1
+    c.close()
+
+
+def test_raft_group_codec_roundtrip():
+    g = hz.RaftGroupId("default", 3, 12)
+    frames = hz.raft_group_frames(g) + [str_frame("tail")]
+    g2, j = decode_raft_group(frames, 0)
+    assert (g2.name, g2.seed, g2.group_id) == ("default", 3, 12)
+    assert frames[j].payload == b"tail"
+
+
+# ---------------------------------------------------------------------------
+# suite client error mapping (HzCPClient over the mock member)
+# ---------------------------------------------------------------------------
+
+def test_suite_lock_client_against_mock(member, monkeypatch):
+    from jepsen_tpu.suites import hazelcast as suite
+
+    monkeypatch.setattr(suite, "PORT", member.port)
+    base = suite.HzCPClient("lock")
+    c1 = base.open({}, "127.0.0.1")
+    c2 = base.open({}, "127.0.0.1")
+    op1 = c1.invoke({}, _op("acquire", 1))
+    assert op1["type"] == "ok" and op1["value"] > 0
+    assert c2.invoke({}, _op("acquire", 2))["type"] == "fail"
+    # release by non-owner maps to a fail with the owner error
+    bad = c2.invoke({}, _op("release", 2))
+    assert bad["type"] == "fail" and bad["error"] == "not-lock-owner"
+    assert c1.invoke({}, _op("release", 1))["type"] == "ok"
+    got = c2.invoke({}, _op("acquire", 2))
+    assert got["type"] == "ok" and got["value"] > op1["value"]
+    c1.close({})
+    c2.close({})
+
+
+def test_suite_ids_and_cas_clients_against_mock(member, monkeypatch):
+    from jepsen_tpu.suites import hazelcast as suite
+
+    monkeypatch.setattr(suite, "PORT", member.port)
+    ids = suite.HzCPClient("ids").open({}, "127.0.0.1")
+    seen = {ids.invoke({}, _op("generate", 0))["value"] for _ in range(5)}
+    assert len(seen) == 5
+    cas = suite.HzCPClient("cas").open({}, "127.0.0.1")
+    assert cas.invoke({}, _op("read", 0))["value"] == 0
+    assert cas.invoke({}, _op("write", 0, 3))["type"] == "ok"
+    assert cas.invoke({}, _op("cas", 0, [3, 4]))["type"] == "ok"
+    out = cas.invoke({}, _op("cas", 0, [3, 4]))
+    assert out["type"] == "fail" and out["error"] == "cas-failed"
+    assert cas.invoke({}, _op("read", 0))["value"] == 4
+    ids.close({})
+    cas.close({})
+
+
+def test_suite_net_error_mapping(monkeypatch):
+    from jepsen_tpu.suites import hazelcast as suite
+
+    # connect to a dead port: open fails; invoke on a closed conn -> info
+    c = suite.HzCPClient("lock")
+    c.conn = HzClient("127.0.0.1", 1)   # never connected
+    out = c.invoke({}, _op("acquire", 1))
+    assert out["type"] == "info" and out["error"][0] == "net"
+    out = c.invoke({}, _op("read", 1))
+    assert out["type"] == "fail"
+
+
+# ---------------------------------------------------------------------------
+# fake-mode lifecycle for every CP workload
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wl", ["cp-lock", "reentrant-cp-lock",
+                                "fenced-lock", "reentrant-fenced-lock",
+                                "cp-semaphore", "atomic-long-ids",
+                                "cp-cas-long"])
+def test_hazelcast_cp_fake_lifecycle(wl):
+    from conftest import run_fake
+    from jepsen_tpu.suites.hazelcast import hazelcast_test
+
+    res = run_fake(hazelcast_test, workload=wl, time_limit=2.0)
+    r = res["results"]
+    assert r["valid?"] is True, r
+    assert r["workload"]["valid?"] is True
+    assert r["stats"]["count"] > 0

@@ -606,3 +606,57 @@ namespace jepsen {{
     finally:
         proc.kill()
         proc.wait()
+
+
+@pytest.mark.realdb
+def test_hazelcast_real_member_cp_lock(tmp_path, monkeypatch):
+    """A real 3-member Hazelcast cluster (hz-start from a local
+    distribution; the CP subsystem needs >= 3 CP members) served the CP
+    lock workload through the from-scratch binary protocol client.
+    Needs JEPSEN_HAZELCAST_HOME pointing at an unpacked hazelcast-5.x
+    distribution (or hz-start on PATH) and a JVM."""
+    import glob
+
+    home = os.environ.get("JEPSEN_HAZELCAST_HOME")
+    binary = (glob.glob(os.path.join(home, "bin", "hz-start"))[0]
+              if home and glob.glob(os.path.join(home, "bin", "hz-start"))
+              else shutil.which("hz-start"))
+    if not binary:
+        pytest.skip("no hazelcast distribution available")
+    from jepsen_tpu.suites import hazelcast as hz_suite
+
+    ports = [_free_port() for _ in range(3)]
+    members = ", ".join(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            cfg = tmp_path / f"hazelcast-{i}.yaml"
+            cfg.write_text(hz_suite.CONFIG_YAML % {
+                "port": port, "members": members,
+                "queue": hz_suite.QUEUE, "cp_members": 3})
+            env = dict(os.environ, HAZELCAST_CONFIG=str(cfg))
+            procs.append(subprocess.Popen(
+                [binary], env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        for port, proc in zip(ports, procs):
+            _await_port(port, proc, timeout_s=180.0)
+        monkeypatch.setattr(hz_suite, "PORT", ports[0])
+
+        def factory():
+            # CP discovery completes asynchronously after boot: retried
+            # by _await_conn until the lock round-trips
+            c = hz_suite.HzCPClient("lock").open({}, "127.0.0.1")
+            out = c.invoke({}, {"f": "acquire", "process": 0,
+                                "value": None})
+            assert out["type"] == "ok" and out["value"] > 0, out
+            assert c.invoke({}, {"f": "release", "process": 0,
+                                 "value": None})["type"] == "ok"
+            c.close({})
+            return True
+
+        assert _await_conn(factory, procs[0], timeout_s=180.0)
+    finally:
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            proc.wait(timeout=10)
