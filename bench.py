@@ -156,6 +156,76 @@ def _trials(fn, n: int = 5):
     return out, times
 
 
+_ROOFLINE: dict = {}
+
+
+def device_roofline() -> dict:
+    """Measured single-chip peaks used as denominators for the
+    hardware-efficiency fractions (VERDICT r4 #7: every ratio was
+    vs-CPU; nothing said what fraction of the chip the kernels use).
+    Empirical, not datasheet: best-of-3 large square matmuls (f32 and
+    bf16) and a large elementwise add for HBM read+write bandwidth."""
+    if _ROOFLINE:
+        return _ROOFLINE
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # chain enough work inside ONE dispatch that the tunnel's ~100 ms
+    # round-trip amortizes away — a single 4096 matmul finishes in
+    # microseconds of device time and would measure the tunnel instead
+    measured: dict = {}   # publish all-or-nothing: a partial cache
+    #                       would silently drop fractions forever
+    n, reps = 4096, 32
+    for dt, key in ((jnp.float32, "f32_matmul_flops"),
+                    (jnp.bfloat16, "bf16_matmul_flops")):
+        a = jnp.eye(n, dtype=dt) * 0.5
+
+        @jax.jit
+        def chain(x, a=a):
+            return lax.fori_loop(0, reps, lambda i, y: y @ a, x)
+
+        chain(a).block_until_ready()
+        _, ts = _trials(lambda: chain(a).block_until_ready(), 3)
+        measured[key] = reps * 2.0 * n ** 3 / min(ts)
+    big = jnp.ones((64 * 1024 * 1024,), jnp.float32)   # 256 MB
+    bw_reps = 64
+
+    @jax.jit
+    def adds(x):
+        return lax.fori_loop(0, bw_reps, lambda i, y: y + 1.0, x)
+
+    adds(big).block_until_ready()
+    _, ts = _trials(lambda: adds(big).block_until_ready(), 3)
+    measured["hbm_bytes_per_sec"] = bw_reps * 2.0 * big.size * 4 / min(ts)
+    _ROOFLINE.update(measured)
+    return _ROOFLINE
+
+
+def matrix_roofline_extras(n_returns: int, S: int, V: int,
+                           seconds: float) -> dict:
+    """Roofline accounting for the transfer-matrix kernels: each return
+    composes one [MV, MV] operator via ~(ceil(log2 S) + 2) dense f32
+    matmuls (closure squarings + K-apply + P-update; the elementwise L
+    build is excluded, so this is a LOWER bound on issued FLOPs).
+    ``roofline_frac`` = modeled achieved FLOP/s over the measured f32
+    matmul peak — small matrices (MV ~ 2^S·V) under-tile the MXU, which
+    is exactly what this fraction is here to make visible."""
+    MV = (1 << S) * V
+    n_sq = 0
+    while (1 << n_sq) < S:
+        n_sq += 1
+    flops_per_return = (n_sq + 2) * 2.0 * MV ** 3
+    achieved = n_returns * flops_per_return / seconds
+    peak = device_roofline()["f32_matmul_flops"]
+    return {
+        "modeled_flops_per_return": round(flops_per_return),
+        "achieved_matmul_flops": round(achieved),
+        "device_f32_matmul_peak_flops": round(peak),
+        "roofline_frac": round(achieved / peak, 4),
+    }
+
+
 def _median(ts):
     """Upper median — the one idiom shared by every bench reporter."""
     s = sorted(ts)
@@ -244,6 +314,14 @@ def cfg_multikey():
         med, extras = _spread(times, nk * 1000)
         name = ("multikey_64x1k_ops_per_sec" if main
                 else f"multikey_{nk}x1k_ops_per_sec")
+        try:
+            n_rets = sum(int((np.asarray(s.kind) == 1).sum())
+                         for s in streams)
+            extras.update(matrix_roofline_extras(
+                n_rets, streams[0].n_slots, len(streams[0].intern), med))
+        except Exception:
+            print("[bench] roofline add-on failed:", file=sys.stderr)
+            traceback.print_exc()
         emit(name, nk * 1000 / med, "ops/s", dt_cpu / med,
              cpu_sequential_ops_per_sec=round(nk * 1000 / dt_cpu, 2),
              cpu_trials=cpu_trials, **extras)
@@ -407,6 +485,18 @@ def cfg_matrix_kernel():
     assert bool(alive) and not bool(ovf)
     assert bool(m[0]) == bool(alive), "matrix and scan verdicts must agree"
     extra = {"scan_events_per_sec": round(E / dt_scan, 2), **extras}
+    try:
+        extra.update(matrix_roofline_extras(n_returns, S, V, dt_matrix))
+        # the scan path is event-sequential and bandwidth-bound: bound
+        # it against measured HBM read+write of its P state per event
+        bw = device_roofline()["hbm_bytes_per_sec"]
+        MV = (1 << S) * V
+        scan_bytes = 2.0 * MV * MV * 4          # P read + write, f32
+        extra["scan_hbm_frac"] = round(
+            (E / dt_scan) * scan_bytes / bw, 4)
+    except Exception:
+        print("[bench] roofline add-on failed:", file=sys.stderr)
+        traceback.print_exc()
 
     # failing-history double run: a not-alive matrix verdict falls back to
     # the event scan for diagnostics — measure that total so the cost of
@@ -572,6 +662,13 @@ def cfg_scale(device_rate: float):
             extra["uncounted_overflow_segment"] = overflow
         if failure:
             extra["failure"] = failure
+        try:
+            # returns = half the events (invoke/return block pairs)
+            extra.update(matrix_roofline_extras(
+                total_events // 2, N_PROCS, n_values + 1, counted_at))
+        except Exception:
+            print("[bench] roofline add-on failed:", file=sys.stderr)
+            traceback.print_exc()
         # full per-segment timings to stderr only (they once pushed the
         # metric lines out of the driver's 2000-char stdout tail)
         print(f"[bench] scale segment_seconds={seg_times}", file=sys.stderr)

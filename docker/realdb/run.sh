@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# One-shot `-m realdb` proof run against the compose fleet: up, wait,
+# pytest with the ADDR env vars, transcript to realdb-transcript.txt,
+# down. Run from the repo root or this directory; needs docker compose
+# and network access to pull images (NOT available in the build image —
+# run this on a workstation and commit the transcript).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+cleanup() { docker compose down -v --remove-orphans || true; }
+trap cleanup EXIT
+
+docker compose up -d --wait || {
+    # --wait fails if any service lacks a healthcheck; fall back to a
+    # fixed settle window for the ones without
+    docker compose up -d
+    echo "waiting 90s for services without healthchecks..."
+    sleep 90
+}
+
+export JEPSEN_CASSANDRA_ADDR=127.0.0.1:9042
+export JEPSEN_AEROSPIKE_ADDR=127.0.0.1:3000
+export JEPSEN_AEROSPIKE_NS=test
+export JEPSEN_RABBITMQ_ADDR=127.0.0.1:5672
+export JEPSEN_RETHINKDB_ADDR=127.0.0.1:28015
+export JEPSEN_MYSQL_ADDR=127.0.0.1:3306
+export JEPSEN_HAZELCAST_ADDR=127.0.0.1:5701
+
+cd ../..
+python -m pytest tests/test_realdb.py -m realdb -v -rA \
+    2>&1 | tee docker/realdb/realdb-transcript.txt
+echo "transcript written to docker/realdb/realdb-transcript.txt"

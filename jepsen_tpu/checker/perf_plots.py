@@ -157,7 +157,8 @@ def quantiles_graph(test: dict, history: list[dict], output,
     ax.set_xlabel("time (s)")
     ax.set_ylabel("latency (ms)")
     ax.set_title(f"{test.get('name', 'test')} latency quantiles")
-    ax.legend(loc="upper right", fontsize=8)
+    if ax.get_legend_handles_labels()[0]:   # empty history: no artists
+        ax.legend(loc="upper right", fontsize=8)
     fig.savefig(output, bbox_inches="tight")
     plt.close(fig)
 
@@ -175,7 +176,8 @@ def rate_graph(test: dict, history: list[dict], output,
     ax.set_xlabel("time (s)")
     ax.set_ylabel("throughput (ops/s)")
     ax.set_title(f"{test.get('name', 'test')} rate")
-    ax.legend(loc="upper right", fontsize=8)
+    if ax.get_legend_handles_labels()[0]:   # empty history: no artists
+        ax.legend(loc="upper right", fontsize=8)
     fig.savefig(output, bbox_inches="tight")
     plt.close(fig)
 

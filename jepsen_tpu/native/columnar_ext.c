@@ -209,7 +209,6 @@ static PyObject *parse(PyObject *self, PyObject *args) {
     int out = OUT_BAIL;
     Py_ssize_t n_ok = 0, n = 0;
     PyObject *result = NULL;
-    PyObject *node_pos_b = NULL, *node_inv_b = NULL, *node_proc_b = NULL;
     vec node_proc_v;
     memset(&node_proc_v, 0, sizeof(node_proc_v));
 
@@ -511,16 +510,17 @@ static PyObject *parse(PyObject *self, PyObject *args) {
 done:
     ctx_free(&c);
     vfree(&node_proc_v);
-    Py_XDECREF(node_pos_b);
-    Py_XDECREF(node_inv_b);
-    Py_XDECREF(node_proc_b);
     if (out == OUT_OK) return result;
     Py_XDECREF(result);
     if (out == OUT_BAIL) {
         if (PyErr_Occurred()) PyErr_Clear();
         Py_RETURN_NONE;
     }
-    return NULL; /* OUT_ERR: exception set */
+    /* OUT_ERR: an exception must be set — the vpush (realloc) failure
+     * paths reach here bare, and a NULL return without an exception
+     * would surface as a misleading SystemError */
+    if (!PyErr_Occurred()) PyErr_NoMemory();
+    return NULL;
 }
 
 static PyMethodDef methods[] = {

@@ -283,7 +283,16 @@ def enabled(S: int, V: int) -> bool:
         return _PROBED[key]
     ok = False
     try:
-        T, U, G = 3, 16, 2
+        # T=256 puts the probe in the production tiling regime: T is a
+        # trailing block dimension, so a tiny T (the old 3) compiled a
+        # differently-padded Mosaic program than the ~1-2k-row chunks
+        # production dispatches — a shape-dependent miscompile there
+        # would have slipped past the probe. 256 crosses the sublane
+        # tile boundary like production T does while keeping the
+        # bit-for-bit numpy oracle (T*G matrix products) sub-second;
+        # residual caveat: the probe's U=16 uop table is still smaller
+        # than production's.
+        T, U, G = 256, 16, 2
         rng = np.random.default_rng(0)
         pend = (rng.random((T, G, S)) < 0.5).astype(np.float32)
         ids = rng.integers(0, U, (T, G, S)).astype(np.int32)

@@ -1,0 +1,215 @@
+"""Minimal Apache Ignite thin-client binary protocol for the ignite
+suite's transactional bank workload (reference:
+ignite/src/jepsen/ignite/bank.clj rides the full Java client's
+TRANSACTIONAL cache txns; this is the from-scratch wire equivalent —
+the same playbook as the CQL/RESP/AMQP/hazelcast clients here).
+
+Protocol shape (the "Binary Client Protocol", default port 10800):
+
+- **Handshake**: ``length(le i32) | 1 | major(le i16) | minor | patch |
+  2`` (client code); success response is a single 1 byte after the
+  length. Version 1.6.0 is negotiated — the first revision carrying
+  client transactions (OP_TX_START/OP_TX_END).
+- **Requests**: ``length | op_code(le i16) | request_id(le i64) |
+  payload``; responses echo the request id and carry a status (0 = ok,
+  else an error string follows).
+- **Values** travel as binary data objects: a type-code byte + the
+  value — here longs (4), ints (3), strings (9) and NULL (101).
+- **Cache ops** address caches by the Java ``String.hashCode`` of the
+  cache name, then a flags byte; flag 0x02 marks the op transactional
+  and is followed by the ambient transaction id (le i32) from
+  OP_TX_START. The suite pre-declares the TRANSACTIONAL cache in the
+  server XML, so no cache-configuration codec is needed.
+
+Ops: OP_CACHE_GET 1000, OP_CACHE_PUT 1001, OP_CACHE_GET_ALL 1003,
+OP_TX_START 4000, OP_TX_END 4001.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+
+from jepsen_tpu.suites._wire import close_quietly, recv_exact
+
+OP_CACHE_GET = 1000
+OP_CACHE_PUT = 1001
+OP_CACHE_GET_ALL = 1003
+OP_TX_START = 4000
+OP_TX_END = 4001
+
+TYPE_BYTE = 1
+TYPE_SHORT = 2
+TYPE_INT = 3
+TYPE_LONG = 4
+TYPE_BOOL = 8
+TYPE_STRING = 9
+TYPE_NULL = 101
+
+CONCURRENCY = {"optimistic": 1, "pessimistic": 2}
+ISOLATION = {"read-committed": 1, "repeatable-read": 2, "serializable": 3}
+
+FLAG_TRANSACTIONAL = 0x02
+
+
+def java_hash(s: str) -> int:
+    """Java String.hashCode (cache ids are the name's hash)."""
+    h = 0
+    for ch in s:
+        h = (31 * h + ord(ch)) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def obj_long(v: int) -> bytes:
+    return struct.pack("<bq", TYPE_LONG, v)
+
+
+def obj_string(s: str | None) -> bytes:
+    if s is None:
+        return struct.pack("<b", TYPE_NULL)
+    b = s.encode("utf-8")
+    return struct.pack("<bi", TYPE_STRING, len(b)) + b
+
+
+def read_obj(buf: bytes, off: int):
+    """Decodes one data object; returns (value, next offset)."""
+    code = buf[off]
+    off += 1
+    if code == TYPE_NULL:
+        return None, off
+    if code == TYPE_LONG:
+        return struct.unpack_from("<q", buf, off)[0], off + 8
+    if code == TYPE_INT:
+        return struct.unpack_from("<i", buf, off)[0], off + 4
+    if code == TYPE_SHORT:
+        return struct.unpack_from("<h", buf, off)[0], off + 2
+    if code == TYPE_BYTE:
+        return struct.unpack_from("<b", buf, off)[0], off + 1
+    if code == TYPE_BOOL:
+        return bool(buf[off]), off + 1
+    if code == TYPE_STRING:
+        n = struct.unpack_from("<i", buf, off)[0]
+        off += 4
+        return buf[off:off + n].decode("utf-8"), off + n
+    raise IgniteError(-1, f"unsupported data-object type {code}")
+
+
+class IgniteError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"ignite status {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class ThinClient:
+    """One authenticated thin-client connection, single in-flight
+    request (one client per logical process)."""
+
+    VERSION = (1, 6, 0)
+
+    def __init__(self, host: str, port: int = 10800,
+                 timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.sock: socket.socket | None = None
+        self._req = itertools.count(1)
+        self._lock = threading.Lock()
+        self.tx_id: int | None = None   # ambient transaction
+
+    def connect(self) -> "ThinClient":
+        self.tx_id = None
+        self.sock = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        body = struct.pack("<bhhhb", 1, *self.VERSION, 2)
+        self.sock.sendall(struct.pack("<i", len(body)) + body)
+        n = struct.unpack("<i", recv_exact(self.sock, 4))[0]
+        resp = recv_exact(self.sock, n)
+        if not resp or resp[0] != 1:
+            # failure payload: server version + error string
+            msg = ""
+            if len(resp) > 7:
+                try:
+                    msg, _ = read_obj(resp, 7)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise IgniteError(-1, f"handshake rejected: {msg}")
+        return self
+
+    def close(self):
+        close_quietly(self.sock)
+        self.sock = None
+        self.tx_id = None
+
+    def request(self, op_code: int, payload: bytes) -> bytes:
+        if self.sock is None:
+            raise ConnectionError("not connected")
+        rid = next(self._req)
+        body = struct.pack("<hq", op_code, rid) + payload
+        with self._lock:
+            self.sock.sendall(struct.pack("<i", len(body)) + body)
+            while True:
+                n = struct.unpack("<i", recv_exact(self.sock, 4))[0]
+                resp = recv_exact(self.sock, n)
+                got_rid, status = struct.unpack_from("<qi", resp, 0)
+                if got_rid != rid:
+                    continue  # stale response from an abandoned retry
+                if status != 0:
+                    try:
+                        msg, _ = read_obj(resp, 12)
+                    except Exception:  # noqa: BLE001
+                        msg = "<undecodable>"
+                    raise IgniteError(status, str(msg))
+                return resp[12:]
+
+    # -- cache ops ----------------------------------------------------------
+
+    def _cache_header(self, cache: str) -> bytes:
+        flags, tail = 0, b""
+        if self.tx_id is not None:
+            flags |= FLAG_TRANSACTIONAL
+            tail = struct.pack("<i", self.tx_id)
+        return struct.pack("<ib", java_hash(cache), flags) + tail
+
+    def cache_get(self, cache: str, key: int):
+        out = self.request(OP_CACHE_GET,
+                           self._cache_header(cache) + obj_long(key))
+        return read_obj(out, 0)[0]
+
+    def cache_put(self, cache: str, key: int, value: int) -> None:
+        self.request(OP_CACHE_PUT, self._cache_header(cache)
+                     + obj_long(key) + obj_long(value))
+
+    def cache_get_all(self, cache: str, keys: list[int]) -> dict:
+        payload = self._cache_header(cache) + struct.pack("<i", len(keys))
+        for k in keys:
+            payload += obj_long(k)
+        out = self.request(OP_CACHE_GET_ALL, payload)
+        count = struct.unpack_from("<i", out, 0)[0]
+        off = 4
+        result = {}
+        for _ in range(count):
+            k, off = read_obj(out, off)
+            v, off = read_obj(out, off)
+            result[k] = v
+        return result
+
+    # -- transactions -------------------------------------------------------
+
+    def tx_start(self, concurrency: str = "pessimistic",
+                 isolation: str = "repeatable-read",
+                 timeout_ms: int = 3000, label: str | None = None) -> int:
+        payload = struct.pack("<bbq", CONCURRENCY[concurrency],
+                              ISOLATION[isolation], timeout_ms)
+        payload += obj_string(label)
+        out = self.request(OP_TX_START, payload)
+        self.tx_id = struct.unpack_from("<i", out, 0)[0]
+        return self.tx_id
+
+    def tx_end(self, committed: bool) -> None:
+        tx, self.tx_id = self.tx_id, None
+        if tx is None:
+            return
+        self.request(OP_TX_END, struct.pack("<ib", tx, committed))

@@ -224,13 +224,26 @@ class CrateClient(Client):
                 found = bool((doc or {}).get("found"))
                 return {**op, "type": "ok" if found else "fail"}
             if f == "strong-read":
-                res = http_json(f"{base}/dirty_read/_search",
-                                {"size": 100000000,
-                                 "_source": ["id"]},
-                                timeout_s=self.timeout_s)
-                hits = ((res or {}).get("hits") or {}).get("hits") or []
-                ids = sorted(int(h["_source"]["id"]) for h in hits)
-                return {**op, "type": "ok", "value": ids}
+                # search_after pages (the elasticsearch suite's paging
+                # pattern): a single giant-size request trips ES's
+                # max_result_window cap on exactly the versions under
+                # probe, turning every strong read into info
+                ids: list = []
+                after = None
+                while True:
+                    body = {"size": 10000, "_source": ["id"],
+                            "query": {"match_all": {}},
+                            "sort": [{"id": "asc"}]}
+                    if after is not None:
+                        body["search_after"] = after
+                    res = http_json(f"{base}/dirty_read/_search", body,
+                                    timeout_s=self.timeout_s)
+                    hits = ((res or {}).get("hits") or {}).get("hits") or []
+                    ids.extend(int(h["_source"]["id"]) for h in hits)
+                    if len(hits) < 10000:
+                        break
+                    after = hits[-1]["sort"]
+                return {**op, "type": "ok", "value": sorted(ids)}
             # refresh falls through to SQL either way
         if f == "write":
             self._sql("INSERT INTO dirty_read (id) VALUES (?)", [int(v)])

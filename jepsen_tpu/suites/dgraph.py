@@ -521,23 +521,40 @@ def _extra_workloads() -> dict:
 
 
 def dgraph_test(opts_dict: dict | None = None) -> dict:
-    return build_suite_test(
-        opts_dict, db_name="dgraph", supported_workloads=SUPPORTED_WORKLOADS,
+    o = dict(opts_dict or {})
+    t = build_suite_test(
+        o, db_name="dgraph", supported_workloads=SUPPORTED_WORKLOADS,
         extra_workloads=_extra_workloads(),
         fault_packages={"move-tablet": tablet_mover_package},
         make_real=lambda o: {
             "db": DgraphDB(o.get("version", DEFAULT_VERSION)),
             "client": DgraphClient(), "os": Debian()})
+    if o.get("trace"):
+        # --trace: spans around every client op into the store dir's
+        # trace.jsonl (the dgraph/trace.clj opencensus analog; see
+        # jepsen_tpu/tracing.py)
+        from jepsen_tpu.tracing import TracedClient, Tracer
+        import os as _os
+        path = _os.path.join(o.get("store_dir", "store"), "trace.jsonl")
+        t["tracer"] = Tracer(path)
+        t["client"] = TracedClient(t["client"], t["tracer"])
+    return t
 
 
 main_all = standard_test_all(dgraph_test, SUPPORTED_WORKLOADS,
                              name="jepsen-dgraph")
 
+
+def _dgraph_opts(p):
+    p.add_argument("--version", default=DEFAULT_VERSION)
+    p.add_argument("--trace", action="store_true",
+                   help="span-log client ops to <store>/trace.jsonl")
+
+
 main = cli.single_test_cmd(
-    standard_test_fn(dgraph_test, extra_keys=("version",)),
+    standard_test_fn(dgraph_test, extra_keys=("version", "trace")),
     standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("move-tablet",),
-                    extra=lambda p: p.add_argument(
-                        "--version", default=DEFAULT_VERSION)),
+                    extra=_dgraph_opts),
     name="jepsen-dgraph")
 
 

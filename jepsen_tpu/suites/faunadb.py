@@ -892,11 +892,121 @@ class FaunaTopology(_membership.State):
         pass
 
 
-def topology_fault_package(opts: dict) -> dict:
+def topology_fault_package(opts: dict,
+                           topo: "FaunaTopology | None" = None) -> dict:
     """--fault topology: the membership package over FaunaTopology."""
     from jepsen_tpu.nemesis import membership
-    return membership.package(FaunaTopology(),
+    return membership.package(topo or FaunaTopology(),
                               interval=opts.get("interval", 10.0))
+
+
+class ReplicaPartitionNemesis:
+    """Applies topology-derived grudges (faunadb/nemesis.clj:29-55: the
+    partition vocabulary no generic package can produce — the GRUDGE is
+    computed by the generator from the tracked replica assignments and
+    carried in the op value)."""
+
+    def fs(self):
+        return {"start-partition-replica", "stop-partition-replica"}
+
+    def setup(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        net = test.get("net")
+        if f == "start-partition-replica":
+            v = op.get("value") or {}
+            grudge = v.get("grudge") or {}
+            if net is not None:
+                net.drop_all(test, grudge)
+            return {**op, "type": "info",
+                    "value": ["isolated", v.get("partition-type"), grudge]}
+        if f == "stop-partition-replica":
+            if net is not None:
+                net.heal(test)
+            return {**op, "type": "info", "value": ["network-healed"]}
+        return {**op, "type": "info", "value": ["unknown-f", f]}
+
+    def teardown(self, test):
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+
+
+def replica_partition_ops(topo: "FaunaTopology", rng=None):
+    """Generator fn emitting intra- or inter-replica partition starts
+    from the CURRENT topology (nemesis.clj:29-55 single-node /
+    intra-replica / inter-replica trio; single-node rides the generic
+    partition package here, so this fn carries the replica-aware two)."""
+    import random as _random
+
+    from jepsen_tpu import nemesis as nem
+    r = rng or _random.Random()
+
+    def start_op(test=None, ctx=None):
+        t = topo._ensure_topo(test or {})
+        by_rep: dict[str, list] = {}
+        for n in t["nodes"]:
+            if n["state"] == "active":
+                by_rep.setdefault(n["replica"], []).append(n["node"])
+        kinds = []
+        if any(len(ns) >= 2 for ns in by_rep.values()):
+            kinds.append("intra")
+        if len(by_rep) >= 2:
+            kinds.append("inter")
+        if not kinds:
+            return {"type": "info", "f": "stop-partition-replica",
+                    "value": None}   # degenerate topology: nothing to cut
+        kind = r.choice(kinds)
+        if kind == "intra":
+            # split INSIDE one replica; other replicas stay connected to
+            # both halves (nemesis.clj:29-40)
+            replica, nodes = r.choice(
+                [(rep, ns) for rep, ns in sorted(by_rep.items())
+                 if len(ns) >= 2])
+            halves = nem.bisect(r.sample(nodes, len(nodes)))
+            grudge = nem.complete_grudge(halves)
+            ptype = ["intra-replica", replica]
+        else:
+            # divide replica GROUPS into two sides (nemesis.clj:42-55)
+            groups = [ns for _, ns in sorted(by_rep.items())]
+            r.shuffle(groups)
+            a, b = nem.bisect(groups)
+            grudge = nem.complete_grudge(
+                [[n for g in a for n in g], [n for g in b for n in g]])
+            ptype = ["inter-replica"]
+        return {"type": "info", "f": "start-partition-replica",
+                "value": {"grudge": grudge, "partition-type": ptype}}
+
+    return start_op
+
+
+def replica_partition_package(opts: dict, topo: "FaunaTopology") -> dict:
+    """--fault partition-replica: topology-aware partitions, composable
+    with the topology membership nemesis (the reference's full-nemesis
+    runs them together, nemesis.clj:172-186)."""
+    from jepsen_tpu import generator as gen
+    interval = opts.get("interval", 10.0)
+    g = gen.stagger(interval, gen.cycle(gen.Seq([
+        gen.Fn(replica_partition_ops(topo)),
+        {"type": "info", "f": "stop-partition-replica", "value": None},
+    ])))
+    return {
+        "nemesis": ReplicaPartitionNemesis(),
+        "generator": g,
+        "final_generator": gen.Seq([
+            {"type": "info", "f": "stop-partition-replica",
+             "value": None}]),
+        "perf": {"name": "partition-replica",
+                 "fs": {"start-partition-replica",
+                        "stop-partition-replica"},
+                 "start": {"start-partition-replica"},
+                 "stop": {"stop-partition-replica"}},
+    }
 
 
 SUPPORTED_WORKLOADS = ("register", "bank", "set", "adya", "pages",
@@ -921,12 +1031,19 @@ def faunadb_test(opts_dict: dict | None = None) -> dict:
     o = dict(opts_dict or {})
     workload_name = o.get("workload") or SUPPORTED_WORKLOADS[0]
     fake_client = FAKE_CLIENTS.get(workload_name)
+    # one topology shared by the membership nemesis and the
+    # replica-aware partitioner, so partitions cut along whatever
+    # replica assignments the topology transitions have produced
+    topo = FaunaTopology()
     return build_suite_test(
         o, db_name="faunadb",
         supported_workloads=SUPPORTED_WORKLOADS,
         extra_workloads=_extra_workloads(),
         fake_client=fake_client,
-        fault_packages={"topology": topology_fault_package},
+        fault_packages={
+            "topology": lambda opts: topology_fault_package(opts, topo),
+            "partition-replica":
+                lambda opts: replica_partition_package(opts, topo)},
         make_real=lambda o: {"db": FaunaDB(), "client": FaunaClient(),
                              "os": Debian()})
 
@@ -936,7 +1053,7 @@ main_all = standard_test_all(faunadb_test, SUPPORTED_WORKLOADS,
 
 main = cli.single_test_cmd(
     standard_test_fn(faunadb_test),
-    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("topology",)),
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("topology", "partition-replica")),
     name="jepsen-faunadb")
 
 

@@ -1,21 +1,34 @@
 """Apache Ignite test suite (reference: ignite/ in jaydenwen123/jepsen
 — ignite/src/jepsen/ignite/register.clj checks a linearizable cache
 register through Ignite's atomic cache ops; bank.clj runs transfer
-transactions over the Java client).
+transactions in TRANSACTIONAL cache txns with a configurable
+concurrency/isolation matrix).
 
-The client rides Ignite's REST API (the ignite-rest-http module):
-``?cmd=get/put/cas`` against an atomic REPLICATED cache, where ``cas``
-is Ignite's native compare-and-put (``val2`` = expected) — so the
-register workload's CAS is a single server-side atomic op, no
-read-modify-write window. The bank workload needs the Java client's
-transactions and stays out of REST scope (run it against the SQL
-suites instead). DB automation unpacks the binary release, enables the
-REST module, writes static TcpDiscovery IP-finder config over the node
-list, and runs ignite.sh.
+Two transports:
+
+- **register** rides Ignite's REST API (the ignite-rest-http module):
+  ``?cmd=get/put/cas`` against an atomic REPLICATED cache, where
+  ``cas`` is Ignite's native compare-and-put (``val2`` = expected) —
+  so the register workload's CAS is a single server-side atomic op, no
+  read-modify-write window.
+- **bank** rides the from-scratch thin-client binary protocol
+  (:mod:`jepsen_tpu.suites._ignite`): OP_TX_START/OP_TX_END client
+  transactions around cache get/put on a TRANSACTIONAL cache — the
+  wire equivalent of the reference's ``.txStart`` + get/put/commit
+  dance (bank.clj:88-110), with ``--transaction-concurrency`` and
+  ``--transaction-isolation`` mirroring the reference's matrix
+  (runner.clj option surface).
+
+DB automation unpacks the binary release, enables the REST module,
+writes static TcpDiscovery IP-finder config over the node list
+(declaring both caches, so no client-side cache-configuration codec is
+needed), and runs ignite.sh.
 """
 from __future__ import annotations
 
 import logging
+import socket
+import time
 import urllib.error
 import urllib.parse
 
@@ -26,6 +39,7 @@ from jepsen_tpu.os_setup import Debian
 from jepsen_tpu.suites import (build_suite_test, standard_opt_fn,
                                standard_test_fn)
 from jepsen_tpu.suites._http import NET_ERRORS, http_json
+from jepsen_tpu.suites._ignite import IgniteError as WireError, ThinClient
 
 logger = logging.getLogger("jepsen.ignite")
 
@@ -34,7 +48,9 @@ DIR = "/opt/ignite"
 LOG_FILE = f"{DIR}/jepsen.log"
 PIDFILE = f"{DIR}/ignite.pid"
 REST_PORT = 8080
+THIN_PORT = 10800
 CACHE = "jepsen"
+BANK_CACHE = "ACCOUNTS"
 
 CONFIG_XML = """<?xml version="1.0" encoding="UTF-8"?>
 <beans xmlns="http://www.springframework.org/schema/beans"
@@ -44,12 +60,20 @@ CONFIG_XML = """<?xml version="1.0" encoding="UTF-8"?>
   <bean id="ignite.cfg"
         class="org.apache.ignite.configuration.IgniteConfiguration">
     <property name="cacheConfiguration">
-      <bean class="org.apache.ignite.configuration.CacheConfiguration">
-        <property name="name" value="%(cache)s"/>
-        <property name="cacheMode" value="REPLICATED"/>
-        <property name="atomicityMode" value="ATOMIC"/>
-        <property name="writeSynchronizationMode" value="FULL_SYNC"/>
-      </bean>
+      <list>
+        <bean class="org.apache.ignite.configuration.CacheConfiguration">
+          <property name="name" value="%(cache)s"/>
+          <property name="cacheMode" value="REPLICATED"/>
+          <property name="atomicityMode" value="ATOMIC"/>
+          <property name="writeSynchronizationMode" value="FULL_SYNC"/>
+        </bean>
+        <bean class="org.apache.ignite.configuration.CacheConfiguration">
+          <property name="name" value="%(bank_cache)s"/>
+          <property name="cacheMode" value="REPLICATED"/>
+          <property name="atomicityMode" value="TRANSACTIONAL"/>
+          <property name="writeSynchronizationMode" value="FULL_SYNC"/>
+        </bean>
+      </list>
     </property>
     <property name="discoverySpi">
       <bean class="org.apache.ignite.spi.discovery.tcp.TcpDiscoverySpi">
@@ -88,6 +112,7 @@ class IgniteDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
                             for n in (test.get("nodes") or []))
         control.exec_("tee", f"{DIR}/config/jepsen.xml",
                       stdin=CONFIG_XML % {"cache": CACHE,
+                                          "bank_cache": BANK_CACHE,
                                           "addresses": addresses})
         self.start(test, node)
         cu.await_tcp_port(REST_PORT, host=node)
@@ -171,22 +196,146 @@ class IgniteError(Exception):
     pass
 
 
-SUPPORTED_WORKLOADS = ("register",)
+class IgniteBankClient(Client):
+    """Bank transfers in thin-client transactions (the wire counterpart
+    of bank.clj's BankClient :66-110): read = txStart + getAll + commit;
+    transfer = txStart + two gets + two puts + commit, failing cleanly
+    (with a committed empty txn, like the reference) when the source
+    balance would go negative."""
+
+    def __init__(self, concurrency: str = "pessimistic",
+                 isolation: str = "repeatable-read",
+                 node: str | None = None, conn: ThinClient | None = None,
+                 timeout_s: float = 10.0):
+        self.concurrency = concurrency
+        self.isolation = isolation
+        self.node = node
+        self.conn = conn
+        self.timeout_s = timeout_s
+
+    def open(self, test, node):
+        conn = ThinClient(node, THIN_PORT, timeout_s=self.timeout_s)
+        conn.connect()
+        return IgniteBankClient(self.concurrency, self.isolation, node,
+                                conn, self.timeout_s)
+
+    def setup(self, test):
+        # every node's client seeds concurrently (core runs setup once
+        # per node): balances only written when absent, under one
+        # transaction, with commit conflicts treated as "another seeder
+        # won" and retried until the accounts verifiably exist
+        accounts = list(test.get("accounts", range(8)))
+        per = test.get("total-amount", 80) // max(len(accounts), 1)
+        for _ in range(20):
+            try:
+                self.conn.tx_start(self.concurrency, self.isolation)
+                existing = self.conn.cache_get_all(BANK_CACHE, accounts)
+                missing = [a for a in accounts if existing.get(a) is None]
+                for a in missing:
+                    self.conn.cache_put(BANK_CACHE, a, per)
+                self.conn.tx_end(True)
+                if not missing:
+                    return
+            except WireError:
+                self._abort_quietly()
+                time.sleep(0.2)
+            except (ConnectionError, socket.timeout, OSError):
+                self.conn.tx_id = None
+                raise
+        raise WireError(-1, "bank accounts never fully seeded")
+
+    def _abort_quietly(self):
+        try:
+            self.conn.tx_end(False)
+        except (WireError, ConnectionError, socket.timeout, OSError):
+            self.conn.tx_id = None
+
+    def invoke(self, test, op):
+        f, v = op.get("f"), op.get("value")
+        accounts = list(test.get("accounts", range(8)))
+        committing = False
+        try:
+            if self.conn.sock is None:   # dropped after a net error
+                self.conn.connect()
+            if f == "read":
+                self.conn.tx_start(self.concurrency, self.isolation)
+                balances = self.conn.cache_get_all(BANK_CACHE, accounts)
+                committing = True
+                self.conn.tx_end(True)
+                return {**op, "type": "ok",
+                        "value": {a: balances.get(a) for a in accounts}}
+            if f == "transfer":
+                frm, to = v["from"], v["to"]
+                amount = v["amount"]
+                self.conn.tx_start(self.concurrency, self.isolation)
+                b1 = (self.conn.cache_get(BANK_CACHE, frm) or 0) - amount
+                b2 = (self.conn.cache_get(BANK_CACHE, to) or 0) + amount
+                if b1 < 0:
+                    self.conn.tx_end(True)   # nothing written: commit ok
+                    return {**op, "type": "fail",
+                            "error": ["negative", frm, b1]}
+                self.conn.cache_put(BANK_CACHE, frm, b1)
+                self.conn.cache_put(BANK_CACHE, to, b2)
+                committing = True
+                self.conn.tx_end(True)
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": ["unknown-f", f]}
+        except WireError as e:
+            # a server-side error before commit (lock conflict, txn
+            # timeout) rolls the txn back: a clean fail. An error FROM
+            # the commit itself is indeterminate for transfers -> info.
+            self._abort_quietly()
+            kind = "info" if committing and f == "transfer" else "fail"
+            return {**op, "type": kind, "error": ["ignite", e.message]}
+        except (ConnectionError, socket.timeout, OSError) as e:
+            # half-read stream: drop the connection, reconnect next op
+            self.conn.close()
+            kind = "fail" if f == "read" or not committing else "info"
+            return {**op, "type": kind, "error": ["net", str(e)]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self._abort_quietly()
+            self.conn.close()
+
+
+SUPPORTED_WORKLOADS = ("register", "bank")
 
 
 def ignite_test(opts_dict: dict | None = None) -> dict:
+    o = dict(opts_dict or {})
+
+    def make_real(opts):
+        if (o.get("workload") or SUPPORTED_WORKLOADS[0]) == "bank":
+            client = IgniteBankClient(
+                opts.get("transaction_concurrency", "pessimistic"),
+                opts.get("transaction_isolation", "repeatable-read"))
+        else:
+            client = IgniteClient()
+        return {"db": IgniteDB(opts.get("version", DEFAULT_VERSION)),
+                "client": client, "os": Debian()}
+
     return build_suite_test(
-        opts_dict, db_name="ignite", supported_workloads=SUPPORTED_WORKLOADS,
-        make_real=lambda o: {
-            "db": IgniteDB(o.get("version", DEFAULT_VERSION)),
-            "client": IgniteClient(), "os": Debian()})
+        o, db_name="ignite", supported_workloads=SUPPORTED_WORKLOADS,
+        make_real=make_real)
+
+
+def _ignite_opts(p):
+    p.add_argument("--version", default=DEFAULT_VERSION)
+    p.add_argument("--transaction-concurrency", default="pessimistic",
+                   choices=["optimistic", "pessimistic"],
+                   dest="transaction_concurrency")
+    p.add_argument("--transaction-isolation", default="repeatable-read",
+                   choices=["read-committed", "repeatable-read",
+                            "serializable"],
+                   dest="transaction_isolation")
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(ignite_test, extra_keys=("version",)),
-    standard_opt_fn(SUPPORTED_WORKLOADS,
-                    extra=lambda p: p.add_argument(
-                        "--version", default=DEFAULT_VERSION)),
+    standard_test_fn(ignite_test,
+                     extra_keys=("version", "transaction_concurrency",
+                                 "transaction_isolation")),
+    standard_opt_fn(SUPPORTED_WORKLOADS, extra=_ignite_opts),
     name="jepsen-ignite")
 
 

@@ -1,0 +1,165 @@
+"""Lightweight op tracing: scoped spans with ids, annotations and
+attributes, exported as JSONL (reference:
+dgraph/src/jepsen/dgraph/trace.clj:1-73 — an opencensus wrapper whose
+spans ship to a Jaeger endpoint; here the same span surface writes a
+line-per-span log into the test's store directory, where the web UI and
+offline tooling can read it without a tracing service).
+
+Surface parity with the reference wrapper:
+
+- :func:`with_trace`  — the ``with-trace`` scoped-span macro (a context
+  manager; nested spans share the enclosing trace id)
+- :func:`context`     — current {span-id, trace-id}
+- :func:`annotate`    — timestamped annotation on the current span
+- :func:`attribute`   — string k/v attributes on the current span
+- :class:`TracedClient` — wraps any Client so each invoke runs in a
+  span named after the op's ``f`` (how the dgraph suite's ``--trace``
+  wires client ops, the with-trace call sites in dgraph/client.clj)
+
+Spans are buffered per tracer and flushed by ``close()`` (or each
+``max_buffer`` spans); a tracer with no path is a sampler that never
+samples — every call is a no-op, the reference's neverSample mode.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from jepsen_tpu.client import Client
+
+_local = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_local, "spans", None)
+    if s is None:
+        s = _local.spans = []
+    return s
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Tracer:
+    """Collects spans; ``path=None`` disables sampling entirely."""
+
+    def __init__(self, path: str | None, max_buffer: int = 512):
+        self.path = path
+        self.max_buffer = max_buffer
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    @contextmanager
+    def with_trace(self, name: str):
+        """Scoped span: nested calls inherit the trace id and parent."""
+        if not self.enabled():
+            yield self
+            return
+        stack = _stack()
+        parent = stack[-1] if stack else None
+        span = {
+            "name": name,
+            "span-id": _new_id(),
+            "trace-id": parent["trace-id"] if parent else _new_id(),
+            "parent-id": parent["span-id"] if parent else None,
+            "start": time.time(),
+            "annotations": [],
+            "attributes": {},
+        }
+        stack.append(span)
+        try:
+            yield self
+        finally:
+            stack.pop()
+            span["end"] = time.time()
+            self._emit(span)
+
+    def context(self) -> dict:
+        """{span-id, trace-id} of the current span (trace.clj context)."""
+        stack = _stack()
+        if not stack:
+            return {"span-id": None, "trace-id": None}
+        return {"span-id": stack[-1]["span-id"],
+                "trace-id": stack[-1]["trace-id"]}
+
+    def annotate(self, message: str) -> None:
+        stack = _stack()
+        if stack:
+            stack[-1]["annotations"].append(
+                {"t": time.time(), "message": str(message)})
+
+    def attribute(self, k, v=None) -> None:
+        """One pair or a map of pairs; values stringified (the
+        reference's all-strings opencensus constraint, kept for log
+        stability)."""
+        stack = _stack()
+        if not stack:
+            return
+        attrs = {k: v} if not isinstance(k, dict) else k
+        stack[-1]["attributes"].update(
+            {str(kk): str(vv) for kk, vv in attrs.items()})
+
+    def _emit(self, span: dict) -> None:
+        with self._lock:
+            self._buf.append(span)
+            if len(self._buf) >= self.max_buffer:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buf or not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            for span in self._buf:
+                f.write(json.dumps(span, default=str) + "\n")
+        self._buf.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+
+class TracedClient(Client):
+    """Wraps a client so every invoke runs inside a span named after the
+    op's f, attributed with node/process/type (the dgraph with-trace
+    call-site pattern)."""
+
+    def __init__(self, inner: Client, tracer: Tracer,
+                 node: str | None = None):
+        self.inner = inner
+        self.tracer = tracer
+        self.node = node
+
+    @property
+    def reusable(self):  # delegate reuse semantics
+        return getattr(self.inner, "reusable", False)
+
+    def open(self, test, node):
+        return TracedClient(self.inner.open(test, node), self.tracer, node)
+
+    def setup(self, test):
+        self.inner.setup(test)
+
+    def invoke(self, test, op):
+        with self.tracer.with_trace(f"invoke/{op.get('f')}"):
+            self.tracer.attribute({"node": self.node,
+                                   "process": op.get("process")})
+            out = self.inner.invoke(test, op)
+            self.tracer.attribute("type", out.get("type"))
+            if out.get("error") is not None:
+                self.tracer.attribute("error", out.get("error"))
+            return out
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def close(self, test):
+        self.inner.close(test)
+        self.tracer.close()

@@ -394,3 +394,53 @@ def test_fauna_fake_run_with_topology_fault():
     fs = {op.get("f") for op in result["history"]
           if not isinstance(op.get("process"), int)}
     assert fs & {"add-node", "remove-node"}, fs
+
+
+# ---------------------------------------------------------------------------
+# replica-aware partitions (nemesis.clj:29-55)
+# ---------------------------------------------------------------------------
+
+def test_replica_partition_ops_shapes(dummy):
+    import random
+
+    t, _ = dummy
+    topo = faunadb.FaunaTopology(replicas=3)
+    topo._ensure_topo(t)
+    start = faunadb.replica_partition_ops(topo, rng=random.Random(3))
+    seen = set()
+    for _ in range(40):
+        op = start(t, None)
+        assert op["f"] == "start-partition-replica"
+        v = op["value"]
+        grudge, ptype = v["grudge"], v["partition-type"]
+        seen.add(ptype[0])
+        if ptype[0] == "intra-replica":
+            # both sides live in ONE replica; other replicas untouched
+            members = {n["node"]: n["replica"] for n in topo.topo["nodes"]}
+            involved = set(grudge) | {x for xs in grudge.values()
+                                      for x in xs}
+            assert len({members[n] for n in involved}) == 1
+            assert ptype[1].startswith("replica-")
+        else:
+            # inter-replica: whole replica groups land on one side
+            members = {}
+            for n in topo.topo["nodes"]:
+                members.setdefault(n["replica"], set()).add(n["node"])
+            for group in members.values():
+                sides = {frozenset(grudge.get(n, [])) for n in group}
+                assert len(sides) == 1, "a replica must not be split"
+    assert seen == {"intra-replica", "inter-replica"}
+
+
+def test_replica_partition_fake_run_composes_with_topology():
+    result = run_fake(faunadb.faunadb_test, workload="register",
+                      time_limit=3.0, nemesis_interval=0.5,
+                      faults={"topology", "partition-replica"})
+    h = result["history"]
+    starts = [op for op in h if op.get("f") == "start-partition-replica"
+              and op.get("type") == "info"
+              and isinstance(op.get("value"), list)]
+    assert starts, "replica partitions must fire"
+    assert any(op.get("f") in ("add-node", "remove-node") for op in h), \
+        "topology nemesis must run alongside"
+    assert result["results"]["valid?"] is True

@@ -1,0 +1,284 @@
+"""Ignite thin-client wire tests: the binary protocol client against an
+in-process mock server (handshake, data objects, transactional cache
+ops with real rollback semantics), the suite bank client's error
+mapping, and the fake-mode bank lifecycle."""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites import _ignite as ig
+from jepsen_tpu.suites._ignite import (IgniteError, ThinClient, java_hash,
+                                       obj_long, obj_string, read_obj)
+from jepsen_tpu.suites._wire import recv_exact
+
+
+def test_java_hash_matches_jvm():
+    # well-known java.lang.String#hashCode values
+    assert java_hash("") == 0
+    assert java_hash("a") == 97
+    assert java_hash("abc") == 96354
+    assert java_hash("hello") == 99162322
+    assert java_hash("polygenelubricants") == -2147483648  # famous MIN_VALUE
+
+
+def test_data_object_roundtrip():
+    buf = obj_long(-7) + obj_string("héllo") + obj_string(None)
+    v1, off = read_obj(buf, 0)
+    v2, off = read_obj(buf, off)
+    v3, off = read_obj(buf, off)
+    assert (v1, v2, v3) == (-7, "héllo", None)
+    assert off == len(buf)
+
+
+class MockIgnite:
+    """Thin-protocol server: handshake + GET/PUT/GET_ALL + client
+    transactions with buffered writes (committed on TX_END(true),
+    discarded on TX_END(false) or disconnect)."""
+
+    def __init__(self, reject_handshake=False):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.lock = threading.Lock()
+        self.caches: dict[int, dict] = {}
+        self.tx_seq = 0
+        self.reject_handshake = reject_handshake
+        self.fail_next: str | None = None   # op name to fail once
+        self.stop = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def close(self):
+        self.stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self.stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        # per-connection ambient txn: id -> buffered writes
+        open_tx: dict[int, dict] = {}
+        try:
+            n = struct.unpack("<i", recv_exact(conn, 4))[0]
+            body = recv_exact(conn, n)
+            assert body[0] == 1
+            if self.reject_handshake:
+                msg = obj_string("unsupported version")
+                out = struct.pack("<bhhh", 0, 1, 6, 0) + msg
+                conn.sendall(struct.pack("<i", len(out)) + out)
+                return
+            conn.sendall(struct.pack("<ib", 1, 1))
+            while True:
+                n = struct.unpack("<i", recv_exact(conn, 4))[0]
+                body = recv_exact(conn, n)
+                conn.sendall(self._dispatch(body, open_tx))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            open_tx.clear()   # disconnect rolls back open txns
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _resp(rid, payload=b"", status=0, msg=""):
+        body = struct.pack("<qi", rid, status)
+        if status != 0:
+            body += obj_string(msg)
+        else:
+            body += payload
+        return struct.pack("<i", len(body)) + body
+
+    def _cache_view(self, cache_id, open_tx, tx_id):
+        base = self.caches.setdefault(cache_id, {})
+        if tx_id is not None and tx_id in open_tx:
+            mine = {k: v for (cid, k), v in open_tx[tx_id].items()
+                    if cid == cache_id}
+            return {**base, **mine}
+        return base
+
+    def _dispatch(self, body, open_tx) -> bytes:
+        op, rid = struct.unpack_from("<hq", body, 0)
+        off = 10
+        with self.lock:
+            if self.fail_next:
+                name, self.fail_next = self.fail_next, None
+                if name == "any":
+                    return self._resp(rid, status=1,
+                                      msg="injected server error")
+            if op == ig.OP_TX_START:
+                self.tx_seq += 1
+                open_tx[self.tx_seq] = {}
+                return self._resp(rid, struct.pack("<i", self.tx_seq))
+            if op == ig.OP_TX_END:
+                tx_id, committed = struct.unpack_from("<ib", body, off)
+                writes = open_tx.pop(tx_id, None)
+                if writes is None:
+                    return self._resp(rid, status=1, msg="unknown tx")
+                if committed:
+                    for (cid, k), v in writes.items():
+                        self.caches.setdefault(cid, {})[k] = v
+                return self._resp(rid)
+            # cache ops: header = cache_id i32, flags byte [, tx i32]
+            cid, flags = struct.unpack_from("<ib", body, off)
+            off += 5
+            tx_id = None
+            if flags & ig.FLAG_TRANSACTIONAL:
+                tx_id = struct.unpack_from("<i", body, off)[0]
+                off += 4
+                if tx_id not in open_tx:
+                    return self._resp(rid, status=1, msg="stale tx")
+            if op == ig.OP_CACHE_GET:
+                k, off = read_obj(body, off)
+                view = self._cache_view(cid, open_tx, tx_id)
+                v = view.get(k)
+                return self._resp(rid, obj_long(v) if v is not None
+                                  else struct.pack("<b", ig.TYPE_NULL))
+            if op == ig.OP_CACHE_PUT:
+                k, off = read_obj(body, off)
+                v, off = read_obj(body, off)
+                if tx_id is not None:
+                    open_tx[tx_id][(cid, k)] = v
+                else:
+                    self.caches.setdefault(cid, {})[k] = v
+                return self._resp(rid)
+            if op == ig.OP_CACHE_GET_ALL:
+                count = struct.unpack_from("<i", body, off)[0]
+                off += 4
+                keys = []
+                for _ in range(count):
+                    k, off = read_obj(body, off)
+                    keys.append(k)
+                view = self._cache_view(cid, open_tx, tx_id)
+                out = struct.pack("<i", len(keys))
+                for k in keys:
+                    v = view.get(k)
+                    out += obj_long(k)
+                    out += obj_long(v) if v is not None \
+                        else struct.pack("<b", ig.TYPE_NULL)
+                return self._resp(rid, out)
+            return self._resp(rid, status=1, msg=f"unsupported op {op}")
+
+
+@pytest.fixture()
+def server():
+    s = MockIgnite()
+    yield s
+    s.close()
+
+
+def test_handshake_and_basic_ops(server):
+    c = ThinClient("127.0.0.1", server.port).connect()
+    c.cache_put("ACCOUNTS", 1, 100)
+    assert c.cache_get("ACCOUNTS", 1) == 100
+    assert c.cache_get("ACCOUNTS", 2) is None
+    assert c.cache_get_all("ACCOUNTS", [1, 2]) == {1: 100, 2: None}
+    c.close()
+
+
+def test_handshake_rejection():
+    s = MockIgnite(reject_handshake=True)
+    try:
+        with pytest.raises(IgniteError, match="handshake"):
+            ThinClient("127.0.0.1", s.port).connect()
+    finally:
+        s.close()
+
+
+def test_transaction_commit_and_rollback(server):
+    c = ThinClient("127.0.0.1", server.port).connect()
+    c.cache_put("ACCOUNTS", 0, 50)
+    # rollback: writes invisible afterwards
+    c.tx_start()
+    c.cache_put("ACCOUNTS", 0, 7)
+    assert c.cache_get("ACCOUNTS", 0) == 7      # own-write visible in tx
+    c.tx_end(False)
+    assert c.cache_get("ACCOUNTS", 0) == 50
+    # commit: applied atomically
+    c.tx_start()
+    c.cache_put("ACCOUNTS", 0, 10)
+    c.cache_put("ACCOUNTS", 1, 40)
+    c.tx_end(True)
+    assert c.cache_get_all("ACCOUNTS", [0, 1]) == {0: 10, 1: 40}
+    c.close()
+
+
+def test_server_error_raises(server):
+    c = ThinClient("127.0.0.1", server.port).connect()
+    server.fail_next = "any"
+    with pytest.raises(IgniteError, match="injected"):
+        c.cache_get("ACCOUNTS", 0)
+    c.close()
+
+
+def test_suite_bank_client_against_mock(server, monkeypatch):
+    from jepsen_tpu.suites import ignite as suite
+
+    monkeypatch.setattr(suite, "THIN_PORT", server.port)
+    test = {"accounts": list(range(4)), "total-amount": 40}
+    c = suite.IgniteBankClient().open(test, "127.0.0.1")
+    c.setup(test)
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert out["type"] == "ok"
+    assert sum(out["value"].values()) == 40
+    ok = c.invoke(test, {"f": "transfer", "process": 0,
+                         "value": {"from": 0, "to": 1, "amount": 5}})
+    assert ok["type"] == "ok"
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert out["value"][0] == 5 and out["value"][1] == 15
+    assert sum(out["value"].values()) == 40
+    # overdraft fails cleanly and moves nothing
+    bad = c.invoke(test, {"f": "transfer", "process": 0,
+                          "value": {"from": 0, "to": 1, "amount": 99}})
+    assert bad["type"] == "fail" and bad["error"][0] == "negative"
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert sum(out["value"].values()) == 40
+    # injected server error pre-commit -> clean fail, txn rolled back
+    server.fail_next = "any"
+    err = c.invoke(test, {"f": "transfer", "process": 0,
+                          "value": {"from": 1, "to": 0, "amount": 1}})
+    assert err["type"] == "fail" and err["error"][0] == "ignite"
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert sum(out["value"].values()) == 40
+    c.close(test)
+
+
+def test_suite_bank_client_net_error_reconnects(server, monkeypatch):
+    from jepsen_tpu.suites import ignite as suite
+
+    monkeypatch.setattr(suite, "THIN_PORT", server.port)
+    test = {"accounts": list(range(4)), "total-amount": 40}
+    c = suite.IgniteBankClient().open(test, "127.0.0.1")
+    c.setup(test)
+    c.conn.sock.close()   # simulate a dropped connection
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert out["type"] == "fail" and out["error"][0] == "net"
+    # next invoke reconnects transparently
+    out = c.invoke(test, {"f": "read", "value": None, "process": 0})
+    assert out["type"] == "ok" and sum(out["value"].values()) == 40
+    c.close(test)
+
+
+def test_ignite_bank_fake_lifecycle():
+    from conftest import run_fake
+    from jepsen_tpu.suites.ignite import ignite_test
+
+    res = run_fake(ignite_test, workload="bank", time_limit=2.0)
+    r = res["results"]
+    assert r["valid?"] is True, r
+    assert r["workload"]["valid?"] is True
+    assert r["stats"]["count"] > 0

@@ -345,3 +345,63 @@ def test_fauna_pages_read_not_found_fails():
     out = TClient(node="n1").invoke(
         {"pages": True}, {"f": "read", "type": "invoke", "value": [2, None]})
     assert out["type"] == "fail", out
+
+
+# ---------------------------------------------------------------------------
+# op tracing (dgraph/trace.clj analog, jepsen_tpu/tracing.py)
+# ---------------------------------------------------------------------------
+
+def test_tracer_spans_nest_and_export(tmp_path):
+    import json
+
+    from jepsen_tpu.tracing import Tracer
+
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(path)
+    with tr.with_trace("outer"):
+        outer_ctx = tr.context()
+        tr.annotate("started")
+        with tr.with_trace("inner"):
+            inner_ctx = tr.context()
+            tr.attribute("k", "v")
+        assert tr.context()["span-id"] == outer_ctx["span-id"]
+    tr.close()
+    spans = [json.loads(line) for line in open(path)]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"outer", "inner"}
+    assert inner_ctx["trace-id"] == outer_ctx["trace-id"]
+    assert by_name["inner"]["parent-id"] == outer_ctx["span-id"]
+    assert by_name["inner"]["attributes"] == {"k": "v"}
+    assert by_name["outer"]["annotations"][0]["message"] == "started"
+    assert by_name["outer"]["end"] >= by_name["outer"]["start"]
+
+
+def test_tracer_disabled_is_noop():
+    from jepsen_tpu.tracing import Tracer
+
+    tr = Tracer(None)
+    with tr.with_trace("x"):
+        tr.annotate("y")
+        tr.attribute("a", "b")
+    assert tr.context() == {"span-id": None, "trace-id": None}
+    tr.close()   # nothing written, nothing raised
+
+
+def test_dgraph_trace_fake_run(tmp_path):
+    import json
+
+    from jepsen_tpu import core
+    from jepsen_tpu.suites.dgraph import dgraph_test
+
+    t = dgraph_test({"fake": True, "time_limit": 1.0, "no_perf": True,
+                     "accelerator": "cpu", "trace": True,
+                     "store_dir": str(tmp_path)})
+    res = core.run(t)
+    assert res["results"]["valid?"] is True
+    t["tracer"].close()
+    spans = [json.loads(line)
+             for line in open(tmp_path / "trace.jsonl")]
+    assert spans, "client ops must produce spans"
+    assert all(s["name"].startswith("invoke/") for s in spans)
+    assert all(s["attributes"].get("type") in ("ok", "fail", "info")
+               for s in spans)

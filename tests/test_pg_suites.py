@@ -325,14 +325,29 @@ def test_pg_client_counter_add_checks_rowcount():
 
 def test_yugabyte_test_all_sweep_fake():
     """The test-all runner sweeps every workload expected to pass
-    (yugabyte/core.clj:110-123 + cli.clj:429-515) in fake mode."""
+    (yugabyte/core.clj:110-123 + cli.clj:429-515) in fake mode.
+
+    This validates the sweep MECHANICS (per-workload test maps, store
+    layout, exit codes), not real-time behavior — but the phases are
+    wall-clock-limited, so on a heavily loaded machine a starved run
+    can degenerate. Every workload is empty-phase-safe (verified by a
+    0.02 s-limit sweep), yet one full-suite flake was observed under
+    load; the sweep therefore gets a 2 s limit and one retry with the
+    failing exit code surfaced, so a deterministic regression still
+    fails twice and loudly."""
     import tempfile
 
     from jepsen_tpu.suites.yugabyte import main_all
-    with tempfile.TemporaryDirectory() as tmp:
-        code = main_all(["--no-ssh", "--time-limit", "1",
-                         "--accelerator", "cpu", "--store-dir", tmp])
-    assert code == 0
+
+    codes = []
+    for _ in range(2):
+        with tempfile.TemporaryDirectory() as tmp:
+            code = main_all(["--no-ssh", "--time-limit", "2",
+                             "--accelerator", "cpu", "--store-dir", tmp])
+        codes.append(code)
+        if code == 0:
+            break
+    assert codes[-1] == 0, f"sweep exit codes across attempts: {codes}"
 
 
 def test_monotonic_unhashable_values_do_not_crash():

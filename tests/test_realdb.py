@@ -33,10 +33,12 @@ def _free_port() -> int:
 def _await_conn(factory, proc, timeout_s: float = 30.0, dt: float = 0.3):
     """Retries ``factory()`` until it connects; raises early when the
     daemon has already exited (a dead daemon must not spin the whole
-    timeout and surface as a generic connection error)."""
+    timeout and surface as a generic connection error). ``proc=None``
+    means the server is externally managed (docker/realdb ADDR mode):
+    only the timeout applies."""
     deadline = time.time() + timeout_s
     while True:
-        if proc.poll() is not None:
+        if proc is not None and proc.poll() is not None:
             raise RuntimeError(f"daemon exited rc={proc.returncode}")
         try:
             return factory()
@@ -46,9 +48,22 @@ def _await_conn(factory, proc, timeout_s: float = 30.0, dt: float = 0.3):
             time.sleep(dt)
 
 
-def _await_port(port: int, proc, timeout_s: float = 20.0) -> None:
+def _addr(env_var: str) -> tuple[str, int] | None:
+    """host:port of an ALREADY-RUNNING server (the docker/realdb
+    compose services), or None to spawn a scratch daemon from a local
+    binary. Hosts must be reachable as plain TCP (the compose file maps
+    every service onto 127.0.0.1)."""
+    v = os.environ.get(env_var)
+    if not v:
+        return None
+    host, _, port = v.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _await_port(port: int, proc, timeout_s: float = 20.0,
+                host: str = "127.0.0.1") -> None:
     def probe():
-        socket.create_connection(("127.0.0.1", port), timeout=1).close()
+        socket.create_connection((host, port), timeout=1).close()
 
     _await_conn(probe, proc, timeout_s=timeout_s, dt=0.2)
 
@@ -298,16 +313,29 @@ def test_realdb_mysql_wire_client(tmp_path, monkeypatch):
     native-password auth, CRUD, the serializable bank workload through
     the full suite lifecycle (VERDICT r3 item 6 — the PG template at
     test_realdb_postgres_wire_client, one protocol over)."""
-    mysqld = _find("mariadbd", "JEPSEN_MYSQLD_BIN") \
-        or _find("mysqld", "JEPSEN_MYSQLD_BIN")
-    if not mysqld:
-        pytest.skip("mysqld/mariadbd not installed")
-    install = _find("mariadb-install-db", "JEPSEN_MYSQL_INSTALL_BIN") \
-        or _find("mysql_install_db", "JEPSEN_MYSQL_INSTALL_BIN")
+    addr = _addr("JEPSEN_MYSQL_ADDR")
+    mysqld = install = None
+    if addr is None:
+        mysqld = _find("mariadbd", "JEPSEN_MYSQLD_BIN") \
+            or _find("mysqld", "JEPSEN_MYSQLD_BIN")
+        if not mysqld:
+            pytest.skip("mysqld/mariadbd not installed and no "
+                        "JEPSEN_MYSQL_ADDR")
+        install = _find("mariadb-install-db", "JEPSEN_MYSQL_INSTALL_BIN") \
+            or _find("mysql_install_db", "JEPSEN_MYSQL_INSTALL_BIN")
 
     from jepsen_tpu.suites import galera as galera_suite
     from jepsen_tpu.suites._mysql import MySQLConnection, MySQLError
 
+    if addr is not None:
+        # docker mode: server already up with a password-less root
+        # (MYSQL_ALLOW_EMPTY_PASSWORD=yes in docker/realdb)
+        host, port = addr
+        _mysql_body(None, host, port, galera_suite, MySQLConnection,
+                    MySQLError, tmp_path, monkeypatch)
+        return
+
+    host = "127.0.0.1"
     port = _free_port()
     data = tmp_path / "mysqldata"
     sock = tmp_path / "mysql.sock"
@@ -331,42 +359,53 @@ def test_realdb_mysql_wire_client(tmp_path, monkeypatch):
     proc = subprocess.Popen(base_args, stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     try:
-        _await_port(port, proc)
-
-        # native-password auth (empty root pw) + CRUD over our own wire
-        conn = _await_conn(
-            lambda: MySQLConnection("127.0.0.1", port=port, user="root",
-                                    password="", database="mysql"), proc)
-        rows = conn.query("SELECT 1 + 1")
-        assert int(rows[0][0]) == 2
-
-        conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
-        conn.query("CREATE USER IF NOT EXISTS 'jepsen'@'%' IDENTIFIED "
-                   "WITH mysql_native_password BY 'jepsen'")
-        conn.query("GRANT ALL PRIVILEGES ON jepsen.* TO 'jepsen'@'%'")
-        conn.query("FLUSH PRIVILEGES")
-
-        # authenticated CRUD as the workload user (non-empty password
-        # exercises the scramble path)
-        c2 = MySQLConnection("127.0.0.1", port=port, user="jepsen",
-                             password="jepsen", database="jepsen")
-        c2.query("CREATE TABLE t (k INT PRIMARY KEY, v INT)")
-        c2.query("INSERT INTO t VALUES (1, 10)")
-        c2.query("UPDATE t SET v = 11 WHERE k = 1")
-        rows = c2.query("SELECT v FROM t WHERE k = 1")
-        assert int(rows[0][0]) == 11
-        with pytest.raises(MySQLError):
-            c2.query("INSERT INTO t VALUES (1, 12)")  # duplicate key
-
-        # bank workload end-to-end: dummy remote no-ops the node
-        # automation, the client speaks the real protocol to the daemon
-        monkeypatch.setattr(galera_suite, "PORT", port)
-        result = _run_suite(galera_suite.galera_test, tmp_path / "store",
-                            workload="bank", time_limit=5)
-        assert result["results"]["valid?"] is True, result["results"]
+        _mysql_body(proc, host, port, galera_suite, MySQLConnection,
+                    MySQLError, tmp_path, monkeypatch)
     finally:
         proc.kill()
         proc.wait()
+
+
+def _mysql_body(proc, host, port, galera_suite, MySQLConnection,
+                MySQLError, tmp_path, monkeypatch):
+    """Auth + CRUD + bank lifecycle, shared by the scratch-daemon and
+    ADDR (docker) modes. The workload table is dropped first so a
+    reused server stays rerun-safe."""
+    _await_port(port, proc, host=host)
+
+    # native-password auth (empty root pw) + CRUD over our own wire
+    conn = _await_conn(
+        lambda: MySQLConnection(host, port=port, user="root",
+                                password="", database="mysql"), proc)
+    rows = conn.query("SELECT 1 + 1")
+    assert int(rows[0][0]) == 2
+
+    conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+    conn.query("CREATE USER IF NOT EXISTS 'jepsen'@'%' IDENTIFIED "
+               "WITH mysql_native_password BY 'jepsen'")
+    conn.query("GRANT ALL PRIVILEGES ON jepsen.* TO 'jepsen'@'%'")
+    conn.query("FLUSH PRIVILEGES")
+
+    # authenticated CRUD as the workload user (non-empty password
+    # exercises the scramble path)
+    c2 = MySQLConnection(host, port=port, user="jepsen",
+                         password="jepsen", database="jepsen")
+    c2.query("DROP TABLE IF EXISTS t")
+    c2.query("CREATE TABLE t (k INT PRIMARY KEY, v INT)")
+    c2.query("INSERT INTO t VALUES (1, 10)")
+    c2.query("UPDATE t SET v = 11 WHERE k = 1")
+    rows = c2.query("SELECT v FROM t WHERE k = 1")
+    assert int(rows[0][0]) == 11
+    with pytest.raises(MySQLError):
+        c2.query("INSERT INTO t VALUES (1, 12)")  # duplicate key
+    c2.query("DROP TABLE IF EXISTS accounts")   # bank kit rerun-safety
+
+    # bank workload end-to-end: dummy remote no-ops the node
+    # automation, the client speaks the real protocol to the daemon
+    monkeypatch.setattr(galera_suite, "PORT", port)
+    result = _run_suite(galera_suite.galera_test, tmp_path / "store",
+                        workload="bank", time_limit=5, nodes=[host])
+    assert result["results"]["valid?"] is True, result["results"]
 
 
 # ---------------------------------------------------------------------------
@@ -376,30 +415,40 @@ def test_realdb_rethinkdb_wire_client(tmp_path, monkeypatch):
     """Scratch single-node rethinkdb + the bundled ReQL driver: V0_4
     handshake, DDL, CRUD terms, then the register and set workloads
     through the suite lifecycle."""
-    rethinkdb_bin = _find("rethinkdb", "JEPSEN_RETHINKDB_BIN")
-    if not rethinkdb_bin:
-        pytest.skip("rethinkdb not installed")
+    addr = _addr("JEPSEN_RETHINKDB_ADDR")
+    rethinkdb_bin = None
+    if addr is None:
+        rethinkdb_bin = _find("rethinkdb", "JEPSEN_RETHINKDB_BIN")
+        if not rethinkdb_bin:
+            pytest.skip("rethinkdb not installed and no "
+                        "JEPSEN_RETHINKDB_ADDR")
 
     from jepsen_tpu.suites import rethinkdb as r_suite
     from jepsen_tpu.suites import _reql as r
     from jepsen_tpu.suites._reql import ReqlConnection
 
-    driver_port = _free_port()
-    cluster_port = _free_port()
-    proc = subprocess.Popen(
-        [rethinkdb_bin, "--directory", str(tmp_path / "rdb"),
-         "--bind", "127.0.0.1", "--driver-port", str(driver_port),
-         "--cluster-port", str(cluster_port), "--no-http-admin",
-         "--no-update-check"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    proc = None
+    if addr is not None:
+        host, driver_port = addr
+    else:
+        host = "127.0.0.1"
+        driver_port = _free_port()
+        cluster_port = _free_port()
+        proc = subprocess.Popen(
+            [rethinkdb_bin, "--directory", str(tmp_path / "rdb"),
+             "--bind", "127.0.0.1", "--driver-port", str(driver_port),
+             "--cluster-port", str(cluster_port), "--no-http-admin",
+             "--no-update-check"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
-        _await_port(driver_port, proc, timeout_s=60)
+        _await_port(driver_port, proc, timeout_s=60, host=host)
         conn = _await_conn(
-            lambda: ReqlConnection("127.0.0.1", driver_port), proc)
-        conn.run(r.db_create("smoke"))
-        conn.run(r.table_create(r.db("smoke"), "t"))
-        conn.run(r.insert(r.table(r.db("smoke"), "t"), {"id": 1, "v": 5}))
-        out = conn.run(r.get_field(r.get(r.table(r.db("smoke"), "t"), 1),
+            lambda: ReqlConnection(host, driver_port), proc)
+        db = f"smoke_{os.urandom(4).hex()}"   # rerun-safe database
+        conn.run(r.db_create(db))
+        conn.run(r.table_create(r.db(db), "t"))
+        conn.run(r.insert(r.table(r.db(db), "t"), {"id": 1, "v": 5}))
+        out = conn.run(r.get_field(r.get(r.table(r.db(db), "t"), 1),
                                    "v"))
         assert out == 5
 
@@ -407,11 +456,13 @@ def test_realdb_rethinkdb_wire_client(tmp_path, monkeypatch):
         for workload in ("register", "set"):
             result = _run_suite(r_suite.rethinkdb_test,
                                 tmp_path / f"store-{workload}",
-                                workload=workload, time_limit=5)
+                                workload=workload, time_limit=5,
+                                nodes=[host])
             assert result["results"]["valid?"] is True, result["results"]
     finally:
-        proc.kill()
-        proc.wait()
+        if proc is not None:
+            proc.kill()
+            proc.wait()
 
 
 # ---------------------------------------------------------------------------
@@ -421,44 +472,55 @@ def test_realdb_rabbitmq_wire_client(tmp_path, monkeypatch):
     """Scratch rabbitmq-server + the bundled AMQP 0-9-1 client:
     handshake, declare/publish/get/ack, then the queue workload through
     the suite lifecycle."""
-    server = _find("rabbitmq-server", "JEPSEN_RABBITMQ_BIN")
-    if not server:
-        pytest.skip("rabbitmq-server not installed")
+    addr = _addr("JEPSEN_RABBITMQ_ADDR")
+    server = None
+    if addr is None:
+        server = _find("rabbitmq-server", "JEPSEN_RABBITMQ_BIN")
+        if not server:
+            pytest.skip("rabbitmq-server not installed and no "
+                        "JEPSEN_RABBITMQ_ADDR")
 
     from jepsen_tpu.suites import rabbitmq as mq_suite
     from jepsen_tpu.suites._amqp import AmqpConnection
 
-    port = _free_port()
-    env = dict(os.environ,
-               RABBITMQ_NODENAME=f"jepsen{port}@localhost",
-               RABBITMQ_NODE_PORT=str(port),
-               RABBITMQ_NODE_IP_ADDRESS="127.0.0.1",
-               RABBITMQ_DIST_PORT=str(_free_port()),
-               RABBITMQ_MNESIA_BASE=str(tmp_path / "mnesia"),
-               RABBITMQ_LOG_BASE=str(tmp_path / "log"),
-               RABBITMQ_PID_FILE=str(tmp_path / "pid"),
-               RABBITMQ_ENABLED_PLUGINS_FILE=str(tmp_path / "plugins"))
-    proc = subprocess.Popen([server], env=env,
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+    proc = None
+    if addr is not None:
+        host, port = addr
+    else:
+        host = "127.0.0.1"
+        port = _free_port()
+        env = dict(os.environ,
+                   RABBITMQ_NODENAME=f"jepsen{port}@localhost",
+                   RABBITMQ_NODE_PORT=str(port),
+                   RABBITMQ_NODE_IP_ADDRESS="127.0.0.1",
+                   RABBITMQ_DIST_PORT=str(_free_port()),
+                   RABBITMQ_MNESIA_BASE=str(tmp_path / "mnesia"),
+                   RABBITMQ_LOG_BASE=str(tmp_path / "log"),
+                   RABBITMQ_PID_FILE=str(tmp_path / "pid"),
+                   RABBITMQ_ENABLED_PLUGINS_FILE=str(tmp_path / "plugins"))
+        proc = subprocess.Popen([server], env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
     try:
-        _await_port(port, proc, timeout_s=90)
-        conn = _await_conn(lambda: AmqpConnection("127.0.0.1", port),
+        _await_port(port, proc, timeout_s=90, host=host)
+        conn = _await_conn(lambda: AmqpConnection(host, port),
                            proc, timeout_s=60, dt=0.5)
+        q = f"smoke_{os.urandom(4).hex()}"   # rerun-safe queue
         conn.confirm_select()
-        conn.queue_declare("smoke")
-        conn.publish("smoke", b"42")
-        tag, body = conn.get("smoke")
+        conn.queue_declare(q)
+        conn.publish(q, b"42")
+        tag, body = conn.get(q)
         assert body == b"42"
         conn.ack(tag)
 
         monkeypatch.setattr(mq_suite, "PORT", port)
         result = _run_suite(mq_suite.rabbitmq_test, tmp_path / "store",
-                            workload="queue", time_limit=5)
+                            workload="queue", time_limit=5, nodes=[host])
         assert result["results"]["valid?"] is True, result["results"]
     finally:
-        proc.kill()
-        proc.wait()
+        if proc is not None:
+            proc.kill()
+            proc.wait()
 
 
 # ---------------------------------------------------------------------------
@@ -468,12 +530,49 @@ def test_realdb_cassandra_cql_wire_client(tmp_path):
     """Scratch single-node Cassandra + the from-scratch CQL v4 client:
     STARTUP, DDL, typed Rows decode, counters, and LWT — the protocol
     surface the YCQL suite rides, against a real CQL server (the
-    scripted-server tests' semantics check)."""
-    cassandra_bin = _find("cassandra", "JEPSEN_CASSANDRA_BIN")
-    if not cassandra_bin:
-        pytest.skip("cassandra not installed")
+    scripted-server tests' semantics check). JEPSEN_CASSANDRA_ADDR
+    targets an already-running server (docker/realdb) instead of
+    spawning one."""
+    addr = _addr("JEPSEN_CASSANDRA_ADDR")
+    cassandra_bin = None
+    if addr is None:
+        cassandra_bin = _find("cassandra", "JEPSEN_CASSANDRA_BIN")
+        if not cassandra_bin:
+            pytest.skip("cassandra not installed and no "
+                        "JEPSEN_CASSANDRA_ADDR")
 
     from jepsen_tpu.suites._cql_client import CQLConnection
+
+    if addr is not None:
+        host, port = addr
+        ks = f"smoke_{os.urandom(4).hex()}"   # rerun-safe keyspace
+        conn = _await_conn(lambda: CQLConnection(host, port), None,
+                           timeout_s=60, dt=0.5)
+        try:
+            conn.query(f"CREATE KEYSPACE {ks} WITH replication = "
+                       "{'class': 'SimpleStrategy', "
+                       "'replication_factor': 1}")
+            conn.query(f"CREATE TABLE {ks}.t (k INT PRIMARY KEY, v INT)")
+            conn.query(f"INSERT INTO {ks}.t (k, v) VALUES (1, 10)")
+            rows = conn.query(f"SELECT k, v FROM {ks}.t WHERE k = 1")
+            assert rows == [{"k": 1, "v": 10}]
+            rows = conn.query(
+                f"UPDATE {ks}.t SET v = 11 WHERE k = 1 IF v = 10")
+            assert rows and rows[0].get("[applied]") is True
+            rows = conn.query(
+                f"UPDATE {ks}.t SET v = 12 WHERE k = 1 IF v = 99")
+            assert rows and rows[0].get("[applied]") is False
+            conn.query(f"CREATE TABLE {ks}.c (id INT PRIMARY KEY, "
+                       "n COUNTER)")
+            conn.query(f"UPDATE {ks}.c SET n = n + 5 WHERE id = 0")
+            rows = conn.query(f"SELECT n FROM {ks}.c WHERE id = 0")
+            assert rows[0]["n"] == 5
+        finally:
+            try:
+                conn.query(f"DROP KEYSPACE {ks}")
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        return
 
     port = _free_port()
     storage_port = _free_port()
@@ -538,12 +637,25 @@ def test_realdb_aerospike_wire_client(tmp_path, monkeypatch):
     """Scratch single-node asd + the from-scratch binary protocol
     client: info, put/get, generation CAS, string append, then the
     register workload through the suite lifecycle."""
-    asd = _find("asd", "JEPSEN_ASD_BIN")
-    if not asd:
-        pytest.skip("asd (aerospike) not installed")
+    addr = _addr("JEPSEN_AEROSPIKE_ADDR")
+    asd = None
+    if addr is None:
+        asd = _find("asd", "JEPSEN_ASD_BIN")
+        if not asd:
+            pytest.skip("asd (aerospike) not installed and no "
+                        "JEPSEN_AEROSPIKE_ADDR")
 
     from jepsen_tpu.suites import aerospike as as_suite
     from jepsen_tpu.suites._aerospike import AerospikeConnection
+
+    if addr is not None:
+        host, port = addr
+        # docker images ship namespace "test"; scratch daemons use the
+        # suite's "jepsen"
+        ns = os.environ.get("JEPSEN_AEROSPIKE_NS", "test")
+        _aerospike_body(None, host, port, ns, as_suite,
+                        AerospikeConnection, tmp_path, monkeypatch)
+        return
 
     port = _free_port()
     conf = tmp_path / "asd.conf"
@@ -577,35 +689,48 @@ namespace jepsen {{
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     try:
-        _await_port(port, proc, timeout_s=60)
-
-        def first_contact():
-            c = AerospikeConnection("127.0.0.1", port, namespace="jepsen",
-                                    set_name="registers")
-            c.put(1, 10)  # retried too: partitions settle after the port
-            return c
-
-        conn = _await_conn(first_contact, proc)
-        value, gen = conn.get(1)
-        assert value == 10
-        applied = conn.put(1, 11, generation=gen)
-        assert applied
-        stale = conn.put(1, 12, generation=gen)  # gen moved on: rejected
-        assert not stale
-        conn.append(2, " 7")
-        conn.append(2, " 9")
-        assert conn.get_string(2).split() == ["7", "9"]
-        conn.incr(3, 4)
-        value, _ = conn.get(3)
-        assert value == 4
-
-        monkeypatch.setattr(as_suite, "PORT", port)
-        result = _run_suite(as_suite.aerospike_test, tmp_path / "store",
-                            workload="register", time_limit=5)
-        assert result["results"]["valid?"] is True, result["results"]
+        _aerospike_body(proc, "127.0.0.1", port, "jepsen", as_suite,
+                        AerospikeConnection, tmp_path, monkeypatch)
     finally:
         proc.kill()
         proc.wait()
+
+
+def _aerospike_body(proc, host, port, ns, as_suite, AerospikeConnection,
+                    tmp_path, monkeypatch):
+    """Protocol assertions + suite lifecycle, shared by the scratch-asd
+    and ADDR (docker) modes. Keys are randomized so a reused server
+    (docker) stays rerun-safe."""
+    import random
+
+    _await_port(port, proc, timeout_s=60, host=host)
+    k1, k2, k3 = random.sample(range(1 << 30), 3)
+
+    def first_contact():
+        c = AerospikeConnection(host, port, namespace=ns,
+                                set_name="registers")
+        c.put(k1, 10)  # retried too: partitions settle after the port
+        return c
+
+    conn = _await_conn(first_contact, proc)
+    value, gen = conn.get(k1)
+    assert value == 10
+    applied = conn.put(k1, 11, generation=gen)
+    assert applied
+    stale = conn.put(k1, 12, generation=gen)  # gen moved on: rejected
+    assert not stale
+    conn.append(k2, " 7")
+    conn.append(k2, " 9")
+    assert conn.get_string(k2).split() == ["7", "9"]
+    conn.incr(k3, 4)
+    value, _ = conn.get(k3)
+    assert value == 4
+
+    monkeypatch.setattr(as_suite, "PORT", port)
+    monkeypatch.setattr(as_suite, "NAMESPACE", ns)
+    result = _run_suite(as_suite.aerospike_test, tmp_path / "store",
+                        workload="register", time_limit=5, nodes=[host])
+    assert result["results"]["valid?"] is True, result["results"]
 
 
 @pytest.mark.realdb
@@ -617,13 +742,34 @@ def test_hazelcast_real_member_cp_lock(tmp_path, monkeypatch):
     distribution (or hz-start on PATH) and a JVM."""
     import glob
 
+    from jepsen_tpu.suites import hazelcast as hz_suite
+
+    addr = _addr("JEPSEN_HAZELCAST_ADDR")
+    if addr is not None:
+        # docker/realdb mode: a CP-enabled cluster is already up
+        host, port = addr
+        monkeypatch.setattr(hz_suite, "PORT", port)
+
+        def factory():
+            c = hz_suite.HzCPClient("lock").open({}, host)
+            out = c.invoke({}, {"f": "acquire", "process": 0,
+                                "value": None})
+            assert out["type"] == "ok" and out["value"] > 0, out
+            assert c.invoke({}, {"f": "release", "process": 0,
+                                 "value": None})["type"] == "ok"
+            c.close({})
+            return True
+
+        assert _await_conn(factory, None, timeout_s=180.0)
+        return
+
     home = os.environ.get("JEPSEN_HAZELCAST_HOME")
     binary = (glob.glob(os.path.join(home, "bin", "hz-start"))[0]
               if home and glob.glob(os.path.join(home, "bin", "hz-start"))
               else shutil.which("hz-start"))
     if not binary:
-        pytest.skip("no hazelcast distribution available")
-    from jepsen_tpu.suites import hazelcast as hz_suite
+        pytest.skip("no hazelcast distribution available and no "
+                    "JEPSEN_HAZELCAST_ADDR")
 
     ports = [_free_port() for _ in range(3)]
     members = ", ".join(f"127.0.0.1:{p}" for p in ports)
