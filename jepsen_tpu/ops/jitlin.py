@@ -586,6 +586,11 @@ MATRIX_MAX_ELEMS = 1 << 28
 # smaller dispatches overlap their transfers with compute better while
 # C=2 keeps G at the ~256 sweet spot
 MATRIX_SUB_KEYS = 128
+MATRIX_PIPELINE_KEYS = 32   # sub-batch size for mid-size key batches
+#                             (33..128 keys): small enough that 2-4
+#                             dispatches pipeline host prep against
+#                             device compute, large enough that each
+#                             still fills the chunk-count target
 
 
 def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
@@ -687,29 +692,37 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         num_states = max(len(s.intern) for s in streams)
     V = _bucket(num_states, floor=8)
     B = len(streams)
-    preps = [_returns_prepass(np.asarray(s.kind), np.asarray(s.slot),
-                              np.asarray(s.f), np.asarray(s.a),
-                              np.asarray(s.b))
-             for s in streams]
-    S = max(p[3] for p in preps)
-    R_max = max((p[0].shape[0] for p in preps), default=0)
+    # global (S, R_max) from cheap metadata passes, so the EXPENSIVE
+    # prepass can run per sub-batch inside the dispatch pipeline below
+    # (every sub-batch still compiles at the one shared shape)
+    kinds = [np.asarray(s.kind) for s in streams]
+    slots_np = [np.asarray(s.slot) for s in streams]
+    S = max(int(sl.max(initial=0)) + 1 for sl in slots_np)
+    R_max = max(int((k == EV_RETURN).sum()) for k in kinds)
     if R_max == 0:
         return [(True, -1, False, 0)] * B
 
-    # Large key batches split into sub-dispatches of MATRIX_SUB_KEYS:
-    # per-step cost grows superlinearly with G = B*C past the measured
-    # sweet spot (the [G, MV, MV] intermediates go HBM-bound), so a
-    # pipeline of bounded dispatches beats one huge dispatch. All
-    # sub-batches are submitted BEFORE any result is read, so host prep
-    # and grid transfers for batch k+1 overlap batch k's device compute
-    # — on a tunneled accelerator that hides most of the transfer
-    # wall-clock.
-    # (A mesh shards G across devices, shifting the sweet spot; the mesh
-    # path keeps the single dispatch.)
-    if mesh is None and B > MATRIX_SUB_KEYS:
+    def prep(i):
+        s = streams[i]
+        return _returns_prepass(kinds[i], slots_np[i], np.asarray(s.f),
+                                np.asarray(s.a), np.asarray(s.b))
+
+    # Key batches split into pipelined sub-dispatches: per-step cost
+    # grows superlinearly with G = B*C past the measured sweet spot
+    # (the [G, MV, MV] intermediates go HBM-bound), so a pipeline of
+    # bounded dispatches beats one huge dispatch. Sub-batch k+1's host
+    # prepass + grid build + transfer all run while batch k computes on
+    # device (dispatches are async; nothing is read back until the
+    # end) — on a tunneled accelerator that hides most of the host
+    # wall-clock. MATRIX_PIPELINE_KEYS extends the overlap to mid-size
+    # batches (r4 weak #4: 64-key configs were tunnel/host-bound).
+    # (A mesh shards G across devices, shifting the sweet spot; the
+    # mesh path keeps the single dispatch.)
+    sub = MATRIX_SUB_KEYS if B > MATRIX_SUB_KEYS else MATRIX_PIPELINE_KEYS
+    if mesh is None and B > sub:
         handles = []
-        for lo in range(0, B, MATRIX_SUB_KEYS):
-            sl = preps[lo:lo + MATRIX_SUB_KEYS]
+        for lo in range(0, B, sub):
+            sl = [prep(i) for i in range(lo, min(lo + sub, B))]
             handles.append((len(sl), _matrix_dispatch(
                 sl, S, R_max, V, step_ids, init_state, None)))
         # ONE batched host transfer for the whole pipeline — per-handle
@@ -721,7 +734,8 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         return out
 
     alive, inexact = jax.device_get(_matrix_dispatch(
-        preps, S, R_max, V, step_ids, init_state, mesh))
+        [prep(i) for i in range(B)], S, R_max, V, step_ids, init_state,
+        mesh))
     return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
 
 
@@ -752,7 +766,14 @@ def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh,
             f"matrix_check_batch out of regime: B*MV^2 = {B * MV * MV} "
             f"> {MATRIX_MAX_ELEMS}; split the key batch or use the scan")
     rb = _bucket(R_max, floor=64)
-    C = int(np.clip(256 // B, 1, 256))
+    # chunk-count target, measured on-chip (r5 sweep, 64x1k keys):
+    # G = B*C ≈ 2048 beats the old 256 target by ~9% on key BATCHES
+    # (234k -> 254k ops/s; 4096 flat, 8192 degrades HBM-bound), while
+    # single histories (B=1, incl. the segmented scale path) measured
+    # best at the old 256 — padding past their return count buys
+    # nothing. Per-key C stays capped at 256.
+    target_g = 256 if B == 1 else 2048
+    C = int(np.clip(target_g // B, 1, 256))
     C = max(1, min(C, MATRIX_MAX_ELEMS // (B * MV * MV)))
     if mesh is not None:
         # G = B*C must divide over the mesh or the sharding guard below

@@ -68,21 +68,29 @@ INVALID_FENCE = 0
 
 MSG = {
     "client.authentication": 0x000100,
+    "map.put": 0x010100,
+    "map.get": 0x010200,
+    "map.replaceifsame": 0x010500,
+    "map.putifabsent": 0x010E00,
+    "fencedlock.lock": 0x070100,
+    "fencedlock.trylock": 0x070200,
+    "fencedlock.unlock": 0x070300,
+    "atomiclong.addandget": 0x090300,
+    "atomiclong.compareandset": 0x090400,
+    "atomiclong.get": 0x090500,
+    "atomiclong.getandset": 0x090700,
+    "atomicref.compareandset": 0x0A0200,
+    "atomicref.get": 0x0A0400,
+    "atomicref.set": 0x0A0500,
+    "semaphore.init": 0x0C0100,
+    "semaphore.acquire": 0x0C0200,
+    "semaphore.release": 0x0C0300,
+    "flakeidgen.newidbatch": 0x1C0100,
     "cpgroup.createcpgroup": 0x1E0100,
     "cpsession.createsession": 0x1F0100,
     "cpsession.closesession": 0x1F0200,
     "cpsession.heartbeatsession": 0x1F0300,
     "cpsession.generatethreadid": 0x1F0400,
-    "atomiclong.addandget": 0x090300,
-    "atomiclong.compareandset": 0x090400,
-    "atomiclong.get": 0x090500,
-    "atomiclong.getandset": 0x090700,
-    "fencedlock.lock": 0x070100,
-    "fencedlock.trylock": 0x070200,
-    "fencedlock.unlock": 0x070300,
-    "semaphore.init": 0x0C0100,
-    "semaphore.acquire": 0x0C0200,
-    "semaphore.release": 0x0C0300,
 }
 
 
@@ -207,6 +215,96 @@ def decode_raft_group(frames: list[Frame], i: int) -> tuple[RaftGroupId, int]:
     return RaftGroupId(name, seed, gid), j
 
 
+def murmur3_x86_32(data: bytes, seed: int = 0x01000193) -> int:
+    """Murmur3 32-bit (hazelcast's default-seed variant) — partition
+    routing hashes the key Data's payload with it."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def hash_to_index(hash_: int, length: int) -> int:
+    """Java HashUtil.hashToIndex: MIN_VALUE pins to 0, else abs % n."""
+    if length <= 0:
+        return 0
+    if hash_ == -(1 << 31):
+        return 0
+    return abs(hash_) % length
+
+
+# -- hazelcast serialization (Data) -----------------------------------------
+# Map/AtomicRef values travel as serialized "Data" blobs:
+# ``partition-hash(be i32) | type-id(be i32) | payload`` with the
+# built-in constant serializer ids (Integer -7, Long -8, String -11,
+# long[] -17) and BIG-endian payloads — the one big-endian corner of an
+# otherwise little-endian protocol.
+
+TYPE_LONG_JAVA = -8
+TYPE_STRING_JAVA = -11
+TYPE_LONG_ARRAY_JAVA = -17
+
+
+def data_long(v: int) -> bytes:
+    return struct.pack(">iiq", 0, TYPE_LONG_JAVA, v)
+
+
+def data_string(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">iii", 0, TYPE_STRING_JAVA, len(b)) + b
+
+
+def data_long_array(vals) -> bytes:
+    vals = list(vals)
+    return struct.pack(">iii", 0, TYPE_LONG_ARRAY_JAVA, len(vals)) + \
+        b"".join(struct.pack(">q", v) for v in vals)
+
+
+def decode_data(blob: bytes):
+    """Decodes the Data types this client writes; anything else returns
+    the raw payload bytes (callers treat unknown types opaquely)."""
+    if len(blob) < 8:
+        return None
+    type_id = struct.unpack_from(">i", blob, 4)[0]
+    body = blob[8:]
+    if type_id == TYPE_LONG_JAVA:
+        return struct.unpack(">q", body)[0]
+    if type_id == TYPE_STRING_JAVA:
+        n = struct.unpack_from(">i", body, 0)[0]
+        return body[4:4 + n].decode("utf-8")
+    if type_id == TYPE_LONG_ARRAY_JAVA:
+        n = struct.unpack_from(">i", body, 0)[0]
+        return list(struct.unpack_from(f">{n}q", body, 4)) if n else []
+    return body
+
+
 def decode_error(frames: list[Frame]) -> HzError:
     """ErrorCodec response: a list-of-ErrorHolder data structure; each
     holder = BEGIN, fixed [errorCode(4)], className str, message
@@ -247,6 +345,7 @@ class HzClient:
         self._groups: dict[str, RaftGroupId] = {}
         self._sessions: dict[tuple[str, int], tuple[int, float, float]] = {}
         self._thread_id: int | None = None
+        self.partition_count = 0   # from the auth response
 
     # -- connection/auth ----------------------------------------------------
 
@@ -274,7 +373,23 @@ class HzClient:
         if status != 0:
             raise HzError(status, "AuthenticationException",
                           f"status {status}")
+        # fixed response fields: status(1) memberUuid(17) serVersion(1)
+        # partitionCount(4) ... — the count drives map partition routing
+        off = RESPONSE_HEADER + 19
+        if len(frames[0].payload) >= off + 4:
+            self.partition_count = struct.unpack_from(
+                "<i", frames[0].payload, off)[0]
         return self
+
+    def _partition_of(self, key_data: bytes) -> int:
+        """Partition id for a key Data blob: murmur3 of the payload
+        (header skipped), hashToIndex over the member's partition count.
+        -1 (server-side routing refused by real members for map tasks)
+        only when the auth response carried no count."""
+        if self.partition_count <= 0:
+            return -1
+        return hash_to_index(murmur3_x86_32(key_data[8:]),
+                             self.partition_count)
 
     def close(self):
         close_quietly(self.sock)
@@ -423,6 +538,103 @@ class HzClient:
             fixed=struct.pack("<qq", sid, tid) + encode_uuid(random_uuid()),
             var=raft_group_frames(g) + [str_frame(name)])
         return bool(self._fixed(frames, "<b"))
+
+    # -- IMap (Data-typed distributed map) ----------------------------------
+
+    def map_get(self, name: str, key: bytes):
+        """Decoded value or None (key is a serialized Data blob)."""
+        blob = self.map_get_raw(name, key)
+        return None if blob is None else decode_data(blob)
+
+    def map_get_raw(self, name: str, key: bytes) -> bytes | None:
+        """The stored Data blob itself — replaceIfSame compares
+        byte-for-byte, so CAS callers must hand back EXACTLY what the
+        server holds."""
+        frames = self._invoke(MSG["map.get"],
+                              fixed=struct.pack("<q", 1),  # thread id
+                              var=[str_frame(name), Frame(key)],
+                              partition=self._partition_of(key))
+        if len(frames) < 2 or frames[1].is_null():
+            return None
+        return frames[1].payload
+
+    def map_put(self, name: str, key: bytes, value: bytes):
+        """Previous decoded value or None. ttl -1 = map default."""
+        frames = self._invoke(MSG["map.put"],
+                              fixed=struct.pack("<qq", 1, -1),
+                              var=[str_frame(name), Frame(key),
+                                   Frame(value)],
+                              partition=self._partition_of(key))
+        return self._nullable_data(frames)
+
+    def map_put_if_absent(self, name: str, key: bytes, value: bytes):
+        """Existing decoded value, or None when this put won."""
+        frames = self._invoke(MSG["map.putifabsent"],
+                              fixed=struct.pack("<qq", 1, -1),
+                              var=[str_frame(name), Frame(key),
+                                   Frame(value)],
+                              partition=self._partition_of(key))
+        return self._nullable_data(frames)
+
+    def map_replace_if_same(self, name: str, key: bytes, expected: bytes,
+                            value: bytes) -> bool:
+        """Server-side CAS: replace only when the stored Data equals
+        ``expected`` byte-for-byte (the reference map workload's
+        ``.replace`` three-arg form, hazelcast.clj:469-489)."""
+        frames = self._invoke(MSG["map.replaceifsame"],
+                              fixed=struct.pack("<q", 1),
+                              var=[str_frame(name), Frame(key),
+                                   Frame(expected), Frame(value)],
+                              partition=self._partition_of(key))
+        return bool(self._fixed(frames, "<b"))
+
+    @staticmethod
+    def _nullable_data(frames: list[Frame]):
+        if len(frames) < 2 or frames[1].is_null():
+            return None
+        return decode_data(frames[1].payload)
+
+    # -- CP AtomicReference (Data-typed) ------------------------------------
+
+    def atomic_ref_get(self, name: str):
+        g = self.cp_group(name)
+        frames = self._invoke(MSG["atomicref.get"],
+                              var=raft_group_frames(g) + [str_frame(name)])
+        return self._nullable_data(frames)
+
+    def atomic_ref_set(self, name: str, value) -> None:
+        g = self.cp_group(name)
+        blob = data_long(value) if value is not None else None
+        self._invoke(MSG["atomicref.set"],
+                     var=raft_group_frames(g) + [str_frame(name),
+                     NULL_FRAME if blob is None else Frame(blob)])
+
+    def atomic_ref_compare_and_set(self, name: str, expected, value) \
+            -> bool:
+        """CAS over nullable long refs (the atomic-ref id/cas clients,
+        hazelcast.clj:211-249)."""
+        g = self.cp_group(name)
+        eb = None if expected is None else data_long(expected)
+        vb = None if value is None else data_long(value)
+        frames = self._invoke(
+            MSG["atomicref.compareandset"],
+            var=raft_group_frames(g) + [
+                str_frame(name),
+                NULL_FRAME if eb is None else Frame(eb),
+                NULL_FRAME if vb is None else Frame(vb)])
+        return bool(self._fixed(frames, "<b"))
+
+    # -- FlakeIdGenerator ---------------------------------------------------
+
+    def flake_id_batch(self, name: str, batch_size: int = 1) \
+            -> tuple[int, int, int]:
+        """(base, increment, count) — ids are base + k*increment for
+        k < count (the id-gen workload's newId, hazelcast.clj:252-264;
+        5.x replaced the 3.x IdGenerator with flake ids)."""
+        frames = self._invoke(MSG["flakeidgen.newidbatch"],
+                              fixed=struct.pack("<i", batch_size),
+                              var=[str_frame(name)])
+        return self._fixed(frames, "<qqi")
 
     # -- Semaphore ----------------------------------------------------------
 

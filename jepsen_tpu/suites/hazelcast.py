@@ -232,13 +232,23 @@ SEMAPHORE_NAME = "jepsen.cpSemaphore"
 ATOMIC_NAME = "jepsen.atomic-long"
 CAS_NAME = "jepsen.cas-long"
 
-# workload name -> which CP object family the client drives
+# workload name -> which object family the binary-protocol client
+# drives (everything here rides HzCPClient; "map"-named modes use IMap
+# over the same connection, CP modes add Raft-group/session plumbing)
 CP_MODES = {
     "lock": "lock", "cp-lock": "lock", "reentrant-cp-lock": "lock",
     "fenced-lock": "lock", "reentrant-fenced-lock": "lock",
     "cp-semaphore": "semaphore",
-    "atomic-long-ids": "ids", "cp-cas-long": "cas",
+    "atomic-long-ids": "ids", "cp-id-gen-long": "ids",
+    "cp-cas-long": "cas",
+    "atomic-ref-ids": "ref-ids", "cp-cas-reference": "cas-ref",
+    "id-gen-ids": "flake-ids",
+    "map-set": "map", "crdt-map": "crdt",
 }
+
+MAP_KEY = "hi"   # the reference map workload's single contended key
+REF_NAME = "jepsen.atomic-ref"
+FLAKE_NAME = "jepsen.id-gen"
 
 
 class HzCPClient(Client):
@@ -263,10 +273,17 @@ class HzCPClient(Client):
                 conn.semaphore_init(SEMAPHORE_NAME, cp_wl.NUM_PERMITS)
             except HzError:
                 pass  # already initialised by a sibling
+        if self.mode == "cas-ref":
+            try:
+                # ground a fresh (nil) ref at 0 so the CAS-register
+                # model's initial state is exact
+                conn.atomic_ref_compare_and_set(REF_NAME, None, 0)
+            except HzError:
+                pass
         return HzCPClient(self.mode, node, conn, self.timeout_s)
 
     def invoke(self, test, op):
-        f = op.get("f")
+        f, v = op.get("f"), op.get("value")
         try:
             if self.conn.sock is None:   # dropped after a net error
                 self.conn.connect()
@@ -290,6 +307,62 @@ class HzCPClient(Client):
                 if f == "generate":
                     v = self.conn.atomic_add_and_get(ATOMIC_NAME, 1)
                     return {**op, "type": "ok", "value": v}
+            elif self.mode == "ref-ids":
+                if f == "generate":
+                    # optimistic increment over a CP AtomicReference
+                    # (hazelcast.clj:232-249 atomic-ref-id-client)
+                    v = self.conn.atomic_ref_get(REF_NAME)
+                    v2 = (v or 0) + 1
+                    if self.conn.atomic_ref_compare_and_set(REF_NAME,
+                                                            v, v2):
+                        return {**op, "type": "ok", "value": v2}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+            elif self.mode == "flake-ids":
+                if f == "generate":
+                    base, _inc, _n = self.conn.flake_id_batch(FLAKE_NAME)
+                    return {**op, "type": "ok", "value": base}
+            elif self.mode == "cas-ref":
+                v = op.get("value")
+                if f == "read":
+                    return {**op, "type": "ok",
+                            "value": self.conn.atomic_ref_get(REF_NAME)}
+                if f == "write":
+                    self.conn.atomic_ref_set(REF_NAME, int(v))
+                    return {**op, "type": "ok"}
+                if f == "cas":
+                    old, new = v
+                    if self.conn.atomic_ref_compare_and_set(
+                            REF_NAME, int(old), int(new)):
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+            elif self.mode in ("map", "crdt"):
+                from jepsen_tpu.suites import _hazelcast as wire
+                name = "jepsen.crdt-map" if self.mode == "crdt" \
+                    else "jepsen.map"
+                key = wire.data_string(MAP_KEY)
+                if f == "add":
+                    # long-array CRDT-ish set under one key, grown by
+                    # server-side CAS (hazelcast.clj:453-506: replace /
+                    # putIfAbsent over sorted long arrays — hazelcast
+                    # serialization can't merge HashSets)
+                    cur = self.conn.map_get_raw(name, key)
+                    if cur is None:
+                        won = self.conn.map_put_if_absent(
+                            name, key, wire.data_long_array([int(v)]))
+                        if won is None:
+                            return {**op, "type": "ok"}
+                        return {**op, "type": "fail",
+                                "error": "cas-failed"}
+                    have = wire.decode_data(cur) or []
+                    new = sorted(set(have) | {int(v)})
+                    if self.conn.map_replace_if_same(
+                            name, key, cur, wire.data_long_array(new)):
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+                if f == "read":
+                    got = self.conn.map_get(name, key)
+                    return {**op, "type": "ok",
+                            "value": sorted(got or [])}
             elif self.mode == "cas":
                 v = op.get("value")
                 if f == "read":
@@ -356,6 +429,8 @@ class CPFakeStore(MetaLogDB):
         self.sem: dict = {}
         self.along = 0
         self.ids = 0
+        self.ref = None
+        self.map_set: set = set()
 
     def try_lock(self, p) -> int:
         """Fence if acquired (same fence on reentrant re-acquire), 0 if
@@ -414,6 +489,38 @@ class CPFakeStore(MetaLogDB):
                 return True
             return False
 
+    def ref_get(self):
+        with self.lock:
+            return self.ref
+
+    def ref_set(self, v) -> None:
+        with self.lock:
+            self.ref = v
+
+    def ref_cas(self, old, new) -> bool:
+        with self.lock:
+            if self.ref == old:
+                self.ref = new
+                return True
+            return False
+
+    def ref_cas_grounded(self, old: int, new: int) -> bool:
+        """CAS with a fresh (None) ref reading as 0 — the cas-ref
+        client grounds the reference at 0 on open."""
+        with self.lock:
+            if (self.ref if self.ref is not None else 0) == old:
+                self.ref = new
+                return True
+            return False
+
+    def map_add(self, v: int) -> None:
+        with self.lock:
+            self.map_set.add(int(v))
+
+    def map_read(self) -> list:
+        with self.lock:
+            return sorted(self.map_set)
+
 
 class CPFakeClient(Client):
     """Fake-mode twin of HzCPClient over a CPFakeStore."""
@@ -452,6 +559,36 @@ class CPFakeClient(Client):
         elif self.mode == "ids":
             if f == "generate":
                 return {**op, "type": "ok", "value": self.store.next_id()}
+        elif self.mode in ("ref-ids", "flake-ids"):
+            if f == "generate":
+                if self.mode == "flake-ids":
+                    return {**op, "type": "ok",
+                            "value": self.store.next_id()}
+                v = self.store.ref_get()
+                v2 = (v or 0) + 1
+                if self.store.ref_cas(v, v2):
+                    return {**op, "type": "ok", "value": v2}
+                return {**op, "type": "fail", "error": "cas-failed"}
+        elif self.mode == "cas-ref":
+            v = op.get("value")
+            if f == "read":
+                got = self.store.ref_get()
+                return {**op, "type": "ok",
+                        "value": got if got is not None else 0}
+            if f == "write":
+                self.store.ref_set(int(v))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = v
+                if self.store.ref_cas_grounded(int(old), int(new)):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-failed"}
+        elif self.mode in ("map", "crdt"):
+            if f == "add":
+                self.store.map_add(int(v))
+                return {**op, "type": "ok"}
+            if f == "read":
+                return {**op, "type": "ok", "value": self.store.map_read()}
         elif self.mode == "cas":
             v = op.get("value")
             if f == "read":
@@ -467,28 +604,40 @@ class CPFakeClient(Client):
         return {**op, "type": "fail", "error": ["unknown-f", f]}
 
 
-SUPPORTED_WORKLOADS = ("queue", "map", "lock", "cp-lock",
-                       "reentrant-cp-lock", "fenced-lock",
+SUPPORTED_WORKLOADS = ("queue", "map", "map-set", "crdt-map", "lock",
+                       "cp-lock", "reentrant-cp-lock", "fenced-lock",
                        "reentrant-fenced-lock", "cp-semaphore",
-                       "atomic-long-ids", "cp-cas-long")
+                       "atomic-long-ids", "cp-id-gen-long",
+                       "atomic-ref-ids", "id-gen-ids", "cp-cas-long",
+                       "cp-cas-reference")
 
 
 def _hazelcast_workload(name: str, base: dict) -> dict:
-    """map = the r/w register subset (the REST map API exposes get/put
-    but no CAS); the CP workloads ride the workload kits in
-    workloads/cp_lock.py against the binary-protocol client."""
+    """map = the r/w register subset over REST (kept for transport
+    parity); map-set / crdt-map = the reference's long-array CAS set
+    over the binary protocol (set checker); the CP workloads ride the
+    kits in workloads/cp_lock.py against the binary-protocol client."""
     acc = base["accelerator"]
     if name == "map":
         from jepsen_tpu.workloads import register as register_wl
         return register_wl.workload(base, accelerator=acc, ops=("r", "w"))
+    if name in ("map-set", "crdt-map"):
+        from jepsen_tpu.workloads import set_workload
+        wl = set_workload.workload(base, accelerator=acc)
+        wl["stats_ungated_fs"] = ("add",)   # CAS-raced adds fail
+        return wl
     if name in ("lock", "cp-lock", "reentrant-cp-lock", "fenced-lock",
                 "reentrant-fenced-lock"):
         return cp_wl.lock_workload(base, accelerator=acc, flavor=name)
     if name == "cp-semaphore":
         return cp_wl.semaphore_workload(base, accelerator=acc)
-    if name == "atomic-long-ids":
-        return cp_wl.ids_workload(base, accelerator=acc)
-    if name == "cp-cas-long":
+    if name in ("atomic-long-ids", "cp-id-gen-long", "atomic-ref-ids",
+                "id-gen-ids"):
+        wl = cp_wl.ids_workload(base, accelerator=acc)
+        if name == "atomic-ref-ids":
+            wl["stats_ungated_fs"] = ("generate",)   # optimistic CAS
+        return wl
+    if name in ("cp-cas-long", "cp-cas-reference"):
         return cp_wl.cas_long_workload(base, accelerator=acc)
     from jepsen_tpu.suites import workload_registry
 

@@ -126,6 +126,9 @@ class MockMember:
         self.locks: dict = {}   # name -> [holder(sid,tid)|None, holds, fence]
         self.sem: dict = {}     # name -> {holder: count}
         self.sem_permits: dict = {}
+        self.maps: dict = {}    # map name -> {key blob: value blob}
+        self.refs: dict = {}    # ref name -> Data blob | None
+        self.flake = 0
         self.max_holds = max_holds
         self.permits = permits
         self.auths = 0
@@ -300,6 +303,68 @@ class MockMember:
                         "not a permit holder")
                 held[(sid, tid)] -= 1
                 return self._resp(rtype, corr, struct.pack("<b", 1))
+            if rtype == MSG["map.get"]:
+                name = frames[1].payload.decode()
+                got = self.maps.get(name, {}).get(bytes(frames[2].payload))
+                return self._resp(rtype, corr, b"",
+                                  [NULL_FRAME if got is None
+                                   else Frame(got)])
+            if rtype == MSG["map.put"]:
+                name = frames[1].payload.decode()
+                m = self.maps.setdefault(name, {})
+                k = bytes(frames[2].payload)
+                old = m.get(k)
+                m[k] = bytes(frames[3].payload)
+                return self._resp(rtype, corr, b"",
+                                  [NULL_FRAME if old is None
+                                   else Frame(old)])
+            if rtype == MSG["map.putifabsent"]:
+                name = frames[1].payload.decode()
+                m = self.maps.setdefault(name, {})
+                k = bytes(frames[2].payload)
+                old = m.get(k)
+                if old is None:
+                    m[k] = bytes(frames[3].payload)
+                return self._resp(rtype, corr, b"",
+                                  [NULL_FRAME if old is None
+                                   else Frame(old)])
+            if rtype == MSG["map.replaceifsame"]:
+                name = frames[1].payload.decode()
+                m = self.maps.setdefault(name, {})
+                k = bytes(frames[2].payload)
+                ok = m.get(k) == bytes(frames[3].payload)
+                if ok:
+                    m[k] = bytes(frames[4].payload)
+                return self._resp(rtype, corr, struct.pack("<b", ok))
+            if rtype == MSG["atomicref.get"]:
+                _, name = self._group_and_name(frames)
+                got = self.refs.get(name)
+                return self._resp(rtype, corr, b"",
+                                  [NULL_FRAME if got is None
+                                   else Frame(got)])
+            if rtype == MSG["atomicref.set"]:
+                g, j = hz.decode_raft_group(frames, 1)
+                name = frames[j].payload.decode()
+                vf = frames[j + 1]
+                self.refs[name] = None if vf.is_null() \
+                    else bytes(vf.payload)
+                return self._resp(rtype, corr)
+            if rtype == MSG["atomicref.compareandset"]:
+                g, j = hz.decode_raft_group(frames, 1)
+                name = frames[j].payload.decode()
+                ef, vf = frames[j + 1], frames[j + 2]
+                expected = None if ef.is_null() else bytes(ef.payload)
+                ok = self.refs.get(name) == expected
+                if ok:
+                    self.refs[name] = None if vf.is_null() \
+                        else bytes(vf.payload)
+                return self._resp(rtype, corr, struct.pack("<b", ok))
+            if rtype == MSG["flakeidgen.newidbatch"]:
+                size = struct.unpack_from("<i", fixed, 0)[0]
+                base = self.flake
+                self.flake += size
+                return self._resp(rtype, corr,
+                                  struct.pack("<qqi", base, 1, size))
             return self._error(corr, -1, "java.lang."
                                "UnsupportedOperationException",
                                hex(rtype))
@@ -455,7 +520,7 @@ def test_suite_net_error_mapping(monkeypatch):
 # fake-mode lifecycle for every CP workload
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("wl", ["cp-lock", "reentrant-cp-lock",
+@pytest.mark.parametrize("wl", ["lock", "cp-lock", "reentrant-cp-lock",
                                 "fenced-lock", "reentrant-fenced-lock",
                                 "cp-semaphore", "atomic-long-ids",
                                 "cp-cas-long"])
@@ -468,3 +533,115 @@ def test_hazelcast_cp_fake_lifecycle(wl):
     assert r["valid?"] is True, r
     assert r["workload"]["valid?"] is True
     assert r["stats"]["count"] > 0
+
+
+def test_data_codec_roundtrip():
+    from jepsen_tpu.suites._hazelcast import (data_long, data_long_array,
+                                              data_string, decode_data)
+
+    assert decode_data(data_long(-5)) == -5
+    assert decode_data(data_string("héllo")) == "héllo"
+    assert decode_data(data_long_array([3, 1, 2])) == [3, 1, 2]
+    assert decode_data(data_long_array([])) == []
+
+
+def test_map_cas_set_ops(member):
+    from jepsen_tpu.suites._hazelcast import (data_long_array, data_string,
+                                              decode_data)
+
+    c1, c2 = _client(member), _client(member)
+    key = data_string("hi")
+    # first add wins via putIfAbsent
+    assert c1.map_put_if_absent("jepsen.map", key,
+                                data_long_array([1])) is None
+    # losing putIfAbsent returns the existing value
+    assert c2.map_put_if_absent("jepsen.map", key,
+                                data_long_array([9])) == [1]
+    # CAS grow: must hand back the exact stored blob
+    cur = c1.map_get_raw("jepsen.map", key)
+    assert decode_data(cur) == [1]
+    assert c1.map_replace_if_same("jepsen.map", key, cur,
+                                  data_long_array([1, 2])) is True
+    # a stale CAS (old blob) is rejected
+    assert c2.map_replace_if_same("jepsen.map", key, cur,
+                                  data_long_array([1, 9])) is False
+    assert c2.map_get("jepsen.map", key) == [1, 2]
+    c1.close()
+    c2.close()
+
+
+def test_atomic_ref_and_flake_ids(member):
+    c = _client(member)
+    assert c.atomic_ref_get("jepsen.r") is None
+    assert c.atomic_ref_compare_and_set("jepsen.r", None, 0) is True
+    assert c.atomic_ref_compare_and_set("jepsen.r", None, 5) is False
+    assert c.atomic_ref_compare_and_set("jepsen.r", 0, 7) is True
+    assert c.atomic_ref_get("jepsen.r") == 7
+    c.atomic_ref_set("jepsen.r", 9)
+    assert c.atomic_ref_get("jepsen.r") == 9
+    b0 = c.flake_id_batch("jepsen.g", 4)
+    b1 = c.flake_id_batch("jepsen.g", 4)
+    ids0 = {b0[0] + k * b0[1] for k in range(b0[2])}
+    ids1 = {b1[0] + k * b1[1] for k in range(b1[2])}
+    assert not ids0 & ids1, "batches must not overlap"
+    c.close()
+
+
+def test_suite_map_and_ref_clients_against_mock(member, monkeypatch):
+    from jepsen_tpu.suites import hazelcast as suite
+
+    monkeypatch.setattr(suite, "PORT", member.port)
+    m1 = suite.HzCPClient("map").open({}, "127.0.0.1")
+    m2 = suite.HzCPClient("map").open({}, "127.0.0.1")
+    assert m1.invoke({}, _op("add", 0, 1))["type"] == "ok"
+    assert m2.invoke({}, _op("add", 1, 2))["type"] == "ok"
+    got = m1.invoke({}, _op("read", 0))
+    assert got["type"] == "ok" and got["value"] == [1, 2]
+    refs = suite.HzCPClient("ref-ids").open({}, "127.0.0.1")
+    seen = {refs.invoke({}, _op("generate", 0))["value"]
+            for _ in range(4)}
+    assert seen == {1, 2, 3, 4}
+    flake = suite.HzCPClient("flake-ids").open({}, "127.0.0.1")
+    fl = [flake.invoke({}, _op("generate", 0))["value"] for _ in range(4)]
+    assert len(set(fl)) == 4
+    casr = suite.HzCPClient("cas-ref").open({}, "127.0.0.1")
+    assert casr.invoke({}, _op("cas", 0, [0, 3]))["type"] in ("ok", "fail")
+    for c in (m1, m2, refs, flake, casr):
+        c.close({})
+
+
+@pytest.mark.parametrize("wl", ["map-set", "crdt-map", "atomic-ref-ids",
+                                "id-gen-ids", "cp-id-gen-long",
+                                "cp-cas-reference"])
+def test_hazelcast_extended_fake_lifecycle(wl):
+    from conftest import run_fake
+    from jepsen_tpu.suites.hazelcast import hazelcast_test
+
+    res = run_fake(hazelcast_test, workload=wl, time_limit=2.0)
+    r = res["results"]
+    assert r["valid?"] is True, r
+    assert r["workload"]["valid?"] is True
+
+
+def test_murmur3_known_vectors_and_partition_routing(member):
+    """Murmur3_x86_32 against public vectors (seed-0 classics plus the
+    hazelcast default seed), and the client routes map ops by key."""
+    from jepsen_tpu.suites._hazelcast import hash_to_index, murmur3_x86_32
+
+    # public reference vectors, seed 0
+    def u(h):   # unsigned view for vector comparison
+        return h & 0xFFFFFFFF
+
+    assert u(murmur3_x86_32(b"", 0)) == 0
+    assert u(murmur3_x86_32(b"a", 0)) == 0x3C2569B2
+    assert u(murmur3_x86_32(b"abc", 0)) == 0xB3DD93FA
+    assert u(murmur3_x86_32(b"Hello, world!", 0x9747B28C)) == 0x24884CBA
+    assert hash_to_index(-(1 << 31), 271) == 0
+    assert hash_to_index(-5, 271) == 5
+    # client learned the partition count from the mock's auth response
+    c = _client(member)
+    assert c.partition_count == 271
+    from jepsen_tpu.suites._hazelcast import data_string
+    p = c._partition_of(data_string("hi"))
+    assert 0 <= p < 271
+    c.close()
