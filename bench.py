@@ -438,6 +438,21 @@ def cfg_elle_50k():
          c_parser=columnar_c.available(),
          **extras)
 
+    # stored-column re-check: the same verdict straight off the
+    # history.npz elle_* sidecar — no jsonl, no PyObject parse (the
+    # analyze/re-check path for saved runs, SURVEY §7's
+    # struct-of-arrays stance carried to its conclusion)
+    cols = columnar.parse_columns(history)
+    if cols is not None:
+        r_cols = columnar.check_columns(cols, accelerator="tpu")  # warm
+        assert r_cols["valid?"] is True
+        _, t_cols = _trials(
+            lambda: columnar.check_columns(cols, accelerator="tpu"), 5)
+        med_c, extras_c = _spread(t_cols, n_txns)
+        emit("elle_50k_stored_columns_txns_per_sec", n_txns / med_c,
+             "txns/s", cpu_med / med_c,
+             object_path_txns_per_sec=round(n_txns / med, 2), **extras_c)
+
     bad = _elle_history(n_txns, crossed_pairs=50)
     n_bad = n_txns + 100
     r_cpu, t_cpu = _trials(

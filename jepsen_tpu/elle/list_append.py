@@ -205,6 +205,36 @@ def _scan_reads_py(k, reads, longest, txns, writer_of, failed_writes,
                       anomalies_extra)
 
 
+def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
+                 accelerator: str = "auto",
+                 consistency_models=("strict-serializable",)) -> dict:
+    """Re-checks a STORED run's list-append history, preferring the
+    ``elle_*`` columns in its history.npz sidecar — a pure array
+    pipeline with no jsonl parse and no PyObject history (the
+    struct-of-arrays re-check SURVEY §7 calls for; at 50k txns the
+    stored-column path runs ~7x the object parse). Falls back to the
+    jsonl history when the sidecar predates the columns or a finding
+    needs to cite txn objects (anomalous histories)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.elle import columnar
+
+    try:
+        cols = store.load_elle_columns(test_name, timestamp, store_dir)
+    except Exception:  # noqa: BLE001 - any sidecar damage (missing,
+        #              truncated zip, wrong keys) means: use the jsonl
+        cols = None
+    if cols is not None:
+        try:
+            return columnar.check_columns(
+                cols, consistency_models=consistency_models,
+                accelerator=accelerator)
+        except columnar.NeedsObjects:
+            pass
+    stored = store.load_test(test_name, timestamp, store_dir)
+    return check(stored.get("history") or [], accelerator=accelerator,
+                 consistency_models=consistency_models)
+
+
 def check(history: list[dict], accelerator: str = "auto",
           consistency_models=("strict-serializable",)) -> dict:
     # Production path: the vectorized columnar builder (elle.columnar)

@@ -720,10 +720,18 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     # mesh path keeps the single dispatch.)
     sub = MATRIX_SUB_KEYS if B > MATRIX_SUB_KEYS else MATRIX_PIPELINE_KEYS
     if mesh is None and B > sub:
+        # a short remainder sub-batch would compile at its own shape
+        # (and a B'=1 tail would even flip the chunk target): pad it
+        # with empty keys (R=0 -> identity product, trivially alive)
+        # so EVERY dispatch shares the one compiled shape
+        empty_prep = (np.zeros(0, np.int32), np.zeros((0, 1), bool),
+                      np.zeros((0, 1, 3), np.int64), 1)
         handles = []
         for lo in range(0, B, sub):
             sl = [prep(i) for i in range(lo, min(lo + sub, B))]
-            handles.append((len(sl), _matrix_dispatch(
+            nb = len(sl)
+            sl += [empty_prep] * (sub - nb)
+            handles.append((nb, _matrix_dispatch(
                 sl, S, R_max, V, step_ids, init_state, None)))
         # ONE batched host transfer for the whole pipeline — per-handle
         # np.asarray pairs would pay a tunnel round-trip each

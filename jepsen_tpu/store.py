@@ -88,19 +88,31 @@ def write_history(test: dict) -> None:
 
 def write_columnar(test: dict) -> None:
     """history.npz: the struct-of-arrays sidecar, checker-ready (the
-    EDN->numpy serialization of BASELINE's north star, built at save time)."""
+    EDN->numpy serialization of BASELINE's north star, built at save
+    time). List-append histories additionally persist the Elle builder
+    columns (``elle_*`` keys) so a later re-check runs straight off
+    arrays with no PyObject parse (elle.columnar.check_columns)."""
     import numpy as np
     from jepsen_tpu.history import ColumnarHistory
     history = test.get("history") or []
     if not history:
         return
     col = ColumnarHistory.from_ops(history)
+    extra = {}
+    try:
+        from jepsen_tpu.elle import columnar as _ecol
+        ecols = _ecol.parse_columns(history)
+        if ecols is not None:
+            extra = {f"elle_{k}": v for k, v in ecols.items()}
+    except Exception:  # noqa: BLE001 - the sidecar is an optimization
+        pass
     np.savez_compressed(
         path_mk(test, "history.npz"),
         types=col.types, processes=col.processes, fs=col.fs,
         times=col.times, indices=col.indices,
         completion_of=col.completion_of, invocation_of=col.invocation_of,
         f_table=np.asarray(col.f_table, dtype=object),
+        **extra,
     )
 
 
@@ -123,6 +135,20 @@ def load_columnar(test_name: str, timestamp: str, store_dir: str = BASE_DIR):
             completion_of=z["completion_of"],
             invocation_of=z["invocation_of"],
             f_table=f_table)
+
+
+def load_elle_columns(test_name: str, timestamp: str,
+                      store_dir: str = BASE_DIR) -> dict | None:
+    """The stored Elle builder columns (``elle_*`` in history.npz), or
+    None when the run predates them / the history wasn't storable."""
+    import numpy as np
+    p = path({"name": test_name, "start_time": timestamp,
+              "store_dir": store_dir}, "history.npz")
+    with np.load(p, allow_pickle=True) as z:
+        if "elle_n_ok" not in z:
+            return None
+        return {k[len("elle_"):]: z[k] for k in z.files
+                if k.startswith("elle_")}
 
 
 def write_results(test: dict) -> None:

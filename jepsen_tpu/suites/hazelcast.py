@@ -28,6 +28,7 @@ hazelcast.clj:57-116.
 from __future__ import annotations
 
 import logging
+import time
 import socket
 import urllib.error
 
@@ -274,12 +275,20 @@ class HzCPClient(Client):
             except HzError:
                 pass  # already initialised by a sibling
         if self.mode == "cas-ref":
-            try:
-                # ground a fresh (nil) ref at 0 so the CAS-register
-                # model's initial state is exact
-                conn.atomic_ref_compare_and_set(REF_NAME, None, 0)
-            except HzError:
-                pass
+            # ground a fresh (nil) ref at 0 so the CAS-register model's
+            # initial state is exact. A LOSING CAS returns False (some
+            # sibling grounded first) — that's fine; an HzError is a
+            # real failure, and swallowing it would leave nil reads
+            # that the model misreads as a linearizability violation,
+            # so retry briefly and otherwise let open() fail loudly.
+            for attempt in range(5):
+                try:
+                    conn.atomic_ref_compare_and_set(REF_NAME, None, 0)
+                    break
+                except HzError:
+                    if attempt == 4:
+                        raise
+                    time.sleep(0.5)
         return HzCPClient(self.mode, node, conn, self.timeout_s)
 
     def invoke(self, test, op):

@@ -1374,3 +1374,101 @@ def test_c_front_bails_match_python_front(monkeypatch):
             r_py = list_append.check(h, accelerator="auto")
         assert r_c["valid?"] == r_py["valid?"], (i, r_c, r_py)
         assert r_c["anomaly-types"] == r_py["anomaly-types"], i
+
+
+def test_stored_columns_roundtrip_clean(tmp_path):
+    """parse_columns -> npz save/load -> check_columns must equal the
+    object-path check on a clean history, with no object access."""
+    import numpy as np
+
+    from jepsen_tpu.elle import columnar
+
+    h = []
+    t = 0
+    for i in range(400):
+        k = i % 7
+        seen = list(range(k, i + 1, 7))
+        h.append({"type": "invoke", "process": i % 5,
+                  "value": [["append", k, i], ["r", k, None]], "time": t})
+        h.append({"type": "ok", "process": i % 5,
+                  "value": [["append", k, i], ["r", k, seen]],
+                  "time": t + 1})
+        t += 2
+    cols = columnar.parse_columns(h)
+    if cols is None:
+        pytest.skip("C parser unavailable")
+    p = tmp_path / "cols.npz"
+    np.savez_compressed(p, **cols)
+    with np.load(p) as z:
+        loaded = {k: z[k] for k in z.files}
+    r = columnar.check_columns(loaded, accelerator="auto")
+    r0 = list_append.check(h, accelerator="auto")
+    for key in ("valid?", "anomaly-types", "edge-count", "txn-count"):
+        assert r[key] == r0[key], key
+    assert r["builder"] == "columnar-store"
+
+
+def test_stored_columns_anomalous_needs_objects():
+    """Findings that cite txn objects must raise NeedsObjects instead
+    of fabricating citations."""
+    from jepsen_tpu.elle import columnar
+
+    h = [
+        {"type": "ok", "process": 0, "value": [["append", 0, 1]]},
+        {"type": "ok", "process": 1,
+         "value": [["r", 0, [1, 99]]]},   # phantom + order trouble
+        {"type": "fail", "process": 2, "value": [["append", 0, 99]]},
+    ]
+    cols = columnar.parse_columns(h)
+    if cols is None:
+        pytest.skip("C parser unavailable")
+    with pytest.raises(columnar.NeedsObjects):
+        columnar.check_columns(cols)
+
+
+def test_stored_columns_non_txn_extras_complete():
+    """Extras that never cite txns (duplicate appends) complete from
+    columns alone."""
+    from jepsen_tpu.elle import columnar
+
+    h = [
+        {"type": "ok", "process": 0, "value": [["append", 0, 1]]},
+        {"type": "ok", "process": 1, "value": [["append", 0, 1]]},  # dup
+        {"type": "ok", "process": 2, "value": [["r", 0, [1]]]},
+    ]
+    cols = columnar.parse_columns(h)
+    if cols is None:
+        pytest.skip("C parser unavailable")
+    r = columnar.check_columns(cols)
+    r0 = list_append.check(h, accelerator="auto")
+    assert r["anomaly-types"] == r0["anomaly-types"]
+    assert "duplicate-appends" in r["anomalies"]
+
+
+def test_check_stored_prefers_sidecar(tmp_path):
+    """An append-workload run saved through the store re-checks from
+    the elle_* sidecar columns (and matches a fresh object check)."""
+    from jepsen_tpu import store
+    from jepsen_tpu.elle import columnar, list_append as la
+
+    h = []
+    for i in range(50):
+        k = i % 3
+        seen = list(range(k, i + 1, 3))
+        h.append({"type": "invoke", "process": i % 5,
+                  "value": [["append", k, i]], "time": 2 * i})
+        h.append({"type": "ok", "process": i % 5,
+                  "value": [["append", k, i], ["r", k, seen]],
+                  "time": 2 * i + 1})
+    test = {"name": "elle-store-t", "start_time": "20260731T000000",
+            "store_dir": str(tmp_path), "history": h}
+    store.write_history(test)
+    store.write_columnar(test)
+    cols = store.load_elle_columns("elle-store-t", "20260731T000000",
+                                   str(tmp_path))
+    if cols is None:
+        pytest.skip("C parser unavailable")
+    r = la.check_stored("elle-store-t", "20260731T000000", str(tmp_path),
+                        accelerator="auto")
+    assert r["builder"] == "columnar-store"
+    assert r["valid?"] == la.check(h)["valid?"] is True
