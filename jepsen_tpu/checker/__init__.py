@@ -284,11 +284,67 @@ class SetFullChecker(Checker):
 
         reads: list[tuple[float, Any]] = []  # (invoke time, raw payload)
         pending_read_invokes: dict = {}
-        for i, op in enumerate(history):
+
+        # -- adds: vectorized first-invoke / last-ok per element --------
+        # the per-event Python walk dominated the host side of this
+        # checker at bench scale; for the universal all-int regime the
+        # same semantics (invoke_t = first add event's time, ok_t =
+        # last ok's — el_slot's exact behavior) fall out of masked
+        # first/last-occurrence joins. Non-int elements keep the loop.
+        nh = len(history)
+        # cheap gate first: the columnar path serves only all-int add
+        # values, and a non-int history must not pay for mask building
+        fast = any(op.get("f") == "add" for op in history) and \
+            all(type(op.get("value")) is int for op in history
+                if op.get("f") == "add")
+        scan = range(nh)
+        if fast:
+            fs = [op.get("f") for op in history]
+            typs = [op.get("type") for op in history]
+            add_m = np.fromiter((f == "add" for f in fs), bool, nh)
+            inv_m = np.fromiter((t == "invoke" for t in typs), bool, nh)
+            ok_m = np.fromiter((t == "ok" for t in typs), bool, nh)
+            add_pos = np.nonzero(add_m & (inv_m | ok_m))[0]
+            fast = add_pos.size > 0
+        if fast:
+            add_idx = add_pos.tolist()
+            t_add = np.fromiter(
+                (float(history[i].get("time", i)) for i in add_idx),
+                np.float64, add_pos.size)
+            va = np.asarray([history[i].get("value") for i in add_idx],
+                            np.int64)
+            uniq, first_idx, inverse = np.unique(
+                va, return_index=True, return_inverse=True)
+            order = np.argsort(first_idx)
+            rank = np.empty(order.size, np.int64)
+            rank[order] = np.arange(order.size)
+            el_ids = rank[inverse]
+            for v in uniq[order].tolist():
+                intern.id(v)   # same table the read fallback consults
+            E_fast = int(uniq.size)
+            _, first_per_el = np.unique(el_ids, return_index=True)
+            ok_arr = np.zeros(E_fast)
+            has_ok_arr = np.zeros(E_fast, bool)
+            ok_sel = np.nonzero(ok_m[add_pos])[0]
+            if ok_sel.size:
+                el_ok = el_ids[ok_sel][::-1]
+                t_ok = t_add[ok_sel][::-1]
+                u_ok, last_rev = np.unique(el_ok, return_index=True)
+                ok_arr[u_ok] = t_ok[last_rev]
+                has_ok_arr[u_ok] = True
+            invoke_t = t_add[first_per_el].tolist()
+            ok_t = ok_arr.tolist()
+            has_ok = has_ok_arr.tolist()
+            has_invoke = [True] * E_fast
+            # only the (few) read events still walk in Python
+            read_m = np.fromiter((f == "read" for f in fs), bool, nh)
+            scan = np.nonzero(read_m & (inv_m | ok_m))[0].tolist()
+        for i in scan:
+            op = history[i]
             f, typ, v, p = (op.get("f"), op.get("type"), op.get("value"),
                             op.get("process"))
-            t = float(op.get("time", i))
             if f == "add":
+                t = float(op.get("time", i))
                 j = el_slot(v)
                 if typ == "invoke" and not has_invoke[j]:
                     invoke_t[j] = t
@@ -300,6 +356,7 @@ class SetFullChecker(Checker):
                         invoke_t[j] = t
                         has_invoke[j] = True
             elif f == "read":
+                t = float(op.get("time", i))
                 if typ == "invoke":
                     pending_read_invokes[p] = t
                 elif typ == "ok":
@@ -325,7 +382,7 @@ class SetFullChecker(Checker):
         for r, (_, vs) in enumerate(reads):
             if uv_sorted is not None:
                 try:
-                    arr = np.asarray(list(vs))
+                    arr = np.asarray(vs if type(vs) is list else list(vs))
                 except (TypeError, ValueError, OverflowError):
                     arr = None
                 # signed-int dtype only: asarray would silently coerce

@@ -112,15 +112,19 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
     n_sq = 0
     while (1 << n_sq) < S:
         n_sq += 1
-    Rexp, Kexp, U1, U2 = _static_tables(S, V)
     # f32 throughout: measured FASTER than bf16 on this kernel (both
     # all-bf16 and mixed variants lost ~25% — the bf16 (16, 128) tile
     # shape slows the per-step thresholds/selects more than the MXU
-    # rate buys at MV=256)
-    Rexp_j = jnp.asarray(Rexp)
-    Kexp_j = jnp.asarray(Kexp)
-    U1_j = jnp.asarray(U1)
-    U2_j = jnp.asarray(U2)
+    # rate buys at MV=256).
+    # The tables stay NUMPY here: _build is lru_cached and its first
+    # call may run inside an active jit trace (chunk_product is invoked
+    # while scan_total_pallas traces), where jnp.asarray would yield
+    # that trace's tracers — cached into the closure, they leak into
+    # every later trace sharing the (S, V, T, U) key and kill the
+    # pallas path with UnexpectedTracerError (surfaced by the real-TPU
+    # parity tier once the chunk retune multiplied the shape keys).
+    # grid_fn stages them per trace instead.
+    Rexp, Kexp, U1, U2 = _static_tables(S, V)
 
     def kernel(pend_ref, ids_ref, mtT_ref, slot_ref, val_ref,
                rexp_ref, kexp_ref, u1_ref, u2_ref, out_ref):
@@ -196,7 +200,9 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((G, MV, MV), jnp.bfloat16),
             interpret=interpret,
-        )(pend, ids, mtT, slots, valid, Rexp_j, Kexp_j, U1_j, U2_j)
+        )(pend, ids, mtT, slots, valid,
+          jnp.asarray(Rexp), jnp.asarray(Kexp),
+          jnp.asarray(U1), jnp.asarray(U2))
 
     @jax.jit
     def run(pend, ids, mtT, slots, valid):

@@ -198,7 +198,8 @@ def test_pallas_chunk_product_parity(tpu_device, streams):
     good, bad = streams
     if not pm.enabled(5, 8):
         pytest.fail("pallas probe rejected the kernel on the real chip "
-                    "(lowering failure or miscompile — see the log)")
+                    "(lowering failure or miscompile — see the log); "
+                    f"_DISABLED={pm._DISABLED} _PROBED={pm._PROBED}")
     for stream, expect in ((good, True), (bad, False)):
         pal = matrix_check(stream, force=True)
         os.environ["JEPSEN_TPU_NO_PALLAS"] = "1"
