@@ -246,3 +246,42 @@ def pad_streams(streams: list[EventStream], length: int | None = None) -> dict:
         "b": pad("b", 0, np.int32),
         "n_slots": S,
     }
+
+
+def stream_to_columns(stream: EventStream) -> dict | None:
+    """The stream as plain persistable arrays (the store's ``lin_*``
+    sidecar keys), or None when the intern table holds non-int values
+    (beyond the id-0 None sentinel) — those can't round-trip through
+    an int64 column."""
+    vals = stream.intern.table[1:]
+    if not all(type(v) is int for v in vals):
+        return None
+    return {
+        "kind": np.asarray(stream.kind, np.int8),
+        "slot": np.asarray(stream.slot, np.int32),
+        "f": np.asarray(stream.f, np.int32),
+        "a": np.asarray(stream.a, np.int32),
+        "b": np.asarray(stream.b, np.int32),
+        "op_index": np.asarray(stream.op_index, np.int32),
+        "n_slots": np.int64(stream.n_slots),
+        "n_ops": np.int64(stream.n_ops),
+        "intern_table": np.asarray(vals, np.int64),
+    }
+
+
+def stream_from_columns(cols: dict) -> EventStream:
+    """Rebuilds an EventStream from stream_to_columns' product."""
+    intern = Intern()
+    for v in np.asarray(cols["intern_table"]).tolist():
+        intern.id(int(v))
+    return EventStream(
+        kind=np.asarray(cols["kind"], np.int8),
+        slot=np.asarray(cols["slot"], np.int32),
+        f=np.asarray(cols["f"], np.int32),
+        a=np.asarray(cols["a"], np.int32),
+        b=np.asarray(cols["b"], np.int32),
+        op_index=np.asarray(cols["op_index"], np.int32),
+        n_slots=int(cols["n_slots"]),
+        n_ops=int(cols["n_ops"]),
+        intern=intern,
+    )

@@ -216,3 +216,75 @@ class LinearizableChecker(Checker):
 
 def linearizable(model=None, **kw) -> Checker:
     return LinearizableChecker(model=model, **kw)
+
+
+def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
+                 model=None, accelerator: str = "auto") -> dict:
+    """Re-checks a STORED register run's linearizability, preferring the
+    ``lin_*`` EventStream columns in its history.npz sidecar — no jsonl
+    load, no re-encoding (the stored-column twin of
+    elle.list_append.check_stored). The fast lane settles only VALID
+    verdicts (via the transfer-matrix screen or the exact stream
+    search); anything else — invalid (needs op context for the
+    failure report), out-of-regime, missing sidecar — falls back to
+    the jsonl history through the normal checker."""
+    from jepsen_tpu import store
+    from jepsen_tpu.checker.linear_encode import stream_from_columns
+    from jepsen_tpu.models import CASRegister, cas_register_spec
+
+    model = model if model is not None else CASRegister()
+    cols = None
+    if isinstance(model, CASRegister):
+        try:
+            cols = store.load_linear_columns(test_name, timestamp,
+                                             store_dir)
+        except Exception:  # noqa: BLE001 - damaged sidecar: use jsonl
+            cols = None
+    if cols is not None:
+        try:
+            stream = stream_from_columns(cols)
+            init_id = (0 if model.value is None
+                       else stream.intern.id(model.value))
+            spec = cas_register_spec(init_id)
+            checker = LinearizableChecker(model=model,
+                                          accelerator=accelerator)
+            res = None
+            # same routing as check(): tiny streams skip the device
+            # (compile + dispatch dwarf the search below the threshold)
+            use_device = accelerator == "tpu" or (
+                accelerator == "auto"
+                and len(stream) >= AUTO_TPU_THRESHOLD)
+            if use_device:
+                from jepsen_tpu.ops.jitlin import matrix_check, matrix_ok
+                import numpy as np
+                n_returns = int((np.asarray(stream.kind) == 1).sum())
+                if matrix_ok(stream.n_slots, len(stream.intern),
+                             n_returns):
+                    m = matrix_check(stream, step_ids=spec.step_ids,
+                                     init_state=spec.init_state,
+                                     num_states=len(stream.intern))
+                    if m is not None and m[0] and not m[2]:
+                        res = LinearResult(
+                            valid=True,
+                            algorithm="jitlin-tpu-matrix(stored)")
+            if res is None and spec.init_state == 0:
+                # native C++ search first, like check()'s host lane
+                from jepsen_tpu.native import check_stream_native
+                res = check_stream_native(stream)
+                if res is not None and res.valid == "unknown":
+                    res = None
+                elif res is not None:
+                    res.algorithm += "(stored)"
+            if res is None:
+                res = check_stream(stream, step=cas_register_step_py,
+                                   init_state=spec.init_state)
+                res.algorithm += "(stored)"
+            if res.valid is True:
+                return checker._finish(res, [], None)
+        except Exception:  # noqa: BLE001 - fast lane must never block
+            logger.exception("stored-column linear re-check failed; "
+                             "falling back to jsonl")
+    history = store.load_history(test_name, timestamp, store_dir)
+    checker = LinearizableChecker(model=model, accelerator=accelerator)
+    return checker.check({"name": test_name, "start_time": timestamp,
+                          "store_dir": store_dir}, history, {})

@@ -230,8 +230,8 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
                 accelerator=accelerator)
         except columnar.NeedsObjects:
             pass
-    stored = store.load_test(test_name, timestamp, store_dir)
-    return check(stored.get("history") or [], accelerator=accelerator,
+    history = store.load_history(test_name, timestamp, store_dir)
+    return check(history, accelerator=accelerator,
                  consistency_models=consistency_models)
 
 

@@ -106,6 +106,22 @@ def write_columnar(test: dict) -> None:
             extra = {f"elle_{k}": v for k, v in ecols.items()}
     except Exception:  # noqa: BLE001 - the sidecar is an optimization
         pass
+    # single-register histories additionally persist the encoded
+    # EventStream (lin_* keys) so linearizability re-checks skip the
+    # jsonl + re-encoding (checker/linearizable.check_stored). Cheap
+    # shape probe first: the encoder's pairing pre-pass is a full O(n)
+    # walk and must not run on every non-register history
+    first_f = next((op.get("f") for op in history
+                    if op.get("f") is not None), None)
+    if first_f in ("read", "write", "cas"):
+        try:
+            from jepsen_tpu.checker.linear_encode import (
+                encode_register_ops, stream_to_columns)
+            lcols = stream_to_columns(encode_register_ops(history))
+            if lcols is not None:
+                extra.update({f"lin_{k}": v for k, v in lcols.items()})
+        except Exception:  # noqa: BLE001 - wrong shape after all
+            pass
     np.savez_compressed(
         path_mk(test, "history.npz"),
         types=col.types, processes=col.processes, fs=col.fs,
@@ -137,18 +153,31 @@ def load_columnar(test_name: str, timestamp: str, store_dir: str = BASE_DIR):
             f_table=f_table)
 
 
-def load_elle_columns(test_name: str, timestamp: str,
-                      store_dir: str = BASE_DIR) -> dict | None:
-    """The stored Elle builder columns (``elle_*`` in history.npz), or
-    None when the run predates them / the history wasn't storable."""
+def _load_prefixed(test_name: str, timestamp: str, store_dir: str,
+                   prefix: str, probe_key: str) -> dict | None:
     import numpy as np
     p = path({"name": test_name, "start_time": timestamp,
               "store_dir": store_dir}, "history.npz")
     with np.load(p, allow_pickle=True) as z:
-        if "elle_n_ok" not in z:
+        if probe_key not in z:
             return None
-        return {k[len("elle_"):]: z[k] for k in z.files
-                if k.startswith("elle_")}
+        return {k[len(prefix):]: z[k] for k in z.files
+                if k.startswith(prefix)}
+
+
+def load_elle_columns(test_name: str, timestamp: str,
+                      store_dir: str = BASE_DIR) -> dict | None:
+    """The stored Elle builder columns (``elle_*`` in history.npz), or
+    None when the run predates them / the history wasn't storable."""
+    return _load_prefixed(test_name, timestamp, store_dir, "elle_",
+                          "elle_n_ok")
+
+
+def load_linear_columns(test_name: str, timestamp: str,
+                        store_dir: str = BASE_DIR) -> dict | None:
+    """The stored register EventStream columns (``lin_*``), or None."""
+    return _load_prefixed(test_name, timestamp, store_dir, "lin_",
+                          "lin_n_slots")
 
 
 def write_results(test: dict) -> None:

@@ -611,3 +611,88 @@ def test_matrix_resume_matches_monolithic():
                 break
             base = end
         assert alive == bool(whole[0]), (seed, corrupt, alive, whole)
+
+
+# ---------------------------------------------------------------------------
+# stored-column re-check (lin_* sidecar)
+# ---------------------------------------------------------------------------
+
+def test_stream_columns_roundtrip():
+    import numpy as np
+
+    from jepsen_tpu.checker.linear_encode import (
+        encode_register_ops, stream_from_columns, stream_to_columns)
+
+    h = []
+    for i in range(30):
+        p = i % 3
+        h.append({"type": "invoke", "process": p, "f": "write", "value": i})
+        h.append({"type": "ok", "process": p, "f": "write", "value": i})
+        h.append({"type": "invoke", "process": p, "f": "read",
+                  "value": None})
+        h.append({"type": "ok", "process": p, "f": "read", "value": i})
+    s0 = encode_register_ops(h)
+    cols = stream_to_columns(s0)
+    assert cols is not None
+    s1 = stream_from_columns(cols)
+    assert np.array_equal(s0.kind, s1.kind)
+    assert np.array_equal(s0.f, s1.f)
+    assert np.array_equal(s0.a, s1.a)
+    assert s0.n_slots == s1.n_slots
+    assert list(s0.intern.table) == list(s1.intern.table)
+
+
+def test_stream_columns_reject_non_int_values():
+    from jepsen_tpu.checker.linear_encode import (
+        encode_register_ops, stream_to_columns)
+
+    h = [{"type": "invoke", "process": 0, "f": "write", "value": "x"},
+         {"type": "ok", "process": 0, "f": "write", "value": "x"}]
+    assert stream_to_columns(encode_register_ops(h)) is None
+
+
+def test_linear_check_stored_roundtrip(tmp_path):
+    from jepsen_tpu import store
+    from jepsen_tpu.checker import linearizable as lin_mod
+
+    h = []
+    for i in range(40):
+        p = i % 3
+        h.append({"type": "invoke", "process": p, "f": "write",
+                  "value": i % 5, "time": 2 * i})
+        h.append({"type": "ok", "process": p, "f": "write",
+                  "value": i % 5, "time": 2 * i + 1})
+    test = {"name": "lin-store-t", "start_time": "20260731T000001",
+            "store_dir": str(tmp_path), "history": h}
+    store.write_history(test)
+    store.write_columnar(test)
+    cols = store.load_linear_columns("lin-store-t", "20260731T000001",
+                                     str(tmp_path))
+    assert cols is not None, "register run must persist lin_* columns"
+    out = lin_mod.check_stored("lin-store-t", "20260731T000001",
+                               str(tmp_path), accelerator="cpu")
+    assert out["valid?"] is True
+    assert out["algorithm"].endswith("(stored)")
+
+
+def test_linear_check_stored_invalid_falls_back(tmp_path):
+    """An invalid verdict needs op context: the stored lane must defer
+    to the jsonl path, which renders the full failure report."""
+    from jepsen_tpu import store
+    from jepsen_tpu.checker import linearizable as lin_mod
+
+    h = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 2},  # impossible
+    ]
+    test = {"name": "lin-store-bad", "start_time": "20260731T000002",
+            "store_dir": str(tmp_path), "history": h}
+    store.write_history(test)
+    store.write_columnar(test)
+    out = lin_mod.check_stored("lin-store-bad", "20260731T000002",
+                               str(tmp_path), accelerator="cpu")
+    assert out["valid?"] is False
+    assert not out["algorithm"].endswith("(stored)")
+    assert out.get("failed-op") is not None     # full object report
