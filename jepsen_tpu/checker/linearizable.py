@@ -104,12 +104,24 @@ class LinearizableChecker(Checker):
         if enc is None:
             return self._finish(wgl(history, self.model), history, test)
         stream, step_py, spec = enc
+        res = self._search_stream(stream, step_py, spec, algorithm,
+                                  accelerator, history=history)
+        return self._finish(res, history, test, stream, step_py=step_py,
+                            init_state=spec.init_state)
+
+    def _search_stream(self, stream, step_py, spec, algorithm,
+                       accelerator, history=None) -> LinearResult:
+        """The full encoded-stream dispatch, shared by check() and the
+        stored-column re-check lane (module check_stored): host lanes
+        (native C++ first, exact Python stream search) below the device
+        threshold, device lanes (transfer-matrix screen, frontier
+        kernel, exact-CPU unknown retry) above it."""
         is_cas = isinstance(self.model, CASRegister)
         if accelerator == "cpu" or (
             accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
         ):
             res = None
-            if algorithm in ("jitlin", "auto"):
+            if algorithm in ("jitlin", "auto") or history is None:
                 if is_cas and spec.init_state == 0:
                     # native C++ search first (same algorithm, ~100x the
                     # Python loop); falls back when unbuilt, >63 slots,
@@ -124,9 +136,7 @@ class LinearizableChecker(Checker):
                                        init_state=spec.init_state)
             else:
                 res = wgl(history, self.model)
-            return self._finish(res, history, test, stream,
-                                step_py=step_py,
-                                init_state=spec.init_state)
+            return res
 
         # device path. For long histories over small value domains, the
         # block-composed transfer-matrix kernel settles the verdict with
@@ -142,10 +152,9 @@ class LinearizableChecker(Checker):
             # accept only an exact matrix True: m[2] (inexact/oob) means a
             # state id escaped the intern range and proves nothing
             if m is not None and m[0] and not m[2]:
-                return self._finish(LinearResult(
+                return LinearResult(
                     valid=True, failed_event=-1, failed_op_index=-1,
-                    configs_max=0, algorithm="jitlin-tpu-matrix"),
-                    history, test)
+                    configs_max=0, algorithm="jitlin-tpu-matrix")
         alive, died, overflow, peak = self._tpu_kernel(spec).check(
             stream, capacity=self.capacity
         )
@@ -155,18 +164,14 @@ class LinearizableChecker(Checker):
             res = check_stream(stream, step=step_py,
                                init_state=spec.init_state)
             res.algorithm = "jitlin-cpu(fallback)"
-            return self._finish(res, history, test, stream,
-                                step_py=step_py,
-                                init_state=spec.init_state)
-        res = LinearResult(
+            return res
+        return LinearResult(
             valid=valid,
             failed_event=died,
             failed_op_index=int(stream.op_index[died]) if died >= 0 else -1,
             configs_max=peak,
             algorithm="jitlin-tpu",
         )
-        return self._finish(res, history, test, stream, step_py=step_py,
-                            init_state=spec.init_state)
 
     def _finish(self, res: LinearResult, history, test=None,
                 stream=None, step_py=None, init_state: int = 0) -> dict:
@@ -248,37 +253,13 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
             spec = cas_register_spec(init_id)
             checker = LinearizableChecker(model=model,
                                           accelerator=accelerator)
-            res = None
-            # same routing as check(): tiny streams skip the device
-            # (compile + dispatch dwarf the search below the threshold)
-            use_device = accelerator == "tpu" or (
-                accelerator == "auto"
-                and len(stream) >= AUTO_TPU_THRESHOLD)
-            if use_device:
-                from jepsen_tpu.ops.jitlin import matrix_check, matrix_ok
-                import numpy as np
-                n_returns = int((np.asarray(stream.kind) == 1).sum())
-                if matrix_ok(stream.n_slots, len(stream.intern),
-                             n_returns):
-                    m = matrix_check(stream, step_ids=spec.step_ids,
-                                     init_state=spec.init_state,
-                                     num_states=len(stream.intern))
-                    if m is not None and m[0] and not m[2]:
-                        res = LinearResult(
-                            valid=True,
-                            algorithm="jitlin-tpu-matrix(stored)")
-            if res is None and spec.init_state == 0:
-                # native C++ search first, like check()'s host lane
-                from jepsen_tpu.native import check_stream_native
-                res = check_stream_native(stream)
-                if res is not None and res.valid == "unknown":
-                    res = None
-                elif res is not None:
-                    res.algorithm += "(stored)"
-            if res is None:
-                res = check_stream(stream, step=cas_register_step_py,
-                                   init_state=spec.init_state)
-                res.algorithm += "(stored)"
+            # the one dispatch check() uses — device threshold, matrix
+            # screen, frontier kernel, native-first host lanes — so the
+            # stored lane can't drift from the live one
+            res = checker._search_stream(stream, cas_register_step_py,
+                                         spec, checker.algorithm,
+                                         accelerator)
+            res.algorithm += "(stored)"
             if res.valid is True:
                 return checker._finish(res, [], None)
         except Exception:  # noqa: BLE001 - fast lane must never block

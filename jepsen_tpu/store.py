@@ -111,8 +111,13 @@ def write_columnar(test: dict) -> None:
     # jsonl + re-encoding (checker/linearizable.check_stored). Cheap
     # shape probe first: the encoder's pairing pre-pass is a full O(n)
     # walk and must not run on every non-register history
-    first_f = next((op.get("f") for op in history
-                    if op.get("f") is not None), None)
+    # the probe looks at the first CLIENT op (int process) — a nemesis
+    # op firing before the first client invoke must not mask a register
+    # run (encode_register_ops itself drops non-int-process ops)
+    first_f = next(
+        (op.get("f") for op in history
+         if isinstance(op.get("process"), int) and op.get("process") >= 0
+         and op.get("f") is not None), None)
     if first_f in ("read", "write", "cas"):
         try:
             from jepsen_tpu.checker.linear_encode import (

@@ -1472,3 +1472,36 @@ def test_check_stored_prefers_sidecar(tmp_path):
                         accelerator="auto")
     assert r["builder"] == "columnar-store"
     assert r["valid?"] == la.check(h)["valid?"] is True
+
+
+def test_stored_columns_parity_fuzz():
+    """On messy histories, the stored-column check must either agree
+    with the object path in full or raise NeedsObjects exactly when the
+    object path's findings cite txn values."""
+    from jepsen_tpu.elle import columnar
+
+    rng = random.Random(97)
+    compared = deferred = 0
+    for trial in range(40):
+        h = _messy_history(rng)
+        cols = columnar.parse_columns(h)
+        if cols is None:
+            continue
+        r0 = list_append.check(h, accelerator="auto")
+        try:
+            r = columnar.check_columns(cols, accelerator="auto")
+        except columnar.NeedsObjects:
+            deferred += 1
+            # the object path must indeed have txn-citing output:
+            # a cycle, or a G1a/G1b style extra carrying txn values
+            citing = bool(r0.get("anomalies")) and any(
+                k in r0["anomalies"]
+                for k in ("G1a", "G1b", "G0", "G1c", "G-single", "G2",
+                          "G2-item", "realtime", "process"))
+            assert citing or not r0["valid?"], (trial, r0)
+            continue
+        compared += 1
+        assert r["valid?"] == r0["valid?"], (trial, r, r0)
+        assert r["anomaly-types"] == r0["anomaly-types"], trial
+        assert r["edge-count"] == r0["edge-count"], trial
+    assert compared >= 5 and deferred >= 5, (compared, deferred)

@@ -696,3 +696,22 @@ def test_linear_check_stored_invalid_falls_back(tmp_path):
     assert out["valid?"] is False
     assert not out["algorithm"].endswith("(stored)")
     assert out.get("failed-op") is not None     # full object report
+
+
+def test_lin_sidecar_survives_leading_nemesis_op(tmp_path):
+    """A nemesis op before the first client op must not mask a register
+    run from the lin_* sidecar probe."""
+    from jepsen_tpu import store
+
+    h = [{"type": "info", "process": "nemesis", "f": "start-partition",
+          "value": None}]
+    for i in range(10):
+        h.append({"type": "invoke", "process": 0, "f": "write",
+                  "value": i})
+        h.append({"type": "ok", "process": 0, "f": "write", "value": i})
+    test = {"name": "lin-nem-t", "start_time": "20260801T000003",
+            "store_dir": str(tmp_path), "history": h}
+    store.write_history(test)
+    store.write_columnar(test)
+    assert store.load_linear_columns(
+        "lin-nem-t", "20260801T000003", str(tmp_path)) is not None
