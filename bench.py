@@ -36,7 +36,8 @@ metric:
 Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
 stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
-elle_50k, matrix_kernel, headline, scale).
+elle_50k, matrix_kernel, headline, scale, telemetry — the last opts out
+of the per-stage telemetry block in bench_summary).
 """
 from __future__ import annotations
 
@@ -48,6 +49,8 @@ import traceback
 
 import numpy as np
 
+from jepsen_tpu import telemetry
+
 N_OPS = 10_000
 N_PROCS = 5
 CAPACITY = 256
@@ -55,6 +58,30 @@ BASELINE_OPS_PER_SEC = N_OPS / 3600.0  # reference CPU knossos: 1 h timeout
 GEN_SCHED_BASELINE = 20_000.0          # generator.clj:67-70
 
 _RESULTS: list[dict] = []
+
+# Per-stage telemetry folded into the bench_summary line (BENCH_SKIP key
+# "telemetry" opts out): compile_s (the timed warm-up call — JIT compile
+# plus one execute), wall_s (whole stage), device_peak_mb (allocator
+# high-water AFTER the stage; monotone across stages, so per-stage
+# high-water reads as the running max). The execute side of the
+# compile/execute split is each metric's median trial time, already in
+# the metric lines.
+_STAGE_TELEMETRY: dict = {}
+_TELEMETRY_ON = True
+
+
+def _stage_note(stage: str, **kv):
+    if _TELEMETRY_ON:
+        _STAGE_TELEMETRY.setdefault(stage, {}).update(kv)
+
+
+def _warm_timed(stage: str, fn):
+    """Runs a warm-up (compile) call, recording its wall time as the
+    stage's compile_s via the telemetry block."""
+    t0 = time.perf_counter()
+    out = fn()
+    _stage_note(stage, compile_s=round(time.perf_counter() - t0, 3))
+    return out
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float, **extra):
@@ -188,6 +215,9 @@ def device_roofline() -> dict:
         chain(a).block_until_ready()
         _, ts = _trials(lambda: chain(a).block_until_ready(), 3)
         measured[key] = reps * 2.0 * n ** 3 / min(ts)
+    # publish the measured peak so runtime roofline gauges (checker
+    # telemetry) share bench's denominator
+    telemetry.set_device_peak_flops(measured["f32_matmul_flops"])
     big = jnp.ones((64 * 1024 * 1024,), jnp.float32)   # 256 MB
     bw_reps = 64
 
@@ -211,12 +241,8 @@ def matrix_roofline_extras(n_returns: int, S: int, V: int,
     ``roofline_frac`` = modeled achieved FLOP/s over the measured f32
     matmul peak — small matrices (MV ~ 2^S·V) under-tile the MXU, which
     is exactly what this fraction is here to make visible."""
-    MV = (1 << S) * V
-    n_sq = 0
-    while (1 << n_sq) < S:
-        n_sq += 1
-    flops_per_return = (n_sq + 2) * 2.0 * MV ** 3
-    achieved = n_returns * flops_per_return / seconds
+    flops_per_return = telemetry.matrix_modeled_flops(1, S, V)
+    achieved = telemetry.matrix_modeled_flops(n_returns, S, V) / seconds
     peak = device_roofline()["f32_matmul_flops"]
     return {
         "modeled_flops_per_return": round(flops_per_return),
@@ -307,7 +333,8 @@ def cfg_multikey():
         streams = all_streams[:nk]
         _, cpu_times = _trials(lambda: cpu_n(nk), cpu_trials)
         dt_cpu = min(cpu_times)  # noisy host: best run is the fair anchor
-        batch_check(streams, capacity=CAPACITY)  # warm-up compile
+        _warm_timed(f"multikey_{nk}x1k",            # warm-up compile
+                    lambda: batch_check(streams, capacity=CAPACITY))
         results, times = _trials(
             lambda: batch_check(streams, capacity=CAPACITY), 3)
         assert all(r[0] and not r[2] for r in results)
@@ -350,7 +377,7 @@ def cfg_set_full():
     test, opts = {}, {}
     dev = SetFullChecker(accelerator="tpu")
     cpu = SetFullChecker(accelerator="cpu")
-    dev.check(test, history, opts)  # warm-up compile
+    _warm_timed("set_full", lambda: dev.check(test, history, opts))
     r_dev, t_dev = _trials(lambda: dev.check(test, history, opts), 5)
     r_cpu, t_cpu = _trials(lambda: cpu.check(test, history, opts), 5)
     assert r_dev["valid?"] and r_cpu["valid?"]
@@ -407,7 +434,7 @@ def cfg_elle_50k():
     # screen kernel compiles at the anomalous run's exact bucket shapes
     # (the valid tail alone never reaches it: no back edges, no clusters)
     warm = _elle_history(2_000, crossed_pairs=50)
-    list_append.check(warm, accelerator="tpu")
+    _warm_timed("elle_50k", lambda: list_append.check(warm, accelerator="tpu"))
     # 5 trials: the build is host-bound (C parser + numpy tail) and this
     # shared VM's ambient noise swung 3-trial medians by 40%+ between
     # clean runs. Per-trial phase split on BOTH regimes (r4 weak #1: the
@@ -461,7 +488,8 @@ def cfg_elle_50k():
     # 50k run compiles the cluster screen/search at ITS bucket shapes,
     # and that one-time ~16 s compile was landing inside trial 0 (r5
     # measured phase_cycles_s[0]=15.9 vs 0.13 steady) — warm it out
-    list_append.check(bad, accelerator="tpu")
+    _warm_timed("elle_50k_anomalous",
+                lambda: list_append.check(bad, accelerator="tpu"))
     phases: list[dict] = []
     r_dev, t_dev = _trials(phased(bad, phases), 5)
     assert r_dev["valid?"] is False and r_cpu["valid?"] is False
@@ -490,7 +518,8 @@ def cfg_matrix_kernel():
     n_returns = int((np.asarray(stream.kind) == 1).sum())
     assert matrix_ok(S, V, n_returns), "bench config must be in-regime"
 
-    m = matrix_check(stream)                      # warm-up compile
+    m = _warm_timed("matrix_kernel",              # warm-up compile
+                    lambda: matrix_check(stream))
     assert m is not None and m[0] and not m[2], m
     m, t_matrix = _trials(lambda: matrix_check(stream), 5)
     dt_matrix, extras = _spread(t_matrix, E)
@@ -498,7 +527,8 @@ def cfg_matrix_kernel():
     batch = pad_streams([stream], length=_bucket(E))
     run = JitLinKernel()._get(S, CAPACITY, batched=False, num_states=V)
     args = _device_args(batch)
-    _force(*run(*args))                           # warm-up compile
+    _warm_timed("matrix_kernel_scan",             # warm-up compile
+                lambda: _force(*run(*args)))
     out, t_scan = _trials(lambda: _force(*run(*args)), 5)
     alive, _, ovf, _ = out
     dt_scan, _ = _spread(t_scan, E)
@@ -723,7 +753,8 @@ def cfg_headline() -> float:
     history = _register_history(N_OPS, n_procs=N_PROCS, seed=42, n_values=5)
     stream = encode_register_ops(history)
 
-    m = matrix_check(stream)                      # warm-up compile
+    m = _warm_timed("headline",                   # warm-up compile
+                    lambda: matrix_check(stream))
     assert m is not None and m[0] and not m[2], (
         "10k-op valid small-domain history must verify on the matrix path")
     _, times = _trials(lambda: matrix_check(stream), 5)
@@ -735,7 +766,7 @@ def cfg_headline() -> float:
     run = JitLinKernel()._get(S, CAPACITY, batched=False,
                               num_states=len(stream.intern))
     args = _device_args(batch)
-    _force(*run(*args))                           # warm-up compile
+    _warm_timed("headline_scan", lambda: _force(*run(*args)))
     out, scan_times = _trials(lambda: _force(*run(*args)), 5)
     alive, died, ovf, peak = out
     assert verdict(bool(alive), bool(ovf)) is True, (
@@ -752,19 +783,32 @@ def cfg_headline() -> float:
 
 
 def main() -> None:
+    global _TELEMETRY_ON
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     skip = set(filter(None, os.environ.get("BENCH_SKIP", "").split(",")))
+    # stage telemetry (compile_s/wall_s/device_peak_mb) uses module
+    # helpers only — no registry: bench stages call the kernels directly,
+    # below the instrumented checker/interpreter dispatch layers
+    _TELEMETRY_ON = "telemetry" not in skip
     device_rate = 50_000.0  # headline's event rate sizes the scaling run
 
     def guard(name, fn):
         if name in skip:
             return None
+        t0 = time.perf_counter()
         try:
             return fn()
         except Exception:
             print(f"[bench] {name} FAILED:", file=sys.stderr)
             traceback.print_exc()
             return None
+        finally:
+            if _TELEMETRY_ON:
+                _stage_note(name, wall_s=round(time.perf_counter() - t0, 2))
+                peak = telemetry.device_memory_peak_bytes()
+                if peak is not None:
+                    _stage_note(name,
+                                device_peak_mb=round(peak / 2 ** 20, 1))
 
     guard("cpu_ref", cfg_cpu_ref_200)
     guard("interpreter_sched", cfg_interpreter_sched)
@@ -784,6 +828,8 @@ def main() -> None:
     summary = {"metric": "bench_summary",
                "all": {r["metric"]: [r["value"], r["vs_baseline"]]
                        for r in _RESULTS}}
+    if _STAGE_TELEMETRY:
+        summary["telemetry"] = _STAGE_TELEMETRY
     for line in [r for r in _RESULTS if r["metric"] != headline]:
         print(json.dumps(line), flush=True)
     print(json.dumps(summary), flush=True)

@@ -18,8 +18,10 @@ to 10 because "Writing these can take *hours*", checker.clj:213-216).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.checker import Checker
 from jepsen_tpu.checker.linear_cpu import (
     LinearResult, cas_register_step_py, check_stream, wgl,
@@ -36,6 +38,11 @@ AUTO_TPU_THRESHOLD = 512
 # Failure reports re-run the exact CPU search to recover the dying
 # frontier; skip that recovery for histories longer than this.
 MAX_REPORT_EVENTS = 200_000
+
+# Backends that have completed at least one dispatch this process: the
+# first call's wall time includes JIT compilation, later calls don't —
+# exporting both makes the compile/execute split readable from metrics.
+_FIRST_CHECK_SEEN: set = set()
 
 
 class LinearizableChecker(Checker):
@@ -96,16 +103,25 @@ class LinearizableChecker(Checker):
         algorithm = opts.get("algorithm", self.algorithm)
         accelerator = opts.get("accelerator", self.accelerator)
 
+        t0 = time.perf_counter()
         if algorithm == "wgl":
-            return self._finish(wgl(history, self.model), history, test)
+            res = wgl(history, self.model)
+            self._record_metrics(res, time.perf_counter() - t0,
+                                 len(history), None)
+            return self._finish(res, history, test)
 
         # jitlin path: encode once, run on device or host
         enc = self._encoding(history)
         if enc is None:
-            return self._finish(wgl(history, self.model), history, test)
+            res = wgl(history, self.model)
+            self._record_metrics(res, time.perf_counter() - t0,
+                                 len(history), None)
+            return self._finish(res, history, test)
         stream, step_py, spec = enc
         res = self._search_stream(stream, step_py, spec, algorithm,
                                   accelerator, history=history)
+        self._record_metrics(res, time.perf_counter() - t0, len(stream),
+                             stream)
         return self._finish(res, history, test, stream, step_py=step_py,
                             init_state=spec.init_state)
 
@@ -172,6 +188,65 @@ class LinearizableChecker(Checker):
             configs_max=peak,
             algorithm="jitlin-tpu",
         )
+
+    def _record_metrics(self, res: LinearResult, dt: float, n_events: int,
+                        stream) -> None:
+        """Runtime telemetry for one check dispatch: which backend won,
+        first-call (JIT compile included) vs steady-state latency,
+        events/sec, device-memory high-water, and — on the matrix path —
+        the achieved-FLOPs/roofline gauges using bench.py's modeled-peak
+        accounting (telemetry.matrix_modeled_flops)."""
+        reg = telemetry.get_registry()
+        if not reg.enabled:
+            return
+        try:
+            backend = res.algorithm or "unknown"
+            reg.counter("checker_backend_total",
+                        "checks settled, by winning backend",
+                        labels=("backend",)).inc(backend=backend)
+            reg.histogram("checker_check_seconds",
+                          "check dispatch wall time", labels=("backend",)
+                          ).observe(dt, backend=backend)
+            first = reg.gauge(
+                "checker_first_check_seconds",
+                "first dispatch per backend (includes JIT compile)",
+                labels=("backend",))
+            if backend not in _FIRST_CHECK_SEEN:
+                _FIRST_CHECK_SEEN.add(backend)
+                first.set(dt, backend=backend)
+            else:
+                reg.gauge("checker_steady_check_seconds",
+                          "most recent non-first dispatch (compile "
+                          "amortized; first minus steady ~= compile cost)",
+                          labels=("backend",)).set(dt, backend=backend)
+            if dt > 0:
+                reg.gauge("checker_events_per_sec",
+                          "events verified per second, last check",
+                          labels=("backend",)
+                          ).set(n_events / dt, backend=backend)
+            if "tpu" in backend:
+                peak_bytes = telemetry.device_memory_peak_bytes()
+                if peak_bytes is not None:
+                    reg.gauge("checker_device_memory_peak_bytes",
+                              "device allocator high-water"
+                              ).set_max(peak_bytes)
+            if backend.startswith("jitlin-tpu-matrix") and stream is not None \
+                    and dt > 0:
+                import numpy as np
+                n_returns = int((np.asarray(stream.kind) == 1).sum())
+                achieved = telemetry.matrix_modeled_flops(
+                    n_returns, stream.n_slots, len(stream.intern)) / dt
+                reg.gauge("checker_achieved_matmul_flops",
+                          "modeled matrix-kernel FLOP/s, last check"
+                          ).set(achieved)
+                peak = telemetry.device_peak_flops()
+                if peak:
+                    reg.gauge(
+                        "checker_roofline_frac",
+                        "achieved / measured f32 matmul peak "
+                        "(see doc/observability.md)").set(achieved / peak)
+        except Exception:  # noqa: BLE001 — telemetry never fails a check
+            logger.exception("checker telemetry recording failed")
 
     def _finish(self, res: LinearResult, history, test=None,
                 stream=None, step_py=None, init_state: int = 0) -> dict:

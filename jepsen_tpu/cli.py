@@ -58,6 +58,16 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "cpu", "tpu"],
                    help="checker backend (the TPU switch)")
     p.add_argument("--store-dir", default="store")
+    # unified telemetry (doc/observability.md): spans, metrics, profiles
+    p.add_argument("--trace", action="store_true",
+                   help="span-log client ops to the run's trace.jsonl")
+    p.add_argument("--metrics-interval", type=float, default=None,
+                   help="seconds between background metrics flushes into "
+                        "the store dir (default 10; 0 = final export "
+                        "only, negative = metrics off)")
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler device trace of the "
+                        "checker phase into the run's profile/ dir")
 
 
 def test_opts_to_test(opts, base_test: dict) -> dict:
@@ -69,6 +79,17 @@ def test_opts_to_test(opts, base_test: dict) -> dict:
     test["leave_db_running"] = bool(opts.leave_db_running)
     test["store_dir"] = opts.store_dir
     test["accelerator"] = opts.accelerator
+    # telemetry opts ride along in the test map so every suite gets
+    # spans/metrics/profiles with no suite-side code (core.run wires them)
+    test["trace"] = bool(getattr(opts, "trace", False) or test.get("trace"))
+    interval = getattr(opts, "metrics_interval", None)
+    if interval is None:  # flag omitted: the base test's setting wins
+        interval = test.get("metrics_interval", 10.0)
+    test["metrics_interval"] = max(interval, 0.0)
+    if interval < 0:
+        test["metrics"] = False
+    test["profile"] = bool(getattr(opts, "profile", False)
+                           or test.get("profile"))
     ssh = dict(test.get("ssh") or {})
     ssh.update({
         "username": opts.username,

@@ -7,11 +7,20 @@ from __future__ import annotations
 import random
 import time
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.control.core import Remote, RemoteError, Result
 
 TRIES = 5
 BACKOFF_BASE_S = 0.05
 BACKOFF_JITTER_S = 0.1
+
+
+def _count_retry(op: str) -> None:
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("control_retries_total",
+                    "transport-flake retries beyond the first attempt",
+                    labels=("op",)).inc(op=op)
 
 
 class RetryRemote(Remote):
@@ -34,15 +43,17 @@ class RetryRemote(Remote):
     # we retry them — remote commands exiting 255 are vanishingly rare.
     TRANSPORT_EXITS = (-1, 255)
 
-    def _retrying(self, fn):
+    def _retrying(self, fn, op: str = "execute"):
         err = None
-        for _ in range(TRIES):
+        for attempt in range(TRIES):
             try:
                 return fn()
             except RemoteError as e:
                 raise e  # command failed legitimately; don't retry
             except Exception as e:  # noqa: BLE001  transport flake
                 err = e
+                if attempt < TRIES - 1:  # a retry follows; give-up doesn't count
+                    _count_retry(op)
                 time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
         raise err
 
@@ -52,14 +63,20 @@ class RetryRemote(Remote):
             res = self._retrying(lambda: self.remote.execute(ctx, cmd))
             if res.exit_status not in self.TRANSPORT_EXITS:
                 return res
+            if attempt < TRIES - 1:
+                _count_retry("execute")
             time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
         return res
 
     def upload(self, ctx, local_paths, remote_path):
-        return self._retrying(lambda: self.remote.upload(ctx, local_paths, remote_path))
+        return self._retrying(
+            lambda: self.remote.upload(ctx, local_paths, remote_path),
+            op="upload")
 
     def download(self, ctx, remote_paths, local_path):
-        return self._retrying(lambda: self.remote.download(ctx, remote_paths, local_path))
+        return self._retrying(
+            lambda: self.remote.download(ctx, remote_paths, local_path),
+            op="download")
 
     def disconnect(self):
         self.remote.disconnect()

@@ -11,11 +11,25 @@ from __future__ import annotations
 import os
 import subprocess
 import tempfile
+import time
 from dataclasses import dataclass, field
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.control.core import Remote, RemoteError, Result, wrap_cd, wrap_sudo
 
 DEFAULT_TIMEOUT_S = 120
+
+
+def _record(op: str, dt: float, status: str) -> None:
+    """control_exec latency histogram + outcome counter (no-op when
+    telemetry is disabled)."""
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return
+    reg.histogram("control_exec_seconds", "remote op latency",
+                  labels=("op",)).observe(dt, op=op)
+    reg.counter("control_exec_total", "remote ops by outcome",
+                labels=("op", "status")).inc(op=op, status=status)
 
 
 @dataclass
@@ -65,16 +79,20 @@ class SSHRemote(Remote):
     def _run_ssh(self, cmd_argv: list[str], stdin: str | None = None,
                  check_master: bool = False) -> Result:
         argv = ["ssh"] + self._base_opts() + [self._target()] + cmd_argv
+        t0 = time.perf_counter()
         try:
             p = subprocess.run(
                 argv, capture_output=True, text=True,
                 input=stdin,
                 timeout=self.conn_spec.get("timeout", DEFAULT_TIMEOUT_S),
             )
+            _record("exec", time.perf_counter() - t0,
+                    "ok" if p.returncode == 0 else "error")
             return Result(cmd=" ".join(cmd_argv), exit_status=p.returncode,
                           out=p.stdout, err=p.stderr,
                           host=self.conn_spec.get("host"))
         except subprocess.TimeoutExpired as e:
+            _record("exec", time.perf_counter() - t0, "timeout")
             return Result(cmd=" ".join(cmd_argv), exit_status=-1,
                           out=e.stdout or "", err=f"timeout: {e}",
                           host=self.conn_spec.get("host"))
@@ -88,8 +106,11 @@ class SSHRemote(Remote):
                 + self._base_opts(with_port=False)  # scp spells it -P
                 + (["-P", str(self.conn_spec["port"])] if self.conn_spec.get("port") else [])
                 + sources + [dest])
+        t0 = time.perf_counter()
         p = subprocess.run(argv, capture_output=True, text=True,
                            timeout=self.conn_spec.get("timeout", 600))
+        _record("scp", time.perf_counter() - t0,
+                "ok" if p.returncode == 0 else "error")
         if p.returncode != 0:
             raise RemoteError(f"scp failed: {p.stderr[:500]}",
                               cmd=" ".join(argv), exit_status=p.returncode,

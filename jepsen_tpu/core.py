@@ -15,6 +15,7 @@ from typing import Any
 
 from jepsen_tpu import client as client_mod
 from jepsen_tpu import control, db as db_mod, history as history_mod, store
+from jepsen_tpu import telemetry
 from jepsen_tpu.checker import check_safe
 from jepsen_tpu.generator import interpreter
 from jepsen_tpu.utils import real_pmap, with_relative_time, with_thread_name
@@ -186,18 +187,58 @@ def run_case(test: dict) -> list[dict]:
         return interpreter.run(test)
 
 
+@contextlib.contextmanager
+def _maybe_profile(test: dict):
+    """--profile: a jax.profiler device trace of the checker phase into
+    the store dir's profile/ (telemetry.profiler_trace degrades to a
+    no-op when the profiler is unavailable)."""
+    if not test.get("profile"):
+        yield
+        return
+    with telemetry.profiler_trace(store.path(test, "profile")):
+        yield
+
+
 def analyze(test: dict) -> dict:
     """Indexes the history, runs the checker, persists results
-    (core.clj:221-236)."""
+    (core.clj:221-236), and exports the telemetry snapshot
+    (metrics.prom + metrics.json + metrics-summary.txt) into the store
+    dir. Standalone re-analysis (cli analyze) gets its own registry so
+    checker metrics are captured there too."""
     logger.info("Analyzing...")
     history = history_mod.index(test.get("history") or [])
     test["history"] = history
     checker = test.get("checker")
-    if checker is not None:
-        test["results"] = check_safe(checker, test, history, {})
-    else:
-        test["results"] = {"valid?": True}
-    store.save_2(test)
+    reg = telemetry.get_registry()
+    prev = None
+    if not reg.enabled and test.get("metrics", True) is not False:
+        reg = telemetry.Registry()
+        prev = telemetry.install(reg)
+    try:
+        if checker is not None:
+            with _maybe_profile(test):
+                test["results"] = check_safe(checker, test, history, {})
+        else:
+            test["results"] = {"valid?": True}
+        if reg.enabled:
+            reg.gauge("run_history_ops",
+                      "ops in the final history").set(len(history))
+            # standalone re-analysis (prev installed here) exports under
+            # metrics-analyze.* — the live run's interpreter/control/
+            # nemesis measurements are unreproducible and must survive
+            # any number of re-checks
+            prefix = "metrics" if prev is None else "metrics-analyze"
+            try:
+                reg.export(store.test_dir(test), prefix=prefix)
+                from jepsen_tpu import report
+                report.write_metrics_summary(test, reg,
+                                             filename=f"{prefix}-summary.txt")
+            except Exception:  # noqa: BLE001 — export never masks a verdict
+                logger.exception("telemetry export failed")
+        store.save_2(test)
+    finally:
+        if prev is not None:
+            telemetry.install(prev)
     logger.info("Analysis complete")
     return test
 
@@ -214,10 +255,50 @@ def log_results(test: dict) -> None:
         logger.info("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
 
 
+def _telemetry_setup(test: dict):
+    """Installs a live metrics registry (unless ``metrics: False``) with
+    a periodic background flusher into the store dir, and — for
+    ``trace`` runs — a span tracer wrapped around the client. Returns a
+    teardown closure; the tracer in ``test['tracer']`` is closed by the
+    teardown whether core created it or a suite did (tracing.py leaves
+    shared-tracer teardown to us)."""
+    prev_reg = None
+    flusher = None
+    if test.get("metrics", True) is not False:
+        reg = telemetry.Registry()
+        prev_reg = telemetry.install(reg)
+        interval = test.get("metrics_interval", 10.0)
+        flusher = telemetry.Flusher(reg, store.test_dir(test),
+                                    interval_s=interval or 0).start()
+    if test.get("trace") and test.get("tracer") is None:
+        from jepsen_tpu import tracing
+        test["tracer"] = tracing.Tracer(str(store.path_mk(test,
+                                                          "trace.jsonl")))
+        if test.get("client") is not None and not isinstance(
+                test["client"], tracing.TracedClient):
+            test["client"] = tracing.TracedClient(test["client"],
+                                                  test["tracer"])
+
+    def teardown():
+        tracer = test.get("tracer")
+        if tracer is not None:
+            try:
+                tracer.close()
+            except Exception:  # noqa: BLE001
+                logger.exception("tracer close failed")
+        if flusher is not None:
+            flusher.stop(final_export=True)
+        if prev_reg is not None:
+            telemetry.install(prev_reg)
+
+    return teardown
+
+
 def run(test: dict) -> dict:
     """The whole enchilada (core.clj:326-397)."""
     test = prepare_test(test)
     store.start_logging(test)
+    telemetry_teardown = _telemetry_setup(test)
     try:
         with with_thread_name(f"jepsen-{test.get('name')}"):
             log_test_start(test)
@@ -233,4 +314,5 @@ def run(test: dict) -> dict:
             log_results(test)
             return test
     finally:
+        telemetry_teardown()
         store.stop_logging()

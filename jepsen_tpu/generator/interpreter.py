@@ -16,7 +16,7 @@ import threading
 import time as _time
 from typing import Any
 
-from jepsen_tpu import client as client_mod
+from jepsen_tpu import client as client_mod, telemetry
 from jepsen_tpu.generator import (
     NEMESIS, PENDING, Context, as_gen, context, friendly_exceptions, validate,
 )
@@ -103,6 +103,18 @@ class NemesisWorker(Worker):
     """Applies ops via the test's nemesis (interpreter.clj:69-76)."""
 
     def invoke(self, test, op):
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            f = str(op.get("f"))
+            reg.counter("nemesis_ops_total", "nemesis ops applied",
+                        labels=("f",)).inc(f=f)
+            phase = telemetry.fault_phase(op.get("f"))
+            if phase is not None:
+                reg.event("nemesis-fault", f=f, phase=phase,
+                          value=repr(op.get("value")))
+                gauge = reg.gauge("nemesis_fault_active",
+                                  "open fault windows (begin - end events)")
+                gauge.inc() if phase == "begin" else gauge.dec()
         try:
             nemesis = test.get("nemesis")
             if nemesis is None:
@@ -171,6 +183,33 @@ def run(test: dict) -> list[dict]:
     )}
     history: list[dict] = []
 
+    # telemetry: instruments fetched ONCE before the loop, then driven
+    # through the single-writer fast paths (cell/observer — only this
+    # scheduler thread mutates them, so no per-op lock). When disabled
+    # the per-op cost is a single boolean check (metrics_on).
+    reg = telemetry.get_registry()
+    metrics_on = reg.enabled
+    m_latency = reg.histogram(
+        "interpreter_op_latency_seconds",
+        "invoke -> completion latency by op :f", labels=("f",))
+    inflight_cell = reg.gauge(
+        "interpreter_in_flight_ops",
+        "ops dispatched, not yet completed").cell()
+    qdepth_cell = reg.gauge(
+        "interpreter_completion_queue_depth",
+        "completions waiting for the scheduler (sampled every 128th)").cell()
+    m_ops = reg.counter("interpreter_ops_total",
+                        "ops dispatched to workers", labels=("f",))
+    m_crash = reg.counter(
+        "interpreter_crashed_ops_total",
+        "client ops that crashed to :info (process renumbered)",
+        labels=("f",))
+    lat_obs: dict = {}       # f -> bound observe closure
+    ops_cells: dict = {}     # f -> counter cell
+    invoke_at: dict = {}     # thread -> dispatch time (relative nanos)
+    inflight_n = 0
+    completion_i = 0
+
     def thread_of(process):
         return NEMESIS if process == NEMESIS else ctx.thread_of(process)
 
@@ -178,13 +217,29 @@ def run(test: dict) -> list[dict]:
         """Re-stamps time, frees the thread, updates the generator, and
         renumbers crashed processes (interpreter.clj:216-241). Returns the
         freed thread id."""
-        nonlocal ctx, gen
+        nonlocal ctx, gen, inflight_n, completion_i
         now = relative_time_nanos()
         completion = {**completion, "time": now}
         ctx = ctx.with_time(now)
         thread = thread_of(completion.get("process"))
         if goes_in_history(completion):
             history.append(completion)
+            if metrics_on:
+                t0 = invoke_at.pop(thread, None)
+                if t0 is not None:
+                    f = completion.get("f")
+                    obs = lat_obs.get(f)
+                    if obs is None:
+                        obs = lat_obs[f] = m_latency.observer(f=str(f))
+                    obs((now - t0) / 1e9)
+                inflight_n -= 1
+                inflight_cell[0] = inflight_n
+                completion_i += 1
+                if not completion_i & 127:  # qsize() locks: sample rarely
+                    qdepth_cell[0] = completions.qsize()
+                if (completion.get("type") == "info"
+                        and completion.get("process") != NEMESIS):
+                    m_crash.inc(f=str(completion.get("f")))
             if gen is not None:
                 gen = gen.update(test, ctx, completion)
             if (completion.get("type") == "info"
@@ -239,6 +294,15 @@ def run(test: dict) -> list[dict]:
             ctx = ctx.busy_thread(thread).with_time(now)
             if goes_in_history(op):
                 history.append(op)
+                if metrics_on:
+                    invoke_at[thread] = now
+                    inflight_n += 1
+                    inflight_cell[0] = inflight_n
+                    f = op.get("f")
+                    cell = ops_cells.get(f)
+                    if cell is None:
+                        cell = ops_cells[f] = m_ops.cell(f=str(f))
+                    cell[0] += 1
                 if gen is not None:
                     gen = gen.update(test, ctx, op)
 

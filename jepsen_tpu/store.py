@@ -24,8 +24,28 @@ BASE_DIR = "store"
 # Dropped before serialization (store.clj:160-168)
 NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
-    "remote", "barrier",
+    "remote", "barrier", "tracer",
 }
+
+# Telemetry artifacts a run may leave next to history/results
+# (see doc/observability.md): exported metrics, the span log, and the
+# jax.profiler trace directory.
+TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl")
+PROFILE_DIR = "profile"
+
+
+def telemetry_artifacts(run_dir: Path) -> dict:
+    """{artifact-name: Path} for the telemetry files present in a stored
+    run directory (the web UI links these alongside the classics)."""
+    out: dict[str, Path] = {}
+    for name in TELEMETRY_FILES:
+        p = Path(run_dir) / name
+        if p.is_file():
+            out[name] = p
+    p = Path(run_dir) / PROFILE_DIR
+    if p.is_dir():
+        out[PROFILE_DIR] = p
+    return out
 
 
 def base_dir(test: dict) -> Path:

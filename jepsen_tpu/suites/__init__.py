@@ -111,7 +111,14 @@ def build_suite_test(o: dict | None, *, db_name: str,
         "store_dir": o.get("store_dir", "store"),
         "no_perf": o.get("no_perf", False),
         "leave_db_running": o.get("leave_db_running", False),
+        # telemetry opts (doc/observability.md) ride into the test map so
+        # core.run wires spans/metrics/profiles with no suite-side code
+        "trace": o.get("trace", False),
+        "metrics_interval": o.get("metrics_interval", 10.0),
+        "profile": o.get("profile", False),
     }
+    if "metrics" in o:
+        base["metrics"] = o["metrics"]
     if fake:
         from jepsen_tpu.fakes import KVClient, KVStore
         from jepsen_tpu.net import NoopNet
@@ -201,7 +208,12 @@ def standard_test_fn(suite_test: Callable,
             "nemesis_interval": opts.nemesis_interval,
             "no_perf": opts.no_perf,
             "os": getattr(opts, "os", None),
+            "trace": base.get("trace", False),
+            "metrics_interval": base.get("metrics_interval", 10.0),
+            "profile": base.get("profile", False),
         }
+        if "metrics" in base:
+            o["metrics"] = base["metrics"]
         for k in extra_keys:
             o[k] = getattr(opts, k)
         return suite_test(o)

@@ -529,15 +529,10 @@ def dgraph_test(opts_dict: dict | None = None) -> dict:
         make_real=lambda o: {
             "db": DgraphDB(o.get("version", DEFAULT_VERSION)),
             "client": DgraphClient(), "os": Debian()})
-    if o.get("trace"):
-        # --trace: spans around every client op into the store dir's
-        # trace.jsonl (the dgraph/trace.clj opencensus analog; see
-        # jepsen_tpu/tracing.py)
-        from jepsen_tpu.tracing import TracedClient, Tracer
-        import os as _os
-        path = _os.path.join(o.get("store_dir", "store"), "trace.jsonl")
-        t["tracer"] = Tracer(path)
-        t["client"] = TracedClient(t["client"], t["tracer"])
+    # --trace (the dgraph/trace.clj opencensus analog) now rides the
+    # shared telemetry wiring: build_suite_test carries o["trace"] into
+    # the test map and core.run wraps the client with a per-run tracer
+    # writing <run>/trace.jsonl (see doc/observability.md)
     return t
 
 
@@ -547,12 +542,10 @@ main_all = standard_test_all(dgraph_test, SUPPORTED_WORKLOADS,
 
 def _dgraph_opts(p):
     p.add_argument("--version", default=DEFAULT_VERSION)
-    p.add_argument("--trace", action="store_true",
-                   help="span-log client ops to <store>/trace.jsonl")
 
 
 main = cli.single_test_cmd(
-    standard_test_fn(dgraph_test, extra_keys=("version", "trace")),
+    standard_test_fn(dgraph_test, extra_keys=("version",)),
     standard_opt_fn(SUPPORTED_WORKLOADS, extra_faults=("move-tablet",),
                     extra=_dgraph_opts),
     name="jepsen-dgraph")

@@ -19,9 +19,16 @@ Surface parity with the reference wrapper:
 Spans are buffered per tracer and flushed by ``close()`` (or each
 ``max_buffer`` spans); a tracer with no path is a sampler that never
 samples — every call is a no-op, the reference's neverSample mode.
+
+Lifecycle: one tracer is typically SHARED by many TracedClients (every
+``open()`` hands the same tracer to the per-node clone), so clients never
+tear it down — the owner (core.run for ``--trace`` runs, or whoever
+constructed it) calls ``close()``, which is idempotent. An ``atexit``
+hook flushes whatever is still buffered so spans survive a crashed run.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -52,6 +59,11 @@ class Tracer:
         self.max_buffer = max_buffer
         self._buf: list[dict] = []
         self._lock = threading.Lock()
+        self._closed = False
+        if path is not None:
+            # final-flush safety net: buffered spans survive a run that
+            # crashes before the owner reaches close()
+            atexit.register(self.close)
 
     def enabled(self) -> bool:
         return self.path is not None
@@ -121,9 +133,24 @@ class Tracer:
                 f.write(json.dumps(span, default=str) + "\n")
         self._buf.clear()
 
-    def close(self) -> None:
+    def flush(self) -> None:
+        """Writes any buffered spans; safe to call at any time."""
         with self._lock:
             self._flush_locked()
+
+    def close(self) -> None:
+        """Flushes and unhooks the atexit net. Idempotent — a shared
+        tracer may be closed by its owner AND the atexit hook."""
+        self.flush()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.path is not None:
+            try:
+                atexit.unregister(self.close)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class TracedClient(Client):
@@ -161,5 +188,8 @@ class TracedClient(Client):
         self.inner.teardown(test)
 
     def close(self, test):
+        # flush but do NOT close: the tracer is shared with every other
+        # TracedClient opened from the same prototype — teardown belongs
+        # to the owner (core.run / the suite that built it)
         self.inner.close(test)
-        self.tracer.close()
+        self.tracer.flush()

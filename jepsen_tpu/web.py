@@ -62,6 +62,47 @@ def _validity(run_dir: Path):
     return valid
 
 
+def _metrics_table(path: Path) -> str:
+    """The per-run metrics table: renders an exported metrics.json (one
+    JSONL row per metric child, telemetry.Registry.snapshot format) as
+    HTML. Empty string when the run has no metrics."""
+    try:
+        rows = [json.loads(line) for line in
+                path.read_text().splitlines() if line.strip()]
+    except OSError:
+        return ""
+    except Exception:  # noqa: BLE001 — a corrupt export shouldn't 500 the page
+        logger.exception("unreadable metrics.json at %s", path)
+        return ""
+    cells = []
+    n_events = 0
+    for r in rows:
+        kind = r.get("type")
+        if kind == "event":
+            n_events += 1
+            continue
+        labels = r.get("labels") or {}
+        label_s = ",".join(f"{k}={v}" for k, v in labels.items())
+        if kind == "histogram":
+            mean = (r["sum"] / r["count"]) if r.get("count") else 0.0
+            value = (f"count={r.get('count', 0)} mean={mean:.4g}"
+                     + (f" p95={r['p95']:.4g}" if r.get("p95") is not None
+                        else ""))
+        else:
+            value = f"{r.get('value', 0):g}"
+        cells.append(f"<tr><td>{html.escape(str(r.get('name')))}</td>"
+                     f"<td>{html.escape(kind or '')}</td>"
+                     f"<td>{html.escape(label_s)}</td>"
+                     f"<td>{html.escape(value)}</td></tr>")
+    if not cells:
+        return ""
+    extra = (f"<p>{n_events} telemetry event(s) — see metrics.json</p>"
+             if n_events else "")
+    return ("<h2>metrics</h2><table class='metrics'>"
+            "<tr><th>metric</th><th>type</th><th>labels</th><th>value</th>"
+            "</tr>" + "".join(cells) + "</table>" + extra)
+
+
 class Handler(BaseHTTPRequestHandler):
     store_dir = "store"
 
@@ -99,21 +140,30 @@ class Handler(BaseHTTPRequestHandler):
             self._send(self._page("error", "<p>internal error</p>"), code=500)
 
     def _home(self, base: Path):
-        """Test table, most recent first (web.clj:104-122)."""
+        """Test table, most recent first (web.clj:104-122), with links to
+        each run's telemetry artifacts (metrics/trace/profile) when the
+        run produced them."""
         rows = []
         for name, runs in sorted(store.tests(store_dir=str(base)).items()):
             for ts, run_dir in sorted(runs.items(), reverse=True):
                 valid = _validity(run_dir)
                 cls = {True: "valid-true", False: "valid-false"}.get(
                     valid, "valid-unknown")
+                arts = store.telemetry_artifacts(run_dir)
+                links = " ".join(
+                    f"<a href='/{name}/{ts}/{a}{'/' if a == store.PROFILE_DIR else ''}'>"
+                    f"{html.escape(a)}</a>"
+                    for a in sorted(arts))
                 rows.append(
                     f"<tr class='{cls}'>"
                     f"<td><a href='/{name}/{ts}/'>{html.escape(name)}</a></td>"
                     f"<td><a href='/{name}/{ts}/'>{html.escape(ts)}</a></td>"
                     f"<td>{valid}</td>"
+                    f"<td>{links}</td>"
                     f"<td><a href='/zip/{name}/{ts}'>zip</a></td></tr>")
         body = ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
-                "<th>download</th></tr>" + "".join(rows) + "</table>")
+                "<th>telemetry</th><th>download</th></tr>"
+                + "".join(rows) + "</table>")
         self._send(self._page("Jepsen-TPU", body))
 
     def _files(self, base: Path, rel: str):
@@ -125,7 +175,8 @@ class Handler(BaseHTTPRequestHandler):
                 f"<li><a href='/{rel.rstrip('/')}/{p.name}{'/' if p.is_dir() else ''}'>"
                 f"{html.escape(p.name)}</a></li>"
                 for p in sorted(target.iterdir()))
-            return self._send(self._page(rel, f"<ul>{items}</ul>"))
+            metrics = _metrics_table(target / "metrics.json")
+            return self._send(self._page(rel, f"{metrics}<ul>{items}</ul>"))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"

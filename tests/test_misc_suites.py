@@ -398,9 +398,12 @@ def test_dgraph_trace_fake_run(tmp_path):
                      "store_dir": str(tmp_path)})
     res = core.run(t)
     assert res["results"]["valid?"] is True
-    t["tracer"].close()
+    # the shared telemetry wiring writes a PER-RUN trace.jsonl (and
+    # core.run owns the tracer teardown — no manual close needed)
+    from jepsen_tpu import store
+    _, _, run_dir = store.latest(str(tmp_path))
     spans = [json.loads(line)
-             for line in open(tmp_path / "trace.jsonl")]
+             for line in open(run_dir / "trace.jsonl")]
     assert spans, "client ops must produce spans"
     assert all(s["name"].startswith("invoke/") for s in spans)
     assert all(s["attributes"].get("type") in ("ok", "fail", "info")

@@ -1,0 +1,451 @@
+"""Unified telemetry tests: registry semantics, histogram buckets and
+quantiles, Prometheus rendering, disabled-mode no-ops, flusher lifecycle,
+tracer teardown ownership, and the fake-mode end-to-end export
+(metrics.prom / metrics.json landing in the store dir, rendered by the
+web UI). See doc/observability.md."""
+import json
+import threading
+
+import pytest
+
+from jepsen_tpu import telemetry
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_semantics():
+    r = telemetry.Registry()
+    c = r.counter("ops_total", "ops", labels=("f",))
+    c.inc(f="read")
+    c.inc(2, f="read")
+    c.inc(f="write")
+    assert c.value(f="read") == 3
+    assert c.value(f="write") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, f="read")  # counters only go up
+
+    g = r.gauge("depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+    g.set_max(2)
+    assert g.value() == 4  # high-water keeps the max
+    g.set_max(9)
+    assert g.value() == 9
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    r = telemetry.Registry()
+    a = r.counter("x_total", "first help", labels=("f",))
+    b = r.counter("x_total", labels=("f",))
+    assert a is b
+    assert a.help == "first help"  # first registration wins
+    with pytest.raises(ValueError):
+        r.gauge("x_total")  # same name, different kind
+    with pytest.raises(ValueError):
+        r.counter("x_total", labels=("g",))  # same name, different labels
+
+
+def test_registry_is_thread_safe():
+    r = telemetry.Registry()
+    c = r.counter("n_total", labels=("w",))
+
+    def work(wid):
+        for _ in range(1000):
+            c.inc(w=str(wid % 2))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(w="0") + c.value(w="1") == 8000
+
+
+# ---------------------------------------------------------------------------
+# histograms: log buckets, boundaries, quantiles
+# ---------------------------------------------------------------------------
+
+def test_log_bucket_boundaries():
+    bounds = telemetry.log_buckets(1e-3, 10.0, 4)
+    assert bounds == pytest.approx((1e-3, 1e-2, 1e-1, 1.0))
+    with pytest.raises(ValueError):
+        telemetry.log_buckets(0, 10, 4)
+    # default buckets are log-spaced x4 from 1 µs
+    d = telemetry.DEFAULT_BUCKETS
+    assert d[0] == pytest.approx(1e-6)
+    assert all(b2 / b1 == pytest.approx(4.0) for b1, b2 in zip(d, d[1:]))
+
+
+def test_histogram_bucketing_and_overflow():
+    r = telemetry.Registry()
+    h = r.histogram("h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):
+        h.observe(v)
+    child = h._child({})
+    # bucket counts: <=0.1, <=1.0, <=10.0, +Inf
+    assert child.counts == [2, 1, 1, 1]
+    assert child.count == 5
+    assert child.min == 0.05 and child.max == 100.0
+    assert child.sum == pytest.approx(102.65)
+
+
+def test_histogram_quantiles_interpolate_within_bucket():
+    r = telemetry.Registry()
+    h = r.histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.6, 3.0):
+        h.observe(v)
+    assert h.quantile(0.0) <= h.quantile(0.5) <= h.quantile(1.0)
+    # p50 (rank 2) lands in the (1, 2] bucket
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    # p100 caps at the observed max
+    assert h.quantile(1.0) <= 4.0
+    assert r.histogram("empty", buckets=(1.0,)).quantile(0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_rendering():
+    r = telemetry.Registry()
+    r.counter("req_total", "requests served", labels=("f",)).inc(f='a"b\n')
+    r.gauge("temp").set(3.5)
+    h = r.histogram("lat", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.render_prom()
+    assert "# HELP req_total requests served" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{f="a\\"b\\n"} 1' in text  # label escaping
+    assert "temp 3.5" in text
+    # histogram buckets are CUMULATIVE and end at +Inf
+    assert 'lat_bucket{le="1"} 1' in text
+    assert 'lat_bucket{le="2"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    assert "lat_sum 5.5" in text
+    assert "lat_count 2" in text
+
+
+def test_snapshot_and_export(tmp_path):
+    r = telemetry.Registry()
+    r.counter("c_total").inc(7)
+    r.histogram("h").observe(0.25)
+    r.event("nemesis-fault", f="kill", phase="begin")
+    r.export(tmp_path)
+    rows = [json.loads(line)
+            for line in (tmp_path / "metrics.json").read_text().splitlines()]
+    by = {(row.get("name"), row.get("type")): row for row in rows}
+    assert by[("c_total", "counter")]["value"] == 7
+    hist = by[("h", "histogram")]
+    assert hist["count"] == 1 and hist["min"] == 0.25
+    ev = by[("nemesis-fault", "event")]
+    assert ev["fields"] == {"f": "kill", "phase": "begin"}
+    assert (tmp_path / "metrics.prom").read_text().startswith("#")
+
+
+def test_metrics_summary_report_block():
+    from jepsen_tpu import report
+    r = telemetry.Registry()
+    r.counter("c_total", labels=("f",)).inc(3, f="read")
+    r.gauge("g").set(2)
+    r.histogram("h").observe(1.0)
+    r.event("nemesis-fault", f="kill", phase="begin")
+    text = report.metrics_summary(r.snapshot())
+    assert "c_total{f=read} = 3" in text
+    assert "g = 2" in text
+    assert "count=1" in text
+    assert "nemesis-fault" in text
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+def test_default_registry_is_null_and_noop():
+    reg = telemetry.get_registry()
+    assert reg.enabled is False
+    c = reg.counter("whatever")
+    c.inc()
+    c.inc(5, f="x")
+    assert c.value() == 0.0
+    with reg.timer("t"):
+        pass
+    reg.event("e")
+    assert reg.snapshot() == []
+    assert reg.render_prom() == ""
+    # the same shared instrument backs every name: no per-call allocation
+    assert reg.counter("a") is reg.gauge("b") is reg.histogram("c")
+
+
+def test_install_and_restore():
+    live = telemetry.Registry()
+    prev = telemetry.install(live)
+    try:
+        assert telemetry.get_registry() is live
+        with telemetry.use(telemetry.NULL):
+            assert telemetry.get_registry().enabled is False
+        assert telemetry.get_registry() is live
+    finally:
+        telemetry.install(prev)
+    assert telemetry.get_registry() is prev
+
+
+# ---------------------------------------------------------------------------
+# fault-window classification
+# ---------------------------------------------------------------------------
+
+def test_fault_phase_heuristic():
+    assert telemetry.fault_phase("start_partition") == "begin"
+    assert telemetry.fault_phase("stop_partition") == "end"
+    assert telemetry.fault_phase("kill") == "begin"
+    assert telemetry.fault_phase("start") == "end"  # heal of a kill
+    assert telemetry.fault_phase("pause") == "begin"
+    assert telemetry.fault_phase("resume") == "end"
+    assert telemetry.fault_phase("read") is None
+    assert telemetry.fault_phase(None) is None
+
+
+# ---------------------------------------------------------------------------
+# flusher lifecycle
+# ---------------------------------------------------------------------------
+
+def _telemetry_threads():
+    return [t for t in threading.enumerate()
+            if "telemetry" in (t.name or "")]
+
+
+def test_flusher_periodic_and_final_export(tmp_path):
+    r = telemetry.Registry()
+    r.counter("c_total").inc()
+    fl = telemetry.Flusher(r, tmp_path, interval_s=0.02).start()
+    try:
+        import time
+        deadline = time.time() + 5
+        while not (tmp_path / "metrics.prom").exists():
+            assert time.time() < deadline, "flusher never exported"
+            time.sleep(0.01)
+    finally:
+        fl.stop()
+    assert not _telemetry_threads()
+    assert (tmp_path / "metrics.json").exists()
+
+
+def test_flusher_zero_interval_still_final_exports(tmp_path):
+    r = telemetry.Registry()
+    r.counter("c_total").inc()
+    fl = telemetry.Flusher(r, tmp_path, interval_s=0).start()
+    assert not _telemetry_threads()  # no thread spawned
+    fl.stop()
+    assert (tmp_path / "metrics.prom").exists()
+
+
+# ---------------------------------------------------------------------------
+# tracer lifecycle (the shared-tracer teardown fix)
+# ---------------------------------------------------------------------------
+
+def test_tracer_close_is_idempotent(tmp_path):
+    from jepsen_tpu.tracing import Tracer
+    path = tmp_path / "trace.jsonl"
+    tr = Tracer(str(path))
+    with tr.with_trace("a"):
+        pass
+    tr.close()
+    tr.close()  # second close: no error, no duplicate spans
+    spans = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [s["name"] for s in spans] == ["a"]
+
+
+def test_traced_client_close_leaves_shared_tracer_usable(tmp_path):
+    from jepsen_tpu.fakes import AtomClient, AtomDB
+    from jepsen_tpu.tracing import TracedClient, Tracer
+    path = tmp_path / "trace.jsonl"
+    tracer = Tracer(str(path))
+    db = AtomDB()
+    test = {"db": db}
+    c1 = TracedClient(AtomClient(db), tracer).open(test, "n1")
+    c2 = TracedClient(AtomClient(db), tracer).open(test, "n2")
+    c1.invoke(test, {"f": "write", "value": 1, "process": 0,
+                     "type": "invoke"})
+    c1.close(test)  # must NOT tear down the tracer c2 still holds
+    out = c2.invoke(test, {"f": "read", "value": None, "process": 1,
+                           "type": "invoke"})
+    assert out["type"] == "ok"
+    c2.close(test)
+    tracer.close()  # owner teardown
+    spans = [json.loads(line) for line in path.read_text().splitlines()]
+    assert {s["name"] for s in spans} == {"invoke/write", "invoke/read"}
+
+
+# ---------------------------------------------------------------------------
+# cli opt threading
+# ---------------------------------------------------------------------------
+
+def test_cli_threads_telemetry_opts_into_test_map():
+    import argparse
+    from jepsen_tpu import cli
+    from jepsen_tpu.fakes import noop_test
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    opts = p.parse_args(["--no-ssh", "--trace", "--profile",
+                         "--metrics-interval", "2.5"])
+    t = cli.test_opts_to_test(opts, noop_test())
+    assert t["trace"] is True
+    assert t["profile"] is True
+    assert t["metrics_interval"] == 2.5
+    # negative interval means metrics off entirely
+    opts = p.parse_args(["--no-ssh", "--metrics-interval", "-1"])
+    t = cli.test_opts_to_test(opts, noop_test())
+    assert t["metrics"] is False
+
+
+# ---------------------------------------------------------------------------
+# end to end: fake-mode run -> store dir artifacts -> web UI
+# ---------------------------------------------------------------------------
+
+def _run_fake_cas(tmp, **overrides):
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu import core
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.fakes import AtomClient, AtomDB, noop_test
+    from jepsen_tpu.models import CASRegister
+
+    db = AtomDB()
+    ops = gen.Fn(lambda: {"f": "write", "value": 1})
+    t = noop_test(
+        db=db, client=AtomClient(db),
+        generator=gen.limit(40, ops),
+        checker=linearizable(model=CASRegister()),
+        accelerator="cpu", concurrency=2, nodes=["n1", "n2"],
+        store_dir=str(tmp), **overrides)
+    return core.run(t)
+
+
+def test_e2e_fake_run_exports_metrics(tmp_path):
+    res = _run_fake_cas(tmp_path)
+    assert res["results"]["valid?"] is True
+    from jepsen_tpu import store
+    name, ts, run_dir = store.latest(str(tmp_path))
+    prom = (run_dir / "metrics.prom").read_text()
+    rows = [json.loads(line) for line in
+            (run_dir / "metrics.json").read_text().splitlines()]
+    names = {r.get("name") for r in rows}
+    # interpreter instrumentation saw the 40 writes
+    ops = [r for r in rows if r.get("name") == "interpreter_ops_total"]
+    assert sum(r["value"] for r in ops) == 40
+    assert "interpreter_op_latency_seconds" in names
+    # checker instrumentation recorded the backend dispatch
+    assert any(r.get("name") == "checker_backend_total" for r in rows)
+    assert "interpreter_ops_total" in prom
+    assert "checker_backend_total" in prom
+    assert (run_dir / "metrics-summary.txt").exists()
+    # registry was restored and the flusher thread is gone
+    assert telemetry.get_registry().enabled is False
+    assert not _telemetry_threads()
+
+
+def test_e2e_metrics_disabled_writes_nothing(tmp_path):
+    res = _run_fake_cas(tmp_path, metrics=False)
+    assert res["results"]["valid?"] is True
+    from jepsen_tpu import store
+    _, _, run_dir = store.latest(str(tmp_path))
+    assert not (run_dir / "metrics.prom").exists()
+    assert not (run_dir / "metrics.json").exists()
+    assert not _telemetry_threads()
+
+
+def test_e2e_trace_flag_wires_traced_client(tmp_path):
+    res = _run_fake_cas(tmp_path, trace=True)
+    assert res["results"]["valid?"] is True
+    from jepsen_tpu import store
+    _, _, run_dir = store.latest(str(tmp_path))
+    spans = [json.loads(line) for line in
+             (run_dir / "trace.jsonl").read_text().splitlines()]
+    assert spans and all(s["name"].startswith("invoke/") for s in spans)
+
+
+def test_web_renders_metrics_table_and_links(tmp_path):
+    import urllib.request
+    from jepsen_tpu import store
+    from jepsen_tpu.web import make_server
+
+    _run_fake_cas(tmp_path)
+    name, ts, run_dir = store.latest(str(tmp_path))
+    srv = make_server(str(tmp_path), "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        # run listing links the exported telemetry artifacts
+        assert f"/{name}/{ts}/metrics.json" in home
+        assert f"/{name}/{ts}/metrics.prom" in home
+        run_page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{name}/{ts}/",
+            timeout=10).read().decode()
+        assert "<h2>metrics</h2>" in run_page
+        assert "interpreter_ops_total" in run_page
+        prom = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/{name}/{ts}/metrics.prom",
+            timeout=10).read().decode()
+        assert "# TYPE" in prom
+    finally:
+        srv.shutdown()
+
+
+def test_reanalysis_preserves_run_metrics(tmp_path):
+    """Standalone analyze exports under metrics-analyze.* — the live
+    run's interpreter measurements survive any number of re-checks."""
+    from jepsen_tpu import core, store
+    _run_fake_cas(tmp_path)
+    name, ts, run_dir = store.latest(str(tmp_path))
+    original = (run_dir / "metrics.json").read_text()
+    assert "interpreter_ops_total" in original
+    stored = store.load_test(name, ts, str(tmp_path))
+    from jepsen_tpu.checker.linearizable import linearizable
+    stored["checker"] = linearizable()
+    stored["store_dir"] = str(tmp_path)
+    core.analyze(stored)
+    assert (run_dir / "metrics.json").read_text() == original
+    reanalysis = (run_dir / "metrics-analyze.json").read_text()
+    assert "checker_backend_total" in reanalysis
+    assert "interpreter_ops_total" not in reanalysis
+
+
+def test_store_telemetry_artifacts_listing(tmp_path):
+    from jepsen_tpu import store
+    (tmp_path / "metrics.prom").write_text("")
+    (tmp_path / "profile").mkdir()
+    arts = store.telemetry_artifacts(tmp_path)
+    assert set(arts) == {"metrics.prom", "profile"}
+
+
+def test_nemesis_fault_events_recorded():
+    """A kill/heal nemesis schedule lands fault-window events + the
+    active-window gauge returning to zero."""
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu.generator import interpreter
+    from jepsen_tpu.nemesis import Nemesis
+    from jepsen_tpu.utils import with_relative_time
+
+    class NoteNemesis(Nemesis):
+        def invoke(self, test, op):
+            return {**op, "type": "info"}
+
+    reg = telemetry.Registry()
+    with telemetry.use(reg):
+        test = {"concurrency": 1, "nodes": ["n1"],
+                "nemesis": NoteNemesis(), "client": None,
+                "generator": gen.nemesis_gen([{"f": "kill", "value": None},
+                                              {"f": "start", "value": None}])}
+        with with_relative_time():
+            interpreter.run(test)
+    events = [row for row in reg.snapshot() if row.get("type") == "event"]
+    phases = [e["fields"]["phase"] for e in events]
+    assert phases == ["begin", "end"]
+    assert reg.gauge("nemesis_fault_active").value() == 0
+    assert reg.counter("nemesis_ops_total",
+                       labels=("f",)).value(f="kill") == 1
