@@ -24,7 +24,10 @@ metric:
                               SCC trim vs CPU trim (BASELINE config 5).
   6. matrix_kernel_128k     — block-composed transfer-matrix kernel on a
                               small-value-domain 128k-event history vs the
-                              event-by-event dense scan on device.
+                              event-by-event dense scan on device; carries
+                              per-phase attribution (phase_*_s measured
+                              host/device split + modeled_*_frac analytic
+                              FLOP shares — doc/performance.md).
   7. max_history_len_300s   — largest single history verified on device
                               within the 300 s budget (north-star scaling
                               metric; run length capped by
@@ -328,6 +331,8 @@ def cfg_multikey():
         for s in all_streams[:n]:
             assert check_stream(s).valid is True
 
+    from jepsen_tpu.parallel import pipeline
+
     for nk, main, cpu_trials in ((64, True, 3), (256, False, 2),
                                  (1024, False, 2)):
         streams = all_streams[:nk]
@@ -349,6 +354,21 @@ def cfg_multikey():
         except Exception:
             print("[bench] roofline add-on failed:", file=sys.stderr)
             traceback.print_exc()
+        # dispatch-pipeline occupancy (the overlap evidence for the
+        # small-batch fix): stats of the last trial's pipeline
+        ps = pipeline.last_stats()
+        if ps.get("queue") == "matrix":
+            extras.update(
+                pipeline_batches=ps["batches"],
+                pipeline_inflight_peak=ps["inflight_peak"],
+                pipeline_overlap_frac=ps["overlap_frac"],
+                pipeline_stall_s=ps["stall_s"])
+            # ... and into the bench_summary telemetry block, so the
+            # occupancy evidence survives the driver's stdout tail
+            _stage_note(f"multikey_{nk}x1k",
+                        pipeline={k: ps[k] for k in
+                                  ("batches", "inflight_peak",
+                                   "overlap_frac", "stall_s", "sync_s")})
         emit(name, nk * 1000 / med, "ops/s", dt_cpu / med,
              cpu_sequential_ops_per_sec=round(nk * 1000 / dt_cpu, 2),
              cpu_trials=cpu_trials, **extras)
@@ -378,12 +398,35 @@ def cfg_set_full():
     dev = SetFullChecker(accelerator="tpu")
     cpu = SetFullChecker(accelerator="cpu")
     _warm_timed("set_full", lambda: dev.check(test, history, opts))
-    r_dev, t_dev = _trials(lambda: dev.check(test, history, opts), 5)
+    # per-trial kernel-only time (setscan.last_kernel_seconds): the
+    # hbm_frac roofline divides bytes moved by DEVICE time, not the
+    # whole stage (which is mostly host history parse)
+    from jepsen_tpu.ops import setscan
+    kernel_times: list[float] = []
+
+    def dev_phased():
+        out = dev.check(test, history, opts)
+        kernel_times.append(setscan.last_kernel_seconds())
+        return out
+
+    r_dev, t_dev = _trials(dev_phased, 5)
     r_cpu, t_cpu = _trials(lambda: cpu.check(test, history, opts), 5)
     assert r_dev["valid?"] and r_cpu["valid?"]
     assert r_dev["stable-count"] == r_cpu["stable-count"]
     med, extras = _spread(t_dev, n_els)
     cpu_med, _ = _spread(t_cpu, n_els)
+    try:
+        n_reads = n_els // read_every
+        mb = setscan.modeled_bytes(n_reads, n_els)
+        k_med = _median(kernel_times)
+        bw = device_roofline()["hbm_bytes_per_sec"]
+        extras.update(
+            modeled_hbm_bytes=mb,
+            kernel_seconds=round(k_med, 4),
+            hbm_frac=round((mb / max(k_med, 1e-9)) / bw, 4))
+    except Exception:
+        print("[bench] set-full roofline add-on failed:", file=sys.stderr)
+        traceback.print_exc()
     emit("set_full_elements_per_sec", n_els / med, "elements/s",
          cpu_med / med, cpu_elements_per_sec=round(n_els / cpu_med, 2),
          **extras)
@@ -521,8 +564,32 @@ def cfg_matrix_kernel():
     m = _warm_timed("matrix_kernel",              # warm-up compile
                     lambda: matrix_check(stream))
     assert m is not None and m[0] and not m[2], m
-    m, t_matrix = _trials(lambda: matrix_check(stream), 5)
+    # per-trial host/device phase split (r5 weak #1: the 17.6%-of-peak
+    # single-dispatch fraction was unattributable): prepass/grids are
+    # host encode, dispatch is the async kernel call, fetch is the
+    # device compute + readback wait
+    from jepsen_tpu.ops import jitlin as jitlin_mod
+    phase_trials: list[dict] = []
+
+    def matrix_phased():
+        out = matrix_check(stream)
+        phase_trials.append(jitlin_mod.last_phase_seconds())
+        return out
+
+    m, t_matrix = _trials(matrix_phased, 5)
     dt_matrix, extras = _spread(t_matrix, E)
+    try:
+        from jepsen_tpu.ops.jitlin import _matrix_plan
+        Vb = _bucket(V, 8)
+        C_plan, _T = _matrix_plan(1, S, n_returns, Vb, None)
+        extras.update(telemetry.matrix_phase_model(
+            n_returns, S, Vb, C_plan, 1))
+        for ph in ("prepass", "grids", "dispatch", "fetch"):
+            vals = sorted(p.get(ph, 0.0) for p in phase_trials)
+            extras[f"phase_{ph}_s"] = vals[len(vals) // 2]
+    except Exception:
+        print("[bench] phase attribution failed:", file=sys.stderr)
+        traceback.print_exc()
 
     batch = pad_streams([stream], length=_bucket(E))
     run = JitLinKernel()._get(S, CAPACITY, batched=False, num_states=V)

@@ -245,6 +245,18 @@ class LinearizableChecker(Checker):
                         "checker_roofline_frac",
                         "achieved / measured f32 matmul peak "
                         "(see doc/observability.md)").set(achieved / peak)
+                # per-phase attribution (doc/performance.md): where the
+                # dispatch wall went — host encode (prepass/grids) vs
+                # the async call vs device compute + readback. A small
+                # roofline_frac with small host phases is fixed
+                # round-trip overhead, not kernel inefficiency.
+                from jepsen_tpu.ops.jitlin import last_phase_seconds
+                phase_g = reg.gauge(
+                    "checker_matrix_phase_seconds",
+                    "host/device phase split of the last matrix "
+                    "dispatch", labels=("phase",))
+                for ph, secs in last_phase_seconds().items():
+                    phase_g.set(secs, phase=ph)
         except Exception:  # noqa: BLE001 — telemetry never fails a check
             logger.exception("checker telemetry recording failed")
 

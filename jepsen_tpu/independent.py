@@ -342,8 +342,16 @@ class IndependentChecker(Checker):
                 return None
             streams = [e[0] for e in encs]
             step_py, spec = encs[0][1], encs[0][2]
-            outcomes = batch_check(streams, capacity=chk.capacity,
-                                   kernel=chk._tpu_kernel(spec))
+            # accelerator=auto lets batch_check's round-trip cost model
+            # route small batches to the C++/CPU lane instead of eating
+            # the device dispatch latency (parallel.pipeline.CostModel)
+            from jepsen_tpu import parallel as par
+            outcomes = batch_check(
+                streams, capacity=chk.capacity,
+                kernel=chk._tpu_kernel(spec),
+                accelerator="auto" if accelerator == "auto" else "device")
+            backend = ("jitlin-cpu(routed)" if par.last_route() == "cpu"
+                       else "jitlin-tpu")
             results = {}
             for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
                 v = verdict(alive, ovf)
@@ -353,7 +361,7 @@ class IndependentChecker(Checker):
                     results[fk] = {"valid?": res.valid,
                                    "algorithm": "jitlin-cpu(fallback)"}
                 else:
-                    results[fk] = {"valid?": v, "algorithm": "jitlin-tpu",
+                    results[fk] = {"valid?": v, "algorithm": backend,
                                    "configs-max": peak}
             if lin_name is None:
                 return results

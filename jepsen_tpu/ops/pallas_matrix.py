@@ -53,6 +53,15 @@ logger = logging.getLogger("jepsen.pallas")
 PALLAS_MAX_MV = 512
 PALLAS_MAX_SLOTS = 8
 
+# L-build pre-tiling budget: when the whole [U, MV, MV] pre-tiled uop
+# table fits this many bytes of VMEM alongside the static tables, the
+# per-step U1 @ Mt^T @ U2 tiling dots move OFF the critical path — they
+# run once in XLA before the pallas program instead of 2*S heavily
+# padded [MV, V] x [V, V] MXU dots per step (V is ~8-16 in the matrix
+# regime: those dots under-tile the 128-lane MXU badly, so their cost
+# is far above their FLOP share).
+PALLAS_PRETILE_BYTES = 4 << 20
+
 
 def available() -> bool:
     """Pallas path enabled? (env kill-switch for triage)."""
@@ -94,12 +103,19 @@ def _static_tables(S: int, V: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
+def _build(S: int, V: int, T: int, U: int, interpret: bool = False,
+           pretile: bool = False):
     """Compile-cached pallas chunk-product for static shapes.
 
     Returns fn(pend [T,G,S] f32, ids [T,G,S] i32, mtT [U,V,V] f32,
     slots [T,G] i32, valid [T,G] f32) -> P [G, MV, MV] bf16 — the
     per-chunk composed operator product over its T returns.
+
+    With ``pretile`` the [U, MV, MV] tiled uop table U1 @ Mt_u^T @ U2 is
+    precomputed ONCE in XLA before the pallas program (exact: tiling
+    repeats Mt's cells, no accumulation), and the kernel's L build
+    becomes a gather + VPU multiply — the per-step under-tiled [MV, V]
+    dots leave the critical path entirely.
     """
     import jax
     import jax.numpy as jnp
@@ -148,11 +164,16 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
             L = jnp.zeros((MV, MV), jnp.float32)
             for s in range(S):
                 idx = ids_ref[0, t, s]
-                mtT = mtT_ref[pl.dslice(idx, 1), :, :][0]   # [V, V]
-                tile = jnp.dot(
-                    jnp.dot(u1_ref[...], mtT,
-                            preferred_element_type=jnp.float32),
-                    u2_ref[...], preferred_element_type=jnp.float32)
+                if pretile:
+                    # mtT_ref holds the pre-tiled [U, MV, MV] table:
+                    # pure gather + VPU multiply, no per-step dots
+                    tile = mtT_ref[pl.dslice(idx, 1), :, :][0]
+                else:
+                    mtT = mtT_ref[pl.dslice(idx, 1), :, :][0]   # [V, V]
+                    tile = jnp.dot(
+                        jnp.dot(u1_ref[...], mtT,
+                                preferred_element_type=jnp.float32),
+                        u2_ref[...], preferred_element_type=jnp.float32)
                 L = L + pend_ref[0, t, s] * rexp_ref[s] * tile
             Bm = ((L + eye) > 0).astype(jnp.float32)
             # closure saturates once the exponent reaches the number of
@@ -178,6 +199,16 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
         G = pend.shape[0]
         full = lambda shape: pl.BlockSpec(
             shape, lambda g: (0,) * len(shape), memory_space=pltpu.VMEM)
+        if pretile:
+            # off-critical-path L-build: tile every uop's Mt^T over the
+            # (a, b) blocks once, in XLA (each output cell copies ONE
+            # Mt cell — exact, no accumulation)
+            mt_in = jnp.einsum("iv,uvw,wj->uij", jnp.asarray(U1), mtT,
+                               jnp.asarray(U2))
+            mt_spec = full((U, MV, MV))
+        else:
+            mt_in = mtT
+            mt_spec = full((U, V, V))
         return pl.pallas_call(
             kernel,
             grid=(G,),
@@ -186,7 +217,7 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, T, S), lambda g: (g, 0, 0),
                              memory_space=pltpu.VMEM),
-                full((U, V, V)),
+                mt_spec,
                 pl.BlockSpec((1, T, 1), lambda g: (g, 0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, T, 1), lambda g: (g, 0, 0),
@@ -200,7 +231,7 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((G, MV, MV), jnp.bfloat16),
             interpret=interpret,
-        )(pend, ids, mtT, slots, valid,
+        )(pend, ids, mt_in, slots, valid,
           jnp.asarray(Rexp), jnp.asarray(Kexp),
           jnp.asarray(U1), jnp.asarray(U2))
 
@@ -223,6 +254,11 @@ def _build(S: int, V: int, T: int, U: int, interpret: bool = False):
 FORCE_INTERPRET = False
 
 
+def _pretile_ok(S: int, V: int, U: int) -> bool:
+    MV = (1 << S) * V
+    return U * MV * MV * 4 <= PALLAS_PRETILE_BYTES
+
+
 def chunk_product(S: int, V: int, T: int, U: int,
                   interpret: bool | None = None):
     """The compiled kernel for these static shapes, or None when out of
@@ -232,7 +268,8 @@ def chunk_product(S: int, V: int, T: int, U: int,
     if not available() or S > PALLAS_MAX_SLOTS or MV > PALLAS_MAX_MV:
         return None
     return _build(S, V, T, U,
-                  FORCE_INTERPRET if interpret is None else interpret)
+                  FORCE_INTERPRET if interpret is None else interpret,
+                  _pretile_ok(S, V, U))
 
 
 _PROBED: dict = {}
@@ -305,7 +342,9 @@ def enabled(S: int, V: int) -> bool:
         mtT = (rng.random((U, V, V)) < 0.3).astype(np.float32)
         slots = rng.integers(0, S, (T, G)).astype(np.int32)
         valid = (rng.random((T, G)) < 0.8).astype(np.float32)
-        fn = _build(S, V, T, U, False)
+        # probe the same pretile variant production dispatches at this
+        # U — the two kernels differ in their L-build data path
+        fn = _build(S, V, T, U, False, _pretile_ok(S, V, U))
         got = np.asarray(fn(pend, ids, mtT, slots, valid),
                          dtype=np.float32)
         ref = _oracle_product(S, V, pend, ids, mtT, slots, valid)

@@ -24,6 +24,7 @@ form one 8-device mesh and run both paths end to end.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -76,6 +77,18 @@ def pad_to_multiple(batch: dict, multiple: int) -> tuple[dict, int]:
 
 _DEFAULT_KERNEL = None
 
+# How the most recent batch_check on THIS thread settled: "device"
+# (matrix/scan kernels) or "cpu" (the auto-routed native/Python lane).
+# Thread-local — Compose runs checkers concurrently under bounded_pmap,
+# and a module global would let one thread's route mislabel another's
+# results.
+_ROUTE = threading.local()
+
+
+def last_route() -> str:
+    """The lane the calling thread's most recent batch_check took."""
+    return getattr(_ROUTE, "value", "device")
+
 
 def _default_kernel():
     """One shared default JitLinKernel — its compile cache must survive
@@ -89,7 +102,8 @@ def _default_kernel():
 
 
 def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
-                step_ids=None, init_state: int = 0, kernel=None):
+                step_ids=None, init_state: int = 0, kernel=None,
+                accelerator: str = "device"):
     """Checks a batch of per-key event streams, sharded across a device
     mesh when one is available. The single batching implementation —
     JitLinKernel.check/check_batch delegate here.
@@ -101,6 +115,14 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     chunk axis is sharded across devices (matrix_check_batch handles the
     divisibility bump). The scan serves as the fallback for keys the
     matrix pass leaves undecided (not-alive or inexact).
+
+    ``accelerator``: "device" (default — the historical behavior),
+    "cpu" (the exact native/Python lane, bounded-thread-parallel over
+    keys), or "auto" — consult the round-trip cost model
+    (parallel.pipeline.CostModel) and take the CPU lane when it beats
+    the device's dispatch-latency floor (small batches on tunneled
+    chips). The thread-local ``last_route()`` records which lane
+    settled for the calling thread.
 
     Returns [(alive, died_event, overflow, peak)] per stream (real keys
     only; padding keys are dropped).
@@ -117,6 +139,13 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
             from jepsen_tpu.ops.jitlin import JitLinKernel
             kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
     streams = list(streams)
+    _ROUTE.value = "device"
+    if accelerator in ("cpu", "auto"):
+        cpu = _cpu_batch_maybe(streams, kernel,
+                               force=(accelerator == "cpu"))
+        if cpu is not None:
+            _ROUTE.value = "cpu"
+            return cpu
     # interned-state count selects the exact dense-table kernel when the
     # configuration space 2^S x V is small (jitlin._build_dense_step);
     # every stream must carry an intern table, else a stream with
@@ -162,6 +191,56 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
             return results
 
     return _scan_batch(streams, capacity, mesh, kernel, n_states)
+
+
+def _cpu_batch_maybe(streams, kernel, force: bool = False):
+    """The C++/CPU lane for ``accelerator=auto``: when the round-trip
+    cost model predicts the device's dispatch-latency floor dominates
+    (sub-128-key ``independent`` batches on tunneled chips), checks the
+    keys exactly on host — native C++ first (ctypes releases the GIL, so
+    bounded_pmap runs keys genuinely in parallel), Python stream search
+    as the fallback. Returns None when the device lane should run
+    (model says so, or the kernel's spec has no Python twin here).
+    Measured CPU throughput feeds back into the cost model
+    (pipeline.observe_cpu_rate) so routing tracks the actual host."""
+    import time
+
+    from jepsen_tpu.parallel import pipeline
+
+    # the host lane runs the CAS-register search (the Python twin
+    # honors any init_state; the native C++ lane hardcodes init id 0) —
+    # other specs keep the device lane, whose kernels are spec-generic.
+    # The spec is recognized by its closure origin: cas_register_spec
+    # builds a fresh step_ids per call, so identity against the shared
+    # default is not enough (the checker builds its own spec instance).
+    qn = getattr(kernel.step_ids, "__qualname__", "")
+    if not qn.startswith("cas_register_spec."):
+        if force:
+            # an EXPLICIT cpu request that can't be honored must not
+            # silently become a device dispatch
+            logger.warning(
+                "accelerator=cpu requested but kernel spec %r has no "
+                "host twin in batch_check; using the device lane", qn)
+        return None
+    init_state = kernel.init_state
+    total_events = sum(len(s.kind) for s in streams)
+    if not force and pipeline.auto_route(total_events) != "cpu":
+        return None
+    from jepsen_tpu.checker.linear_cpu import check_stream
+    from jepsen_tpu.native import check_stream_native
+    from jepsen_tpu.utils import bounded_pmap
+
+    def one(stream):
+        res = check_stream_native(stream) if init_state == 0 else None
+        if res is None or res.valid == "unknown":
+            res = check_stream(stream, init_state=init_state)
+        return (res.valid is True, res.failed_event, False,
+                res.configs_max)
+
+    t0 = time.perf_counter()
+    out = bounded_pmap(one, streams)
+    pipeline.observe_cpu_rate(total_events, time.perf_counter() - t0)
+    return out
 
 
 def _scan_batch(streams, capacity, mesh, kernel, n_states):

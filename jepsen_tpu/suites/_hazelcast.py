@@ -32,7 +32,7 @@ middle): Client=0x00, FencedLock=0x07, AtomicLong=0x09, Semaphore=0x0C,
 CPGroup=0x1E, CPSession=0x1F. They are centralised in :data:`MSG` so a
 deployment against a server revision that renumbers a module is a
 one-line audit. The mock-server wire tests
-(tests/test_hazelcast_wire.py) speak the same table from the server
+(tests/test_hazelcast.py) speak the same table from the server
 side and pin the codec layouts; the realdb-gated test exercises a real
 member when one is installed.
 """

@@ -637,6 +637,39 @@ def matrix_modeled_flops(n_returns: int, n_slots: int,
     return n_returns * (n_sq + 2) * 2.0 * MV ** 3
 
 
+def matrix_phase_model(n_returns: int, n_slots: int, num_states: int,
+                       n_chunks: int = 1, n_keys: int = 1) -> dict:
+    """Modeled FLOP shares of one transfer-matrix dispatch, by phase —
+    the analytic companion to the measured host/device split
+    (ops.jitlin.last_phase_seconds). Three on-device phases:
+
+    * ``matmul`` — the closure squarings + kill-apply + compose per
+      return: (ceil(log2 S) + 2) dense [MV, MV] products.
+    * ``lbuild`` — the elementwise L assembly (each of the MV^2 cells
+      sums S gated products).
+    * ``combine`` — the per-key chunk-product chain: C-1 products per
+      key plus the tot0 compose, amortized over the whole dispatch.
+
+    The shares say where a restructure could possibly pay: when
+    ``lbuild_frac`` + ``combine_frac`` is already small, the residual
+    gap to peak is NOT in those phases — it is fixed per-dispatch
+    overhead (host prep + round trip), which the measured phase split
+    attributes directly."""
+    MV = (1 << n_slots) * num_states
+    # the matmul term IS the roofline numerator — shared with
+    # checker_roofline_frac so the attribution can never diverge from
+    # the fraction it explains
+    matmul = matrix_modeled_flops(n_returns, n_slots, num_states)
+    lbuild = n_returns * 2.0 * n_slots * MV * MV
+    combine = n_keys * n_chunks * 2.0 * MV ** 3
+    total = matmul + lbuild + combine
+    return {
+        "modeled_matmul_frac": round(matmul / total, 4),
+        "modeled_lbuild_frac": round(lbuild / total, 6),
+        "modeled_combine_frac": round(combine / total, 6),
+    }
+
+
 _DEVICE_PEAK: dict = {}
 
 

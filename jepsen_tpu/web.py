@@ -103,6 +103,24 @@ def _metrics_table(path: Path) -> str:
             "</tr>" + "".join(cells) + "</table>" + extra)
 
 
+def _elle_section(rel: str, target: Path) -> str:
+    """Links a run's elle/ anomaly artifacts (per-anomaly-type
+    explanation files the txn checkers write on invalid results) from
+    the run page. Empty string when the run has none."""
+    d = target / "elle"
+    if not d.is_dir():
+        return ""
+    files = sorted(p.name for p in d.iterdir()
+                   if p.is_file() and p.suffix == ".txt")
+    if not files:
+        return ""
+    base = rel.rstrip("/")
+    links = " ".join(
+        f"<a href='/{base}/elle/{html.escape(fn)}'>{html.escape(fn)}</a>"
+        for fn in files)
+    return f"<h2>anomalies (elle)</h2><p>{links}</p>"
+
+
 class Handler(BaseHTTPRequestHandler):
     store_dir = "store"
 
@@ -176,7 +194,9 @@ class Handler(BaseHTTPRequestHandler):
                 f"{html.escape(p.name)}</a></li>"
                 for p in sorted(target.iterdir()))
             metrics = _metrics_table(target / "metrics.json")
-            return self._send(self._page(rel, f"{metrics}<ul>{items}</ul>"))
+            elle = _elle_section(rel, target)
+            return self._send(
+                self._page(rel, f"{elle}{metrics}<ul>{items}</ul>"))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"

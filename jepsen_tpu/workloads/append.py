@@ -19,11 +19,17 @@ class AppendChecker(Checker):
         return "elle-list-append"
 
     def check(self, test, history, opts):
-        return list_append.check(
+        result = list_append.check(
             history,
             accelerator=opts.get("accelerator", self.accelerator),
             consistency_models=opts.get("consistency_models",
                                         self.consistency_models))
+        # invalid check: leave human-readable per-anomaly explanation
+        # files under store/<test>/<ts>/elle/ (the reference passes
+        # elle :directory per test, append.clj:17-22)
+        from jepsen_tpu.elle import artifacts
+        artifacts.write_for_test(test, result, opts)
+        return result
 
 
 def checker(**kw) -> Checker:

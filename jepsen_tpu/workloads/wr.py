@@ -19,11 +19,16 @@ class WrChecker(Checker):
         return "elle-rw-register"
 
     def check(self, test, history, opts):
-        return rw_register.check(
+        result = rw_register.check(
             history,
             accelerator=opts.get("accelerator", self.accelerator),
             consistency_models=opts.get("consistency_models",
                                         self.consistency_models))
+        # same artifact surface as the list-append checker: per-anomaly
+        # explanation files in the run's elle/ directory when invalid
+        from jepsen_tpu.elle import artifacts
+        artifacts.write_for_test(test, result, opts)
+        return result
 
 
 def checker(**kw) -> Checker:

@@ -62,6 +62,7 @@ CASES = [
 @pytest.mark.parametrize("suite_fn,opts,may_be_unknown", CASES,
                          ids=[f"{fn.__name__}-{o['workload']}"
                               for fn, o, _ in CASES])
+@pytest.mark.slow
 def test_analyze_verdict_matches_live(tmp_path, suite_fn, opts,
                                       may_be_unknown):
     live, re = _run_and_reanalyze(suite_fn, tmp_path, **opts)

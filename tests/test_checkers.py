@@ -3,6 +3,8 @@ jepsen/test/jepsen/checker_test.clj's strategy)."""
 from jepsen_tpu import checker as c
 from jepsen_tpu.models import UnorderedQueue
 
+import pytest
+
 
 def op(typ, process, f, value=None, **kw):
     return {"type": typ, "process": process, "f": f, "value": value, **kw}
@@ -220,6 +222,7 @@ def _plot_history():
     return h
 
 
+@pytest.mark.slow
 def test_perf_timeline_clock_render(tmp_path):
     from jepsen_tpu import checker as chk
     test = {"name": "plotty", "start_time": "20260729T000000",

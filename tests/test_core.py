@@ -159,6 +159,7 @@ def test_time_limit_wall_clock():
         assert 5 <= n <= 40  # ~20 ops in 1s at 50ms stagger
 
 
+@pytest.mark.slow
 def test_high_concurrency_soak():
     """50 workers x ~4 s of mixed register traffic with a fast nemesis:
     shakes out interpreter races; asserts the structural invariants the

@@ -84,6 +84,7 @@ def test_tablet_mover_timeout_value(monkeypatch):
     assert out["value"] == "timeout"
 
 
+@pytest.mark.slow
 def test_dgraph_fake_run_with_move_tablet_fault():
     result = run_fake(dgraph.dgraph_test, workload="register",
                       faults={"move-tablet"}, nemesis_interval=0.3)
@@ -302,6 +303,7 @@ def test_rethinkdb_counter_client_ops():
     assert out["type"] == "ok" and out["value"] == 7
 
 
+@pytest.mark.slow
 def test_rethinkdb_fake_set_and_counter_runs():
     result = run_fake(rethinkdb.rethinkdb_test, workload="set")
     assert result["results"]["valid?"] is True, result["results"]
@@ -392,6 +394,7 @@ def test_pause_client_bodies():
     assert out["type"] == "ok" and out["value"] == [7, [1, 3]]
 
 
+@pytest.mark.slow
 def test_aerospike_fake_pause_run():
     result = run_fake(aerospike.aerospike_test, workload="pause",
                       faults={"pause-writes"}, time_limit=2.0,

@@ -19,7 +19,10 @@ from dataclasses import replace
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.deepfuzz
+# also `slow`: a tier-1 `-m 'not slow'` invocation OVERRIDES pyproject's
+# `-m 'not deepfuzz'` addopts filter (later -m wins), so without the
+# second marker the quick lane would run these multi-minute fuzzes
+pytestmark = [pytest.mark.deepfuzz, pytest.mark.slow]
 
 
 def test_elle_production_vs_oracle_many():

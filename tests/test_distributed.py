@@ -13,6 +13,11 @@ import sys
 
 import pytest
 
+# slow lane: spawns two OS processes that each initialize a jax
+# runtime — tens of seconds of real time, and dependent on the
+# backend's multiprocess support
+pytestmark = pytest.mark.slow
+
 
 def _free_port() -> int:
     s = socket.socket()

@@ -1,0 +1,122 @@
+"""Elle anomaly artifacts: per-anomaly-type explanation files in the
+store on invalid txn checks, linked from the web UI run page (the
+reference's elle output directory, append.clj:17-22)."""
+import tempfile
+import threading
+import urllib.request
+from pathlib import Path
+
+from jepsen_tpu.elle import artifacts
+
+
+def _anomalous_history():
+    """Two mutually-observing append txns: a wr cycle (G1c)."""
+    h = []
+    t = 0
+
+    def txn(proc, mops):
+        nonlocal t
+        h.append({"type": "invoke", "process": proc,
+                  "value": [[m[0], m[1], None if m[0] == "r" else m[2]]
+                            for m in mops], "time": t})
+        h.append({"type": "ok", "process": proc, "value": mops,
+                  "time": t + 1})
+        t += 2
+
+    txn(0, [["append", 0, 1], ["r", 1, [2]]])
+    txn(1, [["append", 1, 2], ["r", 0, [1]]])
+    return h
+
+
+def test_write_artifacts_renders_cycles(tmp_path):
+    result = {
+        "valid?": False,
+        "anomalies": {
+            "G1c": [[{"from": [["append", 0, 1], ["r", 1, [2]]],
+                      "type": "wr",
+                      "to": [["append", 1, 2], ["r", 0, [1]]]},
+                     {"from": [["append", 1, 2], ["r", 0, [1]]],
+                      "type": "wr",
+                      "to": [["append", 0, 1], ["r", 1, [2]]]}]],
+            "G1a": [{"key": 3, "value": 9}],
+        },
+    }
+    written = artifacts.write_artifacts(tmp_path, result)
+    assert set(written) == {"G1c.txt", "G1a.txt", "index.txt"}
+    g1c = (tmp_path / "G1c.txt").read_text()
+    # human-readable: the gloss, the op terms, and the edge arrows
+    assert "Cyclic information flow" in g1c
+    assert "append 0 1" in g1c
+    assert "--wr-->" in g1c
+    idx = (tmp_path / "index.txt").read_text()
+    assert "G1c.txt" in idx and "valid?: False" in idx
+
+
+def test_write_artifacts_empty_result(tmp_path):
+    assert artifacts.write_artifacts(tmp_path, {"valid?": True}) == []
+    assert not (tmp_path / "index.txt").exists()
+
+
+def test_append_checker_writes_store_artifacts():
+    """End to end: an invalid list-append check through the workload
+    checker leaves readable elle/ files in the test's store dir."""
+    from jepsen_tpu.workloads import append as append_wl
+
+    with tempfile.TemporaryDirectory() as tmp:
+        test = {"name": "elle-art", "start_time": "20260803T000000",
+                "store_dir": tmp}
+        chk = append_wl.checker(accelerator="cpu")
+        res = chk.check(test, _anomalous_history(), {})
+        assert res["valid?"] is False
+        d = Path(tmp) / "elle-art" / "20260803T000000" / "elle"
+        assert (d / "index.txt").exists()
+        files = sorted(p.name for p in d.iterdir())
+        assert any(f.startswith("G") for f in files)
+        # every artifact is plain readable text mentioning the ops
+        body = "".join((d / f).read_text() for f in files)
+        assert "append" in body
+
+
+def test_valid_check_writes_nothing():
+    from jepsen_tpu.workloads import append as append_wl
+
+    history = [
+        {"type": "invoke", "process": 0, "value": [["append", 0, 1]],
+         "time": 0},
+        {"type": "ok", "process": 0, "value": [["append", 0, 1]],
+         "time": 1},
+    ]
+    with tempfile.TemporaryDirectory() as tmp:
+        test = {"name": "elle-ok", "start_time": "20260803T000000",
+                "store_dir": tmp}
+        res = append_wl.checker(accelerator="cpu").check(test, history, {})
+        assert res["valid?"] is True
+        assert not (Path(tmp) / "elle-ok" / "20260803T000000"
+                    / "elle").exists()
+
+
+def test_web_run_page_links_elle_artifacts():
+    from jepsen_tpu.web import make_server
+    from jepsen_tpu.workloads import append as append_wl
+
+    with tempfile.TemporaryDirectory() as tmp:
+        test = {"name": "elle-web", "start_time": "20260803T000000",
+                "store_dir": tmp}
+        append_wl.checker(accelerator="cpu").check(
+            test, _anomalous_history(), {})
+        # the run page needs a dir; the checker created it
+        srv = make_server(tmp, "127.0.0.1", 0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/elle-web/20260803T000000/",
+                timeout=10).read().decode()
+            assert "anomalies (elle)" in page
+            assert "index.txt" in page
+            art = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/elle-web/20260803T000000/"
+                f"elle/index.txt", timeout=10).read().decode()
+            assert "Elle anomaly artifacts" in art
+        finally:
+            srv.shutdown()

@@ -87,6 +87,7 @@ def test_merged_windows():
     assert fauna_monotonic.merged_windows(2, []) == []
 
 
+@pytest.mark.slow
 def test_timestamp_value_plotter_renders_windows(tmp_path):
     history = []
     for i in range(40):
@@ -313,16 +314,19 @@ def test_not_found_error_is_tagged_for_checker():
 # fake-mode lifecycles
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_fauna_fake_monotonic_run():
     result = run_fake(faunadb.faunadb_test, workload="monotonic")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_fauna_fake_multimonotonic_run():
     result = run_fake(faunadb.faunadb_test, workload="multimonotonic")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_fauna_fake_internal_run():
     result = run_fake(faunadb.faunadb_test, workload="internal")
     assert result["results"]["valid?"] is True, result["results"]
@@ -385,6 +389,7 @@ def test_topology_node_view_parses_status(dummy):
         {"node": "n2", "replica": "replica-1", "state": "active"}]
 
 
+@pytest.mark.slow
 def test_fauna_fake_run_with_topology_fault():
     result = run_fake(faunadb.faunadb_test, workload="register",
                       faults={"topology"}, nemesis_interval=0.2,
@@ -432,6 +437,7 @@ def test_replica_partition_ops_shapes(dummy):
     assert seen == {"intra-replica", "inter-replica"}
 
 
+@pytest.mark.slow
 def test_replica_partition_fake_run_composes_with_topology():
     result = run_fake(faunadb.faunadb_test, workload="register",
                       time_limit=3.0, nemesis_interval=0.5,

@@ -10,6 +10,7 @@ for itself (VERDICT round 1, weak #1).
 import jax
 
 import __graft_entry__ as ge
+import pytest
 
 
 def test_entry_returns_jittable_fn_and_args():
@@ -18,12 +19,14 @@ def test_entry_returns_jittable_fn_and_args():
     assert bool(alive) and not bool(ovf)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_in_process():
     # Test env: 8 virtual CPU devices, backends initialized -> fast path.
     assert len(jax.devices()) >= 8
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_self_provisions_when_short_of_devices():
     # 16 > the 8 devices this process owns: must re-exec with a
     # self-provisioned 16-device virtual mesh and still pass.

@@ -520,6 +520,7 @@ def test_suite_net_error_mapping(monkeypatch):
 # fake-mode lifecycle for every CP workload
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 @pytest.mark.parametrize("wl", ["lock", "cp-lock", "reentrant-cp-lock",
                                 "fenced-lock", "reentrant-fenced-lock",
                                 "cp-semaphore", "atomic-long-ids",
@@ -610,6 +611,7 @@ def test_suite_map_and_ref_clients_against_mock(member, monkeypatch):
         c.close({})
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("wl", ["map-set", "crdt-map", "atomic-ref-ids",
                                 "id-gen-ids", "cp-id-gen-long",
                                 "cp-cas-reference"])

@@ -9,6 +9,8 @@ import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
+import pytest
+
 from jepsen_tpu import control
 from jepsen_tpu.suites import (chronos, crate, dgraph, elasticsearch,
                                hazelcast, ignite)
@@ -398,6 +400,7 @@ def run_fake(suite_test_fn, **opts):
         return core.run(t)
 
 
+@pytest.mark.slow
 def test_hazelcast_fake_queue_run():
     result = run_fake(hazelcast.hazelcast_test, workload="queue")
     r = result["results"]
@@ -405,21 +408,25 @@ def test_hazelcast_fake_queue_run():
     assert r["workload"]["attempt-count"] > 0
 
 
+@pytest.mark.slow
 def test_elasticsearch_fake_set_run():
     result = run_fake(elasticsearch.elasticsearch_test, workload="set")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_crate_fake_register_run():
     result = run_fake(crate.crate_test, workload="register")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_ignite_fake_register_run():
     result = run_fake(ignite.ignite_test, workload="register")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_dgraph_fake_set_run():
     result = run_fake(dgraph.dgraph_test, workload="set")
     assert result["results"]["valid?"] is True, result["results"]
@@ -537,6 +544,7 @@ def test_dgraph_client_upsert_conditional():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_upsert_checker_and_dgraph_fake_runs():
     from jepsen_tpu.workloads.upsert import UpsertChecker
     from conftest import run_fake
@@ -610,6 +618,7 @@ def test_crate_lost_updates_rmw_versions():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_crate_fake_lost_updates_run():
     from conftest import run_fake
     from jepsen_tpu.suites.crate import crate_test
@@ -671,6 +680,7 @@ def test_version_divergence_checker_and_crate_bodies():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_crate_fake_version_divergence_run():
     from conftest import run_fake
     from jepsen_tpu.suites.crate import crate_test
@@ -760,6 +770,7 @@ def test_elasticsearch_dirty_read_client_bodies():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_elasticsearch_fake_dirty_read_run():
     from conftest import run_fake
     from jepsen_tpu.suites.elasticsearch import elasticsearch_test
@@ -806,6 +817,7 @@ def test_hazelcast_map_workload_rw_register():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_hazelcast_fake_map_run():
     from conftest import run_fake
     from jepsen_tpu.suites.hazelcast import hazelcast_test
@@ -966,6 +978,7 @@ def test_crate_dirty_read_client_bodies():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_crate_fake_dirty_read_run():
     from conftest import run_fake
     from jepsen_tpu.suites.crate import crate_test

@@ -273,6 +273,7 @@ def test_suite_bank_client_net_error_reconnects(server, monkeypatch):
     c.close(test)
 
 
+@pytest.mark.slow
 def test_ignite_bank_fake_lifecycle():
     from conftest import run_fake
     from jepsen_tpu.suites.ignite import ignite_test

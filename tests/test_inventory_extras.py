@@ -207,6 +207,7 @@ def test_charybdefs_nemesis_ops(dummy):
     n.teardown(t)
 
 
+@pytest.mark.slow
 def test_suite_test_all_sweeps_fake(tmp_path):
     """The shared test-all runner (suites.standard_test_all) sweeps
     every supported workload of a suite in fake mode (cli.clj:429-515;
@@ -220,6 +221,7 @@ def test_suite_test_all_sweeps_fake(tmp_path):
         assert code == 0, suite.__name__
 
 
+@pytest.mark.slow
 def test_faunadb_test_all_sweep_fake(tmp_path):
     """FaunaDB's sweep covers all eight workloads incl. the
     timestamp-monotonicity family."""

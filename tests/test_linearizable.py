@@ -150,6 +150,7 @@ def gen_history(rng: random.Random, n_procs=4, n_ops=40, values=4, corrupt=False
     return history
 
 
+@pytest.mark.slow
 def test_differential_random_histories():
     """wgl == jitlin-cpu == jax kernel across random valid/corrupted
     histories."""
@@ -243,6 +244,7 @@ def _scan_alive(history):
     return bool(alive)
 
 
+@pytest.mark.slow
 def test_matrix_kernel_differential_valid():
     from __graft_entry__ import _register_history  # conftest adds the root
     from jepsen_tpu.checker.linear_encode import encode_register_ops
@@ -254,6 +256,7 @@ def test_matrix_kernel_differential_valid():
         assert m[0] == _scan_alive(h) is True, (n, seed)
 
 
+@pytest.mark.slow
 def test_matrix_kernel_differential_invalid():
     import random
     from __graft_entry__ import _register_history  # conftest adds the root
@@ -561,6 +564,7 @@ def test_segmented_check_matches_whole_run_invalid():
     assert seg[1] >= 0  # died index reported (global)
 
 
+@pytest.mark.slow
 def test_segmented_check_sparse_kernel_path():
     """Force the sparse (capacity-K) kernel by exceeding the dense
     state-count regime, exercising the mask/state resume carry."""
@@ -574,6 +578,7 @@ def test_segmented_check_sparse_kernel_path():
     assert bool(seg[0]) == bool(whole[0])
 
 
+@pytest.mark.slow
 def test_matrix_resume_matches_monolithic():
     """Chaining segment operator products equals one monolithic matrix
     run (block composition is associative), valid and invalid alike."""

@@ -5,6 +5,7 @@ from jepsen_tpu import control
 from jepsen_tpu.suites import faunadb, logcabin, robustirc
 
 from conftest import run_fake  # noqa: E402
+import pytest
 
 NODES = ["n1", "n2", "n3", "n4", "n5"]
 
@@ -51,11 +52,13 @@ def test_fauna_client_not_found_read_is_nil():
     assert out["type"] == "ok" and out["value"] == [2, None]
 
 
+@pytest.mark.slow
 def test_fauna_fake_register_run():
     result = run_fake(faunadb.faunadb_test)
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_fauna_fake_bank_run():
     result = run_fake(faunadb.faunadb_test, workload="bank")
     assert result["results"]["valid?"] is True, result["results"]
@@ -86,6 +89,7 @@ def test_robustirc_db_commands():
         control.disconnect_all(t)
 
 
+@pytest.mark.slow
 def test_robustirc_fake_set_run():
     result = run_fake(robustirc.robustirc_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -146,6 +150,7 @@ def test_logcabin_error_mapping():
     assert out["type"] == "fail"
 
 
+@pytest.mark.slow
 def test_logcabin_fake_register_run():
     result = run_fake(logcabin.logcabin_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -265,6 +270,7 @@ def test_fauna_client_set_and_adya_expressions():
     assert out["type"] == "fail"
 
 
+@pytest.mark.slow
 def test_fauna_fake_set_and_adya_runs():
     for wl in ("set", "adya"):
         result = run_fake(faunadb.faunadb_test, workload=wl)
@@ -330,6 +336,7 @@ def test_fauna_pages_client_cursored_reads():
     assert sent[2]["after"] == ["c1"]  # the cursor chained
 
 
+@pytest.mark.slow
 def test_fauna_fake_pages_run():
     result = run_fake(faunadb.faunadb_test, workload="pages")
     assert result["results"]["valid?"] is True, result["results"]
@@ -387,6 +394,7 @@ def test_tracer_disabled_is_noop():
     tr.close()   # nothing written, nothing raised
 
 
+@pytest.mark.slow
 def test_dgraph_trace_fake_run(tmp_path):
     import json
 

@@ -358,6 +358,7 @@ def test_role_process_targets_right_roles(dummy):
                       "resume-tserver"}
 
 
+@pytest.mark.slow
 def test_yugabyte_fake_mode_kill_master_end_to_end():
     """--fault kill-master runs the full fake lifecycle and the kill ops
     reach only master nodes (VERDICT r2 item 4)."""
@@ -376,6 +377,7 @@ def test_yugabyte_fake_mode_kill_master_end_to_end():
     assert set(starts) <= masters
 
 
+@pytest.mark.slow
 def test_cockroach_fake_mode_skew_critical_end_to_end():
     """--fault skew-critical runs the full fake lifecycle
     (VERDICT r2 item 4)."""

@@ -65,6 +65,31 @@ def test_kernel_matches_numpy_oracle_interpret():
     assert np.array_equal(ref, got)
 
 
+def test_pretile_variant_matches_oracle_interpret():
+    """The pre-tiled L-build (uop tiles computed once in XLA, gathered
+    in the kernel) is bit-identical to the in-kernel tiling dots and
+    the numpy oracle — the variant production picks when the [U, MV,
+    MV] table fits the VMEM budget."""
+    from jepsen_tpu.ops.pallas_matrix import _build, _pretile_ok
+
+    S, V, T, U, G = 3, 8, 5, 16, 4
+    assert _pretile_ok(S, V, U)  # this shape IS the pretile regime
+    rng = np.random.default_rng(3)
+    pend = (rng.random((T, G, S)) < 0.5).astype(np.float32)
+    ids = rng.integers(0, U, (T, G, S)).astype(np.int32)
+    mtT = (rng.random((U, V, V)) < 0.3).astype(np.float32)
+    slots = rng.integers(0, S, (T, G)).astype(np.int32)
+    valid = (rng.random((T, G)) < 0.8).astype(np.float32)
+
+    ref = _oracle(S, V, pend, ids, mtT, slots, valid)
+    for pretile in (False, True):
+        fn = _build(S, V, T, U, interpret=True, pretile=pretile)
+        got = np.asarray(fn(pend, ids, mtT, slots, valid)
+                         ).astype(np.float32)
+        assert np.array_equal(ref, got), f"pretile={pretile}"
+
+
+@pytest.mark.slow
 def test_production_dispatch_verdict_parity(monkeypatch):
     """matrix_check through the pallas path (interpret mode, forced)
     agrees with the XLA scan path on valid AND corrupted histories —

@@ -3,6 +3,8 @@ plus the widened postgres suite — test-map shapes, DB-automation
 command shapes over the dummy remote, fake-mode runs for the new
 monotonic/sequential workloads, and the shared PG client's workload
 bodies against a stub connection."""
+import pytest
+
 from jepsen_tpu import control
 from jepsen_tpu.suites import cockroachdb, postgres, stolon, yugabyte
 from jepsen_tpu.suites._pg_client import PGSuiteClient, seq_table
@@ -85,6 +87,7 @@ def test_yugabyte_ycql_workloads_resolve():
 # fake-mode lifecycle: monotonic & sequential
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_cockroach_fake_monotonic_run():
     result = run_fake(cockroachdb.cockroachdb_test, workload="monotonic")
     assert result["results"]["valid?"] is True, result["results"]
@@ -93,21 +96,25 @@ def test_cockroach_fake_monotonic_run():
     assert finals and finals[-1]["value"], "final read must return rows"
 
 
+@pytest.mark.slow
 def test_cockroach_fake_sequential_run():
     result = run_fake(cockroachdb.cockroachdb_test, workload="sequential")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_stolon_fake_append_run():
     result = run_fake(stolon.stolon_test, workload="append")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_yugabyte_fake_bank_run():
     result = run_fake(yugabyte.yugabyte_test, workload="bank")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_postgres_fake_monotonic_run():
     result = run_fake(postgres.postgres_test, workload="monotonic")
     assert result["results"]["valid?"] is True, result["results"]
@@ -275,6 +282,7 @@ def test_seq_table_stable():
     assert seq_table("5_0").startswith("seq_")
 
 
+@pytest.mark.slow
 def test_cockroach_fake_adya_run():
     result = run_fake(cockroachdb.cockroachdb_test, workload="adya")
     assert result["results"]["valid?"] is True, result["results"]
@@ -323,6 +331,7 @@ def test_pg_client_counter_add_checks_rowcount():
     assert out["type"] == "fail"
 
 
+@pytest.mark.slow
 def test_yugabyte_test_all_sweep_fake():
     """The test-all runner sweeps every workload expected to pass
     (yugabyte/core.clj:110-123 + cli.clj:429-515) in fake mode.
@@ -470,6 +479,7 @@ def test_pg_append_table_txn():
         "serialization-failure"
 
 
+@pytest.mark.slow
 def test_yugabyte_fake_append_table_run():
     from conftest import run_fake
 

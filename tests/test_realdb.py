@@ -133,6 +133,7 @@ S(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
 """
 
 
+@pytest.mark.slow
 def test_realdb_harness_mechanics(tmp_path, monkeypatch):
     """Proves the realdb harness end-to-end without a redis binary: a
     SUBPROCESS mini-RESP daemon stands in for redis-server, and the full

@@ -12,6 +12,7 @@ NODES = ["n1", "n2", "n3", "n4", "n5"]
 
 
 from conftest import run_fake  # noqa: E402
+import pytest
 
 
 # ---------------------------------------------------------------------------
@@ -68,6 +69,7 @@ def test_tidb_db_commands():
 # fake-mode lifecycle: bank, dirty-reads, append
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_galera_fake_bank_run():
     result = run_fake(galera.galera_test, workload="bank")
     assert result["results"]["valid?"] is True, result["results"]
@@ -77,26 +79,31 @@ def test_galera_fake_bank_run():
     assert reads and all(sum(op["value"].values()) == 80 for op in reads)
 
 
+@pytest.mark.slow
 def test_galera_fake_dirty_reads_run():
     result = run_fake(galera.galera_test, workload="dirty-reads")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_percona_fake_bank_run():
     result = run_fake(percona.percona_test, workload="bank")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_tidb_fake_append_run():
     result = run_fake(tidb.tidb_test, workload="append")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_tidb_fake_long_fork_run():
     result = run_fake(tidb.tidb_test, workload="long-fork")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_mysql_cluster_fake_register_run():
     result = run_fake(mysql_cluster.mysql_cluster_test, workload="register")
     assert result["results"]["valid?"] is True, result["results"]
@@ -263,6 +270,7 @@ def test_tidb_multitable_bank_client_body():
     assert out["type"] == "ok" and out["value"] == {0: 3, 1: 7}
 
 
+@pytest.mark.slow
 def test_tidb_fake_set_cas_and_multitable_runs():
     from jepsen_tpu.suites import tidb
 

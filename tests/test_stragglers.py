@@ -65,6 +65,7 @@ def test_monotonic_key_checker_valid_on_consistent():
     assert out["valid?"] is True, out
 
 
+@pytest.mark.slow
 def test_tidb_fake_monotonic_and_sequential_runs():
     result = run_fake(tidb.tidb_test, workload="monotonic")
     assert result["results"]["valid?"] is True, result["results"]
@@ -178,6 +179,7 @@ def test_pg_ledger_transfer_sql():
     assert not any("INSERT" in s for s in c.conn.sql)
 
 
+@pytest.mark.slow
 def test_stolon_fake_ledger_run():
     result = run_fake(stolon.stolon_test, workload="ledger")
     assert result["results"]["valid?"] is True, result["results"]
@@ -220,6 +222,7 @@ def test_transfer_checker_catches_torn_read():
     assert out["valid?"] is False, out
 
 
+@pytest.mark.slow
 def test_mongodb_fake_transfer_run():
     result = run_fake(mongodb.mongodb_test, workload="transfer")
     assert result["results"]["valid?"] is True, result["results"]
@@ -321,6 +324,7 @@ def test_dgraph_sequential_client_bodies():
     assert ("txn_mutate", 42, {"set": [{"key": 2, "value": 1}]}) in c.calls
 
 
+@pytest.mark.slow
 def test_dgraph_fake_delete_and_sequential_runs():
     result = run_fake(dgraph.dgraph_test, workload="delete")
     assert result["results"]["valid?"] is True, result["results"]

@@ -83,6 +83,7 @@ def test_zookeeper_cfg_and_myid():
 from conftest import run_fake  # noqa: E402
 
 
+@pytest.mark.slow
 def test_etcd_fake_register_run():
     result = run_fake(etcd.etcd_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -90,11 +91,13 @@ def test_etcd_fake_register_run():
     assert len(result["history"]) > 0
 
 
+@pytest.mark.slow
 def test_etcd_fake_set_run():
     result = run_fake(etcd.etcd_test, workload="set")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_zookeeper_fake_register_run():
     result = run_fake(zookeeper.zookeeper_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -104,6 +107,7 @@ def test_zookeeper_fake_register_run():
 # CLI
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_etcd_cli_fake_run():
     with tempfile.TemporaryDirectory() as tmp:
         code = etcd.main(["test", "--fake", "--no-ssh", "--time-limit", "1",
@@ -201,6 +205,7 @@ def test_resp_protocol_roundtrip():
     assert received[0] == ["SET", "k", "1"]
 
 
+@pytest.mark.slow
 def test_postgres_fake_append_run():
     """The Elle list-append workload end-to-end over the fake txn store."""
     result = run_fake(postgres.postgres_test, workload="append")
@@ -210,11 +215,13 @@ def test_postgres_fake_append_run():
     assert txns, "no committed txns"
 
 
+@pytest.mark.slow
 def test_redis_fake_set_run():
     result = run_fake(redis.redis_test, workload="set")
     assert result["results"]["valid?"] is True, result["results"]
 
 
+@pytest.mark.slow
 def test_mongodb_fake_register_run():
     result = run_fake(mongodb.mongodb_test, workload="register")
     assert result["results"]["valid?"] is True, result["results"]
@@ -249,6 +256,7 @@ def test_raftis_db_commands():
         control.disconnect_all(t)
 
 
+@pytest.mark.slow
 def test_raftis_fake_register_run():
     from jepsen_tpu.suites import raftis
     result = run_fake(raftis.raftis_test)
@@ -271,6 +279,7 @@ def test_disque_db_join_commands():
         control.disconnect_all(t)
 
 
+@pytest.mark.slow
 def test_disque_fake_queue_run():
     from jepsen_tpu.suites import disque
     result = run_fake(disque.disque_test)
@@ -334,6 +343,7 @@ def test_resp_truncated_replies_raise():
             srv.close()
 
 
+@pytest.mark.slow
 def test_fake_run_with_partition_nemesis_end_to_end():
     """Full lifecycle with the nemesis ACTIVE in fake mode: partition
     ops ride the nemesis thread concurrently with client ops, the final
@@ -352,6 +362,7 @@ def test_fake_run_with_partition_nemesis_end_to_end():
     assert completions and completions[-1].get("f") == "stop-partition"
 
 
+@pytest.mark.slow
 def test_fake_run_with_kill_and_pause_nemesis():
     """Kill/pause fault packages now compose in fake mode (the in-memory
     DB implements Process/Pause as meta-logged no-ops), so the whole

@@ -7,6 +7,8 @@ import socket
 import struct
 import threading
 
+import pytest
+
 from jepsen_tpu.suites import aerospike, rabbitmq, rethinkdb
 from jepsen_tpu.suites import _amqp, _reql
 from jepsen_tpu.suites._aerospike import key_digest, ripemd160
@@ -160,6 +162,7 @@ def test_amqp_channel_close_raises():
     c.close()
 
 
+@pytest.mark.slow
 def test_rabbitmq_fake_queue_run():
     result = run_fake(rabbitmq.rabbitmq_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -264,6 +267,7 @@ def test_rethinkdb_cas_not_replaced_is_fail():
     assert out["type"] == "fail"
 
 
+@pytest.mark.slow
 def test_rethinkdb_fake_register_run():
     result = run_fake(rethinkdb.rethinkdb_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -273,6 +277,7 @@ def test_rethinkdb_fake_register_run():
 # Aerospike
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_ripemd160_vectors():
     """Published RIPEMD-160 test vectors (Dobbertin et al.)."""
     assert ripemd160(b"").hex() == \
@@ -354,6 +359,7 @@ def test_aerospike_gen_cas_fail():
     c.close()
 
 
+@pytest.mark.slow
 def test_aerospike_fake_register_run():
     result = run_fake(aerospike.aerospike_test)
     assert result["results"]["valid?"] is True, result["results"]
@@ -428,6 +434,7 @@ def test_amqp_empty_body_basic_return_keeps_sync():
 # mutex workload (rabbitmq semaphore)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_rabbitmq_fake_mutex_run():
     """The semaphore workload checks linearizable mutual exclusion
     against the knossos mutex model."""
@@ -468,6 +475,7 @@ def test_semaphore_client_state_machine():
     assert calls[-1] == ("reject", 9, True)  # requeue the token
 
 
+@pytest.mark.slow
 def test_aerospike_fake_counter_run():
     result = run_fake(aerospike.aerospike_test, workload="counter")
     assert result["results"]["valid?"] is True, result["results"]
@@ -528,6 +536,7 @@ def test_aerospike_append_and_string_read():
     assert b" 5" in received[0]
 
 
+@pytest.mark.slow
 def test_aerospike_fake_set_run():
     from conftest import run_fake
     from jepsen_tpu.suites.aerospike import aerospike_test
