@@ -1,0 +1,289 @@
+"""Checker backend degradation ladder: fallback as policy, not scatter.
+
+The linearizable checker accumulated three ad-hoc fallbacks (matrix
+screen -> frontier kernel, frontier overflow -> exact CPU retry, native
+C++ capacity miss -> Python stream search) with no shared accounting,
+watchdog, or failure memory. :class:`BackendLadder` owns that chain —
+pallas-matrix -> jitlin device kernel -> native C++ -> CPU — as one
+policy object:
+
+* **Soft demotion**: a backend may *decline* a dispatch (return ``None``
+  or raise :class:`Unavailable`) — out of regime, capacity miss,
+  library unbuilt. The ladder falls through and counts the demotion.
+* **Resource exhaustion**: an XLA ``RESOURCE_EXHAUSTED`` (device OOM)
+  or compile failure gets ONE adaptive retry with halved tile/batch
+  sizes (the backend's ``shrink`` hook) before demoting.
+* **Watchdog**: device dispatches run under a timeout — a hung TPU
+  dispatch (dead tunnel, wedged runtime) demotes to the next backend
+  instead of hanging the run. The stuck thread is abandoned (daemon),
+  mirroring ``utils.timeout``.
+* **Circuit breaker**: ``breaker_threshold`` *consecutive* hard
+  failures trip a per-backend breaker; further dispatches skip the
+  backend until :meth:`reset`. A flaky accelerator degrades a run to
+  CPU once instead of eating the timeout on every check.
+* **Telemetry**: ``checker_backend_demotions_total`` (by backend and
+  reason), ``checker_watchdog_timeouts_total``,
+  ``checker_backend_shrink_retries_total``, and a
+  ``checker_circuit_open`` gauge flow through the registry
+  (doc/observability.md, doc/robustness.md).
+
+The terminal backend of a well-formed ladder always settles, so
+:class:`LadderExhausted` indicates a configuration bug, not a bad
+history.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from jepsen_tpu import telemetry
+
+logger = logging.getLogger("jepsen.checker.ladder")
+
+# Device dispatches hung longer than this demote instead of blocking the
+# run. 0 disables the watchdog (dispatch runs inline on the caller's
+# thread — zero overhead, the pre-ladder behavior).
+DEFAULT_WATCHDOG_S = float(os.environ.get("JEPSEN_TPU_WATCHDOG_S", "600"))
+DEFAULT_BREAKER_THRESHOLD = int(
+    os.environ.get("JEPSEN_TPU_BREAKER_THRESHOLD", "3"))
+
+
+class Unavailable(Exception):
+    """Raised by a backend to decline a dispatch (capability miss, out of
+    regime). A quiet demotion: no failure is counted against the
+    backend."""
+
+
+class LadderExhausted(Exception):
+    """Every backend declined or failed — the ladder was configured
+    without a terminal always-settles backend."""
+
+
+# Exception-text markers for device-memory exhaustion and XLA compile
+# failures. jaxlib's XlaRuntimeError carries the gRPC-style status name
+# in its message; we match text so the ladder needs no jax import (and
+# tests can fake the failure with a plain RuntimeError).
+_RESOURCE_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                     "OOM ")
+_COMPILE_MARKERS = ("XlaRuntimeError", "Compilation failure",
+                    "compilation failed", "INTERNAL: Failed to compile")
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in _RESOURCE_MARKERS)
+
+
+def is_compile_failure(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in _COMPILE_MARKERS)
+
+
+_TIMED_OUT = object()
+
+
+@dataclass
+class Backend:
+    """One rung. ``fn(ctx)`` returns a result, or ``None`` /raises
+    :class:`Unavailable` to decline. ``eligible(ctx)`` gates routing
+    (not counted as demotion — a host-regime dispatch never *attempts*
+    the device rungs). ``shrink(ctx)`` halves the backend's tile/batch
+    knobs in the shared context before the single resource-exhaustion
+    retry; return False when nothing is left to halve. ``device=True``
+    opts the rung into the watchdog."""
+
+    name: str
+    fn: Callable[[dict], Any]
+    eligible: Callable[[dict], bool] = field(default=lambda ctx: True)
+    shrink: Callable[[dict], bool] | None = None
+    device: bool = False
+
+
+class BackendLadder:
+    def __init__(self, backends: list[Backend],
+                 watchdog_s: float = DEFAULT_WATCHDOG_S,
+                 breaker_threshold: int = DEFAULT_BREAKER_THRESHOLD):
+        self.backends = list(backends)
+        self.watchdog_s = watchdog_s
+        self.breaker_threshold = breaker_threshold
+        self._failures: dict[str, int] = {}
+        self._broken: set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- breaker state ------------------------------------------------------
+
+    def broken(self) -> set[str]:
+        with self._lock:
+            return set(self._broken)
+
+    def reset(self, backend: str | None = None) -> None:
+        """Closes breakers (all, or one backend's) and zeroes failure
+        counts — for tests and for operators who fixed the accelerator."""
+        with self._lock:
+            if backend is None:
+                self._broken.clear()
+                self._failures.clear()
+            else:
+                self._broken.discard(backend)
+                self._failures.pop(backend, None)
+        self._export_breaker()
+
+    def _count_failure(self, name: str) -> None:
+        with self._lock:
+            n = self._failures.get(name, 0) + 1
+            self._failures[name] = n
+            tripped = (n >= self.breaker_threshold
+                       and name not in self._broken)
+            if tripped:
+                self._broken.add(name)
+        if tripped:
+            logger.warning("checker backend %r circuit breaker tripped "
+                           "after %d consecutive failures", name, n)
+            reg = telemetry.get_registry()
+            if reg.enabled:
+                reg.event("checker-circuit-open", backend=name, failures=n)
+            self._export_breaker()
+
+    def _count_success(self, name: str) -> None:
+        with self._lock:
+            self._failures[name] = 0
+
+    def _export_breaker(self) -> None:
+        reg = telemetry.get_registry()
+        if not reg.enabled:
+            return
+        g = reg.gauge("checker_circuit_open",
+                      "1 while a backend's circuit breaker is open",
+                      labels=("backend",))
+        with self._lock:
+            broken = set(self._broken)
+        for b in self.backends:
+            g.set(1.0 if b.name in broken else 0.0, backend=b.name)
+
+    def _demote(self, name: str, reason: str) -> None:
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("checker_backend_demotions_total",
+                        "ladder demotions, by backend and reason",
+                        labels=("backend", "reason")
+                        ).inc(backend=name, reason=reason)
+        logger.info("checker backend %r demoted (%s)", name, reason)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _call(self, backend: Backend, ctx: dict) -> Any:
+        """One invocation, under the watchdog for device rungs."""
+        if not backend.device or not self.watchdog_s:
+            return backend.fn(ctx)
+        result: list = []
+        error: list = []
+
+        def run():
+            try:
+                result.append(backend.fn(ctx))
+            except BaseException as e:  # noqa: BLE001
+                error.append(e)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"jepsen-checker-{backend.name}")
+        t.start()
+        t.join(self.watchdog_s)
+        if t.is_alive():
+            return _TIMED_OUT
+        if error:
+            raise error[0]
+        return result[0]
+
+    def run(self, ctx: dict) -> tuple[Any, str]:
+        """Dispatches ``ctx`` down the ladder; returns ``(result,
+        backend_name)`` from the first rung that settles. ``ctx``
+        accumulates ``_attempted`` — the rung names tried *before* the
+        winner — so callers can label results (e.g. the CPU rung tags
+        itself ``(fallback)`` only when reached by demotion from a
+        device rung). Ineligible rungs are pure routing: neither
+        attempted nor counted."""
+        attempted: list[str] = ctx.setdefault("_attempted", [])
+        last = self.backends[-1] if self.backends else None
+        for backend in self.backends:
+            try:
+                if not backend.eligible(ctx):
+                    continue
+            except Exception:  # noqa: BLE001 — a broken gate is a decline
+                logger.exception("eligibility probe for %r failed",
+                                 backend.name)
+                continue
+            terminal = backend is last
+            # the terminal rung is breaker-exempt: it has no fallback,
+            # so skipping it would wedge every subsequent dispatch
+            if not terminal and backend.name in self.broken():
+                self._demote(backend.name, "circuit-open")
+                attempted.append(backend.name)
+                continue
+            res = self._attempt(backend, ctx, terminal=terminal)
+            if res is None:
+                attempted.append(backend.name)
+                continue
+            self._count_success(backend.name)
+            return res, backend.name
+        raise LadderExhausted(
+            f"no checker backend settled the dispatch "
+            f"(attempted: {attempted})")
+
+    def _attempt(self, backend: Backend, ctx: dict,
+                 terminal: bool = False) -> Any:
+        """One rung's dispatch: watchdog, single shrink retry, failure
+        accounting. Returns the result, or None to demote. A hard
+        failure in the ``terminal`` rung re-raises instead of demoting
+        — there is nothing below it, and the caller's check_safe wants
+        the real traceback (the pre-ladder semantics)."""
+        reg = telemetry.get_registry()
+        shrunk = False
+        while True:
+            try:
+                res = self._call(backend, ctx)
+            except Unavailable:
+                self._demote(backend.name, "unavailable")
+                return None
+            except Exception as e:  # noqa: BLE001
+                retryable = is_resource_exhausted(e) or is_compile_failure(e)
+                if retryable and not shrunk and backend.shrink is not None:
+                    try:
+                        can_shrink = backend.shrink(ctx)
+                    except Exception:  # noqa: BLE001
+                        can_shrink = False
+                    if can_shrink:
+                        shrunk = True
+                        if reg.enabled:
+                            reg.counter(
+                                "checker_backend_shrink_retries_total",
+                                "resource-exhaustion retries with halved "
+                                "tile/batch sizes", labels=("backend",)
+                            ).inc(backend=backend.name)
+                        logger.warning(
+                            "backend %r resource-exhausted; retrying once "
+                            "with halved sizes", backend.name)
+                        continue
+                if terminal:
+                    raise
+                self._count_failure(backend.name)
+                self._demote(backend.name,
+                             "resource-exhausted" if retryable else "error")
+                logger.warning("checker backend %r failed: %r",
+                               backend.name, e)
+                return None
+            if res is _TIMED_OUT:
+                if reg.enabled:
+                    reg.counter(
+                        "checker_watchdog_timeouts_total",
+                        "device dispatches abandoned by the watchdog",
+                        labels=("backend",)).inc(backend=backend.name)
+                self._count_failure(backend.name)
+                self._demote(backend.name, "watchdog-timeout")
+                return None
+            if res is None:
+                self._demote(backend.name, "declined")
+                return None
+            return res

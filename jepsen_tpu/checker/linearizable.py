@@ -53,6 +53,8 @@ class LinearizableChecker(Checker):
         accelerator: str = "auto",
         capacity: int = 256,
         multi_shape: tuple = (3, 5),
+        watchdog_s: float | None = None,
+        breaker_threshold: int | None = None,
     ):
         self.model = model if model is not None else CASRegister()
         self.algorithm = algorithm
@@ -62,7 +64,12 @@ class LinearizableChecker(Checker):
         # multi-key-acid workload's shape (multi_key_acid.clj key-range/
         # rand-val)
         self.multi_shape = multi_shape
+        # degradation-ladder tunables (doc/robustness.md); None = the
+        # ladder module's env-tunable defaults
+        self.watchdog_s = watchdog_s
+        self.breaker_threshold = breaker_threshold
         self._kernel = None
+        self._ladder = None
 
     def _encoding(self, history):
         """(stream, step_py, spec) when the model has an int encoding for
@@ -128,66 +135,157 @@ class LinearizableChecker(Checker):
     def _search_stream(self, stream, step_py, spec, algorithm,
                        accelerator, history=None) -> LinearResult:
         """The full encoded-stream dispatch, shared by check() and the
-        stored-column re-check lane (module check_stored): host lanes
-        (native C++ first, exact Python stream search) below the device
-        threshold, device lanes (transfer-matrix screen, frontier
-        kernel, exact-CPU unknown retry) above it."""
-        is_cas = isinstance(self.model, CASRegister)
-        if accelerator == "cpu" or (
-            accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD
-        ):
-            res = None
-            if algorithm in ("jitlin", "auto") or history is None:
-                if is_cas and spec.init_state == 0:
-                    # native C++ search first (same algorithm, ~100x the
-                    # Python loop); falls back when unbuilt, >63 slots,
-                    # or a non-default initial state (the C search
-                    # hardcodes init id 0)
-                    from jepsen_tpu.native import check_stream_native
-                    res = check_stream_native(stream)
-                    if res is not None and res.valid == "unknown":
-                        res = None  # capacity blown: retry in Python
-                if res is None:
-                    res = check_stream(stream, step=step_py,
-                                       init_state=spec.init_state)
-            else:
-                res = wgl(history, self.model)
-            return res
+        stored-column re-check lane (module check_stored), routed
+        through the :class:`~jepsen_tpu.checker.ladder.BackendLadder`:
+        host rungs (native C++ first, exact Python stream search) below
+        the device threshold, device rungs (transfer-matrix screen,
+        frontier kernel) above it, with the exact CPU twin as the
+        terminal rung every demotion lands on."""
+        device_regime = not (accelerator == "cpu" or (
+            accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD))
+        ctx = {
+            "stream": stream,
+            "step_py": step_py,
+            "spec": spec,
+            "history": history,
+            "device_regime": device_regime,
+            "capacity": self.capacity,
+            # the encoded-stream search applies for jitlin/auto, and for
+            # the stored-column lane (no op history to wgl over)
+            "stream_path": (algorithm in ("jitlin", "auto")
+                            or history is None),
+        }
+        res, _backend = self._get_ladder().run(ctx)
+        phases = ctx.pop("_matrix_phase", None)
+        if phases:
+            # the matrix rung may have run on a watchdog thread; make
+            # its phase split visible to this thread's readers
+            # (_record_metrics, bench)
+            from jepsen_tpu.ops.jitlin import publish_phase_seconds
+            publish_phase_seconds(phases)
+        return res
 
-        # device path. For long histories over small value domains, the
-        # block-composed transfer-matrix kernel settles the verdict with
-        # far less sequential depth (MXU boolean matmuls over chunks);
-        # the event scan remains the diagnostics path (died-at, peak).
-        from jepsen_tpu.ops.jitlin import matrix_check, matrix_ok, verdict
-        import numpy as np
-        n_returns = int((np.asarray(stream.kind) == 1).sum())
-        if matrix_ok(stream.n_slots, len(stream.intern), n_returns):
+    def _get_ladder(self):
+        """The degradation ladder, built once per checker: pallas-matrix
+        -> jitlin device frontier -> native C++ -> exact CPU. Demotion,
+        watchdog, adaptive-shrink retry, and circuit-breaker policy all
+        live in checker/ladder.py; the rungs here only encode *what*
+        each backend computes and *when* it is in regime."""
+        if self._ladder is not None:
+            return self._ladder
+        from jepsen_tpu.checker.ladder import Backend, BackendLadder
+
+        is_cas = isinstance(self.model, CASRegister)
+
+        def matrix_eligible(ctx):
+            # long histories over small value domains: the block-composed
+            # transfer-matrix kernel settles the verdict with far less
+            # sequential depth (MXU boolean matmuls over chunks); the
+            # event scan remains the diagnostics path (died-at, peak)
+            if not ctx["device_regime"]:
+                return False
+            import numpy as np
+            from jepsen_tpu.ops.jitlin import matrix_ok
+            stream = ctx["stream"]
+            n_returns = int((np.asarray(stream.kind) == 1).sum())
+            return matrix_ok(stream.n_slots, len(stream.intern), n_returns)
+
+        def matrix_fn(ctx):
+            from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
+            stream, spec = ctx["stream"], ctx["spec"]
             m = matrix_check(stream, step_ids=spec.step_ids,
                              init_state=spec.init_state,
                              num_states=len(stream.intern))
+            # capture the phase split on THIS (possibly watchdog) thread;
+            # _search_stream re-publishes it on the checker's thread
+            ctx["_matrix_phase"] = last_phase_seconds()
             # accept only an exact matrix True: m[2] (inexact/oob) means a
             # state id escaped the intern range and proves nothing
             if m is not None and m[0] and not m[2]:
                 return LinearResult(
                     valid=True, failed_event=-1, failed_op_index=-1,
                     configs_max=0, algorithm="jitlin-tpu-matrix")
-        alive, died, overflow, peak = self._tpu_kernel(spec).check(
-            stream, capacity=self.capacity
-        )
-        valid = verdict(alive, overflow)
-        if valid == "unknown":
-            # frontier overflowed K and died: retry with the exact CPU twin
-            res = check_stream(stream, step=step_py,
-                               init_state=spec.init_state)
-            res.algorithm = "jitlin-cpu(fallback)"
-            return res
-        return LinearResult(
-            valid=valid,
-            failed_event=died,
-            failed_op_index=int(stream.op_index[died]) if died >= 0 else -1,
-            configs_max=peak,
-            algorithm="jitlin-tpu",
-        )
+            return None
+
+        def matrix_shrink(ctx):
+            # halve the chunk element budget: _matrix_plan sizes the
+            # per-step [G, MV, MV] working set under it, so halving it
+            # halves the device-resident intermediates. The halved value
+            # sticks (adaptive): the device told us its real capacity.
+            from jepsen_tpu.ops import jitlin
+            if jitlin.MATRIX_MAX_ELEMS <= (1 << 20):
+                return False
+            jitlin.MATRIX_MAX_ELEMS //= 2
+            return True
+
+        def frontier_fn(ctx):
+            from jepsen_tpu.ops.jitlin import verdict
+            stream, spec = ctx["stream"], ctx["spec"]
+            alive, died, overflow, peak = self._tpu_kernel(spec).check(
+                stream, capacity=ctx["capacity"])
+            valid = verdict(alive, overflow)
+            if valid == "unknown":
+                # frontier overflowed K and died: the exact CPU twin
+                # settles it (terminal rung)
+                return None
+            return LinearResult(
+                valid=valid,
+                failed_event=died,
+                failed_op_index=(int(stream.op_index[died])
+                                 if died >= 0 else -1),
+                configs_max=peak,
+                algorithm="jitlin-tpu",
+            )
+
+        def frontier_shrink(ctx):
+            # halve the frontier capacity K: less device memory per
+            # step. A verdict the smaller frontier can't settle becomes
+            # unknown -> CPU demotion — never a wrong answer.
+            if ctx["capacity"] <= 16:
+                return False
+            ctx["capacity"] = max(16, ctx["capacity"] // 2)
+            return True
+
+        def native_eligible(ctx):
+            # native C++ search (same algorithm, ~100x the Python loop);
+            # host regime only, and only the configuration it hardcodes
+            # (CAS register, init id 0)
+            return (not ctx["device_regime"] and ctx["stream_path"]
+                    and is_cas and ctx["spec"].init_state == 0)
+
+        def native_fn(ctx):
+            from jepsen_tpu.native import check_stream_native
+            res = check_stream_native(ctx["stream"])
+            if res is not None and res.valid == "unknown":
+                return None  # capacity blown (>63 slots live): Python
+            return res  # None when unbuilt -> decline
+
+        def cpu_fn(ctx):
+            from_device = any(n in ("pallas-matrix", "jitlin-device")
+                              for n in ctx.get("_attempted", ()))
+            if ctx["stream_path"] or from_device:
+                res = check_stream(ctx["stream"], step=ctx["step_py"],
+                                   init_state=ctx["spec"].init_state)
+                if from_device:
+                    res.algorithm = "jitlin-cpu(fallback)"
+                return res
+            return wgl(ctx["history"], self.model)
+
+        kw = {}
+        if self.watchdog_s is not None:
+            kw["watchdog_s"] = self.watchdog_s
+        if self.breaker_threshold is not None:
+            kw["breaker_threshold"] = self.breaker_threshold
+        self._ladder = BackendLadder([
+            Backend("pallas-matrix", matrix_fn, eligible=matrix_eligible,
+                    shrink=matrix_shrink, device=True),
+            Backend("jitlin-device", frontier_fn,
+                    eligible=lambda ctx: ctx["device_regime"],
+                    shrink=frontier_shrink, device=True),
+            Backend("native-c", native_fn, eligible=native_eligible),
+            Backend("cpu", cpu_fn),
+        ], **kw)
+        return self._ladder
 
     def _record_metrics(self, res: LinearResult, dt: float, n_events: int,
                         stream) -> None:

@@ -131,9 +131,25 @@ def single_test_cmd(
         p_an = sub.add_parser("analyze", help="re-check a stored history")
         p_an.add_argument("--test-name")
         p_an.add_argument("--timestamp", help="defaults to latest run")
+        p_an.add_argument("--recover", action="store_true",
+                          help="recover a crashed run's partial history "
+                               "from its write-ahead journal "
+                               "(history.wal.jsonl), check it, and mark "
+                               "the results incomplete")
         add_test_opts(p_an)  # analyze takes the same opts (cli.clj:399-427)
         if opt_fn:
             opt_fn(p_an)
+
+        p_heal = sub.add_parser(
+            "heal", help="replay a crashed run's unhealed faults "
+                         "(faults.jsonl) to restore net/clock state")
+        p_heal.add_argument("dir", nargs="?",
+                            help="store dir, or one run's directory "
+                                 "(store/<name>/<timestamp>); defaults "
+                                 "to --store-dir's latest run")
+        p_heal.add_argument("--test-name")
+        p_heal.add_argument("--timestamp", help="defaults to latest run")
+        p_heal.add_argument("--store-dir", default="store")
 
         p_serve = sub.add_parser("serve", help="serve the web UI")
         p_serve.add_argument("--host", default="0.0.0.0")
@@ -162,6 +178,8 @@ def single_test_cmd(
                 return code
             if opts.command == "analyze":
                 return analyze_cmd(opts, test_fn)
+            if opts.command == "heal":
+                return heal_cmd(opts)
             if opts.command == "serve":
                 from jepsen_tpu.web import serve
                 serve(opts.store_dir, opts.host, opts.port)
@@ -176,34 +194,146 @@ def single_test_cmd(
     return main
 
 
-def analyze_cmd(opts, test_fn) -> int:
-    """Re-runs checkers over a stored history (cli.clj:399-427)."""
-    from jepsen_tpu import core, store
-    if opts.test_name:
+def _resolve_run(opts) -> tuple[str, str] | None:
+    """(test-name, timestamp) from --test-name/--timestamp, defaulting
+    to the latest stored run. None when nothing matches."""
+    from jepsen_tpu import store
+    if getattr(opts, "test_name", None):
         name = opts.test_name
-        if opts.timestamp:
-            ts = opts.timestamp
-        else:
-            runs = store.tests(name, opts.store_dir).get(name) or {}
-            if not runs:
-                print(f"no stored runs for test {name!r}", file=sys.stderr)
-                return EXIT_BAD_ARGS
-            ts = sorted(runs)[-1]
-    else:
-        found = store.latest(opts.store_dir)
-        if found is None:
-            print("no stored tests found", file=sys.stderr)
-            return EXIT_BAD_ARGS
-        name, ts, _ = found
+        if getattr(opts, "timestamp", None):
+            return name, opts.timestamp
+        runs = store.tests(name, opts.store_dir).get(name) or {}
+        if not runs:
+            print(f"no stored runs for test {name!r}", file=sys.stderr)
+            return None
+        return name, sorted(runs)[-1]
+    found = store.latest(opts.store_dir)
+    if found is None:
+        print("no stored tests found", file=sys.stderr)
+        return None
+    return found[0], found[1]
+
+
+def analyze_cmd(opts, test_fn) -> int:
+    """Re-runs checkers over a stored history (cli.clj:399-427). With
+    ``--recover``, a crashed run (no history.jsonl) is rebuilt from its
+    write-ahead journal: the partial history is persisted via save_1,
+    checked normally, and its results carry ``incomplete: true``
+    (doc/robustness.md)."""
+    from jepsen_tpu import core, store
+    run = _resolve_run(opts)
+    if run is None:
+        return EXIT_BAD_ARGS
+    name, ts = run
     stored = store.load_test(name, ts, opts.store_dir)
+    stored["store_dir"] = opts.store_dir
+    if getattr(opts, "recover", False):
+        from jepsen_tpu import journal as journal_mod
+        wal = store.path(stored, journal_mod.WAL_NAME)
+        existing = stored.get("history") or []
+        if wal.exists():
+            ops, truncated = journal_mod.read_wal(wal)
+            # a crash DURING save_1 can leave a torn history.jsonl next
+            # to the complete journal: the journal wins whenever it
+            # holds more ops than what the (tolerant) history load saw
+            if len(ops) > len(existing):
+                print(f"recovered {len(ops)} op(s) from {wal}"
+                      + (" (torn final line dropped)" if truncated
+                         else "")
+                      + (f"; replacing {len(existing)}-op torn history"
+                         if existing else ""))
+                stored["history"] = ops
+                stored["wal_recovered"] = True
+                if truncated:
+                    stored["wal_truncated_tail"] = True
+                # persist the recovered history so the run is
+                # re-analyzable through the normal path from here on
+                store.save_1(stored)
+            else:
+                print(f"history.jsonl already holds {len(existing)} "
+                      f"op(s), journal {len(ops)}; nothing to recover")
+        elif not existing:
+            print(f"no history and no journal at {wal}", file=sys.stderr)
+            return EXIT_BAD_ARGS
     # fresh checker from the suite's constructor
     fresh = test_fn(opts)
     stored["checker"] = fresh.get("checker")
-    stored["store_dir"] = opts.store_dir
     test = core.analyze(stored)
     core.log_results(test)
     print(f"valid?: {(test.get('results') or {}).get('valid?')}")
     return validity_exit_code(test)
+
+
+def heal_cmd(opts) -> int:
+    """Replays a crashed run's unhealed faults (``cli heal``): reads the
+    run's ``faults.jsonl``, applies the idempotent heal for each
+    unhealed kind (net partitions flushed, netem cleared, clocks
+    reset), and marks entries healed. Process kill/pause faults need
+    the live db object and are reported unhealable offline
+    (doc/robustness.md)."""
+    import json as _json
+    from pathlib import Path
+
+    from jepsen_tpu import store
+    from jepsen_tpu.nemesis import faults as faults_mod
+
+    run_dir = None
+    if getattr(opts, "dir", None):
+        d = Path(opts.dir)
+        if (d / faults_mod.FAULTS_NAME).exists() or (d / "test.json").exists():
+            run_dir = d  # a single run's directory
+        else:
+            opts.store_dir = str(d)  # a store dir: fall through to latest
+    if run_dir is None:
+        run = _resolve_run(opts)
+        if run is None:
+            return EXIT_BAD_ARGS
+        name, ts = run
+        run_dir = Path(opts.store_dir) / name / ts
+    reg_path = run_dir / faults_mod.FAULTS_NAME
+    if not reg_path.exists():
+        print(f"no fault registry at {reg_path}; nothing to heal")
+        return EXIT_OK
+    test: dict = {}
+    try:
+        with open(run_dir / "test.json") as f:
+            test = _json.load(f)
+    except (OSError, ValueError):
+        logger.warning("no readable test.json in %s", run_dir)
+    test.setdefault("nodes", [])
+    test["store_dir"] = str(run_dir.parent.parent)
+    registry = faults_mod.FaultRegistry(reg_path)
+    try:
+        unhealed = registry.unhealed()
+        if not unhealed:
+            print("no unhealed faults; cluster is clean")
+            return EXIT_OK
+        if not test["nodes"]:
+            # healing over zero nodes would trivially "succeed" and
+            # durably mark the faults healed without touching the
+            # cluster — destroying the only record that healing is
+            # still needed. Refuse instead.
+            print(f"{len(unhealed)} unhealed fault(s) but no node list "
+                  f"(missing/corrupt test.json in {run_dir}); refusing "
+                  "to heal blind — pass a run dir with an intact "
+                  "test.json or heal the cluster manually",
+                  file=sys.stderr)
+            return EXIT_UNKNOWN
+        print(f"replaying {len(unhealed)} unhealed fault(s): "
+              + ", ".join(sorted({str(r.get('kind')) for r in unhealed})))
+        summary = faults_mod.replay_unhealed(test, registry)
+        print(f"healed: {summary['healed']}  "
+              f"unhealable: {summary['unhealable']}  "
+              f"failed: {summary['failed']}")
+        return (EXIT_OK if not summary["unhealable"] and not summary["failed"]
+                else EXIT_UNKNOWN)
+    finally:
+        registry.close()
+        from jepsen_tpu import control
+        try:
+            control.disconnect_all(test)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def test_all_cmd(tests_fn: Callable[[argparse.Namespace], list], name="jepsen-tpu"):

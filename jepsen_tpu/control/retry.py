@@ -1,7 +1,12 @@
 """Retrying remote wrapper (reference: jepsen/src/jepsen/control/retry.clj).
 
-Wraps any Remote, retrying flaky operations: 5 tries with ~50-150 ms
-randomized backoff (retry.clj:15-21 — backoff-time 100 ms ± jitter)."""
+Wraps any Remote, retrying flaky operations: 5 tries with
+capped-exponential full-jitter backoff (``uniform(0, min(cap,
+base * 2**attempt))``, utils.backoff_delay). The reference uses a fixed
+~100 ms ± jitter (retry.clj:15-21); the exponential schedule keeps the
+first retry just as fast while spacing later tries out — a cluster-wide
+transport brownout (dead ControlMaster, rebooting node) stops being
+hammered every 100 ms by every worker at once."""
 from __future__ import annotations
 
 import random
@@ -9,10 +14,11 @@ import time
 
 from jepsen_tpu import telemetry
 from jepsen_tpu.control.core import Remote, RemoteError, Result
+from jepsen_tpu.utils import backoff_delay
 
 TRIES = 5
 BACKOFF_BASE_S = 0.05
-BACKOFF_JITTER_S = 0.1
+BACKOFF_CAP_S = 5.0
 
 
 def _count_retry(op: str) -> None:
@@ -24,17 +30,26 @@ def _count_retry(op: str) -> None:
 
 
 class RetryRemote(Remote):
-    def __init__(self, remote: Remote):
+    def __init__(self, remote: Remote, rng: random.Random | None = None):
         self.remote = remote
+        # injectable RNG so the backoff schedule is deterministic under
+        # a seeded random.Random (tests/test_crashsafe.py)
+        self.rng = rng
+
+    def _sleep(self, attempt: int) -> None:
+        time.sleep(backoff_delay(attempt, BACKOFF_BASE_S, BACKOFF_CAP_S,
+                                 self.rng))
 
     def connect(self, conn_spec: dict) -> "RetryRemote":
         err = None
-        for _ in range(TRIES):
+        for attempt in range(TRIES):
             try:
-                return RetryRemote(self.remote.connect(conn_spec))
+                return RetryRemote(self.remote.connect(conn_spec),
+                                   rng=self.rng)
             except Exception as e:  # noqa: BLE001
                 err = e
-                time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+                if attempt < TRIES - 1:  # no pointless sleep before give-up
+                    self._sleep(attempt)
         raise err
 
     # ssh itself exits 255 on transport failure; our SSHRemote reports
@@ -54,7 +69,7 @@ class RetryRemote(Remote):
                 err = e
                 if attempt < TRIES - 1:  # a retry follows; give-up doesn't count
                     _count_retry(op)
-                time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+                    self._sleep(attempt)
         raise err
 
     def execute(self, ctx, cmd) -> Result:
@@ -65,7 +80,7 @@ class RetryRemote(Remote):
                 return res
             if attempt < TRIES - 1:
                 _count_retry("execute")
-            time.sleep(BACKOFF_BASE_S + random.random() * BACKOFF_JITTER_S)
+                self._sleep(attempt)
         return res
 
     def upload(self, ctx, local_paths, remote_path):

@@ -8,11 +8,15 @@ SSH was "orders of magnitude slower" — shelling out is our default).
 """
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import tempfile
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
+
+logger = logging.getLogger("jepsen.control.ssh")
 
 from jepsen_tpu import telemetry
 from jepsen_tpu.control.core import Remote, RemoteError, Result, wrap_cd, wrap_sudo
@@ -76,8 +80,66 @@ class SSHRemote(Remote):
         host = spec.get("host")
         return f"{user}@{host}" if user else str(host)
 
+    # -- ControlMaster liveness -------------------------------------------
+    #
+    # A master connection can die under us (node reboot, network blip,
+    # ControlPersist expiry racing a long pause). Without intervention
+    # every subsequent exec fails 255 until the RetryRemote gives up —
+    # a dead socket aborted the run. Instead: on a transport-shaped
+    # failure, probe the master (``ssh -O check``); if it's dead, clear
+    # the stale socket and retry the command once — ControlMaster=auto
+    # re-establishes transparently. The retry wrapper above us treats a
+    # second failure as the usual flake.
+
+    def _master_alive(self) -> bool:
+        if not self.control_dir:
+            return True
+        try:
+            p = subprocess.run(
+                ["ssh"] + self._base_opts() + ["-O", "check",
+                                               self._target()],
+                capture_output=True, text=True, timeout=10)
+            return p.returncode == 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _reset_master(self) -> None:
+        """Asks any half-dead master to exit, then removes stale socket
+        files so the next command's ControlMaster=auto can re-listen."""
+        try:
+            subprocess.run(
+                ["ssh"] + self._base_opts() + ["-O", "exit",
+                                               self._target()],
+                capture_output=True, text=True, timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            for sock in Path(self.control_dir).iterdir():
+                try:
+                    sock.unlink()
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
     def _run_ssh(self, cmd_argv: list[str], stdin: str | None = None,
                  check_master: bool = False) -> Result:
+        res = self._exec_ssh(cmd_argv, stdin)
+        if (res.exit_status in (-1, 255) and self.control_dir
+                and not check_master and not self._master_alive()):
+            logger.warning("ssh ControlMaster for %s died; reconnecting",
+                           self.conn_spec.get("host"))
+            self._reset_master()
+            reg = telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("control_master_reconnects_total",
+                            "dead ControlMaster sockets revived in-flight"
+                            ).inc()
+            res = self._exec_ssh(cmd_argv, stdin)
+        return res
+
+    def _exec_ssh(self, cmd_argv: list[str],
+                  stdin: str | None = None) -> Result:
         argv = ["ssh"] + self._base_opts() + [self._target()] + cmd_argv
         t0 = time.perf_counter()
         try:

@@ -15,10 +15,14 @@ from typing import Any
 
 from jepsen_tpu import client as client_mod
 from jepsen_tpu import control, db as db_mod, history as history_mod, store
+from jepsen_tpu import journal as journal_mod
 from jepsen_tpu import telemetry
 from jepsen_tpu.checker import check_safe
 from jepsen_tpu.generator import interpreter
-from jepsen_tpu.utils import real_pmap, with_relative_time, with_thread_name
+from jepsen_tpu.nemesis import faults as faults_mod
+from jepsen_tpu.utils import (
+    real_pmap, retry_with_backoff, with_relative_time, with_thread_name,
+)
 
 logger = logging.getLogger("jepsen.core")
 
@@ -96,7 +100,11 @@ def with_db(test: dict):
     finally:
         if db is not None and not test.get("leave_db_running"):
             try:
-                db_mod.teardown_all(test, db)
+                # teardown is idempotent by contract (db.clj:121-158);
+                # capped-exponential-jitter retries ride out transport
+                # flakes a chaotic run leaves behind
+                retry_with_backoff(lambda: db_mod.teardown_all(test, db),
+                                   tries=3, desc="db teardown")
             except Exception:  # noqa: BLE001
                 logger.exception("DB teardown failed")
 
@@ -175,7 +183,21 @@ def with_client_and_nemesis(test: dict):
                 logger.exception("client teardown failed")
         try:
             if nemesis_box[0] is not None:
-                nemesis_box[0].teardown(test)
+                # idempotent by contract (heal/reset/restart); retried
+                # with capped-exponential full-jitter backoff because
+                # this teardown IS the cluster's heal path
+                retry_with_backoff(lambda: nemesis_box[0].teardown(test),
+                                   tries=4, desc="nemesis teardown")
+                faults = test.get("_faults")
+                if faults is not None:
+                    # teardown restores normal operation (nemesis.clj
+                    # contract) — except file damage, which nothing can
+                    # undo: those entries stay on the books
+                    healed = faults.mark_healed(
+                        kinds=faults_mod.TEARDOWN_HEALS, via="teardown")
+                    if healed:
+                        logger.info("nemesis teardown healed fault(s) %s",
+                                    healed)
         except Exception:  # noqa: BLE001
             logger.exception("nemesis teardown failed")
         test["nemesis"] = proto_nemesis
@@ -220,6 +242,12 @@ def analyze(test: dict) -> dict:
                 test["results"] = check_safe(checker, test, history, {})
         else:
             test["results"] = {"valid?": True}
+        if test.get("wal_recovered"):
+            # verdict over a crash-recovered partial history: sound for
+            # the ops that were journaled, but the run never finished —
+            # badge it so nobody mistakes it for a complete run
+            # (cli analyze --recover, doc/robustness.md)
+            test["results"]["incomplete"] = True
         if reg.enabled:
             reg.gauge("run_history_ops",
                       "ops in the final history").set(len(history))
@@ -294,11 +322,48 @@ def _telemetry_setup(test: dict):
     return teardown
 
 
+def _crash_safety_setup(test: dict):
+    """Installs the write-ahead history journal and the durable fault
+    registry into the store dir (doc/robustness.md). ``wal: False``
+    turns the journal off; ``fault_registry: False`` the registry.
+    Either failing to open degrades to the pre-crash-safe behavior
+    rather than failing the run.
+
+    Also writes an early ``test.json`` snapshot: ``analyze --recover``
+    and ``cli heal`` need the test map (nodes, ssh opts) even when the
+    run never reached save_1 — it is rewritten with the final state at
+    save time."""
+    journal = faults = None
+    try:
+        store.write_test(test)
+    except Exception:  # noqa: BLE001
+        logger.exception("early test.json write failed")
+    if test.get("wal", True) is not False:
+        try:
+            journal = journal_mod.Journal(
+                store.path_mk(test, journal_mod.WAL_NAME),
+                fsync_interval_s=test.get(
+                    "wal_fsync_interval",
+                    journal_mod.DEFAULT_FSYNC_INTERVAL_S))
+            test["_journal"] = journal
+        except OSError:
+            logger.exception("couldn't open history WAL; journaling off")
+    if test.get("fault_registry", True) is not False:
+        try:
+            faults = faults_mod.FaultRegistry(
+                store.path_mk(test, faults_mod.FAULTS_NAME))
+            test["_faults"] = faults
+        except OSError:
+            logger.exception("couldn't open fault registry")
+    return journal, faults
+
+
 def run(test: dict) -> dict:
     """The whole enchilada (core.clj:326-397)."""
     test = prepare_test(test)
     store.start_logging(test)
     telemetry_teardown = _telemetry_setup(test)
+    journal, faults = _crash_safety_setup(test)
     try:
         with with_thread_name(f"jepsen-{test.get('name')}"):
             log_test_start(test)
@@ -310,9 +375,37 @@ def run(test: dict) -> dict:
                         test["history"] = history
                         snarf_logs(test)
                         store.save_1(test)
+                        if journal is not None:
+                            # history.jsonl is authoritative now; a
+                            # surviving WAL marks a crashed run
+                            journal.close(discard=True)
             test = analyze(test)
             log_results(test)
             return test
     finally:
+        test.pop("_journal", None)
+        if journal is not None:
+            journal.close()  # no-op when already discarded
+        test.pop("_faults", None)
+        if faults is not None:
+            # crash-path heal replay: a run that died mid-fault (or
+            # whose nemesis teardown failed) still restores the cluster
+            try:
+                pending = faults.unhealed()
+                actionable = [r for r in pending
+                              if str(r.get("kind"))
+                              not in faults_mod.UNHEALABLE_KINDS]
+                if actionable:
+                    logger.warning("run left %d unhealed fault(s); "
+                                   "replaying heals", len(actionable))
+                    summary = faults_mod.replay_unhealed(test, faults)
+                    logger.info("crash-path heal replay: %s", summary)
+                elif pending:
+                    # file damage: evidence, not a heal target
+                    logger.info("%d unhealable fault record(s) (file "
+                                "damage) remain on the books", len(pending))
+            except Exception:  # noqa: BLE001
+                logger.exception("crash-path fault heal replay failed")
+            faults.close()
         telemetry_teardown()
         store.stop_logging()

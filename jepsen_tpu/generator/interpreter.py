@@ -100,7 +100,13 @@ class ClientWorker(Worker):
 
 
 class NemesisWorker(Worker):
-    """Applies ops via the test's nemesis (interpreter.clj:69-76)."""
+    """Applies ops via the test's nemesis (interpreter.clj:69-76).
+
+    When the test carries a durable fault registry (``test['_faults']``,
+    installed by core.run), fault-opening ops are recorded to
+    ``faults.jsonl`` BEFORE injection and fault-closing ops mark their
+    kind healed after they complete cleanly — the exactly-once-heal
+    ledger a crashed run's recovery replays (doc/robustness.md)."""
 
     def invoke(self, test, op):
         reg = telemetry.get_registry()
@@ -115,14 +121,31 @@ class NemesisWorker(Worker):
                 gauge = reg.gauge("nemesis_fault_active",
                                   "open fault windows (begin - end events)")
                 gauge.inc() if phase == "begin" else gauge.dec()
+        nemesis = test.get("nemesis")
+        faults = test.get("_faults") if nemesis is not None else None
+        fault_phase = fault_kind = None
+        if faults is not None:
+            from jepsen_tpu.nemesis.faults import classify
+            fault_phase, fault_kind = classify(op.get("f"))
+            if fault_phase == "begin":
+                try:
+                    faults.record(fault_kind, f=op.get("f"),
+                                  value=op.get("value"))
+                except Exception:  # noqa: BLE001 — never blocks injection
+                    logger.exception("fault registry record failed")
         try:
-            nemesis = test.get("nemesis")
             if nemesis is None:
                 return {**op, "type": "info"}
             completion = nemesis.invoke(test, op)
             if completion is None:
                 completion = {**op}
             completion.setdefault("type", "info")
+            if (faults is not None and fault_phase == "end"
+                    and completion.get("error") is None):
+                try:
+                    faults.mark_healed(kind=fault_kind, via="nemesis")
+                except Exception:  # noqa: BLE001
+                    logger.exception("fault registry heal-mark failed")
             return completion
         except Exception as e:  # noqa: BLE001
             logger.exception("nemesis op crashed")
@@ -182,6 +205,11 @@ def run(test: dict) -> list[dict]:
         _spawn_worker(test, wid, completions) for wid in ctx.workers
     )}
     history: list[dict] = []
+    # write-ahead journal (core.run installs it): every history-bound op
+    # — invocations at dispatch, completions as they arrive — lands in
+    # history.wal.jsonl the moment it enters the in-memory history, so a
+    # killed run leaves a replayable prefix (doc/robustness.md)
+    journal = test.get("_journal")
 
     # telemetry: instruments fetched ONCE before the loop, then driven
     # through the single-writer fast paths (cell/observer — only this
@@ -224,6 +252,8 @@ def run(test: dict) -> list[dict]:
         thread = thread_of(completion.get("process"))
         if goes_in_history(completion):
             history.append(completion)
+            if journal is not None:
+                journal.append(completion)
             if metrics_on:
                 t0 = invoke_at.pop(thread, None)
                 if t0 is not None:
@@ -294,6 +324,8 @@ def run(test: dict) -> list[dict]:
             ctx = ctx.busy_thread(thread).with_time(now)
             if goes_in_history(op):
                 history.append(op)
+                if journal is not None:
+                    journal.append(op)
                 if metrics_on:
                     invoke_at[thread] = now
                     inflight_n += 1

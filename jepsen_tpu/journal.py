@@ -1,0 +1,154 @@
+"""Write-ahead history journal: crash-safe op persistence.
+
+The reference holds the whole history in memory until ``store/save-1!``
+(core.clj:395) — a control-process crash mid-run discards every recorded
+op. Here the generator interpreter's single-writer scheduler thread
+appends each history-bound op (invocations at dispatch, completions as
+they arrive) to ``store/<test>/<ts>/history.wal.jsonl`` as it happens,
+so a SIGKILLed run leaves a replayable prefix of the history behind.
+
+Durability knobs (test map):
+
+* ``wal: False`` — disable journaling entirely.
+* ``wal_fsync_interval`` — seconds between fsyncs (default
+  :data:`DEFAULT_FSYNC_INTERVAL_S`). ``0`` fsyncs every append
+  (power-loss safe, slow); a negative value never fsyncs (the flush
+  per append still makes every op SIGKILL-safe — kernel page cache
+  survives process death, not power loss).
+
+The reader side (:func:`read_wal` / :func:`read_jsonl_tolerant`)
+tolerates a torn final line: a crash can land mid-``write`` and leave a
+partial JSON document on the last line, which is dropped rather than
+raising ``json.JSONDecodeError``. ``cli analyze --recover`` rebuilds a
+checkable history from the journal of a crashed run
+(doc/robustness.md).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+logger = logging.getLogger("jepsen.journal")
+
+WAL_NAME = "history.wal.jsonl"
+DEFAULT_FSYNC_INTERVAL_S = 1.0
+
+
+class Journal:
+    """Append-only jsonl journal with interval fsync.
+
+    ``append`` is called from the interpreter's scheduler thread only;
+    the lock exists so an abnormal-shutdown ``close`` from the
+    orchestrator thread can't race a final append."""
+
+    def __init__(self, path, fsync_interval_s: float = DEFAULT_FSYNC_INTERVAL_S):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "w", encoding="utf-8")
+        self.fsync_interval_s = fsync_interval_s
+        self._last_fsync = time.monotonic()
+        self._lock = threading.Lock()
+        self.appended = 0
+
+    def append(self, op: dict) -> None:
+        """Writes one op as a JSON line, flushed to the OS immediately
+        (SIGKILL-safe) and fsynced on the configured interval
+        (power-loss-safe). Failures — unserializable op, disk full —
+        are logged, never raised: the journal must not take down the
+        run it protects. A dying WAL (OSError) closes itself; the run
+        continues with the in-memory history, exactly the pre-WAL
+        behavior."""
+        from jepsen_tpu.store import _serializable
+        try:
+            line = json.dumps(_serializable(op)) + "\n"
+        except Exception:  # noqa: BLE001 — journaling never kills a run
+            logger.exception("unserializable op dropped from WAL")
+            return
+        with self._lock:
+            if self._f.closed:
+                return
+            try:
+                self._f.write(line)
+                self._f.flush()
+                self.appended += 1
+                interval = self.fsync_interval_s
+                if interval is not None and interval >= 0:
+                    now = time.monotonic()
+                    if interval == 0 or now - self._last_fsync >= interval:
+                        os.fsync(self._f.fileno())
+                        self._last_fsync = now
+            except OSError:
+                logger.exception("WAL write failed; journaling off for "
+                                 "the rest of the run")
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+
+    def sync(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._last_fsync = time.monotonic()
+
+    def close(self, discard: bool = False) -> None:
+        """Flushes and closes; ``discard=True`` additionally unlinks the
+        file — core.run discards the WAL once ``store.save_1`` has
+        persisted the authoritative ``history.jsonl`` (a surviving WAL
+        without a history.jsonl next to it marks a crashed run)."""
+        with self._lock:
+            if not self._f.closed:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    logger.exception("WAL final fsync failed")
+                self._f.close()
+        if discard:
+            try:
+                self.path.unlink(missing_ok=True)
+            except OSError:
+                logger.exception("couldn't discard WAL %s", self.path)
+
+
+def read_jsonl_tolerant(path) -> tuple[list[dict], bool]:
+    """Parses a jsonl file, tolerating the torn final line a crash (or a
+    file-truncate nemesis aimed at ourselves) leaves behind. Returns
+    ``(rows, truncated)`` — ``truncated`` is True when a final partial
+    line was dropped. A malformed *interior* line is skipped with a
+    warning (defensive: interior tears can't happen from our writer, but
+    a recovery tool must not die on one)."""
+    rows: list[dict] = []
+    truncated = False
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                truncated = True
+                logger.debug("dropped torn final jsonl line in %s", path)
+            else:
+                logger.warning("skipping malformed jsonl line %d in %s",
+                               i + 1, path)
+    # a last line without its newline parsed fine only if the tear
+    # happened to land on a document boundary; count it as complete
+    return rows, truncated
+
+
+def read_wal(path) -> tuple[list[dict], bool]:
+    """The ops recovered from a journal, plus the torn-tail flag."""
+    return read_jsonl_tolerant(path)
+
+
+def wal_path(test: dict):
+    from jepsen_tpu import store
+    return store.path(test, WAL_NAME)

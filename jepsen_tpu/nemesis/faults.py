@@ -1,0 +1,337 @@
+"""Durable fault registry: exactly-once nemesis heal.
+
+A killed run can leave the cluster partitioned with clocks scrambled —
+the nemesis teardown that would have healed it died with the control
+process. This module records every injected fault to
+``store/<test>/<ts>/faults.jsonl`` *before* injection (fsynced — the
+registry must survive the crash it exists for) and marks it healed after
+the closing op or the nemesis teardown. What remains unhealed is exactly
+what a recovery pass must undo:
+
+* ``core.run`` replays unhealed entries in its crash-path ``finally``
+  (full capability: the live test map still holds net/db handles), and
+* ``cli heal <store-dir>`` replays them offline for a run whose process
+  is gone — net and clock state are restorable from the serialized test
+  map alone; process kill/pause heals need the db object and are
+  reported as unhealable offline.
+
+Heal actions are idempotent (``iptables -F``, ``tc qdisc del``, reset
+clock, ``start!``) and retried with capped-exponential full-jitter
+backoff; an entry is marked healed only after its action succeeded, so
+replaying the registry twice heals exactly once.
+
+Registry rows: ``{"op": "inject", "id": n, "kind": ..., "f": ...,
+"value": ..., "time": ...}`` and ``{"op": "heal", "id": n, "via": ...,
+"time": ...}``. The file is append-only jsonl, read with the same
+torn-tail-tolerant reader as the history WAL.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger("jepsen.nemesis.faults")
+
+FAULTS_NAME = "faults.jsonl"
+
+# Heal-action dispatch groups. "file" faults (truncate-file, bitflip)
+# have no inverse — they're recorded so a recovery knows the damage
+# exists, and reported as unhealable.
+KINDS = ("net", "netem", "clock", "process", "pause", "file")
+
+# What a successful nemesis teardown restores ("resumes normal
+# operation", nemesis.clj contract): everything EXCEPT file damage,
+# which no teardown can undo — those entries stay on the books.
+TEARDOWN_HEALS = ("net", "netem", "clock", "process", "pause")
+
+# Kinds with no heal action at all — recorded as evidence, reported as
+# unhealable, and not worth a crash-path replay warning on their own.
+UNHEALABLE_KINDS = ("file",)
+
+
+def classify(f) -> tuple[str | None, str | None]:
+    """``(phase, kind)`` for a nemesis op :f — ``("begin", "net")`` for
+    an op that opens a fault window, ``("end", "net")`` for one that
+    closes it, ``(None, None)`` when the op is not a fault (or is the
+    ambiguous bare ``start``/``stop`` pair, which the kill package uses
+    as heal/fault in the *opposite* sense from the raw partitioner —
+    callers composing those route through f_map'd package names)."""
+    if not isinstance(f, str):
+        return None, None
+    n = f.replace("_", "-")
+    table = {
+        "start-partition": ("begin", "net"), "partition": ("begin", "net"),
+        "snub": ("begin", "net"),
+        "stop-partition": ("end", "net"), "heal": ("end", "net"),
+        "slow": ("begin", "netem"), "flaky": ("begin", "netem"),
+        "start-netem": ("begin", "netem"),
+        "fast": ("end", "netem"), "stop-netem": ("end", "netem"),
+        "bump": ("begin", "clock"), "strobe": ("begin", "clock"),
+        "scramble-clock": ("begin", "clock"),
+        "start-clock": ("begin", "clock"),
+        "reset": ("end", "clock"), "reset-time": ("end", "clock"),
+        "stop-clock": ("end", "clock"),
+        "kill": ("begin", "process"),
+        "pause": ("begin", "pause"), "resume": ("end", "pause"),
+        "start-pause": ("begin", "pause"), "stop-pause": ("end", "pause"),
+        "truncate-file": ("begin", "file"), "bitflip": ("begin", "file"),
+    }
+    if n in table:
+        return table[n]
+    # package convention: start-<x>/stop-<x> open and close an <x>
+    # window — but only map to a kind we actually know how to heal
+    # (e.g. faunadb's start-partition-replica). An unknown suffix
+    # (yugabyte's stop-master is a fault INJECTION, not a heal) must
+    # not be guessed at: wrong bookkeeping is worse than none.
+    for prefix, phase in (("start-", "begin"), ("stop-", "end")):
+        if n.startswith(prefix):
+            base = n[len(prefix):]
+            if base in KINDS:
+                return phase, base
+            if "partition" in base:
+                return phase, "net"
+            return None, None
+    # bare "start"/"stop" are genuinely ambiguous (the kill package's
+    # heal/restart vs the raw Partitioner's open/close) and are NOT
+    # classified; teardown marking and the idempotent replay still
+    # cover both cases
+    return None, None
+
+
+class FaultRegistry:
+    """Append-only durable fault log. Thread-safe: nemesis ops arrive on
+    the nemesis worker thread while teardown/replay run on the
+    orchestrator thread."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._entries: dict[int, dict] = {}
+        self._healed: set[int] = set()
+        self._next_id = 0
+        if self.path.exists():
+            self._load()
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def _load(self) -> None:
+        from jepsen_tpu.journal import read_jsonl_tolerant
+        rows, _truncated = read_jsonl_tolerant(self.path)
+        for row in rows:
+            rid = row.get("id")
+            if not isinstance(rid, int):
+                continue
+            if row.get("op") == "inject":
+                self._entries[rid] = row
+                self._next_id = max(self._next_id, rid + 1)
+            elif row.get("op") == "heal":
+                self._healed.add(rid)
+
+    def _append(self, row: dict) -> None:
+        from jepsen_tpu.store import _serializable
+        self._f.write(json.dumps(_serializable(row)) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def record(self, kind: str, f=None, value: Any = None) -> int:
+        """Durably records an injection BEFORE it happens; returns the
+        fault id. If the control process dies right after, the entry is
+        already on disk for ``cli heal``."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            row = {"op": "inject", "id": rid, "kind": kind, "f": f,
+                   "value": value, "time": time.time()}
+            self._entries[rid] = row
+            self._append(row)
+        self._count("nemesis_faults_recorded_total", kind)
+        return rid
+
+    def mark_healed(self, fault_id: int | None = None,
+                    kind: str | None = None, kinds=None,
+                    via: str = "nemesis") -> list[int]:
+        """Marks faults healed: one by id, every unhealed fault of a
+        kind (or of any kind in ``kinds``), or — all selectors None —
+        every unhealed fault. Returns the ids marked."""
+        with self._lock:
+            if fault_id is not None:
+                ids = ([fault_id] if fault_id in self._entries
+                       and fault_id not in self._healed else [])
+            else:
+                wanted = (set(kinds) if kinds is not None
+                          else {kind} if kind is not None else None)
+                ids = [rid for rid, row in sorted(self._entries.items())
+                       if rid not in self._healed
+                       and (wanted is None or row.get("kind") in wanted)]
+            for rid in ids:
+                self._healed.add(rid)
+                self._append({"op": "heal", "id": rid, "via": via,
+                              "time": time.time()})
+        for rid in ids:
+            self._count("nemesis_faults_healed_total",
+                        self._entries[rid].get("kind"))
+        return ids
+
+    def unhealed(self) -> list[dict]:
+        with self._lock:
+            return [dict(row) for rid, row in sorted(self._entries.items())
+                    if rid not in self._healed]
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    @staticmethod
+    def _count(metric: str, kind) -> None:
+        from jepsen_tpu import telemetry
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter(metric, "durable fault-registry entries",
+                        labels=("kind",)).inc(kind=str(kind))
+
+
+class Unhealable(Exception):
+    """This fault kind cannot be healed with the handles available
+    (e.g. a process kill from ``cli heal``, where the db object is
+    gone, or file damage with no inverse)."""
+
+
+# ---------------------------------------------------------------------------
+# Heal actions — each idempotent over the whole cluster
+# ---------------------------------------------------------------------------
+
+def _net_for(test: dict):
+    net = test.get("net")
+    if net is not None:
+        return net
+    # offline heal (cli heal): the serialized test map dropped the net
+    # object; rebuild the default for the transport
+    from jepsen_tpu.net import IPTables, NoopNet
+    return NoopNet() if (test.get("ssh") or {}).get("dummy") else IPTables()
+
+
+def _heal_net(test: dict) -> None:
+    _net_for(test).heal(test)
+
+
+def _heal_netem(test: dict) -> None:
+    _net_for(test).fast(test)
+
+
+def _heal_clock(test: dict) -> None:
+    """Resyncs every node's clock, RAISING when no mechanism worked on a
+    node — a heal that can't verify its work must not report success
+    (the registry marks healed only on a healer's clean return). Tries
+    the ntp-quality resyncs first, then the coarse ``date -s`` that a
+    control node can always serve."""
+    from jepsen_tpu import control
+    from jepsen_tpu.control.core import RemoteError
+    from jepsen_tpu.utils import real_pmap
+
+    def reset(node):
+        def do():
+            for cmd in (("ntpdate", "-p", "1", "-b", "pool.ntp.org"),
+                        ("chronyc", "-a", "makestep"),
+                        ("systemctl", "restart", "systemd-timesyncd"),
+                        ("date", "-s", f"@{int(time.time())}")):
+                try:
+                    control.exec_(*cmd)
+                    return
+                except RemoteError:
+                    continue
+            raise RuntimeError(f"no working clock-reset mechanism on "
+                               f"{node}")
+        control.on(node, test, do)
+
+    real_pmap(reset, list(test.get("nodes") or []))
+
+
+def _db_heal(test: dict, method: str) -> None:
+    from jepsen_tpu import db as db_mod
+    from jepsen_tpu.utils import real_pmap
+    db = test.get("db")
+    want = db_mod.Process if method == "start" else db_mod.Pause
+    if db is None or not isinstance(db, want):
+        raise Unhealable(
+            f"no live db object implementing {method!r}; restart the "
+            "cluster's processes manually or re-run from a live test map")
+    fn = db.start if method == "start" else db.resume
+    real_pmap(lambda n: fn(test, n), list(test.get("nodes") or []))
+
+
+def _heal_process(test: dict) -> None:
+    _db_heal(test, "start")
+
+
+def _heal_pause(test: dict) -> None:
+    _db_heal(test, "resume")
+
+
+def _heal_file(test: dict) -> None:
+    raise Unhealable("file damage (truncate/bitflip) has no inverse; "
+                     "the db setup cycle must rebuild the node")
+
+
+HEALERS = {
+    "net": _heal_net,
+    "netem": _heal_netem,
+    "clock": _heal_clock,
+    "process": _heal_process,
+    "pause": _heal_pause,
+    "file": _heal_file,
+}
+
+
+def replay_unhealed(test: dict, registry: FaultRegistry,
+                    tries: int = 4, rng=None) -> dict:
+    """Heals every unhealed fault in the registry, grouped by kind (one
+    idempotent cluster-wide action heals any number of same-kind
+    faults), each action retried with capped-exponential full-jitter
+    backoff. Entries are marked healed only after their action
+    succeeded — a second replay is exactly a no-op. Returns
+    ``{"healed": [...], "unhealable": [...], "failed": [...]}`` id
+    lists."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.utils import retry_with_backoff
+
+    out: dict[str, list[int]] = {"healed": [], "unhealable": [],
+                                 "failed": []}
+    pending = registry.unhealed()
+    if not pending:
+        return out
+    by_kind: dict[str, list[dict]] = {}
+    for row in pending:
+        by_kind.setdefault(str(row.get("kind")), []).append(row)
+    reg = telemetry.get_registry()
+    for kind in sorted(by_kind):
+        ids = [r["id"] for r in by_kind[kind]]
+        healer = HEALERS.get(kind)
+        try:
+            if healer is None:
+                raise Unhealable(f"no healer registered for kind {kind!r}")
+            # Unhealable is a terminal verdict, not a flake: no backoff
+            retry_with_backoff(lambda: healer(test), tries=tries, rng=rng,
+                               desc=f"heal {kind}", no_retry=(Unhealable,))
+        except Unhealable as e:
+            logger.warning("faults %s (kind %s) left unhealed: %s",
+                           ids, kind, e)
+            out["unhealable"].extend(ids)
+            continue
+        except Exception:  # noqa: BLE001 — keep healing the other kinds
+            logger.exception("heal replay for kind %r failed after %d "
+                             "tries", kind, tries)
+            out["failed"].extend(ids)
+            continue
+        registry.mark_healed(kind=kind, via="replay")
+        out["healed"].extend(ids)
+        if reg.enabled:
+            reg.counter("nemesis_heal_replayed_total",
+                        "fault heals applied by crash-path/cli replay",
+                        labels=("kind",)).inc(len(ids), kind=kind)
+    return out

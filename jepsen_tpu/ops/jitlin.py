@@ -48,6 +48,15 @@ def last_phase_seconds() -> dict:
     return dict(getattr(_PHASE, "value", {}))
 
 
+def publish_phase_seconds(phases: dict) -> None:
+    """Re-publishes a phase split into THIS thread's slot. The checker's
+    degradation ladder runs device dispatches on a watchdog worker
+    thread; it captures the split there and re-publishes on the
+    dispatching thread so ``last_phase_seconds()`` keeps answering for
+    the thread that owns the check."""
+    _PHASE.value = dict(phases)
+
+
 def _env_int(name: str, default: int) -> int:
     """Env-int knob that degrades to its default on malformed values
     (a bad sweep variable must not make the module unimportable)."""

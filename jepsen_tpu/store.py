@@ -253,12 +253,21 @@ def load_results(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> d
 
 
 def load_history(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> list[dict]:
-    out = []
-    with open(Path(store_dir) / test_name / timestamp / "history.jsonl") as f:
-        for line in f:
-            if line.strip():
-                out.append(json.loads(line))
-    return out
+    """Reads history.jsonl, tolerating the torn final line a crash (or a
+    disk-full save) can leave — a truncated tail is dropped with a
+    warning instead of raising json.JSONDecodeError, so re-analysis of
+    a damaged run still sees every complete op."""
+    from jepsen_tpu.journal import read_jsonl_tolerant
+    p = Path(store_dir) / test_name / timestamp / "history.jsonl"
+    ops, truncated = read_jsonl_tolerant(p)
+    if truncated:
+        logger.warning("history.jsonl at %s has a torn final line; "
+                       "dropped it", p)
+    return ops
+
+
+# the name the recovery tooling uses (doc/robustness.md); same reader
+read_history = load_history
 
 
 def load_test(test_name: str, timestamp: str, store_dir: str = BASE_DIR) -> dict:

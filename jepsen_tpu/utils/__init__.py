@@ -176,6 +176,45 @@ def timeout(ms: float, dflt: Any, fn: Callable[[], Any]) -> Any:
     return result[0]
 
 
+def backoff_delay(attempt: int, base_s: float = 0.05, cap_s: float = 5.0,
+                  rng: random.Random | None = None) -> float:
+    """Capped exponential backoff with FULL jitter (the AWS
+    architecture-blog schedule): ``uniform(0, min(cap, base * 2**n))``.
+    Full jitter decorrelates retry storms — N clients that failed
+    together spread over the whole window instead of thundering back in
+    lockstep. ``rng`` makes the schedule deterministic under a seeded
+    ``random.Random`` for tests."""
+    ceiling = min(cap_s, base_s * (2.0 ** attempt))
+    return (rng or random).uniform(0.0, ceiling)
+
+
+def retry_with_backoff(fn: Callable[[], Any], tries: int = 5,
+                       base_s: float = 0.05, cap_s: float = 5.0,
+                       rng: random.Random | None = None,
+                       desc: str = "operation",
+                       no_retry: tuple = ()) -> Any:
+    """Runs fn up to ``tries`` times with :func:`backoff_delay` sleeps
+    between attempts; raises the last exception when every try fails.
+    Exception types in ``no_retry`` are terminal verdicts, re-raised
+    immediately without burning the remaining attempts. The workhorse
+    behind idempotent nemesis teardowns and fault-registry heal replay
+    (doc/robustness.md)."""
+    err: Exception | None = None
+    for attempt in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            if no_retry and isinstance(e, no_retry):
+                raise
+            err = e
+            if attempt < tries - 1:
+                delay = backoff_delay(attempt, base_s, cap_s, rng)
+                logger.debug("%s failed (try %d/%d), backing off %.3fs: %r",
+                             desc, attempt + 1, tries, delay, e)
+                _time.sleep(delay)
+    raise err
+
+
 def retry(dt_seconds: float, fn: Callable[[], Any], retries: int | None = None) -> Any:
     """Retries fn every dt seconds until it returns non-exceptionally
     (util.clj:425-440)."""

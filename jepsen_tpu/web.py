@@ -26,18 +26,24 @@ td, th { padding: 0.3em 0.8em; border: 1px solid #ddd; text-align: left; }
 .valid-true { background: #c8f7c5; }
 .valid-false { background: #f7c5c5; }
 .valid-unknown { background: #f7eec5; }
+.badge-incomplete { background: #e0d5f7; border-radius: 0.6em;
+  padding: 0.05em 0.5em; font-size: 0.85em; }
 a { text-decoration: none; }
 """
 
 
-_VALIDITY_CACHE: dict[str, tuple[int, object]] = {}
+_VALIDITY_CACHE: dict[str, tuple[int, object, bool]] = {}
 
 
 def _validity(run_dir: Path):
-    """Cached results validity (the reference memoizes result loading —
-    web.clj:48-69 fast-tests — because re-parsing every run per request
-    doesn't scale). Keyed on the results file's mtime, so re-analysis
-    invalidates naturally."""
+    """Cached ``(valid?, incomplete)`` from results.json (the reference
+    memoizes result loading — web.clj:48-69 fast-tests — because
+    re-parsing every run per request doesn't scale). Keyed on the
+    results file's mtime, so re-analysis invalidates naturally.
+    ``incomplete`` is True for results recovered from a crashed run's
+    partial journal (cli analyze --recover), and also when the run
+    directory holds a WAL with no results at all — a crash nobody has
+    recovered yet."""
     f = run_dir / "results.json"
     try:
         mtime = f.stat().st_mtime_ns
@@ -49,17 +55,20 @@ def _validity(run_dir: Path):
             for k in [k for k in _VALIDITY_CACHE
                       if not Path(k).exists()]:
                 _VALIDITY_CACHE.pop(k, None)
-        return None
+        # no results: a leftover WAL marks a crashed, unrecovered run
+        return None, (run_dir / "history.wal.jsonl").exists()
     hit = _VALIDITY_CACHE.get(str(f))
     if hit is not None and hit[0] == mtime:
-        return hit[1]
+        return hit[1], hit[2]
     try:
         with open(f) as fh:
-            valid = json.load(fh).get("valid?")
+            results = json.load(fh)
+        valid = results.get("valid?")
+        incomplete = bool(results.get("incomplete"))
     except Exception:  # noqa: BLE001
-        valid = None
-    _VALIDITY_CACHE[str(f)] = (mtime, valid)
-    return valid
+        valid, incomplete = None, False
+    _VALIDITY_CACHE[str(f)] = (mtime, valid, incomplete)
+    return valid, incomplete
 
 
 def _metrics_table(path: Path) -> str:
@@ -164,9 +173,11 @@ class Handler(BaseHTTPRequestHandler):
         rows = []
         for name, runs in sorted(store.tests(store_dir=str(base)).items()):
             for ts, run_dir in sorted(runs.items(), reverse=True):
-                valid = _validity(run_dir)
+                valid, incomplete = _validity(run_dir)
                 cls = {True: "valid-true", False: "valid-false"}.get(
                     valid, "valid-unknown")
+                badge = (" <span class='badge-incomplete'>incomplete"
+                         "</span>" if incomplete else "")
                 arts = store.telemetry_artifacts(run_dir)
                 links = " ".join(
                     f"<a href='/{name}/{ts}/{a}{'/' if a == store.PROFILE_DIR else ''}'>"
@@ -176,7 +187,7 @@ class Handler(BaseHTTPRequestHandler):
                     f"<tr class='{cls}'>"
                     f"<td><a href='/{name}/{ts}/'>{html.escape(name)}</a></td>"
                     f"<td><a href='/{name}/{ts}/'>{html.escape(ts)}</a></td>"
-                    f"<td>{valid}</td>"
+                    f"<td>{valid}{badge}</td>"
                     f"<td>{links}</td>"
                     f"<td><a href='/zip/{name}/{ts}'>zip</a></td></tr>")
         body = ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
@@ -195,8 +206,18 @@ class Handler(BaseHTTPRequestHandler):
                 for p in sorted(target.iterdir()))
             metrics = _metrics_table(target / "metrics.json")
             elle = _elle_section(rel, target)
+            banner = ""
+            if (target / "results.json").exists() or \
+                    (target / "history.wal.jsonl").exists():
+                _valid, incomplete = _validity(target)
+                if incomplete:
+                    banner = ("<p><span class='badge-incomplete'>"
+                              "incomplete</span> this run crashed; its "
+                              "history was (or can be) recovered from "
+                              "the write-ahead journal via "
+                              "<code>analyze --recover</code></p>")
             return self._send(
-                self._page(rel, f"{elle}{metrics}<ul>{items}</ul>"))
+                self._page(rel, f"{banner}{elle}{metrics}<ul>{items}</ul>"))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"

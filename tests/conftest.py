@@ -40,6 +40,44 @@ if not TPU_SESSION:
         pass
 
 
+# --- tier-1 wall-clock guard -------------------------------------------
+#
+# The quick lane (-m 'not slow') must stay inside the driver's 870 s
+# timeout; PR 2 split the slow tests out to get it there. This guard
+# fails the SESSION when the quick lane exceeds its budget, so a slow
+# test creeping into the quick lane is a red build, not a silent drift
+# back toward the timeout. Tune/disable with JEPSEN_TPU_TIER1_BUDGET_S
+# (0 disables).
+
+import time as _time_mod  # noqa: E402
+
+TIER1_BUDGET_S = float(os.environ.get("JEPSEN_TPU_TIER1_BUDGET_S", "870"))
+
+
+def _is_quick_lane(config) -> bool:
+    expr = config.getoption("markexpr", default="") or ""
+    return "not slow" in expr
+
+
+def pytest_configure(config):
+    config._jepsen_session_t0 = _time_mod.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if TIER1_BUDGET_S <= 0 or not _is_quick_lane(session.config):
+        return
+    elapsed = _time_mod.monotonic() - session.config._jepsen_session_t0
+    if elapsed > TIER1_BUDGET_S:
+        import pytest
+        # pytest.exit from sessionfinish is the supported way to force
+        # the exit status (wrap_session catches exit.Exception here)
+        pytest.exit(
+            f"quick lane took {elapsed:.0f}s, over its "
+            f"{TIER1_BUDGET_S:.0f}s tier-1 budget — move the slow "
+            "test(s) to the slow lane (pytest.mark.slow); see "
+            "doc/robustness.md", returncode=1)
+
+
 def run_fake(suite_test_fn, **opts):
     """Shared fake-mode lifecycle harness for suite tests: builds the
     suite's test map in --fake mode (in-memory doubles over the dummy

@@ -1,0 +1,58 @@
+"""Chaos-test worker: a deliberately slow fake-mode run for the parent
+test to SIGKILL mid-case (tests/test_crashsafe.py).
+
+Opens a partition (recorded, never healed — the kill lands before the
+stop op), then grinds through register ops at ~100/s per worker so the
+write-ahead journal accumulates lines the parent can poll for. Usage:
+
+    python crashsafe_worker.py <store-dir>
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu import core  # noqa: E402
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.fakes import AtomClient, AtomDB, noop_test
+
+
+class SlowAtomClient(AtomClient):
+    """AtomClient with a per-op delay, so the run is killable mid-case
+    instead of finishing before the parent can aim."""
+
+    def invoke(self, test, op):
+        time.sleep(0.01)
+        return super().invoke(test, op)
+
+
+def main() -> int:
+    store_dir = sys.argv[1]
+    db = AtomDB()
+    ops = [{"type": "invoke", "f": "write", "value": 1},
+           {"type": "invoke", "f": "read", "value": None},
+           {"type": "invoke", "f": "cas", "value": [1, 2]},
+           {"type": "invoke", "f": "write", "value": 3}]
+    g = gen.Seq([
+        gen.nemesis_gen(gen.Seq([{"type": "info", "f": "start-partition",
+                                  "value": None}])),
+        gen.clients(gen.limit(50_000, gen.cycle(gen.Seq(ops)))),
+        gen.nemesis_gen(gen.Seq([{"type": "info", "f": "stop-partition",
+                                  "value": None}])),
+    ])
+    t = noop_test(db=db, client=SlowAtomClient(db),
+                  nemesis=nem.partitioner(),
+                  generator=g, store_dir=store_dir,
+                  time_limit=600.0,
+                  # fsync every append: the WAL the parent inspects
+                  # after SIGKILL must be fully durable
+                  wal_fsync_interval=0,
+                  metrics_interval=0)
+    core.run(t)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,9 +97,10 @@ def test_web_validity_cache_invalidates_on_mtime(tmp_path):
     run.mkdir(parents=True)
     f = run / "results.json"
     f.write_text('{"valid?": true}')
-    assert _validity(run) is True
-    assert _validity(run) is True  # served from cache
+    assert _validity(run) == (True, False)
+    assert _validity(run) == (True, False)  # served from cache
     assert str(f) in _VALIDITY_CACHE
-    f.write_text('{"valid?": false}')
+    f.write_text('{"valid?": false, "incomplete": true}')
     os.utime(f, ns=(1, 1))  # force a distinct mtime
-    assert _validity(run) is False  # mtime change invalidated the entry
+    # mtime change invalidated the entry; incomplete badge surfaces
+    assert _validity(run) == (False, True)

@@ -30,6 +30,19 @@ def serve_once(handler, want_thread=False):
         try:
             handler(conn)
         finally:
+            # graceful close: send FIN, then drain until the client
+            # closes. An abrupt close() with unread client bytes still
+            # in our receive buffer makes the kernel RST the connection,
+            # racing the client's reads of our final responses (seen as
+            # a rare ConnectionResetError under load). Real brokers
+            # close gracefully; so do we.
+            try:
+                conn.shutdown(socket.SHUT_WR)
+                conn.settimeout(5)
+                while conn.recv(65536):
+                    pass
+            except OSError:
+                pass
             conn.close()
             srv.close()
 
