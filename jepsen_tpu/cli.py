@@ -68,6 +68,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of the "
                         "checker phase into the run's profile/ dir")
+    # per-op deadline (doc/robustness.md): a hung client invoke becomes
+    # a bounded, indeterminate :info instead of wedging the run
+    p.add_argument("--op-timeout", type=float, default=None,
+                   dest="op_timeout",
+                   help="seconds before an in-flight op is reaped to an "
+                        "indeterminate :info and its worker replaced "
+                        "(default 600; 0 disables; per-op timeout_s and "
+                        "JEPSEN_TPU_OP_TIMEOUT_S also apply)")
 
 
 def test_opts_to_test(opts, base_test: dict) -> dict:
@@ -90,6 +98,9 @@ def test_opts_to_test(opts, base_test: dict) -> dict:
         test["metrics"] = False
     test["profile"] = bool(getattr(opts, "profile", False)
                            or test.get("profile"))
+    if getattr(opts, "op_timeout", None) is not None:
+        # 0 disables (the interpreter treats falsy as no deadline)
+        test["op_timeout_s"] = opts.op_timeout
     ssh = dict(test.get("ssh") or {})
     ssh.update({
         "username": opts.username,

@@ -24,7 +24,22 @@ class Client:
         """One-time database setup through this client."""
 
     def invoke(self, test: dict, op: dict) -> dict:
-        """Applies op, returning its completion (type ok/fail/info)."""
+        """Applies op, returning its completion (type ok/fail/info).
+
+        Deadline contract (doc/robustness.md): the interpreter bounds
+        every invoke with a per-op deadline (``op['timeout_s']`` →
+        ``test['op_timeout_s']`` → ``JEPSEN_TPU_OP_TIMEOUT_S``). An
+        invoke that outlives its deadline has an indeterminate ``info``
+        completion synthesized for it and its worker replaced; whatever
+        this method eventually returns is quarantined to the run's
+        ``late.jsonl`` — never appended to history — and ``close`` is
+        then called from this client's own (zombie) worker thread, never
+        concurrently with a still-running invoke. The replacement worker
+        calls ``open`` for a FRESH client while the hung invoke may
+        still be blocked: ``open`` must hand out independently usable
+        connections (its documented contract above); a client whose
+        ``open`` returns a shared object must tolerate a concurrent
+        invoke on it."""
         raise NotImplementedError
 
     def teardown(self, test: dict) -> None:

@@ -333,7 +333,7 @@ def _crash_safety_setup(test: dict):
     and ``cli heal`` need the test map (nodes, ssh opts) even when the
     run never reached save_1 — it is rewritten with the final state at
     save time."""
-    journal = faults = None
+    journal = faults = late = None
     try:
         store.write_test(test)
     except Exception:  # noqa: BLE001
@@ -355,7 +355,15 @@ def _crash_safety_setup(test: dict):
             test["_faults"] = faults
         except OSError:
             logger.exception("couldn't open fault registry")
-    return journal, faults
+    # the quarantine log for late completions from reaped zombie workers
+    # (doc/robustness.md); lazily opened, so clean runs leave no file
+    try:
+        late = journal_mod.ForensicLog(
+            store.path(test, journal_mod.LATE_NAME))
+        test["_late"] = late
+    except Exception:  # noqa: BLE001
+        logger.exception("couldn't set up late-completion log")
+    return journal, faults, late
 
 
 def run(test: dict) -> dict:
@@ -363,7 +371,7 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     store.start_logging(test)
     telemetry_teardown = _telemetry_setup(test)
-    journal, faults = _crash_safety_setup(test)
+    journal, faults, late = _crash_safety_setup(test)
     try:
         with with_thread_name(f"jepsen-{test.get('name')}"):
             log_test_start(test)
@@ -386,24 +394,26 @@ def run(test: dict) -> dict:
         test.pop("_journal", None)
         if journal is not None:
             journal.close()  # no-op when already discarded
+        test.pop("_late", None)
+        if late is not None:
+            late.close()
         test.pop("_faults", None)
         if faults is not None:
             # crash-path heal replay: a run that died mid-fault (or
-            # whose nemesis teardown failed) still restores the cluster
+            # whose nemesis teardown failed, or whose fault-closing op
+            # outlived its deadline) still restores the cluster
             try:
-                pending = faults.unhealed()
-                actionable = [r for r in pending
-                              if str(r.get("kind"))
-                              not in faults_mod.UNHEALABLE_KINDS]
+                actionable, evidence = faults_mod.actionable_unhealed(faults)
                 if actionable:
                     logger.warning("run left %d unhealed fault(s); "
                                    "replaying heals", len(actionable))
                     summary = faults_mod.replay_unhealed(test, faults)
                     logger.info("crash-path heal replay: %s", summary)
-                elif pending:
+                elif evidence:
                     # file damage: evidence, not a heal target
                     logger.info("%d unhealable fault record(s) (file "
-                                "damage) remain on the books", len(pending))
+                                "damage) remain on the books",
+                                len(evidence))
             except Exception:  # noqa: BLE001
                 logger.exception("crash-path fault heal replay failed")
             faults.close()

@@ -424,6 +424,17 @@ def f_map(f_mapping: dict, gen) -> Generator:
     return Map(rewrite, gen)
 
 
+def op_timeout(timeout_s, gen) -> Generator:
+    """Stamps ``timeout_s`` onto every emitted op — the per-op deadline
+    override the interpreter honors ahead of ``test['op_timeout_s']`` /
+    ``JEPSEN_TPU_OP_TIMEOUT_S`` (doc/robustness.md). ``None``/``0``
+    exempts these ops from deadlines entirely (e.g. a legitimately
+    slow schema migration riding alongside deadline-bounded traffic)."""
+    def stamp(op):
+        return {**op, "timeout_s": timeout_s}
+    return Map(stamp, gen)
+
+
 @dataclass(frozen=True)
 class Filter(Generator):
     """Emits only ops satisfying pred (generator.clj:798-817)."""

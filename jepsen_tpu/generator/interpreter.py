@@ -7,16 +7,35 @@ polling completions and asking the generator for ops (interpreter.clj:
 181-310). Crashed ops (:info) renumber the worker's process and force a
 client reopen unless the client is reusable (:33-67, :142-157). Pseudo-ops
 (:sleep/:log) are handled in-worker and excluded from history (:172-179).
+
+Deadlines and reaping (doc/robustness.md): the reference blocks forever
+on a client that never returns — one hung ``Client.invoke`` wedges the
+whole run. Here every history-bound op carries a deadline (``op
+['timeout_s']`` → ``test['op_timeout_s']`` → ``JEPSEN_TPU_OP_TIMEOUT_S``,
+``None``/``0`` disables) and the scheduler's wait points clamp to the
+earliest one. On expiry the scheduler synthesizes an indeterminate
+``{:type :info, :error [op-timeout ...]}`` completion — journaled and
+process-renumbered like any crash — marks the worker *zombie*, and spawns
+a replacement thread under the same worker id with a bumped generation.
+A zombie's late completion (stale generation) is quarantined to the
+run's ``late.jsonl``, never appended to history; the zombie's client is
+closed by the zombie's own thread when it finally unblocks, never
+concurrently by the scheduler. The drain phase runs under its own
+deadline (``JEPSEN_TPU_DRAIN_S``), and a stall detector
+(``JEPSEN_TPU_STALL_S``) dumps all thread stacks into the store dir when
+neither a dispatch nor a completion happens for too long.
 """
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time as _time
 from typing import Any
 
 from jepsen_tpu import client as client_mod, telemetry
+from jepsen_tpu import journal as journal_mod
 from jepsen_tpu.generator import (
     NEMESIS, PENDING, Context, as_gen, context, friendly_exceptions, validate,
 )
@@ -28,6 +47,56 @@ logger = logging.getLogger("jepsen.interpreter")
 
 # Max time between generator re-polls when pending, µs (interpreter.clj:166-170)
 MAX_PENDING_INTERVAL_S = 0.001
+
+# Deadline defaults (doc/robustness.md). The op timeout is deliberately
+# generous: it exists to unwedge a run, not to police slow databases —
+# a synthesized :info is indeterminate, and flooding a history with
+# them tells the checker nothing.
+DEFAULT_OP_TIMEOUT_S = 600.0
+DEFAULT_DRAIN_TIMEOUT_S = 60.0
+DEFAULT_STALL_S = 300.0
+# How often the drain loop wakes to re-check its deadlines when no
+# completion arrives, and how long the shutdown path waits for worker
+# threads (and thus their self-closed clients) before abandoning them.
+DRAIN_POLL_S = 0.5
+SHUTDOWN_JOIN_S = 5.0
+
+STALL_DUMP_NAME = "stall-threads.txt"
+
+_UNSET = object()
+
+
+def _knob(test: dict, key: str, env: str, default: float) -> float | None:
+    """Resolves a timeout knob: test map → environment → default.
+    ``None``/``0`` (from any layer) disables and returns None."""
+    v = test.get(key, _UNSET)
+    if v is _UNSET:
+        e = os.environ.get(env)
+        if e is None or e == "":
+            v = default
+        else:
+            v = e
+    if not v:
+        return None
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        logger.warning("unparsable %s=%r; using default %s", key, v, default)
+        v = default
+    return float(v) if v else None
+
+
+def _coerce_timeout(v, fallback: float | None) -> float | None:
+    """A per-op ``timeout_s`` override, tolerantly: falsy (incl. "0")
+    disables, garbage degrades to ``fallback`` with a warning — a bad
+    op field must not kill the scheduler."""
+    if not v:
+        return None
+    try:
+        return float(v) or None
+    except (TypeError, ValueError):
+        logger.warning("unparsable op timeout_s=%r; using %s", v, fallback)
+        return fallback
 
 
 class _Exit:
@@ -106,7 +175,19 @@ class NemesisWorker(Worker):
     installed by core.run), fault-opening ops are recorded to
     ``faults.jsonl`` BEFORE injection and fault-closing ops mark their
     kind healed after they complete cleanly — the exactly-once-heal
-    ledger a crashed run's recovery replays (doc/robustness.md)."""
+    ledger a crashed run's recovery replays (doc/robustness.md).
+
+    Unlike clients (reopened per process), the nemesis OBJECT is shared:
+    after a deadline reap, the replacement worker invokes the same
+    ``test['nemesis']`` while the zombie may still be blocked inside it.
+    Nemeses must tolerate that — per-call transports and idempotent
+    heal actions (the existing package contract) already do."""
+
+    # Set by _spawn_worker: when the scheduler reaps this worker at a
+    # deadline, a fault-closing op that later completes must NOT mark
+    # its kind healed — the synthesized :info already stands and the
+    # entry stays on the books for the crash-path / cli-heal replay.
+    zombied: threading.Event | None = None
 
     def invoke(self, test, op):
         reg = telemetry.get_registry()
@@ -140,12 +221,40 @@ class NemesisWorker(Worker):
             if completion is None:
                 completion = {**op}
             completion.setdefault("type", "info")
+            if (faults is not None and fault_phase == "begin"
+                    and completion.get("error") is None
+                    and self.zombied is not None
+                    and self.zombied.is_set()):
+                # the injection landed AFTER this worker was reaped: a
+                # same-kind closing op may already have marked the
+                # pre-recorded entry healed, so put the fault back on
+                # the books — the replay / `cli heal` must know the
+                # late injection exists
+                try:
+                    faults.record(fault_kind, f=op.get("f"),
+                                  value=op.get("value"))
+                    logger.warning(
+                        "fault-opening op %r completed after its "
+                        "deadline; re-recorded kind %r as unhealed",
+                        op.get("f"), fault_kind)
+                except Exception:  # noqa: BLE001
+                    logger.exception("late fault re-record failed")
             if (faults is not None and fault_phase == "end"
                     and completion.get("error") is None):
-                try:
-                    faults.mark_healed(kind=fault_kind, via="nemesis")
-                except Exception:  # noqa: BLE001
-                    logger.exception("fault registry heal-mark failed")
+                if self.zombied is not None and self.zombied.is_set():
+                    # this closing op outlived its deadline: the run
+                    # already recorded an indeterminate :info for it, so
+                    # the entry must stay unhealed — core.run's
+                    # crash-path replay / `cli heal` restores the network
+                    logger.warning(
+                        "fault-closing op %r completed after its "
+                        "deadline; leaving kind %r unhealed for replay",
+                        op.get("f"), fault_kind)
+                else:
+                    try:
+                        faults.mark_healed(kind=fault_kind, via="nemesis")
+                    except Exception:  # noqa: BLE001
+                        logger.exception("fault registry heal-mark failed")
             return completion
         except Exception as e:  # noqa: BLE001
             logger.exception("nemesis op crashed")
@@ -157,21 +266,45 @@ def goes_in_history(op: dict) -> bool:
     return op.get("type") not in ("sleep", "log")
 
 
-def _spawn_worker(test: dict, worker_id, completions: queue.Queue):
-    """Worker thread + its in-queue (interpreter.clj:99-164)."""
+def _spawn_worker(test: dict, worker_id, completions: queue.Queue,
+                  generation: int = 0):
+    """Worker thread + its in-queue (interpreter.clj:99-164).
+
+    Every completion is tagged with this worker's ``generation`` so the
+    scheduler can tell a live worker's result from a reaped zombie's
+    late one. The worker owns its client's lifecycle: it closes the
+    client from its own thread on ``_EXIT`` — and, when zombied, after
+    its one outstanding op finally unblocks — so a close can never race
+    a mid-``invoke`` use of the same client object."""
     in_q: queue.Queue = queue.Queue(maxsize=1)
     if worker_id == NEMESIS:
         worker: Worker = NemesisWorker()
     else:
         nodes = test.get("nodes") or [None]
         worker = ClientWorker(nodes[worker_id % len(nodes)])
+    zombied = threading.Event()
+    if isinstance(worker, NemesisWorker):
+        worker.zombied = zombied
+
+    def close_own_client():
+        if isinstance(worker, ClientWorker):
+            try:
+                worker.close(test)
+            except Exception:  # noqa: BLE001
+                logger.exception("worker %s client close failed", worker_id)
 
     def run():
-        threading.current_thread().name = f"jepsen-worker-{worker_id}"
+        threading.current_thread().name = (
+            f"jepsen-worker-{worker_id}"
+            + (f".{generation}" if generation else ""))
         while True:
             op = in_q.get()
             if op is _EXIT:
-                completions.put((worker_id, _EXIT))
+                # close-before-ack: when the scheduler sees this exit
+                # marker, the client is already released (a hung close
+                # is therefore caught by the drain deadline)
+                close_own_client()
+                completions.put((worker_id, generation, _EXIT))
                 return
             typ = op.get("type")
             if typ == "sleep":
@@ -182,11 +315,85 @@ def _spawn_worker(test: dict, worker_id, completions: queue.Queue):
                 completion = {**op}
             else:
                 completion = worker.invoke(test, op)
-            completions.put((worker_id, completion))
+            completions.put((worker_id, generation, completion))
+            if zombied.is_set():
+                # reaped mid-op: the completion above will be
+                # quarantined (stale generation); close our own client
+                # and die — a replacement already took this worker id
+                close_own_client()
+                return
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
-    return {"id": worker_id, "in": in_q, "thread": t, "worker": worker}
+    return {"id": worker_id, "in": in_q, "thread": t, "worker": worker,
+            "gen": generation, "zombied": zombied}
+
+
+class _StallWatchdog:
+    """Detects a wedged run: history-bound ops in flight, yet neither a
+    dispatch nor a completion for ``stall_s`` seconds. Fires once per
+    stall episode: a telemetry event + counter, a warning, and an
+    all-threads stack dump into the store dir (``stall-threads.txt``) so
+    the wedge is diagnosable post-mortem. Re-arms only after activity
+    resumes. ``inflight_probe`` gates firing: a schedule that is merely
+    *quiet* (nothing in flight — a long :sleep, future-dated ops spaced
+    far apart) is not a stall."""
+
+    def __init__(self, test: dict, stall_s: float | None, activity: list,
+                 inflight_probe=None):
+        self.test = test
+        self.stall_s = stall_s
+        self.activity = activity
+        self.inflight_probe = inflight_probe or (lambda: True)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "_StallWatchdog":
+        if self.stall_s:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="jepsen-stall-watchdog")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        fired_at = None
+        poll = min(max(self.stall_s / 4.0, 0.05), 5.0)
+        while not self._stop.wait(poll):
+            if not self.inflight_probe():
+                fired_at = None
+                continue  # quiet schedule, not a stall
+            last = self.activity[0]
+            if _time.monotonic() - last < self.stall_s:
+                fired_at = None
+                continue
+            if fired_at == last:
+                continue  # this episode is already on the record
+            fired_at = last
+            self._fire(_time.monotonic() - last)
+
+    def _fire(self, idle_s: float) -> None:
+        logger.warning("interpreter stalled: no dispatch or completion "
+                       "for %.1fs; dumping thread stacks", idle_s)
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter(
+                "interpreter_stalls_total",
+                "stall-detector trips (no dispatch or completion for "
+                "JEPSEN_TPU_STALL_S)").inc()
+            reg.event("interpreter-stall", idle_s=round(idle_s, 3))
+        try:
+            from jepsen_tpu import store
+            target = store.path_mk(self.test, STALL_DUMP_NAME)
+        except Exception:  # noqa: BLE001 — bare test map, no store coords
+            logger.debug("no store dir for stall dump", exc_info=True)
+            return
+        telemetry.dump_thread_stacks(target)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
 
 
 def run(test: dict) -> list[dict]:
@@ -211,6 +418,15 @@ def run(test: dict) -> list[dict]:
     # killed run leaves a replayable prefix (doc/robustness.md)
     journal = test.get("_journal")
 
+    # deadline knobs (doc/robustness.md): the test map wins, then the
+    # environment, then the generous defaults; None/0 disables
+    default_timeout_s = _knob(test, "op_timeout_s",
+                              "JEPSEN_TPU_OP_TIMEOUT_S",
+                              DEFAULT_OP_TIMEOUT_S)
+    drain_timeout_s = _knob(test, "drain_timeout_s", "JEPSEN_TPU_DRAIN_S",
+                            DEFAULT_DRAIN_TIMEOUT_S)
+    stall_s = _knob(test, "stall_s", "JEPSEN_TPU_STALL_S", DEFAULT_STALL_S)
+
     # telemetry: instruments fetched ONCE before the loop, then driven
     # through the single-writer fast paths (cell/observer — only this
     # scheduler thread mutates them, so no per-op lock). When disabled
@@ -232,11 +448,39 @@ def run(test: dict) -> list[dict]:
         "interpreter_crashed_ops_total",
         "client ops that crashed to :info (process renumbered)",
         labels=("f",))
+    m_timeouts = reg.counter(
+        "interpreter_op_timeouts_total",
+        "in-flight ops reaped at their deadline (:info synthesized)",
+        labels=("f",))
+    zombies_gauge = reg.gauge(
+        "interpreter_zombie_workers",
+        "deadline-reaped workers whose late completion has not arrived "
+        "yet (drain/shutdown abandons are counted separately)")
+    m_late = reg.counter(
+        "interpreter_late_completions_total",
+        "stale-generation completions quarantined to late.jsonl")
+    m_abandoned = reg.counter(
+        "interpreter_abandoned_workers_total",
+        "workers abandoned at shutdown (still busy past the drain/join "
+        "bounds)")
     lat_obs: dict = {}       # f -> bound observe closure
     ops_cells: dict = {}     # f -> counter cell
     invoke_at: dict = {}     # thread -> dispatch time (relative nanos)
+    inflight: dict = {}      # thread -> its in-flight history-bound op
+    deadlines: dict = {}     # thread -> (deadline rel-nanos, timeout_s)
+    zombies: list = []       # reaped records (their threads self-close)
     inflight_n = 0
     completion_i = 0
+
+    # late.jsonl: core.run installs a ForensicLog; a standalone run
+    # builds its own lazily when the test map has store coordinates
+    late_log = test.get("_late")
+    own_late = False
+    activity = [_time.monotonic()]
+    # the probe reads the scheduler-owned dict without a lock: a racy
+    # truthiness check is fine for a detector that only ever logs
+    watchdog = _StallWatchdog(test, stall_s, activity,
+                              inflight_probe=lambda: bool(invoke_at)).start()
 
     def thread_of(process):
         return NEMESIS if process == NEMESIS else ctx.thread_of(process)
@@ -254,8 +498,12 @@ def run(test: dict) -> list[dict]:
             history.append(completion)
             if journal is not None:
                 journal.append(completion)
+            # dispatch-time tracking is unconditional: the deadline layer
+            # needs it whether or not metrics are on
+            t0 = invoke_at.pop(thread, None)
+            inflight.pop(thread, None)
+            deadlines.pop(thread, None)
             if metrics_on:
-                t0 = invoke_at.pop(thread, None)
                 if t0 is not None:
                     f = completion.get("f")
                     obs = lat_obs.get(f)
@@ -278,43 +526,143 @@ def run(test: dict) -> list[dict]:
         ctx = ctx.free_thread(thread)
         return thread
 
+    def quarantine(wid, payload) -> None:
+        """A stale-generation completion: the zombie finally unblocked.
+        Its synthesized :info already stands in the history, so this one
+        is written to the late.jsonl forensic artifact instead — never
+        appended to history, never journaled."""
+        nonlocal late_log, own_late
+        if metrics_on:
+            zombies_gauge.dec()
+        if not goes_in_history(payload):
+            return
+        if metrics_on:
+            m_late.inc()
+        logger.info("quarantined late completion from zombie worker %s "
+                    "(f=%r)", wid, payload.get("f"))
+        if late_log is None and not own_late:
+            own_late = True  # only try to build one once
+            try:
+                late_log = journal_mod.ForensicLog(
+                    journal_mod.late_path(test))
+            except Exception:  # noqa: BLE001 — bare test map, no store
+                logger.debug("no store dir for late.jsonl", exc_info=True)
+        if late_log is not None:
+            late_log.append({**payload, "late": True, "worker": wid,
+                             "time": relative_time_nanos()})
+
+    def on_item(item) -> None:
+        """Routes one completion-queue item: current-generation
+        completions advance the run; stale ones are quarantined; stale
+        exit markers (a zombie dying) are dropped."""
+        wid, gen_, payload = item
+        activity[0] = _time.monotonic()
+        if gen_ != workers[wid]["gen"]:
+            if payload is not _EXIT:
+                quarantine(wid, payload)
+            return
+        if payload is _EXIT:
+            return  # only drain/shutdown send exits to live workers
+        process_completion(payload)
+
+    def zombify(w) -> None:
+        """The one way a worker is given up on: mark it, leave an exit
+        marker so a racing completion can't strand it on a dead queue,
+        and put it on the books. The zombie closes its own client and
+        exits when it unblocks."""
+        w["zombied"].set()
+        try:
+            w["in"].put_nowait(_EXIT)
+        except queue.Full:
+            pass
+        zombies.append(w)
+
+    def reap(thread, error) -> None:
+        """Deadline expiry: zombifies ``thread``'s worker, synthesizes
+        the indeterminate :info completion for its in-flight op (which
+        journals and renumbers like any crash), and spawns a replacement
+        under a bumped generation. The zombie's client is closed by the
+        zombie's own thread when it unblocks — never here. Deadlines are
+        registered only for history-bound ops, so the in-flight op is
+        always present (pseudo-ops never reap)."""
+        w = workers[thread]
+        zombify(w)
+        op = inflight[thread]
+        deadlines.pop(thread, None)
+        workers[thread] = _spawn_worker(test, thread, completions,
+                                        generation=w["gen"] + 1)
+        if metrics_on:
+            m_timeouts.inc(f=str(op.get("f")))
+            zombies_gauge.inc()
+        logger.warning(
+            "op deadline expired on worker %s (f=%r); synthesizing :info "
+            "and spawning replacement generation %d", thread, op.get("f"),
+            w["gen"] + 1)
+        process_completion({**op, "type": "info", "error": error})
+
+    def expire_deadlines(now_ns) -> list:
+        """Reaps every thread whose per-op deadline has passed; returns
+        the reaped thread ids."""
+        expired = [(t, s) for t, (d, s) in list(deadlines.items())
+                   if d <= now_ns]
+        for t, timeout_s in expired:
+            reap(t, ["op-timeout", timeout_s])
+        return [t for t, _ in expired]
+
+    def earliest_deadline_wait(now_ns) -> float | None:
+        if not deadlines:
+            return None
+        ddl = min(d for d, _ in deadlines.values())
+        return max((ddl - now_ns) / 1e9, 0.0)
+
     try:
         # main scheduling loop (interpreter.clj:206-292)
         while True:
-            # 1. drain any ready completion
+            # 1. drain any ready completion — BEFORE the deadline check:
+            # a completion that already arrived beat its deadline and
+            # must never be falsely reaped
             try:
-                _, completion = completions.get_nowait()
-                process_completion(completion)
+                on_item(completions.get_nowait())
                 continue
             except queue.Empty:
                 pass
-            # 2. ask the generator
             now = relative_time_nanos()
+            if deadlines and expire_deadlines(now):
+                continue
+            # 2. ask the generator
             ctx = ctx.with_time(now)
             res = gen.op(test, ctx) if gen is not None else None
             if res is None:
                 break  # exhausted -> drain
             op, gen2 = res
+            ddl_wait = earliest_deadline_wait(now)
             if op is PENDING:
                 gen = gen2
                 # nothing soon: block briefly on completions
                 # (max-pending-interval, interpreter.clj:166-170,264)
+                wait_s = MAX_PENDING_INTERVAL_S
+                if ddl_wait is not None:
+                    wait_s = min(wait_s, ddl_wait)
                 try:
-                    _, completion = completions.get(timeout=MAX_PENDING_INTERVAL_S)
-                    process_completion(completion)
+                    on_item(completions.get(timeout=wait_s))
                 except queue.Empty:
                     pass
                 continue
             if op["time"] > now:
                 # future-dated: wait for its time, but a completion may
                 # change the schedule — reconsult the (old) generator
-                # (interpreter.clj:268-275)
+                # (interpreter.clj:268-275); an in-flight deadline may
+                # fire first, so never sleep past it
+                full_wait = (op["time"] - now) / 1e9
+                wait_s = full_wait
+                if ddl_wait is not None:
+                    wait_s = min(wait_s, ddl_wait)
                 try:
-                    _, completion = completions.get(timeout=(op["time"] - now) / 1e9)
-                    process_completion(completion)
+                    on_item(completions.get(timeout=wait_s))
                     continue
                 except queue.Empty:
-                    pass
+                    if wait_s < full_wait:
+                        continue  # woke for a deadline, not the op time
             # dispatch
             gen = gen2
             now = relative_time_nanos()
@@ -322,12 +670,23 @@ def run(test: dict) -> list[dict]:
             thread = thread_of(op.get("process"))
             workers[thread]["in"].put(op)
             ctx = ctx.busy_thread(thread).with_time(now)
+            activity[0] = _time.monotonic()
             if goes_in_history(op):
                 history.append(op)
                 if journal is not None:
                     journal.append(op)
+                invoke_at[thread] = now
+                inflight[thread] = op
+                timeout_s = op.get("timeout_s", _UNSET)
+                if timeout_s is _UNSET:
+                    timeout_s = default_timeout_s
+                else:
+                    timeout_s = _coerce_timeout(timeout_s,
+                                                default_timeout_s)
+                if timeout_s:
+                    deadlines[thread] = (now + int(timeout_s * 1e9),
+                                         timeout_s)
                 if metrics_on:
-                    invoke_at[thread] = now
                     inflight_n += 1
                     inflight_cell[0] = inflight_n
                     f = op.get("f")
@@ -339,29 +698,120 @@ def run(test: dict) -> list[dict]:
                     gen = gen.update(test, ctx, op)
 
         # drain: free workers exit now; busy workers exit after completing
-        # (interpreter.clj:250-261)
+        # (interpreter.clj:250-261). The whole phase runs under its own
+        # deadline so one stuck op or hung client close can't wedge
+        # teardown — the run must always reach its checker.
+        drain_deadline = (_time.monotonic() + drain_timeout_s
+                          if drain_timeout_s else None)
         pending_exits = set(workers)
+        reaped_in_drain: set = set()
         for t in ctx.free_threads:
             workers[t]["in"].put(_EXIT)
         while pending_exits:
-            wid, completion = completions.get()
-            if completion is _EXIT:
+            now = relative_time_nanos()
+            wait_s = DRAIN_POLL_S
+            ddl_wait = earliest_deadline_wait(now)
+            if ddl_wait is not None:
+                wait_s = min(wait_s, ddl_wait)
+            if drain_deadline is not None:
+                wait_s = min(wait_s,
+                             max(drain_deadline - _time.monotonic(), 0.0))
+            try:
+                wid, gen_, payload = completions.get(timeout=wait_s)
+            except queue.Empty:
+                just_reaped = expire_deadlines(relative_time_nanos())
+                reaped_in_drain.update(just_reaped)
+                for t in just_reaped:
+                    # the replacement worker is idle: release it
+                    workers[t]["in"].put(_EXIT)
+                if (drain_deadline is not None
+                        and _time.monotonic() >= drain_deadline):
+                    # drain deadline: synthesize :info for whatever is
+                    # still stuck, abandon the stragglers, proceed
+                    for swid in sorted(pending_exits, key=str):
+                        if swid in reaped_in_drain:
+                            # a per-op deadline already handled it
+                            # during this drain: the fresh replacement
+                            # is exiting cleanly, not stuck — don't
+                            # zombify or count it
+                            continue
+                        w = workers[swid]
+                        if not w["thread"].is_alive():
+                            continue
+                        zombify(w)
+                        sop = inflight.get(swid)
+                        deadlines.pop(swid, None)
+                        if metrics_on:
+                            # the abandon counter, not the zombie gauge:
+                            # same-generation abandons have no stale
+                            # completion to decrement on, so the gauge
+                            # would drift for a thread that does return
+                            m_abandoned.inc()
+                        logger.warning(
+                            "drain deadline expired; abandoning worker "
+                            "%s (%s)", swid,
+                            f"f={sop.get('f')!r}" if sop is not None
+                            else "no history-bound op in flight")
+                        if sop is not None:
+                            process_completion(
+                                {**sop, "type": "info",
+                                 "error": ["op-timeout", "drain-deadline"]})
+                    break
+                continue
+            activity[0] = _time.monotonic()
+            if gen_ != workers[wid]["gen"]:
+                if payload is not _EXIT:
+                    quarantine(wid, payload)
+                continue
+            if payload is _EXIT:
                 pending_exits.discard(wid)
                 continue
-            thread = process_completion(completion)
+            thread = process_completion(payload)
             workers[thread]["in"].put(_EXIT)
     finally:
-        # abnormal shutdown: make sure worker threads die and clients close
-        # (interpreter.clj:294-309)
+        watchdog.stop()
+        # shutdown: every live worker gets an exit marker; one too busy
+        # to take it is abandoned EXPLICITLY below — zombie-marked,
+        # counted, logged — never silently leaked (interpreter.clj:294-309)
         for w in workers.values():
+            if not w["thread"].is_alive():
+                continue
             try:
                 w["in"].put_nowait(_EXIT)
             except queue.Full:
                 pass
+        join_deadline = _time.monotonic() + SHUTDOWN_JOIN_S
         for w in workers.values():
+            if w["zombied"].is_set():
+                continue  # a known zombie: never wait on a hung thread
+            w["thread"].join(
+                timeout=max(join_deadline - _time.monotonic(), 0.0))
+        for w in workers.values():
+            if w["thread"].is_alive():
+                if not w["zombied"].is_set():
+                    # still mid-op after the bounded join: make the
+                    # abandonment explicit; the worker closes its own
+                    # client when it unblocks
+                    zombify(w)
+                    if metrics_on:
+                        m_abandoned.inc()
+                    logger.warning(
+                        "worker %s still busy at shutdown; abandoned "
+                        "(its client closes on its own thread when it "
+                        "unblocks)", w["id"])
+                continue
+            # the thread exited, so it already closed its own client;
+            # this is a safety net for a thread that died some other
+            # way — with the thread gone, the close cannot race an
+            # in-flight invoke
             try:
                 if isinstance(w["worker"], ClientWorker):
                     w["worker"].close(test)
             except Exception:  # noqa: BLE001
                 pass
+        if zombies:
+            logger.info("run finished with %d zombie/abandoned worker(s) "
+                        "on the books", len(zombies))
+        if own_late and late_log is not None:
+            late_log.close()
     return history

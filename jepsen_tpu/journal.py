@@ -35,6 +35,7 @@ from pathlib import Path
 logger = logging.getLogger("jepsen.journal")
 
 WAL_NAME = "history.wal.jsonl"
+LATE_NAME = "late.jsonl"
 DEFAULT_FSYNC_INTERVAL_S = 1.0
 
 
@@ -116,6 +117,59 @@ class Journal:
                 logger.exception("couldn't discard WAL %s", self.path)
 
 
+class ForensicLog:
+    """Lazily-opened append-only jsonl for forensic artifacts — the
+    quarantined-late-completion log (``late.jsonl``) the interpreter's
+    deadline layer writes when a reaped zombie worker finally returns
+    (doc/robustness.md). Same never-raise contract as :class:`Journal`:
+    a forensic artifact must not take down the run it documents. The
+    file is only created on first append, so clean runs leave no empty
+    artifacts behind."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self._f = None
+        self._broken = False
+        self._lock = threading.Lock()
+        self.appended = 0
+
+    def append(self, row: dict) -> None:
+        from jepsen_tpu.store import _serializable
+        try:
+            line = json.dumps(_serializable(row)) + "\n"
+        except Exception:  # noqa: BLE001 — forensics never kill a run
+            logger.exception("unserializable row dropped from %s",
+                             self.path.name)
+            return
+        with self._lock:
+            if self._broken:
+                return
+            try:
+                if self._f is None:
+                    self.path.parent.mkdir(parents=True, exist_ok=True)
+                    self._f = open(self.path, "a", encoding="utf-8")
+                self._f.write(line)
+                self._f.flush()
+                self.appended += 1
+            except OSError:
+                logger.exception("forensic log %s failed; disabled",
+                                 self.path)
+                self._broken = True
+                if self._f is not None:
+                    try:
+                        self._f.close()
+                    except OSError:
+                        pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                try:
+                    self._f.close()
+                except OSError:
+                    logger.exception("forensic log close failed")
+
+
 def read_jsonl_tolerant(path) -> tuple[list[dict], bool]:
     """Parses a jsonl file, tolerating the torn final line a crash (or a
     file-truncate nemesis aimed at ourselves) leaves behind. Returns
@@ -152,3 +206,8 @@ def read_wal(path) -> tuple[list[dict], bool]:
 def wal_path(test: dict):
     from jepsen_tpu import store
     return store.path(test, WAL_NAME)
+
+
+def late_path(test: dict):
+    from jepsen_tpu import store
+    return store.path(test, LATE_NAME)

@@ -24,6 +24,14 @@ Registry rows: ``{"op": "inject", "id": n, "kind": ..., "f": ...,
 "value": ..., "time": ...}`` and ``{"op": "heal", "id": n, "via": ...,
 "time": ...}``. The file is append-only jsonl, read with the same
 torn-tail-tolerant reader as the history WAL.
+
+Deadline interplay (doc/robustness.md): nemesis ops run under the
+interpreter's per-op deadlines too. A fault-*closing* op that outlives
+its deadline gets an indeterminate ``:info`` synthesized for it and its
+worker zombied; when the real heal eventually completes, the zombied
+``NemesisWorker`` deliberately does NOT ``mark_healed`` — the entry
+stays on the books so the crash-path replay / ``cli heal`` restores the
+network with the idempotent healers below.
 """
 from __future__ import annotations
 
@@ -134,9 +142,21 @@ class FaultRegistry:
 
     def _append(self, row: dict) -> None:
         from jepsen_tpu.store import _serializable
-        self._f.write(json.dumps(_serializable(row)) + "\n")
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        reopened = self._f.closed
+        if reopened:
+            # a LATE record — a reaped fault injection landing after the
+            # run closed the registry (interpreter zombie thread) — must
+            # still reach the durable log: it may be the only evidence
+            # the cluster is dirty. Append-only jsonl makes a one-shot
+            # reopen safe.
+            self._f = open(self.path, "a", encoding="utf-8")
+        try:
+            self._f.write(json.dumps(_serializable(row)) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        finally:
+            if reopened:
+                self._f.close()
 
     def record(self, kind: str, f=None, value: Any = None) -> int:
         """Durably records an injection BEFORE it happens; returns the
@@ -194,6 +214,19 @@ class FaultRegistry:
         if reg.enabled:
             reg.counter(metric, "durable fault-registry entries",
                         labels=("kind",)).inc(kind=str(kind))
+
+
+def actionable_unhealed(registry: FaultRegistry) -> tuple[list[dict],
+                                                          list[dict]]:
+    """Splits the registry's unhealed entries into ``(actionable,
+    evidence)`` — *evidence* being :data:`UNHEALABLE_KINDS` rows (file
+    damage), which a crash-path replay should report, never retry."""
+    pending = registry.unhealed()
+    actionable = [r for r in pending
+                  if str(r.get("kind")) not in UNHEALABLE_KINDS]
+    evidence = [r for r in pending
+                if str(r.get("kind")) in UNHEALABLE_KINDS]
+    return actionable, evidence
 
 
 class Unhealable(Exception):

@@ -33,6 +33,11 @@ NONSERIALIZABLE_KEYS = {
 TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl")
 PROFILE_DIR = "profile"
 
+# Robustness forensics (doc/robustness.md): completions quarantined
+# from reaped zombie workers, and the stall watchdog's thread-stack
+# dumps. Present only when the run actually produced them.
+FORENSIC_FILES = ("late.jsonl", "stall-threads.txt")
+
 
 def telemetry_artifacts(run_dir: Path) -> dict:
     """{artifact-name: Path} for the telemetry files present in a stored
@@ -45,6 +50,17 @@ def telemetry_artifacts(run_dir: Path) -> dict:
     p = Path(run_dir) / PROFILE_DIR
     if p.is_dir():
         out[PROFILE_DIR] = p
+    return out
+
+
+def forensic_artifacts(run_dir: Path) -> dict:
+    """{artifact-name: Path} for the robustness forensics present in a
+    stored run directory (late.jsonl / stall-threads.txt)."""
+    out: dict[str, Path] = {}
+    for name in FORENSIC_FILES:
+        p = Path(run_dir) / name
+        if p.is_file():
+            out[name] = p
     return out
 
 

@@ -570,6 +570,34 @@ class Flusher:
 
 
 # ---------------------------------------------------------------------------
+# Thread-stack forensics
+# ---------------------------------------------------------------------------
+
+def dump_thread_stacks(target) -> bool:
+    """All-threads stack dump via ``faulthandler`` into ``target`` — a
+    path (appended, with a timestamp header) or an open file object with
+    a real file descriptor. The interpreter's stall watchdog and the
+    tier-1 budget guard both use this so a wedged run/session leaves
+    *where every thread was stuck* on disk instead of nothing. Returns
+    True on success; never raises."""
+    import faulthandler
+    try:
+        if hasattr(target, "write"):
+            faulthandler.dump_traceback(file=target, all_threads=True)
+            return True
+        p = Path(target)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(f"\n==== thread stacks @ {time.time():.3f} ====\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+        return True
+    except Exception:  # noqa: BLE001 — a diagnostic must never raise
+        logger.exception("thread-stack dump failed")
+        return False
+
+
+# ---------------------------------------------------------------------------
 # Nemesis fault-window classification
 # ---------------------------------------------------------------------------
 

@@ -112,6 +112,23 @@ def _metrics_table(path: Path) -> str:
             "</tr>" + "".join(cells) + "</table>" + extra)
 
 
+def _forensics_section(rel: str, target: Path) -> str:
+    """Links a run's robustness forensics — late.jsonl (completions
+    quarantined from reaped zombie workers) and stall-threads.txt (the
+    stall watchdog's stack dumps) — from the run page. Empty string when
+    the run has none (the common, healthy case)."""
+    arts = store.forensic_artifacts(target)
+    if not arts:
+        return ""
+    base = rel.rstrip("/")
+    links = " ".join(
+        f"<a href='/{base}/{html.escape(name)}'>{html.escape(name)}</a>"
+        for name in sorted(arts))
+    return ("<h2>robustness forensics</h2><p>" + links +
+            " — quarantined late completions / stall stack dumps "
+            "(doc/robustness.md)</p>")
+
+
 def _elle_section(rel: str, target: Path) -> str:
     """Links a run's elle/ anomaly artifacts (per-anomaly-type
     explanation files the txn checkers write on invalid results) from
@@ -178,7 +195,8 @@ class Handler(BaseHTTPRequestHandler):
                     valid, "valid-unknown")
                 badge = (" <span class='badge-incomplete'>incomplete"
                          "</span>" if incomplete else "")
-                arts = store.telemetry_artifacts(run_dir)
+                arts = {**store.telemetry_artifacts(run_dir),
+                        **store.forensic_artifacts(run_dir)}
                 links = " ".join(
                     f"<a href='/{name}/{ts}/{a}{'/' if a == store.PROFILE_DIR else ''}'>"
                     f"{html.escape(a)}</a>"
@@ -206,6 +224,7 @@ class Handler(BaseHTTPRequestHandler):
                 for p in sorted(target.iterdir()))
             metrics = _metrics_table(target / "metrics.json")
             elle = _elle_section(rel, target)
+            forensics = _forensics_section(rel, target)
             banner = ""
             if (target / "results.json").exists() or \
                     (target / "history.wal.jsonl").exists():
@@ -217,7 +236,8 @@ class Handler(BaseHTTPRequestHandler):
                               "the write-ahead journal via "
                               "<code>analyze --recover</code></p>")
             return self._send(
-                self._page(rel, f"{banner}{elle}{metrics}<ul>{items}</ul>"))
+                self._page(rel, f"{banner}{forensics}{elle}{metrics}"
+                                f"<ul>{items}</ul>"))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"

@@ -61,14 +61,33 @@ def _is_quick_lane(config) -> bool:
 
 def pytest_configure(config):
     config._jepsen_session_t0 = _time_mod.monotonic()
+    if TIER1_BUDGET_S > 0 and _is_quick_lane(config):
+        # A WEDGED session never reaches sessionfinish — the driver's
+        # outer `timeout` kills it with no diagnostics. Arm faulthandler
+        # to dump every thread's stack at the budget mark, so CI logs
+        # show where the wedge is instead of nothing (doc/robustness.md).
+        import faulthandler
+        try:
+            faulthandler.dump_traceback_later(
+                TIER1_BUDGET_S, file=sys.__stderr__)
+            config._jepsen_dump_armed = True
+        except Exception:  # noqa: BLE001 — diagnostics never break a run
+            pass
 
 
 def pytest_sessionfinish(session, exitstatus):
+    if getattr(session.config, "_jepsen_dump_armed", False):
+        import faulthandler
+        faulthandler.cancel_dump_traceback_later()
     if TIER1_BUDGET_S <= 0 or not _is_quick_lane(session.config):
         return
     elapsed = _time_mod.monotonic() - session.config._jepsen_session_t0
     if elapsed > TIER1_BUDGET_S:
         import pytest
+        # over budget but not wedged: dump what is still running anyway
+        # (a lingering thread is usually the creep's cause), then fail
+        from jepsen_tpu.telemetry import dump_thread_stacks
+        dump_thread_stacks(sys.__stderr__)
         # pytest.exit from sessionfinish is the supported way to force
         # the exit status (wrap_session catches exit.Exception here)
         pytest.exit(

@@ -1,0 +1,500 @@
+"""Per-op deadlines, zombie-worker reaping, and wedge-proof shutdown
+(doc/robustness.md).
+
+The hang-injection tests carry the ``chaos`` marker and assert tight
+absolute wall-clock bounds: a regression in the deadline layer must fail
+fast here, not eat the tier-1 budget by actually wedging."""
+import json
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.client import Client
+from jepsen_tpu.utils import with_relative_time
+
+
+@pytest.fixture
+def metrics_registry():
+    """A live telemetry registry installed for the test's duration."""
+    reg = telemetry.Registry()
+    prev = telemetry.install(reg)
+    try:
+        yield reg
+    finally:
+        telemetry.install(prev)
+
+
+class HangingClient(Client):
+    """Blocks in invoke (a DB behind a partition with no socket timeout)
+    on selected op values, until ``release`` is set — or forever."""
+
+    reusable = False
+
+    def __init__(self, hang_values=(), release=None, on_invoke=None):
+        self.hang_values = set(hang_values)
+        self.release = release if release is not None else threading.Event()
+        self.on_invoke = on_invoke
+        self.log: list = []
+        self._lock = threading.Lock()
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        if self.on_invoke is not None:
+            self.on_invoke(op)
+        if op.get("value") in self.hang_values:
+            self.release.wait()
+            return {**op, "type": "ok"}
+        with self._lock:
+            self.log.append(op.get("value"))
+        return {**op, "type": "ok"}
+
+    def close(self, test):
+        with self._lock:
+            self.log.append("close")
+
+
+def _run(test):
+    from jepsen_tpu.generator import interpreter
+    with with_relative_time():
+        return interpreter.run(test)
+
+
+def _writes(values):
+    return [{"f": "write", "value": v} for v in values]
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution + combinator + forensic log (quick lane, no hangs)
+# ---------------------------------------------------------------------------
+
+def test_knob_resolution(monkeypatch):
+    from jepsen_tpu.generator import interpreter as interp
+
+    env = "JEPSEN_TPU_OP_TIMEOUT_S"
+    monkeypatch.delenv(env, raising=False)
+    # default when nothing is set
+    assert interp._knob({}, "op_timeout_s", env, 600.0) == 600.0
+    # environment beats the default
+    monkeypatch.setenv(env, "12.5")
+    assert interp._knob({}, "op_timeout_s", env, 600.0) == 12.5
+    # env 0 disables
+    monkeypatch.setenv(env, "0")
+    assert interp._knob({}, "op_timeout_s", env, 600.0) is None
+    # the test map beats the environment; explicit None/0 disable
+    monkeypatch.setenv(env, "12.5")
+    assert interp._knob({"op_timeout_s": 3}, "op_timeout_s", env, 600.0) == 3.0
+    assert interp._knob({"op_timeout_s": None}, "op_timeout_s", env,
+                        600.0) is None
+    assert interp._knob({"op_timeout_s": 0}, "op_timeout_s", env,
+                        600.0) is None
+    # garbage in the environment OR the test map degrades to the
+    # default, never raises — a bad knob must not kill the run
+    monkeypatch.setenv(env, "soon")
+    assert interp._knob({}, "op_timeout_s", env, 600.0) == 600.0
+    assert interp._knob({"op_timeout_s": "1m"}, "op_timeout_s", env,
+                        600.0) == 600.0
+    assert interp._knob({"op_timeout_s": "2.5"}, "op_timeout_s", env,
+                        600.0) == 2.5
+
+
+def test_garbage_per_op_timeout_does_not_kill_run():
+    """A generator stamping a bad timeout_s must degrade to the test
+    default (warn), and a string "0" disables — never a scheduler
+    crash."""
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu.fakes import AtomClient, AtomDB
+
+    db = AtomDB()
+    ops = [{"f": "write", "value": 0, "timeout_s": "1m"},
+           {"f": "write", "value": 1, "timeout_s": "0"},
+           {"f": "write", "value": 2}]
+    test = {"concurrency": 1, "nodes": ["n1"], "client": AtomClient(db),
+            "generator": gen.clients(gen.Seq(ops)),
+            "op_timeout_s": 30.0, "drain_timeout_s": 5.0, "stall_s": 0}
+    history = _run(test)
+    assert [op["type"] for op in history
+            if op.get("type") != "invoke"] == ["ok", "ok", "ok"]
+
+
+def test_op_timeout_combinator_stamps_ops():
+    import jepsen_tpu.generator as gen
+
+    g = gen.as_gen(gen.op_timeout(1.5, gen.Seq(_writes([0]))))
+    ctx = gen.context({"concurrency": 1})
+    op, _g2 = g.op({}, ctx)
+    assert op["timeout_s"] == 1.5
+    assert op["f"] == "write"
+
+
+def test_forensic_log_lazy_create_and_roundtrip(tmp_path):
+    from jepsen_tpu.journal import ForensicLog, read_jsonl_tolerant
+
+    p = tmp_path / "sub" / "late.jsonl"
+    log = ForensicLog(p)
+    assert not p.exists()  # lazily created: clean runs leave no artifact
+    log.append({"f": "write", "value": 1, "late": True})
+    log.append({"f": "write", "value": object()})  # unserializable-ish
+    log.close()
+    log.close()  # idempotent
+    rows, truncated = read_jsonl_tolerant(p)
+    assert truncated is False
+    assert [r["value"] for r in rows][0] == 1
+    assert all(r.get("late") or isinstance(r.get("value"), str)
+               for r in rows)
+
+
+def test_cli_op_timeout_flag():
+    import argparse
+
+    from jepsen_tpu import cli
+
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    opts = p.parse_args(["--op-timeout", "2.5", "--no-ssh"])
+    test = cli.test_opts_to_test(opts, {"name": "t"})
+    assert test["op_timeout_s"] == 2.5
+    opts = p.parse_args(["--no-ssh"])
+    test = cli.test_opts_to_test(opts, {"name": "t"})
+    assert "op_timeout_s" not in test  # flag omitted: env/default applies
+
+
+# ---------------------------------------------------------------------------
+# Differential: deadlines enabled-but-untriggered == disabled
+# ---------------------------------------------------------------------------
+
+def _sequential_history(**knobs):
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu.fakes import AtomClient, AtomDB
+
+    db = AtomDB()
+    ops = []
+    for i in range(10):
+        ops.append({"f": "write", "value": i})
+        ops.append({"f": "read", "value": None})
+    test = {"concurrency": 1, "nodes": ["n1"], "client": AtomClient(db),
+            "generator": gen.clients(gen.Seq(ops)), "stall_s": 0, **knobs}
+    return _run(test)
+
+
+def test_histories_identical_deadlines_on_vs_off():
+    """The deadline layer must be invisible until it fires: the same
+    sequential workload produces the same history (modulo wall-clock
+    stamps) with deadlines armed-but-untriggered and disabled."""
+    armed = _sequential_history(op_timeout_s=30.0, drain_timeout_s=30.0)
+    off = _sequential_history(op_timeout_s=0, drain_timeout_s=0)
+    strip = [[{k: v for k, v in op.items() if k != "time"} for op in h]
+             for h in (armed, off)]
+    assert strip[0] == strip[1]
+    assert len(armed) == 40  # 20 invocations + 20 completions
+
+
+# ---------------------------------------------------------------------------
+# Chaos: hang injection
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_hung_op_times_out_and_worker_replaced(metrics_registry):
+    """One hung invoke becomes a bounded :info — op-timeout error,
+    process renumbered — and a replacement worker (bumped generation)
+    serves the rest of the schedule."""
+    import jepsen_tpu.generator as gen
+
+    client = HangingClient(hang_values={1})
+    test = {"concurrency": 1, "nodes": ["n1"], "client": client,
+            "generator": gen.clients(gen.Seq(_writes([0, 1, 2, 3]))),
+            "op_timeout_s": 0.4, "drain_timeout_s": 2.0, "stall_s": 0}
+    t0 = time.monotonic()
+    history = _run(test)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"run took {elapsed:.1f}s — deadline didn't fire"
+
+    # the hung op is exactly one indeterminate :info with the op-timeout
+    # error; it never completed ok
+    done_1 = [op for op in history
+              if op.get("value") == 1 and op.get("type") != "invoke"]
+    assert [op["type"] for op in done_1] == ["info"]
+    assert done_1[0]["error"] == ["op-timeout", 0.4]
+    # the replacement worker served the remaining ops under a renumbered
+    # process (crash semantics, interpreter.clj:142-157)
+    ok_after = [op for op in history
+                if op.get("type") == "ok" and op.get("value") in (2, 3)]
+    assert len(ok_after) == 2
+    assert all(op["process"] == 1 for op in ok_after)
+    assert client.log[:1] == [0] and set(client.log) >= {0, 2, 3}
+    reg = metrics_registry
+    assert reg.counter("interpreter_op_timeouts_total",
+                       labels=("f",)).value(f="write") == 1
+    # the zombie never returned: still on the books at run end
+    assert reg.gauge("interpreter_zombie_workers").value() == 1.0
+    assert reg.counter("interpreter_late_completions_total").value() == 0
+
+
+@pytest.mark.chaos
+def test_late_completion_quarantined(tmp_path, metrics_registry):
+    """A zombie's eventual completion is quarantined to late.jsonl —
+    counted, never appended to history — and the zombie retires."""
+    import jepsen_tpu.generator as gen
+
+    release = threading.Event()
+
+    def on_invoke(op):
+        if op.get("value") == 2:
+            release.set()  # wake the zombie while the run is still live
+
+    client = HangingClient(hang_values={1}, release=release,
+                           on_invoke=on_invoke)
+    ops = _writes([0, 1, 2]) + [{"type": "sleep", "value": 0.4}] \
+        + _writes([3])
+    test = {"concurrency": 1, "nodes": ["n1"], "client": client,
+            "generator": gen.clients(gen.Seq(ops)),
+            "op_timeout_s": 0.4, "drain_timeout_s": 2.0, "stall_s": 0,
+            "name": "late", "start_time": "20260803T000000.000",
+            "store_dir": str(tmp_path)}
+    t0 = time.monotonic()
+    history = _run(test)
+    assert time.monotonic() - t0 < 6.0
+
+    # history holds exactly the synthesized :info for the hung op —
+    # the late ok is NOT there
+    done_1 = [op for op in history
+              if op.get("value") == 1 and op.get("type") != "invoke"]
+    assert [op["type"] for op in done_1] == ["info"]
+    late_file = tmp_path / "late" / "20260803T000000.000" / "late.jsonl"
+    assert late_file.exists()
+    rows = [json.loads(line) for line in
+            late_file.read_text().splitlines()]
+    assert len(rows) == 1
+    assert rows[0]["value"] == 1 and rows[0]["type"] == "ok"
+    assert rows[0]["late"] is True
+    reg = metrics_registry
+    assert reg.counter("interpreter_late_completions_total").value() == 1
+    # the zombie delivered its one op and retired: gauge back to zero
+    assert reg.gauge("interpreter_zombie_workers").value() == 0.0
+
+
+@pytest.mark.chaos
+def test_per_op_timeout_overrides_test_default(metrics_registry):
+    """An op-level timeout_s (gen.op_timeout) beats the generous test
+    default — and the per-op deadline also fires inside the drain."""
+    import jepsen_tpu.generator as gen
+
+    client = HangingClient(hang_values={0})
+    test = {"concurrency": 1, "nodes": ["n1"], "client": client,
+            "generator": gen.clients(
+                gen.op_timeout(0.3, gen.Seq(_writes([0])))),
+            "op_timeout_s": 60.0, "drain_timeout_s": 5.0, "stall_s": 0}
+    t0 = time.monotonic()
+    history = _run(test)
+    assert time.monotonic() - t0 < 4.0
+    infos = [op for op in history if op.get("type") == "info"]
+    assert len(infos) == 1
+    assert infos[0]["error"] == ["op-timeout", 0.3]
+
+
+@pytest.mark.chaos
+def test_drain_deadline_abandons_stuck_op(metrics_registry):
+    """With per-op deadlines disabled, the drain deadline alone unwedges
+    shutdown: the stuck op gets a drain-deadline :info and the worker is
+    abandoned explicitly."""
+    import jepsen_tpu.generator as gen
+
+    client = HangingClient(hang_values={1})
+    test = {"concurrency": 1, "nodes": ["n1"], "client": client,
+            "generator": gen.clients(gen.Seq(_writes([0, 1]))),
+            "op_timeout_s": 0, "drain_timeout_s": 0.5, "stall_s": 0}
+    t0 = time.monotonic()
+    history = _run(test)
+    assert time.monotonic() - t0 < 5.0
+    done_1 = [op for op in history
+              if op.get("value") == 1 and op.get("type") != "invoke"]
+    assert [op["type"] for op in done_1] == ["info"]
+    assert done_1[0]["error"] == ["op-timeout", "drain-deadline"]
+    reg = metrics_registry
+    assert reg.counter("interpreter_abandoned_workers_total").value() >= 1
+
+
+@pytest.mark.chaos
+def test_stall_detector_dumps_thread_stacks(tmp_path, metrics_registry):
+    """No dispatch and no completion for stall_s: the watchdog emits a
+    telemetry event and dumps every thread's stack into the store dir."""
+    import jepsen_tpu.generator as gen
+
+    client = HangingClient(hang_values={1})
+    test = {"concurrency": 1, "nodes": ["n1"], "client": client,
+            "generator": gen.clients(gen.Seq(_writes([0, 1]))),
+            "op_timeout_s": 0, "drain_timeout_s": 1.5, "stall_s": 0.25,
+            "name": "stall", "start_time": "20260803T000001.000",
+            "store_dir": str(tmp_path)}
+    t0 = time.monotonic()
+    _run(test)
+    assert time.monotonic() - t0 < 6.0
+    dump = tmp_path / "stall" / "20260803T000001.000" / "stall-threads.txt"
+    assert dump.exists()
+    text = dump.read_text()
+    assert "thread stacks @" in text
+    # the hung worker's stack is in the dump: it's parked in this file's
+    # HangingClient.invoke (faulthandler prints files, not thread names)
+    assert "test_deadline.py" in text
+    reg = metrics_registry
+    assert reg.counter("interpreter_stalls_total").value() >= 1
+    events = [r for r in reg.snapshot() if r.get("type") == "event"
+              and r.get("name") == "interpreter-stall"]
+    assert events
+
+
+@pytest.mark.chaos
+def test_timed_out_fault_closing_op_stays_unhealed(tmp_path,
+                                                   metrics_registry):
+    """A fault-closing nemesis op that outlives its deadline must NOT
+    mark the fault healed — not when reaped, and not when the hung heal
+    eventually returns — so the idempotent replay can restore the
+    network."""
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu.net import NoopNet
+    from jepsen_tpu.nemesis.faults import FaultRegistry, replay_unhealed
+
+    release = threading.Event()
+
+    class HangingHealNemesis:
+        def invoke(self, test, op):
+            if op.get("f") == "stop-partition":
+                release.wait()
+            return {**op, "type": "info"}
+
+    registry = FaultRegistry(tmp_path / "faults.jsonl")
+    test = {"concurrency": 1, "nodes": ["n1"], "client": None,
+            "nemesis": HangingHealNemesis(), "_faults": registry,
+            "generator": gen.nemesis_gen(gen.Seq([
+                {"type": "info", "f": "start-partition", "value": None},
+                {"type": "info", "f": "stop-partition", "value": None},
+            ])),
+            "op_timeout_s": 0.4, "drain_timeout_s": 2.0, "stall_s": 0}
+    t0 = time.monotonic()
+    history = _run(test)
+    assert time.monotonic() - t0 < 5.0
+    timeouts = [op for op in history
+                if (op.get("error") or [None])[0] == "op-timeout"]
+    assert [op["f"] for op in timeouts] == ["stop-partition"]
+    assert [r["kind"] for r in registry.unhealed()] == ["net"]
+
+    # the hung heal completes LATE: the zombied NemesisWorker must still
+    # refuse to mark it healed
+    release.set()
+    time.sleep(0.3)
+    assert [r["kind"] for r in registry.unhealed()] == ["net"]
+
+    # ... which is exactly what the crash-path / cli-heal replay is for
+    heal_test = {"nodes": ["n1", "n2"], "ssh": {"dummy": True},
+                 "net": NoopNet()}
+    out = replay_unhealed(heal_test, registry)
+    assert len(out["healed"]) == 1 and heal_test["_net_log"] == [("heal",)]
+    assert registry.unhealed() == []
+    registry.close()
+
+
+@pytest.mark.chaos
+def test_late_fault_opening_injection_rerecorded(tmp_path,
+                                                 metrics_registry):
+    """A fault-*opening* op whose injection lands after its deadline is
+    re-recorded: a same-kind closing op may have marked the pre-recorded
+    entry healed in the meantime, and the late injection must not leave
+    the cluster faulted with a clean-looking registry."""
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu.nemesis.faults import FaultRegistry
+
+    release = threading.Event()
+
+    class HangingInjectNemesis:
+        def invoke(self, test, op):
+            if op.get("f") == "start-partition":
+                release.wait()  # the injection is stuck mid-SSH
+            return {**op, "type": "info"}
+
+    registry = FaultRegistry(tmp_path / "faults.jsonl")
+    test = {"concurrency": 1, "nodes": ["n1"], "client": None,
+            "nemesis": HangingInjectNemesis(), "_faults": registry,
+            "generator": gen.nemesis_gen(gen.Seq([
+                {"type": "info", "f": "start-partition", "value": None},
+                {"type": "info", "f": "stop-partition", "value": None},
+            ])),
+            "op_timeout_s": 0.4, "drain_timeout_s": 2.0, "stall_s": 0}
+    t0 = time.monotonic()
+    _run(test)
+    assert time.monotonic() - t0 < 5.0
+    # the replacement worker's stop-partition marked the pre-recorded
+    # injection healed — at this point the registry looks clean
+    assert registry.unhealed() == []
+    # the run ends and closes the registry (as core.run's finally does)
+    # BEFORE the hung injection actually fires: the late record must
+    # still reach the durable log — it is the only evidence the
+    # cluster is dirty
+    registry.close()
+    release.set()
+    time.sleep(0.3)
+    reopened = FaultRegistry(tmp_path / "faults.jsonl")
+    assert [r["kind"] for r in reopened.unhealed()] == ["net"]
+    reopened.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the acceptance scenario end to end through core.run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_full_run_with_forever_hung_client_finishes(tmp_path):
+    """A run whose client hangs forever on one op still finishes end to
+    end — history checked, nemesis fault healed, store written — within
+    op_timeout + drain deadline of the hang, with the op recorded as
+    :info [op-timeout ...] and the timeout/zombie metrics exported."""
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu import core
+    from jepsen_tpu import nemesis as nem
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.fakes import noop_test
+    from jepsen_tpu.nemesis.faults import FaultRegistry
+
+    client = HangingClient(hang_values={3})
+    g = gen.Seq([
+        gen.nemesis_gen(gen.Seq([
+            {"type": "info", "f": "start-partition", "value": None},
+            {"type": "info", "f": "stop-partition", "value": None},
+        ])),
+        gen.clients(gen.Seq(_writes([0, 1, 2, 3, 4, 5]))),
+    ])
+    t = noop_test(client=client, nemesis=nem.partitioner(), generator=g,
+                  checker=linearizable(accelerator="cpu"),
+                  store_dir=str(tmp_path), op_timeout_s=1.0,
+                  drain_timeout_s=2.0, stall_s=0, time_limit=30.0)
+    t0 = time.monotonic()
+    result = core.run(t)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30.0, f"run took {elapsed:.1f}s — wedged?"
+
+    assert result["results"]["valid?"] is True
+    run_dirs = list(tmp_path.glob("noop/2*"))
+    assert len(run_dirs) == 1
+    run_dir = run_dirs[0]
+    assert (run_dir / "results.json").exists()
+    history = [json.loads(line) for line in
+               (run_dir / "history.jsonl").read_text().splitlines()]
+    timeouts = [op for op in history
+                if (op.get("error") or [None])[0] == "op-timeout"]
+    assert len(timeouts) == 1 and timeouts[0]["value"] == 3
+    assert timeouts[0]["type"] == "info"
+    # the nemesis window closed cleanly: nothing left for a replay
+    freg = FaultRegistry(run_dir / "faults.jsonl")
+    assert freg.unhealed() == []
+    freg.close()
+    # the run's exported metrics reflect the reap
+    rows = [json.loads(line) for line in
+            (run_dir / "metrics.json").read_text().splitlines()]
+    by_name = {}
+    for r in rows:
+        if r.get("type") in ("counter", "gauge"):
+            by_name[r["name"]] = by_name.get(r["name"], 0) + r["value"]
+    assert by_name.get("interpreter_op_timeouts_total") == 1
+    assert by_name.get("interpreter_zombie_workers") == 1
