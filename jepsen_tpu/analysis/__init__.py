@@ -1,0 +1,31 @@
+"""Static analysis: preflight test-spec validation + invariant linting.
+
+Jepsen's whole value proposition is catching bugs *before* production —
+this package turns that lens on the framework itself, in the spirit of
+Elle (infer anomalies from structure instead of hoping a test trips
+them) and Eraser-style lock-set race detection:
+
+* :mod:`jepsen_tpu.analysis.preflight` — validates a test map before
+  ``core.run`` touches any node: bounded symbolic enumeration of the
+  generator (via :mod:`jepsen_tpu.generator.simulate`) checks every
+  emitted ``:f`` against the client's declared op surface and every
+  nemesis ``:f`` against :func:`jepsen_tpu.nemesis.faults.classify`
+  healability, plus type/range checks on the runtime knobs and
+  checker/model compatibility. A mis-specified test fails in seconds on
+  the control node instead of minutes into cluster/TPU time.
+
+* :mod:`jepsen_tpu.analysis.lint` — an AST + call-graph linter over the
+  package itself, encoding the concurrency/durability/JAX invariants
+  that PR 1-4 reviews had to enforce by hand (lock-guarded attribute
+  mutation, scheduler/worker thread ownership, no unbounded blocking in
+  the scheduler, flush+fsync pairing, host effects under ``jit``,
+  donated-buffer reuse, recompile hazards). ``jepsen-tpu lint`` runs
+  it; a tier-1 test keeps ``jepsen_tpu/`` itself at zero non-baselined
+  findings.
+
+See doc/static-analysis.md for the rule catalog and diagnostic codes.
+"""
+from __future__ import annotations
+
+from jepsen_tpu.analysis.diagnostics import Diagnostic, Finding  # noqa: F401
+from jepsen_tpu.analysis.preflight import PreflightFailed  # noqa: F401

@@ -1,0 +1,89 @@
+"""Structured diagnostics shared by the preflight and lint engines.
+
+Both engines emit typed, machine-readable findings (stable ``code``,
+``severity``, location, fix hint) so CI can annotate and tooling can
+gate on them — mirroring how the checker returns structured anomaly
+maps instead of prose. Text rendering is ruff-style one-liners; JSON
+rendering is one object per finding (``--format=json``).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One preflight finding against a test map.
+
+    ``path`` is the test-map path the diagnostic is about (``"generator"``,
+    ``"op_timeout_s"``, ...), not a file path — a test is data, so its
+    diagnostics address data."""
+
+    code: str           # stable id, e.g. "GEN001"
+    severity: str       # error | warning | info
+    path: str           # test-map path, e.g. "generator" or "op_timeout_s"
+    message: str
+    hint: str | None = None
+
+    def render(self) -> str:
+        out = f"preflight: {self.severity}: {self.code} [{self.path}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding against a source location.
+
+    ``key()`` is the baseline identity: file + enclosing definition +
+    rule, deliberately *without* line numbers so a waiver survives
+    unrelated edits to the same file."""
+
+    rule: str           # e.g. "lock-guard"
+    code: str           # e.g. "JTL001"
+    path: str           # repo-relative file path
+    line: int
+    col: int
+    qualname: str       # enclosing function/class qualname ("<module>" at top level)
+    message: str
+    hint: str | None = None
+    severity: str = ERROR
+
+    def key(self) -> str:
+        return f"{self.path}::{self.qualname}::{self.rule}"
+
+    def render(self) -> str:
+        out = (f"{self.path}:{self.line}:{self.col}: {self.code} "
+               f"[{self.rule}] {self.message}")
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["key"] = self.key()
+        return d
+
+
+def sort_diagnostics(diags):
+    return sorted(diags, key=lambda d: (_SEVERITY_ORDER.get(d.severity, 9),
+                                        d.code, d.path))
+
+
+def sort_findings(findings):
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def render_json(items) -> str:
+    return "\n".join(json.dumps(x.to_json()) for x in items) + ("\n" if items else "")

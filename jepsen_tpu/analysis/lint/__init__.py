@@ -1,0 +1,225 @@
+"""The invariant linter: driver, baseline, and rendering.
+
+``lint_paths([...])`` walks the given files/directories, parses each
+module once (shared AST cache — the whole package lints in seconds),
+runs every rule, and splits the results against the checked-in baseline
+file. The baseline (``lint-baseline.txt`` next to the linted package)
+holds deliberate waivers keyed by ``path::qualname::rule`` — no line
+numbers, so entries survive unrelated edits. The tier-1 self-lint test
+fails on any non-baselined finding, which turns every future regression
+of these invariant classes into a red build instead of a review catch.
+
+CLI: ``jepsen-tpu lint [paths...] [--format=json] [--baseline FILE]
+[--update-baseline]``.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from jepsen_tpu.analysis.diagnostics import (
+    Finding, render_json, sort_findings,
+)
+from jepsen_tpu.analysis.lint import (
+    astcache, callgraph, rules_concurrency, rules_jax,
+)
+
+logger = logging.getLogger("jepsen.analysis.lint")
+
+BASELINE_NAME = "lint-baseline.txt"
+
+# (rule name, per-module fn | None, global fn | None)
+RULES = (
+    ("lock-guard", rules_concurrency.lock_guard, None),
+    ("fsync-pairing", rules_concurrency.fsync_pairing, None),
+    ("no-host-effects-in-jit", rules_jax.no_host_effects_in_jit, None),
+    ("donation-reuse", rules_jax.donation_reuse, None),
+    ("recompile-hazard", rules_jax.recompile_hazard, None),
+    ("thread-owner", None, rules_concurrency.thread_owner),
+    ("no-unbounded-block", None, rules_concurrency.no_unbounded_block),
+)
+
+RULE_NAMES = tuple(r[0] for r in RULES)
+
+
+@dataclass
+class Report:
+    findings: list = field(default_factory=list)   # actionable (not baselined)
+    baselined: list = field(default_factory=list)  # matched a waiver
+    stale_waivers: list = field(default_factory=list)  # baseline keys unmatched
+    files: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def _collect_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(f for f in p.rglob("*.py")
+                              if "__pycache__" not in f.parts))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def _guess_root(paths) -> Path:
+    """The directory findings are reported relative to (and where the
+    default baseline lives): the parent of the first linted package."""
+    first = Path(paths[0]).resolve() if paths else Path(".").resolve()
+    return first.parent if first.is_dir() else first.parent.parent
+
+
+def load_baseline(path) -> dict[str, str]:
+    """key -> raw line; tolerant of comments/blanks."""
+    out: dict[str, str] = {}
+    try:
+        for line in Path(path).read_text(encoding="utf-8").splitlines():
+            stripped = line.split("#", 1)[0].strip()
+            if stripped:
+                out[stripped] = line
+    except OSError:
+        pass
+    return out
+
+
+def write_baseline(path, findings) -> None:
+    """Regenerates the baseline from ``findings``. An entry that already
+    exists keeps its original line verbatim — the per-key WHY comment
+    the header mandates must survive a regeneration, not be flattened
+    to a bare key."""
+    existing = load_baseline(path)
+    keys = sorted({f.key() for f in findings})
+    header = ("# jepsen-tpu lint baseline — deliberate waivers, one\n"
+              "# `path::qualname::rule` key per line (no line numbers:\n"
+              "# entries survive unrelated edits). Keep this near-empty;\n"
+              "# every entry needs a comment saying WHY the invariant\n"
+              "# doesn't apply. Regenerate: jepsen-tpu lint --update-baseline\n")
+    body = "".join((existing.get(k, k)).rstrip("\n") + "\n" for k in keys)
+    Path(path).write_text(header + body, encoding="utf-8")
+
+
+def lint_paths(paths, baseline=None, root=None, rules=None) -> Report:
+    """Lints files/directories. ``baseline`` defaults to
+    ``<root>/lint-baseline.txt``; pass ``baseline=False`` to skip.
+    ``rules`` optionally restricts to a subset of rule names."""
+    paths = list(paths) or ["jepsen_tpu"]
+    unknown = set(rules or ()) - set(RULE_NAMES)
+    if unknown:
+        # a typo'd --rule must not produce a green "0 findings" run
+        raise ValueError(f"unknown lint rule(s) {sorted(unknown)}; "
+                         f"known: {', '.join(RULE_NAMES)}")
+    root = Path(root) if root is not None else _guess_root(paths)
+    files = _collect_files(paths)
+    if not files:
+        raise ValueError(f"no Python files found under {paths} — a "
+                         "mistyped path would otherwise lint nothing "
+                         "and exit green")
+    report = Report(files=len(files))
+    modules = []
+    for f in files:
+        mod = astcache.parse_module(f, root=root)
+        if mod is not None and not mod.skip:
+            modules.append(mod)
+    selected = set(rules or RULE_NAMES)
+    findings: list[Finding] = []
+    for name, per_module, _global in RULES:
+        if name not in selected or per_module is None:
+            continue
+        for mod in modules:
+            try:
+                findings.extend(per_module(mod))
+            except Exception:  # noqa: BLE001 — one bad file never kills lint
+                logger.exception("rule %s crashed on %s", name, mod.relpath)
+    global_rules = [g for name, _p, g in RULES
+                    if g is not None and name in selected]
+    if global_rules:
+        graph = callgraph.build(modules)
+        for g in global_rules:
+            try:
+                findings.extend(g(graph))
+            except Exception:  # noqa: BLE001
+                logger.exception("global rule %s crashed", g.__name__)
+
+    # dedup (two worker roots can blame the same call site)
+    seen: set = set()
+    unique: list[Finding] = []
+    for f in sort_findings(findings):
+        k = (f.path, f.line, f.col, f.rule, f.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+
+    waivers: dict[str, str] = {}
+    if baseline is not False:
+        bpath = Path(baseline) if baseline else root / BASELINE_NAME
+        waivers = load_baseline(bpath)
+    matched: set = set()
+    for f in unique:
+        if f.key() in waivers:
+            matched.add(f.key())
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    report.stale_waivers = sorted(set(waivers) - matched)
+    _record_metrics(report)
+    return report
+
+
+def _record_metrics(report: Report) -> None:
+    """``lint_findings_total{rule}`` into the installed registry, so
+    waiver growth / finding counts surface in the run's metrics exports
+    (a NULL registry makes this free)."""
+    from jepsen_tpu import telemetry
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return
+    c = reg.counter("lint_findings_total",
+                    "invariant-linter findings by rule (non-baselined)",
+                    labels=("rule",))
+    for f in report.findings:
+        c.inc(rule=f.rule)
+    b = reg.counter("lint_baselined_findings_total",
+                    "lint findings suppressed by the baseline file "
+                    "(waiver growth is a smell worth a dashboard)",
+                    labels=("rule",))
+    for f in report.baselined:
+        b.inc(rule=f.rule)
+
+
+def render_text(report: Report) -> str:
+    lines = [f.render() for f in report.findings]
+    if report.baselined:
+        lines.append(f"{len(report.baselined)} finding(s) suppressed by "
+                     "baseline")
+    if report.stale_waivers:
+        lines.append("stale baseline entries (nothing matches them — "
+                     "remove):")
+        lines.extend(f"  {k}" for k in report.stale_waivers)
+    n = len(report.findings)
+    lines.append(f"{n} finding(s) in {report.files} file(s)"
+                 if n else f"all clear: 0 findings in {report.files} "
+                           "file(s)")
+    return "\n".join(lines)
+
+
+def render_report_json(report: Report) -> str:
+    import json
+    rows = [f.to_json() for f in report.findings]
+    for f in report.baselined:
+        rows.append({**f.to_json(), "baselined": True})
+    summary = {"summary": True, "files": report.files,
+               "findings": len(report.findings),
+               "baselined": len(report.baselined),
+               "stale_waivers": report.stale_waivers}
+    return "\n".join(json.dumps(r) for r in rows + [summary]) + "\n"
+
+
+__all__ = [
+    "BASELINE_NAME", "RULE_NAMES", "Report", "lint_paths", "load_baseline",
+    "render_json", "render_report_json", "render_text", "write_baseline",
+]

@@ -1,0 +1,200 @@
+"""Parsed-module cache for the linter.
+
+Every rule shares ONE parse per file: the AST, the raw source lines,
+the comment map (``ast`` drops comments, so they come from ``tokenize``),
+and a pre-built index of function/class definitions with their
+annotations. Cached by (path, mtime, size) so the self-lint tier-1 test
+and repeated CLI runs in one process never re-parse an unchanged file —
+the whole package lints in a few seconds, comfortably inside the tier-1
+budget.
+
+Annotations are structured comments the rules consume:
+
+* ``# owner: scheduler|worker|any`` on (or directly above) a ``def`` —
+  thread-ownership for the ``thread-owner`` / ``no-unbounded-block``
+  rules.
+* ``# durability: fsync`` on a ``class`` — every writing method must
+  pair flush+fsync (``fsync-pairing``).
+* ``# lint: ignore[rule-a,rule-b]`` trailing a line — waives those
+  rules' findings on that line (on a ``def``/``class`` line: for the
+  whole definition).
+* ``# lint: skip-file`` anywhere — the file is not linted.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_OWNER_RE = re.compile(r"#\s*owner:\s*(scheduler|worker|any)\b")
+_DURABILITY_RE = re.compile(r"#\s*durability:\s*(\w+)")
+_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore\[([^\]]+)\]")
+_SKIP_FILE_RE = re.compile(r"#\s*lint:\s*skip-file\b")
+
+OWNERS = ("scheduler", "worker", "any")
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    class_name: str | None         # innermost enclosing class, if any
+    owner: str | None              # from "# owner:" annotation
+    ignores: frozenset             # rules waived for the whole definition
+    lineno: int
+    end_lineno: int
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    qualname: str
+    node: ast.ClassDef
+    durability: str | None
+    ignores: frozenset
+    bases: tuple                   # base-class name strings
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    relpath: str
+    tree: ast.Module
+    lines: list[str]               # raw source lines, 1-indexed via [i-1]
+    comments: dict[int, str]       # lineno -> full comment text
+    functions: dict[str, FuncInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    imports: dict[str, str] = field(default_factory=dict)  # alias -> module
+    import_names: dict[str, tuple] = field(default_factory=dict)
+    # import_names: local name -> (module, original_name) for from-imports
+    skip: bool = False
+
+    def line_ignores(self, lineno: int) -> frozenset:
+        """Rules waived by a trailing ``# lint: ignore[...]`` comment."""
+        return _parse_ignores(self.comments.get(lineno, ""))
+
+    def def_annotation(self, node, regex):
+        """First regex match in the comment trailing the def/class line,
+        any decorator line, or the line directly above."""
+        candidates = [node.lineno]
+        for dec in getattr(node, "decorator_list", []):
+            candidates.append(dec.lineno)
+        first = min(candidates)
+        candidates.append(first - 1)
+        for ln in candidates:
+            m = regex.search(self.comments.get(ln, ""))
+            if m:
+                return m.group(1)
+        return None
+
+    def def_ignores(self, node) -> frozenset:
+        out: set = set()
+        for ln in [node.lineno, node.lineno - 1]:
+            out |= _parse_ignores(self.comments.get(ln, ""))
+        return frozenset(out)
+
+
+def _parse_ignores(comment: str) -> frozenset:
+    m = _IGNORE_RE.search(comment or "")
+    if not m:
+        return frozenset()
+    return frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def _comment_map(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass  # a file that parses but won't tokenize cleanly: no comments
+    return out
+
+
+def _index(mod: ModuleInfo) -> None:
+    """Fills functions/classes/imports by one walk with qualname scopes."""
+
+    def visit(node, scope: str, class_name: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{scope}.{child.name}" if scope else child.name
+                owner = mod.def_annotation(child, _OWNER_RE)
+                mod.functions[q] = FuncInfo(
+                    qualname=q, node=child, class_name=class_name,
+                    owner=owner, ignores=mod.def_ignores(child),
+                    lineno=child.lineno,
+                    end_lineno=getattr(child, "end_lineno", child.lineno))
+                visit(child, q, class_name)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{scope}.{child.name}" if scope else child.name
+                bases = tuple(_base_name(b) for b in child.bases)
+                mod.classes[q] = ClassInfo(
+                    name=child.name, qualname=q, node=child,
+                    durability=mod.def_annotation(child, _DURABILITY_RE),
+                    ignores=mod.def_ignores(child), bases=bases)
+                visit(child, q, child.name)
+            elif isinstance(child, ast.Import):
+                for alias in child.names:
+                    mod.imports[alias.asname or alias.name.split(".")[0]] \
+                        = alias.name
+            elif isinstance(child, ast.ImportFrom):
+                if child.module and child.level == 0:
+                    for alias in child.names:
+                        mod.import_names[alias.asname or alias.name] = (
+                            child.module, alias.name)
+            else:
+                visit(child, scope, class_name)
+
+    visit(mod.tree, "", None)
+
+
+def _base_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+_CACHE: dict[str, tuple[tuple, ModuleInfo]] = {}
+
+
+def parse_module(path, root=None) -> ModuleInfo | None:
+    """Cached parse; None when the file doesn't parse (a syntax error is
+    a job for the test suite, not the linter)."""
+    p = Path(path)
+    try:
+        st = p.stat()
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    key = str(p.resolve())
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    try:
+        source = p.read_text(encoding="utf-8")
+        tree = ast.parse(source)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    rel = str(p)
+    if root is not None:
+        try:
+            rel = str(p.resolve().relative_to(Path(root).resolve()))
+        except ValueError:
+            rel = str(p)
+    mod = ModuleInfo(path=p, relpath=rel, tree=tree,
+                     lines=source.splitlines(),
+                     comments=_comment_map(source))
+    mod.skip = any(_SKIP_FILE_RE.search(c) for c in mod.comments.values())
+    _index(mod)
+    _CACHE[key] = (stamp, mod)
+    return mod
+
+
+def cache_info() -> dict:
+    return {"modules": len(_CACHE)}

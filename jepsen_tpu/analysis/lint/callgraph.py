@@ -1,0 +1,191 @@
+"""Lightweight intra-package call graph for reachability rules.
+
+Name-based static resolution — deliberately conservative and cheap:
+
+* ``foo(...)`` resolves through the lexical scope chain (sibling nested
+  defs, module-level functions), then ``from x import foo``.
+* ``self.m(...)`` resolves to the method in the caller's class, then its
+  named base classes (same module or from-imported).
+* ``mod.f(...)`` resolves through ``import``/``from pkg import mod``
+  aliases to the target module's top-level ``f``.
+
+Anything else (calls on locals, protocol dispatch, higher-order
+``target=fn`` references) is *unresolved* and simply absent from the
+graph. That is the right default for the thread-owner and
+no-unbounded-block rules: an edge we cannot prove is an edge we do not
+traverse, so reachability sets stay small and findings stay precise.
+A function *reference* (``Thread(target=run)``) is intentionally not an
+edge — spawning a thread is exactly where ownership changes hands.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from jepsen_tpu.analysis.lint.astcache import FuncInfo, ModuleInfo
+
+Node = tuple  # (relpath, qualname)
+
+
+@dataclass
+class CallGraph:
+    edges: dict            # Node -> list[(Node, lineno)]
+    functions: dict        # Node -> FuncInfo
+    modules: dict          # relpath -> ModuleInfo
+
+    def owner(self, node: Node) -> str | None:
+        fi = self.functions.get(node)
+        return fi.owner if fi is not None else None
+
+    def reachable(self, roots, through=None):
+        """BFS closure from ``roots``; ``through(node) -> bool`` gates
+        which nodes are expanded (the node itself is still visited).
+        Returns {node: (parent, lineno)} for path reconstruction."""
+        seen: dict = {}
+        frontier = [(r, None, 0) for r in roots]
+        while frontier:
+            node, parent, lineno = frontier.pop()
+            if node in seen:
+                continue
+            seen[node] = (parent, lineno)
+            if through is not None and not through(node) and parent is not None:
+                continue
+            for callee, ln in self.edges.get(node, ()):
+                if callee not in seen:
+                    frontier.append((callee, node, ln))
+        return seen
+
+    def path_to(self, seen: dict, node: Node) -> list[Node]:
+        out = [node]
+        while True:
+            parent = seen.get(node, (None, 0))[0]
+            if parent is None:
+                break
+            out.append(parent)
+            node = parent
+        return list(reversed(out))
+
+
+def body_calls(func_node: ast.AST):
+    """Call nodes lexically inside ``func_node``, excluding nested
+    def/class bodies (those are their own graph nodes)."""
+    out: list[ast.Call] = []
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def module_dotted(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("\\", "/").replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def build(modules: list[ModuleInfo]) -> CallGraph:
+    by_rel = {m.relpath: m for m in modules}
+    by_dotted = {module_dotted(m.relpath): m for m in modules}
+    functions: dict = {}
+    for m in modules:
+        for q, fi in m.functions.items():
+            functions[(m.relpath, q)] = fi
+
+    def mod_func(dotted: str, name: str):
+        target = by_dotted.get(dotted)
+        if target is None:
+            return None
+        if name in target.functions:
+            return (target.relpath, name)
+        return None
+
+    def resolve_class(mod: ModuleInfo, cname: str):
+        """ClassInfo for a simple class name, same module first, then
+        a from-import."""
+        for q, ci in mod.classes.items():
+            if ci.name == cname:
+                return mod, ci
+        imp = mod.import_names.get(cname)
+        if imp is not None:
+            target = by_dotted.get(imp[0])
+            if target is not None:
+                for q, ci in target.classes.items():
+                    if ci.name == imp[1]:
+                        return target, ci
+        return None, None
+
+    def resolve_method(mod: ModuleInfo, fi: FuncInfo, attr: str):
+        """self.<attr>() — caller's class, then named bases (one hop)."""
+        cls_q = fi.qualname.rsplit(".", 1)[0] if "." in fi.qualname else ""
+        # walk out to the innermost enclosing class qualname
+        parts = fi.qualname.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            cq = ".".join(parts[:i])
+            if cq in mod.classes:
+                cls_q = cq
+                break
+        else:
+            return None
+        cand = f"{cls_q}.{attr}"
+        if cand in mod.functions:
+            return (mod.relpath, cand)
+        for base in mod.classes[cls_q].bases:
+            if not base:
+                continue
+            bmod, bci = resolve_class(mod, base)
+            if bci is None:
+                continue
+            bq = f"{bci.qualname}.{attr}"
+            if bq in bmod.functions:
+                return (bmod.relpath, bq)
+        return None
+
+    def resolve_name(mod: ModuleInfo, fi: FuncInfo, name: str):
+        parts = fi.qualname.split(".")
+        # lexical scope chain: own nested defs, then each enclosing level
+        for i in range(len(parts), -1, -1):
+            cand = ".".join(parts[:i] + [name]) if i else name
+            if cand in mod.functions:
+                return (mod.relpath, cand)
+        imp = mod.import_names.get(name)
+        if imp is not None:
+            return mod_func(imp[0], imp[1]) or mod_func(
+                f"{imp[0]}.{imp[1]}", name)
+        return None
+
+    edges: dict = {}
+    for m in modules:
+        for q, fi in m.functions.items():
+            node = (m.relpath, q)
+            out: list = []
+            for call in body_calls(fi.node):
+                f = call.func
+                target = None
+                if isinstance(f, ast.Name):
+                    target = resolve_name(m, fi, f.id)
+                elif isinstance(f, ast.Attribute) and isinstance(
+                        f.value, ast.Name):
+                    recv = f.value.id
+                    if recv in ("self", "cls"):
+                        target = resolve_method(m, fi, f.attr)
+                    else:
+                        imp = m.imports.get(recv)
+                        if imp is not None:
+                            target = mod_func(imp, f.attr)
+                        else:
+                            nm = m.import_names.get(recv)
+                            if nm is not None:
+                                target = mod_func(
+                                    f"{nm[0]}.{nm[1]}", f.attr)
+                if target is not None and target != node:
+                    out.append((target, call.lineno))
+            if out:
+                edges[node] = out
+    return CallGraph(edges=edges, functions=functions, modules=by_rel)

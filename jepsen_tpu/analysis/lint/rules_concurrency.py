@@ -1,0 +1,403 @@
+"""Concurrency + durability invariant rules.
+
+Each rule encodes an invariant that was violated at least once in PRs
+1-4 and caught only by human review (doc/static-analysis.md maps each
+rule to its incident):
+
+* ``lock-guard`` (JTL001) — Eraser-style lock-set discipline: an
+  attribute the class mutates under ``with self._lock`` anywhere must be
+  mutated under it everywhere (``__init__`` and helpers provably called
+  only under the lock are exempt).
+* ``thread-owner`` (JTL002) — ``# owner: scheduler|worker|any``
+  annotations plus call-graph reachability: worker-reachable code must
+  never call a scheduler-only mutator (the PR 4 concurrent-close race
+  class).
+* ``no-unbounded-block`` (JTL003) — no timeout-less ``Queue.get`` /
+  ``join`` / ``recv`` / ``wait`` reachable from the scheduler loop: one
+  silent unbounded block wedges the whole run (the bug class PR 4's
+  deadline layer exists to kill).
+* ``fsync-pairing`` (JTL004) — ``os.fsync`` without a preceding
+  ``flush`` on the same handle syncs stale buffers; and in a class
+  annotated ``# durability: fsync`` every writing method must carry the
+  full flush+fsync pair (the WAL/fault-registry durability contract
+  from PR 3).
+"""
+from __future__ import annotations
+
+import ast
+
+from jepsen_tpu.analysis.diagnostics import Finding
+from jepsen_tpu.analysis.lint.astcache import ModuleInfo
+from jepsen_tpu.analysis.lint.callgraph import CallGraph, body_calls
+
+MUTATOR_METHODS = frozenset({
+    "append", "add", "clear", "pop", "popitem", "update", "extend",
+    "remove", "discard", "setdefault", "insert", "appendleft", "popleft",
+    "sort", "reverse",
+})
+
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _is_lock_ctor(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name in ("Lock", "RLock")
+
+
+def _self_attr(node, class_name: str | None = None):
+    """'attr' when node is ``self.attr`` / ``cls.attr`` (or
+    ``ClassName.attr`` for class-level state), else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        if node.value.id in ("self", "cls") or node.value.id == class_name:
+            return node.attr
+    return None
+
+
+class _Mutation:
+    __slots__ = ("attr", "lineno", "col", "locked", "method", "desc")
+
+    def __init__(self, attr, lineno, col, locked, method, desc):
+        self.attr, self.lineno, self.col = attr, lineno, col
+        self.locked, self.method, self.desc = locked, method, desc
+
+
+def _with_lock_items(node, lock_attrs, class_name):
+    for item in node.items:
+        a = _self_attr(item.context_expr, class_name)
+        if a in lock_attrs:
+            return True
+    return False
+
+
+def _scan_method(mod, method_fi, lock_attrs, class_name):
+    """(mutations, locked_selfcalls, all_selfcalls) for one method.
+    Nested defs are scanned for mutations but NEVER count as
+    lock-guarded: a closure runs when it is *called*, not where its
+    ``with`` block happens to enclose its definition."""
+    mutations: list[_Mutation] = []
+    locked_calls: list[str] = []
+    all_calls: list[str] = []
+
+    def note(attr, node, desc, locked):
+        mutations.append(_Mutation(attr, node.lineno, node.col_offset,
+                                   locked, method_fi, desc))
+
+    def walk(node, locked: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, False)
+                continue
+            child_locked = locked
+            if isinstance(child, ast.With) and _with_lock_items(
+                    child, lock_attrs, class_name):
+                child_locked = True
+            if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                for t in targets:
+                    a = _self_attr(t, class_name)
+                    if a is not None:
+                        note(a, child, f"self.{a} rebound", locked)
+                    elif isinstance(t, ast.Subscript):
+                        a = _self_attr(t.value, class_name)
+                        if a is not None:
+                            note(a, child, f"self.{a}[...] stored", locked)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            a = _self_attr(el, class_name)
+                            if a is not None:
+                                note(a, child, f"self.{a} rebound", locked)
+            elif isinstance(child, ast.Delete):
+                for t in child.targets:
+                    a = _self_attr(t, class_name) or (
+                        _self_attr(t.value, class_name)
+                        if isinstance(t, ast.Subscript) else None)
+                    if a is not None:
+                        note(a, child, f"self.{a} deleted", locked)
+            elif isinstance(child, ast.Call):
+                f = child.func
+                if isinstance(f, ast.Attribute):
+                    a = _self_attr(f.value, class_name)
+                    if a is not None and f.attr in MUTATOR_METHODS:
+                        note(a, child, f"self.{a}.{f.attr}()", locked)
+                    if isinstance(f.value, ast.Name) \
+                            and f.value.id == "self":
+                        all_calls.append(f.attr)
+                        if locked:
+                            locked_calls.append(f.attr)
+            walk(child, child_locked)
+
+    walk(method_fi.node, False)
+    return mutations, locked_calls, all_calls
+
+
+def lock_guard(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for cq, ci in mod.classes.items():
+        # methods = direct function children of the class (plus their
+        # closures, scanned inside _scan_method)
+        methods = {q: fi for q, fi in mod.functions.items()
+                   if q.startswith(cq + ".")
+                   and "." not in q[len(cq) + 1:]}
+        if not methods:
+            continue
+        lock_attrs: set = set()
+        for fi in methods.values():
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
+                    for t in n.targets:
+                        a = _self_attr(t, ci.name)
+                        if a is not None:
+                            lock_attrs.add(a)
+        for stmt in ci.node.body:  # class-level: _lock = Lock()
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        lock_attrs.add(t.id)
+        if not lock_attrs:
+            continue
+
+        per_method: dict = {}
+        lockheld_callees: set = set()   # self.m() seen under a lock
+        unlocked_callees: set = set()   # self.m() seen outside any lock
+        for q, fi in methods.items():
+            muts, locked_calls, all_calls = _scan_method(
+                mod, fi, lock_attrs, ci.name)
+            per_method[q] = (fi, muts)
+            in_init = fi.node.name in _INIT_METHODS
+            for c in all_calls:
+                if c in locked_calls or in_init:
+                    lockheld_callees.add(c)
+                else:
+                    unlocked_callees.add(c)
+        guarded = {m.attr for fi, muts in per_method.values()
+                   for m in muts
+                   if m.locked and fi.node.name not in _INIT_METHODS}
+        guarded -= set(lock_attrs)
+        if not guarded:
+            continue
+        # helper methods provably called only under the lock (or from
+        # __init__, before the object is shared) inherit the guard
+        exempt_methods = lockheld_callees - unlocked_callees
+        for q, (fi, muts) in per_method.items():
+            name = fi.node.name
+            if name in _INIT_METHODS or name in exempt_methods:
+                continue
+            if "lock-guard" in fi.ignores or "lock-guard" in ci.ignores:
+                continue
+            for m in muts:
+                if m.locked or m.attr not in guarded:
+                    continue
+                if "lock-guard" in mod.line_ignores(m.lineno):
+                    continue
+                locks = "/".join(sorted(f"self.{a}" for a in lock_attrs))
+                out.append(Finding(
+                    rule="lock-guard", code="JTL001", path=mod.relpath,
+                    line=m.lineno, col=m.col + 1, qualname=q,
+                    message=(f"{m.desc} outside `with {locks}` but "
+                             f"self.{m.attr} is lock-guarded elsewhere "
+                             f"in {ci.name}"),
+                    hint="mutate under the lock, or annotate the line "
+                         "with `# lint: ignore[lock-guard]` and document "
+                         "the single-writer argument"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+def thread_owner(graph: CallGraph) -> list[Finding]:
+    out: list[Finding] = []
+    workers = [n for n, fi in graph.functions.items() if fi.owner == "worker"]
+    for root in workers:
+        seen = graph.reachable(
+            [root], through=lambda n: graph.owner(n) != "scheduler")
+        for node, (parent, lineno) in seen.items():
+            if graph.owner(node) != "scheduler" or parent is None:
+                continue
+            pmod = graph.modules.get(parent[0])
+            pfi = graph.functions.get(parent)
+            if pmod is not None and (
+                    "thread-owner" in pmod.line_ignores(lineno)
+                    or (pfi is not None and "thread-owner" in pfi.ignores)):
+                continue
+            chain = " -> ".join(q for _, q in graph.path_to(seen, node))
+            out.append(Finding(
+                rule="thread-owner", code="JTL002",
+                path=parent[0], line=lineno, col=1, qualname=parent[1],
+                message=(f"worker-owned {root[1]!r} reaches "
+                         f"scheduler-only {node[1]!r} ({chain})"),
+                hint="scheduler-only mutators may only run on the "
+                     "scheduler thread; hand results over via the "
+                     "completion queue instead"))
+    return out
+
+
+_BLOCKING = ("get", "join", "wait", "recv")
+
+# Receiver methods that prove "this is a queue" (so its zero-arg .get()
+# blocks). dict.get/ContextVar.get share the name but not these.
+_QUEUE_EVIDENCE = frozenset({"put", "put_nowait", "get_nowait",
+                             "task_done", "qsize"})
+
+
+def _queue_receivers(mod: ModuleInfo) -> frozenset:
+    out: set = set()
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _QUEUE_EVIDENCE:
+            d = _recv_dump(n.func.value)
+            if d is not None:
+                out.add(d)
+    return frozenset(out)
+
+
+def _unbounded_block_call(call: ast.Call, queues: frozenset) -> str | None:
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _BLOCKING:
+        return None
+    kwnames = {k.arg for k in call.keywords}
+    if "timeout" in kwnames:
+        return None
+    if f.attr == "recv":
+        return "recv() with no timeout mechanism"
+    if call.args or any(k.arg is None for k in call.keywords):
+        return None  # dict.get(k)/str.join(xs)-style calls take args
+    if f.attr == "get" and _recv_dump(f.value) not in queues:
+        return None  # no queue evidence: dict/ContextVar-style .get()
+    return f"{f.attr}() without a timeout"
+
+
+def no_unbounded_block(graph: CallGraph) -> list[Finding]:
+    out: list[Finding] = []
+    roots = [n for n, fi in graph.functions.items()
+             if fi.owner == "scheduler"]
+    seen = graph.reachable(
+        [root for root in roots],
+        through=lambda n: graph.owner(n) in (None, "any", "scheduler"))
+    root_of: dict = {}
+    for node in seen:
+        chain = graph.path_to(seen, node)
+        root_of[node] = chain[0]
+    queue_evidence: dict = {}
+    for node in seen:
+        fi = graph.functions.get(node)
+        if fi is None or fi.owner == "worker":
+            continue
+        mod = graph.modules.get(node[0])
+        if mod is None or "no-unbounded-block" in fi.ignores:
+            continue
+        queues = queue_evidence.get(node[0])
+        if queues is None:
+            queues = queue_evidence[node[0]] = _queue_receivers(mod)
+        for call in body_calls(fi.node):
+            why = _unbounded_block_call(call, queues)
+            if why is None:
+                continue
+            if "no-unbounded-block" in mod.line_ignores(call.lineno):
+                continue
+            src = root_of.get(node, node)
+            via = ("" if src == node
+                   else f" (reachable from scheduler-owned {src[1]!r})")
+            out.append(Finding(
+                rule="no-unbounded-block", code="JTL003",
+                path=node[0], line=call.lineno, col=call.col_offset + 1,
+                qualname=node[1],
+                message=f"{why} on the scheduler path{via}",
+                hint="pass timeout= (poll in a loop if the wait is "
+                     "legitimately long) so a hung peer can never wedge "
+                     "the scheduler silently"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+def _recv_dump(node) -> str | None:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def fsync_pairing(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for q, fi in mod.functions.items():
+        if "fsync-pairing" in fi.ignores:
+            continue
+        calls = body_calls(fi.node)
+        flush_of: dict[str, int] = {}   # receiver dump -> first flush line
+        for c in calls:
+            f = c.func
+            if isinstance(f, ast.Attribute) and f.attr == "flush":
+                d = _recv_dump(f.value)
+                if d is not None and d not in flush_of:
+                    flush_of[d] = c.lineno
+        for c in calls:
+            f = c.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "fsync"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "os" and c.args):
+                continue
+            arg = c.args[0]
+            if not (isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "fileno"):
+                continue  # fsync(fd) on a raw descriptor: can't pair
+            recv = _recv_dump(arg.func.value)
+            if recv is None:
+                continue
+            if "fsync-pairing" in mod.line_ignores(c.lineno):
+                continue
+            flushed_at = flush_of.get(recv)
+            if flushed_at is None or flushed_at > c.lineno:
+                out.append(Finding(
+                    rule="fsync-pairing", code="JTL004", path=mod.relpath,
+                    line=c.lineno, col=c.col_offset + 1, qualname=q,
+                    message=(f"os.fsync({recv}.fileno()) without a "
+                             f"preceding {recv}.flush() — buffered "
+                             "writes are not yet in the kernel, so the "
+                             "fsync persists stale data"),
+                    hint=f"call {recv}.flush() before os.fsync()"))
+
+    # durability-annotated classes: every writing method carries the pair
+    for cq, ci in mod.classes.items():
+        if ci.durability != "fsync":
+            continue
+        methods = {q: fi for q, fi in mod.functions.items()
+                   if q.startswith(cq + ".")
+                   and "." not in q[len(cq) + 1:]}
+        for q, fi in methods.items():
+            if "fsync-pairing" in fi.ignores:
+                continue
+            calls = body_calls(fi.node)
+            writes = [c for c in calls
+                      if isinstance(c.func, ast.Attribute)
+                      and c.func.attr == "write"
+                      and _self_attr(c.func.value, ci.name) is not None]
+            if not writes:
+                continue
+            has_flush = any(isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "flush" for c in calls)
+            has_fsync = any(isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "fsync" for c in calls)
+            if has_flush and has_fsync:
+                continue
+            w = writes[0]
+            if "fsync-pairing" in mod.line_ignores(w.lineno):
+                continue
+            missing = [x for x, ok in (("flush", has_flush),
+                                       ("fsync", has_fsync)) if not ok]
+            out.append(Finding(
+                rule="fsync-pairing", code="JTL004", path=mod.relpath,
+                line=w.lineno, col=w.col_offset + 1, qualname=q,
+                message=(f"{ci.name} is `# durability: fsync` but "
+                         f"{fi.node.name} writes without "
+                         f"{' or '.join(missing)}"),
+                hint="pair every durable write with flush + os.fsync "
+                     "(interval batching is fine — the calls must "
+                     "exist on the path)"))
+    return out

@@ -1,0 +1,617 @@
+"""Preflight: static validation of a test map before the run starts.
+
+A mis-specified test — a generator emitting an ``:f`` the client doesn't
+implement, a nemesis kind nothing can heal, a garbage timeout knob —
+historically surfaced minutes into a run, after node setup, DB cycling
+and TPU compile time were already spent, as a history full of
+``unknown-f`` fails (or worse, a cluster left broken by an unhealable
+fault). Preflight catches those in milliseconds on the control node:
+
+* **Generator enumeration** — a bounded, deterministic symbolic run of
+  the generator via :mod:`jepsen_tpu.generator.simulate` (seeded model
+  workers, hard op-count and wall-clock caps, so it terminates on any
+  generator). Every emitted client ``:f`` is checked against the
+  client's declared op surface (:meth:`jepsen_tpu.client.Client.
+  supported_fs`), every nemesis ``:f`` against the nemesis'
+  :meth:`~jepsen_tpu.nemesis.Nemesis.fs` surface and
+  :func:`jepsen_tpu.nemesis.faults.classify` healability. Generators
+  built from *stateful* callables (closure counters, iterators, global
+  ``random``) are detected and skipped — enumerating them would consume
+  the very state the real run needs (diagnostic GEN005 notes the skip).
+
+* **Knob checks** — type/range validation of the runtime knobs
+  (``op_timeout_s``, ``drain_timeout_s``, ``stall_s``,
+  ``wal_fsync_interval``, ``metrics_interval``, ``time_limit``,
+  ``concurrency`` vs node count, time-limit vs op-timeout sanity).
+
+* **Checker/model compatibility** — a linearizable checker whose model
+  doesn't recognize the generator's op surface yields garbage verdicts;
+  preflight cross-checks the enumerated ``:f`` set against the model.
+
+``core.run`` runs preflight by default; ``preflight: False`` in the
+test map (or ``--no-preflight``) restores the old behavior
+bit-identically. ``jepsen-tpu preflight`` runs it standalone. Error
+diagnostics raise :class:`PreflightFailed`; warnings are logged and the
+run proceeds. ``preflight_allow: ["NEM002", ...]`` in the test map
+downgrades named codes to warnings (the documented waiver for tests
+that *deliberately* use unhealable file faults).
+
+Diagnostic codes (doc/static-analysis.md):
+
+====== ======== ======================================================
+code   severity meaning
+====== ======== ======================================================
+GEN001 error    generator emits an ``:f`` outside the client's surface
+GEN002 warning  generator emitted no ops at all
+GEN003 info     enumeration truncated at the op/wall cap
+GEN004 warning  generator raised during enumeration
+GEN005 info     generator is stateful; enumeration skipped
+GEN006 error    generator emits a malformed op
+CLI001 error    client ops emitted but the test has no client
+NEM001 warning  nemesis ops emitted but the test has no nemesis
+NEM002 error    nemesis ``:f`` maps to an unhealable fault kind
+NEM003 error    nemesis ``:f`` outside the nemesis' declared surface
+KNB001 error    knob has a non-numeric type
+KNB002 error    knob out of range
+KNB003 error    concurrency invalid
+KNB004 warning  concurrency leaves nodes without a client worker
+KNB005 warning  per-op deadline exceeds the run's time limit
+KNB006 warning  stringly-typed numeric knob
+CHK001 warning  checker model doesn't recognize enumerated ops
+====== ======== ======================================================
+"""
+from __future__ import annotations
+
+import dataclasses
+import dis
+import logging
+import types
+from typing import Any
+
+from jepsen_tpu.analysis.diagnostics import (
+    ERROR, INFO, WARNING, Diagnostic, sort_diagnostics,
+)
+
+logger = logging.getLogger("jepsen.analysis.preflight")
+
+# Enumeration caps: generous enough to exercise phase structure, small
+# enough to stay invisible next to node setup. Tunable per test map.
+DEFAULT_OP_CAP = 256
+DEFAULT_WALL_CAP_S = 2.0
+
+
+class PreflightFailed(Exception):
+    """Raised by :func:`check` when any error-severity diagnostic fired.
+    ``diagnostics`` holds every finding; ``errors`` just the fatal ones."""
+
+    def __init__(self, diagnostics: list[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        self.errors = [d for d in diagnostics if d.severity == ERROR]
+        lines = [d.render() for d in self.errors]
+        super().__init__(
+            f"preflight failed with {len(self.errors)} error(s):\n"
+            + "\n".join(lines))
+
+
+# ---------------------------------------------------------------------------
+# Statefulness detection — is this generator safe to enumerate?
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CELL_TYPES = (list, dict, set, bytearray)
+_STATE_OPS = frozenset(
+    {"STORE_DEREF", "DELETE_DEREF", "STORE_GLOBAL", "DELETE_GLOBAL"})
+_MISSING = object()
+
+
+def _stateful_callable(fn, _depth: int = 0) -> str | None:
+    """A reason string when calling ``fn`` during enumeration could
+    consume state the real run needs (closure counters, iterators,
+    the global ``random`` stream), else None. Conservative: anything
+    we can't prove stateless is treated as stateful — a skipped
+    enumeration is safe, a corrupted run is not."""
+    if _depth > 4:
+        return "callable nesting too deep to prove stateless"
+    if isinstance(fn, types.MethodType):
+        return f"bound method {getattr(fn, '__qualname__', fn)!r}"
+    if not isinstance(fn, types.FunctionType):
+        return f"non-function callable {type(fn).__name__!r}"
+    for cell in fn.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            return "unresolved closure cell"
+        if hasattr(v, "__next__"):
+            return f"closure over an iterator in {fn.__qualname__!r}"
+        if isinstance(v, _MUTABLE_CELL_TYPES):
+            return (f"closure over a mutable {type(v).__name__} in "
+                    f"{fn.__qualname__!r}")
+        if callable(v):
+            reason = _stateful_callable(v, _depth + 1)
+            if reason:
+                return reason
+    try:
+        for ins in dis.get_instructions(fn):
+            if ins.opname in _STATE_OPS:
+                return (f"{fn.__qualname__!r} rebinds nonlocal/global "
+                        "state")
+            if ins.opname == "LOAD_GLOBAL":
+                reason = _stateful_global(fn, ins.argval, _depth)
+                if reason:
+                    return reason
+    except Exception:  # noqa: BLE001 — bytecode we can't read, assume worst
+        return "unreadable bytecode"
+    return None
+
+
+def _stateful_global(fn, name, depth: int) -> str | None:
+    """Why the global ``name`` referenced by ``fn`` makes enumeration
+    unsafe, or None. Resolves through ``fn.__globals__`` so
+    ``from random import randint``-style imports and stateful global
+    helpers are caught, not just the bare module name."""
+    v = fn.__globals__.get(name, _MISSING)
+    if v is _MISSING:
+        return None  # a builtin (len, range, ...) — stateless
+    if isinstance(v, types.ModuleType):
+        if v.__name__ == "random":
+            return (f"{fn.__qualname__!r} draws from the global random "
+                    "stream")
+        return None
+    mod = getattr(v, "__module__", None)
+    if mod == "random":
+        return (f"{fn.__qualname__!r} draws from the global random "
+                f"stream (via {name!r})")
+    if hasattr(v, "__next__"):
+        return f"{fn.__qualname__!r} reads global iterator {name!r}"
+    if isinstance(v, _MUTABLE_CELL_TYPES):
+        return (f"{fn.__qualname__!r} references global mutable "
+                f"{type(v).__name__} {name!r}")
+    if isinstance(v, types.MethodType):
+        return f"{fn.__qualname__!r} calls global bound method {name!r}"
+    if isinstance(v, types.FunctionType):
+        # a global helper is only safe if IT is provably stateless
+        return _stateful_callable(v, depth + 1)
+    return None  # modules/classes/constants: calls on them don't touch
+    #              generator state the run needs (conservatively allowed)
+
+
+def _stateful_reason(value, _seen: set | None = None) -> str | None:
+    """Walks a generator value tree; returns why it is NOT statically
+    enumerable, or None when every component is pure data / provably
+    stateless callables."""
+    from jepsen_tpu import generator as gen_mod
+
+    seen = _seen if _seen is not None else set()
+    if id(value) in seen:
+        return None
+    seen.add(id(value))
+    if value is None or isinstance(value, (str, int, float, bool, bytes)):
+        return None
+    if isinstance(value, dict):
+        for v in value.values():
+            r = _stateful_reason(v, seen)
+            if r:
+                return r
+        return None
+    if isinstance(value, (list, tuple, set, frozenset)):
+        for v in value:
+            r = _stateful_reason(v, seen)
+            if r:
+                return r
+        return None
+    if callable(value) and not isinstance(value, gen_mod.Generator):
+        return _stateful_callable(value)
+    if isinstance(value, gen_mod.Generator):
+        if not dataclasses.is_dataclass(value):
+            return f"opaque generator {type(value).__name__!r}"
+        for f in dataclasses.fields(value):
+            r = _stateful_reason(getattr(value, f.name), seen)
+            if r:
+                return r
+        return None
+    # an unrecognized embedded object (connection, RNG, ...): refuse
+    return f"embedded {type(value).__name__!r} object"
+
+
+# ---------------------------------------------------------------------------
+# Surfaces
+# ---------------------------------------------------------------------------
+
+def _unwrap_client(client):
+    """Peels wrapper clients: ``client.Validate`` holds the wrapped
+    client in ``.client``, ``tracing.TracedClient`` in ``.inner`` —
+    a ``--trace`` run must get the same surface check as a bare one."""
+    from jepsen_tpu.client import Client
+    for _ in range(8):
+        for attr in ("client", "inner"):
+            inner = getattr(client, attr, None)
+            if isinstance(inner, Client):
+                client = inner
+                break
+        else:
+            return client
+    return client
+
+
+def _client_surface(test: dict):
+    """The client's declared op surface, or None when unknown (no client
+    wired yet, or the client doesn't declare one — the check is then
+    skipped, never guessed)."""
+    client = test.get("client")
+    if client is None:
+        return None
+    client = _unwrap_client(client)
+    fn = getattr(client, "supported_fs", None)
+    if not callable(fn):
+        return None
+    try:
+        surface = fn(test)
+    except Exception:  # noqa: BLE001 — a broken surface is no surface
+        logger.exception("client supported_fs() raised; skipping check")
+        return None
+    return None if surface is None else set(surface)
+
+
+def _nemesis_surface(test: dict):
+    nemesis = test.get("nemesis")
+    if nemesis is None:
+        return None
+    fn = getattr(nemesis, "fs", None)
+    if not callable(fn):
+        return None
+    try:
+        surface = set(fn() or ())
+    except Exception:  # noqa: BLE001
+        logger.exception("nemesis fs() raised; skipping check")
+        return None
+    # the base protocol returns an empty set for "not declared"
+    return surface or None
+
+
+def _model_surface(model) -> set | None:
+    """Op fs a linearizability model recognizes; None = unknown."""
+    try:
+        from jepsen_tpu.models import CASRegister, MultiRegister
+    except Exception:  # noqa: BLE001
+        return None
+    if isinstance(model, CASRegister):
+        return {"read", "write", "cas"}
+    if isinstance(model, MultiRegister):
+        return {"txn"}
+    return None
+
+
+def _walk_checkers(checker, out: list, _depth: int = 0) -> None:
+    if checker is None or _depth > 6:
+        return
+    out.append(checker)
+    sub = getattr(checker, "checkers", None)
+    if isinstance(sub, dict):
+        for c in sub.values():
+            _walk_checkers(c, out, _depth + 1)
+    inner = getattr(checker, "checker", None)
+    if inner is not None and inner is not checker:
+        _walk_checkers(inner, out, _depth + 1)
+
+
+# ---------------------------------------------------------------------------
+# Knob checks
+# ---------------------------------------------------------------------------
+
+# (key, allow_none, min_inclusive) — min None = any finite value
+_NUMERIC_KNOBS = (
+    ("op_timeout_s", True, 0.0),
+    ("drain_timeout_s", True, 0.0),
+    ("stall_s", True, 0.0),
+    ("wal_fsync_interval", True, None),
+    ("metrics_interval", True, None),
+    ("time_limit", True, 0.0),
+)
+
+_UNSET = object()
+
+
+def _check_knobs(test: dict) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for key, allow_none, lo in _NUMERIC_KNOBS:
+        v = test.get(key, _UNSET)
+        if v is _UNSET or (v is None and allow_none):
+            continue
+        if isinstance(v, bool):
+            out.append(Diagnostic(
+                "KNB001", ERROR, key,
+                f"{key} must be a number, got bool {v!r}",
+                hint=f"use a numeric value (0 disables {key})"))
+            continue
+        if isinstance(v, str):
+            try:
+                v = float(v)
+            except ValueError:
+                out.append(Diagnostic(
+                    "KNB001", ERROR, key,
+                    f"{key} must be a number, got {v!r}",
+                    hint="the runtime would fall back to the default "
+                         "with a warning; fix the test map instead"))
+                continue
+            out.append(Diagnostic(
+                "KNB006", WARNING, key,
+                f"{key} is a string ({v!r}); prefer a plain number"))
+        if not isinstance(v, (int, float)):
+            out.append(Diagnostic(
+                "KNB001", ERROR, key,
+                f"{key} must be a number, got {type(v).__name__}"))
+            continue
+        if lo is not None and v < lo:
+            out.append(Diagnostic(
+                "KNB002", ERROR, key,
+                f"{key}={v!r} is below the minimum {lo!r}",
+                hint="0 disables a timeout knob; negatives are "
+                     "meaningless here"))
+
+    nodes = list(test.get("nodes") or [])
+    conc_raw = test.get("concurrency", 1)
+    try:
+        from jepsen_tpu.utils import parse_concurrency
+        conc = parse_concurrency(conc_raw, len(nodes))
+    except Exception as e:  # noqa: BLE001
+        out.append(Diagnostic(
+            "KNB003", ERROR, "concurrency",
+            f"unparsable concurrency {conc_raw!r}: {e}",
+            hint="use an int or the '3n' per-node form"))
+        conc = None
+    if conc is not None and conc < 1:
+        out.append(Diagnostic(
+            "KNB003", ERROR, "concurrency",
+            f"concurrency={conc} — a run needs at least one worker"))
+    elif conc is not None and nodes and conc < len(nodes) \
+            and test.get("client") is not None:
+        out.append(Diagnostic(
+            "KNB004", WARNING, "concurrency",
+            f"concurrency={conc} < {len(nodes)} nodes: "
+            f"{len(nodes) - conc} node(s) never see a client",
+            hint="use '1n' (one worker per node) or more"))
+
+    ot, tl = test.get("op_timeout_s"), test.get("time_limit")
+    if isinstance(ot, (int, float)) and not isinstance(ot, bool) and ot > 0 \
+            and isinstance(tl, (int, float)) and not isinstance(tl, bool) \
+            and 0 < tl < ot:
+        out.append(Diagnostic(
+            "KNB005", WARNING, "op_timeout_s",
+            f"op_timeout_s={ot} exceeds time_limit={tl}: a hung op "
+            "extends the run past its time limit before the deadline "
+            "can fire",
+            hint="set op_timeout_s below time_limit, or accept the "
+                 "longer worst-case run"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Generator enumeration
+# ---------------------------------------------------------------------------
+
+def _cap_knob(test: dict, key: str, default, cast, diags: list) -> Any:
+    """Preflight's own cap knobs, coerced with the same tolerance the
+    subsystem preaches: garbage becomes a KNB001 diagnostic + the
+    default, never a raw ValueError out of the gate itself."""
+    v = test.get(key, default)
+    try:
+        if isinstance(v, bool):
+            raise ValueError("bool is not a count")
+        v = cast(v)
+        if key != "preflight_seed" and v <= 0:
+            raise ValueError("must be positive")
+        return v
+    except (TypeError, ValueError) as e:
+        diags.append(Diagnostic(
+            "KNB001", ERROR, key,
+            f"{key}={test.get(key)!r} is not a usable "
+            f"{cast.__name__} ({e}); enumeration used the default "
+            f"{default!r}"))
+        return default
+
+
+def _enumerate(test: dict) -> tuple[list[dict], list[Diagnostic]]:
+    """Bounded symbolic run of the generator; returns (invocations,
+    diagnostics-from-enumeration). Never touches real clients, nodes,
+    or wall-clock sleeps."""
+    from jepsen_tpu import generator as gen_mod
+    from jepsen_tpu.generator import simulate as sim
+
+    gen_value = test.get("generator")
+    if gen_value is None:
+        return [], []
+    reason = _stateful_reason(gen_value)
+    if reason:
+        return [], [Diagnostic(
+            "GEN005", INFO, "generator",
+            f"generator is not statically enumerable ({reason}); "
+            "op-surface checks skipped",
+            hint="build generators from data/pure callables to get "
+                 "preflight coverage")]
+
+    diags: list[Diagnostic] = []
+    op_cap = _cap_knob(test, "preflight_ops", DEFAULT_OP_CAP, int, diags)
+    wall_cap = _cap_knob(test, "preflight_wall_s", DEFAULT_WALL_CAP_S,
+                         float, diags)
+    seed = _cap_knob(test, "preflight_seed", 0, int, diags)
+    stats: dict = {}
+    try:
+        # simulate's limit counts scheduler STEPS (dispatch and
+        # completion each cost one), so 4x the op budget bounds the
+        # invocation count at roughly 2x preflight_ops. ``stats``
+        # reports which cap (if any) ended the run, so truncation is
+        # NEVER silent — a pseudo-op-heavy generator can exhaust steps
+        # with few invocations.
+        history = sim.quick(test, gen_mod.validate(gen_value),
+                            seed=seed, limit=op_cap * 4,
+                            max_wall_s=wall_cap, stats=stats)
+    except ValueError as e:
+        if "invalid op" in str(e):
+            return [], [Diagnostic(
+                "GEN006", ERROR, "generator",
+                f"generator emits a malformed op: {e}",
+                hint="ops need type invoke/info/sleep/log and a free "
+                     "process; see jepsen_tpu.generator.Validate")]
+        return [], [Diagnostic(
+            "GEN004", WARNING, "generator",
+            f"generator raised during bounded enumeration: {e!r}")]
+    except Exception as e:  # noqa: BLE001 — enumeration must never crash
+        return [], [Diagnostic(
+            "GEN004", WARNING, "generator",
+            f"generator raised during bounded enumeration: {e!r}",
+            hint="the simulated scheduler completes every op :ok with "
+                 "zero latency; generators that depend on richer "
+                 "completions may not be enumerable")]
+    invocations = [op for op in history if op.get("type") == "invoke"]
+    if stats.get("step_limited") or stats.get("wall_limited"):
+        # ONLY the stats flags mean truncation — a generator that
+        # exhausted naturally under the caps got full coverage, however
+        # many ops it emitted, and must not be branded a prefix
+        cause = ("wall-clock cap" if stats.get("wall_limited")
+                 else "step cap")
+        diags.append(Diagnostic(
+            "GEN003", INFO, "generator",
+            f"enumeration truncated by the {cause} at "
+            f"{len(invocations)} op(s) / {stats.get('steps', 0)} "
+            "step(s); coverage is a prefix",
+            hint="raise preflight_ops / preflight_wall_s in the test "
+                 "map for deeper coverage"))
+    if not history:
+        diags.append(Diagnostic(
+            "GEN002", WARNING, "generator",
+            "generator emitted no ops in the bounded enumeration",
+            hint="an empty run produces an empty history; is a "
+                 "time_limit/limit wrapper zeroed out?"))
+    return invocations, diags
+
+
+def _check_ops(test: dict, invocations: list[dict]) -> list[Diagnostic]:
+    from jepsen_tpu.generator import NEMESIS
+    from jepsen_tpu.nemesis.faults import UNHEALABLE_KINDS, classify
+
+    out: list[Diagnostic] = []
+    client_fs: set = set()
+    nemesis_fs: set = set()
+    for op in invocations:
+        if op.get("process") == NEMESIS:
+            nemesis_fs.add(op.get("f"))
+        else:
+            client_fs.add(op.get("f"))
+
+    if client_fs and test.get("client") is None:
+        out.append(Diagnostic(
+            "CLI001", ERROR, "client",
+            f"generator emits client ops ({_fmt_fs(client_fs)}) but the "
+            "test has no client",
+            hint="wire a client into the test map, or restrict the "
+                 "generator to the nemesis thread"))
+    surface = _client_surface(test)
+    if surface is not None:
+        for f in sorted(client_fs - surface, key=str):
+            out.append(Diagnostic(
+                "GEN001", ERROR, "generator",
+                f"generator emits :f {f!r} outside the client's "
+                f"supported surface {_fmt_fs(surface)}",
+                hint="fix the generator's :f, or extend the client's "
+                     "supported_fs()"))
+
+    if nemesis_fs and test.get("nemesis") is None:
+        out.append(Diagnostic(
+            "NEM001", WARNING, "nemesis",
+            f"generator emits nemesis ops ({_fmt_fs(nemesis_fs)}) but "
+            "the test has no nemesis; they will all no-op to :info"))
+    nem_surface = _nemesis_surface(test)
+    if nem_surface is not None:
+        for f in sorted(nemesis_fs - nem_surface, key=str):
+            out.append(Diagnostic(
+                "NEM003", ERROR, "nemesis",
+                f"nemesis op :f {f!r} is outside the nemesis' declared "
+                f"surface {_fmt_fs(nem_surface)}",
+                hint="f_map the generator and nemesis consistently"))
+    for f in sorted(nemesis_fs, key=str):
+        phase, kind = classify(f)
+        if phase == "begin" and kind in UNHEALABLE_KINDS:
+            out.append(Diagnostic(
+                "NEM002", ERROR, "nemesis",
+                f"nemesis op :f {f!r} injects an unhealable fault kind "
+                f"{kind!r} — no teardown, crash-path replay, or `cli "
+                "heal` can undo it",
+                hint="add 'NEM002' to the test map's preflight_allow "
+                     "list if the damage is deliberate (the db cycle "
+                     "must rebuild the node)"))
+
+    # checker/model compatibility over the enumerated client surface
+    checkers: list = []
+    _walk_checkers(test.get("checker"), checkers)
+    for c in checkers:
+        model = getattr(c, "model", None)
+        if model is None:
+            continue
+        msurface = _model_surface(model)
+        if msurface is None:
+            continue
+        unknown = {f for f in client_fs if f is not None} - msurface
+        if unknown:
+            out.append(Diagnostic(
+                "CHK001", WARNING, "checker",
+                f"{type(c).__name__}'s model {type(model).__name__} "
+                f"recognizes {_fmt_fs(msurface)} but the generator "
+                f"emits {_fmt_fs(unknown)}; those ops will read as "
+                "inconsistent",
+                hint="match the workload's model to its op surface"))
+    return out
+
+
+def _fmt_fs(fs) -> str:
+    return "{" + ", ".join(repr(f) for f in sorted(fs, key=str)) + "}"
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def preflight(test: dict) -> list[Diagnostic]:
+    """Every preflight diagnostic for ``test``, sorted errors-first.
+    Pure: no node contact, no sleeps, no mutation of the test map."""
+    diags = _check_knobs(test)
+    invocations, gen_diags = _enumerate(test)
+    diags.extend(gen_diags)
+    diags.extend(_check_ops(test, invocations))
+    allowed = {str(c) for c in (test.get("preflight_allow") or ())}
+    if allowed:
+        diags = [
+            Diagnostic(d.code, WARNING, d.path,
+                       d.message + " (downgraded by preflight_allow)",
+                       hint=d.hint)
+            if d.severity == ERROR and d.code in allowed else d
+            for d in diags
+        ]
+    return sort_diagnostics(diags)
+
+
+def check(test: dict) -> list[Diagnostic]:
+    """Runs :func:`preflight`; logs warnings/infos, raises
+    :class:`PreflightFailed` when any error fired, and counts failures
+    into the installed telemetry registry
+    (``preflight_failures_total{code}``). Returns the diagnostics when
+    the test passes."""
+    from jepsen_tpu import telemetry
+
+    diags = preflight(test)
+    errors = [d for d in diags if d.severity == ERROR]
+    for d in diags:
+        if d.severity == ERROR:
+            logger.error("%s", d.render())
+        elif d.severity == WARNING:
+            logger.warning("%s", d.render())
+        else:
+            logger.info("%s", d.render())
+    reg = telemetry.get_registry()
+    if reg.enabled and errors:
+        c = reg.counter("preflight_failures_total",
+                        "test maps rejected by preflight, by diagnostic "
+                        "code", labels=("code",))
+        for d in errors:
+            c.inc(code=d.code)
+    if errors:
+        raise PreflightFailed(diags)
+    return diags

@@ -76,6 +76,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "indeterminate :info and its worker replaced "
                         "(default 600; 0 disables; per-op timeout_s and "
                         "JEPSEN_TPU_OP_TIMEOUT_S also apply)")
+    # preflight (doc/static-analysis.md): static test-map validation
+    # before any node/db contact; the escape hatch restores the old
+    # behavior bit-identically
+    p.add_argument("--no-preflight", action="store_true",
+                   dest="no_preflight",
+                   help="skip preflight validation of the test map "
+                        "(generator op surface, nemesis healability, "
+                        "knob type/range checks)")
 
 
 def test_opts_to_test(opts, base_test: dict) -> dict:
@@ -101,6 +109,8 @@ def test_opts_to_test(opts, base_test: dict) -> dict:
     if getattr(opts, "op_timeout", None) is not None:
         # 0 disables (the interpreter treats falsy as no deadline)
         test["op_timeout_s"] = opts.op_timeout
+    if getattr(opts, "no_preflight", False):
+        test["preflight"] = False
     ssh = dict(test.get("ssh") or {})
     ssh.update({
         "username": opts.username,
@@ -167,6 +177,32 @@ def single_test_cmd(
         p_serve.add_argument("-p", "--port", type=int, default=8080)
         p_serve.add_argument("--store-dir", default="store")
 
+        p_pre = sub.add_parser(
+            "preflight", help="validate the test map without running it "
+                              "(doc/static-analysis.md)")
+        add_test_opts(p_pre)
+        if opt_fn:
+            opt_fn(p_pre)
+        p_pre.add_argument("--format", choices=["text", "json"],
+                           default="text")
+
+        p_lint = sub.add_parser(
+            "lint", help="run the concurrency/JAX invariant linter "
+                         "(doc/static-analysis.md)")
+        p_lint.add_argument("paths", nargs="*", default=["jepsen_tpu"])
+        p_lint.add_argument("--format", choices=["text", "json"],
+                            default="text")
+        p_lint.add_argument("--baseline",
+                            help="waiver file (default: lint-baseline.txt "
+                                 "next to the linted package)")
+        p_lint.add_argument("--no-baseline", action="store_true",
+                            help="report baselined findings too")
+        p_lint.add_argument("--update-baseline", action="store_true",
+                            help="rewrite the baseline from the current "
+                                 "findings")
+        p_lint.add_argument("--rule", action="append", dest="rules",
+                            help="restrict to a rule (repeatable)")
+
         try:
             opts = parser.parse_args(argv)
         except SystemExit as e:
@@ -175,6 +211,7 @@ def single_test_cmd(
         try:
             if opts.command == "test":
                 from jepsen_tpu import core
+                from jepsen_tpu.analysis.preflight import PreflightFailed
                 code = EXIT_OK
                 for i in range(opts.test_count):
                     try:
@@ -182,7 +219,15 @@ def single_test_cmd(
                     except (ValueError, KeyError) as e:
                         print(f"bad arguments: {e}", file=sys.stderr)
                         return EXIT_BAD_ARGS
-                    result = core.run(test)
+                    try:
+                        result = core.run(test)
+                    except PreflightFailed as e:
+                        for d in e.diagnostics:
+                            print(d.render(), file=sys.stderr)
+                        print("preflight rejected the test before any "
+                              "node was touched (--no-preflight skips)",
+                              file=sys.stderr)
+                        return EXIT_BAD_ARGS
                     code = validity_exit_code(result)
                     if code != EXIT_OK:
                         break
@@ -191,6 +236,10 @@ def single_test_cmd(
                 return analyze_cmd(opts, test_fn)
             if opts.command == "heal":
                 return heal_cmd(opts)
+            if opts.command == "preflight":
+                return preflight_cmd(opts, test_fn)
+            if opts.command == "lint":
+                return lint_cmd(opts)
             if opts.command == "serve":
                 from jepsen_tpu.web import serve
                 serve(opts.store_dir, opts.host, opts.port)
@@ -273,6 +322,74 @@ def analyze_cmd(opts, test_fn) -> int:
     core.log_results(test)
     print(f"valid?: {(test.get('results') or {}).get('valid?')}")
     return validity_exit_code(test)
+
+
+def preflight_cmd(opts, test_fn) -> int:
+    """``jepsen-tpu preflight``: builds the test map exactly as ``test``
+    would and runs the static checks, printing structured diagnostics.
+    Exit 0 when clean (warnings included), EXIT_BAD_ARGS on errors."""
+    from jepsen_tpu import core
+    from jepsen_tpu.analysis import diagnostics as diag_mod
+    from jepsen_tpu.analysis import preflight as preflight_mod
+    try:
+        test = test_fn(opts)
+    except (ValueError, KeyError) as e:
+        print(f"bad arguments: {e}", file=sys.stderr)
+        return EXIT_BAD_ARGS
+    test = core.prepare_test(test)
+    diags = preflight_mod.preflight(test)
+    if getattr(opts, "format", "text") == "json":
+        sys.stdout.write(diag_mod.render_json(diags))
+    else:
+        for d in diags:
+            print(d.render())
+    errors = [d for d in diags if d.severity == diag_mod.ERROR]
+    if errors:
+        print(f"preflight: {len(errors)} error(s), "
+              f"{len(diags) - len(errors)} other diagnostic(s)",
+              file=sys.stderr)
+        return EXIT_BAD_ARGS
+    if getattr(opts, "format", "text") == "text":
+        print(f"preflight clean ({len(diags)} non-fatal diagnostic(s))"
+              if diags else "preflight clean")
+    return EXIT_OK
+
+
+def lint_cmd(opts) -> int:
+    """``jepsen-tpu lint [paths...]``: the invariant linter. Exit 0 when
+    no non-baselined finding remains."""
+    from jepsen_tpu.analysis import lint as lint_mod
+    baseline: object = getattr(opts, "baseline", None)
+    if getattr(opts, "no_baseline", False):
+        baseline = False
+    try:
+        report = lint_mod.lint_paths(opts.paths, baseline=baseline,
+                                     rules=getattr(opts, "rules", None))
+    except ValueError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return EXIT_BAD_ARGS
+    if getattr(opts, "update_baseline", False):
+        if getattr(opts, "rules", None):
+            # a rule-restricted run only sees that rule's findings — a
+            # rewrite from it would silently drop every OTHER rule's
+            # waivers (and their why-comments) from the baseline
+            print("lint: --update-baseline cannot be combined with "
+                  "--rule (it would discard the other rules' waivers); "
+                  "run it over the full rule set", file=sys.stderr)
+            return EXIT_BAD_ARGS
+        from pathlib import Path
+        bpath = (Path(opts.baseline) if getattr(opts, "baseline", None)
+                 else lint_mod._guess_root(opts.paths)
+                 / lint_mod.BASELINE_NAME)
+        lint_mod.write_baseline(bpath, report.findings + report.baselined)
+        print(f"baseline written: {bpath} "
+              f"({len(report.findings) + len(report.baselined)} entries)")
+        return EXIT_OK
+    if getattr(opts, "format", "text") == "json":
+        sys.stdout.write(lint_mod.render_report_json(report))
+    else:
+        print(lint_mod.render_text(report))
+    return EXIT_OK if report.exit_code == 0 else 1
 
 
 def heal_cmd(opts) -> int:
@@ -361,12 +478,21 @@ def test_all_cmd(tests_fn: Callable[[argparse.Namespace], list], name="jepsen-tp
             return EXIT_BAD_ARGS
         try:
             from jepsen_tpu import core
+            from jepsen_tpu.analysis.preflight import PreflightFailed
             worst = EXIT_OK
             # each round rebuilds the test maps — core.run mutates them
             # (cli.clj:429-515 runs every combination test-count times)
             for _ in range(getattr(opts, "test_count", 1) or 1):
                 for test in tests_fn(opts):
-                    result = core.run(test)
+                    try:
+                        result = core.run(test)
+                    except PreflightFailed as e:
+                        for d in e.errors:
+                            print(d.render(), file=sys.stderr)
+                        logger.error("%s rejected by preflight",
+                                     test.get("name"))
+                        worst = max(worst, EXIT_BAD_ARGS)
+                        continue
                     code = validity_exit_code(result)
                     worst = max(worst, code if code != EXIT_OK else worst)
                     logger.info("%s: %s", test.get("name"),

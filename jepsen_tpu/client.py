@@ -48,6 +48,16 @@ class Client:
     def close(self, test: dict) -> None:
         """Releases this client's connection."""
 
+    def supported_fs(self, test: dict) -> set | None:
+        """The op ``:f`` surface this client implements, or None when
+        unknown/unbounded. Preflight (jepsen_tpu.analysis.preflight)
+        checks every generator-emitted ``:f`` against this set BEFORE
+        the run touches a node — a declared surface turns the classic
+        history-full-of-``unknown-f`` misconfiguration into an instant
+        structured diagnostic. Returning None skips the check (never
+        guesses)."""
+        return None
+
 
 class NoopClient(Client):
     """Accepts every op (jepsen.client/noop)."""

@@ -21,7 +21,8 @@ from jepsen_tpu.checker import check_safe
 from jepsen_tpu.generator import interpreter
 from jepsen_tpu.nemesis import faults as faults_mod
 from jepsen_tpu.utils import (
-    real_pmap, retry_with_backoff, with_relative_time, with_thread_name,
+    join_noisy, real_pmap, retry_with_backoff, with_relative_time,
+    with_thread_name,
 )
 
 logger = logging.getLogger("jepsen.core")
@@ -73,7 +74,7 @@ def log_test_start(test: dict) -> None:
 
 
 @contextlib.contextmanager
-def with_os(test: dict):
+def with_os(test: dict):  # owner: scheduler
     """OS setup on all nodes; teardown after (core.clj:93-100)."""
     os_ = test.get("os")
     if os_ is not None:
@@ -89,7 +90,7 @@ def with_os(test: dict):
 
 
 @contextlib.contextmanager
-def with_db(test: dict):
+def with_db(test: dict):  # owner: scheduler
     """DB cycle (teardown->setup, retried), teardown after unless
     leave_db_running (core.clj:172-181, db.clj:121-158)."""
     db = test.get("db")
@@ -109,7 +110,7 @@ def with_db(test: dict):
                 logger.exception("DB teardown failed")
 
 
-def snarf_logs(test: dict) -> None:
+def snarf_logs(test: dict) -> None:  # owner: scheduler
     """Downloads db log files from each node into the store dir
     (core.clj:102-136)."""
     db = test.get("db")
@@ -135,7 +136,7 @@ def snarf_logs(test: dict) -> None:
 
 
 @contextlib.contextmanager
-def with_client_and_nemesis(test: dict):
+def with_client_and_nemesis(test: dict):  # owner: scheduler
     """Nemesis setup (concurrently) + one client open+setup per node;
     teardown both after (core.clj:183-212). Rebinds test['client'] /
     test['nemesis'] to the set-up instances."""
@@ -166,7 +167,7 @@ def with_client_and_nemesis(test: dict):
                     setup_clients.append(c)
                 c.setup(test)
             real_pmap(open_and_setup, list(test.get("nodes") or []))
-        nt.join()
+        join_noisy(nt, "nemesis setup")
         if nemesis_err:
             raise nemesis_err[0]
         if nemesis_box[0] is not None:
@@ -174,7 +175,7 @@ def with_client_and_nemesis(test: dict):
         yield
     finally:
         # never tear down a nemesis that's still setting up
-        nt.join()
+        join_noisy(nt, "nemesis setup (teardown wait)")
         for c in setup_clients:
             try:
                 c.teardown(test)
@@ -203,7 +204,7 @@ def with_client_and_nemesis(test: dict):
         test["nemesis"] = proto_nemesis
 
 
-def run_case(test: dict) -> list[dict]:
+def run_case(test: dict) -> list[dict]:  # owner: scheduler
     """Client+nemesis setup then the interpreter (core.clj:214-219)."""
     with with_client_and_nemesis(test):
         return interpreter.run(test)
@@ -366,13 +367,33 @@ def _crash_safety_setup(test: dict):
     return journal, faults, late
 
 
-def run(test: dict) -> dict:
+def _preflight_gate(test: dict) -> None:
+    """Static validation BEFORE any node/db contact (doc/static-analysis.md).
+    ``preflight: False`` (``--no-preflight``) skips it — restoring the
+    pre-preflight behavior bit-identically, with only a skip counter to
+    show for it. Error diagnostics raise
+    :class:`jepsen_tpu.analysis.preflight.PreflightFailed`."""
+    if test.get("preflight", True) is False:
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("preflight_skipped_total",
+                        "runs that opted out of preflight validation").inc()
+        return
+    from jepsen_tpu.analysis import preflight as preflight_mod
+    preflight_mod.check(test)
+
+
+def run(test: dict) -> dict:  # owner: scheduler
     """The whole enchilada (core.clj:326-397)."""
     test = prepare_test(test)
     store.start_logging(test)
     telemetry_teardown = _telemetry_setup(test)
-    journal, faults, late = _crash_safety_setup(test)
+    journal = faults = late = None
     try:
+        # a mis-specified test dies HERE, in milliseconds, before node
+        # sessions / DB cycling / device compilation spend real time
+        _preflight_gate(test)
+        journal, faults, late = _crash_safety_setup(test)
         with with_thread_name(f"jepsen-{test.get('name')}"):
             log_test_start(test)
             with control.with_test_nodes(test):

@@ -110,6 +110,9 @@ class MetaLogClient(Client):
 class AtomClient(MetaLogClient):
     """CAS-register client over an AtomDB (tests.clj atom-client)."""
 
+    def supported_fs(self, test):
+        return {"read", "write", "cas"}
+
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")
         if f == "read":
@@ -511,6 +514,18 @@ class KVClient(MetaLogClient):
             self.db.bank_init(test.get("accounts", range(8)), 10)
         elif self.whole_read == "dirty":
             self.db.rows_init(int(test.get("dirty-rows", 4)))
+
+    # the union of every dispatch arm below — preflight checks
+    # generator-emitted :f values against this set before a run starts
+    SUPPORTED_FS = frozenset({
+        "read", "write", "cas", "add", "txn", "enqueue", "dequeue",
+        "drain", "transfer", "insert", "acquire", "release", "inc",
+        "read-all", "create-table", "drop-table", "upsert", "read-uids",
+        "refresh", "strong-read", "delete",
+    })
+
+    def supported_fs(self, test):
+        return set(self.SUPPORTED_FS)
 
     def invoke(self, test, op):
         f, v = op.get("f"), op.get("value")

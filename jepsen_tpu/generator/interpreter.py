@@ -148,7 +148,7 @@ class ClientWorker(Worker):
         self.process = process
         return self.client
 
-    def invoke(self, test, op):
+    def invoke(self, test, op):  # owner: worker
         try:
             c = self._ensure_client(test, op.get("process"))
         except Exception as e:  # noqa: BLE001
@@ -189,7 +189,7 @@ class NemesisWorker(Worker):
     # entry stays on the books for the crash-path / cli-heal replay.
     zombied: threading.Event | None = None
 
-    def invoke(self, test, op):
+    def invoke(self, test, op):  # owner: worker
         reg = telemetry.get_registry()
         if reg.enabled:
             f = str(op.get("f"))
@@ -286,14 +286,14 @@ def _spawn_worker(test: dict, worker_id, completions: queue.Queue,
     if isinstance(worker, NemesisWorker):
         worker.zombied = zombied
 
-    def close_own_client():
+    def close_own_client():  # owner: worker
         if isinstance(worker, ClientWorker):
             try:
                 worker.close(test)
             except Exception:  # noqa: BLE001
                 logger.exception("worker %s client close failed", worker_id)
 
-    def run():
+    def run():  # owner: worker
         threading.current_thread().name = (
             f"jepsen-worker-{worker_id}"
             + (f".{generation}" if generation else ""))
@@ -355,7 +355,7 @@ class _StallWatchdog:
             self._thread.start()
         return self
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # owner: any
         fired_at = None
         poll = min(max(self.stall_s / 4.0, 0.05), 5.0)
         while not self._stop.wait(poll):
@@ -396,7 +396,7 @@ class _StallWatchdog:
             self._thread = None
 
 
-def run(test: dict) -> list[dict]:
+def run(test: dict) -> list[dict]:  # owner: scheduler
     """Runs the test's generator to completion, returning the history
     (interpreter.clj:181-310). Must be called inside
     utils.with_relative_time (core.run does this); establishes one if not.
@@ -485,7 +485,7 @@ def run(test: dict) -> list[dict]:
     def thread_of(process):
         return NEMESIS if process == NEMESIS else ctx.thread_of(process)
 
-    def process_completion(completion) -> Any:
+    def process_completion(completion) -> Any:  # owner: scheduler
         """Re-stamps time, frees the thread, updates the generator, and
         renumbers crashed processes (interpreter.clj:216-241). Returns the
         freed thread id."""
@@ -526,7 +526,7 @@ def run(test: dict) -> list[dict]:
         ctx = ctx.free_thread(thread)
         return thread
 
-    def quarantine(wid, payload) -> None:
+    def quarantine(wid, payload) -> None:  # owner: scheduler
         """A stale-generation completion: the zombie finally unblocked.
         Its synthesized :info already stands in the history, so this one
         is written to the late.jsonl forensic artifact instead — never
@@ -551,7 +551,7 @@ def run(test: dict) -> list[dict]:
             late_log.append({**payload, "late": True, "worker": wid,
                              "time": relative_time_nanos()})
 
-    def on_item(item) -> None:
+    def on_item(item) -> None:  # owner: scheduler
         """Routes one completion-queue item: current-generation
         completions advance the run; stale ones are quarantined; stale
         exit markers (a zombie dying) are dropped."""
@@ -565,7 +565,7 @@ def run(test: dict) -> list[dict]:
             return  # only drain/shutdown send exits to live workers
         process_completion(payload)
 
-    def zombify(w) -> None:
+    def zombify(w) -> None:  # owner: scheduler
         """The one way a worker is given up on: mark it, leave an exit
         marker so a racing completion can't strand it on a dead queue,
         and put it on the books. The zombie closes its own client and
@@ -577,7 +577,7 @@ def run(test: dict) -> list[dict]:
             pass
         zombies.append(w)
 
-    def reap(thread, error) -> None:
+    def reap(thread, error) -> None:  # owner: scheduler
         """Deadline expiry: zombifies ``thread``'s worker, synthesizes
         the indeterminate :info completion for its in-flight op (which
         journals and renumbers like any crash), and spawns a replacement
@@ -600,7 +600,7 @@ def run(test: dict) -> list[dict]:
             w["gen"] + 1)
         process_completion({**op, "type": "info", "error": error})
 
-    def expire_deadlines(now_ns) -> list:
+    def expire_deadlines(now_ns) -> list:  # owner: scheduler
         """Reaps every thread whose per-op deadline has passed; returns
         the reaped thread ids."""
         expired = [(t, s) for t, (d, s) in list(deadlines.items())
@@ -609,7 +609,7 @@ def run(test: dict) -> list[dict]:
             reap(t, ["op-timeout", timeout_s])
         return [t for t, _ in expired]
 
-    def earliest_deadline_wait(now_ns) -> float | None:
+    def earliest_deadline_wait(now_ns) -> float | None:  # owner: scheduler
         if not deadlines:
             return None
         ddl = min(d for d, _ in deadlines.values())

@@ -9,6 +9,7 @@ cluster (SURVEY.md §4 tier 1).
 from __future__ import annotations
 
 import random
+import time as _time
 from typing import Callable
 
 from jepsen_tpu import generator as gen_mod
@@ -32,6 +33,10 @@ def simulate(
     complete_fn: Callable[[Context, dict], dict | None],
     ctx: Context | None = None,
     limit: int = 100_000,
+    *,
+    seed: int = 0,
+    max_wall_s: float | None = None,
+    stats: dict | None = None,
 ) -> list[dict]:
     """Simulates gen against model workers.
 
@@ -43,20 +48,48 @@ def simulate(
     Returns the full history: invokes and completions interleaved in time
     order, with generator updates and crashed-process renumbering applied
     exactly as the threaded interpreter would.
+
+    Termination is guaranteed: ``limit`` caps scheduler steps (each step
+    either emits an op, applies a completion, or breaks), ``seed`` makes
+    the default context's rng injectable (deterministic enumeration —
+    preflight and exact-sequence tests depend on it), and ``max_wall_s``
+    adds a hard wall-clock cap for generators whose per-op cost is
+    unbounded (preflight must never hang on a pathological generator);
+    on expiry the history collected so far is returned. A generator
+    stuck at :pending with nothing in flight is a deadlock and breaks
+    immediately rather than spinning.
+
+    Pass a dict as ``stats`` to learn HOW the simulation ended:
+    ``steps`` taken, and ``step_limited`` / ``wall_limited`` flags —
+    callers that must distinguish "generator exhausted" from "cap hit"
+    (preflight's GEN003 truncation diagnostic) read these instead of
+    guessing from history length.
     """
-    ctx = ctx or default_context(test)
+    ctx = ctx or default_context(test, seed=seed)
     g = as_gen(gen)
     history: list[dict] = []
     pending: list[dict] = []  # completion ops waiting for their time
+    if stats is None:
+        stats = {}
+    stats.update(steps=0, step_limited=False, wall_limited=False)
 
     def soonest_pending():
         if not pending:
             return None
         return min(pending, key=lambda o: o["time"])
 
+    deadline = (_time.monotonic() + max_wall_s
+                if max_wall_s is not None else None)
     steps = 0
-    while steps < limit:
+    while True:
+        if steps >= limit:
+            stats["step_limited"] = True
+            break
         steps += 1
+        stats["steps"] = steps
+        if deadline is not None and _time.monotonic() >= deadline:
+            stats["wall_limited"] = True
+            break
         comp = soonest_pending()
         res = g.op(test, ctx) if g is not None else None
         if res is None:
@@ -76,7 +109,11 @@ def simulate(
             g = g2
             continue
         if comp is not None and comp["time"] <= op["time"]:
-            g2, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+            # the completion happens first: apply it (updating the
+            # generator — an until_ok/on_update must see it) and
+            # reconsult; the op we were offered came from the
+            # pre-completion generator state and is NOT dispatched
+            g, ctx, _ = _apply_completion(test, g, ctx, comp, history)
             pending.remove(comp)
             continue
         # dispatch the op
@@ -126,21 +163,23 @@ def _completer(typ: str, latency_nanos: int):
     return complete
 
 
-def quick(test: dict, gen, ctx: Context | None = None) -> list[dict]:
+def quick(test: dict, gen, ctx: Context | None = None, **caps) -> list[dict]:
     """Zero-latency :ok completions — the fastest way to see what a
-    generator emits (generator/test.clj quick)."""
-    return simulate(test, gen, _completer("ok", 0), ctx)
+    generator emits (generator/test.clj quick). ``caps`` pass through to
+    :func:`simulate` (``seed``/``limit``/``max_wall_s`` — preflight's
+    bounded enumeration rides this)."""
+    return simulate(test, gen, _completer("ok", 0), ctx, **caps)
 
 
-def perfect(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0) -> list[dict]:
+def perfect(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0, **caps) -> list[dict]:
     """Fixed-latency :ok completions (generator/test.clj perfect)."""
-    return simulate(test, gen, _completer("ok", ms_to_nanos(latency_ms)), ctx)
+    return simulate(test, gen, _completer("ok", ms_to_nanos(latency_ms)), ctx, **caps)
 
 
-def perfect_info(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0) -> list[dict]:
+def perfect_info(test: dict, gen, ctx: Context | None = None, latency_ms: float = 10.0, **caps) -> list[dict]:
     """Fixed-latency :info (crashed) completions — exercises process
     renumbering (generator/test.clj perfect-info)."""
-    return simulate(test, gen, _completer("info", ms_to_nanos(latency_ms)), ctx)
+    return simulate(test, gen, _completer("info", ms_to_nanos(latency_ms)), ctx, **caps)
 
 
 def invocations(history: list[dict]) -> list[dict]:

@@ -39,7 +39,7 @@ LATE_NAME = "late.jsonl"
 DEFAULT_FSYNC_INTERVAL_S = 1.0
 
 
-class Journal:
+class Journal:  # durability: fsync
     """Append-only jsonl journal with interval fsync.
 
     ``append`` is called from the interpreter's scheduler thread only;

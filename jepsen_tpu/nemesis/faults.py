@@ -111,7 +111,7 @@ def classify(f) -> tuple[str | None, str | None]:
     return None, None
 
 
-class FaultRegistry:
+class FaultRegistry:  # durability: fsync
     """Append-only durable fault log. Thread-safe: nemesis ops arrive on
     the nemesis worker thread while teardown/replay run on the
     orchestrator thread."""

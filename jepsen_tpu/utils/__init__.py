@@ -110,6 +110,27 @@ class ExceptionHolder:
         self.exc: BaseException | None = None
 
 
+# Heartbeat interval for bounded-wait joins: long enough to never spam
+# a healthy run, short enough that a wedged thread is visible in the
+# log well before anyone reaches for SIGKILL.
+JOIN_HEARTBEAT_S = 30.0
+
+
+def join_noisy(thread: threading.Thread, what: str,
+               heartbeat_s: float = JOIN_HEARTBEAT_S) -> None:
+    """Joins ``thread`` with the same wait-forever semantics as a bare
+    ``join()``, but bounded per wait with a heartbeat log — the caller
+    (often the orchestrator/scheduler thread) is never wedged SILENTLY,
+    and a stuck thread is diagnosable from the log
+    (no-unbounded-block, doc/static-analysis.md)."""
+    waited = 0.0
+    while thread.is_alive():
+        thread.join(timeout=heartbeat_s)
+        if thread.is_alive():
+            waited += heartbeat_s
+            logger.warning("%s still running after %.0fs", what, waited)
+
+
 def real_pmap(fn: Callable, coll: Sequence) -> list:
     """Maps fn over coll in one thread per element; re-raises the first
     non-interrupt exception raised by any element (util.clj:65-78, dom-top's
@@ -133,7 +154,7 @@ def real_pmap(fn: Callable, coll: Sequence) -> list:
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        join_noisy(t, f"real_pmap element {t.name}")
     for e in errors:
         if e is not None:
             raise e
