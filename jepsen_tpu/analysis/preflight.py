@@ -305,6 +305,14 @@ _NUMERIC_KNOBS = (
     ("wal_fsync_interval", True, None),
     ("metrics_interval", True, None),
     ("time_limit", True, 0.0),
+    # live checker daemon knobs (doc/observability.md "Live checking");
+    # the daemon itself coerces tolerantly (live.daemon.coerce_knob) —
+    # preflight is where a garbage value becomes an error instead of a
+    # silently-defaulted warning
+    ("live_poll_s", True, 0.0),
+    ("live_lag_budget_ops", True, 0.0),
+    ("live_max_runs", True, 1.0),
+    ("live_check_budget_s", True, 0.0),
 )
 
 _UNSET = object()

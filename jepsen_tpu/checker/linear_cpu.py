@@ -75,6 +75,132 @@ class LinearResult:
     final_configs: list | None = None
 
 
+class FrontierSession:
+    """Resumable just-in-time linearization: the step loop of the CPU
+    twin, factored so a live checker can absorb events in chunks and
+    carry the frontier between polls (doc/observability.md "Live
+    checking"). ``check_stream`` is now a one-shot absorb over this
+    session, so the batch and streaming paths cannot diverge.
+
+    State between absorbs: the surviving configurations (linearized-
+    pending bitmask, model state), the open ops per slot, and the
+    pending mask. Once the frontier dies the session latches its
+    failure LinearResult; further absorbs are no-ops."""
+
+    def __init__(
+        self,
+        step: Callable[[int, int, int, int],
+                       tuple[int, bool]] = cas_register_step_py,
+        init_state: int = 0,
+        algorithm: str = "jitlin-cpu",
+    ):
+        self.step = step
+        self.algorithm = algorithm
+        self.configs: set[tuple[int, int]] = {(0, init_state)}
+        self.cur: dict[int, tuple[int, int, int]] = {}
+        self.cur_idx: dict[int, int] = {}  # slot -> history index of open op
+        self.pending_mask = 0
+        self.configs_max = 1
+        self.events_absorbed = 0
+        self.failure: LinearResult | None = None
+
+    def absorb(self, stream, start: int = 0,
+               end: int | None = None) -> LinearResult:
+        """Consumes events ``[start, end)`` of ``stream`` (any object
+        with kind/slot/f/a/b/op_index sequences + an intern table) and
+        returns the verdict so far. Event indices are absolute, so a
+        failure reports the same ``failed_event`` a one-shot check
+        would."""
+        if self.failure is not None:
+            return self.failure
+        if end is None:
+            end = len(stream.kind)
+        step = self.step
+        configs = self.configs
+        cur = self.cur
+        cur_idx = self.cur_idx
+        pending_mask = self.pending_mask
+        configs_max = self.configs_max
+        kinds, slots = stream.kind, stream.slot
+        fcol, acol, bcol, idxcol = stream.f, stream.a, stream.b, \
+            stream.op_index
+        for e in range(start, end):
+            kind = kinds[e]
+            if kind == EV_NOOP:
+                continue
+            s = int(slots[e])
+            bit = 1 << s
+            if kind == EV_INVOKE:
+                cur[s] = (int(fcol[e]), int(acol[e]), int(bcol[e]))
+                cur_idx[s] = int(idxcol[e])
+                pending_mask |= bit
+                continue
+            # EV_RETURN: closure, then require this op linearized
+            all_seen = set(configs)
+            frontier = configs
+            while frontier:
+                new = set()
+                for mask, state in frontier:
+                    avail = pending_mask & ~mask
+                    m = avail
+                    while m:
+                        low = m & (-m)
+                        m ^= low
+                        sl = low.bit_length() - 1
+                        f, a, b2 = cur[sl]
+                        st2, ok = step(state, f, a, b2)
+                        if ok:
+                            c2 = (mask | low, st2)
+                            if c2 not in all_seen:
+                                all_seen.add(c2)
+                                new.add(c2)
+                frontier = new
+            configs_max = max(configs_max, len(all_seen))
+            configs = {(mask & ~bit, state)
+                       for (mask, state) in all_seen if mask & bit}
+            pending_mask &= ~bit
+            if not configs:
+                def op_indices(mask):
+                    return [cur_idx[t] for t in cur_idx if mask & (1 << t)]
+
+                def state_val(st):
+                    try:
+                        return stream.intern.value(st)
+                    except (IndexError, AttributeError):
+                        return st
+
+                # the fatal op WAS pending when these configs died — its
+                # bit was cleared from pending_mask just above; restore it
+                fatal_pending = pending_mask | bit
+                finals = [{"state": state_val(state),
+                           "linearized": sorted(op_indices(mask)),
+                           "pending": sorted(
+                               op_indices(fatal_pending & ~mask))}
+                          for mask, state in sorted(all_seen)[:10]]
+                self.configs_max = configs_max
+                self.events_absorbed = e + 1
+                self.failure = LinearResult(
+                    valid=False, failed_event=e,
+                    failed_op_index=int(stream.op_index[e]),
+                    configs_max=configs_max, algorithm=self.algorithm,
+                    final_configs=finals,
+                )
+                return self.failure
+        self.configs = configs
+        self.pending_mask = pending_mask
+        self.configs_max = configs_max
+        self.events_absorbed = end
+        return self.result()
+
+    def result(self) -> LinearResult:
+        """The verdict over everything absorbed so far: valid-so-far, or
+        the latched failure."""
+        if self.failure is not None:
+            return self.failure
+        return LinearResult(valid=True, configs_max=self.configs_max,
+                            algorithm=self.algorithm)
+
+
 def check_stream(
     stream: EventStream,
     step: Callable[[int, int, int, int], tuple[int, bool]] = cas_register_step_py,
@@ -82,70 +208,9 @@ def check_stream(
 ) -> LinearResult:
     """Breadth-first JIT linearization: configs are (linearized-pending
     bitmask, state) pairs; closure is computed lazily before each return
-    event (Lowe's 'just-in-time linearization')."""
-    configs: set[tuple[int, int]] = {(0, init_state)}
-    cur: dict[int, tuple[int, int, int]] = {}
-    cur_idx: dict[int, int] = {}   # slot -> history index of its open op
-    pending_mask = 0
-    configs_max = 1
-    for e in range(len(stream)):
-        kind = stream.kind[e]
-        if kind == EV_NOOP:
-            continue
-        s = int(stream.slot[e])
-        bit = 1 << s
-        if kind == EV_INVOKE:
-            cur[s] = (int(stream.f[e]), int(stream.a[e]), int(stream.b[e]))
-            cur_idx[s] = int(stream.op_index[e])
-            pending_mask |= bit
-            continue
-        # EV_RETURN: closure, then require this op linearized
-        all_seen = set(configs)
-        frontier = configs
-        while frontier:
-            new = set()
-            for mask, state in frontier:
-                avail = pending_mask & ~mask
-                m = avail
-                while m:
-                    low = m & (-m)
-                    m ^= low
-                    sl = low.bit_length() - 1
-                    f, a, b2 = cur[sl]
-                    st2, ok = step(state, f, a, b2)
-                    if ok:
-                        c2 = (mask | low, st2)
-                        if c2 not in all_seen:
-                            all_seen.add(c2)
-                            new.add(c2)
-            frontier = new
-        configs_max = max(configs_max, len(all_seen))
-        configs = {(mask & ~bit, state) for (mask, state) in all_seen if mask & bit}
-        pending_mask &= ~bit
-        if not configs:
-            def op_indices(mask):
-                return [cur_idx[t] for t in cur_idx if mask & (1 << t)]
-
-            def state_val(st):
-                try:
-                    return stream.intern.value(st)
-                except (IndexError, AttributeError):
-                    return st
-
-            # the fatal op WAS pending when these configs died — its bit
-            # was cleared from pending_mask just above, so restore it
-            fatal_pending = pending_mask | bit
-            finals = [{"state": state_val(state),
-                       "linearized": sorted(op_indices(mask)),
-                       "pending": sorted(op_indices(fatal_pending & ~mask))}
-                      for mask, state in sorted(all_seen)[:10]]
-            return LinearResult(
-                valid=False, failed_event=e,
-                failed_op_index=int(stream.op_index[e]),
-                configs_max=configs_max, algorithm="jitlin-cpu",
-                final_configs=finals,
-            )
-    return LinearResult(valid=True, configs_max=configs_max, algorithm="jitlin-cpu")
+    event (Lowe's 'just-in-time linearization'). One-shot absorb over a
+    :class:`FrontierSession`."""
+    return FrontierSession(step=step, init_state=init_state).absorb(stream)
 
 
 # ---------------------------------------------------------------------------

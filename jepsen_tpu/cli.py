@@ -157,6 +157,12 @@ def single_test_cmd(
                                "from its write-ahead journal "
                                "(history.wal.jsonl), check it, and mark "
                                "the results incomplete")
+        p_an.add_argument("--no-live-reuse", action="store_true",
+                          dest="no_live_reuse",
+                          help="re-check from scratch even when the live "
+                               "checker daemon left a fresh final "
+                               "incremental verdict (live-status.json) "
+                               "for this run")
         add_test_opts(p_an)  # analyze takes the same opts (cli.clj:399-427)
         if opt_fn:
             opt_fn(p_an)
@@ -176,6 +182,38 @@ def single_test_cmd(
         p_serve.add_argument("--host", default="0.0.0.0")
         p_serve.add_argument("-p", "--port", type=int, default=8080)
         p_serve.add_argument("--store-dir", default="store")
+
+        p_live = sub.add_parser(
+            "live", help="online checker daemon: tail active runs' "
+                         "write-ahead journals and serve streaming "
+                         "verdicts (doc/observability.md)")
+        p_live.add_argument("dirs", nargs="*",
+                            help="store root and/or individual run "
+                                 "directories (store/<name>/<ts>); "
+                                 "defaults to --store-dir")
+        p_live.add_argument("--store-dir", default="store")
+        p_live.add_argument("--poll", dest="live_poll_s", default=None,
+                            help="seconds between WAL polls (default 1)")
+        p_live.add_argument("--lag-budget-ops", dest="live_lag_budget_ops",
+                            default=None,
+                            help="lag budget in ops; beyond it a run's "
+                                 "status flags over_lag_budget")
+        p_live.add_argument("--max-runs", dest="live_max_runs",
+                            default=None,
+                            help="admission cap on concurrently tracked "
+                                 "runs (default 16)")
+        p_live.add_argument("--check-budget", dest="live_check_budget_s",
+                            default=None,
+                            help="per-poll verdict budget in predicted "
+                                 "CPU seconds (cost-model admission)")
+        p_live.add_argument("--accelerator", default="auto",
+                            choices=["auto", "cpu", "tpu"])
+        p_live.add_argument("--once", action="store_true",
+                            help="poll until every tracked run "
+                                 "finalizes, then exit")
+        p_live.add_argument("--timeout", type=float, default=0.0,
+                            help="with --once: give up after this many "
+                                 "seconds (0 = wait forever)")
 
         p_pre = sub.add_parser(
             "preflight", help="validate the test map without running it "
@@ -244,6 +282,8 @@ def single_test_cmd(
                 from jepsen_tpu.web import serve
                 serve(opts.store_dir, opts.host, opts.port)
                 return EXIT_OK
+            if opts.command == "live":
+                return live_cmd(opts)
             return EXIT_BAD_ARGS
         except KeyboardInterrupt:
             return EXIT_CRASH
@@ -272,6 +312,55 @@ def _resolve_run(opts) -> tuple[str, str] | None:
         print("no stored tests found", file=sys.stderr)
         return None
     return found[0], found[1]
+
+
+def live_cmd(opts) -> int:
+    """``jepsen-tpu live``: runs the online checker daemon over a store
+    root and/or explicit run directories (doc/observability.md, "Live
+    checking")."""
+    from pathlib import Path
+
+    from jepsen_tpu.live import daemon as live_daemon
+
+    store_root = opts.store_dir
+    run_dirs: list = []
+    for d in getattr(opts, "dirs", None) or ():
+        p = Path(d)
+        # a run dir holds (or held) a WAL / history; anything else is a
+        # store root (last one wins, mirroring heal_cmd's dir handling)
+        if (p / live_daemon.WAL_NAME).exists() or \
+                (p / "history.jsonl").exists() or \
+                (p / "test.json").exists():
+            run_dirs.append(p)
+        else:
+            store_root = str(p)
+    kw = {
+        "poll_s": opts.live_poll_s,
+        "lag_budget_ops": opts.live_lag_budget_ops,
+        "max_runs": opts.live_max_runs,
+        "check_budget_s": opts.live_check_budget_s,
+        "accelerator": opts.accelerator,
+    }
+    if getattr(opts, "once", False):
+        daemon = live_daemon.LiveDaemon(store_root=store_root,
+                                        run_dirs=run_dirs, **kw)
+        timeout = opts.timeout if opts.timeout and opts.timeout > 0 \
+            else 3600.0
+        statuses = daemon.run_until_idle(timeout_s=timeout)
+        daemon.stop()
+        for label, s in sorted(statuses.items()):
+            print(f"{label}: {s['state']} valid_so_far="
+                  f"{s['valid_so_far']} first_anomaly_op="
+                  f"{s['first_anomaly_op']} lag_ops={s['lag_ops']}")
+        worst = EXIT_OK
+        for s in statuses.values():
+            if s.get("valid_so_far") is False:
+                worst = max(worst, EXIT_INVALID)
+            elif s.get("valid_so_far") not in (True, False):
+                worst = max(worst, EXIT_UNKNOWN)
+        return worst
+    live_daemon.serve(store_root, run_dirs=run_dirs, **kw)
+    return EXIT_OK
 
 
 def analyze_cmd(opts, test_fn) -> int:
@@ -318,6 +407,10 @@ def analyze_cmd(opts, test_fn) -> int:
     # fresh checker from the suite's constructor
     fresh = test_fn(opts)
     stored["checker"] = fresh.get("checker")
+    # a live-daemon-tracked run leaves its final incremental verdict in
+    # live-status.json; analyze reuses it when fresh (same op count)
+    # unless --no-live-reuse re-checks from scratch
+    stored["live_reuse"] = not getattr(opts, "no_live_reuse", False)
     test = core.analyze(stored)
     core.log_results(test)
     print(f"valid?: {(test.get('results') or {}).get('valid?')}")

@@ -222,12 +222,58 @@ def _maybe_profile(test: dict):
         yield
 
 
+def _live_final_results(test: dict, checker) -> dict | None:
+    """The live daemon's final incremental verdict for this run, when it
+    is *fresh* (final state covering exactly this history) and the
+    checker is one whose live session computes the same result shape —
+    a bare LinearizableChecker or elle AppendChecker. Anything else
+    (composed checkers, stats/timeline bundles, recovered histories)
+    re-checks from scratch; reuse must never lose a sub-result."""
+    if not test.get("live_reuse") or test.get("wal_recovered"):
+        return None
+    try:
+        from jepsen_tpu.checker.linearizable import LinearizableChecker
+        from jepsen_tpu.live.daemon import load_live_status
+        from jepsen_tpu.workloads.append import AppendChecker
+        if not isinstance(checker, (LinearizableChecker, AppendChecker)):
+            return None
+        status = load_live_status(store.test_dir(test))
+        if not status or status.get("state") != "final":
+            return None
+        results = status.get("results")
+        if not isinstance(results, dict) or "valid?" not in results:
+            return None
+        if status.get("ops_absorbed") != len(test.get("history") or []):
+            return None  # stale: the history grew/shrank since finalize
+        workload = status.get("workload")
+        # NOT register-independent: a bare LinearizableChecker on a
+        # key-lifted history computes something else entirely (the
+        # supported lifted config is IndependentChecker, which fails
+        # the isinstance gate above) — reuse must not diverge from
+        # what --no-live-reuse would compute
+        if isinstance(checker, LinearizableChecker) and \
+                workload != "register":
+            return None
+        if isinstance(checker, AppendChecker) and workload != "list-append":
+            return None
+        logger.info("reusing live daemon's final incremental verdict "
+                    "(live-status.json, %d ops); --no-live-reuse "
+                    "re-checks from scratch", status.get("ops_absorbed"))
+        return {**results, "live-reused": True}
+    except Exception:  # noqa: BLE001 — reuse is an optimization, never a risk
+        logger.exception("live-verdict reuse probe failed; re-checking")
+        return None
+
+
 def analyze(test: dict) -> dict:
     """Indexes the history, runs the checker, persists results
     (core.clj:221-236), and exports the telemetry snapshot
     (metrics.prom + metrics.json + metrics-summary.txt) into the store
     dir. Standalone re-analysis (cli analyze) gets its own registry so
-    checker metrics are captured there too."""
+    checker metrics are captured there too. A run the live daemon
+    tracked to completion can skip the re-check entirely:
+    ``live_reuse`` (cli analyze's default) adopts the daemon's final
+    incremental verdict when it exactly covers this history."""
     logger.info("Analyzing...")
     history = history_mod.index(test.get("history") or [])
     test["history"] = history
@@ -238,7 +284,10 @@ def analyze(test: dict) -> dict:
         reg = telemetry.Registry()
         prev = telemetry.install(reg)
     try:
-        if checker is not None:
+        reused = _live_final_results(test, checker)
+        if reused is not None:
+            test["results"] = reused
+        elif checker is not None:
             with _maybe_profile(test):
                 test["results"] = check_safe(checker, test, history, {})
         else:

@@ -452,6 +452,26 @@ def _build_py(history: list):
                 f_kid.append(kid(m[1]))
                 f_val.append(v)
 
+    return _assemble(txns=txns, n_ok=n_ok, raw_key=raw_key,
+                     a_txn=a_txn, a_kid=a_kid, a_val=a_val, a_mi=a_mi,
+                     r_txn=r_txn, r_kid=r_kid, r_mi=r_mi,
+                     payloads=payloads, f_kid=f_kid, f_val=f_val,
+                     node_pos=node_pos, node_inv=node_inv,
+                     node_proc=node_proc)
+
+
+def _assemble(*, txns, n_ok, raw_key, a_txn, a_kid, a_val, a_mi,
+              r_txn, r_kid, r_mi, payloads, f_kid, f_val,
+              node_pos, node_inv, node_proc):
+    """Array build + spine selection + prefix verification over flattened
+    micro-op columns, ending in the shared :func:`_tail`. Factored out of
+    ``_build_py`` so the live checker's incremental builder
+    (jepsen_tpu.live.sessions.ElleSession) — which maintains the
+    flattened columns op by op as a run's WAL streams in — reuses the
+    exact batch semantics for every verdict. Returns the ``_build``
+    4-tuple or None on a regime miss (caller falls back to the Python
+    builder)."""
+    n = len(txns)
     nk = len(raw_key)
     if nk >= _MAX_KIDS:
         return None

@@ -1,0 +1,22 @@
+"""Online consistency checking: tail a run's WAL, verdict as it runs.
+
+The reference checks strictly post-hoc (``analyze!`` runs only after the
+run ends — core.clj:221-236); this package turns checking into
+*monitoring*. A daemon (:mod:`jepsen_tpu.live.daemon`) discovers active
+runs under a store root, tails each run's ``history.wal.jsonl`` with an
+incremental offset-tracking reader (:class:`jepsen_tpu.journal.
+WalTailer`), and maintains per-run incremental checker state
+(:mod:`jepsen_tpu.live.sessions`): a resumable linearizability frontier
+and an incrementally-built Elle dependency graph. Each poll publishes
+live verdicts ("valid so far" / "first anomaly at op N"), lag, and
+backend telemetry into a metrics registry and a per-run
+``live-status.json`` the web UI renders (doc/observability.md, "Live
+checking").
+"""
+from jepsen_tpu.live.daemon import (  # noqa: F401
+    LIVE_STATUS_NAME, LiveDaemon, RunTracker, load_live_status,
+)
+from jepsen_tpu.live.sessions import (  # noqa: F401
+    ElleSession, LinearLiveSession, MultiKeyLinearSession, UNSUPPORTED,
+    session_for_ops,
+)

@@ -1,0 +1,563 @@
+"""The online checker daemon: discovery, polling, admission, status.
+
+``jepsen-tpu live [store-root|run-dir ...]`` runs a single poller
+thread that:
+
+1. **discovers** active runs — run directories holding a
+   ``history.wal.jsonl`` with no final live verdict yet;
+2. **tails** each run's WAL via :class:`jepsen_tpu.journal.WalTailer`
+   (offset-tracking, torn-line tolerant);
+3. **checks** each run incrementally through its
+   :mod:`~jepsen_tpu.live.sessions` session, under **cost-model-driven
+   admission**: one poll's verdict work is budgeted by the measured CPU
+   checking rate (:class:`jepsen_tpu.parallel.pipeline.CostModel`), the
+   most-lagged runs are served first, and a hot run consumes at most
+   its fair share — the rest defer with a counted metric instead of
+   starving;
+4. **publishes** per-run ``live-status.json`` (atomic) plus
+   ``live_*`` gauges/histograms into its metrics registry, exported as
+   ``live-metrics.prom`` / ``live-metrics.json`` under the store root;
+5. **finalizes** a run when its authoritative ``history.jsonl``
+   appears: any tail the discarded WAL didn't deliver is absorbed from
+   the history file, the session settles its exact final verdict, and
+   the final state is left in ``live-status.json`` for ``cli analyze``
+   to reuse when fresh.
+
+Shutdown is wedge-proof: ``stop()`` signals the poller and joins it
+with :func:`jepsen_tpu.utils.join_noisy` (bounded waits + heartbeat
+logging; the thread itself is a daemon thread, so a hung check can
+never hold the process hostage). Per-run circuit breakers (mirroring
+the checker ladder's policy) stop re-dispatching a session that failed
+``LIVE_BREAKER_THRESHOLD`` consecutive polls.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.journal import WAL_NAME, WalTailer
+from jepsen_tpu.live import sessions as sessions_mod
+from jepsen_tpu.utils import join_noisy
+
+logger = logging.getLogger("jepsen.live")
+
+LIVE_STATUS_NAME = "live-status.json"
+
+DEFAULT_POLL_S = 1.0
+DEFAULT_LAG_BUDGET_OPS = 50_000
+DEFAULT_MAX_RUNS = 16
+DEFAULT_CHECK_BUDGET_S = 0.5
+LIVE_BREAKER_THRESHOLD = 3
+
+# live knob spec shared with preflight's KNB validation
+# (analysis/preflight._NUMERIC_KNOBS): (key, default, min)
+LIVE_KNOBS = (
+    ("live_poll_s", DEFAULT_POLL_S, 0.0),
+    ("live_lag_budget_ops", DEFAULT_LAG_BUDGET_OPS, 0.0),
+    ("live_max_runs", DEFAULT_MAX_RUNS, 1.0),
+    ("live_check_budget_s", DEFAULT_CHECK_BUDGET_S, 0.0),
+)
+
+
+def coerce_knob(name: str, value, default: float, lo: float) -> float:
+    """Tolerant numeric-knob coercion: strings parse, garbage logs a
+    warning and falls back to the default — the daemon must come up on
+    a half-garbled config, and preflight (KNB001/KNB002) is where the
+    strictness lives."""
+    if value is None:
+        return default
+    try:
+        if isinstance(value, bool):
+            raise ValueError("bool is not a number")
+        v = float(value)
+    except (TypeError, ValueError):
+        logger.warning("live knob %s=%r is not numeric; using default "
+                       "%r", name, value, default)
+        return default
+    if v < lo:
+        logger.warning("live knob %s=%r below minimum %r; clamping",
+                       name, value, lo)
+        return lo
+    return v
+
+
+def load_live_status(run_dir) -> dict | None:
+    """The run's live-status.json as a dict, or None."""
+    try:
+        with open(Path(run_dir) / LIVE_STATUS_NAME) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class RunTracker:
+    """One tracked run: tailer + session + status/metric publication."""
+
+    def __init__(self, run_dir, accelerator: str = "auto"):
+        self.run_dir = Path(run_dir)
+        self.name = self.run_dir.parent.name
+        self.timestamp = self.run_dir.name
+        self.accelerator = accelerator
+        self.tailer = WalTailer(self.run_dir / WAL_NAME)
+        self.session = None
+        self._sniff_buf: list[dict] = []
+        self.unsupported = False
+        self.final = False
+        self.broken: str | None = None
+        self._consecutive_failures = 0
+        self.ops_absorbed = 0
+        self.polls = 0
+        self._caught_up_t = time.monotonic()
+        # valid_so_far stays None (-> live_verdict -1, "unknown") until
+        # a session actually verdicts: an untracked workload or a run
+        # the breaker broke before its first check must never read as
+        # "valid" (doc/observability.md's live_verdict semantics)
+        self.last_verdict: dict = {"valid_so_far": None,
+                                   "first_anomaly_op": None,
+                                   "backend": None, "checked_ops": 0}
+
+    @property
+    def label(self) -> str:
+        return f"{self.name}/{self.timestamp}"
+
+    # -- ingestion ------------------------------------------------------
+
+    def _absorb(self, ops: list[dict]) -> None:
+        if not ops:
+            return
+        self.ops_absorbed += len(ops)
+        if self.unsupported:
+            return
+        if self.session is None:
+            self._sniff_buf.extend(ops)
+            sniffed = sessions_mod.session_for_ops(
+                self._sniff_buf, accelerator=self.accelerator)
+            if sniffed is sessions_mod.UNSUPPORTED:
+                # this workload has no live checker — keep tailing for
+                # lag/liveness, never verdicts
+                self.unsupported = True
+                self._sniff_buf = []
+            elif sniffed is not None:
+                self.session = sniffed
+                for op in self._sniff_buf:
+                    self.session.add(op)
+                self._sniff_buf = []
+            return
+        for op in ops:
+            self.session.add(op)
+
+    def tail(self) -> int:
+        """One tailer poll; returns the number of new ops."""
+        ops = self.tailer.poll()
+        self._absorb(ops)
+        return len(ops)
+
+    def completed(self) -> bool:
+        return (self.run_dir / "history.jsonl").exists()
+
+    # -- checking -------------------------------------------------------
+
+    @property
+    def pending_ops(self) -> int:
+        checked = (self.session.checked_ops if self.session is not None
+                   else self.ops_absorbed)
+        return max(0, self.ops_absorbed - checked)
+
+    def lag_seconds(self, now: float) -> float:
+        return 0.0 if self.pending_ops == 0 else now - self._caught_up_t
+
+    def check(self) -> dict:
+        """One verdict dispatch over everything absorbed so far."""
+        if self.session is None or self.broken:
+            return dict(self.last_verdict)
+        try:
+            v = self.session.verdict()
+            self._consecutive_failures = 0
+        except Exception as e:  # noqa: BLE001 — one bad run can't kill the daemon
+            self._consecutive_failures += 1
+            logger.exception("live check failed for %s", self.label)
+            if self._consecutive_failures >= LIVE_BREAKER_THRESHOLD:
+                self.broken = f"checker breaker open: {e!r}"
+                logger.warning("live breaker open for %s after %d "
+                               "consecutive failures", self.label,
+                               self._consecutive_failures)
+            return dict(self.last_verdict)
+        self.last_verdict = v
+        if self.pending_ops == 0:
+            self._caught_up_t = time.monotonic()
+        return dict(v)
+
+    def finalize(self) -> dict | None:
+        """End-of-run: absorb any ops the discarded WAL never delivered
+        (from the authoritative history.jsonl), settle the exact final
+        verdict, and return the final results map (None when the run
+        has no live checker)."""
+        from jepsen_tpu.journal import read_jsonl_tolerant
+        self.tail()
+        try:
+            ops, _ = read_jsonl_tolerant(self.run_dir / "history.jsonl")
+        except OSError:
+            ops = []
+        if self.tailer.torn_skipped or self.tailer.truncated_tail:
+            # a torn WAL line means what we absorbed is NOT a strict
+            # prefix of the authoritative history — a count-based
+            # back-fill would misalign the session (skip the torn op,
+            # double the tail). Rebuild from history.jsonl: slower,
+            # exact, and the final verdict stays safe to reuse.
+            logger.warning(
+                "live: %s WAL had %d torn line(s); rebuilding the "
+                "session from history.jsonl for the final verdict",
+                self.label, self.tailer.torn_skipped)
+            self.session = None
+            self._sniff_buf = []
+            self.unsupported = False
+            self.ops_absorbed = 0
+            self._absorb(ops)
+        elif len(ops) > self.ops_absorbed:
+            self._absorb(ops[self.ops_absorbed:])
+        self.final = True
+        if self.session is None or self.broken:
+            return None
+        try:
+            results = self.session.finalize()
+            self.last_verdict = self.session.last()
+            return results
+        except Exception:  # noqa: BLE001
+            logger.exception("live finalize failed for %s", self.label)
+            self.broken = "finalize failed"
+            return None
+
+    # -- status ---------------------------------------------------------
+
+    def status(self, lag_budget_ops: float, results: dict | None = None,
+               now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        state = ("error" if self.broken
+                 else "final" if self.final
+                 else "untracked" if self.unsupported or self.session is None
+                 else "tailing")
+        out = {
+            "name": self.name,
+            "timestamp": self.timestamp,
+            "state": state,
+            "workload": (self.session.workload
+                         if self.session is not None else None),
+            "valid_so_far": self.last_verdict.get("valid_so_far"),
+            "first_anomaly_op": self.last_verdict.get("first_anomaly_op"),
+            "backend": self.last_verdict.get("backend"),
+            "ops_absorbed": self.ops_absorbed,
+            "checked_ops": (self.session.checked_ops
+                            if self.session is not None else 0),
+            "lag_ops": self.pending_ops,
+            "lag_s": round(self.lag_seconds(now), 3),
+            "lag_budget_ops": lag_budget_ops,
+            "over_lag_budget": self.pending_ops > lag_budget_ops,
+            "torn_skipped": self.tailer.torn_skipped,
+            "polls": self.polls,
+            "updated": time.time(),
+        }
+        if self.broken:
+            out["error"] = self.broken
+        if results is not None:
+            out["results"] = results
+        return out
+
+    def write_status(self, status: dict) -> None:
+        try:
+            telemetry._atomic_write(
+                self.run_dir / LIVE_STATUS_NAME,
+                json.dumps(status, default=repr) + "\n")
+        except Exception:  # noqa: BLE001 — status publication never kills polls
+            logger.exception("couldn't write %s for %s",
+                             LIVE_STATUS_NAME, self.label)
+
+
+class LiveDaemon:
+    """Multiplexes live checking over every active run under a store
+    root (and/or explicitly named run directories)."""
+
+    def __init__(self, store_root: str | None = None, run_dirs=(),
+                 poll_s=DEFAULT_POLL_S,
+                 lag_budget_ops=DEFAULT_LAG_BUDGET_OPS,
+                 max_runs=DEFAULT_MAX_RUNS,
+                 check_budget_s=DEFAULT_CHECK_BUDGET_S,
+                 accelerator: str = "auto",
+                 registry: telemetry.Registry | None = None,
+                 cost_model=None):
+        self.store_root = Path(store_root) if store_root else None
+        self.run_dirs = [Path(d) for d in run_dirs]
+        self.poll_s = coerce_knob("live_poll_s", poll_s,
+                                  DEFAULT_POLL_S, 0.0)
+        self.lag_budget_ops = coerce_knob(
+            "live_lag_budget_ops", lag_budget_ops,
+            DEFAULT_LAG_BUDGET_OPS, 0.0)
+        self.max_runs = int(coerce_knob("live_max_runs", max_runs,
+                                        DEFAULT_MAX_RUNS, 1.0))
+        self.check_budget_s = coerce_knob(
+            "live_check_budget_s", check_budget_s,
+            DEFAULT_CHECK_BUDGET_S, 0.0)
+        self.accelerator = accelerator
+        self.registry = registry if registry is not None \
+            else telemetry.Registry()
+        if cost_model is None:
+            from jepsen_tpu.parallel.pipeline import CostModel
+            cost_model = CostModel()
+        self.cost_model = cost_model
+        self.trackers: dict[str, RunTracker] = {}
+        self.polls = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()  # guards trackers vs. stop/inspect
+
+    # -- discovery ------------------------------------------------------
+
+    def _candidate_dirs(self) -> list[Path]:
+        out = list(self.run_dirs)
+        root = self.store_root
+        if root is not None and root.is_dir():
+            for name_dir in root.iterdir():
+                if not name_dir.is_dir() or name_dir.name == "current" \
+                        or name_dir.is_symlink():
+                    continue
+                for run_dir in name_dir.iterdir():
+                    if run_dir.is_dir() and not run_dir.is_symlink() \
+                            and run_dir.name != "latest":
+                        out.append(run_dir)
+        return out
+
+    def discover(self) -> int:
+        """Adds trackers for active runs (WAL present, not yet final),
+        newest first, bounded by ``live_max_runs``. Returns the number
+        of newly-admitted runs."""
+        added = 0
+        cands = []
+        for d in self._candidate_dirs():
+            key = str(d)
+            if key in self.trackers:
+                continue
+            if not (d / WAL_NAME).exists():
+                continue
+            status = load_live_status(d)
+            if status is not None and status.get("state") == "final":
+                continue  # a previous daemon already settled this run
+            if (d / "history.jsonl").exists() and status is None \
+                    and d not in self.run_dirs:
+                # completed before we ever saw it: post-hoc territory
+                continue
+            try:
+                mtime = (d / WAL_NAME).stat().st_mtime
+            except OSError:
+                continue
+            cands.append((mtime, d))
+        cands.sort(reverse=True)
+        for _mtime, d in cands:
+            with self._lock:
+                if len(self.trackers) >= self.max_runs:
+                    self.registry.counter(
+                        "live_admission_rejected_total",
+                        "runs not admitted because live_max_runs "
+                        "trackers are active").inc()
+                    break
+                self.trackers[str(d)] = RunTracker(
+                    d, accelerator=self.accelerator)
+            added += 1
+            logger.info("live: tracking %s", d)
+        return added
+
+    # -- polling --------------------------------------------------------
+
+    def poll_once(self) -> dict:  # owner: scheduler
+        """One full poll: discover, tail everything, verdict within the
+        admission budget (most-lagged first), publish status + metrics.
+        Returns a {label: status} snapshot."""
+        t0 = time.perf_counter()
+        self.polls += 1
+        self.discover()
+        reg = self.registry
+        now = time.monotonic()
+        with self._lock:
+            trackers = list(self.trackers.values())
+        statuses: dict[str, dict] = {}
+        done: list[str] = []
+
+        for tr in trackers:
+            n = tr.tail()
+            if n:
+                reg.counter("live_ops_tailed_total",
+                            "ops read from run WALs", labels=("run",)
+                            ).inc(n, run=tr.label)
+
+        # admission: serve the most-lagged runs first; a poll spends at
+        # most live_check_budget_s of predicted CPU checking time, so
+        # one hot run defers instead of starving its neighbours
+        budget_ops = self.cost_model.admission_budget_ops(
+            self.check_budget_s)
+        spent_ops = 0.0
+        order = sorted(trackers, key=lambda t: t.pending_ops,
+                       reverse=True)
+        for tr in order:
+            tr.polls += 1
+            results = None
+            pending = tr.pending_ops
+            if tr.completed() and not tr.final:
+                t_chk = time.perf_counter()
+                results = tr.finalize()
+                self._observe_check(tr, pending,
+                                    time.perf_counter() - t_chk)
+                done.append(str(tr.run_dir))
+            elif tr.final:
+                done.append(str(tr.run_dir))
+            elif pending > 0 and tr.session is not None \
+                    and not tr.broken:
+                if spent_ops > 0 and spent_ops + pending > budget_ops:
+                    reg.counter(
+                        "live_admission_deferred_total",
+                        "verdicts deferred to a later poll by the "
+                        "admission budget", labels=("run",)
+                        ).inc(run=tr.label)
+                else:
+                    t_chk = time.perf_counter()
+                    tr.check()
+                    dt = time.perf_counter() - t_chk
+                    self._observe_check(tr, pending, dt)
+                    spent_ops += pending
+            status = tr.status(self.lag_budget_ops, results=results,
+                               now=now)
+            tr.write_status(status)
+            statuses[tr.label] = status
+            self._export_run_gauges(tr, status)
+
+        with self._lock:
+            for key in done:
+                self.trackers.pop(key, None)
+            active = len(self.trackers)
+        reg.gauge("live_runs_active",
+                  "runs currently tracked by the live checker"
+                  ).set(active)
+        reg.counter("live_polls_total", "daemon poll loops").inc()
+        reg.histogram("live_poll_seconds",
+                      "wall time of one full daemon poll"
+                      ).observe(time.perf_counter() - t0)
+        self._export()
+        return statuses
+
+    def _observe_check(self, tr: RunTracker, n_ops: int,
+                       seconds: float) -> None:
+        reg = self.registry
+        workload = (tr.session.workload if tr.session is not None
+                    else "none")
+        reg.histogram("live_check_seconds",
+                      "incremental verdict dispatch wall time",
+                      labels=("workload",)).observe(seconds,
+                                                    workload=workload)
+        if n_ops > 0 and seconds > 0:
+            # feed the shared cost model so admission budgets track the
+            # measured host instead of the built-in default
+            from jepsen_tpu.parallel.pipeline import observe_cpu_rate
+            observe_cpu_rate(n_ops, seconds)
+
+    def _export_run_gauges(self, tr: RunTracker, status: dict) -> None:
+        reg = self.registry
+        run = tr.label
+        reg.gauge("live_checker_lag_ops",
+                  "ops absorbed but not yet covered by a verdict",
+                  labels=("run",)).set(status["lag_ops"], run=run)
+        reg.gauge("live_checker_lag_s",
+                  "seconds since this run's checker last caught up",
+                  labels=("run",)).set(status["lag_s"], run=run)
+        valid = status.get("valid_so_far")
+        reg.gauge("live_verdict",
+                  "1 valid-so-far, 0 invalid, -1 unknown/untracked",
+                  labels=("run",)).set(
+            1.0 if valid is True else 0.0 if valid is False else -1.0,
+            run=run)
+        first = status.get("first_anomaly_op")
+        reg.gauge("live_first_anomaly_op",
+                  "history index of the first anomaly (-1: none found)",
+                  labels=("run",)).set(
+            -1.0 if first is None else float(first), run=run)
+        if tr.broken:
+            reg.gauge("live_run_breaker_open",
+                      "1 while a run's checker circuit breaker is open",
+                      labels=("run",)).set(1.0, run=run)
+
+    def _export(self) -> None:
+        d = self.store_root
+        if d is None:
+            d = (self.run_dirs[0].parent.parent if self.run_dirs
+                 else None)
+        if d is None:
+            return
+        try:
+            self.registry.export(d, prefix="live-metrics")
+        except Exception:  # noqa: BLE001 — export never stops the poller
+            logger.exception("live metrics export failed")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _loop(self) -> None:  # owner: scheduler
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the poller must survive anything
+                logger.exception("live poll failed")
+            rest = self.poll_s - (time.monotonic() - t0)
+            if rest > 0:
+                self._stop.wait(rest)
+
+    def start(self) -> "LiveDaemon":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="jepsen-live-poller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Wedge-proof shutdown: signal, then join with bounded-wait
+        heartbeats (utils.join_noisy); one final metrics export."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            join_noisy(t, "live daemon poller", heartbeat_s=5.0)
+            self._thread = None
+        self._export()
+
+    def run_until_idle(self, timeout_s: float = 60.0) -> dict:
+        """Foreground helper (tests, ``--once``): polls until every
+        tracked run has finalized (or ``timeout_s`` elapses); returns
+        the last status snapshot."""
+        deadline = time.monotonic() + timeout_s
+        statuses: dict = {}
+        while time.monotonic() < deadline:
+            statuses = self.poll_once()
+            with self._lock:
+                active = len(self.trackers)
+            if not active:
+                break
+            # honor the configured cadence (--poll): a foreground --once
+            # over long-running tests must not re-scan/re-export at 20 Hz
+            time.sleep(min(self.poll_s,
+                           max(0.0, deadline - time.monotonic())))
+        return statuses
+
+
+def serve(store_root: str | None, run_dirs=(), **kw) -> None:
+    """``jepsen-tpu live``: runs the daemon in the foreground until
+    interrupted."""
+    daemon = LiveDaemon(store_root=store_root, run_dirs=run_dirs, **kw)
+    daemon.start()
+    logger.info("live checker daemon polling every %.3gs (ctrl-C stops)",
+                daemon.poll_s)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.stop()

@@ -147,6 +147,13 @@ class CostModel:
         return ("cpu" if self.cpu_seconds(total_events)
                 < self.device_floor_seconds() else "device")
 
+    def admission_budget_ops(self, seconds: float) -> float:
+        """How many events the CPU lane can verify in ``seconds`` — the
+        live daemon's per-poll admission budget (one hot run may spend
+        at most its share of this before the rest defer; the measured
+        EWMA keeps it honest as the host load shifts)."""
+        return max(0.0, seconds) * self.cpu_rate()
+
 
 _DEFAULT_MODEL = CostModel()
 

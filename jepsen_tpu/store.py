@@ -28,9 +28,10 @@ NONSERIALIZABLE_KEYS = {
 }
 
 # Telemetry artifacts a run may leave next to history/results
-# (see doc/observability.md): exported metrics, the span log, and the
-# jax.profiler trace directory.
-TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl")
+# (see doc/observability.md): exported metrics, the span log, the live
+# checker daemon's streaming verdict, and the jax.profiler trace dir.
+TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl",
+                   "live-status.json")
 PROFILE_DIR = "profile"
 
 # Robustness forensics (doc/robustness.md): completions quarantined

@@ -10,6 +10,7 @@ import html
 import io
 import json
 import logging
+import time
 import urllib.parse
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -28,8 +29,20 @@ td, th { padding: 0.3em 0.8em; border: 1px solid #ddd; text-align: left; }
 .valid-unknown { background: #f7eec5; }
 .badge-incomplete { background: #e0d5f7; border-radius: 0.6em;
   padding: 0.05em 0.5em; font-size: 0.85em; }
+.badge-live { background: #c5e3f7; border-radius: 0.6em;
+  padding: 0.05em 0.5em; font-size: 0.85em; }
+.live-panel { border: 1px solid #9cc; background: #f2fafc;
+  padding: 0.6em 1em; margin: 0.5em 0; }
 a { text-decoration: none; }
 """
+
+# a live-status.json older than this is a dead daemon's leftover, not a
+# live run; the home section and the auto-refresh both key off it
+LIVE_FRESH_S = 60.0
+
+# run pages with an actively-tailed live panel meta-refresh at this
+# cadence; the ETag/304 path keeps the refresh nearly free
+LIVE_REFRESH_S = 2
 
 
 _VALIDITY_CACHE: dict[str, tuple[int, object, bool]] = {}
@@ -112,6 +125,101 @@ def _metrics_table(path: Path) -> str:
             "</tr>" + "".join(cells) + "</table>" + extra)
 
 
+def _load_live_status(run_dir: Path) -> dict | None:
+    try:
+        with open(run_dir / "live-status.json") as f:
+            s = json.load(f)
+        return s if isinstance(s, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _live_is_fresh(status: dict) -> bool:
+    try:
+        return time.time() - float(status.get("updated", 0)) < LIVE_FRESH_S
+    except (TypeError, ValueError):
+        return False
+
+
+def _live_panel(target: Path) -> tuple[str, bool]:
+    """(panel html, actively-live?) for a run page: the live checker
+    daemon's streaming verdict — valid-so-far / first-anomaly-at-op-N,
+    lag, and backend rung (doc/observability.md, "Live checking")."""
+    status = _load_live_status(target)
+    if status is None:
+        return "", False
+    live = status.get("state") == "tailing" and _live_is_fresh(status)
+    valid = status.get("valid_so_far")
+    if valid is True:
+        verdict = f"valid so far ({status.get('checked_ops', 0)} ops checked)"
+    elif valid is False:
+        first = status.get("first_anomaly_op")
+        verdict = (f"INVALID — first anomaly at op {first}"
+                   if first is not None else "INVALID")
+    else:
+        verdict = f"unknown ({html.escape(str(status.get('state')))})"
+    rows = [
+        ("verdict", verdict),
+        ("state", status.get("state")),
+        ("workload", status.get("workload")),
+        ("lag", f"{status.get('lag_ops', 0)} op(s) / "
+                f"{status.get('lag_s', 0)} s"
+                + (" — OVER BUDGET" if status.get("over_lag_budget")
+                   else "")),
+        ("backend", status.get("backend")),
+        ("ops", f"{status.get('checked_ops', 0)} checked of "
+                f"{status.get('ops_absorbed', 0)} absorbed"),
+    ]
+    if status.get("torn_skipped"):
+        rows.append(("torn lines skipped", status.get("torn_skipped")))
+    cells = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>" for k, v in rows)
+    badge = " <span class='badge-live'>live</span>" if live else ""
+    panel = (f"<div class='live-panel'><h2>live checking{badge}</h2>"
+             f"<table>{cells}</table>"
+             "<p><a href='live-status.json'>live-status.json</a></p>"
+             "</div>")
+    return panel, live
+
+
+def _live_home_section(tests: dict) -> str:
+    """The home page "Live" section: every actively-tailed run with its
+    streaming verdict and lag. Empty string when no daemon is feeding
+    fresh statuses. Takes the already-scanned ``store.tests()`` map so a
+    meta-refreshing home page walks the store tree once per request."""
+    rows = []
+    for name, runs in sorted(tests.items()):
+        for ts, run_dir in sorted(runs.items(), reverse=True):
+            status = _load_live_status(run_dir)
+            if status is None or status.get("state") != "tailing" \
+                    or not _live_is_fresh(status):
+                continue
+            valid = status.get("valid_so_far")
+            cls = {True: "valid-true", False: "valid-false"}.get(
+                valid, "valid-unknown")
+            first = status.get("first_anomaly_op")
+            verdict = ("valid so far" if valid is True
+                       else f"first anomaly at op {first}"
+                       if valid is False and first is not None
+                       else str(valid))
+            rows.append(
+                f"<tr class='{cls}'>"
+                f"<td><a href='/{name}/{ts}/'>{html.escape(name)}</a></td>"
+                f"<td>{html.escape(ts)}</td>"
+                f"<td>{html.escape(verdict)}</td>"
+                f"<td>{status.get('lag_ops', 0)} /"
+                f" {status.get('lag_s', 0)}s</td>"
+                f"<td>{html.escape(str(status.get('backend')))}</td></tr>")
+    if not rows:
+        return ""
+    return ("<h2>live <span class='badge-live'>"
+            f"{len(rows)} run(s)</span></h2>"
+            "<table><tr><th>test</th><th>time</th><th>verdict</th>"
+            "<th>lag ops/s</th><th>backend</th></tr>"
+            + "".join(rows) + "</table>")
+
+
 def _forensics_section(rel: str, target: Path) -> str:
     """Links a run's robustness forensics — late.jsonl (completions
     quarantined from reaped zombie workers) and stall-threads.txt (the
@@ -163,10 +271,10 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _page(self, title: str, body: str) -> bytes:
+    def _page(self, title: str, body: str, head_extra: str = "") -> bytes:
         return (f"<!doctype html><html><head><title>{html.escape(title)}</title>"
-                f"<style>{STYLE}</style></head><body><h1>{html.escape(title)}"
-                f"</h1>{body}</body></html>").encode()
+                f"{head_extra}<style>{STYLE}</style></head><body>"
+                f"<h1>{html.escape(title)}</h1>{body}</body></html>").encode()
 
     def do_GET(self):  # noqa: N802
         path = urllib.parse.unquote(self.path)
@@ -188,7 +296,8 @@ class Handler(BaseHTTPRequestHandler):
         each run's telemetry artifacts (metrics/trace/profile) when the
         run produced them."""
         rows = []
-        for name, runs in sorted(store.tests(store_dir=str(base)).items()):
+        tests = store.tests(store_dir=str(base))
+        for name, runs in sorted(tests.items()):
             for ts, run_dir in sorted(runs.items(), reverse=True):
                 valid, incomplete = _validity(run_dir)
                 cls = {True: "valid-true", False: "valid-false"}.get(
@@ -208,10 +317,14 @@ class Handler(BaseHTTPRequestHandler):
                     f"<td>{valid}{badge}</td>"
                     f"<td>{links}</td>"
                     f"<td><a href='/zip/{name}/{ts}'>zip</a></td></tr>")
-        body = ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
-                "<th>telemetry</th><th>download</th></tr>"
-                + "".join(rows) + "</table>")
-        self._send(self._page("Jepsen-TPU", body))
+        live = _live_home_section(tests)
+        body = (live + "<h2>runs</h2>" if live else "") \
+            + ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
+               "<th>telemetry</th><th>download</th></tr>"
+               + "".join(rows) + "</table>")
+        head = (f"<meta http-equiv='refresh' content='{LIVE_REFRESH_S}'>"
+                if live else "")
+        self._send(self._page("Jepsen-TPU", body, head_extra=head))
 
     def _files(self, base: Path, rel: str):
         target = (base / rel).resolve()
@@ -222,6 +335,7 @@ class Handler(BaseHTTPRequestHandler):
                 f"<li><a href='/{rel.rstrip('/')}/{p.name}{'/' if p.is_dir() else ''}'>"
                 f"{html.escape(p.name)}</a></li>"
                 for p in sorted(target.iterdir()))
+            live_panel, live = _live_panel(target)
             metrics = _metrics_table(target / "metrics.json")
             elle = _elle_section(rel, target)
             forensics = _forensics_section(rel, target)
@@ -229,21 +343,40 @@ class Handler(BaseHTTPRequestHandler):
             if (target / "results.json").exists() or \
                     (target / "history.wal.jsonl").exists():
                 _valid, incomplete = _validity(target)
-                if incomplete:
+                if incomplete and not live:
                     banner = ("<p><span class='badge-incomplete'>"
                               "incomplete</span> this run crashed; its "
                               "history was (or can be) recovered from "
                               "the write-ahead journal via "
                               "<code>analyze --recover</code></p>")
+            head = (f"<meta http-equiv='refresh' "
+                    f"content='{LIVE_REFRESH_S}'>" if live else "")
             return self._send(
-                self._page(rel, f"{banner}{forensics}{elle}{metrics}"
-                                f"<ul>{items}</ul>"))
+                self._page(rel, f"{live_panel}{banner}{forensics}{elle}"
+                                f"{metrics}<ul>{items}</ul>",
+                           head_extra=head))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"
                      else "image/svg+xml" if target.suffix == ".svg"
                      else "text/plain; charset=utf-8")
-            return self._send(target.read_bytes(), ctype=ctype)
+            # weak-validator ETag from (mtime, size): live pages poll
+            # metrics.json / live-status.json every couple of seconds —
+            # an unchanged snapshot answers 304 with no body re-read
+            try:
+                st = target.stat()
+                etag = f'"{st.st_mtime_ns:x}-{st.st_size:x}"'
+            except OSError:
+                etag = None
+            if etag is not None and \
+                    self.headers.get("If-None-Match") == etag:
+                self.send_response(304)
+                self.send_header("ETag", etag)
+                self.end_headers()
+                return None
+            return self._send(target.read_bytes(), ctype=ctype,
+                              extra_headers=({"ETag": etag} if etag
+                                             else None))
         return self._send(self._page("404", "<p>not found</p>"), code=404)
 
     def _zip(self, base: Path, rel: str):

@@ -75,6 +75,28 @@ def pytest_configure(config):
             pass
 
 
+_TEST_DURATIONS: dict = {}
+
+
+def pytest_runtest_logreport(report):
+    # accumulate per-test wall time (setup+call+teardown) so an
+    # over-budget session can NAME the creep instead of only dumping
+    # thread stacks — a slow-but-finished trip used to leave no trail
+    _TEST_DURATIONS[report.nodeid] = (
+        _TEST_DURATIONS.get(report.nodeid, 0.0)
+        + getattr(report, "duration", 0.0))
+
+
+def _dump_slowest(file, n: int = 10) -> None:
+    worst = sorted(_TEST_DURATIONS.items(), key=lambda kv: -kv[1])[:n]
+    if not worst:
+        return
+    print(f"\n==== slowest {len(worst)} tests this session ====",
+          file=file)
+    for nodeid, secs in worst:
+        print(f"{secs:8.2f}s  {nodeid}", file=file)
+
+
 def pytest_sessionfinish(session, exitstatus):
     if getattr(session.config, "_jepsen_dump_armed", False):
         import faulthandler
@@ -84,8 +106,10 @@ def pytest_sessionfinish(session, exitstatus):
     elapsed = _time_mod.monotonic() - session.config._jepsen_session_t0
     if elapsed > TIER1_BUDGET_S:
         import pytest
-        # over budget but not wedged: dump what is still running anyway
-        # (a lingering thread is usually the creep's cause), then fail
+        # over budget but not wedged: name the slowest tests (the usual
+        # culprits) and dump what is still running (a lingering thread
+        # is the other cause of creep), then fail the session
+        _dump_slowest(sys.__stderr__)
         from jepsen_tpu.telemetry import dump_thread_stacks
         dump_thread_stacks(sys.__stderr__)
         # pytest.exit from sessionfinish is the supported way to force
@@ -93,7 +117,7 @@ def pytest_sessionfinish(session, exitstatus):
         pytest.exit(
             f"quick lane took {elapsed:.0f}s, over its "
             f"{TIER1_BUDGET_S:.0f}s tier-1 budget — move the slow "
-            "test(s) to the slow lane (pytest.mark.slow); see "
+            "test(s) above to the slow lane (pytest.mark.slow); see "
             "doc/robustness.md", returncode=1)
 
 

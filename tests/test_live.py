@@ -1,0 +1,684 @@
+"""Live checking tier: WAL tailing, incremental sessions, the daemon.
+
+Covers the ISSUE-6 acceptance surface:
+
+* torn-line hardening of the tolerant jsonl readers (torn-middle,
+  torn-final, resume-past-torn-once-completed) under concurrent append;
+* FrontierSession chunked absorb == one-shot check_stream, bit for bit;
+* the incremental register encoder == encode_register_ops, bit for bit;
+* end-to-end: a WAL-writing fake run, the daemon tailing it, the
+  verdict flipping to invalid at the exact planted op, lag metrics in
+  the Prometheus export, wedge-proof shutdown;
+* differential: the live final verdict == post-hoc analyze across a
+  register workload and an Elle list-append workload;
+* web UI live panel + ETag/304; preflight knob validation.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.live
+
+
+def _register_history(n, seed=7, planted_at=None, n_procs=4):
+    from __graft_entry__ import _register_history as gen
+    h = gen(n, n_procs=n_procs, seed=seed, n_values=5)
+    planted = None
+    if planted_at is not None:
+        for i, op in enumerate(h):
+            if i >= planted_at and op.get("type") == "ok" \
+                    and op.get("f") == "read" \
+                    and op.get("value") is not None:
+                op["value"] = op["value"] + 10_000  # value nobody wrote
+                planted = i
+                break
+        assert planted is not None, "no read to corrupt"
+    return h, planted
+
+
+# ---------------------------------------------------------------------------
+# torn-line hardening (journal readers)
+# ---------------------------------------------------------------------------
+
+def test_tolerant_reader_torn_middle_keeps_tail(tmp_path):
+    """A torn line MID-file must not swallow the valid lines after it."""
+    from jepsen_tpu.journal import read_jsonl_tolerant
+    p = tmp_path / "w.jsonl"
+    rows = [json.dumps({"i": i}) for i in range(6)]
+    rows[2] = rows[2][:4]  # torn interior line (newline-terminated)
+    p.write_text("\n".join(rows) + "\n")
+    got, truncated = read_jsonl_tolerant(p)
+    assert [r["i"] for r in got] == [0, 1, 3, 4, 5]
+    assert truncated is False  # interior tear, not a torn tail
+
+
+def test_tolerant_reader_torn_final(tmp_path):
+    from jepsen_tpu.journal import read_jsonl_tolerant
+    p = tmp_path / "w.jsonl"
+    p.write_text(json.dumps({"i": 0}) + "\n" + '{"i": 1')  # no newline
+    got, truncated = read_jsonl_tolerant(p)
+    assert [r["i"] for r in got] == [0]
+    assert truncated is True
+
+
+def test_tailer_resumes_past_in_progress_line_once_completed(tmp_path):
+    """An unterminated final line is an in-progress write: the tailer
+    waits, then delivers it once the writer finishes the line."""
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"i": 0}) + "\n")
+        f.write('{"i": 1')  # torn in-progress
+        f.flush()
+        t = WalTailer(p)
+        assert [r["i"] for r in t.poll()] == [0]
+        assert t.poll() == []  # still in progress; offset did not move
+        f.write(', "x": 2}\n')  # writer completes the line
+        f.flush()
+        assert [r["i"] for r in t.poll()] == [1]
+        assert t.torn_skipped == 0
+
+
+def test_tailer_skips_torn_middle_and_counts(tmp_path):
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    p.write_text(json.dumps({"i": 0}) + "\n" + '{"torn\n'
+                 + json.dumps({"i": 2}) + "\n")
+    t = WalTailer(p)
+    assert [r["i"] for r in t.poll()] == [0, 2]
+    assert t.torn_skipped == 1
+
+
+def test_tailer_finalize_drops_unterminated_tail(tmp_path):
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    p.write_text(json.dumps({"i": 0}) + "\n" + '{"i": 1')
+    t = WalTailer(p)
+    assert [r["i"] for r in t.finalize()] == [0]
+    assert t.truncated_tail is True
+    assert t.poll() == []  # offset advanced past the dropped tail
+
+
+def test_tailer_under_concurrent_append(tmp_path):
+    """Poll loop racing a writer thread: every op arrives exactly once,
+    in order, torn lines notwithstanding."""
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    n = 500
+    stop = threading.Event()
+
+    def writer():
+        with open(p, "w") as f:
+            for i in range(n):
+                doc = json.dumps({"i": i})
+                # split some writes mid-line to exercise the torn path
+                if i % 7 == 0:
+                    f.write(doc[:3])
+                    f.flush()
+                    time.sleep(0.0005)
+                    f.write(doc[3:] + "\n")
+                else:
+                    f.write(doc + "\n")
+                f.flush()
+        stop.set()
+
+    w = threading.Thread(target=writer)
+    w.start()
+    t = WalTailer(p)
+    got: list = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        got.extend(t.poll())
+        if stop.is_set() and len(got) >= n:
+            break
+        time.sleep(0.001)
+    w.join(10)
+    assert [r["i"] for r in got] == list(range(n))
+    assert t.torn_skipped == 0
+
+
+# ---------------------------------------------------------------------------
+# FrontierSession + incremental encoder differentials
+# ---------------------------------------------------------------------------
+
+def test_frontier_session_chunked_equals_one_shot():
+    from jepsen_tpu.checker.linear_cpu import (
+        FrontierSession, check_stream,
+    )
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    rng = random.Random(5)
+    for seed in range(8):
+        h, _ = _register_history(120, seed=seed,
+                                 planted_at=60 if seed % 2 else None)
+        stream = encode_register_ops(h)
+        ref = check_stream(stream)
+        s = FrontierSession()
+        e = 0
+        while e < len(stream):
+            e2 = min(len(stream), e + rng.randint(1, 9))
+            res = s.absorb(stream, start=e, end=e2)
+            e = e2
+            if res.valid is False:
+                break
+        res = s.result()
+        assert res.valid == ref.valid
+        assert res.failed_event == ref.failed_event
+        assert res.failed_op_index == ref.failed_op_index
+        assert res.configs_max == ref.configs_max
+        assert res.final_configs == ref.final_configs
+
+
+def test_live_register_encoder_bit_identical_to_batch():
+    """Chunk-fed incremental encoding == encode_register_ops over the
+    full history, including fail pairs, crashed reads, and slot reuse."""
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    from jepsen_tpu.live.sessions import LinearLiveSession
+    rng = random.Random(11)
+    for seed in range(10):
+        h, _ = _register_history(150, seed=seed)
+        # sprinkle crash/fail outcomes: rewrite some oks
+        for op in h:
+            if op.get("type") == "ok" and rng.random() < 0.1:
+                op["type"] = rng.choice(["fail", "info"])
+        batch = encode_register_ops(h)
+        s = LinearLiveSession(accelerator="cpu")
+        i = 0
+        while i < len(h):
+            j = min(len(h), i + rng.randint(1, 13))
+            for op in h[i:j]:
+                s.add(op)
+            s.verdict()
+            i = j
+        s.finalize()
+        st = s.encoder.stream
+        assert list(batch.kind) == st.kind
+        assert list(batch.slot) == st.slot
+        assert list(batch.f) == st.f
+        assert list(batch.a) == st.a
+        assert list(batch.b) == st.b
+        assert list(batch.op_index) == st.op_index
+        assert batch.n_slots == st.n_slots
+        assert batch.intern.table == st.intern.table
+
+
+def test_linear_live_final_verdict_matches_post_hoc():
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.live.sessions import LinearLiveSession
+    for planted in (None, 80):
+        h, planted_i = _register_history(200, seed=3, planted_at=planted)
+        s = LinearLiveSession(accelerator="cpu")
+        for op in h:
+            s.add(op)
+        live = s.finalize()
+        post = LinearizableChecker(accelerator="cpu").check(None, h, {})
+        assert live["valid?"] == post["valid?"]
+        if planted is not None:
+            assert live["valid?"] is False
+            assert h[live["failed-op-index"]] == post["failed-op"]
+            assert live["failed-op-index"] == planted_i
+
+
+def _append_history(n_txns, seed, n_keys=4, plant=False):
+    """Concurrent list-append history with *executed* reads (real
+    payloads, so anomalies are plantable), via test_elle's interleaved
+    builder. ``plant`` duplicates an element inside the first non-empty
+    committed read — a guaranteed ``duplicate-elements`` +
+    ``incompatible-order`` anomaly. Returns (history, planted_op_i)."""
+    from tests.test_elle import _interleaved_history
+    h = _interleaved_history(random.Random(seed), n_txns=n_txns,
+                             n_keys=n_keys)
+    planted = None
+    if plant:
+        for i, op in enumerate(h):
+            if op["type"] == "ok":
+                for m in op["value"]:
+                    if m[0] == "r" and m[2]:
+                        m[2].append(m[2][0])
+                        planted = i
+                        break
+                if planted is not None:
+                    break
+        assert planted is not None, "no non-empty committed read to corrupt"
+    return h, planted
+
+
+def test_elle_session_matches_batch_checker():
+    """Incremental Elle == batch list_append.check across a clean and a
+    planted-anomaly workload (the >= 2 workloads differential)."""
+    from jepsen_tpu.elle import list_append
+    from jepsen_tpu.live.sessions import ElleSession
+    rng = random.Random(2)
+    for seed, plant in ((0, False), (1, True), (2, True)):
+        h, _ = _append_history(120, seed=seed, plant=plant)
+        batch = list_append.check(h, accelerator="cpu")
+        s = ElleSession(accelerator="cpu")
+        for op in h:
+            s.add(op)
+            if rng.random() < 0.02:
+                s.verdict()  # interim verdicts must not corrupt state
+        live = s.finalize()
+        assert live["valid?"] == batch["valid?"]
+        assert live.get("anomaly-types") == batch.get("anomaly-types")
+        assert live["txn-count"] == batch["txn-count"]
+        if plant:
+            assert live["valid?"] is False
+
+
+def test_multikey_session_demuxes_independent_histories():
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.live.sessions import (
+        MultiKeyLinearSession, session_for_ops,
+    )
+    h0, planted = _register_history(120, seed=9, planted_at=40)
+    h1, _ = _register_history(120, seed=10)
+    # disjoint process spaces per key, values lifted to [k, v], the two
+    # keys' ops interleaved — the shape independent's generators emit
+    lifted = [op for pair in zip(
+        ({**op, "value": independent.tuple_value("k0", op.get("value"))}
+         for op in h0),
+        ({**op, "process": op["process"] + 100,
+          "value": independent.tuple_value("k1", op.get("value"))}
+         for op in h1)) for op in pair]
+    s = session_for_ops(lifted)
+    assert isinstance(s, MultiKeyLinearSession)
+    for op in lifted:
+        s.add(op)
+    final = s.finalize()
+    assert final["valid?"] is False
+    assert final["failures"] == ["k0"]
+    # k0's sub-verdict pins the same failed op as a post-hoc check
+    post = LinearizableChecker(accelerator="cpu").check(None, h0, {})
+    assert post["valid?"] is False
+    sub = final["results"]["k0"]
+    assert sub["valid?"] is False
+
+
+def test_session_sniffing():
+    from jepsen_tpu.live.sessions import (
+        ElleSession, LinearLiveSession, MultiKeyLinearSession,
+        UNSUPPORTED, session_for_ops,
+    )
+    reg = [{"type": "invoke", "process": 0, "f": "read", "value": None}]
+    assert isinstance(session_for_ops(reg), LinearLiveSession)
+    ind = [{"type": "invoke", "process": 0, "f": "read",
+            "value": ["k", None]}]
+    assert isinstance(session_for_ops(ind), MultiKeyLinearSession)
+    app = [{"type": "invoke", "process": 0, "f": "txn",
+            "value": [["append", 1, 2]]}]
+    assert isinstance(session_for_ops(app), ElleSession)
+    multi = [{"type": "invoke", "process": 0, "f": "txn",
+              "value": [["w", 1, 2]]}]
+    assert session_for_ops(multi) is UNSUPPORTED
+    assert session_for_ops(
+        [{"type": "invoke", "process": "nemesis", "f": "kill"}]) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: daemon tailing a WAL-writing run
+# ---------------------------------------------------------------------------
+
+def _write_run(run_dir, history, journal_chunks=40, delay_s=0.002,
+               complete=True):
+    """Fake run: appends history to the WAL in chunks from a thread,
+    then persists history.jsonl and discards the WAL (core.run order)."""
+    from jepsen_tpu.journal import Journal
+    run_dir.mkdir(parents=True, exist_ok=True)
+    j = Journal(run_dir / "history.wal.jsonl", fsync_interval_s=-1)
+
+    def writer():
+        for i, op in enumerate(history):
+            j.append(op)
+            if i % journal_chunks == 0:
+                time.sleep(delay_s)
+        if complete:
+            with open(run_dir / "history.jsonl", "w") as f:
+                for op in history:
+                    f.write(json.dumps(op) + "\n")
+            j.close(discard=True)
+        else:
+            j.close()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    return t
+
+
+def test_daemon_end_to_end_register(tmp_path):
+    """The acceptance demo: daemon tails a WAL-writing run, reports
+    valid-so-far, flips to first-anomaly-at-op-N at the exact planted
+    op, exports live_* metrics, finalizes bit-compatible with post-hoc
+    analyze, and shuts down wedge-proof."""
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.live.daemon import LiveDaemon, load_live_status
+
+    h, planted = _register_history(600, seed=4, planted_at=400)
+    run_dir = tmp_path / "reg" / "20260803T000000.000"
+    writer = _write_run(run_dir, h)
+    daemon = LiveDaemon(store_root=str(tmp_path), poll_s=0.02,
+                        accelerator="cpu")
+    daemon.start()
+    saw_valid = False
+    deadline = time.monotonic() + 60
+    status = None
+    while time.monotonic() < deadline:
+        status = load_live_status(run_dir)
+        if status and status.get("valid_so_far") is True \
+                and status.get("checked_ops", 0) > 0:
+            saw_valid = True
+        if status and status.get("state") == "final":
+            break
+        time.sleep(0.02)
+    writer.join(10)
+    t0 = time.monotonic()
+    daemon.stop()
+    assert time.monotonic() - t0 < 30  # wedge-proof join
+    assert status is not None and status["state"] == "final"
+    assert saw_valid, "never observed a valid-so-far interim verdict"
+    assert status["valid_so_far"] is False
+    assert status["first_anomaly_op"] == planted
+    assert status["workload"] == "register"
+    assert status["ops_absorbed"] == len(h)
+    # schema essentials
+    for key in ("lag_ops", "lag_s", "backend", "checked_ops",
+                "updated", "results"):
+        assert key in status, key
+    # final incremental verdict == post-hoc analyze
+    post = LinearizableChecker(accelerator="cpu").check(None, h, {})
+    assert status["results"]["valid?"] == post["valid?"] is False
+    assert h[status["results"]["failed-op-index"]] == post["failed-op"]
+    # lag metrics exported in Prometheus format
+    prom = (tmp_path / "live-metrics.prom").read_text()
+    for metric in ("live_checker_lag_ops", "live_checker_lag_s",
+                   "live_verdict", "live_first_anomaly_op",
+                   "live_runs_active", "live_poll_seconds"):
+        assert metric in prom, metric
+    assert (tmp_path / "live-metrics.json").exists()
+
+
+def test_daemon_end_to_end_elle(tmp_path):
+    """Same demo over an Elle list-append workload, differential against
+    post-hoc list_append.check: a planted duplicate-element anomaly must
+    flip the live verdict."""
+    from jepsen_tpu.elle import list_append
+    from jepsen_tpu.live.daemon import LiveDaemon, load_live_status
+
+    h, planted = _append_history(150, seed=6, n_keys=3, plant=True)
+    run_dir = tmp_path / "append" / "20260803T000000.000"
+    writer = _write_run(run_dir, h)
+    daemon = LiveDaemon(store_root=str(tmp_path), poll_s=0.02,
+                        accelerator="cpu")
+    statuses = daemon.run_until_idle(timeout_s=60)
+    writer.join(10)
+    daemon.stop()
+    status = load_live_status(run_dir)
+    assert status["state"] == "final"
+    assert status["workload"] == "list-append"
+    post = list_append.check(h, accelerator="cpu")
+    assert post["valid?"] is False  # the plant is detectable post-hoc
+    assert status["results"]["valid?"] == post["valid?"]
+    assert status["results"].get("anomaly-types") == \
+        post.get("anomaly-types")
+    assert status["valid_so_far"] is False
+    assert statuses  # run_until_idle surfaced at least one snapshot
+
+
+def test_daemon_admission_defers_not_starves(tmp_path):
+    """Two runs, a tiny admission budget: both still get verdicts, and
+    the deferral counter shows the budget was exercised."""
+    from jepsen_tpu.live.daemon import LiveDaemon
+    from jepsen_tpu.parallel.pipeline import CostModel
+
+    runs = []
+    for k in range(2):
+        h, _ = _register_history(300, seed=20 + k)
+        run_dir = tmp_path / f"r{k}" / "20260803T000000.000"
+        runs.append((run_dir, _write_run(run_dir, h, delay_s=0.001)))
+    daemon = LiveDaemon(
+        store_root=str(tmp_path), poll_s=0.01, accelerator="cpu",
+        check_budget_s=0.001,
+        cost_model=CostModel(cpu_events_per_sec_=1000.0))
+    daemon.run_until_idle(timeout_s=60)
+    for _d, w in runs:
+        w.join(10)
+    daemon.stop()
+    for run_dir, _w in runs:
+        from jepsen_tpu.live.daemon import load_live_status
+        s = load_live_status(run_dir)
+        assert s["state"] == "final"
+        assert s["results"]["valid?"] is True
+    # with a ~1-op budget at least one poll deferred someone
+    snap = {r["name"]: r for r in daemon.registry.snapshot()
+            if r.get("name") == "live_admission_deferred_total"}
+    assert snap, "admission budget never deferred a run"
+
+
+def test_finalize_rebuilds_after_torn_wal_line(tmp_path):
+    """A torn mid-WAL line misaligns the tailer's view of the history;
+    finalize must rebuild from the authoritative history.jsonl instead
+    of back-filling by count — else the planted anomaly inside the torn
+    op is skipped, the tail op doubles, and a WRONG 'exact' final
+    verdict would pass analyze's freshness check and get reused."""
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.live.daemon import RunTracker
+
+    h, planted = _register_history(240, seed=12, planted_at=60)
+    run_dir = tmp_path / "torn" / "20260803T000000.000"
+    run_dir.mkdir(parents=True)
+    with open(run_dir / "history.wal.jsonl", "w") as f:
+        for i, op in enumerate(h):
+            line = json.dumps(op)
+            # tear the planted op's own line (newline-terminated)
+            f.write(line[: len(line) // 2] + "\n" if i == planted
+                    else line + "\n")
+    with open(run_dir / "history.jsonl", "w") as f:
+        for op in h:
+            f.write(json.dumps(op) + "\n")
+    tr = RunTracker(run_dir, accelerator="cpu")
+    tr.tail()
+    assert tr.tailer.torn_skipped == 1
+    results = tr.finalize()
+    post = LinearizableChecker(accelerator="cpu").check(None, h, {})
+    assert post["valid?"] is False
+    assert results["valid?"] is False
+    assert results["failed-op-index"] == planted
+    assert tr.ops_absorbed == len(h)  # rebuilt, not count-back-filled
+
+
+def test_untracked_run_reports_unknown_not_valid(tmp_path):
+    """A workload with no live checker must never read as 'valid':
+    valid_so_far stays None (live_verdict -1) and --once maps it to
+    EXIT_UNKNOWN, not EXIT_OK."""
+    from jepsen_tpu.live.daemon import LiveDaemon, load_live_status
+
+    h = [{"type": t, "process": 0, "f": "txn",
+          "value": [["w", 1, i]], "time": i}
+         for i in range(30) for t in ("invoke", "ok")]
+    run_dir = tmp_path / "unsup" / "20260803T000000.000"
+    _write_run(run_dir, h, complete=False).join(10)
+    daemon = LiveDaemon(store_root=str(tmp_path), poll_s=0.01,
+                        accelerator="cpu")
+    daemon.poll_once()
+    status = load_live_status(run_dir)
+    assert status["state"] == "untracked"
+    assert status["workload"] is None
+    assert status["valid_so_far"] is None
+    # run completes: finalizes with no results (there is no checker)
+    with open(run_dir / "history.jsonl", "w") as f:
+        for op in h:
+            f.write(json.dumps(op) + "\n")
+    daemon.poll_once()
+    daemon.stop()
+    status = load_live_status(run_dir)
+    assert status["state"] == "final"
+    assert status["valid_so_far"] is None
+    assert "results" not in status
+
+
+def test_daemon_breaker_opens_on_poisoned_session(tmp_path, monkeypatch):
+    from jepsen_tpu.live import daemon as daemon_mod
+
+    h, _ = _register_history(50, seed=1)
+    run_dir = tmp_path / "bad" / "20260803T000000.000"
+    w = _write_run(run_dir, h, complete=False)
+    w.join(10)
+    daemon = daemon_mod.LiveDaemon(store_root=str(tmp_path),
+                                   poll_s=0.01, accelerator="cpu")
+    daemon.poll_once()
+    (tr,) = daemon.trackers.values()
+
+    def boom():
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(tr.session, "verdict", boom)
+    tr.last_verdict["checked_ops"] = 0  # force pending work
+    for _ in range(daemon_mod.LIVE_BREAKER_THRESHOLD + 1):
+        tr.check()
+    assert tr.broken
+    status = tr.status(daemon.lag_budget_ops)
+    assert status["state"] == "error"
+    daemon.stop()
+
+
+def test_core_analyze_reuses_fresh_live_verdict(tmp_path):
+    from jepsen_tpu import core
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.live.daemon import LIVE_STATUS_NAME
+
+    h, _ = _register_history(60, seed=2)
+    test = {"name": "reuse", "start_time": "TS", "store_dir": str(tmp_path),
+            "history": list(h), "checker": LinearizableChecker(
+                accelerator="cpu"), "live_reuse": True}
+    run_dir = tmp_path / "reuse" / "TS"
+    run_dir.mkdir(parents=True)
+    status = {"state": "final", "workload": "register",
+              "ops_absorbed": len(h),
+              "results": {"valid?": True, "algorithm": "jitlin-cpu-live",
+                          "configs-max": 7}}
+    (run_dir / LIVE_STATUS_NAME).write_text(json.dumps(status))
+    out = core.analyze(dict(test))
+    assert out["results"]["live-reused"] is True
+    assert out["results"]["algorithm"] == "jitlin-cpu-live"
+    # stale op count: no reuse
+    status["ops_absorbed"] = len(h) - 1
+    (run_dir / LIVE_STATUS_NAME).write_text(json.dumps(status))
+    out = core.analyze(dict(test))
+    assert "live-reused" not in out["results"]
+    # explicit opt-out: no reuse
+    status["ops_absorbed"] = len(h)
+    (run_dir / LIVE_STATUS_NAME).write_text(json.dumps(status))
+    out = core.analyze({**test, "live_reuse": False})
+    assert "live-reused" not in out["results"]
+
+
+# ---------------------------------------------------------------------------
+# web UI: live panel, home section, ETag
+# ---------------------------------------------------------------------------
+
+def _get(port, path, headers=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path, headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    out = (r.status, dict(r.getheaders()), body)
+    conn.close()
+    return out
+
+
+def test_web_live_panel_and_etag(tmp_path):
+    from jepsen_tpu.web import make_server
+
+    run_dir = tmp_path / "livetest" / "20260803T000000.000"
+    run_dir.mkdir(parents=True)
+    status = {"state": "tailing", "workload": "register",
+              "valid_so_far": False, "first_anomaly_op": 42,
+              "backend": "frontier-cpu", "ops_absorbed": 100,
+              "checked_ops": 95, "lag_ops": 5, "lag_s": 0.1,
+              "over_lag_budget": False, "torn_skipped": 0,
+              "polls": 3, "updated": time.time()}
+    (run_dir / "live-status.json").write_text(json.dumps(status))
+    server = make_server(store_dir=str(tmp_path))
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, _hdr, body = _get(port, "/")
+        assert code == 200
+        assert b"live" in body and b"livetest" in body
+        code, hdr, body = _get(port, "/livetest/20260803T000000.000/")
+        assert code == 200
+        assert b"first anomaly at op 42" in body
+        assert b"http-equiv='refresh'" in body  # auto-refreshing panel
+        # JSON served as application/json with a working ETag
+        code, hdr, body = _get(
+            port, "/livetest/20260803T000000.000/live-status.json")
+        assert code == 200
+        assert hdr["Content-Type"] == "application/json"
+        etag = hdr["ETag"]
+        code, hdr, body = _get(
+            port, "/livetest/20260803T000000.000/live-status.json",
+            headers={"If-None-Match": etag})
+        assert code == 304
+        assert body == b""
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# preflight knob coverage + tolerant coercion
+# ---------------------------------------------------------------------------
+
+def test_preflight_validates_live_knobs():
+    from jepsen_tpu.analysis.preflight import preflight
+
+    diags = preflight({"nodes": ["n1"], "live_poll_s": "garbage"})
+    assert any(d.code == "KNB001" and d.path == "live_poll_s"
+               for d in diags)
+    diags = preflight({"nodes": ["n1"], "live_max_runs": 0})
+    assert any(d.code == "KNB002" and d.path == "live_max_runs"
+               for d in diags)
+    diags = preflight({"nodes": ["n1"], "live_lag_budget_ops": -1})
+    assert any(d.code == "KNB002" for d in diags)
+    diags = preflight({"nodes": ["n1"], "live_poll_s": "2.5",
+                       "live_check_budget_s": 0.25})
+    assert any(d.code == "KNB006" for d in diags)  # stringly number
+    assert not any(d.code in ("KNB001", "KNB002") for d in diags)
+
+
+def test_daemon_knob_coercion_tolerant():
+    from jepsen_tpu.live.daemon import LiveDaemon
+
+    d = LiveDaemon(store_root=None, poll_s="0.25",
+                   lag_budget_ops="oops", max_runs=-3,
+                   check_budget_s=None)
+    assert d.poll_s == 0.25
+    assert d.lag_budget_ops == 50_000  # garbage -> default
+    assert d.max_runs == 1             # clamped to the minimum
+    assert d.check_budget_s == 0.5     # None -> default
+
+
+def test_conftest_budget_guard_names_slowest(capsys):
+    import io
+
+    import conftest
+
+    saved = dict(conftest._TEST_DURATIONS)
+    try:
+        conftest._TEST_DURATIONS.clear()
+        for i in range(14):
+            conftest._TEST_DURATIONS[f"tests/test_x.py::t{i}"] = float(i)
+        buf = io.StringIO()
+        conftest._dump_slowest(buf)
+        out = buf.getvalue()
+        assert "slowest 10 tests" in out
+        assert "t13" in out and "t4" in out and "t3" not in out
+    finally:
+        conftest._TEST_DURATIONS.clear()
+        conftest._TEST_DURATIONS.update(saved)
