@@ -313,7 +313,18 @@ _NUMERIC_KNOBS = (
     ("live_lag_budget_ops", True, 0.0),
     ("live_max_runs", True, 1.0),
     ("live_check_budget_s", True, 0.0),
+    # multi-device sharding (doc/performance.md "Multi-device
+    # sharding"): mesh width cap for the sharded checker rung —
+    # parallel.coerce_devices coerces tolerantly at runtime, preflight
+    # is where garbage becomes an error
+    ("mesh_devices", True, 0.0),
 )
+
+# bool knobs: the sharded-rung switch (checker/linearizable.py coerces
+# via parallel.coerce_flag — bools and 0/1 pass, yes/no strings warn,
+# garbage errors here instead of silently reading as unset)
+_BOOL_KNOBS = ("checker_sharded",)
+_BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 _UNSET = object()
 
@@ -354,6 +365,23 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
                 f"{key}={v!r} is below the minimum {lo!r}",
                 hint="0 disables a timeout knob; negatives are "
                      "meaningless here"))
+
+    for key in _BOOL_KNOBS:
+        v = test.get(key, _UNSET)
+        if v is _UNSET or v is None:
+            continue
+        if isinstance(v, bool) or v in (0, 1):
+            continue
+        if isinstance(v, str) and v.strip().lower() in _BOOL_STRINGS:
+            out.append(Diagnostic(
+                "KNB006", WARNING, key,
+                f"{key} is a string ({v!r}); prefer a plain bool"))
+            continue
+        out.append(Diagnostic(
+            "KNB001", ERROR, key,
+            f"{key} must be a bool, got {v!r}",
+            hint="true enables the sharded checker rung, false forces "
+                 "single-device; unset = env default + cost model"))
 
     nodes = list(test.get("nodes") or [])
     conc_raw = test.get("concurrency", 1)

@@ -4,8 +4,8 @@ The linearizable checker accumulated three ad-hoc fallbacks (matrix
 screen -> frontier kernel, frontier overflow -> exact CPU retry, native
 C++ capacity miss -> Python stream search) with no shared accounting,
 watchdog, or failure memory. :class:`BackendLadder` owns that chain —
-pallas-matrix -> jitlin device kernel -> native C++ -> CPU — as one
-policy object:
+sharded-matrix (multi-device mesh) -> pallas-matrix -> jitlin device
+kernel -> native C++ -> CPU — as one policy object:
 
 * **Soft demotion**: a backend may *decline* a dispatch (return ``None``
   or raise :class:`Unavailable`) — out of regime, capacity miss,

@@ -109,6 +109,12 @@ class LinearizableChecker(Checker):
     def check(self, test, history, opts):
         algorithm = opts.get("algorithm", self.algorithm)
         accelerator = opts.get("accelerator", self.accelerator)
+        # multi-device sharding knobs (doc/performance.md "Multi-device
+        # sharding"): checker_sharded force-enables/disables the sharded
+        # rung (None = env default + cost model), mesh_devices caps the
+        # mesh width
+        from jepsen_tpu import parallel as par
+        sharded, mesh_devices = par.sharding_knobs(test, opts)
 
         t0 = time.perf_counter()
         if algorithm == "wgl":
@@ -126,21 +132,24 @@ class LinearizableChecker(Checker):
             return self._finish(res, history, test)
         stream, step_py, spec = enc
         res = self._search_stream(stream, step_py, spec, algorithm,
-                                  accelerator, history=history)
+                                  accelerator, history=history,
+                                  sharded=sharded,
+                                  mesh_devices=mesh_devices)
         self._record_metrics(res, time.perf_counter() - t0, len(stream),
                              stream)
         return self._finish(res, history, test, stream, step_py=step_py,
                             init_state=spec.init_state)
 
     def _search_stream(self, stream, step_py, spec, algorithm,
-                       accelerator, history=None) -> LinearResult:
+                       accelerator, history=None, sharded=None,
+                       mesh_devices=None) -> LinearResult:
         """The full encoded-stream dispatch, shared by check() and the
         stored-column re-check lane (module check_stored), routed
         through the :class:`~jepsen_tpu.checker.ladder.BackendLadder`:
         host rungs (native C++ first, exact Python stream search) below
-        the device threshold, device rungs (transfer-matrix screen,
-        frontier kernel) above it, with the exact CPU twin as the
-        terminal rung every demotion lands on."""
+        the device threshold, device rungs (mesh-sharded matrix,
+        transfer-matrix screen, frontier kernel) above it, with the
+        exact CPU twin as the terminal rung every demotion lands on."""
         device_regime = not (accelerator == "cpu" or (
             accelerator == "auto" and len(stream) < AUTO_TPU_THRESHOLD))
         ctx = {
@@ -150,6 +159,10 @@ class LinearizableChecker(Checker):
             "history": history,
             "device_regime": device_regime,
             "capacity": self.capacity,
+            # sharded-rung routing (doc/performance.md): True forces,
+            # False disables, None = env default + cost-model gate
+            "sharded": sharded,
+            "mesh_devices": mesh_devices,
             # the encoded-stream search applies for jitlin/auto, and for
             # the stored-column lane (no op history to wgl over)
             "stream_path": (algorithm in ("jitlin", "auto")
@@ -166,8 +179,9 @@ class LinearizableChecker(Checker):
         return res
 
     def _get_ladder(self):
-        """The degradation ladder, built once per checker: pallas-matrix
-        -> jitlin device frontier -> native C++ -> exact CPU. Demotion,
+        """The degradation ladder, built once per checker: sharded-matrix
+        (mesh) -> pallas-matrix -> jitlin device frontier -> native C++
+        -> exact CPU. Demotion,
         watchdog, adaptive-shrink retry, and circuit-breaker policy all
         live in checker/ladder.py; the rungs here only encode *what*
         each backend computes and *when* it is in regime."""
@@ -192,6 +206,12 @@ class LinearizableChecker(Checker):
 
         def matrix_fn(ctx):
             from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
+            if ctx.get("_matrix_screened"):
+                # the sharded rung already ran the bit-identical screen
+                # to completion and it didn't settle; don't pay for it
+                # twice (a sharded CRASH leaves the flag unset, so the
+                # demotion path still gets its single-device screen)
+                return None
             stream, spec = ctx["stream"], ctx["spec"]
             m = matrix_check(stream, step_ids=spec.step_ids,
                              init_state=spec.init_state,
@@ -217,6 +237,57 @@ class LinearizableChecker(Checker):
                 return False
             jitlin.MATRIX_MAX_ELEMS //= 2
             return True
+
+        def sharded_eligible(ctx):
+            # the mesh-sharded matrix rung: same regime gate as the
+            # single-device matrix screen, plus ≥2 devices and the
+            # per-device-count cost model (small histories must not pay
+            # mesh overhead). checker_sharded=True skips the cost gate
+            # (the operator asked); False disables the rung outright.
+            if not matrix_eligible(ctx):
+                return False
+            from jepsen_tpu import parallel
+            flag = ctx.get("sharded")
+            if flag is False:
+                return False
+            if flag is not True and not parallel.sharded_enabled():
+                return False
+            if flag is True:
+                mesh = parallel.auto_mesh(ctx.get("mesh_devices"))
+            else:
+                mesh = parallel.sharded_mesh_for(len(ctx["stream"]),
+                                                 ctx.get("mesh_devices"))
+            if mesh is None:
+                return False
+            ctx["_sharded_mesh"] = mesh
+            return True
+
+        def sharded_fn(ctx):
+            # the multi-device twin of matrix_fn: chunk axis sharded
+            # over the mesh, carries tree-combined device-side. A
+            # collective/compile failure (backend without mesh support)
+            # raises — the ladder counts the demotion
+            # (checker_backend_demotions_total{backend="sharded-matrix"})
+            # and falls through to the single-device rungs below, so
+            # sharding unavailability degrades, never fails
+            # (doc/robustness.md).
+            from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
+            stream, spec = ctx["stream"], ctx["spec"]
+            m = matrix_check(stream, step_ids=spec.step_ids,
+                             init_state=spec.init_state,
+                             num_states=len(stream.intern),
+                             mesh=ctx["_sharded_mesh"])
+            ctx["_matrix_phase"] = last_phase_seconds()
+            if m is not None and m[0] and not m[2]:
+                return LinearResult(
+                    valid=True, failed_event=-1, failed_op_index=-1,
+                    configs_max=0, algorithm="jitlin-tpu-matrix-sharded")
+            # the screen COMPLETED but didn't settle (not alive, or
+            # inexact): the single-device screen is bit-identical, so
+            # matrix_fn re-running it would pay a full matrix dispatch
+            # to learn the same thing — flag it to decline instead
+            ctx["_matrix_screened"] = True
+            return None
 
         def frontier_fn(ctx):
             from jepsen_tpu.ops.jitlin import verdict
@@ -261,7 +332,8 @@ class LinearizableChecker(Checker):
             return res  # None when unbuilt -> decline
 
         def cpu_fn(ctx):
-            from_device = any(n in ("pallas-matrix", "jitlin-device")
+            from_device = any(n in ("sharded-matrix", "pallas-matrix",
+                                    "jitlin-device")
                               for n in ctx.get("_attempted", ()))
             if ctx["stream_path"] or from_device:
                 res = check_stream(ctx["stream"], step=ctx["step_py"],
@@ -277,6 +349,8 @@ class LinearizableChecker(Checker):
         if self.breaker_threshold is not None:
             kw["breaker_threshold"] = self.breaker_threshold
         self._ladder = BackendLadder([
+            Backend("sharded-matrix", sharded_fn, eligible=sharded_eligible,
+                    shrink=matrix_shrink, device=True),
             Backend("pallas-matrix", matrix_fn, eligible=matrix_eligible,
                     shrink=matrix_shrink, device=True),
             Backend("jitlin-device", frontier_fn,

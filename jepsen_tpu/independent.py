@@ -344,14 +344,25 @@ class IndependentChecker(Checker):
             step_py, spec = encs[0][1], encs[0][2]
             # accelerator=auto lets batch_check's round-trip cost model
             # route small batches to the C++/CPU lane instead of eating
-            # the device dispatch latency (parallel.pipeline.CostModel)
+            # the device dispatch latency (parallel.pipeline.CostModel);
+            # the mesh knobs shard the key axis over the devices
+            # (doc/performance.md "Multi-device sharding")
             from jepsen_tpu import parallel as par
+            sharded, mesh_devices = par.sharding_knobs(test, opts)
+            # checker_sharded: False forces single-device, True skips
+            # the cost gate (explicit mesh), None = auto (cost-gated)
+            mesh = False if sharded is False else None
+            if sharded is True:
+                mesh = par.auto_mesh(mesh_devices)
             outcomes = batch_check(
                 streams, capacity=chk.capacity,
                 kernel=chk._tpu_kernel(spec),
-                accelerator="auto" if accelerator == "auto" else "device")
-            backend = ("jitlin-cpu(routed)" if par.last_route() == "cpu"
-                       else "jitlin-tpu")
+                accelerator="auto" if accelerator == "auto" else "device",
+                mesh=mesh, mesh_devices=mesh_devices)
+            route = par.last_route()
+            backend = {"cpu": "jitlin-cpu(routed)",
+                       "mesh": "jitlin-tpu-sharded"}.get(route,
+                                                         "jitlin-tpu")
             results = {}
             for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
                 v = verdict(alive, ovf)

@@ -308,15 +308,31 @@ class LinearLiveSession:
         def matrix_fn(ctx):
             # stateless full-prefix screen: exact True settles this
             # poll's verdict without touching the CPU frontier (which
-            # catches up from its own offset on the next demotion)
+            # catches up from its own offset on the next demotion).
+            # Big prefixes shard over the device mesh when the cost
+            # model clears it (doc/performance.md "Multi-device
+            # sharding"); a collective failure retries single-device
+            # inline — the daemon's poll cadence must not burn a whole
+            # ladder demotion on a transient mesh fault.
+            from jepsen_tpu import parallel
             from jepsen_tpu.models import cas_register_spec
             from jepsen_tpu.ops.jitlin import matrix_check
             session = ctx["session"]
             es = session.encoder.stream.to_event_stream()
             spec = cas_register_spec(self._spec_init)
-            m = matrix_check(es, step_ids=spec.step_ids,
-                             init_state=spec.init_state,
-                             num_states=len(es.intern))
+            mesh = parallel.sharded_mesh_for(len(es.kind))
+            try:
+                m = matrix_check(es, step_ids=spec.step_ids,
+                                 init_state=spec.init_state,
+                                 num_states=len(es.intern), mesh=mesh)
+            except Exception:  # noqa: BLE001 — mesh fault: one device
+                if mesh is None:
+                    raise
+                logger.warning("sharded live matrix screen failed; "
+                               "retrying single-device", exc_info=True)
+                m = matrix_check(es, step_ids=spec.step_ids,
+                                 init_state=spec.init_state,
+                                 num_states=len(es.intern))
             if m is not None and m[0] and not m[2]:
                 return {"valid_so_far": True, "first_anomaly_op": None,
                         "checked_ops": session.encoder.ops_encoded}

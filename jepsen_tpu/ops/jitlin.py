@@ -412,51 +412,26 @@ def receiver_kill_tables(S: int, V: int):
     return receiver, kill_idx, kill_mask
 
 
-def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
-                         g_steps: int, n_chunks: int, n_keys: int = 1):
-    """Block-composed transfer-matrix variant of the dense scan.
+def _kernel_math(S: int, V: int, step_ids, G: int):
+    """Trace-time math shared by the single-device transfer-matrix
+    kernel and its shard_map mesh twin: the static receiver/kill
+    tables, the boolean-matmul helpers, the per-scan-step operator
+    build, and the chunk-product combiners. ``G`` is the chunk count
+    one scan step advances — the global count on a single device, a
+    per-device block under shard_map. Everything downstream of the
+    chunk layout is built HERE exactly once, which is what keeps mesh
+    and single-device verdicts bit-identical: both paths compose the
+    same 0/1 operators with the same thresholded bf16 products (every
+    intermediate is exactly 0/1, so any association of the boolean
+    matrix product yields the same matrix)."""
+    import types
 
-    For each return event, closure-then-kill is a *linear* boolean
-    operator on the flattened [2^S * V] table: closure is (I+L)^S where
-    L = sum_t pend_t * (R_t ⊗ M_t) (R_t the static mask-receiver map for
-    slot t, M_t the op's [V, V] transition), computable with
-    ceil(log2 S) boolean matrix squarings; kill is a row gather+mask.
-    Composing the per-return matrices A_i is associative, so chunks of
-    the history multiply *in parallel* (one lax.scan whose every step
-    advances all chunks by one return — [G, MV, MV] batched matmuls on
-    the MXU) and the G chunk products combine at the end. Sequential
-    depth falls from one step per event to one per chunk-row, which is
-    what makes a single long history fast on TPU; the event-by-event
-    dense scan remains the exact-diagnostics path (died-at event, peak).
-
-    With ``n_keys`` = B > 1, the same chunk axis also carries a batch of
-    independent per-key histories (the jepsen.independent regime): chunk
-    g = b * n_chunks + c holds key b's c-th slice of returns, every scan
-    step advances all B x C chunks with one [G, MV, MV] MXU matmul, and
-    the final combine chains each key's C chunk products separately.
-    This replaces the latency-bound vmapped event scan with dense batched
-    matmul work — sequential depth per key falls from E events to
-    T = g_steps.
-
-    Host→device traffic is kept minimal for tunneled/remote accelerators:
-    the host interns the batch's distinct (f, a, b) ops into a table of
-    ``n_uops`` entries, each op's [V, V] transition matrix is built ONCE
-    on device, and the per-return op tables arrive as small int32 id
-    grids gathered against that table each step.
-
-    Boolean products ride bf16 inputs with f32 accumulation (counts
-    <= MV = 2^S * V <= 2^12 are exact in f32) and a >0 threshold.
-    """
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     M = 1 << S
     MV = M * V
-    B, C, T = n_keys, n_chunks, g_steps
-    G = B * C
 
-    # static tables (shared constructor with the pallas kernel) ----------
     receiver, kill_idx, kill_mask = receiver_kill_tables(S, V)
     n_sq = 0
     while (1 << n_sq) < S:
@@ -514,34 +489,110 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
                     inexact | (oob & pend_g & val_g[:, None]).any(axis=1)), None
         return step
 
-    def _combine(P, inexact, tot0):
-        # chain each key's C chunk products in time order: chunks are
-        # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0] @ tot0.
-        # Tree-reduced: boolean matrix product is associative, so pairing
-        # neighbors per level ((P1@P0), (P3@P2), ...) computes the same
-        # 0/1 product in ceil(log2 C) levels of BATCHED matmuls instead
-        # of C sequential [B, MV, MV] products — the old fori_loop chain
-        # was C dependent tiny matmuls of pure launch latency (256 of
-        # them on the single-dispatch bench config).
-        def bmm_pairs(hi, lo):
-            out = jnp.einsum("bnij,bnjk->bnik", hi, lo,
+    def chain_time(seq):
+        """[n, MV, MV] time-ordered chunk products -> their composed
+        product (later chunk on the LEFT), via the same pairing tree as
+        make_combine so every intermediate is a thresholded 0/1
+        matrix."""
+        while seq.shape[0] > 1:        # static n: unrolls at trace time
+            odd = seq[-1:] if seq.shape[0] % 2 else None
+            pairs = seq[:-1] if odd is not None else seq
+            out = jnp.einsum("nij,njk->nik", pairs[1::2], pairs[0::2],
                              preferred_element_type=jnp.bfloat16)
-            return (out > 0).astype(jnp.bfloat16)
-
-        seq = P.reshape(B, C, MV, MV)
-        while seq.shape[1] > 1:        # static C: unrolls at trace time
-            odd = seq[:, -1:] if seq.shape[1] % 2 else None
-            pairs = seq[:, :-1] if odd is not None else seq
-            # later chunk on the LEFT: product order is preserved
-            seq = bmm_pairs(pairs[:, 1::2], pairs[:, 0::2])
+            seq = (out > 0).astype(jnp.bfloat16)
             if odd is not None:
-                seq = jnp.concatenate([seq, odd], axis=1)
-        total = (jnp.einsum("bij,bjk->bik", seq[:, 0],
-                            tot0.astype(jnp.bfloat16),
-                            preferred_element_type=jnp.bfloat16)
-                 > 0).astype(jnp.bfloat16)
-        alive = (total[:, :, init_state] > 0).any(axis=1)
-        return alive, inexact.reshape(B, C).any(axis=1), total
+                seq = jnp.concatenate([seq, odd], axis=0)
+        return seq[0]
+
+    def make_combine(B: int, C: int, init_state: int):
+        def _combine(P, inexact, tot0):
+            # chain each key's C chunk products in time order: chunks are
+            # chunk-major per key, so total_b = P[b,C-1] @ ... @ P[b,0] @ tot0.
+            # Tree-reduced: boolean matrix product is associative, so pairing
+            # neighbors per level ((P1@P0), (P3@P2), ...) computes the same
+            # 0/1 product in ceil(log2 C) levels of BATCHED matmuls instead
+            # of C sequential [B, MV, MV] products — the old fori_loop chain
+            # was C dependent tiny matmuls of pure launch latency (256 of
+            # them on the single-dispatch bench config).
+            def bmm_pairs(hi, lo):
+                out = jnp.einsum("bnij,bnjk->bnik", hi, lo,
+                                 preferred_element_type=jnp.bfloat16)
+                return (out > 0).astype(jnp.bfloat16)
+
+            seq = P.reshape(B, C, MV, MV)
+            while seq.shape[1] > 1:        # static C: unrolls at trace time
+                odd = seq[:, -1:] if seq.shape[1] % 2 else None
+                pairs = seq[:, :-1] if odd is not None else seq
+                # later chunk on the LEFT: product order is preserved
+                seq = bmm_pairs(pairs[:, 1::2], pairs[:, 0::2])
+                if odd is not None:
+                    seq = jnp.concatenate([seq, odd], axis=1)
+            total = (jnp.einsum("bij,bjk->bik", seq[:, 0],
+                                tot0.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.bfloat16)
+                     > 0).astype(jnp.bfloat16)
+            alive = (total[:, :, init_state] > 0).any(axis=1)
+            return alive, inexact.reshape(B, C).any(axis=1), total
+        return _combine
+
+    return types.SimpleNamespace(
+        M=M, MV=MV, n_sq=n_sq, eye=eye, v_range=v_range,
+        receiver_j=receiver_j, kill_idx_j=kill_idx_j,
+        kill_mask_j=kill_mask_j, bmm=bmm, uop_tables=uop_tables,
+        make_step=make_step, chain_time=chain_time,
+        make_combine=make_combine)
+
+
+def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
+                         g_steps: int, n_chunks: int, n_keys: int = 1):
+    """Block-composed transfer-matrix variant of the dense scan.
+
+    For each return event, closure-then-kill is a *linear* boolean
+    operator on the flattened [2^S * V] table: closure is (I+L)^S where
+    L = sum_t pend_t * (R_t ⊗ M_t) (R_t the static mask-receiver map for
+    slot t, M_t the op's [V, V] transition), computable with
+    ceil(log2 S) boolean matrix squarings; kill is a row gather+mask.
+    Composing the per-return matrices A_i is associative, so chunks of
+    the history multiply *in parallel* (one lax.scan whose every step
+    advances all chunks by one return — [G, MV, MV] batched matmuls on
+    the MXU) and the G chunk products combine at the end. Sequential
+    depth falls from one step per event to one per chunk-row, which is
+    what makes a single long history fast on TPU; the event-by-event
+    dense scan remains the exact-diagnostics path (died-at event, peak).
+
+    With ``n_keys`` = B > 1, the same chunk axis also carries a batch of
+    independent per-key histories (the jepsen.independent regime): chunk
+    g = b * n_chunks + c holds key b's c-th slice of returns, every scan
+    step advances all B x C chunks with one [G, MV, MV] MXU matmul, and
+    the final combine chains each key's C chunk products separately.
+    This replaces the latency-bound vmapped event scan with dense batched
+    matmul work — sequential depth per key falls from E events to
+    T = g_steps.
+
+    Host→device traffic is kept minimal for tunneled/remote accelerators:
+    the host interns the batch's distinct (f, a, b) ops into a table of
+    ``n_uops`` entries, each op's [V, V] transition matrix is built ONCE
+    on device, and the per-return op tables arrive as small int32 id
+    grids gathered against that table each step.
+
+    Boolean products ride bf16 inputs with f32 accumulation (counts
+    <= MV = 2^S * V <= 2^12 are exact in f32) and a >0 threshold.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, C, T = n_keys, n_chunks, g_steps
+    G = B * C
+
+    # static tables + step/combine math (shared with the mesh twin —
+    # see _kernel_math; the pallas kernel shares the bit tables via
+    # receiver_kill_tables)
+    math = _kernel_math(S, V, step_ids, G)
+    MV, eye = math.MV, math.eye
+    uop_tables = math.uop_tables
+    make_step = math.make_step
+    _combine = math.make_combine(B, C, init_state)
 
     def _scan_total(pend, op_ids, uops, slots, valid, tot0):
         mt_tab, oob_tab = uop_tables(uops)
@@ -635,6 +686,126 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
     return run
 
 
+def _build_matrix_kernel_mesh(S: int, V: int, step_ids, init_state: int,
+                              g_steps: int, n_chunks: int, n_keys: int,
+                              mesh):
+    """shard_map twin of _build_matrix_kernel over a device mesh.
+
+    Two sharding modes, both built from the SAME step/combine math
+    (_kernel_math) so mesh and single-device verdicts are bit-identical:
+
+    * ``n_keys == 1`` — the segmented scale path / one long history:
+      the chunk axis (C time-ordered chunks of T returns) shards over
+      the mesh. Each device scans its CONTIGUOUS time span of chunks
+      ([C/nd, MV, MV] local products), chains them locally, and the nd
+      span products tree-combine device-side after one small
+      ``all_gather`` ([nd, MV, MV] — the only collective). The composed
+      total applies ``tot0`` and replicates, ready to carry into the
+      next round. Exposes ``resume`` + ``init_total`` like the
+      single-device kernel.
+    * ``n_keys > 1`` — the jepsen.independent key batch: the key axis
+      shards (the dispatch pads B to a device multiple upstream), each
+      device runs the full scan + per-key combine for its own keys with
+      ZERO cross-device traffic, and the per-key verdicts all_gather at
+      the end — B bools over ICI instead of a host-side shard walk.
+
+    Collectives unavailable (backend without mesh support) surface as
+    dispatch exceptions; the checker ladder's ``sharded`` rung demotes
+    to the single-device kernels rather than failing (checker/ladder.py,
+    doc/robustness.md)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:                      # newer jax moved it
+        from jax import shard_map  # type: ignore[attr-defined]
+
+    nd = int(mesh.devices.size)
+    ax = mesh.axis_names[0]
+    B, C, T = n_keys, n_chunks, g_steps
+    if B == 1:
+        if C % nd:
+            raise ValueError(
+                f"chunk count {C} not divisible by {nd} devices: "
+                f"_matrix_plan must pad the chunk axis first")
+        G_local = C // nd
+    else:
+        if B % nd:
+            raise ValueError(
+                f"key count {B} not divisible by {nd} devices: "
+                f"_matrix_dispatch must pad the key axis first")
+        B_local = B // nd
+        G_local = B_local * C
+    math = _kernel_math(S, V, step_ids, G_local)
+    MV, eye = math.MV, math.eye
+
+    def local_products(pend, op_ids, uops, slots, valid):
+        """This device's chunk block through the scan: [G_local, MV, MV]
+        chunk products + per-chunk inexact flags."""
+        mt_tab, oob_tab = math.uop_tables(uops)
+        P0 = jnp.broadcast_to(eye, (G_local, MV, MV))
+        (prod, inexact), _ = lax.scan(math.make_step(mt_tab, oob_tab),
+                                      (P0, jnp.zeros((G_local,), bool)),
+                                      (pend, op_ids, slots, valid))
+        return prod, inexact
+
+    if B == 1:
+        def seg_total(pend, op_ids, uops, slots, valid, tot0):
+            prod, inexact = local_products(pend, op_ids, uops, slots, valid)
+            span = math.chain_time(prod)         # this device's time span
+            # device order IS time order (contiguous chunk blocks), so
+            # the gathered spans chain with the same later-on-the-LEFT
+            # tree as the single-device combine
+            spans = lax.all_gather(span, ax)     # [nd, MV, MV]
+            total = math.chain_time(spans.astype(jnp.bfloat16))
+            total = (jnp.einsum("ij,jk->ik", total,
+                                tot0[0].astype(jnp.bfloat16),
+                                preferred_element_type=jnp.bfloat16)
+                     > 0).astype(jnp.bfloat16)
+            alive = (total[:, init_state] > 0).any()
+            ix = lax.psum(inexact.any().astype(jnp.int32), ax) > 0
+            return alive[None], ix[None], total[None]
+
+        fn = jax.jit(shard_map(
+            seg_total, mesh=mesh,
+            in_specs=(P(None, ax, None), P(None, ax, None), P(),
+                      P(None, ax), P(None, ax), P()),
+            out_specs=(P(), P(), P()), check_rep=False))
+
+        def run(pend, op_ids, uops, slots, valid):
+            alive, inexact, _ = fn(pend, op_ids, uops, slots, valid,
+                                   run.init_total())
+            return alive, inexact
+
+        run.resume = fn
+        run.init_total = lambda: jnp.broadcast_to(
+            jnp.eye(MV, dtype=jnp.bfloat16), (1, MV, MV))
+        return run
+
+    combine = math.make_combine(B_local, C, init_state)
+
+    def key_verdicts(pend, op_ids, uops, slots, valid):
+        prod, inexact = local_products(pend, op_ids, uops, slots, valid)
+        alive, ix, _ = combine(prod, inexact,
+                               jnp.broadcast_to(eye, (B_local, MV, MV)))
+        # gather so every device holds the full per-key verdict vector:
+        # the caller's readback touches one shard instead of walking nd
+        # (device order = key-block order, so the reshape restores the
+        # original key order)
+        return (lax.all_gather(alive, ax).reshape(-1),
+                lax.all_gather(ix, ax).reshape(-1))
+
+    run = jax.jit(shard_map(
+        key_verdicts, mesh=mesh,
+        in_specs=(P(None, ax, None), P(None, ax, None), P(),
+                  P(None, ax), P(None, ax)),
+        out_specs=(P(), P()), check_rep=False))
+    return run
+
+
 # matrix-path applicability: cost is quadratic in MV = 2^S * V (each
 # return becomes an [MV, MV] operator), so the value domain must be small
 # — the realistic register regime (a handful of distinct values), not
@@ -668,13 +839,16 @@ def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
 
 
 def matrix_check(stream, step_ids=None, init_state: int = 0,
-                 num_states: int | None = None, force: bool = False):
+                 num_states: int | None = None, force: bool = False,
+                 mesh=None):
     """Fast exact-aliveness check of ONE history via block-composed
     transfer matrices. Returns (alive, died, overflow, peak) with
     died=-1/peak=0 placeholders — callers that need the failing event or
     frontier stats re-run the event scan (only relevant when not alive).
     Returns None when the matrix regime doesn't apply (``force=True``
-    skips the size gate, for differential tests)."""
+    skips the size gate, for differential tests). With a ``mesh`` the
+    chunk axis shards over the devices (the checker ladder's ``sharded``
+    rung passes parallel.auto_mesh())."""
     if step_ids is None:
         step_ids = _default_step_ids()
     num_states = num_states if num_states is not None else len(stream.intern)
@@ -687,12 +861,12 @@ def matrix_check(stream, step_ids=None, init_state: int = 0,
         return None
     return matrix_check_batch([stream], step_ids=step_ids,
                               init_state=init_state,
-                              num_states=num_states)[0]
+                              num_states=num_states, mesh=mesh)[0]
 
 
 def matrix_check_resume(stream, tot0=None, step_ids=None,
                         init_state: int = 0, num_states: int | None = None,
-                        n_slots: int | None = None):
+                        n_slots: int | None = None, mesh=None):
     """Segmented transfer-matrix verification of one long history: checks
     a segment starting from the composed operator product ``tot0`` of the
     prior segments (None = identity) and returns
@@ -711,7 +885,15 @@ def matrix_check_resume(stream, tot0=None, step_ids=None,
     build segment streams against one interning scheme) so every
     segment's value ids mean the same thing — tot0 is checked against
     the resulting operator dimension and a mismatch raises rather than
-    composing over a permuted basis."""
+    composing over a permuted basis.
+
+    With a ``mesh`` the segment's chunk axis shards over the devices
+    (each device scans a contiguous time span, the span products
+    tree-combine device-side after one [nd, MV, MV] all_gather — see
+    _build_matrix_kernel_mesh). The carry is the same replicated
+    [1, MV, MV] product either way, so a chain may freely mix sharded
+    and single-device segments (the ladder's sharded→device demotion
+    mid-chain is sound)."""
     if step_ids is None:
         step_ids = _default_step_ids()
     if num_states is None:
@@ -734,7 +916,7 @@ def matrix_check_resume(stream, tot0=None, step_ids=None,
         alive = (np.asarray(tot0)[:, :, init_state] > 0).any(axis=1)
         return alive, False, tot0
     out = _matrix_dispatch([prep], S, R_max, V, step_ids, init_state,
-                           None, resume=True, tot0=tot0)
+                           mesh, resume=True, tot0=tot0)
     return out[0], out[1], out[2]
 
 
@@ -769,6 +951,18 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     R_max = max(int((k == EV_RETURN).sum()) for k in kinds)
     if R_max == 0:
         return [(True, -1, False, 0)] * B
+    # every matrix dispatch — key batches, the ladder's sharded rung,
+    # the live daemon's screens, segmented rounds via matrix_check —
+    # feeds the per-device-count rate model here, so mesh_route's
+    # measured-rate comparison activates no matter which caller runs
+    # (doc/performance.md "The cost gate")
+    total_events = sum(len(k) for k in kinds)
+    t_start = time.perf_counter()
+
+    def observe(n_devices: int) -> None:
+        from jepsen_tpu.parallel import pipeline
+        pipeline.observe_device_rate(n_devices, total_events,
+                                     time.perf_counter() - t_start)
 
     def prep(i):
         s = streams[i]
@@ -795,8 +989,6 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         # (and a B'=1 tail would even flip the chunk target): pad it
         # with empty keys (R=0 -> identity product, trivially alive)
         # so EVERY dispatch shares the one compiled shape
-        empty_prep = (np.zeros(0, np.int32), np.zeros((0, 1), bool),
-                      np.zeros((0, 1, 3), np.int64), 1)
         C, T = _matrix_plan(sub, S, R_max, V, None)
         run = _matrix_cache(S, V, step_ids, init_state, T, C, sub)
         pipe = DispatchPipeline(depth=PIPELINE_DEPTH, name="matrix")
@@ -807,7 +999,7 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
                 t0 = time.perf_counter()
                 sl = [prep(i) for i in range(lo, min(lo + sub, B))]
                 counts.append(len(sl))
-                sl += [empty_prep] * (sub - len(sl))
+                sl += [_EMPTY_PREP] * (sub - len(sl))
                 t1 = time.perf_counter()
                 # build + STAGE the grids now (device_put issues the H2D
                 # copies immediately, overlapping in-flight compute)
@@ -831,6 +1023,7 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         out = []
         for nb, (a, ix) in zip(counts, fetched):
             out += [(bool(a[b]), -1, bool(ix[b]), 0) for b in range(nb)]
+        observe(1)
         return out
 
     phases = {}
@@ -843,6 +1036,7 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     alive, inexact = jax.device_get(handle)
     phases["fetch"] = time.perf_counter() - t0
     _PHASE.value = {k: round(v, 4) for k, v in phases.items()}
+    observe(1 if mesh is None else int(mesh.devices.size))
     return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
 
 
@@ -857,13 +1051,20 @@ def _matrix_plan(B, S, R_max, V, mesh):
     that point only slows each of the fewer steps down. C is
     additionally capped by the element budget."""
     MV = (1 << S) * V
-    if B * MV * MV > MATRIX_MAX_ELEMS:
+    nd = int(mesh.devices.size) if mesh is not None else 1
+    # with a mesh the per-step [G, MV, MV] working set shards over the
+    # devices, so the element budget binds PER DEVICE — the key count a
+    # single device must hold is ceil(B/nd) (the dispatch pads B up to a
+    # device multiple for the key-sharded kernel)
+    budget_keys = B if mesh is None else -(-B // nd)
+    if budget_keys * MV * MV > MATRIX_MAX_ELEMS:
         # even C=1 would allocate over-budget [B, MV, MV] intermediates;
         # callers pre-gate with matrix_ok, so a direct caller this large
         # must hear "out of regime" rather than OOM the device
         raise ValueError(
-            f"matrix_check_batch out of regime: B*MV^2 = {B * MV * MV} "
-            f"> {MATRIX_MAX_ELEMS}; split the key batch or use the scan")
+            f"matrix_check_batch out of regime: keys/device * MV^2 = "
+            f"{budget_keys * MV * MV} > {MATRIX_MAX_ELEMS}; split the "
+            f"key batch or use the scan")
     rb = _bucket(R_max, floor=64)
     # chunk-count target, measured on-chip (r5 sweep, 64x1k keys):
     # G = B*C ≈ 2048 beats the old 256 target by ~9% on key BATCHES
@@ -873,19 +1074,15 @@ def _matrix_plan(B, S, R_max, V, mesh):
     # nothing. Per-key C stays capped at 256.
     target_g = 256 if B == 1 else 2048
     C = int(np.clip(target_g // B, 1, 256))
-    C = max(1, min(C, MATRIX_MAX_ELEMS // (B * MV * MV)))
-    if mesh is not None:
-        # G = B*C must divide over the mesh or the sharding guard below
-        # would silently fall back to one device: bump C to the next
-        # value making B*C a device-count multiple (always exists within
-        # nd steps) — kept only if it fits the element budget, else the
-        # original C stands and the batch runs unsharded as before
-        nd = int(mesh.devices.size)
-        c2 = C
-        while (B * c2) % nd:
-            c2 += 1
-        if B * c2 * MV * MV <= MATRIX_MAX_ELEMS:
-            C = c2
+    C = max(1, min(C, MATRIX_MAX_ELEMS // (budget_keys * MV * MV)))
+    if mesh is not None and B == 1:
+        # the chunk axis shards over the mesh: pad C up to a device
+        # multiple (identity chunks, visible in the
+        # checker_mesh_padding_frac gauge) instead of the old silent
+        # fall-back to an unsharded dispatch. Always within budget: the
+        # per-device block C/nd * MV^2 never exceeds the unsharded
+        # C * MV^2 the budget already admitted.
+        C = -(-max(C, nd) // nd) * nd
     T = -(-rb // C)
     return C, T
 
@@ -944,11 +1141,43 @@ def _matrix_grids(preps, S, V, B, C, T, mesh):
 
     grids = [as_tg(np.stack(pends)), as_tg(ids),
              as_tg(np.stack(slots).astype(np.int8)), as_tg(np.stack(vals))]
-    if mesh is not None and (B * C) % mesh.devices.size == 0:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        sh = NamedSharding(mesh, P(None, mesh.axis_names[0]))
-        grids = [jax.device_put(a, sh) for a in grids]
+    if mesh is not None:
+        # the chunk axis G = B*C is a device multiple by construction
+        # (_matrix_plan bumps C for B == 1, _matrix_dispatch pads the
+        # key axis otherwise — the old path here silently DROPPED the
+        # sharding on a non-divisible G): stage each device's block down
+        # its own transfer lane
+        from jepsen_tpu.parallel import shard_chunked
+        grids = shard_chunked(mesh, grids, axis=1)
     return grids, uops
+
+
+# empty key prep (R=0): its chunks are all-invalid, so its product is
+# the identity — trivially alive, trivially exact. The key-axis pad for
+# mesh divisibility, and the pipelined path's tail pad, both use it.
+_EMPTY_PREP = (np.zeros(0, np.int32), np.zeros((0, 1), bool),
+               np.zeros((0, 1, 3), np.int64), 1)
+
+
+def _publish_mesh_padding(B_real, B_pad, S, R_max, V, C, T):
+    """``checker_mesh_padding_frac``: the fraction of a sharded
+    dispatch's chunk-step work (G * T) spent on mesh-divisibility
+    padding — identity chunks from bumping C (B == 1) or padded keys.
+    The cost of never silently dropping sharding, kept visible."""
+    from jepsen_tpu import telemetry
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return
+    try:
+        c0, t0 = _matrix_plan(B_real, S, R_max, V, None)
+        frac = max(0.0, 1.0 - (B_real * c0 * t0) / float(B_pad * C * T))
+    except ValueError:
+        # the unsharded plan can be out of budget where the per-device
+        # sharded one is not: no meaningful baseline, skip the gauge
+        return
+    reg.gauge("checker_mesh_padding_frac",
+              "fraction of sharded chunk-step work spent on mesh "
+              "divisibility padding, last sharded dispatch").set(frac)
 
 
 def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh,
@@ -957,14 +1186,24 @@ def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh,
     """Builds one sub-batch's chunk grids and dispatches the kernel,
     returning UNSYNCED device arrays (alive[B], inexact[B]; plus the
     composed total[B, MV, MV] when ``resume``) so callers can pipeline
-    several dispatches before reading any back. ``phases`` (optional)
-    collects the host grids/dispatch wall split for attribution."""
+    several dispatches before reading any back. With a mesh the dispatch
+    shards (chunk axis for B == 1, key axis otherwise — the key axis is
+    padded HERE with empty keys to a device multiple; callers index only
+    their real keys). ``phases`` (optional) collects the host
+    grids/dispatch wall split for attribution."""
+    B_real = len(preps)
+    if mesh is not None and B_real > 1:
+        nd = int(mesh.devices.size)
+        if B_real % nd:
+            preps = list(preps) + [_EMPTY_PREP] * ((-B_real) % nd)
     B = len(preps)
     C, T = _matrix_plan(B, S, R_max, V, mesh)
+    if mesh is not None:
+        _publish_mesh_padding(B_real, B, S, R_max, V, C, T)
     t0 = time.perf_counter()
     grids, uops = _matrix_grids(preps, S, V, B, C, T, mesh)
     t1 = time.perf_counter()
-    run = _matrix_cache(S, V, step_ids, init_state, T, C, B)
+    run = _matrix_cache(S, V, step_ids, init_state, T, C, B, mesh)
     if resume:
         if tot0 is None:
             tot0 = run.init_total()
@@ -993,13 +1232,23 @@ def _default_step_ids():
     return _DEFAULT_STEP_IDS
 
 
-def _matrix_cache(S, V, step_ids, init_state, T, C, B=1):
+def _matrix_cache(S, V, step_ids, init_state, T, C, B=1, mesh=None):
     # the uop-table length is a runtime array shape — jax.jit retraces on
-    # it, so it doesn't belong in this key
-    key = (S, V, id(step_ids), init_state, T, C, B)
+    # it, so it doesn't belong in this key. A mesh keys on its device ids
+    # + axis names: parallel.auto_mesh caches one Mesh per device count,
+    # so repeated sharded dispatches hit the same compiled kernel.
+    mesh_key = (None if mesh is None else
+                (tuple(int(d.id) for d in mesh.devices.flat),
+                 tuple(mesh.axis_names)))
+    key = (S, V, id(step_ids), init_state, T, C, B, mesh_key)
     fn = _MATRIX_CACHE.get(key)
     if fn is None:
-        fn = _build_matrix_kernel(S, V, step_ids, init_state, T, C, n_keys=B)
+        if mesh is not None:
+            fn = _build_matrix_kernel_mesh(S, V, step_ids, init_state, T,
+                                           C, n_keys=B, mesh=mesh)
+        else:
+            fn = _build_matrix_kernel(S, V, step_ids, init_state, T, C,
+                                      n_keys=B)
         _MATRIX_CACHE[key] = fn
     return fn
 

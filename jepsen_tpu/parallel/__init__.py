@@ -47,13 +47,166 @@ def get_mesh(n_devices: int | None = None, axis: str = "keys"):
     return Mesh(np.array(devs), (axis,))
 
 
+# One Mesh object per (device count, axis): jitlin's compile caches key
+# on the mesh's device ids + axis names, but Mesh construction itself is
+# cheap-ish yet NOT free, and handing callers the same object makes
+# caching behavior obvious in traces.
+_MESH_CACHE: dict = {}
+
+
+def coerce_devices(value, knob: str = "mesh_devices") -> int | None:
+    """Tolerant device-count knob coercion: None/'' read as unset,
+    numeric strings work, garbage warns and reads as unset (the
+    interpreter's knob-layer discipline — a bad sweep variable must
+    not fail a run preflight already admitted)."""
+    if value is None or value == "":
+        return None
+    if isinstance(value, bool):
+        logger.warning("ignoring bool %s=%r (want a device count)",
+                       knob, value)
+        return None
+    try:
+        n = int(float(value))
+    except (TypeError, ValueError):
+        logger.warning("ignoring malformed %s=%r (want an int)",
+                       knob, value)
+        return None
+    return max(0, n)
+
+
+def coerce_flag(value, knob: str = "checker_sharded") -> bool | None:
+    """Tolerant bool knob coercion: None/'' unset; bools and 0/1 pass;
+    yes/no/true/false/on/off strings work; garbage warns and reads as
+    unset (the env/ladder default then applies)."""
+    if value is None or value == "":
+        return None
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, (int, float)) and value in (0, 1):
+        return bool(value)
+    if isinstance(value, str):
+        s = value.strip().lower()
+        if s in ("1", "true", "yes", "on"):
+            return True
+        if s in ("0", "false", "no", "off"):
+            return False
+    logger.warning("ignoring malformed %s=%r (want a bool)", knob, value)
+    return None
+
+
+def sharding_knobs(test, opts) -> tuple:
+    """The per-run sharding knob pair ``(checker_sharded flag,
+    mesh_devices cap)`` from a checker's (test, opts), tolerantly
+    coerced, opts taking precedence over the test map — the ONE reading
+    LinearizableChecker and IndependentChecker share (True forces the
+    sharded path, False disables it, None = env default + cost model)."""
+    tmap = test if isinstance(test, dict) else {}
+    flag = coerce_flag(opts.get("checker_sharded",
+                                tmap.get("checker_sharded")))
+    devices = coerce_devices(opts.get("mesh_devices",
+                                      tmap.get("mesh_devices")))
+    return flag, devices
+
+
+def mesh_devices_limit() -> int | None:
+    """The ``JEPSEN_TPU_MESH_DEVICES`` env cap on mesh width, tolerantly
+    coerced (garbage warns and reads as unset, like the interpreter's
+    knob layer). 0/1 effectively disables sharding; None = no cap."""
+    import os
+    return coerce_devices(os.environ.get("JEPSEN_TPU_MESH_DEVICES"),
+                          knob="JEPSEN_TPU_MESH_DEVICES")
+
+
+def auto_mesh(n_devices: int | None = None, axis: str = "keys"):
+    """The cached 1-D mesh a sharded checker dispatch should run over,
+    or None when fewer than 2 devices would participate. ``n_devices``
+    caps the width (a test-map ``mesh_devices`` knob); the
+    ``JEPSEN_TPU_MESH_DEVICES`` env var caps it globally. Returning the
+    SAME Mesh object per width keeps jitlin's mesh-keyed compile caches
+    warm across dispatches."""
+    import jax
+    try:
+        devs = jax.devices()
+    except Exception:  # noqa: BLE001 — no backend: no mesh
+        return None
+    n = len(devs)
+    if n_devices is not None:
+        n = min(n, int(n_devices))
+    limit = mesh_devices_limit()
+    if limit is not None:
+        n = min(n, limit)
+    if n < 2:
+        return None
+    key = (n, axis)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None or list(mesh.devices.flat) != devs[:n]:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(devs[:n]), (axis,))
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def sharded_enabled() -> bool:
+    """Is the sharded checker rung enabled? ``JEPSEN_TPU_SHARDED``
+    (default on); the test-map ``checker_sharded`` knob overrides per
+    run (checker/linearizable.py coerces it tolerantly)."""
+    import os
+    raw = os.environ.get("JEPSEN_TPU_SHARDED", "1").strip().lower()
+    return raw not in ("0", "false", "no", "off", "")
+
+
+def sharded_mesh_for(total_events: int, n_devices: int | None = None):
+    """The mesh a sharded dispatch should use for ``total_events`` of
+    work, or None: sharding disabled, <2 devices, or the cost model says
+    the batch is too small to amortize mesh overhead (collective setup,
+    divisibility padding, per-device dispatch) — small batches must not
+    pay it (see pipeline.CostModel.mesh_route)."""
+    if not sharded_enabled():
+        return None
+    mesh = auto_mesh(n_devices)
+    if mesh is None:
+        return None
+    from jepsen_tpu.parallel import pipeline
+    if not pipeline.mesh_route(total_events, int(mesh.devices.size)):
+        return None
+    return mesh
+
+
 def shard_leading(mesh, *arrays):
     """Places arrays with their leading axis sharded over the mesh."""
+    return shard_chunked(mesh, list(arrays), axis=0)
+
+
+def shard_chunked(mesh, arrays, axis: int = 0):
+    """Per-device transfer lanes: splits each array into contiguous
+    per-device blocks along ``axis`` and stages each block onto its own
+    device — every ``device_put`` issues that lane's H2D copy
+    immediately and asynchronously, so the eight lanes' staging overlaps
+    each other AND any in-flight compute (the DispatchPipeline overlap
+    discipline, per device) — then assembles the global sharded array
+    the shard_map kernels consume without a resharding copy. The sharded
+    axis must be a device multiple; jitlin's planner guarantees that by
+    padding (never by silently dropping the sharding)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    axis = mesh.axis_names[0]
-    sharding = NamedSharding(mesh, P(axis))
-    return [jax.device_put(a, sharding) for a in arrays]
+    devs = list(mesh.devices.flat)
+    nd = len(devs)
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if a.shape[axis] % nd:
+            raise ValueError(
+                f"axis {axis} length {a.shape[axis]} not divisible by "
+                f"{nd} mesh devices — pad upstream (jitlin._matrix_plan /"
+                f" parallel.pad_to_multiple)")
+        spec = [None] * a.ndim
+        spec[axis] = mesh.axis_names[0]
+        sharding = NamedSharding(mesh, P(*spec))
+        blocks = np.split(a, nd, axis=axis)
+        parts = [jax.device_put(b, d) for b, d in zip(blocks, devs)]
+        out.append(jax.make_array_from_single_device_arrays(
+            a.shape, sharding, parts))
+    return out
 
 
 def pad_to_multiple(batch: dict, multiple: int) -> tuple[dict, int]:
@@ -78,7 +231,8 @@ def pad_to_multiple(batch: dict, multiple: int) -> tuple[dict, int]:
 _DEFAULT_KERNEL = None
 
 # How the most recent batch_check on THIS thread settled: "device"
-# (matrix/scan kernels) or "cpu" (the auto-routed native/Python lane).
+# (single-device matrix/scan kernels), "mesh" (the shard_map multi-device
+# path), or "cpu" (the auto-routed native/Python lane).
 # Thread-local — Compose runs checkers concurrently under bounded_pmap,
 # and a module global would let one thread's route mislabel another's
 # results.
@@ -103,7 +257,7 @@ def _default_kernel():
 
 def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
                 step_ids=None, init_state: int = 0, kernel=None,
-                accelerator: str = "device"):
+                accelerator: str = "device", mesh_devices: int | None = None):
     """Checks a batch of per-key event streams, sharded across a device
     mesh when one is available. The single batching implementation —
     JitLinKernel.check/check_batch delegate here.
@@ -122,7 +276,10 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     (parallel.pipeline.CostModel) and take the CPU lane when it beats
     the device's dispatch-latency floor (small batches on tunneled
     chips). The thread-local ``last_route()`` records which lane
-    settled for the calling thread.
+    settled for the calling thread ("cpu" / "device" / "mesh").
+    ``mesh_devices`` caps auto-detected mesh width (the test-map knob;
+    pass ``mesh=False`` to force single-device, as the multi-process
+    path does).
 
     Returns [(alive, died_event, overflow, peak)] per stream (real keys
     only; padding keys are dropped).
@@ -140,7 +297,11 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
             kernel = JitLinKernel(step_ids=step_ids, init_state=init_state)
     streams = list(streams)
     _ROUTE.value = "device"
-    if accelerator in ("cpu", "auto"):
+    # an explicit mesh is an operator force (checker_sharded: True) —
+    # the auto CPU route must not silently override it
+    explicit_mesh = mesh is not None and mesh is not False
+    if accelerator == "cpu" or (accelerator == "auto"
+                                and not explicit_mesh):
         cpu = _cpu_batch_maybe(streams, kernel,
                                force=(accelerator == "cpu"))
         if cpu is not None:
@@ -159,10 +320,17 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
     # path (distributed.batch_check_distributed) splits keys BY PROCESS
     # and must not let auto-detection grab the process-spanning global
     # mesh (a process can only address its own devices' shards)
+    total_events = sum(len(s.kind) for s in streams)
     if mesh is False:
         mesh = None
-    elif mesh is None and len(jax.devices()) > 1:
-        mesh = get_mesh()
+    elif mesh is None:
+        # cost-gated: a small batch must not pay mesh overhead
+        # (collective setup, divisibility padding) — the per-device-count
+        # rate model routes it to one device (doc/performance.md);
+        # ``mesh_devices`` (the test-map knob) caps the width
+        mesh = sharded_mesh_for(total_events, mesh_devices)
+    if mesh is not None:
+        _ROUTE.value = "mesh"
 
     S_all = max(max(1, s.n_slots) for s in streams)
     if n_states is not None and S_all <= MATRIX_MAX_SLOTS \
@@ -171,11 +339,15 @@ def batch_check(streams: Sequence, capacity: int = 256, mesh=None,
         total_returns = sum(int((np.asarray(s.kind) == EV_RETURN).sum())
                             for s in streams)
         # single-device batches split into MATRIX_SUB_KEYS dispatches, so
-        # the element budget binds per sub-batch, not the whole key set
-        sub = (len(streams) if mesh is not None
+        # the element budget binds per sub-batch, not the whole key set.
+        # A mesh pads keys to a device multiple and holds B/nd per device
+        sub = (-(-len(streams) // int(mesh.devices.size))
+               if mesh is not None
                else min(len(streams), MATRIX_SUB_KEYS))
         if total_returns >= MATRIX_MIN_RETURNS \
                 and sub * mv * mv <= MATRIX_MAX_ELEMS:
+            # matrix_check_batch feeds the per-device-count rate model
+            # itself (every caller benefits, not just this one)
             results = matrix_check_batch(
                 streams, step_ids=kernel.step_ids,
                 init_state=kernel.init_state, num_states=n_states,

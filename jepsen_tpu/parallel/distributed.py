@@ -50,6 +50,27 @@ def global_mesh(axis: str = "edges"):
     return Mesh(np.asarray(jax.devices()), (axis,))
 
 
+def local_mesh(axis: str = "keys", max_devices: int | None = None):
+    """A mesh over THIS process's devices only, or None with fewer than
+    two (``max_devices`` caps the width — pass
+    parallel.mesh_devices_limit() so the JEPSEN_TPU_MESH_DEVICES global
+    disable applies to multi-process runs too). The intra-host half of
+    the multi-host decomposition: keys split by process over DCN
+    (batch_check_distributed), then each process's slice shards over its
+    own devices with the same shard_map kernels — a process can only
+    materialize its own devices' shards, so the process-spanning global
+    mesh must never be handed to a local batch_check."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.local_devices()
+    if max_devices is not None:
+        devs = devs[:max_devices]
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), (axis,))
+
+
 def _place_local(mesh, local: np.ndarray):
     """Global sharded array from this process's shard (equal-length
     shards per process; caller pads)."""
@@ -114,8 +135,22 @@ def batch_check_distributed(streams, capacity: int = 256, kernel=None):
     pid, n_proc = jax.process_index(), jax.process_count()
     lo = pid * n // n_proc
     hi = (pid + 1) * n // n_proc
+    # within the process, the slice may still shard over the LOCAL
+    # devices (cost-gated like the single-host path); mesh=False remains
+    # the floor so auto-detection can never grab the process-spanning
+    # global mesh
+    mesh = False
+    if hi > lo:
+        from jepsen_tpu import parallel
+        from jepsen_tpu.parallel import pipeline
+        lm = (local_mesh(max_devices=parallel.mesh_devices_limit())
+              if parallel.sharded_enabled() else None)
+        if lm is not None and pipeline.mesh_route(
+                sum(len(s.kind) for s in streams[lo:hi]),
+                int(lm.devices.size)):
+            mesh = lm
     local = batch_check(streams[lo:hi], capacity=capacity, kernel=kernel,
-                        mesh=False) if hi > lo else []
+                        mesh=mesh) if hi > lo else []
     # fixed-size per-process row block (keys aren't perfectly divisible):
     # pad with sentinel rows, mark validity in column 0
     per = -(-n // n_proc)
@@ -123,10 +158,13 @@ def batch_check_distributed(streams, capacity: int = 256, kernel=None):
     for i, (alive, died, ovf, peak) in enumerate(local):
         block[i] = (1, int(bool(alive)), int(died), int(bool(ovf)),
                     int(peak))
-    gathered = multihost_utils.process_allgather(block)
+    # single-process allgather returns the block unstacked; normalize to
+    # the (n_proc, per, 5) layout the unpack below expects
+    gathered = np.asarray(
+        multihost_utils.process_allgather(block)).reshape(n_proc, per, 5)
     out = []
     for p in range(n_proc):
-        for row in np.asarray(gathered)[p]:
+        for row in gathered[p]:
             if row[0] == 1:
                 out.append((bool(row[1]), int(row[2]), bool(row[3]),
                             int(row[4])))

@@ -64,6 +64,23 @@ DEFAULT_CPU_EVENTS_PER_SEC = 100_000.0
 
 _RTT_CACHE: dict = {}
 _CPU_RATE: dict = {}
+# measured checker throughput per mesh width: {n_devices: events/s EWMA}
+# (n_devices=1 is the single-device lane). Feeds CostModel.mesh_route so
+# a small batch is not sent to the mesh on faith.
+_DEVICE_RATE: dict = {}
+
+# Below this many events, a batch with no measured rates skips the mesh:
+# the fixed mesh costs (per-device staging, divisibility padding, the
+# verdict collective) can't amortize on tiny dispatches. Env-tunable for
+# on-chip sweeps.
+MESH_MIN_EVENTS = int(os.environ.get("JEPSEN_TPU_MESH_MIN_EVENTS",
+                                     str(1 << 16)))
+# with no measured single-device rate, every Nth mesh-eligible batch
+# runs single-device instead — the probe that lets mesh_route's
+# measured comparison activate (and demote a losing mesh) in workloads
+# that would otherwise only ever sample the mesh width
+MESH_PROBE_EVERY = 16
+_MESH_PROBE_COUNT = 0
 
 
 def measured_roundtrip_s() -> float:
@@ -111,6 +128,31 @@ def cpu_events_per_sec() -> float:
     return _CPU_RATE.get("events_per_sec", DEFAULT_CPU_EVENTS_PER_SEC)
 
 
+def observe_device_rate(n_devices: int, n_events: int,
+                        seconds: float) -> None:
+    """Feeds one measured device-lane sample into the per-device-count
+    rate model (EWMA per mesh width). The first sample per width
+    includes JIT compile — the EWMA washes it out within a few
+    dispatches, and an under-estimate only means routing a batch to one
+    device, the old behavior. Samples below a quarter of
+    MESH_MIN_EVENTS are dropped: a tiny dispatch measures fixed
+    overhead (compile, staging, the round trip), not throughput, and
+    would mislead the route comparison at the large sizes where routing
+    matters."""
+    if (seconds <= 0 or n_events < max(1, MESH_MIN_EVENTS // 4)
+            or n_devices < 1):
+        return
+    rate = n_events / seconds
+    prev = _DEVICE_RATE.get(n_devices)
+    _DEVICE_RATE[n_devices] = (rate if prev is None
+                               else 0.7 * prev + 0.3 * rate)
+
+
+def device_events_per_sec(n_devices: int) -> float | None:
+    """The measured EWMA rate at a mesh width, or None (no sample)."""
+    return _DEVICE_RATE.get(n_devices)
+
+
 class CostModel:
     """Round-trip-vs-CPU routing for ``accelerator=auto``.
 
@@ -154,6 +196,35 @@ class CostModel:
         EWMA keeps it honest as the host load shifts)."""
         return max(0.0, seconds) * self.cpu_rate()
 
+    def mesh_route(self, total_events: int, n_devices: int) -> bool:
+        """Should a batch of ``total_events`` take the ``n_devices``
+        mesh path? With measured rates at both widths, compare predicted
+        times (the mesh side also pays ~1 extra round trip for the
+        verdict collective + per-device staging); without evidence, gate
+        on MESH_MIN_EVENTS so small batches never pay mesh overhead on
+        faith. A wrong "no" is the old single-device behavior; a wrong
+        "yes" self-corrects once the rates land — and because a
+        mesh-dominated workload would otherwise never produce a
+        single-device sample, every MESH_PROBE_EVERY-th eligible batch
+        with no measured single-device rate runs single-device as a
+        probe, so the comparison can activate and demote a losing
+        mesh."""
+        global _MESH_PROBE_COUNT
+        if n_devices < 2:
+            return False
+        r1 = device_events_per_sec(1)
+        rn = device_events_per_sec(n_devices)
+        if r1 and rn:
+            return (total_events / rn + self.rtt()
+                    < total_events / r1)
+        if total_events < MESH_MIN_EVENTS:
+            return False
+        if r1 is None:
+            _MESH_PROBE_COUNT += 1
+            if _MESH_PROBE_COUNT % MESH_PROBE_EVERY == 0:
+                return False
+        return True
+
 
 _DEFAULT_MODEL = CostModel()
 
@@ -161,6 +232,11 @@ _DEFAULT_MODEL = CostModel()
 def auto_route(total_events: int) -> str:
     """Module-level routing with the process-default cost model."""
     return _DEFAULT_MODEL.route(total_events)
+
+
+def mesh_route(total_events: int, n_devices: int) -> bool:
+    """Module-level mesh gate with the process-default cost model."""
+    return _DEFAULT_MODEL.mesh_route(total_events, n_devices)
 
 
 def donate_ok() -> bool:

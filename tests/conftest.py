@@ -15,8 +15,34 @@ import sys
 # Exception: JEPSEN_TPU_TESTS=1 opts a session INTO the real chip for the
 # ``-m tpu`` parity tier (tests/test_tpu_parity.py) — the platform list is
 # left alone so the axon TPU stays the default device.
+#
+# The ``-m mesh`` lane (multi-device sharding differentials,
+# tests/test_mesh.py) overrides even that: its tests NEED the 8-device
+# virtual CPU mesh, and a single tunneled chip can't provide one — so a
+# mesh-lane session is always forced onto the virtual mesh.
 TPU_SESSION = bool(os.environ.get("JEPSEN_TPU_TESTS"))
-if not TPU_SESSION:
+
+
+def _wants_mesh_lane() -> bool:
+    """True when this session's -m expression selects the mesh marker
+    (parsed from argv — this must run before pytest parses options,
+    because the XLA flag only works before any jax import)."""
+    def selects(expr: str) -> bool:
+        return "mesh" in expr and "not mesh" not in expr
+
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        if a in ("-m", "--markexpr") and i + 1 < len(argv) \
+                and selects(argv[i + 1]):
+            return True
+        if (a.startswith("-m") or a.startswith("--markexpr=")) \
+                and selects(a):
+            return True
+    return False
+
+
+MESH_LANE = _wants_mesh_lane()
+if not TPU_SESSION or MESH_LANE:
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
     if "--xla_force_host_platform_device_count" not in _flags:
@@ -31,7 +57,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # CPU-only by design (outside the opted-in tpu tier), so force the
 # platform list back to cpu before any backend init (conftest imports
 # before any test touches jax).
-if not TPU_SESSION:
+if not TPU_SESSION or MESH_LANE:
     try:
         import jax
 
