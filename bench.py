@@ -39,8 +39,11 @@ metric:
 Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
 stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
-elle_50k, matrix_kernel, headline, scale, telemetry — the last opts out
-of the per-stage telemetry block in bench_summary).
+elle_50k, online_lag, matrix_kernel, explain, multichip, headline,
+scale, telemetry — the last opts out of the per-stage telemetry block
+in bench_summary). ``explain`` tracks anomaly-forensics cost
+(explain_latency_128k: localize + shrink a planted anomaly; the bar is
+< 2× the plain check wall — doc/observability.md "Anomaly forensics").
 """
 from __future__ import annotations
 
@@ -643,6 +646,55 @@ def cfg_matrix_kernel():
          dt_scan / dt_matrix, **extra)
 
 
+def cfg_explain():
+    """explain_latency_128k: anomaly forensics (device localization +
+    witness shrink, checker/explain.py) on a planted-anomaly 128k-event
+    history. The bar is < 2× the PLAIN matrix check's wall time —
+    forensics must stay in the same cost class as the verdict they
+    explain, or nobody runs them (vs_baseline = 2×check / explain; ≥ 1
+    is under the bar). Steady-state like every quick config: the one
+    warm-up explain compiles the forensics kernels (products + prefix
+    scan + the ddmin candidate buckets its deterministic round sequence
+    touches)."""
+    from dataclasses import replace
+
+    from jepsen_tpu.checker.explain import explain_stream
+    from jepsen_tpu.checker.linear_cpu import check_stream
+    from jepsen_tpu.ops.jitlin import matrix_check
+
+    stream = _block_stream(12_800, n_values=4)   # 128k events, V=5
+    E = len(stream)
+    # plant the anomaly the way cfg_matrix_kernel's failing path does:
+    # one read observes a value that is neither w_{t-1} nor w_t
+    t = (E // (2 * N_PROCS)) // 2
+    a_bad = stream.a.copy()
+    a_bad[t * 2 * N_PROCS + 1] = (t + 1) % 4 + 1
+    bad = replace(stream, a=a_bad)
+
+    m = _warm_timed("explain_check", lambda: matrix_check(bad))
+    assert m is not None and not m[0] and not m[2], m
+    _, t_check = _trials(lambda: matrix_check(bad), 3)
+    check_med = _median(t_check)
+
+    f = _warm_timed("explain", lambda: explain_stream(bad))
+    assert f is not None, "planted anomaly must localize"
+    # differential anchor: the device bisection must land on the exact
+    # CPU frontier rejection (one CPU pass, outside the trials)
+    cpu = check_stream(bad)
+    assert f["first_anomaly"]["event"] == cpu.failed_event, (
+        f["first_anomaly"], cpu.failed_event)
+    results, t_explain = _trials(lambda: explain_stream(bad), 3)
+    explain_med = _median(t_explain)
+    emit("explain_latency_128k", explain_med, "s",
+         (2.0 * check_med) / max(explain_med, 1e-9),
+         check_seconds=round(check_med, 4),
+         first_anomaly_op=results["first_anomaly"]["op_index"],
+         witness_ops=len(results["witness"]["op_indices"]),
+         bisect_steps=results["bisect_steps"],
+         shrink_candidates=results["witness"]["candidates"],
+         trials=len(t_explain))
+
+
 def cfg_scale(device_rate: float):
     """North-star scaling metric: the largest single logical history
     verified on device inside the 300 s budget.
@@ -1115,6 +1167,7 @@ def main() -> None:
     guard("elle_50k", cfg_elle_50k)
     guard("online_lag", cfg_online_lag)
     guard("matrix_kernel", cfg_matrix_kernel)
+    guard("explain", cfg_explain)
     guard("multichip", cfg_multichip_scaling)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))

@@ -318,12 +318,18 @@ _NUMERIC_KNOBS = (
     # parallel.coerce_devices coerces tolerantly at runtime, preflight
     # is where garbage becomes an error
     ("mesh_devices", True, 0.0),
+    # anomaly forensics (doc/observability.md "Anomaly forensics"):
+    # witness-shrink bounds — checker/explain coerces tolerantly at
+    # runtime, preflight is where garbage becomes an error
+    ("explain_shrink_budget", True, 0.0),
+    ("explain_max_witness_ops", True, 1.0),
 )
 
-# bool knobs: the sharded-rung switch (checker/linearizable.py coerces
-# via parallel.coerce_flag — bools and 0/1 pass, yes/no strings warn,
-# garbage errors here instead of silently reading as unset)
-_BOOL_KNOBS = ("checker_sharded",)
+# bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
+# bools and 0/1 pass, yes/no strings warn, garbage errors here instead
+# of silently reading as unset): the sharded-rung switch and the
+# anomaly-forensics switch
+_BOOL_KNOBS = ("checker_sharded", "explain")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 _UNSET = object()
@@ -377,11 +383,17 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
                 "KNB006", WARNING, key,
                 f"{key} is a string ({v!r}); prefer a plain bool"))
             continue
+        hints = {
+            "checker_sharded": "true enables the sharded checker rung, "
+                               "false forces single-device; unset = env "
+                               "default + cost model",
+            "explain": "true (the default) derives anomaly forensics on "
+                       "invalid verdicts; false skips localization and "
+                       "artifacts",
+        }
         out.append(Diagnostic(
             "KNB001", ERROR, key,
-            f"{key} must be a bool, got {v!r}",
-            hint="true enables the sharded checker rung, false forces "
-                 "single-device; unset = env default + cost model"))
+            f"{key} must be a bool, got {v!r}", hint=hints.get(key)))
 
     nodes = list(test.get("nodes") or [])
     conc_raw = test.get("concurrency", 1)

@@ -1,0 +1,569 @@
+"""Anomaly forensics: localization, minimal witnesses, and `explain`.
+
+Jepsen's value is the *evidence* a run persists, not just its verdict
+(README's store/ surface; Elle's whole contribution is a human-readable
+proof of an anomaly). The fast checker paths outgrew that: the matrix,
+segmented, and sharded kernels verify hundreds of millions of events and
+answer only ``valid: false``. This module turns that bare INVALID into
+something a human can act on:
+
+* **Localization** — the exact first anomaly. In the transfer-matrix
+  regime, :func:`jepsen_tpu.ops.jitlin.matrix_localize` bisects the
+  composable per-chunk operator products ON DEVICE (log-depth prefix
+  combines + one [MV]-vector chunk re-scan) and lands on the same event
+  the exact CPU frontier would reject — pinned bit-identical by
+  tests/test_explain.py across single-device, segmented, sharded-mesh,
+  and live-screen backends. Out of regime, the CPU frontier's own
+  rejection (``LinearResult.failed_event``) serves.
+
+* **Witness shrink** — a minimal window that still reproduces the
+  failure. A bounded ddmin pass removes candidate op subsets from the
+  guilty window and re-checks every candidate of a round in ONE vmapped
+  device dispatch (``jitlin.matrix_window_rescan``), accepting only
+  candidates that die at the *same* return (a window that fails
+  elsewhere explains a different anomaly). Ops already pending at the
+  window's entry ride the carried frontier vector and are reported as
+  context. Knobs: ``explain_shrink_budget`` (total candidate checks),
+  ``explain_max_witness_ops`` (stop shrinking below this), both
+  tolerantly coerced (KNB house style; preflight is where garbage
+  errors).
+
+* **Artifacts** — a per-run ``anomaly.json`` (first anomaly op, witness
+  op indices + per-op process/timing detail, overlapping fault windows
+  from the durable ``faults.jsonl`` registry) and a rendered
+  ``witness-timeline.html`` (checker/timeline.render_witness) with the
+  nemesis/fault overlay. The web run page links both ("Explain" panel);
+  ``jepsen-tpu explain <run-dir>`` re-derives them offline.
+
+Telemetry: ``explain_bisect_steps``, ``explain_latency_seconds``,
+``witness_ops`` flow through the installed registry.
+
+See doc/observability.md "Anomaly forensics".
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+
+logger = logging.getLogger("jepsen.checker.explain")
+
+ANOMALY_NAME = "anomaly.json"
+WITNESS_TIMELINE_NAME = "witness-timeline.html"
+
+DEFAULT_SHRINK_BUDGET = 128     # total ddmin candidate evaluations
+DEFAULT_MAX_WITNESS_OPS = 16    # stop shrinking at this many ops
+
+# anomaly.json caps detail lists so a pathological witness can't bloat
+# the artifact past what a human (or the web panel) would read
+MAX_DETAIL_OPS = 200
+
+
+def enabled(test=None, opts=None) -> bool:
+    """The ``explain`` knob (default ON): tolerantly coerced — bools and
+    0/1 pass, yes/no strings work, garbage warns and reads as the
+    default. Preflight (KNB001) is where strictness lives."""
+    from jepsen_tpu import parallel
+    v = None
+    if isinstance(opts, dict) and "explain" in opts:
+        v = opts.get("explain")
+    elif isinstance(test, dict):
+        v = test.get("explain")
+    flag = parallel.coerce_flag(v, knob="explain")
+    return True if flag is None else flag
+
+
+def _coerce_count(value, knob: str, default: int, lo: int = 0) -> int:
+    """Tolerant positive-int knob coercion, matching the interpreter's
+    knob-layer discipline: numeric strings parse, garbage warns and
+    falls back to the default, values below ``lo`` clamp."""
+    if value is None or value == "":
+        return default
+    try:
+        if isinstance(value, bool):
+            raise ValueError("bool is not a count")
+        n = int(float(value))
+    except (TypeError, ValueError):
+        logger.warning("ignoring malformed %s=%r (want an int); using "
+                       "default %r", knob, value, default)
+        return default
+    return max(lo, n)
+
+
+def shrink_budget(test=None) -> int:
+    return _coerce_count((test or {}).get("explain_shrink_budget"),
+                         "explain_shrink_budget", DEFAULT_SHRINK_BUDGET)
+
+
+def max_witness_ops(test=None) -> int:
+    return _coerce_count((test or {}).get("explain_max_witness_ops"),
+                         "explain_max_witness_ops",
+                         DEFAULT_MAX_WITNESS_OPS, lo=1)
+
+
+# ---------------------------------------------------------------------------
+# Core: forensics over an encoded stream
+# ---------------------------------------------------------------------------
+
+def explain_stream(stream, step_ids=None, step_py=None, init_state: int = 0,
+                   num_states: int | None = None, loc=None, failure=None,
+                   shrink_budget: int | None = None,
+                   max_witness_ops: int | None = None) -> dict | None:
+    """Forensics for one encoded history: localize the first anomaly and
+    shrink a minimal witness window. ``loc`` reuses a
+    :class:`~jepsen_tpu.ops.jitlin.MatrixLocalization` a checker rung
+    already computed; ``failure`` reuses an exact CPU
+    :class:`~jepsen_tpu.checker.linear_cpu.LinearResult` (no re-check).
+    Returns the forensics dict (anomaly.json's core), or None when the
+    stream is valid."""
+    t0 = time.perf_counter()
+    budget = _coerce_count(shrink_budget, "explain_shrink_budget",
+                           DEFAULT_SHRINK_BUDGET)
+    max_ops = _coerce_count(max_witness_ops, "explain_max_witness_ops",
+                            DEFAULT_MAX_WITNESS_OPS, lo=1)
+    from jepsen_tpu.ops import jitlin
+    if loc is None:
+        n_states = (num_states if num_states is not None
+                    else len(stream.intern))
+        n_returns = int((np.asarray(stream.kind) == jitlin.EV_RETURN).sum())
+        if jitlin.matrix_ok(max(1, getattr(stream, "n_slots", 1)),
+                            n_states, n_returns):
+            try:
+                loc = jitlin.matrix_localize(stream, step_ids=step_ids,
+                                             init_state=init_state,
+                                             num_states=num_states)
+            except Exception:  # noqa: BLE001 — forensics must not raise
+                logger.exception("matrix localization failed; the CPU "
+                                 "frontier settles")
+                loc = None
+    if loc is not None:
+        out = _forensics_from_loc(stream, loc, budget, max_ops)
+    else:
+        out = _forensics_cpu(stream, step_py, init_state, failure)
+    if out is None:
+        return None
+    out["explain_latency_seconds"] = round(time.perf_counter() - t0, 4)
+    _export_metrics(out)
+    return out
+
+
+def first_failure(stream, step_ids=None, step_py=None, init_state: int = 0,
+                  num_states: int | None = None):
+    """``(failed_event, failed_op_index)`` of a stream's first anomaly —
+    device bisection when in regime, exact CPU frontier otherwise — or
+    None when the stream is valid. The cheap localization-only surface
+    (distributed key batches use it; see
+    parallel.distributed.localize_keys_distributed)."""
+    from jepsen_tpu.ops import jitlin
+    n_states = num_states if num_states is not None else len(stream.intern)
+    n_returns = int((np.asarray(stream.kind) == jitlin.EV_RETURN).sum())
+    if jitlin.matrix_ok(max(1, getattr(stream, "n_slots", 1)), n_states,
+                        n_returns):
+        try:
+            loc = jitlin.matrix_localize(stream, step_ids=step_ids,
+                                         init_state=init_state,
+                                         num_states=num_states)
+            if loc is not None:
+                return loc.failed_event, loc.failed_op_index
+        except Exception:  # noqa: BLE001
+            logger.exception("matrix localization failed; CPU settles")
+    from jepsen_tpu.checker.linear_cpu import (
+        cas_register_step_py, check_stream)
+    res = check_stream(stream, step=step_py or cas_register_step_py,
+                       init_state=init_state)
+    if res.valid is not False:
+        return None
+    return int(res.failed_event), int(res.failed_op_index)
+
+
+def _forensics_cpu(stream, step_py, init_state, failure=None) -> dict | None:
+    """CPU-frontier forensics (out of matrix regime, or localization
+    declined): the exact rejection point plus a frontier-derived witness
+    (the ops pending when the frontier died — no ddmin; the device
+    window machinery is the matrix regime's)."""
+    res = failure
+    if res is None or res.valid is not False:
+        from jepsen_tpu.checker.linear_cpu import (
+            cas_register_step_py, check_stream)
+        res = check_stream(stream, step=step_py or cas_register_step_py,
+                           init_state=init_state)
+    if res.valid is not False:
+        return None
+    pend = sorted({int(i) for c in (res.final_configs or [])
+                   for i in (c.get("pending") or [])})
+    fatal = int(res.failed_op_index)
+    return {
+        "first_anomaly": {"event": int(res.failed_event),
+                          "op_index": fatal},
+        "backend": "frontier-cpu",
+        "bisect_steps": 0,
+        "witness": {
+            "op_indices": sorted(set(pend + [fatal])),
+            "context_op_indices": [],
+            "window_op_count": len(pend) + 1,
+            "shrunk_from": None,
+            "rounds": 0,
+            "candidates": 0,
+            "minimal": False,
+        },
+    }
+
+
+def _forensics_from_loc(stream, loc, budget: int, max_ops: int) -> dict:
+    """Witness shrink over a settled device localization: bounded ddmin
+    on the guilty window's removable ops, every round's candidates
+    evaluated in one vmapped ``matrix_window_rescan`` dispatch. A
+    candidate counts only when it dies at the SAME return the full
+    history died at — a window that fails elsewhere explains a
+    different anomaly."""
+    from jepsen_tpu.checker.linear_encode import EV_INVOKE
+    from jepsen_tpu.ops.jitlin import _bucket, matrix_window_rescan
+
+    kind = np.asarray(stream.kind)
+    slot = np.asarray(stream.slot)
+    op_index = np.asarray(stream.op_index)
+    T, t_star = loc.chunk_returns, loc.step
+    ret_idx = loc.ret_idx
+    base_r = loc.chunk * T
+
+    # occupant lookup: which op (identified by its invoke EVENT) holds
+    # slot s at event e — the last invoke on s at or before e
+    inv_pos: dict[int, np.ndarray] = {}
+    for s in np.unique(slot[kind == EV_INVOKE]):
+        inv_pos[int(s)] = np.nonzero((kind == EV_INVOKE) & (slot == s))[0]
+
+    def occupant(s: int, e: int) -> int:
+        pos = inv_pos.get(int(s))
+        if pos is None or len(pos) == 0:
+            return -1
+        j = int(np.searchsorted(pos, e, side="right")) - 1
+        return int(pos[j]) if j >= 0 else -1
+
+    window_events = [int(ret_idx[base_r + r]) for r in range(t_star + 1)]
+    boundary_event = int(ret_idx[base_r - 1]) if base_r > 0 else -1
+    S = loc.window_pend.shape[1]
+    occ_grid = np.full((t_star + 1, S), -1, np.int64)
+    ret_op = np.full((t_star + 1,), -1, np.int64)
+    for r, e in enumerate(window_events):
+        ret_op[r] = occupant(int(slot[e]), e)
+        for s in np.nonzero(loc.window_pend[r])[0]:
+            occ_grid[r, int(s)] = occupant(int(s), e)
+    fatal_event = window_events[-1]
+    fatal_op = int(ret_op[t_star])
+    ops_in_window = sorted(
+        {int(o) for o in occ_grid[occ_grid >= 0].ravel()}
+        | {int(o) for o in ret_op[ret_op >= 0]})
+    # ops invoked before the window boundary are context: their bits
+    # already live in the carried frontier vector and cannot be removed
+    context = [o for o in ops_in_window if o <= boundary_event]
+    removable = [o for o in ops_in_window
+                 if o > boundary_event and o != fatal_op]
+
+    base_pend = np.asarray(loc.window_pend).copy()
+    base_valid = np.asarray(loc.window_valid).copy()
+    base_valid[t_star + 1:] = False  # past the fatal return: irrelevant
+
+    def grids_for(keeps: list[list[int]]):
+        K = len(keeps)
+        pend = np.broadcast_to(base_pend, (K,) + base_pend.shape).copy()
+        valid = np.broadcast_to(base_valid, (K,) + base_valid.shape).copy()
+        for k, ks in enumerate(keeps):
+            kept_ops = np.asarray(
+                sorted(set(ks) | set(context) | {fatal_op}), np.int64)
+            keep_grid = (occ_grid < 0) | np.isin(occ_grid, kept_ops)
+            pend[k, :t_star + 1] &= keep_grid
+            valid[k, :t_star + 1] &= np.isin(ret_op, kept_ops)
+        return pend, valid
+
+    def dies_at_fatal(cands: list[list[int]]) -> list[bool]:
+        K = len(cands)
+        Kb = _bucket(K, floor=4)
+        padded = cands + [list(kept)] * (Kb - K)  # keep-all pads, ignored
+        pend, valid = grids_for(padded)
+        first = matrix_window_rescan(loc, pend, valid)
+        return [int(first[i]) == t_star for i in range(K)]
+
+    kept = list(removable)
+    rounds = candidates_used = 0
+    n = 2
+    # "minimal" is a PROOF, not a progress report: True only when ddmin
+    # converged — no single op can be removed (a full single-op-granularity
+    # round found no candidate), or nothing removable remains. A loop cut
+    # short by the candidate budget or the max_ops early-stop shrank the
+    # witness but proved nothing about irreducibility.
+    converged = not removable
+    while kept and len(kept) > max_ops and n <= len(kept) and budget > 0:
+        segs = np.array_split(np.asarray(kept, np.int64), n)
+        cands = []
+        for i in range(len(segs)):
+            rest = [int(x) for j, seg in enumerate(segs) if j != i
+                    for x in seg]
+            cands.append(rest)
+        truncated = len(cands) > budget
+        cands = cands[:budget]
+        budget -= len(cands)
+        candidates_used += len(cands)
+        rounds += 1
+        ok = dies_at_fatal(cands)
+        hit = next((i for i, o in enumerate(ok) if o), None)
+        if hit is not None:
+            kept = cands[hit]
+            n = max(2, min(n - 1, max(1, len(kept))))
+            if not kept:
+                converged = True
+                break
+        else:
+            if n >= len(kept):
+                converged = not truncated
+                break
+            n = min(len(kept), 2 * n)
+
+    witness_events = sorted(set(kept) | {fatal_op})
+    out = {
+        "first_anomaly": {"event": fatal_event,
+                          "op_index": int(op_index[fatal_event])},
+        "backend": "matrix-bisect",
+        "bisect_steps": int(loc.bisect_steps),
+        "witness": {
+            "op_indices": sorted({int(op_index[e]) for e in witness_events}),
+            "context_op_indices": sorted({int(op_index[e])
+                                          for e in context}),
+            "window_op_count": len(ops_in_window),
+            "shrunk_from": len(removable),
+            "rounds": rounds,
+            "candidates": candidates_used,
+            "minimal": converged,
+        },
+    }
+    if fatal_event != loc.failed_event:  # pragma: no cover — invariant
+        logger.warning("witness window disagrees with localization "
+                       "(%d != %d); reporting the localization",
+                       fatal_event, loc.failed_event)
+        out["first_anomaly"] = {"event": int(loc.failed_event),
+                                "op_index": int(loc.failed_op_index)}
+    return out
+
+
+def _export_metrics(forensics: dict) -> None:
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return
+    try:
+        backend = forensics.get("backend", "unknown")
+        reg.counter("explain_total", "anomaly forensics derived, by "
+                    "localization backend", labels=("backend",)
+                    ).inc(backend=backend)
+        reg.gauge("explain_bisect_steps",
+                  "device combine steps of the last first-anomaly "
+                  "bisection").set(forensics.get("bisect_steps", 0))
+        reg.histogram("explain_latency_seconds",
+                      "wall time of localize + witness shrink"
+                      ).observe(forensics.get("explain_latency_seconds",
+                                              0.0))
+        reg.gauge("witness_ops", "ops in the last minimal witness").set(
+            len((forensics.get("witness") or {}).get("op_indices") or ()))
+    except Exception:  # noqa: BLE001 — telemetry never fails forensics
+        logger.exception("explain telemetry recording failed")
+
+
+# ---------------------------------------------------------------------------
+# Artifacts: anomaly.json + witness timeline
+# ---------------------------------------------------------------------------
+
+def compose_anomaly(history, forensics: dict, registry_rows=None) -> dict:
+    """The full anomaly.json payload: forensics enriched with per-op
+    detail (process, f, value, invoke/completion times) and the fault
+    windows from the durable registry that overlap the witness."""
+    payload = {k: v for k, v in forensics.items()}
+    hist = history or []
+    completion_of: dict[int, dict] = {}
+    invoke_of: dict[int, int] = {}   # completion index -> invoke index
+    open_inv: dict = {}
+    for i, op in enumerate(hist):
+        p, typ = op.get("process"), op.get("type")
+        if typ == "invoke":
+            open_inv[p] = i
+        elif typ in ("ok", "fail", "info"):
+            j = open_inv.pop(p, None)
+            if j is not None:
+                completion_of[j] = op
+                invoke_of[i] = j
+
+    def op_detail(i: int) -> dict:
+        """Per-op detail for either half of an op: witness indices are
+        INVOKE indices, while first_anomaly's op_index is the fatal
+        RETURN's (completion's) index — both resolve to the full
+        invoke+completion pair."""
+        if not (0 <= i < len(hist)):
+            return {"index": int(i)}
+        op = hist[i]
+        inv, comp = op, completion_of.get(i)
+        if comp is None and i in invoke_of:
+            inv, comp = hist[invoke_of[i]], op
+        d = {"index": int(i), "process": op.get("process"),
+             "f": op.get("f"), "value": op.get("value"),
+             "type": op.get("type"), "time": inv.get("time")}
+        if comp is not None:
+            d["completion_type"] = comp.get("type")
+            d["completion_value"] = comp.get("value")
+            if comp.get("time") is not None and inv.get("time") is not None:
+                d["latency_ns"] = comp["time"] - inv["time"]
+        return d
+
+    fa = dict(payload.get("first_anomaly") or {})
+    fa.update(op_detail(fa.get("op_index", -1)))
+    payload["first_anomaly"] = fa
+    wit = dict(payload.get("witness") or {})
+    indices = list(wit.get("op_indices") or [])
+    wit["ops"] = [op_detail(i) for i in indices[:MAX_DETAIL_OPS]]
+    if len(indices) > MAX_DETAIL_OPS:
+        wit["ops_truncated"] = len(indices) - MAX_DETAIL_OPS
+    payload["witness"] = wit
+
+    try:
+        from jepsen_tpu.nemesis import faults as faults_mod
+        windows = faults_mod.history_windows(hist, registry_rows or [])
+        times = [hist[i].get("time") for i in indices
+                 if 0 <= i < len(hist) and hist[i].get("time") is not None]
+        fa_t = fa.get("time")
+        if fa_t is not None:
+            times.append(fa_t)
+        if times:
+            lo, hi = min(times), max(times)
+            for w in windows:
+                w0, w1 = w.get("start_time"), w.get("end_time")
+                if w0 is None:
+                    w["overlaps_witness"] = False
+                else:
+                    w["overlaps_witness"] = (w1 is None or w1 >= lo) \
+                        and w0 <= hi
+        payload["fault_windows"] = windows
+    except Exception:  # noqa: BLE001 — the overlay is best-effort
+        logger.exception("fault-window overlay failed")
+        payload.setdefault("fault_windows", [])
+    return payload
+
+
+def write_artifacts(test: dict, history, forensics: dict,
+                    opts: dict | None = None) -> dict:
+    """Writes ``anomaly.json`` + ``witness-timeline.html`` into the
+    run's store dir (nested under ``subdirectory`` for independent's
+    per-key lift). Returns {artifact-name: path}; empty on failure —
+    artifact writing never masks a verdict."""
+    out: dict = {}
+    if not test:
+        return out
+    try:
+        from jepsen_tpu import store
+        from jepsen_tpu.nemesis import faults as faults_mod
+        sub = (opts or {}).get("subdirectory")
+        rows = faults_mod.load_rows(
+            store.path(test, faults_mod.FAULTS_NAME))
+        payload = compose_anomaly(history, forensics, registry_rows=rows)
+        p = store.path_mk(test, *filter(None, [sub, ANOMALY_NAME]))
+        p.write_text(json.dumps(payload, indent=2, default=repr) + "\n")
+        out[ANOMALY_NAME] = p
+        try:
+            from jepsen_tpu.checker import timeline
+            html = timeline.render_witness(test, history or [], payload)
+            tp = store.path_mk(test,
+                               *filter(None, [sub, WITNESS_TIMELINE_NAME]))
+            tp.write_text(html)
+            out[WITNESS_TIMELINE_NAME] = tp
+        except Exception:  # noqa: BLE001 — json evidence beats no evidence
+            logger.exception("witness timeline rendering failed")
+    except Exception:  # noqa: BLE001
+        logger.exception("anomaly artifact write failed")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Offline: `jepsen-tpu explain <run-dir>`
+# ---------------------------------------------------------------------------
+
+def explain_run(run_dir, shrink_budget: int | None = None,
+                max_witness_ops: int | None = None) -> dict | None:
+    """Re-derives forensics for a STORED run: loads its history, runs
+    localization + witness shrink, writes ``anomaly.json`` +
+    ``witness-timeline.html`` into the run dir. Register workloads take
+    the full matrix-bisect path; list-append histories re-run the Elle
+    checker and get its cycle artifacts + witness timeline. Returns a
+    summary dict ({"valid": True} when there is nothing to explain;
+    {"unsupported": f} for workloads with no forensics; None when the
+    run has no usable history)."""
+    # resolve BEFORE splitting: a relative dir ("." from inside a run)
+    # must still yield real <store>/<name>/<ts> components
+    run_dir = Path(run_dir).resolve()
+    name, ts = run_dir.parent.name, run_dir.name
+    store_dir = str(run_dir.parent.parent)
+    from jepsen_tpu import store
+    try:
+        history = store.load_history(name, ts, store_dir)
+    except (FileNotFoundError, OSError):
+        return None
+    if not history:
+        return None
+    test = {"name": name, "start_time": ts, "store_dir": store_dir}
+    first_f = store.first_client_f(history)
+    if first_f == "txn":
+        return _explain_elle_run(test, history)
+    if first_f not in ("read", "write", "cas"):
+        return {"unsupported": first_f}
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    try:
+        stream = encode_register_ops(history)
+    except (TypeError, ValueError) as e:
+        # key-lifted / exotic value shapes: outside the offline encoder
+        logger.warning("history not encodable as a plain register run "
+                       "(%r); explain unsupported", e)
+        return {"unsupported": first_f}
+    forensics = explain_stream(stream, shrink_budget=shrink_budget,
+                               max_witness_ops=max_witness_ops)
+    if forensics is None:
+        return {"valid": True}
+    arts = write_artifacts(test, history, forensics)
+    return {
+        "valid": False,
+        "first_anomaly_op": forensics["first_anomaly"]["op_index"],
+        "witness_ops": len(forensics["witness"]["op_indices"]),
+        "backend": forensics["backend"],
+        "artifacts": sorted(str(k) for k in arts),
+    }
+
+
+def _explain_elle_run(test: dict, history) -> dict:
+    """Offline forensics for a transactional run: the matching Elle
+    checker's result map feeds the same artifact surface (per-anomaly
+    cycle explanations + witness timeline with fault overlay). The
+    checker is sniffed from the mop shapes the same way the live
+    daemon's session_for_ops does — append/r mops are list-append,
+    r/w mops are rw-register; anything else has no offline forensics."""
+    fs: set = set()
+    for op in history:
+        if op.get("f") != "txn" or op.get("type") != "invoke":
+            continue
+        fs |= {m[0] for m in (op.get("value") or ())
+               if isinstance(m, (list, tuple)) and m}
+        if fs - {"r"}:
+            break  # one non-read mop settles the dialect
+    from jepsen_tpu.elle import artifacts as elle_artifacts
+    if fs and fs <= {"append", "r"}:
+        from jepsen_tpu.elle import list_append as elle_checker
+    elif fs and fs <= {"r", "w"}:
+        from jepsen_tpu.elle import rw_register as elle_checker
+    else:
+        return {"unsupported": "txn"}
+    result = elle_checker.check(history, accelerator="cpu")
+    if result.get("valid?") is True:
+        return {"valid": True}
+    elle_artifacts.write_for_test(test, result, history=history)
+    return {
+        "valid": result.get("valid?"),
+        "anomaly_types": result.get("anomaly-types") or [],
+        "artifacts": ["elle/"],
+    }

@@ -114,7 +114,9 @@ class LinearizableChecker(Checker):
         # rung (None = env default + cost model), mesh_devices caps the
         # mesh width
         from jepsen_tpu import parallel as par
+        from jepsen_tpu.checker import explain as explain_mod
         sharded, mesh_devices = par.sharding_knobs(test, opts)
+        explain_on = explain_mod.enabled(test, opts)
 
         t0 = time.perf_counter()
         if algorithm == "wgl":
@@ -131,18 +133,24 @@ class LinearizableChecker(Checker):
                                  len(history), None)
             return self._finish(res, history, test)
         stream, step_py, spec = enc
+        extras: dict = {}
         res = self._search_stream(stream, step_py, spec, algorithm,
                                   accelerator, history=history,
                                   sharded=sharded,
-                                  mesh_devices=mesh_devices)
+                                  mesh_devices=mesh_devices,
+                                  explain=explain_on, extras=extras)
         self._record_metrics(res, time.perf_counter() - t0, len(stream),
                              stream)
         return self._finish(res, history, test, stream, step_py=step_py,
-                            init_state=spec.init_state)
+                            init_state=spec.init_state,
+                            step_ids=spec.step_ids,
+                            explain_on=explain_on,
+                            explain_loc=extras.get("loc"), opts=opts)
 
     def _search_stream(self, stream, step_py, spec, algorithm,
                        accelerator, history=None, sharded=None,
-                       mesh_devices=None) -> LinearResult:
+                       mesh_devices=None, explain=True,
+                       extras=None) -> LinearResult:
         """The full encoded-stream dispatch, shared by check() and the
         stored-column re-check lane (module check_stored), routed
         through the :class:`~jepsen_tpu.checker.ladder.BackendLadder`:
@@ -163,12 +171,20 @@ class LinearizableChecker(Checker):
             # False disables, None = env default + cost-model gate
             "sharded": sharded,
             "mesh_devices": mesh_devices,
+            # anomaly forensics (doc/observability.md): invalid matrix
+            # verdicts localize on device instead of demoting to a full
+            # re-scan just to find the op
+            "explain": explain,
             # the encoded-stream search applies for jitlin/auto, and for
             # the stored-column lane (no op history to wgl over)
             "stream_path": (algorithm in ("jitlin", "auto")
                             or history is None),
         }
         res, _backend = self._get_ladder().run(ctx)
+        if extras is not None and "_explain_loc" in ctx:
+            # the rung's device localization rides out so _finish can
+            # reuse it for the witness shrink (no second bisection)
+            extras["loc"] = ctx["_explain_loc"]
         phases = ctx.pop("_matrix_phase", None)
         if phases:
             # the matrix rung may have run on a watchdog thread; make
@@ -204,6 +220,44 @@ class LinearizableChecker(Checker):
             n_returns = int((np.asarray(stream.kind) == 1).sum())
             return matrix_ok(stream.n_slots, len(stream.intern), n_returns)
 
+        def matrix_settle(ctx, m, algo):
+            """A COMPLETED matrix screen verdict -> LinearResult, or
+            None to demote. An exact True settles valid. An exact False
+            localizes the first anomaly ON DEVICE (the forensics
+            bisection over the composable chunk products,
+            jitlin.matrix_localize — bit-identical to the CPU
+            frontier's rejection) and settles INVALID with the precise
+            event, instead of demoting to a full event re-scan just to
+            find the op (doc/observability.md "Anomaly forensics").
+            Inexact (oob) proves nothing either way and always
+            demotes."""
+            if m is None:
+                return None
+            if m[2]:
+                return None
+            if m[0]:
+                return LinearResult(
+                    valid=True, failed_event=-1, failed_op_index=-1,
+                    configs_max=0, algorithm=algo)
+            if not ctx.get("explain", True):
+                return None  # explain off: the old demote-to-scan path
+            from jepsen_tpu.ops.jitlin import matrix_localize
+            stream, spec = ctx["stream"], ctx["spec"]
+            try:
+                loc = matrix_localize(stream, step_ids=spec.step_ids,
+                                      init_state=spec.init_state,
+                                      num_states=len(stream.intern))
+            except Exception:  # noqa: BLE001 — localization never fails a check
+                logger.exception("matrix localization failed; demoting")
+                loc = None
+            if loc is None:
+                return None
+            ctx["_explain_loc"] = loc
+            return LinearResult(
+                valid=False, failed_event=loc.failed_event,
+                failed_op_index=loc.failed_op_index, configs_max=0,
+                algorithm=algo)
+
         def matrix_fn(ctx):
             from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
             if ctx.get("_matrix_screened"):
@@ -219,13 +273,7 @@ class LinearizableChecker(Checker):
             # capture the phase split on THIS (possibly watchdog) thread;
             # _search_stream re-publishes it on the checker's thread
             ctx["_matrix_phase"] = last_phase_seconds()
-            # accept only an exact matrix True: m[2] (inexact/oob) means a
-            # state id escaped the intern range and proves nothing
-            if m is not None and m[0] and not m[2]:
-                return LinearResult(
-                    valid=True, failed_event=-1, failed_op_index=-1,
-                    configs_max=0, algorithm="jitlin-tpu-matrix")
-            return None
+            return matrix_settle(ctx, m, "jitlin-tpu-matrix")
 
         def matrix_shrink(ctx):
             # halve the chunk element budget: _matrix_plan sizes the
@@ -278,14 +326,14 @@ class LinearizableChecker(Checker):
                              num_states=len(stream.intern),
                              mesh=ctx["_sharded_mesh"])
             ctx["_matrix_phase"] = last_phase_seconds()
-            if m is not None and m[0] and not m[2]:
-                return LinearResult(
-                    valid=True, failed_event=-1, failed_op_index=-1,
-                    configs_max=0, algorithm="jitlin-tpu-matrix-sharded")
-            # the screen COMPLETED but didn't settle (not alive, or
-            # inexact): the single-device screen is bit-identical, so
-            # matrix_fn re-running it would pay a full matrix dispatch
-            # to learn the same thing — flag it to decline instead
+            res = matrix_settle(ctx, m, "jitlin-tpu-matrix-sharded")
+            if res is not None:
+                return res
+            # the screen COMPLETED but didn't settle (inexact, or
+            # invalid with localization declined/off): the
+            # single-device screen is bit-identical, so matrix_fn
+            # re-running it would pay a full matrix dispatch to learn
+            # the same thing — flag it to decline instead
             ctx["_matrix_screened"] = True
             return None
 
@@ -433,7 +481,9 @@ class LinearizableChecker(Checker):
             logger.exception("checker telemetry recording failed")
 
     def _finish(self, res: LinearResult, history, test=None,
-                stream=None, step_py=None, init_state: int = 0) -> dict:
+                stream=None, step_py=None, init_state: int = 0,
+                step_ids=None, explain_on: bool = True, explain_loc=None,
+                opts=None) -> dict:
         out: dict[str, Any] = {
             "valid?": res.valid,
             "algorithm": res.algorithm,
@@ -448,7 +498,9 @@ class LinearizableChecker(Checker):
             # recovers the dying configurations for the report (the
             # knossos :configs surface). Gated by length — the history was
             # routed to the device because host search may be slow, and a
-            # report must never cost more than the verdict.
+            # report must never cost more than the verdict. A device
+            # localization (explain_loc) carries the exact event already,
+            # so the recovery stays purely report detail.
             if res.final_configs is None and stream is not None \
                     and len(stream) <= MAX_REPORT_EVENTS:
                 try:
@@ -462,7 +514,45 @@ class LinearizableChecker(Checker):
             if res.final_configs is not None:
                 out["final-configs"] = res.final_configs
             out["plot"] = self._render(res, history, test)
+            self._explain(out, res, history, test, stream, step_py,
+                          init_state, step_ids, explain_on, explain_loc,
+                          opts)
         return out
+
+    def _explain(self, out, res, history, test, stream, step_py,
+                 init_state, step_ids, explain_on, explain_loc,
+                 opts) -> None:
+        """Anomaly forensics for an INVALID verdict: localize + shrink a
+        minimal witness, write ``anomaly.json`` + the witness timeline
+        into the store dir, and surface a summary in the result
+        (doc/observability.md "Anomaly forensics"). Never fails the
+        check; ``explain: False`` in the test map turns it off."""
+        if not explain_on or stream is None:
+            return
+        try:
+            from jepsen_tpu.checker import explain as explain_mod
+            tmap = test if isinstance(test, dict) else {}
+            forensics = explain_mod.explain_stream(
+                stream, step_ids=step_ids, step_py=step_py,
+                init_state=init_state, loc=explain_loc, failure=res,
+                shrink_budget=explain_mod.shrink_budget(tmap),
+                max_witness_ops=explain_mod.max_witness_ops(tmap))
+            if forensics is None:
+                return
+            out["explain"] = {
+                "first-anomaly-op": forensics["first_anomaly"]["op_index"],
+                "witness-ops": len(forensics["witness"]["op_indices"]),
+                "backend": forensics["backend"],
+                "bisect-steps": forensics["bisect_steps"],
+            }
+            if test is not None:
+                arts = explain_mod.write_artifacts(test, history,
+                                                   forensics, opts=opts)
+                if arts:
+                    out["explain"]["artifacts"] = sorted(
+                        str(k) for k in arts)
+        except Exception:  # noqa: BLE001 — forensics never mask a verdict
+            logger.exception("anomaly forensics failed")
 
     def _render(self, res, history, test) -> str | None:
         """linear.png into the test's store dir (checker.clj:205-212)."""
@@ -515,9 +605,12 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
             # the one dispatch check() uses — device threshold, matrix
             # screen, frontier kernel, native-first host lanes — so the
             # stored lane can't drift from the live one
+            # explain=False: an invalid stored verdict falls back to the
+            # jsonl full check below, which runs forensics itself — a
+            # localization here would be paid for and discarded
             res = checker._search_stream(stream, cas_register_step_py,
                                          spec, checker.algorithm,
-                                         accelerator)
+                                         accelerator, explain=False)
             res.algorithm += "(stored)"
             if res.valid is True:
                 return checker._finish(res, [], None)

@@ -87,9 +87,56 @@ def nemesis_activity(history: list[dict]) -> list[tuple[float, float]]:
     return out
 
 
-def _shade_nemesis(ax, history):
+def registry_fault_windows(test, history) -> list[dict]:
+    """Fault windows from the durable ``faults.jsonl`` registry
+    (nemesis/faults.py), in history time. This is what history-derived
+    ``nemesis_intervals`` cannot see: fault-specific ``:f`` names
+    (partition/heal, kill, bump...) classified by kind, and heals that
+    happened OUTSIDE the history — nemesis teardown, the crash-path
+    replay, ``cli heal`` — which otherwise read as never healed. []
+    when the test can't address a store dir or the run has no
+    registry."""
+    if not test or not isinstance(test, dict) \
+            or test.get("start_time") is None:
+        return []
+    try:
+        from jepsen_tpu import store
+        from jepsen_tpu.nemesis import faults as faults_mod
+        rows = faults_mod.load_rows(
+            store.path(test, faults_mod.FAULTS_NAME))
+        if not rows:
+            return []
+        return faults_mod.history_windows(history, rows)
+    except Exception:  # noqa: BLE001 — the overlay is best-effort
+        logger.exception("registry fault-window overlay failed")
+        return []
+
+
+FAULT_SHADE = "#f7dcc4"
+
+
+def _shade_nemesis(ax, history, test=None):
     for t0, t1 in nemesis_activity(history):
         ax.axvspan(t0, t1, color=NEMESIS_SHADE, zorder=0)
+    # registry-derived windows layer on top in a warmer shade, labeled
+    # by kind — crash-replayed heals appear here even though no history
+    # op closes them (the satellite the durable registry buys the plots)
+    windows = [w for w in registry_fault_windows(test, history)
+               if w.get("start_time") is not None]
+    # the open-window end needs a full history max(); a fault-free run
+    # (the common case) must not pay that O(n) pass per plot
+    end = (max((op.get("time", 0) for op in history), default=0) / NS
+           if windows else 0.0)
+    for w in windows:
+        t0 = w["start_time"] / NS
+        t1 = w["end_time"] / NS if w.get("end_time") is not None else end
+        ax.axvspan(t0, t1, color=FAULT_SHADE, alpha=0.55, zorder=0)
+        label = str(w.get("kind"))
+        if w.get("healed") and w.get("end_time") is None:
+            label += f" (healed via {w.get('via')})"
+        ax.annotate(label, xy=(t0, 1.0), xycoords=("data", "axes fraction"),
+                    fontsize=6, color="#a05010", rotation=90,
+                    va="top", ha="left")
 
 
 def _figure():
@@ -108,7 +155,7 @@ def point_graph(test: dict, history: list[dict], output) -> None:
     Downsampled evenly past POINT_LIMIT points per type — a 1M-op run
     must render in seconds, not choke matplotlib (r2 weak #5)."""
     plt, fig, ax = _figure()
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     by_type: dict[str, list[tuple]] = defaultdict(list)
     for op in invokes_with_latency(history):
         comp = op.get("completion") or {}
@@ -145,7 +192,7 @@ def quantiles_graph(test: dict, history: list[dict], output,
                     dt: float = 10.0, qs=DEFAULT_QUANTILES) -> None:
     """Latency quantiles over time (perf.clj:513-559)."""
     plt, fig, ax = _figure()
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     ops = invokes_with_latency(history)
     times = np.asarray([o.get("time", 0) / NS for o in ops])
     lats = np.asarray([o["latency"] / 1e6 for o in ops])
@@ -167,7 +214,7 @@ def rate_graph(test: dict, history: list[dict], output,
                dt: float = 10.0) -> None:
     """Throughput per (f, completion-type) (perf.clj:559-599)."""
     plt, fig, ax = _figure()
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     for (f, typ), pts in sorted(rate(history, dt).items(), key=str):
         arr = np.asarray(pts)
         ax.plot(arr[:, 0], arr[:, 1], "-",

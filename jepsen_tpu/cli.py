@@ -178,6 +178,29 @@ def single_test_cmd(
         p_heal.add_argument("--timestamp", help="defaults to latest run")
         p_heal.add_argument("--store-dir", default="store")
 
+        p_ex = sub.add_parser(
+            "explain", help="re-derive anomaly forensics for a stored "
+                            "run: localize the first anomaly, shrink a "
+                            "minimal witness, write anomaly.json + "
+                            "witness-timeline.html "
+                            "(doc/observability.md)")
+        p_ex.add_argument("dir", nargs="?",
+                          help="one run's directory "
+                               "(store/<name>/<timestamp>) or a store "
+                               "dir; defaults to --store-dir's latest "
+                               "run")
+        p_ex.add_argument("--test-name")
+        p_ex.add_argument("--timestamp", help="defaults to latest run")
+        p_ex.add_argument("--store-dir", default="store")
+        p_ex.add_argument("--shrink-budget", type=int, default=None,
+                          dest="explain_shrink_budget",
+                          help="max witness-shrink candidate checks "
+                               "(default 128)")
+        p_ex.add_argument("--max-witness-ops", type=int, default=None,
+                          dest="explain_max_witness_ops",
+                          help="stop shrinking once the witness is this "
+                               "small (default 16)")
+
         p_serve = sub.add_parser("serve", help="serve the web UI")
         p_serve.add_argument("--host", default="0.0.0.0")
         p_serve.add_argument("-p", "--port", type=int, default=8080)
@@ -274,6 +297,8 @@ def single_test_cmd(
                 return analyze_cmd(opts, test_fn)
             if opts.command == "heal":
                 return heal_cmd(opts)
+            if opts.command == "explain":
+                return explain_cmd(opts)
             if opts.command == "preflight":
                 return preflight_cmd(opts, test_fn)
             if opts.command == "lint":
@@ -483,6 +508,59 @@ def lint_cmd(opts) -> int:
     else:
         print(lint_mod.render_text(report))
     return EXIT_OK if report.exit_code == 0 else 1
+
+
+def explain_cmd(opts) -> int:
+    """``jepsen-tpu explain``: offline anomaly forensics for a stored
+    run — localization + minimal witness + artifacts, re-derived from
+    history.jsonl alone (doc/observability.md "Anomaly forensics").
+    Exit codes follow ``validity_exit_code``'s convention: EXIT_OK when
+    the run is valid (nothing to explain), EXIT_INVALID when forensics
+    were derived and written, EXIT_UNKNOWN for a run explain cannot
+    judge (no usable history, or a workload with no forensics),
+    EXIT_BAD_ARGS when no run could be resolved at all."""
+    from pathlib import Path
+
+    from jepsen_tpu.checker import explain as explain_mod
+
+    run_dir = None
+    if getattr(opts, "dir", None):
+        d = Path(opts.dir)
+        if (d / "history.jsonl").exists() or (d / "test.json").exists():
+            run_dir = d  # a single run's directory
+        else:
+            opts.store_dir = str(d)  # a store dir: fall through to latest
+    if run_dir is None:
+        run = _resolve_run(opts)
+        if run is None:
+            return EXIT_BAD_ARGS
+        name, ts = run
+        run_dir = Path(opts.store_dir) / name / ts
+    summary = explain_mod.explain_run(
+        run_dir,
+        shrink_budget=getattr(opts, "explain_shrink_budget", None),
+        max_witness_ops=getattr(opts, "explain_max_witness_ops", None))
+    if summary is None:
+        print(f"no usable history at {run_dir}", file=sys.stderr)
+        return EXIT_UNKNOWN
+    if summary.get("valid") is True:
+        print(f"{run_dir}: history is valid — nothing to explain")
+        return EXIT_OK
+    if "unsupported" in summary:
+        print(f"{run_dir}: no forensics for workload "
+              f"{summary['unsupported']!r} (register and list-append "
+              "histories are supported)", file=sys.stderr)
+        return EXIT_UNKNOWN
+    if "first_anomaly_op" in summary:
+        print(f"{run_dir}: first anomaly at op "
+              f"{summary['first_anomaly_op']} — witness of "
+              f"{summary['witness_ops']} op(s) via {summary['backend']}; "
+              f"wrote {', '.join(summary.get('artifacts') or [])}")
+    else:
+        print(f"{run_dir}: valid?={summary.get('valid')} anomalies="
+              f"{summary.get('anomaly_types')}; wrote "
+              f"{', '.join(summary.get('artifacts') or [])}")
+    return EXIT_INVALID if summary.get("valid") is False else EXIT_UNKNOWN
 
 
 def heal_cmd(opts) -> int:

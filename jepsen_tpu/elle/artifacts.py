@@ -145,11 +145,71 @@ def write_artifacts(dirpath, result: dict) -> list[str]:
     return written
 
 
-def write_for_test(test, result: dict, opts: dict | None = None) -> None:
+def cited_op_indices(result: dict, history: list[dict]) -> list[int]:
+    """History indices of every completion whose txn value a cycle or
+    extra finding cites — the op set an anomaly's explanation is *about*
+    (the witness the cycle renderings imply). Best-effort value
+    matching, like the live first-anomaly surface."""
+    cited: list = []
+    for findings in (result.get("anomalies") or {}).values():
+        for item in findings if isinstance(findings, list) else ():
+            for hop in item if isinstance(item, list) else ():
+                if isinstance(hop, dict):
+                    cited.extend([hop.get("from"), hop.get("to"),
+                                  hop.get("read"), hop.get("read-txn"),
+                                  hop.get("writer")])
+    out = []
+    for i, op in enumerate(history or []):
+        if op.get("type") not in ("ok", "info"):
+            continue
+        v = op.get("value")
+        if v is None:
+            continue
+        if any(c is not None and c == v for c in cited):
+            out.append(i)
+    return out
+
+
+def _write_witness_timeline(dirpath, test, result: dict,
+                            history: list[dict]) -> str | None:
+    """The cycle explanations' witness-window timeline + fault overlay
+    (doc/observability.md "Anomaly forensics"): the cited txns rendered
+    as a per-process gantt with the run's durable fault windows
+    overlaid, next to the per-anomaly text files. Returns the filename
+    written, or None."""
+    indices = cited_op_indices(result, history)
+    if not indices:
+        return None
+    from jepsen_tpu import store
+    from jepsen_tpu.checker import explain as explain_mod
+    from jepsen_tpu.checker import timeline
+    from jepsen_tpu.nemesis import faults as faults_mod
+    # cycles cite completions; the timeline draws invoke..completion
+    # pairs, so hand the invokes over too (compose_anomaly enriches)
+    forensics = {
+        "first_anomaly": {"op_index": min(indices)},
+        "backend": "elle",
+        "bisect_steps": 0,
+        "witness": {"op_indices": indices, "context_op_indices": []},
+    }
+    rows = faults_mod.load_rows(store.path(test, faults_mod.FAULTS_NAME)) \
+        if test else []
+    payload = explain_mod.compose_anomaly(history, forensics,
+                                          registry_rows=rows)
+    html = timeline.render_witness(test or {}, history, payload)
+    fn = "witness-timeline.html"
+    (Path(dirpath) / fn).write_text(html)
+    return fn
+
+
+def write_for_test(test, result: dict, opts: dict | None = None,
+                   history: list[dict] | None = None) -> None:
     """Writes the artifacts into ``store/<test>/<ts>/[subdir/]elle/``
     when the result is invalid and the test map can address a store
     directory. The ``subdirectory`` opt (independent's per-key lift)
-    nests the artifacts the same way other per-key artifacts nest."""
+    nests the artifacts the same way other per-key artifacts nest.
+    With ``history``, the cycle explanations additionally get a
+    witness-window timeline with the durable fault-window overlay."""
     if not test or result.get("valid?") is True:
         return
     if not result.get("anomalies"):
@@ -157,6 +217,15 @@ def write_for_test(test, result: dict, opts: dict | None = None) -> None:
     try:
         from jepsen_tpu import store
         parts = [p for p in [(opts or {}).get("subdirectory"), "elle"] if p]
-        write_artifacts(store.path_mk(test, *parts), result)
+        d = store.path_mk(test, *parts)
+        written = write_artifacts(d, result)
+        if history:
+            try:
+                fn = _write_witness_timeline(d, test, result, history)
+                if fn and written:
+                    with open(Path(d) / "index.txt", "a") as f:
+                        f.write(f"- {fn}\n")
+            except Exception:  # noqa: BLE001 — the timeline is additive
+                logger.exception("elle witness timeline failed")
     except Exception:  # noqa: BLE001
         logger.exception("elle artifact store write failed")

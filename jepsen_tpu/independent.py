@@ -262,6 +262,38 @@ class IndependentChecker(Checker):
         return f"independent({self.checker.name()})"
 
     @staticmethod
+    def _explain_key(test, sub_history, stream, step_py, spec, failure,
+                     result: dict, key_opts: dict) -> None:
+        """Anomaly forensics for one invalid key of the batched device
+        lane: localize + shrink over the key's own stream, artifacts
+        under independent/<k> (doc/observability.md "Anomaly
+        forensics"). Never fails the batch."""
+        try:
+            from jepsen_tpu.checker import explain as explain_mod
+            tmap = test if isinstance(test, dict) else {}
+            forensics = explain_mod.explain_stream(
+                stream, step_ids=spec.step_ids, step_py=step_py,
+                init_state=spec.init_state, failure=failure,
+                shrink_budget=explain_mod.shrink_budget(tmap),
+                max_witness_ops=explain_mod.max_witness_ops(tmap))
+            if forensics is None:
+                return
+            result["explain"] = {
+                "first-anomaly-op": forensics["first_anomaly"]["op_index"],
+                "witness-ops": len(forensics["witness"]["op_indices"]),
+                "backend": forensics["backend"],
+            }
+            if test is not None and isinstance(test, dict) \
+                    and test.get("name"):
+                arts = explain_mod.write_artifacts(
+                    test, sub_history, forensics, opts=key_opts)
+                if arts:
+                    result["explain"]["artifacts"] = sorted(
+                        str(k) for k in arts)
+        except Exception:  # noqa: BLE001 — forensics never mask a verdict
+            logger.exception("per-key anomaly forensics failed")
+
+    @staticmethod
     def _key_opts(opts, k):
         """Per-key opts: sub-checkers write under independent/<k> like the
         reference (independent.clj:287-292), so concurrent keys' artifacts
@@ -363,7 +395,10 @@ class IndependentChecker(Checker):
             backend = {"cpu": "jitlin-cpu(routed)",
                        "mesh": "jitlin-tpu-sharded"}.get(route,
                                                          "jitlin-tpu")
+            from jepsen_tpu.checker import explain as explain_mod
+            explain_on = explain_mod.enabled(test, opts)
             results = {}
+            invalid: list[tuple] = []
             for fk, stream, (alive, died, ovf, peak) in zip(fkeys, streams, outcomes):
                 v = verdict(alive, ovf)
                 if v == "unknown":
@@ -371,9 +406,43 @@ class IndependentChecker(Checker):
                                        init_state=spec.init_state)
                     results[fk] = {"valid?": res.valid,
                                    "algorithm": "jitlin-cpu(fallback)"}
+                    v, failure = res.valid, res
                 else:
                     results[fk] = {"valid?": v, "algorithm": backend,
                                    "configs-max": peak}
+                    failure = None
+                if v is False and explain_on:
+                    invalid.append((fk, stream, failure))
+            if invalid:
+                # per-key anomaly forensics — an invalid key is rare, so
+                # the localization dispatches stay off the happy path
+                import jax
+                if jax.process_count() > 1:
+                    # multi-host: split the localizations across
+                    # processes, allgather only the per-key positions
+                    # (no witness/artifacts — every host would race on
+                    # the shared store dir)
+                    from jepsen_tpu.parallel.distributed import (
+                        localize_keys_distributed)
+                    idx = {fk: i for i, fk in enumerate(fkeys)}
+                    found = localize_keys_distributed(
+                        streams, [idx[fk] for fk, _, _ in invalid],
+                        step_ids=spec.step_ids, step_py=step_py,
+                        init_state=spec.init_state)
+                    for fk, _, _ in invalid:
+                        hit = found.get(idx[fk])
+                        if hit is not None:
+                            results[fk]["explain"] = {
+                                "first-anomaly-op": hit[1],
+                                "backend": "matrix-bisect-distributed"}
+                else:
+                    for fk, stream, failure in invalid:
+                        # full forensics + artifacts under the same
+                        # independent/<k> lift the per-key lane uses
+                        self._explain_key(test, subs[fk], stream,
+                                          step_py, spec, failure,
+                                          results[fk],
+                                          self._key_opts(opts, fk))
             if lin_name is None:
                 return results
             pairs = list(subs.items())

@@ -264,6 +264,10 @@ class LinearLiveSession:
         self._last = {"valid_so_far": True, "first_anomaly_op": None,
                       "backend": "frontier-cpu", "checked_ops": 0}
         self._broken: str | None = None
+        # latched device localization: an invalid prefix stays invalid
+        # with the SAME first anomaly (frontier death is monotone), so
+        # later polls answer from the latch instead of re-bisecting
+        self._matrix_first: int | None = None
 
     # -- ingestion ------------------------------------------------------
 
@@ -316,8 +320,14 @@ class LinearLiveSession:
             # ladder demotion on a transient mesh fault.
             from jepsen_tpu import parallel
             from jepsen_tpu.models import cas_register_spec
-            from jepsen_tpu.ops.jitlin import matrix_check
+            from jepsen_tpu.ops.jitlin import matrix_check, matrix_localize
             session = ctx["session"]
+            if self._matrix_first is not None:
+                # an invalid prefix stays invalid at the same op: the
+                # latched localization answers without re-screening
+                return {"valid_so_far": False,
+                        "first_anomaly_op": self._matrix_first,
+                        "checked_ops": session.encoder.ops_encoded}
             es = session.encoder.stream.to_event_stream()
             spec = cas_register_spec(self._spec_init)
             mesh = parallel.sharded_mesh_for(len(es.kind))
@@ -336,7 +346,24 @@ class LinearLiveSession:
             if m is not None and m[0] and not m[2]:
                 return {"valid_so_far": True, "first_anomaly_op": None,
                         "checked_ops": session.encoder.ops_encoded}
-            return None  # invalid/inexact: the exact frontier settles it
+            if m is not None and not m[0] and not m[2]:
+                # exact INVALID: localize on device (the forensics
+                # bisection — doc/observability.md "Anomaly forensics")
+                # so the live screen reports the precise first anomaly
+                # instead of deferring to the slow CPU frontier rung
+                try:
+                    loc = matrix_localize(es, step_ids=spec.step_ids,
+                                          init_state=spec.init_state,
+                                          num_states=len(es.intern))
+                except Exception:  # noqa: BLE001 — frontier settles it
+                    logger.exception("live matrix localization failed")
+                    loc = None
+                if loc is not None:
+                    self._matrix_first = int(loc.failed_op_index)
+                    return {"valid_so_far": False,
+                            "first_anomaly_op": self._matrix_first,
+                            "checked_ops": session.encoder.ops_encoded}
+            return None  # inexact/declined: the exact frontier settles it
 
         def frontier_fn(ctx):
             session = ctx["session"]

@@ -216,6 +216,84 @@ class FaultRegistry:  # durability: fsync
                         labels=("kind",)).inc(kind=str(kind))
 
 
+def load_rows(path) -> list[dict]:
+    """Every row of a ``faults.jsonl`` (torn-tail tolerant, like the
+    registry's own loader); [] when the file is absent/unreadable. The
+    read-only surface the forensics/plotting layers use — no registry
+    object, no write handle."""
+    from jepsen_tpu.journal import read_jsonl_tolerant
+    try:
+        rows, _truncated = read_jsonl_tolerant(Path(path))
+    except OSError:
+        return []
+    return [r for r in rows if isinstance(r, dict)]
+
+
+def pair_rows(rows: list[dict]) -> list[dict]:
+    """Inject rows joined with their heal rows: ``[{id, kind, f, value,
+    t_wall, healed, via, t_heal_wall}]`` in injection order. Wall-clock
+    times (the registry records ``time.time()``); use
+    :func:`history_windows` for history-relative overlays."""
+    heals: dict = {}
+    for r in rows:
+        if r.get("op") == "heal":
+            heals.setdefault(r.get("id"), r)
+    out = []
+    for r in rows:
+        if r.get("op") != "inject":
+            continue
+        h = heals.get(r.get("id"))
+        out.append({"id": r.get("id"), "kind": r.get("kind"),
+                    "f": r.get("f"), "value": r.get("value"),
+                    "t_wall": r.get("time"),
+                    "healed": h is not None,
+                    "via": (h or {}).get("via"),
+                    "t_heal_wall": (h or {}).get("time")})
+    return out
+
+
+def history_windows(history: list[dict], rows: list[dict]) -> list[dict]:
+    """Fault windows in HISTORY time: each durable inject record matched
+    (in order, by ``:f``) to its nemesis op in the history for the start
+    edge; the end edge is the next nemesis op classifying as
+    ``("end", same kind)``, else open. A window whose heal happened
+    OUTSIDE the history — nemesis teardown, the crash-path replay,
+    ``cli heal`` — keeps ``end_time: None`` with ``healed``/``via`` set:
+    exactly the evidence the registry adds over history-derived
+    intervals (crash-replayed heals have no history op to pair with).
+    Registry rows with no matching history op (a crash before the
+    injection journaled) are skipped."""
+    paired = pair_rows(rows)
+    queues: dict = {}
+    for w in paired:
+        queues.setdefault(w.get("f"), []).append(w)
+    open_by_kind: dict[str, list[dict]] = {}
+    out: list[dict] = []
+    for op in history or []:
+        if op.get("process") != "nemesis" or op.get("type") != "info":
+            continue
+        f = op.get("f")
+        phase, kind = classify(f)
+        if phase == "begin":
+            q = queues.get(f)
+            rec = q.pop(0) if q else None
+            win = {"kind": kind if rec is None else rec.get("kind"),
+                   "f": f, "start_time": op.get("time"),
+                   "end_time": None,
+                   "healed": bool(rec and rec.get("healed")),
+                   "via": (rec or {}).get("via"),
+                   "record_id": (rec or {}).get("id"),
+                   "in_registry": rec is not None}
+            out.append(win)
+            open_by_kind.setdefault(win["kind"], []).append(win)
+        elif phase == "end":
+            opened = open_by_kind.get(kind) or []
+            if opened:
+                win = opened.pop(0)
+                win["end_time"] = op.get("time")
+    return out
+
+
 def actionable_unhealed(registry: FaultRegistry) -> tuple[list[dict],
                                                           list[dict]]:
     """Splits the registry's unhealed entries into ``(actionable,

@@ -1253,6 +1253,242 @@ def _matrix_cache(S, V, step_ids, init_state, T, C, B=1, mesh=None):
     return fn
 
 
+# ---------------------------------------------------------------------------
+# Anomaly forensics: device-side first-anomaly localization
+# (checker/explain.py drives these — doc/observability.md "Anomaly
+# forensics")
+# ---------------------------------------------------------------------------
+
+def _build_forensics_kernel(S: int, V: int, step_ids, T: int, C: int):
+    """Device programs for localizing WHERE a transfer-matrix verdict
+    went invalid, built from the same `_kernel_math` as the checking
+    kernels so localization can never disagree with the verdict:
+
+    * ``products`` — the chunk scan WITHOUT the final combine: every
+      chunk's composed [MV, MV] operator product comes back instead of
+      one verdict, so localization can bisect over them.
+    * ``prefix_alive`` — an associative inclusive scan composing the
+      chunk products into prefix products (log-depth on device; boolean
+      matrix products are exact under any association, so the scan's
+      re-pairing cannot change a verdict) and testing each prefix's
+      frontier for survivors: the first dead prefix names the guilty
+      chunk in O(log C) combine depth instead of a CPU re-scan.
+    * ``vec_batch`` — a vmapped per-return re-scan of ONE chunk's
+      operators applied to a [MV] frontier *vector* (not the [MV, MV]
+      matrix — ~MV× cheaper per step), returning each candidate's first
+      dead return: the within-chunk localization step AND the witness
+      shrinker's candidate-mask evaluator (checker/explain.py ddmin).
+    """
+    import types
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    math = _kernel_math(S, V, step_ids, C)
+    MV, eye = math.MV, math.eye
+
+    @jax.jit
+    def products(pend, op_ids, uops, slots, valid):
+        mt_tab, oob_tab = math.uop_tables(uops)
+        P0 = jnp.broadcast_to(eye, (C, MV, MV))
+        (P, inexact), _ = lax.scan(math.make_step(mt_tab, oob_tab),
+                                   (P0, jnp.zeros((C,), bool)),
+                                   (pend, op_ids, slots, valid))
+        return P, inexact
+
+    @jax.jit
+    def prefix_alive(P, v0):
+        def comb(a, b):
+            # a holds earlier chunks' accumulated product, b later ones:
+            # time order composes later-on-the-LEFT like chain_time
+            out = jnp.einsum("...ij,...jk->...ik", b, a,
+                             preferred_element_type=jnp.bfloat16)
+            return (out > 0).astype(jnp.bfloat16)
+
+        prefix = lax.associative_scan(comb, P)
+        # frontier after chunk c = column init of prefix[c] @ tot0, i.e.
+        # prefix[c] @ v0 with v0 the carry's init column
+        w = jnp.einsum("cij,j->ci", prefix, v0.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        return (w > 0).any(axis=1), prefix
+
+    vmath = _kernel_math(S, V, step_ids, 1)
+
+    def _vec_scan(pend, valid, op_ids, uops, slots, v0):
+        """One candidate: the chunk's T return operators applied to the
+        frontier vector ``v0``; returns (first dead return or -1,
+        inexact)."""
+        mt_tab, oob_tab = vmath.uop_tables(uops)
+        base = vmath.make_step(mt_tab, oob_tab)
+
+        def step(carry, inp):
+            carry2, _ = base(carry, inp)
+            vec, _ = carry2
+            return carry2, (vec[0, :, 0] > 0).any()
+
+        # ride make_step's [G=1, MV, MV] @ [G=1, MV, k] matmul with the
+        # vector as a k=1 matrix — same operators, MV× less work
+        P0 = v0.astype(jnp.bfloat16).reshape(1, MV, 1)
+        (_, inexact), alive = lax.scan(
+            step, (P0, jnp.zeros((1,), bool)),
+            (pend[:, None, :], op_ids[:, None, :], slots[:, None],
+             valid[:, None]))
+        first = jnp.where(alive.all(), jnp.int32(-1),
+                          jnp.argmax(~alive).astype(jnp.int32))
+        return first, inexact.any()
+
+    vec_batch = jax.jit(jax.vmap(_vec_scan,
+                                 in_axes=(0, 0, None, None, None, None)))
+    return types.SimpleNamespace(products=products,
+                                 prefix_alive=prefix_alive,
+                                 vec_batch=vec_batch)
+
+
+_FORENSICS_CACHE: dict = {}
+
+
+def _forensics_cache(S, V, step_ids, T, C):
+    key = (S, V, id(step_ids), T, C)
+    fk = _FORENSICS_CACHE.get(key)
+    if fk is None:
+        fk = _build_forensics_kernel(S, V, step_ids, T, C)
+        _FORENSICS_CACHE[key] = fk
+    return fk
+
+
+class MatrixLocalization:
+    """A settled device-side localization: WHERE the transfer-matrix
+    frontier first died, plus the handles checker/explain.py needs to
+    delta-debug a minimal witness over the guilty window (the chunk's
+    host grids and the frontier vector at its entry)."""
+
+    def __init__(self, failed_return, failed_event, failed_op_index,
+                 bisect_steps, chunk, step, n_chunks, chunk_returns,
+                 kernel, uops, window_pend, window_ids, window_slots,
+                 window_valid, v_start, ret_idx):
+        self.failed_return = failed_return      # global return index
+        self.failed_event = failed_event        # stream event index
+        self.failed_op_index = failed_op_index  # history op index
+        self.bisect_steps = bisect_steps
+        self.chunk = chunk                      # guilty chunk c*
+        self.step = step                        # chunk-relative return t*
+        self.n_chunks = n_chunks
+        self.chunk_returns = chunk_returns      # T
+        self.kernel = kernel                    # forensics kernel ns
+        self.uops = uops
+        self.window_pend = window_pend          # [T, S] guilty chunk grids
+        self.window_ids = window_ids
+        self.window_slots = window_slots
+        self.window_valid = window_valid
+        self.v_start = v_start                  # [MV] frontier at entry
+        self.ret_idx = ret_idx                  # return -> event index map
+
+
+def matrix_localize(stream, tot0=None, step_ids=None, init_state: int = 0,
+                    num_states: int | None = None, n_slots: int | None = None):
+    """Localizes the first anomaly of an INVALID matrix-family verdict
+    entirely on device: re-derives the per-chunk operator products (one
+    dispatch of the same cost as the check), bisects the composable
+    prefix products for the first dead chunk (O(log C) combine depth —
+    `prefix_alive`), then pinpoints the return within it with a cheap
+    [MV]-vector re-scan. The result's ``failed_event`` is bit-identical
+    to the exact CPU frontier's first rejection (the operators ARE the
+    frontier transition — pinned by tests/test_explain.py across
+    single-device, segmented, sharded-mesh, and live-screen backends).
+
+    ``tot0`` carries a segmented chain's composed prior product
+    (matrix_check_resume's output), so a failing segment localizes
+    without re-scanning the chain; event/op indices are then relative to
+    THIS segment's stream (its ``op_index`` column keeps them absolute).
+
+    Returns a :class:`MatrixLocalization`, or None when the stream is
+    alive, out of plan budget, or inexact (an oob transition proves
+    nothing — the exact CPU frontier must settle it instead)."""
+    import jax.numpy as jnp
+
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    if num_states is None:
+        num_states = len(stream.intern)
+    V = _bucket(num_states, floor=8)
+    kind = np.asarray(stream.kind)
+    prep = _returns_prepass(kind, np.asarray(stream.slot),
+                            np.asarray(stream.f), np.asarray(stream.a),
+                            np.asarray(stream.b))
+    S = max(n_slots or 1, prep[3])
+    R = prep[0].shape[0]
+    if R == 0:
+        return None
+    MV = (1 << S) * V
+    if tot0 is not None and np.asarray(tot0).shape[-1] != MV:
+        raise ValueError(
+            f"carry dimension {np.asarray(tot0).shape[-1]} != {MV}: "
+            f"segments must share n_slots and num_states")
+    try:
+        C, T = _matrix_plan(1, S, R, V, None)
+    except ValueError:
+        return None  # out of element budget: the CPU frontier settles it
+    grids, uops = _matrix_grids([prep], S, V, 1, C, T, None)
+    fk = _forensics_cache(S, V, step_ids, T, C)
+    P, inexact = fk.products(grids[0], grids[1], uops, grids[2], grids[3])
+    if bool(np.asarray(inexact).any()):
+        return None  # oob transition: localization would prove nothing
+    if tot0 is not None:
+        v0 = (jnp.asarray(tot0).reshape(-1, MV, MV)[0][:, init_state]
+              > 0).astype(jnp.bfloat16)
+    else:
+        v0 = jnp.zeros((MV,), jnp.bfloat16).at[init_state].set(1)
+    alive, prefix = fk.prefix_alive(P, v0)
+    alive = np.asarray(alive)
+    if alive.all():
+        return None  # the (carried) history is alive: nothing to localize
+    c_star = int(np.argmax(~alive))
+    if c_star == 0:
+        v_start = v0
+    else:
+        v_start = (jnp.einsum("ij,j->i", prefix[c_star - 1], v0,
+                              preferred_element_type=jnp.float32)
+                   > 0).astype(jnp.bfloat16)
+    pend_c = np.asarray(grids[0])[:, c_star]
+    ids_c = np.asarray(grids[1])[:, c_star]
+    slots_c = np.asarray(grids[2])[:, c_star]
+    valid_c = np.asarray(grids[3])[:, c_star]
+    first, inexact2 = fk.vec_batch(pend_c[None], valid_c[None], ids_c,
+                                   uops, slots_c, v_start)
+    t_star = int(np.asarray(first)[0])
+    if t_star < 0 or bool(np.asarray(inexact2).any()):
+        # the chunk verdict and its per-return re-scan disagree — a bug
+        # or an oob escape; never report a guessed position
+        logger.warning("matrix localization inconsistency at chunk %d "
+                       "(first=%d); declining", c_star, t_star)
+        return None
+    r_star = c_star * T + t_star
+    ret_idx = np.nonzero(kind == EV_RETURN)[0]
+    event = int(ret_idx[r_star])
+    op_index = int(np.asarray(stream.op_index)[event])
+    bisect_steps = max(1, int(np.ceil(np.log2(max(C, 2))))) + 1
+    return MatrixLocalization(
+        failed_return=r_star, failed_event=event, failed_op_index=op_index,
+        bisect_steps=bisect_steps, chunk=c_star, step=t_star, n_chunks=C,
+        chunk_returns=T, kernel=fk, uops=uops, window_pend=pend_c,
+        window_ids=ids_c, window_slots=slots_c, window_valid=valid_c,
+        v_start=v_start, ret_idx=ret_idx)
+
+
+def matrix_window_rescan(loc: MatrixLocalization, pend_batch, valid_batch):
+    """First dead return (chunk-relative; -1 = survives) for each
+    candidate's masked (pend, valid) grids over the localized chunk,
+    evaluated as ONE vmapped device dispatch — the witness shrinker's
+    inner loop (checker/explain.py). Callers bucket the candidate count
+    so the vmapped kernel compiles at a handful of batch shapes."""
+    first, _ = loc.kernel.vec_batch(
+        np.ascontiguousarray(pend_batch),
+        np.ascontiguousarray(valid_batch),
+        loc.window_ids, loc.uops, loc.window_slots, loc.v_start)
+    return np.asarray(first)
+
+
 # dense-table applicability bounds. Besides the per-axis caps, the closure
 # materializes an [S, 2^S, V] f32 intermediate per batch element, so gate
 # on the product too: S * 2^S * V elements (4 bytes each) must stay under
@@ -1365,7 +1601,10 @@ def _slice_stream(stream, lo: int, hi: int):
     """A view-slice of an EventStream's arrays (shared intern/slots)."""
     import copy
     seg = copy.copy(stream)
-    for field in ("kind", "slot", "f", "a", "b"):
+    # op_index slices too: a segment's diagnostics (matrix_localize's
+    # failed_op_index) must resolve through ITS events, not the full
+    # stream's row numbering
+    for field in ("kind", "slot", "f", "a", "b", "op_index"):
         setattr(seg, field, np.asarray(getattr(stream, field))[lo:hi])
     return seg
 

@@ -39,15 +39,28 @@ PROFILE_DIR = "profile"
 # dumps. Present only when the run actually produced them.
 FORENSIC_FILES = ("late.jsonl", "stall-threads.txt")
 
+# Anomaly forensics (doc/observability.md "Anomaly forensics"): the
+# first-anomaly + minimal-witness artifact and its rendered timeline,
+# written on INVALID verdicts (and by `jepsen-tpu explain`).
+EXPLAIN_FILES = ("anomaly.json", "witness-timeline.html")
+
+
+def _artifact_files(run_dir: Path, names) -> dict:
+    """{artifact-name: Path} for whichever of ``names`` exist as files
+    in a stored run directory (the shared probe behind each artifact
+    family's helper)."""
+    out: dict[str, Path] = {}
+    for name in names:
+        p = Path(run_dir) / name
+        if p.is_file():
+            out[name] = p
+    return out
+
 
 def telemetry_artifacts(run_dir: Path) -> dict:
     """{artifact-name: Path} for the telemetry files present in a stored
     run directory (the web UI links these alongside the classics)."""
-    out: dict[str, Path] = {}
-    for name in TELEMETRY_FILES:
-        p = Path(run_dir) / name
-        if p.is_file():
-            out[name] = p
+    out = _artifact_files(run_dir, TELEMETRY_FILES)
     p = Path(run_dir) / PROFILE_DIR
     if p.is_dir():
         out[PROFILE_DIR] = p
@@ -57,12 +70,13 @@ def telemetry_artifacts(run_dir: Path) -> dict:
 def forensic_artifacts(run_dir: Path) -> dict:
     """{artifact-name: Path} for the robustness forensics present in a
     stored run directory (late.jsonl / stall-threads.txt)."""
-    out: dict[str, Path] = {}
-    for name in FORENSIC_FILES:
-        p = Path(run_dir) / name
-        if p.is_file():
-            out[name] = p
-    return out
+    return _artifact_files(run_dir, FORENSIC_FILES)
+
+
+def explain_artifacts(run_dir: Path) -> dict:
+    """{artifact-name: Path} for the anomaly-forensics artifacts present
+    in a stored run directory (anomaly.json / witness-timeline.html)."""
+    return _artifact_files(run_dir, EXPLAIN_FILES)
 
 
 def base_dir(test: dict) -> Path:
@@ -123,6 +137,18 @@ def write_history(test: dict) -> None:
             f.write(op2str(op) + "\n")
 
 
+def first_client_f(history) -> str | None:
+    """The first CLIENT op's ``:f`` — the cheap workload-shape probe
+    shared by the columnar sidecar and offline forensics. Looks only at
+    int-process ops: a nemesis op firing before the first client invoke
+    must not mask the workload (the encoders themselves drop
+    non-int-process ops)."""
+    return next(
+        (op.get("f") for op in history
+         if isinstance(op.get("process"), int) and op.get("process") >= 0
+         and op.get("f") is not None), None)
+
+
 def write_columnar(test: dict) -> None:
     """history.npz: the struct-of-arrays sidecar, checker-ready (the
     EDN->numpy serialization of BASELINE's north star, built at save
@@ -148,13 +174,7 @@ def write_columnar(test: dict) -> None:
     # jsonl + re-encoding (checker/linearizable.check_stored). Cheap
     # shape probe first: the encoder's pairing pre-pass is a full O(n)
     # walk and must not run on every non-register history
-    # the probe looks at the first CLIENT op (int process) — a nemesis
-    # op firing before the first client invoke must not mask a register
-    # run (encode_register_ops itself drops non-int-process ops)
-    first_f = next(
-        (op.get("f") for op in history
-         if isinstance(op.get("process"), int) and op.get("process") >= 0
-         and op.get("f") is not None), None)
+    first_f = first_client_f(history)
     if first_f in ("read", "write", "cas"):
         try:
             from jepsen_tpu.checker.linear_encode import (

@@ -33,6 +33,8 @@ td, th { padding: 0.3em 0.8em; border: 1px solid #ddd; text-align: left; }
   padding: 0.05em 0.5em; font-size: 0.85em; }
 .live-panel { border: 1px solid #9cc; background: #f2fafc;
   padding: 0.6em 1em; margin: 0.5em 0; }
+.explain-panel { border: 1px solid #d9a; background: #fdf4f2;
+  padding: 0.6em 1em; margin: 0.5em 0; }
 a { text-decoration: none; }
 """
 
@@ -220,6 +222,47 @@ def _live_home_section(tests: dict) -> str:
             + "".join(rows) + "</table>")
 
 
+def _explain_section(rel: str, target: Path) -> str:
+    """The run page's "Explain" panel: the anomaly-forensics summary
+    (first anomaly op, witness size, localization backend) with links to
+    ``anomaly.json`` and the rendered witness timeline
+    (doc/observability.md "Anomaly forensics"). Empty string when the
+    run has no forensics (the valid, healthy case)."""
+    f = target / "anomaly.json"
+    if not f.is_file():
+        return ""
+    base = rel.rstrip("/")
+    links = [f"<a href='/{base}/anomaly.json'>anomaly.json</a>"]
+    if (target / "witness-timeline.html").is_file():
+        links.append(f"<a href='/{base}/witness-timeline.html'>"
+                     "witness-timeline.html</a>")
+    try:
+        a = json.loads(f.read_text())
+    except Exception:  # noqa: BLE001 — a corrupt artifact still links
+        return ("<div class='explain-panel'><h2>explain</h2><p>"
+                + " ".join(links) + "</p></div>")
+    fa = a.get("first_anomaly") or {}
+    wit = a.get("witness") or {}
+    overlapping = sum(1 for w in (a.get("fault_windows") or ())
+                      if w.get("overlaps_witness"))
+    rows = [
+        ("first anomaly", f"op {fa.get('op_index')} — "
+                          f"{fa.get('f')} {fa.get('value')!r} "
+                          f"(process {fa.get('process')})"),
+        ("witness", f"{len(wit.get('op_indices') or [])} op(s)"
+                    + (" (minimal)" if wit.get("minimal") else "")),
+        ("backend", a.get("backend")),
+        ("bisect steps", a.get("bisect_steps")),
+        ("fault windows overlapping", overlapping),
+        ("latency", f"{a.get('explain_latency_seconds')} s"),
+    ]
+    cells = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>" for k, v in rows)
+    return (f"<div class='explain-panel'><h2>explain</h2>"
+            f"<table>{cells}</table><p>" + " ".join(links) + "</p></div>")
+
+
 def _forensics_section(rel: str, target: Path) -> str:
     """Links a run's robustness forensics — late.jsonl (completions
     quarantined from reaped zombie workers) and stall-threads.txt (the
@@ -305,7 +348,8 @@ class Handler(BaseHTTPRequestHandler):
                 badge = (" <span class='badge-incomplete'>incomplete"
                          "</span>" if incomplete else "")
                 arts = {**store.telemetry_artifacts(run_dir),
-                        **store.forensic_artifacts(run_dir)}
+                        **store.forensic_artifacts(run_dir),
+                        **store.explain_artifacts(run_dir)}
                 links = " ".join(
                     f"<a href='/{name}/{ts}/{a}{'/' if a == store.PROFILE_DIR else ''}'>"
                     f"{html.escape(a)}</a>"
@@ -337,6 +381,7 @@ class Handler(BaseHTTPRequestHandler):
                 for p in sorted(target.iterdir()))
             live_panel, live = _live_panel(target)
             metrics = _metrics_table(target / "metrics.json")
+            explain = _explain_section(rel, target)
             elle = _elle_section(rel, target)
             forensics = _forensics_section(rel, target)
             banner = ""
@@ -352,13 +397,16 @@ class Handler(BaseHTTPRequestHandler):
             head = (f"<meta http-equiv='refresh' "
                     f"content='{LIVE_REFRESH_S}'>" if live else "")
             return self._send(
-                self._page(rel, f"{live_panel}{banner}{forensics}{elle}"
+                self._page(rel, f"{live_panel}{banner}{explain}"
+                                f"{forensics}{elle}"
                                 f"{metrics}<ul>{items}</ul>",
                            head_extra=head))
         if target.exists():
             ctype = ("application/json" if target.suffix == ".json"
                      else "image/png" if target.suffix == ".png"
                      else "image/svg+xml" if target.suffix == ".svg"
+                     else "text/html; charset=utf-8"
+                     if target.suffix in (".html", ".htm")
                      else "text/plain; charset=utf-8")
             # weak-validator ETag from (mtime, size): live pages poll
             # metrics.json / live-status.json every couple of seconds —

@@ -28,7 +28,7 @@ class AppendChecker(Checker):
         # files under store/<test>/<ts>/elle/ (the reference passes
         # elle :directory per test, append.clj:17-22)
         from jepsen_tpu.elle import artifacts
-        artifacts.write_for_test(test, result, opts)
+        artifacts.write_for_test(test, result, opts, history=history)
         return result
 
 

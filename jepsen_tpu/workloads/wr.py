@@ -27,7 +27,7 @@ class WrChecker(Checker):
         # same artifact surface as the list-append checker: per-anomaly
         # explanation files in the run's elle/ directory when invalid
         from jepsen_tpu.elle import artifacts
-        artifacts.write_for_test(test, result, opts)
+        artifacts.write_for_test(test, result, opts, history=history)
         return result
 
 
