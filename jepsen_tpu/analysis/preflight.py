@@ -51,6 +51,9 @@ CLI001 error    client ops emitted but the test has no client
 NEM001 warning  nemesis ops emitted but the test has no nemesis
 NEM002 error    nemesis ``:f`` maps to an unhealable fault kind
 NEM003 error    nemesis ``:f`` outside the nemesis' declared surface
+NEM004 error    nemesis package misconfigured (State surface/knobs)
+NEM005 error    membership package is unhealable (no/bad heal spec)
+NEM006 error    clock-rate faults requested but libfaketime is absent
 KNB001 error    knob has a non-numeric type
 KNB002 error    knob out of range
 KNB003 error    concurrency invalid
@@ -63,9 +66,16 @@ CHK001 warning  checker model doesn't recognize enumerated ops
 from __future__ import annotations
 
 import dataclasses
+import datetime
+import decimal
 import dis
+import fractions
 import logging
+import pathlib
+import re
 import types
+import uuid
+from enum import Enum
 from typing import Any
 
 from jepsen_tpu.analysis.diagnostics import (
@@ -98,6 +108,19 @@ class PreflightFailed(Exception):
 # ---------------------------------------------------------------------------
 
 _MUTABLE_CELL_TYPES = (list, dict, set, bytearray)
+# Closure cell types that cannot carry run state a symbolic enumeration
+# could consume. Anything else — notably an arbitrary object instance,
+# like the live MembershipNemesis a membership generator closes over —
+# is treated as stateful: calling through it during enumeration could
+# mutate the very model the real run needs. Common immutable value
+# types (Path, datetime, Decimal, patterns, UUIDs, enums) stay inert so
+# ordinary data-closure generators keep full enumeration coverage.
+_INERT_CELL_TYPES = (type(None), str, int, float, bool, bytes, complex,
+                     range, type, types.ModuleType,
+                     pathlib.PurePath, datetime.date, datetime.time,
+                     datetime.timedelta, datetime.tzinfo,
+                     decimal.Decimal, fractions.Fraction,
+                     re.Pattern, uuid.UUID, Enum)
 _STATE_OPS = frozenset(
     {"STORE_DEREF", "DELETE_DEREF", "STORE_GLOBAL", "DELETE_GLOBAL"})
 _MISSING = object()
@@ -120,15 +143,9 @@ def _stateful_callable(fn, _depth: int = 0) -> str | None:
             v = cell.cell_contents
         except ValueError:
             return "unresolved closure cell"
-        if hasattr(v, "__next__"):
-            return f"closure over an iterator in {fn.__qualname__!r}"
-        if isinstance(v, _MUTABLE_CELL_TYPES):
-            return (f"closure over a mutable {type(v).__name__} in "
-                    f"{fn.__qualname__!r}")
-        if callable(v):
-            reason = _stateful_callable(v, _depth + 1)
-            if reason:
-                return reason
+        reason = _stateful_cell(v, fn, _depth)
+        if reason:
+            return reason
     try:
         for ins in dis.get_instructions(fn):
             if ins.opname in _STATE_OPS:
@@ -141,6 +158,49 @@ def _stateful_callable(fn, _depth: int = 0) -> str | None:
     except Exception:  # noqa: BLE001 — bytecode we can't read, assume worst
         return "unreadable bytecode"
     return None
+
+
+def _stateful_cell(v, fn, depth: int) -> str | None:
+    """Why a closure-cell VALUE makes enumeration unsafe, or None.
+    Recurses into nested immutable containers, partials, and plain
+    functions; allows module-level builtins (``math.sqrt`` — bound to a
+    module) while rejecting instance-bound ones (``random.random`` is a
+    bound method of the hidden global ``Random``); treats any other
+    object instance (nemesis, connection, RNG) as live run state."""
+    import functools
+    if depth > 4:
+        return "cell nesting too deep to prove stateless"
+    if hasattr(v, "__next__"):
+        return f"closure over an iterator in {fn.__qualname__!r}"
+    if isinstance(v, _MUTABLE_CELL_TYPES):
+        return (f"closure over a mutable {type(v).__name__} in "
+                f"{fn.__qualname__!r}")
+    if isinstance(v, types.FunctionType):
+        return _stateful_callable(v, depth + 1)
+    if isinstance(v, types.BuiltinFunctionType):
+        owner = getattr(v, "__self__", None)
+        if owner is None or isinstance(owner, types.ModuleType):
+            return None
+        return (f"{fn.__qualname__!r} closes over builtin method "
+                f"{v.__name__!r} bound to a {type(owner).__name__}")
+    if isinstance(v, functools.partial):
+        for part in (v.func, *v.args, *v.keywords.values()):
+            reason = _stateful_cell(part, fn, depth + 1)
+            if reason:
+                return reason
+        return None
+    if isinstance(v, (tuple, frozenset)):
+        for el in v:
+            reason = _stateful_cell(el, fn, depth + 1)
+            if reason:
+                return reason
+        return None
+    if isinstance(v, _INERT_CELL_TYPES):
+        return None
+    # an object instance: calling the closure can read/advance its
+    # live state
+    return (f"closure over a {type(v).__name__} instance in "
+            f"{fn.__qualname__!r}")
 
 
 def _stateful_global(fn, name, depth: int) -> str | None:
@@ -509,7 +569,15 @@ def _enumerate(test: dict) -> tuple[list[dict], list[Diagnostic]]:
             hint="the simulated scheduler completes every op :ok with "
                  "zero latency; generators that depend on richer "
                  "completions may not be enumerable")]
-    invocations = [op for op in history if op.get("type") == "invoke"]
+    from jepsen_tpu.generator import NEMESIS
+    # dispatched client ops are :invoke; nemesis packages emit their
+    # dispatches as :info op templates (db_package, partition_package,
+    # ...), which the simulated scheduler appends as-is — both are
+    # "what the generator asks for" and both feed the surface checks
+    invocations = [op for op in history
+                   if op.get("type") == "invoke"
+                   or (op.get("process") == NEMESIS
+                       and op.get("type") == "info")]
     if stats.get("step_limited") or stats.get("wall_limited"):
         # ONLY the stats flags mean truncation — a generator that
         # exhausted naturally under the caps got full coverage, however
@@ -614,6 +682,50 @@ def _fmt_fs(fs) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Nemesis package self-checks (NEM004/NEM005/NEM006)
+# ---------------------------------------------------------------------------
+
+def _walk_nemeses(nemesis, out: list, _depth: int = 0) -> None:
+    """Flattens a composed nemesis tree: wrappers hold the inner in
+    ``.nemesis``/``.inner``, Compose in ``.nemeses``."""
+    if nemesis is None or _depth > 6 \
+            or any(nemesis is seen for seen in out):
+        return
+    out.append(nemesis)
+    for attr in ("nemesis", "inner"):
+        sub = getattr(nemesis, attr, None)
+        if sub is not None and sub is not nemesis:
+            _walk_nemeses(sub, out, _depth + 1)
+    subs = getattr(nemesis, "nemeses", None)
+    if isinstance(subs, (list, tuple)):
+        for sub in subs:
+            _walk_nemeses(sub, out, _depth + 1)
+
+
+def _nemesis_package_diags(test: dict) -> list[Diagnostic]:
+    """Package-declared static checks: any nemesis in the composed tree
+    may implement ``preflight_diags(test) -> [Diagnostic]`` (no node
+    contact allowed). This is how the membership package validates its
+    State surface/knobs/healability (NEM004/NEM005) and the clock-rate
+    package surfaces a missing libfaketime (NEM006) BEFORE the run —
+    generator enumeration cannot reach them: their generators are
+    stateful by design (GEN005)."""
+    out: list[Diagnostic] = []
+    nems: list = []
+    _walk_nemeses(test.get("nemesis"), nems)
+    for n in nems:
+        fn = getattr(n, "preflight_diags", None)
+        if not callable(fn):
+            continue
+        try:
+            out.extend(fn(test) or ())
+        except Exception:  # noqa: BLE001 — a broken check is no check
+            logger.exception("%s.preflight_diags raised; skipping",
+                             type(n).__name__)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
 
@@ -624,6 +736,7 @@ def preflight(test: dict) -> list[Diagnostic]:
     invocations, gen_diags = _enumerate(test)
     diags.extend(gen_diags)
     diags.extend(_check_ops(test, invocations))
+    diags.extend(_nemesis_package_diags(test))
     allowed = {str(c) for c in (test.get("preflight_allow") or ())}
     if allowed:
         diags = [

@@ -7,7 +7,10 @@ backbone of cluster-free integration tests of the full run lifecycle
 """
 from __future__ import annotations
 
+import json
 import threading
+import time as _time
+from pathlib import Path
 from typing import Any
 
 from jepsen_tpu import db as db_mod
@@ -720,6 +723,101 @@ class KVClient(MetaLogClient):
         if f == "drain":
             return {**op, "type": "ok", "value": self.db.drain()}
         return {**op, "type": "fail", "error": ["unknown-f", f]}
+
+
+class FakeClusterState:  # durability: fsync
+    """A membership State (nemesis/membership.py) over a DURABLE fake
+    cluster: the member set lives in a JSON file, so reconfigurations
+    survive SIGKILL — exactly the crash-safety story the chaos lane
+    exercises (a killed run's ``cli heal`` restores the recorded pre-op
+    member set by rewriting this file).
+
+    ``settle_s`` keeps a reconfiguration *in flight* (unresolved) for
+    that long after its invoke — the SIGKILL window for the chaos test,
+    and a stand-in for a real cluster's convergence delay. ``op()``
+    alternately shrinks down to ``min_members`` and grows back, one
+    node at a time, never with another op in flight.
+    """
+
+    def __init__(self, path, nodes=None, settle_s: float = 0.0,
+                 min_members: int = 1):
+        self.path = Path(path)
+        self.settle_s = settle_s
+        self.min_members = min_members
+        self._lock = threading.Lock()
+        self._inflight = 0
+        if self.path.exists():
+            self._members = set(json.loads(self.path.read_text()))
+        else:
+            self._members = set(nodes or [])
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._persist()
+        self._all = sorted(set(nodes or []) | set(self._members))
+
+    def _persist(self) -> None:
+        """Atomic durable write: the chaos test inspects this file
+        after SIGKILL, so a torn member set is not an option."""
+        from jepsen_tpu.utils import atomic_write_json
+        atomic_write_json(self.path, sorted(self._members))
+
+    # -- State protocol --------------------------------------------------
+    def fs(self):
+        return {"grow", "shrink"}
+
+    def node_view(self, test, node):
+        with self._lock:
+            return sorted(self._members)
+
+    def merge_views(self, test, views):
+        return self
+
+    def members(self):
+        with self._lock:
+            return set(self._members)
+
+    def heal_spec(self, test):
+        return {"mechanism": "file", "path": str(self.path)}
+
+    def op(self, test):
+        with self._lock:
+            if self._inflight:
+                return "pending"  # one reconfig at a time
+            members = sorted(self._members)
+            absent = [n for n in self._all if n not in self._members]
+            if len(members) > self.min_members and not absent:
+                return {"type": "info", "f": "shrink", "value": members[-1]}
+            if absent:
+                return {"type": "info", "f": "grow", "value": absent[0]}
+            return "pending"
+
+    def invoke(self, test, op):
+        f, node = op.get("f"), op.get("value")
+        with self._lock:
+            if f == "shrink":
+                self._members.discard(node)
+            elif f == "grow":
+                self._members.add(node)
+            else:
+                return ["unknown-f", f]
+            self._persist()
+            self._inflight += 1
+        return {"action": f, "node": node, "at": _time.time()}
+
+    def resolve(self, test):
+        return self
+
+    def resolve_op(self, test, pending_pair):
+        _op, value = pending_pair
+        if not isinstance(value, dict):
+            return self  # errored invoke: nothing will ever converge it
+        if _time.time() - value.get("at", 0.0) < self.settle_s:
+            return None  # still settling (the SIGKILL window)
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+        return self
+
+    def teardown(self, test):
+        pass  # the members file stays — it IS the cluster's state
 
 
 class CrashingClient(Client):

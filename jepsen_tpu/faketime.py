@@ -31,6 +31,19 @@ def find_lib() -> str | None:
     return None
 
 
+def local_lib() -> str | None:
+    """LIB_PATHS probe on the CONTROL host's local filesystem — no
+    remote session needed. Preflight uses it (via the clock-rate
+    nemesis' ``preflight_diags``) to surface a missing distro
+    libfaketime as a structured NEM006 diagnostic BEFORE a dummy/
+    local-mode run starts, instead of a RemoteError mid-run."""
+    import os.path
+    for p in LIB_PATHS:
+        if os.path.exists(p):
+            return p
+    return None
+
+
 def install() -> str:
     """Ensures libfaketime is present (distro package), returning the
     library path (faketime.clj:8-22 capability)."""
@@ -59,10 +72,12 @@ def script(lib: str, rate: float) -> str:
         "exec \"$(dirname \"$0\")/$(basename \"$0\").real\" \"$@\"\n")
 
 
-def wrap(binary: str, rate: float) -> None:
+def wrap(binary: str, rate: float, lib: str | None = None) -> None:
     """Moves binary to binary.real and installs a faketime wrapper in its
-    place (faketime.clj wrap!/:36-55). Idempotent."""
-    lib = install()
+    place (faketime.clj wrap!/:36-55). Idempotent. ``lib`` pins the
+    libfaketime path (skipping the install probe) — the clock-rate
+    nemesis passes a preflight-validated path through."""
+    lib = lib or install()
     if not file_exists(f"{binary}.real"):
         control.exec_("mv", binary, f"{binary}.real")
     write_file(script(lib, rate), binary)

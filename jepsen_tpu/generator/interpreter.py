@@ -206,8 +206,23 @@ class NemesisWorker(Worker):
         faults = test.get("_faults") if nemesis is not None else None
         fault_phase = fault_kind = None
         if faults is not None:
-            from jepsen_tpu.nemesis.faults import classify
+            from jepsen_tpu.nemesis import self_recorded_kinds
+            from jepsen_tpu.nemesis.faults import (
+                SELF_RECORDED_ONLY, classify,
+            )
             fault_phase, fault_kind = classify(op.get("f"))
+            if fault_kind is not None \
+                    and (fault_kind in SELF_RECORDED_ONLY
+                         or fault_kind in self_recorded_kinds(nemesis)):
+                # the nemesis keeps its own (richer) registry books for
+                # this kind — e.g. membership records the pre-op member
+                # set and heal-marks at resolution; a generic record
+                # here would double-book an entry nothing ever heals.
+                # SELF_RECORDED_ONLY kinds are ALSO skipped for plain
+                # nemeses (faunadb topology, rethinkdb reconfigure):
+                # without a model there is no pre-op set to restore,
+                # and an unhealable row is worse than none
+                fault_phase = fault_kind = None
             if fault_phase == "begin":
                 try:
                     faults.record(fault_kind, f=op.get("f"),
@@ -266,6 +281,37 @@ def goes_in_history(op: dict) -> bool:
     return op.get("type") not in ("sleep", "log")
 
 
+# Per-worker-thread state, installed by _spawn_worker's run() so code
+# called FROM a worker (clients, nemeses) can learn its fate without a
+# worker handle. Off-worker threads see nothing.
+_worker_tls = threading.local()
+
+
+def current_worker_zombie():
+    """The calling thread's zombie event (None off-worker) — helpers
+    that hop threads (``utils.timeout``) hand it to their child via
+    :func:`adopt_worker_zombie` so :func:`current_op_reaped` keeps
+    answering for the logical op, not the physical thread."""
+    return getattr(_worker_tls, "zombied", None)
+
+
+def adopt_worker_zombie(event) -> None:
+    if event is not None:
+        _worker_tls.zombied = event
+
+
+def current_op_reaped() -> bool:
+    """True when the calling thread is an interpreter worker whose
+    in-flight op was reaped at its deadline (the worker is zombied and a
+    synthesized indeterminate ``:info`` already stands in the history).
+    Client/nemesis code consults this to keep late side effects off the
+    books — e.g. the membership nemesis leaves its registry entry
+    unhealed so the crash-path / ``cli heal`` replay restores the
+    pre-op member set (doc/robustness.md)."""
+    ev = getattr(_worker_tls, "zombied", None)
+    return ev is not None and ev.is_set()
+
+
 def _spawn_worker(test: dict, worker_id, completions: queue.Queue,
                   generation: int = 0):
     """Worker thread + its in-queue (interpreter.clj:99-164).
@@ -297,6 +343,7 @@ def _spawn_worker(test: dict, worker_id, completions: queue.Queue,
         threading.current_thread().name = (
             f"jepsen-worker-{worker_id}"
             + (f".{generation}" if generation else ""))
+        _worker_tls.zombied = zombied
         while True:
             op = in_q.get()
             if op is _EXIT:

@@ -32,6 +32,29 @@ class Nemesis:
         nemesis.clj:18-21)."""
         return set()
 
+    def self_recorded_kinds(self) -> set:
+        """Fault kinds (faults.KINDS) this nemesis books into the
+        durable registry ITSELF — richer records than the interpreter's
+        generic pre-fire snapshot (e.g. the membership nemesis records
+        the pre-op member set and marks entries healed at resolution).
+        The interpreter's NemesisWorker skips its own record/heal-mark
+        for these kinds so every fault lands exactly once."""
+        return set()
+
+
+def self_recorded_kinds(nemesis) -> set:
+    """``nemesis.self_recorded_kinds()`` with tolerance for bare duck-
+    typed nemeses (tests wire plain objects) — absent or broken means
+    "none": the generic registry path stays on."""
+    fn = getattr(nemesis, "self_recorded_kinds", None)
+    if not callable(fn):
+        return set()
+    try:
+        return set(fn() or ())
+    except Exception:  # noqa: BLE001 — reflection must never block an op
+        logger.exception("self_recorded_kinds() raised; assuming none")
+        return set()
+
 
 class Noop(Nemesis):
     """Does nothing (jepsen.nemesis/noop)."""
@@ -66,6 +89,9 @@ class ValidateNemesis(Nemesis):
     def fs(self):
         return self.nemesis.fs()
 
+    def self_recorded_kinds(self):
+        return self_recorded_kinds(self.nemesis)
+
 
 def validate(nemesis: Nemesis) -> Nemesis:
     return ValidateNemesis(nemesis)
@@ -94,6 +120,9 @@ class Timeout(Nemesis):
 
     def fs(self):
         return self.nemesis.fs()
+
+    def self_recorded_kinds(self):
+        return self_recorded_kinds(self.nemesis)
 
 
 # ---------------------------------------------------------------------------
@@ -311,6 +340,10 @@ class FMap(Nemesis):
     def fs(self):
         return {self.f_mapping.get(f, f) for f in self.nemesis.fs()}
 
+    def self_recorded_kinds(self):
+        # kinds are classify() groups, not :f names — no renaming
+        return self_recorded_kinds(self.nemesis)
+
 
 def f_map(f_mapping: dict, nemesis: Nemesis) -> Nemesis:
     return FMap(f_mapping, nemesis)
@@ -349,6 +382,12 @@ class Compose(Nemesis):
         out = set()
         for n in self.nemeses:
             out |= n.fs()
+        return out
+
+    def self_recorded_kinds(self):
+        out = set()
+        for n in self.nemeses:
+            out |= self_recorded_kinds(n)
         return out
 
 

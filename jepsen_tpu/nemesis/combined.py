@@ -242,6 +242,140 @@ def clock_package(opts: dict) -> dict | None:
 
 
 # ---------------------------------------------------------------------------
+# membership + clock-rate packages (membership.clj:224-250, faketime.clj)
+# ---------------------------------------------------------------------------
+
+def membership_package(opts: dict) -> dict | None:
+    """A membership-reconfiguration package when the test supplies a
+    State model: ``opts["membership_state"]`` (a built State) or
+    ``opts["membership_state_fn"]`` (a ``fn(opts) -> State`` factory —
+    suites use this so fake and real modes build different models).
+    Wired through :func:`jepsen_tpu.nemesis.membership.package`, so ops
+    land in the durable fault registry with their pre-op member sets."""
+    if "membership" not in set(opts.get("faults") or []):
+        return None
+    state = opts.get("membership_state")
+    if state is None and callable(opts.get("membership_state_fn")):
+        state = opts["membership_state_fn"](opts)
+    if state is None:
+        return None
+    from jepsen_tpu.nemesis import membership
+    return membership.package(
+        state, interval=opts.get("interval", DEFAULT_INTERVAL),
+        poll_interval=opts.get("membership_poll_interval",
+                               membership.NODE_VIEW_INTERVAL))
+
+
+def clock_rate_package(opts: dict) -> dict | None:
+    """Begin/end ``clock-rate`` windows: libfaketime rate factors on a
+    random node subset (nemesis/time.ClockRateNemesis). Needs
+    ``opts["clock_rate_binary"]`` — the DB binary to wrap."""
+    if "clock-rate" not in set(opts.get("faults") or []):
+        return None
+    binary = opts.get("clock_rate_binary")
+    if not binary:
+        return None
+    from jepsen_tpu.nemesis.time import ClockRateNemesis, clock_rate_gen
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    stop_op = {"type": "info", "f": "stop-clock-rate",
+               "value": {"binary": binary}}
+    # limit(1, Fn): a bare Fn never exhausts (it is its own
+    # continuation), which would pin the Seq on start ops forever
+    g = gen.stagger(interval, gen.cycle(gen.Seq([
+        gen.limit(1, gen.Fn(clock_rate_gen(binary))), dict(stop_op)])))
+    return {
+        "nemesis": ClockRateNemesis(binary,
+                                    lib=opts.get("clock_rate_lib")),
+        "generator": g,
+        "final_generator": gen.Seq([dict(stop_op)]),
+        "perf": {"name": "clock-rate",
+                 "fs": {"start-clock-rate", "stop-clock-rate"},
+                 "start": {"start-clock-rate"},
+                 "stop": {"stop-clock-rate"}},
+    }
+
+
+def _during_reconfig_package(opts: dict, open_fn: Callable,
+                             close_fn: Callable, inner_pkg: dict | None,
+                             name: str) -> dict | None:
+    """Model-aware combo scaffolding: compose a membership package with
+    a second fault whose window OPENS while a reconfiguration is in
+    flight and CLOSES once it resolves — the schedule jepsen uses to
+    catch consensus bugs that only bite mid-reconfig. The window
+    generator consults the live MembershipNemesis (``pending_count``),
+    so preflight skips it as stateful (GEN005) rather than enumerating
+    through run state. ``open_fn``/``close_fn`` are
+    ``(test, ctx) -> op`` edge builders."""
+    mpkg = membership_package(
+        {**opts, "faults": set(opts.get("faults") or ()) | {"membership"}})
+    if mpkg is None or inner_pkg is None:
+        return None
+    from jepsen_tpu.nemesis.membership import PollingGen
+    mn = mpkg["nemesis"]
+    perf = inner_pkg.get("perf") or {}
+    open_fs = set(perf.get("start") or ())
+    close_fs = set(perf.get("stop") or ())
+    window = {"open": False}
+
+    def window_gen(test, ctx):
+        # PURE over observable state: the window flag flips only in
+        # on_update, when an edge actually DISPATCHED — an offered edge
+        # can sit through many re-polls (busy nemesis thread, lost
+        # scheduling tie) or never dispatch at all, and must keep being
+        # offered rather than silently dropped
+        pending = mn.pending_count()
+        if pending and not window["open"]:
+            return open_fn(test, ctx)
+        if window["open"] and not pending:
+            return close_fn(test, ctx)
+        return None
+
+    def on_update(event):
+        f = event.get("f")
+        if f in open_fs:
+            window["open"] = True
+        elif f in close_fs:
+            window["open"] = False
+
+    pkg = compose_packages([mpkg, {
+        **inner_pkg,
+        "generator": PollingGen(window_gen, on_update=on_update),
+    }])
+    pkg["perf"] = [mpkg.get("perf"), {**perf, "name": name}]
+    return pkg
+
+
+def partition_during_reconfig_package(opts: dict) -> dict | None:
+    """Partition windows synchronized to reconfigurations: the network
+    splits while a membership op is unresolved and heals when the
+    cluster converges."""
+    return _during_reconfig_package(
+        opts,
+        lambda test, ctx: {"type": "info", "f": "start-partition",
+                           "value": None},
+        lambda test, ctx: {"type": "info", "f": "stop-partition",
+                           "value": None},
+        partition_package({**opts, "faults": {"partition"}}),
+        "partition-during-reconfig")
+
+
+def clock_rate_during_reconfig_package(opts: dict) -> dict | None:
+    """Clock-rate skew synchronized to reconfigurations: node clocks
+    drift apart exactly while membership is in flux."""
+    binary = opts.get("clock_rate_binary")
+    if not binary:
+        return None
+    from jepsen_tpu.nemesis.time import clock_rate_gen
+    rate_fn = clock_rate_gen(binary)
+    return _during_reconfig_package(
+        opts, rate_fn,
+        lambda test, ctx: {"type": "info", "f": "stop-clock-rate",
+                           "value": {"binary": binary}},
+        clock_rate_package({**opts, "faults": {"clock-rate"}}),
+        "clock-rate-during-reconfig")
+
+
+# ---------------------------------------------------------------------------
 # composition (combined.clj:283-374)
 # ---------------------------------------------------------------------------
 
@@ -280,12 +414,72 @@ def compose_packages(packages: list[dict]) -> dict:
 
 def nemesis_package(opts: dict) -> dict:
     """The top-level entry (combined.clj:328-374). opts keys: db, faults
-    (set of "kill"/"pause"/"partition"/"clock" plus any name registered
-    in ``fault_packages``), interval, extra_packages, fault_packages
-    (name → builder(opts), the DB-specific vocabularies — see
-    jepsen_tpu.nemesis.db_specific).
+    (set of "kill"/"pause"/"partition"/"clock"/"membership"/"clock-rate"
+    plus any name registered in ``fault_packages``), interval,
+    extra_packages, fault_packages (name → builder(opts), the
+    DB-specific vocabularies — see jepsen_tpu.nemesis.db_specific),
+    membership_state / membership_state_fn (the reconfiguration model),
+    clock_rate_binary / clock_rate_lib (the libfaketime wrap target).
+    The combo faults "partition-during-reconfig" and
+    "clock-rate-during-reconfig" subsume their component packages.
     """
-    pkgs = [db_package(opts), partition_package(opts), clock_package(opts)]
+    faults = set(opts.get("faults") or [])
+    pkgs = [db_package(opts), clock_package(opts)]
+    combos_wanted = faults & {"partition-during-reconfig",
+                              "clock-rate-during-reconfig"}
+    if len(combos_wanted) > 1:
+        # each combo owns the (single) membership State; two combos
+        # would double-drive it — and silently building only one would
+        # drop a fault the user named
+        raise ValueError(
+            "partition-during-reconfig and clock-rate-during-reconfig "
+            "cannot be combined in one run: both own the membership "
+            "State; pick one (the other fault class can ride along "
+            "standalone)")
+    combo = combo_name = None
+    if "partition-during-reconfig" in faults:
+        combo_name = "partition-during-reconfig"
+        combo = partition_during_reconfig_package(
+            {**opts, "faults": faults | {"membership"}})
+    elif "clock-rate-during-reconfig" in faults:
+        combo_name = "clock-rate-during-reconfig"
+        combo = clock_rate_during_reconfig_package(
+            {**opts, "faults": faults | {"membership"}})
+    if combo_name and combo is None:
+        # a fault the user NAMED must never silently no-op (the same
+        # contract NEM005/NEM006 enforce for misconfigured packages)
+        raise ValueError(
+            f"fault {combo_name!r} requested but its wiring is missing: "
+            "it needs membership_state/membership_state_fn"
+            + ("" if combo_name.startswith("partition")
+               else " and clock_rate_binary"))
+    if combo is not None:
+        # the combo already owns the membership nemesis (and its inner
+        # fault); a standalone membership package would double-drive
+        # the same State
+        pkgs.append(combo)
+    else:
+        mpkg = membership_package(opts)
+        if "membership" in faults and mpkg is None:
+            raise ValueError(
+                "fault 'membership' requested but no membership_state/"
+                "membership_state_fn is wired (this suite may not "
+                "support the membership fault class)")
+        pkgs.append(mpkg)
+    if combo_name != "partition-during-reconfig":
+        # the partition combo subsumes the standalone partition
+        # package: a second PartitionNemesis' staggered stop-partition
+        # would heal mid-reconfig, and its start events would flip the
+        # combo's on_update window state
+        pkgs.append(partition_package(opts))
+    if combo_name != "clock-rate-during-reconfig":
+        crpkg = clock_rate_package(opts)
+        if "clock-rate" in faults and crpkg is None:
+            raise ValueError(
+                "fault 'clock-rate' requested but no clock_rate_binary "
+                "is wired (this suite may not support the clock-rate "
+                "fault class)")
+        pkgs.append(crpkg)
     registry = opts.get("fault_packages") or {}
     for name in sorted(set(opts.get("faults") or []) & set(registry)):
         pkgs.append(registry[name](opts))

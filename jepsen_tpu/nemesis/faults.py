@@ -49,17 +49,39 @@ FAULTS_NAME = "faults.jsonl"
 
 # Heal-action dispatch groups. "file" faults (truncate-file, bitflip)
 # have no inverse — they're recorded so a recovery knows the damage
-# exists, and reported as unhealable.
-KINDS = ("net", "netem", "clock", "process", "pause", "file")
+# exists, and reported as unhealable. "membership" faults are cluster
+# reconfigurations: recorded with the PRE-op member set before firing,
+# marked healed once the membership State resolves the op, and — when a
+# crash strands an unresolved reconfig — replayed by restoring the
+# recorded pre-op member set (nemesis/membership.py heal_record).
+# "clock-rate" faults are libfaketime per-node clock-rate windows
+# (faketime.py): the record carries the wrapped binary so an offline
+# heal can unwrap it.
+KINDS = ("net", "netem", "clock", "clock-rate", "process", "pause",
+         "file", "membership")
 
 # What a successful nemesis teardown restores ("resumes normal
 # operation", nemesis.clj contract): everything EXCEPT file damage,
-# which no teardown can undo — those entries stay on the books.
-TEARDOWN_HEALS = ("net", "netem", "clock", "process", "pause")
+# which no teardown can undo — those entries stay on the books — and
+# membership reconfigurations: State.teardown stops the view polling,
+# it does NOT restore the pre-op member set, so an unresolved reconfig
+# must survive teardown for the crash-path / `cli heal` replay.
+TEARDOWN_HEALS = ("net", "netem", "clock", "clock-rate", "process",
+                  "pause")
 
 # Kinds with no heal action at all — recorded as evidence, reported as
 # unhealable, and not worth a crash-path replay warning on their own.
 UNHEALABLE_KINDS = ("file",)
+
+# Kinds the interpreter's GENERIC pre-fire snapshot must never record:
+# a membership record is only actionable with the pre-op member set and
+# a heal spec, which only a self-recording nemesis
+# (``Nemesis.self_recorded_kinds``, e.g. MembershipNemesis) can supply.
+# A generic row would be permanently-unhealed noise — and several
+# pre-existing suites (faunadb topology's add-node/remove-node,
+# rethinkdb's reconfigure) legitimately use membership-flavored ``:f``
+# names with plain nemeses that keep no model at all.
+SELF_RECORDED_ONLY = ("membership",)
 
 
 def classify(f) -> tuple[str | None, str | None]:
@@ -88,6 +110,21 @@ def classify(f) -> tuple[str | None, str | None]:
         "pause": ("begin", "pause"), "resume": ("end", "pause"),
         "start-pause": ("begin", "pause"), "stop-pause": ("end", "pause"),
         "truncate-file": ("begin", "file"), "bitflip": ("begin", "file"),
+        # membership reconfigurations (nemesis/membership.py): each op
+        # is a one-shot state transition, not a begin/end window pair —
+        # it opens as "begin" and is healed by RESOLUTION (the State
+        # observing the cluster converge), never by a closing op
+        "grow": ("begin", "membership"), "shrink": ("begin", "membership"),
+        "join": ("begin", "membership"), "leave": ("begin", "membership"),
+        "add-node": ("begin", "membership"),
+        "remove-node": ("begin", "membership"),
+        "rolling-restart": ("begin", "membership"),
+        "reconfigure": ("begin", "membership"),
+        # libfaketime clock-rate windows (faketime.py); the explicit
+        # rows document the pair — the start-/stop- prefix fallback
+        # below would classify them identically
+        "start-clock-rate": ("begin", "clock-rate"),
+        "stop-clock-rate": ("end", "clock-rate"),
     }
     if n in table:
         return table[n]
@@ -389,6 +426,42 @@ def _heal_file(test: dict) -> None:
                      "the db setup cycle must rebuild the node")
 
 
+def _heal_membership(test: dict, rows: list[dict]) -> None:
+    """Restores each unresolved reconfiguration's recorded pre-op member
+    set (nemesis/membership.py heal_record dispatches on the record's
+    serialized heal spec, so this works offline from ``cli heal``).
+    Rows are applied newest-first so the OLDEST unresolved record's
+    pre-op set — the member set before the first stranded reconfig —
+    is what the cluster ends on."""
+    from jepsen_tpu.nemesis import membership as membership_mod
+    for row in sorted(rows, key=lambda r: r.get("id", 0), reverse=True):
+        membership_mod.heal_record(test, row)
+
+
+def _heal_clock_rate(test: dict, rows: list[dict]) -> None:
+    """Unwraps every libfaketime-wrapped binary the records name
+    (idempotent: faketime.unwrap is a no-op once the .real binary is
+    back in place). The binary path rides in the record value because
+    an offline heal has no nemesis object to ask."""
+    from jepsen_tpu import control, faketime
+    from jepsen_tpu.utils import real_pmap
+    binaries: dict[str, set] = {}
+    for row in rows:
+        v = row.get("value") if isinstance(row.get("value"), dict) else {}
+        binary = v.get("binary")
+        if not binary:
+            raise Unhealable(
+                "clock-rate record names no binary path; unwrap the "
+                "faketime-wrapped binaries manually")
+        nodes = list(v.get("rates") or ()) or list(test.get("nodes") or [])
+        binaries.setdefault(binary, set()).update(nodes)
+    for binary, nodes in sorted(binaries.items()):
+        real_pmap(
+            lambda node, b=binary: control.on(
+                node, test, lambda: faketime.unwrap(b)),
+            sorted(nodes))
+
+
 HEALERS = {
     "net": _heal_net,
     "netem": _heal_netem,
@@ -396,6 +469,15 @@ HEALERS = {
     "process": _heal_process,
     "pause": _heal_pause,
     "file": _heal_file,
+}
+
+# Kinds whose heal depends on WHAT was recorded, not just that
+# something of the kind happened: these healers receive the unhealed
+# rows (pre-op member sets, wrapped-binary paths) and take precedence
+# over the kind-wide HEALERS dispatch in replay_unhealed.
+ROW_HEALERS = {
+    "membership": _heal_membership,
+    "clock-rate": _heal_clock_rate,
 }
 
 
@@ -421,13 +503,19 @@ def replay_unhealed(test: dict, registry: FaultRegistry,
         by_kind.setdefault(str(row.get("kind")), []).append(row)
     reg = telemetry.get_registry()
     for kind in sorted(by_kind):
-        ids = [r["id"] for r in by_kind[kind]]
+        rows = by_kind[kind]
+        ids = [r["id"] for r in rows]
+        row_healer = ROW_HEALERS.get(kind)
         healer = HEALERS.get(kind)
         try:
-            if healer is None:
+            if row_healer is not None:
+                action = lambda: row_healer(test, rows)  # noqa: E731
+            elif healer is not None:
+                action = lambda: healer(test)  # noqa: E731
+            else:
                 raise Unhealable(f"no healer registered for kind {kind!r}")
             # Unhealable is a terminal verdict, not a flake: no backoff
-            retry_with_backoff(lambda: healer(test), tries=tries, rng=rng,
+            retry_with_backoff(action, tries=tries, rng=rng,
                                desc=f"heal {kind}", no_retry=(Unhealable,))
         except Unhealable as e:
             logger.warning("faults %s (kind %s) left unhealed: %s",
@@ -445,4 +533,9 @@ def replay_unhealed(test: dict, registry: FaultRegistry,
             reg.counter("nemesis_heal_replayed_total",
                         "fault heals applied by crash-path/cli replay",
                         labels=("kind",)).inc(len(ids), kind=kind)
+            if kind == "membership":
+                reg.counter("nemesis_membership_replayed_heals_total",
+                            "stranded reconfigurations restored to their "
+                            "recorded pre-op member set by replay"
+                            ).inc(len(ids))
     return out

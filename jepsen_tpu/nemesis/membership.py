@@ -6,25 +6,63 @@ view threads poll every ``NODE_VIEW_INTERVAL`` seconds and merge into a
 resolved view; ops are generated from the current view, applied via the
 State, and completed once the State considers them resolved (fixed-point
 resolve loop, membership.clj:95-107,159-210).
+
+Crash safety (doc/robustness.md "Membership and clock-rate faults"): a
+reconfiguration is the one fault whose *un-heal* requires remembering
+what the cluster looked like. Every membership op is therefore recorded
+to the durable fault registry BEFORE it fires — pre-op member set,
+affected op, and a serialized *heal spec* — and marked healed only once
+the State resolves the op. An op stranded by a SIGKILL (or one whose
+invoke outlived its per-op deadline) stays on the books, and the
+crash-path replay / ``cli heal`` restores the recorded pre-op member
+set through :func:`heal_record`, idempotently.
+
+Threading discipline: ONE lock (``self._lock``) guards ``state`` /
+``_pending`` / ``_views``. ``merge_views`` / ``resolve`` /
+``resolve_op`` / ``op`` are *model* logic — pure-ish, non-blocking —
+and run under the lock (they are called from both the interpreter
+scheduler thread, via the generator, and the nemesis worker thread).
+``node_view`` and ``invoke`` do real cluster I/O and run OUTSIDE the
+lock: a hung ``invoke`` is reaped by the interpreter's per-op deadline
+(the worker zombifies, the registry entry stays unhealed for replay),
+and a hung ``node_view`` only wedges its own poll thread, which
+teardown abandons after a bounded wait.
 """
 from __future__ import annotations
 
 import logging
 import threading
 import time as _time
-from typing import Any
+from importlib import import_module
 
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu import telemetry
 from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.utils import join_noisy
 
 logger = logging.getLogger("jepsen.nemesis.membership")
 
 NODE_VIEW_INTERVAL = 5.0  # seconds (membership.clj:59-61)
+
+# Fixed-point bound: a cyclic resolve_op (state A resolves to B resolves
+# back to A) must not spin the resolve pass forever — the loop stops
+# here and counts the cap (nemesis_membership_resolve_capped_total).
+MAX_RESOLVE_ITERS = 32
+
+# Teardown bound per poll thread: a node_view stuck in remote I/O is
+# abandoned (daemon thread) rather than holding teardown hostage.
+TEARDOWN_JOIN_S = 10.0
 
 
 class State:
     """Membership model protocol (membership/state.clj). Implementations
     are free-form records over {"view": ..., "pending": [...]}-style
     state; all methods return a new State (pure) except invoke/teardown.
+
+    Concurrency contract: ``merge_views``/``resolve``/``resolve_op``/
+    ``op`` run under the nemesis lock and must be non-blocking model
+    logic; ``node_view``/``invoke`` may do cluster I/O and run unlocked
+    (possibly concurrently with each other, like Client methods).
     """
 
     def node_view(self, test: dict, node: str):
@@ -53,7 +91,7 @@ class State:
         """A chance to update internal state; returns new State."""
         return self
 
-    def resolve_op(self, test: dict, pending_pair):
+    def resolve_op(self, test, pending_pair):
         """(op, completion-value) -> None if still pending, else new
         State with the op resolved."""
         return None
@@ -61,32 +99,187 @@ class State:
     def teardown(self, test: dict) -> None:
         pass
 
+    # -- crash-safety surface (durable fault registry) -------------------
+
+    def members(self):
+        """Snapshot of the current member set — recorded as the PRE-op
+        set in the durable fault registry before each reconfiguration.
+        None = unknown (the record carries no restorable set)."""
+        return None
+
+    def heal_spec(self, test: dict):
+        """A JSON-serializable descriptor for restoring a recorded
+        pre-op member set OFFLINE (``cli heal`` has no live State):
+
+        * ``{"mechanism": "file", "path": ...}`` — the member set lives
+          in a JSON file; :func:`restore_members_file` rewrites it.
+        * ``{"mechanism": "import", "module": ..., "fn": ...}`` — the
+          named ``fn(test, row)`` restores the set (e.g. the etcd
+          suite's member-API healer).
+
+        None = membership reconfigurations are unhealable offline
+        (preflight flags the package with NEM005)."""
+        return None
+
+
+HEAL_MECHANISMS = ("file", "import")
+
+
+class _Pending:
+    """One in-flight reconfiguration: the op, its completion value, the
+    durable registry id recorded before it fired, and whether the
+    invoke outlived its deadline (``no_heal`` — resolution must then
+    leave the entry on the books, mirroring the PR-4 late-heal rule)."""
+
+    __slots__ = ("op", "value", "fault_id", "no_heal")
+
+    def __init__(self, op, value, fault_id, no_heal):
+        self.op, self.value = op, value
+        self.fault_id, self.no_heal = fault_id, no_heal
+
+
+class PollingGen(gen_mod.Generator):
+    """Polls ``fn(test, ctx)`` for the next op each time the interpreter
+    asks; PENDING (not exhausted) while fn returns None. Unlike
+    ``gen.Fn`` — whose None means *exhausted* — a membership generator
+    must stay alive through quiet periods where the State has nothing
+    to propose. Inherently stateful (the fn consults live nemesis
+    state), so preflight enumeration skips it with GEN005.
+
+    Schedule subtleties, learned the hard way against the interpreter's
+    actual polling contract (re-polls before dispatch, first-candidate
+    tie-break in ``soonest_op_map``):
+
+    * The emitted op's time is LATCHED to when it first became
+      available (``_ready_at``). Re-stamped ``ctx.time`` each poll, the
+      op would forever TIE with the client generators' now-stamped ops
+      and starve; latched, it goes strictly sooner as the run's clock
+      advances and wins. (The interpreter re-stamps the real dispatch
+      time, so history ordering is untouched.) For the same reason the
+      pacing lives HERE and not in a ``gen.stagger`` wrapper: stagger's
+      ``max(op_time, next_time)`` re-stamps an undispatched op back to
+      "now" on every poll — its state only advances on dispatch — which
+      reintroduces the tie.
+
+    * Dispatch is detected through the generator UPDATE protocol, not
+      by guessing from fn's next answer: an offered op may sit through
+      many re-polls (busy nemesis thread, lost tie) before dispatching,
+      or never dispatch at all. ``update`` sees the dispatched op
+      (matched by ``:f`` on the nemesis thread), re-arms the pacing
+      interval (uniform 0..2·``interval``), resets the latch, and calls
+      the optional ``on_update(event)`` hook — how the during-reconfig
+      combos flip their window state only for edges that actually
+      landed."""
+
+    def __init__(self, fn, interval: float = 0.0, on_update=None):
+        self.fn = fn
+        self.interval_nanos = gen_mod.secs_to_nanos(interval)
+        self.on_update = on_update
+        self._ready_at = None
+        self._not_before = None
+        self._offered = None  # (f, value) of the op awaiting dispatch
+
+    def op(self, test, ctx):
+        if self._not_before is not None and ctx.time < self._not_before:
+            return (gen_mod.PENDING, self)  # pacing window
+        x = self.fn(test, ctx)
+        if x is None:
+            self._ready_at = None
+            self._offered = None
+            return (gen_mod.PENDING, self)
+        op = gen_mod.fill_in_op(dict(x), ctx)
+        if op is gen_mod.PENDING:
+            return (gen_mod.PENDING, self)
+        if self._ready_at is None or self._ready_at > op["time"]:
+            self._ready_at = op["time"]
+        op["time"] = self._ready_at
+        self._offered = (op.get("f"), op.get("value"))
+        return (op, self)
+
+    def update(self, test, ctx, event):
+        if self.on_update is not None:
+            try:
+                self.on_update(event)
+            except Exception:  # noqa: BLE001 — a broken hook can't stall ops
+                logger.exception("PollingGen on_update hook failed")
+        # match on (f, value), not f alone: nemesis events arrive twice
+        # per op (dispatch carries the op's value verbatim, the
+        # completion a rewritten value) — a PREVIOUS dispatch's
+        # completion must not pass for a dispatch of the current offer
+        # and spuriously burn a pacing window
+        if self._offered is not None \
+                and event.get("process") == gen_mod.NEMESIS \
+                and (event.get("f"), event.get("value")) == self._offered:
+            # our offered op actually dispatched: unlatch and pace
+            self._offered = None
+            self._ready_at = None
+            if self.interval_nanos:
+                self._not_before = ctx.time + int(
+                    ctx.rng.random() * 2 * self.interval_nanos)
+        return self
+
 
 class MembershipNemesis(Nemesis):
     """(membership.clj:159-210)"""
 
-    def __init__(self, state: State, poll_interval: float = NODE_VIEW_INTERVAL):
+    def __init__(self, state: State, poll_interval: float = NODE_VIEW_INTERVAL,
+                 max_resolve_iters: int = MAX_RESOLVE_ITERS,
+                 teardown_join_s: float = TEARDOWN_JOIN_S):
         self.state = state
         self.poll_interval = poll_interval
+        self.max_resolve_iters = max_resolve_iters
+        self.teardown_join_s = teardown_join_s
         self._lock = threading.Lock()
         self._views: dict = {}
+        self._view_at: dict = {}   # node -> monotonic time of last good view
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._pending: list = []
+        self._pending: list[_Pending] = []
+        self._last_resolve = 0.0
 
     def fs(self):
-        return self.state.fs()
+        with self._lock:
+            state = self.state
+        return state.fs()
+
+    def self_recorded_kinds(self):
+        # richer records than the interpreter's generic snapshot: the
+        # pre-op member set + heal spec, heal-marked at RESOLUTION
+        return {"membership"}
+
+    def pending_count(self) -> int:
+        """In-flight (unresolved) reconfigurations — the model-aware
+        combined generators key fault windows off this."""
+        with self._lock:
+            return len(self._pending)
 
     # -- node view polling (membership.clj:143-157) ---------------------
-    def _poll_node(self, test, node):
+    def _poll_node(self, test, node):  # owner: worker
         while not self._stop.is_set():
+            with self._lock:
+                state = self.state
             try:
-                view = self.state.node_view(test, node)
+                view = state.node_view(test, node)
+                now = _time.monotonic()
                 with self._lock:
                     self._views[node] = view
+                    self._view_at[node] = now
+                self._staleness(node, 0.0)
             except Exception as e:  # noqa: BLE001
                 logger.debug("node view %s failed: %r", node, e)
+                with self._lock:
+                    last = self._view_at.get(node)
+                if last is not None:
+                    self._staleness(node, _time.monotonic() - last)
             self._stop.wait(self.poll_interval)
+
+    @staticmethod
+    def _staleness(node, seconds: float) -> None:
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.gauge("nemesis_membership_view_staleness_seconds",
+                      "age of each node's last successful membership view",
+                      labels=("node",)).set(seconds, node=str(node))
 
     def setup(self, test):
         for node in test.get("nodes") or []:
@@ -98,68 +291,291 @@ class MembershipNemesis(Nemesis):
         return self
 
     # -- resolution fixed point (membership.clj:95-107) ------------------
-    def _resolve(self, test):
+    def _resolve(self, test):  # owner: any
+        """One merge + bounded fixed-point resolution pass. Runs on both
+        the interpreter scheduler thread (via the generator) and the
+        nemesis worker thread — the whole pass holds ``self._lock``,
+        which is why the State's model methods must not block."""
+        resolved: list[_Pending] = []
         with self._lock:
+            self._last_resolve = _time.monotonic()
             views = dict(self._views)
-        state = self.state
-        try:
-            state = state.merge_views(test, views) or state
-        except Exception as e:  # noqa: BLE001
-            logger.debug("merge_views failed: %r", e)
-        changed = True
-        while changed:
-            changed = False
-            state = state.resolve(test) or state
-            still = []
-            for pair in self._pending:
-                nxt = state.resolve_op(test, pair)
-                if nxt is None:
-                    still.append(pair)
-                else:
-                    state = nxt
-                    changed = True
-            self._pending = still
-        self.state = state
+            state = self.state
+            try:
+                state = state.merge_views(test, views) or state
+            except Exception as e:  # noqa: BLE001
+                logger.debug("merge_views failed: %r", e)
+            iters = 0
+            changed = True
+            while changed and iters < self.max_resolve_iters:
+                iters += 1
+                changed = False
+                state = state.resolve(test) or state
+                still = []
+                for pend in self._pending:
+                    nxt = state.resolve_op(test, (pend.op, pend.value))
+                    if nxt is None:
+                        still.append(pend)
+                    else:
+                        state = nxt
+                        resolved.append(pend)
+                        changed = True
+                self._pending = still
+            capped = changed  # the bound fired while still converging
+            self.state = state
+        for pend in resolved:
+            self._on_resolved(test, pend)
+        reg = telemetry.get_registry()
+        if capped:
+            logger.warning("membership resolve fixed point capped at %d "
+                           "iteration(s); is resolve_op cyclic?", iters)
+            if reg.enabled:
+                reg.counter("nemesis_membership_resolve_capped_total",
+                            "resolve passes stopped by the fixed-point "
+                            "iteration bound").inc()
+        if reg.enabled and resolved:
+            counter = reg.counter("nemesis_membership_resolves_total",
+                                  "membership ops resolved by the State",
+                                  labels=("f",))
+            for pend in resolved:
+                counter.inc(f=str(pend.op.get("f")))
 
-    def invoke(self, test, op):
+    def maybe_resolve(self, test, min_gap_s: float | None = None) -> None:
+        # owner: any
+        """Rate-limited :meth:`_resolve` for hot-path callers — the
+        generator polls once per scheduler iteration (thousands/s on a
+        busy run), but resolution granularity is already bounded by the
+        view-poll cadence, so a pass within ``min_gap_s`` (default
+        half the poll interval, capped at 1 s) is skipped."""
+        gap = min_gap_s if min_gap_s is not None \
+            else min(self.poll_interval / 2.0, 1.0)
+        if _time.monotonic() - self._last_resolve < gap:
+            return
         self._resolve(test)
+
+    def _on_resolved(self, test, pend: _Pending) -> None:  # owner: any
+        """Registry bookkeeping for a resolved op: mark its durable
+        entry healed — the cluster verifiably converged to the post-op
+        configuration — UNLESS the invoke outlived its deadline, in
+        which case the entry stays for the replay (the run already
+        treats the op as indeterminate)."""
+        faults = test.get("_faults")
+        if faults is None or pend.fault_id is None:
+            return
+        if pend.no_heal:
+            logger.warning(
+                "membership op %r resolved after its deadline; leaving "
+                "registry entry %d unhealed for replay",
+                pend.op.get("f"), pend.fault_id)
+            return
         try:
-            value = self.state.invoke(test, op)
-        except Exception as e:  # noqa: BLE001
-            return {**op, "type": "info", "value": ["error", repr(e)]}
-        self._pending.append((op, value))
+            faults.mark_healed(fault_id=pend.fault_id, via="resolve")
+        except Exception:  # noqa: BLE001
+            logger.exception("membership heal-mark failed")
+
+    def invoke(self, test, op):  # owner: worker
         self._resolve(test)
+        with self._lock:
+            state = self.state
+        fault_id = self._record(test, state, op)
+        try:
+            value = state.invoke(test, op)
+        except Exception as e:  # noqa: BLE001
+            # indeterminate reconfig: the registry entry stays unhealed,
+            # so the crash-path replay / `cli heal` restores the
+            # recorded pre-op member set
+            return {**op, "type": "info", "value": ["error", repr(e)]}
+        from jepsen_tpu.generator.interpreter import current_op_reaped
+        reaped = current_op_reaped()
+        with self._lock:
+            self._pending.append(_Pending(op, value, fault_id, reaped))
+        self._resolve(test)
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("nemesis_membership_ops_total",
+                        "membership reconfiguration ops applied",
+                        labels=("f",)).inc(f=str(op.get("f")))
         return {**op, "type": "info", "value": value}
 
-    def teardown(self, test):
+    @staticmethod
+    def _record(test, state: State, op) -> int | None:  # owner: worker
+        """Durably records the reconfiguration BEFORE it fires: the
+        pre-op member set and the heal spec are exactly what a recovery
+        needs when the control process dies mid-reconfig."""
+        faults = test.get("_faults")
+        if faults is None:
+            return None
+        try:
+            pre = state.members()
+            record = {"f": op.get("f"), "value": op.get("value"),
+                      "pre_members": (sorted(pre, key=str)
+                                      if pre is not None else None),
+                      "heal": state.heal_spec(test)}
+            return faults.record("membership", f=op.get("f"), value=record)
+        except Exception:  # noqa: BLE001 — never blocks the reconfig
+            logger.exception("membership fault record failed")
+            return None
+
+    def teardown(self, test):  # owner: scheduler
         self._stop.set()
+        reg = telemetry.get_registry()
         for t in self._threads:
-            t.join(timeout=1.0)
-        self.state.teardown(test)
+            if not join_noisy(t, f"membership view poll {t.name}",
+                              heartbeat_s=2.0,
+                              max_wait_s=self.teardown_join_s):
+                # daemon thread stuck in node_view I/O: abandon it —
+                # teardown must never wedge on a dead node
+                if reg.enabled:
+                    reg.counter("nemesis_membership_poll_abandoned_total",
+                                "view poll threads abandoned at teardown "
+                                "(node_view hung past the join bound)"
+                                ).inc()
+        with self._lock:
+            state = self.state
+        state.teardown(test)
+
+    # -- preflight (doc/static-analysis.md NEM004/NEM005) ----------------
+    def preflight_diags(self, test) -> list:  # owner: scheduler
+        """Static package validation, called by preflight's nemesis walk
+        — no node contact. Checks the State surface, the poll/resolve
+        knobs, and offline healability."""
+        from jepsen_tpu.analysis.diagnostics import ERROR, Diagnostic
+        out: list = []
+        try:
+            fs = set(self.state.fs() or ())
+        except Exception as e:  # noqa: BLE001
+            fs = None
+            out.append(Diagnostic(
+                "NEM004", ERROR, "nemesis",
+                f"membership State.fs() raised: {e!r}"))
+        if fs is not None and not fs:
+            out.append(Diagnostic(
+                "NEM004", ERROR, "nemesis",
+                "membership State declares an empty op surface; the "
+                "package can never emit an op",
+                hint="return the op :f values the State performs from "
+                     "State.fs()"))
+        for name, v, lo in (("poll_interval", self.poll_interval, 0.0),
+                            ("max_resolve_iters", self.max_resolve_iters,
+                             1),
+                            ("teardown_join_s", self.teardown_join_s,
+                             0.0)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v < lo:
+                out.append(Diagnostic(
+                    "NEM004", ERROR, "nemesis",
+                    f"membership package knob {name}={v!r} is not a "
+                    f"number >= {lo!r}"))
+        try:
+            spec = self.state.heal_spec(test)
+        except Exception as e:  # noqa: BLE001
+            spec = None
+            out.append(Diagnostic(
+                "NEM004", ERROR, "nemesis",
+                f"membership State.heal_spec() raised: {e!r}"))
+        if spec is None:
+            out.append(Diagnostic(
+                "NEM005", ERROR, "nemesis",
+                "membership reconfigurations would be unhealable: the "
+                "State declares no heal spec, so a crash mid-reconfig "
+                "strands the cluster with no offline restore",
+                hint="return a {'mechanism': 'file'|'import', ...} spec "
+                     "from State.heal_spec(), or add 'NEM005' to "
+                     "preflight_allow if that is deliberate"))
+        elif not isinstance(spec, dict) \
+                or spec.get("mechanism") not in HEAL_MECHANISMS:
+            out.append(Diagnostic(
+                "NEM005", ERROR, "nemesis",
+                f"membership heal spec {spec!r} names no known "
+                f"mechanism {HEAL_MECHANISMS}; `cli heal` could not "
+                "restore a stranded reconfiguration",
+                hint="use {'mechanism': 'file', 'path': ...} or "
+                     "{'mechanism': 'import', 'module': ..., 'fn': ...}"))
+        return out
 
 
 def membership_gen(nemesis: MembershipNemesis):
-    """Generator polling the State for its next op (membership.clj:212-222)."""
+    """Generator polling the State for its next op (membership.clj:212-222).
+    Runs on the interpreter thread, concurrently with the nemesis
+    worker's invoke — state access goes through the nemesis lock."""
 
-    def next_op(test, ctx):
-        nemesis._resolve(test)
-        op = nemesis.state.op(test)
+    def next_op(test, ctx):  # owner: scheduler
+        nemesis.maybe_resolve(test)
+        with nemesis._lock:
+            state = nemesis.state
+        op = state.op(test)
         if op == "pending" or op is None:
             return None
-        return op
+        return dict(op)
 
     return next_op
 
 
 def package(state: State, interval: float = 10.0,
             poll_interval: float = NODE_VIEW_INTERVAL) -> dict:
-    """A combined-style package (membership.clj:224-250)."""
-    from jepsen_tpu import generator as gen
+    """A combined-style package (membership.clj:224-250). The generator
+    is a PollingGen with built-in stagger-style pacing: "pending" keeps
+    it alive (PENDING), it never exhausts — and preflight enumeration
+    skips it with GEN005 rather than consuming live nemesis state."""
     n = MembershipNemesis(state, poll_interval=poll_interval)
     return {
         "nemesis": n,
-        "generator": gen.stagger(interval, gen.Fn(membership_gen(n))),
+        "generator": PollingGen(membership_gen(n), interval=interval),
         "final_generator": None,
         "perf": {"name": "membership", "fs": state.fs(),
-                 "start": set(), "stop": set()},
+                 "start": set(state.fs()), "stop": set()},
     }
+
+
+# ---------------------------------------------------------------------------
+# Offline heal: restore a recorded pre-op member set (cli heal / the
+# crash-path replay, dispatched from faults.ROW_HEALERS)
+# ---------------------------------------------------------------------------
+
+def heal_record(test: dict, row: dict) -> None:
+    """Restores ONE membership record's pre-op member set, dispatching
+    on its serialized heal spec. Raises
+    :class:`jepsen_tpu.nemesis.faults.Unhealable` when the record
+    carries no usable spec — wrong bookkeeping is worse than none."""
+    from jepsen_tpu.nemesis.faults import Unhealable
+    v = row.get("value") if isinstance(row.get("value"), dict) else {}
+    spec = v.get("heal")
+    if not isinstance(spec, dict):
+        raise Unhealable(
+            f"membership record {row.get('id')} has no heal spec; the "
+            "cluster's member set must be restored manually")
+    mech = spec.get("mechanism")
+    if mech == "file":
+        restore_members_file(test, row)
+    elif mech == "import":
+        try:
+            mod = import_module(str(spec.get("module")))
+            fn = getattr(mod, str(spec.get("fn")))
+        except (ImportError, AttributeError) as e:
+            raise Unhealable(
+                f"membership heal target {spec.get('module')}:"
+                f"{spec.get('fn')} is not importable: {e}") from e
+        fn(test, row)
+    else:
+        raise Unhealable(
+            f"unknown membership heal mechanism {mech!r} "
+            f"(known: {HEAL_MECHANISMS})")
+
+
+def restore_members_file(test: dict, row: dict) -> None:
+    """The "file" heal mechanism: atomically rewrites the member-set
+    JSON file named by the record's heal spec with the recorded pre-op
+    set (``utils.atomic_write_json`` — the restore must be as durable
+    as the record that demanded it). Idempotent."""
+    from jepsen_tpu.nemesis.faults import Unhealable
+    from jepsen_tpu.utils import atomic_write_json
+    v = row.get("value") if isinstance(row.get("value"), dict) else {}
+    spec = v.get("heal") or {}
+    path = spec.get("path")
+    pre = v.get("pre_members")
+    if not path or pre is None:
+        raise Unhealable(
+            f"membership record {row.get('id')} lacks a members-file "
+            "path or a pre-op member set")
+    atomic_write_json(path, sorted(pre, key=str))
+    logger.info("restored member set %s -> %s", sorted(pre, key=str), path)

@@ -15,6 +15,7 @@ import random
 from typing import Iterable
 
 from jepsen_tpu import control
+from jepsen_tpu import faketime as faketime_mod
 from jepsen_tpu.control import RemoteError
 from jepsen_tpu.control.util import file_exists, mkdir
 from jepsen_tpu.nemesis import Nemesis
@@ -182,3 +183,127 @@ def clock_gen():
     """Mixed reset/bump/strobe stream (time.clj clock-gen)."""
     from jepsen_tpu import generator as gen
     return gen.mix([gen.Fn(reset_gen), gen.Fn(bump_gen), gen.Fn(strobe_gen)])
+
+
+# ---------------------------------------------------------------------------
+# Clock-RATE nemesis: divergent per-node clock rates via libfaketime
+# (faketime.py; the faketime.clj capability). Unlike bump/strobe —
+# which JUMP clocks — a rate factor makes node clocks drift apart
+# continuously for the whole window.
+# ---------------------------------------------------------------------------
+
+class ClockRateNemesis(Nemesis):
+    """Ops:
+      {f: "start-clock-rate", value: {"binary": path, "rates": {node: r}}}
+      {f: "stop-clock-rate",  value: {"binary": path}}
+
+    ``start`` wraps the DB binary on each named node with a libfaketime
+    rate factor (faketime.wrap) and — when the test's db implements
+    Process — restarts the process so the wrapper takes effect; ``stop``
+    unwraps and restarts everywhere. The binary path rides in the OP
+    VALUE so the durable ``clock-rate`` registry record carries it: an
+    offline ``cli heal`` must know which binary to unwrap
+    (faults._heal_clock_rate)."""
+
+    def __init__(self, binary: str, lib: str | None = None,
+                 restart: bool = True):
+        self.binary = binary
+        self.lib = lib
+        self.restart = restart
+
+    def fs(self):
+        return {"start-clock-rate", "stop-clock-rate"}
+
+    def _restart(self, test, node) -> None:
+        from jepsen_tpu import db as db_mod
+        db = test.get("db")
+        if self.restart and isinstance(db, db_mod.Process):
+            db.kill(test, node)
+            db.start(test, node)
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        v = op.get("value") if isinstance(op.get("value"), dict) else {}
+        binary = v.get("binary") or self.binary
+        if f == "start-clock-rate":
+            rates = v.get("rates") or {}
+
+            def start(node):
+                rate = float(rates.get(node, 1.0))
+                control.on(node, test,
+                           lambda: faketime_mod.wrap(binary, rate,
+                                                     lib=self.lib))
+                self._restart(test, node)
+
+            real_pmap(start, sorted(rates))
+            return {**op, "type": "info",
+                    "value": {"binary": binary, "rates": rates}}
+        if f == "stop-clock-rate":
+            def stop(node):
+                control.on(node, test,
+                           lambda: faketime_mod.unwrap(binary))
+                self._restart(test, node)
+
+            nodes = sorted(v.get("rates") or ()) \
+                or list(test.get("nodes") or [])
+            real_pmap(stop, nodes)
+            return {**op, "type": "info",
+                    "value": {"binary": binary, "rates": {}}}
+        return {**op, "type": "info", "value": ["unknown-f", f]}
+
+    def teardown(self, test):
+        def stop(node):
+            control.on(node, test, lambda: faketime_mod.unwrap(self.binary))
+            self._restart(test, node)
+        real_pmap(stop, list(test.get("nodes") or []))
+
+    def preflight_diags(self, test) -> list:
+        """Missing-lib check (doc/static-analysis.md NEM006): with the
+        dummy/local transport the control host IS every node, so a
+        local LIB_PATHS probe is authoritative — a run that would die
+        in ``faketime.install`` mid-nemesis dies here instead, as a
+        structured (``preflight_allow``-downgradeable) diagnostic. Over
+        real SSH the library is per-node and install() is probed at
+        fault time; preflight stays silent rather than guessing."""
+        from jepsen_tpu.analysis.diagnostics import ERROR, Diagnostic
+        out: list = []
+        if not self.binary or not isinstance(self.binary, str):
+            out.append(Diagnostic(
+                "NEM004", ERROR, "nemesis",
+                f"clock-rate nemesis has no binary path ({self.binary!r})"
+                " to wrap"))
+        if self.lib:
+            return out
+        if (test.get("ssh") or {}).get("dummy") \
+                and faketime_mod.local_lib() is None:
+            out.append(Diagnostic(
+                "NEM006", ERROR, "nemesis",
+                "clock-rate faults need libfaketime, and no distro "
+                "library exists at any known path "
+                "(jepsen_tpu.faketime.LIB_PATHS)",
+                hint="install the faketime package, pass an explicit "
+                     "lib= path, or add 'NEM006' to preflight_allow to "
+                     "let the run try an on-node install"))
+        return out
+
+
+def clock_rate_nemesis(binary: str, lib: str | None = None,
+                       restart: bool = True) -> Nemesis:
+    return ClockRateNemesis(binary, lib=lib, restart=restart)
+
+
+def clock_rate_gen(binary: str, spread: float = 0.02):
+    """Start-op generator: a random node subset gets random rate factors
+    near 1 (faketime.clj:57-65 rand-factor). Pure over ctx.rng, so
+    preflight can enumerate it."""
+
+    def gen_fn(test, ctx):
+        nodes = list(test.get("nodes") or [])
+        subset = ctx.rng.sample(nodes, ctx.rng.randint(1, len(nodes))) \
+            if nodes else []
+        rates = {n: round(1.0 + ctx.rng.uniform(-spread, spread), 4)
+                 for n in subset}
+        return {"type": "info", "f": "start-clock-rate",
+                "value": {"binary": binary, "rates": rates}}
+
+    return gen_fn

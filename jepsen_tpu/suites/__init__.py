@@ -73,6 +73,7 @@ def build_suite_test(o: dict | None, *, db_name: str,
                      fake_client: Callable | None = None,
                      fake_db: Callable | None = None,
                      fault_packages: dict | None = None,
+                     nemesis_opts: Callable | dict | None = None,
                      defaults: dict | None = None) -> dict:
     """The standard suite test-map constructor shared by every DB suite.
 
@@ -88,6 +89,10 @@ def build_suite_test(o: dict | None, *, db_name: str,
     concurrency/time_limit/nemesis_interval. Fault classes come from
     ``o["faults"]`` (default: partition on real clusters, none in fake
     mode) and are assembled by the combined nemesis packages.
+    ``nemesis_opts`` — a dict, or ``fn(o, base) -> dict`` — merges extra
+    keys into the combined-package opts (membership_state_fn,
+    clock_rate_binary, ...), so suites can offer the membership and
+    clock-rate fault classes.
     """
     from jepsen_tpu.nemesis import combined
 
@@ -148,11 +153,14 @@ def build_suite_test(o: dict | None, *, db_name: str,
     if faults is None:
         faults = set() if fake else {"partition"}
     if faults:
+        extra_nem = (nemesis_opts(o, base) if callable(nemesis_opts)
+                     else dict(nemesis_opts or {}))
         nemesis_pkg = combined.nemesis_package({
             "db": base["db"], "faults": set(faults),
             "fault_packages": fault_packages,
             "interval": o.get("nemesis_interval",
-                              d.get("nemesis_interval", 10.0))})
+                              d.get("nemesis_interval", 10.0)),
+            **extra_nem})
     return compose_test(base, workload, nemesis_pkg)
 
 

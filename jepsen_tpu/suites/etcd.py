@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -219,7 +220,246 @@ class EtcdClient(Client):
         pass
 
 
+# ---------------------------------------------------------------------------
+# Membership: grow/shrink/rolling-restart via the v2 members API
+# (nemesis/membership.py State protocol; doc/robustness.md)
+# ---------------------------------------------------------------------------
+
+def _members_request(node: str, method: str = "GET",
+                     body: dict | None = None,
+                     member_id: str | None = None,
+                     timeout_s: float = 5.0) -> dict:
+    """One v2 members-API call against ``node``. Module-level so tests
+    (and only tests) can stub the transport without a cluster."""
+    url = f"{node_url(node, CLIENT_PORT)}/v2/members"
+    if member_id:
+        url += f"/{urllib.parse.quote(member_id)}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        raw = resp.read().decode()
+        return json.loads(raw) if raw.strip() else {}
+
+
+def _live_members(test: dict) -> tuple[str, list[dict]]:
+    """(queried-node, member rows) from the first reachable node."""
+    last: Exception | None = None
+    for node in test.get("nodes") or []:
+        try:
+            doc = _members_request(node)
+            return node, list(doc.get("members") or [])
+        except Exception as e:  # noqa: BLE001 — try the next node
+            last = e
+    raise RuntimeError(f"no node answered the members API: {last!r}")
+
+
+def restore_members(test: dict, row: dict) -> None:
+    """The etcd membership heal target (``{"mechanism": "import"}`` —
+    dispatched by nemesis/membership.heal_record, including offline from
+    ``cli heal``): diffs the live member set against the record's
+    pre-op set, re-adds removed members and removes half-added ones.
+    Idempotent: a member already present answers 409 on add, already
+    gone answers 404 on delete — both fine."""
+    v = row.get("value") if isinstance(row.get("value"), dict) else {}
+    pre = v.get("pre_members")
+    if pre is None:
+        from jepsen_tpu.nemesis.faults import Unhealable
+        raise Unhealable(
+            f"membership record {row.get('id')} carries no pre-op "
+            "member set")
+    via, members = _live_members(test)
+    current = {m.get("name"): m for m in members if m.get("name")}
+    for name in sorted(set(pre) - set(current)):
+        try:
+            _members_request(via, method="POST",
+                            body={"name": name,
+                                  "peerURLs": [node_url(name, PEER_PORT)]})
+            logger.info("membership heal: re-added %s", name)
+        except urllib.error.HTTPError as e:
+            if e.code != 409:  # already a member: the heal is a no-op
+                raise
+    for name in sorted(set(current) - set(pre)):
+        try:
+            _members_request(via, method="DELETE",
+                            member_id=str(current[name].get("id")))
+            logger.info("membership heal: removed half-added %s", name)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # already gone
+                raise
+
+
+class EtcdMembershipState:
+    """Membership State over etcd's members API (nemesis/membership.py
+    protocol): node views poll ``GET /v2/members``, ops add/remove
+    members (plus a rolling restart through the db Process protocol),
+    and an op resolves once every polled view agrees with the post-op
+    member set. ``merge_views``/``op``/``resolve_op`` are pure model
+    logic under the nemesis lock; ``node_view``/``invoke`` do HTTP."""
+
+    def __init__(self, min_members: int | None = None,
+                 timeout_s: float = 5.0):
+        self.min_members = min_members
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._members: set | None = None   # merged authoritative names
+        self._views: dict = {}
+        self._inflight: tuple | None = None
+
+    def fs(self):
+        return {"add-node", "remove-node", "rolling-restart"}
+
+    def heal_spec(self, test):
+        return {"mechanism": "import",
+                "module": "jepsen_tpu.suites.etcd", "fn": "restore_members"}
+
+    def node_view(self, test, node):
+        doc = _members_request(node, timeout_s=self.timeout_s)
+        return sorted(m.get("name") for m in doc.get("members") or ()
+                      if m.get("name"))
+
+    def merge_views(self, test, views):
+        good = {n: v for n, v in views.items() if v}
+        with self._lock:
+            self._views = good
+            if good:
+                # authoritative = the view the most nodes agree on
+                tallies: dict = {}
+                for v in good.values():
+                    tallies[tuple(v)] = tallies.get(tuple(v), 0) + 1
+                best = max(tallies.items(), key=lambda kv: kv[1])[0]
+                self._members = set(best)
+        return self
+
+    def members(self):
+        with self._lock:
+            return set(self._members) if self._members is not None else None
+
+    def op(self, test):
+        from jepsen_tpu.utils import majority
+        all_nodes = list(test.get("nodes") or [])
+        floor = self.min_members or majority(len(all_nodes))
+        with self._lock:
+            if self._inflight is not None or self._members is None:
+                return "pending"
+            absent = sorted(set(all_nodes) - self._members)
+            if absent:
+                return {"type": "info", "f": "add-node", "value": absent[0]}
+            if len(self._members) > floor:
+                return {"type": "info", "f": "remove-node",
+                        "value": sorted(self._members)[-1]}
+        return "pending"
+
+    def invoke(self, test, op):
+        f, node = op.get("f"), op.get("value")
+        if f == "remove-node":
+            via, members = _live_members(test)
+            target = next((m for m in members if m.get("name") == node),
+                          None)
+            if target is None:
+                return ["not-a-member", node]
+            _members_request(via, method="DELETE",
+                            member_id=str(target.get("id")))
+            db = test.get("db")
+            if isinstance(db, db_mod.Process):
+                db.kill(test, node)
+            expect_present = False
+        elif f == "add-node":
+            via, _members = _live_members(test)
+            try:
+                _members_request(
+                    via, method="POST",
+                    body={"name": node,
+                          "peerURLs": [node_url(node, PEER_PORT)]})
+            except urllib.error.HTTPError as e:
+                if e.code != 409:  # already a member
+                    raise
+            db = test.get("db")
+            if isinstance(db, db_mod.Process):
+                db.start(test, node)
+            expect_present = True
+        elif f == "rolling-restart":
+            db = test.get("db")
+            if not isinstance(db, db_mod.Process):
+                return ["no-process-protocol"]
+            with self._lock:
+                members = sorted(self._members or ())
+            for n in members or list(test.get("nodes") or []):
+                db.kill(test, n)
+                db.start(test, n)
+                cu.await_tcp_port(CLIENT_PORT, host=n)
+            expect_present = None
+        else:
+            return ["unknown-f", f]
+        with self._lock:
+            self._inflight = (f, node)
+        return {"action": f, "node": node, "expect_present": expect_present}
+
+    def resolve(self, test):
+        return self
+
+    def resolve_op(self, test, pending_pair):
+        _op, value = pending_pair
+        if not isinstance(value, dict):
+            # definite no-op (unknown member, unsupported f): resolved
+            with self._lock:
+                self._inflight = None
+            return self
+        expect = value.get("expect_present")
+        node = value.get("node")
+        with self._lock:
+            views = dict(self._views)
+            if expect is False:
+                # the removed node's process was killed: its poll only
+                # fails from here on and the nemesis keeps its LAST
+                # GOOD view — which still lists the node itself.
+                # Requiring that view to agree would block resolution
+                # forever; only the surviving members' views count.
+                views.pop(node, None)
+            if not views:
+                return None
+            for view in views.values():
+                present = node in view
+                if expect is not None and present is not expect:
+                    return None
+            if expect is None:  # rolling restart: views just need accord
+                if len({tuple(v) for v in views.values()}) != 1:
+                    return None
+            self._inflight = None
+        return self
+
+    def teardown(self, test):
+        pass
+
+
+def _nemesis_opts(o: dict, base: dict) -> dict:
+    """Membership + clock-rate wiring for the combined packages: fake
+    mode models the cluster as a durable members file under the store
+    dir (SIGKILL-survivable — the chaos lane's heal target); real mode
+    drives the etcd members API. The clock-rate binary is the etcd
+    binary itself."""
+    def state_fn(_pkg_opts):
+        if (base.get("ssh") or {}).get("dummy"):
+            from pathlib import Path
+
+            from jepsen_tpu.fakes import FakeClusterState
+            path = Path(base.get("store_dir", "store")) / \
+                f"{base.get('name', 'etcd')}-members.json"
+            return FakeClusterState(path, nodes=base.get("nodes"),
+                                    settle_s=o.get("membership_settle_s",
+                                                   0.5))
+        return EtcdMembershipState()
+
+    return {"membership_state_fn": state_fn,
+            "clock_rate_binary": f"{DIR}/etcd"}
+
+
 SUPPORTED_WORKLOADS = ("register", "set")
+
+MEMBERSHIP_FAULTS = ("membership", "clock-rate",
+                     "partition-during-reconfig",
+                     "clock-rate-during-reconfig")
 
 
 def etcd_test(opts_dict: dict | None = None) -> dict:
@@ -227,12 +467,14 @@ def etcd_test(opts_dict: dict | None = None) -> dict:
     return build_suite_test(
         opts_dict, db_name="etcd", supported_workloads=SUPPORTED_WORKLOADS,
         make_real=lambda o: {"db": EtcdDB(o.get("version", DEFAULT_VERSION)),
-                             "client": EtcdClient(), "os": Debian()})
+                             "client": EtcdClient(), "os": Debian()},
+        nemesis_opts=_nemesis_opts)
 
 
 main = cli.single_test_cmd(
     standard_test_fn(etcd_test, extra_keys=("version",)),
     standard_opt_fn(SUPPORTED_WORKLOADS,
+                    extra_faults=MEMBERSHIP_FAULTS,
                     extra=lambda p: p.add_argument(
                         "--version", default=DEFAULT_VERSION)),
     name="jepsen-etcd")

@@ -8,12 +8,15 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
 import logging
 import math
+import os
 import random
 import threading
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
 from typing import Any, Callable, Iterable, Sequence
 
 logger = logging.getLogger("jepsen")
@@ -26,6 +29,20 @@ MICROS_PER_SECOND = 1_000_000
 def majority(n: int) -> int:
     """Smallest integer strictly greater than half of n (util.clj:84)."""
     return n // 2 + 1
+
+
+def atomic_write_json(path, value) -> None:
+    """Durable atomic JSON write: tmp file + flush + fsync + rename, so
+    readers never see a torn document and the content survives a crash.
+    Shared by the durable fake cluster's members file and the
+    membership heal's pre-op-set restore (doc/robustness.md)."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(value, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def minority(n: int) -> int:
@@ -117,18 +134,31 @@ JOIN_HEARTBEAT_S = 30.0
 
 
 def join_noisy(thread: threading.Thread, what: str,
-               heartbeat_s: float = JOIN_HEARTBEAT_S) -> None:
+               heartbeat_s: float = JOIN_HEARTBEAT_S,
+               max_wait_s: float | None = None) -> bool:
     """Joins ``thread`` with the same wait-forever semantics as a bare
     ``join()``, but bounded per wait with a heartbeat log — the caller
     (often the orchestrator/scheduler thread) is never wedged SILENTLY,
     and a stuck thread is diagnosable from the log
-    (no-unbounded-block, doc/static-analysis.md)."""
+    (no-unbounded-block, doc/static-analysis.md).
+
+    ``max_wait_s`` bounds the TOTAL wait: once exhausted the thread is
+    left running and False is returned — the wedge-proof-teardown mode
+    (a poll thread stuck in remote I/O must not hold the run's teardown
+    hostage). Returns True when the thread finished."""
     waited = 0.0
     while thread.is_alive():
-        thread.join(timeout=heartbeat_s)
+        if max_wait_s is not None and waited >= max_wait_s:
+            logger.warning("%s still running after %.0fs; abandoning "
+                           "the wait", what, waited)
+            return False
+        step = heartbeat_s if max_wait_s is None \
+            else min(heartbeat_s, max_wait_s - waited)
+        thread.join(timeout=step)
         if thread.is_alive():
-            waited += heartbeat_s
+            waited += step
             logger.warning("%s still running after %.0fs", what, waited)
+    return True
 
 
 def real_pmap(fn: Callable, coll: Sequence) -> list:
@@ -177,11 +207,21 @@ class JepsenTimeout(Exception):
 
 def timeout(ms: float, dflt: Any, fn: Callable[[], Any]) -> Any:
     """Runs fn in a thread; if it doesn't complete within ms, returns dflt
-    (util.clj:370-381). The straggler thread is abandoned (daemon)."""
+    (util.clj:370-381). The straggler thread is abandoned (daemon).
+
+    The caller's interpreter-worker identity rides along: code under a
+    nemesis ``Timeout`` wrapper (or any thread-hopping helper) must
+    still see ``interpreter.current_op_reaped()`` — the membership
+    nemesis keys its leave-the-registry-entry-unhealed rule on it."""
+    from jepsen_tpu.generator.interpreter import (
+        adopt_worker_zombie, current_worker_zombie,
+    )
     result: list = []
     error: list = []
+    zombie = current_worker_zombie()
 
     def run():
+        adopt_worker_zombie(zombie)
         try:
             result.append(fn())
         except BaseException as e:  # noqa: BLE001

@@ -1,0 +1,67 @@
+"""Chaos-test worker: a fake-mode run with a durable membership nemesis,
+for the parent test to SIGKILL mid-`shrink` (tests/test_membership.py).
+
+The FakeClusterState settles reconfigurations only after ``settle_s``
+(600 s here — effectively never), so the shrink fires, lands in the
+durable fault registry with its pre-op member set, shrinks the
+members file, and then stays UNRESOLVED until the parent kills us:
+exactly the stranded-reconfiguration crash the heal replay exists for.
+Client ops grind meanwhile so the write-ahead journal accumulates lines
+the parent can poll for. Usage:
+
+    python membership_worker.py <store-dir> <members-json-path>
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu import core  # noqa: E402
+from jepsen_tpu import generator as gen
+from jepsen_tpu.fakes import AtomClient, AtomDB, FakeClusterState, noop_test
+from jepsen_tpu.nemesis import combined
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class SlowAtomClient(AtomClient):
+    """AtomClient with a per-op delay, so the run is killable mid-case
+    instead of finishing before the parent can aim."""
+
+    def invoke(self, test, op):
+        time.sleep(0.01)
+        return super().invoke(test, op)
+
+
+def main() -> int:
+    store_dir, members_path = sys.argv[1], sys.argv[2]
+    db = AtomDB()
+    state = FakeClusterState(members_path, nodes=NODES, settle_s=600.0)
+    pkg = combined.nemesis_package({
+        "db": None, "faults": {"membership"},
+        "membership_state": state, "interval": 0.2,
+        "membership_poll_interval": 0.05})
+    ops = [{"type": "invoke", "f": "write", "value": 1},
+           {"type": "invoke", "f": "read", "value": None},
+           {"type": "invoke", "f": "cas", "value": [1, 2]},
+           {"type": "invoke", "f": "write", "value": 3}]
+    g = gen.any_gen(
+        gen.clients(gen.limit(50_000, gen.cycle(gen.Seq(ops)))),
+        gen.nemesis_gen(pkg["generator"]),
+    )
+    t = noop_test(db=db, client=SlowAtomClient(db),
+                  nemesis=pkg["nemesis"],
+                  generator=g, store_dir=store_dir,
+                  nodes=list(NODES),
+                  time_limit=600.0,
+                  # fsync every append: the WAL the parent inspects
+                  # after SIGKILL must be fully durable
+                  wal_fsync_interval=0,
+                  metrics_interval=0)
+    core.run(t)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

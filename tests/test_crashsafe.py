@@ -372,6 +372,47 @@ def test_fault_classify():
     assert classify("stop-master") == (None, None)
     assert classify("read") == (None, None)
     assert classify(None) == (None, None)
+    # membership reconfigurations: one-shot "begin" transitions, healed
+    # by State resolution (nemesis/membership.py), never by a close op
+    for f in ("grow", "shrink", "join", "leave", "add-node",
+              "remove-node", "rolling-restart", "reconfigure"):
+        assert classify(f) == ("begin", "membership"), f
+    assert classify("rolling_restart") == ("begin", "membership")
+    # libfaketime clock-rate windows are a proper begin/end pair
+    assert classify("start-clock-rate") == ("begin", "clock-rate")
+    assert classify("stop-clock-rate") == ("end", "clock-rate")
+
+
+def test_teardown_heals_and_unhealable_table_rows():
+    """The PR-9 table extensions: clock-rate is restored by a clean
+    nemesis teardown (unwrap); membership is NOT — State.teardown does
+    not restore the member set, so unresolved reconfigs must stay on
+    the books for replay — and neither is unhealable evidence."""
+    from jepsen_tpu.nemesis.faults import (
+        KINDS, ROW_HEALERS, TEARDOWN_HEALS, UNHEALABLE_KINDS,
+    )
+    assert "membership" in KINDS and "clock-rate" in KINDS
+    assert "clock-rate" in TEARDOWN_HEALS
+    assert "membership" not in TEARDOWN_HEALS
+    assert "membership" not in UNHEALABLE_KINDS
+    assert "clock-rate" not in UNHEALABLE_KINDS
+    # both heal from WHAT was recorded (pre-op set / binary path), not
+    # from a kind-wide cluster action
+    assert set(ROW_HEALERS) == {"membership", "clock-rate"}
+
+
+def test_teardown_marker_skips_membership(tmp_path):
+    """core's teardown heal marker must leave membership entries
+    unhealed: the fake State teardown can't re-join a removed node."""
+    from jepsen_tpu.nemesis.faults import TEARDOWN_HEALS, FaultRegistry
+
+    reg = FaultRegistry(tmp_path / "faults.jsonl")
+    a = reg.record("net", f="start-partition")
+    b = reg.record("membership", f="shrink",
+                   value={"pre_members": ["n1", "n2"]})
+    assert reg.mark_healed(kinds=TEARDOWN_HEALS, via="teardown") == [a]
+    assert [r["id"] for r in reg.unhealed()] == [b]
+    reg.close()
 
 
 def test_fault_registry_roundtrip_and_reopen(tmp_path):
