@@ -36,6 +36,7 @@ RULES = (
     ("no-host-effects-in-jit", rules_jax.no_host_effects_in_jit, None),
     ("donation-reuse", rules_jax.donation_reuse, None),
     ("recompile-hazard", rules_jax.recompile_hazard, None),
+    ("no-host-roundtrip", rules_jax.no_host_roundtrip, None),
     ("thread-owner", None, rules_concurrency.thread_owner),
     ("no-unbounded-block", None, rules_concurrency.no_unbounded_block),
 )

@@ -14,11 +14,20 @@
   loop retraces every iteration, and a ``static_argnums`` position fed
   the loop variable recompiles per call: both turn a compile-once hot
   path into a compile-always cold one.
+* ``no-host-roundtrip`` (JTJ004) — arrays obtained from the history
+  IR's device placement (``device_columns`` / ``shard_leading`` /
+  ``shard_chunked``) are device-resident by contract; pulling them
+  back to host with ``np.asarray``/``np.array``/``jax.device_get`` or
+  ``.tolist()`` inside checker-path code silently re-pays the H2D/D2H
+  tunnel the IR exists to avoid. Waivable per line with
+  ``# lint: ignore[no-host-roundtrip]`` when a host read is the point
+  (e.g. a final verdict gather).
 
-Rules only scan modules that import ``jax`` (or pallas), and only the
-bodies of functions proven jitted: decorated with ``jit`` /
+The jit rules only scan modules that import ``jax`` (or pallas), and
+only the bodies of functions proven jitted: decorated with ``jit`` /
 ``partial(jax.jit, ...)``, wrapped via ``name = jax.jit(fn, ...)``, or
-passed to ``pallas_call``.
+passed to ``pallas_call``. The host-roundtrip rule scans every module
+(device-placement results can flow anywhere).
 """
 from __future__ import annotations
 
@@ -390,4 +399,124 @@ def recompile_hazard(mod: ModuleInfo) -> list[Finding]:
                             hint="make the argument dynamic (traced), "
                                  "or bucket it so the static set stays "
                                  "small"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JTJ004 — device-resident IR arrays round-tripped to host
+# ---------------------------------------------------------------------------
+
+#: calls whose result is device-resident by contract (the history IR's
+#: placement surface and the parallel staging helpers)
+_DEVICE_SOURCES = {"device_columns", "shard_leading", "shard_chunked"}
+
+#: receiver method that materializes on host
+_ROUNDTRIP_METHODS = {"tolist"}
+
+#: np./jax. level functions that materialize on host
+_ROUNDTRIP_FUNCS = {("np", "asarray"), ("np", "array"),
+                    ("numpy", "asarray"), ("numpy", "array"),
+                    ("jax", "device_get")}
+
+
+def _taint_events(func_node) -> list:
+    """(lineno, name, source) for every Assign target in the function,
+    line-ordered. ``source`` is True (bound from a device-source call),
+    ("alias", base_name) (bound from a subscript of another name), or
+    False (any other binding — CLEARS taint: a name rebound to host
+    data must not stay flagged)."""
+    events = []
+    for n in ast.walk(func_node):
+        if not isinstance(n, ast.Assign):
+            continue
+        val = n.value
+        if isinstance(val, ast.Call) \
+                and isinstance(val.func, ast.Attribute) \
+                and val.func.attr in _DEVICE_SOURCES:
+            src = True
+        elif isinstance(val, ast.Call) and isinstance(val.func, ast.Name) \
+                and val.func.id in _DEVICE_SOURCES:
+            src = True
+        elif isinstance(val, ast.Subscript) \
+                and isinstance(val.value, ast.Name):
+            src = ("alias", val.value.id)
+        else:
+            src = False
+        for t in n.targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    events.append((n.lineno, sub.id, src))
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+def _tainted_at(events, line) -> dict[str, int]:
+    """name -> taint lineno for names device-tainted at ``line``,
+    replaying bindings in line order (last binding wins)."""
+    cur: dict[str, int] = {}
+    for ln, nm, src in events:
+        if ln >= line:
+            break
+        if src is True:
+            cur[nm] = ln
+        elif src is False:
+            cur.pop(nm, None)
+        else:  # subscript alias: tainted iff its base currently is
+            if src[1] in cur:
+                cur[nm] = ln
+            else:
+                cur.pop(nm, None)
+    return cur
+
+
+def _mentions(node, names) -> str | None:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+def no_host_roundtrip(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for q, fi in mod.functions.items():
+        if "no-host-roundtrip" in fi.ignores:
+            continue
+        events = _taint_events(fi.node)
+        if not any(src is True for _, _, src in events):
+            continue
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            tainted = _tainted_at(events, call.lineno)
+            if not tainted:
+                continue
+            f = call.func
+            hit = what = None
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _ROUNDTRIP_METHODS:
+                hit = _mentions(f.value, tainted)
+                what = f".{f.attr}()"
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and (f.value.id, f.attr) in _ROUNDTRIP_FUNCS \
+                    and call.args:
+                hit = _mentions(call.args[0], tainted)
+                what = f"{f.value.id}.{f.attr}()"
+            if hit is None:
+                continue
+            if "no-host-roundtrip" in mod.line_ignores(call.lineno):
+                continue
+            out.append(Finding(
+                rule="no-host-roundtrip", code="JTJ004",
+                path=mod.relpath, line=call.lineno,
+                col=call.col_offset + 1, qualname=q,
+                message=(f"{what} on {hit!r} (device-resident: bound "
+                         f"from a device-placement call at line "
+                         f"{tainted[hit]}) round-trips IR arrays back "
+                         "to host inside a checker path"),
+                hint="consume the device arrays in-kernel (shard_map/"
+                     "jit) or keep a host-side copy from before "
+                     "placement; waive with # lint: "
+                     "ignore[no-host-roundtrip] when a host gather is "
+                     "the point"))
     return out

@@ -387,9 +387,11 @@ _NUMERIC_KNOBS = (
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
 # bools and 0/1 pass, yes/no strings warn, garbage errors here instead
-# of silently reading as unset): the sharded-rung switch and the
-# anomaly-forensics switch
-_BOOL_KNOBS = ("checker_sharded", "explain")
+# of silently reading as unset): the sharded-rung switch, the
+# anomaly-forensics switch, and the history-IR switches
+# (doc/performance.md "History IR")
+_BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
+               "ir_stream_from_wal")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 _UNSET = object()
@@ -450,6 +452,13 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
             "explain": "true (the default) derives anomaly forensics on "
                        "invalid verdicts; false skips localization and "
                        "artifacts",
+            "ir_enabled": "true (the default) shares one columnar "
+                          "history IR across all checkers; false "
+                          "restores per-checker encodes (bit-identical)",
+            "ir_stream_from_wal": "true streams the IR build from the "
+                                  "run's WAL as ops complete; false "
+                                  "(the default) encodes at analyze "
+                                  "time",
         }
         out.append(Diagnostic(
             "KNB001", ERROR, key,

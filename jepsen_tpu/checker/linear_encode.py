@@ -14,6 +14,13 @@ jepsen/src/jepsen/checker.clj:185-216):
 
 Values are interned to dense int32 ids (id 0 = None) so the model transition
 is pure integer arithmetic on device.
+
+The encoder bodies live in :mod:`jepsen_tpu.history_ir.views` (the one
+canonical history IR — encode once, every checker a view); this module
+keeps the :class:`EventStream` contract, the batching helper, and thin
+delegates so existing call sites and the per-key ``independent`` lane
+keep working unchanged. Stream <-> column serialization lives with the
+rest of the IR sidecar (:mod:`jepsen_tpu.history_ir.sidecar`).
 """
 from __future__ import annotations
 
@@ -22,7 +29,6 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from jepsen_tpu.history import Intern
-from jepsen_tpu.models import CAS_F_CAS, CAS_F_READ, CAS_F_WRITE
 
 # event kinds
 EV_INVOKE, EV_RETURN, EV_NOOP = 0, 1, 2
@@ -46,179 +52,23 @@ class EventStream:
         return len(self.kind)
 
 
-def encode_register_ops(history: list[dict], intern: Intern | None = None,
+def encode_register_ops(history, intern: Intern | None = None,
                         encode_args=None) -> EventStream:
-    """Encodes a single-register r/w/cas history (the reference tutorial's
-    etcd workload; BASELINE configs 1-3) into an EventStream.
-
-    Op encodings (f, a, b):
-      read v  -> (CAS_F_READ, id(v), 0); a read of None (id 0) matches any state
-      write v -> (CAS_F_WRITE, id(v), 0)
-      cas [u,v] -> (CAS_F_CAS, id(u), id(v))
-
-    ``encode_args(op) -> (f, a, b)`` overrides the per-op encoding (the
-    invoke/completion pairing, slot assignment, and crashed-read handling
-    are model-independent — encode_multi_register_ops reuses them)."""
-    intern = intern or Intern()
-    kinds, slots, fs, as_, bs, idxs = [], [], [], [], [], []
-    open_by_process: dict = {}   # process -> (slot, op)
-    free_slots: list[int] = []
-    next_slot = 0
-    n_ops = 0
-
-    if encode_args is None:
-        def encode_args(op):
-            f, v = op.get("f"), op.get("value")
-            if f == "read":
-                return CAS_F_READ, intern.id(v), 0
-            if f == "write":
-                return CAS_F_WRITE, intern.id(v), 0
-            if f == "cas":
-                u, w = v
-                return CAS_F_CAS, intern.id(u), intern.id(w)
-            raise ValueError(f"unknown register op {f!r}")
-
-    # First pass: pair invokes with completions; find fail pairs and crashed
-    # reads to drop; *complete* invocation values from their returns
-    # (knossos history/complete semantics — a read's definitive value
-    # arrives with its :ok, but the search consumes it at the invoke event).
-    drop = set()
-    open_inv: dict = {}
-    completed_value: dict[int, object] = {}  # invoke idx -> definitive value
-    for i, op in enumerate(history):
-        p, typ = op.get("process"), op.get("type")
-        if not isinstance(p, int) or p < 0:
-            drop.add(i)
-            continue
-        if typ == "invoke":
-            open_inv[p] = i
-        elif typ == "fail":
-            j = open_inv.pop(p, None)
-            if j is not None:
-                drop.add(j)
-            drop.add(i)
-        elif typ == "ok":
-            j = open_inv.pop(p, None)
-            if j is not None and op.get("value") is not None:
-                completed_value[j] = op.get("value")
-        elif typ == "info":
-            j = open_inv.pop(p, None)
-            drop.add(i)  # info completion itself is not an event
-            if j is not None and history[j].get("f") == "read":
-                drop.add(j)  # crashed reads have no effect
-    # ops still open at the end of history (no completion at all) crash too
-    for p, j in open_inv.items():
-        if history[j].get("f") == "read":
-            drop.add(j)
-
-    for i, op in enumerate(history):
-        if i in drop:
-            continue
-        p, typ = op.get("process"), op.get("type")
-        if typ == "invoke":
-            if free_slots:
-                s = free_slots.pop()
-            else:
-                s = next_slot
-                next_slot += 1
-            open_by_process[p] = (s, i)
-            inv = dict(op)
-            if i in completed_value:
-                inv["value"] = completed_value[i]
-            fcode, a, b = encode_args(inv)
-            kinds.append(EV_INVOKE)
-            slots.append(s)
-            fs.append(fcode)
-            as_.append(a)
-            bs.append(b)
-            idxs.append(i)
-            n_ops += 1
-        elif typ == "ok":
-            got = open_by_process.pop(p, None)
-            if got is None:
-                continue
-            s, j = got
-            kinds.append(EV_RETURN)
-            slots.append(s)
-            fs.append(0)
-            as_.append(0)
-            bs.append(0)
-            idxs.append(i)
-            free_slots.append(s)
-        # info: no return event — the crashed op's slot stays occupied
-        # forever, so it may be linearized at any later point or never.
-
-    return EventStream(
-        kind=np.array(kinds, dtype=np.int8),
-        slot=np.array(slots, dtype=np.int32),
-        f=np.array(fs, dtype=np.int32),
-        a=np.array(as_, dtype=np.int32),
-        b=np.array(bs, dtype=np.int32),
-        op_index=np.array(idxs, dtype=np.int32),
-        n_slots=max(next_slot, 1),
-        n_ops=n_ops,
-        intern=intern,
-    )
+    """Encodes a single-register r/w/cas history into an EventStream —
+    see :func:`jepsen_tpu.history_ir.views.encode_register_ops` (the
+    implementation; ``views.register_stream`` memoizes it per-run)."""
+    from jepsen_tpu.history_ir import views
+    return views.encode_register_ops(history, intern=intern,
+                                     encode_args=encode_args)
 
 
-def encode_multi_register_ops(history: list[dict], n_keys: int = 3,
+def encode_multi_register_ops(history, n_keys: int = 3,
                               n_values: int = 5) -> EventStream:
-    """Encodes a multi-register txn history (the multi-key-acid workload,
-    yugabyte/multi_key_acid.clj) for models.multi_register_spec: one op
-    f="txn" whose value is [[f, k, v], ...] packs into base-(2V+2)
-    per-key action digits of ``a`` (see the spec for the layout).
-
-    The packed encoding holds one action per key, which covers the
-    workload's generators exactly (they draw random nonempty *subsets*
-    of the key range, so a txn never touches a key twice); a history
-    with repeated keys in one txn raises ValueError and the checker
-    falls back to the object-model search."""
-    V, K = n_values, n_keys
-    AB = 2 * V + 2
-
-    def encode_args(op):
-        if op.get("f") != "txn":
-            raise ValueError(f"multi-register op must be txn, got "
-                             f"{op.get('f')!r}")
-        acts = [0] * K
-        for f, k, v in op.get("value") or ():
-            if not isinstance(k, int) or not (0 <= k < K):
-                raise ValueError(f"key {k!r} outside [0, {K})")
-            if acts[k] != 0:
-                raise ValueError(f"txn touches key {k} twice")
-            if f == "r":
-                if v is None:
-                    acts[k] = 1
-                elif isinstance(v, int) and 0 <= v < V:
-                    acts[k] = 2 + v
-                else:
-                    raise ValueError(f"read value {v!r} outside [0, {V})")
-            elif f == "w":
-                if not (isinstance(v, int) and 0 <= v < V):
-                    raise ValueError(f"write value {v!r} outside [0, {V})")
-                acts[k] = 2 + V + v
-            else:
-                raise ValueError(f"unknown micro-op {f!r}")
-        a = 0
-        for k in reversed(range(K)):
-            a = a * AB + acts[k]
-        return 0, a, 0
-
-    stream = encode_register_ops(history, encode_args=encode_args)
-    # interned-state count for kernel selection: the whole map space
-    stream.intern = _DenseIntern((V + 1) ** K)
-    return stream
-
-
-class _DenseIntern:
-    """Stands in for Intern when states are arithmetic encodings rather
-    than interned values: only the state-count surface is needed."""
-
-    def __init__(self, n: int):
-        self._n = n
-
-    def __len__(self):
-        return self._n
+    """Encodes a multi-register txn history for
+    models.multi_register_spec — see
+    :func:`jepsen_tpu.history_ir.views.encode_multi_register_ops`."""
+    from jepsen_tpu.history_ir import views
+    return views.encode_multi_register_ops(history, n_keys, n_values)
 
 
 def pad_streams(streams: list[EventStream], length: int | None = None) -> dict:
@@ -250,38 +100,12 @@ def pad_streams(streams: list[EventStream], length: int | None = None) -> dict:
 
 def stream_to_columns(stream: EventStream) -> dict | None:
     """The stream as plain persistable arrays (the store's ``lin_*``
-    sidecar keys), or None when the intern table holds non-int values
-    (beyond the id-0 None sentinel) — those can't round-trip through
-    an int64 column."""
-    vals = stream.intern.table[1:]
-    if not all(type(v) is int for v in vals):
-        return None
-    return {
-        "kind": np.asarray(stream.kind, np.int8),
-        "slot": np.asarray(stream.slot, np.int32),
-        "f": np.asarray(stream.f, np.int32),
-        "a": np.asarray(stream.a, np.int32),
-        "b": np.asarray(stream.b, np.int32),
-        "op_index": np.asarray(stream.op_index, np.int32),
-        "n_slots": np.int64(stream.n_slots),
-        "n_ops": np.int64(stream.n_ops),
-        "intern_table": np.asarray(vals, np.int64),
-    }
+    sidecar keys) — see :mod:`jepsen_tpu.history_ir.sidecar`."""
+    from jepsen_tpu.history_ir import sidecar
+    return sidecar.stream_to_columns(stream)
 
 
 def stream_from_columns(cols: dict) -> EventStream:
     """Rebuilds an EventStream from stream_to_columns' product."""
-    intern = Intern()
-    for v in np.asarray(cols["intern_table"]).tolist():
-        intern.id(int(v))
-    return EventStream(
-        kind=np.asarray(cols["kind"], np.int8),
-        slot=np.asarray(cols["slot"], np.int32),
-        f=np.asarray(cols["f"], np.int32),
-        a=np.asarray(cols["a"], np.int32),
-        b=np.asarray(cols["b"], np.int32),
-        op_index=np.asarray(cols["op_index"], np.int32),
-        n_slots=int(cols["n_slots"]),
-        n_ops=int(cols["n_ops"]),
-        intern=intern,
-    )
+    from jepsen_tpu.history_ir import sidecar
+    return sidecar.stream_from_columns(cols)

@@ -71,30 +71,47 @@ class LinearizableChecker(Checker):
         self._kernel = None
         self._ladder = None
 
-    def _encoding(self, history):
+    def _encoding(self, history, ir=None):
         """(stream, step_py, spec) when the model has an int encoding for
-        the device/stream paths, else None (object-model wgl search)."""
+        the device/stream paths, else None (object-model wgl search).
+        With an ``ir`` (the run's shared history IR) the stream is the
+        memoized view — a second checker over the same history pays
+        nothing (bit-identical either way; tests/test_history_ir.py)."""
         from jepsen_tpu.models import MultiRegister, multi_register_spec
 
         if isinstance(self.model, CASRegister):
             from jepsen_tpu.history import Intern
             from jepsen_tpu.models import cas_register_spec
-            intern = Intern()
-            # a non-None initial register value interns FIRST so its id
-            # is the kernel's init state (single-key-acid starts at 0)
+            if ir is not None:
+                from jepsen_tpu.history_ir import views
+                stream = views.register_stream(ir,
+                                               init_value=self.model.value)
+            else:
+                intern = Intern()
+                # a non-None initial register value interns FIRST so its
+                # id is the kernel's init state (single-key-acid at 0)
+                if self.model.value is not None:
+                    intern.id(self.model.value)
+                stream = encode_register_ops(history, intern=intern)
             init_id = (0 if self.model.value is None
-                       else intern.id(self.model.value))
-            return (encode_register_ops(history, intern=intern),
-                    cas_register_step_py, cas_register_spec(init_id))
+                       else stream.intern.id(self.model.value))
+            return (stream, cas_register_step_py,
+                    cas_register_spec(init_id))
         if isinstance(self.model, MultiRegister):
             from jepsen_tpu.checker.linear_cpu import multi_register_step_py
             from jepsen_tpu.checker.linear_encode import (
                 encode_multi_register_ops)
             k, v = self.multi_shape
-            try:
-                stream = encode_multi_register_ops(history, k, v)
-            except ValueError:
-                return None  # outside the packed encoding: wgl fallback
+            if ir is not None:
+                from jepsen_tpu.history_ir import views
+                stream = views.multi_register_stream(ir, k, v)
+                if stream is None:
+                    return None  # outside the packed encoding: wgl
+            else:
+                try:
+                    stream = encode_multi_register_ops(history, k, v)
+                except ValueError:
+                    return None  # outside the packed encoding: wgl
             return (stream, multi_register_step_py(k, v),
                     multi_register_spec(k, v))
         return None
@@ -125,8 +142,11 @@ class LinearizableChecker(Checker):
                                  len(history), None)
             return self._finish(res, history, test)
 
-        # jitlin path: encode once, run on device or host
-        enc = self._encoding(history)
+        # jitlin path: encode once — through the run's shared history
+        # IR when one is attachable (history_ir.of memoizes on the test
+        # map, so composed checkers share a single encode)
+        from jepsen_tpu import history_ir
+        enc = self._encoding(history, ir=history_ir.of(test, history))
         if enc is None:
             res = wgl(history, self.model)
             self._record_metrics(res, time.perf_counter() - t0,
@@ -592,7 +612,9 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
         try:
             cols = store.load_linear_columns(test_name, timestamp,
                                              store_dir)
-        except Exception:  # noqa: BLE001 - damaged sidecar: use jsonl
+        except Exception as e:  # noqa: BLE001 - damaged sidecar: use jsonl
+            store.note_sidecar_load_failure(
+                f"{test_name}/{timestamp} (lin_*)", e)
             cols = None
     if cols is not None:
         try:

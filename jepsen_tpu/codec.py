@@ -1,5 +1,15 @@
-"""Value <-> bytes codec for histories and wire payloads (reference:
-jepsen/src/jepsen/codec.clj — EDN there, canonical JSON here)."""
+"""Value <-> bytes codec for wire payloads and the history IR's value
+intern table (reference: jepsen/src/jepsen/codec.clj — EDN there,
+canonical JSON here).
+
+History *value* encoding is owned by the IR intern table
+(:class:`jepsen_tpu.history_ir.ir.DeviceHistory` interns every op value
+to a dense int32 id); this codec serializes that table — one canonical
+JSON row per interned value — into the ``history.npz`` sidecar
+(``val_table``; see :func:`jepsen_tpu.history_ir.sidecar
+.intern_to_rows` / ``intern_from_rows``, round-trip pinned in
+tests/test_history_ir.py). Wire payloads (suites/_wire.py et al.) use
+:func:`encode`/:func:`decode` directly, as before."""
 from __future__ import annotations
 
 import json

@@ -396,6 +396,12 @@ def _crash_safety_setup(test: dict):
                     "wal_fsync_interval",
                     journal_mod.DEFAULT_FSYNC_INTERVAL_S))
             test["_journal"] = journal
+            # ir_stream_from_wal: tail our own WAL into an incremental
+            # history-IR builder on a background thread, so the encode
+            # the checkers need at analyze time hides under the run
+            # itself (doc/performance.md "History IR")
+            from jepsen_tpu import history_ir
+            history_ir.maybe_start_wal_streamer(test, journal.path)
         except OSError:
             logger.exception("couldn't open history WAL; journaling off")
     if test.get("fault_registry", True) is not False:
@@ -452,6 +458,13 @@ def run(test: dict) -> dict:  # owner: scheduler
                             history = run_case(test)
                         test["history"] = history
                         snarf_logs(test)
+                        streamer = test.get("_ir_streamer")
+                        if streamer is not None:
+                            # absorb the WAL's final tail while it still
+                            # exists; history_ir.of adopts the streamed
+                            # IR at analyze time (or batch-builds if the
+                            # stream diverged)
+                            streamer.drain_final()
                         store.save_1(test)
                         if journal is not None:
                             # history.jsonl is authoritative now; a
@@ -461,6 +474,9 @@ def run(test: dict) -> dict:  # owner: scheduler
             log_results(test)
             return test
     finally:
+        streamer = test.pop("_ir_streamer", None)
+        if streamer is not None:
+            streamer.drain_final()  # no-op when already drained
         test.pop("_journal", None)
         if journal is not None:
             journal.close()  # no-op when already discarded

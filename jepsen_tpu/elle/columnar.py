@@ -58,15 +58,22 @@ def _cmod():
     return columnar_c.mod()
 
 
-def check_columnar(history: list, consistency_models, accelerator: str):
+def check_columnar(history: list, consistency_models, accelerator: str,
+                   parts=None):
     """Full list-append check on the columnar fast path, or None when the
-    history falls outside the integer regime (caller falls back)."""
+    history falls outside the integer regime (caller falls back).
+    ``parts`` short-circuits the build phase with a precomputed
+    ``_build`` product — the history-IR view
+    (jepsen_tpu.history_ir.views.elle_build) passes it so a run that
+    already encoded pays ~zero build here (``phase_build_s`` then
+    measures just the handoff)."""
     import time as _time
     t0 = _time.perf_counter()
-    try:
-        parts = _build(history)
-    except (TypeError, ValueError, OverflowError):
-        return None
+    if parts is None:
+        try:
+            parts = _build(history)
+        except (TypeError, ValueError, OverflowError):
+            return None
     if parts is None:
         return None
     graph, txns, extras, n_keys = parts

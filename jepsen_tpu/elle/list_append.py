@@ -220,8 +220,10 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
 
     try:
         cols = store.load_elle_columns(test_name, timestamp, store_dir)
-    except Exception:  # noqa: BLE001 - any sidecar damage (missing,
+    except Exception as e:  # noqa: BLE001 - any sidecar damage (missing,
         #              truncated zip, wrong keys) means: use the jsonl
+        store.note_sidecar_load_failure(
+            f"{test_name}/{timestamp} (elle_*)", e)
         cols = None
     if cols is not None:
         try:
@@ -236,24 +238,36 @@ def check_stored(test_name: str, timestamp: str, store_dir: str = "store",
 
 
 def check(history: list[dict], accelerator: str = "auto",
-          consistency_models=("strict-serializable",)) -> dict:
+          consistency_models=("strict-serializable",), ir=None) -> dict:
     # Production path: the vectorized columnar builder (elle.columnar)
     # covers integer-valued histories — the universal workload shape —
     # and feeds the φ-cluster cycle path. The cpu oracle keeps the
     # Python builder below; differential tests pin the two together.
+    # With an ``ir`` (the run's shared history IR) the build product is
+    # the memoized elle_build view: encode once per run.
     if accelerator != "cpu":
         from jepsen_tpu.elle import columnar
-        r = columnar.check_columnar(history, consistency_models, accelerator)
+        parts = None
+        if ir is not None:
+            from jepsen_tpu.history_ir import views
+            parts = views.elle_build(ir)
+        r = (columnar.check_columnar(history, consistency_models,
+                                     accelerator, parts=parts)
+             if parts is not None or ir is None else None)
         if r is not None:
             return r
     # ok txns participate in the graph; failed txns matter for G1a;
     # info (indeterminate) txns' writes may be observed — treated like ok
     # when they are (elle does the same: info writes that appear are real)
-    oks = [op for op in history
-           if op.get("type") == "ok" and isinstance(op.get("process"), int)]
-    fails = [op for op in history if op.get("type") == "fail"]
-    infos = [op for op in history if op.get("type") == "info"
-             and isinstance(op.get("process"), int)]
+    if ir is not None:
+        from jepsen_tpu.history_ir import views
+        oks, fails, infos = views.txn_nodes(ir)
+    else:
+        oks = [op for op in history if op.get("type") == "ok"
+               and isinstance(op.get("process"), int)]
+        fails = [op for op in history if op.get("type") == "fail"]
+        infos = [op for op in history if op.get("type") == "info"
+                 and isinstance(op.get("process"), int)]
 
     txns = oks + infos  # graph nodes; info txns included if observed
     txn_index = {id(op): i for i, op in enumerate(txns)}

@@ -36,12 +36,19 @@ from jepsen_tpu.txn import _hk, int_write_mops
 
 
 def check(history: list[dict], accelerator: str = "auto",
-          consistency_models=("strict-serializable",)) -> dict:
-    oks = [op for op in history
-           if op.get("type") == "ok" and isinstance(op.get("process"), int)]
-    fails = [op for op in history if op.get("type") == "fail"]
-    infos = [op for op in history if op.get("type") == "info"
-             and isinstance(op.get("process"), int)]
+          consistency_models=("strict-serializable",), ir=None) -> dict:
+    # the ok/fail/info node split comes from the run's shared history
+    # IR when one is attached (memoized txn_nodes view — the same split
+    # the list-append checker starts from), else inline
+    if ir is not None:
+        from jepsen_tpu.history_ir import views
+        oks, fails, infos = views.txn_nodes(ir)
+    else:
+        oks = [op for op in history if op.get("type") == "ok"
+               and isinstance(op.get("process"), int)]
+        fails = [op for op in history if op.get("type") == "fail"]
+        infos = [op for op in history if op.get("type") == "info"
+                 and isinstance(op.get("process"), int)]
     txns = oks + infos
     n = len(txns)
 

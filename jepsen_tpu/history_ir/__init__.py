@@ -1,0 +1,128 @@
+"""One device-resident columnar history IR (ROADMAP item 3).
+
+``history_ir.of(test, history)`` is the whole integration surface for
+checkers: it returns the run's shared :class:`DeviceHistory` — building
+it on first call (or adopting the WAL-streamed builder when
+``ir_stream_from_wal`` ran), memoizing it on the test map under
+``_history_ir`` (underscore keys never serialize) — or None when the IR
+is disabled (``ir_enabled: False``) or there is no test map to share
+through. Every checker then derives its encoding as a memoized view
+(:mod:`jepsen_tpu.history_ir.views`), so a multi-checker run encodes
+the history exactly once.
+
+Knobs (test map; preflight-validated, tolerantly coerced like every
+other bool knob):
+
+* ``ir_enabled`` — default True; False restores the per-checker encode
+  paths bit-identically (the views ARE the encoders, so off/on cannot
+  diverge — differential tests pin it).
+* ``ir_stream_from_wal`` — default False; True makes ``core.run`` tail
+  its own WAL into an incremental IR builder during the run, hiding
+  encode latency under the run itself.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from jepsen_tpu.history_ir.builder import (
+    IncrementalHistoryBuilder, WalStreamer,
+)
+from jepsen_tpu.history_ir.ir import CANONICAL_COLUMNS, DeviceHistory
+
+logger = logging.getLogger("jepsen.history_ir")
+
+__all__ = [
+    "DeviceHistory", "IncrementalHistoryBuilder", "WalStreamer",
+    "CANONICAL_COLUMNS", "of", "enabled", "stream_from_wal_enabled",
+    "maybe_start_wal_streamer",
+]
+
+#: test-map key the shared IR memoizes under (underscore: never serialized)
+ATTACH_KEY = "_history_ir"
+STREAMER_KEY = "_ir_streamer"
+
+# one lock for the attach-or-build race: Compose checks run checkers
+# concurrently and both may ask for the IR in the same tick
+_ATTACH_LOCK = threading.Lock()
+
+
+def enabled(test) -> bool:
+    """The ``ir_enabled`` knob, tolerantly coerced (default True)."""
+    from jepsen_tpu.parallel import coerce_flag
+    if not isinstance(test, dict):
+        return True
+    flag = coerce_flag(test.get("ir_enabled"), knob="ir_enabled")
+    return True if flag is None else flag
+
+
+def stream_from_wal_enabled(test) -> bool:
+    """The ``ir_stream_from_wal`` knob, tolerantly coerced (default
+    False — streaming costs a poller thread; runs opt in)."""
+    from jepsen_tpu.parallel import coerce_flag
+    if not isinstance(test, dict):
+        return False
+    flag = coerce_flag(test.get("ir_stream_from_wal"),
+                       knob="ir_stream_from_wal")
+    return False if flag is None else flag
+
+
+def of(test, history) -> DeviceHistory | None:
+    """The run's shared IR for ``history``, or None when disabled or
+    there's no test map to memoize on. Reuses the cached IR only when
+    it was built for this exact history object (analyze re-indexes the
+    history into new dicts; a stale IR must never serve a different
+    list). Prefers the WAL-streamed builder's snapshot when one ran and
+    its ops verify against this history."""
+    if not isinstance(test, dict) or not enabled(test) or history is None:
+        return None
+    with _ATTACH_LOCK:
+        cached = test.get(ATTACH_KEY)
+        if isinstance(cached, DeviceHistory) and cached.ops is history:
+            return cached
+        dh = None
+        streamer = test.get(STREAMER_KEY)
+        if streamer is not None:
+            try:
+                dh = streamer.snapshot_for(history)
+            except Exception:  # noqa: BLE001 — streamed IR is an optimization
+                logger.exception("WAL-streamed IR adoption failed; "
+                                 "batch-building")
+                dh = None
+            if dh is not None:
+                logger.info("adopted WAL-streamed history IR (%d ops)",
+                            len(dh))
+        if dh is None:
+            try:
+                dh = DeviceHistory.from_ops(history)
+            except Exception:  # noqa: BLE001 — the IR is an optimization:
+                # a history the column encoder can't pack (non-numeric
+                # time, unhashable process — a hand-edited or foreign
+                # history.jsonl) must fall back to the per-checker
+                # legacy encodes, never fail the check
+                logger.warning("history IR build failed; checkers fall "
+                               "back to per-checker encodes",
+                               exc_info=True)
+                return None
+        # pin the caller's list itself (from_ops copies it) so the
+        # cached-IR identity check above recognizes repeat calls
+        dh.ops = history
+        test[ATTACH_KEY] = dh
+        return dh
+
+
+def maybe_start_wal_streamer(test, wal_path):
+    """Starts the background WAL->IR streamer for a run when
+    ``ir_stream_from_wal`` (and the IR itself) is on; returns the
+    streamer or None. Installed under ``_ir_streamer`` so
+    :func:`of` finds it at analysis time; ``core.run`` drains it before
+    discarding the WAL and pops it on teardown."""
+    if not (enabled(test) and stream_from_wal_enabled(test)):
+        return None
+    try:
+        streamer = WalStreamer(wal_path).start()
+    except Exception:  # noqa: BLE001 — streaming must not fail the run
+        logger.exception("couldn't start WAL->IR streamer")
+        return None
+    test[STREAMER_KEY] = streamer
+    return streamer

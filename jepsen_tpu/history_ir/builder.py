@@ -1,0 +1,533 @@
+"""Incremental builders for the history IR: encode while the run runs.
+
+The batch path (:meth:`DeviceHistory.from_ops`) walks the finished
+history once. The classes here do the same work *op by op* as ops
+arrive, so the encode cost hides under the run itself:
+
+* :class:`IncrementalHistoryBuilder` — the canonical-column builder:
+  absorbs ops (directly, or tailed from the PR-3 WAL via
+  :class:`jepsen_tpu.journal.WalTailer`) and snapshots a
+  :class:`~jepsen_tpu.history_ir.ir.DeviceHistory` whose columns are
+  bit-identical to the batch build (pinned by tests/test_history_ir.py,
+  including torn-WAL resume).
+* :class:`WalStreamer` — a background thread ``core.run`` starts when
+  the ``ir_stream_from_wal`` knob is on: tails the run's WAL into an
+  IncrementalHistoryBuilder so ``history_ir.of`` finds a ready-made IR
+  at analysis time instead of paying a post-hoc encode.
+* :class:`LiveRegisterEncoder` / :class:`LiveElleColumns` — the per-op
+  encode state the live checker sessions (jepsen_tpu.live.sessions)
+  adapt over; moved here so the streaming sessions are thin views over
+  the IR's builders rather than a parallel encoder lineage.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from jepsen_tpu.history import Intern, TYPE_CODE
+from jepsen_tpu.history_ir.ir import DeviceHistory, ValueIntern
+
+logger = logging.getLogger("jepsen.history_ir")
+
+
+class IncrementalHistoryBuilder:
+    """Builds the canonical IR columns one op at a time.
+
+    ``add`` runs the per-op work (type coding, f/value interning,
+    invocation pairing) exactly once; ``snapshot`` converts the
+    accumulated lists to a :class:`DeviceHistory` (cached until new ops
+    arrive). ``absorb_wal`` pulls whatever a WalTailer has since the
+    last poll."""
+
+    def __init__(self):
+        self.ops: list[dict] = []
+        self._types: list[int] = []
+        self._procs: list[int] = []
+        self._fs: list[int] = []
+        self._times: list[int] = []
+        self._indices: list[int] = []
+        self._value_ids: list[int] = []
+        self.values: list = []
+        self._f_intern = Intern()
+        self._v_intern = ValueIntern()
+        self._completion_of: list[int] = []
+        self._invocation_of: list[int] = []
+        self._open_invoke: dict = {}
+        self._snapshot: DeviceHistory | None = None
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def add(self, op: dict) -> None:
+        i = len(self.ops)
+        self.ops.append(op)
+        self._types.append(TYPE_CODE.get(op.get("type"), 3))
+        p = op.get("process")
+        self._procs.append(p if isinstance(p, int) else -1)
+        self._fs.append(self._f_intern.id(op.get("f")))
+        self._times.append(op.get("time", 0) or 0)
+        idx = op.get("index")
+        self._indices.append(i if idx is None else idx)
+        v = op.get("value")
+        self.values.append(v)
+        self._value_ids.append(self._v_intern.id(v))
+        # invocation pairing, the pair_index walk online
+        self._completion_of.append(-1)
+        self._invocation_of.append(-1)
+        if op.get("type") == "invoke":
+            self._open_invoke[p] = i
+        else:
+            j = self._open_invoke.pop(p, None)
+            if j is not None:
+                self._completion_of[j] = i
+                self._invocation_of[i] = j
+        self._snapshot = None
+
+    def extend(self, ops: Sequence[dict]) -> int:
+        for op in ops:
+            self.add(op)
+        return len(ops)
+
+    def absorb_wal(self, tailer, final: bool = False) -> int:
+        """Absorbs the ops a WalTailer has accumulated since its last
+        poll. Torn mid-file lines are skipped by the tailer (counted in
+        ``tailer.torn_skipped``); the builder just sees fewer ops and
+        the final length check in :meth:`WalStreamer.snapshot_for`
+        falls back to a batch build."""
+        return self.extend(tailer.poll(final=final))
+
+    def snapshot(self) -> DeviceHistory:
+        """The accumulated ops as a DeviceHistory; columns are
+        bit-identical to ``DeviceHistory.from_ops(self.ops)``."""
+        if self._snapshot is None:
+            self._snapshot = DeviceHistory(
+                types=np.asarray(self._types, np.int8),
+                processes=np.asarray(self._procs, np.int32),
+                fs=np.asarray(self._fs, np.int32),
+                times=np.asarray(self._times, np.int64),
+                indices=np.asarray(self._indices, np.int32),
+                completion_of=np.asarray(self._completion_of, np.int32),
+                invocation_of=np.asarray(self._invocation_of, np.int32),
+                f_table=list(self._f_intern.table),
+                values=list(self.values),
+                ops=list(self.ops),
+                value_ids=np.asarray(self._value_ids, np.int32),
+                intern=self._v_intern,
+            )
+        return self._snapshot
+
+
+class WalStreamer:
+    """Tails a run's WAL into an IncrementalHistoryBuilder on a
+    background thread, so the IR is (mostly) built by the time the
+    checkers want it. Wedge-proof by construction: the thread is a
+    daemon, only touches the local WAL file, and ``drain_final`` joins
+    it with a bounded timeout — a hung read abandons streaming and the
+    IR falls back to the batch build, never wedging teardown."""
+
+    def __init__(self, wal_path, poll_interval_s: float = 0.25):
+        from jepsen_tpu.journal import WalTailer
+        self.builder = IncrementalHistoryBuilder()
+        self.tailer = WalTailer(wal_path)
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._broken = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="ir-wal-streamer", daemon=True)
+
+    def start(self) -> "WalStreamer":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:  # owner: worker
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    self.builder.absorb_wal(self.tailer)
+            except Exception:  # noqa: BLE001 — streaming is an optimization
+                logger.exception("WAL streamer poll failed; stopping")
+                self._broken = True
+                return
+            self._stop.wait(self.poll_interval_s)
+
+    def drain_final(self, timeout_s: float = 5.0) -> None:
+        """Stops the poller and absorbs the WAL's final tail. Called
+        before the journal is discarded (core.run) so the last ops are
+        still on disk when the drain reads them."""
+        self._stop.set()
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():
+            logger.warning("WAL streamer didn't stop in %.1fs; "
+                           "abandoning streamed IR", timeout_s)
+            self._broken = True
+            return
+        if self._broken:
+            return
+        try:
+            with self._lock:
+                self.builder.absorb_wal(self.tailer, final=True)
+        except Exception:  # noqa: BLE001 — fall back to the batch build
+            logger.exception("WAL streamer final drain failed")
+            self._broken = True
+
+    def snapshot_for(self, history: list[dict]) -> DeviceHistory | None:
+        """The streamed IR if it exactly covers ``history``, else None
+        (caller batch-builds). The WAL round-trips ops through JSON, so
+        every op is compared field-by-field against the in-memory
+        history — any divergence (unserializable op dropped, torn line
+        skipped, tuple-vs-list value) rejects the stream rather than
+        risking a checker seeing different data."""
+        if self._broken or self._thread.is_alive():
+            return None
+        with self._lock:
+            ops = self.builder.ops
+            if len(ops) != len(history):
+                return None
+            try:
+                for a, b in zip(ops, history):
+                    if (a.get("type") != b.get("type")
+                            or a.get("process") != b.get("process")
+                            or a.get("f") != b.get("f")
+                            or (a.get("time", 0) or 0) != (b.get("time", 0) or 0)
+                            or a.get("value") != b.get("value")):
+                        return None
+            except Exception:  # noqa: BLE001 — exotic values: batch build
+                return None
+            snap = self.builder.snapshot()
+        # a FRESH DeviceHistory sharing the (immutable) columns but not
+        # the view memo: save-time and analyze-time adoptions see
+        # different op dict identities (analyze re-indexes), and views
+        # must cite the REAL op dicts of the history they serve
+        return DeviceHistory(
+            types=snap.types, processes=snap.processes, fs=snap.fs,
+            times=snap.times, indices=snap.indices,
+            completion_of=snap.completion_of,
+            invocation_of=snap.invocation_of,
+            f_table=snap.f_table,
+            values=[op.get("value") for op in history],
+            ops=list(history),
+            value_ids=snap.value_ids, intern=snap.intern)
+
+
+# ---------------------------------------------------------------------------
+# live-session encoders (the streaming sessions adapt over these)
+# ---------------------------------------------------------------------------
+
+
+class ListStream:
+    """A growing, list-backed event stream the FrontierSession can
+    absorb from directly (plain-int lists index faster than numpy
+    scalars on the Python step loop) and that converts to a real
+    EventStream for device dispatch on demand."""
+
+    __slots__ = ("kind", "slot", "f", "a", "b", "op_index", "intern",
+                 "n_slots")
+
+    def __init__(self, intern: Intern):
+        self.kind: list[int] = []
+        self.slot: list[int] = []
+        self.f: list[int] = []
+        self.a: list[int] = []
+        self.b: list[int] = []
+        self.op_index: list[int] = []
+        self.intern = intern
+        self.n_slots = 1
+
+    def __len__(self):
+        return len(self.kind)
+
+    def to_event_stream(self):
+        from jepsen_tpu.checker.linear_encode import EV_INVOKE, EventStream
+        return EventStream(
+            kind=np.asarray(self.kind, np.int8),
+            slot=np.asarray(self.slot, np.int32),
+            f=np.asarray(self.f, np.int32),
+            a=np.asarray(self.a, np.int32),
+            b=np.asarray(self.b, np.int32),
+            op_index=np.asarray(self.op_index, np.int32),
+            n_slots=self.n_slots,
+            n_ops=sum(1 for k in self.kind if k == EV_INVOKE),
+            intern=self.intern,
+        )
+
+
+class LiveRegisterEncoder:
+    """Incremental twin of the register event-stream view
+    (:func:`jepsen_tpu.history_ir.views.encode_register_ops`): absorbs
+    history ops in order and emits the identical event sequence (pinned
+    by a differential fuzz in tests/test_live.py).
+
+    The batch encoder resolves each invoke by looking ahead at its
+    completion (fail pairs drop, crashed reads drop, a read's value
+    completes from its :ok). Online, the look-ahead becomes a stall:
+    encoding advances through the history strictly in order and pauses
+    at the first invoke whose completion hasn't arrived yet — the
+    *checkable prefix*. The stall is bounded by the run's concurrency
+    (plus the per-op deadline that reaps hung ops to :info), and it is
+    exactly the live checker's intrinsic lag."""
+
+    def __init__(self, intern: Intern, encode_args=None):
+        self.intern = intern
+        self.stream = ListStream(intern)
+        if encode_args is None:
+            from jepsen_tpu.models import (
+                CAS_F_CAS, CAS_F_READ, CAS_F_WRITE,
+            )
+
+            def encode_args(op):
+                f, v = op.get("f"), op.get("value")
+                if f == "read":
+                    return CAS_F_READ, intern.id(v), 0
+                if f == "write":
+                    return CAS_F_WRITE, intern.id(v), 0
+                if f == "cas":
+                    u, w = v
+                    return CAS_F_CAS, intern.id(u), intern.id(w)
+                raise ValueError(f"unknown register op {f!r}")
+        self.encode_args = encode_args
+        self._ops: list[dict] = []          # raw history, arrival order
+        self._next = 0                      # next history index to encode
+        self._open_inv: dict = {}           # process -> open invoke index
+        self._outcome: dict[int, tuple] = {}  # invoke idx -> resolution
+        # second-pass state (slot allocation), advanced in order only
+        self._open_by_process: dict = {}
+        self._free_slots: list[int] = []
+        self._next_slot = 0
+        self._finalized = False
+
+    # -- arrival (first-pass resolution) --------------------------------
+
+    def add(self, op: dict) -> None:
+        i = len(self._ops)
+        self._ops.append(op)
+        p, typ = op.get("process"), op.get("type")
+        if not isinstance(p, int) or p < 0:
+            return
+        if typ == "invoke":
+            j = self._open_inv.pop(p, None)
+            if j is not None:
+                # overwritten invoke: never completed, never dropped by
+                # the batch encoder either — encode it, return-less
+                self._outcome[j] = ("keep",)
+            self._open_inv[p] = i
+        elif typ == "fail":
+            j = self._open_inv.pop(p, None)
+            if j is not None:
+                self._outcome[j] = ("drop",)
+        elif typ == "ok":
+            j = self._open_inv.pop(p, None)
+            if j is not None:
+                v = op.get("value")
+                self._outcome[j] = (("ok", v) if v is not None
+                                    else ("keep",))
+        elif typ == "info":
+            j = self._open_inv.pop(p, None)
+            if j is not None:
+                self._outcome[j] = (
+                    ("drop",) if self._ops[j].get("f") == "read"
+                    else ("keep",))
+
+    # -- encoding (second pass, in order, stalls at unresolved) ---------
+
+    def encode_resolved(self) -> int:
+        """Advances the encoder over every op whose resolution is known;
+        returns the new count of encoded history ops (the checkable
+        prefix length)."""
+        from jepsen_tpu.checker.linear_encode import EV_INVOKE, EV_RETURN
+        ops = self._ops
+        st = self.stream
+        # hot loop: bound methods/locals hoisted — this runs once per
+        # history op at WAL-ingest rate
+        kind_app, slot_app = st.kind.append, st.slot.append
+        f_app, a_app, b_app = st.f.append, st.a.append, st.b.append
+        idx_app = st.op_index.append
+        outcome_get = self._outcome.get
+        free_slots = self._free_slots
+        open_bp = self._open_by_process
+        encode_args = self.encode_args
+        n = len(ops)
+        i = self._next
+        while i < n:
+            op = ops[i]
+            p = op.get("process")
+            typ = op.get("type")
+            if not isinstance(p, int) or p < 0:
+                i += 1
+                continue
+            if typ == "invoke":
+                outcome = outcome_get(i)
+                if outcome is None:
+                    if not self._finalized:
+                        break  # stall: completion not seen yet
+                    # end of run: open reads never happened, open
+                    # mutations stay pending forever (batch semantics)
+                    outcome = (("drop",) if op.get("f") == "read"
+                               else ("keep",))
+                if outcome[0] == "drop":
+                    i += 1
+                    continue
+                if free_slots:
+                    s = free_slots.pop()
+                else:
+                    s = self._next_slot
+                    self._next_slot += 1
+                    st.n_slots = max(st.n_slots, self._next_slot)
+                open_bp[p] = s
+                inv = op
+                if outcome[0] == "ok":
+                    inv = dict(op)
+                    inv["value"] = outcome[1]
+                fcode, a, b = encode_args(inv)
+                kind_app(EV_INVOKE)
+                slot_app(s)
+                f_app(fcode)
+                a_app(a)
+                b_app(b)
+                idx_app(i)
+            elif typ == "ok":
+                s = open_bp.pop(p, None)
+                if s is not None:
+                    kind_app(EV_RETURN)
+                    slot_app(s)
+                    f_app(0)
+                    a_app(0)
+                    b_app(0)
+                    idx_app(i)
+                    free_slots.append(s)
+            # fail/info: dropped pair / no return event — the crashed
+            # op's slot stays occupied forever
+            i += 1
+        self._next = i
+        return i
+
+    def finalize(self) -> int:
+        self._finalized = True
+        return self.encode_resolved()
+
+    @property
+    def ops_seen(self) -> int:
+        return len(self._ops)
+
+    @property
+    def ops_encoded(self) -> int:
+        return self._next
+
+
+class TxnCols:
+    """Flattened micro-op columns for one node class (ok or info)."""
+
+    __slots__ = ("pos", "inv", "proc", "txns",
+                 "a_txn", "a_kid", "a_val", "a_mi",
+                 "r_txn", "r_kid", "r_mi", "payloads")
+
+    def __init__(self):
+        self.pos: list[int] = []
+        self.inv: list[int] = []
+        self.proc: list[int] = []
+        self.txns: list[dict] = []
+        self.a_txn: list[int] = []
+        self.a_kid: list[int] = []
+        self.a_val: list[int] = []
+        self.a_mi: list[int] = []
+        self.r_txn: list[int] = []
+        self.r_kid: list[int] = []
+        self.r_mi: list[int] = []
+        self.payloads: list[list] = []
+
+
+class LiveElleColumns:
+    """Incremental list-append builder columns: the per-op build work
+    (event pairing, micro-op flattening, key interning) run once per op
+    as a run's WAL streams in. The live :class:`ElleSession` is a thin
+    adapter over this; each verdict pays only the vectorized assemble.
+    A history outside the integer columnar regime sets ``fallback`` and
+    the session re-checks from the retained history instead."""
+
+    def __init__(self):
+        from jepsen_tpu.elle.columnar import _MAX_MOPS, _MAX_VAL
+        self._max_mops = _MAX_MOPS
+        self._max_val = _MAX_VAL
+        self._last_ev: dict = {}      # process -> (idx, was_invoke)
+        self.ok = TxnCols()
+        self.info = TxnCols()
+        self.f_kid: list[int] = []
+        self.f_val: list[int] = []
+        self._kid_of: dict = {}
+        self.raw_key: list = []
+        self.fallback: str | None = None
+
+    def kid(self, k) -> int:
+        from jepsen_tpu.txn import _hk
+        hk = _hk(k)
+        i = self._kid_of.get(hk)
+        if i is None:
+            i = self._kid_of[hk] = len(self.raw_key)
+            self.raw_key.append(k)
+        return i
+
+    def absorb(self, i: int, op: dict) -> None:
+        """Absorbs history op ``i``; mirrors the batch builder's event
+        extraction + flatten passes exactly (sessions' differential
+        fuzz pins it)."""
+        typ = op.get("type")
+        if typ not in ("invoke", "ok", "fail", "info"):
+            return
+        p = op.get("process")
+        try:
+            prev = self._last_ev.get(p)
+        except TypeError:  # unhashable process: outside every regime
+            self.fallback = self.fallback or "unhashable process"
+            return
+        self._last_ev[p] = (i, typ == "invoke")
+        if typ == "invoke":
+            return
+        inv = prev[0] if (prev is not None and prev[1]) else None
+        if typ == "fail":
+            for m in op.get("value") or ():
+                if m[0] == "append":
+                    v = m[2]
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or not (0 <= v < self._max_val):
+                        self.fallback = "non-int/overflow failed append"
+                        return
+                    self.f_kid.append(self.kid(m[1]))
+                    self.f_val.append(v)
+            return
+        if not isinstance(p, int):
+            return  # not a graph node (batch pint filter)
+        cols = self.ok if typ == "ok" else self.info
+        t = len(cols.pos)
+        cols.pos.append(i)
+        cols.inv.append(-1 if inv is None else inv)
+        cols.proc.append(p)
+        cols.txns.append(op)
+        if self.fallback:
+            return
+        try:
+            for mi, m in enumerate(op.get("value") or ()):
+                if mi >= self._max_mops:
+                    self.fallback = "over-long txn"
+                    return
+                f = m[0]
+                if f == "append":
+                    v = m[2]
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or not (0 <= v < self._max_val):
+                        self.fallback = "non-int/overflow append value"
+                        return
+                    cols.a_txn.append(t)
+                    cols.a_kid.append(self.kid(m[1]))
+                    cols.a_val.append(v)
+                    cols.a_mi.append(mi)
+                elif f == "r" and m[2] is not None:
+                    cols.r_txn.append(t)
+                    cols.r_kid.append(self.kid(m[1]))
+                    cols.r_mi.append(mi)
+                    cols.payloads.append(m[2] if type(m[2]) is list
+                                         else list(m[2]))
+        except (TypeError, ValueError, IndexError, OverflowError) as e:
+            self.fallback = f"unflattenable txn: {e!r}"

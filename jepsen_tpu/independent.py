@@ -305,18 +305,34 @@ class IndependentChecker(Checker):
                 "history-key": k}
 
     def check(self, test, history, opts):
-        keys = history_keys(history)
+        # the per-key split rides the run's shared history IR when one
+        # is attachable (memoized subhistories view): composed lifted
+        # checkers split the history once, not once per checker
+        from jepsen_tpu import history_ir
+        ir = history_ir.of(test, history)
+        if ir is not None:
+            from jepsen_tpu.history_ir import views
+            keys, subs = views.subhistories(ir)
+        else:
+            keys = history_keys(history)
+            subs = {_freeze_key(k): subhistory(k, history) for k in keys}
         if not keys:
             return {"valid?": True, "results": {}, "count": 0}
-        subs = {_freeze_key(k): subhistory(k, history) for k in keys}
 
         batched = self._try_batched(test, keys, subs, opts)
         if batched is not None:
             results = batched
         else:
+            # per-key sub-checks get ir_enabled: False — a sub-history
+            # is not the run's history, so attaching it would evict the
+            # run-level `_history_ir` (and serialize bounded_pmap on
+            # the attach lock); the legacy per-key encode is exactly
+            # what these small sub-checks should pay
+            sub_test = ({**test, "ir_enabled": False}
+                        if isinstance(test, dict) else test)
             pairs = list(subs.items())
             rs = bounded_pmap(
-                lambda kv: check_safe(self.checker, test, kv[1],
+                lambda kv: check_safe(self.checker, sub_test, kv[1],
                                       self._key_opts(opts, kv[0])), pairs)
             results = {k: r for (k, _), r in zip(pairs, rs)}
 

@@ -4,9 +4,11 @@ Each session absorbs ops one at a time as a run's WAL streams in and
 answers ``verdict()`` — "valid so far" or "first anomaly at op N" —
 without re-reading or re-encoding the prefix it has already seen:
 
-* :class:`LinearLiveSession` — single-register linearizability. An
-  incremental twin of ``checker.linear_encode.encode_register_ops``
-  feeds a resumable
+* :class:`LinearLiveSession` — single-register linearizability. The
+  history IR's incremental register encoder
+  (:class:`jepsen_tpu.history_ir.builder.LiveRegisterEncoder`, the
+  streaming twin of the ``views.register_stream`` view) feeds a
+  resumable
   :class:`~jepsen_tpu.checker.linear_cpu.FrontierSession`; verdict
   dispatches ride a :class:`~jepsen_tpu.checker.ladder.BackendLadder`
   (transfer-matrix device screen over the accumulated stream when in
@@ -15,10 +17,16 @@ without re-reading or re-encoding the prefix it has already seen:
 * :class:`ElleSession` — list-append transactional anomalies. The
   PyObject-heavy build phase (event pairing + micro-op flattening +
   key interning — the ``phase_build_s`` that dominates a post-hoc Elle
-  check ~7:1, BENCH_r04) runs once per op as it arrives; each verdict
-  then only pays the vectorized assemble + cycle check
-  (``elle.columnar._assemble`` — the exact batch code path, so the
-  final live verdict cannot diverge from ``cli analyze``).
+  check ~7:1, BENCH_r04) is the history IR's incremental Elle builder
+  (:class:`jepsen_tpu.history_ir.builder.LiveElleColumns`), run once
+  per op as it arrives; each verdict then only pays the vectorized
+  assemble + cycle check (``elle.columnar._assemble`` — the exact
+  batch code path, so the final live verdict cannot diverge from
+  ``cli analyze``).
+
+Both sessions are thin adapters over
+:mod:`jepsen_tpu.history_ir.builder` — the encode state machines live
+with the IR, the sessions own only verdict dispatch/ladder policy.
 
 Sessions are single-threaded by contract: the daemon's poller owns
 them; nothing here takes locks.
@@ -31,9 +39,8 @@ from typing import Any
 from jepsen_tpu.checker.linear_cpu import (
     FrontierSession, cas_register_step_py,
 )
-from jepsen_tpu.checker.linear_encode import EV_INVOKE, EV_RETURN
+from jepsen_tpu.checker.linear_encode import EV_RETURN
 from jepsen_tpu.history import Intern
-from jepsen_tpu.txn import _hk
 
 logger = logging.getLogger("jepsen.live.sessions")
 
@@ -41,209 +48,13 @@ logger = logging.getLogger("jepsen.live.sessions")
 # post-hoc checker routes on (checker/linearizable.AUTO_TPU_THRESHOLD)
 from jepsen_tpu.checker.linearizable import AUTO_TPU_THRESHOLD  # noqa: E402
 
-from jepsen_tpu.elle.columnar import (  # noqa: E402
-    _MAX_KIDS, _MAX_MOPS, _MAX_VAL,
+from jepsen_tpu.elle.columnar import _MAX_KIDS  # noqa: E402
+
+
+# the incremental encode state machine lives with the history IR
+from jepsen_tpu.history_ir.builder import (  # noqa: E402
+    LiveRegisterEncoder as _LiveRegisterEncoder,
 )
-
-
-class _ListStream:
-    """A growing, list-backed event stream the FrontierSession can
-    absorb from directly (plain-int lists index faster than numpy
-    scalars on the Python step loop) and that converts to a real
-    EventStream for device dispatch on demand."""
-
-    __slots__ = ("kind", "slot", "f", "a", "b", "op_index", "intern",
-                 "n_slots")
-
-    def __init__(self, intern: Intern):
-        self.kind: list[int] = []
-        self.slot: list[int] = []
-        self.f: list[int] = []
-        self.a: list[int] = []
-        self.b: list[int] = []
-        self.op_index: list[int] = []
-        self.intern = intern
-        self.n_slots = 1
-
-    def __len__(self):
-        return len(self.kind)
-
-    def to_event_stream(self):
-        import numpy as np
-
-        from jepsen_tpu.checker.linear_encode import EventStream
-        return EventStream(
-            kind=np.asarray(self.kind, np.int8),
-            slot=np.asarray(self.slot, np.int32),
-            f=np.asarray(self.f, np.int32),
-            a=np.asarray(self.a, np.int32),
-            b=np.asarray(self.b, np.int32),
-            op_index=np.asarray(self.op_index, np.int32),
-            n_slots=self.n_slots,
-            n_ops=sum(1 for k in self.kind if k == EV_INVOKE),
-            intern=self.intern,
-        )
-
-
-class _LiveRegisterEncoder:
-    """Incremental twin of ``encode_register_ops``: absorbs history ops
-    in order and emits the identical event sequence (pinned by a
-    differential fuzz in tests/test_live.py).
-
-    The batch encoder resolves each invoke by looking ahead at its
-    completion (fail pairs drop, crashed reads drop, a read's value
-    completes from its :ok). Online, the look-ahead becomes a stall:
-    encoding advances through the history strictly in order and pauses
-    at the first invoke whose completion hasn't arrived yet — the
-    *checkable prefix*. The stall is bounded by the run's concurrency
-    (plus the per-op deadline that reaps hung ops to :info), and it is
-    exactly the live checker's intrinsic lag."""
-
-    def __init__(self, intern: Intern, encode_args=None):
-        self.intern = intern
-        self.stream = _ListStream(intern)
-        if encode_args is None:
-            from jepsen_tpu.models import (
-                CAS_F_CAS, CAS_F_READ, CAS_F_WRITE,
-            )
-
-            def encode_args(op):
-                f, v = op.get("f"), op.get("value")
-                if f == "read":
-                    return CAS_F_READ, intern.id(v), 0
-                if f == "write":
-                    return CAS_F_WRITE, intern.id(v), 0
-                if f == "cas":
-                    u, w = v
-                    return CAS_F_CAS, intern.id(u), intern.id(w)
-                raise ValueError(f"unknown register op {f!r}")
-        self.encode_args = encode_args
-        self._ops: list[dict] = []          # raw history, arrival order
-        self._next = 0                      # next history index to encode
-        self._open_inv: dict = {}           # process -> open invoke index
-        self._outcome: dict[int, tuple] = {}  # invoke idx -> resolution
-        # second-pass state (slot allocation), advanced in order only
-        self._open_by_process: dict = {}
-        self._free_slots: list[int] = []
-        self._next_slot = 0
-        self._finalized = False
-
-    # -- arrival (first-pass resolution) --------------------------------
-
-    def add(self, op: dict) -> None:
-        i = len(self._ops)
-        self._ops.append(op)
-        p, typ = op.get("process"), op.get("type")
-        if not isinstance(p, int) or p < 0:
-            return
-        if typ == "invoke":
-            j = self._open_inv.pop(p, None)
-            if j is not None:
-                # overwritten invoke: never completed, never dropped by
-                # the batch encoder either — encode it, return-less
-                self._outcome[j] = ("keep",)
-            self._open_inv[p] = i
-        elif typ == "fail":
-            j = self._open_inv.pop(p, None)
-            if j is not None:
-                self._outcome[j] = ("drop",)
-        elif typ == "ok":
-            j = self._open_inv.pop(p, None)
-            if j is not None:
-                v = op.get("value")
-                self._outcome[j] = (("ok", v) if v is not None
-                                    else ("keep",))
-        elif typ == "info":
-            j = self._open_inv.pop(p, None)
-            if j is not None:
-                self._outcome[j] = (
-                    ("drop",) if self._ops[j].get("f") == "read"
-                    else ("keep",))
-
-    # -- encoding (second pass, in order, stalls at unresolved) ---------
-
-    def encode_resolved(self) -> int:
-        """Advances the encoder over every op whose resolution is known;
-        returns the new count of encoded history ops (the checkable
-        prefix length)."""
-        ops = self._ops
-        st = self.stream
-        # hot loop: bound methods/locals hoisted — this runs once per
-        # history op at WAL-ingest rate
-        kind_app, slot_app = st.kind.append, st.slot.append
-        f_app, a_app, b_app = st.f.append, st.a.append, st.b.append
-        idx_app = st.op_index.append
-        outcome_get = self._outcome.get
-        free_slots = self._free_slots
-        open_bp = self._open_by_process
-        encode_args = self.encode_args
-        n = len(ops)
-        i = self._next
-        while i < n:
-            op = ops[i]
-            p = op.get("process")
-            typ = op.get("type")
-            if not isinstance(p, int) or p < 0:
-                i += 1
-                continue
-            if typ == "invoke":
-                outcome = outcome_get(i)
-                if outcome is None:
-                    if not self._finalized:
-                        break  # stall: completion not seen yet
-                    # end of run: open reads never happened, open
-                    # mutations stay pending forever (batch semantics)
-                    outcome = (("drop",) if op.get("f") == "read"
-                               else ("keep",))
-                if outcome[0] == "drop":
-                    i += 1
-                    continue
-                if free_slots:
-                    s = free_slots.pop()
-                else:
-                    s = self._next_slot
-                    self._next_slot += 1
-                    st.n_slots = max(st.n_slots, self._next_slot)
-                open_bp[p] = s
-                inv = op
-                if outcome[0] == "ok":
-                    inv = dict(op)
-                    inv["value"] = outcome[1]
-                fcode, a, b = encode_args(inv)
-                kind_app(EV_INVOKE)
-                slot_app(s)
-                f_app(fcode)
-                a_app(a)
-                b_app(b)
-                idx_app(i)
-            elif typ == "ok":
-                s = open_bp.pop(p, None)
-                if s is not None:
-                    kind_app(EV_RETURN)
-                    slot_app(s)
-                    f_app(0)
-                    a_app(0)
-                    b_app(0)
-                    idx_app(i)
-                    free_slots.append(s)
-            # fail/info: dropped pair / no return event — the crashed
-            # op's slot stays occupied forever
-            i += 1
-        self._next = i
-        return i
-
-    def finalize(self) -> int:
-        self._finalized = True
-        return self.encode_resolved()
-
-    @property
-    def ops_seen(self) -> int:
-        return len(self._ops)
-
-    @property
-    def ops_encoded(self) -> int:
-        return self._next
-
 
 class LinearLiveSession:
     """Streaming single-register linearizability over a WAL tail."""
@@ -424,28 +235,6 @@ class LinearLiveSession:
         return out
 
 
-class _TxnCols:
-    """Flattened micro-op columns for one node class (ok or info)."""
-
-    __slots__ = ("pos", "inv", "proc", "txns",
-                 "a_txn", "a_kid", "a_val", "a_mi",
-                 "r_txn", "r_kid", "r_mi", "payloads")
-
-    def __init__(self):
-        self.pos: list[int] = []
-        self.inv: list[int] = []
-        self.proc: list[int] = []
-        self.txns: list[dict] = []
-        self.a_txn: list[int] = []
-        self.a_kid: list[int] = []
-        self.a_val: list[int] = []
-        self.a_mi: list[int] = []
-        self.r_txn: list[int] = []
-        self.r_kid: list[int] = []
-        self.r_mi: list[int] = []
-        self.payloads: list[list] = []
-
-
 class ElleSession:
     """Streaming list-append Elle: incremental graph-build columns.
 
@@ -461,19 +250,17 @@ class ElleSession:
 
     def __init__(self, accelerator: str = "auto",
                  consistency_models=("strict-serializable",)):
+        from jepsen_tpu.history_ir.builder import LiveElleColumns
         self.accelerator = accelerator
         self.consistency_models = tuple(consistency_models)
         self.history: list[dict] = []
-        self._last_ev: dict = {}      # process -> (idx, was_invoke)
-        self._ok = _TxnCols()
-        self._info = _TxnCols()
-        self._f_kid: list[int] = []
-        self._f_val: list[int] = []
-        self._kid_of: dict = {}
-        self._raw_key: list = []
-        self._fallback: str | None = None
+        self._cols = LiveElleColumns()
         self._last = {"valid_so_far": True, "first_anomaly_op": None,
                       "backend": "columnar-incremental", "checked_ops": 0}
+
+    @property
+    def _fallback(self):
+        return self._cols.fallback
 
     @property
     def ops_absorbed(self) -> int:
@@ -486,75 +273,10 @@ class ElleSession:
     def last(self) -> dict:
         return dict(self._last)
 
-    def _kid(self, k) -> int:
-        hk = _hk(k)
-        i = self._kid_of.get(hk)
-        if i is None:
-            i = self._kid_of[hk] = len(self._raw_key)
-            self._raw_key.append(k)
-        return i
-
     def add(self, op: dict) -> None:
         i = len(self.history)
         self.history.append(op)
-        typ = op.get("type")
-        if typ not in ("invoke", "ok", "fail", "info"):
-            return
-        p = op.get("process")
-        try:
-            prev = self._last_ev.get(p)
-        except TypeError:  # unhashable process: outside every regime
-            self._fallback = self._fallback or "unhashable process"
-            return
-        self._last_ev[p] = (i, typ == "invoke")
-        if typ == "invoke":
-            return
-        inv = prev[0] if (prev is not None and prev[1]) else None
-        if typ == "fail":
-            for m in op.get("value") or ():
-                if m[0] == "append":
-                    v = m[2]
-                    if not isinstance(v, int) or isinstance(v, bool) \
-                            or not (0 <= v < _MAX_VAL):
-                        self._fallback = "non-int/overflow failed append"
-                        return
-                    self._f_kid.append(self._kid(m[1]))
-                    self._f_val.append(v)
-            return
-        if not isinstance(p, int):
-            return  # not a graph node (batch pint filter)
-        cols = self._ok if typ == "ok" else self._info
-        t = len(cols.pos)
-        cols.pos.append(i)
-        cols.inv.append(-1 if inv is None else inv)
-        cols.proc.append(p)
-        cols.txns.append(op)
-        if self._fallback:
-            return
-        try:
-            for mi, m in enumerate(op.get("value") or ()):
-                if mi >= _MAX_MOPS:
-                    self._fallback = "over-long txn"
-                    return
-                f = m[0]
-                if f == "append":
-                    v = m[2]
-                    if not isinstance(v, int) or isinstance(v, bool) \
-                            or not (0 <= v < _MAX_VAL):
-                        self._fallback = "non-int/overflow append value"
-                        return
-                    cols.a_txn.append(t)
-                    cols.a_kid.append(self._kid(m[1]))
-                    cols.a_val.append(v)
-                    cols.a_mi.append(mi)
-                elif f == "r" and m[2] is not None:
-                    cols.r_txn.append(t)
-                    cols.r_kid.append(self._kid(m[1]))
-                    cols.r_mi.append(mi)
-                    cols.payloads.append(m[2] if type(m[2]) is list
-                                         else list(m[2]))
-        except (TypeError, ValueError, IndexError, OverflowError) as e:
-            self._fallback = f"unflattenable txn: {e!r}"
+        self._cols.absorb(i, op)
 
     def _check_batch(self) -> dict:
         from jepsen_tpu.elle import list_append
@@ -592,9 +314,10 @@ class ElleSession:
         from jepsen_tpu import elle
         from jepsen_tpu.elle import columnar
 
-        if self._fallback or len(self._raw_key) >= _MAX_KIDS:
+        cols = self._cols
+        if cols.fallback or len(cols.raw_key) >= _MAX_KIDS:
             return self._check_batch()
-        ok, info = self._ok, self._info
+        ok, info = cols.ok, cols.info
         n_ok = len(ok.pos)
         txns = ok.txns + info.txns
         if not txns:
@@ -602,7 +325,7 @@ class ElleSession:
                     "anomalies": {}, "txn-count": 0, "edge-count": 0,
                     "builder": "columnar-incremental"}
         parts = columnar._assemble(
-            txns=txns, n_ok=n_ok, raw_key=self._raw_key,
+            txns=txns, n_ok=n_ok, raw_key=cols.raw_key,
             a_txn=ok.a_txn + [n_ok + t for t in info.a_txn],
             a_kid=ok.a_kid + info.a_kid,
             a_val=ok.a_val + info.a_val,
@@ -611,12 +334,12 @@ class ElleSession:
             r_kid=ok.r_kid + info.r_kid,
             r_mi=ok.r_mi + info.r_mi,
             payloads=ok.payloads + info.payloads,
-            f_kid=list(self._f_kid), f_val=list(self._f_val),
+            f_kid=list(cols.f_kid), f_val=list(cols.f_val),
             node_pos=np.asarray(ok.pos + info.pos, np.int64),
             node_inv=np.asarray(ok.inv + info.inv, np.int64),
             node_proc=np.asarray(ok.proc + info.proc, np.int64))
         if parts is None:  # regime miss the per-op checks didn't catch
-            self._fallback = "assemble regime miss"
+            cols.fallback = "assemble regime miss"
             return self._check_batch()
         graph, txns, extras, nk = parts
         cyc = elle.check_cycles(graph, accelerator=self.accelerator)

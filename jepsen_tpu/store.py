@@ -150,69 +150,56 @@ def first_client_f(history) -> str | None:
 
 
 def write_columnar(test: dict) -> None:
-    """history.npz: the struct-of-arrays sidecar, checker-ready (the
+    """history.npz: the serialized history IR, checker-ready (the
     EDN->numpy serialization of BASELINE's north star, built at save
-    time). List-append histories additionally persist the Elle builder
-    columns (``elle_*`` keys) so a later re-check runs straight off
-    arrays with no PyObject parse (elle.columnar.check_columns)."""
-    import numpy as np
-    from jepsen_tpu.history import ColumnarHistory
+    time). The sidecar is the IR's persistence format
+    (jepsen_tpu.history_ir.sidecar): canonical packed columns + the
+    value intern table, plus the derived view products — ``elle_*``
+    Elle builder columns and ``lin_*`` register EventStream — so later
+    re-checks run straight off arrays with no PyObject parse. Views are
+    derived through the run's shared IR (``history_ir.of``), so a run
+    whose checkers already encoded pays nothing extra here."""
+    from jepsen_tpu import history_ir
+    from jepsen_tpu.history_ir import sidecar
     history = test.get("history") or []
     if not history:
         return
-    col = ColumnarHistory.from_ops(history)
-    extra = {}
-    try:
-        from jepsen_tpu.elle import columnar as _ecol
-        ecols = _ecol.parse_columns(history)
-        if ecols is not None:
-            extra = {f"elle_{k}": v for k, v in ecols.items()}
-    except Exception:  # noqa: BLE001 - the sidecar is an optimization
-        pass
-    # single-register histories additionally persist the encoded
-    # EventStream (lin_* keys) so linearizability re-checks skip the
-    # jsonl + re-encoding (checker/linearizable.check_stored). Cheap
-    # shape probe first: the encoder's pairing pre-pass is a full O(n)
-    # walk and must not run on every non-register history
-    first_f = first_client_f(history)
-    if first_f in ("read", "write", "cas"):
-        try:
-            from jepsen_tpu.checker.linear_encode import (
-                encode_register_ops, stream_to_columns)
-            lcols = stream_to_columns(encode_register_ops(history))
-            if lcols is not None:
-                extra.update({f"lin_{k}": v for k, v in lcols.items()})
-        except Exception:  # noqa: BLE001 - wrong shape after all
-            pass
-    np.savez_compressed(
-        path_mk(test, "history.npz"),
-        types=col.types, processes=col.processes, fs=col.fs,
-        times=col.times, indices=col.indices,
-        completion_of=col.completion_of, invocation_of=col.invocation_of,
-        f_table=np.asarray(col.f_table, dtype=object),
-        **extra,
-    )
+    dh = history_ir.of(test, history)
+    if dh is None:  # ir_enabled: False still persists a sidecar
+        dh = history_ir.DeviceHistory.from_ops(history)
+    sidecar.save(path_mk(test, "history.npz"), dh)
 
 
 def load_columnar(test_name: str, timestamp: str, store_dir: str = BASE_DIR):
-    """Reloads the .npz sidecar as a ColumnarHistory (sans Python values
-    — those live in history.jsonl). This is the restart format for
-    checker jobs (SURVEY.md §5.4: analysis is re-entrant; the columnar
-    sidecar skips the jsonl parse + re-encoding on re-check)."""
-    import numpy as np
-    from jepsen_tpu.history import ColumnarHistory
+    """Reloads the .npz sidecar as a DeviceHistory (the history IR,
+    sans Python op dicts — those live in history.jsonl). This is the
+    restart format for checker jobs (SURVEY.md §5.4: analysis is
+    re-entrant; the sidecar skips the jsonl parse + re-encoding on
+    re-check). DeviceHistory subclasses the old ColumnarHistory return
+    type, so existing callers are unaffected."""
+    from jepsen_tpu.history_ir import sidecar
     p = path({"name": test_name, "start_time": timestamp,
               "store_dir": store_dir}, "history.npz")
-    with np.load(p, allow_pickle=True) as z:
-        # archives from before the f_table key degrade to int codes only
-        f_table = ([None if x is None else str(x) for x in z["f_table"]]
-                   if "f_table" in z else [])
-        return ColumnarHistory(
-            types=z["types"], processes=z["processes"], fs=z["fs"],
-            times=z["times"], indices=z["indices"],
-            completion_of=z["completion_of"],
-            invocation_of=z["invocation_of"],
-            f_table=f_table)
+    return sidecar.load(p)
+
+
+def note_sidecar_load_failure(what: str, exc: BaseException | None = None) -> None:
+    """A corrupt/unreadable history.npz sidecar fell back to the jsonl
+    history: log it and bump ``store_sidecar_load_failures_total`` so
+    the fallback is visible in telemetry instead of silent (the
+    pre-IR code swallowed these bare)."""
+    logger.warning("history.npz sidecar unreadable for %s (%r); "
+                   "falling back to history.jsonl", what, exc)
+    try:
+        from jepsen_tpu import telemetry
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter(
+                "store_sidecar_load_failures_total",
+                "corrupt/unreadable history.npz sidecars that fell "
+                "back to the jsonl history").inc()
+    except Exception:  # noqa: BLE001 — telemetry never blocks a fallback
+        logger.exception("sidecar-failure telemetry recording failed")
 
 
 def _load_prefixed(test_name: str, timestamp: str, store_dir: str,

@@ -19,11 +19,13 @@ class AppendChecker(Checker):
         return "elle-list-append"
 
     def check(self, test, history, opts):
+        from jepsen_tpu import history_ir
         result = list_append.check(
             history,
             accelerator=opts.get("accelerator", self.accelerator),
             consistency_models=opts.get("consistency_models",
-                                        self.consistency_models))
+                                        self.consistency_models),
+            ir=history_ir.of(test, history))
         # invalid check: leave human-readable per-anomaly explanation
         # files under store/<test>/<ts>/elle/ (the reference passes
         # elle :directory per test, append.clj:17-22)

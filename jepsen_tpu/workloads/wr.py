@@ -19,11 +19,13 @@ class WrChecker(Checker):
         return "elle-rw-register"
 
     def check(self, test, history, opts):
+        from jepsen_tpu import history_ir
         result = rw_register.check(
             history,
             accelerator=opts.get("accelerator", self.accelerator),
             consistency_models=opts.get("consistency_models",
-                                        self.consistency_models))
+                                        self.consistency_models),
+            ir=history_ir.of(test, history))
         # same artifact surface as the list-append checker: per-anomaly
         # explanation files in the run's elle/ directory when invalid
         from jepsen_tpu.elle import artifacts

@@ -1,0 +1,577 @@
+"""History-IR differential tier (``-m ir``): one device-resident
+columnar IR, encoded once, every checker a zero-copy view.
+
+Pins ISSUE 11's acceptance bars:
+
+* IR-derived views == legacy encoder outputs **bit-identically** —
+  register EventStream (batch view vs the live incremental encoder),
+  Elle builder columns, the independent per-key split, the set-full
+  membership encode — on register / list-append / wr / independent
+  histories including planted anomalies;
+* the WAL-streamed incremental build is bit-identical to the batch
+  build, survives torn-WAL resume, and REJECTS a diverged stream;
+* a multi-checker run encodes exactly once (the memoized-view
+  identity);
+* the ``history.npz`` sidecar round-trips the IR (canonical columns +
+  codec-encoded intern table) and a corrupt sidecar falls back to the
+  jsonl visibly (``store_sidecar_load_failures_total``);
+* the new knobs preflight-validate and the ``no-host-roundtrip`` lint
+  rule fires/waives.
+"""
+import json
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.history import ColumnarHistory, Intern
+from jepsen_tpu.history_ir import (
+    DeviceHistory, IncrementalHistoryBuilder, WalStreamer, of,
+)
+from jepsen_tpu.history_ir import sidecar, views
+from jepsen_tpu.history_ir.builder import LiveRegisterEncoder
+
+pytestmark = pytest.mark.ir
+
+CANONICAL = ("types", "processes", "fs", "times", "indices",
+             "completion_of", "invocation_of")
+STREAM_COLS = ("kind", "slot", "f", "a", "b", "op_index")
+
+
+def _register_history(n, seed=7, planted_at=None, n_procs=4):
+    from __graft_entry__ import _register_history as gen
+    h = gen(n, n_procs=n_procs, seed=seed, n_values=5)
+    if planted_at is not None:
+        for i, op in enumerate(h):
+            if i >= planted_at and op.get("type") == "ok" \
+                    and op.get("f") == "read" \
+                    and op.get("value") is not None:
+                op["value"] = op["value"] + 10_000
+                return h, i
+        raise AssertionError("no read to corrupt")
+    return h, None
+
+
+def _messy_register_history(n=120, seed=3):
+    """Fuzzed register history with fails, infos, crashed reads,
+    nemesis ops, and an open tail — every drop rule the encoder has."""
+    rng = random.Random(seed)
+    h = []
+    open_p = {}
+    for i in range(n):
+        p = rng.randrange(5)
+        if p in open_p:
+            f, v = open_p.pop(p)
+            typ = rng.choice(["ok", "ok", "ok", "fail", "info"])
+            val = (rng.randrange(5) if typ == "ok" and f == "read"
+                   else v)
+            h.append({"type": typ, "process": p, "f": f, "value": val,
+                      "time": i})
+        elif rng.random() < 0.1:
+            h.append({"type": "info", "process": "nemesis", "f": "kill",
+                      "value": None, "time": i})
+        else:
+            f = rng.choice(["read", "write", "cas"])
+            v = (None if f == "read" else rng.randrange(5) if f == "write"
+                 else [rng.randrange(5), rng.randrange(5)])
+            open_p[p] = (f, v)
+            h.append({"type": "invoke", "process": p, "f": f, "value": v,
+                      "time": i})
+    return h  # some invokes stay open: the crashed-tail rules apply
+
+
+def _elle_history(n_txns=60, anomalous=False):
+    h, t = [], 0
+    for i in range(n_txns):
+        k = i % 3
+        seen = list(range(k, i + 1, 3))
+        h.append({"type": "invoke", "process": i % 4,
+                  "value": [["append", k, i], ["r", k, None]], "time": t})
+        h.append({"type": "ok", "process": i % 4,
+                  "value": [["append", k, i], ["r", k, seen]], "time": t + 1})
+        t += 2
+    if anomalous:
+        # a wr 2-cycle on fresh keys (G1c)
+        for (p, ka, kb, va, vb) in [(8, 100, 101, 9000, 9001)]:
+            h.append({"type": "invoke", "process": p,
+                      "value": [["append", ka, va], ["r", kb, None]],
+                      "time": t})
+            h.append({"type": "ok", "process": p,
+                      "value": [["append", ka, va], ["r", kb, [vb]]],
+                      "time": t + 1})
+            h.append({"type": "invoke", "process": p + 1,
+                      "value": [["append", kb, vb], ["r", ka, None]],
+                      "time": t + 2})
+            h.append({"type": "ok", "process": p + 1,
+                      "value": [["append", kb, vb], ["r", ka, [va]]],
+                      "time": t + 3})
+    return h
+
+
+@pytest.fixture
+def registry():
+    reg = telemetry.Registry()
+    prev = telemetry.install(reg)
+    try:
+        yield reg
+    finally:
+        telemetry.install(prev)
+
+
+# ---------------------------------------------------------------------------
+# IR core: promotion + incremental build
+# ---------------------------------------------------------------------------
+
+def test_device_history_promotes_columnar():
+    h = _messy_register_history()
+    dh = DeviceHistory.from_ops(h)
+    base = ColumnarHistory.from_ops(h)
+    assert isinstance(dh, ColumnarHistory)
+    for name in CANONICAL:
+        assert np.array_equal(getattr(dh, name), getattr(base, name)), name
+    # value ids round-trip through the intern table
+    assert dh.value_ids is not None and len(dh.value_ids) == len(h)
+    for op, vid in zip(h, dh.value_ids.tolist()):
+        assert dh.intern.value(vid) == op.get("value")
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_incremental_builder_bit_identical(seed):
+    h = _messy_register_history(seed=seed)
+    b = IncrementalHistoryBuilder()
+    b.extend(h)
+    inc, ref = b.snapshot(), DeviceHistory.from_ops(h)
+    for name in CANONICAL + ("value_ids",):
+        assert np.array_equal(getattr(inc, name), getattr(ref, name)), name
+    assert inc.f_table == ref.f_table
+    assert inc.intern.table == ref.intern.table
+
+
+def test_wal_streamed_builder_torn_resume(tmp_path):
+    """Chunked WAL writes with an in-progress (unterminated) line midway:
+    the tailer resumes past it once completed, and the streamed IR is
+    bit-identical to the batch build."""
+    h = _messy_register_history(n=80, seed=9)
+    wal = tmp_path / "history.wal.jsonl"
+    s = WalStreamer(wal, poll_interval_s=0.01)
+    # drive the tailer by hand (deterministic: no thread timing)
+    lines = [json.dumps(op) for op in h]
+    with open(wal, "w") as f:
+        f.write("\n".join(lines[:30]) + "\n")
+        f.flush()
+        s.builder.absorb_wal(s.tailer)
+        assert len(s.builder) == 30
+        f.write(lines[30][:10])       # torn in-progress line
+        f.flush()
+        s.builder.absorb_wal(s.tailer)
+        assert len(s.builder) == 30   # offset must NOT advance past it
+        f.write(lines[30][10:] + "\n")
+        f.write("\n".join(lines[31:]) + "\n")
+        f.flush()
+        s.builder.absorb_wal(s.tailer, final=True)
+    assert len(s.builder) == len(h)
+    s._stop.set()
+    dh = s.snapshot_for(h)
+    assert dh is not None
+    ref = DeviceHistory.from_ops(h)
+    for name in CANONICAL + ("value_ids",):
+        assert np.array_equal(getattr(dh, name), getattr(ref, name)), name
+    # a diverged history is rejected, never adopted
+    bad = [dict(op) for op in h]
+    bad[5]["value"] = "not-what-ran"
+    assert s.snapshot_for(bad) is None
+
+
+def test_ir_stream_from_wal_end_to_end(tmp_path, caplog):
+    """core.run with ir_stream_from_wal: the analyze-time IR is adopted
+    from the stream (log line), the verdict is unchanged."""
+    import logging
+
+    from jepsen_tpu import core
+    from test_core import cas_test
+    test, _ = cas_test(str(tmp_path), n_ops=120, concurrency=4)
+    test["ir_stream_from_wal"] = True
+    with caplog.at_level(logging.INFO, logger="jepsen.history_ir"):
+        result = core.run(test)
+    assert result["results"]["linear"]["valid?"] is True
+    assert any("adopted WAL-streamed history IR" in r.message
+               for r in caplog.records), \
+        "analyze did not adopt the streamed IR"
+
+
+# ---------------------------------------------------------------------------
+# views == legacy encoders, bit-identically
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 4])
+@pytest.mark.parametrize("init_value", [None, 0])
+def test_register_stream_view_bit_identical(seed, init_value):
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    h = _messy_register_history(seed=seed)
+    intern = Intern()
+    if init_value is not None:
+        intern.id(init_value)
+    legacy = encode_register_ops(h, intern=intern)
+    view = views.register_stream(DeviceHistory.from_ops(h),
+                                 init_value=init_value)
+    for name in STREAM_COLS:
+        assert np.array_equal(getattr(legacy, name), getattr(view, name)), name
+    assert legacy.n_slots == view.n_slots
+    assert legacy.n_ops == view.n_ops
+    assert legacy.intern.table == view.intern.table
+
+
+@pytest.mark.parametrize("seed", [2, 5, 8])
+def test_register_view_vs_live_incremental_encoder(seed):
+    """The batch view vs the genuinely separate incremental state
+    machine the live sessions use — two implementations, one event
+    sequence."""
+    h = _messy_register_history(seed=seed)
+    enc = LiveRegisterEncoder(Intern())
+    for op in h:
+        enc.add(op)
+    enc.finalize()
+    live = enc.stream.to_event_stream()
+    view = views.register_stream(DeviceHistory.from_ops(h))
+    for name in STREAM_COLS:
+        assert np.array_equal(getattr(live, name), getattr(view, name)), name
+    assert live.n_slots == view.n_slots
+    assert live.intern.table == view.intern.table
+
+
+@pytest.mark.parametrize("anomalous", [False, True])
+def test_elle_view_matches_legacy_and_oracle(anomalous):
+    from jepsen_tpu.elle import list_append
+    h = _elle_history(anomalous=anomalous)
+    test = {"name": "elle-ir"}
+    with_ir = list_append.check(h, accelerator="auto", ir=of(test, h))
+    legacy = list_append.check(h, accelerator="auto")
+    oracle = list_append.check(h, accelerator="cpu")
+    assert with_ir["valid?"] == legacy["valid?"] == oracle["valid?"] \
+        == (not anomalous)
+    assert (sorted(with_ir.get("anomaly-types") or [])
+            == sorted(legacy.get("anomaly-types") or [])
+            == sorted(oracle.get("anomaly-types") or []))
+    if anomalous:
+        assert "G1c" in with_ir["anomaly-types"]
+
+
+def test_wr_checker_ir_on_off_identical():
+    from jepsen_tpu.workloads import wr as wr_mod
+    rng = random.Random(1)
+    h, t = [], 0
+    for i in range(40):
+        k = i % 3
+        mops = [["w", k, i], ["r", k, i]]
+        h.append({"type": "invoke", "process": i % 4, "f": "txn",
+                  "value": [["w", k, None], ["r", k, None]], "time": t})
+        h.append({"type": "ok", "process": i % 4, "f": "txn",
+                  "value": mops, "time": t + 1})
+        t += 2
+    chk = wr_mod.checker(accelerator="cpu")
+    r_ir = chk.check({"name": "wr-ir"}, h, {})
+    r_off = chk.check({"name": "wr-off", "ir_enabled": False}, h, {})
+    assert r_ir["valid?"] == r_off["valid?"]
+    assert (r_ir.get("anomaly-types") or []) == \
+        (r_off.get("anomaly-types") or [])
+
+
+def test_linearizable_checker_ir_on_off_identical():
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    h, planted = _register_history(300, seed=5, planted_at=150)
+    chk = LinearizableChecker(accelerator="cpu")
+    on = chk.check({"name": "ir-on"}, h, {})
+    off = chk.check({"name": "ir-off", "ir_enabled": False}, h, {})
+    assert on["valid?"] is False and off["valid?"] is False
+    assert on["failed-op"] == off["failed-op"]
+    assert on["algorithm"] == off["algorithm"]
+
+
+def test_independent_ir_on_off_identical():
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.independent import checker as lift, tuple_value
+    rng = random.Random(2)
+    h, t = [], 0
+    for i in range(200):
+        k, p = rng.randrange(4), rng.randrange(6)
+        f = rng.choice(["read", "write"])
+        v = None if f == "read" else rng.randrange(5)
+        h.append({"type": "invoke", "process": p, "f": f,
+                  "value": tuple_value(k, v), "time": t})
+        h.append({"type": "ok", "process": p, "f": f,
+                  "value": tuple_value(k, v if v is not None else 0),
+                  "time": t + 1})
+        t += 2
+    chk = lift(linearizable(accelerator="cpu"))
+    on = chk.check({"name": "ind-on"}, h, {})
+    off = chk.check({"name": "ind-off", "ir_enabled": False}, h, {})
+    assert on["valid?"] == off["valid?"]
+    assert on["count"] == off["count"] == 4
+    assert sorted(on["results"]) == sorted(off["results"])
+
+
+def test_set_full_view_matches_cpu_oracle():
+    from jepsen_tpu.checker import SetFullChecker
+    rng = random.Random(3)
+    h, t, added = [], 0, []
+    for i in range(60):
+        if rng.random() < 0.7 or not added:
+            h.append({"type": "invoke", "process": i % 3, "f": "add",
+                      "value": i, "time": t})
+            h.append({"type": "ok", "process": i % 3, "f": "add",
+                      "value": i, "time": t + 1})
+            added.append(i)
+        else:
+            seen = [x for x in added if rng.random() < 0.9]
+            h.append({"type": "invoke", "process": i % 3, "f": "read",
+                      "value": None, "time": t})
+            h.append({"type": "ok", "process": i % 3, "f": "read",
+                      "value": seen, "time": t + 1})
+        t += 2
+    h.append({"type": "invoke", "process": 0, "f": "read", "value": None,
+              "time": t})
+    h.append({"type": "ok", "process": 0, "f": "read", "value": added,
+              "time": t + 1})
+    test = {"name": "set-ir"}
+    dev = SetFullChecker(accelerator="auto").check(test, h, {})
+    cpu = SetFullChecker(accelerator="cpu").check({"name": "s2"}, h, {})
+    for key in ("valid?", "attempt-count", "stable-count", "lost-count",
+                "never-read-count", "stale-count"):
+        assert dev[key] == cpu[key], key
+    # the encode was memoized as an IR view on the shared test map
+    assert ("set-full",) in test["_history_ir"].view_keys()
+
+
+def test_multi_checker_run_encodes_once():
+    from jepsen_tpu.checker import compose
+    from jepsen_tpu.checker.linearizable import linearizable
+    h, _ = _register_history(400, seed=6)
+    test = {"name": "compose-ir"}
+    chk = compose({"a": linearizable(accelerator="cpu"),
+                   "b": linearizable(accelerator="cpu")})
+    out = chk.check(test, h, {})
+    assert out["a"]["valid?"] is True and out["b"]["valid?"] is True
+    ir = test["_history_ir"]
+    keys = [k for k in ir.view_keys() if k[0] == "register-stream"]
+    assert len(keys) == 1, f"two checkers built {len(keys)} streams"
+    # and the view object is shared: a third ask is the same stream
+    s1 = views.register_stream(ir)
+    assert views.register_stream(ir) is s1
+
+
+# ---------------------------------------------------------------------------
+# sidecar + codec round-trip
+# ---------------------------------------------------------------------------
+
+def test_sidecar_roundtrip(tmp_path):
+    h = _messy_register_history(n=60, seed=11)
+    dh = DeviceHistory.from_ops(h)
+    p = tmp_path / "history.npz"
+    sidecar.save(p, dh)
+    back = sidecar.load(p)
+    for name in CANONICAL + ("value_ids",):
+        assert np.array_equal(getattr(back, name), getattr(dh, name)), name
+    assert back.f_table == dh.f_table
+    assert back.intern.table == dh.intern.table  # codec round-trip
+    # register shape: the lin_* stream columns rode along
+    with np.load(p, allow_pickle=True) as z:
+        assert "lin_n_slots" in z.files
+        assert "val_table" in z.files
+
+
+def test_store_write_load_columnar_is_ir(tmp_path):
+    from jepsen_tpu import store
+    h = _messy_register_history(n=40, seed=12)
+    test = {"name": "sc", "start_time": "20260804T000000.000",
+            "store_dir": str(tmp_path), "history": h}
+    store.write_columnar(test)
+    back = store.load_columnar("sc", "20260804T000000.000", str(tmp_path))
+    assert isinstance(back, DeviceHistory)
+    ref = DeviceHistory.from_ops(h)
+    for name in CANONICAL:
+        assert np.array_equal(getattr(back, name), getattr(ref, name)), name
+    # the run's shared IR was attached (write reused/of built it)
+    assert isinstance(test["_history_ir"], DeviceHistory)
+
+
+def test_codec_intern_roundtrip():
+    from jepsen_tpu.history_ir.ir import ValueIntern
+    intern = ValueIntern()
+    for v in (1, "s", [1, 2], {"a": 1}, None, 2.5, [["append", 3, 4]]):
+        intern.id(v)
+    rows = sidecar.intern_to_rows(intern)
+    assert rows is not None
+    back = sidecar.intern_from_rows(rows)
+    assert back.table == intern.table
+    # non-JSON values: table not serializable, sidecar omits values
+    intern.id(object())
+    assert sidecar.intern_to_rows(intern) is None
+
+
+def test_corrupt_sidecar_falls_back_visibly(tmp_path, registry):
+    """check_stored over a corrupt history.npz: verdict still produced
+    from the jsonl, and store_sidecar_load_failures_total counts it."""
+    from jepsen_tpu.checker.linearizable import check_stored
+    h, _ = _register_history(80, seed=13)
+    d = tmp_path / "runf" / "20260804T000000.000"
+    d.mkdir(parents=True)
+    with open(d / "history.jsonl", "w") as f:
+        for op in h:
+            f.write(json.dumps(op) + "\n")
+    (d / "history.npz").write_bytes(b"this is not a zip archive")
+    out = check_stored("runf", "20260804T000000.000", str(tmp_path),
+                       accelerator="cpu")
+    assert out["valid?"] is True
+    assert "store_sidecar_load_failures_total" in registry.render_prom(), \
+        "sidecar failure not counted"
+
+
+# ---------------------------------------------------------------------------
+# knobs + lint
+# ---------------------------------------------------------------------------
+
+def test_preflight_ir_knobs():
+    from jepsen_tpu.analysis.preflight import _check_knobs
+    errs = _check_knobs({"ir_enabled": "banana"})
+    assert any(d.code == "KNB001" and d.path == "ir_enabled"
+               for d in errs)
+    warns = _check_knobs({"ir_stream_from_wal": "true"})
+    assert any(d.code == "KNB006" and d.path == "ir_stream_from_wal"
+               for d in warns)
+    assert not _check_knobs({"ir_enabled": True,
+                             "ir_stream_from_wal": False})
+
+
+def test_lint_no_host_roundtrip(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import numpy as np\n\n\n"
+        "def bad(dh):\n"
+        "    cols, n = dh.device_columns()\n"
+        "    kind = cols['kind']\n"
+        "    return np.asarray(kind)\n\n\n"
+        "def waived(dh):\n"
+        "    cols, n = dh.device_columns()\n"
+        "    return cols['kind'].tolist()  "
+        "# lint: ignore[no-host-roundtrip]\n\n\n"
+        "def clean(dh):\n"
+        "    cols = {'kind': [1]}\n"
+        "    return np.asarray(cols['kind'])\n\n\n"
+        "def rebound(dh, host):\n"
+        "    cols, n = dh.device_columns()\n"
+        "    cols = host['summary']\n"
+        "    return np.asarray(cols)\n")
+    from jepsen_tpu.analysis.lint import lint_paths
+    rep = lint_paths([str(mod)], baseline=None)
+    hits = [f for f in rep.findings if f.rule == "no-host-roundtrip"]
+    assert len(hits) == 1 and hits[0].qualname == "bad", hits
+
+
+@pytest.mark.mesh
+def test_ir_streams_mesh_vs_single_device():
+    """IR-derived per-key streams through the sharded batch dispatch:
+    mesh and single-device verdicts are bit-identical (the IR feeds the
+    `sharded-matrix`/key-sharded lanes without changing results)."""
+    import jax
+
+    from jepsen_tpu.parallel import batch_check, get_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest-forced 8-device virtual mesh")
+    streams = []
+    for k in range(16):
+        h = _messy_register_history(n=60, seed=100 + k)
+        streams.append(views.register_stream(DeviceHistory.from_ops(h)))
+    single = batch_check(streams, mesh=False)
+    mesh = batch_check(streams, mesh=get_mesh(8))
+    assert [r[0] for r in single] == [r[0] for r in mesh]
+    assert [r[1] for r in single] == [r[1] for r in mesh]
+
+
+def test_device_columns_placement_and_memo():
+    """The canonical-column device placement: whole-array single-device
+    staging, mesh padding to a device multiple with inert pad rows, and
+    per-mesh memoization."""
+    import jax
+
+    h = _messy_register_history(n=30, seed=21)
+    dh = DeviceHistory.from_ops(h)
+    cols, n = dh.device_columns()
+    assert n == len(h)
+    assert np.array_equal(np.asarray(cols["types"]), dh.types)
+    assert dh.device_columns()[0] is cols  # memoized
+    if len(jax.devices()) >= 8:
+        from jepsen_tpu.parallel import get_mesh
+        mesh = get_mesh(8)
+        mcols, mn = dh.device_columns(mesh)
+        assert mn == len(h)
+        B = np.asarray(mcols["types"]).shape[0]
+        assert B % 8 == 0 and B >= len(h)
+        assert np.array_equal(np.asarray(mcols["types"])[:mn], dh.types)
+        # pad rows are inert: no process, no pairing
+        assert (np.asarray(mcols["processes"])[mn:] == -1).all()
+        assert (np.asarray(mcols["completion_of"])[mn:] == -1).all()
+        assert dh.device_columns(mesh)[0] is mcols
+
+
+def test_sidecar_intern_positional_on_json_collision(tmp_path):
+    """Two distinct intern ids whose canonical-JSON rows collide (tuple
+    vs list with equal contents) must keep their positional ids on
+    reload — never deduplicate (value_ids would misalign)."""
+    h = [
+        {"type": "invoke", "process": 0, "f": "w", "value": (1, 2),
+         "time": 0},
+        {"type": "ok", "process": 0, "f": "w", "value": [1, 2], "time": 1},
+        {"type": "invoke", "process": 1, "f": "w", "value": "tail",
+         "time": 2},
+        {"type": "ok", "process": 1, "f": "w", "value": "tail", "time": 3},
+    ]
+    dh = DeviceHistory.from_ops(h)
+    assert len(dh.intern.table) == 4  # None, (1,2), [1,2], 'tail'
+    p = tmp_path / "history.npz"
+    sidecar.save(p, dh)
+    back = sidecar.load(p)
+    assert len(back.intern.table) == len(dh.intern.table)
+    assert np.array_equal(back.value_ids, dh.value_ids)
+    # every id still resolves to (the JSON image of) its own value
+    assert back.intern.value(int(dh.value_ids[2])) == "tail"
+    assert back.intern.value(int(dh.value_ids[1])) == [1, 2]
+
+
+def test_independent_per_key_checks_do_not_evict_run_ir():
+    """The lifted checker's per-key sub-checks must not thrash the
+    run-level _history_ir slot (they see ir_enabled: False)."""
+    from jepsen_tpu.checker import compose
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.independent import checker as lift, tuple_value
+    h, t = [], 0
+    for i in range(40):
+        k, p = i % 3, i % 5
+        h.append({"type": "invoke", "process": p, "f": "write",
+                  "value": tuple_value(k, i), "time": t})
+        h.append({"type": "ok", "process": p, "f": "write",
+                  "value": tuple_value(k, i), "time": t + 1})
+        t += 2
+    test = {"name": "ind-evict"}
+    # a Compose of two linearizables defeats _try_batched -> per-key lane
+    chk = lift(compose({"a": linearizable(accelerator="cpu"),
+                        "b": linearizable(accelerator="cpu")}))
+    out = chk.check(test, h, {})
+    assert out["valid?"] is True
+    ir = test.get("_history_ir")
+    assert ir is not None and ir.ops is h, \
+        "per-key sub-checks evicted the run-level IR"
+    assert ("subhistories",) in ir.view_keys()
+
+
+def test_malformed_history_falls_back_soft():
+    """A history the column encoder can't pack (foreign/hand-edited
+    jsonl: non-numeric time, unhashable process) must not crash the
+    checkers — of() returns None and the legacy encodes serve."""
+    from jepsen_tpu.elle import list_append
+    h = [{"type": "info", "process": ["weird"], "time": "bogus"}]
+    t = {"name": "malformed"}
+    assert of(t, h) is None
+    assert "_history_ir" not in t
+    assert list_append.check(h, accelerator="auto", ir=None)["valid?"] \
+        is True
