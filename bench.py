@@ -269,6 +269,19 @@ def _median(ts):
     return s[len(s) // 2]
 
 
+def _combine_reduction(keys, chunks, mv, fused) -> float:
+    """tree/fused modeled-HBM-byte ratio of the chunk combine — the
+    fused combine's designed win (1.0 when the tree ran: the regression
+    signal). Shared by matrix_kernel_128k and the segmented scale
+    metric so the two can't silently diverge."""
+    if not fused:
+        return 1.0
+    return round(
+        telemetry.combine_modeled_hbm_bytes(keys, chunks, mv, False)
+        / max(telemetry.combine_modeled_hbm_bytes(keys, chunks, mv, True),
+              1), 2)
+
+
 def _spread(times, scale: float):
     """Spread extras for emit(): rates at the median/min/max timings."""
     ts = sorted(times)
@@ -651,7 +664,7 @@ def cfg_matrix_kernel():
     m, t_matrix = _trials(matrix_phased, 5)
     dt_matrix, extras = _spread(t_matrix, E)
     try:
-        from jepsen_tpu.ops.jitlin import _matrix_plan
+        from jepsen_tpu.ops.jitlin import _matrix_plan, last_dispatch_info
         Vb = _bucket(V, 8)
         C_plan, _T = _matrix_plan(1, S, n_returns, Vb, None)
         extras.update(telemetry.matrix_phase_model(
@@ -659,8 +672,50 @@ def cfg_matrix_kernel():
         for ph in ("prepass", "grids", "dispatch", "fetch"):
             vals = sorted(p.get(ph, 0.0) for p in phase_trials)
             extras[f"phase_{ph}_s"] = vals[len(vals) // 2]
+        # combine-stage HBM share + routing labels: which kernel
+        # representation and combine path the dispatch actually ran
+        # (probe-selected — "scan"/"tree" on backends without pallas),
+        # and the modeled combine traffic over wall time and measured
+        # bandwidth. The tree/fused byte ratio is the fused combine's
+        # designed win; both are on record so a routing regression is
+        # visible in one diff.
+        info = last_dispatch_info()
+        MV = (1 << S) * Vb
+        fused = info.get("combine") == "fused"
+        bw = device_roofline()["hbm_bytes_per_sec"]
+        cb = telemetry.combine_modeled_hbm_bytes(1, C_plan, MV, fused)
+        extras.update(
+            matrix_variant=info.get("variant", "unknown"),
+            combine_path=info.get("combine", "unknown"),
+            combine_modeled_hbm_bytes=cb,
+            combine_hbm_frac=round((cb / dt_matrix) / bw, 6),
+            combine_fused_reduction=_combine_reduction(
+                1, C_plan, MV, fused))
+        from jepsen_tpu.ops import pallas_matrix
+        extras["pallas_probe_seconds"] = round(
+            pallas_matrix.probe_seconds(), 4)
     except Exception:
         print("[bench] phase attribution failed:", file=sys.stderr)
+        traceback.print_exc()
+
+    # per-variant attribution (ISSUE 12): each representation measured
+    # through the SAME production dispatch with the variant pinned —
+    # probe-gated, so on a backend where a variant can't run the
+    # `*_ran` label records what actually executed instead of lying
+    # with a zero
+    try:
+        from jepsen_tpu.ops import pallas_matrix
+        from jepsen_tpu.ops.jitlin import last_dispatch_info
+        for v in pallas_matrix.VARIANTS:
+            _, t_v = _trials(lambda v=v: matrix_check(stream, variant=v), 2)
+            dt_v = min(t_v)
+            ran = last_dispatch_info().get("variant", "unknown")
+            extras[f"events_per_sec_{v}"] = round(E / dt_v, 2)
+            extras[f"roofline_frac_{v}"] = matrix_roofline_extras(
+                n_returns, S, V, dt_v)["roofline_frac"]
+            extras[f"variant_ran_{v}"] = ran
+    except Exception:
+        print("[bench] per-variant attribution failed:", file=sys.stderr)
         traceback.print_exc()
 
     batch = pad_streams([stream], length=_bucket(E))
@@ -896,6 +951,25 @@ def cfg_scale(device_rate: float):
         if ts and max(ts) > 5 * max(med_seg, 0.1):
             extra["stall"] = (f"tunnel stall: worst segment "
                               f"{max(ts)}s vs median {med_seg}s")
+        try:
+            # fused-combine attribution for the segmented path: the
+            # routing the chain's dispatches actually took, and the
+            # modeled tree/fused HBM-byte ratio the fusion delivers
+            # (1.0 = tree combine ran — the regression signal)
+            from jepsen_tpu.ops.jitlin import (
+                _bucket as _bk, _matrix_plan as _mp, last_dispatch_info)
+            info = last_dispatch_info()
+            Vb = _bk(n_values + 1, 8)
+            MVs = (1 << N_PROCS) * Vb
+            Cs, _Ts = _mp(1, N_PROCS, seg_events // 2, Vb, None)
+            fused = info.get("combine") == "fused"
+            extra["combine_path"] = info.get("combine", "unknown")
+            extra["matrix_variant"] = info.get("variant", "unknown")
+            extra["combine_fused_reduction"] = _combine_reduction(
+                1, Cs, MVs, fused)
+        except Exception:
+            print("[bench] combine attribution failed:", file=sys.stderr)
+            traceback.print_exc()
         if overflow:
             extra["uncounted_overflow_segment"] = overflow
         if failure:

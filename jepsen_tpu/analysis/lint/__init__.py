@@ -37,6 +37,7 @@ RULES = (
     ("donation-reuse", rules_jax.donation_reuse, None),
     ("recompile-hazard", rules_jax.recompile_hazard, None),
     ("no-host-roundtrip", rules_jax.no_host_roundtrip, None),
+    ("threshold-dtype", rules_jax.threshold_dtype, None),
     ("thread-owner", None, rules_concurrency.thread_owner),
     ("no-unbounded-block", None, rules_concurrency.no_unbounded_block),
 )

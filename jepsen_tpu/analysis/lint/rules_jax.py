@@ -22,6 +22,19 @@
   tunnel the IR exists to avoid. Waivable per line with
   ``# lint: ignore[no-host-roundtrip]`` when a host read is the point
   (e.g. a final verdict gather).
+* ``threshold-dtype`` (JTJ005) — ``jnp.dot(...,
+  preferred_element_type=jnp.float32)`` whose result feeds a ``> 0``
+  threshold, in kernel scope. The threshold is the proof the operands
+  live in the boolean 0/1 semiring (the product is consumed as
+  reachability, not magnitude), and an f32 matmul then computes
+  AND/OR at 1/4 the MXU's int8 operand density — the pattern the
+  packed-boolean kernel rework removed (ops/pallas_matrix.py,
+  doc/performance.md "Packed boolean kernels"). Kernel scope =
+  proven-jitted functions, plus every function of a module that
+  imports pallas (kernel bodies there are reached through
+  ``pallas_call`` indirections the jit index can't always prove).
+  Waivable per line where f32 is load-bearing (e.g. the probe-verified
+  terminal fallback variant every backend can lower).
 
 The jit rules only scan modules that import ``jax`` (or pallas), and
 only the bodies of functions proven jitted: decorated with ``jit`` /
@@ -474,6 +487,102 @@ def _mentions(node, names) -> str | None:
         if isinstance(sub, ast.Name) and sub.id in names:
             return sub.id
     return None
+
+
+def _imports_pallas(mod: ModuleInfo) -> bool:
+    if any("pallas" in v for v in mod.imports.values()):
+        return True
+    return any("pallas" in m or n == "pallas"
+               for m, n in mod.import_names.values())
+
+
+def _is_jnp_dot_f32(call: ast.Call, mod: ModuleInfo) -> bool:
+    """``jnp.dot(..., preferred_element_type=jnp.float32)`` (any alias
+    of jax.numpy as the receiver)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "dot"
+            and isinstance(f.value, ast.Name)):
+        return False
+    target = mod.imports.get(f.value.id)
+    if not (f.value.id == "jnp" or target == "jax.numpy"):
+        return False
+    for k in call.keywords:
+        if k.arg == "preferred_element_type":
+            v = k.value
+            return isinstance(v, ast.Attribute) and v.attr == "float32"
+    return False
+
+
+def _threshold_dot(node, mod: ModuleInfo):
+    """The ``dot > 0`` / ``0 < dot`` threshold Compare; returns the dot
+    Call or None."""
+    if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+        return None
+    op, left, right = node.ops[0], node.left, node.comparators[0]
+    if isinstance(op, ast.Gt) and isinstance(left, ast.Call) \
+            and isinstance(right, ast.Constant) and right.value == 0 \
+            and _is_jnp_dot_f32(left, mod):
+        return left
+    if isinstance(op, ast.Lt) and isinstance(right, ast.Call) \
+            and isinstance(left, ast.Constant) and left.value == 0 \
+            and _is_jnp_dot_f32(right, mod):
+        return right
+    return None
+
+
+def threshold_dtype(mod: ModuleInfo) -> list[Finding]:
+    pallas_mod = _imports_pallas(mod)
+    if not pallas_mod and not _imports_jax(mod):
+        return []
+    # kernel scope: proven-jitted/pallas bodies; in a pallas-importing
+    # module, every function (kernel defs there reach pallas_call
+    # through closures and name indirections the index can't prove)
+    if pallas_mod:
+        spans = list(mod.functions.values())
+    else:
+        idx = _JitIndex(mod)
+        spans = [mod.functions[q] for q in idx.traced
+                 if q in mod.functions]
+    if not spans:
+        return []
+
+    def innermost(lineno):
+        best = None
+        for fi in spans:
+            if fi.lineno <= lineno <= fi.end_lineno:
+                if best is None or fi.lineno > best.lineno:
+                    best = fi
+        return best
+
+    out: list[Finding] = []
+    seen: set = set()
+    for node in ast.walk(mod.tree):
+        dot = _threshold_dot(node, mod)
+        if dot is None:
+            continue
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+        fi = innermost(node.lineno)
+        if fi is None or "threshold-dtype" in fi.ignores:
+            continue
+        if "threshold-dtype" in (mod.line_ignores(node.lineno)
+                                 | mod.line_ignores(dot.lineno)):
+            continue
+        out.append(Finding(
+            rule="threshold-dtype", code="JTJ005",
+            path=mod.relpath, line=dot.lineno,
+            col=dot.col_offset + 1, qualname=fi.qualname,
+            message="thresholded f32 dot: the > 0 test proves the "
+                    "operands live in the 0/1 boolean semiring, and an "
+                    "f32 matmul computes that AND/OR at 1/4 the MXU's "
+                    "int8 operand density",
+            hint="feed int8 0/1 operands with preferred_element_type="
+                 "jnp.int32 (or the bit-packed uint32 path) and keep "
+                 "the > 0 threshold; waive with # lint: "
+                 "ignore[threshold-dtype] where f32 is load-bearing"))
+    return out
 
 
 def no_host_roundtrip(mod: ModuleInfo) -> list[Finding]:

@@ -60,6 +60,8 @@ KNB003 error    concurrency invalid
 KNB004 warning  concurrency leaves nodes without a client worker
 KNB005 warning  per-op deadline exceeds the run's time limit
 KNB006 warning  stringly-typed numeric knob
+KNB007 error    enum knob outside its value set (matrix_variant, env
+                routing knobs)
 CHK001 warning  checker model doesn't recognize enumerated ops
 ====== ======== ======================================================
 """
@@ -71,6 +73,7 @@ import decimal
 import dis
 import fractions
 import logging
+import os
 import pathlib
 import re
 import types
@@ -388,11 +391,39 @@ _NUMERIC_KNOBS = (
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
 # bools and 0/1 pass, yes/no strings warn, garbage errors here instead
 # of silently reading as unset): the sharded-rung switch, the
-# anomaly-forensics switch, and the history-IR switches
-# (doc/performance.md "History IR")
+# anomaly-forensics switch, the history-IR switches
+# (doc/performance.md "History IR"), and the fused-combine toggle
+# (doc/performance.md "Packed boolean kernels")
 _BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
-               "ir_stream_from_wal")
+               "ir_stream_from_wal", "combine_fused")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
+
+# enum knobs, tolerantly coerced at runtime (pallas_matrix
+# coerce_variant / _env_choice — garbage warns and reads as unset/auto;
+# preflight is where it becomes an error). Each entry: (knob, value
+# set, hint). The variant set is DERIVED from pallas_matrix.VARIANTS
+# (module-level imports there are stdlib+numpy, cheap here) so a new
+# kernel representation can never be rejected by a stale preflight
+# copy. The env rows mirror the test-map rows: a malformed env routing
+# knob silently degrades a whole sweep to the default, so the gate
+# names it before any device contact.
+from jepsen_tpu.ops.pallas_matrix import VARIANTS as _MATRIX_VARIANTS
+
+_VARIANT_VALUES = ("auto",) + _MATRIX_VARIANTS
+_ENUM_KNOBS = (
+    ("matrix_variant", _VARIANT_VALUES,
+     "pins the matrix-kernel representation (probe-gated; a pinned "
+     "variant that can't run demotes down the auto order)"),
+)
+_ENV_ENUM_KNOBS = (
+    ("JEPSEN_TPU_MATRIX_VARIANT", _VARIANT_VALUES,
+     "pins the matrix-kernel representation for this process"),
+    ("JEPSEN_TPU_PALLAS_PROBE", ("auto", "force", "skip"),
+     "probe sidecar policy: auto = cached verdicts, force = re-probe, "
+     "skip = trust the shape gates"),
+    ("JEPSEN_TPU_FUSE_COMBINE", _BOOL_STRINGS,
+     "forces the fused/tree chunk combine (unset = probe decides)"),
+)
 
 _UNSET = object()
 
@@ -460,9 +491,35 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
                                   "(the default) encodes at analyze "
                                   "time",
         }
+        hints["combine_fused"] = (
+            "true pins the fused streaming chunk combine, false the "
+            "tree combine; unset = env default + probe")
         out.append(Diagnostic(
             "KNB001", ERROR, key,
             f"{key} must be a bool, got {v!r}", hint=hints.get(key)))
+
+    for key, values, hint in _ENUM_KNOBS:
+        v = test.get(key, _UNSET)
+        if v is _UNSET or v is None:
+            continue
+        if isinstance(v, str) and v.strip().lower() in values:
+            continue
+        out.append(Diagnostic(
+            "KNB007", ERROR, key,
+            f"{key}={v!r} is not one of {'|'.join(values)}",
+            hint=hint + "; the runtime would warn and fall back to "
+                 "'auto' — fix the test map instead"))
+
+    for key, values, hint in _ENV_ENUM_KNOBS:
+        raw = os.environ.get(key)
+        if raw is None or raw == "":
+            continue
+        if raw.strip().lower() in values:
+            continue
+        out.append(Diagnostic(
+            "KNB007", ERROR, key,
+            f"env {key}={raw!r} is not one of {'|'.join(values)}",
+            hint=hint + "; the runtime would warn and use the default"))
 
     nodes = list(test.get("nodes") or [])
     conc_raw = test.get("concurrency", 1)

@@ -134,6 +134,17 @@ class LinearizableChecker(Checker):
         from jepsen_tpu.checker import explain as explain_mod
         sharded, mesh_devices = par.sharding_knobs(test, opts)
         explain_on = explain_mod.enabled(test, opts)
+        # matrix-kernel routing knobs (doc/performance.md "Packed
+        # boolean kernels"): matrix_variant pins the representation
+        # (probe-gated, demotes down the auto order), combine_fused
+        # pins the combine path; both tolerantly coerced, opts over test
+        from jepsen_tpu.ops import pallas_matrix as pm
+        tmap = test if isinstance(test, dict) else {}
+        matrix_variant = pm.coerce_variant(
+            opts.get("matrix_variant", tmap.get("matrix_variant")))
+        combine_fused = par.coerce_flag(
+            opts.get("combine_fused", tmap.get("combine_fused")),
+            knob="combine_fused")
 
         t0 = time.perf_counter()
         if algorithm == "wgl":
@@ -158,7 +169,9 @@ class LinearizableChecker(Checker):
                                   accelerator, history=history,
                                   sharded=sharded,
                                   mesh_devices=mesh_devices,
-                                  explain=explain_on, extras=extras)
+                                  explain=explain_on, extras=extras,
+                                  matrix_variant=matrix_variant,
+                                  combine_fused=combine_fused)
         self._record_metrics(res, time.perf_counter() - t0, len(stream),
                              stream)
         return self._finish(res, history, test, stream, step_py=step_py,
@@ -170,7 +183,8 @@ class LinearizableChecker(Checker):
     def _search_stream(self, stream, step_py, spec, algorithm,
                        accelerator, history=None, sharded=None,
                        mesh_devices=None, explain=True,
-                       extras=None) -> LinearResult:
+                       extras=None, matrix_variant=None,
+                       combine_fused=None) -> LinearResult:
         """The full encoded-stream dispatch, shared by check() and the
         stored-column re-check lane (module check_stored), routed
         through the :class:`~jepsen_tpu.checker.ladder.BackendLadder`:
@@ -195,6 +209,11 @@ class LinearizableChecker(Checker):
             # verdicts localize on device instead of demoting to a full
             # re-scan just to find the op
             "explain": explain,
+            # matrix-kernel routing (doc/performance.md "Packed boolean
+            # kernels"): pinned representation / combine path, or None
+            # for the probe order
+            "matrix_variant": matrix_variant,
+            "combine_fused": combine_fused,
             # the encoded-stream search applies for jitlin/auto, and for
             # the stored-column lane (no op history to wgl over)
             "stream_path": (algorithm in ("jitlin", "auto")
@@ -289,7 +308,9 @@ class LinearizableChecker(Checker):
             stream, spec = ctx["stream"], ctx["spec"]
             m = matrix_check(stream, step_ids=spec.step_ids,
                              init_state=spec.init_state,
-                             num_states=len(stream.intern))
+                             num_states=len(stream.intern),
+                             variant=ctx.get("matrix_variant"),
+                             combine_fused=ctx.get("combine_fused"))
             # capture the phase split on THIS (possibly watchdog) thread;
             # _search_stream re-publishes it on the checker's thread
             ctx["_matrix_phase"] = last_phase_seconds()
@@ -344,7 +365,9 @@ class LinearizableChecker(Checker):
             m = matrix_check(stream, step_ids=spec.step_ids,
                              init_state=spec.init_state,
                              num_states=len(stream.intern),
-                             mesh=ctx["_sharded_mesh"])
+                             mesh=ctx["_sharded_mesh"],
+                             variant=ctx.get("matrix_variant"),
+                             combine_fused=ctx.get("combine_fused"))
             ctx["_matrix_phase"] = last_phase_seconds()
             res = matrix_settle(ctx, m, "jitlin-tpu-matrix-sharded")
             if res is not None:
@@ -495,8 +518,20 @@ class LinearizableChecker(Checker):
                     "checker_matrix_phase_seconds",
                     "host/device phase split of the last matrix "
                     "dispatch", labels=("phase",))
-                for ph, secs in last_phase_seconds().items():
-                    phase_g.set(secs, phase=ph)
+                split = last_phase_seconds()
+                for ph, secs in split.items():
+                    # the split also carries the routing labels
+                    # (variant / combine) — strings, counted below
+                    if isinstance(secs, (int, float)):
+                        phase_g.set(secs, phase=ph)
+                if "variant" in split:
+                    reg.counter(
+                        "checker_matrix_variant_total",
+                        "matrix dispatches by kernel representation "
+                        "and combine path",
+                        labels=("variant", "combine")).inc(
+                        variant=str(split["variant"]),
+                        combine=str(split.get("combine", "tree")))
         except Exception:  # noqa: BLE001 — telemetry never fails a check
             logger.exception("checker telemetry recording failed")
 

@@ -57,6 +57,46 @@ def publish_phase_seconds(phases: dict) -> None:
     _PHASE.value = dict(phases)
 
 
+# Most recent dispatch routing of the calling thread: which kernel
+# representation ran the chunk products ("f32"/"int8"/"packed", or
+# "scan" for the XLA path) and which combine ("fused"/"tree") — the
+# per-variant labels bench.py attaches to its phase/roofline fields.
+_DISPATCH_INFO = threading.local()
+
+
+def last_dispatch_info() -> dict:
+    """{'variant': ..., 'combine': ...} of the calling thread's most
+    recent matrix dispatch (empty before the first one)."""
+    return dict(getattr(_DISPATCH_INFO, "value", {}))
+
+
+# Per-thread routing overrides (the test-map/opts knobs `matrix_variant`
+# and `combine_fused`, plumbed by checker/linearizable.py): a pinned
+# variant demotes down the probe order when it can't run — PR-3
+# semantics — and `combine_fused=False` pins the tree combine.
+_OVERRIDE = threading.local()
+
+
+def _dispatch_overrides() -> tuple:
+    return (getattr(_OVERRIDE, "variant", None),
+            getattr(_OVERRIDE, "fused", None))
+
+
+class _routing_overrides:
+    """Context manager scoping (variant, fused) overrides to one
+    matrix_check_batch call on this thread."""
+
+    def __init__(self, variant, fused):
+        self._new = (variant, fused)
+
+    def __enter__(self):
+        self._old = _dispatch_overrides()
+        _OVERRIDE.variant, _OVERRIDE.fused = self._new
+
+    def __exit__(self, *exc):
+        _OVERRIDE.variant, _OVERRIDE.fused = self._old
+
+
 def _env_int(name: str, default: int) -> int:
     """Env-int knob that degrades to its default on malformed values
     (a bad sweep variable must not make the module unimportable)."""
@@ -594,72 +634,167 @@ def _build_matrix_kernel(S: int, V: int, step_ids, init_state: int,
     make_step = math.make_step
     _combine = math.make_combine(B, C, init_state)
 
-    def _scan_total(pend, op_ids, uops, slots, valid, tot0):
+    # --- stage 1: per-chunk products ([G, MV, MV] bf16 + inexact) -----
+    # The products and the combine are SEPARATE dispatches: the chunk
+    # products materialize in HBM between the scan and the combine
+    # either way, and the split lets the fused streaming combine (and
+    # its tree fallback) pair with ANY products source — XLA scan or
+    # any pallas kernel variant — without a cross-product of jits.
+
+    def _scan_products(pend, op_ids, uops, slots, valid):
         mt_tab, oob_tab = uop_tables(uops)
         P0 = jnp.broadcast_to(eye, (G, MV, MV))
         (P, inexact), _ = lax.scan(make_step(mt_tab, oob_tab),
                                    (P0, jnp.zeros((G,), bool)),
                                    (pend, op_ids, slots, valid))
+        return P, inexact
+
+    scan_products = jax.jit(_scan_products)
+
+    def _scan_total(pend, op_ids, uops, slots, valid, tot0):
+        """The pre-split single-jit scan + tree combine: the fallback
+        dispatch when neither a pallas products variant nor the fused
+        combine is active (e.g. the CPU backend). One compile and one
+        dispatch, exactly the old profile — the split stages below only
+        engage when a pallas stage actually replaces one of them."""
+        P, inexact = _scan_products(pend, op_ids, uops, slots, valid)
         return _combine(P, inexact, tot0)
 
     scan_total = jax.jit(_scan_total)
+
+    _pallas_jits: dict = {}
+
+    def pallas_products(variant: str):
+        """The jitted products stage through one pallas kernel variant
+        (the T-step chunk product fused into ONE program per chunk, P
+        VMEM-resident across all its returns — ops/pallas_matrix.py).
+        The oob → inexact reduction runs on the small id grids outside
+        the kernel; boolean results are bit-identical to the scan path
+        (exact accumulation of 0/1 addends, thresholded per product,
+        whatever the operand representation)."""
+        fn = _pallas_jits.get(variant)
+        if fn is None:
+            @jax.jit
+            def fn(pend, op_ids, uops, slots, valid):
+                from jepsen_tpu.ops import pallas_matrix
+                mt_tab, oob_tab = uop_tables(uops)
+                kfn = pallas_matrix.chunk_product(
+                    S, V, T, uops.shape[0], variant=variant)
+                mtT = jnp.transpose(mt_tab, (0, 2, 1)).astype(jnp.float32)
+                P = kfn(pend, op_ids, mtT, slots, valid)
+                inexact = (oob_tab[op_ids] & pend
+                           & valid[..., None]).any(axis=(0, 2))
+                return P, inexact
+            _pallas_jits[variant] = fn
+        return fn
+
+    # --- stage 2: the chunk-product combine ---------------------------
     # donating the tot0 carry lets XLA compose chained resume segments'
-    # [B, MV, MV] operator products in place. Kept as a SEPARATE wrapper:
-    # the pallas fallback path may retry scan_total with a tot0 the
-    # failed pallas dispatch already received, so the fallback must never
-    # donate (use-after-donate), and the CPU backend can't honor
-    # donation at all (it would warn per call).
+    # [B, MV, MV] operator products in place. Kept as a SEPARATE
+    # wrapper: a failed fused-combine dispatch already received tot0, so
+    # its tree retry must never donate (use-after-donate), and the CPU
+    # backend can't honor donation at all (it would warn per call).
     from jepsen_tpu.parallel.pipeline import donate_ok
+
+    def _tree_combine(P, inexact, tot0):
+        return _combine(P, inexact, tot0)
+
+    combine_tree = jax.jit(_tree_combine)
+    combine_tree_donate = (jax.jit(_tree_combine, donate_argnums=(2,))
+                           if donate_ok() else combine_tree)
     scan_total_donate = (jax.jit(_scan_total, donate_argnums=(5,))
                          if donate_ok() else scan_total)
 
     @jax.jit
-    def scan_total_pallas(pend, op_ids, uops, slots, valid, tot0):
-        """Same contract as scan_total, with the T-step chunk product
-        fused into ONE pallas program per chunk (P stays VMEM-resident
-        across all its returns — see ops/pallas_matrix.py). The oob →
-        inexact reduction runs on the small id grids outside the
-        kernel; boolean results are bit-identical to the scan path (f32
-        accumulation of 0/1 addends, thresholded per product)."""
+    def combine_fused(P, inexact, tot0):
+        """The fused streaming combine: each key's C chunk products
+        stream through HBM exactly once into a VMEM-resident running
+        product (pallas_matrix._build_combine), instead of the tree's
+        ceil(log2 C) levels of [B, C_l, MV, MV] HBM round-trips.
+        Bit-identical: boolean matrix products are exact under any
+        association."""
         from jepsen_tpu.ops import pallas_matrix
-
-        mt_tab, oob_tab = uop_tables(uops)
-        fn = pallas_matrix.chunk_product(S, V, T, uops.shape[0])
-        mtT = jnp.transpose(mt_tab, (0, 2, 1)).astype(jnp.float32)
-        P = fn(pend, op_ids, mtT, slots, valid)
-        inexact = (oob_tab[op_ids] & pend & valid[..., None]).any(axis=(0, 2))
-        return _combine(P, inexact, tot0)
+        cfn = pallas_matrix.combine_product(B, C, MV)
+        total = cfn(P.reshape(B, C, MV, MV), tot0.astype(jnp.bfloat16))
+        alive = (total[:, :, init_state] > 0).any(axis=1)
+        return alive, inexact.reshape(B, C).any(axis=1), total
 
     synced_shapes: set = set()
+
+    def _sync_first(key, out):
+        # jitted dispatch is async: a Mosaic RUNTIME fault (vs the
+        # lowering faults the probes catch) would otherwise surface at
+        # the caller's readback, outside the dispatch try. Deterministic
+        # per compiled shape, so force one sync on each shape's first
+        # execution and keep later dispatches pipelined.
+        if key not in synced_shapes:
+            import jax
+            jax.block_until_ready(out)
+            synced_shapes.add(key)
 
     def _dispatch_total(pend, op_ids, uops, slots, valid, tot0):
         from jepsen_tpu.ops import pallas_matrix
 
-        if pallas_matrix.enabled(S, V):
-            shape_key = (pend.shape, uops.shape)
+        force_variant, force_fused = _dispatch_overrides()
+        info = {"variant": "scan", "combine": "tree"}
+        U = int(uops.shape[0])
+        fused_want = (force_fused if force_fused is not None
+                      else pallas_matrix.fuse_combine_mode())
+        use_fused = (fused_want is not False
+                     and pallas_matrix.combine_enabled(MV))
+        prod = None
+        while True:
+            variant = pallas_matrix.best_variant(S, V, force=force_variant)
+            if variant is None:
+                break
             try:
-                out = scan_total_pallas(pend, op_ids, uops, slots, valid,
-                                        tot0)
-                # jitted dispatch is async: a Mosaic RUNTIME fault (vs
-                # the lowering faults the probe catches) would otherwise
-                # surface at the caller's readback, outside this try.
-                # Deterministic per compiled shape, so force one sync on
-                # each shape's first execution and keep later dispatches
-                # pipelined.
-                if shape_key not in synced_shapes:
-                    import jax
-                    jax.block_until_ready(out)
-                    synced_shapes.add(shape_key)
-                return out
+                # warm the kernel cache (and the hbm-pretile probe)
+                # OUTSIDE the jit trace below
+                pallas_matrix.chunk_product(S, V, T, U, variant=variant)
+                out_p = pallas_products(variant)(pend, op_ids, uops,
+                                                 slots, valid)
+                _sync_first((pend.shape, uops.shape, variant), out_p)
+                prod = out_p
+                info["variant"] = variant
+                break
             except Exception:  # noqa: BLE001 — lowering/runtime failure
-                logger.warning("pallas matrix path failed at %s; falling "
-                               "back to the XLA scan", (S, V, T),
+                logger.warning("pallas matrix variant %r failed at %s; "
+                               "demoting", variant, (S, V, T),
                                exc_info=True)
-                pallas_matrix.disable(S, V)
-            # fallback retry: tot0 was already handed to the failed
-            # pallas dispatch — the non-donating wrapper is mandatory
-            return scan_total(pend, op_ids, uops, slots, valid, tot0)
-        return scan_total_donate(pend, op_ids, uops, slots, valid, tot0)
+                pallas_matrix.disable(S, V, variant)
+                # loop: best_variant now yields the next representation
+        if prod is not None or use_fused:
+            if prod is None:
+                prod = scan_products(pend, op_ids, uops, slots, valid)
+            P, inexact = prod
+            if use_fused:
+                try:
+                    out = combine_fused(P, inexact, tot0)
+                    _sync_first((pend.shape, "combine"), out)
+                    info["combine"] = "fused"
+                    _DISPATCH_INFO.value = info
+                    return out
+                except Exception:  # noqa: BLE001
+                    logger.warning("fused combine failed at MV=%d; "
+                                   "using the tree combine", MV,
+                                   exc_info=True)
+                    pallas_matrix.disable_combine(MV)
+                    _DISPATCH_INFO.value = info
+                    # tot0 was handed to the failed fused dispatch —
+                    # the non-donating wrapper is mandatory
+                    return combine_tree(P, inexact, tot0)
+            _DISPATCH_INFO.value = info
+            return combine_tree_donate(P, inexact, tot0)
+        # neither pallas stage is active (e.g. the CPU fallback): the
+        # pre-split single-jit path — one compile, one dispatch,
+        # donation as before. The combine_tree_donate return above is
+        # mutually exclusive with this line (both RETURN), so tot0 is
+        # never read after its donation on any one control path — the
+        # line-based rule can't see the early returns, hence the
+        # waiver.
+        _DISPATCH_INFO.value = info
+        return scan_total_donate(pend, op_ids, uops, slots, valid,
+                                 tot0)  # lint: ignore[donation-reuse]
 
     def run(pend, op_ids, uops, slots, valid):
         """pend [T,G,S]; op_ids [T,G,S] (indices into uops [U,3]);
@@ -840,7 +975,8 @@ def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
 
 def matrix_check(stream, step_ids=None, init_state: int = 0,
                  num_states: int | None = None, force: bool = False,
-                 mesh=None):
+                 mesh=None, variant: str | None = None,
+                 combine_fused: bool | None = None):
     """Fast exact-aliveness check of ONE history via block-composed
     transfer matrices. Returns (alive, died, overflow, peak) with
     died=-1/peak=0 placeholders — callers that need the failing event or
@@ -848,7 +984,10 @@ def matrix_check(stream, step_ids=None, init_state: int = 0,
     Returns None when the matrix regime doesn't apply (``force=True``
     skips the size gate, for differential tests). With a ``mesh`` the
     chunk axis shards over the devices (the checker ladder's ``sharded``
-    rung passes parallel.auto_mesh())."""
+    rung passes parallel.auto_mesh()). ``variant`` pins the kernel
+    representation and ``combine_fused`` the combine path for this call
+    (both probe-gated, demote-not-fail — doc/performance.md "Packed
+    boolean kernels")."""
     if step_ids is None:
         step_ids = _default_step_ids()
     num_states = num_states if num_states is not None else len(stream.intern)
@@ -861,12 +1000,16 @@ def matrix_check(stream, step_ids=None, init_state: int = 0,
         return None
     return matrix_check_batch([stream], step_ids=step_ids,
                               init_state=init_state,
-                              num_states=num_states, mesh=mesh)[0]
+                              num_states=num_states, mesh=mesh,
+                              variant=variant,
+                              combine_fused=combine_fused)[0]
 
 
 def matrix_check_resume(stream, tot0=None, step_ids=None,
                         init_state: int = 0, num_states: int | None = None,
-                        n_slots: int | None = None, mesh=None):
+                        n_slots: int | None = None, mesh=None,
+                        variant: str | None = None,
+                        combine_fused: bool | None = None):
     """Segmented transfer-matrix verification of one long history: checks
     a segment starting from the composed operator product ``tot0`` of the
     prior segments (None = identity) and returns
@@ -915,13 +1058,16 @@ def matrix_check_resume(stream, tot0=None, step_ids=None,
             return True, False, tot0
         alive = (np.asarray(tot0)[:, :, init_state] > 0).any(axis=1)
         return alive, False, tot0
-    out = _matrix_dispatch([prep], S, R_max, V, step_ids, init_state,
-                           mesh, resume=True, tot0=tot0)
+    with _routing_overrides(variant, combine_fused):
+        out = _matrix_dispatch([prep], S, R_max, V, step_ids, init_state,
+                               mesh, resume=True, tot0=tot0)
     return out[0], out[1], out[2]
 
 
 def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
-                       num_states: int | None = None, mesh=None):
+                       num_states: int | None = None, mesh=None,
+                       variant: str | None = None,
+                       combine_fused: bool | None = None):
     """Batched transfer-matrix check over independent per-key histories
     (the jepsen.independent regime, BASELINE config 3). All keys' chunk
     products advance together in one [B*C, MV, MV] MXU matmul per scan
@@ -994,32 +1140,34 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
         pipe = DispatchPipeline(depth=PIPELINE_DEPTH, name="matrix")
         phases = {"prepass": 0.0, "grids": 0.0, "dispatch": 0.0}
         counts = []
-        for lo in range(0, B, sub):
-            def stage(lo=lo):
-                t0 = time.perf_counter()
-                sl = [prep(i) for i in range(lo, min(lo + sub, B))]
-                counts.append(len(sl))
-                sl += [_EMPTY_PREP] * (sub - len(sl))
-                t1 = time.perf_counter()
-                # build + STAGE the grids now (device_put issues the H2D
-                # copies immediately, overlapping in-flight compute)
-                grids, uops = _matrix_grids(sl, S, V, sub, C, T, None)
-                args = pipe.stage(*grids, uops)
-                phases["prepass"] += t1 - t0
-                phases["grids"] += time.perf_counter() - t1
-                return tuple(args)
+        with _routing_overrides(variant, combine_fused):
+            for lo in range(0, B, sub):
+                def stage(lo=lo):
+                    t0 = time.perf_counter()
+                    sl = [prep(i) for i in range(lo, min(lo + sub, B))]
+                    counts.append(len(sl))
+                    sl += [_EMPTY_PREP] * (sub - len(sl))
+                    t1 = time.perf_counter()
+                    # build + STAGE the grids now (device_put issues the
+                    # H2D copies immediately, overlapping in-flight
+                    # compute)
+                    grids, uops = _matrix_grids(sl, S, V, sub, C, T, None)
+                    args = pipe.stage(*grids, uops)
+                    phases["prepass"] += t1 - t0
+                    phases["grids"] += time.perf_counter() - t1
+                    return tuple(args)
 
-            def dispatch(pend, ids, slots, valid, uops):
-                t0 = time.perf_counter()
-                out = run(pend, ids, uops, slots, valid)
-                phases["dispatch"] += time.perf_counter() - t0
-                return out
+                def dispatch(pend, ids, slots, valid, uops):
+                    t0 = time.perf_counter()
+                    out = run(pend, ids, uops, slots, valid)
+                    phases["dispatch"] += time.perf_counter() - t0
+                    return out
 
-            pipe.submit(stage, dispatch)
-        t0 = time.perf_counter()
-        fetched = pipe.results()
+                pipe.submit(stage, dispatch)
+            t0 = time.perf_counter()
+            fetched = pipe.results()
         phases["fetch"] = time.perf_counter() - t0
-        _PHASE.value = {k: round(v, 4) for k, v in phases.items()}
+        _publish_phases(phases)
         out = []
         for nb, (a, ix) in zip(counts, fetched):
             out += [(bool(a[b]), -1, bool(ix[b]), 0) for b in range(nb)]
@@ -1030,14 +1178,25 @@ def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
     t0 = time.perf_counter()
     preps = [prep(i) for i in range(B)]
     phases["prepass"] = time.perf_counter() - t0
-    handle = _matrix_dispatch(preps, S, R_max, V, step_ids, init_state,
-                              mesh, phases=phases)
-    t0 = time.perf_counter()
-    alive, inexact = jax.device_get(handle)
+    with _routing_overrides(variant, combine_fused):
+        handle = _matrix_dispatch(preps, S, R_max, V, step_ids, init_state,
+                                  mesh, phases=phases)
+        t0 = time.perf_counter()
+        alive, inexact = jax.device_get(handle)
     phases["fetch"] = time.perf_counter() - t0
-    _PHASE.value = {k: round(v, 4) for k, v in phases.items()}
+    _publish_phases(phases)
     observe(1 if mesh is None else int(mesh.devices.size))
     return [(bool(alive[b]), -1, bool(inexact[b]), 0) for b in range(B)]
+
+
+def _publish_phases(phases: dict) -> None:
+    """Rounds the measured host/device split and annotates it with the
+    dispatch routing labels (variant + combine path) for this thread's
+    ``last_phase_seconds`` readers — the per-variant attribution
+    bench.py folds into the matrix metrics."""
+    out = {k: round(v, 4) for k, v in phases.items()}
+    out.update(last_dispatch_info())
+    _PHASE.value = out
 
 
 def _matrix_plan(B, S, R_max, V, mesh):
@@ -1200,6 +1359,10 @@ def _matrix_dispatch(preps, S, R_max, V, step_ids, init_state, mesh,
     C, T = _matrix_plan(B, S, R_max, V, mesh)
     if mesh is not None:
         _publish_mesh_padding(B_real, B, S, R_max, V, C, T)
+        # the mesh twin runs the XLA scan + device-side tree combine by
+        # construction (collectives pair with the tree — see
+        # _build_matrix_kernel_mesh); label the routing accordingly
+        _DISPATCH_INFO.value = {"variant": "scan", "combine": "tree"}
     t0 = time.perf_counter()
     grids, uops = _matrix_grids(preps, S, V, B, C, T, mesh)
     t1 = time.perf_counter()

@@ -698,6 +698,29 @@ def matrix_phase_model(n_returns: int, n_slots: int, num_states: int,
     }
 
 
+def combine_modeled_hbm_bytes(n_keys: int, n_chunks: int, mv: int,
+                              fused: bool, itemsize: int = 2) -> int:
+    """Modeled HBM traffic of the chunk-product combine stage, per
+    dispatch (bf16 matrices: itemsize 2). The tree combine's
+    ceil(log2 C) levels each read two [MV, MV] products and write one
+    per pair; the fused streaming combine (pallas_matrix._build_combine)
+    reads each chunk product exactly once, reads tot0, and writes only
+    the total — the ratio of the two is the ``combine_fused_reduction``
+    bench.py reports, and ``combine_hbm_frac`` divides the active
+    model's bytes by wall time and measured HBM bandwidth."""
+    cell = mv * mv * itemsize
+    if fused:
+        return n_keys * (n_chunks + 2) * cell
+    total = 0
+    c = n_chunks
+    while c > 1:
+        pairs = c // 2
+        total += pairs * 3 * cell       # read 2, write 1 per pair
+        c = pairs + (c % 2)
+    total += 3 * cell                   # the tot0 compose
+    return n_keys * total
+
+
 _DEVICE_PEAK: dict = {}
 
 

@@ -147,6 +147,25 @@ def pytest_sessionfinish(session, exitstatus):
             "doc/robustness.md", returncode=1)
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _hermetic_fs_cache(tmp_path_factory):
+    """fs_cache writes (the pallas probe-verdict sidecar above all —
+    ops/pallas_matrix persists per-backend probe results there) land in
+    a session temp dir, never the user's real ~/.jepsen-tpu/cache:
+    tests must neither pollute nor depend on developer-machine state.
+    Per-test JEPSEN_CACHE_DIR monkeypatches still override."""
+    prev = os.environ.get("JEPSEN_CACHE_DIR")
+    os.environ["JEPSEN_CACHE_DIR"] = str(tmp_path_factory.mktemp("fs-cache"))
+    yield
+    if prev is None:
+        os.environ.pop("JEPSEN_CACHE_DIR", None)
+    else:
+        os.environ["JEPSEN_CACHE_DIR"] = prev
+
+
 def run_fake(suite_test_fn, **opts):
     """Shared fake-mode lifecycle harness for suite tests: builds the
     suite's test map in --fake mode (in-memory doubles over the dummy

@@ -151,6 +151,32 @@ class TestPreflightDiagnostics:
         assert all(d.severity == "warning" for d in diags
                    if d.code == "KNB004")
 
+    def test_knb007_matrix_variant_enum(self):
+        t = _atom_test(matrix_variant="bf16")
+        diags = _pf(t)
+        assert "KNB007" in _codes(diags)
+        assert "KNB007" not in _codes(_pf(_atom_test(
+            matrix_variant="packed")))
+        assert "KNB007" not in _codes(_pf(_atom_test(
+            matrix_variant="auto")))
+
+    def test_knb_combine_fused_bool(self):
+        assert "KNB001" in _codes(_pf(_atom_test(combine_fused="maybe")))
+        diags = _pf(_atom_test(combine_fused="yes"))
+        assert "KNB001" not in _codes(diags)   # stringly bool: warn only
+        assert "KNB006" in _codes(diags)
+        assert "KNB001" not in _codes(_pf(_atom_test(combine_fused=True)))
+
+    def test_knb007_env_routing_knobs(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PALLAS_PROBE", "sometimes")
+        assert "KNB007" in _codes(_pf(_atom_test()))
+        monkeypatch.setenv("JEPSEN_TPU_PALLAS_PROBE", "skip")
+        monkeypatch.setenv("JEPSEN_TPU_MATRIX_VARIANT", "int8")
+        monkeypatch.setenv("JEPSEN_TPU_FUSE_COMBINE", "off")
+        assert "KNB007" not in _codes(_pf(_atom_test()))
+        monkeypatch.setenv("JEPSEN_TPU_FUSE_COMBINE", "fast")
+        assert "KNB007" in _codes(_pf(_atom_test()))
+
     def test_knb005_deadline_exceeds_time_limit(self):
         t = _atom_test(op_timeout_s=600, time_limit=30)
         assert "KNB005" in _codes(_pf(t))
@@ -447,6 +473,64 @@ class TestLintRules:
                 return buf
         """
         assert _lint_source(tmp_path, src, rules=["donation-reuse"]) == []
+
+    def test_threshold_dtype_fires_in_kernel_scope(self, tmp_path):
+        bad = """
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                def bool_mm(x, y):
+                    return (jnp.dot(x, y,
+                                    preferred_element_type=jnp.float32)
+                            > 0).astype(jnp.float32)
+                o_ref[...] = bool_mm(x_ref[...], x_ref[...])
+        """
+        finds = _lint_source(tmp_path, bad, rules=["threshold-dtype"])
+        assert [f.rule for f in finds] == ["threshold-dtype"]
+        # the int8 form (the rework's replacement) is the fix
+        good = bad.replace("jnp.float32)\n                            > 0",
+                           "jnp.int32)\n                            > 0")
+        assert _lint_source(tmp_path, good,
+                            rules=["threshold-dtype"]) == []
+
+    def test_threshold_dtype_waiver_and_jit_scope(self, tmp_path):
+        waived = """
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                def bool_mm(x, y):
+                    return (
+                        jnp.dot(x, y,  # lint: ignore[threshold-dtype]
+                                preferred_element_type=jnp.float32) > 0
+                    ).astype(jnp.float32)
+                o_ref[...] = bool_mm(x_ref[...], x_ref[...])
+        """
+        assert _lint_source(tmp_path, waived,
+                            rules=["threshold-dtype"]) == []
+        # jitted function in a non-pallas module is kernel scope too
+        jit_bad = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def screen(a, b):
+                return (jnp.dot(a, b,
+                                preferred_element_type=jnp.float32) > 0)
+        """
+        finds = _lint_source(tmp_path, jit_bad, rules=["threshold-dtype"])
+        assert [f.rule for f in finds] == ["threshold-dtype"]
+        # an UN-jitted host function without pallas: not kernel scope
+        host = jit_bad.replace("@jax.jit\n            ", "")
+        assert _lint_source(tmp_path, host,
+                            rules=["threshold-dtype"]) == []
+        # a dot without the threshold (magnitude consumer): not flagged
+        mag = jit_bad.replace(" > 0", "")
+        assert _lint_source(tmp_path, mag,
+                            rules=["threshold-dtype"]) == []
 
     def test_recompile_hazard_jit_in_loop(self, tmp_path):
         bad = """

@@ -324,15 +324,22 @@ def test_session_sniffing():
 # ---------------------------------------------------------------------------
 
 def _write_run(run_dir, history, journal_chunks=40, delay_s=0.002,
-               complete=True):
+               complete=True, pause_at=None, pause_until=None):
     """Fake run: appends history to the WAL in chunks from a thread,
-    then persists history.jsonl and discards the WAL (core.run order)."""
+    then persists history.jsonl and discards the WAL (core.run order).
+    ``pause_at``/``pause_until``: before writing op index ``pause_at``
+    the writer blocks on ``pause_until()`` — tests gate the interesting
+    suffix (e.g. a planted anomaly) on the daemon having observably
+    screened the prefix, instead of racing a fixed delay against
+    machine load."""
     from jepsen_tpu.journal import Journal
     run_dir.mkdir(parents=True, exist_ok=True)
     j = Journal(run_dir / "history.wal.jsonl", fsync_interval_s=-1)
 
     def writer():
         for i, op in enumerate(history):
+            if i == pause_at and pause_until is not None:
+                pause_until()
             j.append(op)
             if i % journal_chunks == 0:
                 time.sleep(delay_s)
@@ -359,7 +366,14 @@ def test_daemon_end_to_end_register(tmp_path):
 
     h, planted = _register_history(600, seed=4, planted_at=400)
     run_dir = tmp_path / "reg" / "20260803T000000.000"
-    writer = _write_run(run_dir, h)
+    # the anomalous suffix is gated on the TEST observing an interim
+    # valid-so-far verdict (30 s escape hatch), so the interim-verdict
+    # assertion can't lose a fixed-delay race against machine load —
+    # under a busy suite the daemon's first screen was landing only
+    # after the whole 120 ms write finished
+    saw_valid_evt = threading.Event()
+    writer = _write_run(run_dir, h, pause_at=planted,
+                        pause_until=lambda: saw_valid_evt.wait(30))
     daemon = LiveDaemon(store_root=str(tmp_path), poll_s=0.02,
                         accelerator="cpu")
     daemon.start()
@@ -371,9 +385,11 @@ def test_daemon_end_to_end_register(tmp_path):
         if status and status.get("valid_so_far") is True \
                 and status.get("checked_ops", 0) > 0:
             saw_valid = True
+            saw_valid_evt.set()
         if status and status.get("state") == "final":
             break
         time.sleep(0.02)
+    saw_valid_evt.set()  # never wedge the writer on a failing run
     writer.join(10)
     t0 = time.monotonic()
     daemon.stop()

@@ -105,6 +105,49 @@ def test_segmented_mesh_differential_bit_identical():
         assert bool(a1[0]) is (anomaly is None), name
 
 
+def test_segmented_variant_chain_matches_mesh_twin(monkeypatch):
+    """ISSUE 12 mesh-twin bit-identity: a segmented chain through the
+    pallas kernel variants + fused streaming combine (single device,
+    interpret mode) composes the SAME verdicts and the SAME carry
+    operator bits as the sharded mesh twin (XLA scan + device-side tree
+    combine) — the variants change the operand representation, never
+    one bit of the composed operator."""
+    import jepsen_tpu.ops.pallas_matrix as pm
+    from jepsen_tpu.history import Intern
+    from jepsen_tpu.ops import jitlin
+
+    mesh = _mesh()
+    for variant in ("packed", "int8"):
+        intern = Intern()
+        segs = [_stream(120, seed=10 + s, intern=intern) for s in range(2)]
+        outs = {}
+        monkeypatch.setattr(pm, "FORCE_INTERPRET", True)
+        try:
+            tot, alive = None, None
+            for seg in segs:
+                alive, _, tot = jitlin.matrix_check_resume(
+                    seg, tot, n_slots=N_PROCS, num_states=len(intern),
+                    variant=variant, combine_fused=True)
+            info = jitlin.last_dispatch_info()
+            assert info == {"variant": variant, "combine": "fused"}, info
+            outs["pallas"] = (np.asarray(alive).copy(),
+                              np.asarray(tot).copy())
+        finally:
+            monkeypatch.setattr(pm, "FORCE_INTERPRET", False)
+        tot, alive = None, None
+        for seg in segs:
+            alive, _, tot = jitlin.matrix_check_resume(
+                seg, tot, n_slots=N_PROCS, num_states=len(intern),
+                mesh=mesh)
+        outs["mesh"] = (np.asarray(alive).copy(), np.asarray(tot).copy())
+        a1, t1 = outs["pallas"]
+        a2, t2 = outs["mesh"]
+        assert np.array_equal(a1, a2), variant
+        assert np.array_equal(t1, t2), (
+            f"{variant}: carry operators diverge from the mesh twin")
+        assert bool(a1[0])
+
+
 def test_segmented_mixed_chain_sharded_then_single():
     """A chain may mix sharded and single-device segments (the ladder's
     sharded→device demotion mid-chain): the carry is the same replicated
