@@ -40,8 +40,11 @@ Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
 stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
 elle_50k, ir_amortization, online_lag, matrix_kernel, explain,
-multichip, headline, scale, telemetry — the last opts out of the
-per-stage telemetry block in bench_summary). ``ir_amortization``
+multichip, ckpt, headline, scale, telemetry — the last opts out of the
+per-stage telemetry block in bench_summary). ``ckpt`` measures the
+resumable-check cost/benefit (ckpt_overhead_frac bar <= 5%, plus
+resume_savings_frac at a 50% cut — doc/robustness.md "Resumable checks
+and the elastic mesh"). ``ir_amortization``
 measures the history-IR encode-once contract: a two-checker run over
 one 50k-op history reports the first encode's wall vs the second
 checker's encode phase (target ~= 0 — views are memoized on the shared
@@ -1266,6 +1269,110 @@ def cfg_membership_resolve():
          per_cycle_ms=round(1000.0 * med / n_cycles, 3), **extras)
 
 
+def cfg_ckpt():
+    """Resumable-check cost/benefit (doc/robustness.md "Resumable
+    checks and the elastic mesh"), riding the segmented 300s metric's
+    path at a bench-friendly scale:
+
+    * ``ckpt_overhead_frac`` — segmented matrix chain with a durable
+      checkpoint persisted after EVERY segment (interval 0: the
+      worst-case write cadence; production's default is one write per
+      5 s) vs the plain chain. Bar: <= 5% overhead.
+    * ``resume_savings_frac`` — the same chain resumed from a
+      checkpoint at the 50% cut vs checked from zero. The checkpoint
+      is authored through the same carry/fingerprint machinery the
+      checker uses, so the resumed run exercises real validation
+      (hash + config match), not a mock.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from jepsen_tpu.checker.checkpoint import (
+        CheckpointStore, encode_array, stream_prefix_hash,
+    )
+    from jepsen_tpu.ops.jitlin import (
+        _bucket, _slice_stream, matrix_check_segmented,
+        matrix_segmented_config,
+    )
+
+    # multichip-bench shapes (3-way concurrency, rand-int-5 domain →
+    # MV = 64): big enough to segment, small enough that the CPU
+    # container's matrix kernel finishes the trial matrix promptly
+    n_procs, n_values = 3, 5
+    seg_events = int(os.environ.get("BENCH_CKPT_SEG_EVENTS",
+                                    str(1 << 13)))
+    n_segs = int(os.environ.get("BENCH_CKPT_SEGMENTS", "6"))
+    seg_blocks = seg_events // (2 * n_procs)
+    seg_events = seg_blocks * 2 * n_procs
+    stream = _block_stream(seg_blocks * n_segs, n_procs=n_procs,
+                           n_values=n_values)
+    kw = dict(num_states=n_values + 1, n_slots=n_procs,
+              max_segment=seg_events)
+
+    def plain():
+        a, _, ix, _ = matrix_check_segmented(stream, **kw)
+        assert a and not ix
+
+    _warm_timed("ckpt", plain)
+    _, t_plain = _trials(plain, 3)
+    wall_plain = _median(t_plain)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        def with_ckpt():
+            store = CheckpointStore(Path(tmp) / "check.ckpt",
+                                    interval_s=0.0, resume=False)
+            a, _, ix, _ = matrix_check_segmented(stream, ckpt=store,
+                                                 **kw)
+            assert a and not ix
+            assert store.writes >= n_segs - 1, store.writes
+
+        _, t_ckpt = _trials(with_ckpt, 3)
+        wall_ckpt = _median(t_ckpt)
+
+        # author a 50%-cut checkpoint through the real carry machinery
+        half = seg_blocks * (n_segs // 2) * 2 * n_procs
+        carries = []
+        a, _, ix, _ = matrix_check_segmented(
+            _slice_stream(stream, 0, half), carry_sink=carries.append,
+            **kw)
+        assert a and not ix and carries
+        S, V = n_procs, _bucket(n_values + 1, floor=8)
+        resume_path = Path(tmp) / "resume.ckpt"
+        CheckpointStore(resume_path, resume=True).save({
+            "kind": "matrix",
+            "config": matrix_segmented_config(S, V, 0, n_values + 1,
+                                              seg_events, None, None),
+            "events_done": half, "segment": n_segs // 2,
+            "prefix_hash": stream_prefix_hash(stream, half),
+            "carry": {"tot0": encode_array(np.asarray(
+                carries[-1]["tot0"]))},
+        })
+
+        def resumed():
+            store = CheckpointStore(resume_path, interval_s=None,
+                                    resume=True)
+            a2, _, ix2, _ = matrix_check_segmented(stream, ckpt=store,
+                                                   **kw)
+            assert a2 and not ix2
+
+        _warm_timed("ckpt_resume", resumed)
+        _, t_res = _trials(resumed, 3)
+        wall_res = _median(t_res)
+
+    overhead = max(0.0, wall_ckpt / max(wall_plain, 1e-9) - 1.0)
+    savings = max(0.0, 1.0 - wall_res / max(wall_plain, 1e-9))
+    emit("ckpt_overhead_frac", overhead, "frac",
+         0.05 / max(overhead, 1e-6),
+         plain_wall_s=round(wall_plain, 4),
+         ckpt_wall_s=round(wall_ckpt, 4), segments=n_segs,
+         segment_events=seg_events, write_cadence="every-segment",
+         path="matrix-segmented")
+    emit("resume_savings_frac", savings, "frac", savings / 0.33,
+         full_wall_s=round(wall_plain, 4),
+         resumed_wall_s=round(wall_res, 4), resume_cut_frac=0.5,
+         path="matrix-segmented")
+
+
 def cfg_headline() -> float:
     """The headline, printed last: a 10k-op single-register history on
     device vs the reference's 1 h CPU knossos timeout.
@@ -1355,6 +1462,7 @@ def main() -> None:
     guard("matrix_kernel", cfg_matrix_kernel)
     guard("explain", cfg_explain)
     guard("multichip", cfg_multichip_scaling)
+    guard("ckpt", cfg_ckpt)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
 

@@ -386,6 +386,12 @@ _NUMERIC_KNOBS = (
     # runtime, preflight is where garbage becomes an error
     ("explain_shrink_budget", True, 0.0),
     ("explain_max_witness_ops", True, 1.0),
+    # resumable checks + the elastic mesh (doc/robustness.md
+    # "Resumable checks and the elastic mesh"): seconds between durable
+    # check.ckpt persists (<= 0 disables — so any finite value passes
+    # range), and the mesh shrink ladder's floor width
+    ("check_ckpt_interval", True, None),
+    ("mesh_min_devices", True, 0.0),
 )
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
@@ -395,7 +401,7 @@ _NUMERIC_KNOBS = (
 # (doc/performance.md "History IR"), and the fused-combine toggle
 # (doc/performance.md "Packed boolean kernels")
 _BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
-               "ir_stream_from_wal", "combine_fused")
+               "ir_stream_from_wal", "combine_fused", "resume_check")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 # enum knobs, tolerantly coerced at runtime (pallas_matrix
@@ -423,6 +429,20 @@ _ENV_ENUM_KNOBS = (
      "skip = trust the shape gates"),
     ("JEPSEN_TPU_FUSE_COMBINE", _BOOL_STRINGS,
      "forces the fused/tree chunk combine (unset = probe decides)"),
+    ("JEPSEN_TPU_RESUME_CHECK", _BOOL_STRINGS,
+     "process-wide twin of resume_check (durable check.ckpt "
+     "auto-resume, doc/robustness.md)"),
+)
+
+# numeric env twins: a malformed value silently degrades the whole
+# sweep to the default at runtime, so the gate names it here
+# (key, hint)
+_ENV_NUMERIC_KNOBS = (
+    ("JEPSEN_TPU_CHECK_CKPT_INTERVAL",
+     "seconds between durable check.ckpt persists (<= 0 disables)"),
+    ("JEPSEN_TPU_MESH_MIN_DEVICES",
+     "the elastic mesh shrink ladder's floor width (below it the "
+     "checker demotes to single-device)"),
 )
 
 _UNSET = object()
@@ -494,6 +514,10 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
         hints["combine_fused"] = (
             "true pins the fused streaming chunk combine, false the "
             "tree combine; unset = env default + probe")
+        hints["resume_check"] = (
+            "true (the default) resumes an interrupted check from its "
+            "durable check.ckpt; false (analyze --no-resume-check) "
+            "re-checks from zero")
         out.append(Diagnostic(
             "KNB001", ERROR, key,
             f"{key} must be a bool, got {v!r}", hint=hints.get(key)))
@@ -520,6 +544,19 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
             "KNB007", ERROR, key,
             f"env {key}={raw!r} is not one of {'|'.join(values)}",
             hint=hint + "; the runtime would warn and use the default"))
+
+    for key, hint in _ENV_NUMERIC_KNOBS:
+        raw = os.environ.get(key)
+        if raw is None or raw == "":
+            continue
+        try:
+            float(raw)
+        except ValueError:
+            out.append(Diagnostic(
+                "KNB001", ERROR, key,
+                f"env {key}={raw!r} is not a number",
+                hint=hint + "; the runtime would warn and use the "
+                     "default"))
 
     nodes = list(test.get("nodes") or [])
     conc_raw = test.get("concurrency", 1)

@@ -1,0 +1,417 @@
+"""Durable checker checkpoints: the check survives its own faults.
+
+PRs 3/4 made the *run* crash-safe (WAL + ``analyze --recover``); this
+module makes the *check* resumable. Long-running checks — the segmented
+transfer-matrix chain (``ops/jitlin.matrix_check_segmented`` /
+``segmented_check``) and the exact CPU frontier
+(``checker/linear_cpu.FrontierSession``) — periodically persist their
+tiny carry state to an fsynced ``check.ckpt`` under the run's store
+dir, and ``analyze`` auto-resumes from a valid checkpoint instead of
+restarting a minutes-long check from zero after a SIGKILL, preemption,
+OOM, or device loss. Resumption is bit-identical to an uninterrupted
+check: every carry here (a composed 0/1 operator product, a frontier
+configuration set) is exact state the uninterrupted check would hold at
+the same cut.
+
+Checkpoint schema (one JSON document, atomic tmp+flush+fsync+rename):
+
+* ``version`` — :data:`VERSION`; a reader that doesn't recognize it
+  discards.
+* ``kind`` — ``matrix`` (segmented transfer-matrix ``tot0`` carry),
+  ``frontier`` (segmented event-scan carry: sparse mask/state pair or
+  dense table), or ``frontier-session`` (the exact CPU frontier's
+  configuration set).
+* ``config`` — the knob/shape fingerprint the writer ran under
+  (S, V, init state, variant/combine pins, segment size, ...). Any
+  drift between writer and reader discards the checkpoint with a
+  warning: a carry is only meaningful under the exact same encoding.
+* ``events_done`` / ``segment`` — how far the consumed stream prefix
+  reaches (an event index at a segment cut) and which segment wrote it.
+* ``prefix_hash`` — sha256 over the encoded stream columns up to
+  ``events_done``. Analyze re-encodes the history deterministically,
+  so a matching hash proves the resumed check is consuming the same
+  prefix the checkpoint summarizes; a mismatch (different run, edited
+  history, recovered-then-grown WAL) discards rather than trusts.
+* ``carry`` — the tiny resume state itself. 0/1 matrices (the matrix
+  ``tot0``, the dense frontier table) are bit-packed; everything else
+  rides plain JSON.
+
+Validity rules (``load_resume``): version match, kind match, exact
+``config`` match, ``events_done`` within the stream, prefix hash match.
+Anything else is discarded — with a warning and the file cleared — and
+the check restarts from zero; a checkpoint can delay a verdict, never
+change one. ``resume_check: False`` (``analyze --no-resume-check``)
+opts out of resuming entirely; ``check_ckpt_interval`` (seconds,
+``<= 0`` disables, env twin ``JEPSEN_TPU_CHECK_CKPT_INTERVAL``)
+throttles writing. Completed checks clear their checkpoint — a
+surviving ``check.ckpt`` marks an interrupted check, and the web UI
+lists it with the run's forensic artifacts (doc/robustness.md
+"Resumable checks and the elastic mesh").
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+
+logger = logging.getLogger("jepsen.checker.checkpoint")
+
+CKPT_NAME = "check.ckpt"
+VERSION = 1
+DEFAULT_CKPT_INTERVAL_S = 5.0
+
+# chunk size for the checkpointed exact CPU frontier: absorb this many
+# events between checkpoint opportunities (the frontier can cut
+# anywhere — its state carries the open ops)
+FRONTIER_CHUNK_EVENTS = 65_536
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+def ckpt_interval(test) -> float | None:
+    """Seconds between checkpoint persists (``check_ckpt_interval`` in
+    the test map, env twin ``JEPSEN_TPU_CHECK_CKPT_INTERVAL``), or None
+    when checkpointing is disabled (``<= 0``). Tolerantly coerced:
+    garbage warns and falls back to the default — preflight (KNB001) is
+    where strictness lives."""
+    tmap = test if isinstance(test, dict) else {}
+    raw = tmap.get("check_ckpt_interval")
+    if raw is None:
+        raw = os.environ.get("JEPSEN_TPU_CHECK_CKPT_INTERVAL")
+    if raw is None or raw == "":
+        return DEFAULT_CKPT_INTERVAL_S
+    try:
+        if isinstance(raw, bool):
+            raise ValueError("bool is not an interval")
+        v = float(raw)
+    except (TypeError, ValueError):
+        logger.warning("ignoring malformed check_ckpt_interval=%r; using "
+                       "default %r", raw, DEFAULT_CKPT_INTERVAL_S)
+        return DEFAULT_CKPT_INTERVAL_S
+    return None if v <= 0 else v
+
+
+def resume_enabled(test) -> bool:
+    """Should a valid checkpoint be resumed from? ``resume_check`` in
+    the test map (default True; ``analyze --no-resume-check`` sets it
+    False), env twin ``JEPSEN_TPU_RESUME_CHECK``."""
+    from jepsen_tpu.parallel import coerce_flag
+    tmap = test if isinstance(test, dict) else {}
+    flag = coerce_flag(tmap.get("resume_check"), knob="resume_check")
+    if flag is not None:
+        return flag
+    env = coerce_flag(os.environ.get("JEPSEN_TPU_RESUME_CHECK"),
+                      knob="JEPSEN_TPU_RESUME_CHECK")
+    return True if env is None else env
+
+
+# ---------------------------------------------------------------------------
+# Stream prefix hashing
+# ---------------------------------------------------------------------------
+
+def step_identity(fn) -> str:
+    """A stable identity for the model step function/spec a carry was
+    built under — part of the checkpoint's config fingerprint, so a
+    carry written under one model can never be resumed under another
+    whose encoded columns happen to match (the prefix hash covers the
+    columns, which are model-independent)."""
+    mod = getattr(fn, "__module__", None) or type(fn).__module__
+    qn = getattr(fn, "__qualname__", None) or type(fn).__qualname__
+    return f"{mod}.{qn}"
+
+
+def stream_prefix_hash(stream, end: int) -> str:
+    """sha256 over the encoded stream columns up to event ``end``.
+
+    The columns (kind/slot/f/a/b) are derived deterministically from
+    the history — value ids assign in first-appearance order — so an
+    identical history prefix hashes identically across re-encodes,
+    while any divergence (different run, edited history) changes the
+    hash. ``op_index`` is excluded: it is diagnostics, not checked
+    content."""
+    h = hashlib.sha256()
+    for name in ("kind", "slot", "f", "a", "b"):
+        col = np.ascontiguousarray(np.asarray(getattr(stream, name))[:end])
+        h.update(col.tobytes())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Array codecs: carries are tiny, but 0/1 matrices pack 8x
+# ---------------------------------------------------------------------------
+
+def encode_array(a) -> dict:
+    """A numpy (or device) array as a JSON-serializable dict. Arrays
+    whose entries are exactly 0/1 — the matrix ``tot0`` product, the
+    dense frontier table — pack to one bit per entry."""
+    a = np.asarray(a)
+    if a.dtype == bool or (a.size and
+                           np.isin(a.astype(np.float32), (0.0, 1.0)).all()) \
+            or (not a.size):
+        bits = np.packbits((a.astype(np.float32) > 0).reshape(-1)
+                           if a.dtype != bool else a.reshape(-1))
+        return {"enc": "bits", "shape": list(a.shape),
+                "b64": base64.b64encode(bits.tobytes()).decode("ascii")}
+    a = np.ascontiguousarray(a)
+    return {"enc": "raw", "shape": list(a.shape), "dtype": str(a.dtype),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def decode_array(d: dict) -> np.ndarray:
+    raw = base64.b64decode(d["b64"])
+    shape = tuple(int(x) for x in d["shape"])
+    if d["enc"] == "bits":
+        n = int(np.prod(shape)) if shape else 1
+        bits = np.unpackbits(np.frombuffer(raw, np.uint8), count=n)
+        return bits.reshape(shape).astype(np.float32)
+    return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# The store
+# ---------------------------------------------------------------------------
+
+class CheckpointStore:  # durability: fsync
+    """One run's ``check.ckpt``: interval-gated atomic persists of a
+    resumable check's carry state.
+
+    ``maybe_save`` takes a zero-arg state builder so the (small) cost
+    of materializing the carry on host — a device sync for the matrix
+    ``tot0`` — is only paid when the interval has actually elapsed.
+    The interval clock starts at construction, so a check shorter than
+    one interval writes nothing."""
+
+    def __init__(self, path, interval_s: float | None = DEFAULT_CKPT_INTERVAL_S,
+                 resume: bool = True):
+        self.path = Path(path)
+        self.interval_s = interval_s
+        self.resume = resume
+        self._last_save = time.monotonic()
+        self._last_events = 0
+        self.writes = 0
+
+    # -- writing --------------------------------------------------------
+
+    def due(self) -> bool:
+        return (self.interval_s is not None
+                and time.monotonic() - self._last_save >= self.interval_s)
+
+    def maybe_save(self, make_state, events_done: int) -> bool:
+        """Persists ``make_state()`` when the write interval has
+        elapsed. Always updates the staleness gauge (ops consumed since
+        the last durable checkpoint)."""
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.gauge("checker_ckpt_staleness_ops",
+                      "ops consumed since the last durable checker "
+                      "checkpoint").set(max(0, events_done
+                                           - self._last_events))
+        if not self.due():
+            return False
+        try:
+            state = make_state()
+        except Exception:  # noqa: BLE001 — checkpointing never fails a check
+            logger.exception("checkpoint state build failed; skipping")
+            return False
+        return self.save(state, events_done=events_done)
+
+    def save(self, state: dict, events_done: int | None = None) -> bool:
+        from jepsen_tpu.utils import atomic_write_json
+        doc = dict(state)
+        doc.setdefault("version", VERSION)
+        doc["wrote_at"] = time.time()
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write_json(self.path, doc)
+        except Exception:  # noqa: BLE001 — a full disk must not fail the check
+            logger.exception("checker checkpoint write failed; continuing "
+                             "unresumably")
+            return False
+        self._last_save = time.monotonic()
+        if events_done is not None:
+            self._last_events = int(events_done)
+        self.writes += 1
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("checker_ckpt_writes_total",
+                        "durable checker checkpoint persists").inc()
+            reg.gauge("checker_ckpt_staleness_ops",
+                      "ops consumed since the last durable checker "
+                      "checkpoint").set(0)
+        return True
+
+    # -- reading --------------------------------------------------------
+
+    def load(self) -> dict | None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear(self) -> None:
+        """Removes the checkpoint — a completed check must not leave a
+        stale carry for the next analyze to trust."""
+        try:
+            self.path.unlink(missing_ok=True)
+        except OSError:
+            logger.exception("couldn't clear %s", self.path)
+
+
+def count_resume(source: str) -> None:
+    """``checker_resume_total{source}``: a check resumed from a durable
+    checkpoint (``ckpt``) or an in-process carry threaded across a
+    ladder demotion (``carry``)."""
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("checker_resume_total",
+                    "checks resumed instead of restarted, by source",
+                    labels=("source",)).inc(source=source)
+
+
+def load_resume(store: CheckpointStore | None, kind: str, config: dict,
+                stream) -> dict | None:
+    """The validated resume state for ``stream``, or None.
+
+    Validity: version + kind + exact config match, ``events_done``
+    within the stream, and the prefix hash over the re-encoded stream
+    matching the writer's. Any mismatch discards the checkpoint (with
+    a warning and the file cleared) — knob drift or a different
+    history must restart, never compose over a foreign carry."""
+    if store is None or not store.resume:
+        return None
+    state = store.load()
+    if state is None:
+        return None
+    label = store.path
+    if state.get("version") != VERSION or state.get("kind") != kind:
+        logger.warning("discarding %s: version/kind mismatch (%r/%r vs "
+                       "%r/%r)", label, state.get("version"),
+                       state.get("kind"), VERSION, kind)
+        store.clear()
+        return None
+    if state.get("config") != config:
+        logger.warning("discarding %s: knob/config drift (%r vs %r)",
+                       label, state.get("config"), config)
+        store.clear()
+        return None
+    end = state.get("events_done")
+    if not isinstance(end, int) or end < 0 or end > len(stream.kind):
+        logger.warning("discarding %s: events_done=%r outside the stream",
+                       label, end)
+        store.clear()
+        return None
+    if stream_prefix_hash(stream, end) != state.get("prefix_hash"):
+        logger.warning("discarding %s: consumed-prefix hash mismatch — "
+                       "the stored carry summarizes a different history",
+                       label)
+        store.clear()
+        return None
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Matrix-carry -> CPU-frontier handoff
+# ---------------------------------------------------------------------------
+
+def frontier_from_matrix_carry(carry: dict, step, init_state: int,
+                               algorithm: str = "jitlin-cpu(resumed)"):
+    """A :class:`~jepsen_tpu.checker.linear_cpu.FrontierSession` seeded
+    from a segmented transfer-matrix carry, or None when the carry
+    can't seed one.
+
+    At a quiescent cut every live row of the composed operator product
+    has mask 0 (each return's kill cleared its slot bit), so the
+    frontier the CPU twin would hold at the same cut is exactly
+    ``{(0, state) : tot0[0][0*V + state, init_state] > 0}`` — the
+    operators ARE the frontier transition, pinned bit-identical by the
+    matrix/CPU differentials. A carry with a live non-zero-mask row is
+    not at a quiescent cut and is declined (the caller restarts)."""
+    from jepsen_tpu.checker.linear_cpu import FrontierSession
+    try:
+        tot = np.asarray(carry["tot0"], dtype=np.float32)
+        V = int(carry["V"])
+        events_done = int(carry["events_done"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    mv = tot.shape[-1]
+    vec = tot.reshape(-1, mv, mv)[0][:, init_state]
+    live = np.nonzero(vec > 0)[0]
+    if live.size == 0:
+        return None  # dead carry: the matrix verdict already settled it
+    if (live // V != 0).any():
+        logger.warning("matrix carry at event %d is not at a quiescent "
+                       "cut; declining the frontier handoff", events_done)
+        return None
+    fs = FrontierSession(step=step, init_state=init_state,
+                         algorithm=algorithm)
+    fs.configs = {(0, int(r % V)) for r in live}
+    fs.events_absorbed = events_done
+    return fs
+
+
+# ---------------------------------------------------------------------------
+# Checkpointed exact CPU frontier
+# ---------------------------------------------------------------------------
+
+def checkpointed_check_stream(stream, step, init_state: int,
+                              store: CheckpointStore,
+                              algorithm: str = "jitlin-cpu",
+                              session=None):
+    """The exact CPU frontier check with periodic durable checkpoints:
+    absorbs the stream in :data:`FRONTIER_CHUNK_EVENTS` chunks through
+    a resumable :class:`FrontierSession`, persisting the session
+    snapshot between chunks when the write interval elapses, and
+    resuming a valid ``frontier-session`` checkpoint instead of
+    starting over. Bit-identical to a one-shot ``check_stream`` (the
+    session IS the one-shot loop; chunk cuts carry the open-op state).
+    ``session`` overrides the starting session (a carry handoff)."""
+    from jepsen_tpu.checker.linear_cpu import FrontierSession
+    config = {"path": "frontier-cpu", "init_state": int(init_state),
+              "algorithm": algorithm, "step": step_identity(step)}
+    fs = session
+    if fs is None:
+        state = load_resume(store, "frontier-session", config, stream)
+        if state is not None:
+            fs = FrontierSession.restore(state.get("carry") or {},
+                                         step=step, init_state=init_state,
+                                         algorithm=algorithm)
+            if fs is not None:
+                count_resume("ckpt")
+                logger.info("resuming exact CPU frontier from %s at "
+                            "event %d/%d", store.path,
+                            fs.events_absorbed, len(stream.kind))
+    if fs is None:
+        fs = FrontierSession(step=step, init_state=init_state,
+                             algorithm=algorithm)
+    n = len(stream.kind)
+    pos = fs.events_absorbed
+    while pos < n:
+        end = min(n, pos + FRONTIER_CHUNK_EVENTS)
+        res = fs.absorb(stream, start=pos, end=end)
+        pos = end
+        if res.valid is False:
+            break
+        if pos < n:
+            def make_state(fs=fs, pos=pos):
+                snap = fs.snapshot()
+                if snap is None:
+                    raise ValueError("frontier session not snapshotable")
+                return {"kind": "frontier-session", "config": config,
+                        "events_done": pos, "segment": pos
+                        // FRONTIER_CHUNK_EVENTS,
+                        "prefix_hash": stream_prefix_hash(stream, pos),
+                        "carry": snap}
+            store.maybe_save(make_state, pos)
+    return fs.result()

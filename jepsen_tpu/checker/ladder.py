@@ -82,7 +82,35 @@ def is_compile_failure(e: BaseException) -> bool:
     return any(m in s for m in _COMPILE_MARKERS)
 
 
+def _safe_pred(pred, e: BaseException) -> bool:
+    try:
+        return bool(pred(e))
+    except Exception:  # noqa: BLE001 — a broken predicate is a no
+        logger.exception("backend retryable predicate failed")
+        return False
+
+
 _TIMED_OUT = object()
+
+# Exception-text markers for losing a device / a collective mid-dispatch
+# — the elastic sharded rung's shrink trigger (doc/robustness.md
+# "Resumable checks and the elastic mesh"). Text-matched like the
+# resource markers so tests can fake the failure with a RuntimeError.
+# Capability misses ("collectives are not implemented on this backend")
+# are NOT losses: shrinking a mesh the backend can't run at any width
+# only delays the demotion, so those demote immediately.
+_DEVICE_LOSS_MARKERS = ("UNAVAILABLE", "device lost", "DEVICE_LOST",
+                        "collective", "DATA_LOSS", "ABORTED",
+                        "failed to connect")
+_CAPABILITY_MARKERS = ("not implemented", "not supported", "unimplemented",
+                       "UNIMPLEMENTED")
+
+
+def is_device_loss(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    if any(m in s for m in _CAPABILITY_MARKERS):
+        return False
+    return any(m in s for m in _DEVICE_LOSS_MARKERS)
 
 
 @dataclass
@@ -91,15 +119,24 @@ class Backend:
     :class:`Unavailable` to decline. ``eligible(ctx)`` gates routing
     (not counted as demotion — a host-regime dispatch never *attempts*
     the device rungs). ``shrink(ctx)`` halves the backend's tile/batch
-    knobs in the shared context before the single resource-exhaustion
-    retry; return False when nothing is left to halve. ``device=True``
-    opts the rung into the watchdog."""
+    knobs in the shared context before a resource-exhaustion retry
+    (the failing exception rides ``ctx["_shrink_error"]`` so an
+    elastic rung can attribute a device loss); return False when
+    nothing is left to halve. ``max_shrinks`` bounds the retries (1 =
+    the classic single adaptive retry; the elastic sharded rung sets
+    it to its shrink-ladder depth so an 8-device mesh can step 8→4→2
+    before demoting). ``retryable`` extends the shrink-retry trigger
+    beyond RESOURCE_EXHAUSTED/compile failures (e.g. device-loss /
+    collective errors for the elastic mesh). ``device=True`` opts the
+    rung into the watchdog."""
 
     name: str
     fn: Callable[[dict], Any]
     eligible: Callable[[dict], bool] = field(default=lambda ctx: True)
     shrink: Callable[[dict], bool] | None = None
     device: bool = False
+    max_shrinks: int = 1
+    retryable: Callable[[BaseException], bool] | None = None
 
 
 class BackendLadder:
@@ -240,22 +277,35 @@ class BackendLadder:
         — there is nothing below it, and the caller's check_safe wants
         the real traceback (the pre-ladder semantics)."""
         reg = telemetry.get_registry()
-        shrunk = False
+        shrinks = 0
         while True:
+            # carry generation: rungs that thread a resume carry through
+            # ctx (the segmented matrix chain) capture this at entry and
+            # only publish carries while it is still theirs — a
+            # watchdog-abandoned zombie's late writes can't clobber the
+            # resumed rung's own progress (doc/robustness.md)
+            ctx["_gen"] = ctx.get("_gen", 0) + 1
             try:
                 res = self._call(backend, ctx)
             except Unavailable:
                 self._demote(backend.name, "unavailable")
                 return None
             except Exception as e:  # noqa: BLE001
-                retryable = is_resource_exhausted(e) or is_compile_failure(e)
-                if retryable and not shrunk and backend.shrink is not None:
+                rex = is_resource_exhausted(e) or is_compile_failure(e)
+                elastic = (backend.retryable is not None
+                           and _safe_pred(backend.retryable, e))
+                retryable = rex or elastic
+                if retryable and shrinks < backend.max_shrinks \
+                        and backend.shrink is not None:
+                    ctx["_shrink_error"] = e
                     try:
                         can_shrink = backend.shrink(ctx)
                     except Exception:  # noqa: BLE001
                         can_shrink = False
+                    finally:
+                        ctx.pop("_shrink_error", None)
                     if can_shrink:
-                        shrunk = True
+                        shrinks += 1
                         if reg.enabled:
                             reg.counter(
                                 "checker_backend_shrink_retries_total",
@@ -263,14 +313,17 @@ class BackendLadder:
                                 "tile/batch sizes", labels=("backend",)
                             ).inc(backend=backend.name)
                         logger.warning(
-                            "backend %r resource-exhausted; retrying once "
-                            "with halved sizes", backend.name)
+                            "backend %r failed retryably (%s); retrying "
+                            "with shrunk sizes (%d/%d)", backend.name,
+                            type(e).__name__, shrinks,
+                            backend.max_shrinks)
                         continue
                 if terminal:
                     raise
                 self._count_failure(backend.name)
                 self._demote(backend.name,
-                             "resource-exhausted" if retryable else "error")
+                             "resource-exhausted" if rex
+                             else "device-loss" if elastic else "error")
                 logger.warning("checker backend %r failed: %r",
                                backend.name, e)
                 return None

@@ -200,6 +200,65 @@ class FrontierSession:
         return LinearResult(valid=True, configs_max=self.configs_max,
                             algorithm=self.algorithm)
 
+    # -- durable snapshots (doc/robustness.md "Resumable checks") -------
+
+    def snapshot(self) -> dict | None:
+        """The session's resumable state as a JSON-serializable dict, or
+        None when it can't be serialized faithfully (exotic open-op
+        values). The snapshot is exact: restoring it and absorbing the
+        remaining events is bit-identical to one uninterrupted absorb —
+        the configuration set IS the algorithm's whole state."""
+        try:
+            snap = {
+                "configs": sorted([int(m), int(s)] for m, s in self.configs),
+                "cur": {str(k): [int(x) for x in v]
+                        for k, v in self.cur.items()},
+                "cur_idx": {str(k): int(v) for k, v in self.cur_idx.items()},
+                "pending_mask": int(self.pending_mask),
+                "configs_max": int(self.configs_max),
+                "events_absorbed": int(self.events_absorbed),
+            }
+            if self.failure is not None:
+                f = self.failure
+                snap["failure"] = {
+                    "failed_event": int(f.failed_event),
+                    "failed_op_index": int(f.failed_op_index),
+                    "configs_max": int(f.configs_max),
+                    "algorithm": f.algorithm,
+                }
+            return snap
+        except (TypeError, ValueError):
+            return None
+
+    @classmethod
+    def restore(cls, snap: dict, step=cas_register_step_py,
+                init_state: int = 0, algorithm: str = "jitlin-cpu"):
+        """A session rebuilt from :meth:`snapshot`'s product, or None on
+        a malformed snapshot (the caller restarts from zero — a bad
+        snapshot can delay a verdict, never change one)."""
+        try:
+            fs = cls(step=step, init_state=init_state, algorithm=algorithm)
+            fs.configs = {(int(m), int(s)) for m, s in snap["configs"]}
+            fs.cur = {int(k): tuple(int(x) for x in v)
+                      for k, v in (snap.get("cur") or {}).items()}
+            fs.cur_idx = {int(k): int(v)
+                          for k, v in (snap.get("cur_idx") or {}).items()}
+            fs.pending_mask = int(snap["pending_mask"])
+            fs.configs_max = int(snap.get("configs_max", 1))
+            fs.events_absorbed = int(snap["events_absorbed"])
+            fail = snap.get("failure")
+            if fail is not None:
+                fs.failure = LinearResult(
+                    valid=False,
+                    failed_event=int(fail["failed_event"]),
+                    failed_op_index=int(fail["failed_op_index"]),
+                    configs_max=int(fail.get("configs_max", 0)),
+                    algorithm=fail.get("algorithm") or algorithm,
+                )
+            return fs
+        except (KeyError, TypeError, ValueError):
+            return None
+
 
 def check_stream(
     stream: EventStream,

@@ -165,13 +165,25 @@ class LinearizableChecker(Checker):
             return self._finish(res, history, test)
         stream, step_py, spec = enc
         extras: dict = {}
+        # durable checker checkpoints (doc/robustness.md "Resumable
+        # checks and the elastic mesh"): a run-dir-backed check persists
+        # its tiny carry to check.ckpt and auto-resumes a valid one
+        ckpt = self._ckpt_store(test)
+        min_devices = par.coerce_devices(
+            opts.get("mesh_min_devices", tmap.get("mesh_min_devices")),
+            knob="mesh_min_devices")
         res = self._search_stream(stream, step_py, spec, algorithm,
                                   accelerator, history=history,
                                   sharded=sharded,
                                   mesh_devices=mesh_devices,
                                   explain=explain_on, extras=extras,
                                   matrix_variant=matrix_variant,
-                                  combine_fused=combine_fused)
+                                  combine_fused=combine_fused,
+                                  ckpt=ckpt, mesh_min_devices=min_devices)
+        if ckpt is not None:
+            # the check settled: a surviving check.ckpt would mark an
+            # interrupted check and mislead the next analyze
+            ckpt.clear()
         self._record_metrics(res, time.perf_counter() - t0, len(stream),
                              stream)
         return self._finish(res, history, test, stream, step_py=step_py,
@@ -180,11 +192,31 @@ class LinearizableChecker(Checker):
                             explain_on=explain_on,
                             explain_loc=extras.get("loc"), opts=opts)
 
+    def _ckpt_store(self, test):
+        """The run's durable check.ckpt store, or None when the test
+        map has no store coordinates (bare re-checks, unit tests) or
+        checkpointing AND resumption are both off."""
+        if not isinstance(test, dict) or not test.get("start_time"):
+            return None
+        from jepsen_tpu.checker import checkpoint as ckpt_mod
+        interval = ckpt_mod.ckpt_interval(test)
+        resume = ckpt_mod.resume_enabled(test)
+        if interval is None and not resume:
+            return None
+        try:
+            from jepsen_tpu import store
+            path = store.path(test, ckpt_mod.CKPT_NAME)
+        except Exception:  # noqa: BLE001 — no store dir: no checkpoints
+            return None
+        return ckpt_mod.CheckpointStore(path, interval_s=interval,
+                                        resume=resume)
+
     def _search_stream(self, stream, step_py, spec, algorithm,
                        accelerator, history=None, sharded=None,
                        mesh_devices=None, explain=True,
                        extras=None, matrix_variant=None,
-                       combine_fused=None) -> LinearResult:
+                       combine_fused=None, ckpt=None,
+                       mesh_min_devices=None) -> LinearResult:
         """The full encoded-stream dispatch, shared by check() and the
         stored-column re-check lane (module check_stored), routed
         through the :class:`~jepsen_tpu.checker.ladder.BackendLadder`:
@@ -214,6 +246,13 @@ class LinearizableChecker(Checker):
             # for the probe order
             "matrix_variant": matrix_variant,
             "combine_fused": combine_fused,
+            # durable checkpoints + the elastic shrink floor
+            # (doc/robustness.md "Resumable checks and the elastic
+            # mesh"): the rungs persist/resume their carries through
+            # _ckpt, and the sharded rung's shrink ladder bottoms out
+            # at mesh_min_devices
+            "_ckpt": ckpt,
+            "mesh_min_devices": mesh_min_devices,
             # the encoded-stream search applies for jitlin/auto, and for
             # the stored-column lane (no op history to wgl over)
             "stream_path": (algorithm in ("jitlin", "auto")
@@ -242,9 +281,55 @@ class LinearizableChecker(Checker):
         each backend computes and *when* it is in regime."""
         if self._ladder is not None:
             return self._ladder
-        from jepsen_tpu.checker.ladder import Backend, BackendLadder
+        from jepsen_tpu.checker.ladder import (
+            Backend, BackendLadder, is_device_loss, is_resource_exhausted,
+        )
 
         is_cas = isinstance(self.model, CASRegister)
+
+        def carry_sink(ctx):
+            """A gen-guarded carry publisher for the segmented matrix
+            chain: carries only land while the publishing attempt still
+            owns the ladder (a watchdog-abandoned zombie's late writes
+            are dropped — the demoted rung already resumed)."""
+            gen = ctx.get("_gen", 0)
+
+            def sink(carry):
+                if ctx.get("_gen", 0) == gen:
+                    ctx["_carry"] = carry
+            return sink
+
+        def matrix_rung_check(ctx, mesh):
+            """The matrix screen one rung runs: one-shot for short
+            streams, the crash-resumable segmented chain when a
+            durable checkpoint store is attached, a demotion carry is
+            waiting, or the stream is longer than one segment —
+            bit-identical either way (boolean operator products are
+            exact under any association)."""
+            from jepsen_tpu.ops.jitlin import (
+                MATRIX_SEGMENT_EVENTS, matrix_check, matrix_check_segmented,
+            )
+            stream, spec = ctx["stream"], ctx["spec"]
+            kw = dict(step_ids=spec.step_ids, init_state=spec.init_state,
+                      num_states=len(stream.intern), mesh=mesh,
+                      variant=ctx.get("matrix_variant"),
+                      combine_fused=ctx.get("combine_fused"))
+            carry = ctx.get("_carry")
+            if carry is not None and carry.get("rep") != "matrix":
+                carry = None
+            ckpt = ctx.get("_ckpt")
+            # a stream within one segment can never write a mid-chain
+            # checkpoint, so it only takes the chain when a resume is
+            # actually pending (a surviving check.ckpt or a demotion
+            # carry) — short checks keep the one-shot dispatch
+            resume_pending = carry is not None or (
+                ckpt is not None and ckpt.resume and ckpt.path.exists())
+            if not resume_pending and len(stream) <= MATRIX_SEGMENT_EVENTS:
+                return matrix_check(stream, force=False, **kw)
+            return matrix_check_segmented(stream, ckpt=ctx.get("_ckpt"),
+                                          carry=carry,
+                                          carry_sink=carry_sink(ctx),
+                                          **kw)
 
         def matrix_eligible(ctx):
             # long histories over small value domains: the block-composed
@@ -298,19 +383,15 @@ class LinearizableChecker(Checker):
                 algorithm=algo)
 
         def matrix_fn(ctx):
-            from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
+            from jepsen_tpu.ops.jitlin import last_phase_seconds
             if ctx.get("_matrix_screened"):
                 # the sharded rung already ran the bit-identical screen
                 # to completion and it didn't settle; don't pay for it
                 # twice (a sharded CRASH leaves the flag unset, so the
-                # demotion path still gets its single-device screen)
+                # demotion path still gets its single-device screen —
+                # resuming from the sharded rung's threaded carry)
                 return None
-            stream, spec = ctx["stream"], ctx["spec"]
-            m = matrix_check(stream, step_ids=spec.step_ids,
-                             init_state=spec.init_state,
-                             num_states=len(stream.intern),
-                             variant=ctx.get("matrix_variant"),
-                             combine_fused=ctx.get("combine_fused"))
+            m = matrix_rung_check(ctx, mesh=None)
             # capture the phase split on THIS (possibly watchdog) thread;
             # _search_stream re-publishes it on the checker's thread
             ctx["_matrix_phase"] = last_phase_seconds()
@@ -354,20 +435,16 @@ class LinearizableChecker(Checker):
         def sharded_fn(ctx):
             # the multi-device twin of matrix_fn: chunk axis sharded
             # over the mesh, carries tree-combined device-side. A
-            # collective/compile failure (backend without mesh support)
-            # raises — the ladder counts the demotion
-            # (checker_backend_demotions_total{backend="sharded-matrix"})
-            # and falls through to the single-device rungs below, so
-            # sharding unavailability degrades, never fails
-            # (doc/robustness.md).
-            from jepsen_tpu.ops.jitlin import last_phase_seconds, matrix_check
-            stream, spec = ctx["stream"], ctx["spec"]
-            m = matrix_check(stream, step_ids=spec.step_ids,
-                             init_state=spec.init_state,
-                             num_states=len(stream.intern),
-                             mesh=ctx["_sharded_mesh"],
-                             variant=ctx.get("matrix_variant"),
-                             combine_fused=ctx.get("combine_fused"))
+            # collective error / device loss raises — sharded_shrink
+            # below rebuilds the mesh over the survivors and the retry
+            # RESUMES from the threaded carry (elastic mesh,
+            # doc/robustness.md "Resumable checks and the elastic
+            # mesh"); only when the shrink ladder bottoms out does the
+            # ladder demote to the single-device rungs — which also
+            # resume from the carry, so sharding unavailability
+            # degrades, never fails and never restarts.
+            from jepsen_tpu.ops.jitlin import last_phase_seconds
+            m = matrix_rung_check(ctx, mesh=ctx["_sharded_mesh"])
             ctx["_matrix_phase"] = last_phase_seconds()
             res = matrix_settle(ctx, m, "jitlin-tpu-matrix-sharded")
             if res is not None:
@@ -379,6 +456,31 @@ class LinearizableChecker(Checker):
             # the same thing — flag it to decline instead
             ctx["_matrix_screened"] = True
             return None
+
+        def sharded_shrink(ctx):
+            # the elastic mesh: rebuild over the surviving device set
+            # and let the retry resume from the carry. A genuine OOM
+            # (RESOURCE_EXHAUSTED) first gets the classic element-budget
+            # halving — shrinking the mesh INCREASES per-device load,
+            # and an OOM message that happens to name a device must
+            # never poison a healthy device's health record — and only
+            # shrinks the mesh (unattributed) once the budget bottoms
+            # out. Device-loss/collective failures shrink with casualty
+            # attribution from the error text.
+            from jepsen_tpu import parallel
+            mesh = ctx.get("_sharded_mesh")
+            exc = ctx.get("_shrink_error")
+            oom = exc is not None and is_resource_exhausted(exc)
+            if oom and matrix_shrink(ctx):
+                return True
+            new = parallel.shrink_mesh(
+                mesh, exc=None if oom else exc,
+                min_devices=ctx.get("mesh_min_devices")) \
+                if mesh is not None else None
+            if new is not None:
+                ctx["_sharded_mesh"] = new
+                return True
+            return False
 
         def frontier_fn(ctx):
             from jepsen_tpu.ops.jitlin import verdict
@@ -427,8 +529,37 @@ class LinearizableChecker(Checker):
                                     "jitlin-device")
                               for n in ctx.get("_attempted", ()))
             if ctx["stream_path"] or from_device:
-                res = check_stream(ctx["stream"], step=ctx["step_py"],
-                                   init_state=ctx["spec"].init_state)
+                step = ctx["step_py"]
+                init = ctx["spec"].init_state
+                # a demoted matrix rung's threaded carry seeds the exact
+                # frontier at its last quiescent cut — a watchdog
+                # timeout or mesh collapse keeps its completed segments
+                # instead of restarting (doc/robustness.md)
+                session = None
+                carry = ctx.get("_carry")
+                if carry is not None and carry.get("rep") == "matrix" \
+                        and carry.get("init_state") == init:
+                    from jepsen_tpu.checker import checkpoint as ckpt_mod
+                    session = ckpt_mod.frontier_from_matrix_carry(
+                        carry, step, init)
+                    if session is not None:
+                        ckpt_mod.count_resume("carry")
+                        logger.info(
+                            "exact CPU frontier resuming from the "
+                            "demoted matrix rung's carry at event %d",
+                            session.events_absorbed)
+                ckpt = ctx.get("_ckpt")
+                if ckpt is not None:
+                    from jepsen_tpu.checker import checkpoint as ckpt_mod
+                    res = ckpt_mod.checkpointed_check_stream(
+                        ctx["stream"], step, init, ckpt,
+                        session=session)
+                elif session is not None:
+                    res = session.absorb(ctx["stream"],
+                                         start=session.events_absorbed)
+                else:
+                    res = check_stream(ctx["stream"], step=step,
+                                       init_state=init)
                 if from_device:
                     res.algorithm = "jitlin-cpu(fallback)"
                 return res
@@ -440,8 +571,14 @@ class LinearizableChecker(Checker):
         if self.breaker_threshold is not None:
             kw["breaker_threshold"] = self.breaker_threshold
         self._ladder = BackendLadder([
+            # the sharded rung is ELASTIC: device-loss/collective
+            # failures shrink the mesh over the survivors (up to
+            # max_shrinks steps, e.g. 8→4→2) and resume from the
+            # carry, demoting to single-device only when the shrink
+            # ladder bottoms out at mesh_min_devices
             Backend("sharded-matrix", sharded_fn, eligible=sharded_eligible,
-                    shrink=matrix_shrink, device=True),
+                    shrink=sharded_shrink, device=True, max_shrinks=6,
+                    retryable=is_device_loss),
             Backend("pallas-matrix", matrix_fn, eligible=matrix_eligible,
                     shrink=matrix_shrink, device=True),
             Backend("jitlin-device", frontier_fn,

@@ -163,6 +163,12 @@ def single_test_cmd(
                                "checker daemon left a fresh final "
                                "incremental verdict (live-status.json) "
                                "for this run")
+        p_an.add_argument("--no-resume-check", action="store_true",
+                          dest="no_resume_check",
+                          help="re-check from zero even when an "
+                               "interrupted check left a valid durable "
+                               "checkpoint (check.ckpt) for this run "
+                               "(doc/robustness.md)")
         add_test_opts(p_an)  # analyze takes the same opts (cli.clj:399-427)
         if opt_fn:
             opt_fn(p_an)
@@ -436,6 +442,10 @@ def analyze_cmd(opts, test_fn) -> int:
     # live-status.json; analyze reuses it when fresh (same op count)
     # unless --no-live-reuse re-checks from scratch
     stored["live_reuse"] = not getattr(opts, "no_live_reuse", False)
+    # an interrupted check leaves a durable check.ckpt; the checker
+    # auto-resumes a valid one unless --no-resume-check opts out
+    if getattr(opts, "no_resume_check", False):
+        stored["resume_check"] = False
     test = core.analyze(stored)
     core.log_results(test)
     print(f"valid?: {(test.get('results') or {}).get('valid?')}")

@@ -273,6 +273,9 @@ class LiveRegisterEncoder:
     def __init__(self, intern: Intern, encode_args=None):
         self.intern = intern
         self.stream = ListStream(intern)
+        # snapshot() can only rebuild the default arg encoder; a custom
+        # one makes the encoder unsnapshotable (restarts re-ingest)
+        self._default_args = encode_args is None
         if encode_args is None:
             from jepsen_tpu.models import (
                 CAS_F_CAS, CAS_F_READ, CAS_F_WRITE,
@@ -415,6 +418,109 @@ class LiveRegisterEncoder:
     @property
     def ops_encoded(self) -> int:
         return self._next
+
+    # -- durable snapshots (the live daemon's restart path ------------
+    #    doc/robustness.md "Resumable checks and the elastic mesh")
+
+    _SCALARS = (type(None), bool, int, float, str)
+
+    # encoded streams longer than this are not snapshotted: the raw-op
+    # tail stays tiny (bounded by concurrency), but the encoded int
+    # columns grow with the run, and re-serializing tens of MB of JSON
+    # every snapshot interval would cost more than the restart re-ingest
+    # it avoids. Beyond the cap a daemon restart re-reads the WAL — a
+    # bounded few seconds of parse, paid once, instead of a recurring
+    # per-poll tax.
+    SNAPSHOT_MAX_EVENTS = 1 << 20
+
+    def snapshot(self) -> dict | None:
+        """The encoder's resumable state as a JSON-serializable dict,
+        or None when it can't be serialized faithfully (exotic intern
+        values, a custom ``encode_args``) or economically (the encoded
+        columns are past :data:`SNAPSHOT_MAX_EVENTS`). History ops
+        before the encode cursor are never consulted again — of the
+        RAW history only the unresolved tail is kept (bounded by the
+        run's concurrency) — but the encoded columns themselves ride
+        along whole, which is what the size cap bounds."""
+        if not getattr(self, "_default_args", False):
+            return None  # custom encode_args: can't rebuild it
+        if len(self.stream) > self.SNAPSHOT_MAX_EVENTS:
+            return None  # re-ingest on restart beats a per-poll tax
+        if any(not isinstance(v, self._SCALARS)
+               for v in self.intern.table):
+            return None
+        nxt = self._next
+        try:
+            snap = {
+                "intern": list(self.intern.table[1:]),
+                "stream": {
+                    "kind": list(self.stream.kind),
+                    "slot": list(self.stream.slot),
+                    "f": list(self.stream.f),
+                    "a": list(self.stream.a),
+                    "b": list(self.stream.b),
+                    "op_index": list(self.stream.op_index),
+                    "n_slots": self.stream.n_slots,
+                },
+                "next": nxt,
+                "tail_ops": self._ops[nxt:],
+                "open_inv": {str(p): i for p, i in self._open_inv.items()},
+                "outcome": {str(i): list(o)
+                            for i, o in self._outcome.items() if i >= nxt},
+                "open_by_process": {str(p): s for p, s
+                                    in self._open_by_process.items()},
+                "free_slots": list(self._free_slots),
+                "next_slot": self._next_slot,
+                "finalized": self._finalized,
+            }
+            # prove JSON faithfulness now — a tail op with a tuple value
+            # or non-string keys must reject here, not diverge later
+            import json
+            if json.loads(json.dumps(snap)) != snap:
+                return None
+            return snap
+        except (TypeError, ValueError):
+            return None
+
+    @classmethod
+    def restore(cls, snap: dict) -> "LiveRegisterEncoder | None":
+        """An encoder rebuilt from :meth:`snapshot`'s product, or None
+        on a malformed snapshot (the caller re-ingests from scratch —
+        a bad snapshot may cost a re-read, never a wrong stream)."""
+        try:
+            intern = Intern()
+            for v in snap["intern"]:
+                intern.id(v)
+            enc = cls(intern)
+            st = enc.stream
+            s = snap["stream"]
+            st.kind = [int(x) for x in s["kind"]]
+            st.slot = [int(x) for x in s["slot"]]
+            st.f = [int(x) for x in s["f"]]
+            st.a = [int(x) for x in s["a"]]
+            st.b = [int(x) for x in s["b"]]
+            st.op_index = [int(x) for x in s["op_index"]]
+            st.n_slots = int(s["n_slots"])
+            nxt = int(snap["next"])
+            # ops before the cursor are never consulted again —
+            # placeholders keep the indexing aligned without the bulk
+            enc._ops = [None] * nxt + list(snap["tail_ops"])
+            enc._next = nxt
+            enc._open_inv = {int(p): int(i)
+                             for p, i in (snap.get("open_inv")
+                                          or {}).items()}
+            enc._outcome = {int(i): tuple(o)
+                            for i, o in (snap.get("outcome")
+                                         or {}).items()}
+            enc._open_by_process = {int(p): int(s2) for p, s2
+                                    in (snap.get("open_by_process")
+                                        or {}).items()}
+            enc._free_slots = [int(x) for x in snap.get("free_slots") or []]
+            enc._next_slot = int(snap["next_slot"])
+            enc._finalized = bool(snap.get("finalized", False))
+            return enc
+        except (KeyError, TypeError, ValueError):
+            return None
 
 
 class TxnCols:

@@ -25,6 +25,7 @@ checkable history from the journal of a crashed run
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -229,6 +230,47 @@ class WalTailer:
         self.lines_read = 0
         self.torn_skipped = 0
         self.truncated_tail = False
+        # running digest of every byte the offset has advanced past —
+        # the live daemon's restart snapshots record it so a resumed
+        # tailer can prove it is continuing the SAME file (divergence-
+        # checked adoption, doc/robustness.md "Resumable checks and the
+        # elastic mesh")
+        self._sha = hashlib.sha256()
+
+    def prefix_sha(self) -> str:
+        """sha256 of the bytes consumed so far (everything before
+        ``offset``)."""
+        return self._sha.hexdigest()
+
+    def seek(self, offset: int, lines_read: int = 0,
+             torn_skipped: int = 0, prefix_sha: str | None = None) -> bool:
+        """Repositions a FRESH tailer at a snapshot's offset — the
+        restart path. Verifies the snapshot's ``prefix_sha`` against
+        the file's actual first ``offset`` bytes before adopting;
+        a mismatch (truncated/rewritten WAL, a different run reusing
+        the dir) returns False and leaves the tailer at 0, so the
+        caller re-ingests from scratch instead of trusting a stale
+        cursor."""
+        offset = int(offset)
+        h = hashlib.sha256()
+        try:
+            with open(self.path, "rb") as f:
+                remaining = offset
+                while remaining > 0:
+                    chunk = f.read(min(1 << 20, remaining))
+                    if not chunk:
+                        return False  # file shorter than the snapshot
+                    h.update(chunk)
+                    remaining -= len(chunk)
+        except OSError:
+            return False
+        if prefix_sha is not None and h.hexdigest() != prefix_sha:
+            return False
+        self.offset = offset
+        self.lines_read = int(lines_read)
+        self.torn_skipped = int(torn_skipped)
+        self._sha = h
+        return True
 
     def _read_new(self) -> bytes:
         try:
@@ -273,13 +315,16 @@ class WalTailer:
                         logger.warning(
                             "live tail: skipping torn jsonl line in %s "
                             "(%.80r)", self.path, line)
-        # the offset only ever advances past newline-terminated lines
+        # the offset only ever advances past newline-terminated lines;
+        # the running digest advances in lockstep (seek() verifies it)
         self.offset += pos
+        self._sha.update(chunk[:pos])
         if final and pos < len(chunk):
             # unterminated tail at end-of-run: permanently torn
             self.truncated_tail = True
             self.torn_skipped += 1
             self.offset += len(chunk) - pos
+            self._sha.update(chunk[pos:])
             logger.warning("live tail: dropped unterminated final line "
                            "in %s", self.path)
         return ops

@@ -46,6 +46,12 @@ from jepsen_tpu.utils import join_noisy
 logger = logging.getLogger("jepsen.live")
 
 LIVE_STATUS_NAME = "live-status.json"
+# per-run restart snapshot: session carry + WAL byte offset, so a daemon
+# restart resumes tailing where it left off instead of re-ingesting the
+# whole WAL (doc/robustness.md "Resumable checks and the elastic mesh")
+LIVE_CKPT_NAME = "live-session.ckpt"
+# at most one snapshot write per tracked run per this many seconds
+SNAPSHOT_MIN_INTERVAL_S = 5.0
 
 DEFAULT_POLL_S = 1.0
 DEFAULT_LAG_BUDGET_OPS = 50_000
@@ -119,6 +125,116 @@ class RunTracker:
         self.last_verdict: dict = {"valid_so_far": None,
                                    "first_anomaly_op": None,
                                    "backend": None, "checked_ops": 0}
+        # restart adoption: True resumed from a snapshot, False rejected
+        # one (divergence / unrestorable), None = no snapshot found
+        self.resumed: bool | None = None
+        self._last_snapshot = 0.0
+        self._snapshot_ops = 0
+        self._adopt_snapshot()
+
+    # -- restart snapshots ----------------------------------------------
+
+    @property
+    def _ckpt_path(self) -> Path:
+        return self.run_dir / LIVE_CKPT_NAME
+
+    def _adopt_snapshot(self) -> None:
+        """Divergence-checked adoption of a previous daemon's snapshot
+        (mirroring the WAL streamer's field-by-field verification): the
+        tailer only seeks to the saved offset when the WAL's first
+        ``offset`` bytes hash to what the writer consumed, and the
+        session payload must restore whole. Anything else discards the
+        snapshot and re-ingests from zero — a restart may cost a
+        re-read, never a diverged verdict."""
+        try:
+            with open(self._ckpt_path, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return
+        if snap.get("version") != 1:
+            self.resumed = False
+            return
+        session = None
+        if snap.get("session") is not None:
+            session = sessions_mod.restore_session(
+                snap["session"], accelerator=self.accelerator)
+            if session is None:
+                logger.warning("live: %s snapshot's session payload "
+                               "didn't restore; re-ingesting", self.label)
+                self.resumed = False
+                return
+        elif not snap.get("unsupported"):
+            # a sessionless, not-unsupported snapshot would drop the
+            # sniff buffer's ops — re-ingest instead
+            self.resumed = False
+            return
+        if not self.tailer.seek(snap.get("offset", 0),
+                                lines_read=snap.get("lines_read", 0),
+                                torn_skipped=snap.get("torn_skipped", 0),
+                                prefix_sha=snap.get("prefix_sha")):
+            logger.warning("live: %s WAL diverged from its restart "
+                           "snapshot (hash mismatch); re-ingesting",
+                           self.label)
+            self.resumed = False
+            return
+        self.session = session
+        self.unsupported = bool(snap.get("unsupported"))
+        self.ops_absorbed = int(snap.get("ops_absorbed", 0))
+        last = snap.get("last_verdict")
+        if isinstance(last, dict):
+            self.last_verdict = last
+        self.resumed = True
+        logger.info("live: %s resumed from snapshot at WAL offset %d "
+                    "(%d ops absorbed)", self.label, self.tailer.offset,
+                    self.ops_absorbed)
+
+    def maybe_snapshot(self) -> bool:
+        """Persists the restart snapshot when the interval elapsed and
+        something new was absorbed. Unsnapshotable sessions (Elle's
+        retained-history state) skip — their restart path is the
+        re-ingest."""
+        if self.final or self.broken:
+            return False
+        if self.session is None and not self.unsupported:
+            return False  # still sniffing: the buffer isn't durable
+        now = time.monotonic()
+        if now - self._last_snapshot < SNAPSHOT_MIN_INTERVAL_S:
+            return False
+        if self.ops_absorbed == self._snapshot_ops:
+            return False
+        sess_snap = None
+        if self.session is not None:
+            sess_snap = self.session.snapshot()
+            if sess_snap is None:
+                return False
+        payload = {
+            "version": 1,
+            "offset": self.tailer.offset,
+            "lines_read": self.tailer.lines_read,
+            "torn_skipped": self.tailer.torn_skipped,
+            "prefix_sha": self.tailer.prefix_sha(),
+            "ops_absorbed": self.ops_absorbed,
+            "unsupported": self.unsupported,
+            "session": sess_snap,
+            "last_verdict": dict(self.last_verdict),
+            "wrote_at": time.time(),
+        }
+        try:
+            from jepsen_tpu.utils import atomic_write_json
+            atomic_write_json(self._ckpt_path, payload)
+        except Exception:  # noqa: BLE001 — snapshots never kill a poll
+            logger.exception("live: snapshot write failed for %s",
+                             self.label)
+            return False
+        self._last_snapshot = now
+        self._snapshot_ops = self.ops_absorbed
+        return True
+
+    def clear_snapshot(self) -> None:
+        try:
+            self._ckpt_path.unlink(missing_ok=True)
+        except OSError:
+            logger.exception("couldn't clear %s", self._ckpt_path)
 
     @property
     def label(self) -> str:
@@ -356,14 +472,36 @@ class LiveDaemon:
         cands.sort(reverse=True)
         for _mtime, d in cands:
             with self._lock:
+                full = len(self.trackers) >= self.max_runs
+            if full:
+                self.registry.counter(
+                    "live_admission_rejected_total",
+                    "runs not admitted because live_max_runs "
+                    "trackers are active").inc()
+                break
+            # construct OUTSIDE the lock: snapshot adoption re-hashes
+            # the consumed WAL prefix (seconds on a big run), and
+            # stop()/poll must not block behind it
+            tracker = RunTracker(d, accelerator=self.accelerator)
+            with self._lock:
                 if len(self.trackers) >= self.max_runs:
                     self.registry.counter(
                         "live_admission_rejected_total",
                         "runs not admitted because live_max_runs "
                         "trackers are active").inc()
                     break
-                self.trackers[str(d)] = RunTracker(
-                    d, accelerator=self.accelerator)
+                self.trackers[str(d)] = tracker
+            if tracker.resumed is True:
+                self.registry.counter(
+                    "live_session_resumes_total",
+                    "trackers resumed from a restart snapshot instead "
+                    "of re-ingesting the WAL").inc()
+            elif tracker.resumed is False:
+                self.registry.counter(
+                    "live_session_resume_rejected_total",
+                    "restart snapshots discarded (divergence or "
+                    "unrestorable payload); the tracker re-ingested"
+                ).inc()
             added += 1
             logger.info("live: tracking %s", d)
         return added
@@ -408,6 +546,9 @@ class LiveDaemon:
                 results = tr.finalize()
                 self._observe_check(tr, pending,
                                     time.perf_counter() - t_chk)
+                # the run is over: the restart snapshot has nothing
+                # left to resume (live-status.json holds the final)
+                tr.clear_snapshot()
                 done.append(str(tr.run_dir))
             elif tr.final:
                 done.append(str(tr.run_dir))
@@ -425,6 +566,10 @@ class LiveDaemon:
                     dt = time.perf_counter() - t_chk
                     self._observe_check(tr, pending, dt)
                     spent_ops += pending
+            if not tr.final and tr.maybe_snapshot():
+                reg.counter("live_session_ckpt_writes_total",
+                            "restart-snapshot persists (session carry "
+                            "+ WAL offset)").inc()
             status = tr.status(self.lag_budget_ops, results=results,
                                now=now)
             tr.write_status(status)

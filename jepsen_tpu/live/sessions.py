@@ -208,6 +208,57 @@ class LinearLiveSession:
         self._last = out
         return dict(out)
 
+    # -- durable snapshots (the daemon's restart path) ------------------
+
+    def snapshot(self) -> dict | None:
+        """The session's resumable state as a JSON-serializable dict, or
+        None when it can't be serialized faithfully (poisoned session,
+        exotic values) — the daemon then re-ingests the WAL from zero
+        on restart, slower but never wrong."""
+        if self._broken:
+            return None
+        enc = self.encoder.snapshot()
+        if enc is None:
+            return None
+        frontier = self.frontier.snapshot()
+        if frontier is None:
+            return None
+        return {
+            "workload": self.workload,
+            "spec_init": self._spec_init,
+            "encoder": enc,
+            "frontier": frontier,
+            "matrix_first": self._matrix_first,
+            "last": dict(self._last),
+        }
+
+    @classmethod
+    def restore(cls, snap: dict, accelerator: str = "auto"):
+        """A session rebuilt from :meth:`snapshot`, or None on a
+        malformed snapshot."""
+        try:
+            enc = _LiveRegisterEncoder.restore(snap["encoder"])
+            if enc is None:
+                return None
+            init_id = int(snap["spec_init"])
+            frontier = FrontierSession.restore(
+                snap["frontier"], step=cas_register_step_py,
+                init_state=init_id, algorithm="jitlin-cpu-live")
+            if frontier is None:
+                return None
+            sess = cls(accelerator=accelerator)
+            sess.intern = enc.intern
+            sess._spec_init = init_id
+            sess.encoder = enc
+            sess.frontier = frontier
+            sess._matrix_first = snap.get("matrix_first")
+            last = snap.get("last")
+            if isinstance(last, dict):
+                sess._last = last
+            return sess
+        except (KeyError, TypeError, ValueError):
+            return None
+
     def finalize(self) -> dict:
         """End-of-run verdict: resolves the still-open tail exactly as
         the batch encoder would, then settles on the exact CPU frontier
@@ -300,6 +351,13 @@ class ElleSession:
 
     def verdict(self) -> dict:
         return self._update_last(self._result())
+
+    def snapshot(self) -> dict | None:
+        # an Elle session's state IS the whole retained history (the
+        # batch fallback needs every op) — a snapshot would be as large
+        # as the WAL it replaces, so restarts re-ingest instead
+        # (documented limitation, doc/robustness.md)
+        return None
 
     def finalize(self) -> dict:
         out = self._result()
@@ -432,6 +490,46 @@ class MultiKeyLinearSession:
         }
         return dict(self._last)
 
+    def snapshot(self) -> dict | None:
+        """Composes the per-key sessions' snapshots; any unsnapshotable
+        key rejects the whole (a partial restore would silently drop a
+        key's history)."""
+        subs = []
+        for k, s in self.sub.items():
+            sub = s.snapshot()
+            if sub is None:
+                return None
+            key = list(k) if isinstance(k, tuple) else k
+            subs.append([key, sub])
+        try:
+            import json
+            if json.loads(json.dumps(subs)) != subs:
+                return None
+        except (TypeError, ValueError):
+            return None
+        return {"workload": self.workload,
+                "ops_absorbed": self.ops_absorbed,
+                "last": dict(self._last), "sub": subs}
+
+    @classmethod
+    def restore(cls, snap: dict, accelerator: str = "auto"):
+        try:
+            from jepsen_tpu.independent import _freeze_key
+            sess = cls(accelerator=accelerator)
+            sess.ops_absorbed = int(snap["ops_absorbed"])
+            last = snap.get("last")
+            if isinstance(last, dict):
+                sess._last = last
+            for key, sub in snap["sub"]:
+                restored = LinearLiveSession.restore(
+                    sub, accelerator=accelerator)
+                if restored is None:
+                    return None
+                sess.sub[_freeze_key(key)] = restored
+            return sess
+        except (KeyError, TypeError, ValueError):
+            return None
+
     def verdict(self) -> dict:
         return self._merge({k: s.verdict() for k, s in self.sub.items()})
 
@@ -450,6 +548,21 @@ class MultiKeyLinearSession:
 
 #: session_for_ops sentinel: client ops seen, no live checker matches
 UNSUPPORTED = object()
+
+
+def restore_session(snap, accelerator: str = "auto"):
+    """A session rebuilt from a tracker snapshot's ``session`` payload
+    (the daemon's restart path), or None when the payload is missing,
+    names an unknown workload, or fails to restore — the tracker then
+    re-ingests the WAL from zero."""
+    if not isinstance(snap, dict):
+        return None
+    workload = snap.get("workload")
+    if workload == "register":
+        return LinearLiveSession.restore(snap, accelerator=accelerator)
+    if workload == "register-independent":
+        return MultiKeyLinearSession.restore(snap, accelerator=accelerator)
+    return None
 
 
 def session_for_ops(ops: list[dict], accelerator: str = "auto"):

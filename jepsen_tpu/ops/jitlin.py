@@ -965,6 +965,12 @@ MATRIX_PIPELINE_KEYS = _env_int("JEPSEN_TPU_PIPELINE_KEYS", 32)
 # dispatches in flight before the pipeline's delayed blocking kicks in
 # (bounds the [G, MV, MV] working sets resident on device at once)
 PIPELINE_DEPTH = _env_int("JEPSEN_TPU_PIPELINE_DEPTH", 2)
+# events per segment of a resumable matrix chain (matrix_check_segmented
+# / the checker's segmented matrix rung): also the routing threshold —
+# streams longer than one segment take the resumable chain so a crash
+# or demotion mid-check keeps its completed segments
+MATRIX_SEGMENT_EVENTS = _env_int("JEPSEN_TPU_MATRIX_SEGMENT_EVENTS",
+                                 1 << 20)
 
 
 def matrix_ok(S: int, num_states: int | None, n_returns: int) -> bool:
@@ -1062,6 +1068,151 @@ def matrix_check_resume(stream, tot0=None, step_ids=None,
         out = _matrix_dispatch([prep], S, R_max, V, step_ids, init_state,
                                mesh, resume=True, tot0=tot0)
     return out[0], out[1], out[2]
+
+
+def matrix_segmented_config(S, V, init_state, num_states, max_segment,
+                            variant, combine_fused, step_ids=None) -> dict:
+    """The knob/shape fingerprint a segmented-matrix checkpoint is
+    valid under — ONE constructor shared by the writer
+    (matrix_check_segmented) and out-of-band checkpoint authors
+    (bench.py's resume_savings stage, tests), so a fingerprint drift
+    between them is impossible by construction. ``step_ids`` stamps
+    the model identity: the prefix hash covers only the encoded
+    columns, which are model-independent, so a model swap between
+    interrupt and resume must discard on the config instead."""
+    from jepsen_tpu.checker.checkpoint import step_identity
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    return {"path": "matrix", "S": S, "V": V, "init_state": init_state,
+            "num_states": num_states, "max_segment": max_segment,
+            "variant": variant, "combine_fused": combine_fused,
+            "step": step_identity(step_ids)}
+
+
+def matrix_check_segmented(stream, step_ids=None, init_state: int = 0,
+                           num_states: int | None = None,
+                           n_slots: int | None = None, mesh=None,
+                           variant: str | None = None,
+                           combine_fused: bool | None = None,
+                           max_segment: int | None = None,
+                           ckpt=None, carry: dict | None = None,
+                           carry_sink=None):
+    """One long small-domain history through a crash-resumable chain of
+    :func:`matrix_check_resume` segments cut at quiescent points.
+    Returns the :func:`matrix_check` quad ``(alive, -1, inexact, 0)``.
+
+    Resumable two ways (doc/robustness.md "Resumable checks and the
+    elastic mesh"):
+
+    * ``ckpt`` — a :class:`~jepsen_tpu.checker.checkpoint.CheckpointStore`:
+      the composed ``tot0`` product persists after each segment when
+      the write interval elapses; a valid ``matrix`` checkpoint (same
+      S/V/knobs, matching consumed-prefix hash) resumes the chain at
+      its cut. Bit-identical: boolean operator products are exact
+      under any association, so a resumed chain composes the same
+      total as an uninterrupted one.
+    * ``carry``/``carry_sink`` — the in-process twin for the checker
+      ladder: after each exact segment ``carry_sink`` receives
+      ``{"rep": "matrix", "tot0", "events_done", "S", "V",
+      "init_state"}``, and a matching ``carry`` passed back in resumes
+      mid-chain — how a watchdog-demoted or mesh-shrunk rung keeps its
+      completed segments instead of restarting.
+
+    Soundness: an INEXACT segment (oob transition) aborts the chain
+    immediately WITHOUT sinking or persisting its carry — an
+    under-approximate product must never seed an exact resume. Dead
+    carries are likewise never persisted (the verdict settles now).
+    With a ``mesh`` each segment's chunk axis shards over the devices;
+    the carry is the same replicated product either way, so a chain
+    may shrink or demote its mesh between segments freely."""
+    if step_ids is None:
+        step_ids = _default_step_ids()
+    if num_states is None:
+        num_states = len(stream.intern)
+    V = _bucket(num_states, floor=8)
+    kind = np.asarray(stream.kind)
+    slot = np.asarray(stream.slot)
+    S = max(n_slots or 1, int(slot.max(initial=0)) + 1)
+    if max_segment is None:
+        max_segment = MATRIX_SEGMENT_EVENTS
+    cuts = quiescent_cuts(kind, max_segment)
+    cut_set = set(cuts)
+    n = len(kind)
+    base, seg_i = 0, 0
+    tot = None
+    inexact_any = False
+    config = ckpt_mod = None
+    if ckpt is not None:
+        from jepsen_tpu.checker import checkpoint as ckpt_mod
+        config = matrix_segmented_config(S, V, init_state, num_states,
+                                         max_segment, variant,
+                                         combine_fused,
+                                         step_ids=step_ids)
+    # in-process carry first (it is at least as fresh as the durable
+    # checkpoint: the sink runs every segment, the store on an interval)
+    if carry is not None:
+        if (carry.get("rep") == "matrix" and carry.get("S") == S
+                and carry.get("V") == V
+                and carry.get("init_state") == init_state
+                and carry.get("events_done") in cut_set):
+            tot = carry["tot0"]
+            base = int(carry["events_done"])
+            seg_i = cuts.index(base) + 1
+            from jepsen_tpu.checker.checkpoint import count_resume
+            count_resume("carry")
+            logger.info("segmented matrix check resuming from in-process "
+                        "carry at event %d/%d", base, n)
+        else:
+            logger.warning("matrix carry (S=%r V=%r events=%r) doesn't "
+                           "fit this stream (S=%d V=%d); restarting",
+                           carry.get("S"), carry.get("V"),
+                           carry.get("events_done"), S, V)
+    if tot is None and ckpt is not None:
+        state = ckpt_mod.load_resume(ckpt, "matrix", config, stream)
+        if state is not None and state["events_done"] in cut_set:
+            tot = ckpt_mod.decode_array(state["carry"]["tot0"])
+            base = int(state["events_done"])
+            seg_i = cuts.index(base) + 1
+            ckpt_mod.count_resume("ckpt")
+            logger.info("resuming segmented matrix check from %s at "
+                        "event %d/%d", ckpt.path, base, n)
+        elif state is not None:
+            logger.warning("matrix checkpoint's cut %d is not a "
+                           "quiescent cut of this stream; restarting",
+                           state["events_done"])
+    for end in cuts:
+        if end <= base:
+            continue
+        seg = _slice_stream(stream, base, end)
+        alive, ix, tot = matrix_check_resume(
+            seg, tot, step_ids=step_ids, init_state=init_state,
+            num_states=num_states, n_slots=S, mesh=mesh, variant=variant,
+            combine_fused=combine_fused)
+        alive_b = bool(np.asarray(alive).all())
+        ix_b = bool(np.asarray(ix).any())
+        if ix_b:
+            # an oob escape proves nothing — and its under-approximate
+            # carry must never seed an exact resume: abort unsunk
+            return alive_b, -1, True, 0
+        if not alive_b:
+            return False, -1, inexact_any, 0
+        base = end
+        seg_i += 1
+        if carry_sink is not None:
+            carry_sink({"rep": "matrix", "tot0": tot, "events_done": base,
+                        "S": S, "V": V, "init_state": init_state})
+        if ckpt is not None and base < n:
+            def make_state(tot=tot, base=base, seg_i=seg_i):
+                return {
+                    "kind": "matrix", "config": config,
+                    "events_done": base, "segment": seg_i,
+                    "prefix_hash": ckpt_mod.stream_prefix_hash(stream,
+                                                               base),
+                    "carry": {"tot0": ckpt_mod.encode_array(
+                        np.asarray(tot))},
+                }
+            ckpt.maybe_save(make_state, base)
+    return True, -1, inexact_any, 0
 
 
 def matrix_check_batch(streams, step_ids=None, init_state: int = 0,
@@ -1716,7 +1867,8 @@ def quiescent_cuts(kind, max_segment: int) -> list[int]:
 
 
 def segmented_check(stream, max_segment: int = 1 << 21, kernel=None,
-                    capacity: int = 256, num_states: int | None = None):
+                    capacity: int = 256, num_states: int | None = None,
+                    ckpt=None):
     """Checks one long history as a chain of bounded segments, carrying
     the frontier on device between them — arbitrarily long histories in
     bounded device memory (and bounded single-dispatch size, which the
@@ -1729,7 +1881,15 @@ def segmented_check(stream, max_segment: int = 1 << 21, kernel=None,
     valid history. When a window has no quiescent point, the segment
     extends to the next one (or the end) — soundness beats the
     segment-size preference. Returns (alive, died_event, overflow, peak).
-    """
+
+    ``ckpt`` (a :class:`jepsen_tpu.checker.checkpoint.CheckpointStore`)
+    makes the chain crash-resumable: the frontier carry persists after
+    each segment when the write interval elapses, and a valid
+    ``frontier`` checkpoint (same cuts, same kernel config, matching
+    consumed-prefix hash) resumes the chain at its cut instead of
+    restarting — bit-identical, the carry IS the frontier the
+    uninterrupted chain holds there (doc/robustness.md "Resumable
+    checks and the elastic mesh")."""
     if kernel is None:
         kernel = JitLinKernel()
     if num_states is None and getattr(stream, "intern", None) is not None:
@@ -1742,8 +1902,33 @@ def segmented_check(stream, max_segment: int = 1 << 21, kernel=None,
     carry = run.init_carry()
     alive, died, ovf, peak = True, -1, False, 0
     base = 0
+    config = ckpt_state = None
+    if ckpt is not None:
+        from jepsen_tpu.checker import checkpoint as ckpt_mod
+        config = {"path": "segmented", "S": S, "capacity": capacity,
+                  "num_states": num_states, "max_segment": max_segment,
+                  "dense": bool(_dense_ok(S, num_states)),
+                  "step": ckpt_mod.step_identity(kernel.step_ids)}
+        ckpt_state = ckpt_mod.load_resume(ckpt, "frontier", config, stream)
+        if ckpt_state is not None and ckpt_state["events_done"] in set(cuts):
+            base = ckpt_state["events_done"]
+            carry = tuple(ckpt_mod.decode_array(a).astype(d.dtype)
+                          for a, d in zip(ckpt_state["carry"]["arrays"],
+                                          (np.asarray(c) for c in carry)))
+            ovf = bool(ckpt_state["carry"].get("overflow", False))
+            peak = int(ckpt_state["carry"].get("peak", 0))
+            ckpt_mod.count_resume("ckpt")
+            logger.info("resuming segmented check from %s at event %d/%d",
+                        ckpt.path, base, len(kind))
+        elif ckpt_state is not None:
+            logger.warning("segmented checkpoint's cut %d is not a "
+                           "quiescent cut of this stream; restarting",
+                           ckpt_state["events_done"])
+            ckpt_state = None
     from jepsen_tpu.checker.linear_encode import pad_streams
     for end in cuts:
+        if end <= base:
+            continue  # already covered by the resumed carry
         seg = _slice_stream(stream, base, end)
         batch = pad_streams([seg], length=_bucket(len(seg)))
         out = run(batch["kind"][0], batch["slot"][0], batch["f"][0],
@@ -1757,6 +1942,22 @@ def segmented_check(stream, max_segment: int = 1 << 21, kernel=None,
         if not a:
             return False, base + d if d >= 0 else -1, ovf, peak
         base = end
+        if ckpt is not None and base < len(kind):
+            from jepsen_tpu.checker import checkpoint as ckpt_mod
+
+            def make_state(carry=carry, base=base, ovf=ovf, peak=peak):
+                return {
+                    "kind": "frontier", "config": config,
+                    "events_done": base, "segment": cuts.index(base),
+                    "prefix_hash": ckpt_mod.stream_prefix_hash(stream,
+                                                               base),
+                    "carry": {
+                        "arrays": [ckpt_mod.encode_array(np.asarray(c))
+                                   for c in carry],
+                        "overflow": ovf, "peak": peak,
+                    },
+                }
+            ckpt.maybe_save(make_state, base)
     return True, -1, ovf, peak
 
 

@@ -117,18 +117,153 @@ def mesh_devices_limit() -> int | None:
                           knob="JEPSEN_TPU_MESH_DEVICES")
 
 
+# ---------------------------------------------------------------------------
+# Device health + the elastic mesh shrink path
+# (doc/robustness.md "Resumable checks and the elastic mesh")
+# ---------------------------------------------------------------------------
+
+_HEALTH_LOCK = threading.Lock()
+_FAILED_DEVICES: set[int] = set()
+
+# mesh widths below this bottom out the shrink ladder (the checker then
+# demotes to the single-device rungs); a 1-wide "mesh" is no mesh at all
+DEFAULT_MESH_MIN_DEVICES = 2
+
+
+def mark_device_failed(device_id: int) -> None:
+    """Records a device as unhealthy: ``auto_mesh`` (and therefore
+    every future sharded dispatch) builds over the survivors until
+    :func:`reset_device_health`."""
+    with _HEALTH_LOCK:
+        if device_id in _FAILED_DEVICES:
+            return
+        _FAILED_DEVICES.add(device_id)
+    logger.warning("device %d marked unhealthy; future meshes exclude it",
+                   device_id)
+
+
+def failed_device_ids() -> frozenset:
+    with _HEALTH_LOCK:
+        return frozenset(_FAILED_DEVICES)
+
+
+def reset_device_health() -> None:
+    """Clears the failed-device set — for tests, and for operators who
+    fixed the accelerator (mirrors BackendLadder.reset)."""
+    with _HEALTH_LOCK:
+        _FAILED_DEVICES.clear()
+
+
+def mesh_min_devices(value=None) -> int:
+    """The shrink ladder's floor: the smallest mesh width worth keeping
+    sharded (below it the checker demotes to single-device). Test-map
+    knob ``mesh_min_devices`` (``value``), env twin
+    ``JEPSEN_TPU_MESH_MIN_DEVICES``, default
+    :data:`DEFAULT_MESH_MIN_DEVICES`; never below 2."""
+    import os
+    n = coerce_devices(value, knob="mesh_min_devices")
+    if n is None:
+        n = coerce_devices(os.environ.get("JEPSEN_TPU_MESH_MIN_DEVICES"),
+                           knob="JEPSEN_TPU_MESH_MIN_DEVICES")
+    if n is None:
+        n = DEFAULT_MESH_MIN_DEVICES
+    return max(2, n)
+
+
+def _failed_ids_from_exc(exc, known_ids) -> list[int]:
+    """Best-effort device attribution for a dispatch failure: device
+    ids named in the exception text (``device 3``, ``TPU_5``, ...)
+    that exist on this backend. Empty when the error names nothing —
+    the shrink path then halves conservatively instead of guessing."""
+    if exc is None:
+        return []
+    import re
+    s = f"{type(exc).__name__}: {exc}"
+    ids = set()
+    for m in re.finditer(r"(?:device|TPU|tpu)[ _:#]*(\d+)", s):
+        ids.add(int(m.group(1)))
+    return sorted(i for i in ids if i in known_ids)
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def shrink_mesh(mesh, exc=None, min_devices: int | None = None,
+                axis: str = "keys"):
+    """The surviving mesh after a sharded-dispatch failure, or None
+    when shrink bottoms out (fewer healthy devices than the
+    ``mesh_min_devices`` floor — the caller demotes to single-device).
+
+    Attribution: device ids named in ``exc`` are marked unhealthy; an
+    unattributable failure (most collective errors name nothing)
+    conservatively halves the width instead — either way the rebuilt
+    mesh is strictly narrower than ``mesh``, so repeated shrinks
+    terminate. Widths stay powers of two (the compile caches and the
+    cost model's per-width EWMA rates both key on width, so a sparse
+    width set keeps them warm). Counts ``mesh_shrink_total{from,to}``."""
+    import jax
+    cur = list(mesh.devices.flat)
+    n_from = len(cur)
+    try:
+        all_devs = jax.devices()
+    except Exception:  # noqa: BLE001 — backend gone entirely
+        return None
+    named = _failed_ids_from_exc(exc, {d.id for d in all_devs})
+    for i in named:
+        mark_device_failed(i)
+    failed = failed_device_ids()
+    healthy = [d for d in all_devs if d.id not in failed]
+    if named and any(d.id in named for d in cur):
+        # the error named the casualty: keep every survivor it allows
+        target = _pow2_floor(min(len(healthy), n_from))
+    else:
+        # unattributable: drop half the lanes rather than guess wrong
+        target = _pow2_floor(max(1, n_from // 2))
+    if target >= n_from:
+        target = _pow2_floor(max(1, n_from // 2))
+    floor = mesh_min_devices(min_devices)
+    if target < floor or len(healthy) < target:
+        logger.warning("mesh shrink bottomed out (%d healthy, floor %d); "
+                       "demoting to single-device", len(healthy), floor)
+        return None
+    new = auto_mesh(target, axis=axis)
+    if new is None or int(new.devices.size) >= n_from:
+        return None
+    from jepsen_tpu import telemetry
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("mesh_shrink_total",
+                    "elastic mesh shrinks after sharded-dispatch "
+                    "failures, by width transition",
+                    labels=("from", "to")).inc(
+            **{"from": str(n_from), "to": str(int(new.devices.size))})
+    logger.warning("mesh shrunk %d -> %d devices after dispatch failure "
+                   "(%s)", n_from, int(new.devices.size),
+                   f"{type(exc).__name__}" if exc is not None else
+                   "unattributed")
+    return new
+
+
 def auto_mesh(n_devices: int | None = None, axis: str = "keys"):
     """The cached 1-D mesh a sharded checker dispatch should run over,
     or None when fewer than 2 devices would participate. ``n_devices``
     caps the width (a test-map ``mesh_devices`` knob); the
-    ``JEPSEN_TPU_MESH_DEVICES`` env var caps it globally. Returning the
-    SAME Mesh object per width keeps jitlin's mesh-keyed compile caches
-    warm across dispatches."""
+    ``JEPSEN_TPU_MESH_DEVICES`` env var caps it globally; devices
+    marked unhealthy (:func:`mark_device_failed` — the elastic shrink
+    path) are excluded. Returning the SAME Mesh object per width keeps
+    jitlin's mesh-keyed compile caches warm across dispatches."""
     import jax
     try:
         devs = jax.devices()
     except Exception:  # noqa: BLE001 — no backend: no mesh
         return None
+    failed = failed_device_ids()
+    if failed:
+        devs = [d for d in devs if d.id not in failed]
     n = len(devs)
     if n_devices is not None:
         n = min(n, int(n_devices))

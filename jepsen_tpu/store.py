@@ -35,9 +35,13 @@ TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl",
 PROFILE_DIR = "profile"
 
 # Robustness forensics (doc/robustness.md): completions quarantined
-# from reaped zombie workers, and the stall watchdog's thread-stack
-# dumps. Present only when the run actually produced them.
-FORENSIC_FILES = ("late.jsonl", "stall-threads.txt")
+# from reaped zombie workers, the stall watchdog's thread-stack dumps,
+# and an interrupted check's durable checkpoint / the live daemon's
+# restart snapshot (both cleared on completion — their PRESENCE marks
+# an interrupted check/daemon). Present only when the run actually
+# produced them.
+FORENSIC_FILES = ("late.jsonl", "stall-threads.txt", "check.ckpt",
+                  "live-session.ckpt")
 
 # Anomaly forensics (doc/observability.md "Anomaly forensics"): the
 # first-anomaly + minimal-witness artifact and its rendered timeline,

@@ -265,9 +265,12 @@ def _explain_section(rel: str, target: Path) -> str:
 
 def _forensics_section(rel: str, target: Path) -> str:
     """Links a run's robustness forensics — late.jsonl (completions
-    quarantined from reaped zombie workers) and stall-threads.txt (the
-    stall watchdog's stack dumps) — from the run page. Empty string when
-    the run has none (the common, healthy case)."""
+    quarantined from reaped zombie workers), stall-threads.txt (the
+    stall watchdog's stack dumps), and check.ckpt / live-session.ckpt
+    (an interrupted check's durable carry / the live daemon's restart
+    snapshot — their presence marks an interrupted check) — from the
+    run page. Empty string when the run has none (the common, healthy
+    case)."""
     arts = store.forensic_artifacts(target)
     if not arts:
         return ""
@@ -276,8 +279,8 @@ def _forensics_section(rel: str, target: Path) -> str:
         f"<a href='/{base}/{html.escape(name)}'>{html.escape(name)}</a>"
         for name in sorted(arts))
     return ("<h2>robustness forensics</h2><p>" + links +
-            " — quarantined late completions / stall stack dumps "
-            "(doc/robustness.md)</p>")
+            " — quarantined late completions / stall stack dumps / "
+            "interrupted-check checkpoints (doc/robustness.md)</p>")
 
 
 def _elle_section(rel: str, target: Path) -> str:

@@ -1,0 +1,84 @@
+"""Chaos-test worker: a deliberately slowed, every-segment-checkpointed
+segmented matrix check for the parent test to SIGKILL mid-check
+(tests/test_resume.py).
+
+Runs the PRODUCTION dispatch — ``LinearizableChecker.check`` with a
+run-dir-backed test map — over a deterministic valid register history,
+with ``matrix_check_resume`` wrapped in a per-segment sleep so the
+parent can aim a SIGKILL between two durable ``check.ckpt`` persists.
+The parent resumes the same check in-process afterwards and asserts a
+bit-identical verdict that re-ran only the segments after the last
+checkpoint.
+
+Usage:
+
+    JEPSEN_TPU_MATRIX_SEGMENT_EVENTS=2048 \
+        python resume_worker.py <store-dir> <name> <timestamp> [sleep_s]
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PROCS, N_VALUES = 3, 5
+
+
+def block_history(n_blocks: int, seed: int = 11,
+                  plant_anomaly_at: int | None = None) -> list[dict]:
+    """Deterministic valid register history of write-then-read blocks
+    (quiescent between every pair, so every segment boundary is a
+    quiescent cut). Shared by the worker and the parent test — both
+    sides MUST check the identical history for the bit-identity
+    assertions to mean anything. ``plant_anomaly_at`` makes block b's
+    read observe a never-written value (non-linearizable)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    ops: list[dict] = []
+    for b in range(n_blocks):
+        p = int(rng.integers(N_PROCS))
+        v = int(rng.integers(N_VALUES))
+        ops.append({"process": p, "type": "invoke", "f": "write",
+                    "value": v})
+        ops.append({"process": p, "type": "ok", "f": "write", "value": v})
+        p2 = int(rng.integers(N_PROCS))
+        rv = (v + 1) % N_VALUES if b == plant_anomaly_at else v
+        ops.append({"process": p2, "type": "invoke", "f": "read",
+                    "value": None})
+        ops.append({"process": p2, "type": "ok", "f": "read", "value": rv})
+    return ops
+
+
+def main() -> int:
+    store_dir, name, ts = sys.argv[1:4]
+    sleep_s = float(sys.argv[4]) if len(sys.argv) > 4 else 0.25
+
+    from jepsen_tpu.ops import jitlin
+
+    real = jitlin.matrix_check_resume
+
+    def slow_resume(*args, **kw):
+        out = real(*args, **kw)
+        time.sleep(sleep_s)
+        return out
+
+    jitlin.matrix_check_resume = slow_resume
+
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+
+    test = {"name": name, "start_time": ts, "store_dir": store_dir,
+            # write a durable checkpoint at every opportunity: the
+            # parent kills between two persists
+            "check_ckpt_interval": 0.001,
+            "checker_sharded": False}
+    history = block_history(4096)
+    out = LinearizableChecker(accelerator="tpu").check(test, history, {})
+    print(json.dumps({"valid": out["valid?"],
+                      "algorithm": out["algorithm"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

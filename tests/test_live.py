@@ -454,7 +454,12 @@ def test_daemon_admission_defers_not_starves(tmp_path):
     for k in range(2):
         h, _ = _register_history(300, seed=20 + k)
         run_dir = tmp_path / f"r{k}" / "20260803T000000.000"
-        runs.append((run_dir, _write_run(run_dir, h, delay_s=0.001)))
+        # chunk/delay sized so each writer spans MANY daemon polls: a
+        # writer that finishes before the first poll finalizes both
+        # runs immediately and no poll ever has two pending runs to
+        # arbitrate (the flake this pins down)
+        runs.append((run_dir, _write_run(run_dir, h, journal_chunks=5,
+                                         delay_s=0.005)))
     daemon = LiveDaemon(
         store_root=str(tmp_path), poll_s=0.01, accelerator="cpu",
         check_budget_s=0.001,
