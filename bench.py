@@ -1373,6 +1373,44 @@ def cfg_ckpt():
          path="matrix-segmented")
 
 
+def cfg_lint():
+    """lint_wall_s: full-tree static-analysis wall clock — the cost of
+    the tier-1 self-lint gate (tests/test_lint_clean.py) with every
+    rule enabled, including the interprocedural thread-edge call graph,
+    lock-order deadlock detection, and durability-protocol passes. The
+    bar: < 60 s cold (fresh AST cache), < 30 s warm (the steady-state
+    cost every tier-1 run actually pays). A regression here silently
+    eats the tier-1 budget, so it gets a metric line like any kernel.
+    ``vs_baseline`` is bar/actual for the warm number (>1 = under
+    bar)."""
+    from pathlib import Path
+
+    from jepsen_tpu.analysis import lint as lint_mod
+    from jepsen_tpu.analysis.lint import astcache
+
+    root = Path(__file__).resolve().parent
+    pkg = root / "jepsen_tpu"
+
+    def run():
+        rep = lint_mod.lint_paths([str(pkg)],
+                                  baseline=str(root / "lint-baseline.txt"),
+                                  root=str(root))
+        assert rep.findings == [], [f.render() for f in rep.findings]
+        return rep
+
+    astcache._CACHE.clear()
+    t0 = time.perf_counter()
+    rep = run()
+    cold_s = time.perf_counter() - t0
+    _, times = _trials(run, 3)
+    warm_s = _median(times)
+    assert cold_s < 60.0, f"cold full-tree lint took {cold_s:.1f}s"
+    assert warm_s < 30.0, f"warm full-tree lint took {warm_s:.1f}s"
+    emit("lint_wall_s", warm_s, "s", 30.0 / max(warm_s, 1e-9),
+         cold_s=round(cold_s, 2), files=rep.files,
+         rules=len(lint_mod.RULE_NAMES), trials=len(times))
+
+
 def cfg_headline() -> float:
     """The headline, printed last: a 10k-op single-register history on
     device vs the reference's 1 h CPU knossos timeout.
@@ -1463,6 +1501,7 @@ def main() -> None:
     guard("explain", cfg_explain)
     guard("multichip", cfg_multichip_scaling)
     guard("ckpt", cfg_ckpt)
+    guard("lint", cfg_lint)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
 

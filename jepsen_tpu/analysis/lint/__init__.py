@@ -22,7 +22,8 @@ from jepsen_tpu.analysis.diagnostics import (
     Finding, render_json, sort_findings,
 )
 from jepsen_tpu.analysis.lint import (
-    astcache, callgraph, rules_concurrency, rules_jax,
+    astcache, callgraph, rules_concurrency, rules_durability, rules_jax,
+    rules_telemetry,
 )
 
 logger = logging.getLogger("jepsen.analysis.lint")
@@ -33,6 +34,7 @@ BASELINE_NAME = "lint-baseline.txt"
 RULES = (
     ("lock-guard", rules_concurrency.lock_guard, None),
     ("fsync-pairing", rules_concurrency.fsync_pairing, None),
+    ("durability-protocol", rules_durability.durability_protocol, None),
     ("no-host-effects-in-jit", rules_jax.no_host_effects_in_jit, None),
     ("donation-reuse", rules_jax.donation_reuse, None),
     ("recompile-hazard", rules_jax.recompile_hazard, None),
@@ -40,6 +42,9 @@ RULES = (
     ("threshold-dtype", rules_jax.threshold_dtype, None),
     ("thread-owner", None, rules_concurrency.thread_owner),
     ("no-unbounded-block", None, rules_concurrency.no_unbounded_block),
+    ("lock-order", None, rules_concurrency.lock_order),
+    ("cond-wait", None, rules_concurrency.cond_wait),
+    ("telemetry-name", None, rules_telemetry.telemetry_name),
 )
 
 RULE_NAMES = tuple(r[0] for r in RULES)
@@ -140,7 +145,7 @@ def lint_paths(paths, baseline=None, root=None, rules=None) -> Report:
     global_rules = [g for name, _p, g in RULES
                     if g is not None and name in selected]
     if global_rules:
-        graph = callgraph.build(modules)
+        graph = callgraph.build(modules, root=root)
         for g in global_rules:
             try:
                 findings.extend(g(graph))

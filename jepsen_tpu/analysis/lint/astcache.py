@@ -14,7 +14,20 @@ Annotations are structured comments the rules consume:
   thread-ownership for the ``thread-owner`` / ``no-unbounded-block``
   rules.
 * ``# durability: fsync`` on a ``class`` — every writing method must
-  pair flush+fsync (``fsync-pairing``).
+  pair flush+fsync (``fsync-pairing``). ``# durability:
+  record-before-act`` (on a ``class`` or ``def``) — durable record
+  calls must precede the action they protect (``durability-protocol``).
+  Comma-separated lists compose: ``# durability: fsync,
+  record-before-act``.
+* ``# blocking: rpc|io|...`` on a ``def`` — the function can block on
+  a remote peer / slow I/O; the ``lock-order`` rule flags calls into it
+  made while holding a lock.
+* ``# thread-helper: spawn(arg=N)`` / ``# thread-helper:
+  sync-spawn(arg=N)`` on a ``def`` — the function runs its Nth
+  positional argument on (an)other thread(s); ``sync-spawn`` means the
+  caller waits for them (``utils.real_pmap``), ``spawn`` means it does
+  not have to (``utils.timeout``). The call graph turns call sites of
+  such helpers into thread-spawn edges.
 * ``# lint: ignore[rule-a,rule-b]`` trailing a line — waives those
   rules' findings on that line (on a ``def``/``class`` line: for the
   whole definition).
@@ -26,15 +39,25 @@ import ast
 import io
 import re
 import tokenize
+import zlib
 from dataclasses import dataclass, field
 from pathlib import Path
 
 _OWNER_RE = re.compile(r"#\s*owner:\s*(scheduler|worker|any)\b")
-_DURABILITY_RE = re.compile(r"#\s*durability:\s*(\w+)")
+_DURABILITY_RE = re.compile(r"#\s*durability:\s*([\w-]+(?:\s*,\s*[\w-]+)*)")
+_BLOCKING_RE = re.compile(r"#\s*blocking:\s*([\w-]+)")
+_THREAD_HELPER_RE = re.compile(
+    r"#\s*thread-helper:\s*(spawn|sync-spawn)\s*\(\s*arg\s*=\s*(\d+)\s*\)")
 _IGNORE_RE = re.compile(r"#\s*lint:\s*ignore\[([^\]]+)\]")
 _SKIP_FILE_RE = re.compile(r"#\s*lint:\s*skip-file\b")
 
 OWNERS = ("scheduler", "worker", "any")
+
+
+def _split_durabilities(raw: str | None) -> frozenset:
+    if not raw:
+        return frozenset()
+    return frozenset(p.strip() for p in raw.split(",") if p.strip())
 
 
 @dataclass
@@ -46,6 +69,9 @@ class FuncInfo:
     ignores: frozenset             # rules waived for the whole definition
     lineno: int
     end_lineno: int
+    durabilities: frozenset = frozenset()  # "# durability:" on the def
+    blocking: str | None = None    # from "# blocking:" annotation
+    thread_helper: tuple | None = None  # ("spawn"|"sync-spawn", arg index)
 
 
 @dataclass
@@ -56,6 +82,7 @@ class ClassInfo:
     durability: str | None
     ignores: frozenset
     bases: tuple                   # base-class name strings
+    durabilities: frozenset = frozenset()
 
 
 @dataclass
@@ -76,7 +103,7 @@ class ModuleInfo:
         """Rules waived by a trailing ``# lint: ignore[...]`` comment."""
         return _parse_ignores(self.comments.get(lineno, ""))
 
-    def def_annotation(self, node, regex):
+    def def_annotation_match(self, node, regex):
         """First regex match in the comment trailing the def/class line,
         any decorator line, or the line directly above."""
         candidates = [node.lineno]
@@ -87,8 +114,12 @@ class ModuleInfo:
         for ln in candidates:
             m = regex.search(self.comments.get(ln, ""))
             if m:
-                return m.group(1)
+                return m
         return None
+
+    def def_annotation(self, node, regex):
+        m = self.def_annotation_match(node, regex)
+        return m.group(1) if m else None
 
     def def_ignores(self, node) -> frozenset:
         out: set = set()
@@ -123,19 +154,29 @@ def _index(mod: ModuleInfo) -> None:
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 q = f"{scope}.{child.name}" if scope else child.name
                 owner = mod.def_annotation(child, _OWNER_RE)
+                helper = None
+                hm = mod.def_annotation_match(child, _THREAD_HELPER_RE)
+                if hm is not None:
+                    helper = (hm.group(1), int(hm.group(2)))
                 mod.functions[q] = FuncInfo(
                     qualname=q, node=child, class_name=class_name,
                     owner=owner, ignores=mod.def_ignores(child),
                     lineno=child.lineno,
-                    end_lineno=getattr(child, "end_lineno", child.lineno))
+                    end_lineno=getattr(child, "end_lineno", child.lineno),
+                    durabilities=_split_durabilities(
+                        mod.def_annotation(child, _DURABILITY_RE)),
+                    blocking=mod.def_annotation(child, _BLOCKING_RE),
+                    thread_helper=helper)
                 visit(child, q, class_name)
             elif isinstance(child, ast.ClassDef):
                 q = f"{scope}.{child.name}" if scope else child.name
                 bases = tuple(_base_name(b) for b in child.bases)
+                durability = mod.def_annotation(child, _DURABILITY_RE)
                 mod.classes[q] = ClassInfo(
                     name=child.name, qualname=q, node=child,
-                    durability=mod.def_annotation(child, _DURABILITY_RE),
-                    ignores=mod.def_ignores(child), bases=bases)
+                    durability=durability,
+                    ignores=mod.def_ignores(child), bases=bases,
+                    durabilities=_split_durabilities(durability))
                 visit(child, q, child.name)
             elif isinstance(child, ast.Import):
                 for alias in child.names:
@@ -165,21 +206,28 @@ _CACHE: dict[str, tuple[tuple, ModuleInfo]] = {}
 
 def parse_module(path, root=None) -> ModuleInfo | None:
     """Cached parse; None when the file doesn't parse (a syntax error is
-    a job for the test suite, not the linter)."""
+    a job for the test suite, not the linter).
+
+    The cache key is ``(mtime_ns, size, crc32(content))``: an editor or
+    test harness that rewrites a file with same-size content inside one
+    filesystem timestamp tick (coarse mtime granularity) must still
+    invalidate — the crc costs one cheap read per call, while the
+    expensive parse + tokenize + index is what the cache skips."""
     p = Path(path)
     try:
         st = p.stat()
-        stamp = (st.st_mtime_ns, st.st_size)
+        raw = p.read_bytes()
     except OSError:
         return None
+    stamp = (st.st_mtime_ns, st.st_size, zlib.crc32(raw))
     key = str(p.resolve())
     hit = _CACHE.get(key)
     if hit is not None and hit[0] == stamp:
         return hit[1]
     try:
-        source = p.read_text(encoding="utf-8")
+        source = raw.decode("utf-8")
         tree = ast.parse(source)
-    except (OSError, SyntaxError, ValueError):
+    except (SyntaxError, ValueError):
         return None
     rel = str(p)
     if root is not None:

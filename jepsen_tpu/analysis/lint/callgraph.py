@@ -1,4 +1,4 @@
-"""Lightweight intra-package call graph for reachability rules.
+"""Intra-package call graph with thread-spawn edges.
 
 Name-based static resolution — deliberately conservative and cheap:
 
@@ -9,38 +9,85 @@ Name-based static resolution — deliberately conservative and cheap:
 * ``mod.f(...)`` resolves through ``import``/``from pkg import mod``
   aliases to the target module's top-level ``f``.
 
-Anything else (calls on locals, protocol dispatch, higher-order
-``target=fn`` references) is *unresolved* and simply absent from the
-graph. That is the right default for the thread-owner and
-no-unbounded-block rules: an edge we cannot prove is an edge we do not
-traverse, so reachability sets stay small and findings stay precise.
-A function *reference* (``Thread(target=run)``) is intentionally not an
-edge — spawning a thread is exactly where ownership changes hands.
+Anything else (calls on locals, protocol dispatch) is *unresolved* and
+simply absent from the graph: an edge we cannot prove is an edge we do
+not traverse, so reachability sets stay small and findings stay precise.
+
+Thread-spawn edges (closing PR-5's documented "thread targets are not
+edges" limit): a function *reference* handed to a thread-creation idiom
+becomes an edge tagged with how the target will run —
+
+* ``kind="spawn"`` — the target runs on another thread and the caller
+  does not (have to) wait for it: ``threading.Thread(target=f)``,
+  ``threading.Timer(t, f)``, ``executor.submit(f, ...)``, and helpers
+  annotated ``# thread-helper: spawn(arg=N)`` (``utils.timeout``).
+* ``kind="sync-spawn"`` — the target runs on other thread(s) but the
+  caller blocks until they finish, so a wedge in the target IS a wedge
+  in the caller: helpers annotated ``# thread-helper: sync-spawn(arg=N)``
+  (``utils.real_pmap``, ``utils.bounded_pmap``).
+
+Both kinds carry an **owner transition**: a spawn target without an
+explicit ``# owner:`` annotation is implicitly worker-owned — it runs
+on a fresh thread, never the scheduler's (``effective_owner``). Rules
+choose which kinds to traverse: ``thread-owner`` follows everything
+(any spawned thread is still not the scheduler), ``no-unbounded-block``
+follows plain calls and ``sync-spawn`` (a detached thread's block can't
+wedge the spawner), and the lock-order analysis follows calls and
+``sync-spawn`` but never ``spawn`` (a new thread does not inherit the
+spawner's held locks).
 """
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from jepsen_tpu.analysis.lint.astcache import FuncInfo, ModuleInfo
 
 Node = tuple  # (relpath, qualname)
 
+CALL = "call"
+SPAWN = "spawn"
+SYNC_SPAWN = "sync-spawn"
+
+# Thread-class constructors: class name -> how the target argument is
+# passed ((keyword name, positional index) — Timer's is its second
+# positional, Thread's is keyword-only in practice).
+_THREAD_CTORS = {
+    "Thread": ("target", None),
+    "Timer": ("function", 1),
+}
+
 
 @dataclass
 class CallGraph:
-    edges: dict            # Node -> list[(Node, lineno)]
+    edges: dict            # Node -> list[(Node, lineno, kind)]
     functions: dict        # Node -> FuncInfo
     modules: dict          # relpath -> ModuleInfo
+    spawn_targets: dict = field(default_factory=dict)  # Node -> kind
+    root: object = None    # lint root (Path) — doc cross-checks live here
 
     def owner(self, node: Node) -> str | None:
         fi = self.functions.get(node)
         return fi.owner if fi is not None else None
 
-    def reachable(self, roots, through=None):
+    def effective_owner(self, node: Node) -> str | None:
+        """The explicit ``# owner:`` annotation, else the spawn-implied
+        owner: a thread-spawn target runs on a fresh thread, so absent
+        an annotation it is worker-owned — the owner transition that
+        lets reachability rules see through thread creation."""
+        owner = self.owner(node)
+        if owner is not None:
+            return owner
+        if node in self.spawn_targets:
+            return "worker"
+        return None
+
+    def reachable(self, roots, through=None, kinds=None):
         """BFS closure from ``roots``; ``through(node) -> bool`` gates
-        which nodes are expanded (the node itself is still visited).
-        Returns {node: (parent, lineno)} for path reconstruction."""
+        which nodes are expanded (the node itself is still visited);
+        ``kinds`` restricts which edge kinds are traversed (default:
+        all). Returns {node: (parent, lineno)} for path
+        reconstruction."""
         seen: dict = {}
         frontier = [(r, None, 0) for r in roots]
         while frontier:
@@ -50,7 +97,9 @@ class CallGraph:
             seen[node] = (parent, lineno)
             if through is not None and not through(node) and parent is not None:
                 continue
-            for callee, ln in self.edges.get(node, ()):
+            for callee, ln, kind in self.edges.get(node, ()):
+                if kinds is not None and kind not in kinds:
+                    continue
                 if callee not in seen:
                     frontier.append((callee, node, ln))
         return seen
@@ -90,7 +139,18 @@ def module_dotted(relpath: str) -> str:
     return name
 
 
-def build(modules: list[ModuleInfo]) -> CallGraph:
+def _spawn_arg(call: ast.Call, kw: str | None, pos: int | None):
+    """The target-function expression of a spawn call, or None."""
+    if kw is not None:
+        for k in call.keywords:
+            if k.arg == kw:
+                return k.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def build(modules: list[ModuleInfo], root=None) -> CallGraph:
     by_rel = {m.relpath: m for m in modules}
     by_dotted = {module_dotted(m.relpath): m for m in modules}
     functions: dict = {}
@@ -160,32 +220,98 @@ def build(modules: list[ModuleInfo]) -> CallGraph:
                 f"{imp[0]}.{imp[1]}", name)
         return None
 
+    def resolve_callable(m: ModuleInfo, fi: FuncInfo, f):
+        """A call's func expression -> Node, shared by plain calls and
+        spawn-target references."""
+        if isinstance(f, ast.Name):
+            return resolve_name(m, fi, f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            recv = f.value.id
+            if recv in ("self", "cls"):
+                return resolve_method(m, fi, f.attr)
+            imp = m.imports.get(recv)
+            if imp is not None:
+                return mod_func(imp, f.attr)
+            nm = m.import_names.get(recv)
+            if nm is not None:
+                return mod_func(f"{nm[0]}.{nm[1]}", f.attr)
+        return None
+
+    def resolve_ref(m: ModuleInfo, fi: FuncInfo, expr):
+        """A function REFERENCE (spawn target) -> Node. Unwraps
+        ``functools.partial(f, ...)``."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name == "partial" and expr.args:
+                return resolve_ref(m, fi, expr.args[0])
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return resolve_callable(m, fi, expr)
+        return None
+
+    def thread_ctor(m: ModuleInfo, f) -> str | None:
+        """'Thread'/'Timer' when the call constructs a threading class."""
+        if isinstance(f, ast.Attribute) and f.attr in _THREAD_CTORS \
+                and isinstance(f.value, ast.Name):
+            if m.imports.get(f.value.id) == "threading":
+                return f.attr
+        if isinstance(f, ast.Name):
+            imp = m.import_names.get(f.id)
+            if imp is not None and imp[0] == "threading" \
+                    and imp[1] in _THREAD_CTORS:
+                return imp[1]
+        return None
+
     edges: dict = {}
+    spawn_targets: dict = {}
+
+    def note_spawn(out, m, fi, expr, lineno, kind):
+        target = resolve_ref(m, fi, expr)
+        if target is None:
+            return
+        out.append((target, lineno, kind))
+        # "spawn" (detached) dominates for the owner transition; either
+        # way the target runs off the spawner's thread
+        if spawn_targets.get(target) != SPAWN:
+            spawn_targets[target] = kind
+
     for m in modules:
         for q, fi in m.functions.items():
             node = (m.relpath, q)
             out: list = []
             for call in body_calls(fi.node):
                 f = call.func
-                target = None
-                if isinstance(f, ast.Name):
-                    target = resolve_name(m, fi, f.id)
-                elif isinstance(f, ast.Attribute) and isinstance(
-                        f.value, ast.Name):
-                    recv = f.value.id
-                    if recv in ("self", "cls"):
-                        target = resolve_method(m, fi, f.attr)
-                    else:
-                        imp = m.imports.get(recv)
-                        if imp is not None:
-                            target = mod_func(imp, f.attr)
-                        else:
-                            nm = m.import_names.get(recv)
-                            if nm is not None:
-                                target = mod_func(
-                                    f"{nm[0]}.{nm[1]}", f.attr)
+                target = resolve_callable(m, fi, f)
                 if target is not None and target != node:
-                    out.append((target, call.lineno))
+                    out.append((target, call.lineno, CALL))
+                # thread-spawn idioms ------------------------------------
+                ctor = thread_ctor(m, f)
+                if ctor is not None:
+                    kw, pos = _THREAD_CTORS[ctor]
+                    expr = _spawn_arg(call, kw, pos)
+                    if expr is not None:
+                        note_spawn(out, m, fi, expr, call.lineno, SPAWN)
+                elif isinstance(f, ast.Attribute) and f.attr == "submit" \
+                        and call.args:
+                    # executor.submit(fn, ...) — and the repo's
+                    # DispatchPipeline.submit(prep_fn, dispatch_fn):
+                    # every positional callable runs on another thread
+                    for a in call.args:
+                        note_spawn(out, m, fi, a, call.lineno, SPAWN)
+                elif target is not None:
+                    helper = functions.get(target)
+                    spec = helper.thread_helper if helper is not None \
+                        else None
+                    if spec is not None:
+                        kind, idx = spec
+                        if len(call.args) > idx:
+                            note_spawn(out, m, fi, call.args[idx],
+                                       call.lineno,
+                                       SYNC_SPAWN if kind == SYNC_SPAWN
+                                       else SPAWN)
             if out:
                 edges[node] = out
-    return CallGraph(edges=edges, functions=functions, modules=by_rel)
+    return CallGraph(edges=edges, functions=functions, modules=by_rel,
+                     spawn_targets=spawn_targets, root=root)

@@ -21,6 +21,23 @@ rule to its incident):
   annotated ``# durability: fsync`` every writing method must carry the
   full flush+fsync pair (the WAL/fault-registry durability contract
   from PR 3).
+* ``lock-order`` (JTL005) — lockdep-style deadlock detection over the
+  interprocedural lock-acquisition-order graph: cycles between locks,
+  calls that re-acquire a held non-reentrant ``Lock``, lock-held calls
+  into ``# blocking:``-annotated functions, and unbounded blocking
+  primitives executed while holding a lock.
+* ``cond-wait`` (JTL006) — condition-variable discipline: ``wait()``
+  must sit in a ``while``-predicate loop under the condition's own
+  lock, ``notify`` must run under the lock, and a timeout-less
+  ``wait()`` reachable from a scheduler-owned root escalates (one
+  missed notify would wedge the run silently).
+
+The reachability rules traverse the thread-spawn edges the callgraph
+rework added (``Thread(target=...)``, ``submit``, ``# thread-helper:``
+idioms): ``thread-owner`` follows every edge kind, ``no-unbounded-block``
+follows calls + ``sync-spawn`` (a detached thread's block can't wedge
+its spawner), and the lock analyses follow calls + ``sync-spawn`` but
+never ``spawn`` (a fresh thread does not inherit held locks).
 """
 from __future__ import annotations
 
@@ -28,7 +45,9 @@ import ast
 
 from jepsen_tpu.analysis.diagnostics import Finding
 from jepsen_tpu.analysis.lint.astcache import ModuleInfo
-from jepsen_tpu.analysis.lint.callgraph import CallGraph, body_calls
+from jepsen_tpu.analysis.lint.callgraph import (
+    CALL, SPAWN, SYNC_SPAWN, CallGraph, body_calls,
+)
 
 MUTATOR_METHODS = frozenset({
     "append", "add", "clear", "pop", "popitem", "update", "extend",
@@ -74,13 +93,16 @@ def _with_lock_items(node, lock_attrs, class_name):
 
 
 def _scan_method(mod, method_fi, lock_attrs, class_name):
-    """(mutations, locked_selfcalls, all_selfcalls) for one method.
-    Nested defs are scanned for mutations but NEVER count as
+    """(mutations, locked_selfcalls, all_selfcalls, ref_calls) for one
+    method. Nested defs are scanned for mutations but NEVER count as
     lock-guarded: a closure runs when it is *called*, not where its
-    ``with`` block happens to enclose its definition."""
+    ``with`` block happens to enclose its definition. ``ref_calls`` are
+    ``self.m`` references passed as call arguments (thread-spawn
+    targets): always unlocked, wherever they lexically sit."""
     mutations: list[_Mutation] = []
     locked_calls: list[str] = []
     all_calls: list[str] = []
+    ref_calls: list[str] = []
 
     def note(attr, node, desc, locked):
         mutations.append(_Mutation(attr, node.lineno, node.col_offset,
@@ -130,10 +152,23 @@ def _scan_method(mod, method_fi, lock_attrs, class_name):
                         all_calls.append(f.attr)
                         if locked:
                             locked_calls.append(f.attr)
+                # a `self.m` REFERENCE handed to a call
+                # (Thread(target=self.m), executor.submit(self.m)) runs
+                # on whatever thread eventually invokes it — never
+                # provably under this lock, even when the spawn site is
+                # inside the `with`. Count it as an unlocked call so
+                # the helper-exemption can't blow through a thread edge.
+                for arg in list(child.args) + [k.value
+                                               for k in child.keywords]:
+                    a = (arg.attr if isinstance(arg, ast.Attribute)
+                         and isinstance(arg.value, ast.Name)
+                         and arg.value.id in ("self", "cls") else None)
+                    if a is not None:
+                        ref_calls.append(a)
             walk(child, child_locked)
 
     walk(method_fi.node, False)
-    return mutations, locked_calls, all_calls
+    return mutations, locked_calls, all_calls, ref_calls
 
 
 def lock_guard(mod: ModuleInfo) -> list[Finding]:
@@ -166,7 +201,7 @@ def lock_guard(mod: ModuleInfo) -> list[Finding]:
         lockheld_callees: set = set()   # self.m() seen under a lock
         unlocked_callees: set = set()   # self.m() seen outside any lock
         for q, fi in methods.items():
-            muts, locked_calls, all_calls = _scan_method(
+            muts, locked_calls, all_calls, ref_calls = _scan_method(
                 mod, fi, lock_attrs, ci.name)
             per_method[q] = (fi, muts)
             in_init = fi.node.name in _INIT_METHODS
@@ -175,6 +210,9 @@ def lock_guard(mod: ModuleInfo) -> list[Finding]:
                     lockheld_callees.add(c)
                 else:
                     unlocked_callees.add(c)
+            # spawn-target references escape the lock even from __init__
+            # (the thread runs after the object is shared)
+            unlocked_callees.update(ref_calls)
         guarded = {m.attr for fi, muts in per_method.values()
                    for m in muts
                    if m.locked and fi.node.name not in _INIT_METHODS}
@@ -211,8 +249,15 @@ def lock_guard(mod: ModuleInfo) -> list[Finding]:
 # ---------------------------------------------------------------------------
 
 def thread_owner(graph: CallGraph) -> list[Finding]:
+    # roots: explicitly worker-annotated functions PLUS thread-spawn
+    # targets without an annotation (the owner transition — a spawned
+    # target runs on a fresh thread, so scheduler-only code it reaches
+    # is exactly the PR-4 concurrent-close race class). Spawn edges are
+    # traversed too: a thread spawned from a worker is still not the
+    # scheduler.
     out: list[Finding] = []
-    workers = [n for n, fi in graph.functions.items() if fi.owner == "worker"]
+    workers = [n for n in graph.functions
+               if graph.effective_owner(n) == "worker"]
     for root in workers:
         seen = graph.reachable(
             [root], through=lambda n: graph.owner(n) != "scheduler")
@@ -272,21 +317,52 @@ def _unbounded_block_call(call: ast.Call, queues: frozenset) -> str | None:
     return f"{f.attr}() without a timeout"
 
 
+def scheduler_reachable(graph: CallGraph):
+    """{node: (parent, lineno, via_sync)} closure from scheduler-owned
+    roots — plain calls through non-worker-annotated nodes, plus
+    ``sync-spawn`` edges (the caller waits for the spawned work, so its
+    block is the scheduler's block). Detached ``spawn`` edges are never
+    followed: a parked worker thread can't wedge its spawner.
+    ``via_sync`` records whether the path crossed a sync-spawn edge —
+    nodes so reached are scanned even when worker-annotated."""
+    seen: dict = {}
+    frontier = [(n, None, 0, False) for n, fi in graph.functions.items()
+                if fi.owner == "scheduler"]
+    while frontier:
+        node, parent, lineno, via_sync = frontier.pop()
+        prev = seen.get(node)
+        # re-visit on a via_sync UPGRADE (False -> True): the first
+        # visit may have arrived on a plain-call path that stops at a
+        # worker-annotated leaf, while a sync-spawn path to the same
+        # node must both scan it and expand through it — first-visit-
+        # wins would silently drop those findings depending on source
+        # order
+        if prev is not None and (prev[2] or not via_sync):
+            continue
+        seen[node] = (parent, lineno, via_sync)
+        if parent is not None and not via_sync \
+                and graph.owner(node) not in (None, "any", "scheduler"):
+            continue  # worker-annotated leaf on a plain-call path
+        for callee, ln, kind in graph.edges.get(node, ()):
+            if kind == SPAWN:
+                continue
+            frontier.append((callee, node, ln,
+                             via_sync or kind == SYNC_SPAWN))
+    return seen
+
+
 def no_unbounded_block(graph: CallGraph) -> list[Finding]:
     out: list[Finding] = []
-    roots = [n for n, fi in graph.functions.items()
-             if fi.owner == "scheduler"]
-    seen = graph.reachable(
-        [root for root in roots],
-        through=lambda n: graph.owner(n) in (None, "any", "scheduler"))
+    seen = scheduler_reachable(graph)
+    path_index = {n: (p, ln) for n, (p, ln, _v) in seen.items()}
     root_of: dict = {}
     for node in seen:
-        chain = graph.path_to(seen, node)
+        chain = graph.path_to(path_index, node)
         root_of[node] = chain[0]
     queue_evidence: dict = {}
-    for node in seen:
+    for node, (_parent, _ln, via_sync) in seen.items():
         fi = graph.functions.get(node)
-        if fi is None or fi.owner == "worker":
+        if fi is None or (fi.owner == "worker" and not via_sync):
             continue
         mod = graph.modules.get(node[0])
         if mod is None or "no-unbounded-block" in fi.ignores:
@@ -311,6 +387,471 @@ def no_unbounded_block(graph: CallGraph) -> list[Finding]:
                 hint="pass timeout= (poll in a loop if the wait is "
                      "legitimately long) so a hung peer can never wedge "
                      "the scheduler silently"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order (JTL005): lockdep-style deadlock detection
+# ---------------------------------------------------------------------------
+
+_LOCKLIKE = ("Lock", "RLock", "Condition")
+# non-reentrant constructors: re-acquiring on the same thread deadlocks
+_NON_REENTRANT = ("Lock",)
+
+
+def _lock_ctor_kind(node) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name if name in _LOCKLIKE else None
+
+
+class _LockInventory:
+    """Per-module lock-like attributes: ``locks[(scope, attr)] = kind``
+    where scope is the class qualname ('' for module globals), plus the
+    Condition->associated-lock map (``Condition(self._lock)`` acquires
+    ``self._lock``, so ordering identity must collapse to it)."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.locks: dict = {}
+        self.cv_assoc: dict = {}   # (scope, cv_attr) -> assoc lock attr
+        for cq, ci in mod.classes.items():
+            methods = [fi for q, fi in mod.functions.items()
+                       if q.startswith(cq + ".")
+                       and "." not in q[len(cq) + 1:]]
+            for fi in methods:
+                for n in ast.walk(fi.node):
+                    if isinstance(n, ast.Assign):
+                        kind = _lock_ctor_kind(n.value)
+                        if kind is None:
+                            continue
+                        for t in n.targets:
+                            a = _self_attr(t, ci.name)
+                            if a is not None:
+                                self.locks[(cq, a)] = kind
+                                self._note_assoc(cq, a, n.value, ci.name)
+            for stmt in ci.node.body:
+                if isinstance(stmt, ast.Assign):
+                    kind = _lock_ctor_kind(stmt.value)
+                    if kind is None:
+                        continue
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.locks[(cq, t.id)] = kind
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _lock_ctor_kind(stmt.value)
+                if kind is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.locks[("", t.id)] = kind
+
+    def _note_assoc(self, scope, attr, ctor, class_name):
+        if _lock_ctor_kind(ctor) == "Condition" and ctor.args:
+            assoc = _self_attr(ctor.args[0], class_name)
+            if assoc is not None:
+                self.cv_assoc[(scope, attr)] = assoc
+
+    def lock_id(self, mod, fi, expr):
+        """(relpath, scope, attr) for a with-item context expression
+        that names a known lock, else None. Conditions constructed over
+        an explicit lock collapse to that lock's identity."""
+        scope = _enclosing_class(mod, fi)
+        a = _self_attr(expr, scope.rsplit(".", 1)[-1] if scope else None)
+        if a is not None and scope is not None:
+            assoc = self.cv_assoc.get((scope, a))
+            if assoc is not None and (scope, assoc) in self.locks:
+                a = assoc
+            if (scope, a) in self.locks:
+                return (mod.relpath, scope, a)
+        if isinstance(expr, ast.Name) and ("", expr.id) in self.locks:
+            return (mod.relpath, "", expr.id)
+        return None
+
+    def kind(self, lock_id) -> str | None:
+        return self.locks.get((lock_id[1], lock_id[2]))
+
+
+def _enclosing_class(mod: ModuleInfo, fi) -> str | None:
+    parts = fi.qualname.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        cq = ".".join(parts[:i])
+        if cq in mod.classes:
+            return cq
+    return None
+
+
+def _lock_name(lock_id) -> str:
+    _rel, scope, attr = lock_id
+    return f"{scope.rsplit('.', 1)[-1]}.{attr}" if scope else attr
+
+
+class _FuncLockScan:
+    """Lexical lock-region scan of ONE function: direct acquisitions,
+    direct nested-order edges, direct same-``Lock`` re-acquisition, and
+    every call made while holding at least one lock. Nested defs are
+    skipped — a closure acquires when *called*, and it is its own graph
+    node."""
+
+    def __init__(self, mod, fi, inv: _LockInventory, queues: frozenset):
+        self.acquires: set = set()
+        self.order_edges: list = []      # (L1, L2, lineno)
+        self.self_deadlocks: list = []   # (L, lineno, col)
+        self.held_calls: list = []       # (lineno, col, tuple(held))
+        self.held_blockers: list = []    # (lineno, col, why, held)
+        self._inv = inv
+        self._mod = mod
+        self._fi = fi
+        self._queues = queues
+        self._walk(fi.node, [])
+
+    def _walk(self, node, held: list):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in child.items:
+                    lid = self._inv.lock_id(self._mod, self._fi,
+                                            item.context_expr)
+                    if lid is None:
+                        continue
+                    self.acquires.add(lid)
+                    # order against the outer held set AND the items
+                    # already acquired by THIS statement: `with a, b:`
+                    # is sugar for nested withs, so it contributes the
+                    # same a -> b edge
+                    for h in held + acquired:
+                        if h != lid:
+                            self.order_edges.append((h, lid, child.lineno))
+                    if (lid in held or lid in acquired) \
+                            and self._inv.kind(lid) in _NON_REENTRANT:
+                        self.self_deadlocks.append(
+                            (lid, child.lineno, child.col_offset))
+                    acquired.append(lid)
+                if acquired:
+                    child_held = held + acquired
+            elif isinstance(child, ast.Call) and held:
+                self.held_calls.append(
+                    (child.lineno, child.col_offset, tuple(held)))
+                why = _unbounded_block_call(child, self._queues)
+                if why is not None and not self._wait_on_held_cv(
+                        child, held):
+                    self.held_blockers.append(
+                        (child.lineno, child.col_offset, why, tuple(held)))
+            self._walk(child, child_held)
+
+    def _wait_on_held_cv(self, call: ast.Call, held: list) -> bool:
+        """``cv.wait()`` while holding ``cv`` RELEASES the lock for the
+        duration of the wait — the textbook pattern, not a lock-held
+        block. (Its while-loop/timeout discipline is cond-wait's job.)"""
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("wait", "wait_for")):
+            return False
+        rid = self._inv.lock_id(self._mod, self._fi, f.value)
+        return rid is not None and rid in held
+
+
+def lock_order(graph: CallGraph) -> list[Finding]:
+    """Interprocedural lock-order analysis. Traverses plain call and
+    ``sync-spawn`` edges only: a detached thread does not inherit the
+    spawner's held locks (its acquisitions are its own thread's
+    ordering problem, analyzed from its own root)."""
+    out: list[Finding] = []
+    inventories: dict = {}
+    scans: dict = {}
+    queue_evidence: dict = {}
+    for rel, mod in graph.modules.items():
+        inv = inventories[rel] = _LockInventory(mod)
+        queues = queue_evidence[rel] = _queue_receivers(mod)
+        for q, fi in mod.functions.items():
+            scans[(rel, q)] = _FuncLockScan(mod, fi, inv, queues)
+
+    # transitive acquisition sets (fixpoint over call/sync-spawn edges)
+    eff = {n: set(s.acquires) for n, s in scans.items()}
+    # nodes that (transitively) reach a `# blocking:`-annotated function
+    blocking_rep = {n: f"{fi.qualname} (# blocking: {fi.blocking})"
+                    for n, fi in graph.functions.items()
+                    if fi.blocking is not None}
+    changed = True
+    while changed:
+        changed = False
+        for node, edges in graph.edges.items():
+            if node not in eff:
+                continue
+            for callee, _ln, kind in edges:
+                if kind == SPAWN:
+                    continue
+                ce = eff.get(callee)
+                if ce and not ce <= eff[node]:
+                    eff[node] |= ce
+                    changed = True
+                rep = blocking_rep.get(callee)
+                if rep is not None and node not in blocking_rep:
+                    blocking_rep[node] = rep
+                    changed = True
+
+    def waived(mod, fi, lineno) -> bool:
+        return ("lock-order" in fi.ignores
+                or "lock-order" in mod.line_ignores(lineno))
+
+    order_graph: dict = {}   # L1 -> {L2: (path, lineno, qualname)}
+    for node, scan in scans.items():
+        mod = graph.modules[node[0]]
+        fi = graph.functions[node]
+        for lid, lineno, col in scan.self_deadlocks:
+            if waived(mod, fi, lineno):
+                continue
+            out.append(Finding(
+                rule="lock-order", code="JTL005", path=node[0],
+                line=lineno, col=col + 1, qualname=node[1],
+                message=(f"nested `with {_lock_name(lid)}` re-acquires a "
+                         "non-reentrant Lock already held — guaranteed "
+                         "self-deadlock"),
+                hint="use an RLock, or restructure so the inner region "
+                     "runs outside the lock"))
+        for lineno, col, why, held in scan.held_blockers:
+            if waived(mod, fi, lineno):
+                continue
+            locks = ", ".join(sorted(_lock_name(h) for h in held))
+            out.append(Finding(
+                rule="lock-order", code="JTL005", path=node[0],
+                line=lineno, col=col + 1, qualname=node[1],
+                message=(f"{why} while holding {locks} — every other "
+                         "user of the lock blocks behind a wait that "
+                         "may never end"),
+                hint="release the lock before blocking, or bound the "
+                     "wait with timeout="))
+        # direct nested-with order edges. A waived site contributes no
+        # edge — `# lint: ignore[lock-order]` on the acquisition line
+        # (or the def) must suppress the cycles it participates in, the
+        # same escape hatch every other diagnostic of this rule honors.
+        for L1, L2, lineno in scan.order_edges:
+            if waived(mod, fi, lineno):
+                continue
+            order_graph.setdefault(L1, {}).setdefault(
+                L2, (node[0], lineno, node[1]))
+        # calls made under a lock: what does the callee acquire?
+        edges_by_line: dict = {}
+        for callee, ln, kind in graph.edges.get(node, ()):
+            if kind != SPAWN:
+                edges_by_line.setdefault(ln, []).append(callee)
+        for lineno, col, held in scan.held_calls:
+            for callee in edges_by_line.get(lineno, ()):
+                for lid in eff.get(callee, ()):
+                    for h in held:
+                        if h == lid:
+                            if inventories[lid[0]].kind(lid) \
+                                    in _NON_REENTRANT \
+                                    and not waived(mod, fi, lineno):
+                                out.append(Finding(
+                                    rule="lock-order", code="JTL005",
+                                    path=node[0], line=lineno,
+                                    col=col + 1, qualname=node[1],
+                                    message=(
+                                        f"call into {callee[1]!r} may "
+                                        f"re-acquire non-reentrant "
+                                        f"{_lock_name(lid)} already "
+                                        "held here — self-deadlock"),
+                                    hint="split a _locked() helper that "
+                                         "assumes the lock, or use an "
+                                         "RLock"))
+                        elif not waived(mod, fi, lineno):
+                            order_graph.setdefault(h, {}).setdefault(
+                                lid, (node[0], lineno, node[1]))
+                rep = blocking_rep.get(callee)
+                if rep is not None and not waived(mod, fi, lineno):
+                    locks = ", ".join(sorted(_lock_name(h) for h in held))
+                    out.append(Finding(
+                        rule="lock-order", code="JTL005", path=node[0],
+                        line=lineno, col=col + 1, qualname=node[1],
+                        message=(f"call into blocking {rep} while "
+                                 f"holding {locks}"),
+                        hint="blocking/RPC work must not run under a "
+                             "lock; snapshot state, release, then call"))
+
+    out.extend(_order_cycles(order_graph))
+    return out
+
+
+def _order_cycles(order_graph: dict) -> list[Finding]:
+    """One finding per lock-order cycle (Tarjan SCCs of the
+    acquired-before digraph; any SCC with a cycle is an AB-BA deadlock
+    waiting for the right interleaving)."""
+    out: list[Finding] = []
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (the lock graph is tiny, but recursion limits
+        # are not worth betting on)
+        work = [(v, iter(order_graph.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(order_graph.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in list(order_graph):
+        if v not in index:
+            strongconnect(v)
+    for comp in sccs:
+        cyclic = len(comp) > 1 or (
+            comp and comp[0] in order_graph.get(comp[0], ()))
+        if not cyclic:
+            continue
+        comp = sorted(comp)
+        ring = " -> ".join(_lock_name(x) for x in comp + [comp[0]])
+        # anchor at the lexically first edge site inside the component
+        sites = [order_graph[a][b] for a in comp
+                 for b in order_graph.get(a, ()) if b in comp]
+        path, lineno, qualname = min(sites)
+        out.append(Finding(
+            rule="lock-order", code="JTL005", path=path, line=lineno,
+            col=1, qualname=qualname,
+            message=(f"lock-order cycle: {ring} — two threads taking "
+                     "these locks in opposite orders deadlock"),
+            hint="impose one global acquisition order (document it next "
+                 "to the lock constructors) or merge the locks"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cond-wait (JTL006): condition-variable discipline
+# ---------------------------------------------------------------------------
+
+def cond_wait(graph: CallGraph) -> list[Finding]:
+    out: list[Finding] = []
+    sched = scheduler_reachable(graph)
+    for rel, mod in graph.modules.items():
+        inv = _LockInventory(mod)
+        cvs = {key for key, kind in inv.locks.items()
+               if kind == "Condition"}
+        if not cvs:
+            continue
+        for q, fi in mod.functions.items():
+            scope = _enclosing_class(mod, fi) or ""
+            cls_name = scope.rsplit(".", 1)[-1] if scope else None
+            node = (rel, q)
+            on_sched = node in sched and not (
+                graph.owner(node) == "worker" and not sched[node][2])
+
+            def guard_names(cv_attr):
+                names = {cv_attr}
+                assoc = inv.cv_assoc.get((scope, cv_attr))
+                if assoc is not None:
+                    names.add(assoc)
+                return names
+
+            def visit(n, held: frozenset, in_while: bool):
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    child_held, child_while = held, in_while
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        got = set()
+                        for item in child.items:
+                            a = _self_attr(item.context_expr, cls_name)
+                            if a is not None:
+                                got.add(a)
+                        if got:
+                            child_held = held | got
+                    elif isinstance(child, ast.While):
+                        child_while = True
+                    if isinstance(child, ast.Call):
+                        _check_cv_call(child, held, in_while)
+                    visit(child, child_held, child_while)
+
+            def _check_cv_call(call, held, in_while):
+                f = call.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr in ("wait", "wait_for", "notify",
+                                       "notify_all")):
+                    return
+                a = _self_attr(f.value, cls_name)
+                if a is None or (scope, a) not in cvs:
+                    return
+                if "cond-wait" in fi.ignores \
+                        or "cond-wait" in mod.line_ignores(call.lineno):
+                    return
+                loc = dict(rule="cond-wait", code="JTL006", path=rel,
+                           line=call.lineno, col=call.col_offset + 1,
+                           qualname=q)
+                under_lock = bool(guard_names(a) & held)
+                if not under_lock:
+                    out.append(Finding(
+                        **loc,
+                        message=(f"self.{a}.{f.attr}() outside `with "
+                                 f"self.{a}` — {'waiting' if 'wait' in f.attr else 'notifying'} "
+                                 "without the condition's lock races "
+                                 "the predicate"),
+                        hint=f"wrap in `with self.{a}:`"))
+                if f.attr == "wait" and not in_while:
+                    out.append(Finding(
+                        **loc,
+                        message=(f"self.{a}.wait() not inside a "
+                                 "while-predicate loop — spurious "
+                                 "wakeups and stolen notifies break a "
+                                 "naked wait"),
+                        hint="loop: `while not <predicate>: "
+                             f"self.{a}.wait(...)` (or use wait_for)"))
+                kwnames = {k.arg for k in call.keywords}
+                timeout_less = ("timeout" not in kwnames
+                                and ((f.attr == "wait" and not call.args)
+                                     or (f.attr == "wait_for"
+                                         and len(call.args) < 2)))
+                if timeout_less and f.attr in ("wait", "wait_for") \
+                        and on_sched:
+                    out.append(Finding(
+                        **loc,
+                        message=(f"timeout-less self.{a}.{f.attr}() "
+                                 "reachable from a scheduler-owned root "
+                                 "— one missed notify wedges the run "
+                                 "silently"),
+                        hint="pass timeout= and re-check the predicate "
+                             "in the loop"))
+
+            visit(fi.node, frozenset(), False)
     return out
 
 

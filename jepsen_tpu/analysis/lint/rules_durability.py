@@ -1,0 +1,233 @@
+"""Durability-protocol rules (JTD001, rule ``durability-protocol``).
+
+The repo's crash-safety story rests on two hand-rolled disciplines the
+PR-13 checkpoint work made load-bearing everywhere:
+
+* **atomic replace** — durable documents are published by
+  temp-write -> flush -> fsync -> rename (``utils.atomic_write_json``).
+  Skipping the fsync means ``os.replace`` can publish a name whose
+  *data* is still in the page cache: a power cut leaves a torn or
+  empty file under the durable name — exactly the torn-document class
+  the rename exists to prevent.
+* **record-before-act** — the durable record of an action (fault
+  registry inject rows, membership pre-op member sets) must hit disk
+  BEFORE the action fires, or a crash between the two strands state no
+  recovery pass knows about.
+
+Three diagnostics, all rule ``durability-protocol``:
+
+1. *fsync-before-rename*: a function that writes a file and then
+   ``os.replace``/``os.rename``s it must call ``os.fsync`` before the
+   rename (line order; waivable where process-crash atomicity is all
+   that's wanted and power loss is accepted).
+2. *durable overwrite*: inside a class annotated ``# durability: ...``,
+   a direct ``open(<self path>, "w"/"wb")`` outside ``__init__`` with
+   no subsequent rename bypasses the atomic-replace helper — a crash
+   mid-write leaves the durable artifact truncated. (``__init__`` is
+   exempt: creating a fresh append-only file is the WAL protocol.)
+3. *record-after-act*: in a function annotated ``# durability:
+   record-before-act`` (or any method of a class so annotated) that
+   performs act calls (``.invoke/.apply/.inject/.fire/.execute/
+   .exec_``), a durable ``.record*``/``._record*`` call must appear on
+   an earlier line than the first act. Late *re*-records after the act
+   are fine — there must simply exist a record that precedes it.
+"""
+from __future__ import annotations
+
+import ast
+
+from jepsen_tpu.analysis.diagnostics import Finding
+from jepsen_tpu.analysis.lint.astcache import ModuleInfo
+from jepsen_tpu.analysis.lint.callgraph import body_calls
+
+RULE = "durability-protocol"
+CODE = "JTD001"
+
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+# attribute-call names that fire the action a durable record protects
+ACT_ATTRS = frozenset({"invoke", "apply", "inject", "fire", "execute",
+                       "exec_"})
+
+_WRITE_ATTRS = frozenset({"write", "writelines", "dump", "copyfileobj"})
+
+
+def _attr_call(call: ast.Call) -> str | None:
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else None
+
+
+def _is_os_call(call: ast.Call, mod: ModuleInfo, name: str) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == name \
+            and isinstance(f.value, ast.Name):
+        return mod.imports.get(f.value.id) == "os" or f.value.id == "os"
+    return False
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """The literal mode of an ``open``/``io.open`` call, or None."""
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    if name not in ("open", "fdopen"):
+        return None
+    mode = None
+    if len(call.args) > 1:
+        mode = call.args[1]
+    for k in call.keywords:
+        if k.arg == "mode":
+            mode = k.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _mentions_self_attr(expr, class_name) -> str | None:
+    """First ``self.<attr>`` mentioned anywhere inside ``expr``."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id in ("self", "cls"):
+            return n.attr
+        if isinstance(n, ast.Name) and class_name is not None \
+                and n.id == class_name:
+            return n.id
+    return None
+
+
+def _fsync_before_rename(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for q, fi in mod.functions.items():
+        if RULE in fi.ignores:
+            continue
+        calls = body_calls(fi.node)
+        renames = [c for c in calls
+                   if _is_os_call(c, mod, "replace")
+                   or _is_os_call(c, mod, "rename")]
+        if not renames:
+            continue
+        write_lines = [c.lineno for c in calls
+                       if _attr_call(c) in _WRITE_ATTRS]
+        wrote = bool(write_lines) \
+            or any((_open_mode(c) or "r").strip("b").rstrip("+")
+                   in ("w", "a", "x") for c in calls)
+        if not wrote:
+            continue  # a pure rename (store rotation) is not a publish
+        fsyncs = [c.lineno for c in calls if _is_os_call(c, mod, "fsync")]
+        for rn in renames:
+            if RULE in mod.line_ignores(rn.lineno):
+                continue
+            # the fsync must land BETWEEN the last write preceding this
+            # rename and the rename itself: an fsync that published an
+            # EARLIER file must not vouch for a later unfsynced one
+            # (a function can publish two documents; each needs its own
+            # flush-to-disk before its rename)
+            last_write = max((w for w in write_lines if w < rn.lineno),
+                             default=0)
+            if any(last_write <= ln <= rn.lineno for ln in fsyncs):
+                continue
+            out.append(Finding(
+                rule=RULE, code=CODE, path=mod.relpath, line=rn.lineno,
+                col=rn.col_offset + 1, qualname=q,
+                message=("os.replace/rename publishes a freshly-written "
+                         "file without fsync — a power cut can leave a "
+                         "torn or empty document under the durable "
+                         "name"),
+                hint="flush + os.fsync(f.fileno()) before the rename "
+                     "(utils.atomic_write_json is the house pattern), "
+                     "or waive with # lint: ignore[durability-protocol] "
+                     "where process-crash atomicity is all that's "
+                     "needed"))
+    return out
+
+
+def _durable_overwrite(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for cq, ci in mod.classes.items():
+        if not ci.durabilities:
+            continue
+        methods = {q: fi for q, fi in mod.functions.items()
+                   if q.startswith(cq + ".")
+                   and "." not in q[len(cq) + 1:]}
+        for q, fi in methods.items():
+            if fi.node.name in _INIT_METHODS or RULE in fi.ignores:
+                continue
+            calls = body_calls(fi.node)
+            rename_lines = [c.lineno for c in calls
+                            if _is_os_call(c, mod, "replace")
+                            or _is_os_call(c, mod, "rename")]
+            for c in calls:
+                mode = _open_mode(c)
+                if mode is None or mode.strip("b").rstrip("+") not in \
+                        ("w", "x"):
+                    continue
+                if not c.args or _mentions_self_attr(
+                        c.args[0], ci.name) is None:
+                    continue  # a scratch path, not the durable artifact
+                if any(rl >= c.lineno for rl in rename_lines):
+                    # this open feeds a later rename: the atomic-replace
+                    # path, which diagnostic 1 audits. A rename BEFORE
+                    # the open vouches for nothing — a method that
+                    # atomically publishes one artifact may still
+                    # overwrite a second one in place.
+                    continue
+                if RULE in mod.line_ignores(c.lineno):
+                    continue
+                out.append(Finding(
+                    rule=RULE, code=CODE, path=mod.relpath,
+                    line=c.lineno, col=c.col_offset + 1, qualname=q,
+                    message=(f"direct open(..., {mode!r}) overwrites a "
+                             f"durable artifact of {ci.name} "
+                             f"(# durability: "
+                             f"{', '.join(sorted(ci.durabilities))}) "
+                             "in place — a crash mid-write truncates "
+                             "it"),
+                    hint="write via utils.atomic_write_json / "
+                         "tmp+fsync+os.replace, or append-only"))
+    return out
+
+
+def _record_before_act(mod: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    for q, fi in mod.functions.items():
+        annotated = "record-before-act" in fi.durabilities
+        if not annotated and fi.class_name is not None:
+            for cq, ci in mod.classes.items():
+                if ci.name == fi.class_name \
+                        and q.startswith(cq + ".") \
+                        and "record-before-act" in ci.durabilities:
+                    annotated = True
+                    break
+        if not annotated or RULE in fi.ignores:
+            continue
+        calls = body_calls(fi.node)
+        records = [c.lineno for c in calls
+                   if (_attr_call(c) or "").lstrip("_")
+                   .startswith("record")]
+        acts = [c for c in calls if _attr_call(c) in ACT_ATTRS]
+        if not acts:
+            continue
+        first_act = min(acts, key=lambda c: (c.lineno, c.col_offset))
+        if any(ln < first_act.lineno for ln in records):
+            continue
+        if RULE in mod.line_ignores(first_act.lineno):
+            continue
+        what = "no durable record call at all" if not records else \
+            "the record lands only after the action fired"
+        out.append(Finding(
+            rule=RULE, code=CODE, path=mod.relpath,
+            line=first_act.lineno, col=first_act.col_offset + 1,
+            qualname=q,
+            message=(f"acts before durably recording ({what}) — a crash "
+                     "between the action and its record strands state "
+                     "no recovery pass knows about"),
+            hint="record the injection/reconfiguration to the durable "
+                 "registry BEFORE firing it (record-before-act)"))
+    return out
+
+
+def durability_protocol(mod: ModuleInfo) -> list[Finding]:
+    return (_fsync_before_rename(mod) + _durable_overwrite(mod)
+            + _record_before_act(mod))

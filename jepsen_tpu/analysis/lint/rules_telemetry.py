@@ -1,0 +1,181 @@
+"""telemetry-name rule (JTM001): metric-name hygiene + doc drift.
+
+Every ``Counter``/``Gauge``/``Histogram``/timer registration with a
+literal name is collected package-wide and checked:
+
+* **snake_case** — ``^[a-z][a-z0-9_]*$`` (Prometheus-safe, grep-safe).
+* **suffix conventions** — counters end in ``_total``; histograms and
+  timers end in a unit suffix (``_seconds``/``_ops``/``_bytes``/
+  ``_steps``). Gauges are free-form (they carry ``_frac``/``_active``/
+  unit suffixes by convention but legitimately vary).
+* **kind-unique** — one name must map to one instrument kind across
+  the whole package: re-registering ``x_total`` as a gauge elsewhere
+  would raise at runtime only if both call sites execute in one
+  process, i.e. exactly the silent-until-production class.
+* **label consistency** — two literal ``labels=(...)`` tuples for the
+  same name must agree (the registry raises on mismatch at runtime).
+* **doc cross-check** — metric names cited in
+  ``doc/observability.md`` (the ``name{labels}`` form, or bare
+  ``*_total`` names) must exist in code: a silent rename strands the
+  operators' dashboards. (Skipped when the doc isn't under the lint
+  root — fixture trees.)
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from jepsen_tpu.analysis.diagnostics import Finding
+from jepsen_tpu.analysis.lint.callgraph import CallGraph
+
+RULE = "telemetry-name"
+CODE = "JTM001"
+
+_KINDS = {"counter": "counter", "gauge": "gauge",
+          "histogram": "histogram", "timer": "histogram"}
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_COUNTER_SUFFIX = ("_total",)
+_HIST_SUFFIXES = ("_seconds", "_ops", "_bytes", "_steps")
+
+DOC_NAME = Path("doc") / "observability.md"
+# `name{labels}` citations are unambiguous; bare names are only
+# trusted as metric citations when they carry the _total suffix no
+# knob/file name uses
+_DOC_CITED = re.compile(r"`([a-z][a-z0-9_]*)\{[^}`\n]*\}`"
+                        r"|`([a-z][a-z0-9_]*_total)`")
+
+
+class _Reg:
+    __slots__ = ("name", "kind", "labels", "path", "line", "col",
+                 "qualname")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _literal_labels(call: ast.Call):
+    """The ``labels=(...)`` tuple when it is a literal, else None."""
+    for k in call.keywords:
+        if k.arg != "labels":
+            continue
+        v = k.value
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts)
+    return None
+
+
+def _enclosing_qualname(mod, lineno: int) -> str:
+    best = "<module>"
+    best_span = None
+    for q, fi in mod.functions.items():
+        if fi.lineno <= lineno <= fi.end_lineno:
+            span = fi.end_lineno - fi.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def _registrations(mod) -> list[_Reg]:
+    out: list[_Reg] = []
+    for n in ast.walk(mod.tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _KINDS and n.args):
+            continue
+        arg = n.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        out.append(_Reg(name=arg.value, kind=_KINDS[n.func.attr],
+                        labels=_literal_labels(n), path=mod.relpath,
+                        line=n.lineno, col=n.col_offset + 1,
+                        qualname=_enclosing_qualname(mod, n.lineno)))
+    return out
+
+
+def telemetry_name(graph: CallGraph) -> list[Finding]:
+    out: list[Finding] = []
+    regs: list[_Reg] = []
+    for rel, mod in graph.modules.items():
+        for r in _registrations(mod):
+            fi = mod.functions.get(r.qualname)
+            if fi is not None and RULE in fi.ignores:
+                continue
+            if RULE in mod.line_ignores(r.line):
+                continue
+            regs.append(r)
+
+    def finding(r: _Reg, message: str, hint: str | None = None):
+        out.append(Finding(rule=RULE, code=CODE, path=r.path,
+                           line=r.line, col=r.col, qualname=r.qualname,
+                           message=message, hint=hint))
+
+    by_name: dict[str, list[_Reg]] = {}
+    for r in regs:
+        by_name.setdefault(r.name, []).append(r)
+        if not _SNAKE.match(r.name):
+            finding(r, f"metric name {r.name!r} is not snake_case",
+                    "lowercase letters, digits, underscores only")
+            continue
+        if r.kind == "counter" and not r.name.endswith(_COUNTER_SUFFIX):
+            finding(r, f"counter {r.name!r} must end in _total "
+                       "(Prometheus counter convention)",
+                    "rename to <thing>_total; update "
+                    "doc/observability.md citations")
+        if r.kind == "histogram" \
+                and not r.name.endswith(_HIST_SUFFIXES):
+            finding(r, f"histogram {r.name!r} lacks a unit suffix",
+                    "append _seconds/_ops/_bytes/_steps so the unit is "
+                    "in the name")
+
+    for name, rs in sorted(by_name.items()):
+        kinds = sorted({r.kind for r in rs})
+        if len(kinds) > 1:
+            r = rs[-1]
+            finding(r, f"metric {name!r} registered as "
+                       f"{' and '.join(kinds)} across the package — "
+                       "the registry raises at runtime when both call "
+                       "sites meet",
+                    "one name, one instrument kind")
+        label_sets = {r.labels for r in rs if r.labels is not None}
+        if len(label_sets) > 1:
+            r = rs[-1]
+            pretty = " vs ".join(str(s) for s in sorted(label_sets))
+            finding(r, f"metric {name!r} registered with conflicting "
+                       f"label sets ({pretty})",
+                    "label names are part of the series identity; "
+                    "unify them")
+
+    out.extend(_doc_drift(graph, set(by_name)))
+    return out
+
+
+def _doc_drift(graph: CallGraph, registered: set) -> list[Finding]:
+    if graph.root is None:
+        return []
+    doc = Path(graph.root) / DOC_NAME
+    try:
+        lines = doc.read_text(encoding="utf-8").splitlines()
+    except OSError:
+        return []
+    out: list[Finding] = []
+    seen: set = set()
+    for i, line in enumerate(lines, 1):
+        for m in _DOC_CITED.finditer(line):
+            name = m.group(1) or m.group(2)
+            if name in registered or name in seen:
+                continue
+            seen.add(name)
+            out.append(Finding(
+                rule=RULE, code=CODE, path=str(DOC_NAME), line=i, col=1,
+                qualname="<doc>",
+                message=(f"doc/observability.md cites metric {name!r} "
+                         "but nothing in the linted tree registers it "
+                         "— a silent rename strands dashboards"),
+                hint="update the doc (or restore the metric name)"))
+    return out

@@ -182,7 +182,7 @@ def decode_array(d: dict) -> np.ndarray:
 # The store
 # ---------------------------------------------------------------------------
 
-class CheckpointStore:  # durability: fsync
+class CheckpointStore:  # durability: fsync (via utils.atomic_write_json)
     """One run's ``check.ckpt``: interval-gated atomic persists of a
     resumable check's carry state.
 

@@ -108,9 +108,12 @@ def disconnect_all(test: dict) -> None:
 
 # -- the shell DSL ---------------------------------------------------------
 
-def exec_(*args, stdin: str | None = None) -> str:
+def exec_(*args, stdin: str | None = None) -> str:  # blocking: rpc
     """Runs a shell command on the current session, returning trimmed
-    stdout; raises RemoteError on nonzero exit (control.clj:138-157)."""
+    stdout; raises RemoteError on nonzero exit (control.clj:138-157).
+    Annotated ``# blocking: rpc``: the lock-order rule flags any call
+    that reaches this while holding a lock — a remote exec (bounded,
+    but up to the transport timeout) must never run under one."""
     c = _current()
     cmd = join_cmd(args)
     ctx = {"dir": c["dir"], "sudo": c["sudo"], "stdin": stdin}
@@ -121,7 +124,7 @@ def exec_(*args, stdin: str | None = None) -> str:
     return res.out.strip()
 
 
-def exec_star(*args, stdin: str | None = None) -> Result:
+def exec_star(*args, stdin: str | None = None) -> Result:  # blocking: rpc
     """Like exec_ but returns the full Result without raising."""
     c = _current()
     cmd = join_cmd(args)

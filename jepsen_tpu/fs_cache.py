@@ -56,6 +56,11 @@ def _atomic_write(dest: Path, write_fn) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             write_fn(f)
+            f.flush()
+            # fsync before the rename: os.replace without it can publish
+            # a torn/empty cache entry after a power cut, and a corrupt
+            # cache entry silently feeds every later run
+            os.fsync(f.fileno())
         os.replace(tmp, dest)
     except BaseException:
         try:

@@ -189,6 +189,9 @@ class NemesisWorker(Worker):
     # entry stays on the books for the crash-path / cli-heal replay.
     zombied: threading.Event | None = None
 
+    # the fault row must be on disk before the injection fires; the
+    # durability-protocol lint rule holds this method to that order
+    # durability: record-before-act
     def invoke(self, test, op):  # owner: worker
         reg = telemetry.get_registry()
         if reg.enabled:

@@ -100,8 +100,11 @@ def load_live_status(run_dir) -> dict | None:
         return None
 
 
-class RunTracker:
-    """One tracked run: tailer + session + status/metric publication."""
+class RunTracker:  # durability: fsync
+    """One tracked run: tailer + session + status/metric publication.
+    Durable artifacts (the restart snapshot, live-status.json) go
+    through atomic tmp+fsync+rename writers only — the
+    ``durability-protocol`` lint rule holds this class to it."""
 
     def __init__(self, run_dir, accelerator: str = "auto"):
         self.run_dir = Path(run_dir)

@@ -373,6 +373,7 @@ class MembershipNemesis(Nemesis):
         except Exception:  # noqa: BLE001
             logger.exception("membership heal-mark failed")
 
+    # durability: record-before-act
     def invoke(self, test, op):  # owner: worker
         self._resolve(test)
         with self._lock:

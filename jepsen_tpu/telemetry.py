@@ -516,6 +516,12 @@ def _atomic_write(path: Path, content: str) -> None:
     try:
         with os.fdopen(fd, "w") as f:
             f.write(content)
+            f.flush()
+            # fsync before the rename: without it os.replace can publish
+            # the durable name with its data still in the page cache, so
+            # a power cut leaves a torn/empty snapshot — and analyze
+            # REUSES live-status.json written through this helper
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         try:

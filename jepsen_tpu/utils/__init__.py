@@ -161,6 +161,7 @@ def join_noisy(thread: threading.Thread, what: str,
     return True
 
 
+# thread-helper: sync-spawn(arg=0)
 def real_pmap(fn: Callable, coll: Sequence) -> list:
     """Maps fn over coll in one thread per element; re-raises the first
     non-interrupt exception raised by any element (util.clj:65-78, dom-top's
@@ -191,6 +192,7 @@ def real_pmap(fn: Callable, coll: Sequence) -> list:
     return results
 
 
+# thread-helper: sync-spawn(arg=0)
 def bounded_pmap(fn: Callable, coll: Iterable, bound: int | None = None) -> list:
     """Parallel map with a bounded worker pool (dom-top bounded-pmap)."""
     coll = list(coll)
@@ -205,6 +207,8 @@ class JepsenTimeout(Exception):
     pass
 
 
+# thread-helper: spawn(arg=2) — the child is abandoned at the deadline,
+# so its block can't wedge the caller; ownership still transfers
 def timeout(ms: float, dflt: Any, fn: Callable[[], Any]) -> Any:
     """Runs fn in a thread; if it doesn't complete within ms, returns dflt
     (util.clj:370-381). The straggler thread is abandoned (daemon).

@@ -1,0 +1,902 @@
+"""Interprocedural lint tier: thread-spawn edges, lock-order, cond-wait,
+durability-protocol, telemetry-name, and the AST-cache rewrite fix.
+
+PR-5 style: every new rule/diagnostic gets a deliberately broken
+fixture (true positive) AND its corrected twin (must stay silent).
+The thread-edge tests additionally run the same fixture against a
+spawn-edge-stripped graph — the PR-5 "thread targets are not edges"
+semantics — proving each finding is *previously invisible*: it is
+reachable only through a thread-spawn edge.
+"""
+from __future__ import annotations
+
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.analysis import lint as lint_mod
+from jepsen_tpu.analysis.lint import astcache, callgraph
+from jepsen_tpu.analysis.lint import rules_concurrency as rc
+
+pytestmark = pytest.mark.lint
+
+
+def _lint_source(tmp_path, source, rules=None, name="fx.py"):
+    d = tmp_path / "fixture_pkg"
+    d.mkdir(exist_ok=True)
+    (d / name).write_text(textwrap.dedent(source), encoding="utf-8")
+    rep = lint_mod.lint_paths([str(d)], baseline=False, rules=rules)
+    return rep.findings
+
+
+def _graphs(tmp_path, source, name="fx.py"):
+    """(new graph, spawn-edge-stripped old-semantics graph)."""
+    d = tmp_path / "fixture_pkg"
+    d.mkdir(exist_ok=True)
+    f = d / name
+    f.write_text(textwrap.dedent(source), encoding="utf-8")
+    mod = astcache.parse_module(f, root=str(tmp_path))
+    g = callgraph.build([mod], root=str(tmp_path))
+    stripped = callgraph.CallGraph(
+        edges={n: [(c, ln, k) for c, ln, k in es if k == callgraph.CALL]
+               for n, es in g.edges.items()},
+        functions=g.functions, modules=g.modules, spawn_targets={},
+        root=g.root)
+    return g, stripped
+
+
+# ---------------------------------------------------------------------------
+# Thread-spawn edges: the PR-5 known limit, closed
+# ---------------------------------------------------------------------------
+
+THREAD_ESCAPE = """
+    import threading
+
+    def mutate_schedule():  # owner: scheduler
+        pass
+
+    def step():
+        mutate_schedule()
+
+    def worker_loop():
+        step()
+
+    def launch():  # owner: scheduler
+        t = threading.Thread(target=worker_loop, daemon=True)
+        t.start()
+"""
+
+
+class TestThreadEdges:
+    def test_thread_target_owner_escape_fires(self, tmp_path):
+        """The PR-4 incident shape: an UNANNOTATED Thread target reaches
+        a scheduler-only mutator. Only the spawn edge's owner transition
+        makes worker_loop a worker root at all."""
+        finds = _lint_source(tmp_path, THREAD_ESCAPE,
+                             rules=["thread-owner"])
+        assert [f.rule for f in finds] == ["thread-owner"]
+        assert "worker_loop" in finds[0].message
+
+    def test_previously_invisible_without_spawn_edges(self, tmp_path):
+        """The same fixture against the old single-thread graph
+        (spawn edges stripped, no spawn targets): silent. This is the
+        documented PR-5 blind spot the rework closes."""
+        g, stripped = _graphs(tmp_path, THREAD_ESCAPE)
+        assert callgraph.SPAWN in {k for _n, es in g.edges.items()
+                                   for _c, _ln, k in es}
+        assert rc.thread_owner(g) != []
+        assert rc.thread_owner(stripped) == []
+
+    def test_corrected_twin_silent(self, tmp_path):
+        good = THREAD_ESCAPE.replace("# owner: scheduler\n        pass",
+                                     "# owner: any\n        pass", 1)
+        assert _lint_source(tmp_path, good, rules=["thread-owner"]) == []
+
+    def test_timer_and_submit_targets_resolve(self, tmp_path):
+        src = """
+            import threading
+
+            def tick():
+                touch()
+
+            def touch():  # owner: scheduler
+                pass
+
+            def arm():  # owner: scheduler
+                threading.Timer(5.0, tick).start()
+
+            def offload(pool):  # owner: scheduler
+                pool.submit(tick)
+        """
+        finds = _lint_source(tmp_path, src, rules=["thread-owner"])
+        assert len(finds) == 1 and finds[0].rule == "thread-owner"
+        assert "tick" in finds[0].message
+
+    def test_sync_spawn_helper_blocks_scheduler(self, tmp_path):
+        """A # thread-helper: sync-spawn(arg=0) helper (utils.real_pmap's
+        shape): the caller WAITS, so an unbounded block in the spawned
+        fn is the scheduler's block — visible only through the edge."""
+        src = """
+            import threading
+
+            def pmap(fn, coll):  # thread-helper: sync-spawn(arg=0)
+                ts = [threading.Thread(target=fn, args=(x,))
+                      for x in coll]
+                for t in ts:
+                    t.start()
+
+            def drain(q):
+                q.put_nowait(None)
+                return q.get()
+
+            def teardown(queues):  # owner: scheduler
+                pmap(drain, queues)
+        """
+        finds = _lint_source(tmp_path, src, rules=["no-unbounded-block"])
+        assert [f.rule for f in finds] == ["no-unbounded-block"]
+        assert "teardown" in finds[0].message
+        good = src.replace("q.get()", "q.get(timeout=5.0)")
+        assert _lint_source(tmp_path, good,
+                            rules=["no-unbounded-block"]) == []
+
+    def test_detached_spawn_not_a_scheduler_block(self, tmp_path):
+        """A worker parked on its own queue (the interpreter's in_q
+        pattern) must NOT flag: detached spawn edges are not traversed
+        by no-unbounded-block."""
+        src = """
+            import threading
+
+            def loop(q):
+                q.put_nowait(None)
+                while True:
+                    q.get()
+
+            def launch(q):  # owner: scheduler
+                threading.Thread(target=loop, args=(q,), daemon=True).start()
+        """
+        assert _lint_source(tmp_path, src,
+                            rules=["no-unbounded-block"]) == []
+
+    def test_lock_guard_sees_through_spawn_reference(self, tmp_path):
+        """A helper provably called only under the lock used to inherit
+        the guard — but a Thread(target=self._wipe) reference runs it
+        on a fresh thread with NO lock. The thread-edge closure defeats
+        the exemption."""
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def add(self, x):
+                    with self._lock:
+                        self.items.append(x)
+
+                def reset(self):
+                    with self._lock:
+                        self._wipe()
+
+                def _wipe(self):
+                    self.items.clear()
+
+                def reset_bg(self):
+                    threading.Thread(target=self._wipe).start()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-guard"])
+        assert [f.rule for f in finds] == ["lock-guard"]
+        assert "_wipe" in finds[0].qualname
+        # corrected: spawn a locked wrapper instead of the bare helper
+        good = bad.replace("threading.Thread(target=self._wipe).start()",
+                           "threading.Thread(target=self.reset).start()")
+        assert _lint_source(tmp_path, good, rules=["lock-guard"]) == []
+
+    def test_differential_single_thread_graph_identical(self, tmp_path):
+        """On a module with NO thread idioms, the enlarged graph must be
+        finding-identical to the old call-only graph for every
+        pre-existing global rule."""
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+            def mutate():  # owner: scheduler
+                pass
+
+            def step():
+                mutate()
+
+            def worker_loop():  # owner: worker
+                step()
+
+            def pump(q):  # owner: scheduler
+                q.put_nowait(1)
+                return q.get()
+        """
+        g, stripped = _graphs(tmp_path, src)
+        assert g.spawn_targets == {}
+        for rule in (rc.thread_owner, rc.no_unbounded_block):
+            new = [f.render() for f in rule(g)]
+            old = [f.render() for f in rule(stripped)]
+            assert new == old and new  # identical AND non-empty
+
+
+    def test_via_sync_upgrade_not_order_dependent(self, tmp_path):
+        """Review pin: a node reached FIRST by a plain-call path (which
+        stops at worker-annotated leaves) and also via sync-spawn must
+        still be scanned — first-visit-wins dropped the finding
+        depending on statement order."""
+        src = """
+            import threading
+
+            def pmap(fn, coll):  # thread-helper: sync-spawn(arg=0)
+                ts = [threading.Thread(target=fn, args=(x,))
+                      for x in coll]
+                for t in ts:
+                    t.start()
+
+            def drain(q):  # owner: worker
+                q.put_nowait(None)
+                return q.get()
+
+            def teardown(queues):  # owner: scheduler
+                drain(queues[0])
+                pmap(drain, queues)
+        """
+        finds = _lint_source(tmp_path, src, rules=["no-unbounded-block"])
+        assert [f.rule for f in finds] == ["no-unbounded-block"]
+        # and with the statements swapped (sync-spawn seen first)
+        swapped = src.replace(
+            "drain(queues[0])\n                pmap(drain, queues)",
+            "pmap(drain, queues)\n                drain(queues[0])")
+        finds2 = _lint_source(tmp_path, swapped,
+                              rules=["no-unbounded-block"])
+        assert [f.rule for f in finds2] == ["no-unbounded-block"]
+
+
+# ---------------------------------------------------------------------------
+# lock-order (JTL005)
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_ab_ba_cycle(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert [f.rule for f in finds] == ["lock-order"]
+        assert "cycle" in finds[0].message
+        good = bad.replace(
+            "with self._b:\n                        with self._a:",
+            "with self._a:\n                        with self._b:")
+        assert _lint_source(tmp_path, good, rules=["lock-order"]) == []
+
+    def test_interprocedural_self_deadlock(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def _bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def bump_twice(self):
+                    with self._lock:
+                        self._bump()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert [f.rule for f in finds] == ["lock-order"]
+        assert "re-acquire" in finds[0].message
+        assert finds[0].qualname == "Box.bump_twice"
+        good = bad.replace("threading.Lock()", "threading.RLock()")
+        assert _lint_source(tmp_path, good, rules=["lock-order"]) == []
+
+    def test_cross_function_cycle_through_calls(self, tmp_path):
+        """The interprocedural case: each function nests only via a
+        call, so only the transitive acquisition analysis sees it."""
+        bad = """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def take_b():
+                with _b:
+                    pass
+
+            def take_a():
+                with _a:
+                    pass
+
+            def ab():
+                with _a:
+                    take_b()
+
+            def ba():
+                with _b:
+                    take_a()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert len(finds) == 1 and "cycle" in finds[0].message
+
+    def test_blocking_annotation_under_lock(self, tmp_path):
+        bad = """
+            import threading
+
+            def fetch():  # blocking: rpc
+                pass
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        fetch()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert [f.rule for f in finds] == ["lock-order"]
+        assert "blocking" in finds[0].message
+        good = bad.replace(
+            "with self._lock:\n                        fetch()",
+            "fetch()\n                    with self._lock:\n"
+            "                        pass")
+        assert _lint_source(tmp_path, good, rules=["lock-order"]) == []
+
+    def test_unbounded_primitive_under_lock(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def pump(self, q):
+                    q.put_nowait(1)
+                    with self._lock:
+                        return q.get()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert [f.rule for f in finds] == ["lock-order"]
+        assert "while holding" in finds[0].message
+        good = bad.replace("q.get()", "q.get(timeout=1.0)")
+        assert _lint_source(tmp_path, good, rules=["lock-order"]) == []
+
+    def test_multi_item_with_orders_its_own_items(self, tmp_path):
+        """Review pin: `with self._a, self._b:` is sugar for nested
+        withs and must contribute the same a->b edge — the combined
+        form was a blind spot."""
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def fwd(self):
+                    with self._a, self._b:
+                        pass
+
+                def rev(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """
+        finds = _lint_source(tmp_path, bad, rules=["lock-order"])
+        assert len(finds) == 1 and "cycle" in finds[0].message
+        # and `with a, a:` on a plain Lock is a direct self-deadlock
+        dup = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+
+                def oops(self):
+                    with self._a, self._a:
+                        pass
+        """
+        finds = _lint_source(tmp_path, dup, rules=["lock-order"])
+        assert len(finds) == 1 and "self-deadlock" in finds[0].message
+
+    def test_cycle_respects_inline_waiver(self, tmp_path):
+        """Review pin: `# lint: ignore[lock-order]` on an acquisition
+        site must suppress the cycles that edge participates in, like
+        every other diagnostic of the rule."""
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def ba(self):
+                    with self._b:
+                        with self._a:  # lint: ignore[lock-order]
+                            pass
+        """
+        assert _lint_source(tmp_path, src, rules=["lock-order"]) == []
+
+    def test_condition_wait_releases_its_lock(self, tmp_path):
+        """The reconnect.py _RWLock shape: cv.wait() under `with cv`
+        RELEASES the lock — textbook, must stay silent (regression pin
+        for the false positive the first lock-order draft produced)."""
+        src = """
+            import threading
+
+            class RW:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._writer = False
+
+                def acquire_read(self):
+                    with self._cond:
+                        while self._writer:
+                            self._cond.wait()
+        """
+        assert _lint_source(tmp_path, src, rules=["lock-order"]) == []
+
+
+# ---------------------------------------------------------------------------
+# cond-wait (JTL006)
+# ---------------------------------------------------------------------------
+
+class TestCondWait:
+    def test_naked_wait_not_in_while(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):
+                    with self._cond:
+                        if not self.ready:
+                            self._cond.wait(1.0)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["cond-wait"])
+        assert [f.rule for f in finds] == ["cond-wait"]
+        assert "while" in finds[0].message
+        good = bad.replace("if not self.ready:", "while not self.ready:")
+        assert _lint_source(tmp_path, good, rules=["cond-wait"]) == []
+
+    def test_wait_outside_lock(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):
+                    while not self.ready:
+                        self._cond.wait(1.0)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["cond-wait"])
+        assert [f.rule for f in finds] == ["cond-wait"]
+        assert "outside" in finds[0].message
+
+    def test_notify_outside_lock(self, tmp_path):
+        bad = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def wake(self):
+                    self.ready = True
+                    self._cond.notify_all()
+        """
+        finds = _lint_source(tmp_path, bad, rules=["cond-wait"])
+        assert [f.rule for f in finds] == ["cond-wait"]
+        good = bad.replace(
+            "self.ready = True\n                    "
+            "self._cond.notify_all()",
+            "with self._cond:\n                        "
+            "self.ready = True\n                        "
+            "self._cond.notify_all()")
+        assert _lint_source(tmp_path, good, rules=["cond-wait"]) == []
+
+    def test_timeoutless_wait_escalates_on_scheduler_path(self, tmp_path):
+        sched = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def block(self):  # owner: scheduler
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+        """
+        finds = _lint_source(tmp_path, sched, rules=["cond-wait"])
+        assert [f.rule for f in finds] == ["cond-wait"]
+        assert "scheduler" in finds[0].message
+        # same discipline off the scheduler path: no escalation
+        off = sched.replace("  # owner: scheduler", "")
+        assert _lint_source(tmp_path, off, rules=["cond-wait"]) == []
+        # bounded wait on the scheduler path: fine
+        bounded = sched.replace("self._cond.wait()",
+                                "self._cond.wait(1.0)")
+        assert _lint_source(tmp_path, bounded, rules=["cond-wait"]) == []
+
+    def test_condition_with_explicit_lock_identity(self, tmp_path):
+        """Condition(self._lock): waiting under `with self._lock` IS
+        under the condition's lock."""
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self.ready = False
+
+                def block(self):
+                    with self._lock:
+                        while not self.ready:
+                            self._cond.wait(0.5)
+        """
+        assert _lint_source(tmp_path, src, rules=["cond-wait"]) == []
+
+
+# ---------------------------------------------------------------------------
+# durability-protocol (JTD001)
+# ---------------------------------------------------------------------------
+
+class TestDurabilityProtocol:
+    def test_missing_fsync_before_rename(self, tmp_path):
+        bad = """
+            import os
+
+            def publish(path, tmp, doc):
+                with open(tmp, "w") as f:
+                    f.write(doc)
+                    f.flush()
+                os.replace(tmp, path)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert [f.rule for f in finds] == ["durability-protocol"]
+        assert "fsync" in finds[0].message
+        good = bad.replace(
+            "f.flush()",
+            "f.flush()\n                    os.fsync(f.fileno())")
+        assert _lint_source(tmp_path, good,
+                            rules=["durability-protocol"]) == []
+
+    def test_fsync_of_earlier_publish_does_not_vouch(self, tmp_path):
+        """Review pin: a function that atomically publishes file A and
+        then renames an unfsynced file B must still flag B — any-fsync-
+        before-any-rename let A's fsync vouch for B."""
+        bad = """
+            import os
+
+            def publish_two(a_tmp, a, b_tmp, b, doc):
+                with open(a_tmp, "w") as f:
+                    f.write(doc)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(a_tmp, a)
+                with open(b_tmp, "w") as g:
+                    g.write(doc)
+                    g.flush()
+                os.replace(b_tmp, b)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert len(finds) == 1 and finds[0].line > 10
+        good = bad.replace(
+            "g.flush()",
+            "g.flush()\n                    os.fsync(g.fileno())")
+        assert _lint_source(tmp_path, good,
+                            rules=["durability-protocol"]) == []
+
+    def test_rename_elsewhere_does_not_exempt_overwrite(self, tmp_path):
+        """Review pin: an atomic publish of one artifact must not exempt
+        a direct in-place overwrite of a SECOND durable artifact in the
+        same method (the per-method has_rename shortcut did)."""
+        bad = """
+            import os
+
+            class Reg:  # durability: fsync
+                def __init__(self, path, ckpt):
+                    self.path = path
+                    self.ckpt = ckpt
+
+                def publish(self, tmp, doc):
+                    with open(tmp, "w") as f:
+                        f.write(doc)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, self.path)
+                    with open(self.ckpt, "w") as g:
+                        g.write(doc)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert len(finds) == 1 and "overwrites" in finds[0].message
+        # open(self.<tmp attr>) FOLLOWED by a rename stays exempt
+        good = """
+            import os
+
+            class Reg:  # durability: fsync
+                def __init__(self, path, tmp):
+                    self.path = path
+                    self.tmp = tmp
+
+                def publish(self, doc):
+                    with open(self.tmp, "w") as f:
+                        f.write(doc)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(self.tmp, self.path)
+        """
+        assert _lint_source(tmp_path, good,
+                            rules=["durability-protocol"]) == []
+
+    def test_pure_rename_not_flagged(self, tmp_path):
+        src = """
+            import os
+
+            def rotate(a, b):
+                os.replace(a, b)
+        """
+        assert _lint_source(tmp_path, src,
+                            rules=["durability-protocol"]) == []
+
+    def test_durable_overwrite_in_annotated_class(self, tmp_path):
+        bad = """
+            class Registry:  # durability: fsync
+                def __init__(self, path):
+                    self.path = path
+
+                def rewrite(self, doc):
+                    with open(self.path, "w") as f:
+                        f.write(doc)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert [f.rule for f in finds] == ["durability-protocol"]
+        assert "overwrites" in finds[0].message
+        # corrected twin: append-only (the WAL protocol)
+        good = bad.replace('open(self.path, "w")', 'open(self.path, "a")')
+        assert _lint_source(tmp_path, good,
+                            rules=["durability-protocol"]) == []
+
+    def test_init_fresh_file_exempt(self, tmp_path):
+        src = """
+            class Wal:  # durability: fsync
+                def __init__(self, path):
+                    self.path = path
+                    self._f = open(self.path, "w")
+        """
+        assert _lint_source(tmp_path, src,
+                            rules=["durability-protocol"]) == []
+
+    def test_record_after_act(self, tmp_path):
+        bad = """
+            class Nem:
+                # durability: record-before-act
+                def invoke(self, registry, nemesis, op):
+                    res = nemesis.invoke(op)
+                    registry.record("net", op)
+                    return res
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert [f.rule for f in finds] == ["durability-protocol"]
+        assert "record" in finds[0].message
+        good = bad.replace(
+            'res = nemesis.invoke(op)\n                    '
+            'registry.record("net", op)',
+            'registry.record("net", op)\n                    '
+            'res = nemesis.invoke(op)')
+        assert _lint_source(tmp_path, good,
+                            rules=["durability-protocol"]) == []
+
+    def test_late_re_record_allowed(self, tmp_path):
+        """NemesisWorker.invoke's shape: a record precedes the act, and
+        a deliberate LATE re-record follows it — allowed (there exists
+        an earlier record)."""
+        src = """
+            class Nem:
+                # durability: record-before-act
+                def invoke(self, registry, nemesis, op, reaped):
+                    registry.record("net", op)
+                    res = nemesis.invoke(op)
+                    if reaped:
+                        registry.record("net", op)
+                    return res
+        """
+        assert _lint_source(tmp_path, src,
+                            rules=["durability-protocol"]) == []
+
+    def test_act_without_any_record(self, tmp_path):
+        bad = """
+            class Nem:
+                # durability: record-before-act
+                def invoke(self, nemesis, op):
+                    return nemesis.invoke(op)
+        """
+        finds = _lint_source(tmp_path, bad, rules=["durability-protocol"])
+        assert len(finds) == 1 and "no durable record" in finds[0].message
+
+
+# ---------------------------------------------------------------------------
+# telemetry-name (JTM001)
+# ---------------------------------------------------------------------------
+
+class TestTelemetryName:
+    def test_suffix_and_case_conventions(self, tmp_path):
+        bad = """
+            def setup(reg):
+                reg.counter("opsDone")
+                reg.counter("ops_count")
+                reg.histogram("op_latency")
+        """
+        finds = _lint_source(tmp_path, bad, rules=["telemetry-name"])
+        msgs = "\n".join(f.message for f in finds)
+        assert len(finds) == 3
+        assert "snake_case" in msgs and "_total" in msgs \
+            and "unit suffix" in msgs
+        good = """
+            def setup(reg):
+                reg.counter("ops_done_total")
+                reg.counter("ops_total")
+                reg.histogram("op_latency_seconds")
+                reg.gauge("queue_depth")
+        """
+        assert _lint_source(tmp_path, good, rules=["telemetry-name"]) == []
+
+    def test_kind_conflict(self, tmp_path):
+        bad = """
+            def a(reg):
+                reg.counter("x_total")
+
+            def b(reg):
+                reg.gauge("x_total")
+        """
+        finds = _lint_source(tmp_path, bad, rules=["telemetry-name"])
+        assert len(finds) == 1 and "counter and gauge" in finds[0].message
+
+    def test_label_conflict(self, tmp_path):
+        bad = """
+            def a(reg):
+                reg.counter("y_total", "h", labels=("f",))
+
+            def b(reg):
+                reg.counter("y_total", "h", labels=("g",))
+        """
+        finds = _lint_source(tmp_path, bad, rules=["telemetry-name"])
+        assert len(finds) == 1 and "label sets" in finds[0].message
+
+    def test_doc_drift(self, tmp_path):
+        d = tmp_path / "pkg"
+        d.mkdir()
+        (d / "m.py").write_text(textwrap.dedent("""
+            def setup(reg):
+                reg.counter("real_total", labels=("f",))
+        """), encoding="utf-8")
+        doc = tmp_path / "doc"
+        doc.mkdir()
+        (doc / "observability.md").write_text(
+            "counts `real_total{f}` and the renamed-away "
+            "`gone_total` plus knob `live_poll_s`.\n",
+            encoding="utf-8")
+        rep = lint_mod.lint_paths([str(d)], baseline=False,
+                                  root=str(tmp_path),
+                                  rules=["telemetry-name"])
+        assert [f.qualname for f in rep.findings] == ["<doc>"]
+        assert "gone_total" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# astcache: same-mtime same-size rewrite invalidation
+# ---------------------------------------------------------------------------
+
+class TestAstCacheRewrite:
+    def test_same_tick_same_size_rewrite_invalidates(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def aa(): pass\n", encoding="utf-8")
+        m1 = astcache.parse_module(p)
+        assert "aa" in m1.functions
+        st = p.stat()
+        p.write_text("def bb(): pass\n", encoding="utf-8")  # same size
+        # force the SAME mtime: a coarse-timestamp filesystem (or a
+        # fast test harness) rewriting inside one tick
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+        st2 = p.stat()
+        assert (st2.st_mtime_ns, st2.st_size) \
+            == (st.st_mtime_ns, st.st_size)
+        m2 = astcache.parse_module(p)
+        assert "bb" in m2.functions and "aa" not in m2.functions
+
+    def test_unchanged_file_hits_cache(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def aa(): pass\n", encoding="utf-8")
+        m1 = astcache.parse_module(p)
+        assert astcache.parse_module(p) is m1
+
+
+# ---------------------------------------------------------------------------
+# Regression pins for the true positives the new analysis surfaced
+# ---------------------------------------------------------------------------
+
+class TestDurabilityFixes:
+    """durability-protocol flagged two real write+rename publishers with
+    no fsync — a power cut could publish a torn/empty artifact under a
+    durable name (live-status.json is REUSED by analyze; a corrupt
+    fs_cache entry feeds every later run). Pinned here; the lint gate
+    keeps them fixed."""
+
+    def _trace(self, monkeypatch):
+        events = []
+        real_fsync, real_replace = os.fsync, os.replace
+        monkeypatch.setattr(
+            os, "fsync",
+            lambda fd: (events.append("fsync"), real_fsync(fd))[1])
+        monkeypatch.setattr(
+            os, "replace",
+            lambda a, b: (events.append("replace"),
+                          real_replace(a, b))[1])
+        return events
+
+    def test_telemetry_atomic_write_fsyncs_before_rename(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu import telemetry
+        events = self._trace(monkeypatch)
+        telemetry._atomic_write(tmp_path / "metrics.json", "{}\n")
+        assert "fsync" in events
+        assert events.index("fsync") < events.index("replace")
+        assert (tmp_path / "metrics.json").read_text() == "{}\n"
+
+    def test_fs_cache_atomic_write_fsyncs_before_rename(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu import fs_cache
+        monkeypatch.setattr(fs_cache, "cache_root",
+                            lambda: tmp_path / "cache", raising=False)
+        events = self._trace(monkeypatch)
+        fs_cache._atomic_write(tmp_path / "entry",
+                               lambda f: f.write(b"payload"))
+        assert "fsync" in events
+        assert events.index("fsync") < events.index("replace")
+        assert (tmp_path / "entry").read_bytes() == b"payload"
