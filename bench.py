@@ -40,8 +40,12 @@ Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
 stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
 elle_50k, ir_amortization, online_lag, matrix_kernel, explain,
-multichip, ckpt, headline, scale, telemetry — the last opts out of the
-per-stage telemetry block in bench_summary). ``ckpt`` measures the
+multichip, ckpt, trace, headline, scale, telemetry — the last opts out
+of the per-stage telemetry block in bench_summary). ``trace`` measures
+the causal-trace cost (trace_overhead_frac: fully-traced vs untraced
+interpreter wall, bar <= 5%, with the always-on flight-recorder
+configuration <= 1% — doc/observability.md "Causal trace").
+``ckpt`` measures the
 resumable-check cost/benefit (ckpt_overhead_frac bar <= 5%, plus
 resume_savings_frac at a 50% cut — doc/robustness.md "Resumable checks
 and the elastic mesh"). ``ir_amortization``
@@ -1373,6 +1377,108 @@ def cfg_ckpt():
          path="matrix-segmented")
 
 
+def cfg_trace():
+    """trace_overhead_frac: the causal trace's cost on the hot path
+    (doc/observability.md "Causal trace") — the REAL generator
+    interpreter (threads, queues, deadlines) over the standard register
+    workload, measured three ways:
+
+    * untraced — NULL tracer (the default run minus the flight
+      recorder): the anchor;
+    * flight-recorder only — the always-on default configuration; bar
+      <= 1% over the anchor;
+    * causal trace — streaming Perfetto trace.json sink + flight
+      recorder (the run-wide span stream this subsystem adds); bar
+      <= 5%.
+
+    The pre-existing per-client span log (tracing.py's trace.jsonl +
+    TracedClient, which ``--trace`` also turns on) is measured
+    separately as ``client_span_overhead_frac`` — it predates the
+    causal trace and its cost must not hide inside (or be blamed on)
+    the new stream's number.
+
+    Best-of-N trials on both sides: the interpreter's wall is
+    thread-scheduling noisy, and the overhead question is about the
+    added per-op work, which the best runs isolate."""
+    import tempfile
+    from pathlib import Path
+
+    import jepsen_tpu.generator as gen
+    from jepsen_tpu import trace as trace_mod
+    from jepsen_tpu import tracing
+    from jepsen_tpu.fakes import AtomClient, AtomDB, noop_test
+    from jepsen_tpu.generator import interpreter
+
+    n = int(os.environ.get("BENCH_TRACE_OPS", "4000"))
+    trials = 5
+
+    def build(wrap=None):
+        db = AtomDB()
+        client = AtomClient(db)
+        if wrap is not None:
+            client = wrap(client)
+        return noop_test(
+            name="bench-trace", db=db, client=client, concurrency=5,
+            checker=None,
+            generator=gen.clients(gen.limit(n, gen.mix([
+                gen.repeat({"f": "read"}),
+                lambda test, ctx: {"f": "write",
+                                   "value": ctx.rng.randrange(5)},
+            ]))))
+
+    def measure(make_tracer, wrap=None) -> float:
+        best = float("inf")
+        for _ in range(trials):
+            test = build(wrap)
+            tracer = make_tracer()
+            with trace_mod.use(tracer):
+                t0 = time.perf_counter()
+                history = interpreter.run(test)
+                dt = time.perf_counter() - t0
+            tracer.close()
+            n_inv = sum(1 for op in history if op["type"] == "invoke")
+            assert n_inv == n, n_inv
+            best = min(best, dt)
+        return best
+
+    with tempfile.TemporaryDirectory() as tmp:
+        t_plain = measure(lambda: trace_mod.NULL_TRACER)
+        t_flight = measure(lambda: trace_mod.RunTracer(
+            flight=trace_mod.FlightRecorder(
+                trace_mod.DEFAULT_FLIGHT_EVENTS)))
+        runs = [0]
+
+        def traced_tracer():
+            runs[0] += 1
+            return trace_mod.RunTracer(
+                perfetto=trace_mod.PerfettoSink(
+                    Path(tmp) / f"trace-{runs[0]}.json"),
+                flight=trace_mod.FlightRecorder(
+                    trace_mod.DEFAULT_FLIGHT_EVENTS))
+
+        t_traced = measure(traced_tracer)
+
+        legacy = tracing.Tracer(str(Path(tmp) / "trace.jsonl"))
+        t_client = measure(lambda: trace_mod.NULL_TRACER,
+                           wrap=lambda c: tracing.TracedClient(c, legacy))
+        legacy.close()
+
+    overhead = max(0.0, t_traced / max(t_plain, 1e-9) - 1.0)
+    flight_overhead = max(0.0, t_flight / max(t_plain, 1e-9) - 1.0)
+    client_overhead = max(0.0, t_client / max(t_plain, 1e-9) - 1.0)
+    emit("trace_overhead_frac", overhead, "frac",
+         0.05 / max(overhead, 1e-6),
+         flight_overhead_frac=round(flight_overhead, 4),
+         client_span_overhead_frac=round(client_overhead, 4),
+         untraced_wall_s=round(t_plain, 4),
+         flight_wall_s=round(t_flight, 4),
+         traced_wall_s=round(t_traced, 4),
+         client_span_wall_s=round(t_client, 4),
+         ops=n, trials=trials,
+         untraced_ops_per_sec=round(n / t_plain, 1),
+         traced_ops_per_sec=round(n / t_traced, 1))
+
+
 def cfg_lint():
     """lint_wall_s: full-tree static-analysis wall clock — the cost of
     the tier-1 self-lint gate (tests/test_lint_clean.py) with every
@@ -1501,6 +1607,7 @@ def main() -> None:
     guard("explain", cfg_explain)
     guard("multichip", cfg_multichip_scaling)
     guard("ckpt", cfg_ckpt)
+    guard("trace", cfg_trace)
     guard("lint", cfg_lint)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))

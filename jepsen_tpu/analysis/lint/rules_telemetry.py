@@ -1,4 +1,4 @@
-"""telemetry-name rule (JTM001): metric-name hygiene + doc drift.
+"""telemetry-name rule (JTM001): metric/trace-name hygiene + doc drift.
 
 Every ``Counter``/``Gauge``/``Histogram``/timer registration with a
 literal name is collected package-wide and checked:
@@ -19,6 +19,17 @@ literal name is collected package-wide and checked:
   ``*_total`` names) must exist in code: a silent rename strands the
   operators' dashboards. (Skipped when the doc isn't under the lint
   root — fixture trees.)
+
+Causal-trace emissions (``jepsen_tpu.trace``,
+doc/observability.md "Causal trace") are held to the same hygiene:
+every ``span``/``begin``/``instant``/``complete``/``window_begin``/
+``window_end`` call with literal track/name arguments must use
+**kebab-case** for both — track and span names are the trace's metric
+names (Perfetto queries, the web summary, and the offline
+differential all key on them), so "Worker_0" vs "worker-0" drift is
+exactly the dashboard-stranding class the metric checks exist for.
+Dynamic names (f-strings — the per-worker tracks) are not literals
+and are skipped.
 """
 from __future__ import annotations
 
@@ -38,6 +49,14 @@ _KINDS = {"counter": "counter", "gauge": "gauge",
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
 _COUNTER_SUFFIX = ("_total",)
 _HIST_SUFFIXES = ("_seconds", "_ops", "_bytes", "_steps")
+
+# trace-emission methods whose first two args are (track, name) —
+# both must be kebab-case when literal. `.end(track)` is excluded:
+# it carries no span name, and every literal track a .end names is
+# opened by one of these.
+_TRACE_METHODS = ("span", "begin", "instant", "complete",
+                  "window_begin", "window_end")
+_KEBAB = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
 
 DOC_NAME = Path("doc") / "observability.md"
 # `name{labels}` citations are unambiguous; bare names are only
@@ -151,7 +170,47 @@ def telemetry_name(graph: CallGraph) -> list[Finding]:
                     "label names are part of the series identity; "
                     "unify them")
 
+    out.extend(_trace_names(graph))
     out.extend(_doc_drift(graph, set(by_name)))
+    return out
+
+
+def _trace_names(graph: CallGraph) -> list[Finding]:
+    """Kebab-case check over literal trace track/span names: a
+    ``tracer.span("Bad_Track", "Do Stuff")`` drifts the track
+    vocabulary every trace consumer keys on."""
+    out: list[Finding] = []
+    for rel, mod in graph.modules.items():
+        for n in ast.walk(mod.tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _TRACE_METHODS
+                    and len(n.args) >= 2):
+                continue
+            lits = []
+            for role, arg in (("track", n.args[0]), ("span", n.args[1])):
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    lits.append((role, arg.value))
+            if not lits:
+                continue  # dynamic (f-string worker tracks): skipped
+            if RULE in mod.line_ignores(n.lineno):
+                continue
+            qual = _enclosing_qualname(mod, n.lineno)
+            fi = mod.functions.get(qual)
+            if fi is not None and RULE in fi.ignores:
+                continue
+            for role, value in lits:
+                if _KEBAB.match(value):
+                    continue
+                out.append(Finding(
+                    rule=RULE, code=CODE, path=mod.relpath,
+                    line=n.lineno, col=n.col_offset + 1, qualname=qual,
+                    message=(f"trace {role} name {value!r} is not "
+                             "kebab-case — track/span names are the "
+                             "trace's query keys"),
+                    hint="lowercase letters, digits, dashes only "
+                         "(doc/observability.md \"Causal trace\")"))
     return out
 
 

@@ -392,6 +392,11 @@ _NUMERIC_KNOBS = (
     # range), and the mesh shrink ladder's floor width
     ("check_ckpt_interval", True, None),
     ("mesh_min_devices", True, 0.0),
+    # causal trace (doc/observability.md "Causal trace"): the flight
+    # recorder's ring capacity — trace.flight_recorder_events coerces
+    # tolerantly at runtime (garbage warns + default), preflight is
+    # where it becomes an error. 0 disables the recorder.
+    ("flight_recorder_events", True, 0.0),
 )
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
@@ -401,7 +406,8 @@ _NUMERIC_KNOBS = (
 # (doc/performance.md "History IR"), and the fused-combine toggle
 # (doc/performance.md "Packed boolean kernels")
 _BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
-               "ir_stream_from_wal", "combine_fused", "resume_check")
+               "ir_stream_from_wal", "combine_fused", "resume_check",
+               "trace")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 # enum knobs, tolerantly coerced at runtime (pallas_matrix
@@ -432,6 +438,9 @@ _ENV_ENUM_KNOBS = (
     ("JEPSEN_TPU_RESUME_CHECK", _BOOL_STRINGS,
      "process-wide twin of resume_check (durable check.ckpt "
      "auto-resume, doc/robustness.md)"),
+    ("JEPSEN_TPU_TRACE", _BOOL_STRINGS,
+     "process-wide twin of the trace knob (run-wide causal trace to "
+     "trace.json, doc/observability.md)"),
 )
 
 # numeric env twins: a malformed value silently degrades the whole
@@ -443,6 +452,9 @@ _ENV_NUMERIC_KNOBS = (
     ("JEPSEN_TPU_MESH_MIN_DEVICES",
      "the elastic mesh shrink ladder's floor width (below it the "
      "checker demotes to single-device)"),
+    ("JEPSEN_TPU_FLIGHT_RECORDER_EVENTS",
+     "process-wide twin of flight_recorder_events (the crash/stall "
+     "flight recorder's ring capacity; 0 disables)"),
 )
 
 _UNSET = object()
@@ -518,6 +530,10 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
             "true (the default) resumes an interrupted check from its "
             "durable check.ckpt; false (analyze --no-resume-check) "
             "re-checks from zero")
+        hints["trace"] = (
+            "true streams the run-wide causal trace to trace.json "
+            "(Perfetto) plus the per-client span log; the flight "
+            "recorder stays on either way (flight_recorder_events)")
         out.append(Diagnostic(
             "KNB001", ERROR, key,
             f"{key} must be a bool, got {v!r}", hint=hints.get(key)))

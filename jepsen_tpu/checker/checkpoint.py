@@ -249,6 +249,11 @@ class CheckpointStore:  # durability: fsync (via utils.atomic_write_json)
             reg.gauge("checker_ckpt_staleness_ops",
                       "ops consumed since the last durable checker "
                       "checkpoint").set(0)
+        from jepsen_tpu import trace as trace_mod
+        trace_mod.get_tracer().instant(
+            trace_mod.TRACK_CHECKPOINT, "ckpt-write",
+            args={"kind": str(state.get("kind")),
+                  "events_done": events_done})
         return True
 
     # -- reading --------------------------------------------------------
@@ -278,6 +283,10 @@ def count_resume(source: str) -> None:
         reg.counter("checker_resume_total",
                     "checks resumed instead of restarted, by source",
                     labels=("source",)).inc(source=source)
+    from jepsen_tpu import trace as trace_mod
+    trace_mod.get_tracer().instant(trace_mod.TRACK_CHECKPOINT,
+                                   "ckpt-resume",
+                                   args={"source": source})
 
 
 def load_resume(store: CheckpointStore | None, kind: str, config: dict,

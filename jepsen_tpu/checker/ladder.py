@@ -207,6 +207,10 @@ class BackendLadder:
                         "ladder demotions, by backend and reason",
                         labels=("backend", "reason")
                         ).inc(backend=name, reason=reason)
+        from jepsen_tpu import trace as trace_mod
+        trace_mod.get_tracer().instant(
+            trace_mod.TRACK_LADDER, "demote",
+            args={"backend": name, "reason": reason})
         logger.info("checker backend %r demoted (%s)", name, reason)
 
     # -- dispatch -----------------------------------------------------------
@@ -276,8 +280,22 @@ class BackendLadder:
         failure in the ``terminal`` rung re-raises instead of demoting
         — there is nothing below it, and the caller's check_safe wants
         the real traceback (the pre-ladder semantics)."""
+        from jepsen_tpu import trace as trace_mod
+        tracer = trace_mod.get_tracer()
         reg = telemetry.get_registry()
         shrinks = 0
+        t0_us = 0
+
+        def rung_span(outcome: str) -> None:
+            # one self-contained slice per attempt (ph X, not B/E: a
+            # watchdog-abandoned zombie attempt may still be emitting
+            # when the next rung starts — X slices can't tear a pairing)
+            if tracer.enabled:
+                tracer.complete(trace_mod.TRACK_LADDER, "rung", t0_us,
+                                trace_mod.now_us() - t0_us,
+                                args={"backend": backend.name,
+                                      "outcome": outcome})
+
         while True:
             # carry generation: rungs that thread a resume carry through
             # ctx (the segmented matrix chain) capture this at entry and
@@ -285,9 +303,11 @@ class BackendLadder:
             # watchdog-abandoned zombie's late writes can't clobber the
             # resumed rung's own progress (doc/robustness.md)
             ctx["_gen"] = ctx.get("_gen", 0) + 1
+            t0_us = trace_mod.now_us() if tracer.enabled else 0
             try:
                 res = self._call(backend, ctx)
             except Unavailable:
+                rung_span("unavailable")
                 self._demote(backend.name, "unavailable")
                 return None
             except Exception as e:  # noqa: BLE001
@@ -306,6 +326,7 @@ class BackendLadder:
                         ctx.pop("_shrink_error", None)
                     if can_shrink:
                         shrinks += 1
+                        rung_span("shrink-retry")
                         if reg.enabled:
                             reg.counter(
                                 "checker_backend_shrink_retries_total",
@@ -318,6 +339,7 @@ class BackendLadder:
                             type(e).__name__, shrinks,
                             backend.max_shrinks)
                         continue
+                rung_span("error")
                 if terminal:
                     raise
                 self._count_failure(backend.name)
@@ -328,6 +350,7 @@ class BackendLadder:
                                backend.name, e)
                 return None
             if res is _TIMED_OUT:
+                rung_span("watchdog-timeout")
                 if reg.enabled:
                     reg.counter(
                         "checker_watchdog_timeouts_total",
@@ -337,6 +360,8 @@ class BackendLadder:
                 self._demote(backend.name, "watchdog-timeout")
                 return None
             if res is None:
+                rung_span("declined")
                 self._demote(backend.name, "declined")
                 return None
+            rung_span("settled")
             return res

@@ -686,6 +686,7 @@ class LinearizableChecker(Checker):
             lo = max(0, i - 5)
             out["failed-op"] = history[i] if i < len(history) else None
             out["context"] = history[lo : i + 1][-10:]
+            self._trace_anomaly(history, i, res)
             # device verdicts carry no frontier detail: one exact CPU pass
             # recovers the dying configurations for the report (the
             # knossos :configs surface). Gated by length — the history was
@@ -710,6 +711,43 @@ class LinearizableChecker(Checker):
                           init_state, step_ids, explain_on, explain_loc,
                           opts)
         return out
+
+    def _trace_anomaly(self, history, op_index: int, res) -> None:
+        """The causal-trace half of an INVALID verdict: an ``explain``
+        instant on the checker track carrying the first-anomaly op's
+        stable trace id — the same id the interpreter's dispatch slice
+        carries in its args, so the anomaly links straight back to its
+        original dispatch. ``op_index`` may name either half of the op
+        (the matrix localizer reports the fatal return); the id is
+        always minted from the *invocation*'s time, which is what
+        dispatch used. Never fails a check (doc/observability.md
+        "Causal trace")."""
+        try:
+            from jepsen_tpu import trace as trace_mod
+            tracer = trace_mod.get_tracer()
+            if not tracer.enabled or not (0 <= op_index < len(history)):
+                return
+            op = history[op_index]
+            inv = op
+            if op.get("type") != "invoke":
+                # walk back to this process's invocation — the most
+                # recent earlier invoke by the same process
+                for j in range(op_index - 1, -1, -1):
+                    cand = history[j]
+                    if cand.get("process") == op.get("process") \
+                            and cand.get("type") == "invoke":
+                        inv = cand
+                        break
+            tr_id = trace_mod.trace_id_for(inv.get("process"),
+                                           inv.get("time"))
+            tracer.instant(trace_mod.TRACK_CHECKER, "explain",
+                           args={"op_index": op_index,
+                                 "f": str(op.get("f")),
+                                 "process": op.get("process"),
+                                 "algorithm": res.algorithm,
+                                 "trace_id": tr_id})
+        except Exception:  # noqa: BLE001 — tracing never masks a verdict
+            logger.exception("anomaly trace emission failed")
 
     def _explain(self, out, res, history, test, stream, step_py,
                  init_state, step_ids, explain_on, explain_loc,

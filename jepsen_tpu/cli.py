@@ -60,7 +60,15 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--store-dir", default="store")
     # unified telemetry (doc/observability.md): spans, metrics, profiles
     p.add_argument("--trace", action="store_true",
-                   help="span-log client ops to the run's trace.jsonl")
+                   help="causal trace: stream a Perfetto trace.json of "
+                        "the whole run (workers, nemesis, checker "
+                        "ladder, checkpoints) plus the per-client span "
+                        "log trace.jsonl")
+    p.add_argument("--flight-recorder-events", type=int, default=None,
+                   dest="flight_recorder_events",
+                   help="flight-recorder ring capacity (default 4096; "
+                        "0 disables; the ring dumps to "
+                        "flight-recorder.jsonl on stalls and crashes)")
     p.add_argument("--metrics-interval", type=float, default=None,
                    help="seconds between background metrics flushes into "
                         "the store dir (default 10; 0 = final export "
@@ -106,6 +114,9 @@ def test_opts_to_test(opts, base_test: dict) -> dict:
         test["metrics"] = False
     test["profile"] = bool(getattr(opts, "profile", False)
                            or test.get("profile"))
+    if getattr(opts, "flight_recorder_events", None) is not None:
+        # 0 disables the always-on flight recorder for this run
+        test["flight_recorder_events"] = opts.flight_recorder_events
     if getattr(opts, "op_timeout", None) is not None:
         # 0 disables (the interpreter treats falsy as no deadline)
         test["op_timeout_s"] = opts.op_timeout
@@ -207,6 +218,25 @@ def single_test_cmd(
                           help="stop shrinking once the witness is this "
                                "small (default 16)")
 
+        p_tr = sub.add_parser(
+            "trace", help="re-derive a stored run's causal trace from "
+                          "its artifacts (WAL/history + faults.jsonl + "
+                          "late.jsonl + telemetry events) into a "
+                          "Perfetto-loadable trace.json "
+                          "(doc/observability.md)")
+        p_tr.add_argument("dir", nargs="?",
+                          help="one run's directory "
+                               "(store/<name>/<timestamp>) or a store "
+                               "dir; defaults to --store-dir's latest "
+                               "run")
+        p_tr.add_argument("--test-name")
+        p_tr.add_argument("--timestamp", help="defaults to latest run")
+        p_tr.add_argument("--store-dir", default="store")
+        p_tr.add_argument("--out", help="target path (default: the "
+                                        "run's trace.json, or "
+                                        "trace-derived.json when a "
+                                        "live trace already exists)")
+
         p_serve = sub.add_parser("serve", help="serve the web UI")
         p_serve.add_argument("--host", default="0.0.0.0")
         p_serve.add_argument("-p", "--port", type=int, default=8080)
@@ -305,6 +335,8 @@ def single_test_cmd(
                 return heal_cmd(opts)
             if opts.command == "explain":
                 return explain_cmd(opts)
+            if opts.command == "trace":
+                return trace_cmd(opts)
             if opts.command == "preflight":
                 return preflight_cmd(opts, test_fn)
             if opts.command == "lint":
@@ -571,6 +603,53 @@ def explain_cmd(opts) -> int:
               f"{summary.get('anomaly_types')}; wrote "
               f"{', '.join(summary.get('artifacts') or [])}")
     return EXIT_INVALID if summary.get("valid") is False else EXIT_UNKNOWN
+
+
+def trace_cmd(opts) -> int:
+    """``jepsen-tpu trace``: offline causal-trace derivation for a
+    stored run — old runs become traceable retroactively
+    (doc/observability.md "Causal trace"). Prints the summary (span
+    counts per track, slowest ops, demotion chain) and the written
+    path. Exit 0 on success, EXIT_UNKNOWN when the run has no usable
+    op artifact, EXIT_BAD_ARGS when no run resolves."""
+    from pathlib import Path
+
+    from jepsen_tpu.journal import WAL_NAME
+    from jepsen_tpu.trace.derive import derive_run_trace, summarize_trace
+
+    run_dir = None
+    if getattr(opts, "dir", None):
+        d = Path(opts.dir)
+        if (d / "history.jsonl").exists() or (d / WAL_NAME).exists() \
+                or (d / "test.json").exists():
+            run_dir = d  # a single run's directory
+        else:
+            opts.store_dir = str(d)  # a store dir: fall through to latest
+    if run_dir is None:
+        run = _resolve_run(opts)
+        if run is None:
+            return EXIT_BAD_ARGS
+        name, ts = run
+        run_dir = Path(opts.store_dir) / name / ts
+    out = derive_run_trace(run_dir, out=getattr(opts, "out", None))
+    if out is None:
+        print(f"no usable history or journal at {run_dir}",
+              file=sys.stderr)
+        return EXIT_UNKNOWN
+    summary = summarize_trace(out)
+    if summary:
+        tracks = ", ".join(f"{t}: {n}"
+                           for t, n in summary["tracks"].items())
+        print(f"{out}: {summary['events']} event(s) across "
+              f"{len(summary['tracks'])} track(s) [{tracks}]")
+        for o in summary["slowest_ops"]:
+            print(f"  slow: {o['name']} ({o['track']}) {o['dur_ms']} ms")
+        if summary["demotions"]:
+            print("  demotion chain: " + " -> ".join(summary["demotions"]))
+    else:
+        print(f"{out}: written (no events?)")
+    print("load it at https://ui.perfetto.dev (or chrome://tracing)")
+    return EXIT_OK
 
 
 def heal_cmd(opts) -> int:

@@ -335,11 +335,15 @@ def log_results(test: dict) -> None:
 
 def _telemetry_setup(test: dict):
     """Installs a live metrics registry (unless ``metrics: False``) with
-    a periodic background flusher into the store dir, and — for
-    ``trace`` runs — a span tracer wrapped around the client. Returns a
-    teardown closure; the tracer in ``test['tracer']`` is closed by the
-    teardown whether core created it or a suite did (tracing.py leaves
-    shared-tracer teardown to us)."""
+    a periodic background flusher into the store dir, the run's causal
+    tracer (Perfetto ``trace.json`` sink at ``--trace`` verbosity, the
+    always-on flight recorder unless ``flight_recorder_events`` is 0 —
+    doc/observability.md "Causal trace"), and — for ``trace`` runs — a
+    span tracer wrapped around the client. Returns a teardown closure;
+    the tracer in ``test['tracer']`` is closed by the teardown whether
+    core created it or a suite did (tracing.py leaves shared-tracer
+    teardown to us)."""
+    from jepsen_tpu import trace as trace_mod
     prev_reg = None
     flusher = None
     if test.get("metrics", True) is not False:
@@ -348,7 +352,15 @@ def _telemetry_setup(test: dict):
         interval = test.get("metrics_interval", 10.0)
         flusher = telemetry.Flusher(reg, store.test_dir(test),
                                     interval_s=interval or 0).start()
-    if test.get("trace") and test.get("tracer") is None:
+    run_tracer = trace_mod.for_test(test)
+    prev_tracer = trace_mod.install(run_tracer)
+    if run_tracer.flight is not None:
+        try:
+            run_tracer.arm_crash_dump(
+                store.path(test, trace_mod.FLIGHT_NAME))
+        except Exception:  # noqa: BLE001 — bare test map, no store coords
+            logger.debug("no store dir for crash-dump hook", exc_info=True)
+    if trace_mod.trace_enabled(test) and test.get("tracer") is None:
         from jepsen_tpu import tracing
         test["tracer"] = tracing.Tracer(str(store.path_mk(test,
                                                           "trace.jsonl")))
@@ -364,6 +376,11 @@ def _telemetry_setup(test: dict):
                 tracer.close()
             except Exception:  # noqa: BLE001
                 logger.exception("tracer close failed")
+        try:
+            run_tracer.close()
+        except Exception:  # noqa: BLE001
+            logger.exception("run tracer close failed")
+        trace_mod.install(prev_tracer)
         if flusher is not None:
             flusher.stop(final_export=True)
         if prev_reg is not None:
@@ -438,6 +455,23 @@ def _preflight_gate(test: dict) -> None:
     preflight_mod.check(test)
 
 
+def _fatal_flight_dump(test: dict, exc: BaseException) -> None:
+    """The fatal-path flight-recorder dump (doc/observability.md
+    "Causal trace"): a run dying on an exception leaves its last ~N
+    trace events next to the store artifacts. ``PreflightFailed`` is
+    exempt — a rejected test map never ran, there is nothing to
+    record."""
+    from jepsen_tpu.analysis.preflight import PreflightFailed
+    if isinstance(exc, PreflightFailed):
+        return
+    from jepsen_tpu import trace as trace_mod
+    try:
+        trace_mod.get_tracer().dump_flight(
+            store.path(test, trace_mod.FLIGHT_NAME), reason="fatal")
+    except Exception:  # noqa: BLE001 — a crash dump must never mask the crash
+        logger.exception("fatal-path flight dump failed")
+
+
 def run(test: dict) -> dict:  # owner: scheduler
     """The whole enchilada (core.clj:326-397)."""
     test = prepare_test(test)
@@ -473,6 +507,9 @@ def run(test: dict) -> dict:  # owner: scheduler
             test = analyze(test)
             log_results(test)
             return test
+    except BaseException as e:
+        _fatal_flight_dump(test, e)
+        raise
     finally:
         streamer = test.pop("_ir_streamer", None)
         if streamer is not None:

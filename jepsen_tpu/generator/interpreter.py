@@ -36,6 +36,7 @@ from typing import Any
 
 from jepsen_tpu import client as client_mod, telemetry
 from jepsen_tpu import journal as journal_mod
+from jepsen_tpu import trace as trace_mod
 from jepsen_tpu.generator import (
     NEMESIS, PENDING, Context, as_gen, context, friendly_exceptions, validate,
 )
@@ -431,6 +432,9 @@ class _StallWatchdog:
                 "stall-detector trips (no dispatch or completion for "
                 "JEPSEN_TPU_STALL_S)").inc()
             reg.event("interpreter-stall", idle_s=round(idle_s, 3))
+        tracer = trace_mod.get_tracer()
+        tracer.instant(trace_mod.TRACK_SCHEDULER, "stall",
+                       args={"idle_s": round(idle_s, 3)})
         try:
             from jepsen_tpu import store
             target = store.path_mk(self.test, STALL_DUMP_NAME)
@@ -438,6 +442,15 @@ class _StallWatchdog:
             logger.debug("no store dir for stall dump", exc_info=True)
             return
         telemetry.dump_thread_stacks(target)
+        # a wedge is exactly what the flight recorder exists for: the
+        # last ~N events of causal context land next to the stack dump
+        try:
+            from jepsen_tpu import store
+            tracer.dump_flight(
+                store.path_mk(self.test, trace_mod.FLIGHT_NAME),
+                reason="stall")
+        except Exception:  # noqa: BLE001 — diagnostics never raise
+            logger.debug("no store dir for flight dump", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()
@@ -483,6 +496,25 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
     # the per-op cost is a single boolean check (metrics_on).
     reg = telemetry.get_registry()
     metrics_on = reg.enabled
+    # causal trace (doc/observability.md "Causal trace"): every
+    # history-bound op opens a slice on its worker's track at dispatch,
+    # keyed by the stable trace id — a pure function of the op's
+    # (process, invoke-time), which client spans, the WAL record, reap
+    # forensics, and the checker's explain localization all share. The
+    # hot path appends one raw tuple per event through op_sink() (the
+    # telemetry cell() analog); track names, ids, and wall timestamps
+    # are derived at sink-drain/dump time from the op dicts + the
+    # one-shot clock origin below.
+    tracer = trace_mod.get_tracer()
+    tracing_on = tracer.enabled
+    op_trace = None
+    if tracing_on:
+        tracer.set_op_origin(_time.time_ns() // 1000
+                             - relative_time_nanos() // 1000)
+        op_trace = tracer.op_sink()
+        tracer.instant(trace_mod.TRACK_SCHEDULER, "interpreter-start",
+                       args={"workers": len(ctx.workers)})
+    OP_B, OP_X = trace_mod.OP_BEGIN, trace_mod.OP_COMPLETE
     m_latency = reg.histogram(
         "interpreter_op_latency_seconds",
         "invoke -> completion latency by op :f", labels=("f",))
@@ -553,6 +585,8 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
             t0 = invoke_at.pop(thread, None)
             inflight.pop(thread, None)
             deadlines.pop(thread, None)
+            if op_trace is not None:
+                op_trace((OP_X, thread, completion, t0))
             if metrics_on:
                 if t0 is not None:
                     f = completion.get("f")
@@ -588,6 +622,12 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
             return
         if metrics_on:
             m_late.inc()
+        if tracing_on:
+            tracer.instant(
+                trace_mod.TRACK_SCHEDULER, "late-completion",
+                args={"worker": wid, "f": str(payload.get("f")),
+                      "trace_id": trace_mod.trace_id_for(
+                          payload.get("process"), payload.get("time"))})
         logger.info("quarantined late completion from zombie worker %s "
                     "(f=%r)", wid, payload.get("f"))
         if late_log is None and not own_late:
@@ -598,7 +638,12 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
             except Exception:  # noqa: BLE001 — bare test map, no store
                 logger.debug("no store dir for late.jsonl", exc_info=True)
         if late_log is not None:
+            # invoke_time preserves the dispatch stamp the re-stamped
+            # "time" clobbers: it is the trace id's second input, so
+            # offline derivation can join this row to its dispatch
+            # slice (jepsen_tpu/trace/derive.py)
             late_log.append({**payload, "late": True, "worker": wid,
+                             "invoke_time": payload.get("time"),
                              "time": relative_time_nanos()})
 
     def on_item(item) -> None:  # owner: scheduler
@@ -644,6 +689,16 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
         if metrics_on:
             m_timeouts.inc(f=str(op.get("f")))
             zombies_gauge.inc()
+        if tracing_on:
+            # the reap instant carries the op's trace id, so the
+            # synthesized :info (which ends the dispatch slice below)
+            # links back to the original dispatch causally
+            tracer.instant(
+                trace_mod.TRACK_SCHEDULER, "op-timeout",
+                args={"worker": thread, "f": str(op.get("f")),
+                      "replacement_gen": w["gen"] + 1,
+                      "trace_id": trace_mod.trace_id_for(
+                          op.get("process"), op.get("time"))})
         logger.warning(
             "op deadline expired on worker %s (f=%r); synthesizing :info "
             "and spawning replacement generation %d", thread, op.get("f"),
@@ -727,6 +782,8 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                     journal.append(op)
                 invoke_at[thread] = now
                 inflight[thread] = op
+                if op_trace is not None:
+                    op_trace((OP_B, thread, op))
                 timeout_s = op.get("timeout_s", _UNSET)
                 if timeout_s is _UNSET:
                     timeout_s = default_timeout_s
@@ -753,6 +810,10 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
         # teardown — the run must always reach its checker.
         drain_deadline = (_time.monotonic() + drain_timeout_s
                           if drain_timeout_s else None)
+        if tracing_on:
+            tracer.instant(trace_mod.TRACK_SCHEDULER, "drain-begin",
+                           args={"busy": len(ctx.workers)
+                                 - len(ctx.free_threads)})
         pending_exits = set(workers)
         reaped_in_drain: set = set()
         for t in ctx.free_threads:
@@ -802,6 +863,12 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                             "%s (%s)", swid,
                             f"f={sop.get('f')!r}" if sop is not None
                             else "no history-bound op in flight")
+                        if tracing_on:
+                            tracer.instant(
+                                trace_mod.TRACK_SCHEDULER,
+                                "worker-abandoned",
+                                args={"worker": swid,
+                                      "phase": "drain-deadline"})
                         if sop is not None:
                             process_completion(
                                 {**sop, "type": "info",
@@ -845,6 +912,11 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                     zombify(w)
                     if metrics_on:
                         m_abandoned.inc()
+                    if tracing_on:
+                        tracer.instant(trace_mod.TRACK_SCHEDULER,
+                                       "worker-abandoned",
+                                       args={"worker": w["id"],
+                                             "phase": "shutdown"})
                     logger.warning(
                         "worker %s still busy at shutdown; abandoned "
                         "(its client closes on its own thread when it "

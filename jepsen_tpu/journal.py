@@ -83,6 +83,18 @@ class Journal:  # durability: fsync
                     if interval == 0 or now - self._last_fsync >= interval:
                         os.fsync(self._f.fileno())
                         self._last_fsync = now
+                        # causal trace: the durability boundary is an
+                        # event worth seeing next to the op slices —
+                        # everything before this instant survives power
+                        # loss (per-append emission would double the
+                        # hot path; the op itself is already traceable
+                        # via its derivable trace id)
+                        from jepsen_tpu import trace as trace_mod
+                        tracer = trace_mod.get_tracer()
+                        if tracer.enabled:
+                            tracer.instant(
+                                trace_mod.TRACK_WAL, "wal-fsync",
+                                args={"appended": self.appended})
             except OSError:
                 logger.exception("WAL write failed; journaling off for "
                                  "the rest of the run")

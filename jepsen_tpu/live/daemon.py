@@ -517,6 +517,9 @@ class LiveDaemon:
         Returns a {label: status} snapshot."""
         t0 = time.perf_counter()
         self.polls += 1
+        from jepsen_tpu import trace as trace_mod
+        tracer = trace_mod.get_tracer()
+        poll_t0 = trace_mod.now_us() if tracer.enabled else 0
         self.discover()
         reg = self.registry
         now = time.monotonic()
@@ -546,7 +549,13 @@ class LiveDaemon:
             pending = tr.pending_ops
             if tr.completed() and not tr.final:
                 t_chk = time.perf_counter()
+                chk_t0 = trace_mod.now_us() if tracer.enabled else 0
                 results = tr.finalize()
+                if tracer.enabled:
+                    tracer.complete(trace_mod.TRACK_LIVE, "finalize",
+                                    chk_t0, trace_mod.now_us() - chk_t0,
+                                    args={"run": tr.label,
+                                          "ops": pending})
                 self._observe_check(tr, pending,
                                     time.perf_counter() - t_chk)
                 # the run is over: the restart snapshot has nothing
@@ -565,8 +574,15 @@ class LiveDaemon:
                         ).inc(run=tr.label)
                 else:
                     t_chk = time.perf_counter()
+                    chk_t0 = trace_mod.now_us() if tracer.enabled else 0
                     tr.check()
                     dt = time.perf_counter() - t_chk
+                    if tracer.enabled:
+                        tracer.complete(trace_mod.TRACK_LIVE, "check",
+                                        chk_t0,
+                                        trace_mod.now_us() - chk_t0,
+                                        args={"run": tr.label,
+                                              "ops": pending})
                     self._observe_check(tr, pending, dt)
                     spent_ops += pending
             if not tr.final and tr.maybe_snapshot():
@@ -590,6 +606,10 @@ class LiveDaemon:
         reg.histogram("live_poll_seconds",
                       "wall time of one full daemon poll"
                       ).observe(time.perf_counter() - t0)
+        if tracer.enabled:
+            tracer.complete(trace_mod.TRACK_LIVE, "poll", poll_t0,
+                            trace_mod.now_us() - poll_t0,
+                            args={"runs": len(trackers)})
         self._export()
         return statuses
 

@@ -207,6 +207,16 @@ class FaultRegistry:  # durability: fsync
             self._entries[rid] = row
             self._append(row)
         self._count("nemesis_faults_recorded_total", kind)
+        # causal trace: the DURABLE registry is the source of truth for
+        # fault windows (not the op stream — crash-replayed heals and
+        # late re-records only exist here); async slices keyed by fault
+        # id so overlapping windows never interleave
+        from jepsen_tpu import trace as trace_mod
+        tracer = trace_mod.get_tracer()
+        if tracer.enabled:
+            tracer.window_begin(trace_mod.TRACK_NEMESIS, str(kind),
+                                wid=f"fault-{rid}",
+                                args={"f": str(f), "id": rid})
         return rid
 
     def mark_healed(self, fault_id: int | None = None,
@@ -232,6 +242,15 @@ class FaultRegistry:  # durability: fsync
         for rid in ids:
             self._count("nemesis_faults_healed_total",
                         self._entries[rid].get("kind"))
+        if ids:
+            from jepsen_tpu import trace as trace_mod
+            tracer = trace_mod.get_tracer()
+            if tracer.enabled:
+                for rid in ids:
+                    tracer.window_end(
+                        trace_mod.TRACK_NEMESIS,
+                        str(self._entries[rid].get("kind")),
+                        wid=f"fault-{rid}", args={"via": via})
         return ids
 
     def unhealed(self) -> list[dict]:

@@ -1180,16 +1180,24 @@ def matrix_check_segmented(stream, step_ids=None, init_state: int = 0,
             logger.warning("matrix checkpoint's cut %d is not a "
                            "quiescent cut of this stream; restarting",
                            state["events_done"])
+    from jepsen_tpu import trace as trace_mod
+    tracer = trace_mod.get_tracer()
     for end in cuts:
         if end <= base:
             continue
         seg = _slice_stream(stream, base, end)
+        seg_t0 = trace_mod.now_us() if tracer.enabled else 0
         alive, ix, tot = matrix_check_resume(
             seg, tot, step_ids=step_ids, init_state=init_state,
             num_states=num_states, n_slots=S, mesh=mesh, variant=variant,
             combine_fused=combine_fused)
         alive_b = bool(np.asarray(alive).all())
         ix_b = bool(np.asarray(ix).any())
+        if tracer.enabled:
+            tracer.complete(trace_mod.TRACK_CHECKPOINT, "segment",
+                            seg_t0, trace_mod.now_us() - seg_t0,
+                            args={"base": base, "end": end,
+                                  "alive": alive_b, "inexact": ix_b})
         if ix_b:
             # an oob escape proves nothing — and its under-approximate
             # carry must never seed an exact resume: abort unsunk

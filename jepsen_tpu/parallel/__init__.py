@@ -241,6 +241,11 @@ def shrink_mesh(mesh, exc=None, min_devices: int | None = None,
                     "failures, by width transition",
                     labels=("from", "to")).inc(
             **{"from": str(n_from), "to": str(int(new.devices.size))})
+    from jepsen_tpu import trace as trace_mod
+    trace_mod.get_tracer().instant(
+        trace_mod.TRACK_LADDER, "mesh-shrink",
+        args={"from": n_from, "to": int(new.devices.size),
+              "error": type(exc).__name__ if exc is not None else None})
     logger.warning("mesh shrunk %d -> %d devices after dispatch failure "
                    "(%s)", n_from, int(new.devices.size),
                    f"{type(exc).__name__}" if exc is not None else

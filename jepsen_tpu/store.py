@@ -28,19 +28,24 @@ NONSERIALIZABLE_KEYS = {
 }
 
 # Telemetry artifacts a run may leave next to history/results
-# (see doc/observability.md): exported metrics, the span log, the live
-# checker daemon's streaming verdict, and the jax.profiler trace dir.
+# (see doc/observability.md): exported metrics, the span logs (the
+# per-client trace.jsonl and the run-wide Perfetto trace.json), the
+# live checker daemon's streaming verdict, and the jax.profiler trace
+# dir.
 TELEMETRY_FILES = ("metrics.prom", "metrics.json", "trace.jsonl",
+                   "trace.json", "trace-derived.json",
                    "live-status.json")
 PROFILE_DIR = "profile"
 
 # Robustness forensics (doc/robustness.md): completions quarantined
 # from reaped zombie workers, the stall watchdog's thread-stack dumps,
-# and an interrupted check's durable checkpoint / the live daemon's
-# restart snapshot (both cleared on completion — their PRESENCE marks
-# an interrupted check/daemon). Present only when the run actually
-# produced them.
-FORENSIC_FILES = ("late.jsonl", "stall-threads.txt", "check.ckpt",
+# the flight recorder's crash/stall dump (doc/observability.md "Causal
+# trace"), and an interrupted check's durable checkpoint / the live
+# daemon's restart snapshot (both cleared on completion — their
+# PRESENCE marks an interrupted check/daemon). Present only when the
+# run actually produced them.
+FORENSIC_FILES = ("late.jsonl", "stall-threads.txt",
+                  "flight-recorder.jsonl", "check.ckpt",
                   "live-session.ckpt")
 
 # Anomaly forensics (doc/observability.md "Anomaly forensics"): the

@@ -1,0 +1,301 @@
+"""Offline trace derivation: old runs become traceable retroactively.
+
+A live ``--trace`` run streams its trace as it happens. But every run —
+traced or not — already persists the raw material: the WAL / history
+(per-op process + invoke time, which is all :func:`trace_id_for`
+needs), the durable fault registry (``faults.jsonl``), the quarantine
+log (``late.jsonl``), and the exported telemetry events + checker phase
+timers (``metrics.json``). ``jepsen-tpu trace <run-dir>`` re-derives a
+merged Perfetto trace from those artifacts, with op trace ids
+IDENTICAL to what a live trace would have minted (pinned by
+tests/test_trace.py's live-vs-derived differential).
+
+Timebase: wall-clock microseconds. History op times are nanoseconds
+relative to the run origin; the origin is recovered from the run's
+``start_time`` (test.json), so fault-registry rows and telemetry
+events — which carry epoch timestamps — land on the same axis to
+within the run's setup time (the origin is stamped slightly before the
+interpreter starts; documented in doc/observability.md).
+
+Worker-track mapping mirrors the interpreter: thread =
+``process % concurrency`` (process renumbering adds the client-thread
+count, so the residue is stable), nemesis ops on the ``nemesis``
+track.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+from pathlib import Path
+
+from jepsen_tpu.trace import (
+    TRACK_CHECKER, TRACK_LADDER, TRACK_NEMESIS, TRACK_SCHEDULER,
+    RunTracer, trace_id_for, worker_track,
+)
+from jepsen_tpu.trace.perfetto import PerfettoSink, read_trace_events
+
+logger = logging.getLogger("jepsen.trace.derive")
+
+DERIVED_NAME = "trace-derived.json"
+
+# telemetry event name -> track for the offline instants
+_EVENT_TRACKS = {
+    "nemesis-fault": TRACK_NEMESIS,
+    "interpreter-stall": TRACK_SCHEDULER,
+    "checker-circuit-open": TRACK_LADDER,
+}
+
+
+def _origin_us(test: dict) -> int:
+    """Epoch microseconds of the run's start_time, or 0 (pure-relative
+    timebase) when it doesn't parse."""
+    ts = str(test.get("start_time") or "")
+    try:
+        dt = datetime.datetime.strptime(ts, "%Y%m%dT%H%M%S.%f")
+        return int(dt.timestamp() * 1e6)
+    except ValueError:
+        return 0
+
+
+def _load_jsonl(path: Path) -> list[dict]:
+    if not path.is_file():
+        return []
+    from jepsen_tpu.journal import read_jsonl_tolerant
+    rows, _ = read_jsonl_tolerant(path)
+    return [r for r in rows if isinstance(r, dict)]
+
+
+def _load_ops(run_dir: Path) -> list[dict]:
+    """history.jsonl when the run completed, else the surviving WAL —
+    a crashed run's trace covers exactly the journaled prefix."""
+    from jepsen_tpu.journal import WAL_NAME
+    ops = _load_jsonl(run_dir / "history.jsonl")
+    if ops:
+        return ops
+    return _load_jsonl(run_dir / WAL_NAME)
+
+
+def _concurrency(test: dict, ops: list[dict]) -> int:
+    c = test.get("concurrency")
+    if isinstance(c, int) and c >= 1:
+        return c
+    # fallback for a run with no readable test.json: the peak number of
+    # concurrently-open client invocations. Every worker holds at most
+    # one op in flight, so the peak is the busiest-moment worker count
+    # — a heuristic (an always-idle worker is invisible), but unlike
+    # counting distinct process ids it is immune to crash renumbering
+    # (a renumbered process is never in flight alongside its
+    # predecessor)
+    open_p: set = set()
+    peak = 1
+    for op in ops:
+        p, typ = op.get("process"), op.get("type")
+        if not isinstance(p, int):
+            continue
+        if typ == "invoke":
+            open_p.add(p)
+            if len(open_p) > peak:
+                peak = len(open_p)
+        elif typ in ("ok", "fail", "info"):
+            open_p.discard(p)
+    return peak
+
+
+def _op_track(process, concurrency: int) -> str:
+    if isinstance(process, int) and process >= 0:
+        return worker_track(process % concurrency)
+    return TRACK_NEMESIS
+
+
+def derive_run_trace(run_dir, out=None) -> Path | None:
+    """Writes the merged offline trace for a stored run; returns the
+    written path, or None when the run has no usable op artifact.
+    ``out`` overrides the target; by default the trace lands at
+    ``trace.json``, or ``trace-derived.json`` when a live-written
+    trace.json already exists (a derived trace must never clobber the
+    richer live one)."""
+    run_dir = Path(run_dir)
+    ops = _load_ops(run_dir)
+    if not ops:
+        return None
+    test: dict = {}
+    try:
+        with open(run_dir / "test.json", encoding="utf-8") as f:
+            test = json.load(f)
+    except (OSError, ValueError):
+        logger.warning("no readable test.json in %s; deriving with "
+                       "defaults", run_dir)
+    if out is None:
+        live = run_dir / "trace.json"
+        out = run_dir / (DERIVED_NAME if live.exists() else "trace.json")
+    origin = _origin_us(test)
+    conc = _concurrency(test, ops)
+    sink = PerfettoSink(out)
+    tracer = RunTracer(perfetto=sink)
+    try:
+        last_ts = origin
+        open_inv: dict = {}  # process -> (ts_us, invoke op)
+        for op in ops:
+            t = op.get("time")
+            if not isinstance(t, (int, float)):
+                continue
+            ts = origin + int(t / 1e3)
+            last_ts = max(last_ts, ts)
+            typ = op.get("type")
+            if typ == "invoke":
+                open_inv[op.get("process")] = (ts, op)
+            elif typ in ("ok", "fail", "info"):
+                inv = open_inv.pop(op.get("process"), None)
+                if inv is None:
+                    continue  # a completion with no journaled invoke
+                inv_ts, inv_op = inv
+                args = {"process": inv_op.get("process"),
+                        "f": str(inv_op.get("f")), "type": typ,
+                        "trace_id": trace_id_for(inv_op.get("process"),
+                                                 inv_op.get("time"))}
+                if op.get("error") is not None:
+                    args["error"] = str(op.get("error"))
+                tracer.complete(
+                    _op_track(inv_op.get("process"), conc),
+                    str(inv_op.get("f")), inv_ts,
+                    max(ts - inv_ts, 1), args=args)
+        # ops still in flight when the run died: open B slices, exactly
+        # the live sinks' in-flight semantics (flight dump / SIGKILL)
+        for process, (ts, inv_op) in sorted(open_inv.items(), key=str):
+            tracer.begin(_op_track(process, conc), str(inv_op.get("f")),
+                         ts_us=ts,
+                         args={"process": process,
+                               "f": str(inv_op.get("f")),
+                               "trace_id": trace_id_for(
+                                   process, inv_op.get("time"))})
+        _derive_faults(tracer, run_dir)
+        _derive_late(tracer, run_dir, origin)
+        _derive_metrics(tracer, run_dir, last_ts)
+    finally:
+        tracer.close()
+    return Path(out)
+
+
+def _derive_faults(tracer: RunTracer, run_dir: Path) -> None:
+    """Fault windows from the durable registry: inject rows open an
+    async slice keyed by fault id, heal rows close it; an unhealed
+    entry stays open — exactly the crash evidence the registry exists
+    for."""
+    from jepsen_tpu.nemesis.faults import FAULTS_NAME
+    injects: dict[int, dict] = {}
+    for row in _load_jsonl(run_dir / FAULTS_NAME):
+        rid = row.get("id")
+        t = row.get("time")
+        if not isinstance(rid, int) or not isinstance(t, (int, float)):
+            continue
+        ts = int(t * 1e6)
+        if row.get("op") == "inject":
+            injects[rid] = row
+            tracer.window_begin(TRACK_NEMESIS, str(row.get("kind")),
+                                wid=f"fault-{rid}", ts_us=ts,
+                                args={"f": row.get("f"), "id": rid})
+        elif row.get("op") == "heal" and rid in injects:
+            tracer.window_end(TRACK_NEMESIS,
+                              str(injects[rid].get("kind")),
+                              wid=f"fault-{rid}", ts_us=ts,
+                              args={"via": row.get("via")})
+
+
+def _derive_late(tracer: RunTracer, run_dir: Path, origin: int) -> None:
+    from jepsen_tpu.journal import LATE_NAME
+    for row in _load_jsonl(run_dir / LATE_NAME):
+        t = row.get("time")  # the quarantine stamp (when it surfaced)
+        ts = origin + int(t / 1e3) if isinstance(t, (int, float)) else None
+        # the id joins on the op's DISPATCH time — quarantine preserves
+        # it as invoke_time because it re-stamps "time" (rows from runs
+        # predating that field get no id rather than a wrong one)
+        inv_t = row.get("invoke_time")
+        tracer.instant(TRACK_SCHEDULER, "late-completion", ts_us=ts,
+                       args={"worker": row.get("worker"),
+                             "f": row.get("f"),
+                             "trace_id": trace_id_for(row.get("process"),
+                                                      inv_t)
+                             if isinstance(inv_t, (int, float))
+                             else None})
+
+
+def _derive_metrics(tracer: RunTracer, run_dir: Path,
+                    end_ts: int) -> None:
+    """Telemetry events become instants; the checker's measured phase
+    split (``checker_matrix_phase_seconds{phase}``) becomes synthetic
+    slices anchored at the end of the history — durations are real,
+    placement is approximate (the export records no start times)."""
+    rows = _load_jsonl(run_dir / "metrics.json") \
+        or _load_jsonl(run_dir / "metrics-analyze.json")
+    for row in rows:
+        if row.get("type") == "event":
+            t = row.get("time")
+            if not isinstance(t, (int, float)):
+                continue
+            track = _EVENT_TRACKS.get(str(row.get("name")), TRACK_CHECKER)
+            tracer.instant(track, str(row.get("name")),
+                           ts_us=int(t * 1e6),
+                           args=row.get("fields") or {})
+        elif (row.get("name") == "checker_matrix_phase_seconds"
+              and isinstance(row.get("value"), (int, float))
+              and row.get("value") > 0):
+            phase = (row.get("labels") or {}).get("phase", "?")
+            tracer.complete(TRACK_CHECKER, "phase", end_ts,
+                            int(row["value"] * 1e6),
+                            args={"phase": phase,
+                                  "seconds": row["value"]})
+
+
+# ---------------------------------------------------------------------------
+# Summary (shared by `jepsen-tpu trace` and the web run page)
+# ---------------------------------------------------------------------------
+
+def summarize_trace(path, max_bytes: int = 8 << 20) -> dict | None:
+    """{tracks: {name: count}, slowest_ops: [...], demotions: [...],
+    events: n} for a trace.json — reading at most ``max_bytes`` so a
+    huge trace can't wedge a page render."""
+    try:
+        events = read_trace_events(path, max_bytes=max_bytes)
+    except OSError:
+        return None
+    if not events:
+        return None
+    names: dict[int, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            names[ev.get("tid")] = (ev.get("args") or {}).get("name", "?")
+    tracks: dict[str, int] = {}
+    spans: list[tuple[float, str, str]] = []  # (dur_us, track, name)
+    open_b: dict[int, dict] = {}
+    demotions: list[str] = []
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        track = names.get(ev.get("tid"), "?")
+        tracks[track] = tracks.get(track, 0) + 1
+        if ph == "B":
+            open_b[ev.get("tid")] = ev
+        elif ph == "E":
+            b = open_b.pop(ev.get("tid"), None)
+            if b is not None and isinstance(ev.get("ts"), (int, float)) \
+                    and isinstance(b.get("ts"), (int, float)):
+                spans.append((ev["ts"] - b["ts"], track,
+                              str(b.get("name"))))
+        elif ph == "X" and isinstance(ev.get("dur"), (int, float)):
+            spans.append((ev["dur"], track, str(ev.get("name"))))
+        elif ph == "i" and ev.get("name") == "demote":
+            args = ev.get("args") or {}
+            demotions.append(f"{args.get('backend')} "
+                             f"({args.get('reason')})")
+    spans.sort(reverse=True)
+    return {
+        "events": sum(tracks.values()),
+        "tracks": dict(sorted(tracks.items())),
+        "slowest_ops": [
+            {"track": t, "name": n, "dur_ms": round(d / 1000.0, 3)}
+            for d, t, n in spans[:5]],
+        "demotions": demotions,
+        "open_spans": len(open_b),
+    }

@@ -31,6 +31,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -40,6 +41,13 @@ from jepsen_tpu.client import Client
 _local = threading.local()
 
 
+def _trace_pkg():
+    """The run-wide causal-trace package, lazily — tracing.py is the
+    legacy per-client span log and must stay importable standalone."""
+    from jepsen_tpu import trace as trace_mod
+    return trace_mod
+
+
 def _stack() -> list:
     s = getattr(_local, "spans", None)
     if s is None:
@@ -47,23 +55,38 @@ def _stack() -> list:
     return s
 
 
-def _new_id() -> str:
-    return os.urandom(8).hex()
-
-
 class Tracer:
-    """Collects spans; ``path=None`` disables sampling entirely."""
+    """Collects spans; ``path=None`` disables sampling entirely.
 
-    def __init__(self, path: str | None, max_buffer: int = 512):
+    Span/trace ids come from a PER-TRACER seeded RNG (``seed``
+    injectable for deterministic tests), never the global ``random``
+    module: a tracer drawing from shared global state is exactly the
+    stateful-closure shape preflight's GEN005 skip and the
+    ``no-host-effects-in-jit`` rule assume away — and two tracers
+    seeded identically must produce identical id streams regardless of
+    what the rest of the process consumed."""
+
+    def __init__(self, path: str | None, max_buffer: int = 512,
+                 seed: int | None = None):
         self.path = path
         self.max_buffer = max_buffer
         self._buf: list[dict] = []
         self._lock = threading.Lock()
         self._closed = False
+        self._rng = random.Random(
+            seed if seed is not None
+            else int.from_bytes(os.urandom(8), "big"))
         if path is not None:
             # final-flush safety net: buffered spans survive a run that
             # crashes before the owner reaches close()
             atexit.register(self.close)
+
+    def _new_id(self) -> str:
+        # many TracedClients share one tracer, so draws race — the C
+        # _random.Random keeps its state consistent under the GIL, and
+        # a (vanishingly rare) duplicate id costs less than a lock on
+        # every span
+        return f"{self._rng.getrandbits(64):016x}"
 
     def enabled(self) -> bool:
         return self.path is not None
@@ -78,8 +101,8 @@ class Tracer:
         parent = stack[-1] if stack else None
         span = {
             "name": name,
-            "span-id": _new_id(),
-            "trace-id": parent["trace-id"] if parent else _new_id(),
+            "span-id": self._new_id(),
+            "trace-id": parent["trace-id"] if parent else self._new_id(),
             "parent-id": parent["span-id"] if parent else None,
             "start": time.time(),
             "annotations": [],
@@ -169,7 +192,16 @@ class TracedClient(Client):
         return getattr(self.inner, "reusable", False)
 
     def open(self, test, node):
-        return TracedClient(self.inner.open(test, node), self.tracer, node)
+        fresh = self.inner.open(test, node)
+        # symmetric peeling (the _unwrap_client contract, in reverse):
+        # a suite whose open() hands back an ALREADY-traced client —
+        # e.g. one that routes through the test map's wrapped prototype
+        # — must not double-wrap (nested spans per op) and must not
+        # swap tracers; exactly ONE layer, OUR tracer, survives a
+        # reopen (regression-pinned by the two-open test)
+        while isinstance(fresh, TracedClient):
+            fresh = fresh.inner
+        return TracedClient(fresh, self.tracer, node)
 
     def setup(self, test):
         self.inner.setup(test)
@@ -178,6 +210,16 @@ class TracedClient(Client):
         with self.tracer.with_trace(f"invoke/{op.get('f')}"):
             self.tracer.attribute({"node": self.node,
                                    "process": op.get("process")})
+            tm = _trace_pkg()
+            if tm.get_tracer().enabled:
+                # the run-wide causal id rides the client span as an
+                # attribute: the same (process, invoke-time) id the
+                # interpreter's dispatch slice carries, so trace.jsonl
+                # client spans join trace.json worker slices exactly
+                # (doc/observability.md "Causal trace")
+                self.tracer.attribute(
+                    "trace-id", tm.trace_id_for(op.get("process"),
+                                                op.get("time")))
             out = self.inner.invoke(test, op)
             self.tracer.attribute("type", out.get("type"))
             if out.get("error") is not None:

@@ -263,6 +263,49 @@ def _explain_section(rel: str, target: Path) -> str:
             f"<table>{cells}</table><p>" + " ".join(links) + "</p></div>")
 
 
+def _trace_section(rel: str, target: Path) -> str:
+    """The run page's "Causal trace" panel: a summary of the Perfetto
+    trace (span counts per track, slowest ops, the demotion chain) with
+    links to ``trace.json`` and — when a crash/stall left one — the
+    flight-recorder dump (doc/observability.md "Causal trace"). Empty
+    string when the run has no trace artifacts."""
+    names = [n for n in ("trace.json", "trace-derived.json",
+                         "flight-recorder.jsonl")
+             if (target / n).is_file()]
+    if not names:
+        return ""
+    base = rel.rstrip("/")
+    links = " ".join(f"<a href='/{base}/{n}'>{n}</a>" for n in names)
+    summary = ""
+    trace_file = next((n for n in names if n.endswith(".json")), None)
+    if trace_file is not None:
+        try:
+            from jepsen_tpu.trace.derive import summarize_trace
+            s = summarize_trace(target / trace_file)
+        except Exception:  # noqa: BLE001 — a corrupt trace still links
+            logger.exception("trace summary failed for %s", target)
+            s = None
+        if s:
+            tracks = ", ".join(f"{t}: {n}" for t, n in s["tracks"].items())
+            rows = [("events", s["events"]), ("tracks", tracks)]
+            if s["slowest_ops"]:
+                rows.append(("slowest", "; ".join(
+                    f"{o['name']} ({o['track']}) {o['dur_ms']} ms"
+                    for o in s["slowest_ops"])))
+            if s["demotions"]:
+                rows.append(("demotion chain",
+                             " → ".join(s["demotions"])))
+            if s["open_spans"]:
+                rows.append(("unfinished spans", s["open_spans"]))
+            summary = "<table>" + "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in rows) + "</table>"
+    return ("<h2>causal trace</h2>" + summary + "<p>" + links +
+            " — load trace.json in <a href='https://ui.perfetto.dev'>"
+            "Perfetto</a> (doc/observability.md)</p>")
+
+
 def _forensics_section(rel: str, target: Path) -> str:
     """Links a run's robustness forensics — late.jsonl (completions
     quarantined from reaped zombie workers), stall-threads.txt (the
@@ -386,6 +429,7 @@ class Handler(BaseHTTPRequestHandler):
             metrics = _metrics_table(target / "metrics.json")
             explain = _explain_section(rel, target)
             elle = _elle_section(rel, target)
+            trace = _trace_section(rel, target)
             forensics = _forensics_section(rel, target)
             banner = ""
             if (target / "results.json").exists() or \
@@ -401,7 +445,7 @@ class Handler(BaseHTTPRequestHandler):
                     f"content='{LIVE_REFRESH_S}'>" if live else "")
             return self._send(
                 self._page(rel, f"{live_panel}{banner}{explain}"
-                                f"{forensics}{elle}"
+                                f"{trace}{forensics}{elle}"
                                 f"{metrics}<ul>{items}</ul>",
                            head_extra=head))
         if target.exists():

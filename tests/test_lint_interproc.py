@@ -809,6 +809,41 @@ class TestTelemetryName:
         finds = _lint_source(tmp_path, bad, rules=["telemetry-name"])
         assert len(finds) == 1 and "label sets" in finds[0].message
 
+    def test_trace_name_conventions(self, tmp_path):
+        """Trace track/span literals must be kebab-case — the causal
+        trace's query-key hygiene (doc/observability.md)."""
+        bad = """
+            def emit(tracer):
+                tracer.instant("Bad_Track", "op-timeout")
+                tracer.complete("checkpoint", "Ckpt_Write", 0, 1)
+                with tracer.span("checker-ladder", "Rung Attempt"):
+                    pass
+        """
+        finds = _lint_source(tmp_path, bad, rules=["telemetry-name"])
+        assert len(finds) == 3
+        msgs = "\n".join(f.message for f in finds)
+        assert "Bad_Track" in msgs and "Ckpt_Write" in msgs \
+            and "Rung Attempt" in msgs
+        assert all("kebab-case" in f.message for f in finds)
+        good = """
+            def emit(tracer, track):
+                tracer.instant("scheduler", "op-timeout")
+                tracer.complete("checkpoint", "ckpt-write", 0, 1)
+                tracer.window_begin("nemesis", "net", wid="fault-0")
+                # dynamic names (worker tracks) are not literals: skipped
+                tracer.instant(f"worker-{track}", "late-completion")
+                tracer.instant(track, "stall")
+        """
+        assert _lint_source(tmp_path, good, rules=["telemetry-name"]) == []
+
+    def test_trace_name_waivable(self, tmp_path):
+        waived = """
+            def emit(tracer):
+                tracer.instant("Legacy_Track", "x")  # lint: ignore[telemetry-name]
+        """
+        assert _lint_source(tmp_path, waived,
+                            rules=["telemetry-name"]) == []
+
     def test_doc_drift(self, tmp_path):
         d = tmp_path / "pkg"
         d.mkdir()

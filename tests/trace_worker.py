@@ -1,0 +1,61 @@
+"""Chaos-test worker for the causal trace (tests/test_trace.py).
+
+A fake-mode ``--trace`` run whose client HANGS after a prefix of fast
+ops: the interpreter's stall watchdog (armed down to 1 s here) fires
+and dumps the flight recorder, and the streaming trace.json keeps
+accumulating — then the parent SIGKILLs the process mid-run and
+asserts both artifacts survived as loadable prefixes. Usage:
+
+    python trace_worker.py <store-dir>
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu import core  # noqa: E402
+from jepsen_tpu import generator as gen
+from jepsen_tpu.fakes import AtomClient, AtomDB, noop_test
+
+
+class HangingAtomClient(AtomClient):
+    """Fast for the first ops, then blocks forever — the wedge the
+    stall watchdog (and its flight-recorder dump) exists for."""
+
+    invocations = 0
+    _count_lock = threading.Lock()
+
+    def invoke(self, test, op):
+        with HangingAtomClient._count_lock:
+            HangingAtomClient.invocations += 1
+            n = HangingAtomClient.invocations
+        if n > 20:
+            time.sleep(3600)
+        return super().invoke(test, op)
+
+
+def main() -> int:
+    store_dir = sys.argv[1]
+    db = AtomDB()
+    t = noop_test(
+        db=db, client=HangingAtomClient(db),
+        generator=gen.clients(gen.limit(
+            50_000, gen.cycle(gen.Seq([
+                {"type": "invoke", "f": "write", "value": 1},
+                {"type": "invoke", "f": "read", "value": None},
+            ])))),
+        store_dir=store_dir, time_limit=600.0,
+        trace=True,
+        # a 1 s stall threshold so the hung client trips the watchdog
+        # (and its flight dump) quickly; generous op deadlines so the
+        # reaper never beats the watchdog to the wedge
+        stall_s=1.0, op_timeout_s=300.0,
+        wal_fsync_interval=0, metrics_interval=0)
+    core.run(t)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
