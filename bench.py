@@ -40,8 +40,12 @@ Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
 stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
 elle_50k, ir_amortization, online_lag, matrix_kernel, explain,
-multichip, ckpt, trace, headline, scale, telemetry — the last opts out
-of the per-stage telemetry block in bench_summary). ``trace`` measures
+multichip, ckpt, trace, fleet, headline, scale, telemetry — the last
+opts out of the per-stage telemetry block in bench_summary).
+``fleet`` measures the fleet plane end to end (fleet_runs_sustained:
+100 concurrent runs shipped over loopback HTTP into one pool daemon,
+one mesh shrink + regrow cycle injected, verdicts checked bit-identical
+to local analyze — doc/observability.md "Fleet plane"). ``trace`` measures
 the causal-trace cost (trace_overhead_frac: fully-traced vs untraced
 interpreter wall, bar <= 5%, with the always-on flight-recorder
 configuration <= 1% — doc/observability.md "Causal trace").
@@ -1231,6 +1235,205 @@ def cfg_online_lag():
          **extras)
 
 
+def _fleet_measure():
+    """100 concurrent synthetic runs shipped over loopback HTTP into
+    one FleetDaemon, with one mesh shrink + one regrow cycle injected
+    mid-flight. Returns the raw measurement dict (also the
+    --fleet-child stdout payload)."""
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    import jax
+
+    from __graft_entry__ import _register_history
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.fleet.scheduler import FleetDaemon
+    from jepsen_tpu.fleet.ship import Shipper
+    from jepsen_tpu.journal import Journal
+    from jepsen_tpu.live.daemon import load_live_status
+
+    n_runs = 100
+    ops_per_run = 120
+    histories = {f"r{i:03d}": _register_history(
+        ops_per_run, n_procs=3, seed=i, n_values=5)
+        for i in range(n_runs)}
+    reg = telemetry.Registry()
+    # regrow_mesh/shrink_mesh count on the process-global registry
+    prev = telemetry.install(reg)
+    tmp = tempfile.mkdtemp(prefix="fleet-bench-")
+    worst_lag = 0.0
+    try:
+        src = Path(tmp) / "src"
+        store = Path(tmp) / "fleet"
+        fd = FleetDaemon(store, port=0, poll_s=0.05,
+                         ingest_budget_s=0.5, max_runs=n_runs + 8,
+                         accelerator="cpu", registry=reg,
+                         regrow_backoff_s=0.05)
+        fd.start()
+        t0 = time.perf_counter()
+
+        def one(ts, h):
+            # ship WHILE producing — the live-shipping shape; a run
+            # landing already complete is post-hoc territory
+            rd = src / "bench" / ts
+            rd.mkdir(parents=True)
+            j = Journal(rd / "history.wal.jsonl", fsync_interval_s=-1)
+            j.append(h[0])
+            sh = Shipper(rd, f"http://127.0.0.1:{fd.port}",
+                         poll_s=0.02)
+            shipped = []
+            st = threading.Thread(
+                target=lambda: shipped.append(sh.run(timeout_s=240)),
+                daemon=True)
+            st.start()
+            born = time.monotonic()
+            for op in h[1:]:
+                j.append(op)
+                time.sleep(0.0005)
+            j.close()
+            # keep the run live for a few discovery polls before the
+            # final lands — a run that completes inside one poll is
+            # (correctly) post-hoc territory, not the pool's; polls
+            # stretch toward ingest_budget_s with 100 runs tracked
+            time.sleep(max(0.0, 2.0 - (time.monotonic() - born)))
+            with open(rd / "history.jsonl", "w") as f:
+                for op in h:
+                    f.write(json.dumps(op) + "\n")
+            st.join(240)
+            if shipped != [True]:
+                raise RuntimeError(f"run {ts} never finalized")
+
+        threads = [threading.Thread(target=one, args=(ts, h),
+                                    daemon=True)
+                   for ts, h in histories.items()]
+        for t in threads:
+            t.start()
+
+        # one shrink + one regrow cycle mid-flight: fail a device the
+        # way a collective error would, then let the fleet daemon's
+        # heal probe regrow the mesh
+        time.sleep(0.3)
+        devs = jax.devices()
+        mesh = parallel.auto_mesh() if len(devs) >= 2 else None
+        if mesh is not None and int(mesh.devices.size) >= 2:
+            casualty = list(mesh.devices.flat)[-1].id
+            parallel.shrink_mesh(mesh, RuntimeError(
+                f"UNAVAILABLE: device {casualty} lost mid collective"))
+
+        def lag_gauge():
+            return reg.gauge("fleet_worst_lag_ops",
+                             "largest per-run checker lag across "
+                             "the pool").value()
+
+        for t in threads:
+            while t.is_alive():
+                t.join(0.1)
+                worst_lag = max(worst_lag, lag_gauge())
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and fd.daemon.trackers:
+            worst_lag = max(worst_lag, lag_gauge())
+            time.sleep(0.05)
+        elapsed = time.perf_counter() - t0
+        if fd.daemon.trackers:
+            raise RuntimeError(
+                f"pool never settled {len(fd.daemon.trackers)} runs")
+        fd.stop()
+
+        snap = reg.snapshot()
+
+        def ctr(name):
+            return sum(r["value"] for r in snap if r["name"] == name)
+
+        # fleet verdicts must be bit-identical to local analyze over
+        # the same histories
+        mismatches = 0
+        invalid = 0
+        for ts, h in histories.items():
+            status = load_live_status(store / "bench" / ts)
+            if status is None or status.get("state") != "final":
+                raise RuntimeError(f"run {ts} has no final status")
+            local = LinearizableChecker(
+                accelerator="cpu").check({}, h, {})
+            mismatches += status["valid_so_far"] is not local["valid?"]
+            invalid += status["valid_so_far"] is False
+        if mismatches:
+            raise RuntimeError(
+                f"{mismatches} fleet verdicts diverged from local "
+                "analyze")
+        total_ops = n_runs * ops_per_run
+        return {"runs": n_runs, "ops_total": total_ops,
+                "ops_per_sec": round(total_ops / elapsed, 1),
+                "wall_s": round(elapsed, 2),
+                "worst_lag_ops": int(worst_lag),
+                "shrinks": int(ctr("mesh_shrink_total")),
+                "regrows": int(ctr("mesh_regrow_total")),
+                "ingest_bytes": int(ctr("fleet_ingest_bytes_total")),
+                "ingest_rejected": int(
+                    ctr("fleet_ingest_rejected_total")),
+                "invalid_runs": invalid,
+                "n_devices": len(devs)}
+    finally:
+        telemetry.install(prev)
+        with parallel._HEALTH_LOCK:
+            parallel._FAILED_DEVICES.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def cfg_fleet_runs_sustained():
+    """fleet_runs_sustained: sustained ops/s through the full fleet
+    plane — 100 concurrent synthetic runs shipping WALs over loopback
+    HTTP into one ingest receiver while the pool daemon live-checks
+    them all — with one mesh shrink + one regrow cycle injected
+    mid-flight (doc/observability.md "Fleet plane"). Guards bounded
+    worst live_checker_lag_ops, verdict parity against local analyze
+    on the same WALs, and zero ingest rejections on the happy path.
+    Self-provisions an 8-virtual-CPU-device subprocess when this
+    process cannot supply >= 2 devices (the shrink/regrow leg needs a
+    mesh that can narrow and widen)."""
+    in_proc = False
+    if "jax" in sys.modules:
+        import jax
+        try:
+            in_proc = len(jax.devices()) >= 2
+        except Exception:  # noqa: BLE001 — backend unreachable: child
+            in_proc = False
+    if in_proc:
+        data = _fleet_measure()
+    else:
+        import subprocess
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--fleet-child"],
+            capture_output=True, text=True, timeout=480, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"fleet child failed (rc {out.returncode}):\n"
+                f"{out.stderr[-2000:]}")
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+    rate = data["ops_per_sec"]
+    # the bar: >= 2k ops/s sustained over network ingest with lag
+    # bounded by the admission budget's working set
+    emit("fleet_runs_sustained", rate, "ops/s", rate / 2_000.0,
+         runs=data["runs"], ops_total=data["ops_total"],
+         wall_s=data["wall_s"], worst_lag_ops=data["worst_lag_ops"],
+         mesh_shrinks=data["shrinks"], mesh_regrows=data["regrows"],
+         ingest_bytes=data["ingest_bytes"],
+         ingest_rejected=data["ingest_rejected"],
+         invalid_runs=data["invalid_runs"],
+         n_devices=data["n_devices"], in_process=in_proc,
+         verdict_parity="bit-identical to local analyze")
+
+
 def cfg_membership_resolve():
     """membership_resolve_latency: full reconfiguration cycles per
     second through the membership scenario machinery — durable registry
@@ -1608,6 +1811,7 @@ def main() -> None:
     guard("multichip", cfg_multichip_scaling)
     guard("ckpt", cfg_ckpt)
     guard("trace", cfg_trace)
+    guard("fleet", cfg_fleet_runs_sustained)
     guard("lint", cfg_lint)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
@@ -1648,5 +1852,7 @@ if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if "--multichip-child" in sys.argv:
         _multichip_child()
+    elif "--fleet-child" in sys.argv:
+        print(json.dumps(_fleet_measure()), flush=True)
     else:
         main()

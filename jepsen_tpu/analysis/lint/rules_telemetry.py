@@ -171,7 +171,7 @@ def telemetry_name(graph: CallGraph) -> list[Finding]:
                     "unify them")
 
     out.extend(_trace_names(graph))
-    out.extend(_doc_drift(graph, set(by_name)))
+    out.extend(_doc_drift(graph, set(by_name), by_name))
     return out
 
 
@@ -214,17 +214,18 @@ def _trace_names(graph: CallGraph) -> list[Finding]:
     return out
 
 
-def _doc_drift(graph: CallGraph, registered: set) -> list[Finding]:
+def _doc_drift(graph: CallGraph, registered: set,
+               by_name: dict | None = None) -> list[Finding]:
     if graph.root is None:
         return []
     doc = Path(graph.root) / DOC_NAME
     try:
-        lines = doc.read_text(encoding="utf-8").splitlines()
+        text = doc.read_text(encoding="utf-8")
     except OSError:
         return []
     out: list[Finding] = []
     seen: set = set()
-    for i, line in enumerate(lines, 1):
+    for i, line in enumerate(text.splitlines(), 1):
         for m in _DOC_CITED.finditer(line):
             name = m.group(1) or m.group(2)
             if name in registered or name in seen:
@@ -237,4 +238,24 @@ def _doc_drift(graph: CallGraph, registered: set) -> list[Finding]:
                          "but nothing in the linted tree registers it "
                          "— a silent rename strands dashboards"),
                 hint="update the doc (or restore the metric name)"))
+    # reverse direction for the fleet vocabulary: every registered
+    # fleet_* metric must be cited in the doc's "Fleet plane" section —
+    # the fleet dashboard is operator-facing from day one, so an
+    # undocumented series IS the drift (the forward check can't see it:
+    # nothing cites it). Scoped to fleet_* to keep the rule additive
+    # for the pre-fleet vocabulary.
+    for name, rs in sorted((by_name or {}).items()):
+        if not name.startswith("fleet_"):
+            continue
+        if re.search(r"`" + re.escape(name) + r"[`{]", text):
+            continue
+        r = rs[0]
+        out.append(Finding(
+            rule=RULE, code=CODE, path=r.path, line=r.line, col=r.col,
+            qualname=r.qualname,
+            message=(f"fleet metric {name!r} is not cited in "
+                     "doc/observability.md — the fleet dashboard "
+                     "vocabulary must stay documented"),
+            hint="cite it (backticked, with its labels) in the "
+                 "\"Fleet plane\" section"))
     return out

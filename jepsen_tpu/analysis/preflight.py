@@ -397,6 +397,12 @@ _NUMERIC_KNOBS = (
     # tolerantly at runtime (garbage warns + default), preflight is
     # where it becomes an error. 0 disables the recorder.
     ("flight_recorder_events", True, 0.0),
+    # fleet plane knobs (doc/observability.md "Fleet plane"): the
+    # fleet daemon coerces tolerantly (fleet.fleet_knob) — preflight
+    # is where garbage becomes an error
+    ("fleet_port", True, 0.0),
+    ("fleet_ingest_budget_s", True, 0.0),
+    ("fleet_max_runs", True, 1.0),
 )
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
@@ -455,6 +461,15 @@ _ENV_NUMERIC_KNOBS = (
     ("JEPSEN_TPU_FLIGHT_RECORDER_EVENTS",
      "process-wide twin of flight_recorder_events (the crash/stall "
      "flight recorder's ring capacity; 0 disables)"),
+    ("JEPSEN_TPU_FLEET_PORT",
+     "process-wide twin of fleet_port (the fleet daemon's ingest/"
+     "status port, doc/observability.md \"Fleet plane\")"),
+    ("JEPSEN_TPU_FLEET_INGEST_BUDGET_S",
+     "process-wide twin of fleet_ingest_budget_s (the pool's per-poll "
+     "verdict budget in predicted CPU seconds)"),
+    ("JEPSEN_TPU_FLEET_MAX_RUNS",
+     "process-wide twin of fleet_max_runs (the pool's admission cap "
+     "on concurrently tracked runs)"),
 )
 
 _UNSET = object()

@@ -274,6 +274,53 @@ def single_test_cmd(
                             help="with --once: give up after this many "
                                  "seconds (0 = wait forever)")
 
+        p_ship = sub.add_parser(
+            "ship", help="ship a run's WAL to a fleet ingest receiver "
+                         "over HTTP, resume-token checked "
+                         "(doc/observability.md \"Fleet plane\")")
+        p_ship.add_argument("dir", help="one run's directory "
+                                        "(store/<name>/<timestamp>)")
+        p_ship.add_argument("--to", default=None,
+                            help="receiver base URL (default "
+                                 "http://127.0.0.1:<fleet_port>)")
+        p_ship.add_argument("--poll", dest="ship_poll_s", type=float,
+                            default=0.2,
+                            help="seconds between WAL polls when idle")
+        p_ship.add_argument("--timeout", type=float, default=300.0,
+                            help="give up after this many seconds")
+
+        p_fleet = sub.add_parser(
+            "fleet", help="fleet daemon: HTTP WAL ingest + pooled live "
+                          "checking + /fleet dashboard aggregate "
+                          "(doc/observability.md \"Fleet plane\")")
+        p_fleet.add_argument("--store-dir", default="store",
+                             help="ingest store root (shipped runs land "
+                                  "here)")
+        p_fleet.add_argument("--host", default="127.0.0.1")
+        p_fleet.add_argument("-p", "--port", dest="fleet_port",
+                             default=None,
+                             help="ingest/status port (default 8091; "
+                                  "env twin JEPSEN_TPU_FLEET_PORT)")
+        p_fleet.add_argument("--ingest-budget",
+                             dest="fleet_ingest_budget_s", default=None,
+                             help="per-poll verdict budget in predicted "
+                                  "CPU seconds (env twin "
+                                  "JEPSEN_TPU_FLEET_INGEST_BUDGET_S)")
+        p_fleet.add_argument("--max-runs", dest="fleet_max_runs",
+                             default=None,
+                             help="admission cap on concurrently "
+                                  "tracked runs (env twin "
+                                  "JEPSEN_TPU_FLEET_MAX_RUNS)")
+        p_fleet.add_argument("--poll", dest="fleet_poll_s", type=float,
+                             default=None,
+                             help="seconds between pool polls")
+        p_fleet.add_argument("--once", action="store_true",
+                             help="poll until every tracked run "
+                                  "finalizes, then exit")
+        p_fleet.add_argument("--timeout", type=float, default=0.0,
+                             help="with --once: give up after this "
+                                  "many seconds (0 = wait forever)")
+
         p_pre = sub.add_parser(
             "preflight", help="validate the test map without running it "
                               "(doc/static-analysis.md)")
@@ -347,6 +394,10 @@ def single_test_cmd(
                 return EXIT_OK
             if opts.command == "live":
                 return live_cmd(opts)
+            if opts.command == "ship":
+                return ship_cmd(opts)
+            if opts.command == "fleet":
+                return fleet_cmd(opts)
             return EXIT_BAD_ARGS
         except KeyboardInterrupt:
             return EXIT_CRASH
@@ -423,6 +474,59 @@ def live_cmd(opts) -> int:
                 worst = max(worst, EXIT_UNKNOWN)
         return worst
     live_daemon.serve(store_root, run_dirs=run_dirs, **kw)
+    return EXIT_OK
+
+
+def ship_cmd(opts) -> int:
+    """``jepsen-tpu ship``: streams one run dir's WAL to a fleet
+    ingest receiver, resume-token checked, finalizing with the
+    authoritative history once the run completes
+    (doc/observability.md "Fleet plane")."""
+    from pathlib import Path
+
+    from jepsen_tpu.fleet import DEFAULT_FLEET_PORT, fleet_knob
+    from jepsen_tpu.fleet.ship import Shipper
+
+    run_dir = Path(opts.dir)
+    base = opts.to
+    if base is None:
+        port = int(fleet_knob("fleet_port", None,
+                              DEFAULT_FLEET_PORT, 0.0))
+        base = f"http://127.0.0.1:{port}"
+    sh = Shipper(run_dir, base, poll_s=opts.ship_poll_s)
+    ok = sh.run(timeout_s=opts.timeout)
+    print(f"{sh.key}: shipped {sh.bytes_sent} byte(s) in "
+          f"{sh.chunks_sent} chunk(s), {sh.resets} reset(s), "
+          f"finalized={sh.finalized}")
+    return EXIT_OK if ok else EXIT_CRASH
+
+
+def fleet_cmd(opts) -> int:
+    """``jepsen-tpu fleet``: the pool side — HTTP WAL ingest, one live
+    daemon over the ingest store, mesh heal probes, and the aggregated
+    fleet-status plane (doc/observability.md "Fleet plane")."""
+    from jepsen_tpu.fleet import scheduler as fleet_scheduler
+    from jepsen_tpu.live.daemon import DEFAULT_POLL_S
+
+    kw = {
+        "host": opts.host,
+        "port": opts.fleet_port,
+        "ingest_budget_s": opts.fleet_ingest_budget_s,
+        "max_runs": opts.fleet_max_runs,
+        "poll_s": (opts.fleet_poll_s if opts.fleet_poll_s is not None
+                   else DEFAULT_POLL_S),
+    }
+    if getattr(opts, "once", False):
+        fd = fleet_scheduler.FleetDaemon(opts.store_dir, **kw)
+        timeout = opts.timeout if opts.timeout and opts.timeout > 0 \
+            else 3600.0
+        payload = fd.run_until_idle(timeout_s=timeout)
+        runs = payload.get("runs", {})
+        print(f"fleet: {runs.get('final', 0)} run(s) settled, "
+              f"{runs.get('invalid', 0)} invalid, worst lag "
+              f"{payload.get('worst_lag_ops', 0)} ops")
+        return EXIT_INVALID if runs.get("invalid", 0) else EXIT_OK
+    fleet_scheduler.serve(opts.store_dir, **kw)
     return EXIT_OK
 
 

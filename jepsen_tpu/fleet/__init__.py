@@ -1,0 +1,55 @@
+"""Fleet observability plane: many producers, one checker pool.
+
+The single-run story (core.run writes a WAL, the live daemon tails it,
+``analyze`` settles it post hoc) assumes the run was born on the host
+that checks it. At fleet scale it wasn't: runs are born on many control
+hosts and a shared accelerator-backed pool does the checking. This
+package is the bridge (doc/observability.md "Fleet plane"):
+
+* :mod:`.ingest` — the HTTP WAL-shipping receiver. Producers POST
+  chunked WAL bytes with the tailer's prefix-sha256 offset as a resume
+  token; the receiver verifies the prefix hash before every append, so
+  a diverged or replayed shipment is rejected with the current token
+  instead of silently absorbed.
+* :mod:`.ship` — the producer-side client (``jepsen-tpu ship``),
+  riding :class:`jepsen_tpu.journal.WalTailer` so it ships exactly the
+  newline-terminated prefix a local checker would have consumed.
+* :mod:`.scheduler` — the pool daemon: one
+  :class:`jepsen_tpu.live.daemon.LiveDaemon` over the ingest store
+  (admission-budgeted, most-lagged-first, per-run breakers), plus the
+  elastic mesh's heal path (``parallel.regrow_mesh``).
+* :mod:`.status` — the aggregated ``fleet-status.json`` + fleet-level
+  Prometheus export behind the ``/fleet`` dashboard.
+
+Knobs follow the live-daemon convention: tolerant coercion here so the
+daemon comes up on a half-garbled config, strictness in preflight
+(KNB001/KNB002), and a ``JEPSEN_TPU_*`` env twin per knob.
+"""
+from __future__ import annotations
+
+import os
+
+from jepsen_tpu.live.daemon import coerce_knob
+
+DEFAULT_FLEET_PORT = 8091
+DEFAULT_FLEET_INGEST_BUDGET_S = 0.5
+DEFAULT_FLEET_MAX_RUNS = 64
+
+# (knob, default, floor) — mirrored by preflight's KNB rows and the
+# env twins below; doc/observability.md "Fleet plane" documents each
+FLEET_KNOBS = (
+    ("fleet_port", DEFAULT_FLEET_PORT, 0.0),
+    ("fleet_ingest_budget_s", DEFAULT_FLEET_INGEST_BUDGET_S, 0.0),
+    ("fleet_max_runs", DEFAULT_FLEET_MAX_RUNS, 1.0),
+)
+
+
+def fleet_knob(name: str, value, default: float, lo: float) -> float:
+    """Tolerant fleet-knob coercion with an env twin: an explicit
+    ``value`` wins, else ``JEPSEN_TPU_<NAME>`` is consulted, else the
+    default. Garbage in either logs a warning and falls back — the
+    fleet daemon must come up; preflight is where garbage is an
+    error."""
+    if value is None:
+        value = os.environ.get("JEPSEN_TPU_" + name.upper())
+    return coerce_knob(name, value, default, lo)

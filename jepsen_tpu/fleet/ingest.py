@@ -1,0 +1,324 @@
+"""The ingest plane: an HTTP receiver for shipped WAL bytes.
+
+The protocol is the WAL streamer's divergence-checked resume contract
+(doc/robustness.md), lifted onto the wire. The resume token IS the
+tailer's cursor: ``(offset, prefix_sha256)``. Every ``POST /wal`` names
+the offset it believes it is appending at and the sha256 of every byte
+before it; the receiver accepts only when both match its own cursor, so
+
+* a **replayed** chunk (stale offset) bounces with 409 + the current
+  token — the shipper fast-forwards, nothing is double-absorbed;
+* a **diverged** shipment (same offset, different prefix hash — the
+  producer's WAL was rewritten, or a different run reuses the name)
+  bounces the same way, and the shipper's only way back in is an
+  explicit offset-0 reset;
+* a **gap** (offset beyond the receiver's) bounces so a shipper that
+  lost its receiver (receiver restart, wiped store) re-ships from the
+  receiver's real cursor instead of leaving a hole.
+
+The chunk itself carries ``X-Jepsen-Chunk-Sha`` — the running digest
+*after* the append — verified before any byte hits disk, so a corrupt
+body is dropped with no cursor movement.
+
+Accepted bytes land in ``<store>/<name>/<ts>/history.wal.jsonl`` — the
+exact layout core.run writes locally — so the live daemon's discovery,
+tailing, snapshots and verdicts work unchanged on shipped runs, and
+``analyze`` on the receiver's copy is bit-identical to the producer's.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.journal import WAL_NAME
+from jepsen_tpu.utils import join_noisy
+
+logger = logging.getLogger(__name__)
+
+# one path segment: excludes "", ".", "..", hidden names and separators
+_SEGMENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+MAX_CHUNK_BYTES = 32 << 20  # absurdly large for one WAL poll
+
+
+def _atomic_write_bytes(path: Path, body: bytes) -> None:
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class _IngestHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # a whole fleet reconnecting at once (receiver restart, network
+    # partition healing) is the normal case, not a burst to shed: the
+    # stdlib's 5-deep listen backlog RSTs the stragglers and every one
+    # of them walks the recovery ladder
+    request_queue_size = 128
+
+
+class IngestServer:
+    """Receives shipped WALs into a local store root.
+
+    Per-run cursor state lives in ``_runs[name/ts] = {"offset", "sha",
+    "bytes"}`` under one lock — verification + append are serialized,
+    which is what makes the accept/reject decision race-free when two
+    shippers (a producer restart overlapping its predecessor) target
+    the same run. A cursor missing from ``_runs`` (receiver restart)
+    is rebuilt by hashing the WAL already on disk, so shippers resume
+    against a restarted receiver without re-sending history."""
+
+    def __init__(self, store_root, host: str = "127.0.0.1",
+                 port: int = 0,
+                 registry: telemetry.Registry | None = None):
+        self.store_root = Path(store_root)
+        self.registry = registry if registry is not None \
+            else telemetry.get_registry()
+        self._runs: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._httpd = _IngestHTTPServer((host, port),
+                                        self._make_handler())
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # -- cursor state ---------------------------------------------------
+
+    def _wal_path(self, key: str) -> Path:
+        return self.store_root / key / WAL_NAME
+
+    def _cursor(self, key: str) -> dict:
+        """The run's cursor, creating it from the on-disk WAL when this
+        receiver has never seen the run (fresh run OR receiver
+        restart). Caller holds ``_lock``."""
+        st = self._runs.get(key)
+        if st is None:
+            st = {"offset": 0, "sha": hashlib.sha256(), "bytes": 0}
+            p = self._wal_path(key)
+            try:
+                with open(p, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        st["sha"].update(chunk)
+                        st["offset"] += len(chunk)
+            except OSError:
+                pass  # no WAL yet: cursor starts at 0
+            self._runs[key] = st
+        return st
+
+    def _reject(self, reason: str) -> None:
+        self.registry.counter(
+            "fleet_ingest_rejected_total",
+            "shipped chunks bounced by resume-token verification",
+            labels=("reason",)).inc(reason=reason)
+
+    # -- protocol ops (handler threads) ---------------------------------
+
+    def token(self, key: str) -> dict:  # owner: worker
+        with self._lock:
+            st = self._cursor(key)
+            return {"offset": st["offset"],
+                    "prefix_sha": st["sha"].hexdigest()}
+
+    def append_chunk(self, key: str, offset: int, prefix_sha: str,
+                     chunk_sha: str, body: bytes,
+                     reset: bool = False):  # owner: worker
+        """Verifies the resume token + chunk digest and appends.
+        Returns None on accept, or the current-token dict the shipper
+        needs to recover (409 payload)."""
+        with self._lock:
+            st = self._cursor(key)
+            if reset:
+                if offset != 0:
+                    self._reject("bad-reset")
+                    return {"offset": st["offset"],
+                            "prefix_sha": st["sha"].hexdigest()}
+                # explicit re-ingest-from-zero: the producer's WAL was
+                # rewritten out from under its shipper (seek() failed
+                # locally) — truncate and start over
+                p = self._wal_path(key)
+                p.parent.mkdir(parents=True, exist_ok=True)
+                with open(p, "wb"):
+                    pass
+                st["offset"] = 0
+                st["sha"] = hashlib.sha256()
+                logger.warning("fleet ingest: %s reset to offset 0",
+                               key)
+            if offset != st["offset"]:
+                self._reject("stale-token" if offset < st["offset"]
+                             else "gap")
+                return {"offset": st["offset"],
+                        "prefix_sha": st["sha"].hexdigest()}
+            if prefix_sha != st["sha"].hexdigest():
+                self._reject("diverged")
+                return {"offset": st["offset"],
+                        "prefix_sha": st["sha"].hexdigest()}
+            sha = st["sha"].copy()
+            sha.update(body)
+            if chunk_sha != sha.hexdigest():
+                # corrupt in flight: no cursor movement, no disk write
+                self._reject("bad-chunk")
+                return {"offset": st["offset"],
+                        "prefix_sha": st["sha"].hexdigest()}
+            p = self._wal_path(key)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            with open(p, "ab") as f:
+                f.write(body)
+                f.flush()
+            st["sha"] = sha
+            st["offset"] += len(body)
+            st["bytes"] += len(body)
+            self.registry.counter(
+                "fleet_ingest_bytes_total",
+                "WAL bytes accepted over the ingest plane"
+                ).inc(len(body))
+            self.registry.counter(
+                "fleet_ingest_chunks_total",
+                "WAL chunks accepted over the ingest plane").inc()
+            return None
+
+    def finalize_run(self, key: str, sha256: str,
+                     body: bytes) -> bool:  # owner: worker
+        """Atomically installs the authoritative ``history.jsonl`` —
+        the producer's run is over. Digest-checked like every other
+        byte on this wire."""
+        if hashlib.sha256(body).hexdigest() != sha256:
+            self._reject("bad-chunk")
+            return False
+        d = self.store_root / key
+        d.mkdir(parents=True, exist_ok=True)
+        _atomic_write_bytes(d / "history.jsonl", body)
+        return True
+
+    def ingest_stats(self) -> dict:
+        """(bytes-by-run, total) snapshot for the status plane."""
+        with self._lock:
+            return {k: st["bytes"] for k, st in self._runs.items()}
+
+    # -- http plumbing --------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802
+                logger.debug("ingest: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes = b"",
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                if body:
+                    self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _run_key(self) -> str | None:
+                parts = self.path.split("/")
+                # "/wal/<name>/<ts>" -> ["", "wal", name, ts]
+                if len(parts) != 4:
+                    return None
+                name, ts = parts[2], parts[3]
+                if not (_SEGMENT.match(name) and _SEGMENT.match(ts)):
+                    return None
+                return name + "/" + ts
+
+            def _body(self) -> bytes | None:
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    return None
+                if n < 0 or n > MAX_CHUNK_BYTES:
+                    return None
+                return self.rfile.read(n)
+
+            def do_GET(self) -> None:  # noqa: N802  # owner: worker
+                if self.path.startswith("/wal/"):
+                    key = self._run_key()
+                    if key is None:
+                        self._send(404)
+                        return
+                    self._send(200, json.dumps(
+                        server.token(key)).encode())
+                elif self.path == "/fleet-status.json":
+                    try:
+                        data = (server.store_root
+                                / "fleet-status.json").read_bytes()
+                    except OSError:
+                        self._send(404)
+                        return
+                    self._send(200, data)
+                elif self.path == "/metrics":
+                    self._send(200,
+                               server.registry.render_prom().encode(),
+                               ctype="text/plain; version=0.0.4")
+                else:
+                    self._send(404)
+
+            def do_POST(self) -> None:  # noqa: N802  # owner: worker
+                key = self._run_key()
+                body = self._body()
+                if key is None or body is None:
+                    self._send(400)
+                    return
+                h = self.headers
+                if self.path.startswith("/wal/"):
+                    try:
+                        offset = int(h.get("X-Jepsen-Offset", ""))
+                    except ValueError:
+                        self._send(400)
+                        return
+                    current = server.append_chunk(
+                        key, offset,
+                        h.get("X-Jepsen-Prefix-Sha", ""),
+                        h.get("X-Jepsen-Chunk-Sha", ""), body,
+                        reset=h.get("X-Jepsen-Reset") == "1")
+                    if current is None:
+                        self._send(204)
+                    else:
+                        self._send(409,
+                                   json.dumps(current).encode())
+                elif self.path.startswith("/final/"):
+                    if server.finalize_run(
+                            key, h.get("X-Jepsen-Sha256", ""), body):
+                        self._send(204)
+                    else:
+                        self._send(400)
+                else:
+                    self._send(404)
+
+        return Handler
+
+    def start(self) -> "IngestServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="fleet-ingest", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            join_noisy(self._thread, "fleet ingest server",
+                       max_wait_s=10.0)
+            self._thread = None
+        self._httpd.server_close()

@@ -1,0 +1,189 @@
+"""The producer-side WAL shipper (``jepsen-tpu ship <run-dir>``).
+
+Rides :class:`jepsen_tpu.journal.WalTailer` — the same cursor the live
+daemon tails with locally — so what goes on the wire is exactly the
+newline-terminated prefix a local checker would have consumed: torn
+final lines stay home until their newline lands.
+
+Recovery is a ladder, cheapest rung first (doc/observability.md "Fleet
+plane"):
+
+1. normal append — POST at the tailer's own ``(offset, prefix_sha)``;
+2. on 409 the receiver's current token comes back — a **fresh** local
+   tailer ``seek()``\\ s to it (hash-verified against the local WAL), so
+   a shipper restart or a receiver that is ahead/behind fast-forwards
+   without re-sending what already landed;
+3. when that seek fails — the local WAL no longer hash-matches what
+   the receiver holds (mid-file rewrite, a new run reusing the dir) —
+   the only honest move is an explicit offset-0 ``X-Jepsen-Reset`` and
+   a full re-ship. Divergence costs a re-send, never a wrong byte.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from jepsen_tpu.journal import WAL_NAME, WalTailer
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_POLL_S = 0.2
+HTTP_TIMEOUT_S = 10.0
+
+_EMPTY_SHA = hashlib.sha256().hexdigest()
+
+
+class Shipper:
+    """Ships one run dir's WAL to an ingest receiver."""
+
+    def __init__(self, run_dir, base_url: str,
+                 poll_s: float = DEFAULT_POLL_S):
+        self.run_dir = Path(run_dir)
+        self.base = base_url.rstrip("/")
+        self.key = (self.run_dir.parent.name + "/" + self.run_dir.name)
+        self.poll_s = poll_s
+        self.tailer = WalTailer(self.run_dir / WAL_NAME)
+        self.chunks_sent = 0
+        self.bytes_sent = 0
+        self.resets = 0
+        self.finalized = False
+
+    # -- wire -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 headers: dict | None = None):  # blocking: rpc
+        """One HTTP exchange; returns (status, body) or None when the
+        receiver is unreachable (the caller's loop retries)."""
+        req = urllib.request.Request(self.base + path, data=body,
+                                     headers=headers or {},
+                                     method=method)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=HTTP_TIMEOUT_S) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            logger.warning("ship %s: receiver unreachable (%s)",
+                           self.key, e)
+            return None
+
+    # -- recovery ladder ------------------------------------------------
+
+    def _recover(self, token: dict) -> bool:
+        """Repositions at the receiver's token, or resets the receiver
+        to 0 when the local WAL diverged from what it holds. Returns
+        False only when the receiver is unreachable."""
+        fresh = WalTailer(self.run_dir / WAL_NAME)
+        offset = int(token.get("offset", 0))
+        if offset > 0 and fresh.seek(
+                offset, prefix_sha=token.get("prefix_sha")):
+            logger.info("ship %s: resumed at receiver offset %d",
+                        self.key, offset)
+            self.tailer = fresh
+            return True
+        # local prefix doesn't hash to what the receiver absorbed:
+        # re-ingest from zero, explicitly
+        got = self._request(
+            "POST", "/wal/" + self.key,
+            headers={"X-Jepsen-Offset": "0",
+                     "X-Jepsen-Prefix-Sha": _EMPTY_SHA,
+                     "X-Jepsen-Chunk-Sha": _EMPTY_SHA,
+                     "X-Jepsen-Reset": "1"})
+        if got is None:
+            return False
+        self.resets += 1
+        self.tailer = WalTailer(self.run_dir / WAL_NAME)
+        logger.warning("ship %s: local WAL diverged from receiver; "
+                       "reset and re-shipping from 0", self.key)
+        return True
+
+    def sync(self) -> bool:
+        """Adopts the receiver's current cursor before the first ship —
+        a restarted shipper continues instead of colliding."""
+        got = self._request("GET", "/wal/" + self.key)
+        if got is None or got[0] != 200:
+            return False
+        token = json.loads(got[1])
+        if int(token.get("offset", 0)) == 0:
+            return True  # both sides at zero already
+        return self._recover(token)
+
+    # -- shipping -------------------------------------------------------
+
+    def step(self) -> int:
+        """Ships one WAL poll's worth of complete lines. Returns bytes
+        shipped (0: nothing new, or receiver unreachable)."""
+        pre_off = self.tailer.offset
+        pre_sha = self.tailer.prefix_sha()
+        body = self.tailer.poll_bytes()
+        if not body:
+            return 0
+        got = self._request(
+            "POST", "/wal/" + self.key, body=body,
+            headers={"X-Jepsen-Offset": str(pre_off),
+                     "X-Jepsen-Prefix-Sha": pre_sha,
+                     "X-Jepsen-Chunk-Sha": self.tailer.prefix_sha()})
+        if got is None:
+            # undo nothing: the tailer advanced, but recovery re-syncs
+            # it from the receiver's token on the next step
+            self.tailer = WalTailer(self.run_dir / WAL_NAME)
+            self.sync()
+            return 0
+        status, resp = got
+        if status == 204:
+            self.chunks_sent += 1
+            self.bytes_sent += len(body)
+            return len(body)
+        if status == 409:
+            try:
+                token = json.loads(resp)
+            except ValueError:
+                token = {}
+            self._recover(token)
+            return 0
+        logger.warning("ship %s: receiver said %s", self.key, status)
+        return 0
+
+    def _final_path(self) -> Path:
+        return self.run_dir / "history.jsonl"
+
+    def finalize(self) -> bool:
+        """Ships the authoritative history.jsonl once the run is over
+        and the WAL is fully drained."""
+        try:
+            body = self._final_path().read_bytes()
+        except OSError:
+            return False
+        got = self._request(
+            "POST", "/final/" + self.key, body=body,
+            headers={"X-Jepsen-Sha256":
+                     hashlib.sha256(body).hexdigest()})
+        if got is not None and got[0] == 204:
+            self.finalized = True
+            return True
+        return False
+
+    def run(self, timeout_s: float = 300.0) -> bool:
+        """Ships until the run completes (history.jsonl shipped) or the
+        deadline passes. Returns True when fully shipped + finalized."""
+        deadline = time.monotonic() + timeout_s
+        self.sync()
+        while time.monotonic() < deadline:
+            shipped = self.step()
+            if shipped:
+                continue  # drain hot WALs without sleeping
+            if self._final_path().exists():
+                # run is over; one last drain for the WAL tail, then
+                # ship the authoritative history
+                while self.step():
+                    pass
+                if self.finalize():
+                    return True
+            time.sleep(self.poll_s)
+        return False

@@ -1,0 +1,151 @@
+"""The fleet status plane: one aggregated, atomically-published view.
+
+``fleet-status.json`` is the dashboard's (and any curl's) single read:
+per-run ``live_*`` state rolled up across the pool — runs active /
+deferred / invalid, the worst checker lag and who owns it, breaker
+trips, the elastic mesh's current width, ingest throughput — plus a
+top-K-by-lag run table whose rows link straight into each run's
+existing artifacts (live-status.json, anomaly explains, the witness
+timeline, the causal trace). Published with the same tmp+fsync+rename
+discipline as every other status file (telemetry._atomic_write), so a
+reader never sees a torn fleet view.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+
+from jepsen_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+FLEET_STATUS_NAME = "fleet-status.json"
+TOP_RUNS = 10
+
+# artifacts a run row links to, when present in its run dir
+_LINKABLE = ("live-status.json", "anomaly.json", "witness-timeline.html",
+             "trace.json", "history.jsonl")
+
+
+def _counter_total(snap: list[dict], name: str) -> float:
+    return sum(s.get("value", 0.0) for s in snap if s["name"] == name)
+
+
+def _mesh_view() -> dict:
+    from jepsen_tpu import parallel
+    failed = sorted(parallel.failed_device_ids())
+    width = 0
+    try:
+        import jax
+        width = parallel._pow2_floor(
+            max(1, len(jax.devices()) - len(failed)))
+    except Exception:  # noqa: BLE001 — no accelerator runtime is a fine fleet state
+        pass
+    return {"width": width, "failed_devices": failed}
+
+
+class FleetStatus:
+    """Accumulates cross-poll state (throughput deltas) and writes the
+    aggregate. One instance per fleet daemon, touched only by the
+    scheduler poll loop — no locking needed."""
+
+    def __init__(self, store_root, registry: telemetry.Registry):
+        self.store_root = Path(store_root)
+        self.registry = registry
+        self.polls = 0
+        self._prev_bytes = 0.0
+        self._prev_t = time.monotonic()
+        # labels seen reaching "final": trackers pop once settled, so
+        # the dashboard's finals count must outlive them
+        self._finals_seen: set[str] = set()
+        self._invalid_seen: set[str] = set()
+
+    def _run_row(self, label: str, st: dict) -> dict:
+        run_dir = self.store_root / label
+        links = {a: label + "/" + a for a in _LINKABLE
+                 if (run_dir / a).exists()}
+        return {
+            "name": label.split("/", 1)[0],
+            "timestamp": label.split("/", 1)[-1],
+            "state": st.get("state"),
+            "valid_so_far": st.get("valid_so_far"),
+            "lag_ops": st.get("lag_ops", 0),
+            "lag_s": st.get("lag_s", 0.0),
+            "first_anomaly_op": st.get("first_anomaly_op"),
+            "breaker_open": st.get("state") == "error",
+            "links": links,
+        }
+
+    def write(self, statuses: dict, ingest_by_run: dict) -> dict:
+        """Aggregates this poll's per-run statuses + ingest cursors and
+        atomically publishes fleet-status.json; returns the payload."""
+        self.polls += 1
+        snap = self.registry.snapshot()
+        now = time.monotonic()
+        bytes_total = _counter_total(snap, "fleet_ingest_bytes_total")
+        dt = max(1e-9, now - self._prev_t)
+        bytes_per_s = max(0.0, bytes_total - self._prev_bytes) / dt
+        self._prev_bytes, self._prev_t = bytes_total, now
+
+        sts = list(statuses.items())
+        for k, st in sts:
+            if st.get("state") == "final":
+                self._finals_seen.add(k)
+            if st.get("valid_so_far") is False:
+                self._invalid_seen.add(k)
+        worst = max(sts, key=lambda kv: kv[1].get("lag_ops", 0),
+                    default=None)
+        ranked = sorted(sts, key=lambda kv: kv[1].get("lag_ops", 0),
+                        reverse=True)[:TOP_RUNS]
+        payload = {
+            "version": 1,
+            "updated": time.time(),
+            "polls": self.polls,
+            "runs": {
+                "tracked": len(sts),
+                "active": sum(1 for _, st in sts
+                              if st.get("state") != "final"),
+                "invalid": len(self._invalid_seen),
+                "final": len(self._finals_seen),
+                "breaker_open": sum(1 for _, st in sts
+                                    if st.get("state") == "error"),
+                "deferred_total": _counter_total(
+                    snap, "live_admission_deferred_total"),
+            },
+            "worst_lag_ops": (worst[1].get("lag_ops", 0)
+                              if worst else 0),
+            "worst_lag_run": worst[0] if worst else None,
+            "mesh": {
+                **_mesh_view(),
+                "shrinks": _counter_total(snap, "mesh_shrink_total"),
+                "regrows": _counter_total(snap, "mesh_regrow_total"),
+            },
+            "ingest": {
+                "bytes_total": bytes_total,
+                "bytes_per_s": bytes_per_s,
+                "chunks_total": _counter_total(
+                    snap, "fleet_ingest_chunks_total"),
+                "rejected_total": _counter_total(
+                    snap, "fleet_ingest_rejected_total"),
+                "runs": len(ingest_by_run),
+            },
+            "top_runs": [self._run_row(k, st) for k, st in ranked],
+        }
+        try:
+            telemetry._atomic_write(
+                self.store_root / FLEET_STATUS_NAME,
+                json.dumps(payload, indent=1))
+        except OSError:
+            logger.exception("fleet-status.json write failed")
+        return payload
+
+
+def load_fleet_status(store_root) -> dict | None:
+    try:
+        with open(Path(store_root) / FLEET_STATUS_NAME,
+                  encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -341,6 +341,31 @@ class WalTailer:
                            "in %s", self.path)
         return ops
 
+    def poll_bytes(self) -> bytes:
+        """Raw shipping twin of :meth:`poll`: the newline-terminated
+        bytes appended since the last poll, advancing ``offset`` /
+        ``lines_read`` / the prefix digest in lockstep — WITHOUT
+        parsing. The fleet ingest plane ships these bytes verbatim, so
+        the receiver's file is a byte-identical prefix of the source
+        WAL and its checker verdicts match the local path bit for bit
+        (doc/observability.md "Fleet plane").
+
+        The torn-boundary contract is inherited: an in-progress final
+        line (no trailing newline yet) is left unread, so a shipped
+        chunk never ends mid-document and ``(offset, prefix_sha())``
+        stays a valid resume token at every chunk boundary."""
+        chunk = self._read_new()
+        if not chunk:
+            return b""
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return b""  # only an in-progress line so far: ship nothing
+        body = chunk[:nl + 1]
+        self.lines_read += body.count(b"\n")
+        self.offset += len(body)
+        self._sha.update(body)
+        return body
+
     def finalize(self) -> list[dict]:
         return self.poll(final=True)
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from pathlib import Path
@@ -58,6 +59,13 @@ DEFAULT_LAG_BUDGET_OPS = 50_000
 DEFAULT_MAX_RUNS = 16
 DEFAULT_CHECK_BUDGET_S = 0.5
 LIVE_BREAKER_THRESHOLD = 3
+
+# Cap on distinct {run} label values in the per-run metric export: at
+# fleet scale (100+ concurrent runs) one series per run per gauge is a
+# cardinality explosion every scrape pays for. The top-K runs by lag
+# keep their own series; the rest fold into one run="other" aggregate
+# (doc/observability.md "Fleet plane"). Env-tunable for big hosts.
+DEFAULT_RUN_SERIES_TOPK = 8
 
 # live knob spec shared with preflight's KNB validation
 # (analysis/preflight._NUMERIC_KNOBS): (key, default, min)
@@ -428,6 +436,19 @@ class LiveDaemon:
         self.cost_model = cost_model
         self.trackers: dict[str, RunTracker] = {}
         self.polls = 0
+        self.run_series_topk = int(coerce_knob(
+            "JEPSEN_TPU_LIVE_RUN_SERIES",
+            os.environ.get("JEPSEN_TPU_LIVE_RUN_SERIES"),
+            DEFAULT_RUN_SERIES_TOPK, 1.0))
+        # discovery cache: {name_dir: (mtime_ns, [run_dirs])} — a name
+        # dir's run list is reused between polls while its mtime holds
+        self._scan_cache: dict | None = None
+        # candidates examined and rejected, keyed by run-dir mtime_ns:
+        # skipped with ONE stat per poll until something changes inside
+        self._settled: dict[str, int] = {}
+        # stable {run} label interning for per-run counters (bounded
+        # at run_series_topk exact labels; later runs share "other")
+        self._run_labels: dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()  # guards trackers vs. stop/inspect
@@ -435,37 +456,80 @@ class LiveDaemon:
     # -- discovery ------------------------------------------------------
 
     def _candidate_dirs(self) -> list[Path]:
+        """Run-dir candidates under the store root, via a per-name-dir
+        cached scan with an mtime fast-path: run dirs are created and
+        removed *inside* name dirs, so an unchanged name-dir mtime
+        proves its cached run-dir list is still complete. A poll over
+        an unchanged tree costs one root listing plus one stat per
+        name dir, not an O(runs) listing — a 100+-run store root used
+        to pay the full re-scan every tick. (The root's own mtime is
+        deliberately not part of the key: the metrics export writes
+        files there every poll.)"""
         out = list(self.run_dirs)
         root = self.store_root
-        if root is not None and root.is_dir():
-            for name_dir in root.iterdir():
-                if not name_dir.is_dir() or name_dir.name == "current" \
-                        or name_dir.is_symlink():
-                    continue
-                for run_dir in name_dir.iterdir():
-                    if run_dir.is_dir() and not run_dir.is_symlink() \
-                            and run_dir.name != "latest":
-                        out.append(run_dir)
+        if root is None or not root.is_dir():
+            return out
+        cache = self._scan_cache
+        fresh: dict[Path, tuple[int, list[Path]]] = {}
+        all_hit = cache is not None
+        for name_dir in root.iterdir():
+            if not name_dir.is_dir() or name_dir.name == "current" \
+                    or name_dir.is_symlink():
+                continue
+            try:
+                m = name_dir.stat().st_mtime_ns
+            except OSError:
+                continue
+            got = cache.get(name_dir) if cache is not None else None
+            if got is not None and got[0] == m:
+                fresh[name_dir] = got
+                out.extend(got[1])
+                continue
+            all_hit = False
+            runs = [run_dir for run_dir in name_dir.iterdir()
+                    if run_dir.is_dir() and not run_dir.is_symlink()
+                    and run_dir.name != "latest"]
+            fresh[name_dir] = (m, runs)
+            out.extend(runs)
+        self._scan_cache = fresh
+        if all_hit:
+            self.registry.counter(
+                "live_scan_cache_hits_total",
+                "discovery polls answered entirely from the cached "
+                "store scan (name-dir mtime fast-path)").inc()
         return out
 
     def discover(self) -> int:
         """Adds trackers for active runs (WAL present, not yet final),
         newest first, bounded by ``live_max_runs``. Returns the number
-        of newly-admitted runs."""
+        of newly-admitted runs. Candidates rejected once are skipped
+        with a single run-dir stat until their mtime changes (a WAL or
+        status file appearing bumps it), so settled runs cost O(1) per
+        poll instead of a WAL stat + a status-JSON parse each."""
         added = 0
         cands = []
         for d in self._candidate_dirs():
             key = str(d)
             if key in self.trackers:
                 continue
+            try:
+                d_m = d.stat().st_mtime_ns
+            except OSError:
+                continue
+            if self._settled.get(key) == d_m:
+                continue  # rejected before; nothing changed inside since
             if not (d / WAL_NAME).exists():
+                self._settled[key] = d_m
                 continue
             status = load_live_status(d)
             if status is not None and status.get("state") == "final":
-                continue  # a previous daemon already settled this run
+                # a previous daemon already settled this run
+                self._settled[key] = d_m
+                continue
             if (d / "history.jsonl").exists() and status is None \
                     and d not in self.run_dirs:
                 # completed before we ever saw it: post-hoc territory
+                self._settled[key] = d_m
                 continue
             try:
                 mtime = (d / WAL_NAME).stat().st_mtime
@@ -526,6 +590,7 @@ class LiveDaemon:
         with self._lock:
             trackers = list(self.trackers.values())
         statuses: dict[str, dict] = {}
+        rows: list[tuple[RunTracker, dict]] = []
         done: list[str] = []
 
         for tr in trackers:
@@ -533,7 +598,7 @@ class LiveDaemon:
             if n:
                 reg.counter("live_ops_tailed_total",
                             "ops read from run WALs", labels=("run",)
-                            ).inc(n, run=tr.label)
+                            ).inc(n, run=self._run_label(tr.label))
 
         # admission: serve the most-lagged runs first; a poll spends at
         # most live_check_budget_s of predicted CPU checking time, so
@@ -571,7 +636,7 @@ class LiveDaemon:
                         "live_admission_deferred_total",
                         "verdicts deferred to a later poll by the "
                         "admission budget", labels=("run",)
-                        ).inc(run=tr.label)
+                        ).inc(run=self._run_label(tr.label))
                 else:
                     t_chk = time.perf_counter()
                     chk_t0 = trace_mod.now_us() if tracer.enabled else 0
@@ -593,7 +658,8 @@ class LiveDaemon:
                                now=now)
             tr.write_status(status)
             statuses[tr.label] = status
-            self._export_run_gauges(tr, status)
+            rows.append((tr, status))
+        self._publish_run_series(rows)
 
         with self._lock:
             for key in done:
@@ -628,30 +694,95 @@ class LiveDaemon:
             from jepsen_tpu.parallel.pipeline import observe_cpu_rate
             observe_cpu_rate(n_ops, seconds)
 
-    def _export_run_gauges(self, tr: RunTracker, status: dict) -> None:
+    def _run_label(self, label: str) -> str:
+        """Bounded {run} label interning for per-run counters: the first
+        ``run_series_topk`` distinct runs keep their exact label; every
+        later run shares ``"other"`` so a fleet-scale store can't blow
+        up prom series cardinality. Counters can't be re-labeled after
+        the fact (their value is cumulative), so the mapping is sticky
+        for the daemon's lifetime."""
+        got = self._run_labels.get(label)
+        if got is not None:
+            return got
+        if len(self._run_labels) < self.run_series_topk:
+            self._run_labels[label] = label
+            return label
+        return "other"
+
+    def _publish_run_series(self, rows: list) -> None:
+        """Rebuilds the {run}-labeled gauges from this poll's statuses:
+        exact series for the top-K most-lagged runs, one ``run="other"``
+        aggregate for the rest (worst lag / worst verdict / summed open
+        breakers), and the unlabeled fleet rollups. Gauges are cleared
+        first so runs that finished or fell out of the top K don't
+        linger as stale series."""
         reg = self.registry
-        run = tr.label
-        reg.gauge("live_checker_lag_ops",
-                  "ops absorbed but not yet covered by a verdict",
-                  labels=("run",)).set(status["lag_ops"], run=run)
-        reg.gauge("live_checker_lag_s",
-                  "seconds since this run's checker last caught up",
-                  labels=("run",)).set(status["lag_s"], run=run)
-        valid = status.get("valid_so_far")
-        reg.gauge("live_verdict",
-                  "1 valid-so-far, 0 invalid, -1 unknown/untracked",
-                  labels=("run",)).set(
-            1.0 if valid is True else 0.0 if valid is False else -1.0,
-            run=run)
-        first = status.get("first_anomaly_op")
-        reg.gauge("live_first_anomaly_op",
-                  "history index of the first anomaly (-1: none found)",
-                  labels=("run",)).set(
-            -1.0 if first is None else float(first), run=run)
-        if tr.broken:
-            reg.gauge("live_run_breaker_open",
-                      "1 while a run's checker circuit breaker is open",
-                      labels=("run",)).set(1.0, run=run)
+        lag_g = reg.gauge("live_checker_lag_ops",
+                          "ops absorbed but not yet covered by a verdict",
+                          labels=("run",))
+        lag_s_g = reg.gauge("live_checker_lag_s",
+                            "seconds since this run's checker last "
+                            "caught up", labels=("run",))
+        verdict_g = reg.gauge("live_verdict",
+                              "1 valid-so-far, 0 invalid, -1 "
+                              "unknown/untracked", labels=("run",))
+        first_g = reg.gauge("live_first_anomaly_op",
+                            "history index of the first anomaly "
+                            "(-1: none found)", labels=("run",))
+        breaker_g = reg.gauge("live_run_breaker_open",
+                              "1 while a run's checker circuit breaker "
+                              "is open (other: open-breaker count)",
+                              labels=("run",))
+        for g in (lag_g, lag_s_g, verdict_g, first_g, breaker_g):
+            g.clear()
+
+        ranked = sorted(rows, key=lambda r: r[1]["lag_ops"],
+                        reverse=True)
+        exact, other = ranked[:self.run_series_topk], \
+            ranked[self.run_series_topk:]
+        for tr, st in exact:
+            run = tr.label
+            lag_g.set(st["lag_ops"], run=run)
+            lag_s_g.set(st["lag_s"], run=run)
+            valid = st.get("valid_so_far")
+            verdict_g.set(
+                1.0 if valid is True else
+                0.0 if valid is False else -1.0, run=run)
+            first = st.get("first_anomaly_op")
+            first_g.set(-1.0 if first is None else float(first),
+                        run=run)
+            if tr.broken:
+                breaker_g.set(1.0, run=run)
+        if other:
+            sts = [st for _, st in other]
+            lag_g.set(max(st["lag_ops"] for st in sts), run="other")
+            lag_s_g.set(max(st["lag_s"] for st in sts), run="other")
+            valids = [st.get("valid_so_far") for st in sts]
+            # worst-case ordering: any invalid beats any unknown beats
+            # all-valid (a plain min() would rank unknown below invalid)
+            verdict_g.set(
+                0.0 if any(v is False for v in valids) else
+                -1.0 if any(v is None for v in valids) else 1.0,
+                run="other")
+            broken = sum(1 for tr, _ in other if tr.broken)
+            if broken:
+                breaker_g.set(float(broken), run="other")
+
+        # unlabeled fleet rollups: always cheap to scrape no matter how
+        # many runs the pool holds
+        all_sts = [st for _, st in rows]
+        reg.gauge("fleet_runs_active",
+                  "runs tracked by this pool that are not yet final"
+                  ).set(sum(1 for st in all_sts
+                            if st.get("state") != "final"))
+        reg.gauge("fleet_worst_lag_ops",
+                  "largest per-run checker lag across the pool"
+                  ).set(max((st["lag_ops"] for st in all_sts),
+                            default=0))
+        reg.gauge("fleet_invalid_runs",
+                  "runs whose live verdict is invalid-so-far"
+                  ).set(sum(1 for st in all_sts
+                            if st.get("valid_so_far") is False))
 
     def _export(self) -> None:
         d = self.store_root

@@ -253,6 +253,70 @@ def shrink_mesh(mesh, exc=None, min_devices: int | None = None,
     return new
 
 
+def probe_device(device) -> bool:
+    """One tiny H2D+D2H round trip on a single device — the heal
+    probe. True means the device answered; False (any failure) means
+    it stays on the unhealthy list."""
+    try:
+        import jax
+        jax.device_get(jax.device_put(np.zeros(8, np.float32), device))
+        return True
+    except Exception:  # noqa: BLE001 — an unhealable device is just unhealed
+        return False
+
+
+def regrow_mesh(axis: str = "keys", probe=probe_device):
+    """The elastic mesh's heal path: re-probe every device marked
+    unhealthy, clear the ones that answer, and return the regrown mesh
+    — or None when nothing healed (or healing didn't widen a
+    power-of-two step, so the working width is unchanged).
+
+    The twin of :func:`shrink_mesh`: shrink reacts to a dispatch
+    failure, regrow reacts to the fleet scheduler's periodic heal probe
+    (doc/robustness.md "The elastic mesh"). Widths stay powers of two
+    for the same reason shrink's do — compile caches and the per-width
+    rate EWMAs key on width. Counts ``mesh_regrow_total{from,to}``."""
+    import jax
+    failed = failed_device_ids()
+    if not failed:
+        return None
+    try:
+        all_devs = jax.devices()
+    except Exception:  # noqa: BLE001 — backend gone entirely
+        return None
+    n_from = _pow2_floor(max(1, len(all_devs) - len(failed)))
+    healed = [d.id for d in all_devs
+              if d.id in failed and probe(d)]
+    if not healed:
+        return None
+    with _HEALTH_LOCK:
+        for i in healed:
+            _FAILED_DEVICES.discard(i)
+    still_failed = failed_device_ids()
+    n_to = _pow2_floor(max(1, len(all_devs) - len(still_failed)))
+    if n_to <= n_from or n_to < 2:
+        return None
+    new = auto_mesh(n_to, axis=axis)
+    if new is None:
+        return None
+    from jepsen_tpu import telemetry
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("mesh_regrow_total",
+                    "elastic mesh regrows after device heal probes, "
+                    "by width transition",
+                    labels=("from", "to")).inc(
+            **{"from": str(n_from), "to": str(int(new.devices.size))})
+    from jepsen_tpu import trace as trace_mod
+    trace_mod.get_tracer().instant(
+        trace_mod.TRACK_LADDER, "mesh-regrow",
+        args={"from": n_from, "to": int(new.devices.size),
+              "healed": healed})
+    logger.info("mesh regrown %d -> %d devices (healed: %s)",
+                n_from, int(new.devices.size), healed)
+    return new
+
+
 def auto_mesh(n_devices: int | None = None, axis: str = "keys"):
     """The cached 1-D mesh a sharded checker dispatch should run over,
     or None when fewer than 2 devices would participate. ``n_devices``

@@ -88,6 +88,14 @@ class _Family:
         with self._lock:
             return sorted(self._children.items())
 
+    def clear(self) -> None:
+        """Drops every child series. For per-snapshot-rebuilt label
+        sets — the live daemon's capped ``{run}`` gauges re-rank which
+        runs keep their own series on every poll, and a run that fell
+        out of the top-K must stop exporting a stale value."""
+        with self._lock:
+            self._children.clear()
+
 
 class Counter(_Family):
     """Monotone sum. ``inc(amount, **labels)``."""
@@ -419,6 +427,9 @@ class _NullInstrument:
 
     def quantile(self, q: float, **labels):
         return None
+
+    def clear(self) -> None:
+        pass
 
 
 _NULL_INSTRUMENT = _NullInstrument()

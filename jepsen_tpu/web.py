@@ -371,6 +371,8 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if path == "/" or path == "":
                 return self._home(base)
+            if path == "/fleet":
+                return self._fleet(base)
             if path.startswith("/zip/"):
                 return self._zip(base, path[len("/zip/"):])
             return self._files(base, path.lstrip("/"))
@@ -408,13 +410,77 @@ class Handler(BaseHTTPRequestHandler):
                     f"<td>{links}</td>"
                     f"<td><a href='/zip/{name}/{ts}'>zip</a></td></tr>")
         live = _live_home_section(tests)
-        body = (live + "<h2>runs</h2>" if live else "") \
+        fleet = ("<p><a href='/fleet'>fleet dashboard</a></p>"
+                 if (base / "fleet-status.json").exists() else "")
+        body = fleet + (live + "<h2>runs</h2>" if live else "") \
             + ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
                "<th>telemetry</th><th>download</th></tr>"
                + "".join(rows) + "</table>")
         head = (f"<meta http-equiv='refresh' content='{LIVE_REFRESH_S}'>"
                 if live else "")
         self._send(self._page("Jepsen-TPU", body, head_extra=head))
+
+    def _fleet(self, base: Path):
+        """The fleet dashboard: renders ``fleet-status.json`` (the pool
+        scheduler's atomically-published aggregate — doc/observability.md
+        "Fleet plane") with first-anomaly links into each run's explain
+        and trace artifacts."""
+        try:
+            with open(base / "fleet-status.json", encoding="utf-8") as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            return self._send(self._page(
+                "fleet", "<p>no fleet-status.json — is a fleet daemon "
+                "writing to this store?</p>"), code=404)
+        runs, mesh, ing = st.get("runs", {}), st.get("mesh", {}), \
+            st.get("ingest", {})
+        stale = time.time() - float(st.get("updated", 0)) > LIVE_FRESH_S
+        badge = (" <span class='badge-incomplete'>stale</span>"
+                 if stale else "")
+        worst = st.get("worst_lag_run")
+        cards = (
+            f"<p>runs: <b>{runs.get('active', 0)}</b> active / "
+            f"{runs.get('tracked', 0)} tracked / "
+            f"{runs.get('final', 0)} final / "
+            f"<b class='valid-false'>{runs.get('invalid', 0)}"
+            f" invalid</b> / {runs.get('breaker_open', 0)} breaker open"
+            f" / {int(runs.get('deferred_total', 0))} deferred{badge}"
+            f"</p>"
+            f"<p>worst lag: <b>{st.get('worst_lag_ops', 0)}</b> ops"
+            + (f" ({html.escape(str(worst))})" if worst else "")
+            + f" · mesh: <b>{mesh.get('width', 0)}</b> devices wide, "
+            f"failed {mesh.get('failed_devices', [])}, "
+            f"{int(mesh.get('shrinks', 0))} shrinks / "
+            f"{int(mesh.get('regrows', 0))} regrows"
+            f" · ingest: {ing.get('bytes_per_s', 0.0):.0f} B/s, "
+            f"{int(ing.get('bytes_total', 0))} B total, "
+            f"{int(ing.get('rejected_total', 0))} rejected</p>")
+        rows = []
+        for r in st.get("top_runs", []):
+            valid = r.get("valid_so_far")
+            cls = {True: "valid-true", False: "valid-false"}.get(
+                valid, "valid-unknown")
+            rel = f"{r.get('name')}/{r.get('timestamp')}"
+            first = r.get("first_anomaly_op")
+            links = " ".join(
+                f"<a href='/{html.escape(p)}'>{html.escape(a)}</a>"
+                for a, p in sorted(r.get("links", {}).items()))
+            rows.append(
+                f"<tr class='{cls}'>"
+                f"<td><a href='/{html.escape(rel)}/'>"
+                f"{html.escape(rel)}</a></td>"
+                f"<td>{html.escape(str(r.get('state')))}</td>"
+                f"<td>{valid}</td>"
+                f"<td>{r.get('lag_ops', 0)}</td>"
+                f"<td>{'-' if first is None else first}</td>"
+                f"<td>{links}</td></tr>")
+        table = ("<h2>most lagged runs</h2>"
+                 "<table><tr><th>run</th><th>state</th><th>valid?</th>"
+                 "<th>lag (ops)</th><th>first anomaly</th>"
+                 "<th>artifacts</th></tr>" + "".join(rows) + "</table>"
+                 if rows else "<p>no tracked runs this poll</p>")
+        head = f"<meta http-equiv='refresh' content='{LIVE_REFRESH_S}'>"
+        self._send(self._page("fleet", cards + table, head_extra=head))
 
     def _files(self, base: Path, rel: str):
         target = (base / rel).resolve()

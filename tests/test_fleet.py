@@ -1,0 +1,654 @@
+"""Fleet observability tier: network WAL ingest, pool scheduler,
+fleet status plane (doc/observability.md "Fleet plane").
+
+Covers the ISSUE-16 acceptance surface:
+
+* `WalTailer.poll_bytes` / `seek` resume-token edge cases under
+  shipping: torn final line held at the shipped boundary, a replayed
+  chunk with a stale token rejected (nothing double-absorbed), a
+  mid-file rewrite re-ingested from zero via hash-mismatch + explicit
+  reset;
+* ingest protocol: token GETs, divergence/gap rejection with reason
+  counters, receiver-restart cursor rebuild, digest-checked finals;
+* end-to-end over loopback HTTP: a producer-side fake run shipped
+  while it is written, the pool daemon settling it with a verdict
+  bit-identical to post-hoc analyze on the producer's own history;
+* per-run series capping (top-K + `other`) and the unlabeled fleet
+  rollup gauges; the discovery scan cache's mtime fast-path;
+* preflight knob rows + tolerant coercion + env twins;
+* the `/fleet` web dashboard; multi-producer e2e in the slow lane.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+pytestmark = pytest.mark.fleet
+
+
+def _register_history(n, seed=7, planted_at=None, n_procs=4):
+    from __graft_entry__ import _register_history as gen
+    h = gen(n, n_procs=n_procs, seed=seed, n_values=5)
+    planted = None
+    if planted_at is not None:
+        for i, op in enumerate(h):
+            if i >= planted_at and op.get("type") == "ok" \
+                    and op.get("f") == "read" \
+                    and op.get("value") is not None:
+                op["value"] = op["value"] + 10_000
+                planted = i
+                break
+        assert planted is not None, "no read to corrupt"
+    return h, planted
+
+
+def _write_wal(run_dir, ops, complete=False):
+    from jepsen_tpu.journal import Journal
+    run_dir.mkdir(parents=True, exist_ok=True)
+    j = Journal(run_dir / "history.wal.jsonl", fsync_interval_s=-1)
+    for op in ops:
+        j.append(op)
+    j.close()
+    if complete:
+        with open(run_dir / "history.jsonl", "w") as f:
+            for op in ops:
+                f.write(json.dumps(op) + "\n")
+
+
+@pytest.fixture()
+def ingest(tmp_path):
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.fleet.ingest import IngestServer
+    reg = telemetry.Registry()
+    srv = IngestServer(tmp_path / "fleet", port=0, registry=reg)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# WalTailer shipping seams: poll_bytes + seek resume tokens
+# ---------------------------------------------------------------------------
+
+def test_poll_bytes_holds_torn_final_line(tmp_path):
+    """The shipped boundary is always a newline: an in-progress final
+    line ships nothing (offset frozen), then ships whole once the
+    writer completes it — so a receiver never holds a torn prefix."""
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    with open(p, "w") as f:
+        f.write('{"i": 0}\n{"i": 1')  # torn in-progress tail
+        f.flush()
+        t = WalTailer(p)
+        body = t.poll_bytes()
+        assert body == b'{"i": 0}\n'
+        assert t.poll_bytes() == b""  # torn tail: nothing ships
+        off_before = t.offset
+        f.write('}\n')
+        f.flush()
+        assert t.poll_bytes() == b'{"i": 1}\n'
+        assert t.offset > off_before
+    # the running digest equals the file prefix digest — the resume
+    # token a shipper would present
+    assert t.prefix_sha() == hashlib.sha256(
+        p.read_bytes()).hexdigest()
+
+
+def test_seek_rejects_rewritten_prefix(tmp_path):
+    """The shipping resume seam: seek() adopts a token only when the
+    file's first `offset` bytes hash to it; a rewritten WAL fails and
+    leaves the tailer at 0 (re-ingest)."""
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "w.jsonl"
+    p.write_text('{"i": 0}\n{"i": 1}\n')
+    t = WalTailer(p)
+    t.poll_bytes()
+    offset, sha = t.offset, t.prefix_sha()
+
+    fresh = WalTailer(p)
+    assert fresh.seek(offset, prefix_sha=sha)
+    assert fresh.offset == offset
+
+    p.write_text('{"i": 9}\n{"i": 1}\n')  # same length, new bytes
+    diverged = WalTailer(p)
+    assert not diverged.seek(offset, prefix_sha=sha)
+    assert diverged.offset == 0  # re-ingest from zero
+
+    # file shorter than the token: also rejected
+    p.write_text('{"i"')
+    short = WalTailer(p)
+    assert not short.seek(offset, prefix_sha=sha)
+    assert short.offset == 0
+
+
+# ---------------------------------------------------------------------------
+# ingest protocol: replay, divergence, gap, reset, restart
+# ---------------------------------------------------------------------------
+
+def _ship_all(run_dir, port):
+    from jepsen_tpu.fleet.ship import Shipper
+    sh = Shipper(run_dir, f"http://127.0.0.1:{port}")
+    sh.sync()
+    while sh.step():
+        pass
+    return sh
+
+
+def test_replayed_chunk_with_stale_token_rejected(tmp_path, ingest):
+    """A replayed shipment (process restart re-sending an already-
+    absorbed chunk) bounces on its stale token and nothing is
+    double-absorbed."""
+    h, _ = _register_history(40, seed=1)
+    rd = tmp_path / "src" / "reg" / "20260806T000001"
+    _write_wal(rd, h)
+    sh = _ship_all(rd, ingest.port)
+    assert sh.chunks_sent >= 1
+
+    wal = (rd / "history.wal.jsonl").read_bytes()
+    # replay the whole WAL as one chunk at offset 0 with valid hashes:
+    # exactly what a restarted, token-less shipper would try
+    current = ingest.append_chunk(
+        "reg/20260806T000001", 0, hashlib.sha256().hexdigest(),
+        hashlib.sha256(wal).hexdigest(), wal)
+    assert current is not None  # rejected, token returned
+    assert current["offset"] == len(wal)
+    got = ingest.registry.counter(
+        "fleet_ingest_rejected_total", labels=("reason",)
+        ).value(reason="stale-token")
+    assert got == 1
+    # nothing double-absorbed: receiver copy still byte-identical
+    assert (ingest.store_root / "reg" / "20260806T000001"
+            / "history.wal.jsonl").read_bytes() == wal
+
+    # and a shipper recovering via the token re-syncs without resets
+    sh2 = _ship_all(rd, ingest.port)
+    assert sh2.resets == 0 and sh2.chunks_sent == 0
+
+
+def test_diverged_and_gap_shipments_rejected(tmp_path, ingest):
+    h, _ = _register_history(30, seed=2)
+    rd = tmp_path / "src" / "reg" / "20260806T000002"
+    _write_wal(rd, h)
+    _ship_all(rd, ingest.port)
+    key = "reg/20260806T000002"
+    token = ingest.token(key)
+
+    # same offset, wrong prefix hash -> diverged
+    bad = ingest.append_chunk(key, token["offset"], "0" * 64,
+                              hashlib.sha256(b"x").hexdigest(), b"x")
+    assert bad is not None
+    # offset beyond the receiver's -> gap
+    gap = ingest.append_chunk(key, token["offset"] + 100,
+                              token["prefix_sha"],
+                              hashlib.sha256(b"x").hexdigest(), b"x")
+    assert gap is not None and gap["offset"] == token["offset"]
+    # corrupt body (chunk digest mismatch) -> bad-chunk, cursor frozen
+    corrupt = ingest.append_chunk(key, token["offset"],
+                                  token["prefix_sha"], "0" * 64, b"x")
+    assert corrupt is not None
+    reasons = {
+        r: ingest.registry.counter(
+            "fleet_ingest_rejected_total", labels=("reason",)
+            ).value(reason=r)
+        for r in ("diverged", "gap", "bad-chunk")}
+    assert reasons == {"diverged": 1, "gap": 1, "bad-chunk": 1}
+
+
+def test_midfile_rewrite_resets_and_reships(tmp_path, ingest):
+    """The bottom rung of the recovery ladder: the producer's WAL was
+    rewritten under the shipper, the local seek() fails against the
+    receiver's token, and an explicit reset re-ingests from zero —
+    ending byte-identical to the NEW file."""
+    from jepsen_tpu.fleet.ship import Shipper
+    h, _ = _register_history(30, seed=3)
+    rd = tmp_path / "src" / "reg" / "20260806T000003"
+    _write_wal(rd, h)
+    _ship_all(rd, ingest.port)
+
+    # rewrite the WAL wholesale (a new run reusing the dir)
+    h2, _ = _register_history(20, seed=9)
+    (rd / "history.wal.jsonl").unlink()
+    _write_wal(rd, h2)
+
+    sh = Shipper(rd, f"http://127.0.0.1:{ingest.port}")
+    sh.sync()  # receiver token no longer hash-matches -> reset rung
+    while sh.step():
+        pass
+    assert sh.resets == 1
+    want = (rd / "history.wal.jsonl").read_bytes()
+    got = (ingest.store_root / "reg" / "20260806T000003"
+           / "history.wal.jsonl").read_bytes()
+    assert got == want
+
+
+def test_receiver_restart_rebuilds_cursor_from_disk(tmp_path, ingest):
+    """A receiver restart must not force a re-ship: the cursor is
+    rebuilt by hashing the WAL already on disk."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.fleet.ingest import IngestServer
+    h, _ = _register_history(30, seed=4)
+    rd = tmp_path / "src" / "reg" / "20260806T000004"
+    _write_wal(rd, h)
+    _ship_all(rd, ingest.port)
+    wal = (rd / "history.wal.jsonl").read_bytes()
+
+    srv2 = IngestServer(ingest.store_root, port=0,
+                        registry=telemetry.Registry())
+    srv2.start()
+    try:
+        sh = _ship_all(rd, srv2.port)
+        assert sh.resets == 0 and sh.chunks_sent == 0  # nothing re-sent
+        token = srv2.token("reg/20260806T000004")
+        assert token["offset"] == len(wal)
+        assert token["prefix_sha"] == hashlib.sha256(wal).hexdigest()
+    finally:
+        srv2.stop()
+
+
+def test_final_install_is_digest_checked(tmp_path, ingest):
+    body = b'{"i": 0}\n'
+    assert not ingest.finalize_run("reg/20260806T000005", "0" * 64,
+                                   body)
+    assert ingest.finalize_run(
+        "reg/20260806T000005", hashlib.sha256(body).hexdigest(), body)
+    assert (ingest.store_root / "reg" / "20260806T000005"
+            / "history.jsonl").read_bytes() == body
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: ship while writing, pool settles, verdict parity
+# ---------------------------------------------------------------------------
+
+def _analyze_locally(history):
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    return LinearizableChecker(accelerator="cpu").check({}, history, {})
+
+
+def test_fleet_end_to_end_verdict_parity(tmp_path):
+    """A producer-side run shipped over loopback HTTP while it is
+    written; the pool daemon settles it and the fleet verdict (valid
+    AND invalid cases) matches the local post-hoc checker on the same
+    history, bit for bit."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.fleet.scheduler import FleetDaemon
+    from jepsen_tpu.fleet.ship import Shipper
+    from jepsen_tpu.live.daemon import load_live_status
+
+    cases = {"ok": _register_history(300, seed=5),
+             "bad": _register_history(300, seed=6, planted_at=200)}
+    src = tmp_path / "src"
+    fd = FleetDaemon(tmp_path / "fleet", port=0, poll_s=0.02,
+                     accelerator="cpu",
+                     registry=telemetry.Registry())
+    fd.start()
+    try:
+        shippers = []
+        for name, (h, _) in cases.items():
+            rd = src / name / "20260806T000010"
+            rd.mkdir(parents=True)
+
+            def produce(rd=rd, h=h):
+                from jepsen_tpu.journal import Journal
+                j = Journal(rd / "history.wal.jsonl",
+                            fsync_interval_s=-1)
+                for op in h:
+                    j.append(op)
+                    time.sleep(0.0005)
+                j.close()
+                with open(rd / "history.jsonl", "w") as f:
+                    for op in h:
+                        f.write(json.dumps(op) + "\n")
+
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
+            sh = Shipper(rd, f"http://127.0.0.1:{fd.port}",
+                         poll_s=0.01)
+            st = threading.Thread(
+                target=lambda sh=sh: sh.run(timeout_s=60),
+                daemon=True)
+            st.start()
+            shippers.append((t, st, sh))
+        for t, st, sh in shippers:
+            t.join(60)
+            st.join(60)
+            assert sh.finalized, "shipper never finalized"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and fd.daemon.trackers:
+            time.sleep(0.05)
+        assert not fd.daemon.trackers, "pool never settled the runs"
+    finally:
+        fd.stop()
+
+    for name, (h, planted) in cases.items():
+        fleet_dir = tmp_path / "fleet" / name / "20260806T000010"
+        # receiver copies byte-identical to the producer's artifacts
+        assert (fleet_dir / "history.wal.jsonl").read_bytes() == \
+            (src / name / "20260806T000010"
+             / "history.wal.jsonl").read_bytes()
+        status = load_live_status(fleet_dir)
+        assert status["state"] == "final"
+        local = _analyze_locally(h)
+        assert status["valid_so_far"] is local["valid?"]
+        if planted is not None:
+            assert status["valid_so_far"] is False
+            assert status["first_anomaly_op"] == planted
+
+    # the status plane saw both runs through to final
+    from jepsen_tpu.fleet.status import load_fleet_status
+    payload = load_fleet_status(tmp_path / "fleet")
+    assert payload["runs"]["final"] == 2
+    assert payload["runs"]["invalid"] == 1
+    assert payload["ingest"]["chunks_total"] >= 2
+    assert (tmp_path / "fleet" / "fleet-metrics.prom").exists()
+
+
+# ---------------------------------------------------------------------------
+# series capping + fleet rollups
+# ---------------------------------------------------------------------------
+
+def test_run_series_capped_topk_plus_other(tmp_path):
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.live.daemon import LiveDaemon
+
+    reg = telemetry.Registry()
+    d = LiveDaemon(store_root=tmp_path, registry=reg)
+    d.run_series_topk = 3
+    rows = []
+    for i in range(8):
+        tr = SimpleNamespace(label=f"reg/run{i}", broken=None)
+        st = {"state": "tailing", "valid_so_far": (None if i == 0
+                                                   else i != 5),
+              "lag_ops": 100 * i, "lag_s": 0.1 * i,
+              "first_anomaly_op": 42 if i == 5 else None}
+        rows.append((tr, st))
+    rows[1][0].broken = "boom"
+    d._publish_run_series(rows)
+    snap = reg.snapshot()
+
+    lag = [s for s in snap if s["name"] == "live_checker_lag_ops"]
+    runs = sorted(s["labels"]["run"] for s in lag)
+    # top-3 by lag exactly, everything else folded into "other"
+    assert runs == ["other", "reg/run5", "reg/run6", "reg/run7"]
+    other_lag = next(s["value"] for s in lag
+                     if s["labels"]["run"] == "other")
+    assert other_lag == 400  # the worst folded run's lag
+    # worst verdict in "other": run5 is in the exact set, so the fold
+    # holds run0's unknown (None) and the valid rest -> -1
+    verd = {s["labels"]["run"]: s["value"] for s in snap
+            if s["name"] == "live_verdict"}
+    assert verd["other"] == -1.0
+    assert verd["reg/run5"] == 0.0
+    # folded breaker count rides the "other" series as a count
+    brk = {s["labels"]["run"]: s["value"] for s in snap
+           if s["name"] == "live_run_breaker_open"}
+    assert brk == {"other": 1.0}
+
+    # unlabeled rollups stay exact regardless of the cap
+    rollups = {s["name"]: s["value"] for s in snap
+               if s["name"].startswith("fleet_")}
+    assert rollups == {"fleet_runs_active": 8.0,
+                       "fleet_worst_lag_ops": 700.0,
+                       "fleet_invalid_runs": 1.0}
+
+    # a smaller next poll clears stale series instead of leaving them
+    d._publish_run_series(rows[:1])
+    lag2 = [s for s in reg.snapshot()
+            if s["name"] == "live_checker_lag_ops"]
+    assert [s["labels"]["run"] for s in lag2] == ["reg/run0"]
+
+
+def test_run_label_interning_bounded(tmp_path):
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.live.daemon import LiveDaemon
+    d = LiveDaemon(store_root=tmp_path,
+                   registry=telemetry.Registry())
+    d.run_series_topk = 2
+    assert d._run_label("a") == "a"
+    assert d._run_label("b") == "b"
+    assert d._run_label("c") == "other"  # beyond the cap
+    assert d._run_label("a") == "a"      # sticky for the lifetime
+    assert len(d._run_labels) == 2       # "other" is never stored
+
+
+# ---------------------------------------------------------------------------
+# discovery scan cache
+# ---------------------------------------------------------------------------
+
+def test_discovery_scan_cache_and_invalidation(tmp_path):
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.live.daemon import LiveDaemon
+
+    h, _ = _register_history(20, seed=7)
+    _write_wal(tmp_path / "reg" / "20260806T000020", h, complete=True)
+    reg = telemetry.Registry()
+    d = LiveDaemon(store_root=tmp_path, registry=reg, poll_s=0.01,
+                   accelerator="cpu")
+    d.poll_once()
+    d.poll_once()
+    d.poll_once()
+
+    def hits():
+        return sum(s["value"] for s in reg.snapshot()
+                   if s["name"] == "live_scan_cache_hits_total")
+
+    warm = hits()
+    assert warm >= 1  # unchanged tree answered from the cache
+    # settled candidates are skipped without re-parsing their status
+    assert str(tmp_path / "reg" / "20260806T000020") in d._settled
+
+    # a new run inside an existing name dir bumps its mtime: the cache
+    # must miss once and the run must be discovered
+    _write_wal(tmp_path / "reg" / "20260806T000021", h)
+    d.poll_once()
+    assert any(k.endswith("20260806T000021") for k in d.trackers)
+    # a brand-new name dir is discovered the same way
+    _write_wal(tmp_path / "cas" / "20260806T000022", h)
+    d.poll_once()
+    assert any("cas" in k for k in d.trackers)
+    d.stop()
+
+
+# ---------------------------------------------------------------------------
+# knobs: preflight rows, tolerant coercion, env twins
+# ---------------------------------------------------------------------------
+
+def test_preflight_validates_fleet_knobs():
+    from jepsen_tpu.analysis.preflight import preflight
+
+    diags = preflight({"nodes": ["n1"], "fleet_port": "garbage"})
+    assert any(d.code == "KNB001" and d.path == "fleet_port"
+               for d in diags)
+    diags = preflight({"nodes": ["n1"], "fleet_max_runs": 0})
+    assert any(d.code == "KNB002" and d.path == "fleet_max_runs"
+               for d in diags)
+    diags = preflight({"nodes": ["n1"], "fleet_ingest_budget_s": -1})
+    assert any(d.code == "KNB002" for d in diags)
+
+
+def test_preflight_validates_fleet_env_twins(monkeypatch):
+    from jepsen_tpu.analysis.preflight import preflight
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_PORT", "not-a-port")
+    diags = preflight({"nodes": ["n1"]})
+    assert any("JEPSEN_TPU_FLEET_PORT" in (d.path or "")
+               for d in diags)
+
+
+def test_fleet_knob_tolerant_coercion_and_env_twin(monkeypatch):
+    from jepsen_tpu.fleet import fleet_knob
+    assert fleet_knob("fleet_max_runs", "12", 64, 1.0) == 12.0
+    assert fleet_knob("fleet_max_runs", "oops", 64, 1.0) == 64.0
+    assert fleet_knob("fleet_max_runs", -5, 64, 1.0) == 1.0
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_MAX_RUNS", "7")
+    assert fleet_knob("fleet_max_runs", None, 64, 1.0) == 7.0
+    # an explicit value beats the twin
+    assert fleet_knob("fleet_max_runs", 3, 64, 1.0) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# /fleet dashboard + status endpoints
+# ---------------------------------------------------------------------------
+
+def _get(port, path, headers=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path, headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    out = (r.status, dict(r.getheaders()), body)
+    conn.close()
+    return out
+
+
+def test_web_fleet_dashboard(tmp_path):
+    from jepsen_tpu.web import make_server
+
+    payload = {
+        "version": 1, "updated": time.time(), "polls": 7,
+        "runs": {"tracked": 2, "active": 1, "invalid": 1, "final": 1,
+                 "breaker_open": 0, "deferred_total": 3},
+        "worst_lag_ops": 123, "worst_lag_run": "reg/20260806T000030",
+        "mesh": {"width": 4, "failed_devices": [7], "shrinks": 1,
+                 "regrows": 0},
+        "ingest": {"bytes_total": 1000, "bytes_per_s": 42.0,
+                   "chunks_total": 5, "rejected_total": 1, "runs": 2},
+        "top_runs": [
+            {"name": "reg", "timestamp": "20260806T000030",
+             "state": "tailing", "valid_so_far": False,
+             "lag_ops": 123, "lag_s": 0.2, "first_anomaly_op": 40,
+             "breaker_open": False,
+             "links": {"live-status.json":
+                       "reg/20260806T000030/live-status.json"}}],
+    }
+    (tmp_path / "fleet-status.json").write_text(json.dumps(payload))
+    server = make_server(store_dir=str(tmp_path))
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, _hdr, body = _get(port, "/fleet")
+        assert code == 200
+        assert b"worst lag" in body and b"123" in body
+        assert b"reg/20260806T000030" in body
+        assert b"live-status.json" in body  # first-anomaly artifact link
+        assert b"http-equiv='refresh'" in body
+        # the home page links to the dashboard when the aggregate exists
+        code, _hdr, home = _get(port, "/")
+        assert code == 200 and b"href='/fleet'" in home
+    finally:
+        server.shutdown()
+        server.server_close()
+    # no aggregate -> 404 with a hint, not a crash
+    (tmp_path / "fleet-status.json").unlink()
+    server = make_server(store_dir=str(tmp_path))
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        code, _hdr, body = _get(port, "/fleet")
+        assert code == 404 and b"fleet daemon" in body
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_ingest_status_and_metrics_endpoints(tmp_path, ingest):
+    h, _ = _register_history(20, seed=8)
+    rd = tmp_path / "src" / "reg" / "20260806T000040"
+    _write_wal(rd, h)
+    _ship_all(rd, ingest.port)
+    code, _hdr, body = _get(ingest.port, "/metrics")
+    assert code == 200
+    assert b"fleet_ingest_bytes_total" in body
+    # fleet-status.json served once the status plane writes it
+    (ingest.store_root / "fleet-status.json").write_text("{}")
+    code, _hdr, body = _get(ingest.port, "/fleet-status.json")
+    assert code == 200 and body == b"{}"
+    # path traversal is rejected at the segment gate
+    code, _hdr, _body = _get(ingest.port, "/wal/../x")
+    assert code in (400, 404)
+
+
+# ---------------------------------------------------------------------------
+# multi-producer e2e (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_multi_producer_e2e(tmp_path):
+    """Eight producers shipping concurrently into one pool: every run
+    settles, every verdict matches local analyze, and the aggregate
+    counts them all."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.fleet.scheduler import FleetDaemon
+    from jepsen_tpu.fleet.ship import Shipper
+    from jepsen_tpu.fleet.status import load_fleet_status
+    from jepsen_tpu.live.daemon import load_live_status
+
+    n_runs = 8
+    hs = {f"2026080{i:02d}T000000": _register_history(
+        150, seed=i, planted_at=100 if i == 3 else None)[0]
+        for i in range(n_runs)}
+    src = tmp_path / "src"
+    fd = FleetDaemon(tmp_path / "fleet", port=0, poll_s=0.02,
+                     accelerator="cpu", max_runs=n_runs,
+                     registry=telemetry.Registry())
+    fd.start()
+    try:
+        threads = []
+        for ts, h in hs.items():
+            rd = src / "reg" / ts
+
+            def one(rd=rd, h=h):
+                # ship WHILE producing — a run that lands on the
+                # receiver already complete is (correctly) post-hoc
+                # territory, not the pool's
+                from jepsen_tpu.journal import Journal
+                rd.mkdir(parents=True)
+                j = Journal(rd / "history.wal.jsonl",
+                            fsync_interval_s=-1)
+                j.append(h[0])
+                sh = Shipper(rd, f"http://127.0.0.1:{fd.port}",
+                             poll_s=0.01)
+                shipped = []
+                st = threading.Thread(
+                    target=lambda: shipped.append(
+                        sh.run(timeout_s=120)), daemon=True)
+                st.start()
+                for op in h[1:]:
+                    j.append(op)
+                    time.sleep(0.001)
+                j.close()
+                with open(rd / "history.jsonl", "w") as f:
+                    for op in h:
+                        f.write(json.dumps(op) + "\n")
+                st.join(120)
+                assert shipped == [True]
+
+            t = threading.Thread(target=one, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and fd.daemon.trackers:
+            time.sleep(0.05)
+        assert not fd.daemon.trackers
+    finally:
+        fd.stop()
+
+    invalid = 0
+    for ts, h in hs.items():
+        status = load_live_status(tmp_path / "fleet" / "reg" / ts)
+        assert status["state"] == "final"
+        local = _analyze_locally(h)
+        assert status["valid_so_far"] is local["valid?"]
+        invalid += status["valid_so_far"] is False
+    assert invalid == 1
+    payload = load_fleet_status(tmp_path / "fleet")
+    assert payload["runs"]["final"] == n_runs
+    assert payload["runs"]["invalid"] == 1

@@ -430,6 +430,41 @@ def test_shrink_mesh_unit(metrics_registry, healthy_devices):
 
 
 @pytest.mark.mesh
+def test_regrow_mesh_unit(metrics_registry, healthy_devices):
+    """The heal path: a probe-passing failed device rejoins and the
+    mesh regrows to the next power-of-two width, counted in
+    mesh_regrow_total{from,to} (doc/robustness.md "The elastic
+    mesh")."""
+    import jax
+
+    from jepsen_tpu import parallel
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest-forced 8-device mesh")
+    mesh = parallel.auto_mesh(8)
+    err = RuntimeError("UNAVAILABLE: device 7 lost mid collective")
+    assert int(parallel.shrink_mesh(mesh, exc=err).devices.size) == 4
+
+    # healthy pool, nothing failed after heal -> regrow 4 -> 8
+    new = parallel.regrow_mesh()
+    assert new is not None and int(new.devices.size) == 8
+    assert parallel.failed_device_ids() == set()
+    assert all(
+        any(d.id == 7 for d in new.devices.flat) for _ in (0,))
+    regrown = metrics_registry.counter(
+        "mesh_regrow_total", labels=("from", "to")).value(
+        **{"from": "4", "to": "8"})
+    assert regrown == 1
+
+    # nothing failed: regrow is a no-op
+    assert parallel.regrow_mesh() is None
+
+    # a device that FAILS its probe stays excluded: no regrow
+    parallel.mark_device_failed(7)
+    assert parallel.regrow_mesh(probe=lambda d: False) is None
+    assert 7 in parallel.failed_device_ids()
+
+
+@pytest.mark.mesh
 def test_mesh_min_devices_floor(healthy_devices):
     from jepsen_tpu import parallel
     assert parallel.mesh_min_devices(None) == 2
