@@ -38,8 +38,8 @@ metric:
 
 Environment knobs: BENCH_SCALE_TARGET_S (seconds of device time the
 scaling run aims to fill; 0 skips config 7), BENCH_SKIP (comma-separated
-stage keys to skip: cpu_ref, interpreter_sched, multikey, set_full,
-elle_50k, ir_amortization, online_lag, matrix_kernel, explain,
+stage keys to skip: cpu_ref, interpreter_sched, wal_ingest, multikey,
+set_full, elle_50k, ir_amortization, online_lag, matrix_kernel, explain,
 multichip, ckpt, trace, fleet, headline, scale, telemetry — the last
 opts out of the per-stage telemetry block in bench_summary).
 ``fleet`` measures the fleet plane end to end (fleet_runs_sustained:
@@ -322,8 +322,15 @@ def cfg_cpu_ref_200() -> float:
 
 def cfg_interpreter_sched():
     """Reference anchor: >20k ops/sec pure-generator scheduling
-    (generator.clj:67-70)."""
+    (generator.clj:67-70). The simulated loop rides the native
+    scheduler lane (columnar_ext.c sim_lane) when probed; the
+    ``sched_batch_*`` extras measure the THREADED interpreter's chunked
+    completion bus (``sched_batch_ops``) against its per-op fallback —
+    Tentpole B of the host ingest spine (doc/performance.md)."""
     import jepsen_tpu.generator as gen
+    from jepsen_tpu.generator.interpreter import (
+        DEFAULT_SCHED_BATCH_OPS, run as interp_run,
+    )
     from jepsen_tpu.generator.simulate import quick
 
     n = 50_000
@@ -333,8 +340,85 @@ def cfg_interpreter_sched():
     n_inv = sum(1 for op in history if op["type"] == "invoke")
     assert n_inv == n, n_inv
     med, extras = _spread(times, n)
+
+    class _Echo:
+        def open(self, test, node):
+            return self
+
+        def setup(self, test):
+            pass
+
+        def invoke(self, test, op):
+            return {**op, "type": "ok"}
+
+        def teardown(self, test):
+            pass
+
+        def close(self, test):
+            pass
+
+    m = 10_000
+
+    def threaded(batch):
+        t = {"concurrency": 8, "client": _Echo(), "nodes": ["n1"],
+             "name": "bench-sched", "sched_batch_ops": batch,
+             "generator": gen.clients(gen.limit(
+                 m, gen.Fn(lambda: {"f": "write", "value": 1})))}
+        h = interp_run(t)
+        assert sum(1 for op in h if op["type"] == "invoke") == m
+        return h
+
+    _, t_batched = _trials(lambda: threaded(DEFAULT_SCHED_BATCH_OPS), 3)
+    _, t_per_op = _trials(lambda: threaded(0), 3)
+    batched_rate = m / _median(t_batched)
+    per_op_rate = m / _median(t_per_op)
     emit("interpreter_sched_ops_per_sec", n / med, "ops/s",
-         (n / med) / GEN_SCHED_BASELINE, **extras)
+         (n / med) / GEN_SCHED_BASELINE,
+         sched_batch_default=DEFAULT_SCHED_BATCH_OPS,
+         sched_batch_ops_per_sec=round(batched_rate, 1),
+         sched_batch_per_op_ops_per_sec=round(per_op_rate, 1),
+         sched_batch_vs_per_op=round(batched_rate / per_op_rate, 3),
+         **extras)
+
+
+def cfg_wal_ingest():
+    """wal_ingest_native: the raw WAL chunk scan+parse rate, native
+    (columnar_ext.c ingest_chunk) vs the pure-Python twin over the same
+    bytes — the tail side of the 1M ops/s ingest bar, isolated from
+    encode+frontier (those ride online_lag)."""
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    from jepsen_tpu.store import _serializable
+
+    history = _register_history(100_000, n_procs=5, seed=3, n_values=5)
+    n = len(history)  # invokes + completions
+    chunk = "".join(json.dumps(_serializable(op)) + "\n"
+                    for op in history).encode()
+
+    def native():
+        m = ingest.native_mod()
+        assert m is not None, "native ingest unavailable"
+        with ingest.ingest_burst():
+            ops, consumed, torn, _tr = m.ingest_chunk(
+                chunk, True, ingest._line_fallback,
+                ingest._SKIP, ingest._TORN)
+        assert len(ops) == n and torn == 0 and consumed == len(chunk)
+
+    def python():
+        with ingest.ingest_burst():
+            ops, consumed, torn, _tr = parse_wal_chunk_py(chunk,
+                                                          final=True)
+        assert len(ops) == n and torn == 0 and consumed == len(chunk)
+
+    _, t_nat = _trials(native, 5)
+    _, t_py = _trials(python, 3)
+    med, extras = _spread(t_nat, n)
+    rate = n / med
+    emit("wal_ingest_native_ops_per_sec", rate, "ops/s",
+         rate / (n / _median(t_py)),  # vs_baseline IS the ratio
+         python_ops_per_sec=round(n / _median(t_py), 1),
+         chunk_mb=round(len(chunk) / 2 ** 20, 1), **extras)
 
 
 def cfg_multikey():
@@ -1172,16 +1256,19 @@ def cfg_online_lag():
     reader + JSON parse) -> incremental register encode -> resumable
     frontier — with a verdict poll after every chunk, and the worst
     verdict lag observed at any poll. The target shape is the
-    acceptance bar: >= 100k ops/s sustained at bounded lag."""
+    acceptance bar: >= 1M ops/s sustained at bounded lag (raised from
+    100k by the host ingest spine — native tail+parse, chunked
+    ``add_many`` encode, GC deferred per burst)."""
     import tempfile
     from pathlib import Path
 
     from __graft_entry__ import _register_history
+    from jepsen_tpu.history_ir import ingest as ingest_mod
     from jepsen_tpu.journal import Journal, WalTailer
     from jepsen_tpu.live.sessions import LinearLiveSession
 
     n = 100_000
-    chunk = 10_000
+    chunk = 20_000  # one verdict poll per chunk bounds the lag
     # 3-way concurrency: the live path's steady-state shape (a serving
     # fleet's per-key streams are narrow; wide frontiers are the batch
     # checker's province — and the budget/admission machinery's, not
@@ -1198,11 +1285,12 @@ def cfg_online_lag():
             tailer = WalTailer(wal)
             session = LinearLiveSession(accelerator="cpu")
             lag_max = 0
-            ops = tailer.poll()
+            with ingest_mod.ingest_burst():
+                ops = tailer.poll()
             assert len(ops) == len(history), len(ops)
             for i in range(0, len(ops), chunk):
-                for op in ops[i:i + chunk]:
-                    session.add(op)
+                with ingest_mod.ingest_burst():
+                    session.add_many(ops[i:i + chunk])
                 v = session.verdict()
                 assert v["valid_so_far"] is True, v
                 lag_max = max(lag_max,
@@ -1213,24 +1301,24 @@ def cfg_online_lag():
         lag_max, times = _trials(consume, 5)
 
         # checker-side sustained rate (pre-parsed ops): isolates the
-        # incremental encode+frontier from the JSON tail, which is
-        # pure stdlib-loads cost and the ingest bottleneck
+        # incremental encode+frontier from the JSON tail
         parsed = WalTailer(wal).poll()
 
         def check_only():
             session = LinearLiveSession(accelerator="cpu")
             for i in range(0, len(parsed), chunk):
-                for op in parsed[i:i + chunk]:
-                    session.add(op)
+                with ingest_mod.ingest_burst():
+                    session.add_many(parsed[i:i + chunk])
                 session.verdict()
             session.finalize()
 
         _, check_times = _trials(check_only, 3)
     med, extras = _spread(times, len(history))
     rate = len(history) / med
-    emit("online_checker_lag", rate, "ops/s", rate / 100_000.0,
+    emit("online_checker_lag", rate, "ops/s", rate / 1_000_000.0,
          lag_ops_max=int(lag_max), chunk_ops=chunk, n_ops=n,
          path="tail+encode+frontier",
+         native_ingest=ingest_mod.enabled(),
          check_ops_per_sec=round(len(history) / min(check_times), 1),
          **extras)
 
@@ -1800,6 +1888,7 @@ def main() -> None:
 
     guard("cpu_ref", cfg_cpu_ref_200)
     guard("interpreter_sched", cfg_interpreter_sched)
+    guard("wal_ingest", cfg_wal_ingest)
     guard("multikey", cfg_multikey)
     guard("set_full", cfg_set_full)
     guard("elle_50k", cfg_elle_50k)

@@ -403,6 +403,11 @@ _NUMERIC_KNOBS = (
     ("fleet_port", True, 0.0),
     ("fleet_ingest_budget_s", True, 0.0),
     ("fleet_max_runs", True, 1.0),
+    # host ingest spine (doc/performance.md "Host ingest spine"): the
+    # chunked-scheduler drain size — interpreter._knob coerces
+    # tolerantly at runtime (garbage warns + default, 0/None = per-op
+    # fallback), preflight is where garbage becomes an error
+    ("sched_batch_ops", True, 0.0),
 )
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
@@ -413,7 +418,7 @@ _NUMERIC_KNOBS = (
 # (doc/performance.md "Packed boolean kernels")
 _BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
                "ir_stream_from_wal", "combine_fused", "resume_check",
-               "trace")
+               "trace", "ingest_native")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 # enum knobs, tolerantly coerced at runtime (pallas_matrix

@@ -115,6 +115,14 @@ class FrontierSession:
             return self.failure
         if end is None:
             end = len(stream.kind)
+        # native frontier (doc/performance.md "Host ingest spine"):
+        # the C twin runs the same BFS closure on COPIES and only
+        # commits on a fully-alive chunk; a death (or any regime miss)
+        # replays the untouched Python state below so the failure
+        # forensics are bit-identical to the pure path
+        from jepsen_tpu.history_ir import ingest
+        if ingest.frontier_absorb(self, stream, start, end):
+            return self.result()
         step = self.step
         configs = self.configs
         cur = self.cur

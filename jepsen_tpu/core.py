@@ -405,6 +405,20 @@ def _crash_safety_setup(test: dict):
         store.write_test(test)
     except Exception:  # noqa: BLE001
         logger.exception("early test.json write failed")
+    # host ingest spine: honor the test map's ingest_native knob for
+    # every consumer that never sees the test map (tailers, sessions),
+    # and pre-register the fallback counter so run metrics export it
+    # even when the native path never falls back (absence must mean
+    # "zero fallbacks", not "counter unknown")
+    try:
+        from jepsen_tpu.history_ir import ingest as ingest_mod
+        ingest_mod.configure_from_test(test)
+        telemetry.get_registry().counter(
+            "native_ingest_fallback_total",
+            "ingest work that fell back to the Python path",
+            labels=("reason",))
+    except Exception:  # noqa: BLE001 — knob plumbing never blocks a run
+        logger.exception("ingest knob configuration failed")
     if test.get("wal", True) is not False:
         try:
             journal = journal_mod.Journal(

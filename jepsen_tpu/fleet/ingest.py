@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from jepsen_tpu import telemetry
+from jepsen_tpu.history_ir import ingest as ingest_mod
 from jepsen_tpu.journal import WAL_NAME
 from jepsen_tpu.utils import join_noisy
 
@@ -75,14 +76,26 @@ class IngestServer:
     shippers (a producer restart overlapping its predecessor) target
     the same run. A cursor missing from ``_runs`` (receiver restart)
     is rebuilt by hashing the WAL already on disk, so shippers resume
-    against a restarted receiver without re-sending history."""
+    against a restarted receiver without re-sending history.
+
+    Verified chunks are handed STRAIGHT to the native ingest spine
+    (history_ir.ingest.parse_wal_chunk) while the bytes are still in
+    memory — a co-located consumer registered via ``feed`` gets the
+    parsed op dicts without ever re-reading the file the tailer path
+    would have to. A per-run carry buffer stitches lines split across
+    chunk boundaries; its cursor advances exactly as the tailer's
+    would, so a consumer that later falls back to disk-tailing resumes
+    at the same op."""
 
     def __init__(self, store_root, host: str = "127.0.0.1",
                  port: int = 0,
-                 registry: telemetry.Registry | None = None):
+                 registry: telemetry.Registry | None = None,
+                 feed=None):
         self.store_root = Path(store_root)
         self.registry = registry if registry is not None \
             else telemetry.get_registry()
+        # feed(key, ops): parsed-op push for a co-located consumer
+        self.feed = feed
         self._runs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._httpd = _IngestHTTPServer((host, port),
@@ -104,7 +117,8 @@ class IngestServer:
         restart). Caller holds ``_lock``."""
         st = self._runs.get(key)
         if st is None:
-            st = {"offset": 0, "sha": hashlib.sha256(), "bytes": 0}
+            st = {"offset": 0, "sha": hashlib.sha256(), "bytes": 0,
+                  "carry": b"", "ops": 0, "torn": 0}
             p = self._wal_path(key)
             try:
                 with open(p, "rb") as f:
@@ -155,6 +169,9 @@ class IngestServer:
                     pass
                 st["offset"] = 0
                 st["sha"] = hashlib.sha256()
+                st["carry"] = b""
+                st["ops"] = 0
+                st["torn"] = 0
                 logger.warning("fleet ingest: %s reset to offset 0",
                                key)
             if offset != st["offset"]:
@@ -181,6 +198,7 @@ class IngestServer:
             st["sha"] = sha
             st["offset"] += len(body)
             st["bytes"] += len(body)
+            self._feed_chunk(key, st, body)
             self.registry.counter(
                 "fleet_ingest_bytes_total",
                 "WAL bytes accepted over the ingest plane"
@@ -189,6 +207,40 @@ class IngestServer:
                 "fleet_ingest_chunks_total",
                 "WAL chunks accepted over the ingest plane").inc()
             return None
+
+    def _feed_chunk(self, key: str, st: dict, body: bytes) -> None:
+        """Parses the just-verified bytes through the native ingest
+        spine while they're still in memory. The carry buffer holds the
+        unterminated tail a chunk boundary split, so every op parses
+        exactly once and in order; parsed counts feed the status plane
+        and the optional ``feed`` consumer gets the op dicts directly
+        (no disk re-read). Counts restart with the process — a
+        late-attaching consumer seeds itself from the on-disk WAL.
+        Caller holds ``_lock``."""
+        buf = st["carry"] + body
+        try:
+            with ingest_mod.ingest_burst():
+                ops, consumed, torn, _trunc = ingest_mod.parse_wal_chunk(
+                    buf, final=False)
+        except Exception:  # noqa: BLE001 — parse never bounces a chunk
+            logger.exception("fleet ingest: post-append parse failed "
+                             "for %s", key)
+            st["carry"] = b""
+            return
+        st["carry"] = buf[consumed:]
+        st["ops"] += len(ops)
+        st["torn"] += torn
+        if ops:
+            self.registry.counter(
+                "fleet_ingest_ops_total",
+                "ops parsed straight off verified ingest chunks").inc(
+                len(ops))
+        if self.feed is not None and ops:
+            try:
+                self.feed(key, ops)
+            except Exception:  # noqa: BLE001 — consumer bugs stay local
+                logger.exception("fleet ingest: feed consumer failed "
+                                 "for %s", key)
 
     def finalize_run(self, key: str, sha256: str,
                      body: bytes) -> bool:  # owner: worker
@@ -207,6 +259,13 @@ class IngestServer:
         """(bytes-by-run, total) snapshot for the status plane."""
         with self._lock:
             return {k: st["bytes"] for k, st in self._runs.items()}
+
+    def parse_stats(self) -> dict:
+        """Per-run ``{"ops", "torn"}`` parsed straight off verified
+        chunks (this process's lifetime)."""
+        with self._lock:
+            return {k: {"ops": st["ops"], "torn": st["torn"]}
+                    for k, st in self._runs.items()}
 
     # -- http plumbing --------------------------------------------------
 

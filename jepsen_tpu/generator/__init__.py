@@ -74,7 +74,13 @@ class Context:
         return self.workers[thread]
 
     def thread_of(self, process):
-        for t, p in self.workers.items():
+        # fast path: until a process crashes, workers[t] == t, so a
+        # process that maps to itself IS its thread (process ids are
+        # unique across the map, so no other thread can claim it)
+        w = self.workers
+        if process is not None and w.get(process) == process:
+            return process
+        for t, p in w.items():
             if p == process:
                 return t
         return None
@@ -97,21 +103,58 @@ class Context:
                 _FREE_SORT_CACHE.clear()
             ts = sorted(self.free_threads, key=_thread_sort_key)
             _FREE_SORT_CACHE[self.free_threads] = ts
-        t = ts[self.rng.randrange(len(ts))]
-        return self.workers[t]
+        # rng._randbelow is the exact draw randrange()/choice() bottom
+        # out in (Random dispatches it per-instance, so subclasses that
+        # override random() keep their variant) — byte-identical entropy
+        # consumption, so deterministic enumeration (preflight,
+        # exact-sequence tests) sees the same schedule, minus two frames
+        # of argument plumbing on the hottest call of the scheduler
+        return self.workers[ts[self.rng._randbelow(len(ts))]]
 
-    # -- functional updates (direct construction: dataclasses.replace's
-    # field introspection was the scheduler loop's hottest cost) --------
+    # -- functional updates (direct __dict__ construction: the generated
+    # frozen-dataclass __init__ routes every field through
+    # object.__setattr__, ~3x the cost of plain dict stores, and these
+    # three run on every scheduler step) --------------------------------
     def with_time(self, time: int) -> "Context":
-        return Context(time, self.free_threads, self.workers, self.rng)
+        c = Context.__new__(Context)
+        d = c.__dict__
+        d["time"] = time
+        d["free_threads"] = self.free_threads
+        d["workers"] = self.workers
+        d["rng"] = self.rng
+        return c
 
     def busy_thread(self, thread) -> "Context":
-        return Context(self.time, self.free_threads - {thread},
-                       self.workers, self.rng)
+        # free-thread sets cycle through a tiny space (2^threads), so
+        # the set algebra is memoized the same way the sorted view is
+        key = (self.free_threads, thread)
+        fs = _FREE_SUB_CACHE.get(key)
+        if fs is None:
+            if len(_FREE_SUB_CACHE) > 4096:
+                _FREE_SUB_CACHE.clear()
+            fs = _FREE_SUB_CACHE[key] = self.free_threads - {thread}
+        c = Context.__new__(Context)
+        d = c.__dict__
+        d["time"] = self.time
+        d["free_threads"] = fs
+        d["workers"] = self.workers
+        d["rng"] = self.rng
+        return c
 
     def free_thread(self, thread) -> "Context":
-        return Context(self.time, self.free_threads | {thread},
-                       self.workers, self.rng)
+        key = (self.free_threads, thread)
+        fs = _FREE_ADD_CACHE.get(key)
+        if fs is None:
+            if len(_FREE_ADD_CACHE) > 4096:
+                _FREE_ADD_CACHE.clear()
+            fs = _FREE_ADD_CACHE[key] = self.free_threads | {thread}
+        c = Context.__new__(Context)
+        d = c.__dict__
+        d["time"] = self.time
+        d["free_threads"] = fs
+        d["workers"] = self.workers
+        d["rng"] = self.rng
+        return c
 
     def with_next_process(self, thread) -> "Context":
         """Assigns a fresh process id to thread after a crash."""
@@ -130,6 +173,8 @@ class Context:
 
 
 _FREE_SORT_CACHE: dict = {}
+_FREE_SUB_CACHE: dict = {}
+_FREE_ADD_CACHE: dict = {}
 
 
 def _thread_sort_key(t):
@@ -227,15 +272,52 @@ class Fn(Generator):
     f: Callable
 
     def op(self, test, ctx):
-        try:
-            x = self.f(test, ctx)
-        except TypeError as e:
-            if "positional argument" in str(e):
-                x = self.f()
-            else:
-                raise
+        # the calling convention (f(test, ctx) vs f()) is discovered once
+        # by trial and memoized: the old raise-and-retry probe cost ~1µs
+        # of exception machinery on EVERY op for zero-arity fns — the
+        # single hottest line of the simulated scheduler. The memo lives
+        # outside the dataclass fields, so equality/hash are unchanged.
+        f = self.f
+        style = self.__dict__.get("_style")
+        if style == 0:
+            x = f()
+        elif style == 1:
+            x = f(test, ctx)
+        else:
+            try:
+                x = f(test, ctx)
+                object.__setattr__(self, "_style", 1)
+            except TypeError as e:
+                if "positional argument" in str(e):
+                    x = f()
+                    object.__setattr__(self, "_style", 0)
+                else:
+                    raise
+        return self.op_tail(test, ctx, x)
+
+    def op_tail(self, test, ctx, x):
+        """Fn.op's tail after ``x = f()`` — split out so the native
+        scheduler lane (columnar_ext.c sim_lane) can hand back an
+        already-consumed x on bail without calling f twice."""
         if x is None:
             return None
+        if type(x) is dict:
+            # exactly what as_gen→OpTemplate.op would produce — one op,
+            # inner generator exhausted, the fn stays as continuation —
+            # with fill_in_op's body inlined (x may be a shared template,
+            # so the copy is load-bearing; only the frames are shed)
+            op = dict(x)
+            if op.get("process") is None:
+                p = ctx.some_free_process()
+                if p is None:
+                    return (PENDING, self)
+                op["process"] = p
+            if op.get("time") is None:
+                op["time"] = ctx.time
+            op.setdefault("type", "invoke")
+            op.setdefault("f", None)
+            op.setdefault("value", None)
+            return (op, self)
         gen = as_gen(x)
         res = gen.op(test, ctx)
         if res is None:
@@ -773,24 +855,49 @@ class Limit(Generator):
     gen: Any
 
     def op(self, test, ctx):
-        if self.remaining <= 0:
+        remaining = self.remaining
+        if remaining <= 0:
             return None
         g = as_gen(self.gen)
         if g is None:
             return None
-        res = g.op(test, ctx)
+        return self.op_tail(g.op(test, ctx))
+
+    def op_tail(self, res):
+        """Limit.op's tail after the inner generator produced ``res`` —
+        the native lane's bail handoff (simulate._lane_attempt)
+        re-enters here with its consumed inner result."""
         if res is None:
             return None
+        remaining = self.remaining
         op, g2 = res
         if op is PENDING:
-            return (PENDING, Limit(self.remaining, g2))
-        return (op, Limit(self.remaining - 1, g2) if g2 is not None else None)
+            return (PENDING, _mk_limit(remaining, g2))
+        return (op, _mk_limit(remaining - 1, g2) if g2 is not None else None)
 
     def update(self, test, ctx, event):
         g = as_gen(self.gen)
         if g is None:
             return self
-        return Limit(self.remaining, g.update(test, ctx, event))
+        g2 = g.update(test, ctx, event)
+        if g2 is g and g is self.gen:
+            # inner generator ignored the event (Fn and friends return
+            # self): the copy the old code built here was ==-identical,
+            # so returning self is observationally the same value
+            return self
+        return Limit(self.remaining, g2)
+
+
+def _mk_limit(remaining, gen) -> "Limit":
+    """Limit built by direct __dict__ store — ==/hash-identical to
+    Limit(remaining, gen), without the frozen-dataclass __init__ that
+    routes both fields through object.__setattr__ (one Limit is built
+    per emitted op, so this is scheduler-hot)."""
+    lim = Limit.__new__(Limit)
+    d = lim.__dict__
+    d["remaining"] = remaining
+    d["gen"] = gen
+    return lim
 
 
 def limit(n: int, gen) -> Generator:

@@ -49,6 +49,12 @@ logger = logging.getLogger("jepsen.interpreter")
 # Max time between generator re-polls when pending, µs (interpreter.clj:166-170)
 MAX_PENDING_INTERVAL_S = 0.001
 
+# Completions processed (and WAL records coalesced) per scheduler drain
+# chunk; the ``sched_batch_ops`` test knob / ``JEPSEN_TPU_SCHED_BATCH``
+# env twin override, ``0``/``None`` restores per-op hops + per-op WAL
+# appends (doc/performance.md "Host ingest spine").
+DEFAULT_SCHED_BATCH_OPS = 256
+
 # Deadline defaults (doc/robustness.md). The op timeout is deliberately
 # generous: it exists to unwedge a run, not to police slow databases —
 # a synthesized :info is indeterminate, and flooding a history with
@@ -105,6 +111,61 @@ class _Exit:
 
 
 _EXIT = _Exit()
+
+
+class _SchedBus:
+    """Chunked completion bus between workers and the scheduler.
+
+    Workers stage compact ``(worker_id, generation, payload)`` tuples;
+    the scheduler drains the staged run (up to ``max_chunk``) in ONE
+    lock round instead of one queue hop per completion — the
+    scheduler-side analog of the batched trace emission, and the thing
+    that lets the WAL coalesce a whole chunk into one write+fsync.
+    Arrival order is preserved exactly (stages append under the lock),
+    so history order, generator updates, and the late-quarantine
+    bookkeeping see the same schedule a per-op queue.Queue would; with
+    ``max_chunk=1`` the bus IS that per-op queue.
+    """
+
+    def __init__(self, max_chunk: int = DEFAULT_SCHED_BATCH_OPS):
+        self.max_chunk = max(int(max_chunk), 1)
+        self._cv = threading.Condition(threading.Lock())
+        self._staged: list = []
+
+    def put(self, item) -> None:  # owner: worker
+        with self._cv:
+            self._staged.append(item)
+            self._cv.notify()
+
+    def qsize(self) -> int:  # owner: scheduler (sampled metric only)
+        with self._cv:
+            return len(self._staged)
+
+    def drain_nowait(self) -> list:  # owner: scheduler
+        # racy truthiness peek: a miss only delays one poll round, and
+        # the hot loop skips a lock acquisition on every empty pass
+        if not self._staged:
+            return []
+        with self._cv:
+            return self._take()
+
+    def drain(self, timeout: float) -> list:  # owner: scheduler
+        """Blocks up to ``timeout`` for the first staged tuple; an empty
+        list is the queue.Empty analog (the wait genuinely timed out —
+        wait_for rides out spurious wakeups, and a notify always leaves
+        something staged for _take)."""
+        with self._cv:
+            self._cv.wait_for(lambda: self._staged, timeout)
+            return self._take()
+
+    def _take(self) -> list:
+        staged = self._staged
+        if len(staged) <= self.max_chunk:
+            self._staged = []
+            return staged
+        chunk = staged[:self.max_chunk]
+        del staged[:self.max_chunk]
+        return chunk
 
 
 class Worker:
@@ -316,7 +377,7 @@ def current_op_reaped() -> bool:
     return ev is not None and ev.is_set()
 
 
-def _spawn_worker(test: dict, worker_id, completions: queue.Queue,
+def _spawn_worker(test: dict, worker_id, completions: "_SchedBus",
                   generation: int = 0):
     """Worker thread + its in-queue (interpreter.clj:99-164).
 
@@ -470,16 +531,44 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
 
     gen = friendly_exceptions(validate(as_gen(test.get("generator"))))
     ctx = context(test)
-    completions: queue.Queue = queue.Queue()
+    # chunked scheduler (doc/performance.md "Host ingest spine"): the
+    # knob caps both the completions processed per bus drain and the
+    # WAL records coalesced per flush; 0/None restores per-op behavior
+    sched_batch_f = _knob(test, "sched_batch_ops", "JEPSEN_TPU_SCHED_BATCH",
+                          DEFAULT_SCHED_BATCH_OPS)
+    sched_batch = int(sched_batch_f) if sched_batch_f else 1
+    completions = _SchedBus(max_chunk=sched_batch)
     workers = {w["id"]: w for w in (
         _spawn_worker(test, wid, completions) for wid in ctx.workers
     )}
     history: list[dict] = []
     # write-ahead journal (core.run installs it): every history-bound op
     # — invocations at dispatch, completions as they arrive — lands in
-    # history.wal.jsonl the moment it enters the in-memory history, so a
-    # killed run leaves a replayable prefix (doc/robustness.md)
+    # history.wal.jsonl before the scheduler next goes to sleep, so a
+    # killed run leaves a replayable prefix (doc/robustness.md). Within
+    # one drain chunk the records stage in wal_stage and land as ONE
+    # write(+interval fsync) via Journal.append_many — bytes identical
+    # to per-op appends, syscalls per chunk instead of per op.
     journal = test.get("_journal")
+    wal_stage: list = []
+
+    def wal_push(rec) -> None:  # owner: scheduler
+        if journal is None:
+            return
+        if sched_batch <= 1:
+            journal.append(rec)
+            return
+        wal_stage.append(rec)
+        if len(wal_stage) >= sched_batch:
+            wal_flush()
+
+    def wal_flush() -> None:  # owner: scheduler
+        """Coalesced WAL landing — called at every point the scheduler
+        can block or exit, so the durability contract stays "everything
+        before the scheduler sleeps is on disk"."""
+        if wal_stage:
+            journal.append_many(wal_stage)
+            wal_stage.clear()
 
     # deadline knobs (doc/robustness.md): the test map wins, then the
     # environment, then the generous defaults; None/0 disables
@@ -578,8 +667,7 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
         thread = thread_of(completion.get("process"))
         if goes_in_history(completion):
             history.append(completion)
-            if journal is not None:
-                journal.append(completion)
+            wal_push(completion)
             # dispatch-time tracking is unconditional: the deadline layer
             # needs it whether or not metrics are on
             t0 = invoke_at.pop(thread, None)
@@ -723,14 +811,17 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
     try:
         # main scheduling loop (interpreter.clj:206-292)
         while True:
-            # 1. drain any ready completion — BEFORE the deadline check:
+            # 1. drain any ready completions — BEFORE the deadline check:
             # a completion that already arrived beat its deadline and
-            # must never be falsely reaped
-            try:
-                on_item(completions.get_nowait())
+            # must never be falsely reaped. Chunked: the old loop only
+            # ever reached expire_deadlines with an EMPTY queue (the
+            # get_nowait/continue spin), so handling the whole chunk and
+            # continuing is order-identical to one-at-a-time.
+            chunk = completions.drain_nowait()
+            if chunk:
+                for item in chunk:
+                    on_item(item)
                 continue
-            except queue.Empty:
-                pass
             now = relative_time_nanos()
             if deadlines and expire_deadlines(now):
                 continue
@@ -748,10 +839,9 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                 wait_s = MAX_PENDING_INTERVAL_S
                 if ddl_wait is not None:
                     wait_s = min(wait_s, ddl_wait)
-                try:
-                    on_item(completions.get(timeout=wait_s))
-                except queue.Empty:
-                    pass
+                wal_flush()  # land staged records before sleeping
+                for item in completions.drain(wait_s):
+                    on_item(item)
                 continue
             if op["time"] > now:
                 # future-dated: wait for its time, but a completion may
@@ -762,12 +852,14 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                 wait_s = full_wait
                 if ddl_wait is not None:
                     wait_s = min(wait_s, ddl_wait)
-                try:
-                    on_item(completions.get(timeout=wait_s))
+                wal_flush()  # land staged records before sleeping
+                chunk = completions.drain(wait_s)
+                if chunk:
+                    for item in chunk:
+                        on_item(item)
                     continue
-                except queue.Empty:
-                    if wait_s < full_wait:
-                        continue  # woke for a deadline, not the op time
+                if wait_s < full_wait:
+                    continue  # woke for a deadline, not the op time
             # dispatch
             gen = gen2
             now = relative_time_nanos()
@@ -778,8 +870,7 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
             activity[0] = _time.monotonic()
             if goes_in_history(op):
                 history.append(op)
-                if journal is not None:
-                    journal.append(op)
+                wal_push(op)
                 invoke_at[thread] = now
                 inflight[thread] = op
                 if op_trace is not None:
@@ -816,6 +907,7 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                                  - len(ctx.free_threads)})
         pending_exits = set(workers)
         reaped_in_drain: set = set()
+        wal_flush()  # main loop is done; land anything still staged
         for t in ctx.free_threads:
             workers[t]["in"].put(_EXIT)
         while pending_exits:
@@ -827,9 +919,9 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
             if drain_deadline is not None:
                 wait_s = min(wait_s,
                              max(drain_deadline - _time.monotonic(), 0.0))
-            try:
-                wid, gen_, payload = completions.get(timeout=wait_s)
-            except queue.Empty:
+            wal_flush()  # land staged records before sleeping
+            chunk = completions.drain(wait_s)
+            if not chunk:
                 just_reaped = expire_deadlines(relative_time_nanos())
                 reaped_in_drain.update(just_reaped)
                 for t in just_reaped:
@@ -876,17 +968,22 @@ def run(test: dict) -> list[dict]:  # owner: scheduler
                     break
                 continue
             activity[0] = _time.monotonic()
-            if gen_ != workers[wid]["gen"]:
-                if payload is not _EXIT:
-                    quarantine(wid, payload)
-                continue
-            if payload is _EXIT:
-                pending_exits.discard(wid)
-                continue
-            thread = process_completion(payload)
-            workers[thread]["in"].put(_EXIT)
+            for wid, gen_, payload in chunk:
+                if gen_ != workers[wid]["gen"]:
+                    if payload is not _EXIT:
+                        quarantine(wid, payload)
+                    continue
+                if payload is _EXIT:
+                    pending_exits.discard(wid)
+                    continue
+                thread = process_completion(payload)
+                workers[thread]["in"].put(_EXIT)
     finally:
         watchdog.stop()
+        try:
+            wal_flush()  # never leak staged WAL records on any exit path
+        except Exception:
+            logger.exception("final WAL flush failed")
         # shutdown: every live worker gets an exit marker; one too busy
         # to take it is abandoned EXPLICITLY below — zombie-marked,
         # counted, logged — never silently leaked (interpreter.clj:294-309)

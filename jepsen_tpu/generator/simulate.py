@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import random
 import time as _time
+import types as _types
+from heapq import heappop as _heappop, heappush as _heappush
 from typing import Callable
 
 from jepsen_tpu import generator as gen_mod
@@ -19,6 +21,25 @@ from jepsen_tpu.generator import (
 from jepsen_tpu.utils import ms_to_nanos
 
 DEFAULT_TEST = {"concurrency": 2}
+
+# sentinels for the native scheduler lane plumbing (see _lane_attempt):
+# _AUTO = resolve the lane from the ingest dispatch layer per call;
+# _NO_INJECT = no half-finished step handed back by a lane bail
+_AUTO = object()
+_NO_INJECT = object()
+
+
+def _native_lane():
+    """The probed-and-trusted C scheduler loop (columnar_ext.c
+    sim_lane), or None. Resolved per simulate() call — the ingest
+    knob/probe latch (history_ir/ingest.py) owns the verdict, so
+    ``ingest_native=0`` / a probe divergence turn this off exactly like
+    the rest of the native plane."""
+    try:
+        from jepsen_tpu.history_ir import ingest
+        return ingest.sim_lane()
+    except Exception:  # noqa: BLE001 — the lane is an optimization only
+        return None
 
 
 def default_context(test: dict | None = None, seed: int = 0) -> Context:
@@ -37,6 +58,7 @@ def simulate(
     seed: int = 0,
     max_wall_s: float | None = None,
     stats: dict | None = None,
+    _lane=_AUTO,
 ) -> list[dict]:
     """Simulates gen against model workers.
 
@@ -68,79 +90,126 @@ def simulate(
     ctx = ctx or default_context(test, seed=seed)
     g = as_gen(gen)
     history: list[dict] = []
-    pending: list[dict] = []  # completion ops waiting for their time
+    # completions waiting for their time, as a (time, seq, op) heap:
+    # the soonest is peeked on EVERY step but removed only when it
+    # applies, so O(1) peek beats the old per-step linear min(). ``seq``
+    # (monotone insertion order) breaks time ties exactly the way the
+    # old first-match scan did — and keeps the un-comparable op dicts
+    # out of the tuple comparison.
+    pending: list[tuple] = []
+    pending_seq = 0
     if stats is None:
         stats = {}
     stats.update(steps=0, step_limited=False, wall_limited=False)
 
-    def soonest_pending():
-        if not pending:
-            return None
-        return min(pending, key=lambda o: o["time"])
-
     deadline = (_time.monotonic() + max_wall_s
                 if max_wall_s is not None else None)
     steps = 0
-    while True:
-        if steps >= limit:
-            stats["step_limited"] = True
-            break
-        steps += 1
-        stats["steps"] = steps
-        if deadline is not None and _time.monotonic() >= deadline:
-            stats["wall_limited"] = True
-            break
-        comp = soonest_pending()
-        res = g.op(test, ctx) if g is not None else None
-        if res is None:
-            if comp is None:
-                break
-            g2, ctx, done = _apply_completion(test, g, ctx, comp, history)
-            pending.remove(comp)
-            g = g2
-            continue
-        op, g_next = res
-        if op is PENDING:
-            if comp is None:
-                # Nothing will ever free a thread or advance time: deadlock.
-                break
-            g2, ctx, _ = _apply_completion(test, g, ctx, comp, history)
-            pending.remove(comp)
-            g = g2
-            continue
-        if comp is not None and comp["time"] <= op["time"]:
-            # the completion happens first: apply it (updating the
-            # generator — an until_ok/on_update must see it) and
-            # reconsult; the op we were offered came from the
-            # pre-completion generator state and is NOT dispatched
-            g, ctx, _ = _apply_completion(test, g, ctx, comp, history)
-            pending.remove(comp)
-            continue
-        # dispatch the op
-        g = g_next
-        ctx = ctx.with_time(max(ctx.time, op["time"]))
-        thread = NEMESIS if op["process"] == NEMESIS else ctx.thread_of(op["process"])
-        ctx = ctx.busy_thread(thread)
-        if op["type"] in ("sleep", "log"):
-            dt = op["value"] if op["type"] == "sleep" else 0
-            completion = dict(op)
-            completion["time"] = op["time"] + ms_to_nanos(dt * 1000 if dt else 0)
-            completion["type"] = "__free__"
-            pending.append(completion)
+    inject = _NO_INJECT
+    try:
+        # the stock Limit(Fn)/stock-completer/stock-rng shape runs its
+        # whole loop in C when the native ingest plane is trusted —
+        # bit-identical by the sim_lane contract (history dicts, rng
+        # entropy, step counts), with a mid-step bail handing the
+        # consumed f() result back through ``inject``
+        if deadline is None and g is not None:
+            lane = _native_lane() if _lane is _AUTO else _lane
+            if lane is not None:
+                _lsteps = [0]
+                try:
+                    out = _lane_attempt(test, g, ctx, complete_fn, limit,
+                                        history, pending, lane, _lsteps)
+                finally:
+                    # on any exit — f() raising included — the lane has
+                    # folded its progress back; the twin would have
+                    # counted those steps too
+                    steps = _lsteps[0]
+                if out is not None:
+                    status, pending_seq, g, ctx, bail_x = out
+                    if status == 1:
+                        stats["step_limited"] = True
+                        return history
+                    if status == 0:
+                        return history
+                    # status 3: f() already ran for this step — finish
+                    # the step's tail here and continue pure-Python
+                    inject = g.op_tail(g.gen.op_tail(test, ctx, bail_x))
+        while True:
+            if inject is not _NO_INJECT:
+                # a lane bail mid-step: the limit check, step count and
+                # g.op consult already happened natively — resume at
+                # the res-handling point with the handed-back result
+                res = inject
+                inject = _NO_INJECT
+                comp = pending[0][2] if pending else None
+            else:
+                if steps >= limit:
+                    stats["step_limited"] = True
+                    break
+                steps += 1
+                if deadline is not None and _time.monotonic() >= deadline:
+                    stats["wall_limited"] = True
+                    break
+                comp = pending[0][2] if pending else None
+                res = g.op(test, ctx) if g is not None else None
+            if res is None:
+                if comp is None:
+                    break
+                g, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+                _heappop(pending)
+                continue
+            op, g_next = res
+            if op is PENDING:
+                if comp is None:
+                    # Nothing will ever free a thread or advance time:
+                    # deadlock.
+                    break
+                g, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+                _heappop(pending)
+                continue
+            if comp is not None and pending[0][0] <= op["time"]:
+                # the completion happens first: apply it (updating the
+                # generator — an until_ok/on_update must see it) and
+                # reconsult; the op we were offered came from the
+                # pre-completion generator state and is NOT dispatched
+                g, ctx, _ = _apply_completion(test, g, ctx, comp, history)
+                _heappop(pending)
+                continue
+            # dispatch the op
+            g = g_next
+            if op["time"] > ctx.time:
+                ctx = ctx.with_time(op["time"])
+            thread = (NEMESIS if op["process"] == NEMESIS
+                      else ctx.thread_of(op["process"]))
+            ctx = ctx.busy_thread(thread)
+            if op["type"] in ("sleep", "log"):
+                dt = op["value"] if op["type"] == "sleep" else 0
+                completion = dict(op)
+                completion["time"] = (op["time"]
+                                      + ms_to_nanos(dt * 1000 if dt else 0))
+                completion["type"] = "__free__"
+                _heappush(pending,
+                          (completion["time"], pending_seq, completion))
+                pending_seq += 1
+                if g is not None:
+                    g = g.update(test, ctx, op)
+                continue
+            history.append(op)
             if g is not None:
                 g = g.update(test, ctx, op)
-            continue
-        history.append(op)
-        if g is not None:
-            g = g.update(test, ctx, op)
-        completion = complete_fn(ctx, op)
-        if completion is not None:
-            pending.append(completion)
+            completion = complete_fn(ctx, op)
+            if completion is not None:
+                _heappush(pending,
+                          (completion["time"], pending_seq, completion))
+                pending_seq += 1
+    finally:
+        stats["steps"] = steps
     return history
 
 
 def _apply_completion(test, g, ctx, comp, history):
-    ctx = ctx.with_time(max(ctx.time, comp["time"]))
+    if comp["time"] > ctx.time:
+        ctx = ctx.with_time(comp["time"])
     thread = NEMESIS if comp["process"] == NEMESIS else ctx.thread_of(comp["process"])
     ctx = ctx.free_thread(thread)
     if comp["type"] == "__free__":
@@ -154,12 +223,160 @@ def _apply_completion(test, g, ctx, comp, history):
     return g, ctx, False
 
 
+def _lane_attempt(test, g, ctx, complete_fn, limit, history, pending,
+                  lane, steps_out):
+    """Runs the scheduler's hot loop natively when every moving part is
+    the stock shape (columnar_ext.c sim_lane's contract): Limit(Fn)
+    with a zero-arity plain function, a ``_sim_kind``-marked ok/fail
+    completer, a stock random.Random, <= 62 threads with unique
+    process ids. Returns None when ineligible — the caller runs the
+    pure loop from untouched state — else ``(status, pending_seq, g,
+    ctx, bail_x)`` with the shared ``history``/``pending`` lists
+    already advanced and ``steps_out[0]`` holding the steps taken
+    (set even when the lane propagates an exception from f()).
+    """
+    kind = getattr(complete_fn, "_sim_kind", None)
+    if (kind is None or kind[0] not in ("ok", "fail")
+            or type(kind[1]) is not int or kind[1] < 0):
+        return None
+    if (type(g) is not gen_mod.Limit
+            or type(g.gen) is not gen_mod.Fn):
+        return None
+    remaining = g.remaining
+    if (type(remaining) is not int or abs(remaining) > 2 ** 60
+            or type(limit) is not int or not 0 <= limit <= 2 ** 60):
+        return None
+    fn_gen = g.gen
+    f = fn_gen.f
+    style = fn_gen.__dict__.get("_style")
+    if style is None:
+        if type(f) is not _types.FunctionType:
+            return None
+        code = f.__code__
+        if code.co_argcount != 0 or (code.co_flags & 0x04):
+            return None
+        # f(test, ctx) would TypeError("...positional argument...") and
+        # Fn.op's probe would settle on f(): memoize that verdict the
+        # same way the probe does
+        object.__setattr__(fn_gen, "_style", 0)
+    elif style != 0:
+        return None
+    rng = ctx.rng
+    if type(rng) is not random.Random:
+        return None
+    time0 = ctx.time
+    if type(time0) is not int or not 0 <= time0 <= 2 ** 60:
+        return None
+    workers = ctx.workers
+    n = len(workers)
+    if not 1 <= n <= 62:
+        return None
+    try:
+        # bit i of the lane's free mask = the i-th thread in sorted
+        # order, so subset sort order == ascending bit order
+        ts = sorted(workers, key=gen_mod._thread_sort_key)
+        procs = [workers[t] for t in ts]
+        if len(set(procs)) != n:
+            return None  # thread_of needs unique process ids
+        pos = {t: i for i, t in enumerate(ts)}
+        freemask = 0
+        for t in ctx.free_threads:
+            freemask |= 1 << pos[t]
+    except (TypeError, KeyError):
+        return None
+    st = rng.getstate()
+    if st[0] != 3 or len(st[1]) != 625:
+        return None
+    S = {"f": f, "remaining": remaining, "limit": limit, "steps": 0,
+         "time": time0, "procs": procs, "free": freemask,
+         "history": history, "typ": kind[0], "latency": kind[1],
+         "mt": st[1], "seq": 0}
+    try:
+        status = lane(S)
+    finally:
+        # the lane writes back over the keys it read on EVERY exit
+        # (errors included), so folding up is unconditional; a call
+        # that died before loading state folds back as a no-op
+        steps_out[0] = S["steps"]
+        rng.setstate((3, S["mt"], st[2]))
+        pending.extend(S.get("pending", ()))
+    fm = S["free"]
+    fs = frozenset(t for i, t in enumerate(ts) if fm >> i & 1)
+    c = Context.__new__(Context)
+    d = c.__dict__
+    d["time"] = S["time"]
+    d["free_threads"] = fs
+    d["workers"] = workers
+    d["rng"] = rng
+    g2 = gen_mod._mk_limit(S["remaining"], fn_gen)
+    return (status, S["seq"], g2, c, S.pop("bail_x", None))
+
+
+def _lane_probe(lane) -> bool:
+    """Canned differential for ingest._probe: the native scheduler lane
+    vs the pure twin across latencies (pre-emption), seeds (MT
+    write-back), concurrencies (PENDING pressure) and a bail-heavy
+    generator (mid-step handoff). True iff histories, stats AND the
+    rng's end state all match."""
+    def mk():
+        c = {"n": 0}
+        def f():
+            c["n"] += 1
+            return {"f": "write", "value": c["n"] % 5}
+        return gen_mod.limit(40, gen_mod.Fn(f))
+
+    def mk_bail():
+        c = {"n": 0}
+        def f():
+            c["n"] += 1
+            if c["n"] > 30:
+                return None
+            if c["n"] % 7 == 0:
+                # explicit process key: outside the lane's dict shape,
+                # forces the consumed-x bail handoff
+                return {"f": "read", "value": None, "process": None}
+            return {"f": "w", "value": c["n"]}
+        return gen_mod.limit(25, gen_mod.Fn(f))
+
+    def fp(h):
+        # key INSERTION order is part of the bit-identity contract
+        # (json/repr of history dicts see it), so == isn't enough
+        return [list(op.items()) for op in h]
+
+    try:
+        for mk_gen in (mk, mk_bail):
+            for typ, latency in (("ok", 0), ("ok", 7), ("fail", 3)):
+                for seed in (0, 7):
+                    for conc in (1, 2, 5):
+                        test = {"concurrency": conc}
+                        r1, r2 = random.Random(seed), random.Random(seed)
+                        s1: dict = {}
+                        s2: dict = {}
+                        h1 = simulate(test, mk_gen(),
+                                      _completer(typ, latency),
+                                      context(test, rng=r1),
+                                      stats=s1, _lane=None)
+                        h2 = simulate(test, mk_gen(),
+                                      _completer(typ, latency),
+                                      context(test, rng=r2),
+                                      stats=s2, _lane=lane)
+                        if (fp(h1) != fp(h2) or s1 != s2
+                                or r1.getstate() != r2.getstate()):
+                            return False
+        return True
+    except Exception:  # noqa: BLE001 — a crashing lane condemns native
+        return False
+
+
 def _completer(typ: str, latency_nanos: int):
     def complete(ctx: Context, op: dict):
         comp = dict(op)
         comp["type"] = typ
         comp["time"] = op["time"] + latency_nanos
         return comp
+    # the native scheduler lane recognizes this stock completer by its
+    # (type, latency) signature instead of decompiling the closure
+    complete._sim_kind = (typ, latency_nanos)
     return complete
 
 

@@ -87,6 +87,13 @@ class IncrementalHistoryBuilder:
         self._snapshot = None
 
     def extend(self, ops: Sequence[dict]) -> int:
+        # chunked native column append (doc/performance.md "Host ingest
+        # spine"): the C twin runs add()'s exact mutation sequence over
+        # the whole batch, bailing per-op to self.add for anything
+        # outside the fast regime; Python loop when native is off
+        from jepsen_tpu.history_ir import ingest
+        if ingest.builder_extend(self, ops):
+            return len(ops)
         for op in ops:
             self.add(op)
         return len(ops)
@@ -334,12 +341,31 @@ class LiveRegisterEncoder:
                     ("drop",) if self._ops[j].get("f") == "read"
                     else ("keep",))
 
+    def add_many(self, ops: Sequence[dict]) -> None:
+        """Chunked :meth:`add` — one native call per WAL poll instead
+        of a Python frame per op (doc/performance.md "Host ingest
+        spine"); falls back to the per-op loop bit-identically."""
+        from jepsen_tpu.history_ir import ingest
+        if isinstance(ops, list) and ingest.encoder_add_encode(self, ops):
+            return
+        if ingest.encoder_add(self, ops):
+            return
+        for op in ops:
+            self.add(op)
+
     # -- encoding (second pass, in order, stalls at unresolved) ---------
 
     def encode_resolved(self) -> int:
         """Advances the encoder over every op whose resolution is known;
         returns the new count of encoded history ops (the checkable
         prefix length)."""
+        # native fast path: advances the same cursor/slot state in
+        # place; a mid-stream bail (exotic value, unknown f) leaves
+        # ``_next`` AT the offending op so the loop below resumes — and
+        # raises — from bit-identical state
+        from jepsen_tpu.history_ir import ingest
+        if ingest.encoder_encode(self):
+            return self._next
         from jepsen_tpu.checker.linear_encode import EV_INVOKE, EV_RETURN
         ops = self._ops
         st = self.stream

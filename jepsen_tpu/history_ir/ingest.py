@@ -1,0 +1,499 @@
+"""Host ingest spine: the native WAL tail→parse→IR fast path.
+
+The WAL hot loop — newline scan, JSON parse, canonical-column append,
+live register encode, frontier absorb — runs in the C extension
+(``native/columnar_ext.c``) when it's available and provably identical,
+and in the pure-Python twins otherwise (doc/performance.md "Host ingest
+spine"). This module is the dispatch layer:
+
+* **Knob**: the ``ingest_native`` test-map key / ``JEPSEN_TPU_INGEST_NATIVE``
+  env twin turn the native path off (it defaults on). Coercion is
+  tolerant — "0"/"false"/"off" disable, anything else keeps the default.
+* **Probe**: before first use the native entry points run a canned
+  differential (torn lines, unicode escapes, surrogates, big ints,
+  cas pairs, a frontier death) against the Python twins; any divergence
+  disables the native path for the process and bumps the fallback
+  counter. The same one-shot latch as the elle columnar parser.
+* **Fallback counter**: ``native_ingest_fallback_total{reason}`` in the
+  process registry counts every drop back to Python (missing compiler,
+  probe mismatch, per-chunk regime bail, frontier death replay), so a
+  fleet receiver silently running the slow path shows up in metrics.
+
+Bit-identity contract: every native entry point either mutates the SAME
+Python-level state its twin owns (builder columns, encoder dicts) in
+the twin's exact order, or works on copies and lets the caller replay
+the twin from untouched state. The differential suites in
+tests/test_history_ir.py and tests/test_live.py pin both directions.
+"""
+from __future__ import annotations
+
+import contextlib
+import gc
+import json
+import logging
+import os
+import threading
+
+logger = logging.getLogger("jepsen.history_ir")
+
+# sentinels for the per-line fallback protocol (see _line_fallback)
+_SKIP = object()  # whitespace-only line: skipped, not counted
+_TORN = object()  # undecodable line: torn, counted
+
+_TRUTHY = {"1", "true", "yes", "on", "force", "native"}
+_FALSY = {"0", "false", "no", "off", "python", "disabled"}
+
+
+def coerce_flag(value, default: bool = True) -> bool:
+    """Tolerant knob coercion: bools pass through, common string forms
+    map, anything unrecognized keeps the default (a typo'd knob must
+    not silently flip a correctness-adjacent path)."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s in _TRUTHY:
+        return True
+    if s in _FALSY:
+        return False
+    return default
+
+
+_lock = threading.Lock()
+# set while the probe differential runs: the probe drives the Python
+# twins, which re-enter native_mod() — the flag makes those nested
+# calls take the pure path instead of deadlocking on _lock
+_tls = threading.local()
+# probe state: None = not probed yet; True/False = probe verdict latch
+_probe_ok: bool | None = None
+# test-map override recorded by configure_from_test (env still wins
+# when the test map is silent)
+_test_override: bool | None = None
+
+
+@contextlib.contextmanager
+def ingest_burst():
+    """Defers the cyclic GC for the duration of one drain/consume burst.
+
+    The spine allocates container objects (op dicts, value lists,
+    column ints) at millions per second; letting the generational
+    collector run between chunk calls walks the whole accumulated
+    session state every few hundred thousand ops and costs a large
+    fraction of ingest throughput. Collection is deferred, never
+    skipped — the enclosing loop re-enables GC between bursts, so a
+    burst is bounded garbage (one poll's worth). Nested/disabled states
+    pass through untouched."""
+    if not gc.isenabled():
+        yield
+        return
+    gc.disable()
+    try:
+        yield
+    finally:
+        gc.enable()
+
+
+def fallback_count(reason: str, n: int = 1) -> None:
+    """Bumps ``native_ingest_fallback_total{reason}``."""
+    try:
+        from jepsen_tpu import telemetry
+        telemetry.get_registry().counter(
+            "native_ingest_fallback_total",
+            "ingest work that fell back to the Python path",
+            labels=("reason",)).inc(n, reason=reason)
+    except Exception:  # noqa: BLE001 — metrics never break ingest
+        pass
+
+
+def configure_from_test(test: dict | None) -> None:
+    """Records the test map's ``ingest_native`` knob so consumers that
+    never see the test map (tailers, sessions) honor it. Env twin
+    ``JEPSEN_TPU_INGEST_NATIVE`` still applies when the map is silent."""
+    global _test_override
+    if test is None:
+        return
+    v = test.get("ingest_native")
+    _test_override = None if v is None else coerce_flag(v, default=True)
+
+
+def reset() -> None:
+    """Test hook: forget the probe latch and test-map override."""
+    global _probe_ok, _test_override
+    with _lock:
+        _probe_ok = None
+        _test_override = None
+
+
+def _knob_on() -> bool:
+    if _test_override is not None:
+        return _test_override
+    return coerce_flag(os.environ.get("JEPSEN_TPU_INGEST_NATIVE"),
+                       default=True)
+
+
+def _mod():
+    """The C module with the spine entry points, or None."""
+    from jepsen_tpu.native import columnar_c
+    m = columnar_c.mod()
+    if m is None or not hasattr(m, "ingest_chunk"):
+        return None  # no compiler, build failed, or a stale .so
+    return m
+
+
+def native_mod():
+    """The probed-and-trusted native module, or None (Python twins).
+
+    First call runs the differential probe; the verdict latches for the
+    process (the existing probe/disable protocol of the columnar
+    parser, extended with a self-check)."""
+    global _probe_ok
+    if not _knob_on():
+        return None
+    if _probe_ok is False:
+        return None
+    if getattr(_tls, "probing", False):
+        return None  # twins run pure-Python inside the differential
+    m = _mod()
+    if m is None:
+        if _probe_ok is None:
+            with _lock:
+                if _probe_ok is None:
+                    _probe_ok = False
+            fallback_count("build")
+            logger.info("native ingest unavailable (no compiled "
+                        "extension); using Python ingest twins")
+        return None
+    if _probe_ok:
+        return m
+    # the probe runs OUTSIDE _lock: it drives the Python twins, which
+    # re-enter this function (the _tls.probing flag routes them pure),
+    # and holding a non-reentrant lock across that re-entry is a
+    # self-deadlock shape. Two threads racing here at most probe twice
+    # — the differential is pure (fresh builders, canned bytes), so
+    # the duplicate is harmless and the verdict latch below is
+    # first-writer-wins.
+    _tls.probing = True
+    try:
+        verdict = _probe(m)
+    finally:
+        _tls.probing = False
+    with _lock:
+        if _probe_ok is None:
+            _probe_ok = verdict
+            if not verdict:
+                fallback_count("probe")
+    return m if _probe_ok else None
+
+
+def enabled() -> bool:
+    return native_mod() is not None
+
+
+def sim_lane():
+    """``columnar_c.sim_lane`` when the native plane is enabled and
+    probed, else None (the simulated scheduler runs its pure loop).
+    generator/simulate.py resolves this per simulate() call, so the
+    knob/probe latch governs the scheduler lane exactly like the WAL
+    spine entry points."""
+    m = native_mod()
+    return getattr(m, "sim_lane", None) if m is not None else None
+
+
+# -- per-line fallback (shared with the C scanner) ----------------------
+
+def _line_fallback(line: bytes):
+    """Decides parse/skip/torn for a line the C parser bailed on, with
+    WalTailer.poll's tolerant semantics: decode with replacement, skip
+    whitespace-only lines silently, count undecodable lines torn."""
+    s = line.decode("utf-8", "replace")
+    if not s or s.isspace():
+        return _SKIP
+    try:
+        return json.loads(s)
+    except json.JSONDecodeError:
+        return _TORN
+
+
+def parse_wal_chunk(chunk: bytes, final: bool = False):
+    """``(ops, consumed, torn, truncated)`` for a raw WAL byte chunk —
+    native scan+parse when trusted, else the Python twin in journal.py.
+    ``consumed`` covers exactly the newline-terminated prefix (plus the
+    dropped tail when ``final``), so the caller's offset/prefix-sha
+    cursor advances identically on both paths."""
+    m = native_mod()
+    if m is not None:
+        ops, consumed, torn, truncated = m.ingest_chunk(
+            chunk, final, _line_fallback, _SKIP, _TORN)
+        return ops, consumed, torn, bool(truncated)
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    return parse_wal_chunk_py(chunk, final=final)
+
+
+# -- builder / encoder / frontier adapters ------------------------------
+
+def _builder_state(b):
+    return (b.ops, b._types, b._procs, b._fs, b._times, b._indices,
+            b._value_ids, b.values, b._completion_of, b._invocation_of,
+            b._open_invoke, b._f_intern._ids, b._f_intern.table,
+            b._v_intern._ids, b._v_intern.table, b.add)
+
+
+def builder_extend(builder, ops: list, start: int = 0) -> bool:
+    """Appends ``ops[start:]`` into the builder's canonical columns on
+    the native path; returns False when the caller must run the Python
+    twin instead (builder shape outside the fast regime)."""
+    m = native_mod()
+    if m is None or not isinstance(ops, list):
+        return False
+    from jepsen_tpu.history import Intern
+    from jepsen_tpu.history_ir.ir import ValueIntern
+    if (type(builder._f_intern) is not Intern
+            or type(builder._v_intern) is not ValueIntern):
+        fallback_count("regime")
+        return False
+    m.builder_extend(ops, start, _builder_state(builder))
+    builder._snapshot = None
+    return True
+
+
+def _encoder_eligible(enc) -> bool:
+    from jepsen_tpu.history import Intern
+    return bool(enc._default_args) and type(enc.intern) is Intern
+
+
+def encoder_add(enc, ops: list, start: int = 0) -> bool:
+    """LiveRegisterEncoder.add over a chunk, natively. False = caller
+    runs the per-op Python twin."""
+    m = native_mod()
+    if m is None or not isinstance(ops, list) or not _encoder_eligible(enc):
+        return False
+    m.register_add(ops, start, (enc._ops, enc._open_inv, enc._outcome,
+                                enc.add))
+    return True
+
+
+def encoder_add_encode(enc, ops: list, start: int = 0) -> bool:
+    """Fused LiveRegisterEncoder.add_many + encode_resolved: the
+    chunk's op dicts are classified once in C, with the add pass's
+    field reads feeding the encoder directly. Encoding eagerly here is
+    observationally identical — encode_resolved is a deterministic
+    cursor advance over ``_ops``, so running it at add time instead of
+    at the next verdict lands in the same state. False = caller runs
+    the per-op Python add twin (and encoding stays lazy)."""
+    m = native_mod()
+    if (m is None or not isinstance(ops, list)
+            or not _encoder_eligible(enc)):
+        return False
+    s = enc.stream
+    nxt, next_slot, n_slots, enc_ran, bailed = m.register_add_encode(
+        ops, start,
+        (enc._ops, enc._open_inv, enc._outcome, enc.add),
+        (enc._ops, enc._outcome, enc._open_by_process, enc._free_slots,
+         s.kind, s.slot, s.f, s.a, s.b, s.op_index,
+         enc.intern._ids, enc.intern.table,
+         enc._next, enc._next_slot, s.n_slots, enc._finalized))
+    if enc_ran:
+        enc._next, enc._next_slot, s.n_slots = nxt, next_slot, n_slots
+        if bailed:
+            # cursor is AT the offending op; the next encode_resolved
+            # resumes (and raises) through the Python twin from there
+            fallback_count("encode-bail")
+    return True
+
+
+def encoder_encode(enc) -> bool:
+    """LiveRegisterEncoder.encode_resolved, natively. Advances the
+    encoder's cursor/slots in place; a mid-stream bail leaves the
+    cursor AT the offending op so the Python twin resumes (and raises)
+    from bit-identical state. False = caller runs the twin outright."""
+    m = native_mod()
+    if m is None or not _encoder_eligible(enc):
+        return False
+    s = enc.stream
+    nxt, next_slot, n_slots, bailed = m.register_encode(
+        (enc._ops, enc._outcome, enc._open_by_process, enc._free_slots,
+         s.kind, s.slot, s.f, s.a, s.b, s.op_index,
+         enc.intern._ids, enc.intern.table,
+         enc._next, enc._next_slot, s.n_slots, enc._finalized))
+    enc._next, enc._next_slot, s.n_slots = nxt, next_slot, n_slots
+    if bailed:
+        fallback_count("encode-bail")
+        return False  # twin resumes from enc._next
+    return True
+
+
+def frontier_absorb(fs, stream, start: int, end: int | None = None):
+    """FrontierSession.absorb on the native path. Returns True when the
+    session state advanced natively; False when the caller must run
+    the Python twin (regime miss, config blow-up, or frontier death —
+    the C works on copies, so the twin replays from untouched state
+    and produces the identical failure forensics)."""
+    m = native_mod()
+    if m is None or fs.failure is not None:
+        return False
+    from jepsen_tpu.checker.linear_cpu import cas_register_step_py
+    if fs.step is not cas_register_step_py:
+        return False
+    kind = stream.kind
+    if not isinstance(kind, list):
+        return False  # numpy-backed streams take the Python loop
+    if end is None:
+        end = len(kind)
+    out = m.frontier_absorb(fs.configs, fs.cur, fs.cur_idx,
+                            fs.pending_mask, kind, stream.slot, stream.f,
+                            stream.a, stream.b, stream.op_index,
+                            start, end, fs.configs_max)
+    if out is None:
+        fallback_count("frontier-bail")
+        return False
+    if len(out) == 2 and out[0] == "dead":
+        fallback_count("frontier-dead")
+        return False  # twin replays for the failure payload
+    configs, cur, cur_idx, pending, cmax, _seen = out
+    fs.configs = configs
+    fs.cur = cur
+    fs.cur_idx = cur_idx
+    fs.pending_mask = pending
+    fs.configs_max = cmax
+    fs.events_absorbed = end
+    return True
+
+
+# -- the probe -----------------------------------------------------------
+
+_PROBE_WAL = (
+    b'{"type":"invoke","f":"write","value":3,"process":0,"time":11}\n'
+    b'{"type":"ok","f":"write","value":3,"process":0,"time":12}\n'
+    b'{"type":"invoke","f":"cas","value":[3,1],"process":1,"time":13}\n'
+    b'\n'
+    b'{"torn": tr\n'
+    b'{"type":"ok","f":"cas","value":[3,1],"process":1,"time":14}\n'
+    b'{"u":"\\ud83d\\ude00 caf\\u00e9 \\ud800","big":123456789012345678901,'
+    b'"neg":-0,"x":1.5e-3,"inf":Infinity}\n'
+    b'{"type":"invoke","f":"read","value":null,"process":2,"time":15}\n'
+    b'{"type":"ok","f":"read","value":1,"process":2,"time":16}\n'
+    b'{"type":"invoke","f":"read","value":null,"process":0,"time":17'
+)  # unterminated tail
+
+
+def _deep_eq(a, b) -> bool:
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, dict):
+        return (set(a) == set(b)
+                and all(_deep_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)):
+        return (len(a) == len(b)
+                and all(_deep_eq(x, y) for x, y in zip(a, b)))
+    if isinstance(a, float):
+        return repr(a) == repr(b)  # -0.0, nan-payload exactness
+    return a == b
+
+
+def _probe(m) -> bool:
+    """One-shot differential of every native entry point against its
+    Python twin over a canned nasty WAL. Any divergence (or exception)
+    condemns the native path for the process."""
+    try:
+        from jepsen_tpu.checker.linear_cpu import FrontierSession
+        from jepsen_tpu.checker.linear_encode import (
+            EV_INVOKE, EV_NOOP, EV_RETURN,
+        )
+        from jepsen_tpu.history import Intern
+        from jepsen_tpu.history_ir.builder import (
+            IncrementalHistoryBuilder, LiveRegisterEncoder,
+        )
+        from jepsen_tpu.journal import parse_wal_chunk_py
+        from jepsen_tpu.models import CAS_F_CAS, CAS_F_READ, CAS_F_WRITE
+        if (EV_INVOKE, EV_RETURN, EV_NOOP) != (0, 1, 2):
+            return False  # C hardcodes these
+        if (CAS_F_READ, CAS_F_WRITE, CAS_F_CAS) != (0, 1, 2):
+            return False
+        for final in (False, True):
+            got = m.ingest_chunk(_PROBE_WAL, final, _line_fallback,
+                                 _SKIP, _TORN)
+            want = parse_wal_chunk_py(_PROBE_WAL, final=final)
+            if not (_deep_eq(list(got[0]), list(want[0]))
+                    and got[1] == want[1] and got[2] == want[2]
+                    and bool(got[3]) == bool(want[3])):
+                logger.warning("native ingest probe: chunk parse "
+                               "diverged (final=%s); disabling", final)
+                return False
+        ops = parse_wal_chunk_py(_PROBE_WAL, final=True)[0]
+        ops = [o for o in ops if isinstance(o, dict) and "type" in o]
+        b1, b2 = IncrementalHistoryBuilder(), IncrementalHistoryBuilder()
+        for o in ops:
+            b1.add(o)
+        m.builder_extend(ops, 0, _builder_state(b2))
+        for at in ("ops", "_types", "_procs", "_fs", "_times", "_indices",
+                   "_value_ids", "values", "_completion_of",
+                   "_invocation_of", "_open_invoke"):
+            if not _deep_eq(getattr(b1, at), getattr(b2, at)):
+                logger.warning("native ingest probe: builder column %s "
+                               "diverged; disabling", at)
+                return False
+        if (b1._f_intern.table != b2._f_intern.table
+                or b1._v_intern.table != b2._v_intern.table):
+            logger.warning("native ingest probe: intern tables "
+                           "diverged; disabling")
+            return False
+        e1 = LiveRegisterEncoder(Intern())
+        e2 = LiveRegisterEncoder(Intern())
+        for o in ops:
+            e1.add(o)
+        m.register_add(ops, 0, (e2._ops, e2._open_inv, e2._outcome,
+                                e2.add))
+        e1._finalized = e2._finalized = True
+        e1.encode_resolved()
+        s2 = e2.stream
+        nxt, nslot, nslots, bailed = m.register_encode(
+            (e2._ops, e2._outcome, e2._open_by_process, e2._free_slots,
+             s2.kind, s2.slot, s2.f, s2.a, s2.b, s2.op_index,
+             e2.intern._ids, e2.intern.table,
+             e2._next, e2._next_slot, s2.n_slots, e2._finalized))
+        e2._next, e2._next_slot, s2.n_slots = nxt, nslot, nslots
+        if bailed:
+            e2.encode_resolved()
+        s1 = e1.stream
+        for at in ("kind", "slot", "f", "a", "b", "op_index", "n_slots"):
+            if getattr(s1, at) != getattr(s2, at):
+                logger.warning("native ingest probe: encoder stream %s "
+                               "diverged; disabling", at)
+                return False
+        if (e1._next, e1._next_slot, e1._free_slots, e1._open_by_process) \
+                != (e2._next, e2._next_slot, e2._free_slots,
+                    e2._open_by_process):
+            logger.warning("native ingest probe: encoder cursor "
+                           "diverged; disabling")
+            return False
+        f1, f2 = FrontierSession(), FrontierSession()
+        f1.absorb(s1, 0, len(s1.kind))
+        out = m.frontier_absorb(f2.configs, f2.cur, f2.cur_idx,
+                                f2.pending_mask, s2.kind, s2.slot, s2.f,
+                                s2.a, s2.b, s2.op_index, 0, len(s2.kind),
+                                f2.configs_max)
+        if out is None or (len(out) == 2 and out[0] == "dead"):
+            f2.absorb(s2, 0, len(s2.kind))
+        else:
+            (f2.configs, f2.cur, f2.cur_idx, f2.pending_mask,
+             f2.configs_max) = out[:5]
+            f2.events_absorbed = len(s2.kind)
+        if (f1.configs != f2.configs or f1.cur != f2.cur
+                or f1.cur_idx != f2.cur_idx
+                or f1.pending_mask != f2.pending_mask
+                or f1.configs_max != f2.configs_max
+                or f1.failure != f2.failure):
+            logger.warning("native ingest probe: frontier state "
+                           "diverged; disabling")
+            return False
+        if hasattr(m, "sim_lane"):
+            from jepsen_tpu.generator.simulate import _lane_probe
+            if not _lane_probe(m.sim_lane):
+                logger.warning("native ingest probe: scheduler lane "
+                               "diverged; disabling")
+                return False
+        return True
+    except Exception:  # noqa: BLE001 — a crashing probe condemns native
+        logger.exception("native ingest probe crashed; disabling")
+        return False

@@ -103,6 +103,53 @@ class Journal:  # durability: fsync
                 except OSError:
                     pass
 
+    # owner: interpreter scheduler thread (sole writer); the lock only
+    # guards against an abnormal-shutdown close() from the orchestrator
+    def append_many(self, ops) -> None:
+        """Batched twin of :meth:`append` for the chunked scheduler
+        drain (doc/performance.md "Host ingest spine"): serializes the
+        whole batch, then does ONE write+flush — and at most one
+        interval fsync — instead of a syscall pair per op. An
+        unserializable op drops that op only, exactly as in
+        :meth:`append`; the surviving lines still land in batch order,
+        so the WAL bytes are identical to per-op appends of the same
+        sequence."""
+        from jepsen_tpu.store import _serializable
+        parts: list[str] = []
+        for op in ops:
+            try:
+                parts.append(json.dumps(_serializable(op)) + "\n")
+            except Exception:  # noqa: BLE001 — journaling never kills a run
+                logger.exception("unserializable op dropped from WAL")
+        if not parts:
+            return
+        with self._lock:
+            if self._f.closed:
+                return
+            try:
+                self._f.write("".join(parts))
+                self._f.flush()
+                self.appended += len(parts)
+                interval = self.fsync_interval_s
+                if interval is not None and interval >= 0:
+                    now = time.monotonic()
+                    if interval == 0 or now - self._last_fsync >= interval:
+                        os.fsync(self._f.fileno())
+                        self._last_fsync = now
+                        from jepsen_tpu import trace as trace_mod
+                        tracer = trace_mod.get_tracer()
+                        if tracer.enabled:
+                            tracer.instant(
+                                trace_mod.TRACK_WAL, "wal-fsync",
+                                args={"appended": self.appended})
+            except OSError:
+                logger.exception("WAL write failed; journaling off for "
+                                 "the rest of the run")
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+
     def sync(self) -> None:
         with self._lock:
             if not self._f.closed:
@@ -212,6 +259,61 @@ def read_jsonl_tolerant(path) -> tuple[list[dict], bool]:
     return rows, truncated
 
 
+def parse_wal_chunk_py(chunk: bytes, final: bool = False):
+    """Pure-Python twin of the native ``ingest_chunk`` scanner — the
+    WAL chunk protocol both paths implement bit-identically
+    (doc/performance.md "Host ingest spine").
+
+    Takes the raw bytes read from a WAL at some resume cursor and
+    returns ``(ops, consumed, torn, truncated)``:
+
+    * ``ops`` — the parsed documents of every complete (newline-
+      terminated) line, in order; whitespace-only lines skipped.
+    * ``consumed`` — bytes the caller's cursor may advance past: the
+      newline-terminated prefix, plus the dropped unterminated tail
+      when ``final``. Never lands mid-line, so ``(offset, prefix_sha)``
+      stays a valid resume token at every chunk boundary.
+    * ``torn`` — newline-terminated lines that didn't parse (interior
+      tears), plus the dropped tail when ``final`` truncates one.
+    * ``truncated`` — True when ``final`` dropped an unterminated
+      in-progress final line.
+    """
+    ops: list = []
+    torn = 0
+    nl = chunk.rfind(b"\n")
+    pos = nl + 1  # bytes of newline-terminated (complete) lines
+    loads = json.loads
+    if pos:
+        # fast path: the whole complete portion as ONE json array
+        # (~2.7x a per-line loop); tolerant per-line path only when
+        # something in the chunk doesn't parse
+        body = chunk[:nl]
+        try:
+            ops = loads(b"[" + body.replace(b"\n", b",") + b"]")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            ops = []
+            try:
+                lines = body.decode("utf-8").split("\n")
+            except UnicodeDecodeError:
+                lines = body.decode("utf-8", "replace").split("\n")
+            for line in lines:
+                if not line or line.isspace():
+                    continue
+                try:
+                    ops.append(loads(line))
+                except json.JSONDecodeError:
+                    torn += 1
+                    logger.debug("torn jsonl line in chunk (%.80r)", line)
+    consumed = pos
+    truncated = False
+    if final and pos < len(chunk):
+        # unterminated tail at end-of-run: permanently torn
+        truncated = True
+        torn += 1
+        consumed = len(chunk)
+    return ops, consumed, torn, truncated
+
+
 class WalTailer:
     """Incremental offset-tracking WAL reader for the live checker
     (doc/observability.md "Live checking").
@@ -246,12 +348,32 @@ class WalTailer:
         # the live daemon's restart snapshots record it so a resumed
         # tailer can prove it is continuing the SAME file (divergence-
         # checked adoption, doc/robustness.md "Resumable checks and the
-        # elastic mesh")
+        # elastic mesh"). Maintained LAZILY: hashing 30-60ns/op on the
+        # ingest hot loop for a digest that is only read at snapshot
+        # points would cost real throughput, and the consumed prefix of
+        # an append-only WAL never changes — so poll() just advances
+        # the offset and prefix_sha() catches the digest up from the
+        # file on demand.
         self._sha = hashlib.sha256()
+        self._sha_pos = 0  # bytes already folded into _sha
 
     def prefix_sha(self) -> str:
         """sha256 of the bytes consumed so far (everything before
         ``offset``)."""
+        if self._sha_pos < self.offset:
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(self._sha_pos)
+                    remaining = self.offset - self._sha_pos
+                    while remaining > 0:
+                        chunk = f.read(min(1 << 20, remaining))
+                        if not chunk:
+                            break  # truncated under us; digest of what
+                        self._sha.update(chunk)
+                        self._sha_pos += len(chunk)
+                        remaining -= len(chunk)
+            except OSError:
+                pass
         return self._sha.hexdigest()
 
     def seek(self, offset: int, lines_read: int = 0,
@@ -282,6 +404,7 @@ class WalTailer:
         self.lines_read = int(lines_read)
         self.torn_skipped = int(torn_skipped)
         self._sha = h
+        self._sha_pos = offset
         return True
 
     def _read_new(self) -> bytes:
@@ -296,47 +419,26 @@ class WalTailer:
         chunk = self._read_new()
         if not chunk:
             return []
-        ops: list[dict] = []
-        # json.loads dominates the tail at 100k+ lines/s: the fast path
-        # parses the whole complete portion as ONE json array (~2.7x a
-        # per-line loop — C-level parse, no per-call overhead), falling
-        # back to the tolerant per-line path only when something in the
-        # chunk doesn't parse (a torn mid-file line, an empty line)
-        nl = chunk.rfind(b"\n")
-        pos = nl + 1  # bytes of newline-terminated (complete) lines
-        loads = json.loads
-        if pos:
-            body = chunk[:nl]
-            try:
-                ops = loads(b"[" + body.replace(b"\n", b",") + b"]")
-                self.lines_read += len(ops)
-            except (json.JSONDecodeError, UnicodeDecodeError):
-                ops = []
-                try:
-                    lines = body.decode("utf-8").split("\n")
-                except UnicodeDecodeError:
-                    lines = body.decode("utf-8", "replace").split("\n")
-                for line in lines:
-                    if not line or line.isspace():
-                        continue
-                    try:
-                        ops.append(loads(line))
-                        self.lines_read += 1
-                    except json.JSONDecodeError:
-                        self.torn_skipped += 1
-                        logger.warning(
-                            "live tail: skipping torn jsonl line in %s "
-                            "(%.80r)", self.path, line)
-        # the offset only ever advances past newline-terminated lines;
-        # the running digest advances in lockstep (seek() verifies it)
-        self.offset += pos
-        self._sha.update(chunk[:pos])
-        if final and pos < len(chunk):
-            # unterminated tail at end-of-run: permanently torn
+        # the hot loop lives in the native ingest spine when available
+        # (native/columnar_ext.c ingest_chunk, ~10x json.loads on op
+        # traffic); parse_wal_chunk_py is the bit-identical fallback
+        # behind the probe/disable protocol (doc/performance.md)
+        from jepsen_tpu.history_ir import ingest
+        ops, consumed, torn, truncated = ingest.parse_wal_chunk(
+            chunk, final=final)
+        self.lines_read += len(ops)
+        if torn:
+            self.torn_skipped += torn
+            interior = torn - (1 if truncated else 0)
+            if interior:
+                logger.warning("live tail: skipped %d torn jsonl "
+                               "line(s) in %s", interior, self.path)
+        # the offset only ever advances past newline-terminated lines
+        # (plus the dropped tail when final); the prefix digest catches
+        # up lazily from the file (seek() verifies it)
+        self.offset += consumed
+        if truncated:
             self.truncated_tail = True
-            self.torn_skipped += 1
-            self.offset += len(chunk) - pos
-            self._sha.update(chunk[pos:])
             logger.warning("live tail: dropped unterminated final line "
                            "in %s", self.path)
         return ops
@@ -363,7 +465,6 @@ class WalTailer:
         body = chunk[:nl + 1]
         self.lines_read += body.count(b"\n")
         self.offset += len(body)
-        self._sha.update(body)
         return body
 
     def finalize(self) -> list[dict]:

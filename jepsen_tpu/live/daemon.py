@@ -270,9 +270,17 @@ class RunTracker:  # durability: fsync
                 self._sniff_buf = []
             elif sniffed is not None:
                 self.session = sniffed
-                for op in self._sniff_buf:
-                    self.session.add(op)
+                self._add_chunk(self._sniff_buf)
                 self._sniff_buf = []
+            return
+        self._add_chunk(ops)
+
+    def _add_chunk(self, ops: list[dict]) -> None:
+        # chunked ingest when the session supports it (one native call
+        # per poll — doc/performance.md "Host ingest spine")
+        add_many = getattr(self.session, "add_many", None)
+        if add_many is not None:
+            add_many(ops)
             return
         for op in ops:
             self.session.add(op)

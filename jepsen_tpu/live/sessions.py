@@ -91,6 +91,18 @@ class LinearLiveSession:
             self._broken = f"unencodable op: {e!r}"
             logger.exception("live register session poisoned")
 
+    def add_many(self, ops: list) -> None:
+        """Chunked ingest: one native call per WAL poll instead of a
+        Python frame per op (doc/performance.md "Host ingest spine"),
+        with the same poison-not-kill contract as :meth:`add`."""
+        if self._broken:
+            return
+        try:
+            self.encoder.add_many(ops)
+        except Exception as e:  # noqa: BLE001 — a bad op poisons, not kills
+            self._broken = f"unencodable op: {e!r}"
+            logger.exception("live register session poisoned")
+
     @property
     def ops_absorbed(self) -> int:
         return self.encoder.ops_seen
@@ -329,6 +341,10 @@ class ElleSession:
         self.history.append(op)
         self._cols.absorb(i, op)
 
+    def add_many(self, ops: list) -> None:
+        for op in ops:
+            self.add(op)
+
     def _check_batch(self) -> dict:
         from jepsen_tpu.elle import list_append
         return list_append.check(
@@ -463,6 +479,10 @@ class MultiKeyLinearSession:
             sess = self.sub[k] = LinearLiveSession(
                 accelerator=self.accelerator)
         sess.add({**op, "value": v[1]})
+
+    def add_many(self, ops: list) -> None:
+        for op in ops:
+            self.add(op)
 
     @property
     def checked_ops(self) -> int:

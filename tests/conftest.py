@@ -151,6 +151,39 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True, scope="session")
+def _native_ingest_build_guard():
+    """Tier-1 guard for the host ingest spine: when a compiler is
+    present, the C extension must BUILD and pass its differential
+    probe — a silent fallback to the Python twins would let native
+    regressions (or a probe divergence) ship unnoticed behind green
+    tests. No compiler (g++ genuinely absent) still degrades softly;
+    every other failure is loud."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        yield
+        return
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.native import columnar_c
+    try:
+        so = columnar_c.build()
+    except Exception as e:  # noqa: BLE001 — rethrown as the loud signal
+        pytest.exit("native ingest guard: columnar_ext.c failed to "
+                    f"compile with g++ present: {e!r}", returncode=1)
+    m = columnar_c.mod()
+    if m is None or not hasattr(m, "ingest_chunk"):
+        pytest.exit(f"native ingest guard: built {so} but the module "
+                    "did not load or lacks the spine entry points",
+                    returncode=1)
+    if ingest.native_mod() is None:
+        pytest.exit("native ingest guard: extension built but the "
+                    "differential probe condemned it (see "
+                    "jepsen.history_ir log) — tier-1 must not run on "
+                    "a silently-diverged native path", returncode=1)
+    yield
+
+
+@pytest.fixture(autouse=True, scope="session")
 def _hermetic_fs_cache(tmp_path_factory):
     """fs_cache writes (the pallas probe-verdict sidecar above all —
     ops/pallas_matrix persists per-backend probe results there) land in

@@ -575,3 +575,148 @@ def test_malformed_history_falls_back_soft():
     assert "_history_ir" not in t
     assert list_append.check(h, accelerator="auto", ir=None)["valid?"] \
         is True
+
+
+# -- host ingest spine: native vs Python differentials ------------------
+#
+# The native WAL tail→parse→IR path (native/columnar_ext.c via
+# history_ir.ingest) must be bit-identical to the Python twins over
+# every torn-tail shape the tolerant reader defines. Each case runs the
+# SAME bytes through m.ingest_chunk and journal.parse_wal_chunk_py and
+# compares the full (ops, consumed, torn, truncated) tuple with
+# type-exact deep equality (int-vs-float, -0.0, key sets).
+
+def _native_ingest():
+    from jepsen_tpu.history_ir import ingest
+    m = ingest.native_mod()
+    if m is None:
+        pytest.skip("native ingest extension unavailable")
+    return m, ingest
+
+
+def _chunk_both(m, ingest, chunk: bytes, final: bool):
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    got = m.ingest_chunk(chunk, final, ingest._line_fallback,
+                         ingest._SKIP, ingest._TORN)
+    want = parse_wal_chunk_py(chunk, final=final)
+    assert ingest._deep_eq(list(got[0]), list(want[0])), \
+        f"ops diverged (final={final})"
+    assert got[1] == want[1], "consumed diverged"
+    assert got[2] == want[2], "torn count diverged"
+    assert bool(got[3]) == bool(want[3]), "truncated flag diverged"
+    return want
+
+
+_L = b'{"type":"ok","f":"write","value":%d,"process":0,"time":%d}\n'
+
+
+@pytest.mark.parametrize("final", [False, True])
+def test_ingest_chunk_torn_final_line(final):
+    m, ingest = _native_ingest()
+    chunk = (_L % (1, 10)) + (_L % (2, 11)) + b'{"type":"ok","f":"wr'
+    ops, consumed, torn, truncated = _chunk_both(m, ingest, chunk, final)
+    assert len(ops) == 2
+    if final:
+        assert truncated and torn == 1 and consumed == len(chunk)
+    else:
+        # cursor parks at the tear; the next poll resumes there
+        assert not truncated and torn == 0
+        assert consumed == len(chunk) - len(b'{"type":"ok","f":"wr')
+
+
+@pytest.mark.parametrize("final", [False, True])
+def test_ingest_chunk_torn_interior_line(final):
+    m, ingest = _native_ingest()
+    chunk = (_L % (1, 10)) + b'{"torn": tru\n' + (_L % (2, 11))
+    ops, consumed, torn, truncated = _chunk_both(m, ingest, chunk, final)
+    # one tear costs one op, never the lines after it
+    assert [o["value"] for o in ops] == [1, 2]
+    assert torn == 1 and not truncated and consumed == len(chunk)
+
+
+def test_ingest_chunk_unicode_and_large_values():
+    m, ingest = _native_ingest()
+    chunk = (
+        b'{"u":"\\ud83d\\ude00 caf\\u00e9","lone":"\\ud800tail"}\n'
+        b'{"big":123456789012345678901234567890,"neg":-0,'
+        b'"f":1.5e-300,"ninf":-Infinity,"nan":NaN}\n'
+        + ('{"raw":"' + "\u00e9\u6f22\U0001f600" + '"}\n').encode()
+        + b'{"deep":[[[[[1]]]]],"v":' + str(2**70).encode() + b'}\n')
+    ops, consumed, torn, truncated = _chunk_both(m, ingest, chunk, True)
+    assert len(ops) == 4 and torn == 0 and not truncated
+    assert ops[3]["v"] == 2**70  # arbitrary-precision ints survive
+
+
+def test_ingest_chunk_whitespace_and_empty_lines():
+    m, ingest = _native_ingest()
+    chunk = b"\n   \n" + (_L % (5, 20)) + b"\t\n" + (_L % (6, 21))
+    ops, consumed, torn, truncated = _chunk_both(m, ingest, chunk, True)
+    assert [o["value"] for o in ops] == [5, 6]
+    assert torn == 0  # whitespace-only lines skip silently, never count
+
+
+def test_wal_tailer_resume_from_offset_prefix_sha(tmp_path):
+    """WalTailer.seek's (offset, prefix_sha256) resume token advances
+    identically whether the polls ran native or pure-Python — a
+    receiver that restarts onto the other path resumes at the same op."""
+    import hashlib
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import WalTailer
+    p = tmp_path / "history.wal.jsonl"
+    body = b"".join(_L % (i, 100 + i) for i in range(50))
+    p.write_bytes(body[: len(body) - 7])  # mid-line tear at the tail
+
+    ingest.reset()
+    tailers = {}
+    for mode, env in (("native", "1"), ("python", "0")):
+        import os as _os
+        old = _os.environ.get("JEPSEN_TPU_INGEST_NATIVE")
+        _os.environ["JEPSEN_TPU_INGEST_NATIVE"] = env
+        try:
+            ingest.reset()
+            t = WalTailer(p)
+            ops = t.poll()
+            tailers[mode] = (len(ops), t.offset, t.prefix_sha())
+        finally:
+            if old is None:
+                _os.environ.pop("JEPSEN_TPU_INGEST_NATIVE", None)
+            else:
+                _os.environ["JEPSEN_TPU_INGEST_NATIVE"] = old
+            ingest.reset()
+    assert tailers["native"] == tailers["python"]
+    n_ops, off, sha = tailers["native"]
+    assert n_ops == 49  # the torn tail op is parked, not delivered
+    assert sha == hashlib.sha256(body[:off]).hexdigest()
+    # resume a FRESH tailer from the recorded token: identical pickup
+    t2 = WalTailer(p)
+    t2.seek(off, lines_read=n_ops)
+    p.write_bytes(body)  # writer completes the torn line
+    more = t2.poll()
+    assert [o["value"] for o in more] == [49]
+
+
+def test_fleet_ingest_feeds_native_parse(tmp_path):
+    """The fleet receiver hands verified chunk bytes straight to the
+    native parse while they're in memory: the feed consumer sees every
+    op exactly once and in order even when a chunk boundary splits a
+    line, and the receiver's parse counters match."""
+    import hashlib
+    from jepsen_tpu.fleet.ingest import IngestServer
+    got = []
+    srv = IngestServer(tmp_path, registry=telemetry.Registry(),
+                       feed=lambda key, ops: got.extend(
+                           (key, o["value"]) for o in ops))
+    body = b"".join(_L % (i, 100 + i) for i in range(10))
+    cut = body.index(b"\n", len(body) // 2) + 30  # mid-line split
+    sha = hashlib.sha256()
+    off = 0
+    for part in (body[:cut], body[cut:]):
+        prefix = sha.hexdigest()
+        sha.update(part)
+        assert srv.append_chunk("run/ts1", off, prefix,
+                                sha.hexdigest(), part) is None
+        off += len(part)
+    assert [v for _, v in got] == list(range(10))
+    assert all(k == "run/ts1" for k, _ in got)
+    st = srv.parse_stats()["run/ts1"]
+    assert st["ops"] == 10 and st["torn"] == 0

@@ -703,3 +703,58 @@ def test_conftest_budget_guard_names_slowest(capsys):
     finally:
         conftest._TEST_DURATIONS.clear()
         conftest._TEST_DURATIONS.update(saved)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_append_native_python_identical(tmp_path):
+    """SIGKILL a writer mid-append, then parse the surviving WAL through
+    both sides of the host ingest spine: the native chunk scanner and
+    the Python tolerant reader must deliver the identical op list and
+    torn-tail verdict on whatever byte prefix the kill left behind."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+    from pathlib import Path
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py, read_jsonl_tolerant
+    wal = tmp_path / "kill.wal.jsonl"
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from jepsen_tpu.journal import Journal\n"
+        "j = Journal(%r, fsync_interval_s=0.0)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    j.append({'type': 'ok', 'f': 'write', 'value': i,\n"
+        "              'process': i %% 5, 'time': i,\n"
+        "              'pad': 'x' * (i %% 97)})\n"
+        "    i += 1\n" % (str(Path(__file__).parent.parent), str(wal)))
+    proc = subprocess.Popen([sys.executable, "-c", code])
+    try:
+        deadline = _t.monotonic() + 30.0
+        while _t.monotonic() < deadline:
+            if wal.exists() and wal.stat().st_size > 20_000:
+                break
+            _t.sleep(0.02)
+        else:
+            pytest.fail("writer produced no WAL bytes to kill over")
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+    raw = wal.read_bytes()
+    m = ingest.native_mod()
+    if m is None:
+        pytest.skip("native ingest extension unavailable")
+    for final in (False, True):
+        got = m.ingest_chunk(raw, final, ingest._line_fallback,
+                             ingest._SKIP, ingest._TORN)
+        want = parse_wal_chunk_py(raw, final=final)
+        assert ingest._deep_eq(list(got[0]), list(want[0]))
+        assert (got[1], got[2], bool(got[3])) == \
+            (want[1], want[2], bool(want[3]))
+    # and both agree with the tolerant whole-file reader's op list
+    rows, _trunc = read_jsonl_tolerant(wal)
+    assert ingest._deep_eq(list(got[0]), rows)
+    assert [o["value"] for o in rows] == list(range(len(rows)))

@@ -1,0 +1,268 @@
+"""Chunked interpreter scheduler + native scheduler lane
+(doc/performance.md "Host ingest spine").
+
+Pins the Tentpole-B contracts: the ``_SchedBus`` drains whole chunks
+without reordering a single completion, the coalesced WAL lands the
+same record sequence (and the same bytes) a per-op journal would, the
+``sched_batch_ops`` knob and its env twin coerce tolerantly, and the
+native ``sim_lane`` is bit-identical to the pure-Python simulated
+scheduler — including the mid-op bail path.
+"""
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator.interpreter import (
+    DEFAULT_SCHED_BATCH_OPS, _SchedBus, run, ClientWorker,
+)
+from jepsen_tpu.generator.simulate import quick
+from jepsen_tpu.journal import Journal
+
+
+# -- _SchedBus ----------------------------------------------------------
+
+def test_sched_bus_preserves_arrival_order():
+    bus = _SchedBus(max_chunk=8)
+    for i in range(20):
+        bus.put(i)
+    out = []
+    while True:
+        chunk = bus.drain_nowait()
+        if not chunk:
+            break
+        assert len(chunk) <= 8
+        out.extend(chunk)
+    assert out == list(range(20))
+
+
+def test_sched_bus_max_chunk_caps_and_remainder_stays():
+    bus = _SchedBus(max_chunk=3)
+    for i in range(5):
+        bus.put(i)
+    assert bus.drain_nowait() == [0, 1, 2]
+    assert bus.qsize() == 2
+    assert bus.drain(0.0) == [3, 4]
+
+
+def test_sched_bus_drain_timeout_is_empty_list():
+    bus = _SchedBus(max_chunk=4)
+    assert bus.drain(0.01) == []  # the queue.Empty analog
+    assert bus.drain_nowait() == []
+
+
+def test_sched_bus_wakes_blocked_drain():
+    bus = _SchedBus(max_chunk=4)
+    got = []
+
+    def producer():
+        bus.put("x")
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = bus.drain(5.0)
+    t.join()
+    assert got == ["x"]
+
+
+def test_sched_bus_concurrent_producers_lose_nothing():
+    bus = _SchedBus(max_chunk=7)
+    n_workers, per = 8, 200
+
+    def worker(wid):
+        for i in range(per):
+            bus.put((wid, i))
+
+    ts = [threading.Thread(target=worker, args=(w,))
+          for w in range(n_workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    seen = []
+    while bus.qsize():
+        seen.extend(bus.drain_nowait())
+    assert len(seen) == n_workers * per
+    # per-producer order is preserved even when interleaved
+    for w in range(n_workers):
+        assert [i for wid, i in seen if wid == w] == list(range(per))
+
+
+# -- knob + env twin ----------------------------------------------------
+
+class _EchoClient:
+    def open(self, test, node):
+        return self
+
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+    def teardown(self, test):
+        pass
+
+    def close(self, test):
+        pass
+
+
+def _threaded_run(n=300, conc=3, journal=None, **knobs):
+    test = {"concurrency": conc, "client": _EchoClient(),
+            "nodes": ["n1"], "name": "sched-batch",
+            "generator": gen.clients(gen.limit(
+                n, gen.Fn(lambda: {"f": "write", "value": 1}))),
+            **({"_journal": journal} if journal is not None else {}),
+            **knobs}
+    return run(test)
+
+
+@pytest.mark.parametrize("knob", [None, 0, 1, 64, "257", "bogus"])
+def test_sched_batch_knob_accepts_all_forms(knob):
+    kw = {} if knob is None else {"sched_batch_ops": knob}
+    h = _threaded_run(n=120, **kw)
+    ok = [o for o in h if o["type"] == "ok"]
+    assert len(ok) == 120
+
+
+def test_sched_batch_env_twin(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_SCHED_BATCH", "16")
+    h = _threaded_run(n=90)
+    assert len([o for o in h if o["type"] == "ok"]) == 90
+
+
+# -- WAL coalescing -----------------------------------------------------
+
+def test_append_many_bytes_identical_to_per_op(tmp_path):
+    """Journal.append_many is the coalesced landing the scheduler's
+    wal_flush uses: for the same records it must write the exact bytes
+    a per-op append loop would."""
+    rng = random.Random(11)
+    ops = [{"type": "ok", "f": "write", "value": rng.randint(-5, 5),
+            "process": i % 4, "time": i,
+            "u": "café \U0001f600", "big": 2**70 + i}
+           for i in range(500)]
+    p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    j1 = Journal(p1)
+    for o in ops:
+        j1.append(o)
+    j1.close()
+    j2 = Journal(p2)
+    j2.append_many(ops)
+    j2.close()
+    assert p1.read_bytes() == p2.read_bytes()
+
+
+def test_batched_wal_order_matches_history(tmp_path):
+    """Under chunked scheduling the journal must still receive every
+    history-bound record in exact history order — coalescing batches
+    the WRITES, never reorders the records."""
+    wal = tmp_path / "history.wal.jsonl"
+    j = Journal(wal)
+    h = _threaded_run(n=400, conc=4, journal=j, sched_batch_ops=32)
+    j.close()
+    recs = [json.loads(ln) for ln in wal.read_bytes().splitlines()]
+    want = [o for o in h if o.get("type") in
+            ("invoke", "ok", "fail", "info")]
+    assert recs == want
+
+
+def test_per_op_fallback_wal_order_matches_history(tmp_path):
+    wal = tmp_path / "history.wal.jsonl"
+    j = Journal(wal)
+    h = _threaded_run(n=200, conc=4, journal=j, sched_batch_ops=0)
+    j.close()
+    recs = [json.loads(ln) for ln in wal.read_bytes().splitlines()]
+    assert recs == [o for o in h if o.get("type") in
+                    ("invoke", "ok", "fail", "info")]
+
+
+# -- native scheduler lane ---------------------------------------------
+
+def _lane_available():
+    from jepsen_tpu.history_ir import ingest
+    if ingest.sim_lane() is None:
+        pytest.skip("native scheduler lane unavailable")
+
+
+def _fingerprint(h):
+    # key ORDER is part of the contract (json/repr observability)
+    return [list(op.items()) for op in h]
+
+
+def _mk_plain(n):
+    return gen.limit(n, gen.Fn(lambda: {"f": "write", "value": 1}))
+
+
+def _mk_bail(n):
+    cnt = {"i": 0}
+
+    def f():
+        cnt["i"] += 1
+        if cnt["i"] % 7 == 0:
+            # explicit process: the lane can't simulate it → mid-op bail
+            return {"f": "write", "value": cnt["i"], "process": 0}
+        if cnt["i"] > n:
+            return None
+        return {"f": "write", "value": cnt["i"]}
+
+    return gen.limit(n, gen.Fn(f))
+
+
+@pytest.mark.parametrize("mk", [_mk_plain, _mk_bail])
+@pytest.mark.parametrize("seed", [0, 7, 42])
+@pytest.mark.parametrize("conc", [1, 2, 5])
+def test_sim_lane_bit_identical_to_python(mk, seed, conc):
+    """simulate() with the native lane vs the forced pure-Python loop:
+    identical history (values, key order), identical end-of-run rng
+    state — including generators that force the mid-op bail path."""
+    _lane_available()
+    from jepsen_tpu.history_ir import ingest
+
+    def one(env):
+        os.environ["JEPSEN_TPU_INGEST_NATIVE"] = env
+        ingest.reset()
+        try:
+            test = {"concurrency": conc, "name": "lane-diff"}
+            stats = {}
+            h = quick(test, mk(60), seed=seed, stats=stats)
+            return _fingerprint(h), stats
+        finally:
+            os.environ.pop("JEPSEN_TPU_INGEST_NATIVE", None)
+            ingest.reset()
+
+    assert one("1") == one("0")
+
+
+def test_sim_lane_exception_folds_back_and_propagates():
+    """An f() that raises mid-lane must surface the exception AND leave
+    steps/rng folded back exactly like the pure loop."""
+    _lane_available()
+    from jepsen_tpu.history_ir import ingest
+
+    def one(env):
+        os.environ["JEPSEN_TPU_INGEST_NATIVE"] = env
+        ingest.reset()
+        try:
+            cnt = {"i": 0}
+
+            def f():
+                cnt["i"] += 1
+                if cnt["i"] == 13:
+                    raise RuntimeError("boom")
+                return {"f": "write", "value": cnt["i"]}
+
+            try:
+                quick({"concurrency": 3, "name": "lane-raise"},
+                      gen.limit(50, gen.Fn(f)), seed=3)
+            except RuntimeError as e:
+                return cnt["i"], str(e)
+            pytest.fail("exception did not propagate")
+        finally:
+            os.environ.pop("JEPSEN_TPU_INGEST_NATIVE", None)
+            ingest.reset()
+
+    assert one("1") == one("0")
