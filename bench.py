@@ -1808,6 +1808,67 @@ def cfg_lint():
          rules=len(lint_mod.RULE_NAMES), trials=len(times))
 
 
+def cfg_fuzz():
+    """fuzz_trials_per_sec + fuzz_coverage_edges_per_1k_trials: the
+    schedule fuzzer's throughput and its guidance signal. Two hunts at
+    an identical 300-trial budget over a bug-free target (inline pool,
+    no early stop): one coverage-guided, one blind-random. Throughput
+    is the guided hunt's trials/wall. The guidance bar rides the DEEP
+    edges — fault×op interleavings whose active mask composes >= 3
+    fault kinds, the class the corpus splicer exists to reach (blind
+    triple-overlaps are rare by construction): guided must find >= 2x
+    the blind count at equal trials. ``vs_baseline`` on the edges
+    metric is ratio/2 (>1 = over bar). Fully deterministic given the
+    seed, so the ratio is a regression pin, not a flake."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu.fuzz.hunt import Hunter
+
+    trials, seed = 300, 1
+
+    def deep(edges):
+        # "op:<kind+kind+...>:<f>" edges with a 3-way composed mask
+        return sum(1 for e in edges
+                   if e.startswith("op:")
+                   and len(e.split(":")[1].split("+")) >= 3)
+
+    tmp = tempfile.mkdtemp(prefix="jepsen-bench-fuzz-")
+    try:
+        res = {}
+        for mode in ("guided", "blind"):
+            h = Hunter(os.path.join(tmp, mode), trials=trials,
+                       pool_workers=0, trial_ops=120, seed=seed,
+                       guided=(mode == "guided"), bug_spec=None,
+                       batch_size=25, stop_on_first=False)
+            t0 = time.perf_counter()
+            summary = h.run()
+            wall = time.perf_counter() - t0
+            assert summary["trials"] == trials, summary
+            assert summary["outcomes"].get("error", 0) == 0, (
+                f"{mode} hunt hit errored trials: {summary['outcomes']}")
+            res[mode] = {"wall": wall, "edges": set(h.covmap.edges)}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    g, b = res["guided"], res["blind"]
+    g_deep, b_deep = deep(g["edges"]), deep(b["edges"])
+    ratio = g_deep / max(b_deep, 1)
+    assert ratio >= 2.0, (
+        f"guided found {g_deep} deep edges vs blind {b_deep} at "
+        f"{trials} trials — guidance bar is >= 2x")
+    trials_per_sec = trials / g["wall"]
+    emit("fuzz_trials_per_sec", trials_per_sec, "trials/s",
+         trials_per_sec / 20.0, trials=trials, seed=seed,
+         guided_wall_s=round(g["wall"], 2),
+         blind_wall_s=round(b["wall"], 2))
+    emit("fuzz_coverage_edges_per_1k_trials",
+         len(g["edges"]) * 1000.0 / trials, "edges/1k",
+         ratio / 2.0, deep_edges_guided=g_deep, deep_edges_blind=b_deep,
+         edges_guided=len(g["edges"]), edges_blind=len(b["edges"]),
+         guided_vs_blind_deep_ratio=round(ratio, 2))
+
+
 def cfg_headline() -> float:
     """The headline, printed last: a 10k-op single-register history on
     device vs the reference's 1 h CPU knossos timeout.
@@ -1902,6 +1963,7 @@ def main() -> None:
     guard("trace", cfg_trace)
     guard("fleet", cfg_fleet_runs_sustained)
     guard("lint", cfg_lint)
+    guard("fuzz", cfg_fuzz)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
 

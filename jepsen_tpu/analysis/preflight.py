@@ -408,6 +408,14 @@ _NUMERIC_KNOBS = (
     # tolerantly at runtime (garbage warns + default, 0/None = per-op
     # fallback), preflight is where garbage becomes an error
     ("sched_batch_ops", True, 0.0),
+    # schedule fuzzer knobs (doc/robustness.md "Schedule fuzzing"):
+    # the hunt coerces tolerantly (fuzz.hunt.fuzz_knob) — preflight is
+    # where garbage becomes an error. fuzz_seed accepts any finite
+    # value (a seed is just entropy).
+    ("fuzz_trials", True, 1.0),
+    ("fuzz_pool_workers", True, 0.0),
+    ("fuzz_trial_ops", True, 8.0),
+    ("fuzz_seed", True, None),
 )
 
 # bool knobs, tolerantly coerced at runtime (parallel.coerce_flag —
@@ -475,6 +483,18 @@ _ENV_NUMERIC_KNOBS = (
     ("JEPSEN_TPU_FLEET_MAX_RUNS",
      "process-wide twin of fleet_max_runs (the pool's admission cap "
      "on concurrently tracked runs)"),
+    ("JEPSEN_TPU_FUZZ_TRIALS",
+     "process-wide twin of fuzz_trials (the hunt's trial budget, "
+     "doc/robustness.md \"Schedule fuzzing\")"),
+    ("JEPSEN_TPU_FUZZ_POOL_WORKERS",
+     "process-wide twin of fuzz_pool_workers (trial pool processes; "
+     "0/1 runs trials inline)"),
+    ("JEPSEN_TPU_FUZZ_TRIAL_OPS",
+     "process-wide twin of fuzz_trial_ops (client ops per fuzz "
+     "trial)"),
+    ("JEPSEN_TPU_FUZZ_SEED",
+     "process-wide twin of fuzz_seed (the hunt seed; the whole "
+     "search replays bit-identically from it)"),
 )
 
 _UNSET = object()

@@ -106,6 +106,55 @@ def max_witness_ops(test=None) -> int:
                          DEFAULT_MAX_WITNESS_OPS, lo=1)
 
 
+def ddmin(items: list, fails, budget: int = DEFAULT_SHRINK_BUDGET,
+          min_items: int = 0) -> tuple[list, dict]:
+    """Generic bounded delta-debugging minimization — the exact round
+    structure of the device witness shrink in
+    :func:`_forensics_from_loc`, lifted over a plain predicate so other
+    reproducers (the schedule fuzzer's failing-trial minimization,
+    doc/robustness.md "Schedule fuzzing") shrink through the same
+    machinery. ``fails(subset)`` returns True when the failure still
+    reproduces with only ``subset`` kept; the caller has already
+    established ``fails(items)``. Returns ``(kept, info)`` with
+    ``info["minimal"]`` a PROOF, not a progress report: True only when
+    a full single-item-granularity round removed nothing (or nothing
+    removable remains) — a loop cut short by the evaluation budget
+    shrank the input but proved nothing about irreducibility."""
+    kept = list(items)
+    rounds = candidates_used = 0
+    n = 2
+    converged = not kept
+    while kept and len(kept) > min_items and n <= len(kept) \
+            and budget > 0:
+        chunk = (len(kept) + n - 1) // n
+        segs = [kept[i:i + chunk] for i in range(0, len(kept), chunk)]
+        cands = [[x for j, seg in enumerate(segs) if j != i for x in seg]
+                 for i in range(len(segs))]
+        truncated = len(cands) > budget
+        cands = cands[:budget]
+        rounds += 1
+        hit = None
+        for i, cand in enumerate(cands):
+            budget -= 1
+            candidates_used += 1
+            if fails(cand):
+                hit = i
+                break
+        if hit is not None:
+            kept = cands[hit]
+            n = max(2, min(n - 1, max(1, len(kept))))
+            if not kept:
+                converged = True
+                break
+        else:
+            if n >= len(kept):
+                converged = not truncated and budget >= 0
+                break
+            n = min(len(kept), 2 * n)
+    return kept, {"rounds": rounds, "candidates": candidates_used,
+                  "minimal": converged}
+
+
 # ---------------------------------------------------------------------------
 # Core: forensics over an encoded stream
 # ---------------------------------------------------------------------------

@@ -148,7 +148,21 @@ class BackendLadder:
         self.breaker_threshold = breaker_threshold
         self._failures: dict[str, int] = {}
         self._broken: set[str] = set()
+        # (backend, outcome) attempt regimes this ladder has entered —
+        # the rung half of the fuzzer's checker-state coverage signal
+        # (doc/robustness.md "Schedule fuzzing")
+        self._cov_entries: set[tuple[str, str]] = set()
         self._lock = threading.Lock()
+
+    def coverage_probe(self) -> dict:
+        """Rung-regime coverage for the schedule fuzzer: every
+        (backend, outcome) pair any attempt has produced on this
+        ladder, as stable edge strings. A schedule that first drives a
+        rung into shrink-retry or watchdog-timeout is exploring checker
+        territory no prior corpus entry reached."""
+        with self._lock:
+            entries = sorted(self._cov_entries)
+        return {"edges": ["rung:%s:%s" % e for e in entries]}
 
     # -- breaker state ------------------------------------------------------
 
@@ -287,6 +301,8 @@ class BackendLadder:
         t0_us = 0
 
         def rung_span(outcome: str) -> None:
+            with self._lock:
+                self._cov_entries.add((backend.name, outcome))
             # one self-contained slice per attempt (ph X, not B/E: a
             # watchdog-abandoned zombie attempt may still be emitting
             # when the next rung starts — X slices can't tear a pairing)

@@ -101,6 +101,11 @@ class FrontierSession:
         self.cur_idx: dict[int, int] = {}  # slot -> history index of open op
         self.pending_mask = 0
         self.configs_max = 1
+        # near-miss margin for coverage_probe(): the SMALLEST surviving
+        # frontier seen after any return — 1 means a single legal
+        # linearization kept the history alive (it "almost failed");
+        # None until a return has been absorbed
+        self.configs_min: int | None = None
         self.events_absorbed = 0
         self.failure: LinearResult | None = None
 
@@ -122,6 +127,13 @@ class FrontierSession:
         # forensics are bit-identical to the pure path
         from jepsen_tpu.history_ir import ingest
         if ingest.frontier_absorb(self, stream, start, end):
+            # the C twin doesn't track the per-return minimum; fold in
+            # the post-chunk frontier so the near-miss margin stays
+            # meaningful (coarser granularity, same direction)
+            if self.failure is None and self.configs:
+                n = len(self.configs)
+                if self.configs_min is None or n < self.configs_min:
+                    self.configs_min = n
             return self.result()
         step = self.step
         configs = self.configs
@@ -129,6 +141,7 @@ class FrontierSession:
         cur_idx = self.cur_idx
         pending_mask = self.pending_mask
         configs_max = self.configs_max
+        configs_min = self.configs_min
         kinds, slots = stream.kind, stream.slot
         fcol, acol, bcol, idxcol = stream.f, stream.a, stream.b, \
             stream.op_index
@@ -167,6 +180,9 @@ class FrontierSession:
             configs = {(mask & ~bit, state)
                        for (mask, state) in all_seen if mask & bit}
             pending_mask &= ~bit
+            if configs and (configs_min is None
+                            or len(configs) < configs_min):
+                configs_min = len(configs)
             if not configs:
                 def op_indices(mask):
                     return [cur_idx[t] for t in cur_idx if mask & (1 << t)]
@@ -179,6 +195,7 @@ class FrontierSession:
 
                 # the fatal op WAS pending when these configs died — its
                 # bit was cleared from pending_mask just above; restore it
+                self.configs_min = configs_min
                 fatal_pending = pending_mask | bit
                 finals = [{"state": state_val(state),
                            "linearized": sorted(op_indices(mask)),
@@ -197,8 +214,26 @@ class FrontierSession:
         self.configs = configs
         self.pending_mask = pending_mask
         self.configs_max = configs_max
+        self.configs_min = configs_min
         self.events_absorbed = end
         return self.result()
+
+    def coverage_probe(self) -> dict:
+        """Checker-state coverage for the schedule fuzzer
+        (doc/robustness.md "Schedule fuzzing"): a tiny structural
+        summary of where this history drove the frontier —
+        ``edges`` are log2 cardinality buckets of the peak frontier
+        (new buckets mean the schedule exercised a concurrency regime
+        no corpus entry reached before), ``margin`` is the near-miss
+        metric (smallest surviving frontier; 1 = one legal
+        linearization away from a verdict flip; None = no returns
+        absorbed), and ``died`` latches an actual failure."""
+        edges = ["frontier:peak:b%d" % self.configs_max.bit_length()]
+        if self.configs_min is not None:
+            edges.append("frontier:min:b%d"
+                         % self.configs_min.bit_length())
+        return {"edges": edges, "margin": self.configs_min,
+                "died": self.failure is not None}
 
     def result(self) -> LinearResult:
         """The verdict over everything absorbed so far: valid-so-far, or
@@ -224,6 +259,8 @@ class FrontierSession:
                 "cur_idx": {str(k): int(v) for k, v in self.cur_idx.items()},
                 "pending_mask": int(self.pending_mask),
                 "configs_max": int(self.configs_max),
+                "configs_min": (None if self.configs_min is None
+                                else int(self.configs_min)),
                 "events_absorbed": int(self.events_absorbed),
             }
             if self.failure is not None:
@@ -253,6 +290,8 @@ class FrontierSession:
                           for k, v in (snap.get("cur_idx") or {}).items()}
             fs.pending_mask = int(snap["pending_mask"])
             fs.configs_max = int(snap.get("configs_max", 1))
+            cmin = snap.get("configs_min")
+            fs.configs_min = None if cmin is None else int(cmin)
             fs.events_absorbed = int(snap["events_absorbed"])
             fail = snap.get("failure")
             if fail is not None:

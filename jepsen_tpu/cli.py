@@ -321,6 +321,47 @@ def single_test_cmd(
                              help="with --once: give up after this "
                                   "many seconds (0 = wait forever)")
 
+        p_hunt = sub.add_parser(
+            "hunt", help="coverage-guided nemesis schedule fuzzer: "
+                         "thousands of short fake-mode trials verdicted "
+                         "through the live fleet path; anomalies ddmin-"
+                         "minimize into hunt/<id>/ artifacts "
+                         "(doc/robustness.md \"Schedule fuzzing\")")
+        p_hunt.add_argument("--store-dir", default="store",
+                            help="hunt workspace; artifacts land under "
+                                 "<store>/hunt/<id>/")
+        p_hunt.add_argument("--trials", dest="fuzz_trials", default=None,
+                            help="trial budget (default 400; env twin "
+                                 "JEPSEN_TPU_FUZZ_TRIALS)")
+        p_hunt.add_argument("--pool-workers", dest="fuzz_pool_workers",
+                            default=None,
+                            help="trial pool processes; 0/1 = inline "
+                                 "(env twin JEPSEN_TPU_FUZZ_POOL_WORKERS)")
+        p_hunt.add_argument("--trial-ops", dest="fuzz_trial_ops",
+                            default=None,
+                            help="client ops per trial (default 120; env "
+                                 "twin JEPSEN_TPU_FUZZ_TRIAL_OPS)")
+        p_hunt.add_argument("--seed", dest="fuzz_seed", default=None,
+                            help="hunt seed: fully determines the search "
+                                 "(env twin JEPSEN_TPU_FUZZ_SEED)")
+        p_hunt.add_argument("--blind", action="store_true",
+                            help="disable coverage guidance (the "
+                                 "random-baseline bench.py compares "
+                                 "against)")
+        p_hunt.add_argument("--no-stop-on-first", action="store_true",
+                            help="spend the whole trial budget even "
+                                 "after an anomaly lands")
+        p_hunt.add_argument("--demo-bug", action="store_true",
+                            help="plant the canned interleaving-gated "
+                                 "anomaly into every trial's target")
+        p_hunt.add_argument("--accelerator", default="cpu",
+                            choices=["auto", "cpu", "tpu"])
+        p_hunt.add_argument("--replay", metavar="ID", default=None,
+                            help="re-run a landed hunt/<ID> artifact and "
+                                 "verify the bit-identical reproduction")
+        p_hunt.add_argument("--list", action="store_true",
+                            help="list landed anomalies and exit")
+
         p_pre = sub.add_parser(
             "preflight", help="validate the test map without running it "
                               "(doc/static-analysis.md)")
@@ -398,6 +439,8 @@ def single_test_cmd(
                 return ship_cmd(opts)
             if opts.command == "fleet":
                 return fleet_cmd(opts)
+            if opts.command == "hunt":
+                return hunt_cmd(opts)
             return EXIT_BAD_ARGS
         except KeyboardInterrupt:
             return EXIT_CRASH
@@ -528,6 +571,53 @@ def fleet_cmd(opts) -> int:
         return EXIT_INVALID if runs.get("invalid", 0) else EXIT_OK
     fleet_scheduler.serve(opts.store_dir, **kw)
     return EXIT_OK
+
+
+def hunt_cmd(opts) -> int:
+    """``jepsen-tpu hunt``: the coverage-guided schedule fuzzer
+    (doc/robustness.md "Schedule fuzzing"). Exit codes mirror ``test``:
+    a landed anomaly is EXIT_INVALID; ``--replay`` exits EXIT_OK only
+    on a bit-identical reproduction."""
+    import json as _json
+
+    from jepsen_tpu.fuzz import hunt as hunt_mod
+
+    if getattr(opts, "list", False):
+        rows = hunt_mod.list_hunts(opts.store_dir)
+        for r in rows:
+            print(f"{r['id']}: seed={r['seed']} n_ops={r['n_ops']} "
+                  f"windows={r['windows']}")
+        if not rows:
+            print("no landed anomalies")
+        return EXIT_OK
+    if opts.replay:
+        try:
+            out = hunt_mod.replay(opts.store_dir, opts.replay)
+        except (OSError, ValueError) as e:
+            print(f"replay failed to load hunt/{opts.replay}: {e}",
+                  file=sys.stderr)
+            return EXIT_BAD_ARGS
+        print(_json.dumps(out, indent=2))
+        return (EXIT_OK if out["identical"] and out["reproduced"]
+                else EXIT_INVALID)
+    hunter = hunt_mod.Hunter(
+        opts.store_dir,
+        trials=opts.fuzz_trials,
+        pool_workers=opts.fuzz_pool_workers,
+        trial_ops=opts.fuzz_trial_ops,
+        seed=opts.fuzz_seed,
+        guided=not getattr(opts, "blind", False),
+        bug_spec=(hunt_mod.DEMO_BUG_SPEC
+                  if getattr(opts, "demo_bug", False) else None),
+        accelerator=opts.accelerator,
+        stop_on_first=not getattr(opts, "no_stop_on_first", False))
+    summary = hunter.run()
+    print(_json.dumps(summary, indent=2))
+    for hid in summary.get("hunt_ids", ()):
+        print(f"reproduce with: jepsen-tpu hunt --store-dir "
+              f"{opts.store_dir} --replay {hid}"
+              + (" (--demo-bug artifact)" if hunter.bug_spec else ""))
+    return EXIT_INVALID if summary["anomalies"] else EXIT_OK
 
 
 def analyze_cmd(opts, test_fn) -> int:

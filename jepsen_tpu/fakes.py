@@ -737,13 +737,27 @@ class FakeClusterState:  # durability: fsync
     and a stand-in for a real cluster's convergence delay. ``op()``
     alternately shrinks down to ``min_members`` and grows back, one
     node at a time, never with another op in flight.
+
+    ``clock_rate`` is the libfaketime rate factor (faketime.py): the
+    fake cluster's convergence clock runs ``clock_rate``× wall speed,
+    so a clock-rate nemesis window composed with a membership reconfig
+    settles deterministically in *cluster* time — ``settle_s`` used to
+    be measured in raw wall seconds, which silently decoupled the two
+    nemeses in fake mode (a 2× clock made the settle window look twice
+    as long to the cluster). ``set_clock_rate`` flips it mid-run the
+    way a clock-rate window begins/ends. ``time_fn`` injects the wall
+    clock itself (tests, deterministic fuzz trials).
     """
 
     def __init__(self, path, nodes=None, settle_s: float = 0.0,
-                 min_members: int = 1):
+                 min_members: int = 1, clock_rate: float = 1.0,
+                 time_fn=None):
         self.path = Path(path)
         self.settle_s = settle_s
         self.min_members = min_members
+        self.clock_rate = float(clock_rate) if clock_rate and \
+            clock_rate > 0 else 1.0
+        self._time_fn = time_fn if time_fn is not None else _time.time
         self._lock = threading.Lock()
         self._inflight = 0
         if self.path.exists():
@@ -801,16 +815,45 @@ class FakeClusterState:  # durability: fsync
                 return ["unknown-f", f]
             self._persist()
             self._inflight += 1
-        return {"action": f, "node": node, "at": _time.time()}
+        return {"action": f, "node": node, "at": self._time_fn()}
 
     def resolve(self, test):
         return self
+
+    def set_clock_rate(self, factor) -> None:
+        """Applies a libfaketime-style rate factor to the convergence
+        clock (a clock-rate fault window opening/closing). Garbage or
+        non-positive factors read as 1.0 — the nemesis must never wedge
+        the cluster it is faulting."""
+        try:
+            f = float(factor)
+        except (TypeError, ValueError):
+            f = 1.0
+        self.clock_rate = f if f > 0 else 1.0
+
+    def mutate_knobs(self, rng) -> dict:
+        """Seeded knob mutation for schedule fuzzing (doc/robustness.md
+        "Schedule fuzzing"): jiggles the settle window and the member-
+        count floor with the caller's rng and returns the new knob dict
+        — the same rng state always produces the same knobs, so a
+        fuzzed schedule's seed tuple fully determines the cluster."""
+        self.settle_s = round(rng.choice(
+            (0.0, 0.01, 0.05, 0.1, 0.25)) * rng.choice((1, 1, 2)), 4)
+        upper = max(1, len(self._all) - 1) if self._all else 1
+        self.min_members = rng.randint(1, upper)
+        return {"settle_s": self.settle_s,
+                "min_members": self.min_members}
 
     def resolve_op(self, test, pending_pair):
         _op, value = pending_pair
         if not isinstance(value, dict):
             return self  # errored invoke: nothing will ever converge it
-        if _time.time() - value.get("at", 0.0) < self.settle_s:
+        # the settle window is measured on the CLUSTER's clock: wall
+        # elapsed × the active faketime rate factor (a 2× clock
+        # converges in half the wall time, exactly as a real node
+        # LD_PRELOADed with "+0 x2" would)
+        elapsed = self._time_fn() - value.get("at", 0.0)
+        if elapsed * self.clock_rate < self.settle_s:
             return None  # still settling (the SIGKILL window)
         with self._lock:
             self._inflight = max(0, self._inflight - 1)

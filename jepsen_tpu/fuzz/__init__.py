@@ -1,0 +1,25 @@
+"""Coverage-guided nemesis schedule fuzzing (doc/robustness.md
+"Schedule fuzzing").
+
+The checker fleet turned active bug hunter (ROADMAP item 5):
+thousands of short deterministic fake-mode runs, each one a seeded
+:mod:`jepsen_tpu.generator.simulate` trial under a mutated nemesis
+schedule, verdicted in batch through the live daemon's ingest path.
+Mutation is steered by a coverage map instead of blind randomness —
+novel fault×op interleaving signatures, new checker-state regimes
+(frontier cardinality buckets, ladder rung outcomes via
+``coverage_probe()``), and shrinking frontier margins as a near-miss
+signal. Failing schedules auto-minimize through the PR-8 ddmin and
+land as replayable ``hunt/<id>/`` artifacts.
+
+Modules:
+
+* :mod:`~jepsen_tpu.fuzz.schedule` — the seed tuple: a JSON-stable
+  nemesis schedule (generator seed, op budget, fault windows, knobs).
+* :mod:`~jepsen_tpu.fuzz.corpus` — AFL-style corpus + seeded mutators.
+* :mod:`~jepsen_tpu.fuzz.coverage` — the edge map and signal
+  extraction.
+* :mod:`~jepsen_tpu.fuzz.trial` — one schedule → one WAL-backed run.
+* :mod:`~jepsen_tpu.fuzz.hunt` — the hunter loop, artifacts, replay.
+"""
+from jepsen_tpu.fuzz.schedule import Schedule  # noqa: F401

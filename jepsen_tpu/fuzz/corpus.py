@@ -1,0 +1,111 @@
+"""AFL-style schedule corpus: retention + seeded mutation.
+
+Everything draws from the caller's ``random.Random`` — the hunt's
+``fuzz_seed`` fully determines which parents are picked and how they
+mutate, so a whole hunt replays bit-identically (the determinism the
+quick-lane tests pin).
+"""
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu.fuzz.schedule import FAULT_KINDS, Schedule
+
+MAX_WINDOWS = 6
+
+
+def random_schedule(rng: random.Random, n_ops: int = 120,
+                    max_windows: int = 3) -> Schedule:
+    """A uniformly random point in schedule space — the blind-random
+    baseline's generator AND the mutation space's reference: mutation
+    can reach anything this can emit."""
+    faults = [_random_window(rng)
+              for _ in range(rng.randint(0, max_windows))]
+    return Schedule(seed=rng.getrandbits(32), n_ops=n_ops,
+                    concurrency=rng.randint(2, 4), faults=faults,
+                    knobs=_random_knobs(rng))
+
+
+def _random_window(rng: random.Random) -> dict:
+    return {"kind": rng.choice(FAULT_KINDS),
+            "start": round(rng.random() * 0.9, 4),
+            "dur": round(0.05 + rng.random() * 0.4, 4)}
+
+
+def _random_knobs(rng: random.Random) -> dict:
+    return {"settle_s": rng.choice((0.0, 0.01, 0.05)),
+            "min_members": rng.randint(1, 4),
+            "clock_rate": rng.choice((0.5, 2.0, 5.0))}
+
+
+def mutate(schedule: Schedule, rng: random.Random,
+           splice_from: Schedule | None = None) -> Schedule:
+    """One seeded mutation step. Operators (picked by the rng):
+    timing jiggle, window add/remove/kind-swap, knob mutation, seed
+    reroll, op-budget nudge — plus AFL-style splice when a second
+    parent is offered (the union of two parents' windows is how the
+    hunt composes partial interleavings into overlapping ones)."""
+    s = schedule.copy()
+    if splice_from is not None and splice_from.faults \
+            and rng.random() < 0.5:
+        take = rng.randint(1, len(splice_from.faults))
+        pool = list(splice_from.faults)
+        rng.shuffle(pool)
+        s.faults = (s.faults + pool[:take])[:MAX_WINDOWS]
+        return s
+    op = rng.randrange(6)
+    if op == 0 and s.faults:  # jiggle one window's timing
+        w = rng.choice(s.faults)
+        w["start"] = round(min(0.95, max(
+            0.0, float(w["start"]) + rng.uniform(-0.15, 0.15))), 4)
+        w["dur"] = round(min(0.6, max(
+            0.02, float(w["dur"]) + rng.uniform(-0.1, 0.1))), 4)
+    elif op == 1 and len(s.faults) < MAX_WINDOWS:  # add a window
+        s.faults.append(_random_window(rng))
+    elif op == 2 and s.faults:  # drop a window
+        s.faults.pop(rng.randrange(len(s.faults)))
+    elif op == 3 and s.faults:  # swap a window's kind
+        rng.choice(s.faults)["kind"] = rng.choice(FAULT_KINDS)
+    elif op == 4:  # knob mutation
+        s.knobs.update(_random_knobs(rng))
+    else:  # reroll the generator seed / nudge the op budget
+        s.seed = rng.getrandbits(32)
+        if rng.random() < 0.3:
+            s.n_ops = max(40, min(400, s.n_ops + rng.choice(
+                (-40, -20, 20, 40))))
+    if not s.faults:
+        s.faults.append(_random_window(rng))
+    return s
+
+
+class Corpus:
+    """Retained schedules with pick weighting toward recent additions
+    (new coverage lives at the frontier of the search, so the newest
+    entries are the most promising parents — the classic AFL queue
+    bias, deterministic here because the pick rng is the hunt's)."""
+
+    def __init__(self, base: Schedule | None = None):
+        self.entries: list[dict] = []
+        self.seen: set[str] = set()
+        if base is not None:
+            self.add(base, reason="seed")
+
+    def add(self, schedule: Schedule, reason: str = "new-edge") -> bool:
+        key = schedule.key()
+        if key in self.seen:
+            return False
+        self.seen.add(key)
+        self.entries.append({"schedule": schedule, "key": key,
+                             "reason": reason})
+        return True
+
+    def pick(self, rng: random.Random) -> Schedule:
+        if not self.entries:
+            return random_schedule(rng)
+        n = len(self.entries)
+        # triangular bias toward the tail (newest)
+        i = max(rng.randint(0, n - 1), rng.randint(0, n - 1))
+        return self.entries[i]["schedule"]
+
+    def __len__(self) -> int:
+        return len(self.entries)

@@ -1,0 +1,94 @@
+"""Coverage signals: what makes one schedule worth keeping.
+
+Three signal families feed one edge set (doc/robustness.md "Schedule
+fuzzing"):
+
+* **fault×op interleavings** — from the trial history itself: each
+  client completion is keyed by the fault-kind set active at that
+  instant (derived from the nemesis ``:info`` ops the trial wove in,
+  classified exactly as the PR-15 trace/fault-window machinery does),
+  its ``:f``, and its completion type. ``op:net+clock-rate:write:ok``
+  first appearing means some schedule drove a determinate write
+  through an overlapping partition+clock-skew — territory blind
+  randomness rarely composes.
+* **checker-state transitions** — ``coverage_probe()`` edges from the
+  live session (frontier cardinality buckets, ladder rung regimes).
+* **near-miss margins** — not edges: the frontier's smallest surviving
+  configuration count. A shrinking margin means the schedule walked to
+  the cliff's edge; the corpus promotes it even with zero new edges.
+"""
+from __future__ import annotations
+
+from jepsen_tpu.nemesis.faults import classify
+
+# membership windows have no end op (healed by resolution); for the
+# interleaving signature treat a begin as active for this many client
+# invocations — the convergence horizon, not a real heal
+MEMBERSHIP_HORIZON_OPS = 12
+
+
+def history_edges(history: list[dict]) -> list[str]:
+    """Fault×op interleaving signatures of one trial history."""
+    edges: set[str] = set()
+    active: dict[str, int] = {}
+    member_left = 0
+    for op in history or ():
+        f = op.get("f")
+        if op.get("process") == "nemesis":
+            if op.get("type") != "info":
+                continue
+            phase, kind = classify(f)
+            if kind is None:
+                continue
+            if kind == "membership":
+                member_left = MEMBERSHIP_HORIZON_OPS
+            elif phase == "begin":
+                active[kind] = active.get(kind, 0) + 1
+            elif phase == "end" and active.get(kind):
+                active[kind] -= 1
+                if not active[kind]:
+                    del active[kind]
+            continue
+        typ = op.get("type")
+        if typ == "invoke":
+            if member_left:
+                member_left -= 1
+            continue
+        kinds = sorted(k for k, n in active.items() if n)
+        if member_left:
+            kinds = sorted(kinds + ["membership"])
+        mask = "+".join(kinds) or "none"
+        edges.add(f"op:{mask}:{f}:{typ}")
+    return sorted(edges)
+
+
+class CoverageMap:
+    """The global edge set plus the best (smallest) near-miss margin.
+    ``observe`` returns how many edges were NEW — the guidance signal
+    the corpus promotes on."""
+
+    def __init__(self):
+        self.edges: set[str] = set()
+        self.best_margin: int | None = None
+
+    def observe(self, edges) -> int:
+        new = 0
+        for e in edges or ():
+            if e not in self.edges:
+                self.edges.add(e)
+                new += 1
+        return new
+
+    def observe_margin(self, margin) -> bool:
+        """True when ``margin`` beats (shrinks below) the best seen —
+        the near-miss promotion trigger."""
+        if margin is None:
+            return False
+        m = int(margin)
+        if self.best_margin is None or m < self.best_margin:
+            self.best_margin = m
+            return True
+        return False
+
+    def __len__(self) -> int:
+        return len(self.edges)

@@ -1,0 +1,89 @@
+"""The fuzzer's unit of search: a deterministic nemesis schedule.
+
+A :class:`Schedule` is the complete seed tuple for one fake-mode trial
+— generator seed, client-op budget, concurrency, fault windows, and
+fake-cluster knobs. Trials are pure functions of it (the simulator's
+wall cap rides a virtual clock, the fault model draws from the
+schedule's own rng), so a stored schedule IS the reproduction:
+``jepsen-tpu hunt --replay <id>`` re-runs it bit-identically.
+
+Windows live in *op-index fraction* space (``start``/``dur`` in
+[0, 1) of the trial's op budget), not wall time — mutation then
+composes with op-budget mutation without re-anchoring, and the same
+schedule scales to a longer trial for minimization experiments.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+# window kinds the fake-mode fault model implements, with their
+# begin/end nemesis op names (classified by nemesis/faults.classify —
+# the trace/fault-window machinery must see these as real windows).
+# membership is a one-shot reconfiguration: a begin op, no end op
+# (healed by resolution), exactly the real MembershipNemesis contract.
+WINDOW_OPS = {
+    "net": ("start-partition", "stop-partition"),
+    "clock-rate": ("start-clock-rate", "stop-clock-rate"),
+    "pause": ("pause", "resume"),
+    "membership": ("grow", None),
+}
+FAULT_KINDS = tuple(WINDOW_OPS)
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One point in schedule space. ``faults`` is a list of
+    ``{"kind", "start", "dur"}`` dicts; ``knobs`` feeds
+    ``FakeClusterState`` (settle window, member floor)."""
+
+    seed: int = 0
+    n_ops: int = 120
+    concurrency: int = 3
+    faults: list = dataclasses.field(default_factory=list)
+    knobs: dict = dataclasses.field(default_factory=dict)
+
+    def canonical(self) -> dict:
+        return {
+            "seed": int(self.seed),
+            "n_ops": int(self.n_ops),
+            "concurrency": int(self.concurrency),
+            "faults": [{"kind": str(w["kind"]),
+                        "start": round(float(w["start"]), 6),
+                        "dur": round(float(w["dur"]), 6)}
+                       for w in self.faults],
+            "knobs": {str(k): self.knobs[k] for k in sorted(self.knobs)},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.canonical(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Schedule":
+        d = json.loads(text)
+        return cls(seed=int(d.get("seed", 0)),
+                   n_ops=int(d.get("n_ops", 120)),
+                   concurrency=int(d.get("concurrency", 3)),
+                   faults=list(d.get("faults") or []),
+                   knobs=dict(d.get("knobs") or {}))
+
+    def key(self) -> str:
+        """Stable content id — the hunt artifact directory name and the
+        corpus dedup key."""
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:12]
+
+    def copy(self) -> "Schedule":
+        return Schedule.from_json(self.to_json())
+
+    def windows_ops(self) -> list[tuple[int, int, str]]:
+        """Windows resolved to op-index space: ``(start_idx, end_idx,
+        kind)``, end exclusive, each window at least one op wide."""
+        out = []
+        for w in self.faults:
+            start = max(0, min(self.n_ops - 1,
+                               int(float(w["start"]) * self.n_ops)))
+            width = max(1, int(float(w["dur"]) * self.n_ops))
+            out.append((start, min(self.n_ops, start + width),
+                        str(w["kind"])))
+        return out

@@ -1,0 +1,292 @@
+"""One schedule → one deterministic fake-mode run.
+
+A trial is a PURE function of its :class:`~jepsen_tpu.fuzz.schedule.
+Schedule`: the client ops come from a seeded generator through
+:func:`jepsen_tpu.generator.simulate.simulate` (wall cap on a
+:class:`~jepsen_tpu.generator.simulate.StepClock`, so load can't skew
+truncation), the fault model draws every coin from the schedule's own
+rng, and the register semantics under each fault window are fixed.
+Same schedule ⇒ byte-identical history — the replay contract
+(doc/robustness.md "Schedule fuzzing") rests on this.
+
+Fault semantics on the fake register target:
+
+* ``net`` (partition) — ops invoked inside the window complete as
+  ``:info`` (indeterminate); whether the effect applied is a seeded
+  coin. Exactly the pressure that grows the checker frontier.
+* ``clock-rate`` — completion latency scales by the faketime rate
+  factor (fast clock, short window); composes with membership via
+  ``FakeClusterState.set_clock_rate``.
+* ``pause`` — SIGSTOP-ish: completion latency stretches 5×, so ops
+  overlap that otherwise wouldn't.
+* ``membership`` — a one-shot grow/shrink through a real
+  :class:`~jepsen_tpu.fakes.FakeClusterState` (durable members file,
+  settle window on the cluster clock).
+
+``PlantedBug`` is the seam tests use to hide an anomaly behind a
+specific fault×op interleaving: a staged state machine that arms on
+(fault-mask, f) matches and, fully armed, tears one write — acked
+``ok`` but leaving the register corrupted — so the next read returns
+a value nobody ever wrote, which no linearization explains.
+"""
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+
+from jepsen_tpu import generator as gen_mod
+from jepsen_tpu.fuzz.schedule import WINDOW_OPS, Schedule
+from jepsen_tpu.generator.simulate import StepClock, simulate
+from jepsen_tpu.journal import WAL_NAME, Journal
+from jepsen_tpu.utils import ms_to_nanos
+
+N_VALUES = 5
+
+# cap on indeterminate (:info) completions per trial: each one leaves
+# a forever-open slot in the checker frontier, and an 80-op partition
+# window of pure timeouts is a 2^31-config search (a real partitioned
+# client times out a few in-flight ops, then fails fast on connection
+# refused — determinate :fail, which the encoder drops entirely)
+MAX_CRASHES = 6
+
+
+class PlantedBug:
+    """Interleaving-gated torn-write fault: ``stages`` is a list of
+    ``(kinds, f)`` pairs; a completed op whose active fault-kind set
+    covers ``kinds`` and whose ``:f`` matches arms the next stage. The
+    FINAL stage's matching op is acknowledged ``ok`` with
+    its effect torn (the register left corrupted) — then re-arms from
+    zero. Serializable via ``spec`` for replay."""
+
+    def __init__(self, stages):
+        self.stages = [(frozenset(kinds), str(f)) for kinds, f in stages]
+        self.armed = 0
+
+    @classmethod
+    def from_spec(cls, spec) -> "PlantedBug | None":
+        if not spec:
+            return None
+        return cls([(tuple(kinds), f) for kinds, f in spec])
+
+    def spec(self) -> list:
+        return [[sorted(kinds), f] for kinds, f in self.stages]
+
+    def on_op(self, f: str, active: frozenset) -> bool:
+        """True when this op's effect must be dropped (acked ok)."""
+        if not self.stages:
+            return False
+        kinds, want_f = self.stages[self.armed]
+        if f == want_f and kinds <= active:
+            self.armed += 1
+            if self.armed == len(self.stages):
+                self.armed = 0
+                return True
+        return False
+
+
+def run_trial(schedule: Schedule, bug: PlantedBug | None = None
+              ) -> list[dict]:
+    """The schedule's history: client invokes/completions from the
+    simulator interleaved with the nemesis ``:info`` ops that delimit
+    its fault windows (so :func:`jepsen_tpu.nemesis.faults.
+    history_windows`-style consumers see real windows)."""
+    rng = random.Random(schedule.seed)
+    op_rng = random.Random(rng.getrandbits(64))
+    fault_rng = random.Random(rng.getrandbits(64))
+
+    wins = schedule.windows_ops()
+
+    def active_at(i: int) -> frozenset:
+        return frozenset(kind for (s, e, kind) in wins if s <= i < e)
+
+    # membership rides a real FakeClusterState on a virtual clock —
+    # deterministic, durable, honoring the satellite-2 settle contract
+    cluster = None
+    member_wins = [w for w in wins if w[2] == "membership"]
+    if member_wins:
+        import tempfile
+
+        from jepsen_tpu.fakes import FakeClusterState
+        vclock = {"t": 0.0}
+        tmp = tempfile.mkdtemp(prefix="jepsen-fuzz-members-")
+        cluster = FakeClusterState(
+            Path(tmp) / "members.json",
+            nodes=[f"n{i}" for i in range(1, 6)],
+            settle_s=float(schedule.knobs.get("settle_s", 0.0)),
+            min_members=int(schedule.knobs.get("min_members", 1)),
+            time_fn=lambda: vclock["t"])
+
+    def mk_gen():
+        def f():
+            roll = op_rng.random()
+            if roll < 0.4:
+                return {"f": "read", "value": None}
+            if roll < 0.8:
+                return {"f": "write",
+                        "value": op_rng.randrange(N_VALUES)}
+            return {"f": "cas", "value": [op_rng.randrange(N_VALUES),
+                                          op_rng.randrange(N_VALUES)]}
+        # clients(): the sim context carries a nemesis thread, and an
+        # op dispatched there would mutate the register invisibly (the
+        # encoder drops non-int processes) — instant false anomalies
+        return gen_mod.clients(gen_mod.limit(schedule.n_ops,
+                                             gen_mod.Fn(f)))
+
+    # cur starts None — the checker's CASRegister model begins
+    # undefined, so a pre-first-write read must return None (a 0 here
+    # would be an unlinearizable phantom and every trial would "fail").
+    # "torn" latches a torn write's corrupt replica value until the
+    # first determinate read observes it (reads inside a partition
+    # crash, so the exposure may come long after the tear).
+    state = {"cur": None, "i": 0, "member_flip": 0, "crashes": 0,
+             "torn": None}
+
+    def complete(ctx, op):
+        i = state["i"]
+        state["i"] = i + 1
+        active = active_at(i)
+        if cluster is not None:
+            vclock["t"] = i * 0.01
+            cluster.set_clock_rate(
+                float(schedule.knobs.get("clock_rate", 2.0))
+                if "clock-rate" in active else 1.0)
+            for (s, _e, kind) in wins:
+                if kind == "membership" and s == i:
+                    mop = cluster.op({})
+                    if isinstance(mop, dict):
+                        val = cluster.invoke({}, mop)
+                        state["_pending_member"] = (mop, val)
+            pend = state.pop("_pending_member", None)
+            if pend is not None and cluster.resolve_op({}, pend) is None:
+                state["_pending_member"] = pend
+        f, value = op["f"], op["value"]
+        latency_ms = 5.0 + fault_rng.random() * 10.0
+        if "clock-rate" in active:
+            latency_ms *= 1.0 / float(
+                schedule.knobs.get("clock_rate", 2.0))
+        if "pause" in active:
+            latency_ms *= 5.0
+        torn = bug.on_op(f, active) if bug is not None else False
+        comp = dict(op)
+        comp["time"] = op["time"] + ms_to_nanos(latency_ms)
+        if "net" in active and not torn:
+            crash = (state["crashes"] < MAX_CRASHES
+                     and fault_rng.random() < 0.5)
+            if crash:
+                # indeterminate: the partitioned client never hears
+                # back; a seeded coin decides whether the effect landed
+                state["crashes"] += 1
+                applied = fault_rng.random() < 0.5
+                if applied and f == "write":
+                    state["cur"] = value
+                elif applied and f == "cas" \
+                        and state["cur"] == value[0]:
+                    state["cur"] = value[1]
+                comp["type"] = "info"
+            else:
+                # connection refused: determinate failure, no effect
+                comp["type"] = "fail"
+            return comp
+        if f == "read":
+            comp["type"] = "ok"
+            if state["torn"] is not None:
+                # the read lands on the torn replica: a value nobody
+                # ever wrote, which no linearization can explain
+                comp["value"] = state["torn"]
+                state["torn"] = None
+            else:
+                comp["value"] = state["cur"]
+            return comp
+        if f == "write":
+            state["cur"] = value
+            if torn:
+                # torn write: acked ok, applied — but one replica is
+                # left holding out-of-domain corrupt bytes
+                state["torn"] = N_VALUES + i
+            comp["type"] = "ok"
+            return comp
+        # cas
+        if state["cur"] == value[0] and not torn:
+            state["cur"] = value[1]
+            comp["type"] = "ok"
+        else:
+            comp["type"] = "ok" if torn else "fail"
+        return comp
+
+    history = simulate({"concurrency": schedule.concurrency}, mk_gen(),
+                       complete, seed=schedule.seed,
+                       limit=schedule.n_ops * 8,
+                       max_wall_s=float(schedule.n_ops) * 8,
+                       clock=StepClock(step_s=1.0), _lane=None)
+    return _inject_nemesis(history, wins)
+
+
+def _inject_nemesis(history: list[dict], wins) -> list[dict]:
+    """Weaves begin/end nemesis ``:info`` ops into the history at the
+    window boundaries (op-index space → just before the matching
+    client invoke), so the fault×op interleaving is first-class
+    history the coverage extractor and the trace plane both read."""
+    starts: dict[int, list[str]] = {}
+    ends: dict[int, list[str]] = {}
+    member_seq = {"n": 0}
+    for (s, e, kind) in wins:
+        starts.setdefault(s, []).append(kind)
+        if WINDOW_OPS[kind][1] is not None:
+            ends.setdefault(e, []).append(kind)
+
+    def nem_ops(i: int, t) -> list[dict]:
+        out = []
+        for kind in ends.get(i, ()):
+            out.append({"type": "info", "process": "nemesis",
+                        "f": WINDOW_OPS[kind][1], "value": None,
+                        "time": t})
+        for kind in starts.get(i, ()):
+            f = WINDOW_OPS[kind][0]
+            if kind == "membership":
+                f = "grow" if member_seq["n"] % 2 else "shrink"
+                member_seq["n"] += 1
+            out.append({"type": "info", "process": "nemesis",
+                        "f": f, "value": None, "time": t})
+        return out
+
+    out: list[dict] = []
+    inv = 0
+    for op in history:
+        if op.get("type") == "invoke":
+            out.extend(nem_ops(inv, op.get("time", 0)))
+            inv += 1
+        out.append(op)
+    tail_t = (history[-1].get("time", 0) if history else 0)
+    for i in sorted(set(list(starts) + list(ends))):
+        if i >= inv:
+            out.extend(nem_ops(i, tail_t))
+    return out
+
+
+def write_run(history: list[dict], run_dir) -> Path:
+    """Persists one trial as a discoverable run dir: the WAL first
+    (the daemon's admission ticket), then the authoritative
+    ``history.jsonl`` that lets it finalize on the next poll."""
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    j = Journal(run_dir / WAL_NAME, fsync_interval_s=-1)
+    j.append_many(history)
+    j.close()
+    with open(run_dir / "history.jsonl", "w", encoding="utf-8") as f:
+        for op in history:
+            f.write(json.dumps(op) + "\n")
+    return run_dir
+
+
+# owner: worker — process-pool entry (each pool worker runs trials
+# sequentially from its own argument tuple; no shared state)
+def pool_run_trial(args) -> tuple[int, list[dict]]:
+    """Top-level (picklable) pool entry: ``(idx, schedule_json,
+    run_dir, bug_spec)`` → ``(idx, history)``, with the run dir
+    written as a side effect."""
+    idx, schedule_json, run_dir, bug_spec = args
+    schedule = Schedule.from_json(schedule_json)
+    history = run_trial(schedule, bug=PlantedBug.from_spec(bug_spec))
+    write_run(history, run_dir)
+    return idx, history

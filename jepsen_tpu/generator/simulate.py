@@ -48,6 +48,24 @@ def default_context(test: dict | None = None, seed: int = 0) -> Context:
     return context(test or DEFAULT_TEST, rng=random.Random(seed))
 
 
+class StepClock:
+    """A virtual wall clock derived from its OWN call count: each read
+    advances ``step_s`` seconds. Injected into :func:`simulate` as
+    ``clock``, it makes ``max_wall_s`` a pure step-count cap — the same
+    seed truncates at the same op under any machine load, which is the
+    reproducibility contract schedule fuzzing is built on
+    (doc/robustness.md "Schedule fuzzing")."""
+
+    def __init__(self, step_s: float = 1e-6):
+        self.step_s = step_s
+        self.reads = 0
+
+    def __call__(self) -> float:
+        t = self.reads * self.step_s
+        self.reads += 1
+        return t
+
+
 def simulate(
     test: dict,
     gen,
@@ -58,6 +76,7 @@ def simulate(
     seed: int = 0,
     max_wall_s: float | None = None,
     stats: dict | None = None,
+    clock: Callable[[], float] | None = None,
     _lane=_AUTO,
 ) -> list[dict]:
     """Simulates gen against model workers.
@@ -81,6 +100,14 @@ def simulate(
     stuck at :pending with nothing in flight is a deadlock and breaks
     immediately rather than spinning.
 
+    ``clock`` makes the wall-cap clock injectable (default
+    ``time.monotonic``). The real clock means the same seed can
+    truncate at DIFFERENT ops under different machine load — fine for
+    preflight's never-hang cap, fatal for seed ⇒ schedule
+    reproducibility. Callers that need exact replay pass a virtual
+    clock (:class:`StepClock`), making the cap a deterministic
+    function of scheduler steps alone.
+
     Pass a dict as ``stats`` to learn HOW the simulation ended:
     ``steps`` taken, and ``step_limited`` / ``wall_limited`` flags —
     callers that must distinguish "generator exhausted" from "cap hit"
@@ -102,7 +129,9 @@ def simulate(
         stats = {}
     stats.update(steps=0, step_limited=False, wall_limited=False)
 
-    deadline = (_time.monotonic() + max_wall_s
+    if clock is None:
+        clock = _time.monotonic
+    deadline = (clock() + max_wall_s
                 if max_wall_s is not None else None)
     steps = 0
     inject = _NO_INJECT
@@ -147,7 +176,7 @@ def simulate(
                     stats["step_limited"] = True
                     break
                 steps += 1
-                if deadline is not None and _time.monotonic() >= deadline:
+                if deadline is not None and clock() >= deadline:
                     stats["wall_limited"] = True
                     break
                 comp = pending[0][2] if pending else None

@@ -422,7 +422,7 @@ class LiveDaemon:
                  check_budget_s=DEFAULT_CHECK_BUDGET_S,
                  accelerator: str = "auto",
                  registry: telemetry.Registry | None = None,
-                 cost_model=None):
+                 cost_model=None, on_final=None):
         self.store_root = Path(store_root) if store_root else None
         self.run_dirs = [Path(d) for d in run_dirs]
         self.poll_s = coerce_knob("live_poll_s", poll_s,
@@ -442,6 +442,13 @@ class LiveDaemon:
             from jepsen_tpu.parallel.pipeline import CostModel
             cost_model = CostModel()
         self.cost_model = cost_model
+        # on_final(tracker, results): observed right after a run's
+        # finalize, while the tracker (and its session) still exists —
+        # final trackers are popped at the end of the poll, so this is
+        # the only seam where a batch consumer (the schedule fuzzer's
+        # coverage collection) can read per-run session state. A
+        # raising hook is logged, never fatal to the poll.
+        self.on_final = on_final
         self.trackers: dict[str, RunTracker] = {}
         self.polls = 0
         self.run_series_topk = int(coerce_knob(
@@ -631,6 +638,12 @@ class LiveDaemon:
                                           "ops": pending})
                 self._observe_check(tr, pending,
                                     time.perf_counter() - t_chk)
+                if self.on_final is not None:
+                    try:
+                        self.on_final(tr, results)
+                    except Exception:  # noqa: BLE001 — a hook never kills a poll
+                        logger.exception("on_final hook failed for %s",
+                                         tr.label)
                 # the run is over: the restart snapshot has nothing
                 # left to resume (live-status.json holds the final)
                 tr.clear_snapshot()

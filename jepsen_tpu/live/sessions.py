@@ -208,6 +208,19 @@ class LinearLiveSession:
 
     # -- verdicts -------------------------------------------------------
 
+    def coverage_probe(self) -> dict:
+        """Checker-state coverage for the schedule fuzzer
+        (doc/robustness.md "Schedule fuzzing"): the frontier's
+        cardinality buckets + near-miss margin merged with the ladder's
+        rung-regime entries. Sessions are per-run, so the probe is a
+        per-trial signal without any reset bookkeeping."""
+        probe = self.frontier.coverage_probe()
+        edges = list(probe.get("edges") or [])
+        if self._ladder is not None:
+            edges.extend(self._ladder.coverage_probe().get("edges") or ())
+        return {"edges": edges, "margin": probe.get("margin"),
+                "died": bool(probe.get("died"))}
+
     def verdict(self) -> dict:
         """Advances the checkable prefix and returns the live verdict:
         ``{valid_so_far, first_anomaly_op, backend, checked_ops}``."""

@@ -222,6 +222,33 @@ def _live_home_section(tests: dict) -> str:
             + "".join(rows) + "</table>")
 
 
+def _hunt_home_section(base: Path) -> str:
+    """The home page "hunt" section: anomalies the schedule fuzzer
+    landed under ``<store>/hunt/`` (doc/robustness.md "Schedule
+    fuzzing"), each linking into its artifact bundle. Empty string
+    when no hunt has landed anything."""
+    from jepsen_tpu.fuzz.hunt import list_hunts
+    rows = []
+    for h in list_hunts(base):
+        hid = str(h.get("id"))
+        rows.append(
+            "<tr class='valid-false'>"
+            f"<td><a href='/hunt/{hid}/'>{html.escape(hid)}</a></td>"
+            f"<td>{h.get('windows')}</td>"
+            f"<td>{h.get('n_ops')}</td>"
+            f"<td>{h.get('seed')}</td>"
+            f"<td><code>jepsen-tpu hunt --replay {html.escape(hid)}"
+            "</code></td></tr>")
+    if not rows:
+        return ""
+    return ("<h2>hunt <span class='badge-incomplete'>"
+            f"{len(rows)} anomal{'y' if len(rows) == 1 else 'ies'}"
+            "</span></h2>"
+            "<table><tr><th>id</th><th>windows</th><th>n_ops</th>"
+            "<th>gen seed</th><th>reproduce</th></tr>"
+            + "".join(rows) + "</table>")
+
+
 def _explain_section(rel: str, target: Path) -> str:
     """The run page's "Explain" panel: the anomaly-forensics summary
     (first anomaly op, witness size, localization backend) with links to
@@ -412,7 +439,8 @@ class Handler(BaseHTTPRequestHandler):
         live = _live_home_section(tests)
         fleet = ("<p><a href='/fleet'>fleet dashboard</a></p>"
                  if (base / "fleet-status.json").exists() else "")
-        body = fleet + (live + "<h2>runs</h2>" if live else "") \
+        hunt = _hunt_home_section(base)
+        body = fleet + hunt + (live + "<h2>runs</h2>" if live else "") \
             + ("<table><tr><th>test</th><th>time</th><th>valid?</th>"
                "<th>telemetry</th><th>download</th></tr>"
                + "".join(rows) + "</table>")

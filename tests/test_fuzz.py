@@ -1,0 +1,487 @@
+"""Schedule-fuzzer tier (doc/robustness.md "Schedule fuzzing").
+
+Covers the ISSUE-18 acceptance surface:
+
+* trial determinism: same schedule ⇒ byte-identical history, with the
+  simulator's wall cap riding a :class:`StepClock` so machine load
+  can't skew truncation (the same-seed/different-load differential);
+* the satellite seams: ``FakeClusterState.mutate_knobs`` seeded knob
+  mutation + the rate-aware settle window, tolerant ``fuzz_knob``
+  coercion with ``JEPSEN_TPU_FUZZ_*`` env twins;
+* schedule canonicalization/round-trip, corpus mutation determinism,
+  fault×op interleaving edge extraction, checker-state
+  ``coverage_probe()`` on :class:`FrontierSession` and the ladder,
+  near-miss margin promotion;
+* the generic PR-8 ddmin over fault windows, a planted-bug
+  positive/negative pair, artifact landing + bit-identical replay,
+  and whole-hunt determinism through the fleet verdict path;
+* slow lane: the guided-vs-blind e2e — a seeded guided hunt finds and
+  minimizes the interleaving-gated demo anomaly at a budget where
+  blind random finds nothing.
+"""
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+pytestmark = pytest.mark.fuzz
+
+OVERLAP_FAULTS = [{"kind": k, "start": 0.1, "dur": 0.4}
+                  for k in ("net", "clock-rate", "pause", "membership")]
+
+
+def _schedule(seed=3, n_ops=120, faults=None):
+    from jepsen_tpu.fuzz.schedule import Schedule
+    return Schedule(seed=seed, n_ops=n_ops, concurrency=3,
+                    faults=[dict(w) for w in (faults or [])])
+
+
+# -- satellite 1: injectable clock / trial determinism -----------------
+
+
+def test_step_clock_is_pure_step_count():
+    from jepsen_tpu.generator.simulate import StepClock
+    c = StepClock(step_s=0.5)
+    assert [c() for _ in range(4)] == [0.0, 0.5, 1.0, 1.5]
+    assert c.reads == 4
+
+
+def test_simulate_wall_cap_ignores_real_load():
+    """Same seed, different machine load ⇒ identical truncation: the
+    StepClock makes ``max_wall_s`` a pure step-count cap, so a
+    complete_fn that stalls (load) changes nothing."""
+    import time
+
+    from jepsen_tpu import generator as gen_mod
+    from jepsen_tpu.generator.simulate import StepClock, simulate
+
+    def run(stall_s):
+        def gen():
+            n = {"i": 0}
+
+            def f():
+                n["i"] += 1
+                return {"f": "write", "value": n["i"]}
+            return gen_mod.clients(gen_mod.limit(200, gen_mod.Fn(f)))
+
+        def complete(ctx, op):
+            if stall_s:
+                time.sleep(stall_s)
+            out = dict(op)
+            out["type"] = "ok"
+            out["time"] = op["time"] + 1
+            return out
+
+        return simulate({"concurrency": 3}, gen(), complete, seed=11,
+                        limit=1600, max_wall_s=40.0,
+                        clock=StepClock(step_s=1.0), _lane=None)
+
+    fast, loaded = run(0.0), run(0.002)
+    assert fast == loaded
+    assert len(fast) < 400  # the cap actually truncated
+
+
+def test_trial_same_schedule_byte_identical():
+    from jepsen_tpu.fuzz.trial import run_trial
+    s = _schedule(seed=7, faults=OVERLAP_FAULTS)
+    a = "".join(json.dumps(op) + "\n" for op in run_trial(s))
+    b = "".join(json.dumps(op) + "\n" for op in run_trial(s.copy()))
+    assert a == b and a
+
+
+def test_trial_histories_are_client_clean():
+    """Client ops never land on the nemesis thread (they would mutate
+    the register invisibly), and indeterminate completions stay under
+    the frontier-explosion cap."""
+    from jepsen_tpu.fuzz.trial import MAX_CRASHES, run_trial
+    h = run_trial(_schedule(seed=2, faults=OVERLAP_FAULTS))
+    client = [op for op in h if isinstance(op.get("process"), int)]
+    assert client and all(isinstance(op.get("process"), int)
+                          for op in h if op.get("type") != "info"
+                          or op.get("process") != "nemesis")
+    infos = [op for op in client if op.get("type") == "info"]
+    assert len(infos) <= MAX_CRASHES
+
+
+# -- satellite 2: FakeClusterState fuzz seams --------------------------
+
+
+def test_fake_cluster_mutate_knobs_deterministic(tmp_path):
+    from jepsen_tpu.fakes import FakeClusterState
+
+    def knobs(seed):
+        c = FakeClusterState(tmp_path / f"m{seed}.json",
+                             nodes=["n1", "n2", "n3"], time_fn=lambda: 0.0)
+        return [c.mutate_knobs(random.Random(seed)) for _ in range(5)]
+
+    assert knobs(42) == knobs(42)
+    assert knobs(42) != knobs(43)
+    for k in knobs(42):
+        assert k["settle_s"] >= 0.0 and 1 <= k["min_members"] <= 2
+
+
+def test_fake_cluster_rate_aware_settle(tmp_path):
+    """The settle window is measured on the CLUSTER clock: a 2× rate
+    factor converges in half the wall time, and garbage rates read as
+    1.0 (the nemesis must never wedge the cluster)."""
+    from jepsen_tpu.fakes import FakeClusterState
+    vclock = {"t": 0.0}
+    c = FakeClusterState(tmp_path / "members.json",
+                         nodes=["n1", "n2", "n3"], settle_s=1.0,
+                         time_fn=lambda: vclock["t"])
+    op = c.op({})
+    pend = (op, c.invoke({}, op))
+    c.set_clock_rate(2.0)
+    vclock["t"] = 0.4  # 0.4 wall × 2.0 = 0.8 cluster < 1.0: in flight
+    assert c.resolve_op({}, pend) is None
+    vclock["t"] = 0.6  # 1.2 cluster ≥ 1.0: settled
+    assert c.resolve_op({}, pend) is c
+    c.set_clock_rate("garbage")
+    assert c.clock_rate == 1.0
+    c.set_clock_rate(-3)
+    assert c.clock_rate == 1.0
+
+
+# -- knobs: tolerant coercion + env twins ------------------------------
+
+
+def test_fuzz_knob_env_twin_and_coercion(monkeypatch):
+    from jepsen_tpu.fuzz.hunt import fuzz_knob
+    assert fuzz_knob("fuzz_trials", None, 400, 1.0) == 400
+    assert fuzz_knob("fuzz_trials", 12, 400, 1.0) == 12
+    monkeypatch.setenv("JEPSEN_TPU_FUZZ_TRIALS", "77")
+    assert fuzz_knob("fuzz_trials", None, 400, 1.0) == 77
+    assert fuzz_knob("fuzz_trials", 12, 400, 1.0) == 12  # explicit wins
+    monkeypatch.setenv("JEPSEN_TPU_FUZZ_TRIALS", "banana")
+    assert fuzz_knob("fuzz_trials", None, 400, 1.0) == 400
+    assert fuzz_knob("fuzz_trials", True, 400, 1.0) == 400  # bool ≠ number
+    assert fuzz_knob("fuzz_trials", -5, 400, 1.0) == 1.0  # clamps to min
+    assert fuzz_knob("fuzz_seed", -5, 0, None) == -5  # no floor on seed
+
+
+def test_preflight_has_fuzz_knob_rows():
+    from jepsen_tpu.analysis.preflight import (_ENV_NUMERIC_KNOBS,
+                                               _NUMERIC_KNOBS)
+    from jepsen_tpu.fuzz.hunt import FUZZ_KNOBS
+    rows = {r[0] for r in _NUMERIC_KNOBS}
+    envs = {r[0] for r in _ENV_NUMERIC_KNOBS}
+    for key, _default, _lo in FUZZ_KNOBS:
+        assert key in rows, f"preflight KNB row missing for {key}"
+        assert "JEPSEN_TPU_" + key.upper() in envs, \
+            f"preflight env twin missing for {key}"
+
+
+# -- schedule + corpus -------------------------------------------------
+
+
+def test_schedule_round_trip_and_key():
+    s = _schedule(seed=9, faults=OVERLAP_FAULTS)
+    s.knobs = {"clock_rate": 2.0, "settle_s": 0.01}
+    from jepsen_tpu.fuzz.schedule import Schedule
+    t = Schedule.from_json(s.to_json())
+    assert t.canonical() == s.canonical()
+    assert t.key() == s.key() and len(s.key()) == 12
+    t.faults[0]["start"] = 0.5
+    assert t.key() != s.key()
+
+
+def test_schedule_windows_ops_bounds():
+    s = _schedule(n_ops=100, faults=[
+        {"kind": "net", "start": 0.99, "dur": 0.5},
+        {"kind": "pause", "start": 0.0, "dur": 0.0001}])
+    wins = s.windows_ops()
+    for start, end, _kind in wins:
+        assert 0 <= start < 100 and start < end <= 100
+    assert wins[1][1] - wins[1][0] == 1  # every window ≥ one op wide
+
+
+def test_corpus_mutation_deterministic():
+    from jepsen_tpu.fuzz.corpus import mutate, random_schedule
+    base = _schedule(seed=1, faults=OVERLAP_FAULTS)
+
+    def walk(seed):
+        rng = random.Random(seed)
+        s, out = base, []
+        for _ in range(20):
+            s = mutate(s, rng, splice_from=random_schedule(rng))
+            out.append(s.key())
+        return out
+
+    assert walk(5) == walk(5)
+    assert walk(5) != walk(6)
+
+
+def test_corpus_dedup_and_pick():
+    from jepsen_tpu.fuzz.corpus import Corpus
+    c = Corpus(base=_schedule(seed=1))
+    assert len(c) == 1
+    assert not c.add(_schedule(seed=1))  # same key: dedup
+    assert c.add(_schedule(seed=2), reason="new-edge")
+    assert len(c) == 2
+    picked = {c.pick(random.Random(i)).seed for i in range(20)}
+    assert picked <= {1, 2} and picked
+
+
+# -- coverage signals --------------------------------------------------
+
+
+def test_history_edges_fault_op_interleaving():
+    from jepsen_tpu.fuzz.coverage import history_edges
+    h = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "info", "process": "nemesis", "f": "start-partition"},
+        {"type": "info", "process": "nemesis", "f": "start-clock-rate"},
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 1},
+        {"type": "info", "process": "nemesis", "f": "stop-partition"},
+        {"type": "invoke", "process": 0, "f": "cas", "value": [1, 2]},
+        {"type": "fail", "process": 0, "f": "cas", "value": [1, 2]},
+    ]
+    edges = history_edges(h)
+    assert "op:none:write:ok" in edges
+    assert "op:clock-rate+net:read:ok" in edges
+    assert "op:clock-rate:cas:fail" in edges
+
+
+def test_history_edges_membership_horizon():
+    from jepsen_tpu.fuzz.coverage import (MEMBERSHIP_HORIZON_OPS,
+                                          history_edges)
+    h = [{"type": "info", "process": "nemesis", "f": "grow"}]
+    for i in range(MEMBERSHIP_HORIZON_OPS + 2):
+        h.append({"type": "invoke", "process": 0, "f": "read"})
+        h.append({"type": "ok", "process": 0, "f": "read", "value": None})
+    edges = history_edges(h)
+    assert "op:membership:read:ok" in edges
+    assert "op:none:read:ok" in edges  # past the horizon
+
+
+def test_coverage_map_new_edges_and_near_miss():
+    from jepsen_tpu.fuzz.coverage import CoverageMap
+    m = CoverageMap()
+    assert m.observe(["a", "b"]) == 2
+    assert m.observe(["b", "c"]) == 1
+    assert len(m) == 3
+    assert not m.observe_margin(None)
+    assert m.observe_margin(5) and m.best_margin == 5
+    assert not m.observe_margin(7)  # only a SHRINKING margin promotes
+    assert m.observe_margin(1) and m.best_margin == 1
+
+
+def test_frontier_session_coverage_probe():
+    from jepsen_tpu.checker.linear_cpu import FrontierSession
+    from jepsen_tpu.checker.linear_encode import encode_register_ops
+    fs = FrontierSession()
+    probe = fs.coverage_probe()
+    assert probe["margin"] is None and probe["died"] is False
+    h = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 1},
+    ]
+    res = fs.absorb(encode_register_ops(h))
+    assert res.valid is True
+    probe = fs.coverage_probe()
+    assert any(e.startswith("frontier:peak:b") for e in probe["edges"])
+    assert isinstance(probe["margin"], int) and probe["margin"] >= 1
+    assert probe["died"] is False
+
+
+def test_ladder_coverage_probe_rung_regimes():
+    from jepsen_tpu.checker.ladder import Backend, BackendLadder
+    ladder = BackendLadder([
+        Backend("flaky", lambda ctx: None),  # declines every dispatch
+        Backend("steady", lambda ctx: {"ok": True}),
+    ])
+    assert ladder.coverage_probe()["edges"] == []
+    out, name = ladder.run({})
+    assert name == "steady" and out == {"ok": True}
+    edges = ladder.coverage_probe()["edges"]
+    assert "rung:steady:settled" in edges
+    assert any(e.startswith("rung:flaky:") for e in edges)
+
+
+# -- ddmin + planted bug ----------------------------------------------
+
+
+def test_ddmin_generic_minimization():
+    from jepsen_tpu.checker.explain import ddmin
+    items = list("abcdefgh")
+    kept, info = ddmin(items, lambda ws: {"a", "e"} <= set(ws))
+    assert kept == ["a", "e"]
+    assert info["minimal"] is True
+
+
+def test_planted_bug_positive_and_negative():
+    """The demo bug is interleaving-gated: a four-way-overlap schedule
+    trips it (and ONLY the bug — the same schedule is valid on the
+    honest register); a no-overlap schedule never arms the final
+    stage."""
+    from jepsen_tpu.checker.linearizable import LinearizableChecker
+    from jepsen_tpu.fuzz.hunt import DEMO_BUG_SPEC
+    from jepsen_tpu.fuzz.trial import PlantedBug, run_trial
+    ck = LinearizableChecker(accelerator="cpu")
+    overlap = _schedule(seed=3, faults=OVERLAP_FAULTS)
+    h = run_trial(overlap, bug=PlantedBug.from_spec(DEMO_BUG_SPEC))
+    assert ck.check(None, h, {"explain": False})["valid?"] is False
+    assert ck.check(None, run_trial(overlap),
+                    {"explain": False})["valid?"] is True
+    apart = _schedule(seed=3, faults=[
+        {"kind": "net", "start": 0.0, "dur": 0.12},
+        {"kind": "clock-rate", "start": 0.2, "dur": 0.12},
+        {"kind": "pause", "start": 0.45, "dur": 0.12},
+        {"kind": "membership", "start": 0.7, "dur": 0.12}])
+    h = run_trial(apart, bug=PlantedBug.from_spec(DEMO_BUG_SPEC))
+    assert ck.check(None, h, {"explain": False})["valid?"] is True
+
+
+def test_planted_bug_spec_round_trip():
+    from jepsen_tpu.fuzz.trial import PlantedBug
+    from jepsen_tpu.fuzz.hunt import DEMO_BUG_SPEC
+    bug = PlantedBug.from_spec(DEMO_BUG_SPEC)
+    assert PlantedBug.from_spec(bug.spec()).spec() == bug.spec()
+    assert PlantedBug.from_spec(None) is None
+    assert PlantedBug.from_spec([]) is None
+
+
+# -- artifacts + replay ------------------------------------------------
+
+
+def test_minimize_land_and_replay(tmp_path):
+    """The quick-lane artifact contract: a known-tripping anomaly
+    minimizes through ddmin (still invalid at every probe), lands as a
+    hunt/<id>/ bundle, and --replay reproduces it bit-identically."""
+    from jepsen_tpu.fuzz import hunt as hunt_mod
+    h = hunt_mod.Hunter(tmp_path, trials=1, pool_workers=0,
+                        seed=0, bug_spec=hunt_mod.DEMO_BUG_SPEC)
+    schedule = _schedule(seed=3, faults=OVERLAP_FAULTS)
+    assert h._trial_invalid(schedule) is not None
+    minimized, info = h.minimize(schedule)
+    assert len(minimized.faults) <= len(schedule.faults)
+    assert minimized.n_ops <= schedule.n_ops
+    assert h._trial_invalid(minimized) is not None
+    hunt_id = h.land({"schedule": schedule,
+                      "verdict": {"valid_so_far": False}})
+    d = tmp_path / "hunt" / hunt_id
+    for name in ("schedule.json", "minimized.json", "history.jsonl",
+                 "verdict.json", "hunt.json"):
+        assert (d / name).exists(), name
+    meta = json.loads((d / "hunt.json").read_text())
+    assert meta["bug_spec"] == hunt_mod.DEMO_BUG_SPEC
+    assert meta["seed_tuple"]["n_ops"] == minimized.n_ops
+    rep = hunt_mod.replay(tmp_path, hunt_id)
+    assert rep["identical"] is True and rep["reproduced"] is True
+    hunts = hunt_mod.list_hunts(tmp_path)
+    assert [r["id"] for r in hunts] == [hunt_id]
+    assert hunt_mod.list_hunts(tmp_path / "nope") == []
+
+
+def test_hunt_deterministic_through_fleet_path(tmp_path):
+    """Whole-hunt determinism through the LiveDaemon verdict path: two
+    hunts with the same seed tuple discover identical coverage and
+    retain identical corpora."""
+    from jepsen_tpu.fuzz.hunt import Hunter
+
+    def go(tag):
+        h = Hunter(tmp_path / tag, trials=8, pool_workers=0,
+                   trial_ops=60, seed=4, batch_size=4,
+                   stop_on_first=False)
+        summary = h.run()
+        keys = [e["key"] for e in h.corpus.entries]
+        return summary, sorted(h.covmap.edges), keys
+
+    (sum_a, edges_a, keys_a), (sum_b, edges_b, keys_b) = go("a"), go("b")
+    assert sum_a["outcomes"] == sum_b["outcomes"]
+    assert sum_a["outcomes"]["error"] == 0
+    assert sum_a["trials"] == 8
+    assert edges_a == edges_b and edges_a
+    assert keys_a == keys_b
+    assert sum_a["coverage_edges"] == len(edges_a)
+    # scratch trial dirs are reaped; only the corpus/coverage remain
+    assert not (tmp_path / "a" / "work").exists() or \
+        not any((tmp_path / "a" / "work").iterdir())
+
+
+def test_hunt_telemetry_metrics(tmp_path):
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.fuzz.hunt import Hunter
+    reg = telemetry.Registry()
+    h = Hunter(tmp_path, trials=4, pool_workers=0, trial_ops=60,
+               seed=4, batch_size=4, stop_on_first=False, registry=reg)
+    summary = h.run()
+    rows = {r["name"]: r for r in reg.snapshot()
+            if r["type"] in ("counter", "gauge")}
+    assert sum(r["value"] for r in reg.snapshot()
+               if r["name"] == "fuzz_trials_total") == summary["trials"]
+    assert rows["fuzz_coverage_edges"]["value"] == \
+        float(summary["coverage_edges"])
+    assert rows["fuzz_corpus_size"]["value"] == \
+        float(summary["corpus_size"])
+
+
+def test_web_home_lists_hunt_artifacts(tmp_path):
+    """The web home page surfaces landed hunts with replay hints."""
+    import urllib.request
+
+    from jepsen_tpu.web import make_server
+    d = tmp_path / "hunt" / "cafe00112233"
+    d.mkdir(parents=True)
+    (d / "hunt.json").write_text(json.dumps({
+        "id": "cafe00112233",
+        "seed_tuple": {"seed": 9, "n_ops": 64,
+                       "faults": [{"kind": "net", "start": 0.1,
+                                   "dur": 0.2}]}}))
+    srv = make_server(str(tmp_path), "127.0.0.1", 0)
+    import threading
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_address[1]}/",
+            timeout=10).read().decode()
+    finally:
+        srv.shutdown()
+        t.join(timeout=10)
+    assert "cafe00112233" in home
+    assert "hunt --replay cafe00112233" in home
+
+
+# -- the e2e: guided finds what blind cannot (slow lane) ---------------
+
+
+@pytest.mark.slow
+def test_guided_hunt_finds_planted_anomaly_blind_does_not(tmp_path):
+    """ISSUE-18 acceptance: at an equal 400-trial budget against the
+    interleaving-gated demo bug, the seeded guided hunt finds AND
+    minimizes the anomaly; blind random finds nothing; the landed
+    artifact replays bit-identically."""
+    from jepsen_tpu.fuzz import hunt as hunt_mod
+
+    guided = hunt_mod.Hunter(tmp_path / "guided", trials=400,
+                             pool_workers=0, trial_ops=120, seed=1,
+                             guided=True,
+                             bug_spec=hunt_mod.DEMO_BUG_SPEC)
+    g = guided.run()
+    assert g["anomalies"] >= 1, g
+    assert g["trials"] <= 400
+    hunt_id = g["hunt_ids"][0]
+    d = tmp_path / "guided" / "hunt" / hunt_id
+    meta = json.loads((d / "hunt.json").read_text())
+    minimized = meta["seed_tuple"]
+    original = json.loads((d / "schedule.json").read_text())
+    assert len(minimized["faults"]) <= len(original["faults"])
+    assert minimized["n_ops"] <= original["n_ops"]
+    rep = hunt_mod.replay(tmp_path / "guided", hunt_id)
+    assert rep["identical"] is True and rep["reproduced"] is True
+
+    blind = hunt_mod.Hunter(tmp_path / "blind", trials=400,
+                            pool_workers=0, trial_ops=120, seed=1,
+                            guided=False,
+                            bug_spec=hunt_mod.DEMO_BUG_SPEC)
+    b = blind.run()
+    assert b["anomalies"] == 0, b
+    assert b["trials"] == 400
+    assert b["outcomes"]["error"] == 0
