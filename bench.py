@@ -1522,6 +1522,191 @@ def cfg_fleet_runs_sustained():
          verdict_parity="bit-identical to local analyze")
 
 
+def cfg_fleet_failover():
+    """fleet_failover: kill the ACTIVE pool host under live shipped
+    load and measure what HA actually costs (doc/robustness.md "Fleet
+    HA"). Real OS processes — the receiver and both leased pool hosts
+    are the fleet-chaos harness's child roles — with pool0 holding
+    every lease when it is SIGKILLed:
+
+    * ``fleet_failover_adoption_s`` — wall from the kill to the
+      standby holding a lease on EVERY in-flight run. Bar: <= 2x the
+      lease TTL (one TTL for the lease to expire, one for the
+      standby's discovery/claim cadence).
+    * ``fleet_failover_recheck_frac`` — fraction of the runs already
+      settled before the kill that any host finalized AGAIN
+      afterwards. Bar: <= 0.1 (the design says 0: a final verdict is
+      durable and discovery skips it; the 10% headroom is for a
+      verdict racing the kill itself).
+    """
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    from __graft_entry__ import _register_history
+    from jepsen_tpu.fleet.chaos import _Child, _free_port
+    from jepsen_tpu.fleet.ship import Shipper
+    from jepsen_tpu.journal import WAL_NAME, Journal, read_jsonl_tolerant
+    from jepsen_tpu.live.daemon import load_live_status
+
+    ttl = float(os.environ.get("BENCH_FAILOVER_TTL_S", "1.0"))
+    n_pre = int(os.environ.get("BENCH_FAILOVER_PRE_RUNS", "6"))
+    n_live = int(os.environ.get("BENCH_FAILOVER_LIVE_RUNS", "6"))
+    ops_per_run = 120
+    deadline_s = 120.0
+    reg = telemetry.Registry()
+    tmp = tempfile.mkdtemp(prefix="fleet-failover-")
+    root = Path(tmp)
+    fleet = root / "fleet"
+    src = root / "src"
+    fleet.mkdir()
+    src.mkdir()
+    port = _free_port()
+    receiver = _Child(fleet, "receiver",
+                      ["--store", str(fleet), "--port", str(port)],
+                      "failover-receiver.log")
+    pool0 = _Child(fleet, "pool",
+                   ["--store", str(fleet), "--host-id", "pool0",
+                    "--ttl", str(ttl)], "failover-pool0.log")
+    pool1 = _Child(fleet, "pool",
+                   ["--store", str(fleet), "--host-id", "pool1",
+                    "--ttl", str(ttl)], "failover-pool1.log")
+    release_finals = threading.Event()
+    threads: list[threading.Thread] = []
+
+    def lease_host(key):
+        try:
+            with open(fleet / key / "check.lease",
+                      encoding="utf-8") as f:
+                return json.load(f).get("host")
+        except (OSError, ValueError):
+            return None
+
+    def start_run(key, history, hold_final):
+        """Producer + shipper for one run; ``hold_final`` gates the
+        history.jsonl write on release_finals so the run stays live
+        (tailing) until the conductor has measured adoption."""
+        rd = src / key
+        rd.mkdir(parents=True)
+
+        def produce():
+            j = Journal(rd / WAL_NAME, fsync_interval_s=-1)
+            for op in history:
+                j.append(op)
+            j.close()
+            if hold_final:
+                release_finals.wait(deadline_s)
+            else:
+                # hold the final until the pool LEASED the run: a
+                # history.jsonl landing before the pool's first poll
+                # makes it post-hoc territory (discovery skips it) and
+                # there'd be no settled verdict to survive the kill
+                end = time.monotonic() + deadline_s
+                while time.monotonic() < end and lease_host(key) is None:
+                    time.sleep(0.02)
+            with open(rd / "history.jsonl", "w", encoding="utf-8") as f:
+                for op in history:
+                    f.write(json.dumps(op) + "\n")
+
+        sh = Shipper(rd, f"http://127.0.0.1:{port}", poll_s=0.02,
+                     registry=reg)
+        tp = threading.Thread(target=produce, daemon=True)
+        ts = threading.Thread(
+            target=lambda: sh.run(timeout_s=deadline_s), daemon=True)
+        tp.start()
+        ts.start()
+        threads.extend([tp, ts])
+
+    def await_final(keys, budget):
+        end = time.monotonic() + budget
+        pending = set(keys)
+        while pending and time.monotonic() < end:
+            for key in sorted(pending):
+                st = load_live_status(fleet / key)
+                if st is not None and st.get("state") == "final":
+                    pending.discard(key)
+            time.sleep(0.05)
+        if pending:
+            raise RuntimeError(f"failover runs never settled: "
+                               f"{sorted(pending)}")
+
+    pre_keys = [f"fob/p{i:02d}" for i in range(n_pre)]
+    live_keys = [f"fob/l{i:02d}" for i in range(n_live)]
+    try:
+        receiver.spawn()
+        pool0.spawn()
+        # phase A: settle a population under pool0 — the runs whose
+        # verdicts must SURVIVE the kill un-rechecked
+        for i, key in enumerate(pre_keys):
+            start_run(key, _register_history(ops_per_run, n_procs=3,
+                                             seed=i, n_values=5),
+                      hold_final=False)
+        await_final(pre_keys, deadline_s)
+        # phase B: live runs; pool0 must hold every lease before the
+        # kill so the kill provably hits the ACTIVE host
+        for i, key in enumerate(live_keys):
+            start_run(key, _register_history(ops_per_run, n_procs=3,
+                                             seed=100 + i, n_values=5),
+                      hold_final=True)
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end and any(
+                lease_host(k) != "pool0" for k in live_keys):
+            time.sleep(0.05)
+        assert all(lease_host(k) == "pool0" for k in live_keys)
+        pool1.spawn()  # standby: sees pool0's live leases, claims none
+        time.sleep(max(2 * 0.05, ttl / 4))
+
+        t_kill = time.monotonic()
+        pool0.kill()
+        adopted: set = set()
+        adoption_s = None
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            for k in live_keys:
+                if k not in adopted and lease_host(k) == "pool1":
+                    adopted.add(k)
+            if len(adopted) == len(live_keys):
+                adoption_s = time.monotonic() - t_kill
+                break
+            time.sleep(0.02)
+        if adoption_s is None:
+            raise RuntimeError(
+                f"standby adopted {len(adopted)}/{n_live} runs within "
+                f"{deadline_s}s")
+        release_finals.set()
+        for t in threads:
+            t.join(deadline_s)
+        await_final(live_keys, deadline_s)
+    finally:
+        release_finals.set()
+        for child in (receiver, pool0, pool1):
+            child.kill()
+
+    rechecked = set()
+    for f in sorted(fleet.glob("finals-*.jsonl")):
+        rows, _ = read_jsonl_tolerant(f)
+        for row in rows:
+            key = str(row.get("key"))
+            if key in pre_keys and row.get("host") == "pool1":
+                rechecked.add(key)
+    recheck_frac = len(rechecked) / max(n_pre, 1)
+    snap = reg.snapshot()
+    resyncs = {r["labels"].get("reason"): int(r["value"])
+               for r in snap if r["name"] == "fleet_ship_resyncs_total"}
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    emit("fleet_failover_adoption_s", adoption_s, "s",
+         (2.0 * ttl) / max(adoption_s, 1e-6),
+         lease_ttl_s=ttl, live_runs=n_live, settled_pre=n_pre,
+         ship_resyncs=resyncs, killed_host="pool0",
+         adopter="pool1")
+    emit("fleet_failover_recheck_frac", recheck_frac, "frac",
+         0.1 / max(recheck_frac, 1e-6),
+         rechecked=sorted(rechecked), settled_pre=n_pre,
+         lease_ttl_s=ttl)
+
+
 def cfg_membership_resolve():
     """membership_resolve_latency: full reconfiguration cycles per
     second through the membership scenario machinery — durable registry
@@ -1962,6 +2147,7 @@ def main() -> None:
     guard("ckpt", cfg_ckpt)
     guard("trace", cfg_trace)
     guard("fleet", cfg_fleet_runs_sustained)
+    guard("fleet_failover", cfg_fleet_failover)
     guard("lint", cfg_lint)
     guard("fuzz", cfg_fuzz)
     device_rate = guard("headline", cfg_headline) or device_rate

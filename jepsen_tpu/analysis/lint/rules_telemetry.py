@@ -243,7 +243,10 @@ def _doc_drift(graph: CallGraph, registered: set,
     # the fleet dashboard is operator-facing from day one, so an
     # undocumented series IS the drift (the forward check can't see it:
     # nothing cites it). Scoped to fleet_* to keep the rule additive
-    # for the pre-fleet vocabulary.
+    # for the pre-fleet vocabulary; the HA series ride the same prefix
+    # (fleet_lease_* for leased checking/fencing, fleet_ship_* for
+    # shipper re-syncs, fleet_ingest_shed_total / fleet_degraded_total
+    # for backpressure + degraded mode — doc/robustness.md "Fleet HA").
     for name, rs in sorted((by_name or {}).items()):
         if not name.startswith("fleet_"):
             continue

@@ -403,6 +403,10 @@ _NUMERIC_KNOBS = (
     ("fleet_port", True, 0.0),
     ("fleet_ingest_budget_s", True, 0.0),
     ("fleet_max_runs", True, 1.0),
+    # fleet HA knobs (doc/robustness.md "Fleet HA"): leased-checking
+    # TTL (0 disables leasing) and the receiver's free-disk shed floor
+    ("fleet_lease_ttl_s", True, 0.0),
+    ("fleet_disk_headroom_mb", True, 0.0),
     # host ingest spine (doc/performance.md "Host ingest spine"): the
     # chunked-scheduler drain size — interpreter._knob coerces
     # tolerantly at runtime (garbage warns + default, 0/None = per-op
@@ -483,6 +487,12 @@ _ENV_NUMERIC_KNOBS = (
     ("JEPSEN_TPU_FLEET_MAX_RUNS",
      "process-wide twin of fleet_max_runs (the pool's admission cap "
      "on concurrently tracked runs)"),
+    ("JEPSEN_TPU_FLEET_LEASE_TTL_S",
+     "process-wide twin of fleet_lease_ttl_s (leased-checking TTL; "
+     "0 disables leasing, doc/robustness.md \"Fleet HA\")"),
+    ("JEPSEN_TPU_FLEET_DISK_HEADROOM_MB",
+     "process-wide twin of fleet_disk_headroom_mb (the receiver's "
+     "free-disk floor below which chunks shed with 429)"),
     ("JEPSEN_TPU_FUZZ_TRIALS",
      "process-wide twin of fuzz_trials (the hunt's trial budget, "
      "doc/robustness.md \"Schedule fuzzing\")"),
@@ -613,6 +623,45 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
                 f"env {key}={raw!r} is not a number",
                 hint=hint + "; the runtime would warn and use the "
                      "default"))
+
+    # fleet_receivers (doc/robustness.md "Fleet HA"): the shipper's
+    # failover endpoint list — a comma-separated string or a list of
+    # base URLs. The runtime (fleet.fleet_receivers) tolerantly reads
+    # garbage as unset; here a malformed entry is an error, because a
+    # silently-empty list means no failover when the receiver dies.
+    _RECV_HINT = ("a list of receiver base URLs (or one comma-"
+                  "separated string), e.g. ['http://pool-a:8091', "
+                  "'http://pool-b:8091']")
+    for origin, value in (
+            ("fleet_receivers", test.get("fleet_receivers", _UNSET)),
+            ("JEPSEN_TPU_FLEET_RECEIVERS",
+             os.environ.get("JEPSEN_TPU_FLEET_RECEIVERS", _UNSET))):
+        if value is _UNSET or value is None or value == "":
+            continue
+        if isinstance(value, str):
+            entries = [p.strip() for p in value.split(",")]
+        elif isinstance(value, (list, tuple)):
+            entries = [p.strip() if isinstance(p, str) else p
+                       for p in value]
+        else:
+            out.append(Diagnostic(
+                "KNB001", ERROR, origin,
+                f"{origin} must be a URL list or comma-separated "
+                f"string, got {type(value).__name__}",
+                hint=_RECV_HINT))
+            continue
+        for p in entries:
+            if not isinstance(p, str):
+                out.append(Diagnostic(
+                    "KNB001", ERROR, origin,
+                    f"{origin} entry {p!r} is not a string",
+                    hint=_RECV_HINT))
+            elif p and not (p.startswith("http://")
+                            or p.startswith("https://")):
+                out.append(Diagnostic(
+                    "KNB007", ERROR, origin,
+                    f"{origin} entry {p!r} is not an http(s) base URL",
+                    hint=_RECV_HINT))
 
     nodes = list(test.get("nodes") or [])
     conc_raw = test.get("concurrency", 1)

@@ -193,10 +193,17 @@ class CheckpointStore:  # durability: fsync (via utils.atomic_write_json)
     one interval writes nothing."""
 
     def __init__(self, path, interval_s: float | None = DEFAULT_CKPT_INTERVAL_S,
-                 resume: bool = True):
+                 resume: bool = True, guard=None):
         self.path = Path(path)
         self.interval_s = interval_s
         self.resume = resume
+        # guard() -> bool: fencing hook for leased fleet checking
+        # (doc/robustness.md "Fleet HA") — re-checked immediately before
+        # every persist, so a checker whose run lease went stale cannot
+        # overwrite its adopter's checkpoint with an older carry. None
+        # (the single-host default) never fences.
+        self.guard = guard
+        self.fenced = False
         self._last_save = time.monotonic()
         self._last_events = 0
         self.writes = 0
@@ -228,6 +235,12 @@ class CheckpointStore:  # durability: fsync (via utils.atomic_write_json)
 
     def save(self, state: dict, events_done: int | None = None) -> bool:
         from jepsen_tpu.utils import atomic_write_json
+        if self.guard is not None and not self.guard():
+            self.fenced = True
+            logger.warning("checkpoint write to %s fenced: the run "
+                           "lease went stale (a newer epoch owns it)",
+                           self.path)
+            return False
         doc = dict(state)
         doc.setdefault("version", VERSION)
         doc["wrote_at"] = time.time()

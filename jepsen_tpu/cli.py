@@ -280,8 +280,11 @@ def single_test_cmd(
                          "(doc/observability.md \"Fleet plane\")")
         p_ship.add_argument("dir", help="one run's directory "
                                         "(store/<name>/<timestamp>)")
-        p_ship.add_argument("--to", default=None,
-                            help="receiver base URL (default "
+        p_ship.add_argument("--to", default=None, action="append",
+                            help="receiver base URL; repeat (or comma-"
+                                 "separate) for failover targets "
+                                 "(default: fleet_receivers knob / "
+                                 "JEPSEN_TPU_FLEET_RECEIVERS, else "
                                  "http://127.0.0.1:<fleet_port>)")
         p_ship.add_argument("--poll", dest="ship_poll_s", type=float,
                             default=0.2,
@@ -311,6 +314,17 @@ def single_test_cmd(
                              help="admission cap on concurrently "
                                   "tracked runs (env twin "
                                   "JEPSEN_TPU_FLEET_MAX_RUNS)")
+        p_fleet.add_argument("--lease-ttl", dest="fleet_lease_ttl_s",
+                             default=None,
+                             help="run-lease TTL in seconds for leased "
+                                  "checking; 0 disables leasing (env "
+                                  "twin JEPSEN_TPU_FLEET_LEASE_TTL_S)")
+        p_fleet.add_argument("--disk-headroom",
+                             dest="fleet_disk_headroom_mb", default=None,
+                             help="free-disk floor in MB below which "
+                                  "the receiver sheds chunks with 429 "
+                                  "(env twin "
+                                  "JEPSEN_TPU_FLEET_DISK_HEADROOM_MB)")
         p_fleet.add_argument("--poll", dest="fleet_poll_s", type=float,
                              default=None,
                              help="seconds between pool polls")
@@ -320,6 +334,29 @@ def single_test_cmd(
         p_fleet.add_argument("--timeout", type=float, default=0.0,
                              help="with --once: give up after this "
                                   "many seconds (0 = wait forever)")
+
+        p_chaos = sub.add_parser(
+            "fleet-chaos", help="self-chaos harness: producers + "
+                                "receiver + a two-host leased pool "
+                                "under SIGKILL/SIGSTOP/torn-TCP/ENOSPC "
+                                "injection; asserts the HA invariants "
+                                "(doc/robustness.md \"Fleet HA\")")
+        p_chaos.add_argument("--store-dir", default="store",
+                             help="harness workspace; the report lands "
+                                  "at <store>/fleet-chaos.json")
+        p_chaos.add_argument("--runs", type=int, default=4,
+                             help="producer runs to ship under chaos")
+        p_chaos.add_argument("--ops", type=int, default=160,
+                             help="history ops per run")
+        p_chaos.add_argument("--seed", type=int, default=0,
+                             help="seeds the chaos schedule and every "
+                                  "producer history")
+        p_chaos.add_argument("--lease-ttl", dest="fleet_lease_ttl_s",
+                             type=float, default=1.0,
+                             help="pool hosts' lease TTL (short: more "
+                                  "adoption churn)")
+        p_chaos.add_argument("--timeout", type=float, default=180.0,
+                             help="overall harness deadline in seconds")
 
         p_hunt = sub.add_parser(
             "hunt", help="coverage-guided nemesis schedule fuzzer: "
@@ -439,6 +476,8 @@ def single_test_cmd(
                 return ship_cmd(opts)
             if opts.command == "fleet":
                 return fleet_cmd(opts)
+            if opts.command == "fleet-chaos":
+                return fleet_chaos_cmd(opts)
             if opts.command == "hunt":
                 return hunt_cmd(opts)
             return EXIT_BAD_ARGS
@@ -527,20 +566,29 @@ def ship_cmd(opts) -> int:
     (doc/observability.md "Fleet plane")."""
     from pathlib import Path
 
-    from jepsen_tpu.fleet import DEFAULT_FLEET_PORT, fleet_knob
+    from jepsen_tpu.fleet import (DEFAULT_FLEET_PORT, fleet_knob,
+                                  fleet_receivers)
     from jepsen_tpu.fleet.ship import Shipper
 
     run_dir = Path(opts.dir)
-    base = opts.to
-    if base is None:
+    # --to repeats (or comma-separates) into a failover list; with none
+    # given, the fleet_receivers knob/env twin decides, and the local
+    # fleet_port receiver is the last resort (doc/robustness.md
+    # "Fleet HA")
+    bases: list[str] = []
+    for item in opts.to or ():
+        bases.extend(fleet_receivers(item))
+    if not bases:
+        bases = fleet_receivers()
+    if not bases:
         port = int(fleet_knob("fleet_port", None,
                               DEFAULT_FLEET_PORT, 0.0))
-        base = f"http://127.0.0.1:{port}"
-    sh = Shipper(run_dir, base, poll_s=opts.ship_poll_s)
+        bases = [f"http://127.0.0.1:{port}"]
+    sh = Shipper(run_dir, bases, poll_s=opts.ship_poll_s)
     ok = sh.run(timeout_s=opts.timeout)
     print(f"{sh.key}: shipped {sh.bytes_sent} byte(s) in "
           f"{sh.chunks_sent} chunk(s), {sh.resets} reset(s), "
-          f"finalized={sh.finalized}")
+          f"{sh.failovers} failover(s), finalized={sh.finalized}")
     return EXIT_OK if ok else EXIT_CRASH
 
 
@@ -556,6 +604,8 @@ def fleet_cmd(opts) -> int:
         "port": opts.fleet_port,
         "ingest_budget_s": opts.fleet_ingest_budget_s,
         "max_runs": opts.fleet_max_runs,
+        "lease_ttl_s": opts.fleet_lease_ttl_s,
+        "disk_headroom_mb": opts.fleet_disk_headroom_mb,
         "poll_s": (opts.fleet_poll_s if opts.fleet_poll_s is not None
                    else DEFAULT_POLL_S),
     }
@@ -571,6 +621,23 @@ def fleet_cmd(opts) -> int:
         return EXIT_INVALID if runs.get("invalid", 0) else EXIT_OK
     fleet_scheduler.serve(opts.store_dir, **kw)
     return EXIT_OK
+
+
+def fleet_chaos_cmd(opts) -> int:
+    """``jepsen-tpu fleet-chaos``: the fleet-HA self-chaos harness
+    (doc/robustness.md "Fleet HA"). Exits EXIT_OK only when every
+    invariant held — zero double-checked runs, zero lost/duplicated
+    WAL bytes, fleet verdicts bit-identical to local analyze."""
+    import json as _json
+
+    from jepsen_tpu.fleet.chaos import run_fleet_chaos
+
+    report = run_fleet_chaos(opts.store_dir, runs=opts.runs,
+                             n_ops=opts.ops, seed=opts.seed,
+                             lease_ttl_s=opts.fleet_lease_ttl_s,
+                             timeout_s=opts.timeout)
+    print(_json.dumps(report, indent=2))
+    return EXIT_OK if report["ok"] else EXIT_INVALID
 
 
 def hunt_cmd(opts) -> int:

@@ -34,6 +34,11 @@ from jepsen_tpu.live.daemon import coerce_knob
 DEFAULT_FLEET_PORT = 8091
 DEFAULT_FLEET_INGEST_BUDGET_S = 0.5
 DEFAULT_FLEET_MAX_RUNS = 64
+# HA knobs (doc/robustness.md "Fleet HA"): run-lease TTL for the pool's
+# leased checking (0 disables leasing — the single-host mode), and the
+# receiver's free-disk floor below which it sheds chunks with 429
+DEFAULT_FLEET_LEASE_TTL_S = 10.0
+DEFAULT_FLEET_DISK_HEADROOM_MB = 64.0
 
 # (knob, default, floor) — mirrored by preflight's KNB rows and the
 # env twins below; doc/observability.md "Fleet plane" documents each
@@ -41,6 +46,8 @@ FLEET_KNOBS = (
     ("fleet_port", DEFAULT_FLEET_PORT, 0.0),
     ("fleet_ingest_budget_s", DEFAULT_FLEET_INGEST_BUDGET_S, 0.0),
     ("fleet_max_runs", DEFAULT_FLEET_MAX_RUNS, 1.0),
+    ("fleet_lease_ttl_s", DEFAULT_FLEET_LEASE_TTL_S, 0.0),
+    ("fleet_disk_headroom_mb", DEFAULT_FLEET_DISK_HEADROOM_MB, 0.0),
 )
 
 
@@ -53,3 +60,28 @@ def fleet_knob(name: str, value, default: float, lo: float) -> float:
     if value is None:
         value = os.environ.get("JEPSEN_TPU_" + name.upper())
     return coerce_knob(name, value, default, lo)
+
+
+def fleet_receivers(value=None) -> list[str]:
+    """The shipper's receiver endpoint list (``fleet_receivers``): an
+    explicit value wins (an iterable of base URLs, or one comma-
+    separated string), else the ``JEPSEN_TPU_FLEET_RECEIVERS`` env
+    twin, else empty. Tolerant like every fleet knob — blank entries
+    drop, garbage (a non-string, non-iterable value) logs a warning
+    and reads as unset; preflight (KNB001) is where garbage errors."""
+    import logging
+    if value is None:
+        value = os.environ.get("JEPSEN_TPU_FLEET_RECEIVERS")
+    if value is None:
+        return []
+    if isinstance(value, str):
+        parts = value.split(",")
+    else:
+        try:
+            parts = [str(v) for v in value]
+        except TypeError:
+            logging.getLogger(__name__).warning(
+                "fleet knob fleet_receivers=%r is not a URL list; "
+                "ignoring", value)
+            return []
+    return [p.strip().rstrip("/") for p in parts if p and p.strip()]

@@ -27,12 +27,14 @@ tailing, snapshots and verdicts work unchanged on shipped runs, and
 """
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import logging
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
@@ -47,6 +49,22 @@ logger = logging.getLogger(__name__)
 _SEGMENT = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 
 MAX_CHUNK_BYTES = 32 << 20  # absurdly large for one WAL poll
+
+# honest load shedding (doc/robustness.md "Fleet HA"): the Retry-After
+# a 429 carries, and how long an ENOSPC'd run stays parked before the
+# next append re-probes the disk
+RETRY_AFTER_S = 1.0
+ENOSPC_PARK_S = 5.0
+
+
+def disk_free_mb(path) -> float | None:
+    """Free megabytes on ``path``'s filesystem, or None when the probe
+    itself fails (the caller must not shed on a broken probe)."""
+    try:
+        st = os.statvfs(str(path))
+    except (OSError, AttributeError):
+        return None
+    return st.f_bavail * st.f_frsize / (1 << 20)
 
 
 def _atomic_write_bytes(path: Path, body: bytes) -> None:
@@ -90,12 +108,25 @@ class IngestServer:
     def __init__(self, store_root, host: str = "127.0.0.1",
                  port: int = 0,
                  registry: telemetry.Registry | None = None,
-                 feed=None):
+                 feed=None, disk_headroom_mb: float = 0.0,
+                 pressure=None, fault_hook=None):
         self.store_root = Path(store_root)
         self.registry = registry if registry is not None \
             else telemetry.get_registry()
         # feed(key, ops): parsed-op push for a co-located consumer
         self.feed = feed
+        # honest backpressure (doc/robustness.md "Fleet HA"):
+        # disk_headroom_mb > 0 sheds chunks with 429 + Retry-After when
+        # the store's filesystem drops below that free space;
+        # pressure() -> seconds | None is the pool's aggregate-lag hook
+        # (non-None = shed, telling shippers how long to back off)
+        self.disk_headroom_mb = float(disk_headroom_mb or 0.0)
+        self.pressure = pressure
+        # fault_hook(key, body): test seam — called right before the
+        # WAL append so the chaos harness can inject ENOSPC (an OSError
+        # it raises takes the exact same park-and-bounce path a real
+        # disk-full does)
+        self.fault_hook = fault_hook
         self._runs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._httpd = _IngestHTTPServer((host, port),
@@ -130,6 +161,18 @@ class IngestServer:
                         st["offset"] += len(chunk)
             except OSError:
                 pass  # no WAL yet: cursor starts at 0
+            # a receiver restart must also remember the run was sealed:
+            # an installed history.jsonl IS the final (finals-race 409s
+            # survive the restart)
+            fp = self.store_root / key / "history.jsonl"
+            try:
+                final_body = fp.read_bytes()
+            except OSError:
+                final_body = None
+            if final_body is not None:
+                st["final"] = True
+                st["final_sha"] = hashlib.sha256(
+                    final_body).hexdigest()
             self._runs[key] = st
         return st
 
@@ -138,6 +181,33 @@ class IngestServer:
             "fleet_ingest_rejected_total",
             "shipped chunks bounced by resume-token verification",
             labels=("reason",)).inc(reason=reason)
+
+    def _shed(self, reason: str, retry_after_s: float) -> dict:
+        """A 429 verdict: the chunk is bounced un-absorbed (no cursor
+        movement, no disk write) with an honest Retry-After."""
+        self.registry.counter(
+            "fleet_ingest_shed_total",
+            "chunks shed with 429 + Retry-After under pressure",
+            labels=("reason",)).inc(reason=reason)
+        return {"shed": reason, "retry_after": retry_after_s}
+
+    def overload(self):  # -> dict | None
+        """The receiver-wide shed verdict, or None when healthy: disk
+        headroom below the floor, or the pool's aggregate-lag hook
+        asking for backoff. Checked before any per-chunk work."""
+        if self.disk_headroom_mb > 0:
+            free = disk_free_mb(self.store_root)
+            if free is not None and free < self.disk_headroom_mb:
+                return self._shed("headroom", RETRY_AFTER_S)
+        if self.pressure is not None:
+            try:
+                wait = self.pressure()
+            except Exception:  # noqa: BLE001 — a broken hook must not shed
+                logger.exception("fleet ingest: pressure hook failed")
+                wait = None
+            if wait is not None:
+                return self._shed("lag", float(wait))
+        return None
 
     # -- protocol ops (handler threads) ---------------------------------
 
@@ -155,6 +225,21 @@ class IngestServer:
         needs to recover (409 payload)."""
         with self._lock:
             st = self._cursor(key)
+            if st.get("final"):
+                # finals race: once the authoritative history.jsonl is
+                # installed the run's WAL is sealed — a late chunk gets
+                # 409 so the loser knows, and the history stays the one
+                # digest-valid document
+                self._reject("finalized")
+                out = {"offset": st["offset"],
+                       "prefix_sha": st["sha"].hexdigest()}
+                out["reason"] = "finalized"
+                return out
+            parked = st.get("parked_until", 0.0) - time.monotonic()
+            if parked > 0:
+                # ENOSPC park: bounce without touching the disk until
+                # the park lapses (then the append itself re-probes)
+                return self._shed("enospc", parked)
             if reset:
                 if offset != 0:
                     self._reject("bad-reset")
@@ -192,9 +277,34 @@ class IngestServer:
                         "prefix_sha": st["sha"].hexdigest()}
             p = self._wal_path(key)
             p.parent.mkdir(parents=True, exist_ok=True)
-            with open(p, "ab") as f:
-                f.write(body)
-                f.flush()
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(key, body)
+                with open(p, "ab") as f:
+                    f.write(body)
+                    f.flush()
+            except OSError as e:
+                # roll back any partial append so the on-disk WAL still
+                # ends exactly at the advertised cursor — a half-landed
+                # chunk must bounce, never corrupt
+                try:
+                    if p.exists() and p.stat().st_size > st["offset"]:
+                        os.truncate(p, st["offset"])
+                except OSError:
+                    logger.exception("fleet ingest: couldn't roll back "
+                                     "partial append for %s", key)
+                if e.errno == errno.ENOSPC:
+                    # disk full is a weather condition, not a fatal
+                    # fault: park the run and shed honestly; the park's
+                    # lapse re-probes by just trying the next append
+                    st["parked_until"] = time.monotonic() + ENOSPC_PARK_S
+                    logger.warning("fleet ingest: ENOSPC appending %s; "
+                                   "parked %.3gs", key, ENOSPC_PARK_S)
+                    return self._shed("enospc", ENOSPC_PARK_S)
+                logger.exception("fleet ingest: append failed for %s",
+                                 key)
+                return self._shed("io-error", RETRY_AFTER_S)
+            st["parked_until"] = 0.0
             st["sha"] = sha
             st["offset"] += len(body)
             st["bytes"] += len(body)
@@ -243,17 +353,41 @@ class IngestServer:
                                  "for %s", key)
 
     def finalize_run(self, key: str, sha256: str,
-                     body: bytes) -> bool:  # owner: worker
+                     body: bytes) -> str:  # owner: worker
         """Atomically installs the authoritative ``history.jsonl`` —
         the producer's run is over. Digest-checked like every other
-        byte on this wire."""
+        byte on this wire. Returns ``"ok"`` (installed, or an
+        idempotent byte-identical replay), ``"conflict"`` (already
+        finalized with DIFFERENT bytes — the 409 loser of the finals
+        race), ``"bad"`` (digest mismatch), or ``"shed"`` (disk
+        refused; retry later). Serialized under the run lock so a
+        final racing a late chunk resolves deterministically."""
         if hashlib.sha256(body).hexdigest() != sha256:
             self._reject("bad-chunk")
-            return False
-        d = self.store_root / key
-        d.mkdir(parents=True, exist_ok=True)
-        _atomic_write_bytes(d / "history.jsonl", body)
-        return True
+            return "bad"
+        with self._lock:
+            st = self._cursor(key)
+            if st.get("final"):
+                if st.get("final_sha") == sha256:
+                    return "ok"  # idempotent re-send of the same final
+                self._reject("finalized")
+                return "conflict"
+            d = self.store_root / key
+            try:
+                d.mkdir(parents=True, exist_ok=True)
+                _atomic_write_bytes(d / "history.jsonl", body)
+            except OSError as e:
+                if e.errno == errno.ENOSPC:
+                    st["parked_until"] = (time.monotonic()
+                                          + ENOSPC_PARK_S)
+                    self._shed("enospc", ENOSPC_PARK_S)
+                    return "shed"
+                logger.exception("fleet ingest: final install failed "
+                                 "for %s", key)
+                return "shed"
+            st["final"] = True
+            st["final_sha"] = sha256
+            return "ok"
 
     def ingest_stats(self) -> dict:
         """(bytes-by-run, total) snapshot for the status plane."""
@@ -343,24 +477,46 @@ class IngestServer:
                     except ValueError:
                         self._send(400)
                         return
-                    current = server.append_chunk(
-                        key, offset,
-                        h.get("X-Jepsen-Prefix-Sha", ""),
-                        h.get("X-Jepsen-Chunk-Sha", ""), body,
-                        reset=h.get("X-Jepsen-Reset") == "1")
+                    current = server.overload()
+                    if current is None:
+                        current = server.append_chunk(
+                            key, offset,
+                            h.get("X-Jepsen-Prefix-Sha", ""),
+                            h.get("X-Jepsen-Chunk-Sha", ""), body,
+                            reset=h.get("X-Jepsen-Reset") == "1")
                     if current is None:
                         self._send(204)
+                    elif "shed" in current:
+                        self._send_retry_after(current)
                     else:
                         self._send(409,
                                    json.dumps(current).encode())
                 elif self.path.startswith("/final/"):
-                    if server.finalize_run(
-                            key, h.get("X-Jepsen-Sha256", ""), body):
+                    got = server.finalize_run(
+                        key, h.get("X-Jepsen-Sha256", ""), body)
+                    if got == "ok":
                         self._send(204)
+                    elif got == "conflict":
+                        self._send(409, json.dumps(
+                            {"reason": "finalized"}).encode())
+                    elif got == "shed":
+                        self._send_retry_after(
+                            {"shed": "enospc",
+                             "retry_after": RETRY_AFTER_S})
                     else:
                         self._send(400)
                 else:
                     self._send(404)
+
+            def _send_retry_after(self, verdict: dict) -> None:
+                body = json.dumps(verdict).encode()
+                self.send_response(429)
+                self.send_header("Retry-After", "%.3f" % max(
+                    0.0, float(verdict.get("retry_after", 0.0))))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
         return Handler
 

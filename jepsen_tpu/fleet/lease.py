@@ -1,0 +1,211 @@
+"""Leased checking with fencing: at most one pool host per run.
+
+The fleet plane's pool can hold many checker hosts over one shared
+ingest store. Without coordination two hosts would both admit the same
+run, burn double the accelerator time, and race each other's
+``live-session.ckpt`` / ``live-status.json`` writes. This module is the
+coordination: a per-run **lease file** (``check.lease`` next to the
+run's WAL) that a host must hold before checking, written with the same
+tmp+flush+fsync+rename discipline as every other durable artifact.
+
+Lease schema (one JSON document)::
+
+    {"version": 1, "host": "<host-id>", "epoch": 7,
+     "acquired_at": <wall>, "renewed_at": <wall>, "ttl_s": 10.0}
+
+* **epoch** — monotonically increasing takeover counter. Every claim of
+  a free or expired lease bumps it; renewal by the holder keeps it. The
+  epoch is the fencing token: a host checkpoints and publishes status
+  only while the on-disk lease still names *its* ``(host, epoch)``.
+* **TTL + heartbeat** — the holder renews every poll; a lease whose
+  ``renewed_at + ttl_s`` is in the past is up for adoption. A SIGKILLed
+  or partitioned checker therefore blocks its runs for at most one TTL.
+* **fencing** — :meth:`LeaseStore.guard` re-reads the lease immediately
+  before every durable write (restart snapshot, live-status, check
+  checkpoint, final publication). A host that lost its lease — paused
+  past the TTL, partitioned from the store — sees a foreign or newer
+  epoch, drops the write, and abandons the tracker. Its stale state can
+  never overwrite the adopter's progress, so a run converges to exactly
+  one final verdict even across a kill/partition/un-pause of its
+  checker (doc/robustness.md "Fleet HA").
+
+Claims are last-writer-wins on ``os.replace`` with a read-back verify:
+two hosts racing for an expired lease both write, but the read-back
+elects exactly one winner and the loser reports the claim as failed.
+The guard re-read before every durable write bounds any residual
+overlap to in-memory work — wasted CPU, never a conflicting artifact.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import time
+from pathlib import Path
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.utils import atomic_write_json
+
+logger = logging.getLogger(__name__)
+
+LEASE_NAME = "check.lease"
+LEASE_VERSION = 1
+DEFAULT_LEASE_TTL_S = 10.0
+
+
+def default_host_id() -> str:
+    """A host identity unique per checker process: a pool is typically
+    one process per host, but two processes on one box must still fence
+    each other."""
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+class LeaseStore:  # durability: fsync (via utils.atomic_write_json)
+    """Per-run lease files under a store root, for one host identity.
+
+    Touched only by the owning daemon's scheduler poll thread; cross-
+    *host* mutual exclusion is the lease protocol itself (fsync-atomic
+    writes + read-back + fencing re-reads), not an in-process lock."""
+
+    def __init__(self, store_root, host_id: str | None = None,
+                 ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 registry: telemetry.Registry | None = None,
+                 time_fn=time.time):
+        self.store_root = Path(store_root)
+        self.host_id = host_id if host_id else default_host_id()
+        self.ttl_s = float(ttl_s)
+        self.registry = registry if registry is not None \
+            else telemetry.get_registry()
+        # wall time, not monotonic: expiry is compared across hosts
+        self._time = time_fn
+        # run-dir-str -> epoch we hold (our own view; the file decides)
+        self.held: dict[str, int] = {}
+
+    # -- file plumbing ----------------------------------------------------
+
+    def lease_path(self, run_dir) -> Path:
+        return Path(run_dir) / LEASE_NAME
+
+    def read(self, run_dir) -> dict | None:
+        """The on-disk lease document, or None (missing/torn)."""
+        try:
+            with open(self.lease_path(run_dir), encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) \
+            and doc.get("version") == LEASE_VERSION else None
+
+    def _expired(self, doc: dict) -> bool:
+        try:
+            horizon = float(doc.get("renewed_at", 0)) \
+                + float(doc.get("ttl_s", self.ttl_s))
+        except (TypeError, ValueError):
+            return True  # a garbled lease never blocks adoption
+        return self._time() > horizon
+
+    def _write(self, run_dir, epoch: int, acquired_at: float) -> bool:
+        doc = {"version": LEASE_VERSION, "host": self.host_id,
+               "epoch": int(epoch), "acquired_at": acquired_at,
+               "renewed_at": self._time(), "ttl_s": self.ttl_s}
+        try:
+            atomic_write_json(self.lease_path(run_dir), doc)
+        except OSError:
+            logger.exception("lease write failed for %s", run_dir)
+            return False
+        return True
+
+    # -- protocol ---------------------------------------------------------
+
+    def acquire(self, run_dir) -> int | None:
+        """Claims the run; returns the fencing epoch, or None when
+        another live holder owns it (or the claim raced and lost)."""
+        cur = self.read(run_dir)
+        now = self._time()
+        if cur is not None and cur.get("host") == self.host_id \
+                and not self._expired(cur):
+            # already ours: a renewal, not a takeover
+            epoch = int(cur.get("epoch", 0))
+            if self._write(run_dir, epoch,
+                           float(cur.get("acquired_at", now))):
+                self.held[str(run_dir)] = epoch
+                return epoch
+            return None
+        if cur is not None and not self._expired(cur):
+            return None  # a live foreign holder
+        epoch = int(cur.get("epoch", 0)) + 1 if cur is not None else 1
+        if not self._write(run_dir, epoch, now):
+            return None
+        # read-back verify: last-writer-wins elects exactly one claimant
+        back = self.read(run_dir)
+        if back is None or back.get("host") != self.host_id \
+                or int(back.get("epoch", -1)) != epoch:
+            logger.info("lease claim for %s lost the race to %r",
+                        run_dir, back and back.get("host"))
+            return None
+        self.held[str(run_dir)] = epoch
+        self.registry.counter(
+            "fleet_lease_acquired_total",
+            "run leases claimed by this pool host (first claims and "
+            "takeovers of expired leases)").inc()
+        return epoch
+
+    def renew(self, run_dir, epoch: int) -> bool:
+        """Heartbeat: pushes ``renewed_at`` forward while the on-disk
+        lease still names our ``(host, epoch)``. False = lease lost —
+        the caller must fence itself and abandon the run."""
+        cur = self.read(run_dir)
+        if cur is None or cur.get("host") != self.host_id \
+                or int(cur.get("epoch", -1)) != int(epoch):
+            self._lost(run_dir)
+            return False
+        if self._expired(cur):
+            # expired but not yet adopted: renewing would resurrect a
+            # lease another host may be mid-claim on — treat as lost
+            self._lost(run_dir)
+            return False
+        if not self._write(run_dir, int(epoch),
+                           float(cur.get("acquired_at", self._time()))):
+            return False
+        self.registry.counter(
+            "fleet_lease_renewals_total",
+            "lease heartbeat renewals by the holding host").inc()
+        return True
+
+    def guard(self, run_dir, epoch: int) -> bool:
+        """The fencing check: may a write stamped ``epoch`` proceed?
+        Re-reads the lease; a foreign or newer epoch means this host
+        was deposed and the write must be dropped."""
+        cur = self.read(run_dir)
+        ok = (cur is not None and cur.get("host") == self.host_id
+              and int(cur.get("epoch", -1)) == int(epoch))
+        if not ok:
+            self.registry.counter(
+                "fleet_lease_fenced_writes_total",
+                "durable writes rejected because the writer's lease "
+                "epoch went stale (the host was deposed)").inc()
+        return ok
+
+    def release(self, run_dir, epoch: int) -> None:
+        """Drops the lease (run finalized / daemon shutting down) —
+        only when still ours at ``epoch``; a deposed host must not
+        unlink its successor's lease."""
+        self.held.pop(str(run_dir), None)
+        cur = self.read(run_dir)
+        if cur is None or cur.get("host") != self.host_id \
+                or int(cur.get("epoch", -1)) != int(epoch):
+            return
+        try:
+            self.lease_path(run_dir).unlink(missing_ok=True)
+        except OSError:
+            logger.exception("couldn't release lease for %s", run_dir)
+
+    def _lost(self, run_dir) -> None:
+        if self.held.pop(str(run_dir), None) is not None:
+            self.registry.counter(
+                "fleet_lease_lost_total",
+                "leases this host held and lost (TTL expiry while "
+                "paused/partitioned, or a takeover)").inc()
+            logger.warning("lease lost for %s; fencing and abandoning "
+                           "the run", run_dir)

@@ -17,61 +17,141 @@ plane"):
    the receiver holds (mid-file rewrite, a new run reusing the dir) —
    the only honest move is an explicit offset-0 ``X-Jepsen-Reset`` and
    a full re-ship. Divergence costs a re-send, never a wrong byte.
+
+HA legs (doc/robustness.md "Fleet HA"): the shipper takes a **list**
+of receiver endpoints and fails over to the next on every unreachable
+exchange — the prefix-sha resume token makes cross-receiver replay
+safe by construction (the new receiver's cursor says exactly what it
+holds; the ladder above does the rest). While every endpoint is down,
+retries ride :func:`jepsen_tpu.utils.backoff_delay` — capped
+exponential full jitter, so a rebooting receiver isn't met by a
+thundering herd of fixed-cadence shippers. A 429 + Retry-After (the
+receiver shedding load honestly) is obeyed verbatim. Every re-sync is
+counted (``fleet_ship_resyncs_total{reason}``) so a flapping receiver
+is visible in the metrics, not silent.
 """
 from __future__ import annotations
 
 import hashlib
 import json
 import logging
+import random
 import time
 import urllib.error
 import urllib.request
 from pathlib import Path
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.journal import WAL_NAME, WalTailer
+from jepsen_tpu.utils import backoff_delay
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_POLL_S = 0.2
 HTTP_TIMEOUT_S = 10.0
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 5.0
 
 _EMPTY_SHA = hashlib.sha256().hexdigest()
 
 
 class Shipper:
-    """Ships one run dir's WAL to an ingest receiver."""
+    """Ships one run dir's WAL to an ingest receiver (or a failover
+    list of them)."""
 
-    def __init__(self, run_dir, base_url: str,
-                 poll_s: float = DEFAULT_POLL_S):
+    def __init__(self, run_dir, base_url, poll_s: float = DEFAULT_POLL_S,
+                 registry: telemetry.Registry | None = None,
+                 rng: random.Random | None = None):
         self.run_dir = Path(run_dir)
-        self.base = base_url.rstrip("/")
+        if isinstance(base_url, str):
+            bases = [base_url]
+        else:
+            bases = list(base_url)
+        if not bases:
+            raise ValueError("Shipper needs at least one receiver URL")
+        self.bases = [b.rstrip("/") for b in bases]
+        self._base_i = 0
         self.key = (self.run_dir.parent.name + "/" + self.run_dir.name)
         self.poll_s = poll_s
+        self.registry = registry if registry is not None \
+            else telemetry.get_registry()
+        # rng: seeds the backoff jitter for deterministic tests
+        self.rng = rng
         self.tailer = WalTailer(self.run_dir / WAL_NAME)
         self.chunks_sent = 0
         self.bytes_sent = 0
         self.resets = 0
+        self.failovers = 0
         self.finalized = False
+        self.sealed = False  # receiver says the run is already final
+        # consecutive unreachable/shed exchanges: the backoff ladder's
+        # rung, reset to 0 by any successful exchange
+        self._attempt = 0
+        # monotonic deadline a 429's Retry-After told us to wait until
+        self._retry_at = 0.0
+
+    @property
+    def base(self) -> str:
+        return self.bases[self._base_i]
+
+    def _resync(self, reason: str) -> None:
+        self.registry.counter(
+            "fleet_ship_resyncs_total",
+            "shipper cursor re-syncs, by cause (failover, 409 "
+            "recovery, divergence reset, shed backoff)",
+            labels=("reason",)).inc(reason=reason)
 
     # -- wire -----------------------------------------------------------
 
     def _request(self, method: str, path: str, body: bytes = b"",
                  headers: dict | None = None):  # blocking: rpc
-        """One HTTP exchange; returns (status, body) or None when the
-        receiver is unreachable (the caller's loop retries)."""
+        """One HTTP exchange against the current endpoint; returns
+        (status, body, headers) or None when it is unreachable (the
+        caller fails over / backs off)."""
         req = urllib.request.Request(self.base + path, data=body,
                                      headers=headers or {},
                                      method=method)
         try:
             with urllib.request.urlopen(
                     req, timeout=HTTP_TIMEOUT_S) as resp:
-                return resp.status, resp.read()
+                return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
-            return e.code, e.read()
+            return e.code, e.read(), dict(e.headers or {})
         except (urllib.error.URLError, OSError, TimeoutError) as e:
-            logger.warning("ship %s: receiver unreachable (%s)",
-                           self.key, e)
+            logger.warning("ship %s: receiver %s unreachable (%s)",
+                           self.key, self.base, e)
             return None
+
+    def _failover(self) -> None:
+        """Rotates to the next receiver endpoint (no-op with one). The
+        resume-token handshake on the next exchange re-syncs the cursor
+        against whatever the new receiver actually holds."""
+        if len(self.bases) > 1:
+            self._base_i = (self._base_i + 1) % len(self.bases)
+            self.failovers += 1
+            logger.warning("ship %s: failing over to %s", self.key,
+                           self.base)
+        self._resync("failover")
+
+    def _on_shed(self, resp_body: bytes, headers: dict) -> None:
+        """Obeys a 429's Retry-After verbatim: the receiver is shedding
+        honestly and told us exactly when to come back."""
+        wait = None
+        try:
+            wait = float(headers.get("Retry-After", ""))
+        except (TypeError, ValueError):
+            try:
+                wait = float(json.loads(resp_body).get("retry_after"))
+            except (TypeError, ValueError):
+                pass
+        if wait is None or wait < 0:
+            wait = backoff_delay(self._attempt, BACKOFF_BASE_S,
+                                 BACKOFF_CAP_S, self.rng)
+        self._retry_at = time.monotonic() + wait
+        self._attempt += 1
+        self._resync("shed")
+        logger.info("ship %s: receiver shedding; retrying in %.3gs",
+                    self.key, wait)
 
     # -- recovery ladder ------------------------------------------------
 
@@ -79,6 +159,12 @@ class Shipper:
         """Repositions at the receiver's token, or resets the receiver
         to 0 when the local WAL diverged from what it holds. Returns
         False only when the receiver is unreachable."""
+        if token.get("reason") == "finalized":
+            # the receiver already holds the authoritative history for
+            # this run (a finals race we lost, or a re-ship of a done
+            # run): the WAL is sealed, nothing left to ship
+            self.sealed = True
+            return True
         fresh = WalTailer(self.run_dir / WAL_NAME)
         offset = int(token.get("offset", 0))
         if offset > 0 and fresh.seek(
@@ -86,6 +172,13 @@ class Shipper:
             logger.info("ship %s: resumed at receiver offset %d",
                         self.key, offset)
             self.tailer = fresh
+            self._resync("recover")
+            return True
+        if offset == 0:
+            # the receiver holds nothing (a failover target's fresh
+            # store): just restart the local cursor, no reset needed
+            self.tailer = fresh
+            self._resync("recover")
             return True
         # local prefix doesn't hash to what the receiver absorbed:
         # re-ingest from zero, explicitly
@@ -98,6 +191,7 @@ class Shipper:
         if got is None:
             return False
         self.resets += 1
+        self._resync("reset")
         self.tailer = WalTailer(self.run_dir / WAL_NAME)
         logger.warning("ship %s: local WAL diverged from receiver; "
                        "reset and re-shipping from 0", self.key)
@@ -111,14 +205,18 @@ class Shipper:
             return False
         token = json.loads(got[1])
         if int(token.get("offset", 0)) == 0:
-            return True  # both sides at zero already
+            self.tailer = WalTailer(self.run_dir / WAL_NAME)
+            return True  # receiver at zero: ship from the top
         return self._recover(token)
 
     # -- shipping -------------------------------------------------------
 
     def step(self) -> int:
         """Ships one WAL poll's worth of complete lines. Returns bytes
-        shipped (0: nothing new, or receiver unreachable)."""
+        shipped (0: nothing new, receiver unreachable/shedding, or the
+        run is sealed)."""
+        if self.sealed or time.monotonic() < self._retry_at:
+            return 0
         pre_off = self.tailer.offset
         pre_sha = self.tailer.prefix_sha()
         body = self.tailer.poll_bytes()
@@ -130,16 +228,27 @@ class Shipper:
                      "X-Jepsen-Prefix-Sha": pre_sha,
                      "X-Jepsen-Chunk-Sha": self.tailer.prefix_sha()})
         if got is None:
-            # undo nothing: the tailer advanced, but recovery re-syncs
-            # it from the receiver's token on the next step
+            # the tailer advanced past bytes the receiver never saw:
+            # fail over, and re-sync from the (new) receiver's token
+            self._attempt += 1
+            self._failover()
             self.tailer = WalTailer(self.run_dir / WAL_NAME)
             self.sync()
             return 0
-        status, resp = got
+        status, resp, headers = got
         if status == 204:
+            self._attempt = 0
             self.chunks_sent += 1
             self.bytes_sent += len(body)
             return len(body)
+        if status == 429:
+            # un-absorbed: rewind to re-poll the same bytes later
+            self._on_shed(resp, headers)
+            fresh = WalTailer(self.run_dir / WAL_NAME)
+            if not fresh.seek(pre_off, prefix_sha=pre_sha):
+                fresh = WalTailer(self.run_dir / WAL_NAME)
+            self.tailer = fresh
+            return 0
         if status == 409:
             try:
                 token = json.loads(resp)
@@ -164,10 +273,33 @@ class Shipper:
             "POST", "/final/" + self.key, body=body,
             headers={"X-Jepsen-Sha256":
                      hashlib.sha256(body).hexdigest()})
-        if got is not None and got[0] == 204:
+        if got is None:
+            self._attempt += 1
+            self._failover()
+            return False
+        status, resp, headers = got
+        if status == 204:
             self.finalized = True
             return True
+        if status == 429:
+            self._on_shed(resp, headers)
+        elif status == 409:
+            # finals race lost: someone else's (byte-different) final
+            # is installed — ours will never land, stop trying
+            self.sealed = True
+            logger.warning("ship %s: final conflicts with an installed "
+                           "history; receiver's wins", self.key)
         return False
+
+    def _idle_delay(self) -> float:
+        """The loop's sleep: poll cadence when healthy, the jittered
+        backoff ladder while the receiver is unreachable or shedding."""
+        if self._attempt == 0:
+            return self.poll_s
+        wait = backoff_delay(self._attempt - 1, BACKOFF_BASE_S,
+                             BACKOFF_CAP_S, self.rng)
+        until_retry = self._retry_at - time.monotonic()
+        return max(wait, until_retry, 0.0)
 
     def run(self, timeout_s: float = 300.0) -> bool:
         """Ships until the run completes (history.jsonl shipped) or the
@@ -178,12 +310,15 @@ class Shipper:
             shipped = self.step()
             if shipped:
                 continue  # drain hot WALs without sleeping
+            if self.sealed:
+                return True
             if self._final_path().exists():
                 # run is over; one last drain for the WAL tail, then
                 # ship the authoritative history
                 while self.step():
                     pass
-                if self.finalize():
+                if self.finalize() or self.sealed:
                     return True
-            time.sleep(self.poll_s)
+            time.sleep(min(self._idle_delay(),
+                           max(0.0, deadline - time.monotonic())))
         return False

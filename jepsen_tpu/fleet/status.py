@@ -78,9 +78,16 @@ class FleetStatus:
             "links": links,
         }
 
-    def write(self, statuses: dict, ingest_by_run: dict) -> dict:
+    def write(self, statuses: dict, ingest_by_run: dict,
+              ha: dict | None = None) -> dict:
         """Aggregates this poll's per-run statuses + ingest cursors and
-        atomically publishes fleet-status.json; returns the payload."""
+        atomically publishes fleet-status.json; returns the payload.
+        ``ha`` is the scheduler's HA view (host id, leasing state) —
+        counter totals for the lease/shed/degraded story ride along so
+        the dashboard reads one file. A failed publish sets
+        ``degraded_write`` in the returned payload instead of raising:
+        status is a non-verdict surface (doc/robustness.md
+        "Fleet HA")."""
         self.polls += 1
         snap = self.registry.snapshot()
         now = time.monotonic()
@@ -129,7 +136,20 @@ class FleetStatus:
                     snap, "fleet_ingest_chunks_total"),
                 "rejected_total": _counter_total(
                     snap, "fleet_ingest_rejected_total"),
+                "shed_total": _counter_total(
+                    snap, "fleet_ingest_shed_total"),
                 "runs": len(ingest_by_run),
+            },
+            "ha": {
+                **(ha or {}),
+                "lease_acquired": _counter_total(
+                    snap, "fleet_lease_acquired_total"),
+                "lease_lost": _counter_total(
+                    snap, "fleet_lease_lost_total"),
+                "fenced_writes": _counter_total(
+                    snap, "fleet_lease_fenced_writes_total"),
+                "degraded_total": _counter_total(
+                    snap, "fleet_degraded_total"),
             },
             "top_runs": [self._run_row(k, st) for k, st in ranked],
         }
@@ -139,6 +159,7 @@ class FleetStatus:
                 json.dumps(payload, indent=1))
         except OSError:
             logger.exception("fleet-status.json write failed")
+            payload["degraded_write"] = True
         return payload
 
 

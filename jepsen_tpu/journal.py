@@ -22,9 +22,16 @@ partial JSON document on the last line, which is dropped rather than
 raising ``json.JSONDecodeError``. ``cli analyze --recover`` rebuilds a
 checkable history from the journal of a crashed run
 (doc/robustness.md).
+``ENOSPC`` is the one write failure treated as transient rather than
+fatal: a full disk usually drains (log rotation, a neighbour's cleanup,
+an operator), so the journal **parks** the failed lines in a bounded
+in-memory buffer and retries them on the next append instead of
+permanently self-disabling the way a generically dying disk does
+(doc/robustness.md "Fleet HA").
 """
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import logging
@@ -38,6 +45,10 @@ logger = logging.getLogger("jepsen.journal")
 WAL_NAME = "history.wal.jsonl"
 LATE_NAME = "late.jsonl"
 DEFAULT_FSYNC_INTERVAL_S = 1.0
+# lines held in memory while the disk is full; older lines drop first
+# once exceeded (counted in Journal.parked_dropped) — bounding memory
+# matters more than completeness once ENOSPC persists
+ENOSPC_PARK_MAX_LINES = 10_000
 
 
 class Journal:  # durability: fsync
@@ -50,51 +61,148 @@ class Journal:  # durability: fsync
     def __init__(self, path, fsync_interval_s: float = DEFAULT_FSYNC_INTERVAL_S):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._f = open(self.path, "w", encoding="utf-8")
+        # binary mode: byte-exact offsets make the ENOSPC rollback in
+        # _write_locked possible (truncate back to the last good line
+        # boundary, so a retried park can't duplicate bytes a failed
+        # flush partially landed)
+        self._f = open(self.path, "wb")
         self.fsync_interval_s = fsync_interval_s
         self._last_fsync = time.monotonic()
         self._lock = threading.Lock()
         self.appended = 0
+        # ENOSPC park state: lines waiting for the disk to drain, the
+        # byte offset of the last fully-flushed line boundary, and
+        # whether the tail may still hold a partial line (only when the
+        # rollback truncate itself failed — terminated with a bare
+        # newline on resume; the tolerant readers skip torn lines)
+        self.parked: list[bytes] = []
+        self.parked_dropped = 0
+        self._good_offset = 0
+        self._parked_closed = False
+        self._dirty_tail = False
+        self._park_logged = False
+
+    def _park(self, parts: list[bytes]) -> None:
+        """Holds lines in the bounded in-memory buffer while the disk
+        is full; oldest lines drop first past the cap."""
+        keep = self.parked + parts
+        overflow = len(keep) - ENOSPC_PARK_MAX_LINES
+        if overflow > 0:
+            self.parked_dropped += overflow
+            keep = keep[overflow:]
+        self.parked = keep
+        if not self._park_logged:
+            self._park_logged = True
+            logger.warning(
+                "WAL %s hit ENOSPC; parking lines in memory (bounded "
+                "at %d) until the disk drains", self.path,
+                ENOSPC_PARK_MAX_LINES)
+
+    def _write_locked(self, parts: list[bytes]) -> bool:
+        """Writes ``parts`` — plus any ENOSPC-parked backlog — under
+        the caller's lock. Returns True on success; False when the disk
+        is (still) full and the lines were parked for the next append;
+        re-raises any other OSError for the caller's permanent-disable
+        path."""
+        if self._f.closed:
+            # the previous ENOSPC dropped the handle (with its
+            # un-flushable buffer); reopen at the rolled-back tail
+            try:
+                self._f = open(self.path, "ab")
+            except OSError as e:
+                if e.errno != errno.ENOSPC:
+                    raise
+                self._park(parts)
+                return False
+            self._parked_closed = False
+        pending = self.parked + parts
+        if self._dirty_tail:
+            # rollback couldn't truncate the partial line a failed
+            # flush landed: a bare newline terminates it into a torn
+            # line the tolerant readers already skip, instead of
+            # gluing the retry onto it
+            pending = [b"\n"] + pending
+        try:
+            # fsync rides the interval in _fsync_locked, invoked by the
+            # append/append_many callers right after a successful write
+            self._f.write(b"".join(pending))  # lint: ignore[fsync-pairing]
+            self._f.flush()
+        except OSError as e:
+            if e.errno != errno.ENOSPC:
+                raise
+            # disk full is transient in a way a dying disk isn't. Drop
+            # the handle — close() discards the un-flushable buffer so
+            # a retry can't double-write it — and roll the OS file back
+            # to the last good line boundary so partially-landed bytes
+            # can't duplicate either; then park the batch for the next
+            # append.
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._parked_closed = True
+            try:
+                if self.path.stat().st_size > self._good_offset:
+                    os.truncate(self.path, self._good_offset)
+                self._dirty_tail = False
+            except OSError:
+                self._dirty_tail = True
+            self._park(parts)
+            return False
+        self.appended += len(self.parked) + len(parts)
+        self._good_offset += sum(len(p) for p in pending)
+        self.parked = []
+        self._dirty_tail = False
+        if self._park_logged:
+            self._park_logged = False
+            logger.info("WAL %s recovered from ENOSPC; parked lines "
+                        "flushed (%d dropped while full)", self.path,
+                        self.parked_dropped)
+        return True
+
+    def _fsync_locked(self) -> None:
+        """Interval fsync under the caller's lock (the durability
+        boundary — everything before this instant survives power
+        loss)."""
+        interval = self.fsync_interval_s
+        if interval is None or interval < 0:
+            return
+        now = time.monotonic()
+        if interval == 0 or now - self._last_fsync >= interval:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._last_fsync = now
+            # causal trace: the durability boundary is an event worth
+            # seeing next to the op slices (per-append emission would
+            # double the hot path; the op itself is already traceable
+            # via its derivable trace id)
+            from jepsen_tpu import trace as trace_mod
+            tracer = trace_mod.get_tracer()
+            if tracer.enabled:
+                tracer.instant(trace_mod.TRACK_WAL, "wal-fsync",
+                               args={"appended": self.appended})
 
     def append(self, op: dict) -> None:
         """Writes one op as a JSON line, flushed to the OS immediately
         (SIGKILL-safe) and fsynced on the configured interval
         (power-loss-safe). Failures — unserializable op, disk full —
         are logged, never raised: the journal must not take down the
-        run it protects. A dying WAL (OSError) closes itself; the run
+        run it protects. ``ENOSPC`` parks the line for a retry on the
+        next append; any other OSError closes the journal and the run
         continues with the in-memory history, exactly the pre-WAL
         behavior."""
         from jepsen_tpu.store import _serializable
         try:
-            line = json.dumps(_serializable(op)) + "\n"
+            line = (json.dumps(_serializable(op)) + "\n").encode("utf-8")
         except Exception:  # noqa: BLE001 — journaling never kills a run
             logger.exception("unserializable op dropped from WAL")
             return
         with self._lock:
-            if self._f.closed:
+            if self._f.closed and not self._parked_closed:
                 return
             try:
-                self._f.write(line)
-                self._f.flush()
-                self.appended += 1
-                interval = self.fsync_interval_s
-                if interval is not None and interval >= 0:
-                    now = time.monotonic()
-                    if interval == 0 or now - self._last_fsync >= interval:
-                        os.fsync(self._f.fileno())
-                        self._last_fsync = now
-                        # causal trace: the durability boundary is an
-                        # event worth seeing next to the op slices —
-                        # everything before this instant survives power
-                        # loss (per-append emission would double the
-                        # hot path; the op itself is already traceable
-                        # via its derivable trace id)
-                        from jepsen_tpu import trace as trace_mod
-                        tracer = trace_mod.get_tracer()
-                        if tracer.enabled:
-                            tracer.instant(
-                                trace_mod.TRACK_WAL, "wal-fsync",
-                                args={"appended": self.appended})
+                if self._write_locked([line]):
+                    self._fsync_locked()
             except OSError:
                 logger.exception("WAL write failed; journaling off for "
                                  "the rest of the run")
@@ -115,33 +223,21 @@ class Journal:  # durability: fsync
         so the WAL bytes are identical to per-op appends of the same
         sequence."""
         from jepsen_tpu.store import _serializable
-        parts: list[str] = []
+        parts: list[bytes] = []
         for op in ops:
             try:
-                parts.append(json.dumps(_serializable(op)) + "\n")
+                parts.append(
+                    (json.dumps(_serializable(op)) + "\n").encode("utf-8"))
             except Exception:  # noqa: BLE001 — journaling never kills a run
                 logger.exception("unserializable op dropped from WAL")
         if not parts:
             return
         with self._lock:
-            if self._f.closed:
+            if self._f.closed and not self._parked_closed:
                 return
             try:
-                self._f.write("".join(parts))
-                self._f.flush()
-                self.appended += len(parts)
-                interval = self.fsync_interval_s
-                if interval is not None and interval >= 0:
-                    now = time.monotonic()
-                    if interval == 0 or now - self._last_fsync >= interval:
-                        os.fsync(self._f.fileno())
-                        self._last_fsync = now
-                        from jepsen_tpu import trace as trace_mod
-                        tracer = trace_mod.get_tracer()
-                        if tracer.enabled:
-                            tracer.instant(
-                                trace_mod.TRACK_WAL, "wal-fsync",
-                                args={"appended": self.appended})
+                if self._write_locked(parts):
+                    self._fsync_locked()
             except OSError:
                 logger.exception("WAL write failed; journaling off for "
                                  "the rest of the run")
@@ -152,10 +248,18 @@ class Journal:  # durability: fsync
 
     def sync(self) -> None:
         with self._lock:
-            if not self._f.closed:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-                self._last_fsync = time.monotonic()
+            if self._f.closed and not self._parked_closed:
+                return
+            if self.parked or self._parked_closed:
+                try:
+                    if not self._write_locked([]):
+                        return  # still full: nothing new to make durable
+                except OSError:
+                    logger.exception("WAL sync flush failed")
+                    return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._last_fsync = time.monotonic()
 
     def close(self, discard: bool = False) -> None:
         """Flushes and closes; ``discard=True`` additionally unlinks the
@@ -163,13 +267,20 @@ class Journal:  # durability: fsync
         persisted the authoritative ``history.jsonl`` (a surviving WAL
         without a history.jsonl next to it marks a crashed run)."""
         with self._lock:
-            if not self._f.closed:
+            if not self._f.closed or self._parked_closed:
                 try:
-                    self._f.flush()
-                    os.fsync(self._f.fileno())
+                    if self.parked or self._parked_closed:
+                        self._write_locked([])  # last ENOSPC-drain try
+                    if not self._f.closed:
+                        self._f.flush()
+                        os.fsync(self._f.fileno())
                 except OSError:
                     logger.exception("WAL final fsync failed")
-                self._f.close()
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._parked_closed = False
         if discard:
             try:
                 self.path.unlink(missing_ok=True)

@@ -114,11 +114,22 @@ class RunTracker:  # durability: fsync
     through atomic tmp+fsync+rename writers only — the
     ``durability-protocol`` lint rule holds this class to it."""
 
-    def __init__(self, run_dir, accelerator: str = "auto"):
+    def __init__(self, run_dir, accelerator: str = "auto",
+                 fence=None, lease: dict | None = None):
         self.run_dir = Path(run_dir)
         self.name = self.run_dir.parent.name
         self.timestamp = self.run_dir.name
         self.accelerator = accelerator
+        # fence() -> bool: re-checks the caller's run lease immediately
+        # before every durable write (doc/robustness.md "Fleet HA"). A
+        # False verdict drops the write and marks the tracker fenced —
+        # a deposed checker's stale state must never overwrite its
+        # adopter's progress. None (single-host live mode) never fences.
+        self.fence = fence
+        self.fenced = False
+        # {"host", "epoch"} when leased: stamped into every status this
+        # tracker publishes, so artifacts record which holder wrote them
+        self.lease = lease
         self.tailer = WalTailer(self.run_dir / WAL_NAME)
         self.session = None
         self._sniff_buf: list[dict] = []
@@ -204,7 +215,7 @@ class RunTracker:  # durability: fsync
         something new was absorbed. Unsnapshotable sessions (Elle's
         retained-history state) skip — their restart path is the
         re-ingest."""
-        if self.final or self.broken:
+        if self.final or self.broken or self.fenced:
             return False
         if self.session is None and not self.unsupported:
             return False  # still sniffing: the buffer isn't durable
@@ -230,6 +241,10 @@ class RunTracker:  # durability: fsync
             "last_verdict": dict(self.last_verdict),
             "wrote_at": time.time(),
         }
+        if self.fence is not None and not self.fence():
+            # deposed mid-check: the adopter owns this snapshot now
+            self.fenced = True
+            return False
         try:
             from jepsen_tpu.utils import atomic_write_json
             atomic_write_json(self._ckpt_path, payload)
@@ -397,11 +412,16 @@ class RunTracker:  # durability: fsync
         }
         if self.broken:
             out["error"] = self.broken
+        if self.lease is not None:
+            out["lease"] = dict(self.lease)
         if results is not None:
             out["results"] = results
         return out
 
     def write_status(self, status: dict) -> None:
+        if self.fence is not None and not self.fence():
+            self.fenced = True
+            return
         try:
             telemetry._atomic_write(
                 self.run_dir / LIVE_STATUS_NAME,
@@ -422,7 +442,7 @@ class LiveDaemon:
                  check_budget_s=DEFAULT_CHECK_BUDGET_S,
                  accelerator: str = "auto",
                  registry: telemetry.Registry | None = None,
-                 cost_model=None, on_final=None):
+                 cost_model=None, on_final=None, lease_store=None):
         self.store_root = Path(store_root) if store_root else None
         self.run_dirs = [Path(d) for d in run_dirs]
         self.poll_s = coerce_knob("live_poll_s", poll_s,
@@ -449,6 +469,13 @@ class LiveDaemon:
         # coverage collection) can read per-run session state. A
         # raising hook is logged, never fatal to the poll.
         self.on_final = on_final
+        # lease_store (fleet.lease.LeaseStore | None): multi-host pool
+        # coordination. When set, a run is only admitted after its lease
+        # is claimed, the lease is heartbeat-renewed every poll, and all
+        # durable writes are fenced on the claim epoch. None = the
+        # single-host live mode, byte-identical to the pre-lease path.
+        self.lease_store = lease_store
+        self._lease_epochs: dict[str, int] = {}
         self.trackers: dict[str, RunTracker] = {}
         self.polls = 0
         self.run_series_topk = int(coerce_knob(
@@ -561,18 +588,35 @@ class LiveDaemon:
                     "runs not admitted because live_max_runs "
                     "trackers are active").inc()
                 break
+            fence, lease, epoch = None, None, None
+            if self.lease_store is not None:
+                epoch = self.lease_store.acquire(d)
+                if epoch is None:
+                    # a live foreign holder: their run, not ours — the
+                    # mtime fast-path must NOT settle it (we should
+                    # retry once their lease expires)
+                    logger.debug("live: %s leased elsewhere; skipping", d)
+                    continue
+                ls = self.lease_store
+                fence = (lambda rd=d, ep=epoch: ls.guard(rd, ep))
+                lease = {"host": ls.host_id, "epoch": epoch}
             # construct OUTSIDE the lock: snapshot adoption re-hashes
             # the consumed WAL prefix (seconds on a big run), and
             # stop()/poll must not block behind it
-            tracker = RunTracker(d, accelerator=self.accelerator)
+            tracker = RunTracker(d, accelerator=self.accelerator,
+                                 fence=fence, lease=lease)
             with self._lock:
                 if len(self.trackers) >= self.max_runs:
                     self.registry.counter(
                         "live_admission_rejected_total",
                         "runs not admitted because live_max_runs "
                         "trackers are active").inc()
+                    if self.lease_store is not None:
+                        self.lease_store.release(d, epoch)
                     break
                 self.trackers[str(d)] = tracker
+                if epoch is not None:
+                    self._lease_epochs[str(d)] = epoch
             if tracker.resumed is True:
                 self.registry.counter(
                     "live_session_resumes_total",
@@ -608,6 +652,22 @@ class LiveDaemon:
         rows: list[tuple[RunTracker, dict]] = []
         done: list[str] = []
 
+        # lease heartbeat first: a tracker whose renewal fails is
+        # fenced for the whole poll — no tail, no check, no writes; its
+        # restart snapshot stays on disk for the adopting host
+        fenced: list[RunTracker] = []
+        if self.lease_store is not None:
+            alive: list[RunTracker] = []
+            for tr in trackers:
+                ep = self._lease_epochs.get(str(tr.run_dir))
+                if ep is not None and self.lease_store.renew(
+                        tr.run_dir, ep):
+                    alive.append(tr)
+                else:
+                    tr.fenced = True
+                    fenced.append(tr)
+            trackers = alive
+
         for tr in trackers:
             n = tr.tail()
             if n:
@@ -628,6 +688,16 @@ class LiveDaemon:
             results = None
             pending = tr.pending_ops
             if tr.completed() and not tr.final:
+                if self.lease_store is not None:
+                    # fresh fencing read immediately before the final:
+                    # a host un-paused past its TTL must not publish a
+                    # second final over its adopter's
+                    ep = self._lease_epochs.get(str(tr.run_dir))
+                    if ep is None or not self.lease_store.guard(
+                            tr.run_dir, ep):
+                        tr.fenced = True
+                        fenced.append(tr)
+                        continue
                 t_chk = time.perf_counter()
                 chk_t0 = trace_mod.now_us() if tracer.enabled else 0
                 results = tr.finalize()
@@ -678,6 +748,11 @@ class LiveDaemon:
             status = tr.status(self.lag_budget_ops, results=results,
                                now=now)
             tr.write_status(status)
+            if tr.fenced:
+                # deposed mid-poll (the write_status fence re-read):
+                # nothing was published; drop the tracker
+                fenced.append(tr)
+                continue
             statuses[tr.label] = status
             rows.append((tr, status))
         self._publish_run_series(rows)
@@ -685,6 +760,14 @@ class LiveDaemon:
         with self._lock:
             for key in done:
                 self.trackers.pop(key, None)
+                if self.lease_store is not None:
+                    self.lease_store.release(
+                        key, self._lease_epochs.pop(key, -1))
+            for tr in fenced:
+                # fenced trackers leave their lease file alone (the
+                # adopter owns it now) and keep their snapshot on disk
+                self.trackers.pop(str(tr.run_dir), None)
+                self._lease_epochs.pop(str(tr.run_dir), None)
             active = len(self.trackers)
         reg.gauge("live_runs_active",
                   "runs currently tracked by the live checker"
@@ -846,6 +929,14 @@ class LiveDaemon:
         if t is not None:
             join_noisy(t, "live daemon poller", heartbeat_s=5.0)
             self._thread = None
+        if self.lease_store is not None:
+            # clean shutdown hands runs over immediately instead of
+            # making the adopter wait out the TTL
+            with self._lock:
+                epochs = {key: self._lease_epochs.pop(key, -1)
+                          for key in list(self.trackers)}
+            for key, epoch in epochs.items():
+                self.lease_store.release(key, epoch)
         self._export()
 
     def run_until_idle(self, timeout_s: float = 60.0) -> dict:

@@ -35,6 +35,7 @@ network with the idempotent healers below.
 """
 from __future__ import annotations
 
+import errno
 import json
 import logging
 import os
@@ -46,6 +47,10 @@ from typing import Any
 logger = logging.getLogger("jepsen.nemesis.faults")
 
 FAULTS_NAME = "faults.jsonl"
+# rows held in memory while the disk is full (ENOSPC): fault records
+# are few and small, but losing one means an unhealable cluster, so
+# the bound is generous
+ENOSPC_PARK_MAX_ROWS = 1000
 
 # Heal-action dispatch groups. "file" faults (truncate-file, bitflip)
 # have no inverse — they're recorded so a recovery knows the damage
@@ -163,6 +168,13 @@ class FaultRegistry:  # durability: fsync
         if self.path.exists():
             self._load()
         self._f = open(self.path, "a", encoding="utf-8")
+        # ENOSPC park (doc/robustness.md "Fleet HA"): rows waiting for
+        # the disk to drain, retried on the next _append/close. Rows
+        # are idempotent on load (keyed by id), so the torn/duplicate
+        # lines a failed flush can leave are harmless; the tolerant
+        # reader skips them.
+        self._parked: list[str] = []
+        self._dirty_tail = False
 
     def _load(self) -> None:
         from jepsen_tpu.journal import read_jsonl_tolerant
@@ -179,6 +191,7 @@ class FaultRegistry:  # durability: fsync
 
     def _append(self, row: dict) -> None:
         from jepsen_tpu.store import _serializable
+        line = json.dumps(_serializable(row)) + "\n"
         reopened = self._f.closed
         if reopened:
             # a LATE record — a reaped fault injection landing after the
@@ -188,12 +201,40 @@ class FaultRegistry:  # durability: fsync
             # reopen safe.
             self._f = open(self.path, "a", encoding="utf-8")
         try:
-            self._f.write(json.dumps(_serializable(row)) + "\n")
+            # a bare newline terminates whatever partial line a failed
+            # flush left (readers skip torn lines); the ENOSPC backlog
+            # rides along before the new row
+            prefix = ("\n" if self._dirty_tail else "") \
+                + "".join(self._parked)
+            self._f.write(prefix + line)
             self._f.flush()
             os.fsync(self._f.fileno())
+            if self._parked or self._dirty_tail:
+                logger.info("fault registry %s recovered from ENOSPC; "
+                            "%d parked row(s) flushed", self.path,
+                            len(self._parked))
+            self._parked = []
+            self._dirty_tail = False
+        except OSError as e:
+            if e.errno != errno.ENOSPC:
+                raise
+            # disk full is transient: park the row (bounded) for the
+            # next _append/close instead of losing the only evidence a
+            # fault was injected — a full disk must not make the
+            # registry permanently self-disable (doc/robustness.md
+            # "Fleet HA")
+            self._dirty_tail = True
+            if len(self._parked) < ENOSPC_PARK_MAX_ROWS:
+                self._parked.append(line)
+            logger.warning("fault registry %s hit ENOSPC; row parked "
+                           "for retry (%d waiting)", self.path,
+                           len(self._parked))
         finally:
             if reopened:
-                self._f.close()
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
 
     def record(self, kind: str, f=None, value: Any = None) -> int:
         """Durably records an injection BEFORE it happens; returns the
@@ -260,8 +301,24 @@ class FaultRegistry:  # durability: fsync
 
     def close(self) -> None:
         with self._lock:
+            if (self._parked or self._dirty_tail) and not self._f.closed:
+                # last ENOSPC-drain try before the handle goes away
+                try:
+                    self._f.write(("\n" if self._dirty_tail else "")
+                                  + "".join(self._parked))
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                    self._parked = []
+                    self._dirty_tail = False
+                except OSError:
+                    logger.warning("fault registry %s: %d parked row(s) "
+                                   "lost at close (disk still full)",
+                                   self.path, len(self._parked))
             if not self._f.closed:
-                self._f.close()
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
 
     @staticmethod
     def _count(metric: str, kind) -> None:

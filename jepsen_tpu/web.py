@@ -448,6 +448,28 @@ class Handler(BaseHTTPRequestHandler):
                 if live else "")
         self._send(self._page("Jepsen-TPU", body, head_extra=head))
 
+    @staticmethod
+    def _ha_line(ha: dict) -> str:
+        """One HA/degraded card line (doc/robustness.md "Fleet HA"):
+        who holds leases, the fencing/shed counters, and whether
+        non-verdict surfaces are degraded."""
+        if not ha:
+            return ""
+        lease = (f"leased checking (ttl {ha.get('lease_ttl_s', 0)}s), "
+                 f"{ha.get('leases_held', 0)} held"
+                 if ha.get("leasing") else "leasing off")
+        degraded = int(ha.get("degraded_total", 0))
+        badge = (" <span class='badge-incomplete'>degraded</span>"
+                 if degraded else "")
+        shed = (" <span class='badge-incomplete'>shedding</span>"
+                if ha.get("shedding") else "")
+        return (f"<p>ha: host <b>{html.escape(str(ha.get('host', '?')))}"
+                f"</b> · {lease} · "
+                f"{int(ha.get('lease_acquired', 0))} takeovers / "
+                f"{int(ha.get('lease_lost', 0))} lost / "
+                f"{int(ha.get('fenced_writes', 0))} fenced writes"
+                f"{shed}{badge}</p>")
+
     def _fleet(self, base: Path):
         """The fleet dashboard: renders ``fleet-status.json`` (the pool
         scheduler's atomically-published aggregate — doc/observability.md
@@ -482,7 +504,9 @@ class Handler(BaseHTTPRequestHandler):
             f"{int(mesh.get('regrows', 0))} regrows"
             f" · ingest: {ing.get('bytes_per_s', 0.0):.0f} B/s, "
             f"{int(ing.get('bytes_total', 0))} B total, "
-            f"{int(ing.get('rejected_total', 0))} rejected</p>")
+            f"{int(ing.get('rejected_total', 0))} rejected, "
+            f"{int(ing.get('shed_total', 0))} shed</p>"
+            + self._ha_line(st.get("ha", {})))
         rows = []
         for r in st.get("top_runs", []):
             valid = r.get("valid_so_far")

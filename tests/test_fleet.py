@@ -252,10 +252,11 @@ def test_receiver_restart_rebuilds_cursor_from_disk(tmp_path, ingest):
 
 def test_final_install_is_digest_checked(tmp_path, ingest):
     body = b'{"i": 0}\n'
-    assert not ingest.finalize_run("reg/20260806T000005", "0" * 64,
-                                   body)
+    assert ingest.finalize_run("reg/20260806T000005", "0" * 64,
+                               body) == "bad"
     assert ingest.finalize_run(
-        "reg/20260806T000005", hashlib.sha256(body).hexdigest(), body)
+        "reg/20260806T000005", hashlib.sha256(body).hexdigest(),
+        body) == "ok"
     assert (ingest.store_root / "reg" / "20260806T000005"
             / "history.jsonl").read_bytes() == body
 
