@@ -1968,7 +1968,7 @@ def cfg_lint():
     from pathlib import Path
 
     from jepsen_tpu.analysis import lint as lint_mod
-    from jepsen_tpu.analysis.lint import astcache
+    from jepsen_tpu.analysis.lint import astcache, csrc
 
     root = Path(__file__).resolve().parent
     pkg = root / "jepsen_tpu"
@@ -1981,6 +1981,7 @@ def cfg_lint():
         return rep
 
     astcache._CACHE.clear()
+    csrc._CACHE.clear()
     t0 = time.perf_counter()
     rep = run()
     cold_s = time.perf_counter() - t0
@@ -1991,6 +1992,25 @@ def cfg_lint():
     emit("lint_wall_s", warm_s, "s", 30.0 / max(warm_s, 1e-9),
          cold_s=round(cold_s, 2), files=rep.files,
          rules=len(lint_mod.RULE_NAMES), trials=len(times))
+
+    # the JTN family alone over the shipped C sources — the acceptance
+    # bar is < 10 s warm for the native rule pass
+    def run_native():
+        rep = lint_mod.lint_paths([str(pkg / "native")], baseline=False,
+                                  root=str(root), rules=["jtn-*"])
+        assert rep.findings == [], [f.render() for f in rep.findings]
+        return rep
+
+    csrc._CACHE.clear()
+    t0 = time.perf_counter()
+    nrep = run_native()
+    n_cold_s = time.perf_counter() - t0
+    _, ntimes = _trials(run_native, 3)
+    n_warm_s = _median(ntimes)
+    assert n_warm_s < 10.0, f"warm native lint took {n_warm_s:.1f}s"
+    emit("lint_native_wall_s", n_warm_s, "s", 10.0 / max(n_warm_s, 1e-9),
+         cold_s=round(n_cold_s, 3), files=nrep.files,
+         rules=len(lint_mod.C_RULES), trials=len(ntimes))
 
 
 def cfg_fuzz():
@@ -2052,6 +2072,44 @@ def cfg_fuzz():
          ratio / 2.0, deep_edges_guided=g_deep, deep_edges_blind=b_deep,
          edges_guided=len(g["edges"]), edges_blind=len(b["edges"]),
          guided_vs_blind_deep_ratio=round(ratio, 2))
+
+
+def cfg_fuzz_native():
+    """fuzz_native_execs_per_sec: the differential WAL-parser fuzz
+    harness's throughput against the plain -O3 build (the san build's
+    ~2-5x tax is the lane's, not the harness's), plus corpus coverage —
+    every checked-in seed and every mutation operator must have fired
+    within the budget (a silently dead operator means a coverage hole,
+    not a perf win). Zero divergences is an assertion, not a metric:
+    a C-vs-Python disagreement fails the bench like any broken kernel.
+    Deterministic under the fixed seed."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu.fuzz import native as fuzz_native
+
+    execs, seed = 4000, 1
+    tmp = tempfile.mkdtemp(prefix="jepsen-bench-fuzz-native-")
+    try:
+        res = fuzz_native.run_fuzz(execs, seed=seed, san=False,
+                                   store_dir=tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if res["status"] == "no-native":
+        print("[bench] fuzz_native skipped: no native build", flush=True)
+        return
+    assert res["divergences"] == 0, res["artifacts"]
+    seeds_hit = len(res["seed_coverage"])
+    ops_hit = len(res["operator_coverage"])
+    assert seeds_hit == len(fuzz_native.SEEDS), res["seed_coverage"]
+    assert ops_hit == len(fuzz_native.OPERATORS), res["operator_coverage"]
+    rate = res["execs_per_s"]
+    emit("fuzz_native_execs_per_sec", rate, "execs/s", rate / 1000.0,
+         execs=res["execs"], seed=seed,
+         corpus_seeds_covered=seeds_hit,
+         operators_covered=ops_hit,
+         ops_parsed=res["ops_parsed"], torn_lines=res["torn_lines"],
+         wall_s=round(res["elapsed_s"], 2))
 
 
 def cfg_headline() -> float:
@@ -2150,6 +2208,7 @@ def main() -> None:
     guard("fleet_failover", cfg_fleet_failover)
     guard("lint", cfg_lint)
     guard("fuzz", cfg_fuzz)
+    guard("fuzz_native", cfg_fuzz_native)
     device_rate = guard("headline", cfg_headline) or device_rate
     guard("scale", lambda: cfg_scale(device_rate))
 

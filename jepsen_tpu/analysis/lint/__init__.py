@@ -14,6 +14,7 @@ CLI: ``jepsen-tpu lint [paths...] [--format=json] [--baseline FILE]
 """
 from __future__ import annotations
 
+import fnmatch
 import logging
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -22,8 +23,8 @@ from jepsen_tpu.analysis.diagnostics import (
     Finding, render_json, sort_findings,
 )
 from jepsen_tpu.analysis.lint import (
-    astcache, callgraph, rules_concurrency, rules_durability, rules_jax,
-    rules_telemetry,
+    astcache, callgraph, csrc, rules_concurrency, rules_durability,
+    rules_jax, rules_native, rules_telemetry,
 )
 
 logger = logging.getLogger("jepsen.analysis.lint")
@@ -47,7 +48,18 @@ RULES = (
     ("telemetry-name", None, rules_telemetry.telemetry_name),
 )
 
-RULE_NAMES = tuple(r[0] for r in RULES)
+# per-C-module rules (name, fn over a csrc.CModuleInfo) — the JTN
+# family; they ride the same baseline/waiver/--rule machinery, just
+# over the token layer instead of the AST
+C_RULES = (
+    ("jtn-alloc-check", rules_native.alloc_check),
+    ("jtn-cleanup-return", rules_native.cleanup_return),
+    ("jtn-errcheck", rules_native.errcheck),
+    ("jtn-gil-call", rules_native.gil_call),
+    ("jtn-bounds-guard", rules_native.bounds_guard),
+)
+
+RULE_NAMES = tuple(r[0] for r in RULES) + tuple(r[0] for r in C_RULES)
 
 
 @dataclass
@@ -62,15 +74,36 @@ class Report:
         return 1 if self.findings else 0
 
 
+_C_SUFFIXES = (".c", ".cc", ".cpp", ".cxx")
+
+
 def _collect_files(paths) -> list[Path]:
     out: list[Path] = []
     for p in paths:
         p = Path(p)
         if p.is_dir():
-            out.extend(sorted(f for f in p.rglob("*.py")
-                              if "__pycache__" not in f.parts))
-        elif p.suffix == ".py":
+            files = [f for f in p.rglob("*")
+                     if f.suffix in (".py",) + _C_SUFFIXES
+                     and "__pycache__" not in f.parts]
+            out.extend(sorted(files))
+        elif p.suffix in (".py",) + _C_SUFFIXES:
             out.append(p)
+    return out
+
+
+def resolve_rules(rules) -> set | None:
+    """Expands ``--rule`` names (globs allowed: ``jtn-*``) against
+    RULE_NAMES; raises on anything that matches nothing — a typo'd
+    --rule must not produce a green "0 findings" run."""
+    if not rules:
+        return None
+    out: set = set()
+    for r in rules:
+        hits = fnmatch.filter(RULE_NAMES, r)
+        if not hits:
+            raise ValueError(f"unknown lint rule(s) [{r!r}]; "
+                             f"known: {', '.join(RULE_NAMES)}")
+        out.update(hits)
     return out
 
 
@@ -115,24 +148,26 @@ def lint_paths(paths, baseline=None, root=None, rules=None) -> Report:
     ``<root>/lint-baseline.txt``; pass ``baseline=False`` to skip.
     ``rules`` optionally restricts to a subset of rule names."""
     paths = list(paths) or ["jepsen_tpu"]
-    unknown = set(rules or ()) - set(RULE_NAMES)
-    if unknown:
-        # a typo'd --rule must not produce a green "0 findings" run
-        raise ValueError(f"unknown lint rule(s) {sorted(unknown)}; "
-                         f"known: {', '.join(RULE_NAMES)}")
+    resolved = resolve_rules(rules)
     root = Path(root) if root is not None else _guess_root(paths)
     files = _collect_files(paths)
     if not files:
-        raise ValueError(f"no Python files found under {paths} — a "
+        raise ValueError(f"no lintable files found under {paths} — a "
                          "mistyped path would otherwise lint nothing "
                          "and exit green")
     report = Report(files=len(files))
     modules = []
+    cmodules = []
     for f in files:
+        if f.suffix in _C_SUFFIXES:
+            cmod = csrc.parse_c_module(f, root=root)
+            if cmod is not None and not cmod.skip:
+                cmodules.append(cmod)
+            continue
         mod = astcache.parse_module(f, root=root)
         if mod is not None and not mod.skip:
             modules.append(mod)
-    selected = set(rules or RULE_NAMES)
+    selected = resolved if resolved is not None else set(RULE_NAMES)
     findings: list[Finding] = []
     for name, per_module, _global in RULES:
         if name not in selected or per_module is None:
@@ -142,6 +177,15 @@ def lint_paths(paths, baseline=None, root=None, rules=None) -> Report:
                 findings.extend(per_module(mod))
             except Exception:  # noqa: BLE001 — one bad file never kills lint
                 logger.exception("rule %s crashed on %s", name, mod.relpath)
+    for name, per_cmodule in C_RULES:
+        if name not in selected:
+            continue
+        for cmod in cmodules:
+            try:
+                findings.extend(per_cmodule(cmod))
+            except Exception:  # noqa: BLE001 — one bad file never kills lint
+                logger.exception("rule %s crashed on %s", name,
+                                 cmod.relpath)
     global_rules = [g for name, _p, g in RULES
                     if g is not None and name in selected]
     if global_rules:
@@ -227,6 +271,7 @@ def render_report_json(report: Report) -> str:
 
 
 __all__ = [
-    "BASELINE_NAME", "RULE_NAMES", "Report", "lint_paths", "load_baseline",
+    "BASELINE_NAME", "C_RULES", "RULE_NAMES", "Report", "lint_paths",
+    "load_baseline", "resolve_rules",
     "render_json", "render_report_json", "render_text", "write_baseline",
 ]

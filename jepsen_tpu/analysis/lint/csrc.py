@@ -1,0 +1,313 @@
+"""Parsed-C-module cache for the native lint rules.
+
+The token-level twin of ``astcache.py``: every JTN rule shares ONE
+tokenization per ``.c``/``.cpp`` file — the token stream, the raw
+source lines, the comment map, and a pre-built index of function
+definitions found by brace matching. Cached by the same
+``(mtime_ns, size, crc32)`` stamp, so the tier-1 self-lint gate and
+repeated CLI runs never re-tokenize an unchanged file.
+
+This is deliberately NOT a C parser. It is a lexer plus a
+brace-matched function index, which is exactly enough for the JTN
+rule families (unchecked allocs, cleanup-bypassing returns,
+``PyErr_Occurred`` discipline, GIL-released CPython calls, unguarded
+index writes) and nothing more — doc/static-analysis.md "Native code"
+spells out the honest limits. Waivers mirror the Python side:
+
+* ``/* lint: ignore[rule-a,rule-b] */`` (or the ``//`` form) trailing
+  a line waives those rules on that line; on a function's signature
+  or opening-brace line, for the whole function.
+* ``/* lint: skip-file */`` anywhere skips the file.
+
+Preprocessor directives (``#include``/``#define`` bodies, with
+backslash continuations) are consumed wholesale and never tokenized
+into the stream — a function-like macro body is invisible to the
+rules, which is a documented limit, not a bug.
+"""
+from __future__ import annotations
+
+import re
+import zlib
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_IGNORE_RE = re.compile(r"lint:\s*ignore\[([^\]]+)\]")
+_SKIP_FILE_RE = re.compile(r"lint:\s*skip-file\b")
+
+C_SUFFIXES = (".c", ".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+_TOKEN_RE = re.compile(
+    r"""
+      (?P<comment>//[^\n]*|/\*.*?\*/)
+    | (?P<str>"(?:\\.|[^"\\\n])*")
+    | (?P<char>'(?:\\.|[^'\\\n])*')
+    | (?P<num>0[xX][0-9a-fA-F]+[uUlL]*
+        |\d+(?:\.\d*)?(?:[eE][+-]?\d+)?[uUlLfF]*
+        |\.\d+(?:[eE][+-]?\d+)?[fF]?)
+    | (?P<id>[A-Za-z_]\w*)
+    | (?P<punct>->|\+\+|--|<<=|>>=|<<|>>|<=|>=|==|!=|&&|\|\||::
+        |[-+*/%&|^!~<>=?:;,.(){}\[\]#\\])
+    """,
+    re.X | re.S)
+
+# C/C++ keywords the function indexer must not mistake for a function
+# name in front of a brace-delimited body
+_BODY_KEYWORDS = frozenset({
+    "if", "else", "for", "while", "do", "switch", "struct", "union",
+    "enum", "class", "namespace", "try", "catch", "sizeof", "return",
+})
+_SCOPE_KEYWORDS = frozenset({"namespace", "class", "struct", "union",
+                             "extern"})
+
+
+@dataclass
+class Tok:
+    __slots__ = ("kind", "text", "line", "col")
+    kind: str       # comment tokens are stripped before the stream
+    text: str
+    line: int
+    col: int
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"Tok({self.kind},{self.text!r},{self.line})"
+
+
+@dataclass
+class CFuncInfo:
+    name: str
+    qualname: str
+    lineno: int           # line of the opening brace's signature
+    end_lineno: int
+    body_start: int       # token index of '{'
+    body_end: int         # token index of matching '}'
+    ignores: frozenset = frozenset()
+
+
+@dataclass
+class CModuleInfo:
+    path: Path
+    relpath: str
+    lines: list[str]
+    tokens: list[Tok]
+    comments: dict[int, str]      # lineno -> comment text on that line
+    functions: dict[str, CFuncInfo] = field(default_factory=dict)
+    skip: bool = False
+
+    def line_ignores(self, lineno: int) -> frozenset:
+        return _parse_ignores(self.comments.get(lineno, ""))
+
+
+def _parse_ignores(comment: str) -> frozenset:
+    m = _IGNORE_RE.search(comment or "")
+    if not m:
+        return frozenset()
+    return frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def _tokenize(source: str) -> tuple[list[Tok], dict[int, str]]:
+    toks: list[Tok] = []
+    comments: dict[int, str] = {}
+    line = 1
+    line_start = 0
+    pos = 0
+    n = len(source)
+    while pos < n:
+        ch = source[pos]
+        if ch == "\n":
+            line += 1
+            pos += 1
+            line_start = pos
+            continue
+        if ch in " \t\r\f\v":
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(source, pos)
+        if m is None:  # stray byte: skip it, stay tolerant
+            pos += 1
+            continue
+        kind = m.lastgroup or "punct"
+        text = m.group(0)
+        col = pos - line_start + 1
+        if kind == "comment":
+            # map every line the comment touches (a trailing single-line
+            # waiver and a boxed multi-line header both resolve)
+            parts = text.split("\n")
+            for i, part in enumerate(parts):
+                comments[line + i] = (comments.get(line + i, "")
+                                      + " " + part)
+            if len(parts) > 1:
+                # a boxed multi-line comment's marker must resolve from
+                # the line the comment ENDS on (the one adjacent to the
+                # waived statement/signature): carry the full text there
+                end = line + len(parts) - 1
+                comments[end] = comments[end] + " " + " ".join(parts[:-1])
+        else:
+            toks.append(Tok(kind, text, line, col))
+        line += text.count("\n")
+        if "\n" in text:
+            line_start = m.end() - (len(text) - text.rfind("\n") - 1)
+        pos = m.end()
+    return toks, comments
+
+
+def _strip_directives(toks: list[Tok]) -> list[Tok]:
+    """Drops preprocessor logical lines (``#`` first-on-line through
+    end of line, following backslash continuations)."""
+    out: list[Tok] = []
+    i = 0
+    n = len(toks)
+    prev_line = -1
+    while i < n:
+        t = toks[i]
+        if t.text == "#" and t.line != prev_line:
+            # consume the directive's logical line
+            cur = t.line
+            i += 1
+            while i < n:
+                nxt = toks[i]
+                if nxt.line == cur:
+                    if nxt.text == "\\":
+                        cur += 1  # continuation: extend one line
+                    i += 1
+                    continue
+                if nxt.line == cur + 1 and toks[i - 1].text == "\\":
+                    cur = nxt.line
+                    continue
+                break
+            prev_line = cur
+            continue
+        prev_line = t.line
+        out.append(t)
+        i += 1
+    return out
+
+
+def _match_brace(toks: list[Tok], open_idx: int) -> int:
+    """Token index of the ``}`` matching ``toks[open_idx] == '{'``;
+    len(toks)-1 when unbalanced (tolerant)."""
+    depth = 0
+    for i in range(open_idx, len(toks)):
+        t = toks[i].text
+        if t == "{":
+            depth += 1
+        elif t == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(toks) - 1
+
+
+def _func_ignores(mod: CModuleInfo, sig_line: int, brace_line: int,
+                  name_line: int) -> frozenset:
+    out: set = set()
+    for ln in {sig_line, sig_line - 1, name_line, brace_line}:
+        out |= mod.line_ignores(ln)
+    return frozenset(out)
+
+
+def _index_functions(mod: CModuleInfo) -> None:
+    """Brace-matched function discovery: a top-level (or class/
+    namespace-nested) ``name ( ... ) {`` is a function definition.
+    Initializer braces (``= {...}``), control-flow bodies, and
+    aggregate definitions are skipped or recursed as appropriate."""
+    toks = mod.tokens
+
+    def scan(lo: int, hi: int) -> None:
+        i = lo
+        while i < hi:
+            if toks[i].text != "{":
+                i += 1
+                continue
+            close = _match_brace(toks, i)
+            # look back for `ident ( ... )` directly before the brace
+            j = i - 1
+            func_name = None
+            if j >= lo and toks[j].text == ")":
+                depth = 0
+                k = j
+                while k >= lo:
+                    if toks[k].text == ")":
+                        depth += 1
+                    elif toks[k].text == "(":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    k -= 1
+                if k > lo:
+                    prev = toks[k - 1]
+                    if (prev.kind == "id"
+                            and prev.text not in _BODY_KEYWORDS):
+                        func_name = prev
+            if func_name is not None:
+                name = func_name.text
+                qual = name
+                seq = 2
+                while qual in mod.functions:  # overloads / statics
+                    qual = f"{name}#{seq}"
+                    seq += 1
+                fi = CFuncInfo(
+                    name=name, qualname=qual, lineno=func_name.line,
+                    end_lineno=toks[close].line, body_start=i,
+                    body_end=close,
+                    ignores=_func_ignores(mod, func_name.line,
+                                          toks[i].line, func_name.line))
+                mod.functions[qual] = fi
+                i = close + 1
+                continue
+            # aggregate/namespace scope: recurse so methods inside a
+            # class/namespace body (wgl.cpp's FlatSet) are indexed
+            scope = False
+            k = j
+            while k >= lo and k >= j - 4:
+                if toks[k].kind == "id" and toks[k].text in _SCOPE_KEYWORDS:
+                    scope = True
+                    break
+                if toks[k].kind == "str" and k >= 1 \
+                        and toks[k - 1].text == "extern":
+                    scope = True  # extern "C" { ... }
+                    break
+                if toks[k].text in ("=", ";", "}", "{", ")"):
+                    break
+                k -= 1
+            if scope:
+                scan(i + 1, close)
+            i = close + 1
+
+    scan(0, len(toks))
+
+
+_CACHE: dict[str, tuple[tuple, CModuleInfo]] = {}
+
+
+def parse_c_module(path, root=None) -> CModuleInfo | None:
+    """Cached tokenize+index; None when the file can't be read. Same
+    stamp discipline as ``astcache.parse_module``."""
+    p = Path(path)
+    try:
+        st = p.stat()
+        raw = p.read_bytes()
+    except OSError:
+        return None
+    stamp = (st.st_mtime_ns, st.st_size, zlib.crc32(raw))
+    key = str(p.resolve())
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    source = raw.decode("utf-8", "replace")
+    rel = str(p)
+    if root is not None:
+        try:
+            rel = str(p.resolve().relative_to(Path(root).resolve()))
+        except ValueError:
+            rel = str(p)
+    toks, comments = _tokenize(source)
+    mod = CModuleInfo(path=p, relpath=rel, lines=source.splitlines(),
+                      tokens=_strip_directives(toks), comments=comments)
+    mod.skip = any(_SKIP_FILE_RE.search(c) for c in comments.values())
+    _index_functions(mod)
+    _CACHE[key] = (stamp, mod)
+    return mod
+
+
+def cache_info() -> dict:
+    return {"modules": len(_CACHE)}

@@ -1,0 +1,433 @@
+"""JTN rules: native-code (C/C++) invariants over the token layer.
+
+The host ingest spine (``native/columnar_ext.c``, ``native/wgl.cpp``)
+parses **network-delivered adversarial bytes** (the PR-16/19 fleet
+receiver feeds it), so the invariants these rules encode are exactly
+the remotely-triggerable memory-safety classes:
+
+* ``jtn-alloc-check`` (JTN001) — an allocation result
+  (``malloc``/``realloc``/``PyList_New``/…) dereferenced before any
+  NULL check, and statement-position ``PyArg_Parse*`` calls whose
+  failure return is discarded.
+* ``jtn-cleanup-return`` (JTN002) — in a function using goto-cleanup
+  discipline, a direct ``return NULL``/``return -1`` between the
+  first ``goto`` and its cleanup label bypasses the release path
+  (the classic error-path leak/refcount-imbalance shape).
+* ``jtn-errcheck`` (JTN003) — ambiguous-failure conversions
+  (``PyLong_AsLongLong`` returns -1 both for the value -1 and for an
+  error) must be followed by ``PyErr_Occurred()`` — the checked
+  ``fast_int``/``as_i64`` idiom in columnar_ext.c.
+* ``jtn-gil-call`` (JTN004) — no CPython API call between
+  ``Py_BEGIN_ALLOW_THREADS`` and ``Py_END_ALLOW_THREADS`` (the GIL is
+  released there; touching an object is a race, not a bug report).
+* ``jtn-bounds-guard`` (JTN005) — an array *write* indexed by a
+  variable that is never compared against anything in the whole
+  function: an index derived from ``consumed``/chunk length with no
+  bound anywhere is an OOB write waiting for the right input.
+
+These are token-level heuristics, not a verifier — flow-insensitive
+by design, with the same waiver discipline as the Python rules
+(``/* lint: ignore[rule] */`` + why-comment for provably-safe idioms).
+doc/static-analysis.md "Native code" records the honest limits.
+"""
+from __future__ import annotations
+
+from jepsen_tpu.analysis.diagnostics import Finding
+from jepsen_tpu.analysis.lint.csrc import CFuncInfo, CModuleInfo, Tok
+
+RULE_ALLOC = "jtn-alloc-check"
+RULE_CLEANUP = "jtn-cleanup-return"
+RULE_ERRCHECK = "jtn-errcheck"
+RULE_GIL = "jtn-gil-call"
+RULE_BOUNDS = "jtn-bounds-guard"
+
+CODES = {RULE_ALLOC: "JTN001", RULE_CLEANUP: "JTN002",
+         RULE_ERRCHECK: "JTN003", RULE_GIL: "JTN004",
+         RULE_BOUNDS: "JTN005"}
+
+# allocators whose NULL return the very next deref would crash on
+ALLOC_FNS = frozenset({
+    "malloc", "calloc", "realloc",
+    "PyMem_Malloc", "PyMem_Calloc", "PyMem_Realloc", "PyMem_RawMalloc",
+    "PyList_New", "PyDict_New", "PyTuple_New", "PyUnicode_New",
+    "PyByteArray_FromStringAndSize", "PyBytes_FromStringAndSize",
+})
+# must-check-result calls: a discarded failure return silently
+# proceeds with unconverted arguments
+MUST_CHECK_CALLS = ("PyArg_ParseTuple", "PyArg_ParseTupleAndKeywords",
+                    "PyArg_Parse", "PyArg_UnpackTuple")
+# conversions where the error return collides with a legal value
+FALLIBLE_CONVERSIONS = frozenset({
+    "PyLong_AsLongLong", "PyLong_AsLong", "PyLong_AsSsize_t",
+    "PyLong_AsUnsignedLongLong", "PyLong_AsSize_t",
+    "PyFloat_AsDouble", "PyNumber_AsSsize_t",
+    "PyDict_GetItemWithError",
+})
+# identifiers legal while the GIL is released
+_GIL_SAFE = frozenset({
+    "Py_BEGIN_ALLOW_THREADS", "Py_END_ALLOW_THREADS",
+    "Py_BLOCK_THREADS", "Py_UNBLOCK_THREADS",
+})
+
+
+def _waived(mod: CModuleInfo, fi: CFuncInfo, rule: str, line: int) -> bool:
+    # trailing waiver, or one on the line directly above: C statements
+    # routinely fill the line, so the why-comment + waiver sit above
+    return (rule in fi.ignores or rule in mod.line_ignores(line)
+            or rule in mod.line_ignores(line - 1))
+
+
+def _finding(rule: str, mod: CModuleInfo, fi: CFuncInfo, tok: Tok,
+             message: str, hint: str | None = None) -> Finding:
+    return Finding(rule=rule, code=CODES[rule], path=mod.relpath,
+                   line=tok.line, col=tok.col, qualname=fi.qualname,
+                   message=message, hint=hint)
+
+
+def _match_paren(toks: list[Tok], open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(toks)):
+        t = toks[i].text
+        if t == "(":
+            depth += 1
+        elif t == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(toks) - 1
+
+
+def _body(mod: CModuleInfo, fi: CFuncInfo) -> tuple[list[Tok], int, int]:
+    return mod.tokens, fi.body_start + 1, fi.body_end
+
+
+# -- JTN001: unchecked allocation --------------------------------------
+
+def _is_null_token(t: Tok) -> bool:
+    return t.text in ("NULL", "nullptr") or (t.kind == "num"
+                                             and t.text == "0")
+
+
+def _occurrence_is_check(toks: list[Tok], i: int) -> bool:
+    """True when ``toks[i]`` (the alloc'd var) participates in a NULL
+    check: ``!v``, ``v == NULL``, ``v != NULL``, or a bare truth test
+    between boolean/paren delimiters."""
+    prev = toks[i - 1].text if i > 0 else ""
+    nxt = toks[i + 1].text if i + 1 < len(toks) else ""
+    if prev == "!":
+        return True
+    if nxt in ("==", "!=") and i + 2 < len(toks) \
+            and _is_null_token(toks[i + 2]):
+        return True
+    if prev in ("(", "&&", "||") and nxt in (")", "&&", "||", "?"):
+        return True
+    return False
+
+
+def _occurrence_is_deref(toks: list[Tok], i: int) -> bool:
+    prev = toks[i - 1].text if i > 0 else ""
+    nxt = toks[i + 1].text if i + 1 < len(toks) else ""
+    if nxt in ("[", "->", "."):
+        return True
+    if prev == "*":
+        # `*v` deref vs `a * v` multiply: deref when the token before
+        # the star is an operator/open-paren/assign/statement edge
+        pp = toks[i - 2].text if i >= 2 else ";"
+        if pp in (";", "{", "}", "(", ",", "=", "return", "+", "-",
+                  "==", "!=", "&&", "||"):
+            return True
+    return False
+
+
+def alloc_check(mod: CModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    toks = mod.tokens
+    for fi in mod.functions.values():
+        _, lo, hi = _body(mod, fi)
+        i = lo
+        while i < hi:
+            t = toks[i]
+            if t.kind != "id":
+                i += 1
+                continue
+            # statement-position PyArg_* call: result discarded
+            if t.text.startswith(MUST_CHECK_CALLS) \
+                    and i + 1 < hi and toks[i + 1].text == "(":
+                prev = toks[i - 1].text
+                if prev in (";", "{", "}") \
+                        and not _waived(mod, fi, RULE_ALLOC, t.line):
+                    out.append(_finding(
+                        RULE_ALLOC, mod, fi, t,
+                        f"{t.text} return value discarded — a failed "
+                        "parse leaves the output arguments garbage",
+                        hint="wrap it: if (!PyArg_…(...)) return NULL;"))
+                i = _match_paren(toks, i + 1) + 1
+                continue
+            if t.text not in ALLOC_FNS or i + 1 >= hi \
+                    or toks[i + 1].text != "(":
+                i += 1
+                continue
+            close = _match_paren(toks, i + 1)
+            # assignment target: `v = alloc(...)` (possibly `type *v =`)
+            if i < 2 or toks[i - 1].text != "=" \
+                    or toks[i - 2].kind != "id":
+                i = close + 1
+                continue
+            var = toks[i - 2].text
+            # inside a condition (`if (!(v = malloc(...)))`) — the
+            # check is the enclosing expression
+            depth = 0
+            guarded = False
+            for k in range(lo, i - 2):
+                if toks[k].text == "(":
+                    depth += 1
+                elif toks[k].text == ")":
+                    depth -= 1
+            if depth > 0:
+                guarded = True
+            if not guarded:
+                # first later occurrence of var decides: check -> ok;
+                # deref -> finding; anything else (passed on, returned,
+                # reassigned) -> out of scope for this rule
+                k = close + 1
+                while k < hi:
+                    if toks[k].kind == "id" and toks[k].text == var:
+                        if _occurrence_is_check(toks, k):
+                            guarded = True
+                        elif _occurrence_is_deref(toks, k):
+                            if not _waived(mod, fi, RULE_ALLOC, t.line):
+                                out.append(_finding(
+                                    RULE_ALLOC, mod, fi, t,
+                                    f"{t.text}() result {var!r} is "
+                                    "dereferenced (line "
+                                    f"{toks[k].line}) before any NULL "
+                                    "check",
+                                    hint="check the allocation before "
+                                         "touching it; on failure take "
+                                         "the function's error path"))
+                        break
+                    k += 1
+            i = close + 1
+    return out
+
+
+# -- JTN002: error return bypassing goto-cleanup -----------------------
+
+# `return 0` is deliberately absent: it is the SUCCESS value for
+# int-returning CPython protocols, so flagging it would bury the
+# signal in noise
+_ERROR_RETURNS = (("NULL",), ("nullptr",), ("-", "1"))
+
+
+def _labels_and_gotos(toks: list[Tok], lo: int, hi: int):
+    labels: dict[str, int] = {}
+    gotos: list[tuple[str, int]] = []
+    i = lo
+    while i < hi:
+        t = toks[i]
+        if t.kind == "id":
+            if t.text == "goto" and i + 1 < hi \
+                    and toks[i + 1].kind == "id":
+                gotos.append((toks[i + 1].text, i))
+                i += 2
+                continue
+            if i + 1 < hi and toks[i + 1].text == ":" \
+                    and t.text not in ("default", "case", "public",
+                                       "private", "protected") \
+                    and (i + 2 >= hi or toks[i + 2].text != ":"):
+                prev = toks[i - 1].text if i > lo else "{"
+                if prev in (";", "{", "}", ":"):
+                    labels.setdefault(t.text, i)
+        elif t.text == "case":
+            # skip `case X:` so the colon isn't taken for a label
+            while i < hi and toks[i].text != ":":
+                i += 1
+        elif t.text == "?":
+            # skip ternary up to its ':' at the same paren depth
+            depth = 0
+            i += 1
+            while i < hi:
+                x = toks[i].text
+                if x in ("(", "["):
+                    depth += 1
+                elif x in (")", "]"):
+                    depth -= 1
+                elif x == ":" and depth <= 0:
+                    break
+                elif x in (";", "{", "}"):
+                    break
+                i += 1
+        i += 1
+    return labels, gotos
+
+
+def cleanup_return(mod: CModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    toks = mod.tokens
+    for fi in mod.functions.values():
+        _, lo, hi = _body(mod, fi)
+        labels, gotos = _labels_and_gotos(toks, lo, hi)
+        # cleanup labels: goto targets defined AFTER their first goto
+        cleanup = [labels[n] for n, gi in
+                   {n: gi for n, gi in reversed(gotos)}.items()
+                   if n in labels and labels[n] > gi]
+        if not cleanup:
+            continue
+        first_goto = min(gi for n, gi in gotos
+                         if n in labels and labels[n] > gi)
+        first_label = min(cleanup)
+        i = first_goto
+        while i < first_label:
+            t = toks[i]
+            if t.kind == "id" and t.text == "return":
+                tail = tuple(x.text for x in toks[i + 1:i + 3])
+                is_err = any(tail[:len(sig)] == sig
+                             and toks[i + 1 + len(sig)].text == ";"
+                             for sig in _ERROR_RETURNS
+                             if i + 1 + len(sig) < hi)
+                if is_err and not _waived(mod, fi, RULE_CLEANUP, t.line):
+                    out.append(_finding(
+                        RULE_CLEANUP, mod, fi, t,
+                        "direct error return inside a goto-cleanup "
+                        "region — it bypasses the cleanup label's "
+                        "releases",
+                        hint="route the error through the cleanup "
+                             "label (goto …), or waive with a "
+                             "why-comment if provably nothing is "
+                             "owned here"))
+            i += 1
+    return out
+
+
+# -- JTN003: PyErr_Occurred discipline ---------------------------------
+
+_ERRCHECK_WINDOW = 64  # tokens of slack after the call
+
+
+def errcheck(mod: CModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    toks = mod.tokens
+    for fi in mod.functions.values():
+        _, lo, hi = _body(mod, fi)
+        i = lo
+        while i < hi:
+            t = toks[i]
+            if t.kind != "id" or t.text not in FALLIBLE_CONVERSIONS \
+                    or i + 1 >= hi or toks[i + 1].text != "(":
+                i += 1
+                continue
+            close = _match_paren(toks, i + 1)
+            window = toks[close:min(close + _ERRCHECK_WINDOW, hi)]
+            # PyErr_Clear (tolerant-path discard) and PyErr_Fetch are
+            # error-AWARE handling too, not just PyErr_Occurred
+            checked = any(w.kind == "id" and w.text in
+                          ("PyErr_Occurred", "PyErr_Clear",
+                           "PyErr_Fetch", "fast_int", "as_i64")
+                          for w in window)
+            if not checked and not _waived(mod, fi, RULE_ERRCHECK,
+                                           t.line):
+                out.append(_finding(
+                    RULE_ERRCHECK, mod, fi, t,
+                    f"{t.text}() error return is ambiguous (-1/NULL "
+                    "is also a legal value) and no PyErr_Occurred() "
+                    "follows",
+                    hint="check `== -1 && PyErr_Occurred()` (the "
+                         "as_i64 idiom), or waive with a why-comment "
+                         "when the input is provably in range"))
+            i = close + 1
+    return out
+
+
+# -- JTN004: CPython API while the GIL is released ---------------------
+
+def gil_call(mod: CModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    toks = mod.tokens
+    for fi in mod.functions.values():
+        _, lo, hi = _body(mod, fi)
+        released = False
+        for i in range(lo, hi):
+            t = toks[i]
+            if t.kind != "id":
+                continue
+            if t.text == "Py_BEGIN_ALLOW_THREADS":
+                released = True
+                continue
+            if t.text in ("Py_END_ALLOW_THREADS", "Py_BLOCK_THREADS"):
+                released = False
+                continue
+            if t.text == "Py_UNBLOCK_THREADS":
+                released = True
+                continue
+            if not released:
+                continue
+            if (t.text.startswith(("Py", "_Py"))
+                    and t.text not in _GIL_SAFE
+                    and i + 1 < hi and toks[i + 1].text == "("
+                    and not _waived(mod, fi, RULE_GIL, t.line)):
+                out.append(_finding(
+                    RULE_GIL, mod, fi, t,
+                    f"{t.text}() called between "
+                    "Py_BEGIN/END_ALLOW_THREADS — the GIL is released "
+                    "here; touching CPython state is a data race",
+                    hint="move the call outside the allow-threads "
+                         "block, or re-acquire with Py_BLOCK_THREADS"))
+    return out
+
+
+# -- JTN005: unguarded variable-index array write ----------------------
+
+def bounds_guard(mod: CModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    toks = mod.tokens
+    for fi in mod.functions.values():
+        _, lo, hi = _body(mod, fi)
+        # an identifier counts as bounded when it participates in a
+        # comparison anywhere in the function, OR is assigned through a
+        # mask/modulo (`idx = hash & (cap - 1)` — the open-addressing
+        # probe idiom IS the bounds guard)
+        compared: set[str] = set()
+        for i in range(lo, hi):
+            if toks[i].text in ("<", ">", "<=", ">=", "==", "!="):
+                for j in (i - 1, i + 1):
+                    if lo <= j < hi and toks[j].kind == "id":
+                        compared.add(toks[j].text)
+            elif toks[i].text == "=" and i > lo \
+                    and toks[i - 1].kind == "id":
+                k = i + 1
+                while k < hi and toks[k].text != ";":
+                    if toks[k].text in ("&", "%", "&="):
+                        compared.add(toks[i - 1].text)
+                        break
+                    k += 1
+        i = lo
+        while i < hi - 4:
+            t = toks[i]
+            # pattern: name [ idx ] =   /  name [ idx ++ ] =
+            if t.kind == "id" and toks[i + 1].text == "[":
+                j = i + 2
+                idx = None
+                if toks[j].kind == "id":
+                    idx = toks[j]
+                    j += 1
+                    if j < hi and toks[j].text in ("++", "--"):
+                        j += 1
+                elif toks[j].text in ("++", "--") \
+                        and toks[j + 1].kind == "id":
+                    idx = toks[j + 1]
+                    j += 2
+                if idx is not None and j < hi \
+                        and toks[j].text == "]" and j + 1 < hi \
+                        and toks[j + 1].text == "=" \
+                        and idx.text not in compared \
+                        and not _waived(mod, fi, RULE_BOUNDS, t.line):
+                    out.append(_finding(
+                        RULE_BOUNDS, mod, fi, t,
+                        f"write to {t.text}[{idx.text}…] but "
+                        f"{idx.text!r} is never compared against any "
+                        "bound in this function",
+                        hint="guard the index against the buffer's "
+                             "capacity before the write (or waive "
+                             "with the invariant that bounds it)"))
+            i += 1
+    return out

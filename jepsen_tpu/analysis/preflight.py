@@ -430,7 +430,7 @@ _NUMERIC_KNOBS = (
 # (doc/performance.md "Packed boolean kernels")
 _BOOL_KNOBS = ("checker_sharded", "explain", "ir_enabled",
                "ir_stream_from_wal", "combine_fused", "resume_check",
-               "trace", "ingest_native")
+               "trace", "ingest_native", "native_san")
 _BOOL_STRINGS = ("1", "0", "true", "false", "yes", "no", "on", "off")
 
 # enum knobs, tolerantly coerced at runtime (pallas_matrix
@@ -464,6 +464,11 @@ _ENV_ENUM_KNOBS = (
     ("JEPSEN_TPU_TRACE", _BOOL_STRINGS,
      "process-wide twin of the trace knob (run-wide causal trace to "
      "trace.json, doc/observability.md)"),
+    ("JEPSEN_TPU_NATIVE_SAN", _BOOL_STRINGS,
+     "process-wide twin of native_san (route the native ingest spine "
+     "through the ASan+UBSan build; unavailable => Python twins with "
+     "the san-unavailable fallback reason, doc/static-analysis.md "
+     "\"Native code\")"),
 )
 
 # numeric env twins: a malformed value silently degrades the whole
@@ -584,6 +589,14 @@ def _check_knobs(test: dict) -> list[Diagnostic]:
             "true streams the run-wide causal trace to trace.json "
             "(Perfetto) plus the per-client span log; the flight "
             "recorder stays on either way (flight_recorder_events)")
+        hints["ingest_native"] = (
+            "true (the default) lets the probed C ingest spine run the "
+            "WAL hot loop; false forces the Python twins")
+        hints["native_san"] = (
+            "true routes the native ingest spine through the ASan+UBSan "
+            "build (requires the runtime LD_PRELOADed; otherwise the "
+            "Python twins run, counted san-unavailable); false/unset = "
+            "the plain -O3 build")
         out.append(Diagnostic(
             "KNB001", ERROR, key,
             f"{key} must be a bool, got {v!r}", hint=hints.get(key)))

@@ -409,7 +409,8 @@ def single_test_cmd(
                            default="text")
 
         p_lint = sub.add_parser(
-            "lint", help="run the concurrency/JAX invariant linter "
+            "lint", help="run the concurrency/JAX/native-C invariant "
+                         "linter; collects .py and .c/.cpp files "
                          "(doc/static-analysis.md)")
         p_lint.add_argument("paths", nargs="*", default=["jepsen_tpu"])
         p_lint.add_argument("--format", choices=["text", "json"],
@@ -423,7 +424,29 @@ def single_test_cmd(
                             help="rewrite the baseline from the current "
                                  "findings")
         p_lint.add_argument("--rule", action="append", dest="rules",
-                            help="restrict to a rule (repeatable)")
+                            help="restrict to a rule (repeatable; globs "
+                                 "allowed: --rule 'jtn-*' runs just the "
+                                 "native C rules)")
+
+        p_fuzz = sub.add_parser(
+            "fuzz-native",
+            help="differential WAL-parser fuzz harness: seeded, "
+                 "grammar-aware byte mutants through the native "
+                 "ingest_chunk (chunked + whole-buffer) vs the Python "
+                 "tolerant parser, byte-exact agreement asserted on "
+                 "every exec; runs under the ASan+UBSan build when "
+                 "available (doc/static-analysis.md \"Native code\")")
+        p_fuzz.add_argument("--execs", type=int, default=100_000,
+                            help="mutant executions (default 100000)")
+        p_fuzz.add_argument("--seed", type=int, default=0,
+                            help="master seed: fully determines the "
+                                 "mutant stream")
+        p_fuzz.add_argument("--no-san", action="store_true",
+                            help="run against the plain -O3 build even "
+                                 "when the sanitizer lane is available")
+        p_fuzz.add_argument("--store-dir", default="store",
+                            help="divergence artifacts land at "
+                                 "<store>/fuzz-native/")
 
         try:
             opts = parser.parse_args(argv)
@@ -466,6 +489,8 @@ def single_test_cmd(
                 return preflight_cmd(opts, test_fn)
             if opts.command == "lint":
                 return lint_cmd(opts)
+            if opts.command == "fuzz-native":
+                return fuzz_native_cmd(opts)
             if opts.command == "serve":
                 from jepsen_tpu.web import serve
                 serve(opts.store_dir, opts.host, opts.port)
@@ -811,6 +836,67 @@ def lint_cmd(opts) -> int:
     else:
         print(lint_mod.render_text(report))
     return EXIT_OK if report.exit_code == 0 else 1
+
+
+def fuzz_native_cmd(opts) -> int:
+    """``jepsen-tpu fuzz-native``: the differential WAL-parser fuzz
+    harness (doc/static-analysis.md "Native code"). By default the run
+    happens under the ASan+UBSan build: when this process doesn't have
+    libasan preloaded (it can't be dlopen'd late — GCC's runtime aborts
+    the process), the command re-execs itself once in a child with
+    ``columnar_c.san_env()``. Exit: 0 clean, 1 divergence found, 2 when
+    no native build is loadable (nothing to differentiate)."""
+    import shutil
+    import subprocess as sp
+
+    from jepsen_tpu.native import columnar_c
+
+    want_san = not getattr(opts, "no_san", False)
+    if want_san and not columnar_c._asan_mapped():
+        env = columnar_c.san_env()
+        built = False
+        if env is not None and shutil.which("g++"):
+            try:
+                columnar_c.build(san=True)
+                built = True
+            except Exception:  # noqa: BLE001 — fall through to plain
+                logger.warning("sanitizer build failed", exc_info=True)
+        if built:
+            print("fuzz-native: re-exec under the ASan+UBSan build "
+                  "(LD_PRELOAD libasan)")
+            sys.stdout.flush()
+            cmd = [sys.executable, "-m", "jepsen_tpu.cli", "fuzz-native",
+                   "--execs", str(opts.execs), "--seed", str(opts.seed),
+                   "--store-dir", opts.store_dir]
+            return sp.run(cmd, env=env).returncode
+        print("fuzz-native: sanitizer lane unavailable (no g++/libasan "
+              "or san build failed); running against the plain -O3 "
+              "build", file=sys.stderr)
+        from jepsen_tpu.history_ir import ingest
+        ingest.fallback_count("san-unavailable")
+        want_san = False
+
+    from jepsen_tpu.fuzz import native as fuzz_native
+    res = fuzz_native.run_fuzz(opts.execs, seed=opts.seed, san=want_san,
+                               store_dir=opts.store_dir, progress=print)
+    if res["status"] == "no-native":
+        print("fuzz-native: no native build loadable in this process; "
+              "nothing to differentiate", file=sys.stderr)
+        return EXIT_UNKNOWN
+    variant = "san" if res["san"] else "plain"
+    print(f"fuzz-native: {res['execs']} execs "
+          f"({res['execs_per_s']:,.0f}/s, variant={variant}, "
+          f"seed={opts.seed}) — {res['ops_parsed']} ops parsed, "
+          f"{res['torn_lines']} torn lines, "
+          f"{res['divergences']} divergence(s)")
+    cov = ", ".join(f"{k}:{v}" for k, v in
+                    sorted(res["operator_coverage"].items()))
+    print(f"  operator coverage: {cov}")
+    if res["divergences"]:
+        for a in res["artifacts"]:
+            print(f"  divergence artifact: {a}", file=sys.stderr)
+        return EXIT_INVALID
+    return EXIT_OK
 
 
 def explain_cmd(opts) -> int:

@@ -1,0 +1,387 @@
+"""Differential WAL-parser fuzz harness (``jepsen-tpu fuzz-native``).
+
+The native ingest spine's third correctness leg, after the JTN lint
+rules and the sanitizer lanes (doc/static-analysis.md "Native code"):
+seeded, grammar-aware byte mutants of realistic WAL traffic are fed
+through the C ``ingest_chunk`` scanner — whole-buffer AND split at
+adversarial chunk boundaries — and every execution asserts byte-exact
+``(ops, consumed, torn, truncated)`` agreement with the pure-Python
+tolerant parser (``journal.parse_wal_chunk_py``). A periodic lane
+round-trips the mutant through a real file and
+``journal.read_jsonl_tolerant`` as a third independent oracle.
+
+Determinism is the contract libFuzzer corpora have and ad-hoc fuzzers
+lack: exec ``i`` under master seed ``s`` derives its own
+``random.Random(f"{s}:{i}")``, so the mutant stream is byte-identical
+across runs, machines, and interpreter sessions (regression-pinned in
+tests/test_lint_native.py), and a divergence artifact names the exact
+``(seed, exec)`` that reproduces it.
+
+Run it under the ASan+UBSan build (the default when the toolchain
+supports it — ``columnar_c.san_env()``): a mutant that walks the C
+scanner out of bounds without corrupting the visible result is
+invisible to the differential but fatal to the sanitizer, and vice
+versa a silent wrong-answer bug is invisible to ASan but caught here.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from pathlib import Path
+
+# -- corpus seeds --------------------------------------------------------
+# Checked-in, not generated: the fuzzer's grammar knowledge lives here.
+# Each seed is one nasty WAL shape the ingest spine must survive; the
+# mutators splice, tear, and bit-rot them from there.
+
+_OPS = b"".join(
+    b'{"type":"invoke","f":"write","value":%d,"process":%d,"time":%d}\n'
+    b'{"type":"ok","f":"write","value":%d,"process":%d,"time":%d}\n'
+    % (v, p, t, v, p, t + 1)
+    for v, p, t in ((3, 0, 11), (7, 1, 13), (9, 2, 17)))
+
+SEEDS: tuple[tuple[str, bytes], ...] = (
+    ("happy", _OPS
+     + b'{"type":"invoke","f":"cas","value":[3,1],"process":1,"time":20}\n'
+     + b'{"type":"ok","f":"cas","value":[3,1],"process":1,"time":21}\n'
+     + b'{"type":"invoke","f":"read","value":null,"process":2,"time":22}\n'
+     + b'{"type":"ok","f":"read","value":1,"process":2,"time":23}\n'),
+    ("torn-final", _OPS
+     + b'{"type":"invoke","f":"read","value":null,"process":0,"time":3'),
+    ("torn-interior",
+     b'{"type":"ok","f":"write","value":1,"process":0,"time":1}\n'
+     b'{"type":"ok","f":"wri\n'
+     b'{"type":"ok","f":"write","value":2,"process":0,"time":2}\n'
+     b'}}}}\n'
+     b'{"type":"ok","f":"write","value":3,"process":0,"time":3}\n'),
+    ("unicode",
+     b'{"u":"\\ud83d\\ude00 caf\\u00e9 \\ud800 \\u0000"}\n'
+     b'{"v":"raw caf\xc3\xa9 \xe2\x82\xac"}\n'
+     b'{"w":"\\n\\t\\"\\\\ \\/ \\b\\f\\r"}\n'),
+    ("numbers",
+     b'{"big":1180591620717411303424,"neg":-0,"tiny":1.5e-3}\n'
+     b'{"huge":123456789012345678901234567890123456789,"z":-0.0}\n'
+     b'{"e":1e308,"f":-1e-308,"g":0.1,"inf":Infinity,"nan":NaN}\n'),
+    ("empties",
+     b'\n   \n\t\n'
+     b'{"type":"ok","f":"read","value":null,"process":0,"time":1}\n'
+     b'\n \n'),
+    ("fleet-chunk",  # one line long enough to straddle receiver chunks
+     b'{"type":"ok","f":"txn","value":[' + b",".join(
+         b"%d" % i for i in range(160)) + b'],"process":5,"time":9}\n'),
+    ("nested",
+     b'{"a":' + b"[" * 24 + b"1" + b"]" * 24 + b',"b":{"c":{"d":[{}]}}}\n'),
+)
+
+# -- seeded mutation operators -------------------------------------------
+
+_BAD_UTF8 = (b"\x80", b"\xc0\xaf", b"\xed\xa0\x80", b"\xf8\x88",
+             b"\xff\xfe", b"\xc3")
+
+
+def _lines(data: bytes) -> list[bytes]:
+    return data.split(b"\n")
+
+
+def _op_splice(rng: random.Random, data: bytes) -> bytes:
+    other = rng.choice(SEEDS)[1]
+    a, b = _lines(data), _lines(other)
+    cut_a = rng.randrange(len(a) + 1)
+    cut_b = rng.randrange(len(b) + 1)
+    return b"\n".join(a[:cut_a] + b[cut_b:])
+
+
+def _op_shuffle(rng: random.Random, data: bytes) -> bytes:
+    ls = _lines(data)
+    rng.shuffle(ls)
+    return b"\n".join(ls)
+
+
+def _op_dup_line(rng: random.Random, data: bytes) -> bytes:
+    ls = _lines(data)
+    i = rng.randrange(len(ls))
+    return b"\n".join(ls[:i] + [ls[i]] * rng.randint(2, 4) + ls[i + 1:])
+
+
+def _op_drop_line(rng: random.Random, data: bytes) -> bytes:
+    ls = _lines(data)
+    i = rng.randrange(len(ls))
+    return b"\n".join(ls[:i] + ls[i + 1:])
+
+
+def _op_truncate(rng: random.Random, data: bytes) -> bytes:
+    if not data:
+        return data
+    return data[:rng.randrange(len(data))]
+
+
+def _op_bit_flip(rng: random.Random, data: bytes) -> bytes:
+    if not data:
+        return data
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 8)):
+        buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+    return bytes(buf)
+
+
+def _op_byte_edit(rng: random.Random, data: bytes) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 6)):
+        which = rng.randrange(3)
+        pos = rng.randrange(len(buf) + 1) if buf else 0
+        if which == 0 or not buf:
+            buf[pos:pos] = bytes([rng.randrange(256)])
+        elif which == 1:
+            del buf[pos % len(buf)]
+        else:
+            buf[pos % len(buf)] = rng.randrange(256)
+    return bytes(buf)
+
+
+def _op_huge_int(rng: random.Random, data: bytes) -> bytes:
+    """Grows a digit run into a 60-300 digit integer — the 2^70 class
+    the columnar value-encoder must route to the bignum path."""
+    runs = [i for i, c in enumerate(data) if 0x31 <= c <= 0x39]
+    if not runs:
+        return data + b'{"v":%s}\n' % (b"9" * rng.randint(60, 300))
+    i = rng.choice(runs)
+    digits = bytes(rng.choice(b"0123456789") for _ in
+                   range(rng.randint(60, 300)))
+    return data[:i] + digits + data[i:]
+
+
+def _op_bad_utf8(rng: random.Random, data: bytes) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 3)):
+        pos = rng.randrange(len(buf) + 1) if buf else 0
+        buf[pos:pos] = rng.choice(_BAD_UTF8)
+    return bytes(buf)
+
+
+def _op_mid_splice(rng: random.Random, data: bytes) -> bytes:
+    """Joins two seeds cut at arbitrary BYTE offsets — the shape a
+    fleet receiver sees when a sender dies mid-frame."""
+    other = rng.choice(SEEDS)[1]
+    a = data[:rng.randrange(len(data) + 1)]
+    b = other[rng.randrange(len(other) + 1):]
+    return a + b
+
+
+OPERATORS: tuple[tuple[str, object], ...] = (
+    ("splice", _op_splice),
+    ("shuffle", _op_shuffle),
+    ("dup-line", _op_dup_line),
+    ("drop-line", _op_drop_line),
+    ("truncate", _op_truncate),
+    ("bit-flip", _op_bit_flip),
+    ("byte-edit", _op_byte_edit),
+    ("huge-int", _op_huge_int),
+    ("bad-utf8", _op_bad_utf8),
+    ("mid-splice", _op_mid_splice),
+)
+
+_MAX_MUTANT = 1 << 16  # mutants never grow unboundedly across stacking
+
+
+def mutant(rng: random.Random) -> tuple[bytes, str, list[str]]:
+    """One mutant: a corpus seed pushed through 1-3 stacked operators.
+    Returns ``(data, seed_name, operator_names)``."""
+    seed_name, data = rng.choice(SEEDS)
+    names: list[str] = []
+    for _ in range(rng.randint(1, 3)):
+        name, op = rng.choice(OPERATORS)
+        data = op(rng, data)[:_MAX_MUTANT]
+        names.append(name)
+    return data, seed_name, names
+
+
+def exec_rng(master_seed: int, i: int) -> random.Random:
+    """The per-exec RNG: derived from ``(master_seed, exec index)`` via
+    string seeding (SHA-512 under the hood — stable across processes
+    and machines, unlike ``hash()``)."""
+    return random.Random(f"jtfuzz:{master_seed}:{i}")
+
+
+def mutant_stream(master_seed: int, n: int):
+    """Yields ``(i, data, seed_name, operator_names)`` for execs
+    ``0..n-1`` — the exact inputs ``run_fuzz`` executes, exposed so the
+    determinism test can pin byte-identity without running the parsers."""
+    for i in range(n):
+        data, seed_name, names = mutant(exec_rng(master_seed, i))
+        yield i, data, seed_name, names
+
+
+# -- execution ------------------------------------------------------------
+
+def _chunked(parse, data: bytes, cuts: list[int], final: bool):
+    """Feeds ``data`` split at ``cuts`` through ``parse`` with the
+    tailer's carry protocol (unconsumed remainder prepends the next
+    piece). The chunk contract says the aggregate must equal the
+    whole-buffer call."""
+    bounds = [0] + cuts + [len(data)]
+    ops: list = []
+    torn = 0
+    total = 0
+    truncated = False
+    buf = b""
+    for k in range(len(bounds) - 1):
+        buf += data[bounds[k]:bounds[k + 1]]
+        last = k == len(bounds) - 2
+        o, c, t, tr = parse(buf, last and final)
+        ops.extend(o)
+        torn += t
+        total += c
+        truncated = bool(tr)
+        buf = buf[c:]
+    return ops, total, torn, truncated
+
+
+def _agree(py, nat) -> bool:
+    from jepsen_tpu.history_ir import ingest
+    return (ingest._deep_eq(list(py[0]), list(nat[0]))
+            and py[1] == nat[1] and py[2] == nat[2]
+            and bool(py[3]) == bool(nat[3]))
+
+
+def _write_divergence(store: Path, i: int, master_seed: int, data: bytes,
+                      mode: str, py, nat) -> Path:
+    d = store / f"div-{i:08d}"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "input.bin").write_bytes(data)
+    meta = {
+        "seed": master_seed, "exec": i, "mode": mode,
+        "python": {"ops": repr(py[0])[:4000], "consumed": py[1],
+                   "torn": py[2], "truncated": bool(py[3])},
+        "native": {"ops": repr(nat[0])[:4000], "consumed": nat[1],
+                   "torn": nat[2], "truncated": bool(nat[3])},
+        "repro": f"jepsen-tpu fuzz-native --seed {master_seed} "
+                 f"--execs {i + 1}",
+    }
+    (d / "meta.json").write_text(json.dumps(meta, indent=2))
+    return d
+
+_MAX_DIVERGENCES = 25   # stop writing artifacts past this; abort run
+_FILE_CHECK_EVERY = 509  # prime stride for the read_jsonl_tolerant lane
+
+
+def run_fuzz(execs: int, seed: int = 0, san: bool = False,
+             store_dir: str = "store", log_every: int = 10_000,
+             progress=None) -> dict:
+    """The harness loop. Returns a stats dict; ``status`` is ``"ok"``,
+    ``"divergence"``, or ``"no-native"`` (toolchain/variant missing —
+    the CLI decides whether that's an error)."""
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py, read_jsonl_tolerant
+    from jepsen_tpu.native import columnar_c
+
+    m = columnar_c.mod(san=san)
+    if m is None or not hasattr(m, "ingest_chunk"):
+        if san:
+            ingest.fallback_count("san-unavailable")
+        return {"status": "no-native", "san": san, "execs": 0,
+                "divergences": 0}
+
+    def native_parse(chunk: bytes, final: bool):
+        return m.ingest_chunk(chunk, final, ingest._line_fallback,
+                              ingest._SKIP, ingest._TORN)
+
+    from jepsen_tpu import telemetry
+    store = Path(store_dir) / "fuzz-native"
+    reg = telemetry.get_registry()
+    exec_ctr = reg.counter("fuzz_native_execs_total",
+                           "differential fuzz executions")
+    div_ctr = reg.counter("fuzz_native_divergence_total",
+                          "C-vs-Python parser divergences found by fuzzing")
+    seed_hits: dict[str, int] = {}
+    op_hits: dict[str, int] = {}
+    divergences: list[str] = []
+    ops_total = 0
+    torn_total = 0
+    flushed = 0
+    i = -1
+    t0 = time.monotonic()
+
+    for i in range(execs):
+        rng = exec_rng(seed, i)
+        data, seed_name, op_names = mutant(rng)
+        seed_hits[seed_name] = seed_hits.get(seed_name, 0) + 1
+        for n in op_names:
+            op_hits[n] = op_hits.get(n, 0) + 1
+        final = rng.random() < 0.5
+
+        py = parse_wal_chunk_py(data, final=final)
+        nat = native_parse(data, final)
+        bad = None
+        if not _agree(py, nat):
+            bad = ("whole", py, nat)
+        else:
+            ncuts = rng.randint(1, 4)
+            cuts = sorted(rng.randrange(len(data) + 1)
+                          for _ in range(ncuts))
+            pyc = _chunked(parse_wal_chunk_py, data, cuts, final)
+            natc = _chunked(native_parse, data, cuts, final)
+            if not _agree(pyc, natc):
+                bad = (f"chunked@{cuts}", pyc, natc)
+            elif not _agree(py, pyc):
+                # the Python twin disagreeing with ITSELF across chunk
+                # boundaries is a protocol bug, not a C bug — still fatal
+                bad = (f"protocol@{cuts}", py, pyc)
+        if bad is None and i % _FILE_CHECK_EVERY == 0 and b"\r" not in data:
+            # third oracle: the file-based tolerant reader. \r excluded
+            # (text-mode universal newlines split on it; the byte
+            # protocol intentionally does not). The appended newline
+            # makes the tail complete so both sides agree final-line
+            # semantics.
+            fdata = data if data.endswith(b"\n") else data + b"\n"
+            fpath = store / f"tmp-{os.getpid()}.jsonl"
+            fpath.parent.mkdir(parents=True, exist_ok=True)
+            fpath.write_bytes(fdata)
+            try:
+                rows, ftrunc = read_jsonl_tolerant(fpath)
+            finally:
+                fpath.unlink(missing_ok=True)
+            fops = parse_wal_chunk_py(fdata, final=True)[0]
+            if not ingest._deep_eq(rows, list(fops)) or ftrunc:
+                bad = ("file-oracle", (fops, len(fdata), 0, False),
+                       (rows, len(fdata), 0, ftrunc))
+        if bad is not None:
+            mode, want, got = bad
+            div_ctr.inc()
+            if len(divergences) < _MAX_DIVERGENCES:
+                d = _write_divergence(store, i, seed, data, mode, want,
+                                      got)
+                divergences.append(str(d))
+            if progress:
+                progress(f"DIVERGENCE exec={i} mode={mode} -> "
+                         f"{divergences[-1] if divergences else '(capped)'}")
+            if len(divergences) >= _MAX_DIVERGENCES:
+                break
+        ops_total += len(py[0])
+        torn_total += py[2]
+        if (i + 1) % log_every == 0:
+            exec_ctr.inc(log_every)
+            flushed += log_every
+            if progress:
+                el = time.monotonic() - t0
+                progress(f"  {i + 1}/{execs} execs, "
+                         f"{(i + 1) / el:,.0f}/s, "
+                         f"{len(divergences)} divergence(s)")
+    done = i + 1
+    if done > flushed:
+        exec_ctr.inc(done - flushed)
+    elapsed = time.monotonic() - t0
+    return {
+        "status": "divergence" if divergences else "ok",
+        "san": san,
+        "execs": done,
+        "elapsed_s": elapsed,
+        "execs_per_s": done / elapsed if elapsed > 0 else 0.0,
+        "divergences": len(divergences),
+        "artifacts": divergences,
+        "ops_parsed": ops_total,
+        "torn_lines": torn_total,
+        "seed_coverage": seed_hits,
+        "operator_coverage": op_hits,
+    }

@@ -70,6 +70,10 @@ _probe_ok: bool | None = None
 # test-map override recorded by configure_from_test (env still wins
 # when the test map is silent)
 _test_override: bool | None = None
+# sanitizer-variant request (test map ``native_san`` / env twin
+# JEPSEN_TPU_NATIVE_SAN). Defaults OFF: the ASan build is a slow-lane
+# correctness tool, never the production spine.
+_test_override_san: bool | None = None
 
 
 @contextlib.contextmanager
@@ -115,14 +119,19 @@ def configure_from_test(test: dict | None) -> None:
         return
     v = test.get("ingest_native")
     _test_override = None if v is None else coerce_flag(v, default=True)
+    global _test_override_san
+    s = test.get("native_san")
+    _test_override_san = (None if s is None
+                          else coerce_flag(s, default=False))
 
 
 def reset() -> None:
-    """Test hook: forget the probe latch and test-map override."""
-    global _probe_ok, _test_override
+    """Test hook: forget the probe latch and test-map overrides."""
+    global _probe_ok, _test_override, _test_override_san
     with _lock:
         _probe_ok = None
         _test_override = None
+        _test_override_san = None
 
 
 def _knob_on() -> bool:
@@ -132,10 +141,22 @@ def _knob_on() -> bool:
                        default=True)
 
 
+def san_on() -> bool:
+    """True when the sanitizer variant of the native spine is requested
+    (test map ``native_san``, env twin ``JEPSEN_TPU_NATIVE_SAN``)."""
+    if _test_override_san is not None:
+        return _test_override_san
+    return coerce_flag(os.environ.get("JEPSEN_TPU_NATIVE_SAN"),
+                       default=False)
+
+
 def _mod():
-    """The C module with the spine entry points, or None."""
+    """The C module with the spine entry points, or None. When the
+    sanitizer lane is requested, ONLY the ASan+UBSan build qualifies —
+    an uninstrumented module must never masquerade as the san lane, so
+    unavailability means the Python twins, loudly counted."""
     from jepsen_tpu.native import columnar_c
-    m = columnar_c.mod()
+    m = columnar_c.mod(san=san_on())
     if m is None or not hasattr(m, "ingest_chunk"):
         return None  # no compiler, build failed, or a stale .so
     return m
@@ -160,9 +181,18 @@ def native_mod():
             with _lock:
                 if _probe_ok is None:
                     _probe_ok = False
-            fallback_count("build")
-            logger.info("native ingest unavailable (no compiled "
-                        "extension); using Python ingest twins")
+            if san_on():
+                # distinct reason: a requested-but-missing sanitizer
+                # build must never be confused with a plain build miss
+                fallback_count("san-unavailable")
+                logger.warning(
+                    "sanitizer ingest build requested "
+                    "(native_san/JEPSEN_TPU_NATIVE_SAN) but unavailable "
+                    "in this process; using Python ingest twins")
+            else:
+                fallback_count("build")
+                logger.info("native ingest unavailable (no compiled "
+                            "extension); using Python ingest twins")
         return None
     if _probe_ok:
         return m

@@ -399,15 +399,41 @@ def parse_wal_chunk_py(chunk: bytes, final: bool = False):
         # (~2.7x a per-line loop); tolerant per-line path only when
         # something in the chunk doesn't parse
         body = chunk[:nl]
+        text = None
         try:
-            ops = loads(b"[" + body.replace(b"\n", b",") + b"]")
+            # strict decode BEFORE the one-array parse: json.loads on
+            # raw bytes decodes with surrogatepass, so a chunk of
+            # all-valid lines would keep raw lone-surrogate bytes as
+            # surrogates while the same line next to a torn neighbor
+            # (or read through WalTailer/read_jsonl_tolerant) gets
+            # U+FFFD replacement — parse results must not depend on
+            # neighboring lines (found by fuzz-native, exec seed 0:271).
+            # Join with ",\n", NOT ",": a torn line with an unbalanced
+            # quote would otherwise swallow bare-comma separators into
+            # its string literal and weld neighboring lines into one
+            # bogus document; keeping the newline makes that a raw
+            # control char inside a string, which strict JSON rejects
+            # (seed 0:2712)
+            text = body.decode("utf-8")
+            ops = loads("[" + text.replace("\n", ",\n") + "]")
+            # the fast path is only trustworthy when every line maps to
+            # exactly ONE array element. Torn lines can weld through a
+            # *structural* position — ",\n" between two halves of a
+            # split numeric array is legal JSON whitespace, so
+            # "[...,1" + "37,...]" parses as one bogus document (seed
+            # 0:90681) — and a single line holding two documents
+            # ("{...},{...}", a mid-line splice) parses as two elements
+            # where the per-line contract says one torn line. Either
+            # direction changes the element count, so a count mismatch
+            # drops to the tolerant per-line path.
+            fast_ok = len(ops) == text.count("\n") + 1
         except (json.JSONDecodeError, UnicodeDecodeError):
+            fast_ok = False
+        if not fast_ok:
             ops = []
-            try:
-                lines = body.decode("utf-8").split("\n")
-            except UnicodeDecodeError:
-                lines = body.decode("utf-8", "replace").split("\n")
-            for line in lines:
+            if text is None:
+                text = body.decode("utf-8", "replace")
+            for line in text.split("\n"):
                 if not line or line.isspace():
                     continue
                 try:

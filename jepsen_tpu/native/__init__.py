@@ -26,27 +26,34 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 
+_SAN_FLAGS = ("-O1", "-g", "-fno-omit-frame-pointer",
+              "-fsanitize=address,undefined", "-fno-sanitize-recover=all")
+
 
 def _build_dir() -> Path:
     d = os.environ.get("JEPSEN_NATIVE_BUILD_DIR")
     return Path(d) if d else _HERE
 
 
-def _so_path() -> Path:
+def _so_path(san: bool = False) -> Path:
     src_hash = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-    return _build_dir() / f"_libwgl-{src_hash}.so"
+    stem = "_libwgl_san" if san else "_libwgl"
+    return _build_dir() / f"{stem}-{src_hash}.so"
 
 
-def build(force: bool = False) -> Path:
-    """Compiles wgl.cpp to a hash-stamped .so (cached)."""
-    so = _so_path()
+def build(force: bool = False, san: bool = False) -> Path:
+    """Compiles wgl.cpp to a hash-stamped .so (cached). ``san`` builds
+    the ASan+UBSan variant as a distinct artifact (doc/static-analysis.md
+    "Native code")."""
+    so = _so_path(san=san)
     if so.exists() and not force:
         return so
     so.parent.mkdir(parents=True, exist_ok=True)
     # per-process tmp name: concurrent builders must not interleave g++
     # output before the atomic publish
     tmp = so.with_suffix(f".so.tmp{os.getpid()}")
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+    perf = _SAN_FLAGS if san else ("-O3", "-march=native")
+    cmd = ["g++", *perf, "-std=c++17", "-shared", "-fPIC",
            "-o", str(tmp), str(_SRC)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -59,8 +66,19 @@ def build(force: bool = False) -> Path:
     return so
 
 
+def _san_on() -> bool:
+    return os.environ.get("JEPSEN_TPU_NATIVE_SAN", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
 def lib():
-    """The loaded library, or None when unbuildable (no g++)."""
+    """The loaded library, or None when unbuildable (no g++).
+
+    Under ``JEPSEN_TPU_NATIVE_SAN=1`` (the sanitizer lane's child env,
+    ``columnar_c.san_env()``) this loads the ASan+UBSan build instead —
+    and REFUSES to serve the uninstrumented one when the ASan runtime
+    is not preloaded: the lane must fall back to the Python search,
+    never masquerade."""
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
@@ -68,7 +86,13 @@ def lib():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            so = build()
+            san = _san_on()
+            if san:
+                from jepsen_tpu.native import columnar_c
+                if not columnar_c._asan_mapped():
+                    raise RuntimeError(
+                        "san wgl requested but libasan is not preloaded")
+            so = build(san=san)
             l = ctypes.CDLL(str(so))
             l.wgl_check.restype = ctypes.c_int
             l.wgl_check.argtypes = [

@@ -260,6 +260,9 @@ static PyObject *parse(PyObject *self, PyObject *args) {
                 goto done;
             }
             if (ev == 1 && prev) {
+                /* prev is always a PyLong WE stored below (i<<1|bit,
+                 * i a list index): in-range, cannot fail.
+                 * lint: ignore[jtn-errcheck] */
                 long long packed = PyLong_AsLongLong(prev);
                 if (packed & 1) c.inv_pos[i] = packed >> 1;
             }
@@ -744,6 +747,10 @@ static PyObject *bk_lookup(const unsigned char *p, Py_ssize_t n) {
 }
 
 /* j->p at the opening quote */
+/* lint: ignore[jtn-bounds-guard] — the UCS4 buffer holds cap = q - s
+ * codepoints and every loop arm consumes >= 1 input byte per emitted
+ * codepoint, so n < cap on every buf[n++] (the fuzz harness hammers
+ * exactly this arithmetic under ASan). */
 static PyObject *jp_string(JP *j) {
     const unsigned char *s = j->p + 1, *q = s;
     int esc = 0, hi = 0;
@@ -844,6 +851,10 @@ static PyObject *jp_string(JP *j) {
         PyObject *u = PyUnicode_FromKindAndData(PyUnicode_4BYTE_KIND, buf,
                                                 n);
         free(buf);
+        /* buf already freed; a MemoryError here must propagate as an
+         * ERROR, while the bail label means "tolerant re-parse" —
+         * routing through it would misfile the failure.
+         * lint: ignore[jtn-cleanup-return] */
         if (!u) return NULL;
         return pool_str(u);
     }
@@ -951,6 +962,8 @@ static PyObject *jp_value(JP *j) {
             PyObject *k = jp_string(j);
             if (!k) {
                 Py_DECREF(d);
+                /* d released inline; obail would double-release.
+                 * lint: ignore[jtn-cleanup-return] */
                 return NULL;
             }
             jp_ws(j);
@@ -963,6 +976,8 @@ static PyObject *jp_value(JP *j) {
             if (!v) {
                 Py_DECREF(k);
                 Py_DECREF(d);
+                /* k, d released inline (obail releases d alone).
+                 * lint: ignore[jtn-cleanup-return] */
                 return NULL;
             }
             int rc = PyDict_SetItem(d, k, v); /* dup keys: last wins */
@@ -970,6 +985,8 @@ static PyObject *jp_value(JP *j) {
             Py_DECREF(v);
             if (rc < 0) {
                 Py_DECREF(d);
+                /* d released inline; error already set by SetItem.
+                 * lint: ignore[jtn-cleanup-return] */
                 return NULL;
             }
             jp_ws(j);
@@ -1533,6 +1550,9 @@ static PyObject *builder_extend(PyObject *self, PyObject *args) {
 
 /* pop(d, key) -> new ref or NULL (check PyErr_Occurred) */
 static PyObject *dict_pop(PyObject *d, PyObject *k) {
+    /* the missing-vs-error split is this helper's documented contract:
+     * every caller checks PyErr_Occurred on NULL (see enc_step_ok).
+     * lint: ignore[jtn-errcheck] */
     PyObject *v = PyDict_GetItemWithError(d, k);
     if (!v) return NULL;
     Py_INCREF(v);

@@ -655,6 +655,79 @@ def test_ingest_chunk_whitespace_and_empty_lines():
     assert torn == 0  # whitespace-only lines skip silently, never count
 
 
+def test_ingest_chunk_raw_surrogate_bytes_get_replaced():
+    """fuzz-native finding (seed 0, exec 271): raw lone-surrogate BYTES
+    (CESU-8 \\xed\\xa0\\x80) parsed differently depending on the
+    neighbors — the fast whole-array path fed raw bytes to json.loads,
+    whose internal decode is surrogatepass, while the tolerant per-line
+    path (and WalTailer/read_jsonl_tolerant) decode with replacement.
+    Pinned: replacement always, regardless of surrounding lines."""
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    line = b'{"f":"\xed\xa0\x80w"}\n'
+    want = {"f": "���w"}
+    solo = parse_wal_chunk_py(line, final=True)
+    noisy = parse_wal_chunk_py(b'{"torn": tr\n' + line, final=True)
+    assert solo[0] == [want], "fast path must not surrogatepass"
+    assert noisy[0] == [want]
+    m = ingest.native_mod()
+    if m is not None:
+        _chunk_both(m, ingest, line, True)
+
+
+def test_ingest_chunk_unbalanced_quote_cannot_weld_lines():
+    """fuzz-native finding (seed 0, exec 2712): a torn line with an
+    unbalanced quote in key position swallowed the fast path's bare
+    "," separators into its string literal and welded itself plus the
+    following lines into ONE syntactically valid document — so the op
+    list depended on where the chunk boundary fell. Pinned: the torn
+    lines stay torn, the valid neighbors parse, nothing welds."""
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    chunk = b'{"ok":1}\n{"a":1,"k:1}\n\n\nb":2}\n{"ok":2}\n'
+    ops, consumed, torn, truncated = parse_wal_chunk_py(chunk, final=True)
+    assert ops == [{"ok": 1}, {"ok": 2}]
+    assert torn == 2 and consumed == len(chunk) and not truncated
+    m = ingest.native_mod()
+    if m is not None:
+        _chunk_both(m, ingest, chunk, True)
+
+
+def test_ingest_chunk_array_tear_cannot_weld_structurally():
+    """fuzz-native finding (seed 0, exec 90681): a line torn INSIDE a
+    numeric array welds through a *structural* position — ",\\n"
+    between "...,1" and "37,...]" is legal JSON whitespace, so the
+    fast path parsed two torn halves as one valid document while the
+    per-line contract (and the C scanner) counts two torn lines.
+    Pinned: element-count-vs-line-count mismatch drops to the
+    tolerant path; the halves stay torn."""
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    chunk = b'{"f":"txn","value":[0,1\n37,2],"time":9}\n'
+    ops, consumed, torn, truncated = parse_wal_chunk_py(chunk, final=True)
+    assert ops == [], "array-context weld must not produce an op"
+    assert torn == 2 and consumed == len(chunk) and not truncated
+    m = ingest.native_mod()
+    if m is not None:
+        _chunk_both(m, ingest, chunk, True)
+
+
+def test_ingest_chunk_multi_document_line_is_torn():
+    """The dual of the weld class: ONE line holding two documents
+    ("{...},{...}", a mid-line splice shape) parsed as two array
+    elements on the fast path, where the per-line contract says one
+    torn line (json.loads: Extra data). Same count-mismatch guard."""
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.journal import parse_wal_chunk_py
+    chunk = b'{"ok":1}\n{"a":1},{"b":2}\n{"ok":2}\n'
+    ops, consumed, torn, truncated = parse_wal_chunk_py(chunk, final=True)
+    assert ops == [{"ok": 1}, {"ok": 2}]
+    assert torn == 1 and consumed == len(chunk) and not truncated
+    m = ingest.native_mod()
+    if m is not None:
+        _chunk_both(m, ingest, chunk, True)
+
+
 def test_wal_tailer_resume_from_offset_prefix_sha(tmp_path):
     """WalTailer.seek's (offset, prefix_sha256) resume token advances
     identically whether the polls ran native or pure-Python — a

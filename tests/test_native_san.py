@@ -1,0 +1,136 @@
+"""Sanitizer lanes: the ASan+UBSan build of the native ingest spine.
+
+Slow-lane (``-m native_san``). The differential suites and a bounded
+fuzz run execute in a CHILD process with the ASan runtime LD_PRELOADed
+(``columnar_c.san_env()``) — GCC's libasan aborts on a late dlopen, so
+the instrumented ``.so`` can never load into this test process
+directly. Gate mirrors conftest's ``_native_ingest_build_guard``: no
+toolchain → soft skip; toolchain present but the san build fails →
+loud ``pytest.exit`` (a silently skipped sanitizer lane would report
+green forever). doc/static-analysis.md "Native code" documents the
+workflow.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.native_san, pytest.mark.slow]
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def san_lane():
+    """(env, so_path) for a sanitizer-capable child, or skip/exit."""
+    from jepsen_tpu.native import columnar_c
+    if shutil.which("g++") is None:
+        pytest.skip("no g++: sanitizer lane unavailable")
+    env = columnar_c.san_env()
+    if env is None:
+        pytest.skip("no libasan/libubsan runtime next to g++")
+    try:
+        so = columnar_c.build(san=True)
+    except Exception as e:  # noqa: BLE001
+        pytest.exit("sanitizer toolchain present but the ASan+UBSan "
+                    f"build of columnar_ext.c failed: {e!r} — the san "
+                    "lane must not silently skip", returncode=3)
+    env["PYTHONPATH"] = str(_REPO)
+    return env, so
+
+
+def _run(cmd, env, timeout=600):
+    return subprocess.run(cmd, env=env, cwd=str(_REPO),
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _assert_no_sanitizer_report(proc):
+    blob = proc.stdout + proc.stderr
+    assert "ERROR: AddressSanitizer" not in blob, blob[-4000:]
+    assert "runtime error:" not in blob, blob[-4000:]  # UBSan
+
+
+def test_san_build_is_distinct_artifact(san_lane):
+    from jepsen_tpu.native import columnar_c
+    env, so = san_lane
+    assert "_columnar_c_san-" in Path(so).name
+    assert Path(so) != columnar_c._so_path(san=False)
+
+
+def test_differential_suites_under_asan(san_lane):
+    """The existing torn/unicode/bigint/resume differentials, re-run
+    with the instrumented scanner doing the work."""
+    env, _so = san_lane
+    proc = _run([sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+                 "tests/test_history_ir.py",
+                 "-k", "ingest_chunk or wal_tailer_resume"],
+                env)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    _assert_no_sanitizer_report(proc)
+    # the suite must have RUN the native cases, not skipped them
+    assert "skipped" not in proc.stdout.lower() or " 0 skipped" in proc.stdout
+
+
+def test_wgl_differentials_under_asan(san_lane):
+    """The C++ WGL search's unit + random-history differential suite,
+    re-run against the instrumented `_libwgl_san` build (the child's
+    JEPSEN_TPU_NATIVE_SAN=1 routes `native.lib()` to it)."""
+    from jepsen_tpu import native
+    env, _so = san_lane
+    try:
+        native.build(san=True)
+    except Exception as e:  # noqa: BLE001
+        pytest.exit("sanitizer toolchain present but the ASan+UBSan "
+                    f"build of wgl.cpp failed: {e!r}", returncode=3)
+    proc = _run([sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+                 "tests/test_native.py"], env)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    _assert_no_sanitizer_report(proc)
+    assert "skipped" not in proc.stdout.lower() or " 0 skipped" in proc.stdout
+
+
+def test_bounded_fuzz_under_asan(san_lane, tmp_path):
+    """A bounded fuzz-native run in the sanitized child: zero
+    divergences AND zero sanitizer reports."""
+    env, _so = san_lane
+    proc = _run([sys.executable, "-m", "jepsen_tpu.cli", "fuzz-native",
+                 "--execs", "2000", "--seed", "1",
+                 "--store-dir", str(tmp_path)], env)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "variant=san" in proc.stdout, proc.stdout[-2000:]
+    assert "0 divergence(s)" in proc.stdout
+    _assert_no_sanitizer_report(proc)
+
+
+def test_san_unavailable_counts_distinct_fallback(monkeypatch):
+    """In THIS (non-preloaded) process the san variant must refuse to
+    load, and the ingest layer must fall back to the Python twins with
+    the dedicated ``san-unavailable`` reason — never a silently
+    uninstrumented native path."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.history_ir import ingest
+    from jepsen_tpu.native import columnar_c
+
+    monkeypatch.setattr(columnar_c, "_mod_san", None)
+    monkeypatch.setattr(columnar_c, "_mod_san_failed", False)
+    monkeypatch.setenv("JEPSEN_TPU_NATIVE_SAN", "1")
+    ingest.reset()
+    try:
+        with telemetry.use(telemetry.Registry()) as reg:
+            assert ingest.native_mod() is None
+            # and the chunk parse still works, through the Python twin
+            ops, consumed, torn, trunc = ingest.parse_wal_chunk(
+                b'{"type":"ok","f":"read","value":1,"process":0,'
+                b'"time":1}\n', final=True)
+            assert len(ops) == 1 and not trunc
+            cell = reg.counter("native_ingest_fallback_total",
+                               labels=("reason",)).cell(
+                                   reason="san-unavailable")
+            assert cell[0] >= 1
+    finally:
+        ingest.reset()
